@@ -1,0 +1,25 @@
+"""futuresdr_tpu — a TPU-native SDR dataflow framework.
+
+A brand-new framework with the capabilities of FutureSDR (reference: Rust, at
+github.com/futuresdr/futuresdr): asynchronous flowgraphs of DSP blocks with stream ports
+(sample buffers) and message ports (Pmt RPC/events), run by pluggable schedulers — designed
+idiomatically for TPUs: the host control plane is an asyncio actor runtime over (C++-backed)
+ring buffers, and the compute plane batches sample frames into TPU HBM, running fused
+FIR/FFT/resampler/channelizer stages as jitted JAX/XLA/Pallas programs.
+"""
+
+__version__ = "0.1.0"
+
+from .types import Pmt, PmtKind
+from .config import config
+from .log import logger
+from .runtime import (Flowgraph, Runtime, Kernel, WorkIo, Mocker, Tag, ItemTag,
+                      message_handler, AsyncScheduler, ThreadedScheduler, FlowgraphError,
+                      ConnectError)
+
+__all__ = [
+    "Pmt", "PmtKind", "config", "logger",
+    "Flowgraph", "Runtime", "Kernel", "WorkIo", "Mocker", "Tag", "ItemTag",
+    "message_handler", "AsyncScheduler", "ThreadedScheduler", "FlowgraphError",
+    "ConnectError", "blocks",
+]

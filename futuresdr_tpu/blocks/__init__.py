@@ -1,0 +1,19 @@
+"""Block library.
+
+Re-design of the reference's ~60-block catalog (``src/blocks/mod.rs:1-110``). Grouped modules:
+functional, vector, stream, dsp, message, io, audio, hardware (seify-style), tpu acceleration.
+"""
+
+from .functional import (Apply, Combine, Filter, Split, Source, FiniteSource, Sink,
+                         ApplyNM, ApplyIntoIter)
+from .vector import VectorSource, VectorSink, NullSource, NullSink, CopyRand
+from .stream import (Copy, Head, Throttle, MovingAvg, TagDebug, Delay,
+                     StreamDuplicator, StreamDeinterleaver, Selector)
+
+__all__ = [
+    "Apply", "Combine", "Filter", "Split", "Source", "FiniteSource", "Sink",
+    "ApplyNM", "ApplyIntoIter",
+    "VectorSource", "VectorSink", "NullSource", "NullSink", "CopyRand",
+    "Copy", "Head", "Throttle", "MovingAvg", "TagDebug", "Delay",
+    "StreamDuplicator", "StreamDeinterleaver", "Selector",
+]

@@ -1,0 +1,132 @@
+"""Vector / null sources and sinks — the test & bench workhorses.
+
+Reference: ``VectorSource``/``VectorSink`` (used throughout ``tests/``), ``NullSource``/
+``NullSink`` and ``CopyRand`` (the ``perf/`` harness blocks, ``perf/fir/fir.rs:49-72``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..runtime.kernel import Kernel
+
+__all__ = ["VectorSource", "VectorSink", "NullSource", "NullSink", "CopyRand"]
+
+
+class VectorSource(Kernel):
+    """Emit a fixed vector (optionally repeated), then EOS."""
+
+    def __init__(self, items, dtype=None, repeat: int = 1):
+        super().__init__()
+        self.items = np.asarray(items, dtype=dtype)
+        self.repeat = repeat
+        self._pos = 0
+        self._round = 0
+        self.output = self.add_stream_output("out", self.items.dtype)
+
+    async def work(self, io, mio, meta):
+        out = self.output.slice()
+        n = len(out)
+        produced = 0
+        while produced < n:
+            if self._round >= self.repeat:
+                break
+            take = min(n - produced, len(self.items) - self._pos)
+            out[produced:produced + take] = self.items[self._pos:self._pos + take]
+            produced += take
+            self._pos += take
+            if self._pos == len(self.items):
+                self._pos = 0
+                self._round += 1
+        if produced:
+            self.output.produce(produced)
+        if self._round >= self.repeat:
+            io.finished = True
+        elif produced > 0:
+            io.call_again = True  # progress made; more space may exist past the wrap
+
+
+class VectorSink(Kernel):
+    """Collect everything; final state readable after ``run`` (`tests/flowgraph.rs:63-70`)."""
+
+    def __init__(self, dtype, capacity: int = 0):
+        super().__init__()
+        self.input = self.add_stream_input("in", dtype)
+        self._chunks: List[np.ndarray] = []
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        if len(inp):
+            self._chunks.append(inp.copy())
+            self.input.consume(len(inp))
+        if self.input.finished():
+            io.finished = True
+
+    def items(self) -> np.ndarray:
+        if not self._chunks:
+            return np.zeros(0, dtype=self.input.dtype)
+        return np.concatenate(self._chunks)
+
+
+class NullSource(Kernel):
+    """Zeros forever (`blocks/null_source`)."""
+
+    def __init__(self, dtype):
+        super().__init__()
+        self.output = self.add_stream_output("out", dtype)
+
+    async def work(self, io, mio, meta):
+        n = self.output.space()
+        if n:
+            # buffer is zero-initialized; producing without writing is the fast path
+            self.output.produce(n)
+            io.call_again = True
+        # n == 0: park until a reader consumes (its consume() notifies this block)
+
+
+class NullSink(Kernel):
+    """Count-and-drop (`blocks/null_sink`); with ``count`` it finishes after n items."""
+
+    def __init__(self, dtype, count: Optional[int] = None):
+        super().__init__()
+        self.input = self.add_stream_input("in", dtype)
+        self.count = count
+        self.n_received = 0
+
+    async def work(self, io, mio, meta):
+        n = self.input.available()
+        if n:
+            self.input.consume(n)
+            self.n_received += n
+        if self.count is not None and self.n_received >= self.count:
+            io.finished = True
+        elif self.input.finished() and self.input.available() == 0:
+            io.finished = True
+
+
+class CopyRand(Kernel):
+    """Copy with randomized chunk sizes (`perf/perf/src/copy_rand.rs`) — stresses the
+    wake/backpressure protocol with irregular work windows."""
+
+    def __init__(self, dtype, max_copy: int = 512, seed: int = 1):
+        super().__init__()
+        self.input = self.add_stream_input("in", dtype)
+        self.output = self.add_stream_output("out", dtype)
+        self.max_copy = max_copy
+        self._rng = np.random.default_rng(seed)
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        out = self.output.slice()
+        n = min(len(inp), len(out))
+        if n > 0:
+            n = min(n, 1 + int(self._rng.integers(self.max_copy)))
+            out[:n] = inp[:n]
+            self.input.consume(n)
+            self.output.produce(n)
+        if self.input.finished() and n == len(inp):
+            io.finished = True
+        elif n > 0 and n < len(inp):
+            io.call_again = True

@@ -1,0 +1,91 @@
+"""Layered global configuration.
+
+Re-design of the reference's config system (``src/runtime/config.rs:16-210``): defaults ←
+``~/.config/futuresdr_tpu/config.toml`` ← project ``config.toml`` ← ``FUTURESDR_TPU_*`` env vars.
+Typed knobs plus a free-form ``misc`` map with typed ``get``.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+__all__ = ["Config", "config", "reload_config"]
+
+_ENV_PREFIX = "FUTURESDR_TPU_"
+
+
+@dataclass
+class Config:
+    # Defaults mirror the reference's (`config.rs:180-210`).
+    queue_size: int = 8192                 # inbox capacity
+    buffer_size: int = 32768               # stream buffer size in bytes
+    slab_reserved: int = 128               # reserved history items for slab buffers
+    stack_size: int = 16 * 1024 * 1024     # (informational; Python threads use default)
+    log_level: str = "info"
+    ctrlport_enable: bool = False
+    ctrlport_bind: str = "127.0.0.1:1337"
+    frontend_path: Optional[str] = None
+    # TPU-specific knobs (no reference analog; this is the compute-plane config).
+    tpu_frame_size: int = 1 << 18          # samples per device frame
+    tpu_frames_in_flight: int = 4          # dispatch pipeline depth
+    misc: dict = field(default_factory=dict)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        """Typed free-form lookup (`config.rs:37-48`)."""
+        if hasattr(self, key) and key != "misc":
+            return getattr(self, key)
+        return self.misc.get(key, default)
+
+    def _apply(self, d: dict):
+        for k, v in d.items():
+            if hasattr(self, k) and k != "misc":
+                cur = getattr(self, k)
+                if isinstance(cur, bool) and isinstance(v, str):
+                    v = v.lower() in ("1", "true", "yes", "on")
+                elif isinstance(cur, int) and not isinstance(cur, bool):
+                    v = int(v)
+                setattr(self, k, v)
+            else:
+                self.misc[k] = v
+
+
+def _load() -> Config:
+    c = Config()
+    for path in (
+        Path.home() / ".config" / "futuresdr_tpu" / "config.toml",
+        Path.cwd() / "config.toml",
+    ):
+        try:
+            if path.is_file():
+                with open(path, "rb") as f:
+                    c._apply(tomllib.load(f))
+        except (OSError, tomllib.TOMLDecodeError):
+            pass
+    env = {
+        k[len(_ENV_PREFIX):].lower(): v
+        for k, v in os.environ.items()
+        if k.startswith(_ENV_PREFIX)
+    }
+    c._apply(env)
+    return c
+
+
+_config: Optional[Config] = None
+
+
+def config() -> Config:
+    """The process-global config singleton (`config.rs:16`)."""
+    global _config
+    if _config is None:
+        _config = _load()
+    return _config
+
+
+def reload_config() -> Config:
+    global _config
+    _config = _load()
+    return _config

@@ -1,0 +1,47 @@
+"""Structured logging setup.
+
+Reference: ``src/runtime/logging.rs:7-26`` (tracing-subscriber with ``FUTURESDR_LOG`` env filter).
+Here: stdlib logging with ``FUTURESDR_TPU_LOG`` overriding the config level.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from .config import config
+
+__all__ = ["init", "logger"]
+
+_initialized = False
+
+_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "off": logging.CRITICAL,
+}
+
+
+def init() -> None:
+    global _initialized
+    if _initialized:
+        return
+    level_name = os.environ.get("FUTURESDR_TPU_LOG", config().log_level).lower()
+    level = _LEVELS.get(level_name, logging.INFO)
+    root = logging.getLogger("futuresdr_tpu")
+    if not root.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)-5s %(name)s: %(message)s", datefmt="%H:%M:%S"))
+        root.addHandler(h)
+    root.setLevel(level)
+    _initialized = True
+
+
+def logger(name: str = "") -> logging.Logger:
+    init()
+    return logging.getLogger(f"futuresdr_tpu.{name}" if name else "futuresdr_tpu")

@@ -1,0 +1,28 @@
+"""Core runtime: flowgraphs of actor blocks over lock-free stream buffers.
+
+TPU-native re-design of ``src/runtime/`` (reference). Public surface mirrors the reference's
+``futuresdr::runtime`` module: Flowgraph/Runtime/Kernel/WorkIo plus buffers, schedulers, tags,
+and the Mocker test harness.
+"""
+
+from .tag import Tag, ItemTag
+from .work_io import WorkIo
+from .kernel import Kernel, BlockMeta, message_handler
+from .message_output import MessageOutputs
+from .inbox import BlockInbox
+from .block import WrappedKernel
+from .flowgraph import Flowgraph, Chain, ConnectError, default_buffer
+from .runtime import (Runtime, FlowgraphHandle, RunningFlowgraph, RuntimeHandle,
+                      FlowgraphError)
+from .scheduler import Scheduler, AsyncScheduler, ThreadedScheduler
+from .mocker import Mocker
+from .buffer import StreamInput, StreamOutput
+
+__all__ = [
+    "Tag", "ItemTag", "WorkIo", "Kernel", "BlockMeta", "message_handler",
+    "MessageOutputs", "BlockInbox", "WrappedKernel",
+    "Flowgraph", "Chain", "ConnectError", "default_buffer",
+    "Runtime", "FlowgraphHandle", "RunningFlowgraph", "RuntimeHandle", "FlowgraphError",
+    "Scheduler", "AsyncScheduler", "ThreadedScheduler",
+    "Mocker", "StreamInput", "StreamOutput",
+]

@@ -1,0 +1,170 @@
+"""WrappedKernel: the per-block actor task containing the block event loop.
+
+Re-design of ``src/runtime/wrapped_kernel.rs:27-309`` (``run_impl``): the loop drains the inbox
+(Call/Callback/StreamInputDone/Terminate), runs orderly shutdown when finished, parks on the
+coalescing notifier (or a ``WorkIo.block_on`` awaitable) when no work is requested, and otherwise
+calls ``kernel.work``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..log import logger
+from ..types import Pmt
+from .inbox import (BlockInbox, Call, Callback, Initialize, StreamInputDone,
+                    StreamOutputDone, Terminate)
+from .kernel import Kernel
+from .work_io import WorkIo
+
+__all__ = ["WrappedKernel"]
+
+log = logger("runtime.block")
+
+
+class WrappedKernel:
+    """Kernel + meta + inbox: the erased ``dyn Block`` of this framework (`block.rs:20-66`)."""
+
+    def __init__(self, kernel: Kernel, block_id: int):
+        self.kernel = kernel
+        self.inbox = BlockInbox()
+        kernel.meta.id = block_id
+        if not kernel.meta.instance_name:
+            kernel.meta.instance_name = f"{kernel.meta.type_name}_{block_id}"
+
+    @property
+    def id(self) -> int:
+        return self.kernel.meta.id
+
+    @property
+    def instance_name(self) -> str:
+        return self.kernel.meta.instance_name
+
+    @property
+    def is_blocking(self) -> bool:
+        return self.kernel.meta.blocking
+
+    def description(self):
+        from ..types import BlockDescription
+        k = self.kernel
+        return BlockDescription(
+            id=self.id,
+            type_name=k.meta.type_name,
+            instance_name=k.meta.instance_name,
+            stream_inputs=[p.name for p in k.stream_inputs],
+            stream_outputs=[p.name for p in k.stream_outputs],
+            message_inputs=k.message_input_names(),
+            message_outputs=k.mio.names,
+            blocking=k.meta.blocking,
+        )
+
+    async def run(self, fg_inbox) -> None:
+        """The block task body (`wrapped_kernel.rs:60-232`). ``fg_inbox`` is the supervisor's
+        queue receiving Initialized/BlockDone/BlockError (see runtime.py)."""
+        from .runtime import BlockDoneMsg, BlockErrorMsg, InitializedMsg
+
+        kernel = self.kernel
+        meta = kernel.meta
+        io = WorkIo()
+        block_on_task: Optional[asyncio.Task] = None
+
+        # ---- init barrier (`wrapped_kernel.rs:84-99`) ------------------------
+        try:
+            kernel.validate_ports()
+            while True:
+                msg = self.inbox.try_recv()
+                if isinstance(msg, Initialize):
+                    break
+                if isinstance(msg, Terminate):
+                    fg_inbox.send(BlockDoneMsg(self.id, self))
+                    return
+                if msg is None:
+                    await self.inbox.wait()
+                    self.inbox.take_pending()
+            await kernel.init(kernel.mio, meta)
+            fg_inbox.send(InitializedMsg(self.id, ok=True))
+        except Exception as e:  # init failure → BlockError (`runtime.rs:501-505`)
+            log.error("block %s failed in init: %r", self.instance_name, e)
+            fg_inbox.send(BlockErrorMsg(self.id, e))
+            return
+
+        # ---- event loop (`wrapped_kernel.rs:106-229`) ------------------------
+        error: Optional[Exception] = None
+        try:
+            while True:
+                io.call_again |= self.inbox.take_pending()
+                while True:
+                    msg = self.inbox.try_recv()
+                    if msg is None:
+                        break
+                    if isinstance(msg, Call):
+                        try:
+                            await kernel.call_handler(io, meta, msg.port, msg.data)
+                        except Exception as e:
+                            log.error("block %s handler error: %r", self.instance_name, e)
+                        io.call_again = True
+                    elif isinstance(msg, Callback):
+                        try:
+                            result = await kernel.call_handler(io, meta, msg.port, msg.data)
+                        except Exception as e:
+                            log.error("block %s handler error: %r", self.instance_name, e)
+                            result = Pmt.invalid_value()
+                        msg.reply.set(result)
+                        io.call_again = True
+                    elif isinstance(msg, StreamInputDone):
+                        kernel.stream_inputs[msg.port_index].set_finished()
+                        io.call_again = True
+                    elif isinstance(msg, StreamOutputDone):
+                        # downstream reader detached → finish (`wrapped_kernel.rs:136-138`)
+                        io.finished = True
+                    elif isinstance(msg, Terminate):
+                        io.finished = True
+
+                if io.finished:
+                    break
+
+                if not io.call_again:
+                    if block_on_task is None:
+                        aw = io.take_block_on()
+                        if aw is not None:
+                            block_on_task = asyncio.ensure_future(aw)
+                    if block_on_task is not None:
+                        # select(block_on_future, inbox.notified()) — `wrapped_kernel.rs:207-222`
+                        inbox_t = asyncio.ensure_future(self.inbox.wait())
+                        done, _ = await asyncio.wait(
+                            {block_on_task, inbox_t}, return_when=asyncio.FIRST_COMPLETED)
+                        if block_on_task in done:
+                            block_on_task = None
+                            io.call_again = True
+                        if inbox_t not in done:
+                            inbox_t.cancel()
+                    else:
+                        await self.inbox.wait()
+                    continue
+
+                io.reset()
+                await kernel.work(io, kernel.mio, meta)
+        except Exception as e:
+            log.error("block %s failed in work: %r", self.instance_name, e)
+            error = e
+        finally:
+            if block_on_task is not None:
+                block_on_task.cancel()
+
+        # ---- orderly shutdown (`wrapped_kernel.rs:188-205`) ------------------
+        try:
+            for p in kernel.stream_outputs:
+                p.notify_finished()
+            for p in kernel.stream_inputs:
+                p.notify_finished()
+            kernel.mio.notify_finished()
+            await kernel.deinit(kernel.mio, meta)
+        except Exception as e:
+            log.error("block %s failed in deinit: %r", self.instance_name, e)
+            error = error or e
+
+        if error is not None:
+            fg_inbox.send(BlockErrorMsg(self.id, error))
+        else:
+            fg_inbox.send(BlockDoneMsg(self.id, self))

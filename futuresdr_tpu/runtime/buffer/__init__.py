@@ -1,0 +1,177 @@
+"""Buffer layer — the stream data plane between blocks.
+
+Re-design of ``src/runtime/buffer/`` (reference, 5.3k LoC): writers/readers move sample items
+through lock-free-ish shared memory with broadcast (1 writer → N readers), size negotiation at
+connect time, tag transport with index rebasing, and EOS propagation through block inboxes
+(``buffer/mod.rs:361-507``, ``buffer/circular.rs``).
+
+Layering:
+  * :class:`BufferWriter` / :class:`BufferReader` — backend interface (ring, slab, circuit, tpu).
+  * :class:`StreamOutput` / :class:`StreamInput` — the port facades blocks declare as attributes
+    (the reference's ``#[input]``/``#[output]`` struct fields, ``macros/src/lib.rs:494-1082``).
+  * Buffer choice is per-connection, defaulting to the double-mapped circular buffer
+    (the reference's ``DefaultCpuReader/Writer`` aliases, ``buffer/mod.rs:564-575``).
+"""
+
+from __future__ import annotations
+
+import math
+from abc import ABC, abstractmethod
+from typing import List, Optional, Sequence, Type
+
+import numpy as np
+
+from ...config import config
+from ..tag import ItemTag, Tag
+
+__all__ = [
+    "BufferReader",
+    "BufferWriter",
+    "StreamInput",
+    "StreamOutput",
+    "negotiate_capacity",
+]
+
+
+class BufferReader(ABC):
+    """Reader endpoint of one connection (`buffer/mod.rs:361-384,445-477`)."""
+
+    #: index of the input port on the consuming block (for StreamInputDone routing)
+    port_index: int = 0
+
+    @abstractmethod
+    def slice(self) -> np.ndarray:
+        """Readable view of available items (zero-copy where the backend allows)."""
+
+    @abstractmethod
+    def tags(self) -> List[ItemTag]:
+        """Tags in the currently readable window, indices relative to the read position."""
+
+    @abstractmethod
+    def consume(self, n: int) -> None:
+        """Advance the read position; wakes the upstream writer block."""
+
+    @abstractmethod
+    def notify_finished(self) -> None:
+        """Reader's block finished: tell the upstream writer (`circular.rs:332-342`)."""
+
+    def items_available(self) -> int:
+        return len(self.slice())
+
+
+class BufferWriter(ABC):
+    """Writer endpoint owning the storage; broadcasts to N readers (`buffer/mod.rs:391-420`)."""
+
+    @abstractmethod
+    def add_reader(self, reader_inbox, port_index: int, min_items: int = 1) -> BufferReader:
+        """Connect one more reader (`BufferWriter::connect`)."""
+
+    @abstractmethod
+    def slice(self) -> np.ndarray:
+        """Writable view of free space."""
+
+    @abstractmethod
+    def produce(self, n: int, tags: Sequence[ItemTag] = ()) -> None:
+        """Commit n written items (+ tags indexed relative to the write window); wakes readers."""
+
+    @abstractmethod
+    def notify_finished(self) -> None:
+        """Writer's block finished: send StreamInputDone to every reader (`circular.rs:213-222`)."""
+
+    def space_available(self) -> int:
+        return len(self.slice())
+
+
+def negotiate_capacity(itemsize: int, min_items_constraints: Sequence[int],
+                       min_buffer_sizes: Sequence[int]) -> int:
+    """Connect-time size negotiation (`buffer/circular.rs:154-189`).
+
+    Capacity in items = max(config buffer_size in bytes, explicit byte minimums,
+    2× the largest ``min_items`` constraint so a full work window always fits),
+    rounded up to a power of two.
+    """
+    items = max(1, config().buffer_size // itemsize)
+    for b in min_buffer_sizes:
+        if b:
+            items = max(items, math.ceil(b / itemsize))
+    for m in min_items_constraints:
+        if m:
+            items = max(items, 2 * m)
+    return 1 << (items - 1).bit_length()
+
+
+class StreamOutput:
+    """Output port facade declared by a block (`#[output]` field equivalent)."""
+
+    def __init__(self, name: str, dtype, min_items: int = 1,
+                 min_buffer_size: int = 0, buffer: Optional[Type] = None):
+        self.name = name
+        self.dtype = np.dtype(dtype) if dtype is not None else None
+        self.min_items = min_items
+        self.min_buffer_size = min_buffer_size
+        self.buffer = buffer          # backend class override for this port
+        self.writer: Optional[BufferWriter] = None
+        self._pending_tags: List[ItemTag] = []
+
+    # -- work()-time API -------------------------------------------------------
+    def slice(self) -> np.ndarray:
+        return self.writer.slice()
+
+    def space(self) -> int:
+        return self.writer.space_available()
+
+    def add_tag(self, index: int, tag: Tag) -> None:
+        """Attach ``tag`` to item ``index`` of the next ``produce`` window."""
+        self._pending_tags.append(ItemTag(index, tag))
+
+    def produce(self, n: int) -> None:
+        tags, self._pending_tags = self._pending_tags, []
+        self.writer.produce(n, tags)
+
+    def notify_finished(self) -> None:
+        if self.writer is not None:
+            self.writer.notify_finished()
+
+    @property
+    def connected(self) -> bool:
+        return self.writer is not None
+
+
+class StreamInput:
+    """Input port facade declared by a block (`#[input]` field equivalent)."""
+
+    def __init__(self, name: str, dtype, min_items: int = 1):
+        self.name = name
+        self.dtype = np.dtype(dtype) if dtype is not None else None
+        self.min_items = min_items
+        self.reader: Optional[BufferReader] = None
+        self._finished = False        # StreamInputDone received (upstream writer done)
+
+    # -- work()-time API -------------------------------------------------------
+    def slice(self) -> np.ndarray:
+        return self.reader.slice()
+
+    def available(self) -> int:
+        return self.reader.items_available()
+
+    def tags(self, n: Optional[int] = None) -> List[ItemTag]:
+        ts = self.reader.tags()
+        return ts if n is None else [t for t in ts if t.index < n]
+
+    def consume(self, n: int) -> None:
+        self.reader.consume(n)
+
+    def finished(self) -> bool:
+        """Upstream signalled EOS; buffered data may remain (`apply.rs:122-124` pattern)."""
+        return self._finished
+
+    def set_finished(self) -> None:
+        self._finished = True
+
+    def notify_finished(self) -> None:
+        if self.reader is not None:
+            self.reader.notify_finished()
+
+    @property
+    def connected(self) -> bool:
+        return self.reader is not None

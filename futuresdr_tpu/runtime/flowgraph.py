@@ -1,0 +1,222 @@
+"""Flowgraph: the graph container with typed stream/message connect.
+
+Re-design of ``src/runtime/flowgraph.rs:205-653``: owns the blocks plus stream/message edge
+lists; ``connect`` is idempotent on already-added blocks (the reference's ``connect_add.rs``);
+stream connects are dtype-checked at connect time (``tests/connect_error.rs`` behavior); buffers
+are materialized at launch with connect-time size negotiation (``buffer/circular.rs:154-189``).
+
+Connect DSL parity (the reference's ``connect!`` macro, ``crates/macros/src/lib.rs:81-237``):
+``fg.connect(a >> b >> c)`` chains default ports; explicit ports via
+``fg.connect_stream(a, "out", b, "in")``; message edges via ``fg.connect_message(a, "out", b,
+"handler")``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Union
+
+import numpy as np
+
+from ..log import logger
+from ..types import FlowgraphDescription
+from .block import WrappedKernel
+from .buffer import negotiate_capacity
+from .buffer.ring import RingWriter
+from .kernel import Kernel
+
+__all__ = ["Flowgraph", "Chain", "ConnectError", "default_buffer"]
+
+log = logger("runtime.flowgraph")
+
+#: process-default stream buffer backend (upgraded to the C++ double-mapped circular
+#: buffer when the native library is available — see buffer/circular.py)
+_DEFAULT_BUFFER: list = [RingWriter]
+
+
+def default_buffer(cls=None):
+    if cls is not None:
+        _DEFAULT_BUFFER[0] = cls
+    return _DEFAULT_BUFFER[0]
+
+
+class ConnectError(Exception):
+    """Bad port name / dtype mismatch at connect time (`tests/connect_error.rs`)."""
+
+
+class Chain:
+    """Accumulator for the ``a >> b >> c`` stream-connect DSL."""
+
+    def __init__(self, kernels: List[Kernel]):
+        self.kernels = kernels
+
+    def __rshift__(self, other) -> "Chain":
+        if isinstance(other, Kernel):
+            return Chain(self.kernels + [other])
+        if isinstance(other, Chain):
+            return Chain(self.kernels + other.kernels)
+        return NotImplemented
+
+
+@dataclass
+class StreamEdge:
+    src: Kernel
+    src_port: str
+    dst: Kernel
+    dst_port: str
+    buffer: Optional[type] = None   # BufferWriter subclass override
+
+
+@dataclass
+class MessageEdge:
+    src: Kernel
+    src_port: str
+    dst: Kernel
+    dst_port: str
+
+
+class Flowgraph:
+    def __init__(self):
+        self._blocks: List[Optional[WrappedKernel]] = []
+        self._kernel_ids: dict = {}           # id(kernel) -> block id
+        self.stream_edges: List[StreamEdge] = []
+        self.message_edges: List[MessageEdge] = []
+        self._launched = False
+
+    # -- graph building --------------------------------------------------------
+    def add(self, kernel: Kernel) -> Kernel:
+        """Add a block; idempotent (`flowgraph.rs:227-241` + `connect_add.rs`)."""
+        key = id(kernel)
+        if key in self._kernel_ids:
+            return kernel
+        bid = len(self._blocks)
+        self._blocks.append(WrappedKernel(kernel, bid))
+        self._kernel_ids[key] = bid
+        return kernel
+
+    def block_id(self, kernel: Kernel) -> int:
+        return self._kernel_ids[id(kernel)]
+
+    def wrapped(self, kernel_or_id: Union[Kernel, int]) -> WrappedKernel:
+        bid = kernel_or_id if isinstance(kernel_or_id, int) else self.block_id(kernel_or_id)
+        blk = self._blocks[bid]
+        if blk is None:
+            raise RuntimeError("block currently taken by a running flowgraph")
+        return blk
+
+    def connect(self, *items) -> None:
+        """Chain default ports: ``fg.connect(src, mid, snk)`` or ``fg.connect(src > mid > snk)``."""
+        kernels: List[Kernel] = []
+        for it in items:
+            if isinstance(it, Chain):
+                kernels.extend(it.kernels)
+            elif isinstance(it, Kernel):
+                kernels.append(it)
+            else:
+                raise ConnectError(f"cannot connect {it!r}")
+        for a, b in zip(kernels, kernels[1:]):
+            out = a.stream_outputs
+            inp = b.stream_inputs
+            if not out:
+                raise ConnectError(f"{a!r} has no stream outputs")
+            if not inp:
+                raise ConnectError(f"{b!r} has no stream inputs")
+            self.connect_stream(a, out[0].name, b, inp[0].name)
+
+    def connect_stream(self, src: Kernel, src_port: str, dst: Kernel, dst_port: str,
+                       buffer: Optional[type] = None) -> None:
+        """Typed stream connect (`flowgraph.rs:364-423`)."""
+        self.add(src)
+        self.add(dst)
+        op = src.stream_output(src_port)   # raises on bad name
+        ip = dst.stream_input(dst_port)
+        if op.dtype is not None and ip.dtype is not None and op.dtype != ip.dtype:
+            raise ConnectError(
+                f"dtype mismatch: {src!r}.{src_port} is {op.dtype}, {dst!r}.{dst_port} is {ip.dtype}")
+        if ip.reader is not None or any(
+                e.dst is dst and e.dst_port == dst_port for e in self.stream_edges):
+            raise ConnectError(f"input {dst!r}.{dst_port} already connected")
+        self.stream_edges.append(StreamEdge(src, src_port, dst, dst_port, buffer))
+
+    def connect_message(self, src: Kernel, src_port: str, dst: Kernel, dst_port: str) -> None:
+        """Message connect (`flowgraph.rs:585-612`)."""
+        self.add(src)
+        self.add(dst)
+        if src_port not in src.mio.names:
+            raise ConnectError(f"{src!r} has no message output {src_port!r}")
+        if dst_port not in dst.message_input_names():
+            raise ConnectError(f"{dst!r} has no message input {dst_port!r}")
+        self.message_edges.append(MessageEdge(src, src_port, dst, dst_port))
+
+    # -- launch-time materialization ------------------------------------------
+    def _materialize(self) -> None:
+        """Create buffers for all stream edges and wire message ports."""
+        # group stream edges by source port (1 writer → N readers broadcast)
+        groups: dict = {}
+        for e in self.stream_edges:
+            groups.setdefault((id(e.src), e.src_port), []).append(e)
+        for (_, _), edges in groups.items():
+            src = edges[0].src
+            sw = self.wrapped(src)
+            op = src.stream_output(edges[0].src_port)
+            out_index = src.stream_outputs.index(op)
+            dtype = op.dtype
+            if dtype is None:
+                for e in edges:
+                    d = e.dst.stream_input(e.dst_port).dtype
+                    if d is not None:
+                        dtype = d
+                        break
+            if dtype is None:
+                dtype = np.dtype(np.uint8)
+            dst_ports = [e.dst.stream_input(e.dst_port) for e in edges]
+            cap = negotiate_capacity(
+                dtype.itemsize,
+                [op.min_items] + [p.min_items for p in dst_ports],
+                [op.min_buffer_size],
+            )
+            buffer_cls = edges[0].buffer or op.buffer or default_buffer()
+            writer = buffer_cls(dtype, cap, sw.inbox, out_index)
+            op.writer = writer
+            for e, ip in zip(edges, dst_ports):
+                dw = self.wrapped(e.dst)
+                in_index = e.dst.stream_inputs.index(ip)
+                ip.reader = writer.add_reader(dw.inbox, in_index, ip.min_items)
+        # message edges
+        for e in self.message_edges:
+            dw = self.wrapped(e.dst)
+            e.src.mio.connect(e.src_port, dw.inbox, e.dst_port)
+
+    def take_blocks(self) -> List[WrappedKernel]:
+        """Materialize and hand the blocks to the runtime (`flowgraph.rs:614-620`)."""
+        if self._launched:
+            raise RuntimeError("flowgraph already running")
+        self._materialize()
+        self._launched = True
+        blocks = [b for b in self._blocks if b is not None]
+        self._blocks = [None] * len(self._blocks)
+        return blocks
+
+    def restore_blocks(self, blocks: List[WrappedKernel]) -> None:
+        """Put finished blocks back so final state is readable (`flowgraph.rs:622-646`)."""
+        for b in blocks:
+            self._blocks[b.id] = b
+        self._launched = False
+
+    # -- introspection ---------------------------------------------------------
+    def describe(self, fg_id: int = 0) -> FlowgraphDescription:
+        return FlowgraphDescription(
+            id=fg_id,
+            blocks=[b.description() for b in self._blocks if b is not None],
+            stream_edges=[
+                (self.block_id(e.src), e.src_port, self.block_id(e.dst), e.dst_port)
+                for e in self.stream_edges
+            ],
+            message_edges=[
+                (self.block_id(e.src), e.src_port, self.block_id(e.dst), e.dst_port)
+                for e in self.message_edges
+            ],
+        )
+
+    def __len__(self):
+        return len(self._blocks)

@@ -1,0 +1,211 @@
+"""Block inboxes: bounded MPSC control queue + coalescing data-notification.
+
+Re-design of the reference's actor plumbing (``src/runtime/block_inbox.rs:28-191``,
+``src/runtime/mod.rs:178-214``): every block has
+  * an **inbox** for control messages (`BlockMessage`: Initialize/Call/Callback/
+    StreamInputDone/StreamOutputDone/Terminate), and
+  * a **notifier** — a coalescing wake-only flag used by the data plane (buffer produce/consume)
+    so per-item wakeups carry no payload and collapse into one.
+
+Unlike the Rust original (kanal channel + atomic waker), this implementation is loop-agnostic and
+thread-safe: blocks may run on different event loops (multi-loop scheduler, blocking blocks on
+dedicated threads), so waking uses ``call_soon_threadsafe`` when crossing loops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..types import Pmt, PortId
+
+__all__ = [
+    "BlockMessage",
+    "Initialize",
+    "Call",
+    "Callback",
+    "StreamInputDone",
+    "StreamOutputDone",
+    "Terminate",
+    "BlockInbox",
+]
+
+
+class BlockMessage:
+    """Base class of control messages (`src/runtime/mod.rs:178-214`)."""
+
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class Initialize(BlockMessage):
+    pass
+
+
+@dataclass(frozen=True)
+class Call(BlockMessage):
+    port: PortId
+    data: Pmt
+
+
+@dataclass(frozen=True)
+class Callback(BlockMessage):
+    port: PortId
+    data: Pmt
+    reply: "ReplySlot"
+
+
+@dataclass(frozen=True)
+class StreamInputDone(BlockMessage):
+    port_index: int
+
+
+@dataclass(frozen=True)
+class StreamOutputDone(BlockMessage):
+    port_index: int
+
+
+@dataclass(frozen=True)
+class Terminate(BlockMessage):
+    pass
+
+
+class ReplySlot:
+    """A oneshot reply channel usable across event loops (reference: futures oneshot)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value: Any = None
+        self._set = False
+        self._waiter: Optional[tuple] = None  # (loop, asyncio.Event)
+
+    def set(self, value: Any) -> None:
+        with self._lock:
+            if self._set:
+                return
+            self._value = value
+            self._set = True
+            waiter = self._waiter
+        if waiter is not None:
+            loop, ev = waiter
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if loop is running:
+                ev.set()
+            else:
+                loop.call_soon_threadsafe(ev.set)
+
+    async def get(self) -> Any:
+        with self._lock:
+            if self._set:
+                return self._value
+            loop = asyncio.get_running_loop()
+            ev = asyncio.Event()
+            self._waiter = (loop, ev)
+        await ev.wait()
+        return self._value
+
+
+class BlockInbox:
+    """Inbox + coalescing notifier for one block.
+
+    ``send``/``try_send`` enqueue a control message and wake the block.  ``notify`` only sets the
+    coalesced pending flag and wakes (`block_inbox.rs:48-65`).  The block's event loop drains with
+    ``take_pending``/``try_recv`` and parks on ``wait`` (`Notified` future equivalent).
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            from ..config import config
+            capacity = config().queue_size
+        self.capacity = capacity
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._pending = False          # coalesced data notification
+        self._waiter: Optional[tuple] = None  # (loop, asyncio.Event)
+        self.closed = False
+
+    # -- producer side --------------------------------------------------------
+    def send(self, msg: BlockMessage) -> None:
+        """Enqueue a control message and wake the block (`block_inbox.rs:120-136`)."""
+        with self._lock:
+            if self.closed:
+                return
+            self._q.append(msg)
+            waiter = self._take_waiter_locked()
+        self._wake(waiter)
+
+    try_send = send  # soft-bounded; see module docstring
+
+    def notify(self) -> None:
+        """Coalescing data-plane wake: no payload, collapses repeats (`block_inbox.rs:48-52`)."""
+        with self._lock:
+            if self.closed:
+                return
+            self._pending = True
+            waiter = self._take_waiter_locked()
+        self._wake(waiter)
+
+    def _take_waiter_locked(self):
+        w, self._waiter = self._waiter, None
+        return w
+
+    @staticmethod
+    def _wake(waiter):
+        if waiter is None:
+            return
+        loop, ev = waiter
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if loop is running:
+            ev.set()
+        else:
+            try:
+                loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass  # target loop already closed (teardown race)
+
+    # -- consumer side (the block's event loop) --------------------------------
+    def take_pending(self) -> bool:
+        """Consume the coalesced notification flag (`block_inbox.rs:104-111`)."""
+        with self._lock:
+            p, self._pending = self._pending, False
+            return p
+
+    def try_recv(self) -> Optional[BlockMessage]:
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    async def wait(self) -> None:
+        """Park until a message arrives or a notification is pending."""
+        with self._lock:
+            if self._pending or self._q:
+                return
+            loop = asyncio.get_running_loop()
+            ev = asyncio.Event()
+            self._waiter = (loop, ev)
+        await ev.wait()
+
+    async def recv(self) -> BlockMessage:
+        """Blocking receive (used by the flowgraph supervisor's main loop)."""
+        while True:
+            m = self.try_recv()
+            if m is not None:
+                return m
+            await self.wait()
+            self.take_pending()
+
+    def close(self) -> None:
+        with self._lock:
+            self.closed = True
+            self._q.clear()

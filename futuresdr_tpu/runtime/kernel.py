@@ -1,0 +1,171 @@
+"""The user-facing block trait: ``Kernel`` with async ``init``/``work``/``deinit``.
+
+Re-design of the reference's ``Kernel`` trait (``src/runtime/kernel.rs:54-90``) plus the port
+plumbing its ``#[derive(Block)]`` macro generates (``crates/macros/src/lib.rs:419-1121``).
+Instead of derive macros, ports are declared in ``__init__`` via ``add_stream_input/_output``
+(stored as ordered attributes, accessible as ``self.input``…), and message handlers are either
+registered with ``add_message_input`` or marked with the :func:`message_handler` decorator
+(the ``#[message_inputs(...)]`` attribute equivalent).
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..types import Pmt, PortId
+from .buffer import StreamInput, StreamOutput
+from .message_output import MessageOutputs
+from .work_io import WorkIo
+
+__all__ = ["Kernel", "BlockMeta", "message_handler"]
+
+
+@dataclass
+class BlockMeta:
+    """Block metadata (`BlockMeta` in the reference macros)."""
+
+    type_name: str = ""
+    instance_name: str = ""
+    blocking: bool = False
+    id: int = -1
+
+
+def message_handler(fn=None, *, name: Optional[str] = None):
+    """Mark an async method as a message-input handler.
+
+    Handler signature: ``async def h(self, io: WorkIo, mio: MessageOutputs, meta: BlockMeta,
+    pmt: Pmt) -> Pmt``. The handler gets the live WorkIo so it can set ``finished`` /
+    ``call_again`` (reference: handlers take ``&mut WorkIo``, ``tests/flowgraph.rs:30-39``).
+    """
+
+    def mark(f):
+        f._message_handler_name = name or f.__name__
+        return f
+
+    return mark(fn) if fn is not None else mark
+
+
+class Kernel:
+    """Base class for all blocks.
+
+    Subclasses declare ports in ``__init__`` and implement any of ``init``, ``work``, ``deinit``.
+    A kernel with no ``work`` override is a pure message block (`#[null_kernel]` equivalent).
+    """
+
+    #: class-level `#[blocking]` marker: run this block's event loop on a dedicated thread
+    BLOCKING: bool = False
+
+    def __init__(self, type_name: Optional[str] = None):
+        self._stream_inputs: List[StreamInput] = []
+        self._stream_outputs: List[StreamOutput] = []
+        self._message_handlers: Dict[str, Callable] = {}
+        self._mio = MessageOutputs([])
+        self.meta = BlockMeta(
+            type_name=type_name or type(self).__name__,
+            blocking=type(self).BLOCKING,
+        )
+        # Collect decorator-marked handlers (class scan replaces the derive macro).
+        for attr_name, member in inspect.getmembers(type(self), inspect.isfunction):
+            hname = getattr(member, "_message_handler_name", None)
+            if hname:
+                self._message_handlers[hname] = getattr(self, attr_name)
+
+    # -- port declaration ------------------------------------------------------
+    def add_stream_input(self, name: str, dtype, min_items: int = 1) -> StreamInput:
+        port = StreamInput(name, dtype, min_items)
+        self._stream_inputs.append(port)
+        return port
+
+    def add_stream_output(self, name: str, dtype, min_items: int = 1,
+                          min_buffer_size: int = 0, buffer=None) -> StreamOutput:
+        port = StreamOutput(name, dtype, min_items, min_buffer_size, buffer)
+        self._stream_outputs.append(port)
+        return port
+
+    def add_message_input(self, name: str, handler: Callable) -> None:
+        self._message_handlers[name] = handler
+
+    def add_message_output(self, name: str) -> None:
+        self._mio.add_port(name)
+
+    # -- port lookup (KernelInterface equivalent, `kernel_interface.rs:23-64`) -
+    @property
+    def stream_inputs(self) -> List[StreamInput]:
+        return self._stream_inputs
+
+    @property
+    def stream_outputs(self) -> List[StreamOutput]:
+        return self._stream_outputs
+
+    @property
+    def mio(self) -> MessageOutputs:
+        return self._mio
+
+    def stream_input(self, id) -> StreamInput:
+        return self._port(self._stream_inputs, id, "input")
+
+    def stream_output(self, id) -> StreamOutput:
+        return self._port(self._stream_outputs, id, "output")
+
+    @staticmethod
+    def _port(ports, id, kind):
+        if isinstance(id, PortId):
+            id = id.id
+        if isinstance(id, int):
+            try:
+                return ports[id]
+            except IndexError:
+                raise KeyError(f"no stream {kind} #{id}") from None
+        for p in ports:
+            if p.name == id:
+                return p
+        raise KeyError(f"no stream {kind} named {id!r} (have {[p.name for p in ports]})")
+
+    def message_input_names(self) -> List[str]:
+        return list(self._message_handlers)
+
+    async def call_handler(self, io: WorkIo, meta: BlockMeta, port: PortId, pmt: Pmt) -> Pmt:
+        """Dispatch a message to the named handler (`macros/lib.rs:1092-1114`)."""
+        pid = port.id if isinstance(port, PortId) else port
+        if isinstance(pid, int):
+            try:
+                pid = list(self._message_handlers)[pid]
+            except IndexError:
+                return Pmt.invalid_value()
+        handler = self._message_handlers.get(pid)
+        if handler is None:
+            return Pmt.invalid_value()
+        result = handler(io, self._mio, meta, pmt)
+        if inspect.isawaitable(result):
+            result = await result
+        return result if isinstance(result, Pmt) else Pmt.from_py(result)
+
+    # -- validation (stream_ports_validate equivalent) -------------------------
+    def validate_ports(self) -> None:
+        for p in self._stream_inputs:
+            if p.reader is None:
+                raise RuntimeError(
+                    f"{self.meta.instance_name or self.meta.type_name}: input {p.name!r} not connected")
+
+    # -- lifecycle -------------------------------------------------------------
+    async def init(self, mio: MessageOutputs, meta: BlockMeta) -> None:
+        pass
+
+    async def work(self, io: WorkIo, mio: MessageOutputs, meta: BlockMeta) -> None:
+        pass
+
+    async def deinit(self, mio: MessageOutputs, meta: BlockMeta) -> None:
+        pass
+
+    # -- connect DSL sugar: `fg.connect(a >> b >> c)` --------------------------
+    # (the reference's `connect!(fg, a > b > c)`; Python chains `>` comparisons,
+    #  so the stream-chain operator here is `>>`)
+    def __rshift__(self, other):
+        from .flowgraph import Chain
+        return Chain([self]) >> other
+
+    def __repr__(self):
+        nm = self.meta.instance_name or self.meta.type_name
+        return f"<{nm}>"

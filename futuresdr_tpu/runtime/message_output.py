@@ -1,0 +1,45 @@
+"""Named message output ports: fan-out of Pmt values to connected handlers.
+
+Reference: ``src/runtime/message_output.rs:12-121``. ``post`` clones the Pmt to every connected
+handler's inbox as a ``Call``; ``notify_finished`` posts ``Pmt::Finished`` so downstream
+message-driven blocks can complete (``message_output.rs:37-47``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..types import Pmt, PortId
+from .inbox import BlockInbox, Call
+
+__all__ = ["MessageOutputs"]
+
+
+class MessageOutputs:
+    def __init__(self, names: List[str]):
+        self._names = list(names)
+        self._conns: Dict[str, List[Tuple[BlockInbox, PortId]]] = {n: [] for n in names}
+
+    @property
+    def names(self) -> List[str]:
+        return list(self._names)
+
+    def add_port(self, name: str) -> None:
+        if name not in self._conns:
+            self._names.append(name)
+            self._conns[name] = []
+
+    def connect(self, name: str, inbox: BlockInbox, handler: PortId) -> None:
+        self._conns[name].append((inbox, PortId.coerce(handler)))
+
+    def connections(self, name: str):
+        return list(self._conns[name])
+
+    def post(self, name: str, pmt: Pmt) -> None:
+        """Fire-and-forget fan-out (`message_output.rs:49-66`)."""
+        for inbox, handler in self._conns[name]:
+            inbox.send(Call(handler, pmt))
+
+    def notify_finished(self) -> None:
+        for name in self._names:
+            self.post(name, Pmt.finished())

@@ -1,0 +1,359 @@
+"""Runtime: launches flowgraphs and runs the per-flowgraph supervisor.
+
+Re-design of ``src/runtime/runtime.rs`` (reference): ``run_flowgraph`` (``runtime.rs:363-597``)
+is the supervisor coroutine — init barrier, message routing, error→terminate cascade, joins block
+tasks, restores blocks into the flowgraph so final state stays readable. ``FlowgraphHandle``
+(``src/runtime/flowgraph_handle.rs:21-171``) is the clonable control handle used by apps, the
+REST control port, and tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Dict, List, Optional, Union
+
+from ..config import config
+from ..log import logger
+from ..types import FlowgraphDescription, Pmt
+from .block import WrappedKernel
+from .flowgraph import Flowgraph
+from .inbox import BlockInbox, Call, Callback, Initialize, ReplySlot, Terminate
+from .kernel import Kernel
+from .scheduler import AsyncScheduler, Scheduler
+
+__all__ = [
+    "Runtime",
+    "FlowgraphHandle",
+    "RunningFlowgraph",
+    "RuntimeHandle",
+    "InitializedMsg",
+    "BlockDoneMsg",
+    "BlockErrorMsg",
+]
+
+log = logger("runtime")
+
+
+# ---- FlowgraphMessage equivalents (`src/runtime/mod.rs` FlowgraphMessage) ----
+class FlowgraphMessage:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class InitializedMsg(FlowgraphMessage):
+    block_id: int
+    ok: bool
+
+
+@dataclass(frozen=True)
+class BlockDoneMsg(FlowgraphMessage):
+    block_id: int
+    block: WrappedKernel
+
+
+@dataclass(frozen=True)
+class BlockErrorMsg(FlowgraphMessage):
+    block_id: int
+    error: Exception
+
+
+@dataclass(frozen=True)
+class BlockCallMsg(FlowgraphMessage):
+    block_id: int
+    port: Any
+    data: Pmt
+
+
+@dataclass(frozen=True)
+class BlockCallbackMsg(FlowgraphMessage):
+    block_id: int
+    port: Any
+    data: Pmt
+    reply: ReplySlot
+
+
+@dataclass(frozen=True)
+class DescribeMsg(FlowgraphMessage):
+    reply: ReplySlot
+
+
+@dataclass(frozen=True)
+class TerminateMsg(FlowgraphMessage):
+    pass
+
+
+class FlowgraphError(RuntimeError):
+    """A block errored; the flowgraph was terminated (`tests/fail.rs` behavior)."""
+
+
+async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
+                                   fg_inbox: BlockInbox,
+                                   initialized: ReplySlot) -> Flowgraph:
+    """The per-flowgraph supervisor (`runtime.rs:363-597`)."""
+    blocks = fg.take_blocks()
+    by_id: Dict[int, WrappedKernel] = {b.id: b for b in blocks}
+    handles = scheduler.run_flowgraph_blocks(blocks, fg_inbox)
+
+    # ---- init barrier (`runtime.rs:380-415`) --------------------------------
+    for b in blocks:
+        b.inbox.send(Initialize())
+    waiting = len(blocks)
+    active = len(blocks)
+    finished: List[WrappedKernel] = []
+    errors: List[Exception] = []
+    queued: List[FlowgraphMessage] = []
+    while waiting > 0:
+        msg = await fg_inbox.recv()
+        if isinstance(msg, InitializedMsg):
+            waiting -= 1
+        elif isinstance(msg, BlockErrorMsg):
+            waiting -= 1
+            active -= 1
+            errors.append(msg.error)
+        elif isinstance(msg, BlockDoneMsg):
+            waiting -= 1
+            active -= 1
+            finished.append(msg.block)
+        else:
+            queued.append(msg)   # early control messages; replay after barrier
+
+    terminated = False
+    if errors:
+        for b in blocks:
+            b.inbox.send(Terminate())
+        terminated = True
+
+    # ---- start signal (`runtime.rs:418-429`) --------------------------------
+    for b in blocks:
+        b.inbox.notify()
+    initialized.set(errors[0] if errors else None)
+
+    # ---- main loop (`runtime.rs:440-571`) -----------------------------------
+    def handle(msg: FlowgraphMessage):
+        nonlocal active, terminated
+        if isinstance(msg, BlockCallMsg):
+            blk = by_id.get(msg.block_id)
+            if blk is not None:
+                blk.inbox.send(Call(msg.port, msg.data))
+        elif isinstance(msg, BlockCallbackMsg):
+            blk = by_id.get(msg.block_id)
+            if blk is None:
+                msg.reply.set(Pmt.invalid_value())
+            else:
+                blk.inbox.send(Callback(msg.port, msg.data, msg.reply))
+        elif isinstance(msg, DescribeMsg):
+            msg.reply.set(_describe(fg, blocks))
+        elif isinstance(msg, TerminateMsg):
+            if not terminated:
+                for b in blocks:
+                    b.inbox.send(Terminate())
+                terminated = True
+        elif isinstance(msg, BlockDoneMsg):
+            active -= 1
+            finished.append(msg.block)
+        elif isinstance(msg, BlockErrorMsg):
+            active -= 1
+            errors.append(msg.error)
+            if not terminated:
+                log.error("block %d errored (%r): terminating flowgraph",
+                          msg.block_id, msg.error)
+                for b in blocks:
+                    b.inbox.send(Terminate())
+                terminated = True
+
+    for msg in queued:
+        handle(msg)
+    while active > 0:
+        handle(await fg_inbox.recv())
+
+    # ---- join + restore (`runtime.rs:589-596`) ------------------------------
+    for h in handles:
+        try:
+            await h
+        except Exception as e:
+            log.error("block task raised: %r", e)
+    fg.restore_blocks(finished)
+    if errors:
+        raise FlowgraphError(str(errors[0])) from errors[0]
+    return fg
+
+
+def _describe(fg: Flowgraph, blocks: List[WrappedKernel]) -> FlowgraphDescription:
+    desc = FlowgraphDescription(id=0, blocks=[b.description() for b in blocks])
+    desc.stream_edges = [
+        (fg.block_id(e.src), e.src_port, fg.block_id(e.dst), e.dst_port)
+        for e in fg.stream_edges
+    ]
+    desc.message_edges = [
+        (fg.block_id(e.src), e.src_port, fg.block_id(e.dst), e.dst_port)
+        for e in fg.message_edges
+    ]
+    return desc
+
+
+class FlowgraphHandle:
+    """Clonable control handle (`flowgraph_handle.rs:21-171`).
+
+    Async methods must run on the scheduler loop; the ``*_sync`` variants bridge from plain
+    threads (the reference's ``block_on``).
+    """
+
+    def __init__(self, fg: Flowgraph, fg_inbox: BlockInbox, scheduler: Scheduler):
+        self._fg = fg
+        self._inbox = fg_inbox
+        self._scheduler = scheduler
+
+    def _bid(self, block: Union[Kernel, int]) -> int:
+        return block if isinstance(block, int) else self._fg.block_id(block)
+
+    # -- async API -------------------------------------------------------------
+    async def post(self, block: Union[Kernel, int], port, data: Pmt = None) -> None:
+        """Fire-and-forget handler invocation (`flowgraph_handle.rs:64-83`)."""
+        data = Pmt.from_py(data) if not isinstance(data, Pmt) else data
+        self._inbox.send(BlockCallMsg(self._bid(block), port, data))
+
+    async def call(self, block: Union[Kernel, int], port, data: Pmt = None) -> Pmt:
+        """Invoke a handler and await its Pmt result (`flowgraph_handle.rs:85-104`)."""
+        data = Pmt.from_py(data) if not isinstance(data, Pmt) else data
+        reply = ReplySlot()
+        self._inbox.send(BlockCallbackMsg(self._bid(block), port, data, reply))
+        return await reply.get()
+
+    async def describe(self) -> FlowgraphDescription:
+        reply = ReplySlot()
+        self._inbox.send(DescribeMsg(reply))
+        return await reply.get()
+
+    async def terminate(self) -> None:
+        self._inbox.send(TerminateMsg())
+
+    # -- sync bridges ----------------------------------------------------------
+    def post_sync(self, block, port, data: Pmt = None) -> None:
+        data = Pmt.from_py(data) if not isinstance(data, Pmt) else data
+        self._inbox.send(BlockCallMsg(self._bid(block), port, data))
+
+    def call_sync(self, block, port, data: Pmt = None) -> Pmt:
+        return self._scheduler.run_coro_sync(self.call(block, port, data))
+
+    def describe_sync(self) -> FlowgraphDescription:
+        return self._scheduler.run_coro_sync(self.describe())
+
+    def terminate_sync(self) -> None:
+        self._inbox.send(TerminateMsg())
+
+
+class RunningFlowgraph:
+    """Handle + completion future (`src/runtime/running_flowgraph.rs:19-98`)."""
+
+    def __init__(self, handle: FlowgraphHandle, task: Awaitable, scheduler: Scheduler):
+        self.handle = handle
+        self._task = task
+        self._scheduler = scheduler
+
+    async def wait(self) -> Flowgraph:
+        """Await completion; returns the flowgraph with final block state."""
+        return await self._task
+
+    def wait_sync(self) -> Flowgraph:
+        return self._scheduler.run_coro_sync(self._wrap())
+
+    async def _wrap(self):
+        return await self._task
+
+    async def stop(self) -> Flowgraph:
+        await self.handle.terminate()
+        return await self.wait()
+
+    def stop_sync(self) -> Flowgraph:
+        self.handle.terminate_sync()
+        return self.wait_sync()
+
+
+class RuntimeHandle:
+    """Registry of running flowgraphs for the control plane (`runtime.rs:311-349`)."""
+
+    def __init__(self, scheduler: Scheduler):
+        self.scheduler = scheduler
+        self._flowgraphs: Dict[int, FlowgraphHandle] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def register(self, handle: FlowgraphHandle) -> int:
+        with self._lock:
+            fg_id = self._next_id
+            self._next_id += 1
+            self._flowgraphs[fg_id] = handle
+            return fg_id
+
+    def unregister(self, fg_id: int) -> None:
+        with self._lock:
+            self._flowgraphs.pop(fg_id, None)
+
+    def get_flowgraph(self, fg_id: int) -> Optional[FlowgraphHandle]:
+        with self._lock:
+            return self._flowgraphs.get(fg_id)
+
+    def flowgraph_ids(self) -> List[int]:
+        with self._lock:
+            return list(self._flowgraphs)
+
+
+class Runtime:
+    """Owns the scheduler and (optionally) the REST control port (`runtime.rs:55-207`)."""
+
+    def __init__(self, scheduler: Optional[Scheduler] = None):
+        self.scheduler = scheduler or AsyncScheduler()
+        self.handle = RuntimeHandle(self.scheduler)
+        self._ctrl_port = None
+        if config().ctrlport_enable:
+            from .ctrl_port import ControlPort
+            self._ctrl_port = ControlPort(self.handle)
+            self._ctrl_port.start()
+
+    # -- async API -------------------------------------------------------------
+    async def start_async(self, fg: Flowgraph) -> RunningFlowgraph:
+        """Launch; resolves once all blocks passed the init barrier (`runtime.rs:169-191`)."""
+        fg_inbox = BlockInbox()
+        initialized = ReplySlot()
+        loop = asyncio.get_running_loop()
+        task = loop.create_task(
+            run_flowgraph_supervisor(fg, self.scheduler, fg_inbox, initialized))
+        handle = FlowgraphHandle(fg, fg_inbox, self.scheduler)
+        fg_id = self.handle.register(handle)
+        err = await initialized.get()
+        join = loop.create_task(_unregister_on_done(task, self.handle, fg_id))
+        running = RunningFlowgraph(handle, join, self.scheduler)
+        if err is not None:
+            # propagate init failure after blocks drained (`tests/fail.rs:66-104`)
+            try:
+                await running.wait()
+            finally:
+                self.handle.unregister(fg_id)
+            raise FlowgraphError(str(err)) from err
+        return running
+
+    async def run_async(self, fg: Flowgraph) -> Flowgraph:
+        running = await self.start_async(fg)
+        return await running.wait()
+
+    # -- sync API --------------------------------------------------------------
+    def run(self, fg: Flowgraph) -> Flowgraph:
+        """Run to completion (`runtime.rs:204-207`)."""
+        return self.scheduler.run_coro_sync(self.run_async(fg))
+
+    def start(self, fg: Flowgraph) -> RunningFlowgraph:
+        return self.scheduler.run_coro_sync(self.start_async(fg))
+
+    def shutdown(self) -> None:
+        if self._ctrl_port is not None:
+            self._ctrl_port.stop()
+        self.scheduler.shutdown()
+
+
+async def _unregister_on_done(task, rt_handle: RuntimeHandle, fg_id: int):
+    try:
+        return await task
+    finally:
+        rt_handle.unregister(fg_id)

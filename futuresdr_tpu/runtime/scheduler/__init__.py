@@ -1,0 +1,19 @@
+"""Pluggable schedulers executing block tasks.
+
+Re-design of ``src/runtime/scheduler/`` (reference): the ``Scheduler`` interface spawns the
+per-block actor tasks and arbitrary coroutines. Python analogs:
+
+  * :class:`AsyncScheduler` (default) — one asyncio event loop on a dedicated thread; blocking
+    blocks (``Kernel.BLOCKING``) run their event loop on their own thread with a private loop
+    (the ``blocking::unblock`` pool of ``smol.rs:119-125``).
+  * :class:`ThreadedScheduler` — N event-loop worker threads with blocks pinned to workers,
+    either explicitly or by block id (the ``FlowScheduler``'s pinned local queues,
+    ``flow.rs:79-136``). Python's GIL means this wins only for workloads that release the GIL
+    (numpy kernels, TPU dispatch, IO) — which is exactly the hot path here.
+"""
+
+from .base import Scheduler
+from .async_scheduler import AsyncScheduler
+from .threaded import ThreadedScheduler
+
+__all__ = ["Scheduler", "AsyncScheduler", "ThreadedScheduler"]

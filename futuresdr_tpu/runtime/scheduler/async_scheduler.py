@@ -1,0 +1,96 @@
+"""Default scheduler: one event loop thread + a thread pool for blocking blocks.
+
+Analog of the reference's ``SmolScheduler`` (``scheduler/smol.rs:56-166``): there, N worker
+threads share an executor; here, the asyncio loop multiplexes all non-blocking block tasks
+(Python concurrency comes from GIL-releasing numpy/TPU/IO work, not from interpreter threads)
+and each ``#[blocking]`` block gets a dedicated thread with its own private event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Awaitable, Callable, List, Optional
+
+from ...log import logger
+from .base import Scheduler
+
+__all__ = ["AsyncScheduler"]
+
+log = logger("scheduler.async")
+
+
+class AsyncScheduler(Scheduler):
+    def __init__(self, blocking_workers: int = 32):
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self._blocking_pool = ThreadPoolExecutor(
+            max_workers=blocking_workers, thread_name_prefix="fsdr-blocking")
+        self._started = threading.Event()
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self) -> None:
+        with self._lock:
+            if self._loop_thread is not None and self._loop_thread.is_alive():
+                return
+            self._started.clear()
+
+            def run():
+                loop = asyncio.new_event_loop()
+                asyncio.set_event_loop(loop)
+                self._loop = loop
+                self._started.set()
+                try:
+                    loop.run_forever()
+                finally:
+                    loop.close()
+
+            self._loop_thread = threading.Thread(
+                target=run, name="fsdr-scheduler", daemon=True)
+            self._loop_thread.start()
+        self._started.wait()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._loop is not None and self._loop.is_running():
+                self._loop.call_soon_threadsafe(self._loop.stop)
+            if self._loop_thread is not None:
+                self._loop_thread.join(timeout=5)
+            self._loop_thread = None
+            self._loop = None
+        self._blocking_pool.shutdown(wait=False, cancel_futures=True)
+
+    @property
+    def loop(self) -> asyncio.AbstractEventLoop:
+        self.start()
+        return self._loop
+
+    # -- spawning --------------------------------------------------------------
+    def run_flowgraph_blocks(self, blocks, fg_inbox) -> List[Awaitable]:
+        handles: List[Awaitable] = []
+        loop = asyncio.get_running_loop()
+        for blk in blocks:
+            if blk.is_blocking:
+                # dedicated thread + private loop (`smol.rs:119-125` blocking pool)
+                def runner(b=blk):
+                    asyncio.run(b.run(fg_inbox))
+                handles.append(loop.run_in_executor(self._blocking_pool, runner))
+            else:
+                handles.append(loop.create_task(
+                    blk.run(fg_inbox), name=f"block:{blk.instance_name}"))
+        return handles
+
+    def spawn(self, coro) -> Awaitable:
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self.loop:
+            return running.create_task(coro)
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return asyncio.wrap_future(fut) if running else fut
+
+    def spawn_blocking(self, fn: Callable) -> Awaitable:
+        return self.loop.run_in_executor(self._blocking_pool, fn)

@@ -1,0 +1,118 @@
+"""Pinned multi-worker scheduler: N event-loop threads, blocks pinned to workers.
+
+Analog of the reference's ``FlowScheduler`` (``scheduler/flow.rs:39-136``): per-worker local
+queues with explicit block pinning (``with_pinned_blocks``) or deterministic id-based mapping
+(``map_block``). Worker 0 doubles as the supervisor loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from ...log import logger
+from .base import Scheduler
+
+__all__ = ["ThreadedScheduler"]
+
+log = logger("scheduler.threaded")
+
+
+class _Worker:
+    def __init__(self, index: int):
+        self.index = index
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.ready = threading.Event()
+        self.thread = threading.Thread(
+            target=self._run, name=f"fsdr-worker-{index}", daemon=True)
+
+    def _run(self):
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self.loop = loop
+        self.ready.set()
+        try:
+            loop.run_forever()
+        finally:
+            loop.close()
+
+
+class ThreadedScheduler(Scheduler):
+    def __init__(self, workers: Optional[int] = None,
+                 pinned: Optional[Dict[str, int]] = None):
+        import os
+        self.n_workers = workers or os.cpu_count() or 4
+        self.pinned = pinned or {}        # instance_name -> worker index
+        self._workers: List[_Worker] = []
+        self._blocking_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="fsdr-blocking")
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._workers:
+                return
+            for i in range(self.n_workers):
+                w = _Worker(i)
+                self._workers.append(w)
+                w.thread.start()
+            for w in self._workers:
+                w.ready.wait()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for w in self._workers:
+                if w.loop is not None and w.loop.is_running():
+                    w.loop.call_soon_threadsafe(w.loop.stop)
+            for w in self._workers:
+                w.thread.join(timeout=5)
+            self._workers = []
+        self._blocking_pool.shutdown(wait=False, cancel_futures=True)
+
+    @property
+    def loop(self) -> asyncio.AbstractEventLoop:
+        self.start()
+        return self._workers[0].loop
+
+    @property
+    def _loop_thread(self):
+        return self._workers[0].thread if self._workers else None
+
+    def map_block(self, blk) -> int:
+        """Deterministic id-based worker mapping (`flow.rs:125-136`)."""
+        if blk.instance_name in self.pinned:
+            return self.pinned[blk.instance_name] % self.n_workers
+        return blk.id % self.n_workers
+
+    def run_flowgraph_blocks(self, blocks, fg_inbox) -> List[Awaitable]:
+        handles: List[Awaitable] = []
+        sup_loop = asyncio.get_running_loop()
+        for blk in blocks:
+            if blk.is_blocking:
+                def runner(b=blk):
+                    asyncio.run(b.run(fg_inbox))
+                handles.append(sup_loop.run_in_executor(self._blocking_pool, runner))
+                continue
+            worker = self._workers[self.map_block(blk)]
+            if worker.loop is sup_loop:
+                handles.append(sup_loop.create_task(
+                    blk.run(fg_inbox), name=f"block:{blk.instance_name}"))
+            else:
+                cf = asyncio.run_coroutine_threadsafe(blk.run(fg_inbox), worker.loop)
+                handles.append(asyncio.wrap_future(cf))
+        return handles
+
+    def spawn(self, coro) -> Awaitable:
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self.loop:
+            return running.create_task(coro)
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return asyncio.wrap_future(fut) if running else fut
+
+    def spawn_blocking(self, fn: Callable) -> Awaitable:
+        return self.loop.run_in_executor(self._blocking_pool, fn)

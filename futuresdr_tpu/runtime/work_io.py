@@ -1,0 +1,33 @@
+"""Work-loop control surface handed to ``Kernel.work``.
+
+Reference: ``src/runtime/work_io.rs:11-41``. ``call_again`` requests an immediate re-run of
+``work`` without waiting for a wakeup; ``finished`` starts orderly shutdown; ``block_on``
+parks the block on an arbitrary awaitable (timers, hardware readiness) instead of the notifier —
+e.g. the reference's ``Throttle`` re-arms itself with a timer (``blocks/throttle.rs:92-94``).
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Optional
+
+__all__ = ["WorkIo"]
+
+
+class WorkIo:
+    __slots__ = ("call_again", "finished", "_block_on")
+
+    def __init__(self):
+        self.call_again: bool = False
+        self.finished: bool = False
+        self._block_on: Optional[Awaitable] = None
+
+    def block_on(self, awaitable: Awaitable) -> None:
+        """Park on ``awaitable`` before the next ``work`` call (`work_io.rs:30-38`)."""
+        self._block_on = awaitable
+
+    def take_block_on(self) -> Optional[Awaitable]:
+        aw, self._block_on = self._block_on, None
+        return aw
+
+    def reset(self) -> None:
+        self.call_again = False

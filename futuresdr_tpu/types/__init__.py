@@ -1,0 +1,19 @@
+"""Value types shared between the runtime, blocks, and external clients.
+
+TPU-native re-design of the reference's ``futuresdr-types`` crate (``crates/types/src/``).
+"""
+
+from .pmt import Pmt, PmtKind, PmtConversionError
+from .ids import BlockId, FlowgraphId, PortId
+from .description import BlockDescription, FlowgraphDescription
+
+__all__ = [
+    "Pmt",
+    "PmtKind",
+    "PmtConversionError",
+    "BlockId",
+    "FlowgraphId",
+    "PortId",
+    "BlockDescription",
+    "FlowgraphDescription",
+]

@@ -1,0 +1,43 @@
+"""Introspection descriptions served over the control plane.
+
+Reference: ``crates/types/src/description.rs:12-46`` (``FlowgraphDescription``,
+``BlockDescription``). These are what the REST API and GUI consume.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+from typing import List
+
+__all__ = ["BlockDescription", "FlowgraphDescription"]
+
+
+@dataclass
+class BlockDescription:
+    id: int
+    type_name: str
+    instance_name: str
+    stream_inputs: List[str] = field(default_factory=list)
+    stream_outputs: List[str] = field(default_factory=list)
+    message_inputs: List[str] = field(default_factory=list)
+    message_outputs: List[str] = field(default_factory=list)
+    blocking: bool = False
+
+    def to_json(self):
+        return asdict(self)
+
+
+@dataclass
+class FlowgraphDescription:
+    id: int
+    blocks: List[BlockDescription] = field(default_factory=list)
+    stream_edges: List[tuple] = field(default_factory=list)  # (src_blk, src_port, dst_blk, dst_port)
+    message_edges: List[tuple] = field(default_factory=list)
+
+    def to_json(self):
+        return {
+            "id": self.id,
+            "blocks": [b.to_json() for b in self.blocks],
+            "stream_edges": [list(e) for e in self.stream_edges],
+            "message_edges": [list(e) for e in self.message_edges],
+        }

@@ -1,0 +1,51 @@
+"""Mocker harness tests (reference: `tests/mocker.rs`, `tests/moving_avg.rs`)."""
+
+import numpy as np
+
+from futuresdr_tpu import Mocker, Pmt
+from futuresdr_tpu.blocks import Apply, Head, Delay
+
+
+def test_apply_doubles():
+    blk = Apply(lambda x: 2.0 * x, np.float32)
+    m = Mocker(blk)
+    data = np.arange(128, dtype=np.float32)
+    m.input("in", data)
+    m.init_output("out", 256)
+    m.init()
+    m.run()
+    m.deinit()
+    np.testing.assert_array_equal(m.output("out"), 2.0 * data)
+
+
+def test_head_stops():
+    blk = Head(np.float32, 10)
+    m = Mocker(blk)
+    m.input("in", np.ones(100, np.float32))
+    m.init_output("out", 100)
+    m.run()
+    assert len(m.output("out")) == 10
+    assert m.finished
+
+
+def test_delay_pad():
+    blk = Delay(np.float32, 4)
+    m = Mocker(blk)
+    m.input("in", np.arange(1, 9, dtype=np.float32))
+    m.input_finished("in")
+    m.init_output("out", 64)
+    m.run()
+    out = m.output("out")
+    np.testing.assert_array_equal(out[:4], np.zeros(4, np.float32))
+    np.testing.assert_array_equal(out[4:12], np.arange(1, 9, dtype=np.float32))
+
+
+def test_message_handler_via_post():
+    blk = Delay(np.float32, 0)
+    m = Mocker(blk)
+    r = m.post("new_value", Pmt.usize(3))
+    assert r == Pmt.ok()
+    r = m.post("new_value", Pmt.string("bogus"))
+    assert r == Pmt.invalid_value()
+    r = m.post("nonexistent", Pmt.null())
+    assert r == Pmt.invalid_value()

@@ -1,0 +1,81 @@
+"""Pmt tagged-union tests (reference: `crates/types/src/pmt.rs` test block)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu.types import Pmt, PmtKind, PmtConversionError
+
+
+def test_constructors_and_kinds():
+    assert Pmt.ok().kind is PmtKind.OK
+    assert Pmt.null().kind is PmtKind.NULL
+    assert Pmt.finished().is_finished()
+    assert Pmt.f32(1.5).kind is PmtKind.F32
+    assert Pmt.u32(2**32 + 2).value == 2  # wraps like the fixed-width type
+
+
+def test_from_py_inference():
+    assert Pmt.from_py(True).kind is PmtKind.BOOL
+    assert Pmt.from_py(3).kind is PmtKind.USIZE
+    assert Pmt.from_py(-3).kind is PmtKind.ISIZE
+    assert Pmt.from_py(3.5).kind is PmtKind.F64
+    assert Pmt.from_py("hi").kind is PmtKind.STRING
+    assert Pmt.from_py(b"ab").kind is PmtKind.BLOB
+    assert Pmt.from_py(np.zeros(4, np.float32)).kind is PmtKind.VEC_F32
+    assert Pmt.from_py(np.zeros(4, np.complex64)).kind is PmtKind.VEC_CF32
+    assert Pmt.from_py({"a": 1}).kind is PmtKind.MAP_STR_PMT
+    assert Pmt.from_py([1, 2]).kind is PmtKind.VEC_PMT
+
+
+def test_equality():
+    assert Pmt.f64(2.0) == Pmt.f64(2.0)
+    assert Pmt.f64(2.0) != Pmt.f32(2.0)
+    assert Pmt.vec_f32([1, 2]) == Pmt.vec_f32([1, 2])
+    assert Pmt.string("a") != Pmt.string("b")
+
+
+def test_accessors_and_errors():
+    assert Pmt.usize(7).to_int() == 7
+    assert Pmt.f64(2.5).to_float() == 2.5
+    assert Pmt.usize(7).to_float() == 7.0
+    with pytest.raises(PmtConversionError):
+        Pmt.string("x").to_int()
+    with pytest.raises(PmtConversionError):
+        Pmt.null().to_ndarray()
+
+
+def test_json_roundtrip():
+    cases = [
+        Pmt.ok(),
+        Pmt.null(),
+        Pmt.finished(),
+        Pmt.string("hello"),
+        Pmt.bool_(True),
+        Pmt.usize(42),
+        Pmt.isize(-42),
+        Pmt.u32(7),
+        Pmt.u64(1 << 40),
+        Pmt.f32(1.5),
+        Pmt.f64(-2.25),
+        Pmt.vec_f32([1.0, 2.0, 3.0]),
+        Pmt.vec_cf32([1 + 2j, 3 - 4j]),
+        Pmt.vec_u64([1, 2, 3]),
+        Pmt.blob(b"\x00\x01\xff"),
+        Pmt.vec([1, "two", 3.0]),
+        Pmt.map({"freq": 100e6, "gain": 30}),
+    ]
+    for p in cases:
+        wire = json.dumps(p.to_json())
+        q = Pmt.from_json(json.loads(wire))
+        assert q == p, f"roundtrip failed for {p!r}: got {q!r}"
+
+
+def test_immutable():
+    p = Pmt.f64(1.0)
+    with pytest.raises(AttributeError):
+        p.value = 2.0
+    arr = Pmt.vec_f32([1, 2]).to_ndarray()
+    with pytest.raises(ValueError):
+        arr[0] = 9
