@@ -18,6 +18,13 @@ from .scheduler import Scheduler, AsyncScheduler, ThreadedScheduler
 from .mocker import Mocker
 from .buffer import StreamInput, StreamOutput
 
+# Upgrade the process default buffer to the C++ double-mapped circular buffer when the
+# native library is present (the reference's DefaultCpuReader/Writer = circular on native,
+# slab on wasm — `buffer/mod.rs:564-575`).
+from .buffer import circular as _circular
+if _circular.available():
+    default_buffer(_circular.CircularWriter)
+
 __all__ = [
     "Tag", "ItemTag", "WorkIo", "Kernel", "BlockMeta", "message_handler",
     "MessageOutputs", "BlockInbox", "WrappedKernel",
