@@ -9,6 +9,9 @@ from .functional import (Apply, Combine, Filter, Split, Source, FiniteSource, Si
 from .vector import VectorSource, VectorSink, NullSource, NullSink, CopyRand
 from .stream import (Copy, Head, Throttle, MovingAvg, TagDebug, Delay,
                      StreamDuplicator, StreamDeinterleaver, Selector)
+from .dsp import (Fir, FirBuilder, Iir, Fft, XlatingFir, SignalSource,
+                  QuadratureDemod, Agc)
+from .pfb import PfbChannelizer, PfbSynthesizer, PfbArbResampler
 
 __all__ = [
     "Apply", "Combine", "Filter", "Split", "Source", "FiniteSource", "Sink",
@@ -16,4 +19,7 @@ __all__ = [
     "VectorSource", "VectorSink", "NullSource", "NullSink", "CopyRand",
     "Copy", "Head", "Throttle", "MovingAvg", "TagDebug", "Delay",
     "StreamDuplicator", "StreamDeinterleaver", "Selector",
+    "Fir", "FirBuilder", "Iir", "Fft", "XlatingFir", "SignalSource",
+    "QuadratureDemod", "Agc",
+    "PfbChannelizer", "PfbSynthesizer", "PfbArbResampler",
 ]

@@ -1,0 +1,331 @@
+"""DSP blocks: filters, FFT, NCO signal source, frequency translation.
+
+Reference: ``src/blocks/{fft.rs,fir.rs,iir.rs,xlating_fir.rs,signal_source/}``. The CPU path
+runs the stateful cores from :mod:`futuresdr_tpu.dsp`; fused TPU execution of the same chains
+lives in :mod:`futuresdr_tpu.tpu`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from ..dsp import firdes
+from ..dsp.kernels import (DecimatingFirFilter, FirFilter, IirFilter,
+                           PolyphaseResamplingFir, Rotator)
+from ..runtime.kernel import Kernel, message_handler
+from ..types import Pmt
+
+__all__ = ["Fir", "FirBuilder", "Iir", "Fft", "XlatingFir", "SignalSource",
+           "QuadratureDemod", "Agc"]
+
+
+class Fir(Kernel):
+    """FIR filter block (`fir.rs`), generic over the filter core: plain, decimating, or
+    polyphase-resampling (pass ``decim``/``interp``). ``min_items`` is set from the tap
+    count as in `fir.rs:49`."""
+
+    def __init__(self, taps, dtype=np.float32, decim: int = 1, interp: int = 1,
+                 tap_dtype=None):
+        super().__init__()
+        taps = np.asarray(taps, dtype=tap_dtype)
+        if interp != 1:
+            self.core = PolyphaseResamplingFir(interp, decim, taps)
+        elif decim != 1:
+            self.core = DecimatingFirFilter(taps, decim)
+        else:
+            self.core = FirFilter(taps)
+        self.decim, self.interp = decim, interp
+        self.input = self.add_stream_input("in", dtype, min_items=min(len(taps), 1 << 14))
+        self.output = self.add_stream_output("out", dtype)
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        out = self.output.slice()
+        # consume what certainly fits: n_in such that ceil(n_in * interp / decim) <= len(out)
+        n_in = min(len(inp), (len(out) * self.decim) // self.interp)
+        if n_in > 0:
+            y = self.core.process(inp[:n_in])
+            assert len(y) <= len(out), "resampler produced more than negotiated"
+            out[:len(y)] = y
+            self.input.consume(n_in)
+            self.output.produce(len(y))
+        if self.input.finished() and n_in == len(inp):
+            io.finished = True
+        elif n_in > 0 and n_in < len(inp):
+            io.call_again = True
+
+
+class FirBuilder:
+    """Convenience constructors (`fir.rs` FirBuilder)."""
+
+    @staticmethod
+    def lowpass(cutoff: float, n_taps: int = 64, dtype=np.float32, **kw) -> Fir:
+        return Fir(firdes.lowpass(cutoff, n_taps), dtype=dtype, **kw)
+
+    @staticmethod
+    def resampling(interp: int, decim: int, dtype=np.complex64,
+                   atten_db: float = 60.0) -> Fir:
+        """Rational resampler with auto-designed Kaiser lowpass (`FirBuilder::resampling`)."""
+        from math import gcd
+        g = gcd(interp, decim)
+        interp, decim = interp // g, decim // g
+        r = max(interp, decim)
+        taps = firdes.kaiser_lowpass(0.5 / r * 0.8, 0.1 / r, atten_db) * interp
+        return Fir(taps, dtype=dtype, decim=decim, interp=interp)
+
+    @staticmethod
+    def decimating(decim: int, cutoff: Optional[float] = None, n_taps: int = 64,
+                   dtype=np.complex64) -> Fir:
+        cutoff = cutoff if cutoff is not None else 0.4 / decim
+        return Fir(firdes.lowpass(cutoff, n_taps), dtype=dtype, decim=decim)
+
+
+class Iir(Kernel):
+    """IIR filter block (`iir.rs`)."""
+
+    def __init__(self, b, a=(1.0,), dtype=np.float32):
+        super().__init__()
+        self.core = IirFilter(b, a)
+        self.input = self.add_stream_input("in", dtype)
+        self.output = self.add_stream_output("out", dtype)
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        out = self.output.slice()
+        n = min(len(inp), len(out))
+        if n > 0:
+            out[:n] = self.core.process(inp[:n])
+            self.input.consume(n)
+            self.output.produce(n)
+        if self.input.finished() and n == len(inp):
+            io.finished = True
+        elif n > 0 and n < len(inp):
+            io.call_again = True
+
+
+class Fft(Kernel):
+    """Frame-wise FFT (`fft.rs`): forward/inverse, optional fftshift and 1/√N
+    normalization, runtime-switchable ``fft_size`` message port."""
+
+    def __init__(self, fft_size: int = 2048, direction: str = "forward",
+                 shift: bool = False, normalize: bool = False, dtype=np.complex64):
+        super().__init__()
+        assert direction in ("forward", "inverse")
+        self.fft_size = int(fft_size)
+        self.direction = direction
+        self.shift = shift
+        self.normalize = normalize
+        self.input = self.add_stream_input("in", dtype, min_items=self.fft_size)
+        self.output = self.add_stream_output("out", dtype, min_items=self.fft_size)
+
+    @message_handler(name="fft_size")
+    async def fft_size_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        try:
+            self.fft_size = p.to_int()
+        except Exception:
+            return Pmt.invalid_value()
+        return Pmt.ok()
+
+    async def work(self, io, mio, meta):
+        n = self.fft_size
+        inp = self.input.slice()
+        out = self.output.slice()
+        k = min(len(inp) // n, len(out) // n)
+        if k > 0:
+            frames = inp[:k * n].reshape(k, n)
+            if self.direction == "forward":
+                y = np.fft.fft(frames, axis=1)
+            else:
+                y = np.fft.ifft(frames, axis=1) * n   # match reference's unscaled inverse
+            if self.normalize:
+                y = y / np.sqrt(n)
+            if self.shift:
+                y = np.fft.fftshift(y, axes=1)
+            out[:k * n] = y.reshape(-1).astype(out.dtype, copy=False)
+            self.input.consume(k * n)
+            self.output.produce(k * n)
+        if self.input.finished() and len(inp) - k * n < n:
+            io.finished = True
+        elif k > 0:
+            io.call_again = True
+
+
+class XlatingFir(Kernel):
+    """Frequency-translating decimating FIR (`xlating_fir.rs`): rotate to baseband,
+    lowpass, decimate — the front half of every receiver."""
+
+    def __init__(self, taps, decim: int, offset_freq: float, sample_rate: float,
+                 dtype=np.complex64):
+        super().__init__()
+        self.rotator = Rotator(-2.0 * np.pi * offset_freq / sample_rate)
+        self.fir = DecimatingFirFilter(np.asarray(taps), decim)
+        self.sample_rate = sample_rate
+        self.input = self.add_stream_input("in", dtype, min_items=len(taps))
+        self.output = self.add_stream_output("out", dtype)
+        self.decim = decim
+
+    @message_handler(name="freq")
+    async def freq_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        try:
+            self.rotator.set_phase_inc(-2.0 * np.pi * p.to_float() / self.sample_rate)
+        except Exception:
+            return Pmt.invalid_value()
+        return Pmt.ok()
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        out = self.output.slice()
+        n_in = min(len(inp), len(out) * self.decim)
+        if n_in > 0:
+            y = self.fir.process(self.rotator.process(inp[:n_in]))
+            out[:len(y)] = y
+            self.input.consume(n_in)
+            self.output.produce(len(y))
+        if self.input.finished() and n_in == len(inp):
+            io.finished = True
+        elif n_in > 0 and n_in < len(inp):
+            io.call_again = True
+
+
+class SignalSource(Kernel):
+    """NCO signal source (`signal_source/`): sin/cos/complex-exponential/square at a
+    given frequency, with ``freq``/``amplitude`` message ports. The reference uses a
+    fixed-point LUT NCO (`fxpt_phase.rs:11-19`); here the oscillator is a vectorized
+    phase accumulator with the same wrap-around semantics."""
+
+    def __init__(self, waveform: str, frequency: float, sample_rate: float,
+                 amplitude: float = 1.0, offset: float = 0.0, dtype=None):
+        super().__init__()
+        assert waveform in ("sin", "cos", "complex", "square")
+        self.waveform = waveform
+        self.sample_rate = float(sample_rate)
+        self.amplitude = float(amplitude)
+        self.offset = float(offset)
+        self._phase = 0.0
+        self._inc = 2.0 * np.pi * frequency / sample_rate
+        if dtype is None:
+            dtype = np.complex64 if waveform == "complex" else np.float32
+        self.output = self.add_stream_output("out", dtype)
+
+    @message_handler(name="freq")
+    async def freq_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        try:
+            self._inc = 2.0 * np.pi * p.to_float() / self.sample_rate
+        except Exception:
+            return Pmt.invalid_value()
+        return Pmt.ok()
+
+    @message_handler(name="amplitude")
+    async def amplitude_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        try:
+            self.amplitude = p.to_float()
+        except Exception:
+            return Pmt.invalid_value()
+        return Pmt.ok()
+
+    async def work(self, io, mio, meta):
+        out = self.output.slice()
+        n = len(out)
+        if n == 0:
+            return
+        ph = self._phase + self._inc * np.arange(n)
+        if self.waveform == "sin":
+            y = np.sin(ph)
+        elif self.waveform == "cos":
+            y = np.cos(ph)
+        elif self.waveform == "square":
+            y = np.sign(np.sin(ph))
+        else:
+            y = np.exp(1j * ph)
+        out[:n] = (self.amplitude * y + self.offset).astype(out.dtype, copy=False)
+        self._phase = float((self._phase + self._inc * n) % (2.0 * np.pi))
+        self.output.produce(n)
+        io.call_again = True
+
+
+class QuadratureDemod(Kernel):
+    """FM quadrature demodulator: ``gain · arg(x[n] · conj(x[n-1]))`` (the reference
+    builds this as an `Apply` in `examples/fm-receiver/src/main.rs:106-113`; it is a
+    named block here because every analog receiver needs it)."""
+
+    def __init__(self, gain: float = 1.0):
+        super().__init__()
+        self.gain = float(gain)
+        self.input = self.add_stream_input("in", np.complex64, min_items=2)
+        self.output = self.add_stream_output("out", np.float32)
+        self._last = np.complex64(1.0)
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        out = self.output.slice()
+        n = min(len(inp), len(out))
+        if n > 0:
+            prev = np.concatenate(([self._last], inp[:n - 1]))
+            out[:n] = self.gain * np.angle(inp[:n] * np.conj(prev))
+            self._last = inp[n - 1]
+            self.input.consume(n)
+            self.output.produce(n)
+        if self.input.finished() and n == len(inp):
+            io.finished = True
+        elif n > 0 and n < len(inp):
+            io.call_again = True
+
+
+class Agc(Kernel):
+    """Automatic gain control: exponential power tracking toward a reference level,
+    with ``max_gain``/locking via message ports (reference `blocks/agc.rs`)."""
+
+    def __init__(self, dtype=np.complex64, reference: float = 1.0,
+                 adjustment_rate: float = 1e-3, max_gain: float = 65536.0):
+        super().__init__()
+        self.reference = float(reference)
+        self.rate = float(adjustment_rate)
+        self.max_gain = float(max_gain)
+        self.gain = 1.0
+        self.locked = False
+        self.input = self.add_stream_input("in", dtype)
+        self.output = self.add_stream_output("out", dtype)
+
+    @message_handler(name="gain_lock")
+    async def gain_lock_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        try:
+            self.locked = bool(p.to_bool() if p.kind.name == "BOOL" else p.to_int())
+        except Exception:
+            return Pmt.invalid_value()
+        return Pmt.ok()
+
+    @message_handler(name="reference_power")
+    async def reference_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        try:
+            self.reference = p.to_float()
+        except Exception:
+            return Pmt.invalid_value()
+        return Pmt.ok()
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        out = self.output.slice()
+        n = min(len(inp), len(out))
+        if n > 0:
+            x = inp[:n]
+            if self.locked:
+                out[:n] = self.gain * x
+            else:
+                mag = np.abs(x)
+                gains = np.empty(n, dtype=np.float64)
+                g = self.gain
+                r, rate, mg = self.reference, self.rate, self.max_gain
+                for i in range(n):          # sequential feedback loop
+                    gains[i] = g
+                    err = r - mag[i] * g
+                    g = min(max(g + rate * err, 0.0), mg)
+                self.gain = g
+                out[:n] = (gains * x).astype(out.dtype, copy=False)
+            self.input.consume(n)
+            self.output.produce(n)
+        if self.input.finished() and n == len(inp):
+            io.finished = True
+        elif n > 0 and n < len(inp):
+            io.call_again = True

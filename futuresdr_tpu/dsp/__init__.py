@@ -1,0 +1,13 @@
+"""DSP math library — the ``futuredsp`` crate equivalent (`crates/futuredsp/src/`).
+
+Pure, dependency-light numerics: window functions, FIR design (windowed/Kaiser/Remez),
+streaming filter cores (FIR/decimating/polyphase-resampling/IIR/rotator). The TPU-jitted
+counterparts live in :mod:`futuresdr_tpu.ops`.
+"""
+
+from . import windows, firdes
+from .kernels import (FirFilter, DecimatingFirFilter, PolyphaseResamplingFir,
+                      IirFilter, Rotator)
+
+__all__ = ["windows", "firdes", "FirFilter", "DecimatingFirFilter",
+           "PolyphaseResamplingFir", "IirFilter", "Rotator"]
