@@ -108,7 +108,7 @@ class PfbSynthesizer(Kernel):
             else:
                 w = self.branch_taps[:, :1] * v
             out = self.output.slice()
-            out[:t * self.n] = w[::-1].T.reshape(-1).astype(np.complex64)
+            out[:t * self.n] = w.T.reshape(-1).astype(np.complex64)
             for p in self.inputs:
                 p.consume(t)
             self.output.produce(t * self.n)
