@@ -1,0 +1,13 @@
+"""Jittable TPU ops: streaming stages and their composition into fused XLA programs.
+
+These are the jax/XLA counterparts of the numpy cores in :mod:`futuresdr_tpu.dsp` — same
+streaming contracts, explicit carry, static shapes. Used by :class:`futuresdr_tpu.tpu.TpuKernel`.
+"""
+
+from .stages import (Stage, Pipeline, fir_stage, fft_stage, mag2_stage, log10_stage,
+                     rotator_stage, quad_demod_stage, apply_stage, fftshift_stage,
+                     decimate_stage, moving_avg_stage)
+
+__all__ = ["Stage", "Pipeline", "fir_stage", "fft_stage", "mag2_stage", "log10_stage",
+           "rotator_stage", "quad_demod_stage", "apply_stage", "fftshift_stage",
+           "decimate_stage", "moving_avg_stage"]

@@ -1,0 +1,259 @@
+"""Jittable streaming stages: the TPU compute plane's unit of composition.
+
+This is where the reference's per-block accelerator dispatch (Vulkan/WGPU compute shaders,
+``blocks/vulkan.rs:96+``) is re-designed TPU-first: instead of one device dispatch per block,
+adjacent DSP blocks compose into ONE jitted XLA program (`SURVEY §7.5`). A :class:`Stage` is a
+pure function ``(carry, frame) -> (carry, out)`` with static frame shape — streaming state
+(filter history, oscillator phase) is explicit carry, which keeps the program jit-compatible
+and lets frame t+1's dispatch chain on frame t's carry entirely on-device (no host sync
+between frames).
+
+Rate changes are rational and static (``in_per_out``/``out_per_in``), mirroring the
+``ComputationStatus`` frame contract of ``futuredsp/lib.rs:33-45``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from fractions import Fraction
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Stage", "Pipeline", "fir_stage", "fft_stage", "mag2_stage", "log10_stage",
+           "rotator_stage", "quad_demod_stage", "apply_stage", "fftshift_stage",
+           "decimate_stage", "moving_avg_stage"]
+
+
+@dataclass
+class Stage:
+    """One streaming stage.
+
+    ``fn(carry, x) -> (carry, y)`` must be jax-traceable with static shapes: for an input
+    frame of n items it returns ``n * ratio`` items (ratio = out/in, a Fraction).
+    """
+
+    fn: Callable[[Any, jnp.ndarray], Tuple[Any, jnp.ndarray]]
+    init_carry: Callable[[np.dtype], Any]
+    ratio: Fraction = Fraction(1, 1)
+    out_dtype: Optional[np.dtype] = None          # None = same as input
+    frame_multiple: int = 1                       # input frame must divide this
+    name: str = "stage"
+
+    def __repr__(self):
+        return f"Stage({self.name}, ratio={self.ratio})"
+
+
+class Pipeline:
+    """A fused chain of stages compiled as a single XLA program.
+
+    The composition is where TPU wins over per-block GPU dispatch: XLA fuses the
+    elementwise stages into the FIR/FFT hot ops, so a NullSource→FIR→FFT→|x|² chain is one
+    kernel launch per frame instead of four buffer hops.
+    """
+
+    def __init__(self, stages: Sequence[Stage], in_dtype):
+        self.stages = list(stages)
+        self.in_dtype = np.dtype(in_dtype)
+        dtype = self.in_dtype
+        fm = 1                      # required input-frame multiple
+        r = Fraction(1, 1)          # cumulative rate in front of each stage
+        for s in self.stages:
+            # stage input = frame_in * r must be integral and a multiple of s.frame_multiple:
+            # frame_in must be a multiple of reduce(m_i / r).numerator (see Fraction math)
+            need = Fraction(s.frame_multiple, 1) / r
+            fm = int(np.lcm(fm, need.numerator))
+            r *= s.ratio
+            fm = int(np.lcm(fm, r.denominator))   # integral intermediate frame sizes
+            if s.out_dtype is not None:
+                dtype = np.dtype(s.out_dtype)
+        self.frame_multiple = fm
+        self.ratio = r
+        self.out_dtype = dtype
+        self._fn = None
+
+    def init_carry(self):
+        dtype = self.in_dtype
+        carries = []
+        for s in self.stages:
+            carries.append(s.init_carry(dtype))
+            if s.out_dtype is not None:
+                dtype = np.dtype(s.out_dtype)
+        return tuple(carries)
+
+    def fn(self):
+        if self._fn is None:
+            stages = self.stages
+
+            def run(carries, x):
+                new_c = []
+                for s, c in zip(stages, carries):
+                    c, x = s.fn(c, x)
+                    new_c.append(c)
+                return tuple(new_c), x
+
+            self._fn = run
+        return self._fn
+
+    def compile(self, frame_size: int, device=None, donate: bool = True):
+        """Jit for a fixed frame size; returns (compiled_fn, initial device carry).
+
+        Placement follows the data: put the carry (and inputs) on ``device``; jit then
+        dispatches there without a deprecated device= argument.
+        """
+        assert frame_size % self.frame_multiple == 0, \
+            f"frame_size {frame_size} not a multiple of {self.frame_multiple}"
+        fn = jax.jit(self.fn(), donate_argnums=(0,) if donate else ())
+        carry = self.init_carry()
+        if device is not None:
+            carry = jax.device_put(carry, device)
+        return fn, carry
+
+    def out_items(self, in_items: int) -> int:
+        q = Fraction(in_items) * self.ratio
+        assert q.denominator == 1
+        return int(q)
+
+
+# ---------------------------------------------------------------------------
+# stage factories
+# ---------------------------------------------------------------------------
+
+def fir_stage(taps, decim: int = 1, name: str = "fir") -> Stage:
+    """Overlap-save FIR (+ optional decimation) as a jitted stage.
+
+    History carry = last ``ntaps-1`` inputs (the `min_items` overlap of `fir.rs:49`
+    reframed for frames, SURVEY §5 long-context note). Real taps convolve complex frames
+    as two real convolutions (keeps the MXU in play; complex conv isn't natively lowered).
+    """
+    taps = np.asarray(taps)
+    nt = len(taps)
+    tj = jnp.asarray(taps)
+
+    def conv_valid(x):
+        # x: [n + nt - 1] → [n]; jnp.convolve(valid) lowers to conv_general_dilated on the
+        # MXU. precision="highest" keeps f32 accumulation (default bf16 passes lose ~7e-3).
+        if jnp.iscomplexobj(x) and not np.iscomplexobj(taps):
+            re = jnp.convolve(x.real, tj, mode="valid", precision="highest")
+            im = jnp.convolve(x.imag, tj, mode="valid", precision="highest")
+            return (re + 1j * im).astype(x.dtype)
+        return jnp.convolve(x, tj.astype(x.dtype) if np.isrealobj(taps) else tj,
+                            mode="valid", precision="highest").astype(x.dtype)
+
+    def fn(carry, x):
+        ext = jnp.concatenate([carry, x])
+        y = conv_valid(ext)
+        if decim > 1:
+            y = y[::decim]
+        return ext[ext.shape[0] - (nt - 1):], y
+
+    def init_carry(dtype):
+        return jnp.zeros(nt - 1, dtype=dtype)
+
+    return Stage(fn, init_carry, Fraction(1, decim), None, decim, name)
+
+
+def decimate_stage(decim: int) -> Stage:
+    def fn(carry, x):
+        return carry, x[::decim]
+
+    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, decim), None, decim, f"decim{decim}")
+
+
+def fft_stage(n: int, direction: str = "forward", shift: bool = False,
+              normalize: bool = False) -> Stage:
+    """Batched frame FFT: input frame reshaped [-1, n], transformed on axis 1."""
+
+    def fn(carry, x):
+        f = x.reshape(-1, n)
+        y = jnp.fft.fft(f, axis=1) if direction == "forward" else jnp.fft.ifft(f, axis=1) * n
+        if normalize:
+            y = y / jnp.sqrt(n)
+        if shift:
+            y = jnp.fft.fftshift(y, axes=1)
+        return carry, y.reshape(-1).astype(jnp.complex64)
+
+    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), np.complex64, n, f"fft{n}")
+
+
+def fftshift_stage(n: int) -> Stage:
+    def fn(carry, x):
+        return carry, jnp.fft.fftshift(x.reshape(-1, n), axes=1).reshape(-1)
+
+    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), None, n, "fftshift")
+
+
+def mag2_stage() -> Stage:
+    def fn(carry, x):
+        return carry, (x.real * x.real + x.imag * x.imag).astype(jnp.float32)
+
+    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), np.float32, 1, "mag2")
+
+
+def log10_stage(scale: float = 10.0, floor: float = 1e-20) -> Stage:
+    def fn(carry, x):
+        return carry, (scale * jnp.log10(jnp.maximum(x, floor))).astype(jnp.float32)
+
+    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), np.float32, 1, "log10")
+
+
+def rotator_stage(phase_inc: float) -> Stage:
+    """Complex rotator with phase carry (futuredsp `Rotator` as a stage)."""
+    inc = float(phase_inc)
+
+    def fn(carry, x):
+        n = x.shape[0]
+        ph = carry + inc * jnp.arange(n, dtype=jnp.float32)
+        y = x * jnp.exp(1j * ph).astype(x.dtype)
+        new = jnp.mod(carry + inc * n, 2 * np.pi)
+        return new, y
+
+    def init_carry(dtype):
+        return jnp.zeros((), dtype=jnp.float32)
+
+    return Stage(fn, init_carry, Fraction(1, 1), None, 1, "rotator")
+
+
+def quad_demod_stage(gain: float = 1.0) -> Stage:
+    """FM discriminator with one-sample carry."""
+
+    def fn(carry, x):
+        prev = jnp.concatenate([carry[None], x[:-1]])
+        y = gain * jnp.angle(x * jnp.conj(prev))
+        return x[-1], y.astype(jnp.float32)
+
+    def init_carry(dtype):
+        return jnp.asarray(1.0 + 0.0j, dtype=dtype)
+
+    return Stage(fn, init_carry, Fraction(1, 1), np.float32, 1, "quad_demod")
+
+
+def apply_stage(f: Callable[[jnp.ndarray], jnp.ndarray], out_dtype=None,
+                name: str = "apply") -> Stage:
+    """Arbitrary elementwise jax function (1:1)."""
+
+    def fn(carry, x):
+        return carry, f(x)
+
+    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), out_dtype, 1, name)
+
+
+def moving_avg_stage(frame_len: int, decay: float = 0.1) -> Stage:
+    """EMA across frames of length ``frame_len`` (spectrum smoothing), carry = the EMA."""
+
+    def fn(carry, x):
+        rows = x.reshape(-1, frame_len)
+
+        def step(c, row):
+            c = c * (1.0 - decay) + row * decay
+            return c, c
+
+        carry, out = jax.lax.scan(step, carry, rows)
+        return carry, out.reshape(-1)
+
+    def init_carry(dtype):
+        return jnp.zeros(frame_len, dtype=jnp.float32)
+
+    return Stage(fn, init_carry, Fraction(1, 1), np.float32, frame_len, "moving_avg")

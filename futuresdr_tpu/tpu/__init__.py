@@ -1,0 +1,12 @@
+"""TPU compute plane: device broker, fused stage pipelines, staging blocks.
+
+The TPU-native replacement for the reference's Vulkan/WGPU/Zynq accelerator layer
+(``src/runtime/buffer/{vulkan,wgpu,zynq}/``, ``src/blocks/{vulkan,wgpu,zynq}.rs``):
+instead of staging buffers + per-block compute dispatch, sample frames move into HBM and
+whole block chains run as single jitted XLA programs (see :mod:`futuresdr_tpu.ops.stages`).
+"""
+
+from .instance import TpuInstance, instance
+from .kernel_block import TpuKernel
+
+__all__ = ["TpuInstance", "instance", "TpuKernel"]

@@ -1,0 +1,53 @@
+"""TpuInstance: the device broker of the TPU compute plane.
+
+Role analog of the reference's accelerator ``Instance`` brokers (``buffer/vulkan/mod.rs:46-127``,
+``buffer/wgpu/mod.rs:78-127``): owns the jax device (or mesh), hands out compiled stage
+programs, and tracks frame-size / in-flight-depth defaults from config.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..config import config
+from ..log import logger
+
+__all__ = ["TpuInstance", "instance"]
+
+log = logger("tpu.instance")
+
+
+class TpuInstance:
+    def __init__(self, device=None, platform: Optional[str] = None):
+        if device is None:
+            devs = jax.devices(platform) if platform else jax.devices()
+            device = devs[0]
+        self.device = device
+        self.frame_size = config().tpu_frame_size
+        self.frames_in_flight = config().tpu_frames_in_flight
+        log.info("TpuInstance on %s (frame=%d, in-flight=%d)",
+                 self.device, self.frame_size, self.frames_in_flight)
+
+    @property
+    def platform(self) -> str:
+        return self.device.platform
+
+    def put(self, arr: np.ndarray):
+        return jax.device_put(arr, self.device)
+
+
+_instance: Optional[TpuInstance] = None
+_lock = threading.Lock()
+
+
+def instance() -> TpuInstance:
+    """Process-global default broker (like the reference's lazy `vulkan::Instance`)."""
+    global _instance
+    with _lock:
+        if _instance is None:
+            _instance = TpuInstance()
+        return _instance

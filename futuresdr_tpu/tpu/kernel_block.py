@@ -1,0 +1,135 @@
+"""TpuKernel: run a fused stage pipeline on the TPU inside a flowgraph.
+
+This is the TPU re-design of the reference's accelerator compute blocks
+(``blocks/vulkan.rs:96+``, ``blocks/wgpu.rs:105+``) and their full/empty staging-buffer
+circuits (``buffer/vulkan/h2d.rs``, SURVEY §3.5): stream samples are batched into fixed-size
+frames, moved host→HBM with ``jax.device_put``, pushed through ONE jitted XLA program (the
+fused block chain), and results stream back. Instead of the reference's explicit buffer
+circulation, pipelining uses XLA's async dispatch: up to ``frames_in_flight`` frames are
+enqueued with their carry chained on-device, so H2D transfer, compute, and D2H of
+neighbouring frames overlap — the double-buffering of `SURVEY §7.5` without bespoke queues.
+
+The block is ``BLOCKING`` (dedicated thread), so the host sync in result retrieval never
+stalls the scheduler loop — the reference marks its hardware blocks ``#[blocking]`` the same
+way (`seify/source.rs`).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..log import logger
+from ..ops.stages import Pipeline, Stage
+from ..runtime.kernel import Kernel
+from .instance import TpuInstance, instance
+
+__all__ = ["TpuKernel"]
+
+log = logger("tpu.kernel")
+
+
+class TpuKernel(Kernel):
+    BLOCKING = True
+
+    def __init__(self, stages: Sequence[Stage], in_dtype,
+                 frame_size: Optional[int] = None,
+                 inst: Optional[TpuInstance] = None,
+                 frames_in_flight: Optional[int] = None):
+        super().__init__()
+        self.inst = inst or instance()
+        self.pipeline = Pipeline(stages, in_dtype)
+        fs = frame_size or self.inst.frame_size
+        m = self.pipeline.frame_multiple
+        self.frame_size = max(m, (fs // m) * m)
+        self.out_frame = self.pipeline.out_items(self.frame_size)
+        self.depth = frames_in_flight or self.inst.frames_in_flight
+        self._compiled = None
+        self._carry = None
+        self._inflight: Deque[Tuple[object, int]] = deque()  # (device result, valid_out)
+        self._pending_out: Optional[np.ndarray] = None
+        self.input = self.add_stream_input("in", in_dtype, min_items=self.frame_size)
+        self.output = self.add_stream_output(
+            "out", self.pipeline.out_dtype, min_items=self.out_frame,
+            min_buffer_size=(self.depth + 1) * self.out_frame *
+            np.dtype(self.pipeline.out_dtype).itemsize)
+
+    async def init(self, mio, meta):
+        self._compiled, self._carry = self.pipeline.compile(
+            self.frame_size, device=self.inst.device)
+        # warm the compile cache off the hot path, then reset the carry state
+        warm_carry, y = self._compiled(self._carry,
+                                       self.inst.put(np.zeros(self.frame_size,
+                                                              dtype=self.pipeline.in_dtype)))
+        y.block_until_ready()
+        del warm_carry  # donated buffers; fresh carry below
+        _, self._carry = self.pipeline.compile(self.frame_size, device=self.inst.device)
+
+    # -- helpers ---------------------------------------------------------------
+    def _dispatch(self, frame: np.ndarray, valid_in: int) -> None:
+        """Enqueue one frame; ``valid_in`` (a frame_multiple multiple) bounds how much of
+        the output is real data vs zero-pad tail."""
+        x = self.inst.put(frame)
+        self._carry, y = self._compiled(self._carry, x)
+        valid_out = self.pipeline.out_items(valid_in)
+        self._inflight.append((y, min(valid_out, self.out_frame)))
+
+    def _drain_one(self) -> np.ndarray:
+        y, valid = self._inflight.popleft()
+        arr = np.asarray(y)       # sync point: blocks only this block's thread
+        return arr[:valid]
+
+    async def work(self, io, mio, meta):
+        # 1. flush pending host-side output first
+        if self._pending_out is not None:
+            out = self.output.slice()
+            k = min(len(out), len(self._pending_out))
+            out[:k] = self._pending_out[:k]
+            self.output.produce(k)
+            self._pending_out = self._pending_out[k:] if k < len(self._pending_out) else None
+            if self._pending_out is not None:
+                return  # downstream full; its consume() will wake us
+
+        inp = self.input.slice()
+        # 2. enqueue as many full frames as the pipeline depth allows.
+        #    The copy is the H2D staging write (reference `vulkan/h2d.rs:29-37`): device_put
+        #    is async, so handing it a live ring-buffer view would race with the writer
+        #    overwriting consumed space — the frame must leave the ring before consume().
+        while len(self._inflight) < self.depth and len(inp) >= self.frame_size:
+            self._dispatch(inp[:self.frame_size].copy(), self.frame_size)
+            self.input.consume(self.frame_size)
+            inp = self.input.slice()
+
+        eos = self.input.finished()
+        if eos and len(inp) > 0 and len(inp) < self.frame_size and \
+                len(self._inflight) < self.depth:
+            # final partial frame: zero-pad, emit only the valid prefix
+            frame = np.zeros(self.frame_size, dtype=self.pipeline.in_dtype)
+            frame[:len(inp)] = inp
+            n = len(inp)
+            # items beyond the last frame_multiple boundary cannot produce integral
+            # output and are dropped at EOS (streaming frame contract)
+            self._dispatch(frame, n - (n % self.pipeline.frame_multiple))
+            self.input.consume(n)
+            inp = self.input.slice()
+
+        # 3. retrieve: when the pipe is full, or on EOS drain
+        should_drain = len(self._inflight) >= self.depth or (eos and self._inflight)
+        if should_drain:
+            result = self._drain_one()
+            out = self.output.slice()
+            k = min(len(out), len(result))
+            out[:k] = result[:k]
+            self.output.produce(k)
+            if k < len(result):
+                self._pending_out = result[k:].copy()
+            io.call_again = True
+            return
+
+        if eos and not self._inflight and self._pending_out is None and \
+                len(inp) < self.frame_size and len(inp) == 0:
+            io.finished = True
+        elif eos and self._inflight:
+            io.call_again = True

@@ -7,9 +7,15 @@ Multi-chip hardware is not available in CI; shardings are validated on a virtual
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"   # override axon: tests are deterministic-CPU
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# jax snapshots JAX_PLATFORMS at import; force it again via config in case the driver
+# environment pre-set another platform before this conftest ran.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,128 @@
+"""TPU stage/pipeline tests (on the CPU jax backend in CI; same code runs on TPU).
+
+Golden parity: fused stage chains must match the numpy/scipy CPU cores frame-for-frame,
+including carry across frame boundaries (SURVEY §7 "determinism for tests").
+"""
+
+import numpy as np
+import pytest
+from scipy import signal as sps
+
+import jax
+
+from futuresdr_tpu.dsp import firdes
+from futuresdr_tpu.ops import (Pipeline, fir_stage, fft_stage, mag2_stage,
+                               rotator_stage, quad_demod_stage, moving_avg_stage)
+
+
+def run_pipeline(pipe: Pipeline, x: np.ndarray, frame: int) -> np.ndarray:
+    fn, carry = pipe.compile(frame)
+    outs = []
+    for i in range(0, len(x) - frame + 1, frame):
+        carry, y = fn(carry, x[i:i + frame])
+        outs.append(np.asarray(y))
+    return np.concatenate(outs)
+
+
+def test_fir_stage_matches_lfilter_across_frames():
+    taps = firdes.lowpass(0.2, 64).astype(np.float32)
+    x = np.random.default_rng(0).standard_normal(8192).astype(np.float32)
+    pipe = Pipeline([fir_stage(taps)], np.float32)
+    y = run_pipeline(pipe, x, 1024)
+    ref = sps.lfilter(taps, 1.0, x)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fir_stage_complex_with_decim():
+    taps = firdes.lowpass(0.1, 32).astype(np.float32)
+    x = (np.exp(1j * 2 * np.pi * 0.03 * np.arange(8192))).astype(np.complex64)
+    pipe = Pipeline([fir_stage(taps, decim=4)], np.complex64)
+    assert pipe.frame_multiple == 4
+    assert pipe.out_items(1024) == 256
+    y = run_pipeline(pipe, x, 1024)
+    ref = sps.lfilter(taps, 1.0, x)[::4]
+    np.testing.assert_allclose(y, ref[:len(y)], rtol=1e-3, atol=1e-4)
+
+
+def test_fused_fir_fft_mag2_chain():
+    """The north-star fusion: FIR → FFT → |x|² as ONE program."""
+    taps = firdes.lowpass(0.2, 64).astype(np.float32)
+    n_fft = 256
+    x = np.random.default_rng(1).standard_normal(16 * 1024).astype(np.complex64)
+    pipe = Pipeline([fir_stage(taps), fft_stage(n_fft), mag2_stage()], np.complex64)
+    assert pipe.out_dtype == np.float32
+    y = run_pipeline(pipe, x, 4096)
+    filtered = sps.lfilter(taps, 1.0, x)
+    ref = np.abs(np.fft.fft(filtered[:len(y)].reshape(-1, n_fft), axis=1)) ** 2
+    np.testing.assert_allclose(y, ref.reshape(-1), rtol=1e-2, atol=1e-2)
+
+
+def test_rotator_stage_phase_continuity():
+    pipe = Pipeline([rotator_stage(0.05)], np.complex64)
+    x = np.ones(4096, dtype=np.complex64)
+    y = run_pipeline(pipe, x, 512)
+    ref = np.exp(1j * 0.05 * np.arange(4096))
+    np.testing.assert_allclose(y, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_quad_demod_stage_carry():
+    fs, fdev = 250e3, 5e3
+    t = np.arange(8192) / fs
+    msg = np.sin(2 * np.pi * 1e3 * t)
+    iq = np.exp(1j * 2 * np.pi * fdev * np.cumsum(msg) / fs).astype(np.complex64)
+    pipe = Pipeline([quad_demod_stage(fs / (2 * np.pi * fdev))], np.complex64)
+    y = run_pipeline(pipe, iq, 1024)
+    assert np.corrcoef(y[100:], msg[99:8191])[0, 1] > 0.999
+
+
+def test_moving_avg_stage():
+    frame_len = 64
+    pipe = Pipeline([moving_avg_stage(frame_len, decay=0.5)], np.float32)
+    x = np.ones(1024, dtype=np.float32)
+    y = run_pipeline(pipe, x, 256)
+    # EMA of ones converges to 1
+    assert abs(y[-frame_len:].mean() - 1.0) < 1e-3
+
+
+def test_pipeline_rate_math():
+    taps = np.ones(16, dtype=np.float32)
+    pipe = Pipeline([fir_stage(taps, decim=2), fft_stage(64), mag2_stage()], np.complex64)
+    # input multiple: decim 2 and fft 64 at post-decim rate → 128 input items
+    assert pipe.frame_multiple == 128
+    assert pipe.out_items(1024) == 512
+
+
+def test_tpu_kernel_block_in_flowgraph():
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSource, VectorSink
+    from futuresdr_tpu.tpu import TpuKernel
+
+    taps = firdes.lowpass(0.2, 64).astype(np.float32)
+    data = np.random.default_rng(2).standard_normal(100_000).astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    tk = TpuKernel([fir_stage(taps)], np.float32, frame_size=8192)
+    snk = VectorSink(np.float32)
+    fg.connect(src, tk, snk)
+    Runtime().run(fg)
+    got = snk.items()
+    ref = sps.lfilter(taps, 1.0, data)
+    assert len(got) >= (len(data) // 8192) * 8192
+    np.testing.assert_allclose(got, ref[:len(got)], rtol=1e-4, atol=1e-5)
+
+
+def test_tpu_kernel_spectrum_chain():
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSource, VectorSink
+    from futuresdr_tpu.tpu import TpuKernel
+
+    n_fft = 512
+    tone = np.exp(1j * 2 * np.pi * 0.1 * np.arange(64 * 1024)).astype(np.complex64)
+    fg = Flowgraph()
+    src = VectorSource(tone)
+    tk = TpuKernel([fft_stage(n_fft), mag2_stage()], np.complex64, frame_size=16 * 1024)
+    snk = VectorSink(np.float32)
+    fg.connect(src, tk, snk)
+    Runtime().run(fg)
+    spec = snk.items()[:n_fft]
+    assert np.argmax(spec) == round(0.1 * n_fft)
