@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""North-star benchmark: Msamples/s through the perf/fir-equivalent flowgraph.
+
+Reference harness: ``perf/fir`` (CopyRand → 64-tap f32 FIR chains; ``perf/fir/fir.rs:14-95``)
+with GNU Radio C++ as its baseline. Here the baseline is this framework's own CPU block path
+(scipy FIR inside the actor runtime) and the measured config is the TPU path: the same
+64-tap FIR fused with a 2048-pt FFT + |x|² spectrum chain (BASELINE.md configs 1+2) running
+as a single jitted XLA program through ``TpuKernel``.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "Msamples/s", "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import Fir, Fft, Apply, NullSink, NullSource, Head
+from futuresdr_tpu.dsp import firdes
+from futuresdr_tpu.ops import fir_stage, fft_stage, mag2_stage
+from futuresdr_tpu.tpu import TpuKernel, instance
+
+N_TAPS = 64
+FFT_SIZE = 2048
+
+
+def run_cpu(n_samples: int) -> float:
+    """CPU path: NullSource → 64-tap FIR → FFT(2048) → mag² → NullSink."""
+    taps = firdes.lowpass(0.2, N_TAPS).astype(np.float32)
+    fg = Flowgraph()
+    src = NullSource(np.complex64)
+    head = Head(np.complex64, n_samples)
+    fir = Fir(taps, np.complex64)
+    fft = Fft(FFT_SIZE)
+    mag = Apply(lambda x: (x.real**2 + x.imag**2), np.complex64, np.float32)
+    snk = NullSink(np.float32)
+    fg.connect(src, head, fir, fft, mag, snk)
+    t0 = time.perf_counter()
+    Runtime().run(fg)
+    dt = time.perf_counter() - t0
+    assert snk.n_received >= n_samples - FFT_SIZE, snk.n_received
+    return n_samples / dt / 1e6
+
+
+def run_tpu(n_samples: int, frame_size: int = 1 << 20, depth: int = 4) -> float:
+    """TPU path: same chain fused into one XLA program."""
+    taps = firdes.lowpass(0.2, N_TAPS).astype(np.float32)
+    fg = Flowgraph()
+    src = NullSource(np.complex64)
+    head = Head(np.complex64, n_samples)
+    tk = TpuKernel([fir_stage(taps), fft_stage(FFT_SIZE), mag2_stage()],
+                   np.complex64, frame_size=frame_size, frames_in_flight=depth)
+    snk = NullSink(np.float32)
+    fg.connect(src, head, tk, snk)
+    t0 = time.perf_counter()
+    Runtime().run(fg)
+    dt = time.perf_counter() - t0
+    assert snk.n_received >= (n_samples // frame_size) * frame_size, snk.n_received
+    return n_samples / dt / 1e6
+
+
+def main():
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu-samples", type=int, default=20_000_000)
+    p.add_argument("--tpu-samples", type=int, default=200_000_000)
+    p.add_argument("--frame", type=int, default=1 << 20)
+    args = p.parse_args()
+
+    inst = instance()
+    cpu_rate = run_cpu(args.cpu_samples)
+    tpu_rate = run_tpu(args.tpu_samples, args.frame)
+    result = {
+        "metric": f"fir64+fft{FFT_SIZE}+mag2 throughput ({inst.platform})",
+        "value": round(tpu_rate, 1),
+        "unit": "Msamples/s",
+        "vs_baseline": round(tpu_rate / cpu_rate, 2),
+        "cpu_baseline_msps": round(cpu_rate, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

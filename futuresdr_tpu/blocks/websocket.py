@@ -1,0 +1,111 @@
+"""WebSocket sinks feeding GUIs (waterfall/constellation/time-sink).
+
+Reference: ``src/blocks/{websocket_sink,websocket_pmt_sink}.rs`` — a WS server that pushes
+the latest stream chunk (or Pmt) to every connected client; the prophecy GUI widgets
+subscribe to these.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional, Set
+
+import numpy as np
+
+from ..log import logger
+from ..runtime.kernel import Kernel, message_handler
+from ..types import Pmt
+
+__all__ = ["WebsocketSink", "WebsocketPmtSink"]
+
+log = logger("blocks.websocket")
+
+
+class _WsServerMixin:
+    async def _start_ws(self, port: int):
+        import websockets
+        self._clients: Set = set()
+
+        async def handler(ws):
+            self._clients.add(ws)
+            try:
+                await ws.wait_closed()
+            finally:
+                self._clients.discard(ws)
+
+        self._server = await websockets.serve(handler, "0.0.0.0", port)
+        log.info("websocket sink listening on :%d", port)
+
+    async def _stop_ws(self):
+        if getattr(self, "_server", None):
+            self._server.close()
+
+    async def _broadcast(self, payload):
+        dead = []
+        for ws in list(self._clients):
+            try:
+                await ws.send(payload)
+            except Exception:
+                dead.append(ws)
+        for ws in dead:
+            self._clients.discard(ws)
+
+
+class WebsocketSink(Kernel, _WsServerMixin):
+    """Push fixed-size binary chunks of the stream to WS clients (`websocket_sink.rs`).
+
+    ``mode``: "drop" sends only the latest chunk per send opportunity (GUI rate),
+    "block" applies backpressure.
+    """
+
+    def __init__(self, port: int, dtype, chunk_items: int = 2048, mode: str = "drop"):
+        super().__init__()
+        self.port = port
+        self.chunk = chunk_items
+        assert mode in ("drop", "block")
+        self.mode = mode
+        self.input = self.add_stream_input("in", dtype, min_items=chunk_items)
+
+    async def init(self, mio, meta):
+        await self._start_ws(self.port)
+
+    async def deinit(self, mio, meta):
+        await self._stop_ws()
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        n = (len(inp) // self.chunk) * self.chunk
+        if n:
+            if self._clients:
+                if self.mode == "drop":
+                    chunk = inp[n - self.chunk:n]
+                    await self._broadcast(chunk.tobytes())
+                else:
+                    for i in range(0, n, self.chunk):
+                        await self._broadcast(inp[i:i + self.chunk].tobytes())
+            self.input.consume(n)
+        if self.input.finished() and len(inp) - n < self.chunk:
+            io.finished = True
+
+
+class WebsocketPmtSink(Kernel, _WsServerMixin):
+    """Push received Pmts to WS clients as JSON (`websocket_pmt_sink.rs`)."""
+
+    def __init__(self, port: int):
+        super().__init__()
+        self.port = port
+
+    async def init(self, mio, meta):
+        await self._start_ws(self.port)
+
+    async def deinit(self, mio, meta):
+        await self._stop_ws()
+
+    @message_handler(name="in")
+    async def in_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        if p.is_finished():
+            io.finished = True
+            return Pmt.ok()
+        await self._broadcast(json.dumps(p.to_json()))
+        return Pmt.ok()

@@ -1,0 +1,92 @@
+"""ZeroMQ transport blocks: host-to-host flowgraph distribution.
+
+Reference: ``src/blocks/zeromq/{pub_sink,sub_source}.rs`` — the reference's inter-process
+distribution story (SURVEY §2.7): PUB/SUB sample streams between runtimes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..log import logger
+from ..runtime.kernel import Kernel
+
+__all__ = ["PubSink", "SubSource"]
+
+log = logger("blocks.zeromq")
+
+
+class PubSink(Kernel):
+    """Publish stream chunks on a ZMQ PUB socket (`zeromq/pub_sink.rs`)."""
+
+    def __init__(self, address: str, dtype):
+        super().__init__()
+        self.address = address
+        self._sock = None
+        self._ctx = None
+        self.input = self.add_stream_input("in", dtype)
+
+    async def init(self, mio, meta):
+        import zmq
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUB)
+        self._sock.bind(self.address)
+
+    async def deinit(self, mio, meta):
+        if self._sock is not None:
+            self._sock.close(linger=0)
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        if len(inp):
+            self._sock.send(inp.tobytes(), copy=True)
+            self.input.consume(len(inp))
+        if self.input.finished():
+            io.finished = True
+
+
+class SubSource(Kernel):
+    """Subscribe to a ZMQ stream (`zeromq/sub_source.rs`)."""
+
+    BLOCKING = True  # zmq recv blocks its own thread, like #[blocking] hardware blocks
+
+    def __init__(self, address: str, dtype, timeout_ms: int = 100):
+        super().__init__()
+        self.address = address
+        self.timeout_ms = timeout_ms
+        self._sock = None
+        self._tail = b""
+        self.output = self.add_stream_output("out", dtype)
+
+    async def init(self, mio, meta):
+        import zmq
+        ctx = zmq.Context.instance()
+        self._sock = ctx.socket(zmq.SUB)
+        self._sock.connect(self.address)
+        self._sock.setsockopt(zmq.SUBSCRIBE, b"")
+        self._sock.setsockopt(zmq.RCVTIMEO, self.timeout_ms)
+
+    async def deinit(self, mio, meta):
+        if self._sock is not None:
+            self._sock.close(linger=0)
+
+    async def work(self, io, mio, meta):
+        import zmq
+        out = self.output.slice()
+        if len(out) == 0:
+            return
+        try:
+            data = self._sock.recv()
+        except zmq.Again:
+            io.call_again = True   # poll again (dedicated thread; cheap)
+            return
+        buf = self._tail + data
+        itemsize = self.output.dtype.itemsize
+        k = min(len(buf) // itemsize, len(out))
+        if k:
+            out[:k] = np.frombuffer(buf[:k * itemsize], dtype=self.output.dtype)
+            self.output.produce(k)
+        self._tail = buf[k * itemsize:]
+        io.call_again = True

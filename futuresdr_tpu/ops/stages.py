@@ -121,38 +121,47 @@ class Pipeline:
 # stage factories
 # ---------------------------------------------------------------------------
 
-def fir_stage(taps, decim: int = 1, name: str = "fir") -> Stage:
-    """Overlap-save FIR (+ optional decimation) as a jitted stage.
+def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> Stage:
+    """FFT overlap-save FIR (+ optional decimation) as a jitted stage.
 
     History carry = last ``ntaps-1`` inputs (the `min_items` overlap of `fir.rs:49`
-    reframed for frames, SURVEY §5 long-context note). Real taps convolve complex frames
-    as two real convolutions (keeps the MXU in play; complex conv isn't natively lowered).
+    reframed for frames, SURVEY §5 long-context note). The frame is blocked into
+    ``fft_len`` segments with hop ``L = fft_len - (ntaps-1)`` and filtered in the
+    frequency domain — batched 2D FFTs are the TPU-idiomatic FIR (direct time-domain
+    convolution compiles poorly at SDR frame sizes on the TPU backend). The
+    frequency-domain taps ride in the carry (identity pass-through under XLA
+    input-output aliasing), which also makes them donation-safe and hot-swappable.
     """
     taps = np.asarray(taps)
     nt = len(taps)
-    tj = jnp.asarray(taps)
-
-    def conv_valid(x):
-        # x: [n + nt - 1] → [n]; jnp.convolve(valid) lowers to conv_general_dilated on the
-        # MXU. precision="highest" keeps f32 accumulation (default bf16 passes lose ~7e-3).
-        if jnp.iscomplexobj(x) and not np.iscomplexobj(taps):
-            re = jnp.convolve(x.real, tj, mode="valid", precision="highest")
-            im = jnp.convolve(x.imag, tj, mode="valid", precision="highest")
-            return (re + 1j * im).astype(x.dtype)
-        return jnp.convolve(x, tj.astype(x.dtype) if np.isrealobj(taps) else tj,
-                            mode="valid", precision="highest").astype(x.dtype)
+    # 50% overlap-save with power-of-two hop L and fft_len = 2L: radix-friendly FFTs and
+    # power-of-two frame multiples (at the cost of carrying L instead of ntaps-1 samples).
+    L = fft_len // 2
+    while L < 2 * nt:                   # hop must comfortably exceed the tap overlap
+        L *= 2
+    fft_len = 2 * L
+    H = np.fft.fft(np.concatenate([taps, np.zeros(fft_len - nt)])).astype(np.complex64)
 
     def fn(carry, x):
-        ext = jnp.concatenate([carry, x])
-        y = conv_valid(ext)
+        Hc, tail = carry
+        ext = jnp.concatenate([tail, x])             # [L + n], n = S*L
+        s = x.shape[0] // L
+        idx = jnp.arange(s)[:, None] * L + jnp.arange(fft_len)[None, :]
+        blocks = ext[idx]                            # [S, 2L] (block s = ext[sL:sL+2L])
+        spec = jnp.fft.fft(blocks, axis=1) * Hc[None, :]
+        seg = jnp.fft.ifft(spec, axis=1)[:, L:]      # linear-conv region (L ≥ ntaps-1)
+        y = seg.reshape(-1)
+        y = y.astype(x.dtype) if jnp.iscomplexobj(x) else y.real.astype(x.dtype)
         if decim > 1:
             y = y[::decim]
-        return ext[ext.shape[0] - (nt - 1):], y
+        return (Hc, ext[ext.shape[0] - L:]), y
 
     def init_carry(dtype):
-        return jnp.zeros(nt - 1, dtype=dtype)
+        return (jnp.asarray(H), jnp.zeros(L, dtype=dtype))
 
-    return Stage(fn, init_carry, Fraction(1, decim), None, decim, name)
+    # frame must be a multiple of the hop (and of decim at the output side)
+    multiple = int(np.lcm(L, decim))
+    return Stage(fn, init_carry, Fraction(1, decim), None, multiple, name)
 
 
 def decimate_stage(decim: int) -> Stage:

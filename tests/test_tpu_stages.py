@@ -27,7 +27,8 @@ def run_pipeline(pipe: Pipeline, x: np.ndarray, frame: int) -> np.ndarray:
 def test_fir_stage_matches_lfilter_across_frames():
     taps = firdes.lowpass(0.2, 64).astype(np.float32)
     x = np.random.default_rng(0).standard_normal(8192).astype(np.float32)
-    pipe = Pipeline([fir_stage(taps)], np.float32)
+    pipe = Pipeline([fir_stage(taps, fft_len=512)], np.float32)
+    assert pipe.frame_multiple == 256   # hop L = fft_len/2
     y = run_pipeline(pipe, x, 1024)
     ref = sps.lfilter(taps, 1.0, x)
     np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
@@ -36,8 +37,8 @@ def test_fir_stage_matches_lfilter_across_frames():
 def test_fir_stage_complex_with_decim():
     taps = firdes.lowpass(0.1, 32).astype(np.float32)
     x = (np.exp(1j * 2 * np.pi * 0.03 * np.arange(8192))).astype(np.complex64)
-    pipe = Pipeline([fir_stage(taps, decim=4)], np.complex64)
-    assert pipe.frame_multiple == 4
+    pipe = Pipeline([fir_stage(taps, decim=4, fft_len=512)], np.complex64)
+    assert pipe.frame_multiple == 256
     assert pipe.out_items(1024) == 256
     y = run_pipeline(pipe, x, 1024)
     ref = sps.lfilter(taps, 1.0, x)[::4]
@@ -86,8 +87,9 @@ def test_moving_avg_stage():
 
 def test_pipeline_rate_math():
     taps = np.ones(16, dtype=np.float32)
-    pipe = Pipeline([fir_stage(taps, decim=2), fft_stage(64), mag2_stage()], np.complex64)
-    # input multiple: decim 2 and fft 64 at post-decim rate → 128 input items
+    pipe = Pipeline([fir_stage(taps, decim=2, fft_len=128), fft_stage(64), mag2_stage()],
+                    np.complex64)
+    # input multiple: hop 64, decim 2, and fft 64 at post-decim rate → 128 input items
     assert pipe.frame_multiple == 128
     assert pipe.out_items(1024) == 512
 
