@@ -12,6 +12,13 @@ from .stream import (Copy, Head, Throttle, MovingAvg, TagDebug, Delay,
 from .dsp import (Fir, FirBuilder, Iir, Fft, XlatingFir, SignalSource,
                   QuadratureDemod, Agc)
 from .pfb import PfbChannelizer, PfbSynthesizer, PfbArbResampler
+from .message import (MessageAnnotator, MessageApply, MessageBurst, MessageCopy,
+                      MessagePipe, MessageSink, MessageSource)
+from .io import (FileSource, FileSink, TcpSource, TcpSink, UdpSource, BlobToUdp,
+                 ChannelSource, ChannelSink)
+from .websocket import WebsocketSink, WebsocketPmtSink
+from .zeromq import PubSink, SubSource
+from .seify import SeifySource, SeifySink, SeifyBuilder
 
 __all__ = [
     "Apply", "Combine", "Filter", "Split", "Source", "FiniteSource", "Sink",
@@ -22,4 +29,11 @@ __all__ = [
     "Fir", "FirBuilder", "Iir", "Fft", "XlatingFir", "SignalSource",
     "QuadratureDemod", "Agc",
     "PfbChannelizer", "PfbSynthesizer", "PfbArbResampler",
+    "MessageAnnotator", "MessageApply", "MessageBurst", "MessageCopy",
+    "MessagePipe", "MessageSink", "MessageSource",
+    "FileSource", "FileSink", "TcpSource", "TcpSink", "UdpSource", "BlobToUdp",
+    "ChannelSource", "ChannelSink",
+    "WebsocketSink", "WebsocketPmtSink",
+    "PubSink", "SubSource",
+    "SeifySource", "SeifySink", "SeifyBuilder",
 ]

@@ -103,15 +103,16 @@ class TcpSource(Kernel):
         self.output = self.add_stream_output("out", dtype)
 
     async def init(self, mio, meta):
+        # bind in init, but accept lazily in work: blocking the init barrier on a peer
+        # that connects only after launch would deadlock the whole flowgraph
         if self.listen:
-            fut = asyncio.get_running_loop().create_future()
+            self._accept_fut = asyncio.get_running_loop().create_future()
 
             async def on_conn(r, w):
-                if not fut.done():
-                    fut.set_result((r, w))
+                if not self._accept_fut.done():
+                    self._accept_fut.set_result((r, w))
 
             self._server = await asyncio.start_server(on_conn, self.host, self.port)
-            self._reader, self._writer = await fut
         else:
             self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
 
@@ -122,6 +123,8 @@ class TcpSource(Kernel):
             self._server.close()
 
     async def work(self, io, mio, meta):
+        if self._reader is None:
+            self._reader, self._writer = await self._accept_fut
         out = self.output.slice()
         if len(out) == 0:
             return
@@ -151,14 +154,13 @@ class TcpSink(Kernel):
 
     async def init(self, mio, meta):
         if self.listen:
-            fut = asyncio.get_running_loop().create_future()
+            self._accept_fut = asyncio.get_running_loop().create_future()
 
             async def on_conn(r, w):
-                if not fut.done():
-                    fut.set_result((r, w))
+                if not self._accept_fut.done():
+                    self._accept_fut.set_result((r, w))
 
             self._server = await asyncio.start_server(on_conn, self.host, self.port)
-            _, self._writer = await fut
         else:
             _, self._writer = await asyncio.open_connection(self.host, self.port)
 
@@ -173,6 +175,8 @@ class TcpSink(Kernel):
             self._server.close()
 
     async def work(self, io, mio, meta):
+        if self._writer is None:
+            _, self._writer = await self._accept_fut
         inp = self.input.slice()
         if len(inp):
             self._writer.write(inp.tobytes())

@@ -1,0 +1,5 @@
+"""Remote control client (the ``futuresdr-remote`` crate equivalent)."""
+
+from .remote import Remote, RemoteFlowgraph, RemoteBlock
+
+__all__ = ["Remote", "RemoteFlowgraph", "RemoteBlock"]

@@ -1,0 +1,69 @@
+"""Typed async HTTP client for the REST control plane.
+
+Re-design of the ``futuresdr-remote`` crate (``crates/remote/src/remote.rs:17-291``):
+``Remote → RemoteFlowgraph → RemoteBlock.call/(callback)`` mirroring the server routes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..types import Pmt
+
+__all__ = ["Remote", "RemoteFlowgraph", "RemoteBlock"]
+
+
+class Remote:
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    async def _get(self, path: str):
+        import aiohttp
+        async with aiohttp.ClientSession() as s:
+            async with s.get(self.url + path) as r:
+                r.raise_for_status()
+                return await r.json()
+
+    async def _post(self, path: str, body):
+        import aiohttp
+        async with aiohttp.ClientSession() as s:
+            async with s.post(self.url + path, json=body) as r:
+                r.raise_for_status()
+                return await r.json()
+
+    async def flowgraphs(self) -> List["RemoteFlowgraph"]:
+        ids = await self._get("/api/fg/")
+        return [RemoteFlowgraph(self, i) for i in ids]
+
+    async def flowgraph(self, fg_id: int = 0) -> "RemoteFlowgraph":
+        return RemoteFlowgraph(self, fg_id)
+
+
+class RemoteFlowgraph:
+    def __init__(self, remote: Remote, fg_id: int):
+        self.remote = remote
+        self.id = fg_id
+
+    async def description(self) -> dict:
+        return await self.remote._get(f"/api/fg/{self.id}/")
+
+    async def blocks(self) -> List["RemoteBlock"]:
+        desc = await self.description()
+        return [RemoteBlock(self, b["id"], b) for b in desc["blocks"]]
+
+    async def block(self, block_id: int) -> "RemoteBlock":
+        desc = await self.remote._get(f"/api/fg/{self.id}/block/{block_id}/")
+        return RemoteBlock(self, block_id, desc)
+
+
+class RemoteBlock:
+    def __init__(self, fg: RemoteFlowgraph, block_id: int, description: Optional[dict] = None):
+        self.fg = fg
+        self.id = block_id
+        self.description = description or {}
+
+    async def call(self, handler, pmt: Pmt = None) -> Pmt:
+        pmt = Pmt.from_py(pmt) if not isinstance(pmt, Pmt) else pmt
+        r = await self.fg.remote._post(
+            f"/api/fg/{self.fg.id}/block/{self.id}/call/{handler}/", pmt.to_json())
+        return Pmt.from_json(r)

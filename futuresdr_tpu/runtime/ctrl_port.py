@@ -1,0 +1,146 @@
+"""REST control plane.
+
+Re-design of ``src/runtime/ctrl_port.rs:96-199`` (axum server on a dedicated thread): an
+aiohttp server on its own thread + event loop, exposing the same four endpoint families:
+
+  GET  /api/fg/                                   → list of flowgraph ids
+  GET  /api/fg/{fg}/                              → FlowgraphDescription
+  GET  /api/fg/{fg}/block/{blk}/                  → BlockDescription
+  GET  /api/fg/{fg}/block/{blk}/call/{handler}/   → call with Pmt::Null
+  POST /api/fg/{fg}/block/{blk}/call/{handler}/   → call with JSON-Pmt body
+
+Pmt values are serialized with the same externally-tagged JSON as the reference's serde.
+CORS is permissive; graceful shutdown on ``stop()``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+from ..config import config
+from ..log import logger
+from ..types import Pmt
+
+__all__ = ["ControlPort"]
+
+log = logger("ctrl_port")
+
+
+class ControlPort:
+    def __init__(self, runtime_handle, bind: Optional[str] = None):
+        self.handle = runtime_handle
+        bind = bind or config().ctrlport_bind
+        host, _, port = bind.partition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port or 1337)
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._runner = None
+
+    # -- server lifecycle (own thread, like the reference's tokio thread) ------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            loop.run_until_complete(self._serve())
+            self._started.set()
+            loop.run_forever()
+            loop.run_until_complete(self._cleanup())
+            loop.close()
+
+        self._thread = threading.Thread(target=run, name="fsdr-ctrlport", daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self._loop is not None and self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._thread = None
+
+    async def _cleanup(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- routes ----------------------------------------------------------------
+    async def _serve(self):
+        from aiohttp import web
+
+        app = web.Application()
+
+        @web.middleware
+        async def cors(request, handler):
+            resp = await handler(request)
+            resp.headers["Access-Control-Allow-Origin"] = "*"
+            return resp
+
+        app.middlewares.append(cors)
+        app.router.add_get("/api/fg/", self._list_fgs)
+        app.router.add_get("/api/fg/{fg}/", self._describe_fg)
+        app.router.add_get("/api/fg/{fg}/block/{blk}/", self._describe_block)
+        app.router.add_get("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
+        app.router.add_post("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
+        fp = config().frontend_path
+        if fp:
+            app.router.add_static("/", fp)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        log.info("control port listening on %s:%d", self.host, self.port)
+
+    async def _list_fgs(self, request):
+        from aiohttp import web
+        return web.json_response(self.handle.flowgraph_ids())
+
+    def _fg(self, request):
+        return self.handle.get_flowgraph(int(request.match_info["fg"]))
+
+    async def _describe_fg(self, request):
+        from aiohttp import web
+        fg = self._fg(request)
+        if fg is None:
+            return web.json_response({"error": "flowgraph not found"}, status=404)
+        desc = await fg.describe()
+        return web.json_response(desc.to_json())
+
+    async def _describe_block(self, request):
+        from aiohttp import web
+        fg = self._fg(request)
+        if fg is None:
+            return web.json_response({"error": "flowgraph not found"}, status=404)
+        desc = await fg.describe()
+        blk = int(request.match_info["blk"])
+        for b in desc.blocks:
+            if b.id == blk:
+                return web.json_response(b.to_json())
+        return web.json_response({"error": "block not found"}, status=404)
+
+    async def _call(self, request):
+        from aiohttp import web
+        fg = self._fg(request)
+        if fg is None:
+            return web.json_response({"error": "flowgraph not found"}, status=404)
+        blk = int(request.match_info["blk"])
+        handler = request.match_info["handler"]
+        try:
+            handler = int(handler)
+        except ValueError:
+            pass
+        if request.method == "POST":
+            try:
+                pmt = Pmt.from_json(await request.json())
+            except Exception as e:
+                return web.json_response({"error": f"bad pmt: {e}"}, status=400)
+        else:
+            pmt = Pmt.null()
+        result = await fg.call(blk, handler, pmt)
+        return web.json_response(result.to_json())

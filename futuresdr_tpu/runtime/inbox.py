@@ -131,14 +131,16 @@ class BlockInbox:
         self.closed = False
 
     # -- producer side --------------------------------------------------------
-    def send(self, msg: BlockMessage) -> None:
-        """Enqueue a control message and wake the block (`block_inbox.rs:120-136`)."""
+    def send(self, msg: BlockMessage) -> bool:
+        """Enqueue a control message and wake the block (`block_inbox.rs:120-136`).
+        Returns False if the inbox is closed (receiver gone)."""
         with self._lock:
             if self.closed:
-                return
+                return False
             self._q.append(msg)
             waiter = self._take_waiter_locked()
         self._wake(waiter)
+        return True
 
     try_send = send  # soft-bounded; see module docstring
 
@@ -206,6 +208,6 @@ class BlockInbox:
             self.take_pending()
 
     def close(self) -> None:
+        """Refuse new sends; already-queued messages stay drainable via try_recv."""
         with self._lock:
             self.closed = True
-            self._q.clear()

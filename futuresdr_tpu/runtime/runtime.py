@@ -174,6 +174,17 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
             await h
         except Exception as e:
             log.error("block task raised: %r", e)
+    # refuse new control sends, then answer anything still queued: a call into a
+    # finished flowgraph returns InvalidValue instead of hanging the caller
+    fg_inbox.close()
+    while True:
+        msg = fg_inbox.try_recv()
+        if msg is None:
+            break
+        if isinstance(msg, BlockCallbackMsg):
+            msg.reply.set(Pmt.invalid_value())
+        elif isinstance(msg, DescribeMsg):
+            msg.reply.set(_describe(fg, blocks))
     fg.restore_blocks(finished)
     if errors:
         raise FlowgraphError(str(errors[0])) from errors[0]
@@ -218,12 +229,14 @@ class FlowgraphHandle:
         """Invoke a handler and await its Pmt result (`flowgraph_handle.rs:85-104`)."""
         data = Pmt.from_py(data) if not isinstance(data, Pmt) else data
         reply = ReplySlot()
-        self._inbox.send(BlockCallbackMsg(self._bid(block), port, data, reply))
+        if not self._inbox.send(BlockCallbackMsg(self._bid(block), port, data, reply)):
+            return Pmt.invalid_value()   # flowgraph already completed
         return await reply.get()
 
     async def describe(self) -> FlowgraphDescription:
         reply = ReplySlot()
-        self._inbox.send(DescribeMsg(reply))
+        if not self._inbox.send(DescribeMsg(reply)):
+            return self._fg.describe()   # flowgraph completed; describe statically
         return await reply.get()
 
     async def terminate(self) -> None:
