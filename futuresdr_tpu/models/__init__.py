@@ -1,0 +1,9 @@
+"""Model zoo: the reference's example applications re-designed TPU-first.
+
+Reference ``examples/`` (SURVEY §2.6): WLAN 802.11 transceiver, LoRa PHY, ZigBee, ADS-B,
+FM receiver, spectrum analyzer, and the burn ML example (→ :mod:`.mcldnn`).
+"""
+
+from .mcldnn import MCLDNN, make_train_step, init_params, loss_fn
+
+__all__ = ["MCLDNN", "make_train_step", "init_params", "loss_fn"]

@@ -1,0 +1,77 @@
+"""MCLDNN: multi-channel convolutional LSTM deep neural network for automatic
+modulation classification — ML-in-the-flowgraph, TPU-native.
+
+Re-design of the reference's burn example model (``examples/burn/src/model.rs:55-62``:
+Conv2D + per-I/Q Conv1D branches → merge convs → 2×LSTM → SELU dense head), which the
+reference trains/infers through burn tensors flowing in the flowgraph. Here the model is
+flax/JAX: it slots into a flowgraph through :class:`futuresdr_tpu.tpu.TpuKernel` (frames of
+IQ → class logits) and trains with a pjit-sharded train step (see ``futuresdr_tpu/parallel``
+and ``__graft_entry__.py``).
+
+Input: ``[batch, 2, n]`` float32 (I/Q rows), e.g. n=128 RadioML-style snippets.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+__all__ = ["MCLDNN", "make_train_step", "init_params", "loss_fn"]
+
+
+class MCLDNN(nn.Module):
+    n_classes: int = 11
+    conv_features: int = 50
+    lstm_features: int = 128
+
+    @nn.compact
+    def __call__(self, iq: jnp.ndarray) -> jnp.ndarray:   # [B, 2, N]
+        f = self.conv_features
+        # branch 1: joint I/Q 2D conv
+        a = nn.Conv(f, (2, 8), padding="SAME", name="conv_iq")(iq[..., None])  # [B,2,N,f]
+        # branches 2/3: per-rail 1D convs
+        i = nn.Conv(f, (8,), padding="SAME", name="conv_i")(iq[:, 0, :, None])  # [B,N,f]
+        q = nn.Conv(f, (8,), padding="SAME", name="conv_q")(iq[:, 1, :, None])
+        rails = jnp.stack([i, q], axis=1)                                       # [B,2,N,f]
+        merged = nn.relu(jnp.concatenate([a, rails], axis=-1))                  # [B,2,N,2f]
+        v = nn.Conv(2 * f, (2, 5), padding="VALID", name="conv_merge")(merged)  # [B,1,N-4,2f]
+        v = nn.relu(v[:, 0])                                                    # [B,N-4,2f]
+        # temporal modelling: 2 stacked LSTMs (lax.scan inside — jit-friendly)
+        v = nn.RNN(nn.OptimizedLSTMCell(self.lstm_features), name="lstm1")(v)
+        v = nn.RNN(nn.OptimizedLSTMCell(self.lstm_features), name="lstm2")(v)
+        h = v[:, -1]                                                            # last step
+        h = nn.selu(nn.Dense(128, name="fc1")(h))
+        h = nn.selu(nn.Dense(128, name="fc2")(h))
+        return nn.Dense(self.n_classes, name="head")(h)
+
+
+def init_params(model: MCLDNN, n: int = 128, seed: int = 0):
+    return model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 2, n), jnp.float32))
+
+
+def loss_fn(model: MCLDNN, params, iq, labels):
+    logits = model.apply(params, iq)
+    onehot = jax.nn.one_hot(labels, model.n_classes)
+    loss = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, acc
+
+
+def make_train_step(model: MCLDNN, optimizer):
+    """Full train step (fwd + bwd + optax update); pure function of (params, opt_state,
+    batch) — shard with jit in/out shardings (see ``parallel.shard_params`` and
+    ``__graft_entry__.dryrun_multichip``)."""
+
+    def step(params, opt_state, iq, labels):
+        (loss, acc), grads = jax.value_and_grad(
+            lambda p: loss_fn(model, p, iq, labels), has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, acc
+
+    return step

@@ -1,0 +1,73 @@
+"""Device mesh helpers for multi-chip scaling.
+
+The reference is single-process shared-memory (SURVEY §2.7); its scale-out story is
+transport blocks between hosts. The TPU-native scale-out is SPMD over an ICI mesh:
+``jax.sharding.Mesh`` + shardings, XLA inserting the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "factor_devices", "shard_params", "P", "NamedSharding"]
+
+
+def factor_devices(n: int, n_axes: int = 2) -> Tuple[int, ...]:
+    """Factor n devices into a near-balanced axis tuple (largest factors first)."""
+    dims = [1] * n_axes
+    rem = n
+    # peel off prime factors, assigning each to the currently-smallest axis
+    f = 2
+    factors = []
+    while rem > 1 and f * f <= rem:
+        while rem % f == 0:
+            factors.append(f)
+            rem //= f
+        f += 1
+    if rem > 1:
+        factors.append(rem)
+    for f in sorted(factors, reverse=True):
+        i = int(np.argmin(dims))
+        dims[i] *= f
+    return tuple(sorted(dims, reverse=True))
+
+
+def make_mesh(axis_names: Sequence[str], shape: Optional[Sequence[int]] = None,
+              devices=None) -> Mesh:
+    """Mesh over all (or given) devices; shape auto-factored when omitted."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = factor_devices(len(devices), len(axis_names))
+    arr = np.array(devices[:int(np.prod(shape))]).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def shard_params(params, mesh: Mesh, axis: str = "mp"):
+    """FSDP-style weight sharding: for each parameter leaf, shard its largest
+    evenly-divisible axis over ``axis``; replicate the rest.
+
+    Returns (sharded_params, shardings_pytree) — pass the shardings as jit
+    in_shardings/out_shardings so the train step runs fully SPMD.
+    """
+    n = mesh.shape[axis]
+
+    def spec_for(leaf):
+        if not hasattr(leaf, "shape") or leaf.ndim == 0:
+            return P()
+        sizes = list(leaf.shape)
+        order = np.argsort(sizes)[::-1]
+        for ax in order:
+            if sizes[ax] % n == 0 and sizes[ax] >= n:
+                spec = [None] * leaf.ndim
+                spec[ax] = axis
+                return P(*spec)
+        return P()
+
+    specs = jax.tree_util.tree_map(spec_for, params)
+    shardings = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
+    sharded = jax.device_put(params, shardings)
+    return sharded, shardings

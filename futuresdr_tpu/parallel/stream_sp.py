@@ -1,0 +1,105 @@
+"""Sequence parallelism for sample streams: shard the time axis over the mesh with
+halo exchange.
+
+This is the SDR analog of ring attention / context parallelism (SURVEY §2.7 row
+"Sequence parallelism"): a long frame is split into contiguous time shards, one per
+device; streaming operators that need history (FIR overlap, `fir.rs:49` ``min_items``)
+get their left halo from the previous device via a single ``ppermute`` over ICI, then
+compute purely locally. One collective per frame, O(taps) bytes — the collective rides
+ICI, not HBM.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+__all__ = ["sp_fir", "sp_fir_fft_mag2", "sp_channelizer"]
+
+
+def _halo_from_left(local: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarray:
+    """Prepend the previous shard's tail (zeros on shard 0) — the halo exchange."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    tail = local[-halo:]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    left_tail = jax.lax.ppermute(tail, axis_name, perm)  # shard i gets shard i-1's tail
+    left_tail = jnp.where(idx == 0, jnp.zeros_like(left_tail), left_tail)
+    return jnp.concatenate([left_tail, local])
+
+
+def sp_fir(taps: np.ndarray, mesh: Mesh, axis: str = "sp") -> Callable:
+    """Time-sharded FIR: input [n] sharded over ``axis``; output identically sharded.
+
+    y = conv_valid(halo ++ local) per shard == the global FIR, exactly.
+    """
+    nt = len(taps)
+    H = jnp.asarray(taps[::-1])  # correlation kernel
+
+    def local_fir(x_local):
+        ext = _halo_from_left(x_local, nt - 1, axis)
+        if jnp.iscomplexobj(ext):
+            re = jnp.convolve(ext.real, jnp.asarray(taps), mode="valid", precision="highest")
+            im = jnp.convolve(ext.imag, jnp.asarray(taps), mode="valid", precision="highest")
+            return (re + 1j * im).astype(x_local.dtype)
+        return jnp.convolve(ext, jnp.asarray(taps), mode="valid",
+                            precision="highest").astype(x_local.dtype)
+
+    return shard_map(local_fir, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+
+
+def sp_fir_fft_mag2(taps: np.ndarray, fft_size: int, mesh: Mesh,
+                    axis: str = "sp") -> Callable:
+    """The fused north-star chain, time-sharded: FIR (halo exchange) → per-shard batched
+    FFT → |x|². Local shard length must be a multiple of ``fft_size``."""
+    nt = len(taps)
+    tj = jnp.asarray(np.asarray(taps, dtype=np.float32))
+
+    def local(x_local):
+        ext = _halo_from_left(x_local, nt - 1, axis)
+        if jnp.iscomplexobj(ext):
+            y = (jnp.convolve(ext.real, tj, mode="valid", precision="highest")
+                 + 1j * jnp.convolve(ext.imag, tj, mode="valid", precision="highest"))
+        else:
+            y = jnp.convolve(ext, tj, mode="valid", precision="highest")
+        spec = jnp.fft.fft(y.reshape(-1, fft_size), axis=1)
+        return (spec.real**2 + spec.imag**2).astype(jnp.float32).reshape(-1)
+
+    return shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+
+
+def sp_channelizer(n_channels: int, taps: np.ndarray, mesh: Mesh,
+                   axis: str = "sp") -> Callable:
+    """Critically-sampled PFB channelizer, time-sharded: input [n] complex sharded over
+    ``axis`` (n/shards must be a multiple of n_channels); output [n_channels, n/N] with
+    the channel axis replicated and time sharded.
+
+    Each branch filter needs K-1 blocks of history → halo = (K-1)·N input samples from
+    the left neighbour; the IFFT across channels is purely local. This is the reference's
+    ``PfbChannelizer`` (`pfb/channelizer.rs`) scaled across chips.
+    """
+    N = n_channels
+    taps = np.asarray(taps, dtype=np.float32)
+    K = -(-len(taps) // N)
+    padded = np.zeros(K * N, dtype=np.float32)
+    padded[:len(taps)] = taps
+    branch = jnp.asarray(padded.reshape(K, N).T)          # [N, K]
+
+    def local(x_local):
+        halo = (K - 1) * N
+        ext = _halo_from_left(x_local, halo, axis)        # [(S + K-1)·N]
+        blocks = ext.reshape(-1, N)[:, ::-1].T            # [N, S + K-1] commutated
+        # batched branch FIR via valid correlation against each branch's taps
+        def one_branch(u, h):
+            return jnp.convolve(u, h[::-1], mode="valid", precision="highest")
+        v = jax.vmap(one_branch)(blocks, branch)          # [N, S]
+        return (jnp.fft.ifft(v, axis=0) * N).astype(jnp.complex64)
+
+    return shard_map(local, mesh=mesh, in_specs=P(axis),
+                     out_specs=P(None, axis))

@@ -1,0 +1,100 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh.
+
+Validates that the sequence-parallel stream ops (halo exchange over ppermute) are
+bit-identical to the single-device computation, and that the sharded MCLDNN train step
+runs SPMD (the driver's dryrun_multichip path).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from scipy import signal as sps
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from futuresdr_tpu.parallel import (make_mesh, factor_devices, shard_params,
+                                    sp_fir, sp_fir_fft_mag2, sp_channelizer)
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+def test_factor_devices():
+    assert factor_devices(8, 2) == (4, 2)
+    assert factor_devices(4, 2) == (2, 2)
+    assert factor_devices(1, 2) == (1, 1)
+    assert factor_devices(6, 2) == (3, 2)
+
+
+def test_sp_fir_matches_global():
+    mesh = make_mesh(("sp",), shape=(8,))
+    taps = np.hanning(63).astype(np.float32)
+    x = np.random.default_rng(0).standard_normal(8 * 512).astype(np.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("sp")))
+    y = jax.jit(sp_fir(taps, mesh))(xs)
+    ref = np.convolve(np.concatenate([np.zeros(62, np.float32), x]), taps, mode="valid")
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sp_fir_fft_mag2_matches_global():
+    mesh = make_mesh(("sp",), shape=(8,))
+    taps = np.hanning(64).astype(np.float32)
+    fft_size = 128
+    x = (np.random.default_rng(1).standard_normal(8 * 4 * fft_size)).astype(np.complex64)
+    xs = jax.device_put(x, NamedSharding(mesh, P("sp")))
+    y = np.asarray(jax.jit(sp_fir_fft_mag2(taps, fft_size, mesh))(xs))
+    filt = sps.lfilter(taps, 1.0, x)
+    ref = np.abs(np.fft.fft(filt.reshape(-1, fft_size), axis=1)) ** 2
+    np.testing.assert_allclose(y, ref.reshape(-1), rtol=1e-2, atol=1e-2)
+
+
+def test_sp_channelizer_routes_tone():
+    mesh = make_mesh(("sp",), shape=(8,))
+    N = 4
+    n = 8 * 64 * N
+    c = 3
+    x = np.exp(1j * 2 * np.pi * (c / N) * np.arange(n)).astype(np.complex64)
+    from futuresdr_tpu.blocks.pfb import pfb_default_taps
+    taps = pfb_default_taps(N)
+    xs = jax.device_put(x, NamedSharding(mesh, P("sp")))
+    y = np.asarray(jax.jit(sp_channelizer(N, taps, mesh))(xs))   # [N, n/N]
+    powers = (np.abs(y[:, 32:]) ** 2).mean(axis=1)
+    assert np.argmax(powers) == c
+    assert powers[c] > 50 * np.delete(powers, c).max()
+
+
+def test_sharded_train_step_spmd():
+    import optax
+    from futuresdr_tpu.models import MCLDNN, init_params, make_train_step
+
+    mesh = make_mesh(("dp", "mp"))
+    model = MCLDNN(n_classes=5, conv_features=8, lstm_features=16)
+    params = init_params(model, n=64)
+    params, shardings = shard_params(params, mesh, axis="mp")
+    # at least one large leaf must actually be sharded over mp
+    specs = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda s: s.spec, shardings,
+                               is_leaf=lambda x: isinstance(x, NamedSharding)))
+    assert any("mp" in str(s) for s in specs)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(model, opt))
+    b = 2 * mesh.shape["dp"]
+    iq = jax.device_put(np.random.default_rng(0).standard_normal((b, 2, 64)).astype(np.float32),
+                        NamedSharding(mesh, P("dp")))
+    labels = jax.device_put(np.zeros(b, np.int32), NamedSharding(mesh, P("dp")))
+    params2, opt_state, loss, acc = step(params, opt_state, iq, labels)
+    assert np.isfinite(float(loss))
+    # params keep their sharding through the step (no silent full replication)
+    leaf = jax.tree_util.tree_leaves(params2)[0]
+    assert leaf.sharding is not None
+
+
+def test_graft_entry_points():
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from __graft_entry__ import entry, dryrun_multichip
+
+    fn, args = entry()
+    y = jax.jit(fn)(*args)
+    assert y.shape == (8, 11)
+    dryrun_multichip(8)
