@@ -1,0 +1,17 @@
+"""IEEE 802.11a/g/p OFDM transceiver — the flagship application.
+
+Re-design of the reference's largest example (``examples/wlan/``, 4.3k LoC, a port of
+gr-ieee802-11): full TX (scramble/convolutional-code/interleave/map/IFFT+CP/preamble) and
+RX (detect/sync/equalize/demap/Viterbi/descramble) with MAC framing, built frame-level and
+batched for the TPU.
+"""
+
+from .consts import MCS_TABLE, Mcs
+from .phy import encode_frame, decode_frame, decode_stream, DecodedFrame
+from .mac import Mac, mpdu_from_payload, payload_from_mpdu
+from .blocks import WlanEncoder, WlanDecoder
+from . import coding, ofdm
+
+__all__ = ["MCS_TABLE", "Mcs", "encode_frame", "decode_frame", "decode_stream",
+           "DecodedFrame", "Mac", "mpdu_from_payload", "payload_from_mpdu",
+           "WlanEncoder", "WlanDecoder", "coding", "ofdm"]

@@ -1,0 +1,136 @@
+"""Streaming WLAN blocks wrapping the frame-level PHY.
+
+Reference: the WLAN example wires ~8 blocks (`examples/wlan/src/bin/loopback.rs:30-123`);
+here the TX is one message→stream block and the RX one stream→message block around the
+batched PHY functions — the per-frame computation is a single fused program (TPU-first),
+while the actor runtime still provides streaming, backpressure, and the message plane.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+import numpy as np
+
+from ...runtime.kernel import Kernel, message_handler
+from ...types import Pmt
+from . import phy
+from .consts import SYM_LEN
+from .mac import Mac
+
+__all__ = ["WlanEncoder", "WlanDecoder"]
+
+
+class WlanEncoder(Kernel):
+    """Message port ``tx`` (Blob payload) → baseband sample stream with inter-frame
+    gap (the reference's Mac → Encoder → Mapper → Prefix path)."""
+
+    def __init__(self, mcs: str = "qpsk_1_2", gap_samples: int = 500,
+                 use_mac: bool = True):
+        super().__init__()
+        self.mcs = mcs
+        self.gap = gap_samples
+        self.mac = Mac() if use_mac else None
+        self._pending: Deque[np.ndarray] = deque()
+        self._current: Optional[np.ndarray] = None
+        self._eos = False
+        self.output = self.add_stream_output("out", np.complex64)
+
+    @message_handler(name="tx")
+    async def tx_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        if p.is_finished():
+            self._eos = True
+            io.call_again = True
+            return Pmt.ok()
+        try:
+            payload = p.to_blob()
+        except Exception:
+            return Pmt.invalid_value()
+        psdu = self.mac.frame(payload) if self.mac else payload
+        frame = phy.encode_frame(psdu, self.mcs)
+        burst = np.concatenate([frame, np.zeros(self.gap, np.complex64)])
+        self._pending.append(burst)
+        io.call_again = True
+        return Pmt.ok()
+
+    async def work(self, io, mio, meta):
+        out = self.output.slice()
+        produced = 0
+        while produced < len(out):
+            if self._current is None:
+                if not self._pending:
+                    break
+                self._current = self._pending.popleft()
+            k = min(len(out) - produced, len(self._current))
+            out[produced:produced + k] = self._current[:k]
+            produced += k
+            self._current = self._current[k:] if k < len(self._current) else None
+        if produced:
+            self.output.produce(produced)
+        if self._eos and self._current is None and not self._pending:
+            io.finished = True
+        elif produced and (self._current is not None or self._pending):
+            io.call_again = True
+
+
+class WlanDecoder(Kernel):
+    """Baseband stream → decoded payload messages on port ``rx`` (the reference's
+    SyncShort → SyncLong → FFT → FrameEqualizer → Decoder path, batched)."""
+
+    #: sample overlap kept between work windows so frames spanning the boundary survive
+    OVERLAP = 4096
+
+    def __init__(self, use_mac: bool = True, chunk: int = 1 << 16):
+        super().__init__()
+        self.mac = Mac() if use_mac else None
+        self.chunk = chunk
+        self.frames = []           # decoded PSDUs (or payloads with MAC)
+        self._tail = np.zeros(0, np.complex64)
+        self._tail_abs = 0         # absolute index of tail[0]
+        self._seen_abs = set()     # absolute lts starts already decoded
+        self.input = self.add_stream_input("in", np.complex64, min_items=1024)
+        self.add_message_output("rx")
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        n = len(inp)
+        if n < self.chunk and not self.input.finished():
+            # wait for a fuller window (coalesced wakeups will re-arm us)
+            if n == 0:
+                return
+        if n == 0:
+            if self.input.finished():
+                io.finished = True
+            return
+        buf = np.concatenate([self._tail, inp[:n]])
+        base = self._tail_abs
+        for start in phy.ofdm.detect_packets(buf):
+            r = phy.ofdm.sync_long(buf, start)
+            if r is None:
+                continue
+            data_start, lts_start, cfo = r
+            abs_lts = base + lts_start
+            if abs_lts in self._seen_abs:
+                continue
+            frame = phy.decode_frame(buf, lts_start, cfo)
+            if frame is None:
+                continue
+            self._seen_abs.add(abs_lts)
+            psdu = frame.psdu
+            if self.mac:
+                payload = self.mac.deframe(psdu)
+                if payload is None:
+                    continue
+                self.frames.append(payload)
+                mio.post("rx", Pmt.blob(payload))
+            else:
+                self.frames.append(psdu)
+                mio.post("rx", Pmt.blob(psdu))
+        keep = min(len(buf), self.OVERLAP)
+        self._tail = buf[len(buf) - keep:].copy()
+        self._tail_abs = base + len(buf) - keep
+        self._seen_abs = {a for a in self._seen_abs if a >= self._tail_abs - self.OVERLAP}
+        self.input.consume(n)
+        if self.input.finished() and self.input.available() == 0:
+            io.finished = True

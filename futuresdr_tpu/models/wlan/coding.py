@@ -1,0 +1,155 @@
+"""802.11 bit-plane coding: scrambler, K=7 convolutional code, puncturing, interleaving,
+and a vectorized soft Viterbi decoder.
+
+Re-design of the reference WLAN example's ``Encoder`` and ``ViterbiDecoder``
+(``examples/wlan/src/{encoder,viterbi_decoder}.rs``). The Viterbi here is numpy-vectorized
+over all 64 trellis states per step (and has a jax twin in ``futuresdr_tpu.ops`` form: the
+same add-compare-select expressed with ``lax.scan``), instead of the reference's scalar
+Rust loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["scramble", "descramble", "conv_encode", "puncture", "depuncture",
+           "interleave", "deinterleave", "viterbi_decode"]
+
+# generator polynomials g0=133_o, g1=171_o (Clause 17.3.5.6)
+_G0, _G1 = 0o133, 0o171
+_K = 7
+_NSTATES = 64
+
+
+def scramble(bits: np.ndarray, seed: int = 0b1011101) -> np.ndarray:
+    """Self-synchronizing scrambler x^7 + x^4 + 1 (Clause 17.3.5.5)."""
+    out = np.empty_like(bits)
+    state = seed & 0x7F
+    for i, b in enumerate(bits):
+        fb = ((state >> 6) ^ (state >> 3)) & 1
+        out[i] = b ^ fb
+        state = ((state << 1) | fb) & 0x7F
+    return out
+
+
+def descramble(bits: np.ndarray, seed: int = 0b1011101) -> np.ndarray:
+    """Descrambling is the same operation (additive scrambler)."""
+    return scramble(bits, seed)
+
+
+# precomputed encoder output tables: for (state, input) → 2 output bits
+_OUT0 = np.zeros((_NSTATES, 2), dtype=np.uint8)
+_OUT1 = np.zeros((_NSTATES, 2), dtype=np.uint8)
+_NEXT = np.zeros((_NSTATES, 2), dtype=np.int64)
+for s in range(_NSTATES):
+    for b in range(2):
+        reg = (b << 6) | s            # shift register: newest bit at MSB
+        _OUT0[s, b] = bin(reg & _G0).count("1") & 1
+        _OUT1[s, b] = bin(reg & _G1).count("1") & 1
+        _NEXT[s, b] = reg >> 1
+
+
+def conv_encode(bits: np.ndarray) -> np.ndarray:
+    """Rate-1/2 convolutional encode; output interleaved [a0, b0, a1, b1, …]."""
+    out = np.empty(2 * len(bits), dtype=np.uint8)
+    s = 0
+    for i, b in enumerate(bits):
+        out[2 * i] = _OUT0[s, b]
+        out[2 * i + 1] = _OUT1[s, b]
+        s = _NEXT[s, b]
+    return out
+
+
+_PUNCTURE = {
+    "1/2": np.array([1, 1], dtype=bool),
+    "2/3": np.array([1, 1, 1, 0], dtype=bool),
+    "3/4": np.array([1, 1, 1, 0, 0, 1], dtype=bool),
+}
+
+
+def puncture(coded: np.ndarray, rate: str) -> np.ndarray:
+    pat = _PUNCTURE[rate]
+    mask = np.resize(pat, len(coded))
+    return coded[mask]
+
+
+def depuncture(llrs: np.ndarray, rate: str) -> np.ndarray:
+    """Re-insert zero-LLR erasures at the punctured positions."""
+    pat = _PUNCTURE[rate]
+    per_block = int(pat.sum())
+    n_blocks = -(-len(llrs) // per_block)
+    mask = np.tile(pat, n_blocks)
+    full = np.zeros(len(mask), dtype=np.float64)
+    pos = np.nonzero(mask)[0][:len(llrs)]
+    full[pos] = llrs
+    return full[:2 * (len(full) // 2)]
+
+
+def interleave(bits: np.ndarray, n_cbps: int, n_bpsc: int) -> np.ndarray:
+    """Two-permutation block interleaver (Clause 17.3.5.7), one OFDM symbol per block."""
+    s = max(n_bpsc // 2, 1)
+    k = np.arange(n_cbps)
+    i = (n_cbps // 16) * (k % 16) + k // 16
+    j = s * (i // s) + (i + n_cbps - (16 * i // n_cbps)) % s
+    perm = np.empty(n_cbps, dtype=np.int64)
+    perm[j] = k              # output position j takes input bit k
+    out = np.empty_like(bits)
+    for blk in range(len(bits) // n_cbps):
+        seg = bits[blk * n_cbps:(blk + 1) * n_cbps]
+        out[blk * n_cbps:(blk + 1) * n_cbps] = seg[perm]
+    return out
+
+
+def deinterleave(vals: np.ndarray, n_cbps: int, n_bpsc: int) -> np.ndarray:
+    s = max(n_bpsc // 2, 1)
+    k = np.arange(n_cbps)
+    i = (n_cbps // 16) * (k % 16) + k // 16
+    j = s * (i // s) + (i + n_cbps - (16 * i // n_cbps)) % s
+    out = np.empty_like(vals)
+    for blk in range(len(vals) // n_cbps):
+        seg = vals[blk * n_cbps:(blk + 1) * n_cbps]
+        out[blk * n_cbps + k] = seg[j]
+    return out
+
+
+def viterbi_decode(llrs: np.ndarray, n_bits: int) -> np.ndarray:
+    """Soft-decision Viterbi over the rate-1/2 mother code, vectorized over 64 states.
+
+    ``llrs``: soft values for coded bits (positive ⇒ bit 1), length ≥ 2·n_bits.
+    Terminated trellis (encoder assumed flushed with ≥6 tail zeros within n_bits).
+    """
+    n_steps = min(len(llrs) // 2, n_bits)
+    lam = llrs[:2 * n_steps].reshape(n_steps, 2).astype(np.float64)
+
+    # branch metric for (state, input): out0*l0 + out1*l1 with outputs in ±1
+    o0 = _OUT0.astype(np.float64) * 2 - 1     # [64, 2]
+    o1 = _OUT1.astype(np.float64) * 2 - 1
+    metrics = np.full(_NSTATES, -1e18)
+    metrics[0] = 0.0
+    decisions = np.empty((n_steps, _NSTATES), dtype=np.uint8)
+    src = np.empty((n_steps, _NSTATES), dtype=np.int64)
+
+    # predecessor table: for next-state t, the two (prev_state, input) candidates
+    prev_tbl = [[] for _ in range(_NSTATES)]
+    for s in range(_NSTATES):
+        for b in range(2):
+            prev_tbl[_NEXT[s, b]].append((s, b))
+    prev_s = np.array([[p[0][0], p[1][0]] for p in prev_tbl])   # [64, 2]
+    prev_b = np.array([[p[0][1], p[1][1]] for p in prev_tbl])   # [64, 2]
+    bm_o0 = o0[prev_s, prev_b]     # [64, 2] branch output bit0 (±1)
+    bm_o1 = o1[prev_s, prev_b]
+
+    for t in range(n_steps):
+        cand = metrics[prev_s] + bm_o0 * lam[t, 0] + bm_o1 * lam[t, 1]   # [64, 2]
+        choice = np.argmax(cand, axis=1)
+        metrics = cand[np.arange(_NSTATES), choice]
+        src[t] = prev_s[np.arange(_NSTATES), choice]
+        decisions[t] = prev_b[np.arange(_NSTATES), choice]
+
+    # traceback from state 0 (the tail bits flush the trellis to state 0)
+    state = 0
+    out = np.empty(n_steps, dtype=np.uint8)
+    for t in range(n_steps - 1, -1, -1):
+        out[t] = decisions[t, state]
+        state = src[t, state]
+    return out[:n_bits]

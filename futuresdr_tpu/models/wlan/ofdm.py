@@ -1,0 +1,172 @@
+"""802.11a OFDM symbol processing: mapping, modulation, synchronization, equalization.
+
+Re-design of the reference WLAN example's ``Mapper``/``Prefix``/``SyncShort``/``SyncLong``/
+``FrameEqualizer`` blocks (``examples/wlan/src/``). Everything here is frame-level and
+vectorized (batched FFTs over all OFDM symbols at once) — on TPU a whole frame is one
+fused program, where the reference processes symbol-by-symbol per block.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from .consts import (CP_LEN, DATA_CARRIERS, FFT_SIZE, LTS_FREQ, MODULATION_TABLES,
+                     N_DATA_CARRIERS, PILOT_CARRIERS, PILOT_POLARITY, PILOT_VALUES,
+                     SYM_LEN, lts_time, sts_time)
+
+__all__ = ["map_bits", "demap_llrs", "ofdm_modulate", "ofdm_demodulate_symbols",
+           "make_preamble", "detect_packets", "sync_long", "estimate_channel",
+           "equalize"]
+
+
+def map_bits(bits: np.ndarray, modulation: str) -> np.ndarray:
+    """Gray-coded constellation mapping; bits LSB-first per symbol."""
+    table = MODULATION_TABLES[modulation]
+    n_bpsc = int(np.log2(len(table)))
+    groups = bits.reshape(-1, n_bpsc)
+    idx = (groups * (1 << np.arange(n_bpsc))).sum(axis=1)
+    return table[idx]
+
+
+def demap_llrs(symbols: np.ndarray, modulation: str) -> np.ndarray:
+    """Max-log soft demapping: LLR per bit, positive ⇒ bit 1 (vectorized over the
+    constellation — 64-point table distance matrix, MXU-shaped on the TPU path)."""
+    table = MODULATION_TABLES[modulation]
+    n_bpsc = int(np.log2(len(table)))
+    d = -np.abs(symbols[:, None] - table[None, :]) ** 2    # [n, M] log-likelihoods
+    llrs = np.empty((len(symbols), n_bpsc))
+    idx = np.arange(len(table))
+    for b in range(n_bpsc):
+        one = (idx >> b) & 1 == 1
+        llrs[:, b] = d[:, one].max(axis=1) - d[:, ~one].max(axis=1)
+    return llrs.reshape(-1)
+
+
+def _carriers_to_spec(data_vals: np.ndarray, pilot_vals: np.ndarray) -> np.ndarray:
+    """[n_sym, 48] data + [n_sym, 4] pilots → [n_sym, 64] spectra."""
+    n_sym = data_vals.shape[0]
+    spec = np.zeros((n_sym, FFT_SIZE), dtype=np.complex128)
+    spec[:, DATA_CARRIERS % FFT_SIZE] = data_vals
+    spec[:, PILOT_CARRIERS % FFT_SIZE] = pilot_vals
+    return spec
+
+
+def ofdm_modulate(data_symbols: np.ndarray, symbol_offset: int = 0) -> np.ndarray:
+    """[n_sym, 48] constellation points → time samples with CP (batched IFFT).
+
+    ``symbol_offset`` indexes the pilot-polarity sequence (0 = SIGNAL symbol).
+    """
+    n_sym = data_symbols.shape[0]
+    pol = PILOT_POLARITY[(symbol_offset + np.arange(n_sym)) % len(PILOT_POLARITY)]
+    pilots = PILOT_VALUES[None, :] * pol[:, None]
+    spec = _carriers_to_spec(data_symbols, pilots)
+    t = np.fft.ifft(spec, axis=1)
+    with_cp = np.concatenate([t[:, -CP_LEN:], t], axis=1)     # [n_sym, 80]
+    return with_cp.reshape(-1).astype(np.complex64)
+
+
+def make_preamble() -> np.ndarray:
+    """STS (160) + LTS (160) samples."""
+    return np.concatenate([sts_time(), lts_time()])
+
+
+def ofdm_demodulate_symbols(samples: np.ndarray, n_sym: int) -> np.ndarray:
+    """Strip CPs and batch-FFT ``n_sym`` symbols: [n_sym, 64] spectra."""
+    s = samples[:n_sym * SYM_LEN].reshape(n_sym, SYM_LEN)[:, CP_LEN:]
+    return np.fft.fft(s, axis=1)
+
+
+def detect_packets(samples: np.ndarray, threshold: float = 0.56,
+                   min_run: int = 32) -> list:
+    """Short-preamble detection via 16-lag autocorrelation plateau
+    (`sync_short.rs` algorithm: |Σ x[n]·x*[n+16]| / Σ|x|² over a window)."""
+    n = len(samples)
+    if n < 160:
+        return []
+    prod = samples[:-16] * np.conj(samples[16:])
+    corr = np.cumsum(prod)
+    win = 48
+    c = np.abs(corr[win:] - corr[:-win])
+    power = np.cumsum(np.abs(samples) ** 2)
+    p = power[win:len(c) + win] - power[:len(c)]
+    metric = c / np.maximum(p, 1e-12)
+    # suppress noise-only windows: the ratio is meaningless where there is no power
+    floor = 1e-4 * float(p.max()) if len(p) else 0.0
+    above = (metric > threshold) & (p > floor)
+    # find rising edges with a sustained run; only a QUALIFYING run consumes the
+    # preamble span — short spurious crossings must not eat into a following plateau
+    starts = []
+    i = 0
+    while i < len(above):
+        if above[i]:
+            j = i
+            while j < len(above) and above[j]:
+                j += 1
+            if j - i >= min_run:
+                starts.append(i)
+                i = j + 160
+            else:
+                i = j + 1
+        else:
+            i += 1
+    return starts
+
+
+def sync_long(samples: np.ndarray, search_start: int, search_len: int = 320 + 80):
+    """Fine timing via cross-correlation with the known LTS symbol; returns the index
+    of the first data (SIGNAL) symbol and the coarse+fine CFO estimate
+    (`sync_long.rs` role)."""
+    lts = lts_time()
+    ref = lts[32 + 64:32 + 128]            # one clean long symbol
+    seg = samples[search_start:search_start + search_len]
+    if len(seg) < 160:
+        return None
+    corr = np.correlate(seg, ref, mode="valid")
+    mag = np.abs(corr)
+    # the two LTS symbols give the two strongest peaks, 64 apart
+    p1 = int(np.argmax(mag))
+    mag2 = mag.copy()
+    lo, hi = max(0, p1 - 8), min(len(mag2), p1 + 8)
+    mag2[lo:hi] = 0
+    p2 = int(np.argmax(mag2))
+    first, second = sorted((p1, p2))
+    if second - first != 64:
+        # fall back: assume exact structure from the stronger peak
+        first = p1 - 64 if p1 >= 64 and mag[p1 - 64] > 0.5 * mag[p1] else p1
+        second = first + 64
+    # CFO from phase drift between the two long symbols
+    a = seg[first:first + 64]
+    b = seg[second:second + 64]
+    cfo = np.angle(np.vdot(a, b)) / 64.0
+    data_start = search_start + second + 64
+    lts_start = search_start + first
+    return data_start, lts_start, cfo
+
+
+def estimate_channel(samples: np.ndarray, lts_start: int) -> np.ndarray:
+    """Average the two LTS symbols and divide by the known sequence → H[64]."""
+    s1 = np.fft.fft(samples[lts_start:lts_start + 64])
+    s2 = np.fft.fft(samples[lts_start + 64:lts_start + 128])
+    ref = np.zeros(FFT_SIZE, dtype=np.complex128)
+    for i, k in enumerate(range(-26, 27)):
+        ref[k % FFT_SIZE] = LTS_FREQ[i]
+    avg = (s1 + s2) / 2.0
+    H = np.ones(FFT_SIZE, dtype=np.complex128)
+    used = ref != 0
+    H[used] = avg[used] / ref[used]
+    return H
+
+
+def equalize(spectra: np.ndarray, H: np.ndarray, symbol_offset: int = 0) -> np.ndarray:
+    """Zero-forcing equalization + residual common-phase-error correction from the four
+    pilots (`frame_equalizer.rs` role). Returns [n_sym, 48] data-carrier symbols."""
+    n_sym = spectra.shape[0]
+    eq = spectra / H[None, :]
+    pol = PILOT_POLARITY[(symbol_offset + np.arange(n_sym)) % len(PILOT_POLARITY)]
+    pilots = eq[:, PILOT_CARRIERS % FFT_SIZE]
+    expected = PILOT_VALUES[None, :] * pol[:, None]
+    cpe = np.angle((pilots * np.conj(expected)).sum(axis=1))
+    eq = eq * np.exp(-1j * cpe)[:, None]
+    return eq[:, DATA_CARRIERS % FFT_SIZE]

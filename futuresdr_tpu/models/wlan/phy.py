@@ -1,0 +1,160 @@
+"""802.11a frame-level PHY: PSDU bytes ↔ baseband samples.
+
+Re-design of the reference WLAN example's TX chain (``encoder.rs`` → ``mapper`` →
+``prefix``) and RX chain (``sync_short``/``sync_long`` → FFT → ``frame_equalizer`` →
+``decoder``), collapsed into two frame-level functions — the TPU-first shape: a whole
+frame is one batched computation, and the streaming blocks in ``blocks.py`` wrap these.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from . import coding, ofdm
+from .consts import MCS_TABLE, Mcs, N_DATA_CARRIERS, SYM_LEN
+
+__all__ = ["encode_frame", "decode_frame", "decode_stream", "DecodedFrame",
+           "bytes_to_bits", "bits_to_bytes"]
+
+SIGNAL_MCS = MCS_TABLE["bpsk_1_2"]
+
+
+def bytes_to_bits(data: bytes) -> np.ndarray:
+    """LSB-first bit unpacking (802.11 bit order)."""
+    arr = np.frombuffer(data, dtype=np.uint8)
+    return np.unpackbits(arr, bitorder="little").astype(np.uint8)
+
+
+def bits_to_bytes(bits: np.ndarray) -> bytes:
+    return np.packbits(bits.astype(np.uint8), bitorder="little").tobytes()
+
+
+def _signal_field(mcs: Mcs, length: int) -> np.ndarray:
+    """24-bit SIGNAL: RATE(4) + R(1) + LENGTH(12) + parity + 6 tail (Clause 17.3.4)."""
+    bits = np.zeros(24, dtype=np.uint8)
+    for i in range(4):
+        bits[i] = (mcs.rate_bits >> (3 - i)) & 1
+    for i in range(12):
+        bits[5 + i] = (length >> i) & 1
+    bits[17] = bits[:17].sum() % 2     # even parity
+    return bits
+
+
+def _parse_signal(bits: np.ndarray) -> Optional[tuple]:
+    if bits[:18].sum() % 2 != 0:
+        return None
+    rate = 0
+    for i in range(4):
+        rate |= int(bits[i]) << (3 - i)
+    length = 0
+    for i in range(12):
+        length |= int(bits[5 + i]) << i
+    for mcs in MCS_TABLE.values():
+        if mcs.rate_bits == rate:
+            return mcs, length
+    return None
+
+
+def encode_frame(psdu: bytes, mcs_name: str = "qpsk_1_2",
+                 scrambler_seed: int = 0b1011101) -> np.ndarray:
+    """PSDU bytes → complex64 baseband frame (preamble + SIGNAL + DATA symbols)."""
+    mcs = MCS_TABLE[mcs_name]
+    length = len(psdu)
+
+    # ---- SIGNAL symbol (BPSK 1/2, not scrambled) -----------------------------
+    sig_coded = coding.conv_encode(_signal_field(mcs, length))
+    sig_inter = coding.interleave(sig_coded, 48, 1)
+    sig_sym = ofdm.map_bits(sig_inter, "bpsk").reshape(1, N_DATA_CARRIERS)
+
+    # ---- DATA: SERVICE + PSDU + tail + pad -----------------------------------
+    service = np.zeros(16, dtype=np.uint8)
+    data_bits = np.concatenate([service, bytes_to_bits(psdu)])
+    n_sym = -(-(len(data_bits) + 6) // mcs.n_dbps)
+    padded = np.zeros(n_sym * mcs.n_dbps, dtype=np.uint8)
+    padded[:len(data_bits)] = data_bits
+    scrambled = coding.scramble(padded, scrambler_seed)
+    scrambled[len(data_bits):len(data_bits) + 6] = 0      # zero the tail bits
+    coded = coding.conv_encode(scrambled)
+    punct = coding.puncture(coded, mcs.coding_rate)
+    inter = coding.interleave(punct, mcs.n_cbps, mcs.n_bpsc)
+    data_syms = ofdm.map_bits(inter, mcs.modulation).reshape(n_sym, N_DATA_CARRIERS)
+
+    # ---- assemble ------------------------------------------------------------
+    preamble = ofdm.make_preamble()
+    signal_t = ofdm.ofdm_modulate(sig_sym, symbol_offset=0)
+    data_t = ofdm.ofdm_modulate(data_syms, symbol_offset=1)
+    return np.concatenate([preamble, signal_t, data_t]).astype(np.complex64)
+
+
+@dataclass
+class DecodedFrame:
+    psdu: bytes
+    mcs: Mcs
+    start: int
+    cfo: float
+    n_symbols: int
+
+
+def decode_frame(samples: np.ndarray, lts_start: int, cfo: float = 0.0,
+                 scrambler_seed: Optional[int] = None) -> Optional[DecodedFrame]:
+    """Decode one frame given LTS timing (`frame_equalizer.rs` + `decoder` roles)."""
+    if cfo != 0.0:
+        n = np.arange(len(samples) - lts_start)
+        samples = samples.copy()
+        samples[lts_start:] = samples[lts_start:] * np.exp(-1j * cfo * n)
+    H = ofdm.estimate_channel(samples, lts_start)
+    data_start = lts_start + 128
+
+    # SIGNAL
+    spec = ofdm.ofdm_demodulate_symbols(samples[data_start:], 1)
+    eq = ofdm.equalize(spec, H, symbol_offset=0)
+    sig_llrs = ofdm.demap_llrs(eq.reshape(-1), "bpsk")
+    sig_deint = coding.deinterleave(sig_llrs, 48, 1)
+    sig_bits = coding.viterbi_decode(sig_deint, 24)
+    parsed = _parse_signal(sig_bits)
+    if parsed is None:
+        return None
+    mcs, length = parsed
+
+    n_bits = 16 + 8 * length + 6
+    n_sym = -(-n_bits // mcs.n_dbps)
+    avail = (len(samples) - data_start - SYM_LEN) // SYM_LEN
+    if n_sym > avail:
+        return None
+    spec = ofdm.ofdm_demodulate_symbols(samples[data_start + SYM_LEN:], n_sym)
+    eq = ofdm.equalize(spec, H, symbol_offset=1)
+    llrs = ofdm.demap_llrs(eq.reshape(-1), mcs.modulation)
+    deint = coding.deinterleave(llrs, mcs.n_cbps, mcs.n_bpsc)
+    depunct = coding.depuncture(deint, mcs.coding_rate)
+    decoded = coding.viterbi_decode(depunct, n_sym * mcs.n_dbps)
+    if scrambler_seed is not None:
+        descrambled = coding.descramble(decoded, scrambler_seed)
+    else:
+        # the 16 SERVICE bits are zeros pre-scrambling: recover the seed by search
+        # (127 candidates × 16 bits — the reference's decoder derives it in closed
+        # form from the first 7 bits; exhaustive search is equivalent and robust)
+        seed = 0b1011101
+        for cand in range(1, 128):
+            if not coding.descramble(decoded[:16], cand).any():
+                seed = cand
+                break
+        descrambled = coding.descramble(decoded, seed)
+    psdu_bits = descrambled[16:16 + 8 * length]
+    return DecodedFrame(bits_to_bytes(psdu_bits), mcs, lts_start, cfo, n_sym)
+
+
+def decode_stream(samples: np.ndarray) -> List[DecodedFrame]:
+    """Full RX: detect (`sync_short`), align (`sync_long`), decode every frame."""
+    out: List[DecodedFrame] = []
+    for start in ofdm.detect_packets(samples):
+        r = ofdm.sync_long(samples, start)
+        if r is None:
+            continue
+        data_start, lts_start, cfo = r
+        frame = decode_frame(samples, lts_start, cfo)
+        if frame is not None:
+            out.append(frame)
+    return out

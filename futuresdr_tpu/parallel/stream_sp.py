@@ -18,7 +18,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+try:
+    from jax import shard_map          # jax ≥ 0.7 stable API
+except ImportError:                    # pragma: no cover
+    from jax.experimental.shard_map import shard_map
 
 __all__ = ["sp_fir", "sp_fir_fft_mag2", "sp_channelizer"]
 
