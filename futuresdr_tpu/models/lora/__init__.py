@@ -1,0 +1,14 @@
+"""LoRa PHY transceiver (reference: ``examples/lora/``, port of gr-lora_sdr).
+
+Chirp-spread-spectrum modulation with Hamming coding, diagonal interleaving, Gray
+mapping, whitening, explicit header, CRC16 — frame-level and batched for TPU.
+"""
+
+from .phy import (LoraParams, modulate_frame, demodulate_frame, detect_frames,
+                  decode_symbols, encode_payload_symbols)
+from .blocks import LoraTransmitter, LoraReceiver
+from . import coding
+
+__all__ = ["LoraParams", "modulate_frame", "demodulate_frame", "detect_frames",
+           "decode_symbols", "encode_payload_symbols", "LoraTransmitter",
+           "LoraReceiver", "coding"]

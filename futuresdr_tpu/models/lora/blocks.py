@@ -1,0 +1,115 @@
+"""Streaming LoRa blocks wrapping the frame-level PHY (reference `examples/lora/src`
+block chain: Modulator | FrameSync → FftDemod → GrayMapping → Deinterleaver →
+HammingDecoder → HeaderDecoder → Decoder — collapsed into TX/RX blocks batched per frame)."""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+import numpy as np
+
+from ...runtime.kernel import Kernel, message_handler
+from ...types import Pmt
+from . import phy
+from .phy import LoraParams
+
+__all__ = ["LoraTransmitter", "LoraReceiver"]
+
+
+class LoraTransmitter(Kernel):
+    """Message port ``tx`` (Blob) → chirp baseband stream with inter-frame gaps."""
+
+    def __init__(self, params: LoraParams = LoraParams(), gap_symbols: int = 4):
+        super().__init__()
+        self.params = params
+        self.gap = gap_symbols * params.n
+        self._pending: Deque[np.ndarray] = deque()
+        self._current: Optional[np.ndarray] = None
+        self._eos = False
+        self.output = self.add_stream_output("out", np.complex64)
+
+    @message_handler(name="tx")
+    async def tx_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        if p.is_finished():
+            self._eos = True
+            io.call_again = True
+            return Pmt.ok()
+        try:
+            payload = p.to_blob()
+        except Exception:
+            return Pmt.invalid_value()
+        frame = phy.modulate_frame(payload, self.params)
+        self._pending.append(np.concatenate([frame, np.zeros(self.gap, np.complex64)]))
+        io.call_again = True
+        return Pmt.ok()
+
+    async def work(self, io, mio, meta):
+        out = self.output.slice()
+        produced = 0
+        while produced < len(out):
+            if self._current is None:
+                if not self._pending:
+                    break
+                self._current = self._pending.popleft()
+            k = min(len(out) - produced, len(self._current))
+            out[produced:produced + k] = self._current[:k]
+            produced += k
+            self._current = self._current[k:] if k < len(self._current) else None
+        if produced:
+            self.output.produce(produced)
+        if self._eos and self._current is None and not self._pending:
+            io.finished = True
+        elif produced and (self._current is not None or self._pending):
+            io.call_again = True
+
+
+class LoraReceiver(Kernel):
+    """Chirp stream → decoded payload messages on ``rx`` (+ ``crc_ok`` flag in a map)."""
+
+    def __init__(self, params: LoraParams = LoraParams(), max_payload: int = 256):
+        super().__init__()
+        self.params = params
+        n = params.n
+        # worst-case frame length in samples, for the inter-window overlap
+        n_sym = 8 + (4 + params.cr) * (2 * (max_payload + 2) // params.sf + 2)
+        self.OVERLAP = (params.n_preamble + 5 + n_sym) * n
+        self.frames = []
+        self.crc_flags = []
+        self._tail = np.zeros(0, np.complex64)
+        self._tail_abs = 0
+        self._seen = set()
+        self.input = self.add_stream_input("in", np.complex64, min_items=4 * n)
+        self.add_message_output("rx")
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        n = len(inp)
+        if n == 0:
+            if self.input.finished():
+                io.finished = True
+            return
+        buf = np.concatenate([self._tail, inp[:n]])
+        base = self._tail_abs
+        for start in phy.detect_frames(buf, self.params):
+            abs_start = base + start
+            key = abs_start // (self.params.n // 2)   # quantized dedup key
+            if key in self._seen:
+                continue
+            r = phy.demodulate_frame(buf, start, self.params)
+            if r is None:
+                continue
+            payload, crc_ok, hdr = r
+            self._seen.add(key)
+            self.frames.append(payload)
+            self.crc_flags.append(crc_ok)
+            mio.post("rx", Pmt.map({"payload": Pmt.blob(payload),
+                                    "crc_ok": Pmt.bool_(crc_ok)}))
+        keep = min(len(buf), self.OVERLAP)
+        self._tail = buf[len(buf) - keep:].copy()
+        self._tail_abs = base + len(buf) - keep
+        self._seen = {k for k in self._seen
+                      if k * (self.params.n // 2) >= self._tail_abs - self.OVERLAP}
+        self.input.consume(n)
+        if self.input.finished() and self.input.available() == 0:
+            io.finished = True

@@ -1,0 +1,227 @@
+"""LoRa CSS PHY: frame-level modulation and demodulation.
+
+Re-design of the reference LoRa example's signal path (``examples/lora/src/``:
+``Modulator``, ``FrameSync`` — dechirp + preamble tracking, ``FftDemod`` — the dechirp+FFT
++argmax demodulator; port of gr-lora_sdr). TPU-first: all symbols of a frame are
+dechirped and FFT'd as one batched [n_sym, 2^sf] computation.
+
+Frame layout: ``n_pre`` upchirps, 2 sync-word chirps, 2.25 downchirps, then header block
+(CR 4/8 at sf-2 bits/symbol) and payload blocks (CR 4/cr at sf bits/symbol).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from . import coding
+
+__all__ = ["LoraParams", "modulate_frame", "demodulate_frame", "detect_frames",
+           "encode_payload_symbols", "decode_symbols"]
+
+
+@dataclass(frozen=True)
+class LoraParams:
+    sf: int = 7                 # spreading factor: 2^sf chips/symbol
+    cr: int = 1                 # coding rate 4/(4+cr)
+    n_preamble: int = 8
+    sync_word: int = 0x12
+    has_crc: bool = True
+    ldro: bool = False          # low-data-rate optimize: payload at sf-2 too
+
+    @property
+    def n(self) -> int:
+        return 1 << self.sf
+
+
+def _upchirp(n: int, shift: int = 0) -> np.ndarray:
+    k = np.arange(n)
+    ph = 2 * np.pi * ((k * k) / (2 * n) + k * (shift / n - 0.5))
+    return np.exp(1j * ph)
+
+
+def _downchirp(n: int) -> np.ndarray:
+    return np.conj(_upchirp(n))
+
+
+def encode_payload_symbols(payload: bytes, p: LoraParams) -> np.ndarray:
+    """Payload bytes → symbol values (header block + payload blocks)."""
+    body = coding.whiten(payload)
+    if p.has_crc:
+        c = coding.crc16(payload)
+        body = body + bytes([c & 0xFF, (c >> 8) & 0xFF])
+    nibbles = []
+    for byte in body:
+        nibbles += [byte & 0xF, byte >> 4]
+    nibbles = np.array(nibbles, dtype=np.uint8)
+
+    sf_app_hdr = p.sf - 2
+    header = coding.build_header(len(payload), p.cr, p.has_crc)
+    hdr_nibbles = np.concatenate([header, nibbles[:max(0, sf_app_hdr - 5)]])
+    if len(hdr_nibbles) < sf_app_hdr:
+        hdr_nibbles = np.concatenate(
+            [hdr_nibbles, np.zeros(sf_app_hdr - len(hdr_nibbles), np.uint8)])
+    used = max(0, sf_app_hdr - 5)
+    rest = nibbles[used:]
+
+    symbols: List[int] = []
+    # header block: CR 4/8, sf-2 bits per symbol
+    cw = coding.hamming_encode(hdr_nibbles, 4)
+    sym = coding.interleave_block(cw, sf_app_hdr, 4)
+    symbols += [int(s) << 2 for s in sym]          # reduced-rate: bins are ×4
+    # payload blocks
+    sf_app = p.sf - 2 if p.ldro else p.sf
+    shift_bits = 2 if p.ldro else 0
+    i = 0
+    while i < len(rest):
+        blk = rest[i:i + sf_app]
+        if len(blk) < sf_app:
+            blk = np.concatenate([blk, np.zeros(sf_app - len(blk), np.uint8)])
+        cw = coding.hamming_encode(blk, p.cr)
+        sym = coding.interleave_block(cw, sf_app, p.cr)
+        symbols += [int(s) << shift_bits for s in sym]
+        i += sf_app
+    # TX applies the inverse Gray map so the RX dechirp+gray lands on the code symbol
+    return coding.degray(np.array(symbols, dtype=np.int64)) % p.n
+
+
+def modulate_frame(payload: bytes, p: LoraParams) -> np.ndarray:
+    """Payload → complex64 baseband frame at 1 sample/chip."""
+    n = p.n
+    up = _upchirp(n)
+    down = _downchirp(n)
+    parts = [np.tile(up, p.n_preamble)]
+    # sync word as two shifted chirps (gr-lora_sdr: nibbles ×8)
+    parts.append(_upchirp(n, ((p.sync_word >> 4) & 0xF) * 8))
+    parts.append(_upchirp(n, (p.sync_word & 0xF) * 8))
+    parts.append(np.concatenate([down, down, down[:n // 4]]))
+    for s in encode_payload_symbols(payload, p):
+        parts.append(_upchirp(n, int(s)))
+    return np.concatenate(parts).astype(np.complex64)
+
+
+def _dechirp_bins(samples: np.ndarray, p: LoraParams) -> np.ndarray:
+    """[k·N] samples → [k, N] dechirped FFT magnitudes' argmax-ready spectra."""
+    n = p.n
+    k = len(samples) // n
+    blocks = samples[:k * n].reshape(k, n) * _downchirp(n)[None, :]
+    return np.fft.fft(blocks, axis=1)
+
+
+def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] = None):
+    """Demodulated symbol values → (payload, crc_ok, header) or None."""
+    g = coding.gray(symbols.astype(np.int64))
+    sf_app_hdr = p.sf - 2
+    n_hdr_sym = 8                                  # CR 4/8 header block
+    if len(g) < n_hdr_sym:
+        return None
+    hdr_sym = (g[:n_hdr_sym] >> 2) & ((1 << sf_app_hdr) - 1)
+    cw = coding.deinterleave_block(hdr_sym, sf_app_hdr, 4)
+    hdr_nibbles = coding.hamming_decode(cw, 4)
+    parsed = coding.parse_header(hdr_nibbles[:5])
+    if parsed is None:
+        return None
+    length, cr, has_crc = parsed
+    extra = list(hdr_nibbles[5:])
+
+    sf_app = p.sf - 2 if p.ldro else p.sf
+    shift_bits = 2 if p.ldro else 0
+    n_crc = 2 if has_crc else 0
+    n_nibbles_needed = 2 * (length + n_crc)
+    nibbles = list(extra)
+    i = n_hdr_sym
+    while len(nibbles) < n_nibbles_needed and i + (4 + cr) <= len(g):
+        blk = (g[i:i + 4 + cr] >> shift_bits) & ((1 << sf_app) - 1)
+        cw = coding.deinterleave_block(blk, sf_app, cr)
+        nibbles += list(coding.hamming_decode(cw, cr))
+        i += 4 + cr
+    if len(nibbles) < n_nibbles_needed:
+        return None
+    data = bytes([(nibbles[2 * j] & 0xF) | ((nibbles[2 * j + 1] & 0xF) << 4)
+                  for j in range(length + n_crc)])
+    payload = coding.dewhiten(data[:length])
+    crc_ok = True
+    if has_crc:
+        rx_crc = data[length] | (data[length + 1] << 8)
+        crc_ok = coding.crc16(payload) == rx_crc
+    return payload, crc_ok, (length, cr, has_crc)
+
+
+def detect_frames(samples: np.ndarray, p: LoraParams) -> List[int]:
+    """Preamble scan (`frame_sync.rs` role): slide in N/4 steps, dechirp two adjacent
+    windows, and look for matching strong bins (constant dechirped symbol = upchirp
+    train); refine timing from the bin index."""
+    n = p.n
+    hop = n // 4
+    starts = []
+    i = 0
+    limit = len(samples) - (p.n_preamble + 5) * n
+    while i < limit:
+        a = np.fft.fft(samples[i:i + n] * _downchirp(n))
+        b = np.fft.fft(samples[i + n:i + 2 * n] * _downchirp(n))
+        ka, kb = int(np.argmax(np.abs(a))), int(np.argmax(np.abs(b)))
+        pa = np.abs(a[ka]) ** 2 / max(np.sum(np.abs(a) ** 2), 1e-12)
+        pb = np.abs(b[kb]) ** 2 / max(np.sum(np.abs(b) ** 2), 1e-12)
+        if ka == kb and pa > 0.3 and pb > 0.3:
+            # inside the preamble: dechirped bin k == sample misalignment d (i = start + d)
+            start = i - ka
+            if start < 0:
+                start += n
+            starts.append(start)
+            i = start + (p.n_preamble + 5) * n    # skip past this frame's start
+        else:
+            i += hop
+    return starts
+
+
+def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams):
+    """Demodulate from a symbol-aligned position anywhere inside the preamble: walk
+    forward over the upchirp train, step over the two sync chirps and the 2.25
+    downchirps, then batch-demod the data symbols (`frame_sync.rs` state machine)."""
+    n = p.n
+    down = _downchirp(n)
+    pos = start
+    # the detector's start can be off by ±a few samples (noise) or a whole symbol
+    # (probe straddling the frame edge): skip leading unaligned symbols and fold out
+    # small bin offsets before walking the train
+    aligned = False
+    for skip in range(3):
+        q = pos + skip * n
+        if q + n > len(samples):
+            break
+        k = int(np.argmax(np.abs(np.fft.fft(samples[q:q + n] * down))))
+        if k == 0:
+            pos = q
+            aligned = True
+            break
+        if 0 < k <= 4 and q - k >= 0:
+            pos = q - k
+            aligned = True
+            break
+        if n - 4 <= k < n:
+            pos = q + (n - k)
+            aligned = True
+            break
+    if not aligned:
+        return None
+    # walk the upchirp train (bin 0); bounded by the max preamble length
+    hops = 0
+    while pos + n <= len(samples) and hops <= p.n_preamble + 2:
+        k = int(np.argmax(np.abs(np.fft.fft(samples[pos:pos + n] * down))))
+        if k != 0:
+            break
+        pos += n
+        hops += 1
+    if hops == 0:
+        return None                 # not on an aligned preamble
+    pos += 2 * n                    # sync word chirps
+    pos += 2 * n + n // 4           # 2.25 downchirps
+    if pos >= len(samples):
+        return None
+    spec = _dechirp_bins(samples[pos:], p)
+    if len(spec) == 0:
+        return None
+    symbols = np.argmax(np.abs(spec), axis=1)
+    return decode_symbols(symbols, p)

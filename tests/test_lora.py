@@ -1,0 +1,132 @@
+"""LoRa PHY tests: coding round-trips and chirp loopbacks (reference: lora example's
+decoding chain tests)."""
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu.models.lora import (LoraParams, modulate_frame, demodulate_frame,
+                                       detect_frames, LoraTransmitter, LoraReceiver,
+                                       coding)
+
+
+def test_whitening_roundtrip():
+    data = bytes(range(100))
+    assert coding.dewhiten(coding.whiten(data)) == data
+    assert coding.whiten(data) != data
+
+
+@pytest.mark.parametrize("cr", [1, 2, 3, 4])
+def test_hamming_roundtrip(cr):
+    nibbles = np.arange(16, dtype=np.uint8)
+    cw = coding.hamming_encode(nibbles, cr)
+    np.testing.assert_array_equal(coding.hamming_decode(cw, cr), nibbles)
+
+
+@pytest.mark.parametrize("cr", [3, 4])
+def test_hamming_corrects_single_error(cr):
+    nibbles = np.arange(16, dtype=np.uint8)
+    cw = coding.hamming_encode(nibbles, cr)
+    for bit in range(4):          # flip each data bit
+        corrupted = cw ^ (1 << bit)
+        np.testing.assert_array_equal(coding.hamming_decode(corrupted, cr), nibbles)
+
+
+@pytest.mark.parametrize("sf_app,cr", [(5, 4), (7, 1), (7, 4), (10, 2)])
+def test_interleaver_roundtrip(sf_app, cr):
+    rng = np.random.default_rng(0)
+    cw = rng.integers(0, 1 << (4 + cr), sf_app).astype(np.uint8)
+    sym = coding.interleave_block(cw, sf_app, cr)
+    assert (sym < (1 << sf_app)).all()
+    np.testing.assert_array_equal(coding.deinterleave_block(sym, sf_app, cr), cw)
+
+
+def test_gray_roundtrip():
+    x = np.arange(4096)
+    np.testing.assert_array_equal(coding.degray(coding.gray(x)), x)
+
+
+def test_header_roundtrip():
+    h = coding.build_header(123, 2, True)
+    assert coding.parse_header(h) == (123, 2, True)
+    bad = h.copy()
+    bad[0] ^= 0x3
+    assert coding.parse_header(bad) is None
+
+
+@pytest.mark.parametrize("sf,cr", [(7, 1), (7, 4), (8, 2), (9, 1), (10, 3)])
+def test_lora_loopback_clean(sf, cr):
+    p = LoraParams(sf=sf, cr=cr)
+    payload = f"lora sf{sf} cr{cr} hello".encode()
+    sig = modulate_frame(payload, p)
+    starts = detect_frames(np.concatenate([np.zeros(137, np.complex64), sig,
+                                           np.zeros(1000, np.complex64)]), p)
+    assert len(starts) >= 1
+    sig2 = np.concatenate([np.zeros(137, np.complex64), sig, np.zeros(1000, np.complex64)])
+    r = demodulate_frame(sig2, starts[0], p)
+    assert r is not None
+    got, crc_ok, hdr = r
+    assert got == payload
+    assert crc_ok
+
+
+def test_lora_loopback_noise():
+    p = LoraParams(sf=8, cr=4)
+    rng = np.random.default_rng(1)
+    payload = b"noisy chirps carry data anyway"
+    sig = modulate_frame(payload, p)
+    sig = np.concatenate([np.zeros(500, np.complex64), sig, np.zeros(500, np.complex64)])
+    sig = (sig + 0.35 * (rng.standard_normal(len(sig))
+                         + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+    starts = detect_frames(sig, p)
+    assert len(starts) >= 1
+    r = demodulate_frame(sig, starts[0], p)
+    assert r is not None
+    got, crc_ok, _ = r
+    assert got == payload
+    assert crc_ok
+
+
+def test_lora_ldro_mode():
+    p = LoraParams(sf=9, cr=2, ldro=True)
+    payload = b"low data rate optimization"
+    sig = modulate_frame(payload, p)
+    r = demodulate_frame(sig, 0, p)
+    assert r is not None and r[0] == payload and r[1]
+
+
+def test_crc_detects_corruption():
+    from futuresdr_tpu.models.lora.phy import encode_payload_symbols, decode_symbols
+
+    p = LoraParams(sf=7, cr=1)
+    payload = b"check me"
+    symbols = encode_payload_symbols(payload, p)
+    bad = symbols.copy()
+    # corrupt a data-plane symbol (the last symbol of a block carries only parity
+    # bits, which detect-only rates ignore — so hit an earlier one)
+    bad[-3] = (bad[-3] + 7) % p.n
+    r = decode_symbols(bad, p)
+    assert r is None or r[1] is False or r[0] != payload
+
+
+def test_flowgraph_loopback():
+    from futuresdr_tpu import Flowgraph, Runtime, Pmt
+    from futuresdr_tpu.blocks import Apply
+
+    p = LoraParams(sf=7, cr=2)
+    rng = np.random.default_rng(2)
+    fg = Flowgraph()
+    tx = LoraTransmitter(p)
+    chan = Apply(lambda x: (x + 0.1 * (rng.standard_normal(len(x))
+                                       + 1j * rng.standard_normal(len(x)))
+                            ).astype(np.complex64), np.complex64)
+    rx = LoraReceiver(p)
+    fg.connect(tx, chan, rx)
+    payloads = [f"packet {i}".encode() * 3 for i in range(4)]
+    rt = Runtime()
+    running = rt.start(fg)
+    for pl in payloads:
+        rt.scheduler.run_coro_sync(running.handle.call(tx, "tx", Pmt.blob(pl)))
+    rt.scheduler.run_coro_sync(running.handle.call(tx, "tx", Pmt.finished()))
+    running.wait_sync()
+    assert rx.frames == payloads
+    assert all(rx.crc_flags)
