@@ -1,0 +1,7 @@
+"""IEEE 802.15.4 / ZigBee O-QPSK transceiver (reference: ``examples/zigbee/``)."""
+
+from .phy import (CHIP_SEQUENCES, modulate_frame, demodulate_stream, mac_frame,
+                  mac_deframe, crc16_802154)
+
+__all__ = ["CHIP_SEQUENCES", "modulate_frame", "demodulate_stream", "mac_frame",
+           "mac_deframe", "crc16_802154"]
