@@ -1,0 +1,207 @@
+"""Mode S / ADS-B message decoding and aircraft tracking.
+
+Re-design of the reference's ``Decoder`` + ``Tracker`` (``examples/adsb/src/``): CRC24
+validation, DF17 extended squitter decode (identification, airborne position with CPR,
+velocity), and an aircraft registry keyed by ICAO address updated from message ports.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["crc24", "decode_frame", "AdsbMessage", "Tracker", "Aircraft",
+           "cpr_global_decode"]
+
+_CRC24_POLY = 0xFFF409
+
+
+def crc24(bits: np.ndarray) -> int:
+    """Mode S CRC-24 (generator 0x1FFF409): polynomial division remainder; a frame whose
+    last 24 bits are the parity of the first n-24 yields remainder 0."""
+    data = [int(b) for b in bits]
+    poly = [int(c) for c in f"{(1 << 24) | _CRC24_POLY:b}"]
+    for i in range(len(data) - 24):
+        if data[i]:
+            for j in range(25):
+                data[i + j] ^= poly[j]
+    out = 0
+    for b in data[-24:]:
+        out = (out << 1) | b
+    return out
+
+
+def _bits_to_int(bits: np.ndarray) -> int:
+    v = 0
+    for b in bits:
+        v = (v << 1) | int(b)
+    return v
+
+
+_CALLSIGN_CHARS = "#ABCDEFGHIJKLMNOPQRSTUVWXYZ##### ###############0123456789######"
+
+
+@dataclass
+class AdsbMessage:
+    df: int
+    icao: int
+    type_code: int = 0
+    callsign: Optional[str] = None
+    altitude_ft: Optional[float] = None
+    cpr: Optional[tuple] = None         # (odd_flag, lat_cpr, lon_cpr)
+    ground_speed_kt: Optional[float] = None
+    track_deg: Optional[float] = None
+    vertical_rate_fpm: Optional[float] = None
+    crc_ok: bool = False
+
+
+def decode_frame(bits: np.ndarray) -> Optional[AdsbMessage]:
+    """Decode a 112-bit DF17/18 extended squitter (56-bit frames: header only)."""
+    if len(bits) < 56:
+        return None
+    df = _bits_to_int(bits[0:5])
+    if df not in (17, 18) or len(bits) < 112:
+        icao = _bits_to_int(bits[8:32]) if len(bits) >= 32 else 0
+        return AdsbMessage(df=df, icao=icao, crc_ok=False)
+    msg = AdsbMessage(df=df, icao=_bits_to_int(bits[8:32]))
+    msg.crc_ok = crc24(bits[:112]) == 0
+    me = bits[32:88]
+    tc = _bits_to_int(me[0:5])
+    msg.type_code = tc
+    if 1 <= tc <= 4:                     # aircraft identification
+        chars = [_CALLSIGN_CHARS[_bits_to_int(me[8 + 6 * i:14 + 6 * i])]
+                 for i in range(8)]
+        msg.callsign = "".join(chars).replace("#", "").strip()
+    elif 9 <= tc <= 18:                  # airborne position (baro altitude)
+        alt_bits = me[8:20]
+        q = alt_bits[7]
+        if q:
+            n = _bits_to_int(np.concatenate([alt_bits[:7], alt_bits[8:]]))
+            msg.altitude_ft = n * 25 - 1000
+        odd = int(me[21])
+        lat = _bits_to_int(me[22:39])
+        lon = _bits_to_int(me[39:56])
+        msg.cpr = (odd, lat, lon)
+    elif tc == 19:                       # airborne velocity (subtype 1: ground speed)
+        subtype = _bits_to_int(me[5:8])
+        if subtype in (1, 2):
+            s_ew = int(me[13])
+            v_ew = _bits_to_int(me[14:24]) - 1
+            s_ns = int(me[24])
+            v_ns = _bits_to_int(me[25:35]) - 1
+            if v_ew >= 0 and v_ns >= 0:
+                vx = -v_ew if s_ew else v_ew
+                vy = -v_ns if s_ns else v_ns
+                msg.ground_speed_kt = math.hypot(vx, vy)
+                msg.track_deg = (math.degrees(math.atan2(vx, vy))) % 360
+            s_vr = int(me[36])
+            vr = _bits_to_int(me[37:46]) - 1
+            if vr >= 0:
+                msg.vertical_rate_fpm = (-vr if s_vr else vr) * 64
+    return msg
+
+
+def _cpr_nl(lat: float) -> int:
+    if abs(lat) >= 87.0:
+        return 1 if abs(lat) < 90.0 else 1
+    a = 1 - math.cos(math.pi / (2 * 15))
+    b = math.cos(math.pi / 180.0 * abs(lat)) ** 2
+    nl = math.floor(2 * math.pi / math.acos(1 - a / b))
+    return max(1, int(nl))
+
+
+def cpr_global_decode(even: tuple, odd: tuple, most_recent_odd: bool = True):
+    """Globally-unambiguous position from an even/odd CPR pair (ICAO Annex 10 algo)."""
+    _, lat_e, lon_e = even
+    _, lat_o, lon_o = odd
+    dlat_e = 360.0 / 60
+    dlat_o = 360.0 / 59
+    yz_e = lat_e / 131072.0
+    yz_o = lat_o / 131072.0
+    j = math.floor(59 * yz_e - 60 * yz_o + 0.5)
+    lat_even = dlat_e * ((j % 60) + yz_e)
+    lat_odd = dlat_o * ((j % 59) + yz_o)
+    if lat_even >= 270:
+        lat_even -= 360
+    if lat_odd >= 270:
+        lat_odd -= 360
+    if _cpr_nl(lat_even) != _cpr_nl(lat_odd):
+        return None
+    lat = lat_odd if most_recent_odd else lat_even
+    nl = _cpr_nl(lat)
+    if most_recent_odd:
+        ni = max(nl - 1, 1)
+        dlon = 360.0 / ni
+        xz = lon_o / 131072.0
+        m = math.floor((lon_e / 131072.0) * (nl - 1) - (lon_o / 131072.0) * nl + 0.5)
+        lon = dlon * ((m % ni) + xz)
+    else:
+        ni = max(nl, 1)
+        dlon = 360.0 / ni
+        xz = lon_e / 131072.0
+        m = math.floor((lon_e / 131072.0) * (nl - 1) - (lon_o / 131072.0) * nl + 0.5)
+        lon = dlon * ((m % ni) + xz)
+    if lon >= 180:
+        lon -= 360
+    return lat, lon
+
+
+@dataclass
+class Aircraft:
+    icao: int
+    callsign: Optional[str] = None
+    altitude_ft: Optional[float] = None
+    lat: Optional[float] = None
+    lon: Optional[float] = None
+    ground_speed_kt: Optional[float] = None
+    track_deg: Optional[float] = None
+    vertical_rate_fpm: Optional[float] = None
+    last_seen: float = 0.0
+    n_messages: int = 0
+    _cpr_even: Optional[tuple] = None
+    _cpr_odd: Optional[tuple] = None
+
+
+class Tracker:
+    """Aircraft registry fed by decoded messages (`tracker.rs` role)."""
+
+    def __init__(self, timeout_s: float = 60.0):
+        self.aircraft: Dict[int, Aircraft] = {}
+        self.timeout = timeout_s
+
+    def update(self, msg: AdsbMessage, now: Optional[float] = None) -> Optional[Aircraft]:
+        if not msg.crc_ok:
+            return None
+        now = time.monotonic() if now is None else now
+        ac = self.aircraft.setdefault(msg.icao, Aircraft(icao=msg.icao))
+        ac.last_seen = now
+        ac.n_messages += 1
+        if msg.callsign:
+            ac.callsign = msg.callsign
+        if msg.altitude_ft is not None:
+            ac.altitude_ft = msg.altitude_ft
+        if msg.ground_speed_kt is not None:
+            ac.ground_speed_kt = msg.ground_speed_kt
+            ac.track_deg = msg.track_deg
+            ac.vertical_rate_fpm = msg.vertical_rate_fpm
+        if msg.cpr is not None:
+            odd, _, _ = msg.cpr
+            if odd:
+                ac._cpr_odd = msg.cpr
+            else:
+                ac._cpr_even = msg.cpr
+            if ac._cpr_even and ac._cpr_odd:
+                pos = cpr_global_decode(ac._cpr_even, ac._cpr_odd, bool(odd))
+                if pos is not None:
+                    ac.lat, ac.lon = pos
+        self._expire(now)
+        return ac
+
+    def _expire(self, now: float):
+        dead = [k for k, a in self.aircraft.items() if now - a.last_seen > self.timeout]
+        for k in dead:
+            del self.aircraft[k]
