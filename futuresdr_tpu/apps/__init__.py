@@ -1,0 +1,1 @@
+"""Runnable applications (the reference's `examples/` binaries re-imagined)."""

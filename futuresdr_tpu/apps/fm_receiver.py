@@ -1,0 +1,82 @@
+"""Broadcast FM receiver with interactive retuning.
+
+Reference: ``examples/fm-receiver/src/main.rs:83-155``: seify → freq-shift → resampling
+FIR → quadrature demod → audio resampler → AudioSink, retuned at runtime via
+``handle.post(src, "freq", Pmt::F64)``. Same chain here; the front half can run fused on
+the TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..blocks import (SeifyBuilder, XlatingFir, QuadratureDemod, Fir, FirBuilder,
+                      AudioSink, WavSink, Head, NullSink)
+from ..dsp import firdes
+from ..runtime import Flowgraph, Runtime
+
+SAMPLE_RATE = 250_000       # after front-end decimation
+AUDIO_RATE = 48_000
+
+
+def build_flowgraph(source=None, *, input_rate: float = 1_000_000.0,
+                    offset: float = 0.0, audio_path: Optional[str] = None,
+                    n_samples: Optional[int] = None):
+    fg = Flowgraph()
+    if source is None:
+        source = (SeifyBuilder().args("driver=dummy,throttle=false")
+                  .sample_rate(input_rate).build_source())
+    last = source
+    if n_samples:
+        head = Head(np.complex64, n_samples)
+        fg.connect(last, head)
+        last = head
+    decim = int(input_rate // SAMPLE_RATE)
+    xlate = XlatingFir(firdes.lowpass(0.5 / decim * 0.8, 128), decim, offset, input_rate)
+    demod = QuadratureDemod(gain=SAMPLE_RATE / (2 * np.pi * 75e3))
+    from math import gcd
+    g = gcd(AUDIO_RATE, SAMPLE_RATE)
+    audio_resamp = Fir(firdes.kaiser_lowpass(0.4 * g / SAMPLE_RATE, 0.1 * g / SAMPLE_RATE)
+                       * (AUDIO_RATE // g),
+                       np.float32, decim=SAMPLE_RATE // g, interp=AUDIO_RATE // g)
+    fg.connect(last, xlate, demod, audio_resamp)
+    if audio_path:
+        sink = WavSink(audio_path, AUDIO_RATE)
+    else:
+        sink = NullSink(np.float32)
+    fg.connect(audio_resamp, sink)
+    return fg, xlate, sink
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(description="FM receiver")
+    p.add_argument("--args", default="driver=dummy,throttle=false")
+    p.add_argument("--freq", type=float, default=100.0e6)
+    p.add_argument("--rate", type=float, default=1e6)
+    p.add_argument("--wav", default=None, help="write audio to WAV instead of soundcard")
+    a = p.parse_args(argv)
+    src = (SeifyBuilder().args(a.args).frequency(a.freq).sample_rate(a.rate)
+           .build_source())
+    fg, xlate, _ = build_flowgraph(src, input_rate=a.rate, audio_path=a.wav)
+    rt = Runtime()
+    running = rt.start(fg)
+    print("FM receiver running; type a frequency offset in Hz (or 'q'):")
+    try:
+        while True:
+            line = input("> ").strip()
+            if line in ("q", "quit", "exit"):
+                break
+            try:
+                running.handle.post_sync(xlate, "freq", float(line))
+            except ValueError:
+                print("not a number")
+    except (EOFError, KeyboardInterrupt):
+        pass
+    running.stop_sync()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Spectrum analyzer: the TPU FFT showcase app.
+
+Reference: ``examples/spectrum`` (``spectrum/src/bin/cpu.rs:14-31``: seify src → Fft(2048)
+→ |x|² → MovingAvg → WebsocketSink, plus a Vulkan variant). Here the compute chain runs
+either on CPU blocks or fused on the TPU (one jitted FFT+|x|²+EMA program), feeding a
+websocket for a GUI and/or a vector sink.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..blocks import Fft, Apply, MovingAvg, SeifyBuilder, WebsocketSink, VectorSink, Head
+from ..runtime import Flowgraph, Runtime
+from ..ops import fft_stage, mag2_stage, moving_avg_stage, log10_stage
+
+FFT_SIZE = 2048
+
+
+def build_flowgraph(source=None, *, use_tpu: bool = True, fft_size: int = FFT_SIZE,
+                    ws_port: Optional[int] = None, n_samples: Optional[int] = None,
+                    collect: bool = False):
+    """Assemble the spectrum flowgraph; returns (fg, sink_or_None)."""
+    fg = Flowgraph()
+    if source is None:
+        source = SeifyBuilder().args("driver=dummy,throttle=false").build_source()
+    last = source
+    if n_samples:
+        head = Head(np.complex64, n_samples)
+        fg.connect(last, head)
+        last = head
+    if use_tpu:
+        from ..tpu import TpuKernel
+        chain = TpuKernel(
+            [fft_stage(fft_size), mag2_stage(),
+             moving_avg_stage(fft_size, decay=0.1), log10_stage()],
+            np.complex64, frame_size=max(16 * fft_size, 1 << 15))
+        fg.connect(last, chain)
+        last = chain
+    else:
+        fft = Fft(fft_size)
+        mag = Apply(lambda x: (x.real ** 2 + x.imag ** 2), np.complex64, np.float32)
+        avg = MovingAvg(fft_size, width=3, decay=0.1)
+        log = Apply(lambda x: 10.0 * np.log10(np.maximum(x, 1e-20)), np.float32)
+        fg.connect(last, fft, mag, avg, log)
+        last = log
+    sink = None
+    if ws_port:
+        ws = WebsocketSink(ws_port, np.float32, chunk_items=fft_size)
+        fg.connect(last, ws)
+    elif collect:
+        sink = VectorSink(np.float32)
+        fg.connect(last, sink)
+    else:
+        from ..blocks import NullSink
+        sink = NullSink(np.float32)
+        fg.connect(last, sink)
+    return fg, sink
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(description="TPU spectrum analyzer")
+    p.add_argument("--args", default="driver=dummy,throttle=false")
+    p.add_argument("--fft", type=int, default=FFT_SIZE)
+    p.add_argument("--cpu", action="store_true", help="use CPU blocks instead of TPU")
+    p.add_argument("--ws-port", type=int, default=9001)
+    p.add_argument("--samples", type=int, default=None)
+    a = p.parse_args(argv)
+    src = SeifyBuilder().args(a.args).build_source()
+    fg, _ = build_flowgraph(src, use_tpu=not a.cpu, fft_size=a.fft,
+                            ws_port=a.ws_port, n_samples=a.samples)
+    Runtime().run(fg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+"""Audio blocks: WAV file source/sink and a soundcard sink (gated).
+
+Reference: ``src/blocks/audio/`` (cpal ``AudioSink``/``AudioSource``, hound wav file
+source/sink). WAV handling uses the stdlib ``wave`` module; the soundcard path is gated on
+``sounddevice`` availability (not present in CI images) and degrades to a null sink with a
+warning — the hardware-without-hardware pattern of SURVEY §4.
+"""
+
+from __future__ import annotations
+
+import wave
+from typing import Optional
+
+import numpy as np
+
+from ..log import logger
+from ..runtime.kernel import Kernel
+
+__all__ = ["WavSource", "WavSink", "AudioSink"]
+
+log = logger("blocks.audio")
+
+
+class WavSource(Kernel):
+    """Stream float32 samples from a WAV file (`audio/wav file source`)."""
+
+    def __init__(self, path: str, repeat: bool = False):
+        super().__init__()
+        self.path = path
+        self.repeat = repeat
+        self._w: Optional[wave.Wave_read] = None
+        self.sample_rate = 0
+        self.n_channels = 1
+        self.output = self.add_stream_output("out", np.float32)
+
+    async def init(self, mio, meta):
+        self._w = wave.open(self.path, "rb")
+        self.sample_rate = self._w.getframerate()
+        self.n_channels = self._w.getnchannels()
+        if self._w.getsampwidth() != 2:
+            raise RuntimeError("WavSource supports 16-bit PCM only")
+
+    async def deinit(self, mio, meta):
+        if self._w:
+            self._w.close()
+
+    async def work(self, io, mio, meta):
+        out = self.output.slice()
+        want = len(out) // self.n_channels
+        if want == 0:
+            return
+        raw = self._w.readframes(min(want, 1 << 15))
+        if not raw:
+            if self.repeat:
+                self._w.rewind()
+                io.call_again = True
+                return
+            io.finished = True
+            return
+        pcm = np.frombuffer(raw, dtype=np.int16).astype(np.float32) / 32768.0
+        out[:len(pcm)] = pcm
+        self.output.produce(len(pcm))
+        io.call_again = True
+
+
+class WavSink(Kernel):
+    """Write float32 samples to a 16-bit PCM WAV file (`audio/wav_sink`)."""
+
+    def __init__(self, path: str, sample_rate: int, n_channels: int = 1):
+        super().__init__()
+        self.path = path
+        self.sample_rate = int(sample_rate)
+        self.n_channels = n_channels
+        self._w: Optional[wave.Wave_write] = None
+        self.input = self.add_stream_input("in", np.float32)
+        self.n_written = 0
+
+    async def init(self, mio, meta):
+        self._w = wave.open(self.path, "wb")
+        self._w.setnchannels(self.n_channels)
+        self._w.setsampwidth(2)
+        self._w.setframerate(self.sample_rate)
+
+    async def deinit(self, mio, meta):
+        if self._w:
+            self._w.close()
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        if len(inp):
+            pcm = np.clip(inp * 32767.0, -32768, 32767).astype(np.int16)
+            self._w.writeframes(pcm.tobytes())
+            self.n_written += len(inp)
+            self.input.consume(len(inp))
+        if self.input.finished():
+            io.finished = True
+
+
+class AudioSink(Kernel):
+    """Soundcard playback (cpal `AudioSink` role); degrades to drop-with-warning when no
+    audio backend is present."""
+
+    BLOCKING = True
+
+    def __init__(self, sample_rate: int, n_channels: int = 1):
+        super().__init__()
+        self.sample_rate = int(sample_rate)
+        self.n_channels = n_channels
+        self._stream = None
+        self.input = self.add_stream_input("in", np.float32)
+
+    async def init(self, mio, meta):
+        try:
+            import sounddevice as sd
+            self._stream = sd.OutputStream(
+                samplerate=self.sample_rate, channels=self.n_channels, dtype="float32")
+            self._stream.start()
+        except Exception as e:
+            log.warning("no audio backend (%r): AudioSink drops samples", e)
+            self._stream = None
+
+    async def deinit(self, mio, meta):
+        if self._stream is not None:
+            self._stream.stop()
+            self._stream.close()
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        if len(inp):
+            if self._stream is not None:
+                frames = inp[:len(inp) - len(inp) % self.n_channels]
+                self._stream.write(frames.reshape(-1, self.n_channels).copy())
+            self.input.consume(len(inp))
+        if self.input.finished():
+            io.finished = True

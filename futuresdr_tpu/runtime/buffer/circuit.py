@@ -1,0 +1,137 @@
+"""Circuit (in-place) buffers: owned frames circulating through a pipeline.
+
+Re-design of ``src/runtime/buffer/circuit.rs`` (reference): the source pops an EMPTY
+frame, fills it, pushes it FULL to the next block; intermediate blocks mutate in place and
+forward; the final block returns the frame to the source — closing the circuit
+(``Flowgraph::close_circuit``, ``flowgraph.rs:433-491``). Zero copies end to end.
+
+On the TPU path the same idea appears as donated device buffers (`TpuKernel` donates its
+carry); this CPU version serves pipelines of mutating host blocks.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+from ..inbox import BlockInbox, StreamInputDone
+
+__all__ = ["Circuit", "InplaceOutput", "InplaceInput"]
+
+
+class Circuit:
+    """The frame pool + the chain of stage queues."""
+
+    def __init__(self, n_buffers: int, items_per_buffer: int, dtype):
+        self.dtype = np.dtype(dtype)
+        self.items = items_per_buffer
+        self._lock = threading.Lock()
+        self._empty: Deque[np.ndarray] = deque(
+            np.zeros(items_per_buffer, self.dtype) for _ in range(n_buffers))
+        self._source_inbox: Optional[BlockInbox] = None
+
+    # -- source side -----------------------------------------------------------
+    def attach_source(self, inbox: BlockInbox):
+        self._source_inbox = inbox
+
+    def get_empty(self) -> Optional[np.ndarray]:
+        with self._lock:
+            return self._empty.popleft() if self._empty else None
+
+    def put_empty(self, buf: np.ndarray) -> None:
+        """Return a frame to the pool (the closing edge of the circuit)."""
+        with self._lock:
+            self._empty.append(buf)
+        if self._source_inbox is not None:
+            self._source_inbox.notify()
+
+
+class InplaceOutput:
+    """Output port pushing full frames to the connected input (`InplaceWriter`).
+
+    Duck-types enough of :class:`..StreamOutput` to live in a kernel's port list.
+    """
+
+    def __init__(self, name: str, dtype=None):
+        self.name = name
+        self.dtype = np.dtype(dtype) if dtype is not None else None
+        self.min_items = 1
+        self._peer: Optional["InplaceInput"] = None
+        self._finished = False
+
+    @property
+    def connected(self) -> bool:
+        return self._peer is not None
+
+    def connect(self, peer: "InplaceInput"):
+        self._peer = peer
+
+    def put_full(self, buf: np.ndarray, n_items: int) -> None:
+        self._peer.push(buf, n_items)
+
+    def notify_finished(self) -> None:
+        if self._peer is not None and not self._finished:
+            self._finished = True
+            self._peer.mark_finished()
+
+
+class InplaceInput:
+    """Input port receiving full frames (`InplaceReader`).
+
+    Duck-types :class:`..StreamInput`'s event-loop surface (``set_finished``,
+    ``notify_finished``, ``reader``) so the block event loop and validation treat it
+    like any other input port.
+    """
+
+    def __init__(self, name: str, dtype=None):
+        self.name = name
+        self.dtype = np.dtype(dtype) if dtype is not None else None
+        self.min_items = 1
+        self._q: Deque[Tuple[np.ndarray, int]] = deque()
+        self._lock = threading.Lock()
+        self._inbox: Optional[BlockInbox] = None
+        self._port_index = 0
+        self._finished = False
+
+    # -- StreamInput duck-typing ----------------------------------------------
+    @property
+    def reader(self):
+        return self._inbox          # non-None once bound ⇒ "connected"
+
+    def set_finished(self) -> None:
+        self._finished = True
+
+    def finished(self) -> bool:
+        return self._finished
+
+    def notify_finished(self) -> None:
+        pass                        # no upstream space accounting for circuits
+
+    @property
+    def connected(self) -> bool:
+        return self._inbox is not None
+
+    # -- circuit API -----------------------------------------------------------
+    def bind(self, inbox: BlockInbox, port_index: int):
+        self._inbox = inbox
+        self._port_index = port_index
+
+    def push(self, buf: np.ndarray, n_items: int) -> None:
+        with self._lock:
+            self._q.append((buf, n_items))
+        if self._inbox is not None:
+            self._inbox.notify()
+
+    def get_full(self) -> Optional[Tuple[np.ndarray, int]]:
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    def __len__(self):
+        return len(self._q)
+
+    def mark_finished(self) -> None:
+        if self._inbox is not None:
+            self._inbox.send(StreamInputDone(self._port_index))

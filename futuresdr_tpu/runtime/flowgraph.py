@@ -75,12 +75,22 @@ class MessageEdge:
     dst_port: str
 
 
+@dataclass
+class InplaceEdge:
+    src: Kernel
+    src_port: str
+    dst: Kernel
+    dst_port: str
+
+
 class Flowgraph:
     def __init__(self):
         self._blocks: List[Optional[WrappedKernel]] = []
         self._kernel_ids: dict = {}           # id(kernel) -> block id
         self.stream_edges: List[StreamEdge] = []
         self.message_edges: List[MessageEdge] = []
+        self.inplace_edges: List[InplaceEdge] = []
+        self._circuits: List[tuple] = []      # (Circuit, source kernel)
         self._launched = False
 
     # -- graph building --------------------------------------------------------
@@ -138,6 +148,23 @@ class Flowgraph:
             raise ConnectError(f"input {dst!r}.{dst_port} already connected")
         self.stream_edges.append(StreamEdge(src, src_port, dst, dst_port, buffer))
 
+    def connect_inplace(self, src: Kernel, src_port: str, dst: Kernel,
+                        dst_port: str) -> None:
+        """Circuit-buffer connect (`flowgraph.rs` stream over Inplace ports)."""
+        self.add(src)
+        self.add(dst)
+        op = src.stream_output(src_port)
+        ip = dst.stream_input(dst_port)
+        if op.dtype is not None and ip.dtype is not None and op.dtype != ip.dtype:
+            raise ConnectError(f"dtype mismatch on inplace edge {src_port}->{dst_port}")
+        self.inplace_edges.append(InplaceEdge(src, src_port, dst, dst_port))
+
+    def close_circuit(self, circuit, source: Kernel) -> None:
+        """Register the circuit's return path: frames released downstream wake this
+        source (`Flowgraph::close_circuit`, `flowgraph.rs:433-491`)."""
+        self.add(source)
+        self._circuits.append((circuit, source))
+
     def connect_message(self, src: Kernel, src_port: str, dst: Kernel, dst_port: str) -> None:
         """Message connect (`flowgraph.rs:585-612`)."""
         self.add(src)
@@ -182,6 +209,15 @@ class Flowgraph:
                 dw = self.wrapped(e.dst)
                 in_index = e.dst.stream_inputs.index(ip)
                 ip.reader = writer.add_reader(dw.inbox, in_index, ip.min_items)
+        # inplace (circuit) edges
+        for e in self.inplace_edges:
+            op = e.src.stream_output(e.src_port)
+            ip = e.dst.stream_input(e.dst_port)
+            dw = self.wrapped(e.dst)
+            op.connect(ip)
+            ip.bind(dw.inbox, e.dst.stream_inputs.index(ip))
+        for circuit, source in self._circuits:
+            circuit.attach_source(self.wrapped(source).inbox)
         # message edges
         for e in self.message_edges:
             dw = self.wrapped(e.dst)

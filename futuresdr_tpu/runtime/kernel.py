@@ -84,6 +84,19 @@ class Kernel:
         self._stream_outputs.append(port)
         return port
 
+    def add_inplace_input(self, name: str, dtype=None):
+        """Circuit (in-place) input port (`buffer/circuit.rs`; see buffer/circuit.py)."""
+        from .buffer.circuit import InplaceInput
+        port = InplaceInput(name, dtype)
+        self._stream_inputs.append(port)
+        return port
+
+    def add_inplace_output(self, name: str, dtype=None):
+        from .buffer.circuit import InplaceOutput
+        port = InplaceOutput(name, dtype)
+        self._stream_outputs.append(port)
+        return port
+
     def add_message_input(self, name: str, handler: Callable) -> None:
         self._message_handlers[name] = handler
 
