@@ -9,6 +9,7 @@ calls ``kernel.work``.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Optional
 
 from ..log import logger
@@ -32,6 +33,23 @@ class WrappedKernel:
         kernel.meta.id = block_id
         if not kernel.meta.instance_name:
             kernel.meta.instance_name = f"{kernel.meta.type_name}_{block_id}"
+        # observability counters (SURVEY §5: block-level metrics are ad hoc in the
+        # reference; here every block reports them via describe/REST)
+        self.work_calls = 0
+        self.work_time_s = 0.0
+        self.messages_handled = 0
+
+    def metrics(self) -> dict:
+        k = self.kernel
+        return {
+            "work_calls": self.work_calls,
+            "work_time_s": round(self.work_time_s, 6),
+            "messages_handled": self.messages_handled,
+            "items_in": {p.name: getattr(p, "items_consumed", 0)
+                         for p in k.stream_inputs},
+            "items_out": {p.name: getattr(p, "items_produced", 0)
+                          for p in k.stream_outputs},
+        }
 
     @property
     def id(self) -> int:
@@ -103,6 +121,7 @@ class WrappedKernel:
                             await kernel.call_handler(io, meta, msg.port, msg.data)
                         except Exception as e:
                             log.error("block %s handler error: %r", self.instance_name, e)
+                        self.messages_handled += 1
                         io.call_again = True
                     elif isinstance(msg, Callback):
                         try:
@@ -111,6 +130,7 @@ class WrappedKernel:
                             log.error("block %s handler error: %r", self.instance_name, e)
                             result = Pmt.invalid_value()
                         msg.reply.set(result)
+                        self.messages_handled += 1
                         io.call_again = True
                     elif isinstance(msg, StreamInputDone):
                         kernel.stream_inputs[msg.port_index].set_finished()
@@ -144,7 +164,10 @@ class WrappedKernel:
                     continue
 
                 io.reset()
+                t0 = time.perf_counter()
                 await kernel.work(io, kernel.mio, meta)
+                self.work_time_s += time.perf_counter() - t0
+                self.work_calls += 1
         except Exception as e:
             log.error("block %s failed in work: %r", self.instance_name, e)
             error = e

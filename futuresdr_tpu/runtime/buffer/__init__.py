@@ -112,6 +112,7 @@ class StreamOutput:
         self.buffer = buffer          # backend class override for this port
         self.writer: Optional[BufferWriter] = None
         self._pending_tags: List[ItemTag] = []
+        self.items_produced = 0       # observability counter (SURVEY §5 metrics)
 
     # -- work()-time API -------------------------------------------------------
     def slice(self) -> np.ndarray:
@@ -126,6 +127,7 @@ class StreamOutput:
 
     def produce(self, n: int) -> None:
         tags, self._pending_tags = self._pending_tags, []
+        self.items_produced += n
         self.writer.produce(n, tags)
 
     def notify_finished(self) -> None:
@@ -146,6 +148,7 @@ class StreamInput:
         self.min_items = min_items
         self.reader: Optional[BufferReader] = None
         self._finished = False        # StreamInputDone received (upstream writer done)
+        self.items_consumed = 0       # observability counter (SURVEY §5 metrics)
 
     # -- work()-time API -------------------------------------------------------
     def slice(self) -> np.ndarray:
@@ -159,6 +162,7 @@ class StreamInput:
         return ts if n is None else [t for t in ts if t.index < n]
 
     def consume(self, n: int) -> None:
+        self.items_consumed += n
         self.reader.consume(n)
 
     def finished(self) -> bool:

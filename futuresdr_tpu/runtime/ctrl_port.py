@@ -85,6 +85,7 @@ class ControlPort:
         app.middlewares.append(cors)
         app.router.add_get("/api/fg/", self._list_fgs)
         app.router.add_get("/api/fg/{fg}/", self._describe_fg)
+        app.router.add_get("/api/fg/{fg}/metrics/", self._metrics)
         app.router.add_get("/api/fg/{fg}/block/{blk}/", self._describe_block)
         app.router.add_get("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
         app.router.add_post("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
@@ -111,6 +112,13 @@ class ControlPort:
             return web.json_response({"error": "flowgraph not found"}, status=404)
         desc = await fg.describe()
         return web.json_response(desc.to_json())
+
+    async def _metrics(self, request):
+        from aiohttp import web
+        fg = self._fg(request)
+        if fg is None:
+            return web.json_response({"error": "flowgraph not found"}, status=404)
+        return web.json_response(await fg.metrics())
 
     async def _describe_block(self, request):
         from aiohttp import web

@@ -80,6 +80,11 @@ class DescribeMsg(FlowgraphMessage):
 
 
 @dataclass(frozen=True)
+class MetricsMsg(FlowgraphMessage):
+    reply: ReplySlot
+
+
+@dataclass(frozen=True)
 class TerminateMsg(FlowgraphMessage):
     pass
 
@@ -145,6 +150,8 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                 blk.inbox.send(Callback(msg.port, msg.data, msg.reply))
         elif isinstance(msg, DescribeMsg):
             msg.reply.set(_describe(fg, blocks))
+        elif isinstance(msg, MetricsMsg):
+            msg.reply.set({b.instance_name: b.metrics() for b in blocks})
         elif isinstance(msg, TerminateMsg):
             if not terminated:
                 for b in blocks:
@@ -238,6 +245,16 @@ class FlowgraphHandle:
         if not self._inbox.send(DescribeMsg(reply)):
             return self._fg.describe()   # flowgraph completed; describe statically
         return await reply.get()
+
+    async def metrics(self) -> dict:
+        """Per-block runtime metrics (work calls/time, items in/out, messages)."""
+        reply = ReplySlot()
+        if not self._inbox.send(MetricsMsg(reply)):
+            return {}
+        return await reply.get()
+
+    def metrics_sync(self) -> dict:
+        return self._scheduler.run_coro_sync(self.metrics())
 
     async def terminate(self) -> None:
         self._inbox.send(TerminateMsg())

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""perf/fir — the north-star sweep: pipes × stages of (CopyRand → 64-tap FIR).
+
+Re-design of the reference's ``perf/fir/fir.rs:14-95``: builds a grid of ``pipes``
+parallel chains, each ``stages`` deep, pushes ``samples`` float32 samples per pipe, and
+emits a CSV row per run: ``run,pipes,stages,samples,max_copy,scheduler,elapsed_secs``.
+
+Schedulers: ``async`` (default single-loop) or ``threaded`` (pinned multi-worker,
+FlowScheduler analog). Add ``--tpu`` to run each pipe's FIR fused on the TPU instead of
+CPU blocks.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime, AsyncScheduler, ThreadedScheduler
+from futuresdr_tpu.blocks import NullSource, NullSink, Head, CopyRand, Fir
+from futuresdr_tpu.dsp import firdes
+
+
+def run_once(pipes: int, stages: int, samples: int, max_copy: int,
+             scheduler: str, use_tpu: bool) -> float:
+    taps = firdes.lowpass(0.2, 64).astype(np.float32)
+    fg = Flowgraph()
+    sinks = []
+    for _ in range(pipes):
+        src = NullSource(np.float32)
+        head = Head(np.float32, samples)
+        fg.connect(src, head)
+        last = head
+        if use_tpu:
+            from futuresdr_tpu.ops import fir_stage
+            from futuresdr_tpu.tpu import TpuKernel
+            for _s in range(stages):
+                blk = TpuKernel([fir_stage(taps)], np.float32, frame_size=1 << 18)
+                fg.connect(last, blk)
+                last = blk
+        else:
+            for _s in range(stages):
+                cr = CopyRand(np.float32, max_copy)
+                fir = Fir(taps, np.float32)
+                fg.connect(last, cr, fir)
+                last = fir
+        snk = NullSink(np.float32)
+        fg.connect(last, snk)
+        sinks.append(snk)
+    sched = ThreadedScheduler() if scheduler == "threaded" else AsyncScheduler()
+    rt = Runtime(sched)
+    t0 = time.perf_counter()
+    rt.run(fg)
+    dt = time.perf_counter() - t0
+    for s in sinks:
+        assert s.n_received >= samples - 64 * stages - 1, s.n_received
+    rt.shutdown()
+    return dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--pipes", type=int, nargs="+", default=[5])
+    p.add_argument("--stages", type=int, nargs="+", default=[6])
+    p.add_argument("--samples", type=int, default=15_000_000)
+    p.add_argument("--max-copy", type=int, default=4096)
+    p.add_argument("--scheduler", choices=["async", "threaded"], default="async")
+    p.add_argument("--tpu", action="store_true")
+    a = p.parse_args()
+    print("run,pipes,stages,samples,max_copy,scheduler,elapsed_secs,msps_total")
+    for r in range(a.runs):
+        for pipes in a.pipes:
+            for stages in a.stages:
+                dt = run_once(pipes, stages, a.samples, a.max_copy,
+                              a.scheduler, a.tpu)
+                msps = pipes * a.samples / dt / 1e6
+                print(f"{r},{pipes},{stages},{a.samples},{a.max_copy},"
+                      f"{a.scheduler},{dt:.3f},{msps:.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""perf/msg — message-plane throughput.
+
+Reference: ``perf/msg/msg.rs``: a chain of message blocks forwarding a burst of PDUs;
+measures messages/s. CSV: ``run,stages,burst,elapsed_secs,msg_per_sec``.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+from futuresdr_tpu import Flowgraph, Runtime, Pmt
+from futuresdr_tpu.blocks import MessageBurst, MessageCopy, MessageSink
+
+
+def run_once(stages: int, burst: int) -> float:
+    fg = Flowgraph()
+    src = MessageBurst(Pmt.usize(1), burst)
+    last = src
+    for _ in range(stages):
+        c = MessageCopy()
+        fg.connect_message(last, "out", c, "in")
+        last = c
+    snk = MessageSink()
+    fg.connect_message(last, "out", snk, "in")
+    rt = Runtime()
+    t0 = time.perf_counter()
+    rt.run(fg)
+    dt = time.perf_counter() - t0
+    assert len(snk.received) == burst, len(snk.received)
+    rt.shutdown()
+    return dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--stages", type=int, nargs="+", default=[8])
+    p.add_argument("--burst", type=int, default=100_000)
+    a = p.parse_args()
+    print("run,stages,burst,elapsed_secs,msg_per_sec")
+    for r in range(a.runs):
+        for stages in a.stages:
+            dt = run_once(stages, a.burst)
+            print(f"{r},{stages},{a.burst},{dt:.3f},{a.burst * stages / dt:.0f}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

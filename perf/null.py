@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""perf/null — copy-chain throughput over buffer backends.
+
+Reference: ``perf/null/null.rs:13-120`` (pipes × stages Copy chains over circular / slab
+/ spsc buffers). Backends here: ``circular`` (C++ double-mapped) and ``ring`` (portable).
+CSV: ``run,pipes,stages,samples,buffer,elapsed_secs``.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import NullSource, NullSink, Head, Copy
+from futuresdr_tpu.runtime.buffer.ring import RingWriter
+from futuresdr_tpu.runtime.buffer import circular
+
+
+def run_once(pipes, stages, samples, backend) -> float:
+    fg = Flowgraph()
+    sinks = []
+    for _ in range(pipes):
+        src = NullSource(np.float32)
+        head = Head(np.float32, samples)
+        fg.connect_stream(src, "out", head, "in", buffer=backend)
+        last = head
+        for _s in range(stages):
+            c = Copy(np.float32)
+            fg.connect_stream(last, "out", c, "in", buffer=backend)
+            last = c
+        snk = NullSink(np.float32)
+        fg.connect_stream(last, "out", snk, "in", buffer=backend)
+        sinks.append(snk)
+    rt = Runtime()
+    t0 = time.perf_counter()
+    rt.run(fg)
+    dt = time.perf_counter() - t0
+    rt.shutdown()
+    return dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--pipes", type=int, nargs="+", default=[4])
+    p.add_argument("--stages", type=int, nargs="+", default=[8])
+    p.add_argument("--samples", type=int, default=15_000_000)
+    p.add_argument("--buffers", nargs="+", default=["circular", "ring"])
+    a = p.parse_args()
+    backends = {"ring": RingWriter}
+    if circular.available():
+        backends["circular"] = circular.CircularWriter
+    print("run,pipes,stages,samples,buffer,elapsed_secs,msps_total")
+    for r in range(a.runs):
+        for name in a.buffers:
+            if name not in backends:
+                continue
+            for pipes in a.pipes:
+                for stages in a.stages:
+                    dt = run_once(pipes, stages, a.samples, backends[name])
+                    print(f"{r},{pipes},{stages},{a.samples},{name},{dt:.3f},"
+                          f"{pipes * a.samples / dt / 1e6:.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
