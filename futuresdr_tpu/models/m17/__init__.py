@@ -1,0 +1,10 @@
+"""M17 digital radio protocol (reference: ``examples/m17/``): base-40 callsigns,
+Golay(24,12), CRC16, K=5 convolutional code, LSF framing, 4FSK RRC PHY."""
+
+from .codec import (encode_callsign, decode_callsign, crc16_m17, golay24_encode,
+                    golay24_decode, conv_encode_m17, viterbi_decode_m17)
+from .phy import Lsf, build_lsf_frame, modulate, demodulate_stream, SYNC_LSF
+
+__all__ = ["encode_callsign", "decode_callsign", "crc16_m17", "golay24_encode",
+           "golay24_decode", "conv_encode_m17", "viterbi_decode_m17",
+           "Lsf", "build_lsf_frame", "modulate", "demodulate_stream", "SYNC_LSF"]

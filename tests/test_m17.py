@@ -1,0 +1,78 @@
+"""M17 tests: codecs (callsign/CRC/Golay/conv) and 4FSK LSF loopback."""
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu.models.m17 import (encode_callsign, decode_callsign, crc16_m17,
+                                      golay24_encode, golay24_decode, conv_encode_m17,
+                                      viterbi_decode_m17, Lsf, build_lsf_frame,
+                                      modulate, demodulate_stream)
+
+
+def test_callsign_roundtrip():
+    for cs in ["W2FBI", "SP5WWP", "N0CALL", "AB1CDE-9"]:
+        assert decode_callsign(encode_callsign(cs)) == cs
+    assert decode_callsign(encode_callsign("@ALL")) == "@ALL"
+
+
+def test_crc16_m17_known_vectors():
+    # vectors from the M17 spec §2.5.4
+    assert crc16_m17(b"") == 0xFFFF
+    assert crc16_m17(b"A") == 0x206E
+    assert crc16_m17(b"123456789") == 0x772B
+
+
+def test_golay_roundtrip_and_correction():
+    rng = np.random.default_rng(0)
+    for d in [0x000, 0xFFF, 0xABC, 0x123]:
+        w = golay24_encode(d)
+        assert golay24_decode(w) == d
+        # up to 3 errors in the 23-bit part are corrected
+        for n_err in (1, 2, 3):
+            pos = rng.choice(23, n_err, replace=False)
+            bad = w
+            for p in pos:
+                bad ^= 1 << (p + 1)
+            assert golay24_decode(bad) == d
+
+
+def test_conv_viterbi_m17():
+    rng = np.random.default_rng(1)
+    bits = np.concatenate([rng.integers(0, 2, 240), np.zeros(4)]).astype(np.uint8)
+    coded = conv_encode_m17(bits)
+    llrs = coded.astype(np.float64) * 2 - 1
+    flip = rng.choice(len(llrs), 20, replace=False)
+    llrs[flip] *= -1
+    dec = viterbi_decode_m17(llrs, len(bits))
+    np.testing.assert_array_equal(dec, bits)
+
+
+def test_lsf_roundtrip():
+    lsf = Lsf(dst="@ALL", src="SP5WWP", type_field=0x0005, meta=b"hello meta din")
+    raw = lsf.to_bytes()
+    assert len(raw) == 30
+    back = Lsf.from_bytes(raw)
+    assert back.dst == "@ALL" and back.src == "SP5WWP"
+    assert back.type_field == 0x0005
+    bad = bytearray(raw)
+    bad[3] ^= 0xFF
+    assert Lsf.from_bytes(bytes(bad)) is None
+
+
+def test_4fsk_lsf_loopback():
+    lsf = Lsf(dst="N0CALL", src="W2FBI")
+    syms = build_lsf_frame(lsf)
+    sig = modulate(syms)
+    sig = np.concatenate([np.zeros(173, np.float32), sig, np.zeros(200, np.float32)])
+    found = demodulate_stream(sig)
+    assert len(found) == 1
+    assert found[0].dst == "N0CALL" and found[0].src == "W2FBI"
+
+
+def test_4fsk_loopback_noise():
+    rng = np.random.default_rng(2)
+    lsf = Lsf(dst="AB1CDE", src="SP5WWP")
+    sig = modulate(build_lsf_frame(lsf))
+    sig = sig + 0.1 * rng.standard_normal(len(sig)).astype(np.float32)
+    found = demodulate_stream(sig)
+    assert len(found) == 1 and found[0].src == "SP5WWP"
