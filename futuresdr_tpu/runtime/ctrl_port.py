@@ -89,9 +89,16 @@ class ControlPort:
         app.router.add_get("/api/fg/{fg}/block/{blk}/", self._describe_block)
         app.router.add_get("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
         app.router.add_post("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
+        import os
         fp = config().frontend_path
+        if not fp:
+            builtin = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "gui")
+            fp = builtin if os.path.isdir(builtin) else None
         if fp:
-            app.router.add_static("/", fp)
+            app.router.add_get("/", lambda r: web.FileResponse(
+                os.path.join(fp, "index.html")))
+            app.router.add_static("/static/", fp)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)

@@ -1,0 +1,5 @@
+"""Utilities: latency tracepoints, misc helpers."""
+
+from .trace import LatencyProbeSource, LatencyProbeSink, latency_stats
+
+__all__ = ["LatencyProbeSource", "LatencyProbeSink", "latency_stats"]

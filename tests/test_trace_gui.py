@@ -1,0 +1,42 @@
+"""Latency tracepoints + GUI serving tests."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import VectorSource, Copy, NullSink
+from futuresdr_tpu.utils import LatencyProbeSource, LatencyProbeSink, latency_stats
+
+
+def test_latency_probes():
+    data = np.zeros(500_000, np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    probe_in = LatencyProbeSource(np.float32, granularity=65536)
+    mid = Copy(np.float32)
+    probe_out = LatencyProbeSink(np.float32)
+    fg.connect(src, probe_in, mid, probe_out)
+    Runtime().run(fg)
+    stats = latency_stats(probe_out.records)
+    assert stats["count"] >= 7
+    assert stats["p99_us"] >= stats["p50_us"] >= 0
+    assert stats["max_us"] < 5e6
+
+
+def test_gui_served_from_ctrl_port():
+    from futuresdr_tpu.runtime.ctrl_port import ControlPort
+    from futuresdr_tpu.runtime.runtime import RuntimeHandle
+    from futuresdr_tpu import AsyncScheduler
+
+    handle = RuntimeHandle(AsyncScheduler())
+    cp = ControlPort(handle, bind="127.0.0.1:29417")
+    cp.start()
+    try:
+        html = urllib.request.urlopen("http://127.0.0.1:29417/").read().decode()
+        assert "waterfall" in html
+        ids = json.load(urllib.request.urlopen("http://127.0.0.1:29417/api/fg/"))
+        assert ids == []
+    finally:
+        cp.stop()
