@@ -1,0 +1,160 @@
+"""Audio OFDM data modem — the rattlegram-role application.
+
+Re-design of the reference's ``examples/rattlegram`` (port of the aicodix modem: MLS
+synchronization, OFDM PSK payload, BCH/polar FEC + OSD): same architecture — an MLS-keyed
+OFDM sync symbol located by cross-correlation, pilot-based channel equalization, QPSK
+payload carriers, FEC + CRC32 — with the FEC realized by this framework's K=7
+convolutional code + soft Viterbi (``models.wlan.coding``) instead of BCH/polar+OSD.
+
+Runs over plain audio: 8 kHz mono, carriers ≈ 1.1–3.3 kHz.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from .wlan import coding as wcoding
+
+__all__ = ["mls", "ModemParams", "modulate", "demodulate", "Modem"]
+
+
+def mls(poly: int = 0b1000011, state: int = 1) -> np.ndarray:
+    """Maximal-length sequence from an LFSR given a primitive polynomial (the
+    reference's MLS utility; default x^6+x+1 → length 63)."""
+    deg = poly.bit_length() - 1
+    n = (1 << deg) - 1
+    out = np.empty(n, dtype=np.uint8)
+    s = state
+    for i in range(n):
+        out[i] = s & 1
+        fb = 0
+        t = s & poly
+        while t:
+            fb ^= t & 1
+            t >>= 1
+        s = (s >> 1) | (fb << (deg - 1))
+    return out
+
+
+@dataclass(frozen=True)
+class ModemParams:
+    fs: int = 8000
+    fft: int = 256
+    cp: int = 32
+    first_carrier: int = 36        # ≈1.1 kHz
+    n_carriers: int = 64           # → up to ≈3.2 kHz
+
+    @property
+    def sym_len(self) -> int:
+        return self.fft + self.cp
+
+    @property
+    def carriers(self) -> np.ndarray:
+        return np.arange(self.first_carrier, self.first_carrier + self.n_carriers)
+
+
+_QPSK = np.array([1 + 1j, -1 + 1j, 1 - 1j, -1 - 1j]) / np.sqrt(2)
+
+
+def _sync_spectrum(p: ModemParams) -> np.ndarray:
+    seq = mls()                                    # 63 chips
+    vals = np.where(np.resize(seq, p.n_carriers) > 0, 1.0, -1.0)
+    spec = np.zeros(p.fft, dtype=np.complex128)
+    spec[p.carriers] = vals
+    return spec
+
+
+def _sym_to_audio(spec: np.ndarray, p: ModemParams) -> np.ndarray:
+    """Hermitian-symmetric IFFT → real audio symbol with CP."""
+    full = spec.copy()
+    full[-np.arange(1, p.fft // 2)] = np.conj(full[np.arange(1, p.fft // 2)])
+    full[0] = full[p.fft // 2] = 0
+    t = np.fft.ifft(full).real * p.fft / np.sqrt(p.n_carriers * 2)
+    return np.concatenate([t[-p.cp:], t])
+
+
+def modulate(payload: bytes, p: ModemParams = ModemParams()) -> np.ndarray:
+    """Payload bytes → audio samples (sync symbol + QPSK payload symbols)."""
+    body = payload + zlib.crc32(payload).to_bytes(4, "little")
+    bits = np.unpackbits(np.frombuffer(body, np.uint8))
+    bits = np.concatenate([bits, np.zeros(6, np.uint8)])        # flush the trellis
+    coded = wcoding.conv_encode(bits)
+    bits_per_sym = 2 * p.n_carriers
+    n_sym = -(-len(coded) // bits_per_sym)
+    padded = np.zeros(n_sym * bits_per_sym, dtype=np.uint8)
+    padded[:len(coded)] = coded
+    sync = _sync_spectrum(p)
+    parts = [_sym_to_audio(sync, p)]
+    for s in range(n_sym):
+        seg = padded[s * bits_per_sym:(s + 1) * bits_per_sym].reshape(-1, 2)
+        idx = seg[:, 0] + 2 * seg[:, 1]
+        spec = np.zeros(p.fft, dtype=np.complex128)
+        spec[p.carriers] = _QPSK[idx]
+        parts.append(_sym_to_audio(spec, p))
+    burst = np.concatenate(parts)
+    return (burst / np.abs(burst).max() * 0.8).astype(np.float32)
+
+
+def demodulate(audio: np.ndarray, n_payload: int,
+               p: ModemParams = ModemParams()) -> Optional[bytes]:
+    """Locate the MLS sync symbol, equalize, demap, Viterbi-decode, CRC-check."""
+    ref = _sym_to_audio(_sync_spectrum(p), p)[p.cp:]
+    corr = np.correlate(audio.astype(np.float64), ref, mode="valid")
+    energy = np.convolve(audio.astype(np.float64) ** 2, np.ones(len(ref)), "full")
+    energy = energy[len(ref) - 1:len(ref) - 1 + len(corr)]
+    norm = np.abs(corr) / np.maximum(np.sqrt(energy * np.sum(ref ** 2)), 1e-12)
+    peak = int(np.argmax(norm))
+    if norm[peak] < 0.5:
+        return None
+    sync_start = peak
+    # channel estimate from the sync symbol
+    sync_spec = np.fft.fft(audio[sync_start:sync_start + p.fft])
+    ref_spec = _sync_spectrum(p)
+    H = sync_spec[p.carriers] / ref_spec[p.carriers]
+
+    n_bits = 8 * (n_payload + 4) + 6
+    n_coded = 2 * n_bits
+    bits_per_sym = 2 * p.n_carriers
+    n_sym = -(-n_coded // bits_per_sym)
+    llrs = np.zeros(n_sym * bits_per_sym)
+    pos = sync_start + p.fft + p.cp
+    for s in range(n_sym):
+        if pos + p.fft > len(audio):
+            return None
+        spec = np.fft.fft(audio[pos:pos + p.fft])
+        eq = spec[p.carriers] / H
+        d = -np.abs(eq[:, None] - _QPSK[None, :]) ** 2
+        b0 = np.maximum(d[:, 1], d[:, 3]) - np.maximum(d[:, 0], d[:, 2])
+        b1 = np.maximum(d[:, 2], d[:, 3]) - np.maximum(d[:, 0], d[:, 1])
+        seg = np.empty(bits_per_sym)
+        seg[0::2] = b0
+        seg[1::2] = b1
+        llrs[s * bits_per_sym:(s + 1) * bits_per_sym] = seg
+        pos += p.sym_len
+    bits = wcoding.viterbi_decode(llrs[:n_coded], n_bits)
+    body = np.packbits(bits[:8 * (n_payload + 4)]).tobytes()
+    payload, crc = body[:n_payload], body[n_payload:n_payload + 4]
+    if zlib.crc32(payload).to_bytes(4, "little") != crc:
+        return None
+    return payload
+
+
+class Modem:
+    """Convenience TX/RX pairing over a fixed payload size (rattlegram bursts carry a
+    fixed 170-byte payload; configurable here)."""
+
+    def __init__(self, payload_size: int = 170, params: ModemParams = ModemParams()):
+        self.size = payload_size
+        self.params = params
+
+    def tx(self, payload: bytes) -> np.ndarray:
+        assert len(payload) <= self.size
+        return modulate(payload.ljust(self.size, b"\x00"), self.params)
+
+    def rx(self, audio: np.ndarray) -> Optional[bytes]:
+        r = demodulate(audio, self.size, self.params)
+        return None if r is None else r.rstrip(b"\x00")
