@@ -169,8 +169,22 @@ def detect_frames(samples: np.ndarray, p: LoraParams) -> List[int]:
             start = i - ka
             if start < 0:
                 start += n
-            starts.append(start)
-            i = start + (p.n_preamble + 5) * n    # skip past this frame's start
+            # validate: two data symbols can match by chance; a real preamble shows a
+            # constant bin over ≥3 aligned consecutive chirps from `start`
+            ok = 0
+            for s in range(3):
+                q = start + s * n
+                if q + n > len(samples):
+                    break
+                kk = int(np.argmax(np.abs(np.fft.fft(
+                    samples[q:q + n] * _downchirp(n)))))
+                if kk in (0, 1, n - 1):
+                    ok += 1
+            if ok >= 3:
+                starts.append(start)
+                i = start + (p.n_preamble + 5) * n    # skip past this frame's start
+            else:
+                i += hop
         else:
             i += hop
     return starts
