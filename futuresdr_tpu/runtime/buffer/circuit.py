@@ -72,6 +72,10 @@ class InplaceOutput:
     def put_full(self, buf: np.ndarray, n_items: int) -> None:
         self._peer.push(buf, n_items)
 
+    def queue_depth(self) -> int:
+        """Frames waiting at the consumer (backpressure signal)."""
+        return len(self._peer) if self._peer is not None else 0
+
     def notify_finished(self) -> None:
         if self._peer is not None and not self._finished:
             self._finished = True
@@ -119,6 +123,10 @@ class InplaceInput:
         self._inbox = inbox
         self._port_index = port_index
 
+    def bind_producer(self, inbox: BlockInbox):
+        """Wake the producing block when frames are taken (backpressure release)."""
+        self._producer_inbox = inbox
+
     def push(self, buf: np.ndarray, n_items: int) -> None:
         with self._lock:
             self._q.append((buf, n_items))
@@ -127,7 +135,10 @@ class InplaceInput:
 
     def get_full(self) -> Optional[Tuple[np.ndarray, int]]:
         with self._lock:
-            return self._q.popleft() if self._q else None
+            item = self._q.popleft() if self._q else None
+        if item is not None and getattr(self, "_producer_inbox", None) is not None:
+            self._producer_inbox.notify()
+        return item
 
     def __len__(self):
         return len(self._q)

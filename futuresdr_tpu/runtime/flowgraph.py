@@ -216,6 +216,7 @@ class Flowgraph:
             dw = self.wrapped(e.dst)
             op.connect(ip)
             ip.bind(dw.inbox, e.dst.stream_inputs.index(ip))
+            ip.bind_producer(self.wrapped(e.src).inbox)
         for circuit, source in self._circuits:
             circuit.attach_source(self.wrapped(source).inbox)
         # message edges

@@ -1,0 +1,141 @@
+"""Device-frame plane: H2D/D2H staging blocks and device-resident stage blocks.
+
+Re-design of the reference's accelerator buffer pairs (``buffer/vulkan/{h2d,d2h}.rs``,
+SURVEY §3.5): there, full/empty staging buffers circulate between host and GPU around each
+compute block. Here the analogous pipeline is explicit blocks over a **frame stream**
+(in-place queue ports carrying whole jax device arrays):
+
+    ... cpu stream → TpuH2D → TpuStage → TpuStage → TpuD2H → cpu stream ...
+
+``TpuH2D`` batches the sample stream into frames and ``device_put``s them; ``TpuStage``
+maps device frames through a jitted :class:`~futuresdr_tpu.ops.stages.Pipeline` — frames
+stay in HBM between stages (no host round-trip, unlike the reference's per-block D2H);
+``TpuD2H`` syncs results back into the sample stream. For a single fused chain prefer
+:class:`~futuresdr_tpu.tpu.TpuKernel`; this frame plane is for pipelines whose stages
+must remain separate blocks (e.g. different frame rates, taps swapped at runtime, or a
+fan-out of device consumers).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..ops.stages import Pipeline, Stage
+from ..runtime.kernel import Kernel
+from .instance import TpuInstance, instance
+
+__all__ = ["TpuH2D", "TpuStage", "TpuD2H"]
+
+
+class TpuH2D(Kernel):
+    """Sample stream → device frames (`vulkan/h2d.rs` writer role)."""
+
+    BLOCKING = True
+
+    def __init__(self, dtype, frame_size: Optional[int] = None,
+                 inst: Optional[TpuInstance] = None, max_inflight: int = 8):
+        super().__init__()
+        self.inst = inst or instance()
+        self.frame_size = frame_size or self.inst.frame_size
+        self.max_inflight = max_inflight
+        self.input = self.add_stream_input("in", dtype, min_items=self.frame_size)
+        self.output = self.add_inplace_output("out")
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        sent = 0
+        while (len(inp) >= self.frame_size
+               and self.output.queue_depth() < self.max_inflight):
+            frame = self.inst.put(inp[:self.frame_size].copy())
+            self.output.put_full(frame, self.frame_size)
+            self.input.consume(self.frame_size)
+            inp = self.input.slice()
+            sent += 1
+        eos = self.input.finished()
+        if eos and 0 < len(inp) < self.frame_size:
+            host = np.zeros(self.frame_size, dtype=self.input.dtype)
+            host[:len(inp)] = inp
+            self.output.put_full(self.inst.put(host), len(inp))
+            self.input.consume(len(inp))
+            inp = self.input.slice()
+        if eos and len(inp) == 0:
+            io.finished = True
+        elif sent and len(inp) >= self.frame_size:
+            io.call_again = True
+        # queue-full park: the consumer's get_full() notifies this block
+
+
+class TpuStage(Kernel):
+    """Device frame → device frame through a jitted stage pipeline; the frame never
+    leaves HBM (`blocks/vulkan.rs` compute role, minus its D2H hop)."""
+
+    BLOCKING = True
+
+    def __init__(self, stages: Sequence[Stage], in_dtype,
+                 inst: Optional[TpuInstance] = None):
+        super().__init__()
+        self.inst = inst or instance()
+        self.pipeline = Pipeline(stages, in_dtype)
+        self._compiled = None
+        self._carry = None
+        self.input = self.add_inplace_input("in")
+        self.output = self.add_inplace_output("out")
+
+    async def work(self, io, mio, meta):
+        while True:
+            item = self.input.get_full()
+            if item is None:
+                break
+            frame, valid = item
+            if self._compiled is None:
+                n = frame.shape[0]
+                assert n % self.pipeline.frame_multiple == 0, \
+                    f"frame {n} not a multiple of {self.pipeline.frame_multiple}"
+                self._compiled, self._carry = self.pipeline.compile(
+                    n, device=self.inst.device)
+            self._carry, y = self._compiled(self._carry, frame)   # async dispatch
+            out_valid = self.pipeline.out_items(
+                valid - valid % self.pipeline.frame_multiple)
+            self.output.put_full(y, out_valid)
+        if self.input.finished() and len(self.input) == 0:
+            io.finished = True
+
+
+class TpuD2H(Kernel):
+    """Device frames → sample stream (`vulkan/d2h.rs` reader role); the only sync
+    point of the device pipeline."""
+
+    BLOCKING = True
+
+    def __init__(self, dtype, inst: Optional[TpuInstance] = None):
+        super().__init__()
+        self.inst = inst or instance()
+        self.input = self.add_inplace_input("in")
+        self.output = self.add_stream_output("out", dtype)
+        self._pending: Optional[np.ndarray] = None
+
+    async def work(self, io, mio, meta):
+        out = self.output.slice()
+        if self._pending is not None:
+            k = min(len(out), len(self._pending))
+            out[:k] = self._pending[:k]
+            self.output.produce(k)
+            self._pending = self._pending[k:] if k < len(self._pending) else None
+            if self._pending is not None:
+                return              # downstream full; its consume() wakes us
+            out = self.output.slice()
+        item = self.input.get_full()
+        if item is not None:
+            frame, valid = item
+            host = np.asarray(frame)[:valid]      # sync point
+            k = min(len(out), len(host))
+            out[:k] = host[:k]
+            self.output.produce(k)
+            if k < len(host):
+                self._pending = host[k:].copy()
+            io.call_again = True
+            return
+        if self.input.finished() and len(self.input) == 0 and self._pending is None:
+            io.finished = True

@@ -1,0 +1,58 @@
+"""Device-frame plane tests: H2D → device-resident stages → D2H (reference vulkan
+h2d/d2h staging pair, SURVEY §3.5), on the CPU jax backend in CI."""
+
+import numpy as np
+from scipy import signal as sps
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import VectorSource, VectorSink
+from futuresdr_tpu.dsp import firdes
+from futuresdr_tpu.ops import fir_stage, fft_stage, mag2_stage
+from futuresdr_tpu.tpu import TpuH2D, TpuStage, TpuD2H
+
+
+def test_h2d_stage_d2h_pipeline():
+    """Two separate device stages; the frame between them never touches the host."""
+    taps = firdes.lowpass(0.2, 64).astype(np.float32)
+    data = np.random.default_rng(0).standard_normal(200_000).astype(np.float32)
+    frame = 16384
+
+    fg = Flowgraph()
+    src = VectorSource(data)
+    h2d = TpuH2D(np.float32, frame_size=frame)
+    s1 = TpuStage([fir_stage(taps, fft_len=1024)], np.float32)
+    s2 = TpuStage([fir_stage(taps, fft_len=1024)], np.float32)
+    d2h = TpuD2H(np.float32)
+    snk = VectorSink(np.float32)
+    fg.connect_stream(src, "out", h2d, "in")
+    fg.connect_inplace(h2d, "out", s1, "in")
+    fg.connect_inplace(s1, "out", s2, "in")
+    fg.connect_inplace(s2, "out", d2h, "in")
+    fg.connect_stream(d2h, "out", snk, "in")
+    Runtime().run(fg)
+
+    got = snk.items()
+    ref = sps.lfilter(taps, 1.0, sps.lfilter(taps, 1.0, data))
+    n = (len(data) // frame) * frame
+    assert len(got) >= n
+    np.testing.assert_allclose(got[:n], ref[:n], rtol=1e-3, atol=1e-4)
+
+
+def test_frame_pipeline_spectrum():
+    frame = 8192
+    n_fft = 256
+    tone = np.exp(1j * 2 * np.pi * 0.2 * np.arange(65536)).astype(np.complex64)
+    fg = Flowgraph()
+    src = VectorSource(tone)
+    h2d = TpuH2D(np.complex64, frame_size=frame)
+    st = TpuStage([fft_stage(n_fft), mag2_stage()], np.complex64)
+    d2h = TpuD2H(np.float32)
+    snk = VectorSink(np.float32)
+    fg.connect_stream(src, "out", h2d, "in")
+    fg.connect_inplace(h2d, "out", st, "in")
+    fg.connect_inplace(st, "out", d2h, "in")
+    fg.connect_stream(d2h, "out", snk, "in")
+    Runtime().run(fg)
+    spec = snk.items()
+    assert len(spec) == 65536
+    assert np.argmax(spec[:n_fft]) == round(0.2 * n_fft)
