@@ -21,14 +21,23 @@ log = logger("scheduler.threaded")
 
 
 class _Worker:
-    def __init__(self, index: int):
+    def __init__(self, index: int, pin_core: bool = False):
         self.index = index
+        self.pin_core = pin_core
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.ready = threading.Event()
         self.thread = threading.Thread(
             target=self._run, name=f"fsdr-worker-{index}", daemon=True)
 
     def _run(self):
+        if self.pin_core:
+            # core pinning (the reference's SmolScheduler/FlowScheduler CPU affinity)
+            try:
+                import os
+                cores = sorted(os.sched_getaffinity(0))
+                os.sched_setaffinity(0, {cores[self.index % len(cores)]})
+            except (AttributeError, OSError) as e:
+                log.warning("core pinning unavailable: %r", e)
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
         self.loop = loop
@@ -41,10 +50,12 @@ class _Worker:
 
 class ThreadedScheduler(Scheduler):
     def __init__(self, workers: Optional[int] = None,
-                 pinned: Optional[Dict[str, int]] = None):
+                 pinned: Optional[Dict[str, int]] = None,
+                 pin_cores: bool = False):
         import os
         self.n_workers = workers or os.cpu_count() or 4
         self.pinned = pinned or {}        # instance_name -> worker index
+        self.pin_cores = pin_cores
         self._workers: List[_Worker] = []
         self._blocking_pool = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="fsdr-blocking")
@@ -55,7 +66,7 @@ class ThreadedScheduler(Scheduler):
             if self._workers:
                 return
             for i in range(self.n_workers):
-                w = _Worker(i)
+                w = _Worker(i, self.pin_cores)
                 self._workers.append(w)
                 w.thread.start()
             for w in self._workers:

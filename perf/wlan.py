@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""perf/wlan — WLAN RX throughput: frames decoded per second.
+
+Reference: ``perf/wlan/rx.rs`` (full 802.11 RX chain vs GNU Radio's wifi_rx).
+Synthesizes a burst stream of QPSK-1/2 frames with noise, then measures full RX
+(detect → sync → equalize → Viterbi → MAC check) throughput.
+CSV: ``run,n_frames,payload_len,decoded,elapsed_secs,frames_per_sec,msamples_per_sec``.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu.models.wlan import encode_frame, decode_stream, Mac
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--frames", type=int, default=200)
+    p.add_argument("--payload", type=int, default=256)
+    p.add_argument("--mcs", default="qpsk_1_2")
+    p.add_argument("--snr-db", type=float, default=25.0)
+    a = p.parse_args()
+
+    rng = np.random.default_rng(0)
+    mac = Mac()
+    parts = []
+    for i in range(a.frames):
+        psdu = mac.frame(bytes(rng.integers(0, 256, a.payload, dtype=np.uint8)))
+        parts += [encode_frame(psdu, a.mcs), np.zeros(300, np.complex64)]
+    sig = np.concatenate(parts)
+    sigma = np.sqrt(np.mean(np.abs(sig) ** 2) * 10 ** (-a.snr_db / 10) / 2)
+    sig = (sig + sigma * (rng.standard_normal(len(sig))
+                          + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+
+    print("run,n_frames,payload_len,decoded,elapsed_secs,frames_per_sec,msamples_per_sec")
+    for r in range(a.runs):
+        t0 = time.perf_counter()
+        decoded = decode_stream(sig)
+        dt = time.perf_counter() - t0
+        print(f"{r},{a.frames},{a.payload},{len(decoded)},{dt:.3f},"
+              f"{len(decoded) / dt:.1f},{len(sig) / dt / 1e6:.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
