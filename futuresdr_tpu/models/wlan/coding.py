@@ -21,15 +21,30 @@ _K = 7
 _NSTATES = 64
 
 
+_KEYSTREAM_CACHE: dict = {}
+
+
+def _keystream(seed: int) -> np.ndarray:
+    """The x^7+x^4+1 additive scrambler's output is a 127-periodic keystream fully
+    determined by the seed — precompute once and tile (vectorized scrambling)."""
+    ks = _KEYSTREAM_CACHE.get(seed)
+    if ks is None:
+        out = np.empty(127, dtype=np.uint8)
+        state = seed & 0x7F
+        for i in range(127):
+            fb = ((state >> 6) ^ (state >> 3)) & 1
+            out[i] = fb
+            state = ((state << 1) | fb) & 0x7F
+        ks = out
+        _KEYSTREAM_CACHE[seed] = ks
+    return ks
+
+
 def scramble(bits: np.ndarray, seed: int = 0b1011101) -> np.ndarray:
-    """Self-synchronizing scrambler x^7 + x^4 + 1 (Clause 17.3.5.5)."""
-    out = np.empty_like(bits)
-    state = seed & 0x7F
-    for i, b in enumerate(bits):
-        fb = ((state >> 6) ^ (state >> 3)) & 1
-        out[i] = b ^ fb
-        state = ((state << 1) | fb) & 0x7F
-    return out
+    """Additive scrambler x^7 + x^4 + 1 (Clause 17.3.5.5), keystream-vectorized."""
+    ks = _keystream(seed)
+    reps = -(-len(bits) // 127)
+    return (bits ^ np.tile(ks, reps)[:len(bits)]).astype(np.uint8)
 
 
 def descramble(bits: np.ndarray, seed: int = 0b1011101) -> np.ndarray:
@@ -112,39 +127,54 @@ def deinterleave(vals: np.ndarray, n_cbps: int, n_bpsc: int) -> np.ndarray:
     return out
 
 
-def viterbi_decode(llrs: np.ndarray, n_bits: int) -> np.ndarray:
-    """Soft-decision Viterbi over the rate-1/2 mother code, vectorized over 64 states.
-
-    ``llrs``: soft values for coded bits (positive ⇒ bit 1), length ≥ 2·n_bits.
-    Terminated trellis (encoder assumed flushed with ≥6 tail zeros within n_bits).
-    """
-    n_steps = min(len(llrs) // 2, n_bits)
-    lam = llrs[:2 * n_steps].reshape(n_steps, 2).astype(np.float64)
-
-    # branch metric for (state, input): out0*l0 + out1*l1 with outputs in ±1
-    o0 = _OUT0.astype(np.float64) * 2 - 1     # [64, 2]
-    o1 = _OUT1.astype(np.float64) * 2 - 1
-    metrics = np.full(_NSTATES, -1e18)
-    metrics[0] = 0.0
-    decisions = np.empty((n_steps, _NSTATES), dtype=np.uint8)
-    src = np.empty((n_steps, _NSTATES), dtype=np.int64)
-
-    # predecessor table: for next-state t, the two (prev_state, input) candidates
+# predecessor tables: for next-state t, the two (prev_state, input) candidates, plus
+# the corresponding ±1 branch outputs — shared by the numpy and lax.scan decoders
+def _build_prev_tables():
     prev_tbl = [[] for _ in range(_NSTATES)]
     for s in range(_NSTATES):
         for b in range(2):
             prev_tbl[_NEXT[s, b]].append((s, b))
     prev_s = np.array([[p[0][0], p[1][0]] for p in prev_tbl])   # [64, 2]
     prev_b = np.array([[p[0][1], p[1][1]] for p in prev_tbl])   # [64, 2]
-    bm_o0 = o0[prev_s, prev_b]     # [64, 2] branch output bit0 (±1)
-    bm_o1 = o1[prev_s, prev_b]
+    o0 = _OUT0.astype(np.float64) * 2 - 1
+    o1 = _OUT1.astype(np.float64) * 2 - 1
+    return prev_s, prev_b, o0[prev_s, prev_b], o1[prev_s, prev_b]
 
+
+_PREV_S, _PREV_B, _BM0, _BM1 = _build_prev_tables()
+
+#: decode via the jitted lax.scan ACS (ops/viterbi.py) above this step count;
+#: short frames stay on the numpy path (jit dispatch overhead dominates them)
+_SCAN_THRESHOLD = 512
+
+
+def viterbi_decode(llrs: np.ndarray, n_bits: int) -> np.ndarray:
+    """Soft-decision Viterbi over the rate-1/2 mother code, vectorized over 64 states.
+
+    ``llrs``: soft values for coded bits (positive ⇒ bit 1), length ≥ 2·n_bits.
+    Terminated trellis (encoder assumed flushed with ≥6 tail zeros within n_bits).
+    Long frames run the XLA scan decoder (`futuresdr_tpu.ops.viterbi`).
+    """
+    n_steps = min(len(llrs) // 2, n_bits)
+    if n_steps >= _SCAN_THRESHOLD:
+        try:
+            from ...ops.viterbi import backend_ready, scan_viterbi
+            if backend_ready():
+                return scan_viterbi(np.asarray(llrs, np.float32), n_bits,
+                                    _PREV_S, _PREV_B, _BM0, _BM1)
+        except Exception:   # pragma: no cover - jax unavailable/backend issues
+            pass
+    lam = llrs[:2 * n_steps].reshape(n_steps, 2).astype(np.float64)
+    metrics = np.full(_NSTATES, -1e18)
+    metrics[0] = 0.0
+    decisions = np.empty((n_steps, _NSTATES), dtype=np.uint8)
+    src = np.empty((n_steps, _NSTATES), dtype=np.int64)
     for t in range(n_steps):
-        cand = metrics[prev_s] + bm_o0 * lam[t, 0] + bm_o1 * lam[t, 1]   # [64, 2]
+        cand = metrics[_PREV_S] + _BM0 * lam[t, 0] + _BM1 * lam[t, 1]   # [64, 2]
         choice = np.argmax(cand, axis=1)
         metrics = cand[np.arange(_NSTATES), choice]
-        src[t] = prev_s[np.arange(_NSTATES), choice]
-        decisions[t] = prev_b[np.arange(_NSTATES), choice]
+        src[t] = _PREV_S[np.arange(_NSTATES), choice]
+        decisions[t] = _PREV_B[np.arange(_NSTATES), choice]
 
     # traceback from state 0 (the tail bits flush the trellis to state 0)
     state = 0

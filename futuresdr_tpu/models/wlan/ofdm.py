@@ -95,22 +95,19 @@ def detect_packets(samples: np.ndarray, threshold: float = 0.56,
     # suppress noise-only windows: the ratio is meaningless where there is no power
     floor = 1e-4 * float(p.max()) if len(p) else 0.0
     above = (metric > threshold) & (p > floor)
-    # find rising edges with a sustained run; only a QUALIFYING run consumes the
-    # preamble span — short spurious crossings must not eat into a following plateau
+    # vectorized run-length extraction; only a QUALIFYING run consumes the preamble
+    # span, so short spurious crossings never eat into a following plateau
+    padded = np.concatenate([[False], above, [False]])
+    d = np.diff(padded.astype(np.int8))
+    run_starts = np.flatnonzero(d == 1)
+    run_ends = np.flatnonzero(d == -1)
     starts = []
-    i = 0
-    while i < len(above):
-        if above[i]:
-            j = i
-            while j < len(above) and above[j]:
-                j += 1
-            if j - i >= min_run:
-                starts.append(i)
-                i = j + 160
-            else:
-                i = j + 1
-        else:
-            i += 1
+    skip_until = -1
+    for s, e in zip(run_starts, run_ends):
+        s = max(int(s), skip_until)     # a run extending past a skip window still counts
+        if e - s >= min_run:
+            starts.append(s)
+            skip_until = int(e) + 160
     return starts
 
 
@@ -139,6 +136,8 @@ def sync_long(samples: np.ndarray, search_start: int, search_len: int = 320 + 80
     # CFO from phase drift between the two long symbols
     a = seg[first:first + 64]
     b = seg[second:second + 64]
+    if len(a) < 64 or len(b) < 64:
+        return None                    # truncated at the stream edge
     cfo = np.angle(np.vdot(a, b)) / 64.0
     data_start = search_start + second + 64
     lts_start = search_start + first

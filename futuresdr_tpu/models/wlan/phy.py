@@ -101,12 +101,14 @@ class DecodedFrame:
 def decode_frame(samples: np.ndarray, lts_start: int, cfo: float = 0.0,
                  scrambler_seed: Optional[int] = None) -> Optional[DecodedFrame]:
     """Decode one frame given LTS timing (`frame_equalizer.rs` + `decoder` roles)."""
+    data_start = lts_start + 128
+    if data_start + SYM_LEN > len(samples):
+        return None                      # frame truncated at the stream edge
     if cfo != 0.0:
         n = np.arange(len(samples) - lts_start)
         samples = samples.copy()
         samples[lts_start:] = samples[lts_start:] * np.exp(-1j * cfo * n)
     H = ofdm.estimate_channel(samples, lts_start)
-    data_start = lts_start + 128
 
     # SIGNAL
     spec = ofdm.ofdm_demodulate_symbols(samples[data_start:], 1)

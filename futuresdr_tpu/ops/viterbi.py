@@ -1,0 +1,83 @@
+"""Viterbi add-compare-select as a jitted lax.scan — the TPU/XLA decode path.
+
+The reference decodes Viterbi in a scalar Rust loop (``examples/wlan/src/
+viterbi_decoder.rs``); here the per-step ACS is vectorized over all trellis states and the
+time recursion is a ``lax.scan``, jit-compiled once per (n_states, bucket-length) and
+reused — frame lengths are padded up to power-of-two buckets. Traceback stays on host
+(cheap, sequential).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Tuple
+
+import numpy as np
+
+__all__ = ["scan_viterbi", "backend_ready"]
+
+
+def backend_ready() -> bool:
+    """True iff a jax backend is ALREADY initialized in this process. Callers that have
+    a numpy fallback use this to avoid triggering device discovery (which can block for
+    minutes when the axon TPU tunnel is wedged) from a pure-CPU code path."""
+    try:
+        from jax._src import xla_bridge
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+@lru_cache(maxsize=None)
+def _compiled(n_states: int, bucket: int, tables_key):
+    import jax
+    import jax.numpy as jnp
+
+    prev_s, prev_b, bm0, bm1 = [np.asarray(t) for t in tables_key_store[tables_key]]
+    ps = jnp.asarray(prev_s)
+    b0 = jnp.asarray(bm0)
+    b1 = jnp.asarray(bm1)
+
+    def step(metrics, lam):
+        cand = metrics[ps] + b0 * lam[0] + b1 * lam[1]       # [S, 2]
+        pick = jnp.argmax(cand, axis=1)
+        new = jnp.take_along_axis(cand, pick[:, None], axis=1)[:, 0]
+        return new, pick.astype(jnp.uint8)
+
+    @jax.jit
+    def run(lams):                                            # [bucket, 2]
+        init = jnp.full((n_states,), -1e18).at[0].set(0.0)
+        _, picks = jax.lax.scan(step, init, lams)
+        return picks                                          # [bucket, S]
+
+    return run
+
+
+tables_key_store: dict = {}
+
+
+def scan_viterbi(llrs: np.ndarray, n_bits: int, prev_s: np.ndarray, prev_b: np.ndarray,
+                 bm0: np.ndarray, bm1: np.ndarray) -> np.ndarray:
+    """Decode ``n_bits`` from soft ``llrs`` (2 per step) given trellis tables.
+
+    ``prev_s/prev_b``: [S, 2] predecessor state/input per next-state; ``bm0/bm1``: the
+    corresponding branch output bits in ±1. Terminated trellis (traceback from state 0).
+    """
+    n_states = prev_s.shape[0]
+    n_steps = min(len(llrs) // 2, n_bits)
+    lam = np.zeros((max(8, 1 << int(np.ceil(np.log2(max(n_steps, 1))))), 2),
+                   dtype=np.float32)
+    lam[:n_steps] = llrs[:2 * n_steps].reshape(n_steps, 2)
+    key = (n_states, prev_s.tobytes(), prev_b.tobytes(), bm0.tobytes(), bm1.tobytes())
+    hkey = hash(key)
+    tables_key_store.setdefault(hkey, (prev_s, prev_b, bm0, bm1))
+    run = _compiled(n_states, lam.shape[0], hkey)
+    picks = np.asarray(run(lam))                              # [bucket, S]
+    # traceback over the real steps only (padding never enters)
+    state = 0
+    out = np.empty(n_steps, dtype=np.uint8)
+    for t in range(n_steps - 1, -1, -1):
+        b = picks[t, state]
+        out[t] = prev_b[state, b]
+        state = prev_s[state, b]
+    return out[:n_bits]
