@@ -150,23 +150,33 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
 
 
 def detect_frames(samples: np.ndarray, p: LoraParams) -> List[int]:
-    """Preamble scan (`frame_sync.rs` role): slide in N/4 steps, dechirp two adjacent
-    windows, and look for matching strong bins (constant dechirped symbol = upchirp
-    train); refine timing from the bin index."""
+    """Preamble scan (`frame_sync.rs` role): dechirp ALL N/4-hop windows as one batched
+    FFT, then look for adjacent windows with matching strong bins (constant dechirped
+    symbol = upchirp train); refine timing from the bin index."""
     n = p.n
     hop = n // 4
+    limit = len(samples) - (p.n_preamble + 5) * n
+    if limit <= 0:
+        return []
+    n_probe = (limit + hop - 1) // hop + 4
+    n_probe = min(n_probe, (len(samples) - n) // hop + 1)
+    idx = np.arange(n_probe)[:, None] * hop + np.arange(n)[None, :]
+    windows = samples[idx] * _downchirp(n)[None, :]
+    spec = np.abs(np.fft.fft(windows, axis=1))                  # [n_probe, N]
+    kmax = np.argmax(spec, axis=1)
+    peak_pow = spec[np.arange(n_probe), kmax] ** 2
+    tot_pow = np.maximum((spec ** 2).sum(axis=1), 1e-12)
+    conc = peak_pow / tot_pow
+
     starts = []
     i = 0
-    limit = len(samples) - (p.n_preamble + 5) * n
-    while i < limit:
-        a = np.fft.fft(samples[i:i + n] * _downchirp(n))
-        b = np.fft.fft(samples[i + n:i + 2 * n] * _downchirp(n))
-        ka, kb = int(np.argmax(np.abs(a))), int(np.argmax(np.abs(b)))
-        pa = np.abs(a[ka]) ** 2 / max(np.sum(np.abs(a) ** 2), 1e-12)
-        pb = np.abs(b[kb]) ** 2 / max(np.sum(np.abs(b) ** 2), 1e-12)
+    while i * hop < limit and i + 4 < n_probe:
+        j = i + 4                                    # window one symbol (4 hops) later
+        ka, kb = int(kmax[i]), int(kmax[j])
+        pa, pb = conc[i], conc[j]
         if ka == kb and pa > 0.3 and pb > 0.3:
-            # inside the preamble: dechirped bin k == sample misalignment d (i = start + d)
-            start = i - ka
+            # inside the preamble: dechirped bin k == sample misalignment d (pos = start + d)
+            start = i * hop - ka
             if start < 0:
                 start += n
             # validate: two data symbols can match by chance; a real preamble shows a
@@ -182,11 +192,11 @@ def detect_frames(samples: np.ndarray, p: LoraParams) -> List[int]:
                     ok += 1
             if ok >= 3:
                 starts.append(start)
-                i = start + (p.n_preamble + 5) * n    # skip past this frame's start
+                i = (start + (p.n_preamble + 5) * n + hop - 1) // hop  # skip the frame head
             else:
-                i += hop
+                i += 1
         else:
-            i += hop
+            i += 1
     return starts
 
 

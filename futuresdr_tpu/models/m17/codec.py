@@ -143,10 +143,7 @@ def conv_encode_m17(bits: np.ndarray) -> np.ndarray:
     return out
 
 
-def viterbi_decode_m17(llrs: np.ndarray, n_bits: int) -> np.ndarray:
-    """Soft Viterbi over the K=5 code, vectorized over 16 states."""
-    n_steps = min(len(llrs) // 2, n_bits)
-    lam = llrs[:2 * n_steps].reshape(n_steps, 2).astype(np.float64)
+def _m17_prev_tables():
     prev_tbl = [[] for _ in range(_NS)]
     for s in range(_NS):
         for b in range(2):
@@ -154,8 +151,26 @@ def viterbi_decode_m17(llrs: np.ndarray, n_bits: int) -> np.ndarray:
     prev_s = np.array([[p[0][0], p[1][0]] for p in prev_tbl])
     prev_b = np.array([[p[0][1], p[1][1]] for p in prev_tbl])
     o = _OUT.astype(np.float64) * 2 - 1
-    bm0 = o[prev_s, prev_b, 0]
-    bm1 = o[prev_s, prev_b, 1]
+    return prev_s, prev_b, o[prev_s, prev_b, 0], o[prev_s, prev_b, 1]
+
+
+_M17_PREV = _m17_prev_tables()
+
+
+def viterbi_decode_m17(llrs: np.ndarray, n_bits: int) -> np.ndarray:
+    """Soft Viterbi over the K=5 code, vectorized over 16 states (XLA scan path for
+    long frames, as the WLAN decoder)."""
+    n_steps = min(len(llrs) // 2, n_bits)
+    prev_s, prev_b, bm0, bm1 = _M17_PREV
+    if n_steps >= 512:
+        try:
+            from ...ops.viterbi import backend_ready, scan_viterbi
+            if backend_ready():
+                return scan_viterbi(np.asarray(llrs, np.float32), n_bits,
+                                    prev_s, prev_b, bm0, bm1)
+        except Exception:   # pragma: no cover
+            pass
+    lam = llrs[:2 * n_steps].reshape(n_steps, 2).astype(np.float64)
     metrics = np.full(_NS, -1e18)
     metrics[0] = 0.0
     src = np.empty((n_steps, _NS), dtype=np.int64)

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""perf/lora — LoRa RX throughput: frames decoded / s and samples / s.
+
+Reference role: the LoRa example's RX chain throughput (dechirp + FFT peak-detect,
+`examples/lora/src/{frame_sync,fft_demod}.rs`).
+CSV: ``run,sf,cr,n_frames,decoded,elapsed_secs,frames_per_sec,msamples_per_sec``.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu.models.lora import (LoraParams, modulate_frame, detect_frames,
+                                       demodulate_frame)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--frames", type=int, default=100)
+    p.add_argument("--sf", type=int, default=7)
+    p.add_argument("--cr", type=int, default=2)
+    a = p.parse_args()
+
+    params = LoraParams(sf=a.sf, cr=a.cr)
+    rng = np.random.default_rng(0)
+    parts = []
+    for i in range(a.frames):
+        payload = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        parts += [modulate_frame(payload, params),
+                  np.zeros(4 * params.n, np.complex64)]
+    sig = np.concatenate(parts)
+    sig = (sig + 0.05 * (rng.standard_normal(len(sig))
+                         + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+
+    print("run,sf,cr,n_frames,decoded,elapsed_secs,frames_per_sec,msamples_per_sec")
+    for r in range(a.runs):
+        t0 = time.perf_counter()
+        decoded = 0
+        for s in detect_frames(sig, params):
+            res = demodulate_frame(sig, s, params)
+            if res is not None and res[1]:
+                decoded += 1
+        dt = time.perf_counter() - t0
+        print(f"{r},{a.sf},{a.cr},{a.frames},{decoded},{dt:.3f},"
+              f"{decoded / dt:.1f},{len(sig) / dt / 1e6:.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
