@@ -164,6 +164,39 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> S
     return Stage(fn, init_carry, Fraction(1, decim), None, multiple, name)
 
 
+def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,
+                   name: str = "resample") -> Stage:
+    """Rational I/D resampler as a fused stage: zero-stuff ×I → overlap-save lowpass
+    (gain I, cutoff 0.5/max(I,D)) → keep every D-th. The TPU counterpart of
+    ``PolyphaseResamplingFir`` — at frame sizes the stuffed FFT filter is MXU/VPU work,
+    and XLA folds the zero-stuffing into the gather."""
+    from math import gcd
+
+    g = gcd(int(interp), int(decim))
+    I, D = int(interp) // g, int(decim) // g
+    if taps is None:
+        from ..dsp import firdes
+        r = max(I, D)
+        taps = firdes.kaiser_lowpass(0.5 / r * 0.8, 0.1 / r) * I
+    inner = fir_stage(taps, decim=1, fft_len=fft_len, name=f"{name}_fir")
+    L = inner.frame_multiple                       # hop of the overlap-save core
+
+    def fn(carry, x):
+        n = x.shape[0]
+        up = jnp.zeros(n * I, dtype=x.dtype).at[::I].set(x)
+        carry, y = inner.fn(carry, up)
+        if D > 1:
+            y = y[::D]
+        return carry, y
+
+    def init_carry(dtype):
+        return inner.init_carry(dtype)
+
+    # frame n must satisfy: n·I divisible by the OS hop L and by D
+    mult = int(np.lcm(L // np.gcd(I, L), D // np.gcd(I, D)))
+    return Stage(fn, init_carry, Fraction(I, D), None, mult, name)
+
+
 def decimate_stage(decim: int) -> Stage:
     def fn(carry, x):
         return carry, x[::decim]
