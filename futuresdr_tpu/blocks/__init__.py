@@ -19,7 +19,7 @@ from .io import (FileSource, FileSink, TcpSource, TcpSink, UdpSource, BlobToUdp,
 from .websocket import WebsocketSink, WebsocketPmtSink
 from .zeromq import PubSink, SubSource
 from .seify import SeifySource, SeifySink, SeifyBuilder
-from .audio import WavSource, WavSink, AudioSink
+from .audio import WavSource, WavSink, AudioSink, AudioSource
 
 __all__ = [
     "Apply", "Combine", "Filter", "Split", "Source", "FiniteSource", "Sink",
@@ -37,5 +37,5 @@ __all__ = [
     "WebsocketSink", "WebsocketPmtSink",
     "PubSink", "SubSource",
     "SeifySource", "SeifySink", "SeifyBuilder",
-    "WavSource", "WavSink", "AudioSink",
+    "WavSource", "WavSink", "AudioSink", "AudioSource",
 ]

@@ -96,6 +96,53 @@ class WavSink(Kernel):
             io.finished = True
 
 
+class AudioSource(Kernel):
+    """Soundcard capture (cpal `AudioSource` role); silence when no backend."""
+
+    BLOCKING = True
+
+    def __init__(self, sample_rate: int, n_channels: int = 1):
+        super().__init__()
+        self.sample_rate = int(sample_rate)
+        self.n_channels = n_channels
+        self._stream = None
+        self.output = self.add_stream_output("out", np.float32)
+
+    async def init(self, mio, meta):
+        try:
+            import sounddevice as sd
+            self._stream = sd.InputStream(
+                samplerate=self.sample_rate, channels=self.n_channels, dtype="float32")
+            self._stream.start()
+        except Exception as e:
+            log.warning("no audio backend (%r): AudioSource emits silence", e)
+            self._stream = None
+
+    async def deinit(self, mio, meta):
+        if self._stream is not None:
+            self._stream.stop()
+            self._stream.close()
+
+    async def work(self, io, mio, meta):
+        import asyncio
+        out = self.output.slice()
+        want = (len(out) // self.n_channels)
+        if want == 0:
+            return
+        if self._stream is not None:
+            frames, _ = self._stream.read(min(want, 4096))
+            data = frames.reshape(-1)
+        else:
+            # silence at roughly real-time pace
+            n = min(want, self.sample_rate // 20)
+            data = np.zeros(n * self.n_channels, np.float32)
+            io.block_on(asyncio.sleep(n / self.sample_rate))
+        out[:len(data)] = data
+        self.output.produce(len(data))
+        if self._stream is not None:
+            io.call_again = True
+
+
 class AudioSink(Kernel):
     """Soundcard playback (cpal `AudioSink` role); degrades to drop-with-warning when no
     audio backend is present."""

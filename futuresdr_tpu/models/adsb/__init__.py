@@ -6,10 +6,11 @@ import numpy as np
 from .phy import modulate_frame, detect_and_demodulate
 from .decoder import (crc24, decode_frame, AdsbMessage, Tracker, Aircraft,
                       cpr_global_decode)
+from .blocks import AdsbReceiver
 
 __all__ = ["modulate_frame", "detect_and_demodulate", "crc24", "decode_frame",
            "AdsbMessage", "Tracker", "Aircraft", "cpr_global_decode",
-           "build_df17_frame"]
+           "build_df17_frame", "AdsbReceiver"]
 
 
 def build_df17_frame(icao: int, me_bits: np.ndarray) -> np.ndarray:

@@ -2,6 +2,7 @@
 
 from .phy import (CHIP_SEQUENCES, modulate_frame, demodulate_stream, mac_frame,
                   mac_deframe, crc16_802154)
+from .blocks import ZigbeeTransmitter, ZigbeeReceiver
 
 __all__ = ["CHIP_SEQUENCES", "modulate_frame", "demodulate_stream", "mac_frame",
-           "mac_deframe", "crc16_802154"]
+           "mac_deframe", "crc16_802154", "ZigbeeTransmitter", "ZigbeeReceiver"]

@@ -1,0 +1,99 @@
+"""Streaming ZigBee blocks (reference `examples/zigbee` chain: modulator |
+ClockRecoveryMm → Demodulator → Mac)."""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+import numpy as np
+
+from ...runtime.kernel import Kernel, message_handler
+from ...types import Pmt
+from .phy import SAMPLES_PER_CHIP, demodulate_stream, mac_deframe, mac_frame, modulate_frame
+
+__all__ = ["ZigbeeTransmitter", "ZigbeeReceiver"]
+
+
+class ZigbeeTransmitter(Kernel):
+    """Message port ``tx`` (Blob payload) → O-QPSK baseband stream."""
+
+    def __init__(self, gap_samples: int = 2000):
+        super().__init__()
+        self.gap = gap_samples
+        self._pending: Deque[np.ndarray] = deque()
+        self._current: Optional[np.ndarray] = None
+        self._eos = False
+        self._seq = 0
+        self.output = self.add_stream_output("out", np.complex64)
+
+    @message_handler(name="tx")
+    async def tx_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        if p.is_finished():
+            self._eos = True
+            io.call_again = True
+            return Pmt.ok()
+        try:
+            payload = p.to_blob()
+        except Exception:
+            return Pmt.invalid_value()
+        psdu = mac_frame(payload, self._seq)
+        self._seq = (self._seq + 1) & 0xFF
+        burst = np.concatenate([modulate_frame(psdu),
+                                np.zeros(self.gap, np.complex64)])
+        self._pending.append(burst)
+        io.call_again = True
+        return Pmt.ok()
+
+    async def work(self, io, mio, meta):
+        out = self.output.slice()
+        produced = 0
+        while produced < len(out):
+            if self._current is None:
+                if not self._pending:
+                    break
+                self._current = self._pending.popleft()
+            k = min(len(out) - produced, len(self._current))
+            out[produced:produced + k] = self._current[:k]
+            produced += k
+            self._current = self._current[k:] if k < len(self._current) else None
+        if produced:
+            self.output.produce(produced)
+        if self._eos and self._current is None and not self._pending:
+            io.finished = True
+        elif produced and (self._current is not None or self._pending):
+            io.call_again = True
+
+
+class ZigbeeReceiver(Kernel):
+    """Baseband stream → validated payloads on ``rx``."""
+
+    def __init__(self, chunk: int = 1 << 15):
+        super().__init__()
+        self.OVERLAP = 160 * 8 * SAMPLES_PER_CHIP
+        self.frames = []
+        self._tail = np.zeros(0, np.complex64)
+        self._seen_payloads: Deque[bytes] = deque(maxlen=16)
+        self.input = self.add_stream_input("in", np.complex64, min_items=1024)
+        self.add_message_output("rx")
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        n = len(inp)
+        if n == 0:
+            if self.input.finished():
+                io.finished = True
+            return
+        buf = np.concatenate([self._tail, inp[:n]])
+        for psdu in demodulate_stream(buf):
+            payload = mac_deframe(psdu)
+            if payload is None or psdu in self._seen_payloads:
+                continue
+            self._seen_payloads.append(psdu)
+            self.frames.append(payload)
+            mio.post("rx", Pmt.blob(payload))
+        keep = min(len(buf), self.OVERLAP)
+        self._tail = buf[len(buf) - keep:].copy()
+        self.input.consume(n)
+        if self.input.finished() and self.input.available() == 0:
+            io.finished = True
