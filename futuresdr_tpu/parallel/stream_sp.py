@@ -24,7 +24,7 @@ try:
 except ImportError:                    # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-__all__ = ["sp_fir", "sp_fir_fft_mag2", "sp_channelizer"]
+__all__ = ["sp_fir", "sp_fir_fft_mag2", "sp_channelizer", "sp_channelizer_a2a"]
 
 
 def _halo_from_left(local: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarray:
@@ -107,3 +107,44 @@ def sp_channelizer(n_channels: int, taps: np.ndarray, mesh: Mesh,
 
     return shard_map(local, mesh=mesh, in_specs=P(axis),
                      out_specs=P(None, axis))
+
+
+def sp_channelizer_a2a(n_channels: int, taps: np.ndarray, mesh: Mesh,
+                       axis: str = "sp") -> Callable:
+    """All-to-all (Ulysses-style) sequence parallelism for the channelizer: input is
+    time-sharded; each device channelizes its own time shard locally (halo from the left
+    neighbour), then one ``all_to_all`` over ICI re-shards from time-split to
+    CHANNEL-split — output [n_channels/n_dev local channels, full time] per device,
+    i.e. [n_channels, n/N] sharded over the channel axis.
+
+    Complements :func:`sp_channelizer` (which keeps time sharding): choose a2a when the
+    downstream consumer is per-channel (demodulators, per-channel decoders), so each
+    device owns whole channels and no further collectives are needed.
+    """
+    N = n_channels
+    n_dev = mesh.shape[axis]
+    assert N % n_dev == 0, "n_channels must divide the mesh axis"
+    taps = np.asarray(taps, dtype=np.float32)
+    K = -(-len(taps) // N)
+    padded = np.zeros(K * N, dtype=np.float32)
+    padded[:len(taps)] = taps
+    branch = jnp.asarray(padded.reshape(K, N).T)          # [N, K]
+
+    def local(x_local):
+        halo = (K - 1) * N
+        ext = _halo_from_left(x_local, halo, axis)
+        blocks = ext.reshape(-1, N)[:, ::-1].T            # [N, S + K-1]
+
+        def one_branch(u, h):
+            return jnp.convolve(u, h[::-1], mode="valid", precision="highest")
+
+        v = jax.vmap(one_branch)(blocks, branch)          # [N, S_local]
+        y = (jnp.fft.ifft(v, axis=0) * N).astype(jnp.complex64)
+        # re-shard: split channel axis into n_dev groups, swap with the time axis
+        y = y.reshape(n_dev, N // n_dev, -1)              # [n_dev, N/n_dev, S_local]
+        g = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=1, tiled=False)
+        # g: [N/n_dev, n_dev, S_local] — device-major time; flatten to full time
+        return g.reshape(N // n_dev, -1)
+
+    return shard_map(local, mesh=mesh, in_specs=P(axis),
+                     out_specs=P(axis, None))
