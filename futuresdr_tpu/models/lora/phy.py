@@ -210,23 +210,42 @@ def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams):
     # the detector's start can be off by ±a few samples (noise) or a whole symbol
     # (probe straddling the frame edge): skip leading unaligned symbols and fold out
     # small bin offsets before walking the train
+    def bin_conc(q: int):
+        spec = np.abs(np.fft.fft(samples[q:q + n] * down))
+        k = int(np.argmax(spec))
+        conc = spec[k] ** 2 / max(np.sum(spec ** 2), 1e-12)
+        return k, conc
+
+    def verified_upchirp(q: int) -> bool:
+        """Aligned preamble chirp: bin 0 with concentrated energy, confirmed on the
+        following chirp too (noise windows pass a single check ~1/128 of the time)."""
+        if q < 0 or q + 2 * n > len(samples):
+            return False
+        k1, c1 = bin_conc(q)
+        if k1 != 0 or c1 < 0.15:
+            return False
+        k2, c2 = bin_conc(q + n)
+        return k2 == 0 and c2 > 0.15
+
     aligned = False
     for skip in range(3):
         q = pos + skip * n
         if q + n > len(samples):
             break
-        k = int(np.argmax(np.abs(np.fft.fft(samples[q:q + n] * down))))
-        if k == 0:
-            pos = q
-            aligned = True
-            break
-        if 0 < k <= 4 and q - k >= 0:
-            pos = q - k
-            aligned = True
-            break
+        k, conc = bin_conc(q)
+        cands = []
+        if k == 0 and conc > 0.15:
+            cands.append(q)
+        if 0 < k <= 4:
+            cands.append(q - k)
         if n - 4 <= k < n:
-            pos = q + (n - k)
-            aligned = True
+            cands.append(q + (n - k))
+        for c in cands:
+            if verified_upchirp(c):
+                pos = c
+                aligned = True
+                break
+        if aligned:
             break
     if not aligned:
         return None
