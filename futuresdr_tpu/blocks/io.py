@@ -187,12 +187,14 @@ class TcpSink(Kernel):
 
 
 class _UdpProto(asyncio.DatagramProtocol):
-    def __init__(self, queue: asyncio.Queue):
+    def __init__(self, queue: asyncio.Queue, event: asyncio.Event):
         self.queue = queue
+        self.event = event
 
     def datagram_received(self, data, addr):
         try:
             self.queue.put_nowait(data)
+            self.event.set()
         except asyncio.QueueFull:
             pass  # drop on overrun, like a real radio
 
@@ -204,6 +206,7 @@ class UdpSource(Kernel):
         super().__init__()
         self.bind, self.port = bind, port
         self._queue: asyncio.Queue = None
+        self._event: asyncio.Event = None
         self._transport = None
         self._tail = b""
         self._qsize = queue_size
@@ -211,27 +214,37 @@ class UdpSource(Kernel):
 
     async def init(self, mio, meta):
         self._queue = asyncio.Queue(self._qsize)
+        self._event = asyncio.Event()
         loop = asyncio.get_running_loop()
         self._transport, _ = await loop.create_datagram_endpoint(
-            lambda: _UdpProto(self._queue), local_addr=(self.bind, self.port))
+            lambda: _UdpProto(self._queue, self._event),
+            local_addr=(self.bind, self.port))
 
     async def deinit(self, mio, meta):
         if self._transport:
             self._transport.close()
 
     async def work(self, io, mio, meta):
+        # never await the socket inside work (it would starve Terminate handling);
+        # drain what's there and park on the arrival event via block_on
         out = self.output.slice()
         if len(out) == 0:
             return
-        data = await self._queue.get()
-        buf = self._tail + data
+        self._event.clear()
+        produced = 0
+        buf = self._tail
+        while not self._queue.empty():
+            buf += self._queue.get_nowait()
         itemsize = self.output.dtype.itemsize
         k = min(len(buf) // itemsize, len(out))
         if k:
             out[:k] = np.frombuffer(buf[:k * itemsize], dtype=self.output.dtype)
             self.output.produce(k)
         self._tail = buf[k * itemsize:]
-        io.call_again = True
+        if not self._queue.empty():
+            io.call_again = True
+        else:
+            io.block_on(self._event.wait())
 
 
 class BlobToUdp(Kernel):
