@@ -14,7 +14,7 @@ from typing import Optional
 import numpy as np
 
 from ..log import logger
-from ..runtime.kernel import Kernel
+from ..runtime.kernel import Kernel, message_handler
 from ..types import Pmt
 
 __all__ = ["FileSource", "FileSink", "TcpSource", "TcpSink", "UdpSource", "BlobToUdp",
@@ -264,9 +264,7 @@ class BlobToUdp(Kernel):
         if self._transport:
             self._transport.close()
 
-    from ..runtime.kernel import message_handler as _mh
-
-    @_mh(name="in")
+    @message_handler(name="in")
     async def in_handler(self, io, mio, meta, p: Pmt) -> Pmt:
         if p.is_finished():
             io.finished = True

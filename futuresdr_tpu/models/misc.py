@@ -6,7 +6,7 @@ recording), ``examples/keyfob`` (rolling-code OOK transmitter).
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 

@@ -79,7 +79,7 @@ def train(n_steps: int = 200, batch: int = 64, n: int = 128, seed: int = 0,
     import jax
     import optax
 
-    from .mcldnn import MCLDNN, init_params, make_train_step, loss_fn
+    from .mcldnn import MCLDNN, init_params, make_train_step
 
     model = model or MCLDNN(n_classes=len(CLASSES))
     params = init_params(model, n=n, seed=seed)

@@ -16,7 +16,6 @@ import numpy as np
 from ...runtime.kernel import Kernel, message_handler
 from ...types import Pmt
 from . import phy
-from .consts import SYM_LEN
 from .mac import Mac
 
 __all__ = ["WlanEncoder", "WlanDecoder"]

@@ -8,8 +8,6 @@ fused program, where the reference processes symbol-by-symbol per block.
 
 from __future__ import annotations
 
-from typing import Tuple
-
 import numpy as np
 
 from .consts import (CP_LEN, DATA_CARRIERS, FFT_SIZE, LTS_FREQ, MODULATION_TABLES,

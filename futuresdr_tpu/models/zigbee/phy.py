@@ -8,7 +8,7 @@ discriminator → clock recovery → chip correlation. Frame-level and vectorize
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 

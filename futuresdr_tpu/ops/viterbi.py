@@ -10,7 +10,6 @@ reused — frame lengths are padded up to power-of-two buckets. Traceback stays 
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import Tuple
 
 import numpy as np
 

@@ -11,8 +11,8 @@ registered with ``add_message_input`` or marked with the :func:`message_handler`
 from __future__ import annotations
 
 import inspect
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
 
 from ..types import Pmt, PortId
 from .buffer import StreamInput, StreamOutput

@@ -10,7 +10,7 @@ against NumPy/SciPy references (SURVEY §4).
 from __future__ import annotations
 
 import asyncio
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 

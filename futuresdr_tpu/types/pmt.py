@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import base64
 import enum
-from typing import Any, Callable, Mapping
+from typing import Any, Mapping
 
 import numpy as np
 
