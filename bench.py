@@ -80,6 +80,8 @@ def run_cpu(n_samples: int) -> float:
 
 def run_tpu(n_samples: int, frame_size: int = 1 << 20, depth: int = 4) -> float:
     """TPU path: same chain fused into one XLA program."""
+    from futuresdr_tpu.config import config
+    config().buffer_size = max(config().buffer_size, 4 * frame_size * 8)
     taps = firdes.lowpass(0.2, N_TAPS).astype(np.float32)
     fg = Flowgraph()
     src = NullSource(np.complex64)
@@ -101,11 +103,21 @@ def main():
     p.add_argument("--cpu-samples", type=int, default=20_000_000)
     p.add_argument("--tpu-samples", type=int, default=200_000_000)
     p.add_argument("--frame", type=int, default=1 << 20)
+    p.add_argument("--depth", type=int, default=4)
+    p.add_argument("--autotune", action="store_true",
+                   help="sweep frame/depth and bench the best combination")
     args = p.parse_args()
 
     inst = instance()
+    frame, depth = args.frame, args.depth
+    if args.autotune:
+        from futuresdr_tpu.tpu import autotune
+        taps = firdes.lowpass(0.2, N_TAPS).astype(np.float32)
+        frame, depth, grid = autotune(
+            [fir_stage(taps), fft_stage(FFT_SIZE), mag2_stage()], np.complex64)
+        print(f"# autotune grid: {grid}", file=sys.stderr)
     cpu_rate = run_cpu(args.cpu_samples)
-    tpu_rate = run_tpu(args.tpu_samples, args.frame)
+    tpu_rate = run_tpu(args.tpu_samples, frame, depth)
     result = {
         "metric": f"fir64+fft{FFT_SIZE}+mag2 throughput ({inst.platform})",
         "value": round(tpu_rate, 1),

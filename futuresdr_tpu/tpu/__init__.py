@@ -9,5 +9,7 @@ whole block chains run as single jitted XLA programs (see :mod:`futuresdr_tpu.op
 from .instance import TpuInstance, instance
 from .kernel_block import TpuKernel
 from .frames import TpuH2D, TpuStage, TpuD2H
+from .autotune import autotune
 
-__all__ = ["TpuInstance", "instance", "TpuKernel", "TpuH2D", "TpuStage", "TpuD2H"]
+__all__ = ["TpuInstance", "instance", "TpuKernel", "TpuH2D", "TpuStage", "TpuD2H",
+           "autotune"]

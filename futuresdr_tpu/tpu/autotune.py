@@ -1,0 +1,77 @@
+"""Frame-size / pipeline-depth autotuning for TPU stage pipelines.
+
+The throughput of a fused stage chain depends on frame size (dispatch amortization vs
+HBM residency) and in-flight depth (transfer/compute overlap). This sweeps a small grid
+with the real pipeline (device dispatch + host staging, as TpuKernel does) and returns
+the best configuration — run once at deploy time, feed the result to ``TpuKernel``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..log import logger
+from ..ops.stages import Pipeline, Stage
+from .instance import TpuInstance, instance
+
+__all__ = ["autotune"]
+
+log = logger("tpu.autotune")
+
+
+def _measure(pipe: Pipeline, frame: int, depth: int, inst: TpuInstance,
+             min_seconds: float) -> float:
+    """Msamples/s through the pipeline incl. H2D staging and D2H sync."""
+    fn, carry = pipe.compile(frame, device=inst.device)
+    host = np.zeros(frame, dtype=pipe.in_dtype)
+    # warmup (compile)
+    carry, y = fn(carry, inst.put(host))
+    np.asarray(y)
+    inflight = []
+    n_frames = 0
+    t0 = time.perf_counter()
+    while True:
+        carry, y = fn(carry, inst.put(host))
+        inflight.append(y)
+        n_frames += 1
+        if len(inflight) >= depth:
+            np.asarray(inflight.pop(0))
+        if n_frames % 4 == 0 and time.perf_counter() - t0 > min_seconds:
+            break
+        if n_frames > 10000:
+            break
+    for y in inflight:
+        np.asarray(y)
+    dt = time.perf_counter() - t0
+    return n_frames * frame / dt / 1e6
+
+
+def autotune(stages: Sequence[Stage], in_dtype,
+             frames: Sequence[int] = (1 << 17, 1 << 18, 1 << 19, 1 << 20),
+             depths: Sequence[int] = (2, 4, 8),
+             min_seconds: float = 0.3,
+             inst: Optional[TpuInstance] = None) -> Tuple[int, int, Dict]:
+    """Returns (best_frame, best_depth, {(frame, depth): Msps})."""
+    inst = inst or instance()
+    pipe = Pipeline(list(stages), in_dtype)
+    results: Dict[Tuple[int, int], float] = {}
+    best = (0, 0)
+    best_rate = -1.0
+    for f in frames:
+        m = pipe.frame_multiple
+        f = max(m, (f // m) * m)
+        for d in depths:
+            try:
+                rate = _measure(Pipeline(list(stages), in_dtype), f, d, inst, min_seconds)
+            except Exception as e:   # OOM at large frames, etc.
+                log.warning("autotune (%d, %d) failed: %r", f, d, e)
+                continue
+            results[(f, d)] = round(rate, 1)
+            if rate > best_rate:
+                best_rate = rate
+                best = (f, d)
+    log.info("autotune best: frame=%d depth=%d (%.1f Msps)", *best, best_rate)
+    return best[0], best[1], results
