@@ -7,11 +7,12 @@ batched for the TPU.
 """
 
 from .consts import MCS_TABLE, Mcs
-from .phy import encode_frame, decode_frame, decode_stream, DecodedFrame
+from .phy import (encode_frame, decode_frame, decode_stream, decode_stream_batch,
+                  DecodedFrame)
 from .mac import Mac, mpdu_from_payload, payload_from_mpdu
 from .blocks import WlanEncoder, WlanDecoder
 from . import coding, ofdm
 
 __all__ = ["MCS_TABLE", "Mcs", "encode_frame", "decode_frame", "decode_stream",
-           "DecodedFrame", "Mac", "mpdu_from_payload", "payload_from_mpdu",
-           "WlanEncoder", "WlanDecoder", "coding", "ofdm"]
+           "decode_stream_batch", "DecodedFrame", "Mac", "mpdu_from_payload",
+           "payload_from_mpdu", "WlanEncoder", "WlanDecoder", "coding", "ofdm"]

@@ -160,3 +160,78 @@ def decode_stream(samples: np.ndarray) -> List[DecodedFrame]:
         if frame is not None:
             out.append(frame)
     return out
+
+
+def _prepare_frame(samples: np.ndarray, lts_start: int, cfo: float):
+    """Front half of decode_frame: everything up to the DATA Viterbi. Returns
+    (mother-code llrs, n_coded_bits, mcs, length) or None."""
+    data_start = lts_start + 128
+    if data_start + SYM_LEN > len(samples):
+        return None
+    if cfo != 0.0:
+        n = np.arange(len(samples) - lts_start)
+        samples = samples.copy()
+        samples[lts_start:] = samples[lts_start:] * np.exp(-1j * cfo * n)
+    H = ofdm.estimate_channel(samples, lts_start)
+    spec = ofdm.ofdm_demodulate_symbols(samples[data_start:], 1)
+    eq = ofdm.equalize(spec, H, symbol_offset=0)
+    sig_llrs = ofdm.demap_llrs(eq.reshape(-1), "bpsk")
+    sig_bits = coding.viterbi_decode(coding.deinterleave(sig_llrs, 48, 1), 24)
+    parsed = _parse_signal(sig_bits)
+    if parsed is None:
+        return None
+    mcs, length = parsed
+    n_bits = 16 + 8 * length + 6
+    n_sym = -(-n_bits // mcs.n_dbps)
+    avail = (len(samples) - data_start - SYM_LEN) // SYM_LEN
+    if n_sym > avail:
+        return None
+    spec = ofdm.ofdm_demodulate_symbols(samples[data_start + SYM_LEN:], n_sym)
+    eq = ofdm.equalize(spec, H, symbol_offset=1)
+    llrs = ofdm.demap_llrs(eq.reshape(-1), mcs.modulation)
+    deint = coding.deinterleave(llrs, mcs.n_cbps, mcs.n_bpsc)
+    depunct = coding.depuncture(deint, mcs.coding_rate)
+    return depunct, n_sym * mcs.n_dbps, mcs, length, lts_start, cfo, n_sym
+
+
+def _finish_frame(decoded_bits: np.ndarray, mcs, length, lts_start, cfo,
+                  n_sym) -> Optional[DecodedFrame]:
+    seed = 0b1011101
+    for cand in range(1, 128):
+        if not coding.descramble(decoded_bits[:16], cand).any():
+            seed = cand
+            break
+    descrambled = coding.descramble(decoded_bits, seed)
+    psdu_bits = descrambled[16:16 + 8 * length]
+    return DecodedFrame(bits_to_bytes(psdu_bits), mcs, lts_start, cfo, n_sym)
+
+
+def decode_stream_batch(samples: np.ndarray) -> List[DecodedFrame]:
+    """Burst-batched RX: all detected frames' Viterbi runs as ONE batched lax.scan —
+    the TPU-idiomatic decoder for recordings with many frames (`perf/wlan --batch`)."""
+    preps = []
+    for start in ofdm.detect_packets(samples):
+        r = ofdm.sync_long(samples, start)
+        if r is None:
+            continue
+        _, lts_start, cfo = r
+        p = _prepare_frame(samples, lts_start, cfo)
+        if p is not None:
+            preps.append(p)
+    if not preps:
+        return []
+    try:
+        from ...ops.viterbi import backend_ready, scan_viterbi_batch
+        if not backend_ready():
+            raise RuntimeError("no jax backend")
+        from .coding import _PREV_S, _PREV_B, _BM0, _BM1
+        bits_list = scan_viterbi_batch([p[0] for p in preps], [p[1] for p in preps],
+                                       _PREV_S, _PREV_B, _BM0, _BM1)
+    except Exception:
+        bits_list = [coding.viterbi_decode(p[0], p[1]) for p in preps]
+    out = []
+    for p, bits in zip(preps, bits_list):
+        f = _finish_frame(bits, *p[2:])
+        if f is not None:
+            out.append(f)
+    return out

@@ -9,6 +9,8 @@ training for the in-flowgraph ML path.
 
 from .mesh import make_mesh, factor_devices, shard_params, P, NamedSharding
 from .stream_sp import sp_fir, sp_fir_fft_mag2, sp_channelizer, sp_channelizer_a2a
+from . import multihost
 
 __all__ = ["make_mesh", "factor_devices", "shard_params", "P", "NamedSharding",
-           "sp_fir", "sp_fir_fft_mag2", "sp_channelizer", "sp_channelizer_a2a"]
+           "sp_fir", "sp_fir_fft_mag2", "sp_channelizer", "sp_channelizer_a2a",
+           "multihost"]

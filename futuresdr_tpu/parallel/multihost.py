@@ -1,0 +1,70 @@
+"""Multi-host (DCN) initialization and mesh construction.
+
+The reference crosses hosts with explicit socket blocks and has no intra-runtime
+distribution (SURVEY §2.7). Here, multi-host scale is jax's distributed runtime: every
+host runs the same SPMD program; the global mesh spans all hosts' devices; XLA routes
+intra-host collectives over ICI and inter-host legs over DCN.
+
+Single-host CI cannot exercise real DCN; this module is the thin, documented entry:
+
+    from futuresdr_tpu.parallel import multihost
+    multihost.initialize(coordinator="10.0.0.1:9999", num_processes=4, process_id=rank)
+    mesh = multihost.global_mesh(("dp", "sp"))
+
+The stream ops in :mod:`.stream_sp` then work unchanged on the global mesh — ``ppermute``
+halo exchanges between shards on different hosts ride DCN automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["initialize", "global_mesh", "is_distributed", "local_device_count",
+           "global_device_count"]
+
+_initialized = False
+
+
+def initialize(coordinator: Optional[str] = None, num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up jax's distributed runtime (no-op when already up or single-host).
+
+    With no arguments, jax auto-detects the cluster environment (TPU pods set the
+    coordination env vars); pass explicit values for manual bring-up.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+    if coordinator is None and num_processes is None:
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            pass          # single-host / no cluster env: stay local
+    else:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _initialized = True
+
+
+def is_distributed() -> bool:
+    import jax
+    return jax.process_count() > 1
+
+
+def local_device_count() -> int:
+    import jax
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+def global_mesh(axis_names: Sequence[str], shape: Optional[Sequence[int]] = None):
+    """Mesh over ALL hosts' devices (call after :func:`initialize` on every host)."""
+    from .mesh import make_mesh
+    import jax
+    return make_mesh(axis_names, shape=shape, devices=jax.devices())

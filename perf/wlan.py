@@ -16,7 +16,7 @@ sys.path.insert(0, "..")
 
 import numpy as np
 
-from futuresdr_tpu.models.wlan import encode_frame, decode_stream, Mac
+from futuresdr_tpu.models.wlan import encode_frame, decode_stream, decode_stream_batch, Mac
 
 
 def main():
@@ -26,7 +26,12 @@ def main():
     p.add_argument("--payload", type=int, default=256)
     p.add_argument("--mcs", default="qpsk_1_2")
     p.add_argument("--snr-db", type=float, default=25.0)
+    p.add_argument("--batch", action="store_true",
+                   help="batched Viterbi (one lax.scan for all frames)")
     a = p.parse_args()
+    if a.batch:
+        import jax
+        jax.devices()   # init backend so the scan decoder engages
 
     rng = np.random.default_rng(0)
     mac = Mac()
@@ -39,10 +44,11 @@ def main():
     sig = (sig + sigma * (rng.standard_normal(len(sig))
                           + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
 
+    decode = decode_stream_batch if a.batch else decode_stream
     print("run,n_frames,payload_len,decoded,elapsed_secs,frames_per_sec,msamples_per_sec")
     for r in range(a.runs):
         t0 = time.perf_counter()
-        decoded = decode_stream(sig)
+        decoded = decode(sig)
         dt = time.perf_counter() - t0
         print(f"{r},{a.frames},{a.payload},{len(decoded)},{dt:.3f},"
               f"{len(decoded) / dt:.1f},{len(sig) / dt / 1e6:.2f}", flush=True)
