@@ -23,7 +23,7 @@ AUDIO_RATE = 48_000
 
 def build_flowgraph(source=None, *, input_rate: float = 1_000_000.0,
                     offset: float = 0.0, audio_path: Optional[str] = None,
-                    n_samples: Optional[int] = None):
+                    n_samples: Optional[int] = None, use_tpu: bool = False):
     fg = Flowgraph()
     if source is None:
         source = (SeifyBuilder().args("driver=dummy,throttle=false")
@@ -34,20 +34,41 @@ def build_flowgraph(source=None, *, input_rate: float = 1_000_000.0,
         fg.connect(last, head)
         last = head
     decim = int(input_rate // SAMPLE_RATE)
-    xlate = XlatingFir(firdes.lowpass(0.5 / decim * 0.8, 128), decim, offset, input_rate)
-    demod = QuadratureDemod(gain=SAMPLE_RATE / (2 * np.pi * 75e3))
     from math import gcd
     g = gcd(AUDIO_RATE, SAMPLE_RATE)
-    audio_resamp = Fir(firdes.kaiser_lowpass(0.4 * g / SAMPLE_RATE, 0.1 * g / SAMPLE_RATE)
-                       * (AUDIO_RATE // g),
-                       np.float32, decim=SAMPLE_RATE // g, interp=AUDIO_RATE // g)
-    fg.connect(last, xlate, demod, audio_resamp)
+    if use_tpu:
+        # whole front end (rotate → decimating FIR → FM discriminator → audio
+        # resampler) as ONE fused XLA program; retuning means rebuilding the kernel
+        from ..ops import fir_stage, quad_demod_stage, resample_stage, rotator_stage
+        from ..tpu import TpuKernel
+        stages = [
+            rotator_stage(-2 * np.pi * offset / input_rate),
+            fir_stage(firdes.lowpass(0.5 / decim * 0.8, 128).astype(np.float32),
+                      decim=decim, fft_len=4096),
+            quad_demod_stage(SAMPLE_RATE / (2 * np.pi * 75e3)),
+            resample_stage(AUDIO_RATE // g, SAMPLE_RATE // g),
+        ]
+        chain = TpuKernel(stages, np.complex64)
+        fg.connect(last, chain)
+        retune = chain         # no runtime retune on the fused path
+        out_block = chain
+    else:
+        xlate = XlatingFir(firdes.lowpass(0.5 / decim * 0.8, 128), decim, offset,
+                           input_rate)
+        demod = QuadratureDemod(gain=SAMPLE_RATE / (2 * np.pi * 75e3))
+        audio_resamp = Fir(firdes.kaiser_lowpass(0.4 * g / SAMPLE_RATE,
+                                                 0.1 * g / SAMPLE_RATE)
+                           * (AUDIO_RATE // g),
+                           np.float32, decim=SAMPLE_RATE // g, interp=AUDIO_RATE // g)
+        fg.connect(last, xlate, demod, audio_resamp)
+        retune = xlate
+        out_block = audio_resamp
     if audio_path:
         sink = WavSink(audio_path, AUDIO_RATE)
     else:
         sink = NullSink(np.float32)
-    fg.connect(audio_resamp, sink)
-    return fg, xlate, sink
+    fg.connect(out_block, sink)
+    return fg, retune, sink
 
 
 def main(argv=None):
@@ -57,10 +78,12 @@ def main(argv=None):
     p.add_argument("--freq", type=float, default=100.0e6)
     p.add_argument("--rate", type=float, default=1e6)
     p.add_argument("--wav", default=None, help="write audio to WAV instead of soundcard")
+    p.add_argument("--tpu", action="store_true", help="fused TPU front end")
     a = p.parse_args(argv)
     src = (SeifyBuilder().args(a.args).frequency(a.freq).sample_rate(a.rate)
            .build_source())
-    fg, xlate, _ = build_flowgraph(src, input_rate=a.rate, audio_path=a.wav)
+    fg, xlate, _ = build_flowgraph(src, input_rate=a.rate, audio_path=a.wav,
+                                   use_tpu=a.tpu)
     rt = Runtime()
     running = rt.start(fg)
     print("FM receiver running; type a frequency offset in Hz (or 'q'):")

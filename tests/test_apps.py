@@ -61,6 +61,30 @@ def test_fm_receiver_recovers_audio_tone(tmp_path):
     assert abs(peak - 1000.0) < 20.0
 
 
+def test_fm_receiver_tpu_fused_path(tmp_path):
+    """The whole FM front end as one fused stage chain recovers the audio tone."""
+    from futuresdr_tpu.apps.fm_receiver import build_flowgraph, AUDIO_RATE
+
+    fs = 1e6
+    n = 1_500_000
+    t = np.arange(n) / fs
+    msg = np.sin(2 * np.pi * 1000.0 * t)
+    iq = np.exp(1j * 2 * np.pi * 75e3 * np.cumsum(msg) / fs).astype(np.complex64)
+    wav = str(tmp_path / "fm_tpu.wav")
+    fg, _, sink = build_flowgraph(VectorSource(iq), input_rate=fs, audio_path=wav,
+                                  use_tpu=True)
+    Runtime().run(fg)
+    assert sink.n_written > AUDIO_RATE // 10
+    import wave
+    w = wave.open(wav, "rb")
+    pcm = np.frombuffer(w.readframes(w.getnframes()), np.int16).astype(np.float64)
+    w.close()
+    pcm = pcm[len(pcm) // 4:]
+    spec = np.abs(np.fft.rfft(pcm * np.hanning(len(pcm))))
+    peak = np.fft.rfftfreq(len(pcm), 1.0 / AUDIO_RATE)[np.argmax(spec[5:]) + 5]
+    assert abs(peak - 1000.0) < 20.0
+
+
 def test_wav_roundtrip(tmp_path):
     path = str(tmp_path / "t.wav")
     data = (0.5 * np.sin(2 * np.pi * 440 / 8000 * np.arange(8000))).astype(np.float32)
