@@ -21,5 +21,20 @@ __all__ = [
     "Pmt", "PmtKind", "config", "logger",
     "Flowgraph", "Runtime", "Kernel", "WorkIo", "Mocker", "Tag", "ItemTag",
     "message_handler", "AsyncScheduler", "ThreadedScheduler", "FlowgraphError",
-    "ConnectError", "blocks",
+    "ConnectError",
+    "blocks", "dsp", "ops", "tpu", "parallel", "models", "utils", "hw", "ctrl", "apps",
 ]
+
+_LAZY_SUBMODULES = {"blocks", "dsp", "ops", "tpu", "parallel", "models", "utils",
+                    "hw", "ctrl", "apps"}
+
+
+def __getattr__(name):
+    # lazy submodule access (`futuresdr_tpu.ops` without paying the jax/flax import
+    # cost when only the host runtime is used)
+    if name in _LAZY_SUBMODULES:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
