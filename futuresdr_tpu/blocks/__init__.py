@@ -10,7 +10,7 @@ from .vector import VectorSource, VectorSink, NullSource, NullSink, CopyRand
 from .stream import (Copy, Head, Throttle, MovingAvg, TagDebug, Delay,
                      StreamDuplicator, StreamDeinterleaver, Selector)
 from .dsp import (Fir, FirBuilder, Iir, Fft, XlatingFir, SignalSource,
-                  QuadratureDemod, Agc)
+                  QuadratureDemod, Agc, ClockRecoveryMm)
 from .pfb import PfbChannelizer, PfbSynthesizer, PfbArbResampler
 from .message import (MessageAnnotator, MessageApply, MessageBurst, MessageCopy,
                       MessagePipe, MessageSink, MessageSource)
@@ -28,7 +28,7 @@ __all__ = [
     "Copy", "Head", "Throttle", "MovingAvg", "TagDebug", "Delay",
     "StreamDuplicator", "StreamDeinterleaver", "Selector",
     "Fir", "FirBuilder", "Iir", "Fft", "XlatingFir", "SignalSource",
-    "QuadratureDemod", "Agc",
+    "QuadratureDemod", "Agc", "ClockRecoveryMm",
     "PfbChannelizer", "PfbSynthesizer", "PfbArbResampler",
     "MessageAnnotator", "MessageApply", "MessageBurst", "MessageCopy",
     "MessagePipe", "MessageSink", "MessageSource",

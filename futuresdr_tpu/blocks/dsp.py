@@ -18,7 +18,60 @@ from ..runtime.kernel import Kernel, message_handler
 from ..types import Pmt
 
 __all__ = ["Fir", "FirBuilder", "Iir", "Fft", "XlatingFir", "SignalSource",
-           "QuadratureDemod", "Agc"]
+           "QuadratureDemod", "Agc", "ClockRecoveryMm"]
+
+
+class ClockRecoveryMm(Kernel):
+    """Mueller-Müller symbol timing recovery on a real-valued waveform.
+
+    Library-block form of the ZigBee example's ``ClockRecoveryMm``
+    (``examples/zigbee/src/clock_recovery_mm.rs``): emits one sample per recovered
+    symbol; ``omega`` is the nominal samples/symbol, adapted within ``±limit``.
+    """
+
+    def __init__(self, omega: float, gain_omega: float = 0.25e-3,
+                 mu: float = 0.5, gain_mu: float = 0.03, omega_limit: float = 0.05):
+        super().__init__()
+        self.omega0 = float(omega)
+        self.omega = float(omega)
+        self.gain_omega = gain_omega
+        self.mu = mu
+        self.gain_mu = gain_mu
+        self.limit = omega_limit
+        self._last = 0.0
+        self._last_d = 0.0
+        self.input = self.add_stream_input("in", np.float32,
+                                           min_items=int(np.ceil(omega)) + 2)
+        self.output = self.add_stream_output("out", np.float32)
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        out = self.output.slice()
+        n_out = 0
+        i = 0
+        need = int(np.ceil(self.omega * (1 + self.limit))) + 2
+        while i + need < len(inp) and n_out < len(out):
+            s = inp[i] * (1 - self.mu) + inp[i + 1] * self.mu
+            d = 1.0 if s > 0 else -1.0
+            err = self._last_d * s - d * self._last
+            self._last, self._last_d = s, d
+            out[n_out] = s
+            n_out += 1
+            self.omega += self.gain_omega * err
+            self.omega = min(max(self.omega, self.omega0 * (1 - self.limit)),
+                             self.omega0 * (1 + self.limit))
+            step = self.omega + self.gain_mu * err
+            pos = i + self.mu + step
+            i = int(pos)
+            self.mu = pos - i
+        if i > 0:
+            self.input.consume(i)
+        if n_out:
+            self.output.produce(n_out)
+        if self.input.finished() and i + need >= len(inp):
+            io.finished = True
+        elif n_out and n_out == len(out):
+            io.call_again = True
 
 
 class Fir(Kernel):

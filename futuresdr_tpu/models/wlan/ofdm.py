@@ -156,14 +156,45 @@ def estimate_channel(samples: np.ndarray, lts_start: int) -> np.ndarray:
     return H
 
 
-def equalize(spectra: np.ndarray, H: np.ndarray, symbol_offset: int = 0) -> np.ndarray:
-    """Zero-forcing equalization + residual common-phase-error correction from the four
-    pilots (`frame_equalizer.rs` role). Returns [n_sym, 48] data-carrier symbols."""
+def equalize(spectra: np.ndarray, H: np.ndarray, symbol_offset: int = 0,
+             algorithm: str = "ls") -> np.ndarray:
+    """Channel equalization + residual common-phase-error correction from the four
+    pilots (`frame_equalizer.rs` role; algorithms as in gr-ieee802-11's equalizer
+    options). Returns [n_sym, 48] data-carrier symbols.
+
+    - ``ls``: zero-forcing with the LTS least-squares estimate (static channel).
+    - ``sta``: spectral-temporal averaging — the channel estimate is refined each
+      symbol from the pilot observations, smoothed across adjacent subcarriers;
+      tracks slow channel drift.
+    """
     n_sym = spectra.shape[0]
-    eq = spectra / H[None, :]
     pol = PILOT_POLARITY[(symbol_offset + np.arange(n_sym)) % len(PILOT_POLARITY)]
-    pilots = eq[:, PILOT_CARRIERS % FFT_SIZE]
     expected = PILOT_VALUES[None, :] * pol[:, None]
-    cpe = np.angle((pilots * np.conj(expected)).sum(axis=1))
-    eq = eq * np.exp(-1j * cpe)[:, None]
-    return eq[:, DATA_CARRIERS % FFT_SIZE]
+    p_idx = PILOT_CARRIERS % FFT_SIZE
+    if algorithm == "ls":
+        eq = spectra / H[None, :]
+        pilots = eq[:, p_idx]
+        cpe = np.angle((pilots * np.conj(expected)).sum(axis=1))
+        eq = eq * np.exp(-1j * cpe)[:, None]
+        return eq[:, DATA_CARRIERS % FFT_SIZE]
+    if algorithm != "sta":
+        raise ValueError(f"unknown equalizer algorithm {algorithm!r}")
+    # STA: per-symbol pilot-driven channel refresh with subcarrier smoothing
+    alpha = 0.5
+    Ht = H.copy()
+    out = np.empty((n_sym, len(DATA_CARRIERS)), dtype=np.complex128)
+    used = np.sort(np.concatenate([DATA_CARRIERS, PILOT_CARRIERS])) % FFT_SIZE
+    for s in range(n_sym):
+        eq_s = spectra[s] / Ht
+        pilots = eq_s[p_idx]
+        cpe = np.angle((pilots * np.conj(expected[s])).sum())
+        eq_s = eq_s * np.exp(-1j * cpe)
+        # refresh: observed pilot channel (post-CPE), interpolated over used carriers
+        obs = spectra[s, p_idx] * np.exp(-1j * cpe) / expected[s]
+        upd = np.interp(used, p_idx[np.argsort(p_idx)],
+                        obs[np.argsort(p_idx)].real) \
+            + 1j * np.interp(used, p_idx[np.argsort(p_idx)],
+                             obs[np.argsort(p_idx)].imag)
+        Ht[used] = (1 - alpha) * Ht[used] + alpha * upd
+        out[s] = eq_s[DATA_CARRIERS % FFT_SIZE]
+    return out
