@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Morse beacon: text → CW audio (WAV) and back (reference: examples/cw)."""
+
+import sys
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import VectorSource, WavSink
+from futuresdr_tpu.models.misc import cw_modulate, cw_demodulate
+
+
+def main():
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("text", nargs="?", default="CQ CQ DE FUTURESDR TPU K")
+    p.add_argument("--wav", default="/tmp/cw.wav")
+    p.add_argument("--wpm", type=float, default=20.0)
+    p.add_argument("--tone", type=float, default=600.0)
+    a = p.parse_args()
+
+    fs = 8000.0
+    audio = cw_modulate(a.text, a.tone, fs, a.wpm)
+    fg = Flowgraph()
+    fg.connect(VectorSource(audio), WavSink(a.wav, int(fs)))
+    Runtime().run(fg)
+    print(f"wrote {a.wav}; decoding back:")
+    print(" ", cw_demodulate(audio, fs, a.wpm))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Audio modem over a simulated acoustic channel (reference: examples/rattlegram)."""
+
+import sys
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu.models.rattlegram import Modem
+
+
+def main():
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("message", nargs="?", default="hello through the speaker")
+    p.add_argument("--noise", type=float, default=0.02)
+    a = p.parse_args()
+
+    rng = np.random.default_rng(0)
+    m = Modem(payload_size=64)
+    audio = m.tx(a.message.encode())
+    print(f"burst: {len(audio)} samples @8 kHz = {len(audio)/8000:.2f} s")
+    channel = np.concatenate([np.zeros(1000, np.float32), 0.5 * audio,
+                              np.zeros(500, np.float32)])
+    channel += a.noise * rng.standard_normal(len(channel)).astype(np.float32)
+    got = m.rx(channel)
+    print("decoded:", got)
+
+
+if __name__ == "__main__":
+    main()
