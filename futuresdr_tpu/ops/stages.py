@@ -282,6 +282,32 @@ def apply_stage(f: Callable[[jnp.ndarray], jnp.ndarray], out_dtype=None,
     return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), out_dtype, 1, name)
 
 
+def agc_stage(reference: float = 1.0, rate: float = 0.1, block: int = 256,
+              max_gain: float = 65536.0) -> Stage:
+    """Block-floating AGC: per-sample gain feedback is inherently sequential, so the
+    TPU form tracks gain at ``block`` granularity — mean magnitude per block, gain
+    evolved by a short ``lax.scan`` over blocks (frame_len/block steps), then applied
+    vectorized. Converges like the reference's per-sample loop (`blocks/agc.rs`) with a
+    ``block``-sample control delay. Carry = the running gain."""
+
+    def fn(carry, x):
+        mags = jnp.abs(x.reshape(-1, block)).mean(axis=1)
+
+        def step(g, m):
+            err = reference - m * g
+            g = jnp.clip(g + rate * err, 0.0, max_gain)
+            return g, g
+
+        g_final, gains = jax.lax.scan(step, carry, mags)
+        y = (x.reshape(-1, block) * gains[:, None]).reshape(-1).astype(x.dtype)
+        return g_final, y
+
+    def init_carry(dtype):
+        return jnp.asarray(1.0, dtype=jnp.float32)
+
+    return Stage(fn, init_carry, Fraction(1, 1), None, block, "agc")
+
+
 def moving_avg_stage(frame_len: int, decay: float = 0.1) -> Stage:
     """EMA across frames of length ``frame_len`` (spectrum smoothing), carry = the EMA."""
 

@@ -85,6 +85,15 @@ def test_moving_avg_stage():
     assert abs(y[-frame_len:].mean() - 1.0) < 1e-3
 
 
+def test_agc_stage_converges():
+    from futuresdr_tpu.ops import agc_stage
+
+    pipe = Pipeline([agc_stage(reference=1.0, rate=5.0, block=64)], np.complex64)
+    x = (0.01 * np.exp(1j * 2 * np.pi * 0.01 * np.arange(32768))).astype(np.complex64)
+    y = run_pipeline(pipe, x, 4096)
+    assert abs(np.abs(y[-2000:]).mean() - 1.0) < 0.1
+
+
 def test_pipeline_rate_math():
     taps = np.ones(16, dtype=np.float32)
     pipe = Pipeline([fir_stage(taps, decim=2, fft_len=128), fft_stage(64), mag2_stage()],
