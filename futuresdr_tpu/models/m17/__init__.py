@@ -4,7 +4,9 @@ Golay(24,12), CRC16, K=5 convolutional code, LSF framing, 4FSK RRC PHY."""
 from .codec import (encode_callsign, decode_callsign, crc16_m17, golay24_encode,
                     golay24_decode, conv_encode_m17, viterbi_decode_m17)
 from .phy import Lsf, build_lsf_frame, modulate, demodulate_stream, SYNC_LSF
+from .blocks import M17Transmitter, M17Receiver
 
 __all__ = ["encode_callsign", "decode_callsign", "crc16_m17", "golay24_encode",
            "golay24_decode", "conv_encode_m17", "viterbi_decode_m17",
-           "Lsf", "build_lsf_frame", "modulate", "demodulate_stream", "SYNC_LSF"]
+           "Lsf", "build_lsf_frame", "modulate", "demodulate_stream", "SYNC_LSF",
+           "M17Transmitter", "M17Receiver"]

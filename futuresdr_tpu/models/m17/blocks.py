@@ -1,0 +1,102 @@
+"""Streaming M17 blocks: LSF beacon transmitter and receiver.
+
+Reference: the M17 example's encoder/decoder block chain (``examples/m17/src/``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+import numpy as np
+
+from ...runtime.kernel import Kernel, message_handler
+from ...types import Pmt
+from .phy import Lsf, SPS, build_lsf_frame, demodulate_stream, modulate
+
+__all__ = ["M17Transmitter", "M17Receiver"]
+
+
+class M17Transmitter(Kernel):
+    """Message port ``tx`` ({dst, src} map or Blob meta) → 4FSK baseband stream."""
+
+    def __init__(self, src_callsign: str = "N0CALL", gap_symbols: int = 40):
+        super().__init__()
+        self.src_callsign = src_callsign
+        self.gap = gap_symbols * SPS
+        self._pending: Deque[np.ndarray] = deque()
+        self._current: Optional[np.ndarray] = None
+        self._eos = False
+        self.output = self.add_stream_output("out", np.float32)
+
+    @message_handler(name="tx")
+    async def tx_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        if p.is_finished():
+            self._eos = True
+            io.call_again = True
+            return Pmt.ok()
+        try:
+            m = p.to_map()
+            lsf = Lsf(dst=m.get("dst", Pmt.string("@ALL")).to_str(),
+                      src=m.get("src", Pmt.string(self.src_callsign)).to_str(),
+                      meta=m["meta"].to_blob() if "meta" in m else bytes(14))
+        except Exception:
+            return Pmt.invalid_value()
+        wave = modulate(build_lsf_frame(lsf))
+        self._pending.append(np.concatenate([wave, np.zeros(self.gap, np.float32)]))
+        io.call_again = True
+        return Pmt.ok()
+
+    async def work(self, io, mio, meta):
+        out = self.output.slice()
+        produced = 0
+        while produced < len(out):
+            if self._current is None:
+                if not self._pending:
+                    break
+                self._current = self._pending.popleft()
+            k = min(len(out) - produced, len(self._current))
+            out[produced:produced + k] = self._current[:k]
+            produced += k
+            self._current = self._current[k:] if k < len(self._current) else None
+        if produced:
+            self.output.produce(produced)
+        if self._eos and self._current is None and not self._pending:
+            io.finished = True
+        elif produced and (self._current is not None or self._pending):
+            io.call_again = True
+
+
+class M17Receiver(Kernel):
+    """4FSK baseband stream → decoded LSF messages on ``rx``."""
+
+    def __init__(self):
+        super().__init__()
+        self.OVERLAP = (8 + 184 + 16) * SPS + 200
+        self.frames = []
+        self._tail = np.zeros(0, np.float32)
+        self._recent = deque(maxlen=8)
+        self.input = self.add_stream_input("in", np.float32, min_items=64 * SPS)
+        self.add_message_output("rx")
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        n = len(inp)
+        if n == 0:
+            if self.input.finished():
+                io.finished = True
+            return
+        buf = np.concatenate([self._tail, inp[:n]])
+        for lsf in demodulate_stream(buf):
+            key = lsf.to_bytes()
+            if key in self._recent:
+                continue
+            self._recent.append(key)
+            self.frames.append(lsf)
+            mio.post("rx", Pmt.map({"dst": lsf.dst, "src": lsf.src,
+                                    "meta": Pmt.blob(lsf.meta)}))
+        keep = min(len(buf), self.OVERLAP)
+        self._tail = buf[len(buf) - keep:].copy()
+        self.input.consume(n)
+        if self.input.finished() and self.input.available() == 0:
+            io.finished = True

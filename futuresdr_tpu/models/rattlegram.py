@@ -17,9 +17,12 @@ from typing import Optional
 
 import numpy as np
 
+from ..runtime.kernel import Kernel, message_handler
+from ..types import Pmt
 from .wlan import coding as wcoding
 
-__all__ = ["mls", "ModemParams", "modulate", "demodulate", "Modem"]
+__all__ = ["mls", "ModemParams", "modulate", "demodulate", "Modem",
+           "ModemTransmitter", "ModemReceiver"]
 
 
 def mls(poly: int = 0b1000011, state: int = 1) -> np.ndarray:
@@ -158,3 +161,90 @@ class Modem:
     def rx(self, audio: np.ndarray) -> Optional[bytes]:
         r = demodulate(audio, self.size, self.params)
         return None if r is None else r.rstrip(b"\x00")
+
+    def burst_samples(self) -> int:
+        """Length of one TX burst in samples (for RX windowing)."""
+        return len(self.tx(b""))
+
+
+class ModemTransmitter(Kernel):
+    """Message port ``tx`` (Blob) → audio sample stream (float32 @ params.fs)."""
+
+    def __init__(self, payload_size: int = 64, params: ModemParams = ModemParams(),
+                 gap_samples: int = 2000):
+        super().__init__()
+        self.modem = Modem(payload_size, params)
+        self.gap = gap_samples
+        self._pending = []
+        self._current: Optional[np.ndarray] = None
+        self._eos = False
+        self.output = self.add_stream_output("out", np.float32)
+
+    @message_handler(name="tx")
+    async def tx_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        if p.is_finished():
+            self._eos = True
+            io.call_again = True
+            return Pmt.ok()
+        try:
+            payload = p.to_blob()
+        except Exception:
+            return Pmt.invalid_value()
+        burst = np.concatenate([self.modem.tx(payload),
+                                np.zeros(self.gap, np.float32)])
+        self._pending.append(burst)
+        io.call_again = True
+        return Pmt.ok()
+
+    async def work(self, io, mio, meta):
+        out = self.output.slice()
+        produced = 0
+        while produced < len(out):
+            if self._current is None:
+                if not self._pending:
+                    break
+                self._current = self._pending.pop(0)
+            k = min(len(out) - produced, len(self._current))
+            out[produced:produced + k] = self._current[:k]
+            produced += k
+            self._current = self._current[k:] if k < len(self._current) else None
+        if produced:
+            self.output.produce(produced)
+        if self._eos and self._current is None and not self._pending:
+            io.finished = True
+        elif produced and (self._current is not None or self._pending):
+            io.call_again = True
+
+
+class ModemReceiver(Kernel):
+    """Audio stream → decoded payload messages on ``rx``."""
+
+    def __init__(self, payload_size: int = 64, params: ModemParams = ModemParams()):
+        super().__init__()
+        self.modem = Modem(payload_size, params)
+        self.OVERLAP = self.modem.burst_samples() + 4 * params.sym_len
+        self.frames = []
+        self._tail = np.zeros(0, np.float32)
+        self._recent = []
+        self.input = self.add_stream_input("in", np.float32,
+                                           min_items=4 * params.sym_len)
+        self.add_message_output("rx")
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        n = len(inp)
+        if n == 0:
+            if self.input.finished():
+                io.finished = True
+            return
+        buf = np.concatenate([self._tail, inp[:n]])
+        payload = self.modem.rx(buf)
+        if payload is not None and payload not in self._recent:
+            self._recent = (self._recent + [payload])[-8:]
+            self.frames.append(payload)
+            mio.post("rx", Pmt.blob(payload))
+        keep = min(len(buf), self.OVERLAP)
+        self._tail = buf[len(buf) - keep:].copy()
+        self.input.consume(n)
+        if self.input.finished() and self.input.available() == 0:
+            io.finished = True

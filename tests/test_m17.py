@@ -69,6 +69,32 @@ def test_4fsk_lsf_loopback():
     assert found[0].dst == "N0CALL" and found[0].src == "W2FBI"
 
 
+def test_m17_flowgraph_loopback():
+    import numpy as _np
+    from futuresdr_tpu import Flowgraph, Runtime, Pmt
+    from futuresdr_tpu.blocks import Apply
+    from futuresdr_tpu.models.m17 import M17Transmitter, M17Receiver
+
+    rng = _np.random.default_rng(4)
+    fg = Flowgraph()
+    tx = M17Transmitter()
+    chan = Apply(lambda x: (x + 0.05 * rng.standard_normal(len(x))
+                            ).astype(_np.float32), _np.float32)
+    rx = M17Receiver()
+    fg.connect(tx, chan, rx)
+    rt = Runtime()
+    running = rt.start(fg)
+    msgs = [{"dst": "@ALL", "src": "W2FBI", "meta": Pmt.blob(b"beacon 1 meta!")},
+            {"dst": "N0CALL", "src": "SP5WWP", "meta": Pmt.blob(b"second beacon.")}]
+    for m in msgs:
+        r = rt.scheduler.run_coro_sync(running.handle.call(tx, "tx", Pmt.map(m)))
+        assert r == Pmt.ok()
+    rt.scheduler.run_coro_sync(running.handle.call(tx, "tx", Pmt.finished()))
+    running.wait_sync()
+    assert [(f.dst, f.src) for f in rx.frames] == [("@ALL", "W2FBI"),
+                                                   ("N0CALL", "SP5WWP")]
+
+
 def test_4fsk_loopback_noise():
     rng = np.random.default_rng(2)
     lsf = Lsf(dst="AB1CDE", src="SP5WWP")

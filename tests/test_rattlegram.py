@@ -32,6 +32,28 @@ def test_modem_noise_and_scale():
     assert m.rx(audio) == b"quiet but still decodable"
 
 
+def test_modem_flowgraph_loopback():
+    from futuresdr_tpu import Flowgraph, Runtime, Pmt
+    from futuresdr_tpu.blocks import Apply
+    from futuresdr_tpu.models.rattlegram import ModemTransmitter, ModemReceiver
+
+    rng = np.random.default_rng(3)
+    fg = Flowgraph()
+    tx = ModemTransmitter(payload_size=48)
+    chan = Apply(lambda x: (0.5 * x + 0.01 * rng.standard_normal(len(x))
+                            ).astype(np.float32), np.float32)
+    rx = ModemReceiver(payload_size=48)
+    fg.connect(tx, chan, rx)
+    payloads = [f"acoustic packet {i}".encode() for i in range(3)]
+    rt = Runtime()
+    running = rt.start(fg)
+    for p in payloads:
+        rt.scheduler.run_coro_sync(running.handle.call(tx, "tx", Pmt.blob(p)))
+    rt.scheduler.run_coro_sync(running.handle.call(tx, "tx", Pmt.finished()))
+    running.wait_sync()
+    assert rx.frames == payloads
+
+
 def test_modem_rejects_garbage():
     m = Modem(payload_size=32)
     rng = np.random.default_rng(1)
