@@ -282,6 +282,45 @@ def apply_stage(f: Callable[[jnp.ndarray], jnp.ndarray], out_dtype=None,
     return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), out_dtype, 1, name)
 
 
+def channelizer_stage(n_channels: int, taps=None, name: str = "channelizer") -> Stage:
+    """Critically-sampled PFB analysis bank as a stage: frames of k·N complex samples →
+    k·N outputs, CHANNEL-INTERLEAVED ([t, N] flattened — feed a StreamDeinterleaver(N)
+    to split, or consume interleaved). Carry = the branch-filter history block.
+
+    The polyphase branch FIRs are expressed as one [N, K] × windows dot per output
+    step batched over the frame (MXU work), followed by a batched IFFT across branches —
+    the fused-TPU form of `blocks/pfb.PfbChannelizer`.
+    """
+    N = n_channels
+    if taps is None:
+        from ..blocks.pfb import pfb_default_taps
+        taps = pfb_default_taps(N)
+    taps = np.asarray(taps, dtype=np.float32)
+    K = -(-len(taps) // N)
+    padded = np.zeros(K * N, dtype=np.float32)
+    padded[:len(taps)] = taps
+    branch = jnp.asarray(padded.reshape(K, N).T)          # [N, K]
+
+    def fn(carry, x):
+        Hc, hist = carry                                   # hist: [(K-1)·N]
+        ext = jnp.concatenate([hist, x])                   # [(t + K-1)·N]
+        blocks = ext.reshape(-1, N)[:, ::-1]               # [t+K-1, N] commutated
+        t = x.shape[0] // N
+        # windows[s, k, c] = blocks[s + (K-1) - k, c]  (branch c history depth k)
+        idx = (jnp.arange(t)[:, None] + (K - 1) - jnp.arange(K)[None, :])
+        windows = blocks[idx]                              # [t, K, N]
+        v = jnp.einsum("tkc,ck->ct", windows, Hc,
+                       precision=jax.lax.Precision.HIGHEST)  # [N, t]
+        y = jnp.fft.ifft(v, axis=0) * N                    # [N, t]
+        new_hist = ext[ext.shape[0] - (K - 1) * N:]
+        return (Hc, new_hist), y.T.reshape(-1).astype(jnp.complex64)
+
+    def init_carry(dtype):
+        return (branch, jnp.zeros((K - 1) * N, dtype=dtype))
+
+    return Stage(fn, init_carry, Fraction(1, 1), np.complex64, N, name)
+
+
 def agc_stage(reference: float = 1.0, rate: float = 0.1, block: int = 256,
               max_gain: float = 65536.0) -> Stage:
     """Block-floating AGC: per-sample gain feedback is inherently sequential, so the

@@ -85,6 +85,34 @@ def test_moving_avg_stage():
     assert abs(y[-frame_len:].mean() - 1.0) < 1e-3
 
 
+def test_channelizer_stage_matches_block():
+    from futuresdr_tpu.ops import channelizer_stage
+    from futuresdr_tpu.blocks.pfb import pfb_default_taps
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSource, VectorSink, PfbChannelizer
+
+    N = 4
+    taps = pfb_default_taps(N)
+    rng = np.random.default_rng(8)
+    x = (rng.standard_normal(4096) + 1j * rng.standard_normal(4096)).astype(np.complex64)
+
+    pipe = Pipeline([channelizer_stage(N, taps)], np.complex64)
+    y = run_pipeline(pipe, x, 1024).reshape(-1, N).T      # [N, t]
+
+    fg = Flowgraph()
+    src = VectorSource(x)
+    chan = PfbChannelizer(N, taps)
+    sinks = [VectorSink(np.complex64) for _ in range(N)]
+    fg.connect_stream(src, "out", chan, "in")
+    for i, s in enumerate(sinks):
+        fg.connect_stream(chan, f"out{i}", s, "in")
+    Runtime().run(fg)
+    for c in range(N):
+        ref = sinks[c].items()
+        n = min(len(ref), y.shape[1])
+        np.testing.assert_allclose(y[c, :n], ref[:n], rtol=1e-3, atol=1e-4)
+
+
 def test_agc_stage_converges():
     from futuresdr_tpu.ops import agc_stage
 
