@@ -95,9 +95,7 @@ class WlanDecoder(Kernel):
         inp = self.input.slice()
         n = len(inp)
         if n < self.chunk and not self.input.finished():
-            # wait for a fuller window (coalesced wakeups will re-arm us)
-            if n == 0:
-                return
+            return          # wait for a fuller window (upstream produce re-arms us)
         if n == 0:
             if self.input.finished():
                 io.finished = True

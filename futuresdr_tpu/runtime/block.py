@@ -97,6 +97,9 @@ class WrappedKernel:
                 if isinstance(msg, Terminate):
                     fg_inbox.send(BlockDoneMsg(self.id, self))
                     return
+                if isinstance(msg, Callback):
+                    # cannot service handlers before init; never leave a caller hanging
+                    msg.reply.set(Pmt.invalid_value())
                 if msg is None:
                     await self.inbox.wait()
                     self.inbox.take_pending()

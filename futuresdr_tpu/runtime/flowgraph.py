@@ -202,7 +202,13 @@ class Flowgraph:
                 [op.min_items] + [p.min_items for p in dst_ports],
                 [op.min_buffer_size],
             )
-            buffer_cls = edges[0].buffer or op.buffer or default_buffer()
+            overrides = {e.buffer for e in edges if e.buffer is not None}
+            if len(overrides) > 1:
+                raise ConnectError(
+                    f"conflicting buffer overrides on broadcast output "
+                    f"{edges[0].src!r}.{edges[0].src_port}: {overrides}")
+            buffer_cls = (overrides.pop() if overrides else None) or op.buffer \
+                or default_buffer()
             writer = buffer_cls(dtype, cap, sw.inbox, out_index)
             op.writer = writer
             for e, ip in zip(edges, dst_ports):

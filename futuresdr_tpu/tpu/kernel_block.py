@@ -12,6 +12,9 @@ neighbouring frames overlap — the double-buffering of `SURVEY §7.5` without b
 The block is ``BLOCKING`` (dedicated thread), so the host sync in result retrieval never
 stalls the scheduler loop — the reference marks its hardware blocks ``#[blocking]`` the same
 way (`seify/source.rs`).
+
+Stream tags are not propagated through the device path (the reference's GPU staging
+buffers drop them likewise); attach metadata out-of-band via message ports when needed.
 """
 
 from __future__ import annotations
