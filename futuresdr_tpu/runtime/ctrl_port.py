@@ -29,12 +29,15 @@ log = logger("ctrl_port")
 
 
 class ControlPort:
-    def __init__(self, runtime_handle, bind: Optional[str] = None):
+    def __init__(self, runtime_handle, bind: Optional[str] = None, extra_routes=None):
+        """``extra_routes``: list of ("GET"|"POST", path, async handler) tuples merged
+        into the app — the `examples/custom-routes` extension point."""
         self.handle = runtime_handle
         bind = bind or config().ctrlport_bind
         host, _, port = bind.partition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port or 1337)
+        self.extra_routes = list(extra_routes or [])
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
@@ -89,6 +92,8 @@ class ControlPort:
         app.router.add_get("/api/fg/{fg}/block/{blk}/", self._describe_block)
         app.router.add_get("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
         app.router.add_post("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
+        for method, path, handler in self.extra_routes:
+            app.router.add_route(method, path, handler)
         import os
         fp = config().frontend_path
         if not fp:

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""perf/buffer_size — throughput vs stream buffer size.
+
+Reference: ``perf/buffer_size/buffer_size.rs`` (buffer-size parameter sweep).
+CSV: ``run,buffer_bytes,samples,elapsed_secs,msps``.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.config import config
+from futuresdr_tpu.blocks import Copy, Head, NullSink, NullSource
+
+
+def run_once(buffer_bytes: int, samples: int) -> float:
+    config().buffer_size = buffer_bytes
+    fg = Flowgraph()
+    src = NullSource(np.float32)
+    head = Head(np.float32, samples)
+    c1, c2 = Copy(np.float32), Copy(np.float32)
+    snk = NullSink(np.float32)
+    fg.connect(src, head, c1, c2, snk)
+    rt = Runtime()
+    t0 = time.perf_counter()
+    rt.run(fg)
+    dt = time.perf_counter() - t0
+    rt.shutdown()
+    return dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=2)
+    p.add_argument("--samples", type=int, default=20_000_000)
+    p.add_argument("--sizes", type=int, nargs="+",
+                   default=[8192, 32768, 131072, 262144, 1048576, 4194304])
+    a = p.parse_args()
+    print("run,buffer_bytes,samples,elapsed_secs,msps")
+    for r in range(a.runs):
+        for size in a.sizes:
+            dt = run_once(size, a.samples)
+            print(f"{r},{size},{a.samples},{dt:.3f},{a.samples/dt/1e6:.1f}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""perf/micro — Mocker-driven block micro-benchmarks + work-call overhead.
+
+Reference: the criterion benches (`benches/apply.rs` — single-block work() via Mocker;
+`benches/sync_vs_async.rs` — async work-call overhead; `benches/flowgraph.rs` — whole
+flowgraph startup/run overhead). CSV rows: ``bench,param,ns_per_item,items_per_sec``.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Mocker, Runtime
+from futuresdr_tpu.blocks import Apply, Fir, VectorSink, VectorSource
+from futuresdr_tpu.dsp import firdes
+
+
+def bench_mocker_apply(window: int, iters: int) -> float:
+    """ns/item through Apply.work via the Mocker (benches/apply.rs)."""
+    blk = Apply(lambda x: 12.0 * x, np.float32)
+    m = Mocker(blk)
+    data = np.zeros(window * iters, np.float32)
+    m.input("in", data)
+    m.init_output("out", len(data))
+    t0 = time.perf_counter()
+    m.run()
+    dt = time.perf_counter() - t0
+    return dt / len(data) * 1e9
+
+
+def bench_mocker_fir(window: int, iters: int) -> float:
+    taps = firdes.lowpass(0.2, 64).astype(np.float32)
+    blk = Fir(taps, np.float32)
+    m = Mocker(blk)
+    data = np.zeros(window * iters, np.float32)
+    m.input("in", data)
+    m.init_output("out", len(data))
+    t0 = time.perf_counter()
+    m.run()
+    dt = time.perf_counter() - t0
+    return dt / len(data) * 1e9
+
+
+def bench_flowgraph_startup(n_blocks: int, runs: int) -> float:
+    """Whole-flowgraph launch+run overhead for a tiny payload (benches/flowgraph.rs)."""
+    total = 0.0
+    for _ in range(runs):
+        fg = Flowgraph()
+        src = VectorSource(np.zeros(1234, np.float32))
+        last = src
+        for _i in range(n_blocks):
+            a = Apply(lambda x: x, np.float32)
+            fg.connect(last, a)
+            last = a
+        snk = VectorSink(np.float32)
+        fg.connect(last, snk)
+        t0 = time.perf_counter()
+        Runtime().run(fg)
+        total += time.perf_counter() - t0
+    return total / runs
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--window", type=int, default=4096)
+    p.add_argument("--iters", type=int, default=2000)
+    a = p.parse_args()
+    print("bench,param,value,unit")
+    ns = bench_mocker_apply(a.window, a.iters)
+    print(f"mocker_apply,{a.window},{ns:.2f},ns_per_item")
+    ns = bench_mocker_fir(a.window, a.iters)
+    print(f"mocker_fir64,{a.window},{ns:.2f},ns_per_item")
+    for nb in (2, 8):
+        s = bench_flowgraph_startup(nb, runs=5)
+        print(f"flowgraph_startup,{nb}_blocks,{s*1e3:.2f},ms_per_run")
+
+
+if __name__ == "__main__":
+    main()

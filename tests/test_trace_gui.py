@@ -26,17 +26,25 @@ def test_latency_probes():
 
 
 def test_gui_served_from_ctrl_port():
+    from aiohttp import web
     from futuresdr_tpu.runtime.ctrl_port import ControlPort
     from futuresdr_tpu.runtime.runtime import RuntimeHandle
     from futuresdr_tpu import AsyncScheduler
 
+    async def my_route(request):
+        return web.json_response({"custom": True})
+
     handle = RuntimeHandle(AsyncScheduler())
-    cp = ControlPort(handle, bind="127.0.0.1:29417")
+    cp = ControlPort(handle, bind="127.0.0.1:29417",
+                     extra_routes=[("GET", "/my/app/", my_route)])
     cp.start()
     try:
         html = urllib.request.urlopen("http://127.0.0.1:29417/").read().decode()
         assert "waterfall" in html
         ids = json.load(urllib.request.urlopen("http://127.0.0.1:29417/api/fg/"))
         assert ids == []
+        # custom-routes extension point (reference: examples/custom-routes)
+        r = json.load(urllib.request.urlopen("http://127.0.0.1:29417/my/app/"))
+        assert r == {"custom": True}
     finally:
         cp.stop()
