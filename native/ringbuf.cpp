@@ -84,9 +84,16 @@ size_t fsdr_dbuf_size(fsdr_dbuf *h) { return h->size; }
 
 #define FSDR_MAX_READERS 16
 
+// Cache-line padding: the writer hammers wpos while each reader hammers its own rpos;
+// sharing a line would false-share every produce/consume (the reference pads its SPSC
+// indices the same way, perf/perf/src/spsc.rs).
+struct alignas(128) fsdr_padded_u64 {
+    std::atomic<uint64_t> v;
+};
+
 struct fsdr_ring {
-    std::atomic<uint64_t> wpos;
-    std::atomic<uint64_t> rpos[FSDR_MAX_READERS];
+    fsdr_padded_u64 wpos;
+    fsdr_padded_u64 rpos[FSDR_MAX_READERS];
     std::atomic<uint32_t> reader_active;  // bitmask
     uint64_t capacity;                    // in items
 };
@@ -103,7 +110,7 @@ int fsdr_ring_add_reader(fsdr_ring *r) {
     for (int i = 0; i < FSDR_MAX_READERS; i++) {
         uint32_t mask = r->reader_active.load(std::memory_order_acquire);
         if (!(mask & (1u << i))) {
-            r->rpos[i].store(r->wpos.load(std::memory_order_acquire),
+            r->rpos[i].v.store(r->wpos.v.load(std::memory_order_acquire),
                              std::memory_order_release);
             if (r->reader_active.compare_exchange_strong(mask, mask | (1u << i)))
                 return i;
@@ -118,21 +125,21 @@ void fsdr_ring_remove_reader(fsdr_ring *r, int idx) {
 }
 
 uint64_t fsdr_ring_wpos(fsdr_ring *r) {
-    return r->wpos.load(std::memory_order_acquire);
+    return r->wpos.v.load(std::memory_order_acquire);
 }
 
 uint64_t fsdr_ring_rpos(fsdr_ring *r, int idx) {
-    return r->rpos[idx].load(std::memory_order_acquire);
+    return r->rpos[idx].v.load(std::memory_order_acquire);
 }
 
 // Free space for the writer = capacity - max over active readers of (wpos - rpos).
 uint64_t fsdr_ring_space(fsdr_ring *r) {
-    uint64_t w = r->wpos.load(std::memory_order_acquire);
+    uint64_t w = r->wpos.v.load(std::memory_order_acquire);
     uint32_t mask = r->reader_active.load(std::memory_order_acquire);
     uint64_t used = 0;
     for (int i = 0; i < FSDR_MAX_READERS; i++) {
         if (mask & (1u << i)) {
-            uint64_t lag = w - r->rpos[i].load(std::memory_order_acquire);
+            uint64_t lag = w - r->rpos[i].v.load(std::memory_order_acquire);
             if (lag > used) used = lag;
         }
     }
@@ -140,16 +147,16 @@ uint64_t fsdr_ring_space(fsdr_ring *r) {
 }
 
 uint64_t fsdr_ring_available(fsdr_ring *r, int idx) {
-    return r->wpos.load(std::memory_order_acquire) -
-           r->rpos[idx].load(std::memory_order_acquire);
+    return r->wpos.v.load(std::memory_order_acquire) -
+           r->rpos[idx].v.load(std::memory_order_acquire);
 }
 
 void fsdr_ring_produce(fsdr_ring *r, uint64_t n) {
-    r->wpos.fetch_add(n, std::memory_order_acq_rel);
+    r->wpos.v.fetch_add(n, std::memory_order_acq_rel);
 }
 
 void fsdr_ring_consume(fsdr_ring *r, int idx, uint64_t n) {
-    r->rpos[idx].fetch_add(n, std::memory_order_acq_rel);
+    r->rpos[idx].v.fetch_add(n, std::memory_order_acq_rel);
 }
 
 }  // extern "C"
