@@ -20,7 +20,7 @@ import time
 sys.path.insert(0, ".")
 
 
-def _ensure_live_backend(timeout: int = 240) -> None:
+def _ensure_live_backend(timeout: int = 150) -> None:
     """The axon TPU tunnel can wedge so that jax.devices() blocks forever; probe it in a
     subprocess and fall back to the CPU backend rather than hanging the bench."""
     if os.environ.get("FSDR_BENCH_PROBED"):
