@@ -41,7 +41,7 @@ class WrappedKernel:
 
     def metrics(self) -> dict:
         k = self.kernel
-        return {
+        m = {
             "work_calls": self.work_calls,
             "work_time_s": round(self.work_time_s, 6),
             "messages_handled": self.messages_handled,
@@ -50,6 +50,13 @@ class WrappedKernel:
             "items_out": {p.name: getattr(p, "items_produced", 0)
                           for p in k.stream_outputs},
         }
+        extra = getattr(k, "extra_metrics", None)
+        if callable(extra):
+            try:
+                m.update(extra())
+            except Exception:
+                pass
+        return m
 
     @property
     def id(self) -> int:

@@ -53,11 +53,19 @@ class TpuKernel(Kernel):
         self._carry = None
         self._inflight: Deque[Tuple[object, int]] = deque()  # (device result, valid_out)
         self._pending_out: Optional[np.ndarray] = None
+        self._frames_dispatched = 0
         self.input = self.add_stream_input("in", in_dtype, min_items=self.frame_size)
         self.output = self.add_stream_output(
             "out", self.pipeline.out_dtype, min_items=self.out_frame,
             min_buffer_size=(self.depth + 1) * self.out_frame *
             np.dtype(self.pipeline.out_dtype).itemsize)
+
+    def extra_metrics(self) -> dict:
+        return {
+            "frame_size": self.frame_size,
+            "frames_in_flight": len(self._inflight),
+            "frames_dispatched": self._frames_dispatched,
+        }
 
     async def init(self, mio, meta):
         self._compiled, self._carry = self.pipeline.compile(
@@ -78,6 +86,7 @@ class TpuKernel(Kernel):
         self._carry, y = self._compiled(self._carry, x)
         valid_out = self.pipeline.out_items(valid_in)
         self._inflight.append((y, min(valid_out, self.out_frame)))
+        self._frames_dispatched += 1
 
     def _drain_one(self) -> np.ndarray:
         y, valid = self._inflight.popleft()
