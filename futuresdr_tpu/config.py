@@ -28,6 +28,7 @@ class Config:
     slab_reserved: int = 128               # reserved history items for slab buffers
     stack_size: int = 16 * 1024 * 1024     # (informational; Python threads use default)
     log_level: str = "info"
+    default_scheduler: str = "async"       # "async" | "threaded"
     ctrlport_enable: bool = False
     ctrlport_bind: str = "127.0.0.1:1337"
     frontend_path: Optional[str] = None

@@ -334,7 +334,13 @@ class Runtime:
     """Owns the scheduler and (optionally) the REST control port (`runtime.rs:55-207`)."""
 
     def __init__(self, scheduler: Optional[Scheduler] = None):
-        self.scheduler = scheduler or AsyncScheduler()
+        if scheduler is None:
+            if config().default_scheduler == "threaded":
+                from .scheduler import ThreadedScheduler
+                scheduler = ThreadedScheduler()
+            else:
+                scheduler = AsyncScheduler()
+        self.scheduler = scheduler
         self.handle = RuntimeHandle(self.scheduler)
         self._ctrl_port = None
         if config().ctrlport_enable:
