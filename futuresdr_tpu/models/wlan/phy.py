@@ -98,54 +98,15 @@ class DecodedFrame:
     n_symbols: int
 
 
-def decode_frame(samples: np.ndarray, lts_start: int, cfo: float = 0.0,
-                 scrambler_seed: Optional[int] = None) -> Optional[DecodedFrame]:
+def decode_frame(samples: np.ndarray, lts_start: int,
+                 cfo: float = 0.0) -> Optional[DecodedFrame]:
     """Decode one frame given LTS timing (`frame_equalizer.rs` + `decoder` roles)."""
-    data_start = lts_start + 128
-    if data_start + SYM_LEN > len(samples):
-        return None                      # frame truncated at the stream edge
-    if cfo != 0.0:
-        n = np.arange(len(samples) - lts_start)
-        samples = samples.copy()
-        samples[lts_start:] = samples[lts_start:] * np.exp(-1j * cfo * n)
-    H = ofdm.estimate_channel(samples, lts_start)
-
-    # SIGNAL
-    spec = ofdm.ofdm_demodulate_symbols(samples[data_start:], 1)
-    eq = ofdm.equalize(spec, H, symbol_offset=0)
-    sig_llrs = ofdm.demap_llrs(eq.reshape(-1), "bpsk")
-    sig_deint = coding.deinterleave(sig_llrs, 48, 1)
-    sig_bits = coding.viterbi_decode(sig_deint, 24)
-    parsed = _parse_signal(sig_bits)
-    if parsed is None:
+    p = _prepare_frame(samples, lts_start, cfo)
+    if p is None:
         return None
-    mcs, length = parsed
-
-    n_bits = 16 + 8 * length + 6
-    n_sym = -(-n_bits // mcs.n_dbps)
-    avail = (len(samples) - data_start - SYM_LEN) // SYM_LEN
-    if n_sym > avail:
-        return None
-    spec = ofdm.ofdm_demodulate_symbols(samples[data_start + SYM_LEN:], n_sym)
-    eq = ofdm.equalize(spec, H, symbol_offset=1)
-    llrs = ofdm.demap_llrs(eq.reshape(-1), mcs.modulation)
-    deint = coding.deinterleave(llrs, mcs.n_cbps, mcs.n_bpsc)
-    depunct = coding.depuncture(deint, mcs.coding_rate)
-    decoded = coding.viterbi_decode(depunct, n_sym * mcs.n_dbps)
-    if scrambler_seed is not None:
-        descrambled = coding.descramble(decoded, scrambler_seed)
-    else:
-        # the 16 SERVICE bits are zeros pre-scrambling: recover the seed by search
-        # (127 candidates × 16 bits — the reference's decoder derives it in closed
-        # form from the first 7 bits; exhaustive search is equivalent and robust)
-        seed = 0b1011101
-        for cand in range(1, 128):
-            if not coding.descramble(decoded[:16], cand).any():
-                seed = cand
-                break
-        descrambled = coding.descramble(decoded, seed)
-    psdu_bits = descrambled[16:16 + 8 * length]
-    return DecodedFrame(bits_to_bytes(psdu_bits), mcs, lts_start, cfo, n_sym)
+    depunct, n_code_bits = p[0], p[1]
+    decoded = coding.viterbi_decode(depunct, n_code_bits)
+    return _finish_frame(decoded, *p[2:])
 
 
 def decode_stream(samples: np.ndarray) -> List[DecodedFrame]:
@@ -196,6 +157,9 @@ def _prepare_frame(samples: np.ndarray, lts_start: int, cfo: float):
 
 def _finish_frame(decoded_bits: np.ndarray, mcs, length, lts_start, cfo,
                   n_sym) -> Optional[DecodedFrame]:
+    # the 16 SERVICE bits are zeros pre-scrambling: recover the TX seed by search
+    # (127 candidates × 16 bits; the reference derives it in closed form from the
+    # first 7 bits — exhaustive search is equivalent and robust)
     seed = 0b1011101
     for cand in range(1, 128):
         if not coding.descramble(decoded_bits[:16], cand).any():
