@@ -202,6 +202,16 @@ def test_agc_converges():
     assert abs(np.abs(y[-1000:]).mean() - 1.0) < 0.05
 
 
+def test_agc_block_mode():
+    x = (0.01 * np.exp(1j * 2 * np.pi * 0.01 * np.arange(60000))).astype(np.complex64)
+    m = Mocker(Agc(reference=1.0, adjustment_rate=2e-2, mode="block"))
+    m.input("in", x)
+    m.init_output("out", len(x))
+    m.run()
+    y = m.output("out")
+    assert abs(np.abs(y[-1000:]).mean() - 1.0) < 0.05
+
+
 def test_iir_block():
     b, a = sps.butter(2, 0.3)
     data = np.random.default_rng(6).standard_normal(10_000).astype(np.float32)
