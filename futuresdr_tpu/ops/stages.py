@@ -321,6 +321,23 @@ def channelizer_stage(n_channels: int, taps=None, name: str = "channelizer") -> 
     return Stage(fn, init_carry, Fraction(1, 1), np.complex64, N, name)
 
 
+def lora_demod_stage(sf: int, name: str = "lora_demod") -> Stage:
+    """LoRa dechirp + batched FFT + argmax as a stage: frames of k·2^sf complex chips →
+    k int32 symbol values (the `FftDemod` hot loop of the LoRa example, fused).
+    The downchirp is generated in-trace (no HBM table)."""
+    n = 1 << sf
+    k_idx = np.arange(n)
+    ph = 2 * np.pi * ((k_idx * k_idx) / (2 * n) + k_idx * (-0.5))
+    down = np.exp(-1j * ph).astype(np.complex64)    # conj(upchirp)
+
+    def fn(carry, x):
+        blocks = x.reshape(-1, n) * jnp.asarray(down)[None, :]
+        spec = jnp.abs(jnp.fft.fft(blocks, axis=1))
+        return carry, jnp.argmax(spec, axis=1).astype(jnp.int32)
+
+    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, n), np.int32, n, name)
+
+
 def agc_stage(reference: float = 1.0, rate: float = 0.1, block: int = 256,
               max_gain: float = 65536.0) -> Stage:
     """Block-floating AGC: per-sample gain feedback is inherently sequential, so the

@@ -85,6 +85,20 @@ def test_moving_avg_stage():
     assert abs(y[-frame_len:].mean() - 1.0) < 1e-3
 
 
+def test_lora_demod_stage():
+    from futuresdr_tpu.ops import lora_demod_stage
+    from futuresdr_tpu.models.lora.phy import _upchirp
+
+    sf = 7
+    n = 1 << sf
+    symbols = np.array([0, 17, 64, 127, 3, 99], dtype=np.int64)
+    sig = np.concatenate([_upchirp(n, int(s)) for s in symbols]).astype(np.complex64)
+    pipe = Pipeline([lora_demod_stage(sf)], np.complex64)
+    fn, carry = pipe.compile(len(sig))
+    _, out = fn(carry, sig)
+    np.testing.assert_array_equal(np.asarray(out), symbols)
+
+
 def test_channelizer_stage_matches_block():
     from futuresdr_tpu.ops import channelizer_stage
     from futuresdr_tpu.blocks.pfb import pfb_default_taps
