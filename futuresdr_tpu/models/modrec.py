@@ -17,7 +17,32 @@ from ..dsp import firdes
 from ..runtime.kernel import Kernel
 from ..types import Pmt
 
-__all__ = ["CLASSES", "synth_batch", "train", "ModClassifier"]
+__all__ = ["CLASSES", "synth_batch", "train", "ModClassifier", "load_pretrained"]
+
+_WEIGHTS_DIR = __import__("os").path.join(__import__("os").path.dirname(
+    __import__("os").path.abspath(__file__)), "weights")
+
+
+def load_pretrained(name: str = "mcldnn_v1"):
+    """Load the packaged pretrained MCLDNN (trained on the synthetic RadioML-style set,
+    `weights/<name>.json` records the architecture). Returns (model, params)."""
+    import json
+    import os
+
+    from ..utils import load_pytree
+    from .mcldnn import MCLDNN, init_params
+
+    cfg_path = os.path.join(_WEIGHTS_DIR, f"{name}.json")
+    ckpt_path = os.path.join(_WEIGHTS_DIR, name)
+    if not (os.path.exists(cfg_path) and os.path.exists(ckpt_path)):
+        raise FileNotFoundError(f"no pretrained weights {name!r} in {_WEIGHTS_DIR}")
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    model = MCLDNN(n_classes=cfg["n_classes"], conv_features=cfg["conv_features"],
+                   lstm_features=cfg["lstm_features"])
+    like = init_params(model, n=cfg["n"])
+    params = load_pytree(ckpt_path, like=like)
+    return model, params
 
 CLASSES = ["bpsk", "qpsk", "qam16", "fm", "noise"]
 

@@ -1,0 +1,44 @@
+"""Packaged pretrained MCLDNN: loads and classifies accurately out of the box
+(the burn example ships a trained model the same way)."""
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu.models.modrec import load_pretrained, synth_batch, CLASSES
+
+
+def test_pretrained_loads_and_classifies():
+    try:
+        model, params = load_pretrained()
+    except FileNotFoundError:
+        pytest.skip("no packaged weights")
+    from futuresdr_tpu.models.mcldnn import loss_fn
+
+    rng = np.random.default_rng(42)
+    X, y = synth_batch(rng, 256, 128, snr_db_range=(10.0, 20.0))
+    _, acc = loss_fn(model, params, X, y)
+    assert float(acc) > 0.9
+
+
+def test_pretrained_in_flowgraph_classifier():
+    try:
+        model, params = load_pretrained()
+    except FileNotFoundError:
+        pytest.skip("no packaged weights")
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSource
+    from futuresdr_tpu.models.modrec import ModClassifier, _psk_qam
+
+    rng = np.random.default_rng(1)
+    x = _psk_qam(rng, 64 * 128, "qpsk")
+    x = x / np.sqrt(np.mean(np.abs(x) ** 2))
+    sigma = np.sqrt(10 ** (-15 / 10) / 2)
+    x = (x + sigma * (rng.standard_normal(len(x))
+                      + 1j * rng.standard_normal(len(x)))).astype(np.complex64)
+    fg = Flowgraph()
+    src = VectorSource(x)
+    clf = ModClassifier(model, params, n=128, batch=8)
+    fg.connect_stream(src, "out", clf, "in")
+    Runtime().run(fg)
+    labels = [c for c, _ in clf.predictions]
+    assert labels and labels.count("qpsk") >= len(labels) * 0.7, labels
