@@ -68,7 +68,17 @@ def main(argv=None):
     p.add_argument("--cpu", action="store_true", help="use CPU blocks instead of TPU")
     p.add_argument("--ws-port", type=int, default=9001)
     p.add_argument("--samples", type=int, default=None)
+    p.add_argument("--autotune", action="store_true",
+                   help="sweep device frame sizes before starting")
     a = p.parse_args(argv)
+    if a.autotune and not a.cpu:
+        from ..tpu import autotune, instance
+        frame, depth, grid = autotune(
+            [fft_stage(a.fft), mag2_stage(), moving_avg_stage(a.fft, 0.1),
+             log10_stage()], np.complex64)
+        inst = instance()
+        inst.frame_size, inst.frames_in_flight = frame, depth
+        print(f"autotuned: frame={frame} depth={depth} ({grid})")
     src = SeifyBuilder().args(a.args).build_source()
     fg, _ = build_flowgraph(src, use_tpu=not a.cpu, fft_size=a.fft,
                             ws_port=a.ws_port, n_samples=a.samples)
