@@ -125,16 +125,19 @@ def decode_stream(samples: np.ndarray) -> List[DecodedFrame]:
 
 def _prepare_frame(samples: np.ndarray, lts_start: int, cfo: float):
     """Front half of decode_frame: everything up to the DATA Viterbi. Returns
-    (mother-code llrs, n_coded_bits, mcs, length) or None."""
+    (mother-code llrs, n_coded_bits, mcs, length) or None.
+
+    CFO correction is applied only to the spans actually demodulated (LTS+SIGNAL,
+    then the data symbols) — correcting the whole remaining stream per frame would
+    make multi-frame decoding O(stream²)."""
     data_start = lts_start + 128
     if data_start + SYM_LEN > len(samples):
         return None
+    head = samples[lts_start:data_start + SYM_LEN]
     if cfo != 0.0:
-        n = np.arange(len(samples) - lts_start)
-        samples = samples.copy()
-        samples[lts_start:] = samples[lts_start:] * np.exp(-1j * cfo * n)
-    H = ofdm.estimate_channel(samples, lts_start)
-    spec = ofdm.ofdm_demodulate_symbols(samples[data_start:], 1)
+        head = head * np.exp(-1j * cfo * np.arange(len(head)))
+    H = ofdm.estimate_channel(head, 0)
+    spec = ofdm.ofdm_demodulate_symbols(head[128:], 1)
     eq = ofdm.equalize(spec, H, symbol_offset=0)
     sig_llrs = ofdm.demap_llrs(eq.reshape(-1), "bpsk")
     sig_bits = coding.viterbi_decode(coding.deinterleave(sig_llrs, 48, 1), 24)
@@ -147,7 +150,11 @@ def _prepare_frame(samples: np.ndarray, lts_start: int, cfo: float):
     avail = (len(samples) - data_start - SYM_LEN) // SYM_LEN
     if n_sym > avail:
         return None
-    spec = ofdm.ofdm_demodulate_symbols(samples[data_start + SYM_LEN:], n_sym)
+    off = data_start + SYM_LEN
+    body = samples[off:off + n_sym * SYM_LEN]
+    if cfo != 0.0:
+        body = body * np.exp(-1j * cfo * (np.arange(len(body)) + (off - lts_start)))
+    spec = ofdm.ofdm_demodulate_symbols(body, n_sym)
     eq = ofdm.equalize(spec, H, symbol_offset=1)
     llrs = ofdm.demap_llrs(eq.reshape(-1), mcs.modulation)
     deint = coding.deinterleave(llrs, mcs.n_cbps, mcs.n_bpsc)
