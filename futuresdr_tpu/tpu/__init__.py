@@ -10,6 +10,7 @@ from .instance import TpuInstance, instance
 from .kernel_block import TpuKernel
 from .frames import TpuH2D, TpuStage, TpuD2H
 from .autotune import autotune
+from .sp_block import SpKernel
 
 __all__ = ["TpuInstance", "instance", "TpuKernel", "TpuH2D", "TpuStage", "TpuD2H",
-           "autotune"]
+           "autotune", "SpKernel"]

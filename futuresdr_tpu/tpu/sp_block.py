@@ -1,0 +1,89 @@
+"""SpKernel: a flowgraph block whose per-frame compute runs SPMD over the ICI mesh.
+
+This closes the loop between the actor runtime and the multi-chip layer: a stream block
+that time-shards each frame across ALL devices of a mesh (sequence parallelism with halo
+exchange, :mod:`futuresdr_tpu.parallel.stream_sp`), one collective per frame over ICI.
+With a 1-device mesh it degrades to a plain jit — the same flowgraph scales from laptop
+CPU to a TPU pod by swapping the mesh (SURVEY §2.7's scale-out story, realized).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Deque, Optional, Tuple
+
+import numpy as np
+
+from ..runtime.kernel import Kernel
+
+__all__ = ["SpKernel"]
+
+
+class SpKernel(Kernel):
+    """Stream block running ``sharded_fn`` (e.g. ``parallel.sp_fir_fft_mag2(...)``)
+    over ``mesh`` per frame; input frames are sharded over ``axis``, outputs gathered.
+
+    Note: the sharded stream ops are stateless ACROSS frames (halo exchange covers
+    intra-frame shard boundaries only) — filter history restarts at each frame edge.
+    Use frames ≫ taps (the default regime) or a stateful `TpuKernel` when exact
+    cross-frame continuity matters on one chip."""
+
+    BLOCKING = True
+
+    def __init__(self, sharded_fn: Callable, mesh, in_dtype, out_dtype,
+                 frame_size: int, ratio: float = 1.0, axis: str = "sp",
+                 frames_in_flight: int = 2):
+        super().__init__()
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        self._fn = jax.jit(sharded_fn)
+        self._in_sharding = NamedSharding(mesh, P(axis))
+        n_dev = mesh.shape[axis]
+        assert frame_size % n_dev == 0, "frame must divide the mesh axis"
+        self.frame_size = frame_size
+        self.out_frame = int(frame_size * ratio)
+        self.depth = frames_in_flight
+        self._inflight: Deque = deque()
+        self._pending: Optional[np.ndarray] = None
+        self.input = self.add_stream_input("in", in_dtype, min_items=frame_size)
+        self.output = self.add_stream_output(
+            "out", out_dtype, min_items=self.out_frame,
+            min_buffer_size=(self.depth + 1) * self.out_frame * np.dtype(out_dtype).itemsize)
+
+    def _dispatch(self, frame: np.ndarray) -> None:
+        import jax
+        x = jax.device_put(frame, self._in_sharding)   # scatter shards over the mesh
+        self._inflight.append(self._fn(x))
+
+    async def work(self, io, mio, meta):
+        if self._pending is not None:
+            out = self.output.slice()
+            k = min(len(out), len(self._pending))
+            out[:k] = self._pending[:k]
+            self.output.produce(k)
+            self._pending = self._pending[k:] if k < len(self._pending) else None
+            if self._pending is not None:
+                return
+        inp = self.input.slice()
+        while len(self._inflight) < self.depth and len(inp) >= self.frame_size:
+            self._dispatch(inp[:self.frame_size].copy())
+            self.input.consume(self.frame_size)
+            inp = self.input.slice()
+        eos = self.input.finished()
+        if self._inflight and (len(self._inflight) >= self.depth or eos):
+            result = np.asarray(self._inflight.popleft())    # gather + sync
+            out = self.output.slice()
+            k = min(len(out), len(result))
+            out[:k] = result[:k]
+            self.output.produce(k)
+            if k < len(result):
+                self._pending = result[k:].copy()
+            io.call_again = True
+            return
+        if eos and not self._inflight and self._pending is None:
+            # partial tail below one frame cannot shard; dropped at EOS
+            if self.input.available():
+                self.input.consume(self.input.available())
+            io.finished = True

@@ -107,6 +107,33 @@ def test_sharded_train_step_spmd():
     assert leaf.sharding is not None
 
 
+def test_sp_kernel_block_in_flowgraph():
+    """A flowgraph block computing SPMD over the virtual 8-device mesh."""
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSource, VectorSink
+    from futuresdr_tpu.tpu import SpKernel
+    from scipy import signal as sps
+
+    mesh = make_mesh(("sp",), shape=(8,))
+    taps = np.hanning(64).astype(np.float32)
+    fft_size = 128
+    frame = 8 * 8 * fft_size
+    fn = sp_fir_fft_mag2(taps, fft_size, mesh)
+    data = np.random.default_rng(3).standard_normal(4 * frame).astype(np.complex64)
+
+    fg = Flowgraph()
+    src = VectorSource(data)
+    spk = SpKernel(fn, mesh, np.complex64, np.float32, frame)
+    snk = VectorSink(np.float32)
+    fg.connect(src, spk, snk)
+    Runtime().run(fg)
+    got = snk.items()
+    assert len(got) == 4 * frame
+    filt = sps.lfilter(taps, 1.0, data[:frame])
+    ref = (np.abs(np.fft.fft(filt.reshape(-1, fft_size), axis=1)) ** 2).reshape(-1)
+    np.testing.assert_allclose(got[:frame], ref, rtol=1e-2, atol=1e-2)
+
+
 def test_graft_entry_points():
     import sys, os
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
