@@ -15,6 +15,7 @@ from ..dsp import firdes
 from ..dsp.kernels import (DecimatingFirFilter, FirFilter, IirFilter,
                            PolyphaseResamplingFir, Rotator)
 from ..runtime.kernel import Kernel, message_handler
+from ..runtime.tag import filter_tags
 from ..types import Pmt
 
 __all__ = ["Fir", "FirBuilder", "Iir", "Fft", "XlatingFir", "SignalSource",
@@ -102,6 +103,12 @@ class Fir(Kernel):
             y = self.core.process(inp[:n_in])
             assert len(y) <= len(out), "resampler produced more than negotiated"
             out[:len(y)] = y
+            # tag transport with rate-change index remapping (SURVEY §7 hard part:
+            # item metadata must survive decimation — `circular.rs:37-64` rebasing
+            # plus the sample-rate scale)
+            for t in filter_tags(self.input.tags(), n_in):
+                self.output.add_tag(min(t.index * self.interp // self.decim,
+                                        max(len(y) - 1, 0)), t.tag)
             self.input.consume(n_in)
             self.output.produce(len(y))
         if self.input.finished() and n_in == len(inp):

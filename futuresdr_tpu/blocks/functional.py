@@ -215,6 +215,8 @@ class ApplyNM(Kernel):
         k = min(len(inp) // self.n, len(out) // self.m)
         if k > 0:
             out[:k * self.m] = self.f(inp[:k * self.n])
+            for t in filter_tags(self.input.tags(), k * self.n):
+                self.output.add_tag(t.index * self.m // self.n, t.tag)
             self.input.consume(k * self.n)
             self.output.produce(k * self.m)
         if self.input.finished() and len(inp) - k * self.n < self.n:

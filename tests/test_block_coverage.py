@@ -182,6 +182,43 @@ def test_finite_source():
     assert len(snk.items()) == 1000
 
 
+def test_tags_remap_through_decimation():
+    """Tag indices scale by the rate change through a decimating FIR (SURVEY hard part)."""
+    from futuresdr_tpu import Kernel
+    from futuresdr_tpu.blocks import Fir, TagDebug
+    from futuresdr_tpu.dsp import firdes
+
+    class TaggingSource(Kernel):
+        def __init__(self):
+            super().__init__()
+            self.output = self.add_stream_output("out", np.complex64)
+            self._sent = False
+
+        async def work(self, io, mio, meta):
+            if self._sent:
+                io.finished = True
+                return
+            out = self.output.slice()
+            n = min(4000, len(out))
+            out[:n] = 0
+            self.output.add_tag(400, Tag.named_usize("marker", 1))
+            self.output.add_tag(2000, Tag.named_usize("marker", 2))
+            self.output.produce(n)
+            self._sent = True
+            io.call_again = True
+
+    fg = Flowgraph()
+    src = TaggingSource()
+    fir = Fir(firdes.lowpass(0.1, 32), np.complex64, decim=4)
+    dbg = TagDebug(np.complex64, "decim")
+    snk = VectorSink(np.complex64)
+    fg.connect(src, fir, dbg, snk)
+    Runtime().run(fg)
+    idx = sorted(t.index for t in dbg.seen)
+    assert len(idx) == 2
+    assert abs(idx[0] - 100) <= 2 and abs(idx[1] - 500) <= 2
+
+
 def test_tags_flow_through_chain():
     from futuresdr_tpu import Kernel
 
