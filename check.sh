@@ -1,0 +1,27 @@
+#!/bin/bash
+# Repo health check (reference: check.sh — fmt/clippy/test across targets).
+# Runs: native C++ tests, the Python suite on the virtual 8-device CPU mesh, and the
+# driver entry validation (single-chip compile + multi-chip sharding dry-run).
+set -e
+cd "$(dirname "$0")"
+
+echo "== native =="
+make -C native test
+
+echo "== python suite =="
+python -m pytest tests/ -q
+
+echo "== graft entries =="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, ".")
+from __graft_entry__ import entry, dryrun_multichip
+fn, args = entry()
+jax.jit(fn)(*args)
+dryrun_multichip(8)
+print("entry + dryrun_multichip(8): OK")
+EOF
+
+echo "ALL CHECKS PASSED"

@@ -51,7 +51,7 @@ class VectorSource(Kernel):
 class VectorSink(Kernel):
     """Collect everything; final state readable after ``run`` (`tests/flowgraph.rs:63-70`)."""
 
-    def __init__(self, dtype, capacity: int = 0):
+    def __init__(self, dtype):
         super().__init__()
         self.input = self.add_stream_input("in", dtype)
         self._chunks: List[np.ndarray] = []

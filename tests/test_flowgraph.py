@@ -25,7 +25,8 @@ def test_copy_chain_zeros():
 
 
 def test_random_bit_exact():
-    data = np.random.default_rng(42).random(1_000_000).astype(np.float32)
+    # 10M random f32, bit-exact through the runtime (`tests/flowgraph.rs:147-172`)
+    data = np.random.default_rng(42).random(10_000_000).astype(np.float32)
     fg = Flowgraph()
     src = VectorSource(data)
     mid = CopyRand(np.float32, max_copy=4096)
