@@ -12,8 +12,8 @@ from typing import Optional
 
 import numpy as np
 
-from ..blocks import (SeifyBuilder, XlatingFir, QuadratureDemod, Fir, FirBuilder,
-                      AudioSink, WavSink, Head, NullSink)
+from ..blocks import (SeifyBuilder, XlatingFir, QuadratureDemod, Fir, WavSink,
+                      Head, NullSink)
 from ..dsp import firdes
 from ..runtime import Flowgraph, Runtime
 

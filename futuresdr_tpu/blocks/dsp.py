@@ -7,7 +7,7 @@ lives in :mod:`futuresdr_tpu.tpu`.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Union
+from typing import Optional
 
 import numpy as np
 

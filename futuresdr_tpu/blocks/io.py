@@ -8,7 +8,6 @@ runtime is an asyncio actor system, so the reference's async-std sockets map 1:1
 from __future__ import annotations
 
 import asyncio
-import os
 from typing import Optional
 
 import numpy as np

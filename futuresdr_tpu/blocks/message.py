@@ -10,7 +10,7 @@ import asyncio
 from typing import Callable, List, Optional
 
 from ..runtime.kernel import Kernel, message_handler
-from ..types import Pmt, PmtKind
+from ..types import Pmt
 
 __all__ = ["MessageAnnotator", "MessageApply", "MessageBurst", "MessageCopy",
            "MessagePipe", "MessageSink", "MessageSource"]

@@ -12,7 +12,7 @@ each output runs at ``fs/N`` (critically sampled).
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 from scipy.signal import lfilter

@@ -7,14 +7,14 @@ fluent ``Builder``. Multi-channel RX maps to multiple output ports.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 
 from ..hw import Device
 from ..log import logger
 from ..runtime.kernel import Kernel, message_handler
-from ..types import Pmt, PmtKind
+from ..types import Pmt
 
 __all__ = ["SeifySource", "SeifySink", "SeifyBuilder"]
 

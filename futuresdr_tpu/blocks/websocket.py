@@ -7,11 +7,8 @@ subscribe to these.
 
 from __future__ import annotations
 
-import asyncio
 import json
-from typing import Optional, Set
-
-import numpy as np
+from typing import Set
 
 from ..log import logger
 from ..runtime.kernel import Kernel, message_handler

@@ -6,8 +6,6 @@ distribution story (SURVEY §2.7): PUB/SUB sample streams between runtimes.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from ..log import logger

@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Optional
 
 import numpy as np
-from scipy.signal import lfilter, lfilter_zi
+from scipy.signal import lfilter
 
 __all__ = ["FirFilter", "DecimatingFirFilter", "PolyphaseResamplingFir", "IirFilter",
            "Rotator"]

@@ -7,7 +7,7 @@ Re-design of the reference ADS-B example (``examples/adsb/src/``: ``PreambleDete
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 

@@ -8,7 +8,7 @@ with root-raised-cosine shaping; frames start with a known 16-bit sync word.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 

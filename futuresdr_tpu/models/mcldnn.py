@@ -13,11 +13,8 @@ Input: ``[batch, 2, n]`` float32 (I/Q rows), e.g. n=128 RadioML-style snippets.
 
 from __future__ import annotations
 
-from typing import Any, Tuple
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from flax import linen as nn
 

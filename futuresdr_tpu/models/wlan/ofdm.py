@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from .consts import (CP_LEN, DATA_CARRIERS, FFT_SIZE, LTS_FREQ, MODULATION_TABLES,
-                     N_DATA_CARRIERS, PILOT_CARRIERS, PILOT_POLARITY, PILOT_VALUES,
+                     PILOT_CARRIERS, PILOT_POLARITY, PILOT_VALUES,
                      SYM_LEN, lts_time, sts_time)
 
 __all__ = ["map_bits", "demap_llrs", "ofdm_modulate", "ofdm_demodulate_symbols",

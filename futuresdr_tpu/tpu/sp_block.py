@@ -10,7 +10,7 @@ CPU to a TPU pod by swapping the mesh (SURVEY §2.7's scale-out story, realized)
 from __future__ import annotations
 
 from collections import deque
-from typing import Callable, Deque, Optional, Tuple
+from typing import Callable, Deque, Optional
 
 import numpy as np
 
