@@ -162,16 +162,19 @@ def _prepare_frame(samples: np.ndarray, lts_start: int, cfo: float):
     return depunct, n_sym * mcs.n_dbps, mcs, length, lts_start, cfo, n_sym
 
 
+_SEED_TABLE: Optional[np.ndarray] = None   # [127, 16] keystream prefixes for seeds 1..127
+
+
 def _finish_frame(decoded_bits: np.ndarray, mcs, length, lts_start, cfo,
                   n_sym) -> Optional[DecodedFrame]:
-    # the 16 SERVICE bits are zeros pre-scrambling: recover the TX seed by search
-    # (127 candidates × 16 bits; the reference derives it in closed form from the
-    # first 7 bits — exhaustive search is equivalent and robust)
-    seed = 0b1011101
-    for cand in range(1, 128):
-        if not coding.descramble(decoded_bits[:16], cand).any():
-            seed = cand
-            break
+    # the 16 SERVICE bits are zeros pre-scrambling: recover the TX seed by matching
+    # the received prefix against all 127 keystream prefixes at once (the reference
+    # derives it in closed form from the first 7 bits — equivalent, vectorized)
+    global _SEED_TABLE
+    if _SEED_TABLE is None:
+        _SEED_TABLE = np.stack([coding._keystream(s)[:16] for s in range(1, 128)])
+    match = np.nonzero((_SEED_TABLE == decoded_bits[None, :16]).all(axis=1))[0]
+    seed = int(match[0]) + 1 if len(match) else 0b1011101
     descrambled = coding.descramble(decoded_bits, seed)
     psdu_bits = descrambled[16:16 + 8 * length]
     return DecodedFrame(bits_to_bytes(psdu_bits), mcs, lts_start, cfo, n_sym)

@@ -1,0 +1,31 @@
+"""Backend liveness helper: probe device init in a subprocess before touching jax.
+
+The axon TPU tunnel can wedge so that the first ``jax.devices()`` blocks indefinitely —
+and the plugin hooks backend init such that only ``jax.config.update('jax_platforms',
+'cpu')`` (not the env var) avoids it. Tools that want "TPU if alive, else CPU" call
+:func:`ensure_backend` before their first jax use.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+__all__ = ["ensure_backend"]
+
+
+def ensure_backend(probe_timeout: int = 120) -> str:
+    """Returns the platform that will be used ("tpu-like" native platform or "cpu")."""
+    code = "import jax; jax.devices(); print('ok')"
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=probe_timeout,
+                           capture_output=True, text=True)
+        alive = r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        alive = False
+    if not alive:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+    import jax
+    return jax.devices()[0].platform

@@ -30,6 +30,8 @@ def main():
                    help="batched Viterbi (one lax.scan for all frames)")
     a = p.parse_args()
     if a.batch:
+        from futuresdr_tpu.utils.backend import ensure_backend
+        print(f"# backend: {ensure_backend()}", file=sys.stderr)
         import jax
         jax.devices()   # init backend so the scan decoder engages
 
