@@ -1,0 +1,78 @@
+"""Edge cases: EOS partial frames through device paths, rate-changing TpuKernel EOS,
+empty streams, zero-length messages."""
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu import Flowgraph, Runtime, Pmt
+from futuresdr_tpu.blocks import VectorSource, VectorSink
+from futuresdr_tpu.dsp import firdes
+from futuresdr_tpu.ops import fir_stage, fft_stage, mag2_stage
+from futuresdr_tpu.tpu import TpuKernel
+
+
+def test_tpu_kernel_eos_partial_frame():
+    """A stream that is NOT a frame multiple still flushes its valid tail."""
+    taps = np.zeros(16, np.float32)
+    taps[0] = 1.0
+    n = 10_000                      # frame 4096 → 2 full frames + 1808 tail
+    data = np.arange(n, dtype=np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    tk = TpuKernel([fir_stage(taps, fft_len=512)], np.float32, frame_size=4096)
+    snk = VectorSink(np.float32)
+    fg.connect(src, tk, snk)
+    Runtime().run(fg)
+    got = snk.items()
+    # valid tail = floor to frame_multiple (hop 256): 1808 → 1792
+    assert len(got) == 8192 + 1792
+    np.testing.assert_allclose(got, data[:len(got)], rtol=1e-4, atol=1e-3)
+
+
+def test_tpu_kernel_rate_change_eos():
+    n_fft = 64
+    n = 5 * 1024 + 100              # not a frame multiple
+    data = np.exp(1j * 2 * np.pi * 0.25 * np.arange(n)).astype(np.complex64)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    tk = TpuKernel([fft_stage(n_fft), mag2_stage()], np.complex64, frame_size=1024)
+    snk = VectorSink(np.float32)
+    fg.connect(src, tk, snk)
+    Runtime().run(fg)
+    got = snk.items()
+    assert len(got) == 5 * 1024 + 64    # 100 → 64 valid at the fft multiple
+    assert np.argmax(got[:n_fft]) == 16
+
+
+def test_tpu_kernel_stream_shorter_than_frame():
+    data = np.ones(100, np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    tk = TpuKernel([fir_stage(np.ones(4, np.float32), fft_len=64)], np.float32,
+                   frame_size=4096)
+    snk = VectorSink(np.float32)
+    fg.connect(src, tk, snk)
+    Runtime().run(fg)
+    got = snk.items()
+    assert len(got) == 96               # 100 floored to hop 32
+    np.testing.assert_allclose(got[4:90], 4.0, rtol=1e-4)
+
+
+def test_empty_vector_source():
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(0, np.float32))
+    snk = VectorSink(np.float32)
+    fg.connect(src, snk)
+    Runtime().run(fg)
+    assert len(snk.items()) == 0
+
+
+def test_empty_blob_message():
+    from futuresdr_tpu.blocks import MessageBurst, MessageSink
+    fg = Flowgraph()
+    burst = MessageBurst(Pmt.blob(b""), 3)
+    snk = MessageSink()
+    fg.connect_message(burst, "out", snk, "in")
+    Runtime().run(fg)
+    assert len(snk.received) == 3
+    assert all(p.to_blob() == b"" for p in snk.received)
