@@ -100,31 +100,35 @@ def depuncture(llrs: np.ndarray, rate: str) -> np.ndarray:
     return full[:2 * (len(full) // 2)]
 
 
+_PERM_CACHE: dict = {}
+
+
+def _interleaver_perms(n_cbps: int, n_bpsc: int):
+    key = (n_cbps, n_bpsc)
+    if key not in _PERM_CACHE:
+        s = max(n_bpsc // 2, 1)
+        k = np.arange(n_cbps)
+        i = (n_cbps // 16) * (k % 16) + k // 16
+        j = s * (i // s) + (i + n_cbps - (16 * i // n_cbps)) % s
+        perm = np.empty(n_cbps, dtype=np.int64)
+        perm[j] = k              # output position j takes input bit k
+        _PERM_CACHE[key] = (perm, j)
+    return _PERM_CACHE[key]
+
+
 def interleave(bits: np.ndarray, n_cbps: int, n_bpsc: int) -> np.ndarray:
-    """Two-permutation block interleaver (Clause 17.3.5.7), one OFDM symbol per block."""
-    s = max(n_bpsc // 2, 1)
-    k = np.arange(n_cbps)
-    i = (n_cbps // 16) * (k % 16) + k // 16
-    j = s * (i // s) + (i + n_cbps - (16 * i // n_cbps)) % s
-    perm = np.empty(n_cbps, dtype=np.int64)
-    perm[j] = k              # output position j takes input bit k
-    out = np.empty_like(bits)
-    for blk in range(len(bits) // n_cbps):
-        seg = bits[blk * n_cbps:(blk + 1) * n_cbps]
-        out[blk * n_cbps:(blk + 1) * n_cbps] = seg[perm]
-    return out
+    """Two-permutation block interleaver (Clause 17.3.5.7), vectorized over all
+    OFDM symbols at once."""
+    perm, _ = _interleaver_perms(n_cbps, n_bpsc)
+    return bits.reshape(-1, n_cbps)[:, perm].reshape(-1)
 
 
 def deinterleave(vals: np.ndarray, n_cbps: int, n_bpsc: int) -> np.ndarray:
-    s = max(n_bpsc // 2, 1)
-    k = np.arange(n_cbps)
-    i = (n_cbps // 16) * (k % 16) + k // 16
-    j = s * (i // s) + (i + n_cbps - (16 * i // n_cbps)) % s
-    out = np.empty_like(vals)
-    for blk in range(len(vals) // n_cbps):
-        seg = vals[blk * n_cbps:(blk + 1) * n_cbps]
-        out[blk * n_cbps + k] = seg[j]
-    return out
+    _, j = _interleaver_perms(n_cbps, n_bpsc)
+    out = np.empty_like(vals.reshape(-1, n_cbps))
+    out[:, :] = vals.reshape(-1, n_cbps)[:, j]
+    # out[blk, k] = vals[blk, j[k]] gives position k the bit that interleaving put at j[k]
+    return out.reshape(-1)
 
 
 # predecessor tables: for next-state t, the two (prev_state, input) candidates, plus
