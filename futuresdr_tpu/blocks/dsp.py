@@ -170,13 +170,22 @@ class Fft(Kernel):
     normalization, runtime-switchable ``fft_size`` message port."""
 
     def __init__(self, fft_size: int = 2048, direction: str = "forward",
-                 shift: bool = False, normalize: bool = False, dtype=np.complex64):
+                 shift: bool = False, normalize: bool = False, dtype=np.complex64,
+                 window=None):
+        """``window``: optional name ("hann", "blackman", …) or array applied per
+        frame before a forward FFT (spectral-leakage control for spectrum display)."""
         super().__init__()
         assert direction in ("forward", "inverse")
         self.fft_size = int(fft_size)
         self.direction = direction
         self.shift = shift
         self.normalize = normalize
+        if window is not None:
+            from ..dsp.windows import get_window
+            window = np.asarray(window) if not isinstance(window, str) \
+                else get_window(window, self.fft_size)
+            assert len(window) == self.fft_size
+        self.window = window
         self.input = self.add_stream_input("in", dtype, min_items=self.fft_size)
         self.output = self.add_stream_output("out", dtype, min_items=self.fft_size)
 
@@ -196,6 +205,8 @@ class Fft(Kernel):
         if k > 0:
             frames = inp[:k * n].reshape(k, n)
             if self.direction == "forward":
+                if self.window is not None:
+                    frames = frames * self.window[None, :]
                 y = np.fft.fft(frames, axis=1)
             else:
                 y = np.fft.ifft(frames, axis=1) * n   # match reference's unscaled inverse

@@ -205,12 +205,22 @@ def decimate_stage(decim: int) -> Stage:
 
 
 def fft_stage(n: int, direction: str = "forward", shift: bool = False,
-              normalize: bool = False) -> Stage:
-    """Batched frame FFT: input frame reshaped [-1, n], transformed on axis 1."""
+              normalize: bool = False, window=None) -> Stage:
+    """Batched frame FFT: input frame reshaped [-1, n], transformed on axis 1.
+    ``window``: optional name/array applied per frame before a forward FFT."""
+    if window is not None:
+        from ..dsp.windows import get_window
+        window = np.asarray(window, dtype=np.float32) if not isinstance(window, str) \
+            else get_window(window, n).astype(np.float32)
 
     def fn(carry, x):
         f = x.reshape(-1, n)
-        y = jnp.fft.fft(f, axis=1) if direction == "forward" else jnp.fft.ifft(f, axis=1) * n
+        if direction == "forward":
+            if window is not None:
+                f = f * jnp.asarray(window)[None, :]
+            y = jnp.fft.fft(f, axis=1)
+        else:
+            y = jnp.fft.ifft(f, axis=1) * n
         if normalize:
             y = y / jnp.sqrt(n)
         if shift:

@@ -85,6 +85,23 @@ def test_fft_shift_and_normalize():
     assert abs(np.max(np.abs(out)) - n / np.sqrt(n)) < 1e-3
 
 
+def test_fft_window_reduces_leakage():
+    n = 256
+    # off-bin tone: rectangular FFT leaks broadly; a Hann window concentrates it
+    tone = np.exp(1j * 2 * np.pi * (10.5 / n) * np.arange(n)).astype(np.complex64)
+    rect = Mocker(Fft(n))
+    rect.input("in", tone)
+    rect.init_output("out", n)
+    rect.run()
+    hann = Mocker(Fft(n, window="hann"))
+    hann.input("in", tone)
+    hann.init_output("out", n)
+    hann.run()
+    far_rect = np.abs(rect.output("out"))[100:150].max()
+    far_hann = np.abs(hann.output("out"))[100:150].max()
+    assert far_hann < far_rect / 10
+
+
 def test_signal_source_tone():
     fs, f = 48000.0, 1000.0
     fg = Flowgraph()
