@@ -29,8 +29,17 @@ def map_bits(bits: np.ndarray, modulation: str) -> np.ndarray:
 
 
 def demap_llrs(symbols: np.ndarray, modulation: str) -> np.ndarray:
-    """Max-log soft demapping: LLR per bit, positive ⇒ bit 1 (vectorized over the
-    constellation — 64-point table distance matrix, MXU-shaped on the TPU path)."""
+    """Max-log soft demapping: LLR per bit, positive ⇒ bit 1. BPSK/QPSK use the
+    closed-form max-log expressions; higher orders the vectorized distance matrix
+    (64-point table — MXU-shaped on the TPU path)."""
+    if modulation == "bpsk":
+        return 4.0 * symbols.real
+    if modulation == "qpsk":
+        a = 4.0 / np.sqrt(2)
+        out = np.empty((len(symbols), 2))
+        out[:, 0] = a * symbols.real
+        out[:, 1] = a * symbols.imag
+        return out.reshape(-1)
     table = MODULATION_TABLES[modulation]
     n_bpsc = int(np.log2(len(table)))
     d = -np.abs(symbols[:, None] - table[None, :]) ** 2    # [n, M] log-likelihoods
