@@ -133,13 +133,18 @@ for s in range(_NS):
         _NXT[s, b] = reg >> 1
 
 
+_G1_KERNEL = np.array([(_G1 >> (4 - j)) & 1 for j in range(5)], dtype=np.uint8)
+_G2_KERNEL = np.array([(_G2 >> (4 - j)) & 1 for j in range(5)], dtype=np.uint8)
+
+
 def conv_encode_m17(bits: np.ndarray) -> np.ndarray:
+    """K=5 rate-1/2 encode as two vectorized GF(2) convolutions."""
+    bits = np.asarray(bits, dtype=np.uint8)
+    a = np.convolve(bits, _G1_KERNEL)[:len(bits)] & 1
+    b = np.convolve(bits, _G2_KERNEL)[:len(bits)] & 1
     out = np.empty(2 * len(bits), dtype=np.uint8)
-    s = 0
-    for i, b in enumerate(bits):
-        out[2 * i] = _OUT[s, b, 0]
-        out[2 * i + 1] = _OUT[s, b, 1]
-        s = _NXT[s, b]
+    out[0::2] = a
+    out[1::2] = b
     return out
 
 

@@ -64,14 +64,23 @@ for s in range(_NSTATES):
         _NEXT[s, b] = reg >> 1
 
 
+# generator taps as convolution kernels (newest input at the shift-register MSB, so
+# the kernel is the generator's bits reversed)
+_G0_KERNEL = np.array([(_G0 >> (6 - j)) & 1 for j in range(7)], dtype=np.uint8)
+_G1_KERNEL = np.array([(_G1 >> (6 - j)) & 1 for j in range(7)], dtype=np.uint8)
+
+
 def conv_encode(bits: np.ndarray) -> np.ndarray:
-    """Rate-1/2 convolutional encode; output interleaved [a0, b0, a1, b1, …]."""
+    """Rate-1/2 convolutional encode; output interleaved [a0, b0, a1, b1, …].
+
+    Convolutional coding IS a GF(2) convolution — one vectorized ``np.convolve`` per
+    generator instead of the reference's per-bit shift-register loop."""
+    bits = np.asarray(bits, dtype=np.uint8)
+    a = np.convolve(bits, _G0_KERNEL)[:len(bits)] & 1
+    b = np.convolve(bits, _G1_KERNEL)[:len(bits)] & 1
     out = np.empty(2 * len(bits), dtype=np.uint8)
-    s = 0
-    for i, b in enumerate(bits):
-        out[2 * i] = _OUT0[s, b]
-        out[2 * i + 1] = _OUT1[s, b]
-        s = _NEXT[s, b]
+    out[0::2] = a
+    out[1::2] = b
     return out
 
 
