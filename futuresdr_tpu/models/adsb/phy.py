@@ -40,26 +40,28 @@ def detect_and_demodulate(mag: np.ndarray, threshold: float = 3.0
     """
     n = len(mag)
     frames = []
-    tpl_on = _PREAMBLE_CHIPS > 0
-    i = 0
+    if n < 16 + 112 * 2:
+        return frames
+    tpl_on = np.flatnonzero(_PREAMBLE_CHIPS > 0)
+    tpl_off = np.flatnonzero(_PREAMBLE_CHIPS == 0)
     noise = np.median(mag) + 1e-9
-    while i + 16 + 112 * 2 <= n:
-        win = mag[i:i + 16]
-        on = win[tpl_on]
-        off = win[~tpl_on]
-        if on.min() > threshold * noise and on.min() > 1.5 * (off.mean() + 1e-12):
-            start = i
-            bits_start = start + 16
-            raw = mag[bits_start:bits_start + 112 * 2]
-            if len(raw) < 112 * 2:
-                break
-            pairs = raw.reshape(112, 2)
-            bits = (pairs[:, 0] > pairs[:, 1]).astype(np.uint8)
-            df = int((bits[0] << 4) | (bits[1] << 3) | (bits[2] << 2)
-                     | (bits[3] << 1) | bits[4])
-            n_bits = 112 if df >= 16 else 56
-            frames.append((start, bits[:n_bits]))
-            i = bits_start + n_bits * 2
-        else:
-            i += 1
+    # vectorized preamble metric over every start position
+    limit = n - (16 + 112 * 2) + 1
+    win = np.lib.stride_tricks.sliding_window_view(mag, 16)[:limit]
+    on_min = win[:, tpl_on].min(axis=1)
+    off_mean = win[:, tpl_off].mean(axis=1)
+    cand = np.flatnonzero((on_min > threshold * noise)
+                          & (on_min > 1.5 * (off_mean + 1e-12)))
+    next_free = 0
+    for start in cand:
+        if start < next_free:
+            continue
+        bits_start = start + 16
+        pairs = mag[bits_start:bits_start + 112 * 2].reshape(112, 2)
+        bits = (pairs[:, 0] > pairs[:, 1]).astype(np.uint8)
+        df = int((bits[0] << 4) | (bits[1] << 3) | (bits[2] << 2)
+                 | (bits[3] << 1) | bits[4])
+        n_bits = 112 if df >= 16 else 56
+        frames.append((int(start), bits[:n_bits]))
+        next_free = bits_start + n_bits * 2
     return frames
