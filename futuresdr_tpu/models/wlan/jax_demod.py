@@ -1,0 +1,79 @@
+"""Jitted OFDM body demodulation: CFO → batched FFT → equalize → CPE → max-log demap.
+
+Completes the XLA residency of the WLAN RX hot path (detection and SIGNAL stay host-side;
+Viterbi already runs as a lax.scan): all data symbols of a frame demap in one jit call,
+bucketed by symbol count and cached per modulation. Constant tables (constellation,
+carrier indices) are passed as device arguments rather than embedded constants (the axon
+backend mis-compiles some large embedded constants).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from .consts import (CP_LEN, DATA_CARRIERS, FFT_SIZE, MODULATION_TABLES,
+                     PILOT_CARRIERS, PILOT_VALUES, SYM_LEN)
+
+__all__ = ["demod_body_jax"]
+
+_DATA_IDX = (DATA_CARRIERS % FFT_SIZE).astype(np.int32)
+_PIL_IDX = (PILOT_CARRIERS % FFT_SIZE).astype(np.int32)
+
+
+@lru_cache(maxsize=None)
+def _compiled(modulation: str, bucket: int):
+    import jax
+    import jax.numpy as jnp
+
+    table = MODULATION_TABLES[modulation].astype(np.complex64)
+    n_bpsc = int(np.log2(len(table)))
+    idx = np.arange(len(table))
+    one_masks = np.stack([((idx >> b) & 1).astype(np.float32)
+                          for b in range(n_bpsc)])            # [n_bpsc, M]
+
+    @jax.jit
+    def run(body, H, pol, sym_mask, cfo, phase0, tbl, data_idx, pil_idx, masks):
+        k = jnp.arange(bucket * SYM_LEN)
+        x = body * jnp.exp(-1j * cfo * (k + phase0))
+        sym = x.reshape(bucket, SYM_LEN)[:, CP_LEN:]
+        spec = jnp.fft.fft(sym, axis=1)
+        eq = spec / H[None, :]
+        pilots = eq[:, pil_idx]
+        expected = jnp.asarray(PILOT_VALUES)[None, :] * pol[:, None]
+        cpe = jnp.angle((pilots * jnp.conj(expected)).sum(axis=1))
+        eq = eq * jnp.exp(-1j * cpe)[:, None]
+        data = eq[:, data_idx]                                # [bucket, 48]
+        d = -jnp.abs(data[..., None] - tbl[None, None, :]) ** 2  # [bucket, 48, M]
+        big = 1e30
+        # per-bit max-log: max over set-bit points minus max over clear-bit points
+        llrs = []
+        for b in range(n_bpsc):
+            m = masks[b][None, None, :]
+            l1 = jnp.max(jnp.where(m > 0, d, -big), axis=2)
+            l0 = jnp.max(jnp.where(m > 0, -big, d), axis=2)
+            llrs.append(l1 - l0)
+        out = jnp.stack(llrs, axis=2).reshape(bucket, -1)     # [bucket, 48*n_bpsc]
+        return (out * sym_mask[:, None]).reshape(-1)
+
+    consts = (table, _DATA_IDX, _PIL_IDX, one_masks)
+    return run, consts
+
+
+def demod_body_jax(body: np.ndarray, H: np.ndarray, n_sym: int, symbol_offset: int,
+                   cfo: float, phase0: float, modulation: str) -> np.ndarray:
+    """Returns raw LLRs for ``n_sym`` symbols ([n_sym·n_cbps]); ``body`` holds exactly
+    n_sym·80 samples (un-CFO-corrected); bucket padding handled internally."""
+    from .consts import PILOT_POLARITY
+
+    bucket = max(4, 1 << int(np.ceil(np.log2(max(n_sym, 1)))))
+    run, consts = _compiled(modulation, bucket)
+    padded = np.zeros(bucket * SYM_LEN, dtype=np.complex64)
+    padded[:n_sym * SYM_LEN] = body
+    pol = PILOT_POLARITY[(symbol_offset + np.arange(bucket)) % len(PILOT_POLARITY)]
+    mask = (np.arange(bucket) < n_sym).astype(np.float32)
+    out = np.asarray(run(padded, H.astype(np.complex64), pol.astype(np.float32),
+                         mask, np.float32(cfo), np.float32(phase0), *consts))
+    n_bpsc = int(np.log2(len(MODULATION_TABLES[modulation])))
+    return out[:n_sym * 48 * n_bpsc]

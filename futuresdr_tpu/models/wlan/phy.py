@@ -152,11 +152,24 @@ def _prepare_frame(samples: np.ndarray, lts_start: int, cfo: float):
         return None
     off = data_start + SYM_LEN
     body = samples[off:off + n_sym * SYM_LEN]
-    if cfo != 0.0:
-        body = body * np.exp(-1j * cfo * (np.arange(len(body)) + (off - lts_start)))
-    spec = ofdm.ofdm_demodulate_symbols(body, n_sym)
-    eq = ofdm.equalize(spec, H, symbol_offset=1)
-    llrs = ofdm.demap_llrs(eq.reshape(-1), mcs.modulation)
+    use_jax = False
+    if n_sym >= 8:
+        try:
+            from ...ops.viterbi import backend_ready
+            use_jax = backend_ready()
+        except Exception:       # pragma: no cover
+            pass
+    if use_jax:
+        # the whole body demod (CFO, batched FFT, equalize, CPE, demap) in one jit
+        from .jax_demod import demod_body_jax
+        llrs = demod_body_jax(body, H, n_sym, 1, cfo, off - lts_start, mcs.modulation)
+    else:
+        if cfo != 0.0:
+            body = body * np.exp(-1j * cfo * (np.arange(len(body))
+                                              + (off - lts_start)))
+        spec = ofdm.ofdm_demodulate_symbols(body, n_sym)
+        eq = ofdm.equalize(spec, H, symbol_offset=1)
+        llrs = ofdm.demap_llrs(eq.reshape(-1), mcs.modulation)
     deint = coding.deinterleave(llrs, mcs.n_cbps, mcs.n_bpsc)
     depunct = coding.depuncture(deint, mcs.coding_rate)
     return depunct, n_sym * mcs.n_dbps, mcs, length, lts_start, cfo, n_sym
