@@ -1,0 +1,69 @@
+"""Property-style fuzz: random nested Pmts survive the JSON wire format, and the REST
+call-by-index path resolves handlers positionally."""
+
+import json
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu.types import Pmt, PmtKind
+
+
+def _random_pmt(rng, depth=0):
+    kinds = ["null", "bool", "int", "float", "str", "blob", "vecf32", "veccf32"]
+    if depth < 2:
+        kinds += ["vec", "map"]
+    k = rng.choice(kinds)
+    if k == "null":
+        return Pmt.null()
+    if k == "bool":
+        return Pmt.bool_(bool(rng.integers(2)))
+    if k == "int":
+        return Pmt.isize(int(rng.integers(-2**40, 2**40)))
+    if k == "float":
+        return Pmt.f64(float(rng.standard_normal()))
+    if k == "str":
+        return Pmt.string("".join(chr(rng.integers(32, 127)) for _ in range(8)))
+    if k == "blob":
+        return Pmt.blob(bytes(rng.integers(0, 256, rng.integers(0, 32),
+                                           dtype=np.uint8)))
+    if k == "vecf32":
+        return Pmt.vec_f32(rng.standard_normal(rng.integers(0, 16)).astype(np.float32))
+    if k == "veccf32":
+        n = rng.integers(0, 8)
+        return Pmt.vec_cf32((rng.standard_normal(n)
+                             + 1j * rng.standard_normal(n)).astype(np.complex64))
+    if k == "vec":
+        return Pmt(PmtKind.VEC_PMT, tuple(_random_pmt(rng, depth + 1)
+                                          for _ in range(rng.integers(0, 4))))
+    return Pmt(PmtKind.MAP_STR_PMT,
+               {f"k{i}": _random_pmt(rng, depth + 1)
+                for i in range(rng.integers(0, 4))})
+
+
+def test_pmt_json_fuzz_roundtrip():
+    rng = np.random.default_rng(0)
+    for _ in range(300):
+        p = _random_pmt(rng)
+        wire = json.dumps(p.to_json())
+        q = Pmt.from_json(json.loads(wire))
+        assert q == p, f"roundtrip mismatch for {p!r} -> {q!r}"
+
+
+def test_handler_call_by_index():
+    """Handlers are addressable positionally (REST /call/{int}/ route semantics)."""
+    import asyncio
+    from futuresdr_tpu.blocks import Delay
+    from futuresdr_tpu.runtime.work_io import WorkIo
+
+    blk = Delay(np.float32, 0)
+
+    async def go():
+        io = WorkIo()
+        r0 = await blk.call_handler(io, blk.meta, 0, Pmt.usize(5))   # new_value
+        r_bad = await blk.call_handler(io, blk.meta, 99, Pmt.usize(5))
+        return r0, r_bad
+
+    r0, r_bad = asyncio.run(go())
+    assert r0 == Pmt.ok()
+    assert r_bad == Pmt.invalid_value()
