@@ -1,0 +1,51 @@
+"""Distributed flowgraphs: WLAN TX in one runtime → ZMQ sample transport → RX in
+another (the reference's inter-process distribution story: zeromq blocks carrying IQ
+between runtimes, SURVEY §2.7)."""
+
+import time
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime, Pmt
+from futuresdr_tpu.blocks import Apply, PubSink, SubSource, Throttle
+from futuresdr_tpu.models.wlan import WlanDecoder, WlanEncoder
+
+
+def test_wlan_over_zmq_between_runtimes():
+    addr = "tcp://127.0.0.1:28123"
+    rng = np.random.default_rng(0)
+
+    # RX runtime: SUB → noisy channel → WLAN decoder
+    fg_rx = Flowgraph()
+    sub = SubSource(addr, np.complex64)
+    chan = Apply(lambda x: (x + 0.01 * (rng.standard_normal(len(x))
+                                        + 1j * rng.standard_normal(len(x)))
+                            ).astype(np.complex64), np.complex64)
+    dec = WlanDecoder(chunk=1 << 14)
+    fg_rx.connect(sub, chan, dec)
+    rt_rx = Runtime()
+    running_rx = rt_rx.start(fg_rx)
+
+    # TX runtime: encoder → throttle (outlive the ZMQ slow-joiner) → PUB
+    fg_tx = Flowgraph()
+    enc = WlanEncoder("qpsk_1_2", gap_samples=2000)
+    thr = Throttle(np.complex64, rate=3e5)
+    pub = PubSink(addr, np.complex64)
+    fg_tx.connect(enc, thr, pub)
+    rt_tx = Runtime()
+    running_tx = rt_tx.start(fg_tx)
+
+    payloads = [f"distributed frame {i}".encode() * 3 for i in range(6)]
+    deadline = time.time() + 30
+    sent = set()
+    # keep retransmitting until the receiver confirms every payload (PUB/SUB is lossy
+    # during join; the set() comparison tolerates the resulting repeats)
+    while time.time() < deadline and len(set(dec.frames)) < len(payloads):
+        for p in payloads:
+            rt_tx.scheduler.run_coro_sync(running_tx.handle.call(enc, "tx",
+                                                                 Pmt.blob(p)))
+        time.sleep(1.0)
+    got = set(dec.frames)
+    running_tx.stop_sync()
+    running_rx.stop_sync()
+    assert set(payloads).issubset(got), f"missing: {set(payloads) - got}"
