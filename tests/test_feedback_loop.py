@@ -1,0 +1,81 @@
+"""Closed-loop control through the message plane: a measurement block feeds a
+controller that retunes an upstream source at runtime (the reference's AGC/sync-style
+feedback loops live on the host exactly like this — SURVEY §7 'feedback stays on host')."""
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime, Kernel, Pmt, message_handler
+
+
+class PeakFreqDetector(Kernel):
+    """Measures the dominant frequency per FFT window and posts it."""
+
+    def __init__(self, fft_size: int, sample_rate: float):
+        super().__init__()
+        self.n = fft_size
+        self.fs = sample_rate
+        self.input = self.add_stream_input("in", np.complex64, min_items=fft_size)
+        self.add_message_output("freq")
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        if len(inp) >= self.n:
+            spec = np.abs(np.fft.fft(inp[:self.n]))
+            peak = float(np.fft.fftfreq(self.n, 1 / self.fs)[int(np.argmax(spec))])
+            mio.post("freq", Pmt.f64(peak))
+            self.input.consume(len(inp) - len(inp) % self.n)
+        if self.input.finished():
+            io.finished = True
+
+
+class TuneController(Kernel):
+    """Steers the source toward ``target`` from measured peaks; connected back to the
+    source's ``freq`` handler — a feedback edge in the message plane."""
+
+    def __init__(self, target: float, gain: float = 0.7):
+        super().__init__()
+        self.target = target
+        self.gain = gain
+        self.current = None
+        self.history = []
+        self.add_message_output("retune")
+
+    @message_handler(name="measured")
+    async def measured(self, io, mio, meta, p: Pmt) -> Pmt:
+        if p.is_finished():
+            io.finished = True
+            return Pmt.ok()
+        peak = p.to_float()
+        self.history.append(peak)
+        if self.current is None:
+            self.current = peak
+        err = self.target - peak
+        if abs(err) > 1.0:
+            self.current = self.current + self.gain * err
+            mio.post("retune", Pmt.f64(self.current))
+        return Pmt.ok()
+
+
+def test_message_plane_feedback_converges():
+    from futuresdr_tpu.blocks import SignalSource, Head
+
+    fs = 100_000.0
+    fg = Flowgraph()
+    src = SignalSource("complex", 5_000.0, fs)        # starts far from the target
+    head = Head(np.complex64, 3_000_000)
+    det = PeakFreqDetector(1024, fs)
+    ctl = TuneController(target=20_000.0)
+    fg.connect(src, head, det)
+    fg.connect_message(det, "freq", ctl, "measured")
+    fg.connect_message(ctl, "retune", src, "freq")    # the feedback edge
+    rt = Runtime()
+    running = rt.start(fg)
+    import time
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        time.sleep(0.2)
+        if ctl.history and abs(ctl.history[-1] - 20_000.0) < 200:
+            break
+    running.stop_sync()
+    assert ctl.history, "no measurements flowed"
+    assert abs(ctl.history[-1] - 20_000.0) < 200, ctl.history[-5:]
