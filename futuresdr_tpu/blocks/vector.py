@@ -118,12 +118,15 @@ class CopyRand(Kernel):
         self._rng = np.random.default_rng(seed)
 
     async def work(self, io, mio, meta):
+        from ..runtime.tag import filter_tags
         inp = self.input.slice()
         out = self.output.slice()
         n = min(len(inp), len(out))
         if n > 0:
             n = min(n, 1 + int(self._rng.integers(self.max_copy)))
             out[:n] = inp[:n]
+            for t in filter_tags(self.input.tags(), n):
+                self.output.add_tag(t.index, t.tag)
             self.input.consume(n)
             self.output.produce(n)
         if self.input.finished() and n == len(inp):

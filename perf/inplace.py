@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""perf/inplace — circuit (in-place) buffers vs copy buffers.
+
+Reference: ``perf/inplace/add.rs`` (in-place add pipeline vs copy pipeline vs GR).
+CSV: ``run,mode,stages,frames,items_per_frame,elapsed_secs,msps``.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime, Kernel
+from futuresdr_tpu.blocks import Apply, VectorSink, Head, NullSink, NullSource
+from futuresdr_tpu.runtime.buffer.circuit import Circuit
+
+
+class InplaceSource(Kernel):
+    def __init__(self, circuit, n_frames):
+        super().__init__()
+        self.circuit = circuit
+        self.n_frames = n_frames
+        self._sent = 0
+        self.output = self.add_inplace_output("out", np.float32)
+
+    async def work(self, io, mio, meta):
+        while self._sent < self.n_frames:
+            buf = self.circuit.get_empty()
+            if buf is None:
+                return
+            self.output.put_full(buf, len(buf))
+            self._sent += 1
+        io.finished = True
+
+
+class InplaceAdd(Kernel):
+    def __init__(self):
+        super().__init__()
+        self.input = self.add_inplace_input("in", np.float32)
+        self.output = self.add_inplace_output("out", np.float32)
+
+    async def work(self, io, mio, meta):
+        while True:
+            item = self.input.get_full()
+            if item is None:
+                break
+            buf, n = item
+            buf[:n] += 1.0
+            self.output.put_full(buf, n)
+        if self.input.finished() and len(self.input) == 0:
+            io.finished = True
+
+
+class InplaceSink(Kernel):
+    def __init__(self, circuit):
+        super().__init__()
+        self.circuit = circuit
+        self.n = 0
+        self.input = self.add_inplace_input("in", np.float32)
+
+    async def work(self, io, mio, meta):
+        while True:
+            item = self.input.get_full()
+            if item is None:
+                break
+            buf, n = item
+            self.n += n
+            self.circuit.put_empty(buf)
+        if self.input.finished() and len(self.input) == 0:
+            io.finished = True
+
+
+def run_inplace(stages, frames, items):
+    circuit = Circuit(4, items, np.float32)
+    fg = Flowgraph()
+    src = InplaceSource(circuit, frames)
+    last = src
+    for _ in range(stages):
+        a = InplaceAdd()
+        fg.connect_inplace(last, "out", a, "in")
+        last = a
+    snk = InplaceSink(circuit)
+    fg.connect_inplace(last, "out", snk, "in")
+    fg.close_circuit(circuit, src)
+    t0 = time.perf_counter()
+    Runtime().run(fg)
+    dt = time.perf_counter() - t0
+    assert snk.n == frames * items
+    return dt
+
+
+def run_copy(stages, frames, items):
+    fg = Flowgraph()
+    src = NullSource(np.float32)
+    head = Head(np.float32, frames * items)
+    fg.connect(src, head)
+    last = head
+    for _ in range(stages):
+        a = Apply(lambda x: x + 1.0, np.float32)
+        fg.connect(last, a)
+        last = a
+    snk = NullSink(np.float32)
+    fg.connect(last, snk)
+    t0 = time.perf_counter()
+    Runtime().run(fg)
+    return time.perf_counter() - t0
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=2)
+    p.add_argument("--stages", type=int, default=4)
+    p.add_argument("--frames", type=int, default=200)
+    p.add_argument("--items", type=int, default=262144)
+    a = p.parse_args()
+    total = a.frames * a.items
+    print("run,mode,stages,frames,items_per_frame,elapsed_secs,msps")
+    for r in range(a.runs):
+        for mode, fn in (("inplace", run_inplace), ("copy", run_copy)):
+            dt = fn(a.stages, a.frames, a.items)
+            print(f"{r},{mode},{a.stages},{a.frames},{a.items},{dt:.3f},"
+                  f"{total/dt/1e6:.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""perf/latency — per-sample pipeline latency via timestamp tracepoints.
+
+Reference: ``perf/null_rand_latency`` (LTTng tracepoints every probe_granularity
+samples). CSV: ``run,stages,granularity,count,p50_us,p99_us,max_us``.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import Copy, CopyRand, Head, NullSource
+from futuresdr_tpu.utils import LatencyProbeSource, LatencyProbeSink, latency_stats
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=2)
+    p.add_argument("--stages", type=int, default=6)
+    p.add_argument("--samples", type=int, default=10_000_000)
+    p.add_argument("--granularity", type=int, default=65536)
+    p.add_argument("--max-copy", type=int, default=4096)
+    a = p.parse_args()
+    print("run,stages,granularity,count,p50_us,p99_us,max_us")
+    for r in range(a.runs):
+        fg = Flowgraph()
+        src = NullSource(np.float32)
+        head = Head(np.float32, a.samples)
+        probe_in = LatencyProbeSource(np.float32, a.granularity)
+        fg.connect(src, head, probe_in)
+        last = probe_in
+        for _ in range(a.stages):
+            c = CopyRand(np.float32, a.max_copy)
+            fg.connect(last, c)
+            last = c
+        snk = LatencyProbeSink(np.float32)
+        fg.connect(last, snk)
+        Runtime().run(fg)
+        s = latency_stats(snk.records)
+        print(f"{r},{a.stages},{a.granularity},{s['count']},"
+              f"{s['p50_us']:.1f},{s['p99_us']:.1f},{s['max_us']:.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
