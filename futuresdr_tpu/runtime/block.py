@@ -184,6 +184,9 @@ class WrappedKernel:
         finally:
             if block_on_task is not None:
                 block_on_task.cancel()
+            leftover = io.take_block_on()
+            if leftover is not None and hasattr(leftover, "close"):
+                leftover.close()      # un-started coroutine: close to avoid the warning
 
         # ---- orderly shutdown (`wrapped_kernel.rs:188-205`) ------------------
         try:

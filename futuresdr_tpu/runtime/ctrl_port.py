@@ -101,8 +101,12 @@ class ControlPort:
                 os.path.abspath(__file__))), "gui")
             fp = builtin if os.path.isdir(builtin) else None
         if fp:
-            app.router.add_get("/", lambda r: web.FileResponse(
-                os.path.join(fp, "index.html")))
+            index = os.path.join(fp, "index.html")
+
+            async def serve_index(request):
+                return web.FileResponse(index)
+
+            app.router.add_get("/", serve_index)
             app.router.add_static("/static/", fp)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
