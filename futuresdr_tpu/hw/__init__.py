@@ -15,7 +15,8 @@ from typing import Dict, Optional, Type
 
 import numpy as np
 
-__all__ = ["Driver", "DummyDriver", "Device", "register_driver", "parse_args"]
+__all__ = ["Driver", "DummyDriver", "FileDriver", "Device", "register_driver",
+           "parse_args"]
 
 
 def parse_args(args: str) -> Dict[str, str]:
@@ -109,7 +110,59 @@ class DummyDriver(Driver):
         return len(samples)
 
 
-_DRIVERS: Dict[str, Type[Driver]] = {"dummy": DummyDriver}
+class FileDriver(Driver):
+    """Replay a complex64 IQ recording as a device (`driver=file,path=...,repeat=true`),
+    wall-clock throttled to the sample rate — the HAL-level file-trx analog."""
+
+    def __init__(self, args: Dict[str, str]):
+        super().__init__(args)
+        self.path = args.get("path")
+        if not self.path:
+            raise ValueError("FileDriver needs path=<file>")
+        self.repeat = args.get("repeat", "true").lower() != "false"
+        self.throttle = args.get("throttle", "true").lower() != "false"
+        self._f = None
+        self._t0: Optional[float] = None
+        self._produced = 0
+        self.tx_written = 0
+
+    def activate_rx(self, channels=(0,)):
+        self._f = open(self.path, "rb")
+        self._t0 = None
+        self._produced = 0
+
+    def read(self, n: int) -> np.ndarray:
+        if self.throttle:
+            now = time.monotonic()
+            if self._t0 is None:
+                self._t0 = now
+            budget = int((now - self._t0) * self.sample_rate) - self._produced
+            while budget <= 0:
+                time.sleep(min(0.005, n / self.sample_rate))
+                budget = int((time.monotonic() - self._t0) * self.sample_rate) \
+                    - self._produced
+            n = min(n, budget)
+        data = self._f.read(n * 8)
+        if len(data) < 8:
+            if not self.repeat:
+                return np.zeros(0, np.complex64)
+            self._f.seek(0)
+            data = self._f.read(n * 8)
+        out = np.frombuffer(data[:(len(data) // 8) * 8], dtype=np.complex64)
+        self._produced += len(out)
+        return out
+
+    def write(self, samples: np.ndarray) -> int:
+        self.tx_written += len(samples)
+        return len(samples)
+
+    def deactivate(self):
+        if self._f:
+            self._f.close()
+            self._f = None
+
+
+_DRIVERS: Dict[str, Type[Driver]] = {"dummy": DummyDriver, "file": FileDriver}
 
 
 def register_driver(name: str, cls: Type[Driver]) -> None:
