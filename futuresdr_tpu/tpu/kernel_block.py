@@ -127,8 +127,11 @@ class TpuKernel(Kernel):
             self.input.consume(n)
             inp = self.input.slice()
 
-        # 3. retrieve: when the pipe is full, or on EOS drain
-        should_drain = len(self._inflight) >= self.depth or (eos and self._inflight)
+        # 3. retrieve: when the pipe is full, when the input is starved (no full frame
+        #    waiting — flush for latency; when saturated the depth gate keeps overlap),
+        #    or on EOS drain
+        should_drain = bool(self._inflight) and (
+            len(self._inflight) >= self.depth or len(inp) < self.frame_size or eos)
         if should_drain:
             result = self._drain_one()
             out = self.output.slice()
