@@ -143,34 +143,51 @@ def _freq_templates(sps_chip: int = SAMPLES_PER_CHIP) -> np.ndarray:
 _FREQ_TEMPLATES = _freq_templates()
 
 
-def demodulate_stream(samples: np.ndarray, sps_chip: int = SAMPLES_PER_CHIP
-                      ) -> List[bytes]:
-    """Full RX (`demodulator.rs` role): quadrature discriminator → MM clock recovery at
-    chip rate → sliding frequency-template correlation for the SFD → despread PSDUs."""
+def _scan_soft_chips(soft: np.ndarray, frames: List[bytes]) -> None:
+    """Sliding SFD correlation + despread over one chip-rate soft stream."""
+    if len(soft) < 96:
+        return
+    # SFD = nibbles 7 then A (0xA7 LSB-nibble first)
+    sfd_t = np.concatenate([_FREQ_TEMPLATES[0x7], _FREQ_TEMPLATES[0xA]])
+    corr = np.correlate(soft.astype(np.float32), sfd_t.astype(np.float32), mode="valid")
+    thresh = 0.72 * len(sfd_t)
+    cand = np.flatnonzero(corr >= thresh)
+    next_free = -1
+    for i in cand:
+        if i < next_free:
+            continue
+        start = i + len(sfd_t)
+        psdu = _despread_from(soft, start)
+        if psdu is not None and psdu not in frames:
+            frames.append(psdu)
+            next_free = start + 64
+    return
+
+
+def demodulate_stream(samples: np.ndarray, sps_chip: int = SAMPLES_PER_CHIP,
+                      timing: str = "phase") -> List[bytes]:
+    """Full RX (`demodulator.rs` role): quadrature discriminator → chip timing →
+    sliding frequency-template correlation for the SFD → despread PSDUs.
+
+    ``timing``: "phase" (default) — fully vectorized: boxcar matched filter, then try
+    every integer sample phase at chip rate (sps small) and dedup; "mm" — the adaptive
+    Mueller-Müller loop (`clock_recovery_mm.rs`), for drifting clocks.
+    """
     if len(samples) < 64 * sps_chip:
         return []
     d = samples[1:] * np.conj(samples[:-1])
     freq = np.angle(d)
-    soft = _mm_clock_recovery(freq, sps_chip)   # one soft value per chip
-    if len(soft) < 96:
-        return []
-    soft = np.sign(soft)
-
-    # SFD = nibbles 7 then A (0xA7 LSB-nibble first)
-    sfd_t = np.concatenate([_FREQ_TEMPLATES[0x7], _FREQ_TEMPLATES[0xA]])
-    corr = np.correlate(soft.astype(np.float32), sfd_t.astype(np.float32), mode="valid")
-    frames = []
-    thresh = 0.72 * len(sfd_t)
-    i = 0
-    while i < len(corr):
-        if corr[i] >= thresh:
-            start = i + len(sfd_t)
-            psdu = _despread_from(soft, start)
-            if psdu is not None:
-                frames.append(psdu)
-                i = start + 64
-                continue
-        i += 1
+    frames: List[bytes] = []
+    if timing == "mm":
+        soft = _mm_clock_recovery(freq, sps_chip)
+        _scan_soft_chips(np.sign(soft), frames)
+        return frames
+    # phase search: chip-rate matched filter (boxcar over one chip) at each phase
+    kernel = np.ones(sps_chip, dtype=np.float32) / sps_chip
+    mf = np.convolve(freq, kernel, mode="valid")
+    for phase in range(sps_chip):
+        soft = np.sign(mf[phase::sps_chip])
+        _scan_soft_chips(soft, frames)
     return frames
 
 
