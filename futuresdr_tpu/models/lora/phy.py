@@ -175,22 +175,22 @@ def detect_frames(samples: np.ndarray, p: LoraParams) -> List[int]:
         ka, kb = int(kmax[i]), int(kmax[j])
         pa, pb = conc[i], conc[j]
         if ka == kb and pa > 0.3 and pb > 0.3:
-            # inside the preamble: dechirped bin k == sample misalignment d (pos = start + d)
+            # inside the preamble: dechirped bin = (f_cfo − misalignment) mod n; use it
+            # as a timing estimate (exact when CFO≈0, refined later by the downchirps)
             start = i * hop - ka
             if start < 0:
                 start += n
             # validate: two data symbols can match by chance; a real preamble shows a
-            # constant bin over ≥3 aligned consecutive chirps from `start`
-            ok = 0
+            # CONSTANT bin over ≥3 aligned consecutive chirps from `start`
+            bins = []
             for s in range(3):
                 q = start + s * n
                 if q + n > len(samples):
                     break
-                kk = int(np.argmax(np.abs(np.fft.fft(
-                    samples[q:q + n] * _downchirp(n)))))
-                if kk in (0, 1, n - 1):
-                    ok += 1
-            if ok >= 3:
+                bins.append(int(np.argmax(np.abs(np.fft.fft(
+                    samples[q:q + n] * _downchirp(n))))))
+            if len(bins) == 3 and all((b - bins[0]) % n in (0, 1, n - 1)
+                                      for b in bins):
                 starts.append(start)
                 i = (start + (p.n_preamble + 5) * n + hop - 1) // hop  # skip the frame head
             else:
@@ -201,70 +201,67 @@ def detect_frames(samples: np.ndarray, p: LoraParams) -> List[int]:
 
 
 def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams):
-    """Demodulate from a symbol-aligned position anywhere inside the preamble: walk
-    forward over the upchirp train, step over the two sync chirps and the 2.25
-    downchirps, then batch-demod the data symbols (`frame_sync.rs` state machine)."""
+    """Demodulate from a symbol-aligned position anywhere inside the preamble.
+
+    CFO-aware sync (`frame_sync.rs` state machine): under a carrier offset of ``f``
+    bins and a timing error of ``d`` samples, preamble UPchirps dechirp to bin
+    ``(f − d) mod n`` while the 2.25 DOWNchirps dechirp (against an upchirp) to
+    ``(f + d) mod n`` — measuring both separates frequency from timing:
+    ``f = (c_up + c_dn)/2``, ``d = (c_dn − c_up)/2``. Data symbols are demodulated at
+    the corrected timing and de-rotated by the integer CFO bin.
+    """
     n = p.n
     down = _downchirp(n)
-    pos = start
-    # the detector's start can be off by ±a few samples (noise) or a whole symbol
-    # (probe straddling the frame edge): skip leading unaligned symbols and fold out
-    # small bin offsets before walking the train
-    def bin_conc(q: int):
-        spec = np.abs(np.fft.fft(samples[q:q + n] * down))
+    up = _upchirp(n)
+
+    def half(x: int) -> int:                      # signed mod-n representative
+        return ((x + n // 2) % n) - n // 2
+
+    def bin_conc(q: int, ref):
+        spec = np.abs(np.fft.fft(samples[q:q + n] * ref))
         k = int(np.argmax(spec))
         conc = spec[k] ** 2 / max(np.sum(spec ** 2), 1e-12)
         return k, conc
 
-    def verified_upchirp(q: int) -> bool:
-        """Aligned preamble chirp: bin 0 with concentrated energy, confirmed on the
-        following chirp too (noise windows pass a single check ~1/128 of the time)."""
-        if q < 0 or q + 2 * n > len(samples):
-            return False
-        k1, c1 = bin_conc(q)
-        if k1 != 0 or c1 < 0.15:
-            return False
-        k2, c2 = bin_conc(q + n)
-        return k2 == 0 and c2 > 0.15
-
-    aligned = False
+    # find a consistent-bin run start (the preamble): any constant bin c (CFO shifts
+    # it away from 0), confirmed on two consecutive chirps — noise windows rarely agree
+    pos = None
+    c_up = None
     for skip in range(3):
-        q = pos + skip * n
-        if q + n > len(samples):
+        q = start + skip * n
+        if q + 2 * n > len(samples):
             break
-        k, conc = bin_conc(q)
-        cands = []
-        if k == 0 and conc > 0.15:
-            cands.append(q)
-        if 0 < k <= 4:
-            cands.append(q - k)
-        if n - 4 <= k < n:
-            cands.append(q + (n - k))
-        for c in cands:
-            if verified_upchirp(c):
-                pos = c
-                aligned = True
-                break
-        if aligned:
+        k1, c1 = bin_conc(q, down)
+        k2, c2 = bin_conc(q + n, down)
+        if c1 > 0.15 and c2 > 0.15 and (k1 - k2) % n in (0, 1, n - 1):
+            pos, c_up = q, k1
             break
-    if not aligned:
+    if pos is None:
         return None
-    # walk the upchirp train (bin 0); bounded by the max preamble length
+    # walk the constant-bin upchirp train; bounded by the max preamble length
     hops = 0
     while pos + n <= len(samples) and hops <= p.n_preamble + 2:
-        k = int(np.argmax(np.abs(np.fft.fft(samples[pos:pos + n] * down))))
-        if k != 0:
+        k, conc = bin_conc(pos, down)
+        if conc < 0.10 or (k - c_up) % n not in (0, 1, n - 1):
             break
         pos += n
         hops += 1
     if hops == 0:
-        return None                 # not on an aligned preamble
+        return None                 # not on a preamble
     pos += 2 * n                    # sync word chirps
-    pos += 2 * n + n // 4           # 2.25 downchirps
-    if pos >= len(samples):
+    # downchirp section: dechirp against an upchirp to split CFO from timing
+    f_bin = 0
+    d_shift = 0
+    if pos + n <= len(samples):
+        c_dn, conc_dn = bin_conc(pos, up)
+        if conc_dn > 0.10:
+            f_bin = int(round(half(c_up + c_dn) / 2.0))
+            d_shift = int(round(half(c_dn - c_up) / 2.0))
+    pos += 2 * n + n // 4 + d_shift # 2.25 downchirps + timing correction
+    if pos < 0 or pos + n > len(samples):
         return None
     spec = _dechirp_bins(samples[pos:], p)
     if len(spec) == 0:
         return None
-    symbols = np.argmax(np.abs(spec), axis=1)
+    symbols = (np.argmax(np.abs(spec), axis=1) - f_bin) % n
     return decode_symbols(symbols, p)

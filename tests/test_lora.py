@@ -86,6 +86,28 @@ def test_lora_loopback_noise():
     assert crc_ok
 
 
+@pytest.mark.parametrize("f_bin", [2.0, -3.0, 4.3])
+def test_lora_cfo_recovery(f_bin):
+    """Carrier offsets (integer and fractional bins) are separated from timing by the
+    up/down-chirp bin measurements and compensated."""
+    p = LoraParams(sf=7, cr=2)
+    rng = np.random.default_rng(5)
+    payload = b"cfo robust lora!"
+    sig = np.concatenate([np.zeros(333, np.complex64), modulate_frame(payload, p),
+                          np.zeros(400, np.complex64)])
+    k = np.arange(len(sig))
+    sig = (sig * np.exp(2j * np.pi * f_bin * k / p.n)).astype(np.complex64)
+    sig = (sig + 0.05 * (rng.standard_normal(len(sig))
+                         + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+    got = None
+    for s in detect_frames(sig, p):
+        r = demodulate_frame(sig, s, p)
+        if r is not None and r[1]:
+            got = r[0]
+            break
+    assert got == payload
+
+
 def test_lora_ldro_mode():
     p = LoraParams(sf=9, cr=2, ldro=True)
     payload = b"low data rate optimization"
