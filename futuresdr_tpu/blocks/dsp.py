@@ -192,9 +192,17 @@ class Fft(Kernel):
     @message_handler(name="fft_size")
     async def fft_size_handler(self, io, mio, meta, p: Pmt) -> Pmt:
         try:
-            self.fft_size = p.to_int()
+            new = p.to_int()
         except Exception:
             return Pmt.invalid_value()
+        if new <= 0:
+            return Pmt.invalid_value()
+        cap = self.input.reader.capacity_items() if self.input.reader else None
+        if cap is not None and new > cap // 2:
+            return Pmt.invalid_value()    # would exceed the negotiated buffer window
+        self.fft_size = new
+        if self.window is not None and len(self.window) != new:
+            self.window = None            # window length no longer matches; drop it
         return Pmt.ok()
 
     async def work(self, io, mio, meta):

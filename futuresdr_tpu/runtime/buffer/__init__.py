@@ -58,6 +58,11 @@ class BufferReader(ABC):
     def items_available(self) -> int:
         return len(self.slice())
 
+    def capacity_items(self) -> Optional[int]:
+        """Total ring capacity if the backend knows it (None otherwise)."""
+        return getattr(getattr(self, "_w", None), "capacity", None) \
+            or getattr(getattr(self, "_writer", None), "capacity", None)
+
 
 class BufferWriter(ABC):
     """Writer endpoint owning the storage; broadcasts to N readers (`buffer/mod.rs:391-420`)."""
