@@ -2,8 +2,29 @@
 
 Reference ``examples/`` (SURVEY §2.6): WLAN 802.11 transceiver, LoRa PHY, ZigBee, ADS-B,
 FM receiver, spectrum analyzer, and the burn ML example (→ :mod:`.mcldnn`).
+
+The ML names (flax-backed) resolve lazily so that importing a protocol model (e.g.
+``futuresdr_tpu.models.wlan``) doesn't pay the flax import cost.
 """
 
-from .mcldnn import MCLDNN, make_train_step, init_params, loss_fn
+__all__ = ["MCLDNN", "make_train_step", "init_params", "loss_fn",
+           "wlan", "lora", "zigbee", "m17", "adsb", "mcldnn", "modrec", "misc",
+           "rattlegram"]
 
-__all__ = ["MCLDNN", "make_train_step", "init_params", "loss_fn"]
+_ML_NAMES = {"MCLDNN", "make_train_step", "init_params", "loss_fn"}
+_SUBMODULES = {"wlan", "lora", "zigbee", "m17", "adsb", "mcldnn", "modrec", "misc",
+               "rattlegram"}
+
+
+def __getattr__(name):
+    import importlib
+    if name in _ML_NAMES:
+        mod = importlib.import_module(".mcldnn", __name__)
+        val = getattr(mod, name)
+        globals()[name] = val
+        return val
+    if name in _SUBMODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
