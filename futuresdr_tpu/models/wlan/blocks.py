@@ -102,16 +102,11 @@ class WlanDecoder(Kernel):
             return
         buf = np.concatenate([self._tail, inp[:n]])
         base = self._tail_abs
-        for start in phy.ofdm.detect_packets(buf):
-            r = phy.ofdm.sync_long(buf, start)
-            if r is None:
-                continue
-            data_start, lts_start, cfo = r
-            abs_lts = base + lts_start
+        # burst-batched decode: every frame in the window shares one batched Viterbi
+        # scan when a jax backend is up; falls back to per-frame numpy otherwise
+        for frame in phy.decode_stream_batch(buf):
+            abs_lts = base + frame.start
             if abs_lts in self._seen_abs:
-                continue
-            frame = phy.decode_frame(buf, lts_start, cfo)
-            if frame is None:
                 continue
             self._seen_abs.add(abs_lts)
             psdu = frame.psdu
