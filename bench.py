@@ -45,8 +45,8 @@ _ensure_live_backend()
 if os.environ.get("FSDR_FORCE_CPU"):
     # env JAX_PLATFORMS=cpu is NOT enough: the axon plugin hooks get_backend and dials
     # the (dead) tunnel anyway; only the config route skips it
-    import jax
-    jax.config.update("jax_platforms", "cpu")
+    from futuresdr_tpu.tpu.instance import force_cpu_platform
+    force_cpu_platform()
 
 import numpy as np
 
@@ -102,20 +102,32 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cpu-samples", type=int, default=20_000_000)
     p.add_argument("--tpu-samples", type=int, default=200_000_000)
-    p.add_argument("--frame", type=int, default=1 << 20)
+    p.add_argument("--frame", type=int, default=0,
+                   help="device frame size (0 = autotune a small grid first)")
     p.add_argument("--depth", type=int, default=4)
     p.add_argument("--autotune", action="store_true",
-                   help="sweep frame/depth and bench the best combination")
+                   help="sweep the full frame/depth grid and bench the best combination")
     args = p.parse_args()
 
     inst = instance()
     frame, depth = args.frame, args.depth
-    if args.autotune:
+    if args.autotune or frame == 0:
+        # default: a quick sweep — the throughput-vs-frame curve depends on the
+        # backend (TPU: HBM residency; CPU fallback: cache footprint), so a fixed
+        # default is wrong on one of them
         from futuresdr_tpu.tpu import autotune
         taps = firdes.lowpass(0.2, N_TAPS).astype(np.float32)
-        frame, depth, grid = autotune(
-            [fir_stage(taps), fft_stage(FFT_SIZE), mag2_stage()], np.complex64)
+        stages = [fir_stage(taps), fft_stage(FFT_SIZE), mag2_stage()]
+        if args.autotune:
+            frame, depth, grid = autotune(stages, np.complex64)
+        else:
+            frame, depth, grid = autotune(
+                stages, np.complex64, frames=(1 << 17, 1 << 18, 1 << 19),
+                depths=(4, 8), min_seconds=0.4)
         print(f"# autotune grid: {grid}", file=sys.stderr)
+        if not grid:                     # every combo failed; bench the default anyway
+            frame, depth = 1 << 18, 4
+            print("# autotune found no working config; using defaults", file=sys.stderr)
     cpu_rate = run_cpu(args.cpu_samples)
     tpu_rate = run_tpu(args.tpu_samples, frame, depth)
     result = {

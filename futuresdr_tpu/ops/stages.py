@@ -41,6 +41,8 @@ class Stage:
     out_dtype: Optional[np.dtype] = None          # None = same as input
     frame_multiple: int = 1                       # input frame must divide this
     name: str = "stage"
+    lti: Optional[Tuple[np.ndarray, int, int]] = None  # (taps, decim, fft_len) when the
+    #   stage is a linear time-invariant FIR — lets Pipeline merge adjacent FIRs into one
 
     def __repr__(self):
         return f"Stage({self.name}, ratio={self.ratio})"
@@ -54,9 +56,10 @@ class Pipeline:
     kernel launch per frame instead of four buffer hops.
     """
 
-    def __init__(self, stages: Sequence[Stage], in_dtype):
-        self.stages = list(stages)
+    def __init__(self, stages: Sequence[Stage], in_dtype, optimize: bool = True):
         self.in_dtype = np.dtype(in_dtype)
+        self.stages = (_merge_lti(list(stages), self.in_dtype)
+                       if optimize else list(stages))
         dtype = self.in_dtype
         fm = 1                      # required input-frame multiple
         r = Fraction(1, 1)          # cumulative rate in front of each stage
@@ -117,6 +120,53 @@ class Pipeline:
         return int(q)
 
 
+def _merge_lti(stages: Sequence[Stage], in_dtype) -> list:
+    """Peephole pass: collapse runs of adjacent LTI FIR stages into ONE overlap-save.
+
+    A cascade of FIRs is itself an FIR with the convolved taps; filtering after a
+    decimator by ``d`` equals filtering with the taps zero-stuffed by ``d`` before it
+    (noble identity), so ``(t1, d1) · (t2, d2) → (t1 * stuff(t2, d1), d1·d2)``. On the
+    device this is the big fusion win: N stage cascades cost ONE FFT pass instead of N
+    (the reference pays per-block dispatch here, ``perf/fir/fir.rs:49-95``).
+
+    The stream dtype is tracked through the chain: on a REAL stream each FIR stage
+    takes ``.real`` at its boundary, so complex-tap runs only merge where the stream
+    is complex at that position.
+    """
+    out: list = []
+    dtype = np.dtype(in_dtype)
+    out_dtypes: list = []               # stream dtype ENTERING each stage in `out`
+    for s in stages:
+        if s.lti is not None and out and out[-1].lti is not None:
+            t1, d1, fl1 = out[-1].lti
+            t2, d2, fl2 = s.lti
+            complex_stream = bool(np.issubdtype(out_dtypes[-1], np.complexfloating))
+            if not complex_stream and not (np.isrealobj(t1) and np.isrealobj(t2)):
+                # a real stream takes .real at EACH stage boundary; merging complex-tap
+                # cascades would change that — only safe on complex streams
+                out.append(s)
+                out_dtypes.append(dtype)
+                if s.out_dtype is not None:
+                    dtype = np.dtype(s.out_dtype)
+                continue
+            if d1 == 1:
+                taps = np.convolve(t1, t2)
+            else:
+                up = np.zeros((len(t2) - 1) * d1 + 1, dtype=np.result_type(t1, t2))
+                up[::d1] = t2
+                taps = np.convolve(t1, up)
+            out[-1] = fir_stage(taps, decim=d1 * d2, fft_len=max(fl1, fl2),
+                                name=f"{out[-1].name}*{s.name}")
+            # stream dtype entering the merged stage is unchanged; FIR stages keep the
+            # stream dtype so `dtype` needs no update here
+        else:
+            out.append(s)
+            out_dtypes.append(dtype)
+            if s.out_dtype is not None:
+                dtype = np.dtype(s.out_dtype)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # stage factories
 # ---------------------------------------------------------------------------
@@ -141,6 +191,10 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> S
         L *= 2
     fft_len = 2 * L
     H = np.fft.fft(np.concatenate([taps, np.zeros(fft_len - nt)])).astype(np.complex64)
+    # real-input path: half-spectrum taps (real inputs discard the imaginary response,
+    # so conv(x, taps).real == conv(x, taps.real) — same semantics as the full path)
+    Hr = np.fft.rfft(np.concatenate([np.real(taps),
+                                     np.zeros(fft_len - nt)])).astype(np.complex64)
 
     def fn(carry, x):
         Hc, tail = carry
@@ -148,20 +202,26 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> S
         s = x.shape[0] // L
         idx = jnp.arange(s)[:, None] * L + jnp.arange(fft_len)[None, :]
         blocks = ext[idx]                            # [S, 2L] (block s = ext[sL:sL+2L])
-        spec = jnp.fft.fft(blocks, axis=1) * Hc[None, :]
-        seg = jnp.fft.ifft(spec, axis=1)[:, L:]      # linear-conv region (L ≥ ntaps-1)
-        y = seg.reshape(-1)
-        y = y.astype(x.dtype) if jnp.iscomplexobj(x) else y.real.astype(x.dtype)
+        if jnp.iscomplexobj(x):
+            spec = jnp.fft.fft(blocks, axis=1) * Hc[None, :]
+            seg = jnp.fft.ifft(spec, axis=1)[:, L:]  # linear-conv region (L ≥ ntaps-1)
+        else:
+            spec = jnp.fft.rfft(blocks, axis=1) * Hc[None, :]
+            seg = jnp.fft.irfft(spec, n=fft_len, axis=1)[:, L:]
+        y = seg.reshape(-1).astype(x.dtype)
         if decim > 1:
             y = y[::decim]
         return (Hc, ext[ext.shape[0] - L:]), y
 
     def init_carry(dtype):
-        return (jnp.asarray(H), jnp.zeros(L, dtype=dtype))
+        dt = np.dtype(dtype)
+        Hsel = H if np.issubdtype(dt, np.complexfloating) else Hr
+        return (jnp.asarray(Hsel), jnp.zeros(L, dtype=dtype))
 
     # frame must be a multiple of the hop (and of decim at the output side)
     multiple = int(np.lcm(L, decim))
-    return Stage(fn, init_carry, Fraction(1, decim), None, multiple, name)
+    return Stage(fn, init_carry, Fraction(1, decim), None, multiple, name,
+                 lti=(taps, decim, fft_len))
 
 
 def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,

@@ -21,9 +21,38 @@ __all__ = ["TpuInstance", "instance"]
 log = logger("tpu.instance")
 
 
+def force_cpu_platform() -> bool:
+    """Pin jax to the CPU platform via the config route; returns True if applied.
+
+    The env var ``JAX_PLATFORMS=cpu`` is NOT sufficient: the axon TPU plugin hooks
+    backend init and dials its (possibly wedged) tunnel anyway; only
+    ``jax.config.update("jax_platforms", "cpu")`` before init skips it. A no-op once a
+    backend is live (switching then would re-trigger plugin discovery and hang).
+    The initialization probe is a private API (jax 0.9); if it moves, assume the
+    common fresh-process case.
+    """
+    try:
+        import jax._src.xla_bridge as _xb
+        initialized = _xb.backends_are_initialized()
+    except (ImportError, AttributeError):
+        initialized = False
+    if initialized:
+        return False
+    jax.config.update("jax_platforms", "cpu")
+    return True
+
+
+def _maybe_force_cpu() -> None:
+    """Honor ``FSDR_FORCE_CPU=1`` before first backend use (see force_cpu_platform)."""
+    import os
+    if os.environ.get("FSDR_FORCE_CPU"):
+        force_cpu_platform()
+
+
 class TpuInstance:
     def __init__(self, device=None, platform: Optional[str] = None):
         if device is None:
+            _maybe_force_cpu()
             devs = jax.devices(platform) if platform else jax.devices()
             device = devs[0]
         self.device = device

@@ -179,3 +179,75 @@ def test_tpu_kernel_spectrum_chain():
     Runtime().run(fg)
     spec = snk.items()[:n_fft]
     assert np.argmax(spec) == round(0.1 * n_fft)
+
+
+def test_lti_merge_cascade_matches_unmerged():
+    """A cascade of FIR stages collapses to ONE overlap-save (noble-identity merge)."""
+    rng = np.random.default_rng(2)
+    taps1 = firdes.lowpass(0.3, 48).astype(np.float32)
+    taps2 = firdes.lowpass(0.25, 32).astype(np.float32)
+    taps3 = firdes.lowpass(0.2, 64).astype(np.float32)
+    stages = lambda: [fir_stage(taps1, fft_len=512), fir_stage(taps2, fft_len=512),
+                      fir_stage(taps3, fft_len=512)]
+    merged = Pipeline(stages(), np.float32)
+    plain = Pipeline(stages(), np.float32, optimize=False)
+    assert len(merged.stages) == 1 and len(plain.stages) == 3
+    x = rng.standard_normal(16384).astype(np.float32)
+    frame = int(np.lcm(merged.frame_multiple, plain.frame_multiple)) * 4
+    y_m = run_pipeline(merged, x, frame)
+    y_p = run_pipeline(plain, x, frame)
+    np.testing.assert_allclose(y_m, y_p[:len(y_m)], rtol=1e-3, atol=1e-4)
+
+
+def test_lti_merge_with_decimation():
+    """(t1, d1)·(t2, d2) → (t1 * stuff(t2, d1), d1·d2) across frame boundaries."""
+    rng = np.random.default_rng(3)
+    taps1 = firdes.lowpass(0.2, 32).astype(np.float32)
+    taps2 = firdes.lowpass(0.4, 24).astype(np.float32)
+    stages = lambda: [fir_stage(taps1, decim=2, fft_len=512),
+                      fir_stage(taps2, decim=3, fft_len=512)]
+    merged = Pipeline(stages(), np.complex64)
+    plain = Pipeline(stages(), np.complex64, optimize=False)
+    assert len(merged.stages) == 1
+    assert merged.ratio == plain.ratio
+    x = (rng.standard_normal(36864) + 1j * rng.standard_normal(36864)).astype(np.complex64)
+    frame = int(np.lcm(merged.frame_multiple, plain.frame_multiple)) * 2
+    y_m = run_pipeline(merged, x, frame)
+    y_p = run_pipeline(plain, x, frame)
+    n = min(len(y_m), len(y_p))
+    np.testing.assert_allclose(y_m[:n], y_p[:n], rtol=1e-3, atol=1e-4)
+
+
+def test_lti_merge_complex_taps_gated_on_real_stream():
+    """Complex-tap cascades only merge on complex streams (real streams take .real at
+    each stage boundary, which merging would change)."""
+    ct = (firdes.lowpass(0.2, 16) * np.exp(1j * 0.3 * np.arange(16))).astype(np.complex64)
+    real_pipe = Pipeline([fir_stage(ct, fft_len=512), fir_stage(ct, fft_len=512)],
+                         np.float32)
+    cplx_pipe = Pipeline([fir_stage(ct, fft_len=512), fir_stage(ct, fft_len=512)],
+                         np.complex64)
+    assert len(real_pipe.stages) == 2      # NOT merged
+    assert len(cplx_pipe.stages) == 1      # merged
+    rng = np.random.default_rng(4)
+    x = (rng.standard_normal(8192) + 1j * rng.standard_normal(8192)).astype(np.complex64)
+    plain = Pipeline([fir_stage(ct, fft_len=512), fir_stage(ct, fft_len=512)],
+                     np.complex64, optimize=False)
+    y_m = run_pipeline(cplx_pipe, x, 2048)
+    y_p = run_pipeline(plain, x, 2048)
+    np.testing.assert_allclose(y_m, y_p[:len(y_m)], rtol=1e-3, atol=1e-4)
+
+
+def test_lti_merge_tracks_stream_dtype():
+    """Complex-tap FIRs AFTER a complex→real stage must not merge (real stream takes
+    .real each boundary), even when the pipeline INPUT is complex."""
+    ct = (firdes.lowpass(0.2, 16) * np.exp(1j * 0.3 * np.arange(16))).astype(np.complex64)
+    pipe = Pipeline([quad_demod_stage(), fir_stage(ct, fft_len=512),
+                     fir_stage(ct, fft_len=512)], np.complex64)
+    assert len(pipe.stages) == 3       # NOT merged: stream is real after quad_demod
+    rt = Pipeline([quad_demod_stage(), fir_stage(ct, fft_len=512),
+                   fir_stage(ct, fft_len=512)], np.complex64, optimize=False)
+    rng = np.random.default_rng(5)
+    x = np.exp(1j * np.cumsum(0.1 * rng.standard_normal(8192))).astype(np.complex64)
+    y_m = run_pipeline(pipe, x, 2048)
+    y_p = run_pipeline(rt, x, 2048)
+    np.testing.assert_allclose(y_m, y_p, rtol=1e-4, atol=1e-5)
