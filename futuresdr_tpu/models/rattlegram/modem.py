@@ -17,9 +17,9 @@ from typing import Optional
 
 import numpy as np
 
-from ..runtime.kernel import Kernel, message_handler
-from ..types import Pmt
-from .wlan import coding as wcoding
+from ...runtime.kernel import Kernel, message_handler
+from ...types import Pmt
+from ..wlan import coding as wcoding
 
 __all__ = ["mls", "ModemParams", "modulate", "demodulate", "Modem",
            "ModemTransmitter", "ModemReceiver"]
