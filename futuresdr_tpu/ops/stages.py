@@ -198,10 +198,11 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> S
 
     def fn(carry, x):
         Hc, tail = carry
-        ext = jnp.concatenate([tail, x])             # [L + n], n = S*L
-        s = x.shape[0] // L
-        idx = jnp.arange(s)[:, None] * L + jnp.arange(fft_len)[None, :]
-        blocks = ext[idx]                            # [S, 2L] (block s = ext[sL:sL+2L])
+        ext = jnp.concatenate([tail, x])             # [(S+1)·L], S = n // L
+        # block s = ext[sL : sL+2L] = rows[s] ++ rows[s+1]: built from two strided
+        # slices + concat, NOT a gather — TPU gathers run ~9× slower than this form
+        rows = ext.reshape(-1, L)
+        blocks = jnp.concatenate([rows[:-1], rows[1:]], axis=1)   # [S, 2L]
         if jnp.iscomplexobj(x):
             spec = jnp.fft.fft(blocks, axis=1) * Hc[None, :]
             seg = jnp.fft.ifft(spec, axis=1)[:, L:]  # linear-conv region (L ≥ ntaps-1)
@@ -216,7 +217,10 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> S
     def init_carry(dtype):
         dt = np.dtype(dtype)
         Hsel = H if np.issubdtype(dt, np.complexfloating) else Hr
-        return (jnp.asarray(Hsel), jnp.zeros(L, dtype=dtype))
+        # complex H2D (incl. eager jnp.zeros, which is a host device_put!) must ride
+        # the pair shim — broken complex transfers on axon, see ops/xfer.py
+        from .xfer import to_device
+        return (to_device(Hsel), to_device(np.zeros(L, dtype=dt)))
 
     # frame must be a multiple of the hop (and of decim at the output side)
     multiple = int(np.lcm(L, decim))
@@ -337,7 +341,10 @@ def quad_demod_stage(gain: float = 1.0) -> Stage:
         return x[-1], y.astype(jnp.float32)
 
     def init_carry(dtype):
-        return jnp.asarray(1.0 + 0.0j, dtype=dtype)
+        # complex host scalars (incl. eager jnp.ones) are device_puts the axon tunnel
+        # cannot materialise — ship via the pair shim (ops/xfer.py)
+        from .xfer import to_device
+        return to_device(np.ones((), dtype=np.dtype(dtype)))
 
     return Stage(fn, init_carry, Fraction(1, 1), np.float32, 1, "quad_demod")
 
@@ -377,8 +384,9 @@ def channelizer_stage(n_channels: int, taps=None, name: str = "channelizer") -> 
         blocks = ext.reshape(-1, N)[:, ::-1]               # [t+K-1, N] commutated
         t = x.shape[0] // N
         # windows[s, k, c] = blocks[s + (K-1) - k, c]  (branch c history depth k)
-        idx = (jnp.arange(t)[:, None] + (K - 1) - jnp.arange(K)[None, :])
-        windows = blocks[idx]                              # [t, K, N]
+        # K static slices + stack instead of a gather (slow on TPU)
+        windows = jnp.stack(
+            [blocks[(K - 1) - k:(K - 1) - k + t] for k in range(K)], axis=1)  # [t, K, N]
         v = jnp.einsum("tkc,ck->ct", windows, Hc,
                        precision=jax.lax.Precision.HIGHEST)  # [N, t]
         y = jnp.fft.ifft(v, axis=0) * N                    # [N, t]
@@ -386,7 +394,8 @@ def channelizer_stage(n_channels: int, taps=None, name: str = "channelizer") -> 
         return (Hc, new_hist), y.T.reshape(-1).astype(jnp.complex64)
 
     def init_carry(dtype):
-        return (branch, jnp.zeros((K - 1) * N, dtype=dtype))
+        from .xfer import to_device
+        return (branch, to_device(np.zeros((K - 1) * N, dtype=np.dtype(dtype))))
 
     return Stage(fn, init_carry, Fraction(1, 1), np.complex64, N, name)
 

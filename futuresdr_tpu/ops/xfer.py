@@ -1,0 +1,103 @@
+"""Host↔device transfer shim: complex streams ride as float32 pairs.
+
+The axon TPU tunnel cannot materialise ``device_put`` complex64 buffers: the put itself
+reports success (it is async), on-device compute over the buffer runs, but ANY
+device-to-host readback whose ancestry includes such a buffer fails with
+``UNIMPLEMENTED: TPU backend error`` (measured round 2; see ``docs/tpu_notes.md``).
+Complex arrays *created on device* (by an XLA program, including in-trace constants)
+are fine in both directions.
+
+So every host→device crossing of a complex array ships the interleaved re/im float32
+pairs (a zero-copy ``view`` on the host) and forms the complex array with one jitted
+``lax.complex`` on device; device→host splits ``.real``/``.imag`` on device and joins on
+the host. Cost on a healthy backend: one trivially fused kernel per transfer — so the
+shim is on for every non-CPU platform rather than probing (a probe would poison the
+process on the broken one).
+
+This mirrors how the reference treats its interleaved-IQ DMA formats (seify streams are
+f32-pair interleaved on the wire, ``src/blocks/seify/source.rs``): pairs are the
+portable wire layout; the "complex" view is formed device-side.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["to_device", "to_host", "split_complex_platform"]
+
+_join_jit = None
+_split_jit = None
+
+
+def _jits():
+    global _join_jit, _split_jit
+    if _join_jit is None:
+        import jax
+
+        _join_jit = jax.jit(lambda p: jax.lax.complex(p[..., 0], p[..., 1]))
+        _split_jit = jax.jit(lambda x: (x.real, x.imag))
+    return _join_jit, _split_jit
+
+
+def split_complex_platform(platform: str) -> bool:
+    """Pair-shipping applies on every accelerator platform (cpu transfers are sane)."""
+    return platform != "cpu"
+
+
+def _device_platform(device=None) -> str:
+    import jax
+
+    if device is None:
+        return jax.default_backend()
+    if hasattr(device, "platform"):          # a Device
+        return device.platform
+    try:                                      # a Sharding
+        devs = list(device.device_set)
+        if devs:
+            return devs[0].platform
+    except AttributeError:
+        pass
+    return jax.default_backend()
+
+
+def to_device(arr, device=None):
+    """``jax.device_put`` that is safe for complex dtypes on broken-transfer backends."""
+    import jax
+
+    if isinstance(arr, jax.Array):
+        # already device-resident: device_put is a same-device no-op (or a safe D2D
+        # move); forcing it through np.asarray would be a blocking D2H round-trip
+        return jax.device_put(arr, device) if device is not None else arr
+    a = np.asarray(arr)
+    if np.issubdtype(a.dtype, np.complexfloating) and \
+            split_complex_platform(_device_platform(device)):
+        f = np.float64 if a.dtype == np.complex128 else np.float32
+        pairs = np.ascontiguousarray(a).view(f).reshape(a.shape + (2,))
+        join, _ = _jits()
+        return join(jax.device_put(pairs, device))
+    return jax.device_put(a, device)
+
+
+def to_host(arr) -> np.ndarray:
+    """``np.asarray`` that reads complex device arrays back as two float transfers."""
+    import jax
+
+    if not isinstance(arr, jax.Array):
+        # host data: the jitted split() would device_put the raw complex array —
+        # the exact broken path this shim avoids
+        return np.asarray(arr)
+    dt = np.dtype(getattr(arr, "dtype", np.float32))
+    if np.issubdtype(dt, np.complexfloating):
+        try:
+            devs = list(arr.devices())
+            platform = devs[0].platform if devs else _device_platform()
+        except Exception:
+            platform = _device_platform()
+        if split_complex_platform(platform):
+            _, split = _jits()
+            r, i = split(arr)
+            out = np.empty(arr.shape, dtype=dt)
+            out.real = np.asarray(r)
+            out.imag = np.asarray(i)
+            return out
+    return np.asarray(arr)

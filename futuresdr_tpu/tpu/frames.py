@@ -129,7 +129,7 @@ class TpuD2H(Kernel):
         item = self.input.get_full()
         if item is not None:
             frame, valid = item
-            host = np.asarray(frame)[:valid]      # sync point
+            host = self.inst.get(frame)[:valid]   # sync point
             k = min(len(out), len(host))
             out[:k] = host[:k]
             self.output.produce(k)

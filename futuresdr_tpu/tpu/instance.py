@@ -66,7 +66,14 @@ class TpuInstance:
         return self.device.platform
 
     def put(self, arr: np.ndarray):
-        return jax.device_put(arr, self.device)
+        """H2D that is safe for complex dtypes (pair shim, see ops/xfer.py)."""
+        from ..ops.xfer import to_device
+        return to_device(arr, self.device)
+
+    def get(self, arr) -> np.ndarray:
+        """D2H that is safe for complex dtypes (pair shim, see ops/xfer.py)."""
+        from ..ops.xfer import to_host
+        return to_host(arr)
 
 
 _instance: Optional[TpuInstance] = None

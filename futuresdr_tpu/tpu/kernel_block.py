@@ -90,7 +90,7 @@ class TpuKernel(Kernel):
 
     def _drain_one(self) -> np.ndarray:
         y, valid = self._inflight.popleft()
-        arr = np.asarray(y)       # sync point: blocks only this block's thread
+        arr = self.inst.get(y)    # sync point: blocks only this block's thread
         return arr[:valid]
 
     async def work(self, io, mio, meta):

@@ -53,8 +53,8 @@ class SpKernel(Kernel):
             min_buffer_size=(self.depth + 1) * self.out_frame * np.dtype(out_dtype).itemsize)
 
     def _dispatch(self, frame: np.ndarray) -> None:
-        import jax
-        x = jax.device_put(frame, self._in_sharding)   # scatter shards over the mesh
+        from ..ops.xfer import to_device
+        x = to_device(frame, self._in_sharding)        # scatter shards over the mesh
         self._inflight.append(self._fn(x))
 
     async def work(self, io, mio, meta):
@@ -73,7 +73,8 @@ class SpKernel(Kernel):
             inp = self.input.slice()
         eos = self.input.finished()
         if self._inflight and (len(self._inflight) >= self.depth or eos):
-            result = np.asarray(self._inflight.popleft())    # gather + sync
+            from ..ops.xfer import to_host
+            result = to_host(self._inflight.popleft())       # gather + sync
             out = self.output.slice()
             k = min(len(out), len(result))
             out[:k] = result[:k]
