@@ -97,14 +97,19 @@ class WavSink(Kernel):
 
 
 class AudioSource(Kernel):
-    """Soundcard capture (cpal `AudioSource` role); silence when no backend."""
+    """Soundcard capture (cpal `AudioSource` role).
+
+    Without an audio backend this **raises at init** (an SDR app capturing silence is a
+    trap, not a fallback) unless constructed with ``allow_null=True``, which emits
+    silence at real-time pace (CI / headless use)."""
 
     BLOCKING = True
 
-    def __init__(self, sample_rate: int, n_channels: int = 1):
+    def __init__(self, sample_rate: int, n_channels: int = 1, allow_null: bool = False):
         super().__init__()
         self.sample_rate = int(sample_rate)
         self.n_channels = n_channels
+        self.allow_null = allow_null
         self._stream = None
         self.output = self.add_stream_output("out", np.float32)
 
@@ -115,6 +120,10 @@ class AudioSource(Kernel):
                 samplerate=self.sample_rate, channels=self.n_channels, dtype="float32")
             self._stream.start()
         except Exception as e:
+            if not self.allow_null:
+                raise RuntimeError(
+                    f"AudioSource: no audio backend ({e!r}); pass allow_null=True "
+                    f"to emit silence instead") from e
             log.warning("no audio backend (%r): AudioSource emits silence", e)
             self._stream = None
 
@@ -144,15 +153,19 @@ class AudioSource(Kernel):
 
 
 class AudioSink(Kernel):
-    """Soundcard playback (cpal `AudioSink` role); degrades to drop-with-warning when no
-    audio backend is present."""
+    """Soundcard playback (cpal `AudioSink` role).
+
+    Without an audio backend this **raises at init** (an FM receiver that runs and plays
+    nothing is a trap) unless constructed with ``allow_null=True``, which drops samples
+    with a warning (CI / headless use)."""
 
     BLOCKING = True
 
-    def __init__(self, sample_rate: int, n_channels: int = 1):
+    def __init__(self, sample_rate: int, n_channels: int = 1, allow_null: bool = False):
         super().__init__()
         self.sample_rate = int(sample_rate)
         self.n_channels = n_channels
+        self.allow_null = allow_null
         self._stream = None
         self.input = self.add_stream_input("in", np.float32)
 
@@ -163,6 +176,10 @@ class AudioSink(Kernel):
                 samplerate=self.sample_rate, channels=self.n_channels, dtype="float32")
             self._stream.start()
         except Exception as e:
+            if not self.allow_null:
+                raise RuntimeError(
+                    f"AudioSink: no audio backend ({e!r}); pass allow_null=True "
+                    f"to drop samples instead") from e
             log.warning("no audio backend (%r): AudioSink drops samples", e)
             self._stream = None
 

@@ -82,7 +82,9 @@ class MessageBurst(Kernel):
 
     async def work(self, io, mio, meta):
         for _ in range(self.n):
-            mio.post("out", self.message)
+            # backpressured: a large burst parks here instead of growing the
+            # consumer's inbox without bound
+            await mio.post_async("out", self.message)
         io.finished = True
 
 

@@ -106,10 +106,17 @@ def decode_frame(bits: np.ndarray) -> Optional[AdsbMessage]:
 
 
 def _cpr_nl(lat: float) -> int:
-    if abs(lat) >= 87.0:
-        return 1 if abs(lat) < 90.0 else 1
+    # ICAO Annex 10 Vol III longitude-zone table edge cases: NL=59 at the equator,
+    # NL=2 at exactly ±87°, NL=1 beyond
+    alat = abs(lat)
+    if alat == 0.0:
+        return 59
+    if alat == 87.0:
+        return 2
+    if alat > 87.0:
+        return 1
     a = 1 - math.cos(math.pi / (2 * 15))
-    b = math.cos(math.pi / 180.0 * abs(lat)) ** 2
+    b = math.cos(math.pi / 180.0 * alat) ** 2
     nl = math.floor(2 * math.pi / math.acos(1 - a / b))
     return max(1, int(nl))
 

@@ -29,8 +29,9 @@ def _fir_kernel(prev_ref, cur_ref, taps_ref, o_ref, *, n_taps: int, block: int):
     acc = jnp.zeros((block,), jnp.float32)
     base = block - (n_taps - 1)
     for k in range(n_taps):                                     # static unroll
-        acc = acc + taps_ref[n_taps - 1 - k] * jax.lax.dynamic_slice(
-            full, (base + k,), (block,))
+        # static slice offsets (k is a Python int) — dynamic_slice has no Mosaic
+        # TC lowering; static lax.slice does
+        acc = acc + taps_ref[n_taps - 1 - k] * full[base + k:base + k + block]
     o_ref[...] = acc
 
 

@@ -128,7 +128,11 @@ class CircularWriter(BufferWriter):
                    min_items: int = 1) -> "CircularReader":
         idx = self._lib.fsdr_ring_add_reader(self._ring)
         if idx < 0:
-            raise RuntimeError("too many readers on one circular buffer (max 16)")
+            raise RuntimeError(
+                "too many readers on one circular buffer (native cap: 16, "
+                "FSDR_MAX_READERS in native/ringbuf.cpp). For wider broadcast "
+                "fan-out use the portable ring buffer (buffer='ring', unbounded "
+                "readers) on this edge.")
         r = CircularReader(self, idx, reader_inbox, port_index)
         self._readers.append(r)
         return r

@@ -128,12 +128,17 @@ class BlockInbox:
         self._lock = threading.Lock()
         self._pending = False          # coalesced data notification
         self._waiter: Optional[tuple] = None  # (loop, asyncio.Event)
+        self._space_waiters: list = []        # producers parked in send_async
         self.closed = False
 
     # -- producer side --------------------------------------------------------
     def send(self, msg: BlockMessage) -> bool:
         """Enqueue a control message and wake the block (`block_inbox.rs:120-136`).
-        Returns False if the inbox is closed (receiver gone)."""
+        Returns False if the inbox is closed (receiver gone).
+
+        UNBOUNDED: reserved for runtime control traffic (Initialize/Terminate/
+        Stream*Done) that must never be dropped. High-rate message-plane producers
+        use :meth:`send_async` (backpressure) or :meth:`try_send` (bounded drop)."""
         with self._lock:
             if self.closed:
                 return False
@@ -142,7 +147,34 @@ class BlockInbox:
         self._wake(waiter)
         return True
 
-    try_send = send  # soft-bounded; see module docstring
+    def try_send(self, msg: BlockMessage) -> bool:
+        """Bounded enqueue: returns False (drops) when the inbox is full or closed —
+        the reference's `try_send` on its bounded kanal channel."""
+        with self._lock:
+            if self.closed or (self.capacity > 0 and len(self._q) >= self.capacity):
+                return False
+            self._q.append(msg)
+            waiter = self._take_waiter_locked()
+        self._wake(waiter)
+        return True
+
+    async def send_async(self, msg: BlockMessage) -> bool:
+        """Bounded enqueue with backpressure: awaits until space frees (the
+        reference's `send().await`). Returns False if the inbox closed."""
+        while True:
+            with self._lock:
+                if self.closed:
+                    return False
+                if self.capacity <= 0 or len(self._q) < self.capacity:
+                    self._q.append(msg)
+                    waiter = self._take_waiter_locked()
+                    break
+                loop = asyncio.get_running_loop()
+                ev = asyncio.Event()
+                self._space_waiters.append((loop, ev))
+            await ev.wait()
+        self._wake(waiter)
+        return True
 
     def notify(self) -> None:
         """Coalescing data-plane wake: no payload, collapses repeats (`block_inbox.rs:48-52`)."""
@@ -183,7 +215,14 @@ class BlockInbox:
 
     def try_recv(self) -> Optional[BlockMessage]:
         with self._lock:
-            return self._q.popleft() if self._q else None
+            m = self._q.popleft() if self._q else None
+            sw: list = []
+            if m is not None and self._space_waiters and \
+                    (self.capacity <= 0 or len(self._q) < self.capacity):
+                sw, self._space_waiters = self._space_waiters, []
+        for w in sw:
+            self._wake(w)
+        return m
 
     def __len__(self) -> int:
         return len(self._q)
@@ -211,3 +250,6 @@ class BlockInbox:
         """Refuse new sends; already-queued messages stay drainable via try_recv."""
         with self._lock:
             self.closed = True
+            sw, self._space_waiters = self._space_waiters, []
+        for w in sw:                   # unpark producers so send_async sees closed
+            self._wake(w)

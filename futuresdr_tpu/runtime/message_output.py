@@ -36,9 +36,16 @@ class MessageOutputs:
         return list(self._conns[name])
 
     def post(self, name: str, pmt: Pmt) -> None:
-        """Fire-and-forget fan-out (`message_output.rs:49-66`)."""
+        """Fire-and-forget fan-out (`message_output.rs:49-66`); unbounded — for
+        low-rate posts. High-rate producers use :meth:`post_async`."""
         for inbox, handler in self._conns[name]:
             inbox.send(Call(handler, pmt))
+
+    async def post_async(self, name: str, pmt: Pmt) -> None:
+        """Fan-out with backpressure: awaits space in each full target inbox — the
+        semantics of the reference's async `post` over its bounded channel."""
+        for inbox, handler in self._conns[name]:
+            await inbox.send_async(Call(handler, pmt))
 
     def notify_finished(self) -> None:
         for name in self._names:

@@ -23,11 +23,21 @@ class WorkIo:
 
     def block_on(self, awaitable: Awaitable) -> None:
         """Park on ``awaitable`` before the next ``work`` call (`work_io.rs:30-38`)."""
+        self._drop_pending()
         self._block_on = awaitable
 
     def take_block_on(self) -> Optional[Awaitable]:
         aw, self._block_on = self._block_on, None
         return aw
 
+    def _drop_pending(self) -> None:
+        """Close a never-awaited parked coroutine (else: RuntimeWarning + leak)."""
+        aw, self._block_on = self._block_on, None
+        if aw is not None and hasattr(aw, "close"):
+            aw.close()
+
     def reset(self) -> None:
+        # a block_on left unconsumed by the event loop (work re-entered via
+        # call_again before the park happened) is stale — work() re-arms if needed
+        self._drop_pending()
         self.call_again = False

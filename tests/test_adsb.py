@@ -95,3 +95,14 @@ def test_build_frame_roundtrip():
     assert crc24(frame) == 0
     m = decode_frame(frame)
     assert m.crc_ok and m.icao == 0xABCDEF and m.type_code == 4
+
+
+def test_cpr_nl_table_edges():
+    from futuresdr_tpu.models.adsb.decoder import _cpr_nl
+    assert _cpr_nl(0.0) == 59
+    assert _cpr_nl(87.0) == 2
+    assert _cpr_nl(-87.0) == 2
+    assert _cpr_nl(88.5) == 1
+    assert _cpr_nl(10.0) == 59           # interior of the NL=59 zone
+    assert _cpr_nl(86.0) == 3            # near-polar interior still formula-driven
+    assert _cpr_nl(45.0) == 42

@@ -50,3 +50,48 @@ def test_message_source_periodic():
     fg.connect_message(src, "out", snk, "in")
     Runtime().run(fg)
     assert len(snk.received) == 5
+
+
+def test_bounded_inbox_try_send_drops_when_full():
+    from futuresdr_tpu.runtime.inbox import BlockInbox, Call
+    from futuresdr_tpu.types import Pmt, PortId
+    ib = BlockInbox(capacity=3)
+    msg = Call(PortId.coerce("in"), Pmt.ok())
+    assert all(ib.try_send(msg) for _ in range(3))
+    assert not ib.try_send(msg)          # full → bounded drop
+    assert ib.try_recv() is not None     # drain one → space frees
+    assert ib.try_send(msg)
+
+
+def test_send_async_backpressures_until_consumer_drains():
+    import asyncio
+    from futuresdr_tpu.runtime.inbox import BlockInbox, Call
+    from futuresdr_tpu.types import Pmt, PortId
+
+    async def scenario():
+        ib = BlockInbox(capacity=2)
+        msg = Call(PortId.coerce("in"), Pmt.ok())
+        await ib.send_async(msg)
+        await ib.send_async(msg)
+        parked = asyncio.ensure_future(ib.send_async(msg))
+        await asyncio.sleep(0.02)
+        assert not parked.done()         # producer parked on the full inbox
+        assert ib.try_recv() is not None
+        await asyncio.wait_for(parked, 1.0)
+        assert len(ib) == 2
+
+    asyncio.run(scenario())
+
+
+def test_large_burst_bounded_inbox_delivers_all():
+    # a burst far larger than the queue capacity must deliver every message
+    # (backpressure, not drops)
+    from futuresdr_tpu.config import config
+    cap = config().queue_size
+    n = cap * 4 + 7
+    fg = Flowgraph()
+    burst = MessageBurst(Pmt.usize(1), n)
+    snk = MessageSink()
+    fg.connect_message(burst, "out", snk, "in")
+    Runtime().run(fg)
+    assert len(snk.received) == n
