@@ -23,36 +23,49 @@ try:
 except ImportError:                    # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-__all__ = ["sp_fir", "sp_fir_fft_mag2", "sp_channelizer", "sp_channelizer_a2a"]
+__all__ = ["sp_fir", "sp_fir_fft_mag2", "sp_fir_stream", "sp_fir_fft_mag2_stream",
+           "sp_channelizer", "sp_channelizer_a2a"]
 
 
-def _halo_from_left(local: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarray:
-    """Prepend the previous shard's tail (zeros on shard 0) — the halo exchange."""
+def _halo_from_left(local: jnp.ndarray, halo: int, axis_name: str,
+                    carry: jnp.ndarray = None) -> jnp.ndarray:
+    """Prepend the previous shard's tail — the halo exchange.
+
+    Shard 0's left context is ``carry`` (the previous FRAME's global tail) when given,
+    zeros otherwise; so the stateful variants make sharded streaming bit-match a
+    single-device streaming stage across frame boundaries (the cross-frame carry the
+    reference keeps implicitly in its ring buffers, `fir.rs:49` min_items)."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     tail = local[-halo:]
     perm = [(i, (i + 1) % n) for i in range(n)]
     left_tail = jax.lax.ppermute(tail, axis_name, perm)  # shard i gets shard i-1's tail
-    left_tail = jnp.where(idx == 0, jnp.zeros_like(left_tail), left_tail)
+    fill = jnp.zeros_like(left_tail) if carry is None else carry.astype(local.dtype)
+    left_tail = jnp.where(idx == 0, fill, left_tail)
     return jnp.concatenate([left_tail, local])
+
+
+def _conv_valid(ext: jnp.ndarray, tj: jnp.ndarray) -> jnp.ndarray:
+    """Valid-mode FIR of the halo-extended shard (complex as two real passes)."""
+    if jnp.iscomplexobj(ext):
+        re = jnp.convolve(ext.real, tj, mode="valid", precision="highest")
+        im = jnp.convolve(ext.imag, tj, mode="valid", precision="highest")
+        return re + 1j * im
+    return jnp.convolve(ext, tj, mode="valid", precision="highest")
 
 
 def sp_fir(taps: np.ndarray, mesh: Mesh, axis: str = "sp") -> Callable:
     """Time-sharded FIR: input [n] sharded over ``axis``; output identically sharded.
 
     y = conv_valid(halo ++ local) per shard == the global FIR, exactly.
+    Requires local shard length ≥ len(taps)-1 (the halo must fit in one neighbour).
     """
     nt = len(taps)
-    H = jnp.asarray(taps[::-1])  # correlation kernel
+    tj = jnp.asarray(np.asarray(taps))
 
     def local_fir(x_local):
         ext = _halo_from_left(x_local, nt - 1, axis)
-        if jnp.iscomplexobj(ext):
-            re = jnp.convolve(ext.real, jnp.asarray(taps), mode="valid", precision="highest")
-            im = jnp.convolve(ext.imag, jnp.asarray(taps), mode="valid", precision="highest")
-            return (re + 1j * im).astype(x_local.dtype)
-        return jnp.convolve(ext, jnp.asarray(taps), mode="valid",
-                            precision="highest").astype(x_local.dtype)
+        return _conv_valid(ext, tj).astype(x_local.dtype)
 
     return shard_map(local_fir, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
 
@@ -66,15 +79,68 @@ def sp_fir_fft_mag2(taps: np.ndarray, fft_size: int, mesh: Mesh,
 
     def local(x_local):
         ext = _halo_from_left(x_local, nt - 1, axis)
-        if jnp.iscomplexobj(ext):
-            y = (jnp.convolve(ext.real, tj, mode="valid", precision="highest")
-                 + 1j * jnp.convolve(ext.imag, tj, mode="valid", precision="highest"))
-        else:
-            y = jnp.convolve(ext, tj, mode="valid", precision="highest")
+        y = _conv_valid(ext, tj)
         spec = jnp.fft.fft(y.reshape(-1, fft_size), axis=1)
         return (spec.real**2 + spec.imag**2).astype(jnp.float32).reshape(-1)
 
     return shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+
+
+def _make_stream(local: Callable, nt: int, mesh: Mesh, axis: str):
+    """Wrap a carry-taking local kernel into ``fn(carry, x) -> (carry, y)`` +
+    ``init_carry``: the carry is the previous frame's global tail (``nt-1`` samples,
+    replicated), consumed by shard 0 as left context. jit ``fn`` with
+    ``donate_argnums=(0,)`` to chain carries on-device."""
+    inner = shard_map(local, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis))
+    n_dev = mesh.shape[axis]
+
+    def fn(carry, x):
+        if x.shape[0] // n_dev < nt - 1:     # trace-time: clear error, not a deep
+            raise ValueError(                # shard_map broadcast failure
+                f"per-shard length {x.shape[0] // n_dev} < halo {nt - 1}: "
+                f"grow the frame or reduce taps/devices")
+        y = inner(x, carry)
+        return x[-(nt - 1):], y              # new carry: global frame tail
+
+    def init_carry(dtype):
+        from jax.sharding import NamedSharding
+
+        from ..ops.xfer import to_device
+        return to_device(np.zeros(nt - 1, dtype=np.dtype(dtype)),
+                         NamedSharding(mesh, P()))
+
+    return fn, init_carry
+
+
+def sp_fir_stream(taps: np.ndarray, mesh: Mesh, axis: str = "sp"):
+    """Cross-frame-stateful time-sharded FIR: ``fn(carry, x) -> (carry, y)``.
+
+    Streaming N frames through the sharded fn bit-matches the single-device streaming
+    ``fir_stage`` (see :func:`_make_stream` for the carry contract)."""
+    nt = len(taps)
+    tj = jnp.asarray(np.asarray(taps))
+
+    def local_fir(x_local, carry):
+        ext = _halo_from_left(x_local, nt - 1, axis, carry)
+        return _conv_valid(ext, tj).astype(x_local.dtype)
+
+    return _make_stream(local_fir, nt, mesh, axis)
+
+
+def sp_fir_fft_mag2_stream(taps: np.ndarray, fft_size: int, mesh: Mesh,
+                           axis: str = "sp"):
+    """Cross-frame-stateful fused north-star chain (see :func:`sp_fir_stream`):
+    FIR with frame-carry halo → per-shard batched FFT → |x|²."""
+    nt = len(taps)
+    tj = jnp.asarray(np.asarray(taps, dtype=np.float32))
+
+    def local(x_local, carry):
+        ext = _halo_from_left(x_local, nt - 1, axis, carry)
+        y = _conv_valid(ext, tj)
+        spec = jnp.fft.fft(y.reshape(-1, fft_size), axis=1)
+        return (spec.real**2 + spec.imag**2).astype(jnp.float32).reshape(-1)
+
+    return _make_stream(local, nt, mesh, axis)
 
 
 def sp_channelizer(n_channels: int, taps: np.ndarray, mesh: Mesh,

@@ -23,22 +23,30 @@ class SpKernel(Kernel):
     """Stream block running ``sharded_fn`` (e.g. ``parallel.sp_fir_fft_mag2(...)``)
     over ``mesh`` per frame; input frames are sharded over ``axis``, outputs gathered.
 
-    Note: the sharded stream ops are stateless ACROSS frames (halo exchange covers
-    intra-frame shard boundaries only) — filter history restarts at each frame edge.
-    Use frames ≫ taps (the default regime) or a stateful `TpuKernel` when exact
-    cross-frame continuity matters on one chip."""
+    With ``init_carry`` given, ``sharded_fn`` must be the stateful form
+    ``fn(carry, x) -> (carry, y)`` (e.g. ``parallel.sp_fir_stream``): the previous
+    frame's global tail is carried on-device and fed to shard 0 as left context, so
+    sharded streaming bit-matches a single-device streaming stage across frames.
+    Stateless fns (``fn(x) -> y``) restart filter history at each frame edge — fine
+    when frames ≫ taps."""
 
     BLOCKING = True
 
     def __init__(self, sharded_fn: Callable, mesh, in_dtype, out_dtype,
                  frame_size: int, ratio: float = 1.0, axis: str = "sp",
-                 frames_in_flight: int = 2):
+                 frames_in_flight: int = 2, init_carry: Optional[Callable] = None):
         super().__init__()
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         self.mesh = mesh
-        self._fn = jax.jit(sharded_fn)
+        self._stateful = init_carry is not None
+        if self._stateful:
+            self._fn = jax.jit(sharded_fn, donate_argnums=(0,))
+            self._carry = init_carry(in_dtype)
+        else:
+            self._fn = jax.jit(sharded_fn)
+            self._carry = None
         self._in_sharding = NamedSharding(mesh, P(axis))
         n_dev = mesh.shape[axis]
         assert frame_size % n_dev == 0, "frame must divide the mesh axis"
@@ -55,7 +63,11 @@ class SpKernel(Kernel):
     def _dispatch(self, frame: np.ndarray) -> None:
         from ..ops.xfer import to_device
         x = to_device(frame, self._in_sharding)        # scatter shards over the mesh
-        self._inflight.append(self._fn(x))
+        if self._stateful:
+            self._carry, y = self._fn(self._carry, x)  # carry chains on-device
+            self._inflight.append(y)
+        else:
+            self._inflight.append(self._fn(x))
 
     async def work(self, io, mio, meta):
         if self._pending is not None:

@@ -123,8 +123,8 @@ def save_flowgraph_state(fg, path: str) -> None:
 
 def load_flowgraph_state(fg, path: str) -> int:
     with open(path, "rb") as f:
-        magic = f.read(2)
-    if magic == b"\x80\x04" or magic[:1] == b"\x80":      # pickle protocol header
+        magic = f.read(1)
+    if magic == b"\x80":                                  # pickle protocol header
         raise ValueError(
             f"{path} is a legacy pickle-format checkpoint; the format changed to "
             f"data-only npz (arbitrary-code-execution hardening). Re-create it with "
