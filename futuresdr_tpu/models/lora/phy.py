@@ -67,10 +67,14 @@ def encode_payload_symbols(payload: bytes, p: LoraParams) -> np.ndarray:
     rest = nibbles[used:]
 
     symbols: List[int] = []
-    # header block: CR 4/8, sf-2 bits per symbol
+    # header block: CR 4/8, sf-2 bits per symbol, reduced rate — the inverse Gray map
+    # runs over the sf-2-bit field and the result rides on bins ×4
+    # (degray(s) << 2, NOT degray(s << 2): multiples of 4 on the wire are what give
+    # the reduced-rate mode its ±2-bin drift immunity, `gray_demap`/`fft_demod` of
+    # gr-lora_sdr)
     cw = coding.hamming_encode(hdr_nibbles, 4)
     sym = coding.interleave_block(cw, sf_app_hdr, 4)
-    symbols += [int(s) << 2 for s in sym]          # reduced-rate: bins are ×4
+    symbols += [int(g) << 2 for g in coding.degray(sym)]
     # payload blocks
     sf_app = p.sf - 2 if p.ldro else p.sf
     shift_bits = 2 if p.ldro else 0
@@ -81,10 +85,9 @@ def encode_payload_symbols(payload: bytes, p: LoraParams) -> np.ndarray:
             blk = np.concatenate([blk, np.zeros(sf_app - len(blk), np.uint8)])
         cw = coding.hamming_encode(blk, p.cr)
         sym = coding.interleave_block(cw, sf_app, p.cr)
-        symbols += [int(s) << shift_bits for s in sym]
+        symbols += [int(g) << shift_bits for g in coding.degray(sym)]
         i += sf_app
-    # TX applies the inverse Gray map so the RX dechirp+gray lands on the code symbol
-    return coding.degray(np.array(symbols, dtype=np.int64)) % p.n
+    return np.array(symbols, dtype=np.int64) % p.n
 
 
 def modulate_frame(payload: bytes, p: LoraParams) -> np.ndarray:
@@ -110,43 +113,157 @@ def _dechirp_bins(samples: np.ndarray, p: LoraParams) -> np.ndarray:
     return np.fft.fft(blocks, axis=1)
 
 
+def _block_cw(bins: np.ndarray, o, sf_app: int, cr: int, shift_bits: int,
+              n: int) -> np.ndarray:
+    """Offset-corrected bins → deinterleaved codewords. ``o`` may be a scalar or a
+    per-symbol integer array (drift correction)."""
+    g = coding.gray((bins - o) % n)
+    sym = (g >> shift_bits) & ((1 << sf_app) - 1)
+    return coding.deinterleave_block(sym, sf_app, cr)
+
+
+def _best_profile(bins: np.ndarray, starts, sf_app: int, cr: int, shift_bits: int,
+                  n: int):
+    """Arbitrate the per-symbol integer bin offset over one interleave block.
+
+    Candidate profiles: for each start offset, constant or one ±1 step at any
+    position (clock drift below ~1 bin per block ⇒ at most one step). The profile
+    with the fewest Hamming parity violations wins; candidates are ordered so ties
+    prefer no step, then the latest step (fewest changed symbols).
+    Returns (codewords, end_offset, violations).
+    """
+    blk = len(bins)
+    cands = []                                    # (v, cw, o_end) in preference order
+    for o0 in starts:
+        profiles = [np.full(blk, o0, dtype=np.int64)]
+        for t in (o0 + 1, o0 - 1):
+            for s in range(blk - 1, -1, -1):     # step at s: bins[s:] use t (s=0 ⇒
+                #                                  the drift crossed at the boundary)
+                prof = np.full(blk, o0, dtype=np.int64)
+                prof[s:] = t
+                profiles.append(prof)
+        for prof in profiles:
+            cw = _block_cw(bins, prof, sf_app, cr, shift_bits, n)
+            v = int(coding.hamming_violations(cw, cr).sum())
+            cands.append((v, cw, int(prof[-1])))
+    vmin = min(c[0] for c in cands)
+    # all minimal-violation candidates, deduped by codewords: at low coding rates a
+    # straddle bit can land on a parity-uncovered data bit (cr1: p0 misses d3), so
+    # ties are real — the payload CRC arbitrates among them later
+    out, seen = [], set()
+    for v, cw, o_end in cands:
+        if v == vmin and cw.tobytes() not in seen:
+            seen.add(cw.tobytes())
+            out.append((cw, o_end, v))
+        if len(out) >= 4:
+            break
+    return out
+
+
 def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] = None):
-    """Demodulated symbol values → (payload, crc_ok, header) or None."""
-    g = coding.gray(symbols.astype(np.int64))
+    """Demodulated symbol bins → (payload, crc_ok, header) or None.
+
+    Tracks residual symbol-timing drift (SFO, `frame_sync.rs` sfo_cum role): a clock
+    offset walks the dechirped bins by ±1 every ~1/(ppm·2^sf) symbols, and the sync
+    epoch leaves a constant integer bias. Per interleave block, the decoder arbitrates
+    an offset profile (constant, or one ±1 step at any intra-block position) with the
+    Hamming parity checks — a wrong offset scrambles codewords and lights up the
+    parities, so the step lands on the exact symbol where the drift crossed a bin
+    boundary. Offsets chain block to block; the header block searches a wide constant
+    bias (±3) on top.
+    """
+    bins = np.asarray(symbols, dtype=np.int64)
+    n = p.n
+    nq = n >> 2
     sf_app_hdr = p.sf - 2
     n_hdr_sym = 8                                  # CR 4/8 header block
-    if len(g) < n_hdr_sym:
+    if len(bins) < n_hdr_sym:
         return None
-    hdr_sym = (g[:n_hdr_sym] >> 2) & ((1 << sf_app_hdr) - 1)
-    cw = coding.deinterleave_block(hdr_sym, sf_app_hdr, 4)
+    # reduced-rate blocks ride on bins ×4 (see encode_payload_symbols): rounding to
+    # the nearest group absorbs ±2 bins of drift/noise, and drift tracking runs in
+    # the uniform group domain
+    qbins = (((bins + 2) >> 2) % nq).astype(np.int64)
+    hdr_cands = _best_profile(qbins[:n_hdr_sym], (0, 1, -1), sf_app_hdr, 4, 0, nq)
+    cw, o_hdr_q, _ = hdr_cands[0]
     hdr_nibbles = coding.hamming_decode(cw, 4)
     parsed = coding.parse_header(hdr_nibbles[:5])
     if parsed is None:
         return None
     length, cr, has_crc = parsed
-    extra = list(hdr_nibbles[5:])
 
     sf_app = p.sf - 2 if p.ldro else p.sf
-    shift_bits = 2 if p.ldro else 0
     n_crc = 2 if has_crc else 0
     n_nibbles_needed = 2 * (length + n_crc)
-    nibbles = list(extra)
-    i = n_hdr_sym
-    while len(nibbles) < n_nibbles_needed and i + (4 + cr) <= len(g):
-        blk = (g[i:i + 4 + cr] >> shift_bits) & ((1 << sf_app) - 1)
-        cw = coding.deinterleave_block(blk, sf_app, cr)
-        nibbles += list(coding.hamming_decode(cw, cr))
-        i += 4 + cr
-    if len(nibbles) < n_nibbles_needed:
+    n_from_hdr = max(0, sf_app_hdr - 5)
+    blk_len = 4 + cr
+    n_blocks = max(0, -(-(n_nibbles_needed - n_from_hdr) // sf_app))
+    if n_hdr_sym + n_blocks * blk_len > len(bins):
         return None
-    data = bytes([(nibbles[2 * j] & 0xF) | ((nibbles[2 * j + 1] & 0xF) << 4)
-                  for j in range(length + n_crc)])
-    payload = coding.dewhiten(data[:length])
-    crc_ok = True
-    if has_crc:
-        rx_crc = data[length] | (data[length + 1] << 8)
-        crc_ok = coding.crc16(payload) == rx_crc
-    return payload, crc_ok, (length, cr, has_crc)
+
+    if p.ldro:
+        p_n = nq
+        pbins = qbins
+        o_run = o_hdr_q
+        first_starts = (o_run, o_run + 1, o_run - 1)
+    else:
+        p_n = n
+        pbins = bins
+        # the header's group offset pins the bin offset only to ±2 within a group;
+        # the first payload block re-searches the residual
+        o_run = 4 * o_hdr_q
+        first_starts = tuple(o_run + r for r in (0, 1, -1, 2, -2, 3, -3))
+
+    block_alts: List[List[np.ndarray]] = []       # per-block candidate nibble lists
+    cached = None                                 # lookahead reuse: (start, cands)
+    for b in range(n_blocks):
+        i = n_hdr_sym + b * blk_len
+        starts = first_starts if b == 0 else (o_run,)
+        if cached is not None and cached[0] == starts:
+            cands = cached[1]
+        else:
+            cands = _best_profile(pbins[i:i + blk_len], starts, sf_app, cr, 0, p_n)
+        cached = None
+        ends = {c[1] for c in cands}
+        if len(ends) > 1 and b + 1 < n_blocks:
+            # tied candidates disagree on the end offset (a low-rate block can hide a
+            # ±1 error entirely on parity-uncovered bits): let the NEXT block's
+            # violations arbitrate which chain to follow
+            j = i + blk_len
+            nxt = {e: _best_profile(pbins[j:j + blk_len], (e,), sf_app, cr, 0, p_n)
+                   for e in sorted(ends)}
+            o_run = min(sorted(ends), key=lambda e: nxt[e][0][2])
+            cached = ((o_run,), nxt[o_run])       # next iteration reuses this sweep
+        else:
+            o_run = cands[0][1]
+        block_alts.append([coding.hamming_decode(cw_, cr) for cw_, _, _ in cands])
+
+    def assemble(choice) -> tuple:
+        nibbles = list(hdr_nibbles[5:])
+        for alt in choice:
+            nibbles += list(alt)
+        if len(nibbles) < n_nibbles_needed:
+            return None
+        data = bytes([(nibbles[2 * j] & 0xF) | ((nibbles[2 * j + 1] & 0xF) << 4)
+                      for j in range(length + n_crc)])
+        payload = coding.dewhiten(data[:length])
+        crc_ok = True
+        if has_crc:
+            rx_crc = data[length] | (data[length + 1] << 8)
+            crc_ok = coding.crc16(payload) == rx_crc
+        return payload, crc_ok, (length, cr, has_crc)
+
+    # CRC arbitrates among the per-block ambiguities (bounded search)
+    import itertools
+    first = None
+    for combo in itertools.islice(itertools.product(*block_alts), 1024):
+        r = assemble(combo)
+        if r is None:
+            return None
+        if first is None:
+            first = r
+        if r[1]:
+            return r
+    return first
 
 
 def detect_frames(samples: np.ndarray, p: LoraParams) -> List[int]:
@@ -263,5 +380,7 @@ def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams):
     spec = _dechirp_bins(samples[pos:], p)
     if len(spec) == 0:
         return None
-    symbols = (np.argmax(np.abs(spec), axis=1) - f_bin) % n
-    return decode_symbols(symbols, p)
+    # raw argmax bins; decode_symbols absorbs the constant sync bias AND the per-symbol
+    # clock drift (SFO) via parity-arbitrated offset tracking — see its docstring
+    bins = (np.argmax(np.abs(spec), axis=1) - f_bin) % n
+    return decode_symbols(bins, p)

@@ -35,6 +35,8 @@ def _halo_from_left(local: jnp.ndarray, halo: int, axis_name: str,
     zeros otherwise; so the stateful variants make sharded streaming bit-match a
     single-device streaming stage across frame boundaries (the cross-frame carry the
     reference keeps implicitly in its ring buffers, `fir.rs:49` min_items)."""
+    if halo <= 0:
+        return local                    # 1-tap FIR: no history needed
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     tail = local[-halo:]
@@ -100,7 +102,8 @@ def _make_stream(local: Callable, nt: int, mesh: Mesh, axis: str):
                 f"per-shard length {x.shape[0] // n_dev} < halo {nt - 1}: "
                 f"grow the frame or reduce taps/devices")
         y = inner(x, carry)
-        return x[-(nt - 1):], y              # new carry: global frame tail
+        # new carry: global frame tail (x[-0:] would be the WHOLE frame at nt=1)
+        return x[x.shape[0] - (nt - 1):], y
 
     def init_carry(dtype):
         from jax.sharding import NamedSharding
