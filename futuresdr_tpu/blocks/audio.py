@@ -167,7 +167,10 @@ class AudioSink(Kernel):
         self.n_channels = n_channels
         self.allow_null = allow_null
         self._stream = None
-        self.input = self.add_stream_input("in", np.float32)
+        # short queue: at 48 kHz a 16 KiB float buffer is already 85 ms of audio —
+        # real-time playback wants the low-latency profile by default
+        self.input = self.add_stream_input("in", np.float32,
+                                           preferred_buffer_size=16384)
 
     async def init(self, mio, meta):
         try:

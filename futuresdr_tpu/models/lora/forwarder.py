@@ -120,8 +120,10 @@ class PacketForwarderClient(Kernel):
         self._token = (self._token + 1) & 0xFFFF
         return self._token.to_bytes(2, "big")
 
-    def _send(self, ident: int, body: bytes = b"", with_eui: bool = True) -> None:
-        pkt = bytes([PROTOCOL_VERSION]) + self._next_token() + bytes([ident])
+    def _send(self, ident: int, body: bytes = b"", with_eui: bool = True,
+              token: Optional[bytes] = None) -> None:
+        pkt = (bytes([PROTOCOL_VERSION]) + (token or self._next_token())
+               + bytes([ident]))
         if with_eui:
             pkt += self.eui
         self._transport.sendto(pkt + body)
@@ -143,9 +145,10 @@ class PacketForwarderClient(Kernel):
             except (ValueError, UnicodeDecodeError):
                 log.warning("malformed PULL_RESP")
                 return
-            # ack the downlink (error NONE) and surface it on the message plane
+            # ack the downlink (error NONE) — the TX_ACK must ECHO the PULL_RESP's
+            # token, that's how the server correlates it — then surface the txpk
             body = json.dumps({"txpk_ack": {"error": "NONE"}}).encode()
-            self._send(TX_ACK, body)
+            self._send(TX_ACK, body, token=data[1:3])
             if "data" in txpk:
                 txpk = dict(txpk)
                 txpk["data"] = Pmt.blob(base64.b64decode(txpk["data"]))

@@ -97,29 +97,53 @@ def modulate_frame(psdu: bytes, sps_chip: int = SAMPLES_PER_CHIP) -> np.ndarray:
 
 
 def _mm_clock_recovery(x: np.ndarray, sps: float, mu0: float = 0.5,
-                       gain_mu: float = 0.03) -> np.ndarray:
-    """Mueller-Müller timing recovery on a real-valued waveform
-    (`ClockRecoveryMm` block, `examples/zigbee/src/clock_recovery_mm.rs` role)."""
-    out = []
-    mu = mu0
-    i = 0
-    last = 0.0
-    last_d = 0.0
-    while i + int(np.ceil(sps)) + 1 < len(x):
-        frac = mu
-        base = i
-        # linear interpolation at base+frac
-        s = x[base] * (1 - frac) + x[base + 1] * frac
+                       gain_step: float = 0.002, gain_phase: float = 0.15,
+                       block: int = 32) -> np.ndarray:
+    """Mueller-Müller timing recovery, block-vectorized
+    (`ClockRecoveryMm` block, `examples/zigbee/src/clock_recovery_mm.rs` role).
+
+    The reference's per-sample loop adapts timing every symbol — inherently
+    sequential and ~50× too slow in Python for the 4 Mchip/s real-time rate. Like the
+    block-floating AGC (`ops/stages.py agc_stage`), the control loop here runs at
+    ``block``-symbol granularity: within a block the timing step is frozen, so all
+    ``block`` interpolants are one vectorized gather+lerp; the MM error aggregated
+    over the block then updates the step (clock-rate estimate) and nudges the phase
+    once. Converges like the per-sample loop with a ``block``-symbol control delay —
+    drift within one block is ≪ a sample for any realistic clock (±100 ppm × 32
+    symbols × 4 sps ≈ 0.01 samples).
+    """
+    n = len(x)
+    out_parts = []
+    pos = mu0
+    step = float(sps)
+    prev_s = 0.0
+    prev_d = 0.0
+    lo, hi = sps * 0.9, sps * 1.1
+    while True:
+        # final partial block: shrink so the stream tail is still despread (the
+        # per-sample loop only lost ~sps samples; losing a whole block would drop
+        # the last chips of a frame ending at the capture edge)
+        blk = block
+        while blk > 0 and pos + step * blk + 2 >= n:
+            blk = int((n - 2 - pos) / step)
+        if blk <= 0:
+            break
+        t = pos + step * np.arange(blk)
+        i = t.astype(np.int64)
+        frac = t - i
+        s = x[i] * (1.0 - frac) + x[i + 1] * frac          # vectorized lerp
         d = np.sign(s)
-        err = last_d * s - d * last
-        last, last_d = s, d
-        out.append(s)
-        step = sps + gain_mu * err
-        step = min(max(step, sps * 0.9), sps * 1.1)
-        i_f = base + frac + step
-        i = int(i_f)
-        mu = i_f - i
-    return np.asarray(out)
+        # MM error over the block incl. the boundary pair with the previous block
+        sl = np.concatenate(([prev_s], s))
+        dl = np.concatenate(([prev_d], d))
+        err = float(np.mean(dl[:-1] * sl[1:] - dl[1:] * sl[:-1]))
+        out_parts.append(s)
+        prev_s, prev_d = float(s[-1]), float(d[-1])
+        step = min(max(sps + gain_step * err * sps, lo), hi)
+        pos = t[-1] + step + gain_phase * err              # phase nudge
+    if not out_parts:
+        return np.zeros(0, dtype=x.dtype)
+    return np.concatenate(out_parts)
 
 
 def _freq_templates(sps_chip: int = SAMPLES_PER_CHIP) -> np.ndarray:

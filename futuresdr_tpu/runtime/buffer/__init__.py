@@ -88,14 +88,19 @@ class BufferWriter(ABC):
 
 
 def negotiate_capacity(itemsize: int, min_items_constraints: Sequence[int],
-                       min_buffer_sizes: Sequence[int]) -> int:
+                       min_buffer_sizes: Sequence[int],
+                       override_bytes: Optional[int] = None) -> int:
     """Connect-time size negotiation (`buffer/circular.rs:154-189`).
 
-    Capacity in items = max(config buffer_size in bytes, explicit byte minimums,
-    2× the largest ``min_items`` constraint so a full work window always fits),
-    rounded up to a power of two.
+    Capacity in items = max(byte budget, explicit byte minimums, 2× the largest
+    ``min_items`` constraint so a full work window always fits), rounded up to a
+    power of two. The byte budget is ``override_bytes`` (a per-edge latency/
+    throughput override) when given, else the config default.
     """
-    items = max(1, config().buffer_size // itemsize)
+    if override_bytes is not None and override_bytes <= 0:
+        raise ValueError(f"buffer_size override must be positive, got {override_bytes}")
+    budget = override_bytes if override_bytes is not None else config().buffer_size
+    items = max(1, budget // itemsize)
     for b in min_buffer_sizes:
         if b:
             items = max(items, math.ceil(b / itemsize))
@@ -109,11 +114,13 @@ class StreamOutput:
     """Output port facade declared by a block (`#[output]` field equivalent)."""
 
     def __init__(self, name: str, dtype, min_items: int = 1,
-                 min_buffer_size: int = 0, buffer: Optional[Type] = None):
+                 min_buffer_size: int = 0, buffer: Optional[Type] = None,
+                 preferred_buffer_size: Optional[int] = None):
         self.name = name
         self.dtype = np.dtype(dtype) if dtype is not None else None
         self.min_items = min_items
         self.min_buffer_size = min_buffer_size
+        self.preferred_buffer_size = preferred_buffer_size
         self.buffer = buffer          # backend class override for this port
         self.writer: Optional[BufferWriter] = None
         self._pending_tags: List[ItemTag] = []
@@ -147,10 +154,14 @@ class StreamOutput:
 class StreamInput:
     """Input port facade declared by a block (`#[input]` field equivalent)."""
 
-    def __init__(self, name: str, dtype, min_items: int = 1):
+    def __init__(self, name: str, dtype, min_items: int = 1,
+                 preferred_buffer_size: Optional[int] = None):
         self.name = name
         self.dtype = np.dtype(dtype) if dtype is not None else None
         self.min_items = min_items
+        # latency hint: a port that feeds a real-time sink (audio, feedback loop)
+        # prefers a short queue; honored at negotiation unless the edge overrides
+        self.preferred_buffer_size = preferred_buffer_size
         self.reader: Optional[BufferReader] = None
         self._finished = False        # StreamInputDone received (upstream writer done)
         self.items_consumed = 0       # observability counter (SURVEY §5 metrics)

@@ -64,7 +64,9 @@ class StreamEdge:
     src_port: str
     dst: Kernel
     dst_port: str
-    buffer: Optional[type] = None   # BufferWriter subclass override
+    buffer: Optional[type] = None       # BufferWriter subclass override
+    buffer_size: Optional[int] = None   # byte-budget override for THIS edge (latency
+    #                                     vs throughput knob; min_items still floor it)
 
 
 @dataclass
@@ -134,8 +136,15 @@ class Flowgraph:
             self.connect_stream(a, out[0].name, b, inp[0].name)
 
     def connect_stream(self, src: Kernel, src_port: str, dst: Kernel, dst_port: str,
-                       buffer: Optional[type] = None) -> None:
-        """Typed stream connect (`flowgraph.rs:364-423`)."""
+                       buffer: Optional[type] = None,
+                       buffer_size: Optional[int] = None) -> None:
+        """Typed stream connect (`flowgraph.rs:364-423`).
+
+        ``buffer_size`` overrides the negotiated byte budget for this edge — the
+        per-edge latency/throughput knob (small buffers ⇒ short queues ⇒ low
+        latency; see docs/performance.md low-latency profile). ``min_items``
+        constraints still floor the capacity so work windows always fit.
+        """
         self.add(src)
         self.add(dst)
         op = src.stream_output(src_port)   # raises on bad name
@@ -146,7 +155,8 @@ class Flowgraph:
         if ip.reader is not None or any(
                 e.dst is dst and e.dst_port == dst_port for e in self.stream_edges):
             raise ConnectError(f"input {dst!r}.{dst_port} already connected")
-        self.stream_edges.append(StreamEdge(src, src_port, dst, dst_port, buffer))
+        self.stream_edges.append(
+            StreamEdge(src, src_port, dst, dst_port, buffer, buffer_size))
 
     def connect_inplace(self, src: Kernel, src_port: str, dst: Kernel,
                         dst_port: str) -> None:
@@ -197,10 +207,24 @@ class Flowgraph:
             if dtype is None:
                 dtype = np.dtype(np.uint8)
             dst_ports = [e.dst.stream_input(e.dst_port) for e in edges]
+            size_overrides = {e.buffer_size for e in edges
+                              if e.buffer_size is not None}
+            if len(size_overrides) > 1:
+                raise ConnectError(
+                    f"conflicting buffer_size overrides on broadcast output "
+                    f"{edges[0].src!r}.{edges[0].src_port}: {size_overrides}")
+            # ports may declare a preference (e.g. AudioSink wants short queues);
+            # an explicit edge override wins, else the smallest preference
+            prefs = [p.preferred_buffer_size
+                     for p in [op] + dst_ports
+                     if getattr(p, "preferred_buffer_size", None)]
+            override = (size_overrides.pop() if size_overrides
+                        else (min(prefs) if prefs else None))
             cap = negotiate_capacity(
                 dtype.itemsize,
                 [op.min_items] + [p.min_items for p in dst_ports],
                 [op.min_buffer_size],
+                override_bytes=override,
             )
             overrides = {e.buffer for e in edges if e.buffer is not None}
             if len(overrides) > 1:

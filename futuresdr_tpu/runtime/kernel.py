@@ -73,14 +73,17 @@ class Kernel:
                 self._message_handlers[hname] = getattr(self, attr_name)
 
     # -- port declaration ------------------------------------------------------
-    def add_stream_input(self, name: str, dtype, min_items: int = 1) -> StreamInput:
-        port = StreamInput(name, dtype, min_items)
+    def add_stream_input(self, name: str, dtype, min_items: int = 1,
+                         preferred_buffer_size=None) -> StreamInput:
+        port = StreamInput(name, dtype, min_items, preferred_buffer_size)
         self._stream_inputs.append(port)
         return port
 
     def add_stream_output(self, name: str, dtype, min_items: int = 1,
-                          min_buffer_size: int = 0, buffer=None) -> StreamOutput:
-        port = StreamOutput(name, dtype, min_items, min_buffer_size, buffer)
+                          min_buffer_size: int = 0, buffer=None,
+                          preferred_buffer_size=None) -> StreamOutput:
+        port = StreamOutput(name, dtype, min_items, min_buffer_size, buffer,
+                            preferred_buffer_size)
         self._stream_outputs.append(port)
         return port
 

@@ -26,21 +26,26 @@ def main():
     p.add_argument("--samples", type=int, default=10_000_000)
     p.add_argument("--granularity", type=int, default=65536)
     p.add_argument("--max-copy", type=int, default=4096)
+    p.add_argument("--buffer-size", type=int, default=0,
+                   help="per-edge buffer byte override (0 = config default); the "
+                        "low-latency profile is --buffer-size 16384")
     a = p.parse_args()
+    bs = a.buffer_size or None
     print("run,stages,granularity,count,p50_us,p99_us,max_us")
     for r in range(a.runs):
         fg = Flowgraph()
         src = NullSource(np.float32)
         head = Head(np.float32, a.samples)
         probe_in = LatencyProbeSource(np.float32, a.granularity)
-        fg.connect(src, head, probe_in)
+        fg.connect_stream(src, "out", head, "in")
+        fg.connect_stream(head, "out", probe_in, "in", buffer_size=bs)
         last = probe_in
         for _ in range(a.stages):
             c = CopyRand(np.float32, a.max_copy)
-            fg.connect(last, c)
+            fg.connect_stream(last, "out", c, "in", buffer_size=bs)
             last = c
         snk = LatencyProbeSink(np.float32)
-        fg.connect(last, snk)
+        fg.connect_stream(last, "out", snk, "in", buffer_size=bs)
         Runtime().run(fg)
         s = latency_stats(snk.records)
         print(f"{r},{a.stages},{a.granularity},{s['count']},"

@@ -110,3 +110,52 @@ def test_flowgraph_roundtrip_on_backend(backend):
     fg.connect_stream(cp, "out", snk, "in", buffer=backend)
     Runtime().run(fg)
     np.testing.assert_array_equal(snk.items(), data)
+
+
+def test_per_edge_buffer_size_override():
+    """connect_stream(buffer_size=...) bounds the negotiated capacity (latency knob)."""
+    import numpy as np
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import Copy, Head, NullSink, NullSource
+
+    fg = Flowgraph()
+    src = NullSource(np.float32)
+    head = Head(np.float32, 100_000)
+    cp = Copy(np.float32)
+    snk = NullSink(np.float32)
+    fg.connect_stream(src, "out", head, "in")
+    fg.connect_stream(head, "out", cp, "in", buffer_size=16384)
+    fg.connect_stream(cp, "out", snk, "in")
+    fg._materialize()
+    small = head.stream_outputs[0].writer.capacity
+    big = src.stream_outputs[0].writer.capacity
+    assert small == 16384 // 4          # 4096 float32 items
+    assert big > small                  # other edges keep the config default
+
+
+def test_preferred_buffer_size_port_hint():
+    """A port's preferred_buffer_size shortens its edge unless overridden."""
+    import numpy as np
+    from futuresdr_tpu import Flowgraph
+    from futuresdr_tpu.blocks import Head, NullSource
+    from futuresdr_tpu.runtime.kernel import Kernel
+
+    class ShortQueueSink(Kernel):
+        def __init__(self):
+            super().__init__()
+            self.input = self.add_stream_input("in", np.float32,
+                                               preferred_buffer_size=8192)
+
+        async def work(self, io, mio, meta):
+            self.input.consume(self.input.available())
+            if self.input.finished():
+                io.finished = True
+
+    fg = Flowgraph()
+    src = NullSource(np.float32)
+    head = Head(np.float32, 1000)
+    snk = ShortQueueSink()
+    fg.connect_stream(src, "out", head, "in")
+    fg.connect_stream(head, "out", snk, "in")
+    fg._materialize()
+    assert head.stream_outputs[0].writer.capacity == 8192 // 4

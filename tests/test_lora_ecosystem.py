@@ -17,7 +17,8 @@ from futuresdr_tpu.models.lora import (LoraParams, LoraTransmitter,
                                        PacketForwarderClient, build_rxpk,
                                        build_multichannel_rx, meshtastic)
 from futuresdr_tpu.models.lora.forwarder import (PROTOCOL_VERSION, PUSH_DATA,
-                                                 PUSH_ACK, PULL_DATA, PULL_RESP)
+                                                 PUSH_ACK, PULL_DATA, PULL_RESP,
+                                                 TX_ACK)
 
 
 class FakeGwmpServer:
@@ -31,6 +32,7 @@ class FakeGwmpServer:
         self.addr = self.sock.getsockname()
         self.push_data = []
         self.pull_addrs = []
+        self.tx_acks = []           # (token, body) pairs
         self._stop = False
         self.thread = threading.Thread(target=self._run, daemon=True)
         self.thread.start()
@@ -51,11 +53,14 @@ class FakeGwmpServer:
             elif ident == PULL_DATA:
                 self.pull_addrs.append(addr)
                 self.sock.sendto(bytes([PROTOCOL_VERSION]) + token + bytes([4]), addr)
+            elif ident == TX_ACK:
+                self.tx_acks.append((bytes(token), data[12:]))
 
-    def send_downlink(self, txpk: dict):
+    def send_downlink(self, txpk: dict, token: bytes = b"\x5a\xa5"):
         body = json.dumps({"txpk": txpk}).encode()
         for addr in self.pull_addrs[-1:]:
-            self.sock.sendto(bytes([PROTOCOL_VERSION, 0, 0, PULL_RESP]) + body, addr)
+            self.sock.sendto(bytes([PROTOCOL_VERSION]) + token
+                             + bytes([PULL_RESP]) + body, addr)
 
     def close(self):
         self._stop = True
@@ -111,6 +116,8 @@ def test_forwarder_push_data_and_downlink():
         assert snk.received, "downlink not surfaced"
         dl = snk.received[0].to_map()
         assert dl["data"].to_blob() == b"dl-payload"
+        # TX_ACK must echo the PULL_RESP token (servers correlate acks by token)
+        assert server.tx_acks and server.tx_acks[0][0] == b"\x5a\xa5"
     finally:
         server.close()
 
