@@ -102,6 +102,9 @@ class SeifySource(Kernel):
         if n == 0:
             return
         data = self.device.driver.read(n)   # blocking; we're on a dedicated thread
+        if data is None:                    # driver EOS (e.g. rtl_tcp server gone)
+            io.finished = True
+            return
         k = len(data)
         if k:
             if self.n_channels == 1:

@@ -39,6 +39,20 @@ class Remote:
         return RemoteFlowgraph(self, fg_id)
 
 
+class Connection:
+    """A typed edge of the remote flowgraph (`remote.rs:246-291`)."""
+
+    def __init__(self, kind: str, src: "RemoteBlock", src_port, dst: "RemoteBlock",
+                 dst_port):
+        self.kind = kind                      # "stream" | "message"
+        self.src, self.src_port = src, src_port
+        self.dst, self.dst_port = dst, dst_port
+
+    def __repr__(self):
+        return (f"Connection({self.kind}: {self.src.instance_name}.{self.src_port} → "
+                f"{self.dst.instance_name}.{self.dst_port})")
+
+
 class RemoteFlowgraph:
     def __init__(self, remote: Remote, fg_id: int):
         self.remote = remote
@@ -55,6 +69,16 @@ class RemoteFlowgraph:
         desc = await self.remote._get(f"/api/fg/{self.id}/block/{block_id}/")
         return RemoteBlock(self, block_id, desc)
 
+    async def connections(self) -> List[Connection]:
+        """Typed stream + message edges (`remote.rs` Connection/ConnectionType)."""
+        desc = await self.description()
+        by_id = {b["id"]: RemoteBlock(self, b["id"], b) for b in desc["blocks"]}
+        out: List[Connection] = []
+        for kind, key in (("stream", "stream_edges"), ("message", "message_edges")):
+            for s, sp, d, dp in desc.get(key, []):
+                out.append(Connection(kind, by_id[s], sp, by_id[d], dp))
+        return out
+
 
 class RemoteBlock:
     def __init__(self, fg: RemoteFlowgraph, block_id: int, description: Optional[dict] = None):
@@ -62,8 +86,32 @@ class RemoteBlock:
         self.id = block_id
         self.description = description or {}
 
-    async def call(self, handler, pmt: Pmt = None) -> Pmt:
+    @property
+    def instance_name(self) -> str:
+        return self.description.get("instance_name", f"block{self.id}")
+
+    @property
+    def type_name(self) -> str:
+        return self.description.get("type_name", "")
+
+    def handlers(self) -> List[str]:
+        """Names of the block's message handlers — addressable by name or index
+        (`remote.rs` Handler::Name/Handler::Id)."""
+        return list(self.description.get("message_inputs", []))
+
+    async def call(self, handler) -> Pmt:
+        """Call with ``Pmt::Null`` — the get-style form (`remote.rs:211-214`:
+        `call` delegates to `callback` with Null)."""
+        return await self.callback(handler, Pmt.null())
+
+    async def callback(self, handler, pmt: Pmt = None) -> Pmt:
+        """Call a handler (by name or index) with ``pmt``; returns the reply."""
+        if pmt is None:
+            pmt = Pmt.null()
         pmt = Pmt.from_py(pmt) if not isinstance(pmt, Pmt) else pmt
         r = await self.fg.remote._post(
             f"/api/fg/{self.fg.id}/block/{self.id}/call/{handler}/", pmt.to_json())
         return Pmt.from_json(r)
+
+    def __repr__(self):
+        return f"{self.instance_name} ({self.type_name}, {self.id})"

@@ -1,0 +1,353 @@
+/* futuresdr_tpu browser widget library.
+ *
+ * Role of the reference's `prophecy` leptos/WASM crate (crates/prophecy/src/lib.rs:9-52):
+ * the same widget inventory — FlowgraphHandle + poll/call_periodically, FlowgraphCanvas
+ * (blocks + stream/message edges), FlowgraphTable, PmtEditor/PmtInput, Slider,
+ * RadioSelector, ListSelector, TimeSink, Waterfall, ConstellationSink,
+ * ConstellationSinkDensity, ArrayView — as plain ES5-ish canvas/DOM code, no build step.
+ * Widgets talk to the REST control plane (runtime/ctrl_port.py routes) and to
+ * WebsocketSink binary float32 frames.
+ */
+'use strict';
+const FSDR = {};
+
+/* ---------------- handle: REST control plane ------------------------------ */
+FSDR.Handle = function (base) { this.base = base.replace(/\/$/, ''); };
+FSDR.Handle.prototype.flowgraphs = async function () {
+  return (await fetch(this.base + '/api/fg/')).json();
+};
+FSDR.Handle.prototype.describe = async function (fg) {
+  return (await fetch(this.base + '/api/fg/' + fg + '/')).json();
+};
+FSDR.Handle.prototype.metrics = async function (fg) {
+  return (await fetch(this.base + '/api/fg/' + fg + '/metrics/')).json();
+};
+FSDR.Handle.prototype.call = async function (fg, blk, handler, pmt) {
+  const r = await fetch(
+    this.base + '/api/fg/' + fg + '/block/' + blk + '/call/' + handler + '/',
+    {method: 'POST', headers: {'Content-Type': 'application/json'},
+     body: JSON.stringify(pmt)});
+  return r.json();
+};
+FSDR.pollPeriodically = function (fn, ms) {
+  let live = true;
+  (async function loop() {
+    while (live) { try { await fn(); } catch (e) {} await new Promise(r => setTimeout(r, ms)); }
+  })();
+  return () => { live = false; };
+};
+FSDR.callPeriodically = function (handle, fg, blk, handler, pmt, ms) {
+  return FSDR.pollPeriodically(() => handle.call(fg, blk, handler, pmt), ms);
+};
+
+/* ---------------- Pmt helpers (externally-tagged JSON, serde style) -------- */
+FSDR.Pmt = {
+  null_: () => 'Null',
+  f64: v => ({F64: +v}), f32: v => ({F32: +v}),
+  u32: v => ({U32: v >>> 0}),
+  u64: v => ({U64: Math.round(Math.abs(+v))}),     // 53-bit safe (>>>0 truncates)
+  usize: v => ({Usize: Math.round(Math.abs(+v))}),
+  isize: v => ({Isize: Math.round(+v)}),
+  bool_: v => ({Bool: !!v}), string: v => ({String: '' + v}),
+  parse(kind, text) {
+    switch (kind) {
+      case 'Null': return 'Null';
+      case 'Bool': return {Bool: text === 'true' || text === '1'};
+      case 'String': return {String: text};
+      case 'F32': case 'F64': return {[kind]: parseFloat(text)};
+      case 'U32': case 'U64': case 'Usize': case 'Isize':
+        return {[kind]: parseInt(text, 10)};
+      default: return JSON.parse(text);     // raw JSON escape hatch (maps, vecs)
+    }
+  },
+};
+
+/* ---------------- FlowgraphCanvas: graph with edges ------------------------ */
+/* Blocks laid out by topological rank over the stream edges; stream edges solid,
+ * message edges dashed. Click a block to select it (fires opts.onSelect(block)). */
+FSDR.FlowgraphCanvas = function (canvas, opts) {
+  this.cv = canvas; this.ctx = canvas.getContext('2d');
+  this.opts = opts || {}; this.desc = null; this.boxes = [];
+  this.selected = null;
+  canvas.addEventListener('click', (ev) => {
+    const r = canvas.getBoundingClientRect();
+    const x = ev.clientX - r.left, y = ev.clientY - r.top;
+    for (const b of this.boxes) {
+      if (x >= b.x && x <= b.x + b.w && y >= b.y && y <= b.y + b.h) {
+        this.selected = b.blk.id;
+        if (this.opts.onSelect) this.opts.onSelect(b.blk);
+        this.draw();
+        return;
+      }
+    }
+  });
+};
+FSDR.FlowgraphCanvas.prototype.update = function (desc) {
+  this.desc = desc; this.layout(); this.draw();
+};
+FSDR.FlowgraphCanvas.prototype.layout = function () {
+  const blocks = this.desc.blocks, edges = this.desc.stream_edges || [];
+  const rank = {};                       // topological rank along stream edges
+  blocks.forEach(b => rank[b.id] = 0);
+  for (let pass = 0; pass < blocks.length; pass++) {
+    let moved = false;
+    for (const [s, , d] of edges.map(e => [e[0], e[1], e[2]])) {
+      if (rank[d] < rank[s] + 1) { rank[d] = rank[s] + 1; moved = true; }
+    }
+    if (!moved) break;
+  }
+  const cols = {};
+  blocks.forEach(b => { (cols[rank[b.id]] = cols[rank[b.id]] || []).push(b); });
+  const W = this.cv.width, H = this.cv.height;
+  const ncol = Math.max(...Object.keys(cols).map(Number)) + 1;
+  const cw = W / ncol;
+  this.boxes = [];
+  for (const [c, bs] of Object.entries(cols)) {
+    const rh = H / bs.length;
+    bs.forEach((b, i) => {
+      const w = Math.min(cw - 24, 150), h = Math.min(rh - 14, 44);
+      this.boxes.push({blk: b, x: c * cw + (cw - w) / 2,
+                       y: i * rh + (rh - h) / 2, w, h});
+    });
+  }
+};
+FSDR.FlowgraphCanvas.prototype.draw = function () {
+  const ctx = this.ctx, cv = this.cv;
+  ctx.fillStyle = '#101418'; ctx.fillRect(0, 0, cv.width, cv.height);
+  const at = {};
+  this.boxes.forEach(b => at[b.blk.id] = b);
+  const edge = (s, d, dashed) => {
+    const a = at[s], b = at[d];
+    if (!a || !b) return;
+    ctx.beginPath();
+    ctx.setLineDash(dashed ? [5, 4] : []);
+    ctx.strokeStyle = dashed ? '#ffb74d' : '#4fc3f7';
+    const x0 = a.x + a.w, y0 = a.y + a.h / 2, x1 = b.x, y1 = b.y + b.h / 2;
+    ctx.moveTo(x0, y0);
+    ctx.bezierCurveTo(x0 + 28, y0, x1 - 28, y1, x1, y1);
+    ctx.stroke();
+    ctx.setLineDash([]);
+    ctx.beginPath();                      // arrow head
+    ctx.moveTo(x1, y1); ctx.lineTo(x1 - 7, y1 - 4); ctx.lineTo(x1 - 7, y1 + 4);
+    ctx.fillStyle = ctx.strokeStyle; ctx.fill();
+  };
+  for (const e of this.desc.stream_edges || []) edge(e[0], e[2], false);
+  for (const e of this.desc.message_edges || []) edge(e[0], e[2], true);
+  for (const b of this.boxes) {
+    ctx.fillStyle = b.blk.id === this.selected ? '#263b4a' : '#1c252b';
+    ctx.strokeStyle = b.blk.id === this.selected ? '#4fc3f7' : '#37474f';
+    ctx.fillRect(b.x, b.y, b.w, b.h); ctx.strokeRect(b.x, b.y, b.w, b.h);
+    ctx.fillStyle = '#cfd8dc'; ctx.font = '11px system-ui';
+    ctx.fillText(b.blk.instance_name, b.x + 6, b.y + 17, b.w - 12);
+    ctx.fillStyle = '#78909c';
+    ctx.fillText('#' + b.blk.id + (b.blk.message_inputs.length ?
+      '  msg: ' + b.blk.message_inputs.join(',') : ''), b.x + 6, b.y + 32, b.w - 12);
+  }
+};
+
+/* ---------------- FlowgraphTable ------------------------------------------- */
+FSDR.FlowgraphTable = function (tbl) { this.tbl = tbl; };
+FSDR.FlowgraphTable.prototype.update = function (desc) {
+  const tbl = this.tbl;
+  while (tbl.rows.length > 1) tbl.deleteRow(1);
+  for (const b of desc.blocks) {
+    const r = tbl.insertRow();
+    for (const v of [b.id, b.instance_name, b.stream_inputs.join(','),
+                     b.stream_outputs.join(','), b.message_inputs.join(',')])
+      r.insertCell().textContent = v;
+  }
+};
+
+/* ---------------- PmtEditor: typed Pmt forms → POST call ------------------- */
+/* One row per message handler of the selected block: kind selector + value input +
+ * send; the reply renders next to the row (`prophecy/src/pmt.rs` PmtEditor role). */
+FSDR.PmtEditor = function (root, handle, fgId) {
+  this.root = root; this.handle = handle; this.fgId = fgId;
+};
+FSDR.PmtEditor.prototype.show = function (blk) {
+  const root = this.root;
+  root.innerHTML = '';
+  const title = document.createElement('h3');
+  title.textContent = blk.instance_name + ' — message handlers';
+  root.appendChild(title);
+  if (!blk.message_inputs.length) {
+    root.appendChild(document.createTextNode('(no message handlers)'));
+    return;
+  }
+  const kinds = ['F64', 'F32', 'U32', 'U64', 'Usize', 'Isize', 'Bool', 'String',
+                 'Null', 'JSON'];
+  for (const h of blk.message_inputs) {
+    const row = document.createElement('div');
+    row.className = 'pmt-row';
+    const name = document.createElement('code');
+    name.textContent = h;
+    const sel = document.createElement('select');
+    kinds.forEach(k => { const o = document.createElement('option');
+                         o.textContent = k; sel.appendChild(o); });
+    const val = document.createElement('input');
+    val.size = 14;
+    const btn = document.createElement('button');
+    btn.textContent = 'call';
+    const out = document.createElement('span');
+    out.className = 'pmt-reply';
+    btn.onclick = async () => {
+      try {
+        const pmt = FSDR.Pmt.parse(sel.value, val.value);
+        const reply = await this.handle.call(this.fgId, blk.id, h, pmt);
+        out.textContent = ' → ' + JSON.stringify(reply);
+      } catch (e) { out.textContent = ' → error: ' + e; }
+    };
+    [name, sel, val, btn, out].forEach(el => row.appendChild(el));
+    root.appendChild(row);
+  }
+};
+
+/* ---------------- parameter widgets: Slider / RadioSelector / ListSelector - */
+FSDR.Slider = function (root, handle, fgId, blkId, handler, opts) {
+  opts = opts || {};
+  const wrap = document.createElement('label');
+  wrap.className = 'fsdr-slider';
+  wrap.textContent = opts.label || handler;
+  const inp = document.createElement('input');
+  inp.type = 'range';
+  inp.min = opts.min ?? 0; inp.max = opts.max ?? 100; inp.step = opts.step ?? 1;
+  inp.value = opts.value ?? inp.min;
+  const val = document.createElement('span');
+  val.textContent = inp.value;
+  inp.oninput = () => { val.textContent = inp.value; };
+  inp.onchange = () => handle.call(fgId, blkId, handler, FSDR.Pmt.f64(inp.value));
+  wrap.appendChild(inp); wrap.appendChild(val);
+  root.appendChild(wrap);
+  return inp;
+};
+FSDR.RadioSelector = function (root, handle, fgId, blkId, handler, options) {
+  const wrap = document.createElement('span');
+  for (const o of options) {                  // [{label, pmt}]
+    const lab = document.createElement('label');
+    const rb = document.createElement('input');
+    rb.type = 'radio'; rb.name = 'rs-' + blkId + '-' + handler;
+    rb.onchange = () => handle.call(fgId, blkId, handler, o.pmt);
+    lab.appendChild(rb); lab.appendChild(document.createTextNode(o.label));
+    wrap.appendChild(lab);
+  }
+  root.appendChild(wrap);
+};
+FSDR.ListSelector = function (root, handle, fgId, blkId, handler, options) {
+  const sel = document.createElement('select');
+  for (const o of options) {
+    const opt = document.createElement('option');
+    opt.textContent = o.label; sel.appendChild(opt);
+  }
+  sel.onchange = () => handle.call(fgId, blkId, handler, options[sel.selectedIndex].pmt);
+  root.appendChild(sel);
+  return sel;
+};
+
+/* ---------------- stream sinks -------------------------------------------- */
+FSDR.Waterfall = function (canvas) {
+  this.cv = canvas; this.ctx = canvas.getContext('2d');
+};
+FSDR.Waterfall.prototype.frame = function (data) {
+  const cv = this.cv, ctx = this.ctx;
+  ctx.drawImage(cv, 0, -1);
+  const img = ctx.createImageData(cv.width, 1);
+  let lo = Infinity, hi = -Infinity;
+  for (const v of data) { if (v < lo) lo = v; if (v > hi) hi = v; }
+  const span = Math.max(hi - lo, 1e-9);
+  for (let x = 0; x < cv.width; x++) {
+    const i = Math.floor(x * data.length / cv.width);
+    const t = (data[i] - lo) / span;
+    img.data[4 * x] = 255 * Math.min(1, 2 * t);
+    img.data[4 * x + 1] = 255 * Math.max(0, 2 * t - 1);
+    img.data[4 * x + 2] = 96 * (1 - t);
+    img.data[4 * x + 3] = 255;
+  }
+  ctx.putImageData(img, 0, cv.height - 1);
+};
+FSDR.TimeSink = function (canvas, mode) {     // mode: 'line' | 'dots'
+  this.cv = canvas; this.ctx = canvas.getContext('2d'); this.mode = mode || 'line';
+};
+FSDR.TimeSink.prototype.frame = function (data) {
+  const cv = this.cv, ctx = this.ctx;
+  ctx.fillStyle = '#101418'; ctx.fillRect(0, 0, cv.width, cv.height);
+  let lo = Infinity, hi = -Infinity;
+  for (const v of data) { if (v < lo) lo = v; if (v > hi) hi = v; }
+  const span = Math.max(hi - lo, 1e-9);
+  ctx.strokeStyle = ctx.fillStyle = '#4fc3f7';
+  ctx.beginPath();
+  for (let x = 0; x < cv.width; x++) {
+    const i = Math.floor(x * data.length / cv.width);
+    const y = cv.height - 4 - (data[i] - lo) / span * (cv.height - 8);
+    if (this.mode === 'dots') ctx.fillRect(x, y, 2, 2);
+    else if (x === 0) ctx.moveTo(x, y); else ctx.lineTo(x, y);
+  }
+  if (this.mode !== 'dots') ctx.stroke();
+};
+FSDR.ConstellationSink = function (canvas) {
+  this.cv = canvas; this.ctx = canvas.getContext('2d');
+};
+FSDR.ConstellationSink.prototype.frame = function (iq) {
+  const cv = this.cv, ctx = this.ctx;
+  ctx.fillStyle = 'rgba(16,20,24,0.35)';
+  ctx.fillRect(0, 0, cv.width, cv.height);
+  ctx.fillStyle = '#80deea';
+  let peak = 1e-9;
+  for (let i = 0; i < iq.length; i++) peak = Math.max(peak, Math.abs(iq[i]));
+  const s = cv.width / (2.2 * peak);
+  for (let i = 0; i + 1 < iq.length; i += 2)
+    ctx.fillRect(cv.width / 2 + iq[i] * s, cv.height / 2 - iq[i + 1] * s, 2, 2);
+};
+/* Density mode: 2D histogram with exponential decay + inferno-ish colormap
+ * (`constellation_sink_density.rs` role). */
+FSDR.ConstellationSinkDensity = function (canvas, opts) {
+  opts = opts || {};
+  this.cv = canvas; this.ctx = canvas.getContext('2d');
+  this.n = opts.bins || 128;
+  this.decay = opts.decay ?? 0.9;
+  this.hist = new Float32Array(this.n * this.n);
+  // scratch surfaces allocated once (a per-frame canvas would churn the GC)
+  if (typeof OffscreenCanvas !== 'undefined') {
+    this.off = new OffscreenCanvas(this.n, this.n);
+  } else {
+    this.off = document.createElement('canvas');
+    this.off.width = this.n; this.off.height = this.n;
+  }
+  this.offCtx = this.off.getContext('2d');
+  this.img = this.offCtx.createImageData(this.n, this.n);
+};
+FSDR.ConstellationSinkDensity.prototype.frame = function (iq) {
+  const n = this.n, h = this.hist;
+  for (let i = 0; i < h.length; i++) h[i] *= this.decay;
+  let peak = 1e-9;
+  for (let i = 0; i < iq.length; i++) peak = Math.max(peak, Math.abs(iq[i]));
+  const s = n / (2.2 * peak);
+  for (let i = 0; i + 1 < iq.length; i += 2) {
+    const x = Math.round(n / 2 + iq[i] * s), y = Math.round(n / 2 - iq[i + 1] * s);
+    if (x >= 0 && x < n && y >= 0 && y < n) h[y * n + x] += 1;
+  }
+  let hi = 1e-9;
+  for (let i = 0; i < h.length; i++) if (h[i] > hi) hi = h[i];
+  const img = this.img;
+  for (let i = 0; i < h.length; i++) {
+    const t = Math.pow(h[i] / hi, 0.5);         // sqrt for perceptual density
+    img.data[4 * i] = 255 * Math.min(1, 1.6 * t);
+    img.data[4 * i + 1] = 255 * Math.max(0, 1.8 * t - 0.55);
+    img.data[4 * i + 2] = 80 + 175 * Math.max(0, 3 * t - 2);
+    img.data[4 * i + 3] = 255;
+  }
+  this.offCtx.putImageData(img, 0, 0);
+  this.ctx.imageSmoothingEnabled = false;
+  this.ctx.drawImage(this.off, 0, 0, this.cv.width, this.cv.height);
+};
+FSDR.ArrayView = function (root, n) { this.root = root; this.n = n || 8; };
+FSDR.ArrayView.prototype.frame = function (data) {
+  let lo = Infinity, hi = -Infinity, sum = 0;
+  for (const v of data) { if (v < lo) lo = v; if (v > hi) hi = v; sum += v; }
+  const head = Array.from(data.slice(0, this.n)).map(v => v.toFixed(3)).join(', ');
+  this.root.textContent =
+    `len=${data.length} min=${lo.toFixed(3)} max=${hi.toFixed(3)} ` +
+    `mean=${(sum / data.length).toFixed(3)}  [${head}, …]`;
+};
+
+/* eslint-disable-next-line no-unused-vars */
+if (typeof module !== 'undefined') module.exports = FSDR;   // node tests

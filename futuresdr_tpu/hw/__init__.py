@@ -55,8 +55,11 @@ class Driver(ABC):
         ...
 
     @abstractmethod
-    def read(self, n: int) -> np.ndarray:
-        """Blocking read of up to n complex64 samples (per activated channel)."""
+    def read(self, n: int):
+        """Blocking read of up to n complex64 samples (per activated channel).
+
+        Returns an ndarray (possibly empty = no data yet) or ``None`` for
+        end-of-stream (device gone) — the source block finishes on None."""
 
     def activate_tx(self, channels=(0,)):
         pass
@@ -175,6 +178,14 @@ class Device:
     def __init__(self, args: str = "driver=dummy"):
         parsed = parse_args(args)
         name = parsed.get("driver", "dummy")
+        if name not in _DRIVERS:
+            # optional drivers live in sibling modules that self-register on import
+            # (hw/rtl_tcp.py pattern) — try the generic lazy import first
+            import importlib
+            try:
+                importlib.import_module(f".{name}", __package__)
+            except ImportError:
+                pass
         try:
             cls = _DRIVERS[name]
         except KeyError:

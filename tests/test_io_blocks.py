@@ -178,7 +178,10 @@ def test_ctrl_port_rest_roundtrip():
         async def via_client():
             rfg = await Remote(base).flowgraph(0)
             blk = await rfg.block(0)
-            return await blk.call("freq", Pmt.f64(3000.0))
+            assert "freq" in blk.handlers()          # typed handler enumeration
+            conns = await rfg.connections()
+            assert any(c.kind == "stream" for c in conns)
+            return await blk.callback("freq", Pmt.f64(3000.0))
 
         res = rt.scheduler.run_coro_sync(via_client())
         assert res == Pmt.ok()

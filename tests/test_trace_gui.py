@@ -48,3 +48,52 @@ def test_gui_served_from_ctrl_port():
         assert r == {"custom": True}
     finally:
         cp.stop()
+
+
+def test_gui_widgets_and_interactive_retune():
+    """The GUI's widget library is served, and the slider/PmtEditor call path —
+    a typed-Pmt POST to the call route — retunes the running FM app (the
+    'interactive retune from the browser' criterion)."""
+    from futuresdr_tpu.apps.fm_receiver import build_flowgraph
+    from futuresdr_tpu.runtime.ctrl_port import ControlPort
+
+    fg, xlate, _ = build_flowgraph(input_rate=1_000_000.0, n_samples=2_000_000)
+    rt = Runtime()
+    running = rt.start(fg)
+    cp = ControlPort(rt.handle, bind="127.0.0.1:29431")
+    cp.start()
+    try:
+        base = "http://127.0.0.1:29431"
+        js = urllib.request.urlopen(base + "/static/widgets.js").read().decode()
+        for widget in ("FlowgraphCanvas", "PmtEditor", "ConstellationSinkDensity",
+                       "Slider", "RadioSelector", "ListSelector", "Waterfall",
+                       "TimeSink", "ArrayView"):
+            assert widget in js, f"widget {widget} missing from widgets.js"
+        html = urllib.request.urlopen(base + "/").read().decode()
+        assert "widgets.js" in html and "PmtEditor".lower() in html.lower()
+
+        # the flowgraph description feeds the canvas: blocks + edges present
+        desc = json.load(urllib.request.urlopen(base + "/api/fg/0/"))
+        assert desc["blocks"] and desc["stream_edges"]
+        xlate_id = next(b["id"] for b in desc["blocks"]
+                        if "XlatingFir" in b["instance_name"])
+        assert "freq" in next(b for b in desc["blocks"]
+                              if b["id"] == xlate_id)["message_inputs"]
+
+        # what the Slider widget sends: POST {"F64": offset} to .../call/freq/
+        before = xlate.rotator.phase_inc
+        req = urllib.request.Request(
+            f"{base}/api/fg/0/block/{xlate_id}/call/freq/",
+            data=json.dumps({"F64": 250_000.0}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        r = json.load(urllib.request.urlopen(req))
+        assert r == "Ok"
+        import time
+        for _ in range(100):
+            if xlate.rotator.phase_inc != before:
+                break
+            time.sleep(0.02)
+        assert xlate.rotator.phase_inc != before, "retune did not reach the block"
+    finally:
+        running.stop_sync()
+        cp.stop()
