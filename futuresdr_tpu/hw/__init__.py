@@ -37,7 +37,8 @@ class Driver(ABC):
         self.args = args
         self.sample_rate = float(args.get("rate", 1e6))
         self.frequency = float(args.get("freq", 100e6))
-        self.gain = float(args.get("gain", 0.0))
+        # None = "not set" (drivers fall back to AGC); 0.0 is a valid manual gain
+        self.gain = float(args["gain"]) if "gain" in args else None
 
     # -- tuning ---------------------------------------------------------------
     def set_sample_rate(self, rate: float, channel: int = 0):
@@ -184,8 +185,12 @@ class Device:
             import importlib
             try:
                 importlib.import_module(f".{name}", __package__)
-            except ImportError:
-                pass
+            except ModuleNotFoundError as e:
+                # only "no such driver module" falls through to the unknown-driver
+                # error; a driver module that exists but fails to import should
+                # surface its real failure
+                if e.name != f"{__package__}.{name}":
+                    raise
         try:
             cls = _DRIVERS[name]
         except KeyError:

@@ -100,7 +100,7 @@ class RtlTcpDriver(Driver):
             self._connect()
         self._cmd(CMD_SAMPLE_RATE, int(self.sample_rate))
         self._cmd(CMD_FREQUENCY, int(self.frequency))
-        if self.gain:
+        if self.gain is not None:                   # 0.0 dB is a valid manual gain
             self._cmd(CMD_GAIN_MODE, 1)
             self._cmd(CMD_GAIN, int(round(self.gain * 10)))
         else:
@@ -117,6 +117,10 @@ class RtlTcpDriver(Driver):
         while len(buf) < want:
             try:
                 chunk = self._sock.recv(want - len(buf))
+            except socket.timeout:
+                # a lull on a live connection is NOT end-of-stream: hand back what we
+                # have (possibly nothing) and let the caller poll again
+                break
             except OSError:
                 chunk = b""
             if not chunk:

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import mxu_fft
+
 __all__ = ["Stage", "Pipeline", "fir_stage", "fft_stage", "mag2_stage", "log10_stage",
            "rotator_stage", "quad_demod_stage", "apply_stage", "fftshift_stage",
            "decimate_stage", "moving_avg_stage"]
@@ -204,8 +206,15 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> S
         rows = ext.reshape(-1, L)
         blocks = jnp.concatenate([rows[:-1], rows[1:]], axis=1)   # [S, 2L]
         if jnp.iscomplexobj(x):
-            spec = jnp.fft.fft(blocks, axis=1) * Hc[None, :]
-            seg = jnp.fft.ifft(spec, axis=1)[:, L:]  # linear-conv region (L ≥ ntaps-1)
+            spec = mxu_fft.fft(blocks) * Hc[None, :]
+            seg = mxu_fft.ifft(spec)[:, L:]          # linear-conv region (L ≥ ntaps-1)
+        elif Hc.shape[0] == fft_len:
+            # real input with a full-spectrum carry (chosen at init_carry time when the
+            # MXU policy was active — the four-step has no half-spectrum variant; it
+            # still beats the XLA rfft). Branching on the carry shape keeps fn and
+            # carry coherent even if the policy flips between init and trace.
+            spec = mxu_fft.fft(blocks.astype(jnp.complex64)) * Hc[None, :]
+            seg = mxu_fft.ifft(spec)[:, L:].real
         else:
             spec = jnp.fft.rfft(blocks, axis=1) * Hc[None, :]
             seg = jnp.fft.irfft(spec, n=fft_len, axis=1)[:, L:]
@@ -216,7 +225,9 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> S
 
     def init_carry(dtype):
         dt = np.dtype(dtype)
-        Hsel = H if np.issubdtype(dt, np.complexfloating) else Hr
+        use_full = (np.issubdtype(dt, np.complexfloating)
+                    or mxu_fft._use_mxu(fft_len))
+        Hsel = H if use_full else Hr
         # complex H2D (incl. eager jnp.zeros, which is a host device_put!) must ride
         # the pair shim — broken complex transfers on axon, see ops/xfer.py
         from .xfer import to_device
@@ -282,9 +293,9 @@ def fft_stage(n: int, direction: str = "forward", shift: bool = False,
         if direction == "forward":
             if window is not None:
                 f = f * jnp.asarray(window)[None, :]
-            y = jnp.fft.fft(f, axis=1)
+            y = mxu_fft.fft(f)
         else:
-            y = jnp.fft.ifft(f, axis=1) * n
+            y = mxu_fft.ifft(f) * n
         if normalize:
             y = y / jnp.sqrt(n)
         if shift:

@@ -1,0 +1,76 @@
+"""Honest device-throughput measurement for streaming stages.
+
+Async-dispatch timing loops lie on this dev environment's tunneled TPU (and can mislead
+on any async backend): `block_until_ready` has been observed returning before queued
+work drains, and the ~100 ms dispatch/readback latency swamps sub-second kernels. See
+docs/tpu_notes.md "Measuring through the tunnel".
+
+:func:`run_marginal` implements the corrected methodology used by ``bench.py`` and
+``perf/fir.py``:
+
+- the frame loop rides INSIDE the jitted program via ``lax.scan`` — one dispatch runs
+  K frames with the stage carry chained;
+- a checksum accumulates in the scan carry and is fed back into each iteration's input,
+  creating a sequential data dependence so XLA cannot hoist the (otherwise
+  loop-invariant) body out of the loop;
+- the checksum readback happens inside the timed region and is validated finite;
+- the reported rate is the **marginal** rate between the two K values, cancelling the
+  constant dispatch latency.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.xfer import to_host
+
+__all__ = ["run_marginal"]
+
+
+def run_marginal(step: Callable, carry0, x, k_pair: Tuple[int, int] = (512, 1024),
+                 reps: int = 4) -> float:
+    """Measure sustained samples/s of ``step(carry, x) -> (carry, y)`` on x's device.
+
+    ``x`` may be any shape; the rate is ``x.size`` samples per step invocation.
+    Returns samples/second (marginal between the two scan lengths). Raises
+    AssertionError if timing noise makes the marginal ill-conditioned (k_hi run not
+    measurably longer than k_lo run) — callers should retry rather than report it.
+    """
+    k_lo, k_hi = k_pair
+    assert k_hi > k_lo
+
+    def make(k):
+        @jax.jit
+        def run_k(carry, xin):
+            def body(c, _):
+                stage_c, acc = c
+                xi = xin * (1 + 1e-20 * acc.astype(xin.dtype))
+                stage_c, y = step(stage_c, xi)
+                return (stage_c, acc + jnp.sum(y).real.astype(jnp.float32)), None
+            (carry, acc), _ = jax.lax.scan(body, (carry, jnp.float32(0)), None,
+                                           length=k)
+            return carry, acc
+        return run_k
+
+    times = {}
+    for k in (k_lo, k_hi):
+        run_k = make(k)
+        _, acc = run_k(carry0, x)
+        assert np.isfinite(float(to_host(acc)))       # compile + warm + validate
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _, acc = run_k(carry0, x)
+            checksum = float(to_host(acc))            # sync inside the timed region
+            best = min(best, time.perf_counter() - t0)
+        assert np.isfinite(checksum), checksum
+        times[k] = best
+    assert times[k_hi] > times[k_lo], (
+        f"marginal ill-conditioned: K={k_hi} ran in {times[k_hi]:.3f}s vs "
+        f"K={k_lo} in {times[k_lo]:.3f}s — timing noise exceeds the workload; "
+        f"increase k_pair or frame size")
+    return (k_hi - k_lo) * int(np.prod(np.shape(x))) / (times[k_hi] - times[k_lo])

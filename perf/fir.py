@@ -65,6 +65,40 @@ def run_once(pipes: int, stages: int, samples: int, max_copy: int,
     return dt
 
 
+def run_device_resident(pipes: int, stages: int, frame_size: int,
+                        k_pair=(256, 512)) -> float:
+    """North-star grid mapped TPU-first: pipes = vmapped batch axis, the per-pipe
+    FIR cascade = ONE fused XLA program (LTI merge collapses the 6 stages into a
+    single combined filter), carry chained frame-to-frame (overlap-save history).
+
+    This is the data-parallel row of SURVEY §2.7: independent pipes become a batch
+    dimension of one kernel, not N scheduler tasks. CopyRand has no device-resident
+    role (it stresses the host scheduler); the measurement is the compute chain, the
+    same methodology as bench.py's device-resident mode: the frame loop rides in a
+    ``lax.scan`` (one dispatch = K frames, checksum feedback defeats loop hoisting)
+    and the reported rate is the marginal rate between the two K values, cancelling
+    the constant dispatch latency (see docs/tpu_notes.md).
+    """
+    import jax
+    import jax.numpy as jnp
+    from futuresdr_tpu.ops import fir_stage
+    from futuresdr_tpu.ops.stages import Pipeline
+    from futuresdr_tpu.tpu.instance import instance
+    from futuresdr_tpu.utils.measure import run_marginal
+
+    taps = firdes.lowpass(0.2, 64).astype(np.float32)
+    inst = instance()
+    pipe = Pipeline([fir_stage(taps, name=f"fir{i}") for i in range(stages)],
+                    np.float32)
+    carry0 = jax.device_put(
+        jax.tree.map(lambda c: jnp.broadcast_to(c, (pipes,) + c.shape),
+                     pipe.init_carry()), inst.device)
+    rng = np.random.default_rng(7)
+    x = jax.device_put(rng.standard_normal((pipes, frame_size)).astype(np.float32),
+                       inst.device)
+    return run_marginal(jax.vmap(pipe.fn()), carry0, x, k_pair) / 1e6
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--runs", type=int, default=3)
@@ -74,7 +108,19 @@ def main():
     p.add_argument("--max-copy", type=int, default=4096)
     p.add_argument("--scheduler", choices=["async", "threaded"], default="async")
     p.add_argument("--tpu", action="store_true")
+    p.add_argument("--device-resident", action="store_true",
+                   help="HBM-resident fused cascade, pipes as a vmapped batch axis")
+    p.add_argument("--frame-size", type=int, default=1 << 19)
     a = p.parse_args()
+    if a.device_resident:
+        print("run,pipes,stages,frame_size,msps_total")
+        for r in range(a.runs):
+            for pipes in a.pipes:
+                for stages in a.stages:
+                    msps = run_device_resident(pipes, stages, a.frame_size)
+                    print(f"{r},{pipes},{stages},{a.frame_size},{msps:.1f}",
+                          flush=True)
+        return
     print("run,pipes,stages,samples,max_copy,scheduler,elapsed_secs,msps_total")
     for r in range(a.runs):
         for pipes in a.pipes:

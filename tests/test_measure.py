@@ -1,0 +1,17 @@
+"""run_marginal: the honest scan-marginal throughput harness (docs/tpu_notes.md)."""
+import numpy as np
+
+from futuresdr_tpu.ops import fir_stage
+from futuresdr_tpu.ops.stages import Pipeline
+from futuresdr_tpu.utils.measure import run_marginal
+
+
+def test_run_marginal_positive_rate():
+    rng = np.random.default_rng(0)
+    taps = rng.standard_normal(32).astype(np.float32)
+    pipe = Pipeline([fir_stage(taps)], np.float32)
+    x = rng.standard_normal(1 << 16).astype(np.float32)
+    import jax
+    rate = run_marginal(pipe.fn(), jax.device_put(pipe.init_carry()),
+                        jax.device_put(x), k_pair=(4, 64), reps=2)
+    assert rate > 0

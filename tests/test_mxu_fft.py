@@ -1,0 +1,90 @@
+"""MXU four-step FFT: correctness of the matmul decomposition vs numpy's FFT.
+
+CI runs on the CPU backend where the `auto` policy picks jnp.fft; these tests force the
+MXU (matmul) implementation so the four-step math itself is validated everywhere. On a
+real TPU the same code runs on the systolic array (measured in docs/tpu_notes.md).
+"""
+import numpy as np
+import pytest
+
+from futuresdr_tpu.ops import mxu_fft
+
+
+@pytest.fixture
+def force_mxu():
+    mxu_fft.set_impl("mxu")
+    yield
+    mxu_fft.set_impl("auto")
+
+
+@pytest.mark.parametrize("n", [256, 1024, 2048, 8192])
+def test_fft_matches_numpy(force_mxu, n):
+    rng = np.random.default_rng(3)
+    x = (rng.standard_normal((4, n)) + 1j * rng.standard_normal((4, n))).astype(np.complex64)
+    got = np.asarray(mxu_fft.fft(x))
+    ref = np.fft.fft(x, axis=-1)
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 1e-4
+
+
+@pytest.mark.parametrize("n", [256, 2048])
+def test_ifft_roundtrip(force_mxu, n):
+    rng = np.random.default_rng(4)
+    x = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(np.complex64)
+    y = np.asarray(mxu_fft.ifft(mxu_fft.fft(x)))
+    assert np.abs(y - x).max() < 1e-4
+
+
+def test_auto_policy_on_cpu_uses_xla():
+    # on the CPU test backend auto must not take the matmul path (bit-exactness with
+    # jnp.fft is part of the CPU contract)
+    assert not mxu_fft._use_mxu(2048)
+
+
+def test_non_pow2_rejected(force_mxu):
+    x = np.zeros(1500, np.complex64)
+    with pytest.raises(AssertionError):
+        mxu_fft.fft(x)
+
+
+def test_fir_stage_mxu_matches_xla():
+    """Overlap-save FIR must produce the same stream on the MXU-FFT path."""
+    from futuresdr_tpu.ops import fir_stage
+    rng = np.random.default_rng(5)
+    taps = rng.standard_normal(64).astype(np.float32)
+
+    def run(x):
+        st = fir_stage(taps)
+        carry = st.init_carry(x.dtype)
+        outs = []
+        frame = 1 << 14
+        for i in range(0, len(x), frame):
+            carry, y = st.fn(carry, x[i:i + frame])
+            outs.append(np.asarray(y))
+        return np.concatenate(outs)
+
+    for dtype in (np.float32, np.complex64):
+        x = rng.standard_normal(1 << 15).astype(np.float32)
+        if dtype == np.complex64:
+            x = (x + 1j * rng.standard_normal(len(x))).astype(np.complex64)
+        y_xla = run(x)
+        mxu_fft.set_impl("mxu")
+        try:
+            y_mxu = run(x)
+        finally:
+            mxu_fft.set_impl("auto")
+        assert np.abs(y_mxu - y_xla).max() < 2e-3, dtype
+
+
+def test_fft_stage_mxu_matches_xla():
+    from futuresdr_tpu.ops import fft_stage
+    rng = np.random.default_rng(6)
+    x = (rng.standard_normal(4096) + 1j * rng.standard_normal(4096)).astype(np.complex64)
+    st = fft_stage(2048)
+    _, y_xla = st.fn(st.init_carry(np.complex64), x)
+    mxu_fft.set_impl("mxu")
+    try:
+        st2 = fft_stage(2048)
+        _, y_mxu = st2.fn(st2.init_carry(np.complex64), x)
+    finally:
+        mxu_fft.set_impl("auto")
+    assert np.abs(np.asarray(y_mxu) - np.asarray(y_xla)).max() < 2e-2
