@@ -48,6 +48,7 @@ class RtlTcpDriver(Driver):
         self.host = args.get("host", "127.0.0.1")
         self.port = int(float(args.get("port", 1234)))
         self._sock: Optional[socket.socket] = None
+        self._leftover = b""        # odd trailing byte of a half-received I/Q pair
         self.tuner_type = 0
         self.tuner_gain_count = 0
 
@@ -111,7 +112,8 @@ class RtlTcpDriver(Driver):
             raise RuntimeError("rtl_tcp: read before activate_rx")
         # collect up to 2n bytes; on server close deliver the partial tail first
         # and signal EOS (None) on the NEXT read
-        buf = b""
+        buf = self._leftover
+        self._leftover = b""
         want = 2 * n
         eos = False
         while len(buf) < want:
@@ -130,6 +132,9 @@ class RtlTcpDriver(Driver):
         if eos and len(buf) < 2:
             return None                             # EOS: server gone → finish
         raw = buf[:(len(buf) // 2) * 2]
+        # a half pair at a timeout boundary belongs to the NEXT read — dropping it
+        # would shift the stream one byte and swap I/Q for the rest of the session
+        self._leftover = buf[len(raw):]
         u = np.frombuffer(raw, dtype=np.uint8).astype(np.float32)
         u = (u - 127.5) / 127.5
         return (u[0::2] + 1j * u[1::2]).astype(np.complex64)

@@ -35,7 +35,9 @@ import numpy as np
 _impl = os.environ.get("FUTURESDR_TPU_FFT_IMPL", "auto")
 _precision = os.environ.get("FUTURESDR_TPU_FFT_PRECISION", "f32")
 
-_MIN_MXU_N = 256          # below this the matmuls are too skinny to beat the XLA FFT
+_MIN_MXU_N = 256          # below this the four-step matmuls are too skinny...
+_MAX_DIRECT_N = 512       # ...but a DIRECT [n,n] DFT matmul wins for small n (any
+                          # factorization, huge batch): one dense MXU pass
 
 
 def set_impl(impl: str) -> None:
@@ -58,8 +60,9 @@ def _use_mxu(n: int) -> bool:
         return False
     if _impl == "mxu":
         return True
-    return (jax.default_backend() == "tpu" and n >= _MIN_MXU_N
-            and (n & (n - 1)) == 0)
+    if jax.default_backend() != "tpu":
+        return False
+    return (8 <= n <= _MAX_DIRECT_N) or (n >= _MIN_MXU_N and (n & (n - 1)) == 0)
 
 
 def _factor(n: int) -> tuple:
@@ -76,6 +79,11 @@ def _lax_precision(precision: Optional[str]):
 
 
 def _mxu_fft(x: jnp.ndarray, n: int, precision: Optional[str]) -> jnp.ndarray:
+    if n <= _MAX_DIRECT_N or (n & (n - 1)) != 0:
+        # direct DFT matmul: one dense [n, n] MXU pass, any n
+        k = jnp.arange(n)
+        F = jnp.exp(-2j * jnp.pi * jnp.outer(k, k) / n).astype(jnp.complex64)
+        return jnp.einsum("kn,...n->...k", F, x, precision=_lax_precision(precision))
     n1, n2 = _factor(n)
     prec = _lax_precision(precision)
     # DFT + twiddle factors computed in trace (device constants, not host transfers)

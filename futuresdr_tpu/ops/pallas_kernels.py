@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-__all__ = ["pallas_fir", "pallas_fir_stage"]
+__all__ = ["pallas_fir", "pallas_fir_continue", "pallas_fir_stage"]
 
 
 def _fir_kernel(prev_ref, cur_ref, taps_ref, o_ref, *, n_taps: int, block: int):
@@ -69,6 +69,27 @@ def pallas_fir(x: jnp.ndarray, taps, block: int = 4096,
     )(xp, xp, taps)
 
 
+def pallas_fir_continue(hist: jnp.ndarray, x: jnp.ndarray, taps: np.ndarray,
+                        block: int = 4096) -> jnp.ndarray:
+    """Streaming continuation: filter frame ``x`` given the previous ``n_taps-1``
+    input samples in ``hist``. Pads to the kernel's block granularity, runs complex
+    frames as two real passes, and returns exactly ``len(x)`` aligned outputs.
+    Shared by :func:`pallas_fir_stage` and ``stages.fir_stage(impl="pallas")``."""
+    taps = np.asarray(taps, dtype=np.float32)
+    nt = len(taps)
+    ext = jnp.concatenate([hist, x])               # [(nt-1) + n]
+    pad = (-ext.shape[0]) % block
+    if pad:
+        ext = jnp.concatenate([ext, jnp.zeros(pad, ext.dtype)])
+    if jnp.iscomplexobj(x):
+        yr = pallas_fir(ext.real, taps, block)
+        yi = pallas_fir(ext.imag, taps, block)
+        y = (yr + 1j * yi).astype(x.dtype)
+    else:
+        y = pallas_fir(ext, taps, block).astype(x.dtype)
+    return y[nt - 1:nt - 1 + x.shape[0]]
+
+
 def pallas_fir_stage(taps, block: int = 4096):
     """Streaming Stage (carry = tail samples) running the pallas kernel per frame; the
     drop-in alternative to :func:`futuresdr_tpu.ops.stages.fir_stage` for short taps."""
@@ -80,16 +101,8 @@ def pallas_fir_stage(taps, block: int = 4096):
     nt = len(taps)
 
     def fn(carry, x):
-        ext = jnp.concatenate([carry, x])          # [(nt-1) + n]
-        pad = (-ext.shape[0]) % block
-        ext_p = jnp.concatenate([ext, jnp.zeros(pad, ext.dtype)])
-        if jnp.iscomplexobj(x):
-            yr = pallas_fir(ext_p.real, taps, block)
-            yi = pallas_fir(ext_p.imag, taps, block)
-            y = (yr + 1j * yi).astype(x.dtype)
-        else:
-            y = pallas_fir(ext_p, taps, block).astype(x.dtype)
-        y = y[nt - 1:nt - 1 + x.shape[0]]
+        y = pallas_fir_continue(carry, x, taps, block)
+        ext = jnp.concatenate([carry, x])
         return ext[ext.shape[0] - (nt - 1):], y
 
     def init_carry(dtype):

@@ -140,8 +140,8 @@ def _merge_lti(stages: Sequence[Stage], in_dtype) -> list:
     out_dtypes: list = []               # stream dtype ENTERING each stage in `out`
     for s in stages:
         if s.lti is not None and out and out[-1].lti is not None:
-            t1, d1, fl1 = out[-1].lti
-            t2, d2, fl2 = s.lti
+            t1, d1, fl1, im1 = out[-1].lti
+            t2, d2, fl2, im2 = s.lti
             complex_stream = bool(np.issubdtype(out_dtypes[-1], np.complexfloating))
             if not complex_stream and not (np.isrealobj(t1) and np.isrealobj(t2)):
                 # a real stream takes .real at EACH stage boundary; merging complex-tap
@@ -157,8 +157,12 @@ def _merge_lti(stages: Sequence[Stage], in_dtype) -> list:
                 up = np.zeros((len(t2) - 1) * d1 + 1, dtype=np.result_type(t1, t2))
                 up[::d1] = t2
                 taps = np.convolve(t1, up)
+            # an explicit "os" on either side pins the merged numerics; "pallas"
+            # survives only if both sides forced it (and the merged taps allow it)
+            impl = "os" if "os" in (im1, im2) else \
+                ("pallas" if im1 == im2 == "pallas" else "auto")
             out[-1] = fir_stage(taps, decim=d1 * d2, fft_len=max(fl1, fl2),
-                                name=f"{out[-1].name}*{s.name}")
+                                name=f"{out[-1].name}*{s.name}", impl=impl)
             # stream dtype entering the merged stage is unchanged; FIR stages keep the
             # stream dtype so `dtype` needs no update here
         else:
@@ -173,7 +177,21 @@ def _merge_lti(stages: Sequence[Stage], in_dtype) -> list:
 # stage factories
 # ---------------------------------------------------------------------------
 
-def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> Stage:
+def _pallas_fir_wins(nt: int, is_complex: bool) -> bool:
+    """Trace-time choice of the direct pallas FIR over FFT overlap-save.
+
+    Measured on a v5e chip (docs/tpu_notes.md): the unrolled shifted-MAC pallas kernel
+    runs ~13.5 Gsps at 16 taps and ~5.0 Gsps at 64 taps vs ~2.7-4.6 Gsps for the
+    overlap-save form — a clear win for short real filters; complex frames pay two
+    real passes, halving the crossover.
+    """
+    if jax.default_backend() != "tpu":
+        return False
+    return nt <= (32 if is_complex else 64)
+
+
+def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
+              impl: str = "auto") -> Stage:
     """FFT overlap-save FIR (+ optional decimation) as a jitted stage.
 
     History carry = last ``ntaps-1`` inputs (the `min_items` overlap of `fir.rs:49`
@@ -183,9 +201,18 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> S
     convolution compiles poorly at SDR frame sizes on the TPU backend). The
     frequency-domain taps ride in the carry (identity pass-through under XLA
     input-output aliasing), which also makes them donation-safe and hot-swappable.
+
+    ``impl``: "auto" additionally routes short real-tap filters to the direct pallas
+    kernel on TPU (see :func:`_pallas_fir_wins`); "os" forces overlap-save; "pallas"
+    forces the direct kernel (CI exercises it in interpret mode).
     """
+    assert impl in ("auto", "os", "pallas"), impl
     taps = np.asarray(taps)
     nt = len(taps)
+    if impl == "pallas":
+        # an explicit force must not silently no-op: the kernel is real-taps-only
+        assert np.isrealobj(taps) and nt >= 2, \
+            "impl='pallas' requires >= 2 real taps (complex taps: use the OS path)"
     # 50% overlap-save with power-of-two hop L and fft_len = 2L: radix-friendly FFTs and
     # power-of-two frame multiples (at the cost of carrying L instead of ntaps-1 samples).
     L = fft_len // 2
@@ -201,6 +228,15 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> S
     def fn(carry, x):
         Hc, tail = carry
         ext = jnp.concatenate([tail, x])             # [(S+1)·L], S = n // L
+        is_c = jnp.iscomplexobj(x)
+        if impl != "os" and np.isrealobj(taps) and nt >= 2 and (
+                impl == "pallas" or _pallas_fir_wins(nt, is_c)):
+            from .pallas_kernels import pallas_fir_continue
+            y = pallas_fir_continue(ext[L - (nt - 1):L], x,
+                                    np.real(taps).astype(np.float32))
+            if decim > 1:
+                y = y[::decim]
+            return (Hc, ext[ext.shape[0] - L:]), y
         # block s = ext[sL : sL+2L] = rows[s] ++ rows[s+1]: built from two strided
         # slices + concat, NOT a gather — TPU gathers run ~9× slower than this form
         rows = ext.reshape(-1, L)
@@ -236,7 +272,7 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir") -> S
     # frame must be a multiple of the hop (and of decim at the output side)
     multiple = int(np.lcm(L, decim))
     return Stage(fn, init_carry, Fraction(1, decim), None, multiple, name,
-                 lti=(taps, decim, fft_len))
+                 lti=(taps, decim, fft_len, impl))
 
 
 def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,
@@ -398,11 +434,11 @@ def channelizer_stage(n_channels: int, taps=None, name: str = "channelizer") -> 
         # K static slices + stack instead of a gather (slow on TPU)
         windows = jnp.stack(
             [blocks[(K - 1) - k:(K - 1) - k + t] for k in range(K)], axis=1)  # [t, K, N]
-        v = jnp.einsum("tkc,ck->ct", windows, Hc,
-                       precision=jax.lax.Precision.HIGHEST)  # [N, t]
-        y = jnp.fft.ifft(v, axis=0) * N                    # [N, t]
+        v = jnp.einsum("tkc,ck->tc", windows, Hc,
+                       precision=jax.lax.Precision.HIGHEST)  # [t, N]
+        y = mxu_fft.ifft(v) * N                  # ifft across branches (small-n MXU)
         new_hist = ext[ext.shape[0] - (K - 1) * N:]
-        return (Hc, new_hist), y.T.reshape(-1).astype(jnp.complex64)
+        return (Hc, new_hist), y.reshape(-1).astype(jnp.complex64)
 
     def init_carry(dtype):
         from .xfer import to_device

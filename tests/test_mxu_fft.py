@@ -40,10 +40,14 @@ def test_auto_policy_on_cpu_uses_xla():
     assert not mxu_fft._use_mxu(2048)
 
 
-def test_non_pow2_rejected(force_mxu):
-    x = np.zeros(1500, np.complex64)
-    with pytest.raises(AssertionError):
-        mxu_fft.fft(x)
+@pytest.mark.parametrize("n", [48, 100, 320])
+def test_direct_dft_non_pow2(force_mxu, n):
+    # small / non-pow2 sizes run as a direct [n, n] DFT matmul
+    rng = np.random.default_rng(8)
+    x = (rng.standard_normal((3, n)) + 1j * rng.standard_normal((3, n))).astype(np.complex64)
+    got = np.asarray(mxu_fft.fft(x))
+    ref = np.fft.fft(x, axis=-1)
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 1e-4
 
 
 def test_fir_stage_mxu_matches_xla():
@@ -88,3 +92,26 @@ def test_fft_stage_mxu_matches_xla():
     finally:
         mxu_fft.set_impl("auto")
     assert np.abs(np.asarray(y_mxu) - np.asarray(y_xla)).max() < 2e-2
+
+
+def test_fir_stage_pallas_impl_matches_os():
+    """fir_stage(impl='pallas') streams identically to the overlap-save path."""
+    from futuresdr_tpu.ops import fir_stage
+    rng = np.random.default_rng(9)
+    taps = rng.standard_normal(32).astype(np.float32)
+    for dtype in (np.float32, np.complex64):
+        x = rng.standard_normal(1 << 15).astype(np.float32)
+        if dtype == np.complex64:
+            x = (x + 1j * rng.standard_normal(len(x))).astype(np.complex64)
+
+        def run(impl):
+            st = fir_stage(taps, impl=impl)
+            carry = st.init_carry(x.dtype)
+            outs = []
+            for i in range(0, len(x), 1 << 13):
+                carry, y = st.fn(carry, x[i:i + (1 << 13)])
+                outs.append(np.asarray(y))
+            return np.concatenate(outs)
+
+        y_os, y_pl = run("os"), run("pallas")
+        assert np.abs(y_os - y_pl).max() < 2e-3, dtype
