@@ -276,11 +276,14 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
 
 
 def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,
-                   name: str = "resample") -> Stage:
-    """Rational I/D resampler as a fused stage: zero-stuff ×I → overlap-save lowpass
-    (gain I, cutoff 0.5/max(I,D)) → keep every D-th. The TPU counterpart of
-    ``PolyphaseResamplingFir`` — at frame sizes the stuffed FFT filter is MXU/VPU work,
-    and XLA folds the zero-stuffing into the gather."""
+                   name: str = "resample", impl: str = "poly") -> Stage:
+    """Rational I/D resampler as a fused stage — the TPU counterpart of
+    ``PolyphaseResamplingFir`` (``futuredsp/polyphase_resampling_fir.rs:41``).
+
+    ``impl="poly"`` (default): true polyphase — phase-grouped stride-D windows built
+    from static slices, contracted against the phase-tap matrix in one MXU einsum.
+    ``impl="stuff"``: the earlier zero-stuff ×I → overlap-save lowpass → ↓D form
+    (kept for cross-validation and for complex taps)."""
     from math import gcd
 
     g = gcd(int(interp), int(decim))
@@ -289,23 +292,69 @@ def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,
         from ..dsp import firdes
         r = max(I, D)
         taps = firdes.kaiser_lowpass(0.5 / r * 0.8, 0.1 / r) * I
-    inner = fir_stage(taps, decim=1, fft_len=fft_len, name=f"{name}_fir")
-    L = inner.frame_multiple                       # hop of the overlap-save core
+    taps = np.asarray(taps)
+    assert impl in ("poly", "stuff"), impl
+    if np.iscomplexobj(taps):
+        impl = "stuff"                  # poly path computes a plain taps·x dot; the
+                                        # stuffed OS path owns complex-tap semantics
+
+    if impl == "stuff":
+        inner = fir_stage(taps, decim=1, fft_len=fft_len, name=f"{name}_fir")
+        L = inner.frame_multiple                   # hop of the overlap-save core
+
+        def fn(carry, x):
+            n = x.shape[0]
+            up = jnp.zeros(n * I, dtype=x.dtype).at[::I].set(x)
+            carry, y = inner.fn(carry, up)
+            if D > 1:
+                y = y[::D]
+            return carry, y
+
+        def init_carry(dtype):
+            return inner.init_carry(dtype)
+
+        # frame n must satisfy: n·I divisible by the OS hop L and by D
+        mult = int(np.lcm(L // np.gcd(I, L), D // np.gcd(I, D)))
+        return Stage(fn, init_carry, Fraction(I, D), None, mult, name)
+
+    # Polyphase form (default): output j = Σ_t taps[p_j + I·t] · x[s_j − t] with
+    # p_j = (j·D) mod I and s_j = ⌊j·D/I⌋. Outputs grouped by residue r = j mod I
+    # share one phase p_r = (r·D) mod I and land on stride-D input offsets
+    # s = q·D + c_r — so each group's windows are a STATIC slice of the row-concat
+    # matrix (the overlap-save trick generalized to stride D), and all I groups
+    # contract in ONE einsum on the MXU. Cost: T/D MACs per input sample vs the
+    # zero-stuffed form's I× inflated FFT frames (48× for the 48/125 audio
+    # resampler) — and no scatter, which the tunnel compiler handles poorly.
+    T = len(taps)
+    Kmax = -(-T // I)                   # taps per phase
+    ftaps = taps.astype(np.float32)
+    PT = np.zeros((I, Kmax), np.float32)
+    for r_ in range(I):
+        phase = ftaps[(r_ * D) % I::I]
+        PT[r_, :len(phase)] = phase
+    PTrev = PT[:, ::-1].copy()          # window index v ↔ tap index t = Kmax−1−v
+    c_off = [(r_ * D) // I for r_ in range(I)]
+    m = max(1, -(-(Kmax - 1) // D))     # history rows so windows never underflow
+    H = m * D
 
     def fn(carry, x):
-        n = x.shape[0]
-        up = jnp.zeros(n * I, dtype=x.dtype).at[::I].set(x)
-        carry, y = inner.fn(carry, up)
-        if D > 1:
-            y = y[::D]
-        return carry, y
+        hist = carry
+        ext = jnp.concatenate([hist, x])                 # [H + n]
+        rows = ext.reshape(-1, D)                        # [m + n/D, D]
+        nq = x.shape[0] // D
+        wide = jnp.concatenate([rows[i:i + nq] for i in range(m + 1)],
+                               axis=1)                   # [nq, (m+1)·D]; wide[q][u] = ext[q·D + u]
+        S = jnp.stack([wide[:, H + c_off[r_] - Kmax + 1:H + c_off[r_] + 1]
+                       for r_ in range(I)])              # [I, nq, Kmax]
+        y = jnp.einsum("rqv,rv->qr", S, jnp.asarray(PTrev),
+                       precision=jax.lax.Precision.HIGHEST)
+        return ext[ext.shape[0] - H:], y.reshape(-1).astype(x.dtype)
 
     def init_carry(dtype):
-        return inner.init_carry(dtype)
+        from .xfer import to_device
+        return to_device(np.zeros(H, dtype=np.dtype(dtype)))
 
-    # frame n must satisfy: n·I divisible by the OS hop L and by D
-    mult = int(np.lcm(L // np.gcd(I, L), D // np.gcd(I, D)))
-    return Stage(fn, init_carry, Fraction(I, D), None, mult, name)
+    return Stage(fn, init_carry, Fraction(I, D), None, D, name)
 
 
 def decimate_stage(decim: int) -> Stage:
