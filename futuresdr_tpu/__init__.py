@@ -14,13 +14,13 @@ from .types import Pmt, PmtKind
 from .config import config
 from .log import logger
 from .runtime import (Flowgraph, Runtime, Kernel, WorkIo, Mocker, Tag, ItemTag,
-                      message_handler, AsyncScheduler, ThreadedScheduler, FlowgraphError,
+                      message_handler, AsyncScheduler, ThreadedScheduler, TpbScheduler, FlowgraphError,
                       ConnectError)
 
 __all__ = [
     "Pmt", "PmtKind", "config", "logger",
     "Flowgraph", "Runtime", "Kernel", "WorkIo", "Mocker", "Tag", "ItemTag",
-    "message_handler", "AsyncScheduler", "ThreadedScheduler", "FlowgraphError",
+    "message_handler", "AsyncScheduler", "ThreadedScheduler", "TpbScheduler", "FlowgraphError",
     "ConnectError",
     "blocks", "dsp", "ops", "tpu", "parallel", "models", "utils", "hw", "ctrl", "apps",
 ]

@@ -14,7 +14,7 @@ from .block import WrappedKernel
 from .flowgraph import Flowgraph, Chain, ConnectError, default_buffer
 from .runtime import (Runtime, FlowgraphHandle, RunningFlowgraph, RuntimeHandle,
                       FlowgraphError)
-from .scheduler import Scheduler, AsyncScheduler, ThreadedScheduler
+from .scheduler import Scheduler, AsyncScheduler, ThreadedScheduler, TpbScheduler
 from .mocker import Mocker
 from .buffer import StreamInput, StreamOutput
 
@@ -30,6 +30,6 @@ __all__ = [
     "MessageOutputs", "BlockInbox", "WrappedKernel",
     "Flowgraph", "Chain", "ConnectError", "default_buffer",
     "Runtime", "FlowgraphHandle", "RunningFlowgraph", "RuntimeHandle", "FlowgraphError",
-    "Scheduler", "AsyncScheduler", "ThreadedScheduler",
+    "Scheduler", "AsyncScheduler", "ThreadedScheduler", "TpbScheduler",
     "Mocker", "StreamInput", "StreamOutput",
 ]

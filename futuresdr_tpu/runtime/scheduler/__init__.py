@@ -14,6 +14,6 @@ per-block actor tasks and arbitrary coroutines. Python analogs:
 
 from .base import Scheduler
 from .async_scheduler import AsyncScheduler
-from .threaded import ThreadedScheduler
+from .threaded import ThreadedScheduler, TpbScheduler
 
-__all__ = ["Scheduler", "AsyncScheduler", "ThreadedScheduler"]
+__all__ = ["Scheduler", "AsyncScheduler", "ThreadedScheduler", "TpbScheduler"]

@@ -15,7 +15,7 @@ from typing import Awaitable, Callable, Dict, List, Optional
 from ...log import logger
 from .base import Scheduler
 
-__all__ = ["ThreadedScheduler"]
+__all__ = ["ThreadedScheduler", "TpbScheduler"]
 
 log = logger("scheduler.threaded")
 
@@ -127,3 +127,44 @@ class ThreadedScheduler(Scheduler):
 
     def spawn_blocking(self, fn: Callable) -> Awaitable:
         return self.loop.run_in_executor(self._blocking_pool, fn)
+
+
+class TpbScheduler(ThreadedScheduler):
+    """Thread-per-block scheduler: every block's event loop gets its own OS thread.
+
+    Role of the reference perf crate's ``TpbScheduler`` (``perf/perf/src/
+    tpb_scheduler.rs:21-24`` — "mainly for comparison to GNU Radio. Do not use."):
+    GNU Radio runs one thread per block, and scheduler comparisons are only
+    apples-to-apples if that execution model is reproducible here. Same caveat as
+    the reference: use :class:`AsyncScheduler` or :class:`ThreadedScheduler` for
+    real workloads.
+    """
+
+    def __init__(self, pin_cores: bool = False):
+        super().__init__(workers=1, pin_cores=pin_cores)
+
+    def run_flowgraph_blocks(self, blocks, fg_inbox) -> List[Awaitable]:
+        self.start()                      # worker 0 = supervisor/spawn loop
+        handles: List[Awaitable] = []
+        for i, blk in enumerate(blocks):
+            # EVERY block — blocking or not — gets its own loop thread (that is the
+            # whole point of this scheduler; the pool-backed blocking branch of the
+            # parent would cap at its pool size). The worker is retired as soon as
+            # its block finishes, so repeated run() calls don't accumulate threads.
+            with self._lock:
+                w = _Worker(len(self._workers), self.pin_cores)
+                self._workers.append(w)
+            w.thread.start()
+            w.ready.wait()
+            cf = asyncio.run_coroutine_threadsafe(blk.run(fg_inbox), w.loop)
+
+            def _retire(_f, w=w):
+                with self._lock:
+                    if w in self._workers:
+                        self._workers.remove(w)
+                if w.loop is not None and w.loop.is_running():
+                    w.loop.call_soon_threadsafe(w.loop.stop)
+
+            cf.add_done_callback(_retire)
+            handles.append(asyncio.wrap_future(cf))
+        return handles

@@ -5,8 +5,9 @@ Re-design of the reference's ``perf/fir/fir.rs:14-95``: builds a grid of ``pipes
 parallel chains, each ``stages`` deep, pushes ``samples`` float32 samples per pipe, and
 emits a CSV row per run: ``run,pipes,stages,samples,max_copy,scheduler,elapsed_secs``.
 
-Schedulers: ``async`` (default single-loop) or ``threaded`` (pinned multi-worker,
-FlowScheduler analog). Add ``--tpu`` to run each pipe's FIR fused on the TPU instead of
+Schedulers: ``async`` (default single-loop), ``threaded`` (pinned multi-worker,
+FlowScheduler analog), or ``tpb`` (thread-per-block, GNU-Radio-style comparison).
+Add ``--tpu`` to run each pipe's FIR fused on the TPU instead of
 CPU blocks.
 """
 
@@ -19,7 +20,7 @@ sys.path.insert(0, "..")
 
 import numpy as np
 
-from futuresdr_tpu import Flowgraph, Runtime, AsyncScheduler, ThreadedScheduler
+from futuresdr_tpu import Flowgraph, Runtime, AsyncScheduler, ThreadedScheduler, TpbScheduler
 from futuresdr_tpu.blocks import NullSource, NullSink, Head, CopyRand, Fir
 from futuresdr_tpu.dsp import firdes
 
@@ -53,7 +54,8 @@ def run_once(pipes: int, stages: int, samples: int, max_copy: int,
         snk = NullSink(np.float32)
         fg.connect(last, snk)
         sinks.append(snk)
-    sched = ThreadedScheduler() if scheduler == "threaded" else AsyncScheduler()
+    sched = {"threaded": ThreadedScheduler, "tpb": TpbScheduler,
+             "async": AsyncScheduler}[scheduler]()
     rt = Runtime(sched)
     t0 = time.perf_counter()
     rt.run(fg)
@@ -106,7 +108,7 @@ def main():
     p.add_argument("--stages", type=int, nargs="+", default=[6])
     p.add_argument("--samples", type=int, default=15_000_000)
     p.add_argument("--max-copy", type=int, default=4096)
-    p.add_argument("--scheduler", choices=["async", "threaded"], default="async")
+    p.add_argument("--scheduler", choices=["async", "threaded", "tpb"], default="async")
     p.add_argument("--tpu", action="store_true")
     p.add_argument("--device-resident", action="store_true",
                    help="HBM-resident fused cascade, pipes as a vmapped batch axis")
