@@ -58,27 +58,36 @@ print(f"proc {pid} OK err={err:.2e}", flush=True)
 @pytest.mark.integration
 def test_two_process_global_mesh_sp_fir(tmp_path):
     # bounded by the communicate(timeout=220) below — no pytest-timeout dependency
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     wf = tmp_path / "worker.py"
     wf.write_text(WORKER)
     pypath = _REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
     env = dict(os.environ,
                XLA_FLAGS="--xla_force_host_platform_device_count=4",
                JAX_PLATFORMS="", PYTHONPATH=pypath.rstrip(os.pathsep))
-    procs = [subprocess.Popen([sys.executable, str(wf), str(i), str(port)],
-                              stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                              text=True, env=env)
-             for i in range(2)]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=220)
-            outs.append(out)
-    finally:
-        for p in procs:
-            p.kill()
+
+    def attempt():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [subprocess.Popen([sys.executable, str(wf), str(i), str(port)],
+                                  stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                                  text=True, env=env)
+                 for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=220)
+                outs.append(out)
+        finally:
+            for p in procs:
+                p.kill()
+        return procs, outs
+
+    procs, outs = attempt()
+    if any(p.returncode != 0 for p in procs):
+        # bind-then-close port probing races other processes on busy hosts; one
+        # retry with a fresh port removes the flake
+        procs, outs = attempt()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} rc={p.returncode}\n{out[-2000:]}"
         assert f"proc {i} OK" in out, out[-2000:]
