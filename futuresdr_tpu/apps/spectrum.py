@@ -70,7 +70,20 @@ def main(argv=None):
     p.add_argument("--samples", type=int, default=None)
     p.add_argument("--autotune", action="store_true",
                    help="sweep device frame sizes before starting")
+    p.add_argument("--bf16", action="store_true",
+                   help="display-grade bf16 FFT precision on the MXU (~6x the XLA "
+                        "FFT, -47 dB error — fine for a waterfall, not for decoding)")
     a = p.parse_args(argv)
+    if a.bf16:
+        import sys as _sys
+
+        import jax
+
+        from ..ops import mxu_fft
+        mxu_fft.set_precision("bf16")
+        if a.cpu or jax.default_backend() != "tpu":
+            print("note: --bf16 affects only the TPU MXU FFT path; "
+                  "this run uses the XLA FFT at full precision", file=_sys.stderr)
     if a.autotune and not a.cpu:
         from ..tpu import autotune, instance
         frame, depth, grid = autotune(
