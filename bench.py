@@ -33,23 +33,77 @@ import time
 sys.path.insert(0, ".")
 
 
-def _ensure_live_backend(timeout: int = 150) -> None:
-    """The axon TPU tunnel can wedge so that jax.devices() blocks forever; probe it in a
-    subprocess and fall back to the CPU backend rather than hanging the bench."""
-    if os.environ.get("FSDR_BENCH_PROBED"):
-        return
-    code = "import jax; jax.devices(); print('ok')"
+def _probe_tpu_once(timeout: int) -> tuple:
+    """One subprocess probe: does jax.devices() come back with a TPU within timeout?
+
+    The probe runs real device work (a tiny jit + readback), not just enumeration —
+    the tunnel has been observed half-alive where devices() succeeds but the first
+    dispatch wedges.
+
+    Returns ``(alive, timed_out)`` — a fast failure (timed_out=False) means the
+    backend came up without a TPU (no plugin / CPU-only box), which retrying can
+    never fix; a timeout means the tunnel is dialing and may recover.
+    """
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "d = jax.devices();"
+        "assert d and d[0].platform == 'tpu', d;"
+        "x = jax.device_put(jnp.arange(8.0), d[0]);"
+        "y = jax.jit(lambda v: (v * 2).sum())(x);"
+        "assert float(y) == 56.0, y;"
+        "print('ok')"
+    )
     try:
         r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
                            capture_output=True, text=True)
-        alive = r.returncode == 0 and "ok" in r.stdout
+        return (r.returncode == 0 and "ok" in r.stdout, False)
     except subprocess.TimeoutExpired:
-        alive = False
+        return (False, True)
+
+
+def _ensure_live_backend() -> None:
+    """The axon TPU tunnel can wedge so that jax.devices() blocks forever, and it
+    recovers on its own timescale — so fight for it: probe in a subprocess repeatedly
+    across a window (default 12 min, FSDR_BENCH_TPU_WAIT to override) before falling
+    back to the CPU backend. Two rounds of driver-captured benches fell back after a
+    single 150 s probe while the tunnel was alive in a later window (VERDICT r2)."""
+    if os.environ.get("FSDR_BENCH_PROBED"):
+        return
+    if os.environ.get("FSDR_FORCE_CPU"):
+        os.environ["FSDR_BENCH_PROBED"] = "1"
+        print("# FSDR_FORCE_CPU set; skipping TPU probe", file=sys.stderr)
+        return
+    budget = float(os.environ.get("FSDR_BENCH_TPU_WAIT", "720"))
+    deadline = time.monotonic() + budget
+    attempt, alive, fast_fails = 0, False, 0
+    while True:
+        attempt += 1
+        left = deadline - time.monotonic()
+        if left <= 0:
+            break
+        t0 = time.monotonic()
+        alive, timed_out = _probe_tpu_once(timeout=int(min(90, max(20, left))))
+        if alive:
+            print(f"# TPU tunnel alive (probe {attempt})", file=sys.stderr)
+            break
+        print(f"# TPU probe {attempt} failed ({time.monotonic()-t0:.0f}s, "
+              f"{'timeout' if timed_out else 'no-tpu'}); "
+              f"{max(0, deadline-time.monotonic()):.0f}s left in window",
+              file=sys.stderr)
+        if not timed_out:
+            # backend answered without a TPU — retrying can never succeed
+            fast_fails += 1
+            if fast_fails >= 2:
+                print("# no TPU on this backend; giving up the probe window early",
+                      file=sys.stderr)
+                break
+        if deadline - time.monotonic() > 30:
+            time.sleep(30)
     env = dict(os.environ, FSDR_BENCH_PROBED="1")
     if not alive:
         env["FSDR_FORCE_CPU"] = "1"
-        print(f"# TPU backend unreachable after {timeout}s; benching on CPU backend",
-              file=sys.stderr)
+        print(f"# TPU backend unreachable after {budget:.0f}s window; "
+              "benching on CPU backend", file=sys.stderr)
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
@@ -201,6 +255,8 @@ def main():
         "value": round(dev_rate, 1),
         "unit": "Msamples/s",
         "vs_baseline": round(dev_rate / cpu_rate, 2),
+        "backend": inst_.platform,
+        "device": str(inst_.device),
         "cpu_baseline_msps": round(cpu_rate, 1),
         "streamed_msps": round(stream_rate, 1),
         "streamed_vs_baseline": round(stream_rate / cpu_rate, 2),

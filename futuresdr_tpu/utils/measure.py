@@ -37,11 +37,14 @@ def run_marginal(step: Callable, carry0, x, k_pair: Tuple[int, int] = (512, 1024
 
     ``x`` may be any shape; the rate is ``x.size`` samples per step invocation.
     Returns samples/second (marginal between the two scan lengths). Raises
-    AssertionError if timing noise makes the marginal ill-conditioned (k_hi run not
+    RuntimeError if timing noise makes the marginal ill-conditioned (k_hi run not
     measurably longer than k_lo run) — callers should retry rather than report it.
+    (Real raises, not asserts: under ``python -O`` an assert-based rail would
+    silently report garbage — the exact failure mode this module exists to prevent.)
     """
     k_lo, k_hi = k_pair
-    assert k_hi > k_lo
+    if k_hi <= k_lo:
+        raise ValueError(f"k_pair must be increasing, got {k_pair}")
 
     def make(k):
         @jax.jit
@@ -60,17 +63,21 @@ def run_marginal(step: Callable, carry0, x, k_pair: Tuple[int, int] = (512, 1024
     for k in (k_lo, k_hi):
         run_k = make(k)
         _, acc = run_k(carry0, x)
-        assert np.isfinite(float(to_host(acc)))       # compile + warm + validate
+        warm = float(to_host(acc))                    # compile + warm + validate
+        if not np.isfinite(warm):
+            raise RuntimeError(f"non-finite warmup checksum {warm} at K={k}")
         best = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
             _, acc = run_k(carry0, x)
             checksum = float(to_host(acc))            # sync inside the timed region
             best = min(best, time.perf_counter() - t0)
-        assert np.isfinite(checksum), checksum
+        if not np.isfinite(checksum):
+            raise RuntimeError(f"non-finite checksum {checksum} at K={k}")
         times[k] = best
-    assert times[k_hi] > times[k_lo], (
-        f"marginal ill-conditioned: K={k_hi} ran in {times[k_hi]:.3f}s vs "
-        f"K={k_lo} in {times[k_lo]:.3f}s — timing noise exceeds the workload; "
-        f"increase k_pair or frame size")
+    if times[k_hi] <= times[k_lo]:
+        raise RuntimeError(
+            f"marginal ill-conditioned: K={k_hi} ran in {times[k_hi]:.3f}s vs "
+            f"K={k_lo} in {times[k_lo]:.3f}s — timing noise exceeds the workload; "
+            f"increase k_pair or frame size")
     return (k_hi - k_lo) * int(np.prod(np.shape(x))) / (times[k_hi] - times[k_lo])
