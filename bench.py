@@ -40,14 +40,16 @@ def _probe_tpu_once(timeout: int) -> tuple:
     the tunnel has been observed half-alive where devices() succeeds but the first
     dispatch wedges.
 
-    Returns ``(alive, timed_out)`` — a fast failure (timed_out=False) means the
-    backend came up without a TPU (no plugin / CPU-only box), which retrying can
-    never fix; a timeout means the tunnel is dialing and may recover.
+    Returns ``(alive, terminal)`` — *terminal* means the backend came up cleanly
+    WITHOUT a TPU (no plugin / CPU-only box), which retrying can never fix. Everything
+    else (timeout while dialing the tunnel, RPC/connection errors from a restarting
+    daemon) is retryable: only a clean no-TPU device list proves "no TPU here".
     """
     code = (
-        "import jax, jax.numpy as jnp;"
+        "import jax, jax.numpy as jnp, sys;"
         "d = jax.devices();"
-        "assert d and d[0].platform == 'tpu', d;"
+        "(print('no-tpu', d), sys.exit(0)) "
+        "  if not any(x.platform == 'tpu' for x in d) else None;"
         "x = jax.device_put(jnp.arange(8.0), d[0]);"
         "y = jax.jit(lambda v: (v * 2).sum())(x);"
         "assert float(y) == 56.0, y;"
@@ -56,9 +58,11 @@ def _probe_tpu_once(timeout: int) -> tuple:
     try:
         r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
                            capture_output=True, text=True)
-        return (r.returncode == 0 and "ok" in r.stdout, False)
+        alive = r.returncode == 0 and "ok" in r.stdout
+        terminal = r.returncode == 0 and "no-tpu" in r.stdout
+        return (alive, terminal)
     except subprocess.TimeoutExpired:
-        return (False, True)
+        return (False, False)
 
 
 def _ensure_live_backend() -> None:
@@ -82,16 +86,16 @@ def _ensure_live_backend() -> None:
         if left <= 0:
             break
         t0 = time.monotonic()
-        alive, timed_out = _probe_tpu_once(timeout=int(min(90, max(20, left))))
+        alive, terminal = _probe_tpu_once(timeout=int(min(90, max(20, left))))
         if alive:
             print(f"# TPU tunnel alive (probe {attempt})", file=sys.stderr)
             break
-        print(f"# TPU probe {attempt} failed ({time.monotonic()-t0:.0f}s, "
-              f"{'timeout' if timed_out else 'no-tpu'}); "
+        print(f"# TPU probe {attempt} failed ({time.monotonic()-t0:.0f}s"
+              f"{', clean no-tpu backend' if terminal else ''}); "
               f"{max(0, deadline-time.monotonic()):.0f}s left in window",
               file=sys.stderr)
-        if not timed_out:
-            # backend answered without a TPU — retrying can never succeed
+        if terminal:
+            # backend initialized cleanly without a TPU — retrying can never succeed
             fast_fails += 1
             if fast_fails >= 2:
                 print("# no TPU on this backend; giving up the probe window early",

@@ -157,10 +157,12 @@ def _merge_lti(stages: Sequence[Stage], in_dtype) -> list:
                 up = np.zeros((len(t2) - 1) * d1 + 1, dtype=np.result_type(t1, t2))
                 up[::d1] = t2
                 taps = np.convolve(t1, up)
-            # an explicit "os" on either side pins the merged numerics; "pallas"
-            # survives only if both sides forced it (and the merged taps allow it)
+            # an explicit "os" on either side pins the merged numerics; "pallas"/
+            # "poly" survive only if both sides forced them (and the merged taps
+            # allow it) — a force must not silently downgrade to "auto"
             impl = "os" if "os" in (im1, im2) else \
-                ("pallas" if im1 == im2 == "pallas" else "auto")
+                ("pallas" if im1 == im2 == "pallas" else
+                 ("poly" if im1 == im2 == "poly" else "auto"))
             out[-1] = fir_stage(taps, decim=d1 * d2, fft_len=max(fl1, fl2),
                                 name=f"{out[-1].name}*{s.name}", impl=impl)
             # stream dtype entering the merged stage is unchanged; FIR stages keep the
@@ -203,12 +205,28 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
     input-output aliasing), which also makes them donation-safe and hot-swappable.
 
     ``impl``: "auto" additionally routes short real-tap filters to the direct pallas
-    kernel on TPU (see :func:`_pallas_fir_wins`); "os" forces overlap-save; "pallas"
-    forces the direct kernel (CI exercises it in interpret mode).
+    kernel on TPU (see :func:`_pallas_fir_wins`), and decimating filters with modest
+    per-output work to the polyphase-decimation einsum (see below); "os" forces
+    overlap-save; "pallas" forces the direct kernel (CI exercises it in interpret
+    mode); "poly" forces the decimating einsum.
+
+    Polyphase decimation (``decim > 1``): computing the full-rate convolution and
+    slicing ``y[::D]`` wastes (D-1)/D of the FLOPs. The decimated output is
+    ``y[q] = Σ_t taps[t] · x[q·D − t]`` — windows of ``ntaps`` samples at stride D,
+    which (like :func:`resample_stage`'s poly path) are STATIC slices of a row-concat
+    matrix, contracted against the reversed taps in one MXU einsum: ntaps/D MACs per
+    input sample, and the stage's frame multiple drops from lcm(hop, D) to D.
+    Matches ``decimate == true`` FIR cores (``futuredsp/fir.rs:31``) re-designed for
+    the MXU rather than translated.
     """
-    assert impl in ("auto", "os", "pallas"), impl
+    assert impl in ("auto", "os", "pallas", "poly"), impl
     taps = np.asarray(taps)
     nt = len(taps)
+    # auto cap nt/D ≤ 32: the poly window matrix materializes ~nt/D × the frame in
+    # HBM, so the route stays where both the MACs/input and the intermediate are
+    # modest; longer filters keep the OS path's fixed fft_len working set
+    if impl == "poly" or (impl == "auto" and decim > 1 and nt <= 32 * decim):
+        return _poly_decim_fir_stage(taps, decim, fft_len, name, impl)
     if impl == "pallas":
         # an explicit force must not silently no-op: the kernel is real-taps-only
         assert np.isrealobj(taps) and nt >= 2, \
@@ -273,6 +291,52 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
     multiple = int(np.lcm(L, decim))
     return Stage(fn, init_carry, Fraction(1, decim), None, multiple, name,
                  lti=(taps, decim, fft_len, impl))
+
+
+def _stride_windows(ext: jnp.ndarray, D: int, m: int, nq: int) -> jnp.ndarray:
+    """``wide[q, u] = ext[q·D + u]`` for ``u ∈ [0, (m+1)·D)`` — the stride-D window
+    matrix built from m+1 static row slices + one concat (no gather, which runs ~9×
+    slower on TPU). Shared by the poly-decimation FIR and the polyphase resampler."""
+    rows = ext.reshape(-1, D)                            # [m + n/D, D]
+    return jnp.concatenate([rows[i:i + nq] for i in range(m + 1)], axis=1)
+
+
+def _poly_decim_fir_stage(taps: np.ndarray, decim: int, fft_len: int,
+                          name: str, impl: str) -> Stage:
+    """Decimating FIR as one stride-D window einsum (see :func:`fir_stage`).
+
+    ``y[q] = Σ_t taps[t] · x[q·D − t]`` — each output's window is a STATIC slice of
+    the row-concat matrix (no gather), all outputs contract in one MXU einsum. The
+    reversed taps ride the carry, so they are donation-safe and hot-swappable exactly
+    like the OS path's frequency-domain ``Hc``.
+    """
+    D = int(decim)
+    nt = len(taps)
+    m = max(1, -(-(nt - 1) // D))       # history rows so windows never underflow
+    H = m * D
+
+    def fn(carry, x):
+        trev, hist = carry
+        ext = jnp.concatenate([hist, x])                 # [H + n]
+        nq = x.shape[0] // D
+        wide = _stride_windows(ext, D, m, nq)            # [nq, (m+1)·D]
+        S = wide[:, H - nt + 1:H + 1]                    # [nq, nt] window ending at q·D
+        y = jnp.einsum("qv,v->q", S, trev,
+                       precision=jax.lax.Precision.HIGHEST)
+        return (trev, ext[ext.shape[0] - H:]), y.astype(x.dtype)
+
+    def init_carry(dtype):
+        dt = np.dtype(dtype)
+        # a real stream takes .real at the stage boundary (same semantics as the OS
+        # path's half-spectrum Hr) — bake that into the carried taps
+        teff = taps if np.issubdtype(dt, np.complexfloating) else np.real(taps)
+        trev = np.ascontiguousarray(teff[::-1]).astype(
+            np.complex64 if np.iscomplexobj(teff) else np.float32)
+        from .xfer import to_device
+        return (to_device(trev), to_device(np.zeros(H, dtype=dt)))
+
+    return Stage(fn, init_carry, Fraction(1, D), None, D, name,
+                 lti=(taps, D, fft_len, impl))
 
 
 def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,
@@ -340,10 +404,8 @@ def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,
     def fn(carry, x):
         hist = carry
         ext = jnp.concatenate([hist, x])                 # [H + n]
-        rows = ext.reshape(-1, D)                        # [m + n/D, D]
         nq = x.shape[0] // D
-        wide = jnp.concatenate([rows[i:i + nq] for i in range(m + 1)],
-                               axis=1)                   # [nq, (m+1)·D]; wide[q][u] = ext[q·D + u]
+        wide = _stride_windows(ext, D, m, nq)            # [nq, (m+1)·D]
         S = jnp.stack([wide[:, H + c_off[r_] - Kmax + 1:H + c_off[r_] + 1]
                        for r_ in range(I)])              # [I, nq, Kmax]
         y = jnp.einsum("rqv,rv->qr", S, jnp.asarray(PTrev),

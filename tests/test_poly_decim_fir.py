@@ -1,0 +1,86 @@
+"""Polyphase decimating fir_stage vs the full-rate overlap-save + slice form.
+
+fir_stage(decim=D) routes (auto, when ntaps/D is modest) to a stride-D window einsum
+costing ntaps/D MACs per input instead of filtering at full rate and slicing y[::D]
+(the reference's decimate=true FIR cores, futuredsp/fir.rs:31, re-designed for the
+MXU). The poly form must stream identically to the OS form, carry history across
+frame edges, and shrink the stage's frame multiple from lcm(hop, D) to D.
+"""
+import numpy as np
+import pytest
+
+from futuresdr_tpu.ops.stages import Pipeline, fir_stage
+
+
+def _run(st, x, frame, dtype):
+    carry = st.init_carry(dtype)
+    outs = []
+    for i in range(0, len(x), frame):
+        carry, y = st.fn(carry, x[i:i + frame])
+        outs.append(np.asarray(y))
+    return np.concatenate(outs)
+
+
+@pytest.mark.parametrize("d_nt", [(2, 31), (4, 63), (8, 64), (3, 17), (25, 200)])
+@pytest.mark.parametrize("dtype", [np.float32, np.complex64])
+def test_poly_decim_matches_os(d_nt, dtype):
+    D, nt = d_nt
+    rng = np.random.default_rng(D * 1000 + nt)
+    taps = (rng.standard_normal(nt) * np.hanning(nt)).astype(np.float32)
+    s_os = fir_stage(taps, decim=D, impl="os")
+    s_po = fir_stage(taps, decim=D, impl="poly")
+    assert s_po.frame_multiple == D
+    frame = int(np.lcm(s_os.frame_multiple, s_po.frame_multiple))
+    x = rng.standard_normal(4 * frame).astype(np.float32)
+    if dtype == np.complex64:
+        x = (x + 1j * rng.standard_normal(len(x))).astype(np.complex64)
+    y_os = _run(s_os, x, frame, dtype)
+    y_po = _run(s_po, x, frame, dtype)
+    assert y_po.shape == y_os.shape
+    scale = max(1e-9, np.abs(y_os).max())
+    assert np.abs(y_po - y_os).max() / scale < 1e-5
+
+
+def test_auto_routes_decim_to_poly():
+    taps = np.hanning(64).astype(np.float32)
+    assert fir_stage(taps, decim=8).frame_multiple == 8          # poly: multiple = D
+    assert fir_stage(taps, decim=1).frame_multiple > 8           # non-decim: OS hop
+    # huge tap count at small D: MACs/input too high, stays on the OS path
+    assert fir_stage(np.ones(8192, np.float32), decim=2).frame_multiple > 2
+
+
+def test_merge_preserves_forced_poly():
+    # two poly-forced stages whose merged taps exceed the auto cap must STAY poly
+    rng = np.random.default_rng(9)
+    t1 = rng.standard_normal(120).astype(np.float32)
+    t2 = rng.standard_normal(80).astype(np.float32)
+    pipe = Pipeline([fir_stage(t1, decim=2, impl="poly"),
+                     fir_stage(t2, decim=1, impl="poly")], np.complex64)
+    assert len(pipe.stages) == 1
+    merged_nt = len(pipe.stages[0].lti[0])
+    assert merged_nt > 32 * 2                    # beyond the auto threshold...
+    assert pipe.frame_multiple == 2              # ...yet still on the poly path
+
+
+def test_poly_decim_merges_in_pipeline():
+    rng = np.random.default_rng(5)
+    t1 = rng.standard_normal(33).astype(np.float32)
+    t2 = rng.standard_normal(21).astype(np.float32)
+    pipe = Pipeline([fir_stage(t1, decim=4), fir_stage(t2, decim=2)], np.complex64)
+    assert len(pipe.stages) == 1                                  # LTI merge fired
+    ref = Pipeline([fir_stage(t1, decim=4, impl="os"),
+                    fir_stage(t2, decim=2, impl="os")], np.complex64, optimize=False)
+    frame = int(np.lcm(pipe.frame_multiple, ref.frame_multiple))
+    x = (rng.standard_normal(2 * frame)
+         + 1j * rng.standard_normal(2 * frame)).astype(np.complex64)
+    cm, cr = pipe.init_carry(), ref.init_carry()
+    fm, fr = pipe.fn(), ref.fn()
+    outs_m, outs_r = [], []
+    for i in range(0, len(x), frame):
+        cm, ym = fm(cm, x[i:i + frame])
+        cr, yr = fr(cr, x[i:i + frame])
+        outs_m.append(np.asarray(ym))
+        outs_r.append(np.asarray(yr))
+    ym, yr = np.concatenate(outs_m), np.concatenate(outs_r)
+    scale = max(1e-9, np.abs(yr).max())
+    assert np.abs(ym - yr).max() / scale < 1e-4

@@ -38,7 +38,7 @@ def test_fir_stage_complex_with_decim():
     taps = firdes.lowpass(0.1, 32).astype(np.float32)
     x = (np.exp(1j * 2 * np.pi * 0.03 * np.arange(8192))).astype(np.complex64)
     pipe = Pipeline([fir_stage(taps, decim=4, fft_len=512)], np.complex64)
-    assert pipe.frame_multiple == 256
+    assert pipe.frame_multiple == 4     # poly-decim path: multiple = D, not lcm(hop, D)
     assert pipe.out_items(1024) == 256
     y = run_pipeline(pipe, x, 1024)
     ref = sps.lfilter(taps, 1.0, x)[::4]
