@@ -79,7 +79,7 @@ def _ensure_live_backend() -> None:
         return
     budget = float(os.environ.get("FSDR_BENCH_TPU_WAIT", "720"))
     deadline = time.monotonic() + budget
-    attempt, alive, fast_fails = 0, False, 0
+    attempt, alive, no_tpu_fails, fast_fails = 0, False, 0, 0
     while True:
         attempt += 1
         left = deadline - time.monotonic()
@@ -87,20 +87,32 @@ def _ensure_live_backend() -> None:
             break
         t0 = time.monotonic()
         alive, terminal = _probe_tpu_once(timeout=int(min(90, max(20, left))))
+        took = time.monotonic() - t0
         if alive:
             print(f"# TPU tunnel alive (probe {attempt})", file=sys.stderr)
             break
-        print(f"# TPU probe {attempt} failed ({time.monotonic()-t0:.0f}s"
+        print(f"# TPU probe {attempt} failed ({took:.0f}s"
               f"{', clean no-tpu backend' if terminal else ''}); "
               f"{max(0, deadline-time.monotonic()):.0f}s left in window",
               file=sys.stderr)
         if terminal:
             # backend initialized cleanly without a TPU — retrying can never succeed
-            fast_fails += 1
-            if fast_fails >= 2:
+            no_tpu_fails += 1
+            if no_tpu_fails >= 2:
                 print("# no TPU on this backend; giving up the probe window early",
                       file=sys.stderr)
                 break
+        elif took < 15:
+            # instant crash (ImportError, broken plugin raising) — probably
+            # deterministic; allow a few retries for a restarting daemon, then stop
+            # burning the window 30 s at a time
+            fast_fails += 1
+            if fast_fails >= 4:
+                print("# probe crashing instantly; giving up the window early",
+                      file=sys.stderr)
+                break
+        else:
+            fast_fails = 0
         if deadline - time.monotonic() > 30:
             time.sleep(30)
     env = dict(os.environ, FSDR_BENCH_PROBED="1")

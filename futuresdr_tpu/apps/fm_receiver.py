@@ -21,6 +21,23 @@ SAMPLE_RATE = 250_000       # after front-end decimation
 AUDIO_RATE = 48_000
 
 
+def front_end_stages(input_rate: float = 1_000_000.0, offset: float = 0.0):
+    """The fused-device FM front end (rotate → decimating FIR → FM discriminator →
+    polyphase audio resampler) as a stage list — shared by :func:`build_flowgraph`
+    and ``perf/fm.py`` so the benchmark measures exactly the pipeline the app ships."""
+    from math import gcd
+    from ..ops import fir_stage, quad_demod_stage, resample_stage, rotator_stage
+    decim = int(input_rate // SAMPLE_RATE)
+    g = gcd(AUDIO_RATE, SAMPLE_RATE)
+    return [
+        rotator_stage(-2 * np.pi * offset / input_rate),
+        fir_stage(firdes.lowpass(0.5 / decim * 0.8, 128).astype(np.float32),
+                  decim=decim, fft_len=4096),
+        quad_demod_stage(SAMPLE_RATE / (2 * np.pi * 75e3)),
+        resample_stage(AUDIO_RATE // g, SAMPLE_RATE // g),
+    ]
+
+
 def build_flowgraph(source=None, *, input_rate: float = 1_000_000.0,
                     offset: float = 0.0, audio_path: Optional[str] = None,
                     n_samples: Optional[int] = None, use_tpu: bool = False):
@@ -37,18 +54,10 @@ def build_flowgraph(source=None, *, input_rate: float = 1_000_000.0,
     from math import gcd
     g = gcd(AUDIO_RATE, SAMPLE_RATE)
     if use_tpu:
-        # whole front end (rotate → decimating FIR → FM discriminator → audio
-        # resampler) as ONE fused XLA program; retuning means rebuilding the kernel
-        from ..ops import fir_stage, quad_demod_stage, resample_stage, rotator_stage
+        # whole front end as ONE fused XLA program; retuning means rebuilding the
+        # kernel (runtime retune lives on the CPU path's XlatingFir message port)
         from ..tpu import TpuKernel
-        stages = [
-            rotator_stage(-2 * np.pi * offset / input_rate),
-            fir_stage(firdes.lowpass(0.5 / decim * 0.8, 128).astype(np.float32),
-                      decim=decim, fft_len=4096),
-            quad_demod_stage(SAMPLE_RATE / (2 * np.pi * 75e3)),
-            resample_stage(AUDIO_RATE // g, SAMPLE_RATE // g),
-        ]
-        chain = TpuKernel(stages, np.complex64)
+        chain = TpuKernel(front_end_stages(input_rate, offset), np.complex64)
         fg.connect(last, chain)
         retune = chain         # no runtime retune on the fused path
         out_block = chain

@@ -16,6 +16,13 @@ __all__ = ["ensure_backend"]
 
 def ensure_backend(probe_timeout: int = 120) -> str:
     """Returns the platform that will be used ("tpu-like" native platform or "cpu")."""
+    import os
+    if os.environ.get("FSDR_FORCE_CPU"):
+        # the init-guarded route (no-op once a backend is live; switching then
+        # would re-trigger plugin discovery and hang)
+        from ..tpu.instance import force_cpu_platform
+        force_cpu_platform()
+        return "cpu"
     code = "import jax; jax.devices(); print('ok')"
     try:
         r = subprocess.run([sys.executable, "-c", code], timeout=probe_timeout,

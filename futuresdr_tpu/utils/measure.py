@@ -28,7 +28,7 @@ import numpy as np
 
 from ..ops.xfer import to_host
 
-__all__ = ["run_marginal"]
+__all__ = ["run_marginal", "run_marginal_retry"]
 
 
 def run_marginal(step: Callable, carry0, x, k_pair: Tuple[int, int] = (512, 1024),
@@ -81,3 +81,19 @@ def run_marginal(step: Callable, carry0, x, k_pair: Tuple[int, int] = (512, 1024
             f"K={k_lo} in {times[k_lo]:.3f}s — timing noise exceeds the workload; "
             f"increase k_pair or frame size")
     return (k_hi - k_lo) * int(np.prod(np.shape(x))) / (times[k_hi] - times[k_lo])
+
+
+def run_marginal_retry(step: Callable, carry0, x,
+                       k_pair: Tuple[int, int] = (512, 1024),
+                       attempts: int = 3, grow: int = 2) -> float:
+    """:func:`run_marginal` with the retry its error contract asks callers for:
+    on an ill-conditioned marginal, double the scan lengths (more work per timing
+    window conditions the difference) and try again, up to ``attempts`` total."""
+    last = None
+    for _ in range(attempts):
+        try:
+            return run_marginal(step, carry0, x, k_pair)
+        except RuntimeError as e:
+            last = e
+            k_pair = (k_pair[0] * grow, k_pair[1] * grow)
+    raise last

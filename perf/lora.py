@@ -19,13 +19,48 @@ from futuresdr_tpu.models.lora import (LoraParams, modulate_frame, detect_frames
                                        demodulate_frame)
 
 
+def run_device_resident(sf: int, symbols_per_frame: int, k_pair) -> tuple:
+    """Dechirp + batched FFT + argmax (the ``FftDemod`` hot loop,
+    ``examples/lora/src/fft_demod.rs``) as a carry-chained device pipeline over
+    HBM-resident frames, scan-marginal methodology (BASELINE target #5)."""
+    import jax
+    from futuresdr_tpu.ops.stages import Pipeline, lora_demod_stage
+    from futuresdr_tpu.ops.xfer import to_device
+    from futuresdr_tpu.utils.measure import run_marginal_retry
+
+    pipe = Pipeline([lora_demod_stage(sf)], np.complex64)
+    frame = (1 << sf) * symbols_per_frame
+    rng = np.random.default_rng(11)
+    host = (rng.standard_normal(frame)
+            + 1j * rng.standard_normal(frame)).astype(np.complex64)
+    carry0 = jax.device_put(pipe.init_carry())
+    x = to_device(host)
+    rate = run_marginal_retry(pipe.fn(), carry0, x, k_pair) / 1e6
+    return rate, frame
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--runs", type=int, default=3)
     p.add_argument("--frames", type=int, default=100)
     p.add_argument("--sf", type=int, default=7)
     p.add_argument("--cr", type=int, default=2)
+    p.add_argument("--device-resident", action="store_true",
+                   help="scan-marginal dechirp+FFT+argmax hot loop on the device")
+    p.add_argument("--symbols-per-frame", type=int, default=2048)
     a = p.parse_args()
+
+    if a.device_resident:
+        from futuresdr_tpu.utils.backend import ensure_backend
+        backend = ensure_backend()
+        print(f"# backend: {backend}", file=sys.stderr)
+        k_pair = (512, 1024) if backend == "tpu" else (8, 16)
+        print("mode,backend,sf,frame,run,msamples_per_sec")
+        for r in range(a.runs):
+            rate, frame = run_device_resident(a.sf, a.symbols_per_frame, k_pair)
+            print(f"device_resident,{backend},{a.sf},{frame},{r},{rate:.1f}",
+                  flush=True)
+        return
 
     params = LoraParams(sf=a.sf, cr=a.cr)
     rng = np.random.default_rng(0)

@@ -19,6 +19,39 @@ import numpy as np
 from futuresdr_tpu.models.wlan import encode_frame, decode_stream, decode_stream_batch, Mac
 
 
+def run_device_resident(bucket: int, modulation: str, k_pair) -> tuple:
+    """The OFDM demod hot loop (CFO → batched FFT64 → equalize → CPE → max-log
+    demap, ``models/wlan/jax_demod.py``) carry-chained over HBM-resident symbol
+    frames, scan-marginal methodology (BASELINE target #4; reference hot loop:
+    ``examples/wlan/src/bin/loopback.rs:60-95`` / ``perf/wlan/rx.rs``)."""
+    import jax
+    from futuresdr_tpu.models.wlan.consts import PILOT_POLARITY, SYM_LEN
+    from futuresdr_tpu.models.wlan.jax_demod import _compiled
+    from futuresdr_tpu.ops.xfer import to_device
+    from futuresdr_tpu.utils.measure import run_marginal_retry
+
+    run, consts = _compiled(modulation, bucket)  # noqa: SLF001 — perf probes the hot loop directly
+    rng = np.random.default_rng(21)
+    frame = bucket * SYM_LEN
+    host = (rng.standard_normal(frame)
+            + 1j * rng.standard_normal(frame)).astype(np.complex64)
+    H = (rng.standard_normal(64) + 1j * rng.standard_normal(64)).astype(np.complex64)
+    H[np.abs(H) < 0.3] = 1.0                      # keep the equalizer well-conditioned
+    pol = PILOT_POLARITY[np.arange(bucket) % len(PILOT_POLARITY)].astype(np.float32)
+    mask = np.ones(bucket, np.float32)
+    dH, dpol, dmask = to_device(H), to_device(pol), to_device(mask)
+    dconsts = tuple(to_device(np.asarray(c)) for c in consts)
+    cfo, ph0 = np.float32(1e-4), np.float32(0.0)
+
+    def step(carry, body):
+        return carry, run(body, dH, dpol, dmask, cfo, ph0, *dconsts)
+
+    carry0 = jax.device_put(np.zeros((), np.float32))
+    x = to_device(host)
+    rate = run_marginal_retry(step, carry0, x, k_pair) / 1e6
+    return rate, frame
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--runs", type=int, default=3)
@@ -28,7 +61,25 @@ def main():
     p.add_argument("--snr-db", type=float, default=25.0)
     p.add_argument("--batch", action="store_true",
                    help="batched Viterbi (one lax.scan for all frames)")
+    p.add_argument("--device-resident", action="store_true",
+                   help="scan-marginal OFDM demod hot loop on the device")
+    p.add_argument("--bucket", type=int, default=1024,
+                   help="symbols per device frame (device-resident mode)")
     a = p.parse_args()
+
+    if a.device_resident:
+        from futuresdr_tpu.utils.backend import ensure_backend
+        backend = ensure_backend()
+        print(f"# backend: {backend}", file=sys.stderr)
+        from futuresdr_tpu.models.wlan.consts import MCS_TABLE
+        modulation = MCS_TABLE[a.mcs].modulation
+        k_pair = (512, 1024) if backend == "tpu" else (8, 16)
+        print("mode,backend,modulation,frame,run,msamples_per_sec")
+        for r in range(a.runs):
+            rate, frame = run_device_resident(a.bucket, modulation, k_pair)
+            print(f"device_resident,{backend},{modulation},{frame},{r},{rate:.1f}",
+                  flush=True)
+        return
     if a.batch:
         from futuresdr_tpu.utils.backend import ensure_backend
         print(f"# backend: {ensure_backend()}", file=sys.stderr)
