@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Deque, Optional, Tuple
+from typing import Deque, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -69,8 +69,10 @@ class InplaceOutput:
     def connect(self, peer: "InplaceInput"):
         self._peer = peer
 
-    def put_full(self, buf: np.ndarray, n_items: int) -> None:
-        self._peer.push(buf, n_items)
+    def put_full(self, buf: np.ndarray, n_items: int, tags: Sequence = ()) -> None:
+        """Push a full frame (+ frame-relative stream tags riding alongside it —
+        the TPU plane's item-indexed metadata transport, SURVEY §7)."""
+        self._peer.push(buf, n_items, tags)
 
     def queue_depth(self) -> int:
         """Frames waiting at the consumer (backpressure signal)."""
@@ -94,7 +96,7 @@ class InplaceInput:
         self.name = name
         self.dtype = np.dtype(dtype) if dtype is not None else None
         self.min_items = 1
-        self._q: Deque[Tuple[np.ndarray, int]] = deque()
+        self._q: Deque[Tuple[np.ndarray, int, tuple]] = deque()
         self._lock = threading.Lock()
         self._inbox: Optional[BlockInbox] = None
         self._port_index = 0
@@ -127,13 +129,13 @@ class InplaceInput:
         """Wake the producing block when frames are taken (backpressure release)."""
         self._producer_inbox = inbox
 
-    def push(self, buf: np.ndarray, n_items: int) -> None:
+    def push(self, buf: np.ndarray, n_items: int, tags: Sequence = ()) -> None:
         with self._lock:
-            self._q.append((buf, n_items))
+            self._q.append((buf, n_items, tuple(tags)))
         if self._inbox is not None:
             self._inbox.notify()
 
-    def get_full(self) -> Optional[Tuple[np.ndarray, int]]:
+    def get_full(self) -> Optional[Tuple[np.ndarray, int, tuple]]:
         with self._lock:
             item = self._q.popleft() if self._q else None
         if item is not None and getattr(self, "_producer_inbox", None) is not None:

@@ -14,19 +14,58 @@ stay in HBM between stages (no host round-trip, unlike the reference's per-block
 :class:`~futuresdr_tpu.tpu.TpuKernel`; this frame plane is for pipelines whose stages
 must remain separate blocks (e.g. different frame rates, taps swapped at runtime, or a
 fan-out of device consumers).
+
+**Tags ride the plane** (SURVEY §7 "item-indexed metadata must ride alongside
+tensors"): ``TpuH2D`` snapshots the stream tags of each frame window (frame-relative
+indices), they travel with the device frame through the inplace queues, each
+``TpuStage`` rebases indices by its pipeline's rate contract (the remap of
+``blocks/dsp.py`` — reference ``buffer/circular.rs:37-64``), and ``TpuD2H`` re-emits
+them into the output stream at the rebased positions — so a retune tag crosses a
+device FIR+decimation segment and lands on the correct output item.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
 from ..ops.stages import Pipeline, Stage
 from ..runtime.kernel import Kernel
+from ..runtime.tag import ItemTag, rebase_tags
 from .instance import TpuInstance, instance
 
-__all__ = ["TpuH2D", "TpuStage", "TpuD2H"]
+__all__ = ["TpuH2D", "TpuStage", "TpuD2H", "rebase_frame_tags", "emit_with_tags"]
+
+
+def rebase_frame_tags(tags: Sequence[ItemTag], pipeline: Pipeline,
+                      out_valid: int) -> List[ItemTag]:
+    """Remap frame-relative tag indices through a pipeline's rate change
+    (out = in · ratio), clamped into the valid output window — the same index
+    math as the CPU path's rate-changing blocks (``blocks/dsp.py``)."""
+    if out_valid <= 0:
+        return []
+    r = pipeline.ratio
+    return [ItemTag(min(t.index * r.numerator // r.denominator, out_valid - 1), t.tag)
+            for t in tags]
+
+
+def emit_with_tags(output, data: np.ndarray,
+                   tags: Sequence[ItemTag]) -> tuple:
+    """Write as much of ``data`` as the stream output accepts, emitting ``tags`` at
+    their produced positions. Returns ``(pending_data, pending_tags)``: the unwritten
+    tail and its rebased tags (``(None, [])`` when everything fit) — shared by the
+    device sinks' partial-drain paths (TpuD2H, TpuKernel)."""
+    out = output.slice()
+    k = min(len(out), len(data))
+    out[:k] = data[:k]
+    for t in tags:
+        if t.index < k:
+            output.add_tag(t.index, t.tag)
+    output.produce(k)
+    if k < len(data):
+        return data[k:].copy(), rebase_tags(tags, k)
+    return None, []
 
 
 class TpuH2D(Kernel):
@@ -48,16 +87,18 @@ class TpuH2D(Kernel):
         sent = 0
         while (len(inp) >= self.frame_size
                and self.output.queue_depth() < self.max_inflight):
+            tags = self.input.tags(self.frame_size)   # frame-relative indices
             frame = self.inst.put(inp[:self.frame_size].copy())
-            self.output.put_full(frame, self.frame_size)
+            self.output.put_full(frame, self.frame_size, tags)
             self.input.consume(self.frame_size)
             inp = self.input.slice()
             sent += 1
         eos = self.input.finished()
         if eos and 0 < len(inp) < self.frame_size:
+            tags = self.input.tags(len(inp))
             host = np.zeros(self.frame_size, dtype=self.input.dtype)
             host[:len(inp)] = inp
-            self.output.put_full(self.inst.put(host), len(inp))
+            self.output.put_full(self.inst.put(host), len(inp), tags)
             self.input.consume(len(inp))
             inp = self.input.slice()
         if eos and len(inp) == 0:
@@ -88,7 +129,7 @@ class TpuStage(Kernel):
             item = self.input.get_full()
             if item is None:
                 break
-            frame, valid = item
+            frame, valid, tags = item
             if self._compiled is None:
                 n = frame.shape[0]
                 assert n % self.pipeline.frame_multiple == 0, \
@@ -98,7 +139,8 @@ class TpuStage(Kernel):
             self._carry, y = self._compiled(self._carry, frame)   # async dispatch
             out_valid = self.pipeline.out_items(
                 valid - valid % self.pipeline.frame_multiple)
-            self.output.put_full(y, out_valid)
+            self.output.put_full(y, out_valid,
+                                 rebase_frame_tags(tags, self.pipeline, out_valid))
         if self.input.finished() and len(self.input) == 0:
             io.finished = True
 
@@ -115,26 +157,20 @@ class TpuD2H(Kernel):
         self.input = self.add_inplace_input("in")
         self.output = self.add_stream_output("out", dtype)
         self._pending: Optional[np.ndarray] = None
+        self._pending_tags: List[ItemTag] = []
 
     async def work(self, io, mio, meta):
-        out = self.output.slice()
         if self._pending is not None:
-            k = min(len(out), len(self._pending))
-            out[:k] = self._pending[:k]
-            self.output.produce(k)
-            self._pending = self._pending[k:] if k < len(self._pending) else None
+            self._pending, self._pending_tags = emit_with_tags(
+                self.output, self._pending, self._pending_tags)
             if self._pending is not None:
                 return              # downstream full; its consume() wakes us
-            out = self.output.slice()
         item = self.input.get_full()
         if item is not None:
-            frame, valid = item
+            frame, valid, tags = item
             host = self.inst.get(frame)[:valid]   # sync point
-            k = min(len(out), len(host))
-            out[:k] = host[:k]
-            self.output.produce(k)
-            if k < len(host):
-                self._pending = host[k:].copy()
+            self._pending, self._pending_tags = emit_with_tags(
+                self.output, host, tags)
             io.call_again = True
             return
         if self.input.finished() and len(self.input) == 0 and self._pending is None:

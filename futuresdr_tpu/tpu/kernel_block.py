@@ -13,20 +13,25 @@ The block is ``BLOCKING`` (dedicated thread), so the host sync in result retriev
 stalls the scheduler loop — the reference marks its hardware blocks ``#[blocking]`` the same
 way (`seify/source.rs`).
 
-Stream tags are not propagated through the device path (the reference's GPU staging
-buffers drop them likewise); attach metadata out-of-band via message ports when needed.
+Stream tags ride the device segment (SURVEY §7): each dispatched frame snapshots the
+tags of its input window, their indices are rebased by the pipeline's rate contract
+(the ``blocks/dsp.py`` remap; reference ``buffer/circular.rs:37-64``), and they are
+re-emitted on the output stream when the frame's results drain — going beyond the
+reference, whose GPU staging buffers drop tags.
 """
 
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Optional, Sequence, Tuple
+from typing import Deque, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..log import logger
 from ..ops.stages import Pipeline, Stage
 from ..runtime.kernel import Kernel
+from ..runtime.tag import ItemTag
+from .frames import emit_with_tags, rebase_frame_tags
 from .instance import TpuInstance, instance
 
 __all__ = ["TpuKernel"]
@@ -51,8 +56,10 @@ class TpuKernel(Kernel):
         self.depth = frames_in_flight or self.inst.frames_in_flight
         self._compiled = None
         self._carry = None
-        self._inflight: Deque[Tuple[object, int]] = deque()  # (device result, valid_out)
+        # (device result, valid_out, rebased tags)
+        self._inflight: Deque[Tuple[object, int, tuple]] = deque()
         self._pending_out: Optional[np.ndarray] = None
+        self._pending_tags: List[ItemTag] = []
         self._frames_dispatched = 0
         self.input = self.add_stream_input("in", in_dtype, min_items=self.frame_size)
         self.output = self.add_stream_output(
@@ -79,28 +86,29 @@ class TpuKernel(Kernel):
         _, self._carry = self.pipeline.compile(self.frame_size, device=self.inst.device)
 
     # -- helpers ---------------------------------------------------------------
-    def _dispatch(self, frame: np.ndarray, valid_in: int) -> None:
+    def _dispatch(self, frame: np.ndarray, valid_in: int,
+                  tags: Sequence[ItemTag] = ()) -> None:
         """Enqueue one frame; ``valid_in`` (a frame_multiple multiple) bounds how much of
-        the output is real data vs zero-pad tail."""
+        the output is real data vs zero-pad tail. ``tags`` are frame-relative and are
+        rebased by the rate contract here, at dispatch time."""
         x = self.inst.put(frame)
         self._carry, y = self._compiled(self._carry, x)
-        valid_out = self.pipeline.out_items(valid_in)
-        self._inflight.append((y, min(valid_out, self.out_frame)))
+        valid_out = min(self.pipeline.out_items(valid_in), self.out_frame)
+        self._inflight.append((y, valid_out,
+                               tuple(rebase_frame_tags(tags, self.pipeline,
+                                                       valid_out))))
         self._frames_dispatched += 1
 
-    def _drain_one(self) -> np.ndarray:
-        y, valid = self._inflight.popleft()
+    def _drain_one(self) -> Tuple[np.ndarray, tuple]:
+        y, valid, tags = self._inflight.popleft()
         arr = self.inst.get(y)    # sync point: blocks only this block's thread
-        return arr[:valid]
+        return arr[:valid], tags
 
     async def work(self, io, mio, meta):
         # 1. flush pending host-side output first
         if self._pending_out is not None:
-            out = self.output.slice()
-            k = min(len(out), len(self._pending_out))
-            out[:k] = self._pending_out[:k]
-            self.output.produce(k)
-            self._pending_out = self._pending_out[k:] if k < len(self._pending_out) else None
+            self._pending_out, self._pending_tags = emit_with_tags(
+                self.output, self._pending_out, self._pending_tags)
             if self._pending_out is not None:
                 return  # downstream full; its consume() will wake us
 
@@ -110,7 +118,8 @@ class TpuKernel(Kernel):
         #    is async, so handing it a live ring-buffer view would race with the writer
         #    overwriting consumed space — the frame must leave the ring before consume().
         while len(self._inflight) < self.depth and len(inp) >= self.frame_size:
-            self._dispatch(inp[:self.frame_size].copy(), self.frame_size)
+            tags = self.input.tags(self.frame_size)
+            self._dispatch(inp[:self.frame_size].copy(), self.frame_size, tags)
             self.input.consume(self.frame_size)
             inp = self.input.slice()
 
@@ -121,9 +130,10 @@ class TpuKernel(Kernel):
             frame = np.zeros(self.frame_size, dtype=self.pipeline.in_dtype)
             frame[:len(inp)] = inp
             n = len(inp)
+            tags = self.input.tags(n)
             # items beyond the last frame_multiple boundary cannot produce integral
             # output and are dropped at EOS (streaming frame contract)
-            self._dispatch(frame, n - (n % self.pipeline.frame_multiple))
+            self._dispatch(frame, n - (n % self.pipeline.frame_multiple), tags)
             self.input.consume(n)
             inp = self.input.slice()
 
@@ -133,13 +143,9 @@ class TpuKernel(Kernel):
         should_drain = bool(self._inflight) and (
             len(self._inflight) >= self.depth or len(inp) < self.frame_size or eos)
         if should_drain:
-            result = self._drain_one()
-            out = self.output.slice()
-            k = min(len(out), len(result))
-            out[:k] = result[:k]
-            self.output.produce(k)
-            if k < len(result):
-                self._pending_out = result[k:].copy()
+            result, tags = self._drain_one()
+            self._pending_out, self._pending_tags = emit_with_tags(
+                self.output, result, tags)
             io.call_again = True
             return
 

@@ -42,7 +42,7 @@ class InplaceAdd(Kernel):
             item = self.input.get_full()
             if item is None:
                 break
-            buf, n = item
+            buf, n, _tags = item
             buf[:n] += self.offset
             self.output.put_full(buf, n)
         if self.input.finished() and len(self.input) == 0:
@@ -63,7 +63,7 @@ class InplaceSink(Kernel):
             item = self.input.get_full()
             if item is None:
                 break
-            buf, n = item
+            buf, n, _tags = item
             self.received.append(buf[:n].copy())
             self.circuit.put_empty(buf)
         if self.input.finished() and len(self.input) == 0:

@@ -1,0 +1,107 @@
+"""Tags across the TPU plane (SURVEY §7: item-indexed metadata rides the tensors).
+
+A tag attached upstream must survive a device FIR+decimation segment — through the
+fused TpuKernel and through the TpuH2D → TpuStage → TpuD2H frame plane — and land on
+the rate-rebased output index (reference index math: ``buffer/circular.rs:37-64`` and
+the CPU path's ``blocks/dsp.py`` remap).
+"""
+import numpy as np
+import pytest
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.dsp import firdes
+from futuresdr_tpu.ops import fir_stage, mag2_stage
+from futuresdr_tpu.runtime.kernel import Kernel
+from futuresdr_tpu.runtime.tag import Tag
+
+DECIM = 4
+TAG_AT = [5, 4099, 10_000]          # first frame, second frame, mid-stream
+
+
+class TaggedRampSource(Kernel):
+    """Ramp source that tags chosen absolute indices with their value."""
+
+    def __init__(self, n, dtype=np.complex64):
+        super().__init__()
+        self.n = n
+        self._pos = 0
+        self.output = self.add_stream_output("out", dtype)
+
+    async def work(self, io, mio, meta):
+        out = self.output.slice()
+        k = min(len(out), self.n - self._pos)
+        if k:
+            out[:k] = np.arange(self._pos, self._pos + k)
+            for a in TAG_AT:
+                if self._pos <= a < self._pos + k:
+                    self.output.add_tag(a - self._pos, Tag.named_usize("mark", a))
+            self.output.produce(k)
+            self._pos += k
+        if self._pos >= self.n:
+            io.finished = True
+        elif k:
+            io.call_again = True
+
+
+class TagRecordingSink(Kernel):
+    """Record (absolute index, tag) pairs as they arrive."""
+
+    def __init__(self, dtype):
+        super().__init__()
+        self.input = self.add_stream_input("in", dtype)
+        self.n_received = 0
+        self.seen = []
+
+    async def work(self, io, mio, meta):
+        n = self.input.available()
+        if n:
+            for t in self.input.tags(n):
+                self.seen.append((self.n_received + t.index, t.tag))
+            self.input.consume(n)
+            self.n_received += n
+        if self.input.finished() and self.input.available() == 0:
+            io.finished = True
+
+
+def _expect(seen):
+    got = {t.value: idx for idx, t in seen}
+    assert set(got) == set(TAG_AT), got
+    for a in TAG_AT:
+        assert got[a] == a // DECIM, (a, got[a])
+
+
+def test_tags_survive_fused_kernel_with_decim():
+    from futuresdr_tpu.tpu import TpuKernel
+
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    n = 3 * 4096 + 1000
+    fg = Flowgraph()
+    src = TaggedRampSource(n)
+    tk = TpuKernel([fir_stage(taps, decim=DECIM)], np.complex64, frame_size=4096)
+    snk = TagRecordingSink(np.complex64)
+    fg.connect(src, tk, snk)
+    Runtime().run(fg)
+    assert snk.n_received >= (n // 4096) * (4096 // DECIM)
+    _expect(snk.seen)
+
+
+def test_tags_survive_frame_plane_with_rate_change():
+    from futuresdr_tpu.tpu import TpuD2H, TpuH2D, TpuStage
+
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    n = 3 * 4096
+    fg = Flowgraph()
+    src = TaggedRampSource(n)
+    h2d = TpuH2D(np.complex64, frame_size=4096)
+    st1 = TpuStage([fir_stage(taps, decim=DECIM)], np.complex64)
+    st2 = TpuStage([mag2_stage()], np.complex64)       # 1:1 stage keeps indices
+    d2h = TpuD2H(np.float32)
+    snk = TagRecordingSink(np.float32)
+    fg.connect_stream(src, "out", h2d, "in")
+    fg.connect_inplace(h2d, "out", st1, "in")
+    fg.connect_inplace(st1, "out", st2, "in")
+    fg.connect_inplace(st2, "out", d2h, "in")
+    fg.connect_stream(d2h, "out", snk, "in")
+    Runtime().run(fg)
+    assert snk.n_received == n // DECIM
+    _expect(snk.seen)
