@@ -22,13 +22,49 @@ __all__ = ["Fir", "FirBuilder", "Iir", "Fft", "XlatingFir", "SignalSource",
            "QuadratureDemod", "Agc", "ClockRecoveryMm"]
 
 
+def _load_mm_native():
+    """Bind the native MM work loop (``native/mm.cpp``) once per process; returns the
+    (lib, state_type) pair or None when the native library is unavailable. The MM
+    control loop is sequential per symbol — the reference runs it compiled
+    (``clock_recovery_mm.rs``); here the same loop is C++ behind ctypes, with the
+    Python loop kept as a portable fallback (``FSDR_NO_NATIVE=1`` forces it)."""
+    import ctypes
+    import os
+    if os.environ.get("FSDR_NO_NATIVE"):
+        return None
+    from ..runtime.buffer.circular import load_native
+    lib = load_native()
+    if lib is None or not hasattr(lib, "fsdr_mm_work"):
+        return None
+
+    class MmState(ctypes.Structure):
+        _fields_ = [("omega", ctypes.c_double), ("omega0", ctypes.c_double),
+                    ("mu", ctypes.c_double), ("last", ctypes.c_double),
+                    ("last_d", ctypes.c_double), ("gain_omega", ctypes.c_double),
+                    ("gain_mu", ctypes.c_double), ("limit", ctypes.c_double)]
+
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.fsdr_mm_work.restype = ctypes.c_int64
+    lib.fsdr_mm_work.argtypes = [f32p, ctypes.c_int64, f32p, ctypes.c_int64,
+                                 ctypes.POINTER(MmState),
+                                 ctypes.POINTER(ctypes.c_int64)]
+    return lib, MmState
+
+
 class ClockRecoveryMm(Kernel):
     """Mueller-Müller symbol timing recovery on a real-valued waveform.
 
     Library-block form of the ZigBee example's ``ClockRecoveryMm``
     (``examples/zigbee/src/clock_recovery_mm.rs``): emits one sample per recovered
     symbol; ``omega`` is the nominal samples/symbol, adapted within ``±limit``.
+
+    The per-symbol adaptation is sequential by construction (each symbol's timing
+    error steers the next sample position), so the hot loop runs as native C++
+    (``native/mm.cpp``, matched to the Python fallback kept below) — the same
+    answer the reference gives by being compiled Rust.
     """
+
+    _native = None      # class-level cache: (lib, MmState) | False
 
     def __init__(self, omega: float, gain_omega: float = 0.25e-3,
                  mu: float = 0.5, gain_mu: float = 0.03, omega_limit: float = 0.05):
@@ -41,30 +77,53 @@ class ClockRecoveryMm(Kernel):
         self.limit = omega_limit
         self._last = 0.0
         self._last_d = 0.0
+        if ClockRecoveryMm._native is None:
+            ClockRecoveryMm._native = _load_mm_native() or False
         self.input = self.add_stream_input("in", np.float32,
                                            min_items=int(np.ceil(omega)) + 2)
         self.output = self.add_stream_output("out", np.float32)
 
+    def _work_native(self, inp: np.ndarray, out: np.ndarray) -> tuple:
+        import ctypes
+        lib, MmState = ClockRecoveryMm._native
+        st = MmState(self.omega, self.omega0, self.mu, self._last, self._last_d,
+                     self.gain_omega, self.gain_mu, self.limit)
+        consumed = ctypes.c_int64(0)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        inp = np.ascontiguousarray(inp)
+        n_out = int(lib.fsdr_mm_work(
+            inp.ctypes.data_as(f32p), len(inp), out.ctypes.data_as(f32p),
+            len(out), ctypes.byref(st), ctypes.byref(consumed)))
+        self.omega, self.mu = st.omega, st.mu
+        self._last, self._last_d = st.last, st.last_d
+        return consumed.value, n_out
+
     async def work(self, io, mio, meta):
         inp = self.input.slice()
         out = self.output.slice()
-        n_out = 0
-        i = 0
+        # entry-omega window requirement — the SAME value the native loop derives
+        # internally (mm.cpp computes it from st->omega before iterating), so the
+        # finished check below agrees with where either loop actually stopped
         need = int(np.ceil(self.omega * (1 + self.limit))) + 2
-        while i + need < len(inp) and n_out < len(out):
-            s = inp[i] * (1 - self.mu) + inp[i + 1] * self.mu
-            d = 1.0 if s > 0 else -1.0
-            err = self._last_d * s - d * self._last
-            self._last, self._last_d = s, d
-            out[n_out] = s
-            n_out += 1
-            self.omega += self.gain_omega * err
-            self.omega = min(max(self.omega, self.omega0 * (1 - self.limit)),
-                             self.omega0 * (1 + self.limit))
-            step = self.omega + self.gain_mu * err
-            pos = i + self.mu + step
-            i = int(pos)
-            self.mu = pos - i
+        if ClockRecoveryMm._native:
+            i, n_out = self._work_native(inp, out)
+        else:
+            n_out = 0
+            i = 0
+            while i + need < len(inp) and n_out < len(out):
+                s = inp[i] * (1 - self.mu) + inp[i + 1] * self.mu
+                d = 1.0 if s > 0 else -1.0
+                err = self._last_d * s - d * self._last
+                self._last, self._last_d = s, d
+                out[n_out] = s
+                n_out += 1
+                self.omega += self.gain_omega * err
+                self.omega = min(max(self.omega, self.omega0 * (1 - self.limit)),
+                                 self.omega0 * (1 + self.limit))
+                step = self.omega + self.gain_mu * err
+                pos = i + self.mu + step
+                i = int(pos)
+                self.mu = pos - i
         if i > 0:
             self.input.consume(i)
         if n_out:

@@ -42,13 +42,16 @@ def load_native() -> Optional[ctypes.CDLL]:
     if _lib is not None:
         return _lib
     so = os.path.join(_NATIVE_DIR, "libfsdr_native.so")
-    if not os.path.exists(so):
-        try:
-            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                           capture_output=True, timeout=120)
-        except Exception as e:
+    # always run make: incremental no-op when up to date, and a pre-existing .so
+    # from before a new source file (e.g. mm.cpp) was added gets its symbols
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+    except Exception as e:
+        if not os.path.exists(so):
             log.warning("native build failed (%r); using portable ring buffer", e)
             return None
+        log.warning("native rebuild failed (%r); using existing %s", e, so)
     try:
         lib = ctypes.CDLL(so)
     except OSError as e:

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""perf/zigbee — ZigBee RX throughput and the MM clock-recovery block rate.
+
+Reference role: the ZigBee example's real-time RX at 4 Mchip/s
+(``examples/zigbee/src/clock_recovery_mm.rs`` + O-QPSK demod). Measures:
+
+- ``mm_block``: the library ClockRecoveryMm block (native C++ loop; FSDR_NO_NATIVE=1
+  for the Python fallback) through the actor runtime, input Msamples/s.
+- ``rx_chain``: full frame-level ZigBee RX (discriminator → clock recovery → chip
+  correlation) frames/s + input Msps.
+
+CSV: ``mode,native,run,value,msamples_per_sec``.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+
+def run_mm_block(n_samples: int) -> tuple:
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import NullSink, VectorSource
+    from futuresdr_tpu.blocks.dsp import ClockRecoveryMm
+
+    rng = np.random.default_rng(0)
+    n_samples = (n_samples // 4) * 4
+    sym = rng.choice([-1.0, 1.0], n_samples // 4).astype(np.float32)
+    x = np.repeat(sym, 4) + 0.05 * rng.standard_normal(n_samples).astype(np.float32)
+    x = x.astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(x)
+    mm = ClockRecoveryMm(4.0, omega_limit=0.1)
+    snk = NullSink(np.float32)
+    fg.connect(src, mm, snk)
+    t0 = time.perf_counter()
+    Runtime().run(fg)
+    dt = time.perf_counter() - t0
+    assert snk.n_received > n_samples // 5
+    # report whether the native loop ACTUALLY ran (a stale .so or failed build
+    # falls back silently; the env var alone would mislabel the row)
+    return n_samples / dt / 1e6, bool(ClockRecoveryMm._native)
+
+
+def run_rx_chain(n_frames: int) -> tuple:
+    from futuresdr_tpu.models.zigbee import demodulate_stream, modulate_frame
+
+    rng = np.random.default_rng(1)
+    parts = []
+    for _ in range(n_frames):
+        payload = bytes(rng.integers(0, 256, 40, dtype=np.uint8))
+        parts += [modulate_frame(payload), np.zeros(256, np.complex64)]
+    sig = np.concatenate(parts)
+    sig = (sig + 0.02 * (rng.standard_normal(len(sig))
+                         + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+    t0 = time.perf_counter()
+    frames = demodulate_stream(sig)
+    dt = time.perf_counter() - t0
+    return len(frames) / dt, len(sig) / dt / 1e6
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--samples", type=int, default=4_000_000)
+    p.add_argument("--frames", type=int, default=100)
+    a = p.parse_args()
+
+    print("mode,native,run,value,msamples_per_sec")
+    native = False
+    for r in range(a.runs):
+        rate, native = run_mm_block(a.samples)
+        print(f"mm_block,{native},{r},-,{rate:.2f}", flush=True)
+    for r in range(a.runs):
+        fps, msps = run_rx_chain(a.frames)
+        print(f"rx_chain,{native},{r},{fps:.1f},{msps:.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
