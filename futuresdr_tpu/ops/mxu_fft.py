@@ -32,33 +32,58 @@ import numpy as np
 
 # Module policy: implementation ("auto" | "mxu" | "xla") and matmul precision
 # ("f32" | "bf16"). Env overrides let a deployment flip the policy without code.
+#
+# TRACE-TIME BINDING: the policy is read when a function is *traced*, and jit
+# caches keep whichever path was bound at first trace. Flipping set_impl /
+# set_precision after a stage or Pipeline has compiled has no effect on the
+# cached executable — rebuild the stage, or pass impl=/precision= explicitly
+# (fft(..., impl=...), fir_stage(..., impl=...)) to bind per call site.
 _impl = os.environ.get("FUTURESDR_TPU_FFT_IMPL", "auto")
 _precision = os.environ.get("FUTURESDR_TPU_FFT_PRECISION", "f32")
 
 _MIN_MXU_N = 256          # below this the four-step matmuls are too skinny...
 _MAX_DIRECT_N = 512       # ...but a DIRECT [n,n] DFT matmul wins for small n (any
                           # factorization, huge batch): one dense MXU pass
+_MAX_FORCED_DIRECT_N = 4096   # forced-mxu safety cap: above this a dense [n,n]
+                              # DFT is O(n^2) HBM (4096^2 c64 = 134 MB); fall
+                              # back to jnp.fft rather than OOM/crawl
 
 
 def set_impl(impl: str) -> None:
-    """Set the FFT implementation policy: "auto" (MXU on TPU), "mxu", or "xla"."""
+    """Set the FFT implementation policy: "auto" (MXU on TPU), "mxu", or "xla".
+
+    Trace-time binding: affects only functions traced *after* this call; already
+    jit-compiled stages keep their old path (see module docstring)."""
     global _impl
     assert impl in ("auto", "mxu", "xla"), impl
     _impl = impl
 
 
 def set_precision(precision: str) -> None:
-    """Set MXU matmul precision: "f32" (accurate) or "bf16" (~2-4x faster, -47 dB)."""
+    """Set MXU matmul precision: "f32" (accurate) or "bf16" (~2-4x faster, -47 dB).
+
+    Trace-time binding: affects only functions traced *after* this call; already
+    jit-compiled stages keep their old path (see module docstring)."""
     global _precision
     assert precision in ("f32", "bf16"), precision
     _precision = precision
 
 
-def _use_mxu(n: int) -> bool:
+def _use_mxu(n: int, impl: Optional[str] = None) -> bool:
     """Trace-time dispatch decision (backend is static under jit)."""
-    if _impl == "xla":
+    eff = impl or _impl
+    if eff == "xla":
         return False
-    if _impl == "mxu":
+    if eff == "mxu":
+        if n > _MAX_FORCED_DIRECT_N and (n & (n - 1)) != 0:
+            # forced policy would route this through a dense [n,n] DFT matmul —
+            # O(n^2) HBM with no upside at this size; refuse and use jnp.fft
+            import logging
+            logging.getLogger("futuresdr_tpu").warning(
+                "fft: impl='mxu' forced but n=%d is a non-power-of-two above the "
+                "direct-DFT cap (%d); falling back to jnp.fft for this size",
+                n, _MAX_FORCED_DIRECT_N)
+            return False
         return True
     if jax.default_backend() != "tpu":
         return False
@@ -99,20 +124,25 @@ def _mxu_fft(x: jnp.ndarray, n: int, precision: Optional[str]) -> jnp.ndarray:
     return jnp.swapaxes(D, -1, -2).reshape(shape)
 
 
-def fft(x: jnp.ndarray, precision: Optional[str] = None) -> jnp.ndarray:
+def fft(x: jnp.ndarray, precision: Optional[str] = None,
+        impl: Optional[str] = None) -> jnp.ndarray:
     """Forward DFT along the last axis. Dispatches MXU four-step vs jnp.fft per the
-    module policy; always safe to call on any backend."""
+    module policy; always safe to call on any backend.
+
+    ``impl``/``precision`` override the module policy for this call site, binding
+    the choice at trace time regardless of later set_impl/set_precision calls."""
     n = x.shape[-1]
     x = x.astype(jnp.complex64)
-    if _use_mxu(n):
+    if _use_mxu(n, impl):
         return _mxu_fft(x, n, precision)
     return jnp.fft.fft(x, axis=-1)
 
 
-def ifft(x: jnp.ndarray, precision: Optional[str] = None) -> jnp.ndarray:
+def ifft(x: jnp.ndarray, precision: Optional[str] = None,
+         impl: Optional[str] = None) -> jnp.ndarray:
     """Inverse DFT along the last axis (conjugation trick over the forward path)."""
     n = x.shape[-1]
     x = x.astype(jnp.complex64)
-    if _use_mxu(n):
+    if _use_mxu(n, impl):
         return jnp.conj(_mxu_fft(jnp.conj(x), n, precision)) / n
     return jnp.fft.ifft(x, axis=-1)

@@ -73,13 +73,18 @@ class ThreadedScheduler(Scheduler):
                 w.ready.wait()
 
     def shutdown(self) -> None:
+        # Stop loops and snapshot under the lock, but join OUTSIDE it: a worker
+        # retiring concurrently (TpbScheduler._retire runs on its own loop thread
+        # and takes self._lock) would otherwise deadlock against the join until
+        # its timeout expired.
         with self._lock:
-            for w in self._workers:
+            workers = list(self._workers)
+            self._workers = []
+            for w in workers:
                 if w.loop is not None and w.loop.is_running():
                     w.loop.call_soon_threadsafe(w.loop.stop)
-            for w in self._workers:
-                w.thread.join(timeout=5)
-            self._workers = []
+        for w in workers:
+            w.thread.join(timeout=5)
         self._blocking_pool.shutdown(wait=False, cancel_futures=True)
 
     @property

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""perf/null_rand — randomized-work-size copy chains over buffer backends.
+
+Reference: ``perf/null_rand/null_rand.rs:13-191`` (pipes × stages CopyRand chains;
+every ``work()`` forwards a random 1..=max_copy chunk). Variable chunk sizes are
+where scheduler wake/backpressure and buffer wrap-around edge cases live — a
+fixed-size Copy chain never exercises them.
+
+CSV: ``run,pipes,stages,samples,max_copy,buffer,scheduler,elapsed_secs,msps_total``.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import NullSource, NullSink, Head, CopyRand
+from futuresdr_tpu.runtime.buffer.ring import RingWriter
+from futuresdr_tpu.runtime.buffer import circular
+from futuresdr_tpu.runtime.scheduler import AsyncScheduler, ThreadedScheduler
+
+
+def run_once(pipes, stages, samples, max_copy, backend, sched) -> float:
+    fg = Flowgraph()
+    for p in range(pipes):
+        src = NullSource(np.float32)
+        head = Head(np.float32, samples)
+        fg.connect_stream(src, "out", head, "in", buffer=backend)
+        last = head
+        for s in range(stages):
+            c = CopyRand(np.float32, max_copy=max_copy, seed=1 + p * stages + s)
+            fg.connect_stream(last, "out", c, "in", buffer=backend)
+            last = c
+        snk = NullSink(np.float32)
+        fg.connect_stream(last, "out", snk, "in", buffer=backend)
+    rt = Runtime(scheduler=sched())
+    t0 = time.perf_counter()
+    rt.run(fg)
+    dt = time.perf_counter() - t0
+    rt.shutdown()
+    return dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--pipes", type=int, nargs="+", default=[5])
+    p.add_argument("--stages", type=int, nargs="+", default=[6])
+    p.add_argument("--samples", type=int, default=2_000_000)
+    p.add_argument("--max-copy", type=int, default=512,
+                   help="max items one work() call forwards (small = max stress)")
+    p.add_argument("--buffers", nargs="+", default=["circular", "ring"])
+    p.add_argument("--schedulers", nargs="+", default=["async", "threaded"])
+    a = p.parse_args()
+    backends = {"ring": RingWriter}
+    if circular.available():
+        backends["circular"] = circular.CircularWriter
+    scheds = {"async": AsyncScheduler, "threaded": ThreadedScheduler}
+    print("run,pipes,stages,samples,max_copy,buffer,scheduler,elapsed_secs,msps_total")
+    for r in range(a.runs):
+        for bname in a.buffers:
+            if bname not in backends:
+                continue
+            for sname in a.schedulers:
+                for pipes in a.pipes:
+                    for stages in a.stages:
+                        dt = run_once(pipes, stages, a.samples, a.max_copy,
+                                      backends[bname], scheds[sname])
+                        print(f"{r},{pipes},{stages},{a.samples},{a.max_copy},"
+                              f"{bname},{sname},{dt:.3f},"
+                              f"{pipes * a.samples / dt / 1e6:.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

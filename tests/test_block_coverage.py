@@ -51,13 +51,22 @@ def test_selector_routing_and_switch():
     fg.connect_stream(sel, "out0", snk, "in")
     rt = Runtime()
     running = rt.start(fg)
-    time.sleep(0.05)
+
+    def poll_for(value, deadline=10.0):
+        # poll instead of a fixed sleep: on a loaded box a 50 ms nap is flake-bait
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < deadline:
+            if value in snk.items():
+                return True
+            time.sleep(0.01)
+        return False
+
+    assert poll_for(0.0), "no samples from input 0 before the switch"
     r = rt.scheduler.run_coro_sync(running.handle.call(sel, "input_index", Pmt.usize(1)))
     assert r == Pmt.usize(1)
-    time.sleep(0.05)
+    assert poll_for(1.0), "no samples from input 1 after the switch"
     running.stop_sync()
     got = snk.items()
-    assert len(got) > 0
     assert 0.0 in got and 1.0 in got        # routed input switched mid-stream
 
 

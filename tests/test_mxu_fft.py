@@ -115,3 +115,18 @@ def test_fir_stage_pallas_impl_matches_os():
 
         y_os, y_pl = run("os"), run("pallas")
         assert np.abs(y_os - y_pl).max() < 2e-3, dtype
+
+
+def test_forced_mxu_huge_nonpow2_falls_back():
+    """impl='mxu' must not route a huge non-power-of-two n through a dense [n,n]
+    DFT matmul (O(n^2) HBM) — it falls back to jnp.fft above the direct cap."""
+    from futuresdr_tpu.ops import mxu_fft
+    assert not mxu_fft._use_mxu(100_000, impl="mxu")      # would be ~80 GB dense
+    assert mxu_fft._use_mxu(300, impl="mxu")              # small direct: fine
+    assert mxu_fft._use_mxu(1 << 16, impl="mxu")          # pow2: four-step, fine
+    # per-call override wins over the module global
+    mxu_fft.set_impl("mxu")
+    try:
+        assert not mxu_fft._use_mxu(2048, impl="xla")
+    finally:
+        mxu_fft.set_impl("auto")
