@@ -11,7 +11,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..dsp import firdes
+from ..dsp import firdes, fxpt
 from ..dsp.kernels import (DecimatingFirFilter, FirFilter, IirFilter,
                            PolyphaseResamplingFir, Rotator)
 from ..runtime.kernel import Kernel, message_handler
@@ -329,20 +329,30 @@ class XlatingFir(Kernel):
 
 class SignalSource(Kernel):
     """NCO signal source (`signal_source/`): sin/cos/complex-exponential/square at a
-    given frequency, with ``freq``/``amplitude`` message ports. The reference uses a
-    fixed-point LUT NCO (`fxpt_phase.rs:11-19`); here the oscillator is a vectorized
-    phase accumulator with the same wrap-around semantics."""
+    given frequency, with ``freq``/``amplitude`` message ports.
+
+    ``nco="fxpt"`` (the reference's `fxpt_phase.rs:11-19` semantics) keeps phase in
+    a wrapping i32 — the increment is an exact integer, so the oscillator never
+    accumulates floating-point phase drift over arbitrarily long runs (frequency
+    quantized to fs/2^32). ``nco="float"`` is the plain float accumulator, kept for
+    comparison; see ``dsp/fxpt.py`` for why the reference's sine LUT is not
+    reproduced."""
 
     def __init__(self, waveform: str, frequency: float, sample_rate: float,
-                 amplitude: float = 1.0, offset: float = 0.0, dtype=None):
+                 amplitude: float = 1.0, offset: float = 0.0, dtype=None,
+                 nco: str = "fxpt"):
         super().__init__()
         assert waveform in ("sin", "cos", "complex", "square")
+        assert nco in ("fxpt", "float"), nco
         self.waveform = waveform
         self.sample_rate = float(sample_rate)
         self.amplitude = float(amplitude)
         self.offset = float(offset)
+        self.nco = nco
         self._phase = 0.0
         self._inc = 2.0 * np.pi * frequency / sample_rate
+        self._phase_i = 0                 # wrapping-i32 domain (nco="fxpt")
+        self._inc_i = fxpt.FixedPointPhase.increment_for(frequency, sample_rate)
         if dtype is None:
             dtype = np.complex64 if waveform == "complex" else np.float32
         self.output = self.add_stream_output("out", dtype)
@@ -350,7 +360,9 @@ class SignalSource(Kernel):
     @message_handler(name="freq")
     async def freq_handler(self, io, mio, meta, p: Pmt) -> Pmt:
         try:
-            self._inc = 2.0 * np.pi * p.to_float() / self.sample_rate
+            f = p.to_float()
+            self._inc = 2.0 * np.pi * f / self.sample_rate
+            self._inc_i = fxpt.FixedPointPhase.increment_for(f, self.sample_rate)
         except Exception:
             return Pmt.invalid_value()
         return Pmt.ok()
@@ -368,7 +380,12 @@ class SignalSource(Kernel):
         n = len(out)
         if n == 0:
             return
-        ph = self._phase + self._inc * np.arange(n)
+        if self.nco == "fxpt":
+            ph = fxpt.i32_to_radians(fxpt.phase_ramp_i32(self._phase_i, self._inc_i, n))
+            self._phase_i = fxpt.advance_u32(self._phase_i, self._inc_i, n)
+        else:
+            ph = self._phase + self._inc * np.arange(n)
+            self._phase = float((self._phase + self._inc * n) % (2.0 * np.pi))
         if self.waveform == "sin":
             y = np.sin(ph)
         elif self.waveform == "cos":
@@ -378,7 +395,6 @@ class SignalSource(Kernel):
         else:
             y = np.exp(1j * ph)
         out[:n] = (self.amplitude * y + self.offset).astype(out.dtype, copy=False)
-        self._phase = float((self._phase + self._inc * n) % (2.0 * np.pi))
         self.output.produce(n)
         io.call_again = True
 

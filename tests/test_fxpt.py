@@ -1,0 +1,133 @@
+"""Fixed-point NCO (`fxpt_phase.rs:11-19` semantics): wrap, exactness, drift.
+
+The property that matters: the i32 accumulator's phase after N samples is the
+same whether computed in one shot or chunk-by-chunk through a streaming run —
+and it never diverges from its integer formula, while a float accumulator's
+phase error grows with run length.
+"""
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu.dsp.fxpt import (FixedPointPhase, i32_to_radians,
+                                    phase_ramp_i32)
+
+
+def test_wrap_semantics():
+    # -2^31 <-> -pi, 0 <-> 0, 2^31-1 <-> pi - eps
+    assert FixedPointPhase(0.0).value == 0
+    assert FixedPointPhase(-np.pi).value == -(2 ** 31)
+    assert FixedPointPhase(np.pi).value == -(2 ** 31)     # pi folds to -pi
+    p = FixedPointPhase(np.pi / 2)
+    assert abs(p.to_radians() - np.pi / 2) < 1e-9
+    # folding: 2pi + x == x
+    assert FixedPointPhase(2 * np.pi + 0.3).value == FixedPointPhase(0.3).value
+
+
+def test_advance_wraps_exactly():
+    inc = FixedPointPhase.increment_for(0.25, 1.0)        # exactly 2^30
+    assert inc == 2 ** 30
+    p = FixedPointPhase(0.0)
+    # 4 advances of 0.25 cycles = 1 cycle = back to start, exactly
+    assert p.advance(inc, 4).value == 0
+    assert abs(p.advance(inc, 2).to_radians()) in (0.0, np.pi)  # half cycle = ±pi
+    # negative frequency wraps the other way
+    inc_neg = FixedPointPhase.increment_for(-0.25, 1.0)
+    assert p.advance(inc_neg, 4).value == 0
+    # a NON-representable rate (0.3) still cancels its own quantization exactly:
+    # chunked advance == one-shot advance, whatever the quantized inc is
+    inc3 = FixedPointPhase.increment_for(0.3, 1.0)
+    assert p.advance(inc3, 1000).value == \
+        p.advance(inc3, 400).advance(inc3, 600).value
+
+
+def test_phase_ramp_matches_scalar_advance():
+    rng = np.random.default_rng(3)
+    for _ in range(10):
+        start = int(rng.integers(-2 ** 31, 2 ** 31))
+        inc = int(np.int32(rng.integers(-2 ** 31, 2 ** 31)))
+        n = int(rng.integers(1, 5000))
+        ramp = phase_ramp_i32(start, inc, n)
+        p = FixedPointPhase(raw=start)
+        assert ramp[0] == p.value
+        assert ramp[-1] == p.advance(inc, n - 1).value
+
+
+def test_chunked_equals_oneshot():
+    """Streaming chunk boundaries are invisible: the concatenated per-chunk ramps
+    equal the one-shot ramp bit-for-bit."""
+    inc = FixedPointPhase.increment_for(97_531.0, 1e6)
+    one = phase_ramp_i32(1234, inc, 100_000)
+    pieces, pos = [], 1234
+    rng = np.random.default_rng(0)
+    done = 0
+    while done < 100_000:
+        k = min(int(rng.integers(1, 7777)), 100_000 - done)
+        pieces.append(phase_ramp_i32(pos, inc, k))
+        pos = (pos + inc * k) & 0xFFFF_FFFF
+        done += k
+    np.testing.assert_array_equal(np.concatenate(pieces), one)
+
+
+def test_long_run_drift_fxpt_vs_float():
+    """After 10^8 samples the fxpt phase is EXACT (integer identity) while the
+    float accumulator, stepped chunk-by-chunk as the float NCO does, has drifted
+    by orders of magnitude more than one fxpt quantum."""
+    freq, fs = 12_345.6789, 1e6
+    n_total, chunk = 100_000_000, 65_536
+
+    inc_i = FixedPointPhase.increment_for(freq, fs)
+    # fxpt: O(1) exactness check — advance() IS the per-chunk update rule
+    p = FixedPointPhase(0.0)
+    n_chunks, rem = divmod(n_total, chunk)
+    for _ in range(3):                     # spot-check a few chunk updates
+        p = p.advance(inc_i, chunk)
+    p_direct = FixedPointPhase(0.0).advance(inc_i, 3 * chunk)
+    assert p == p_direct                   # chunked == one-shot, bit-exact
+    final_fxpt = FixedPointPhase(0.0).advance(inc_i, n_total)
+    expected = (inc_i * n_total) & 0xFFFF_FFFF
+    assert np.uint32(final_fxpt.value & 0xFFFF_FFFF) == np.uint32(expected)
+
+    # float32-precision accumulator (what a naive NCO state is), stepped per chunk
+    inc_f = np.float32(2.0 * np.pi * freq / fs)
+    ph = np.float32(0.0)
+    for _ in range(n_chunks):
+        ph = np.float32((ph + inc_f * chunk) % (2.0 * np.pi))
+    ph = np.float32((ph + inc_f * rem) % (2.0 * np.pi))
+    # ground truth in extended precision
+    true_ph = float((int(n_total) * (2.0 * np.pi * freq / fs)) % (2.0 * np.pi))
+
+    def circ_err(a, b):
+        return abs((a - b + np.pi) % (2 * np.pi) - np.pi)
+
+    float_err = circ_err(float(ph), true_ph)
+    fxpt_err = circ_err(final_fxpt.to_radians(),
+                        float((int(n_total) * (inc_i * np.pi / 2 ** 31)) % (2 * np.pi)))
+    quantum = np.pi / 2 ** 31
+    assert fxpt_err < 4 * quantum          # exact up to the radian conversion
+    assert float_err > 1000 * quantum      # the float path has genuinely drifted
+    assert float_err > 100 * fxpt_err if fxpt_err > 0 else True
+
+
+def test_signal_source_fxpt_block():
+    """SignalSource(nco='fxpt') streams the exact integer-phase waveform and the
+    freq port retunes to the quantized frequency."""
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import SignalSource, Head, VectorSink
+
+    fs, f0, n = 48_000.0, 1_234.5, 20_000
+    fg = Flowgraph()
+    src = SignalSource("complex", f0, fs, nco="fxpt")
+    head = Head(np.complex64, n)
+    snk = VectorSink(np.complex64)
+    fg.connect(src, head, snk)
+    Runtime().run(fg)
+    got = snk.items()
+    assert len(got) == n
+    inc = FixedPointPhase.increment_for(f0, fs)
+    ref = np.exp(1j * i32_to_radians(phase_ramp_i32(0, inc, n))).astype(np.complex64)
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+    # the tone is where we asked (to fs/2^32 quantization)
+    spec = np.abs(np.fft.fft(got * np.hanning(n)))
+    peak = np.argmax(spec[:n // 2]) * fs / n
+    assert abs(peak - f0) < 2 * fs / n
