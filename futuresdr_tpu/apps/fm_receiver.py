@@ -30,9 +30,9 @@ def front_end_stages(input_rate: float = 1_000_000.0, offset: float = 0.0):
     decim = int(input_rate // SAMPLE_RATE)
     g = gcd(AUDIO_RATE, SAMPLE_RATE)
     return [
-        rotator_stage(-2 * np.pi * offset / input_rate),
+        rotator_stage(-2 * np.pi * offset / input_rate, name="tuner"),
         fir_stage(firdes.lowpass(0.5 / decim * 0.8, 128).astype(np.float32),
-                  decim=decim, fft_len=4096),
+                  decim=decim, fft_len=4096, name="chan"),
         quad_demod_stage(SAMPLE_RATE / (2 * np.pi * 75e3)),
         resample_stage(AUDIO_RATE // g, SAMPLE_RATE // g),
     ]
@@ -54,12 +54,13 @@ def build_flowgraph(source=None, *, input_rate: float = 1_000_000.0,
     from math import gcd
     g = gcd(AUDIO_RATE, SAMPLE_RATE)
     if use_tpu:
-        # whole front end as ONE fused XLA program; retuning means rebuilding the
-        # kernel (runtime retune lives on the CPU path's XlatingFir message port)
+        # whole front end as ONE fused XLA program; runtime retune reaches the
+        # device path through the TpuKernel ctrl port ("tuner" stage carry swap —
+        # frames in flight finish at the old frequency, no recompile)
         from ..tpu import TpuKernel
         chain = TpuKernel(front_end_stages(input_rate, offset), np.complex64)
         fg.connect(last, chain)
-        retune = chain         # no runtime retune on the fused path
+        retune = chain
         out_block = chain
     else:
         xlate = XlatingFir(firdes.lowpass(0.5 / decim * 0.8, 128), decim, offset,
@@ -102,7 +103,13 @@ def main(argv=None):
             if line in ("q", "quit", "exit"):
                 break
             try:
-                running.handle.post_sync(xlate, "freq", float(line))
+                off = float(line)
+                if a.tpu:
+                    from ..types import Pmt
+                    running.handle.post_sync(xlate, "ctrl", Pmt.map(
+                        {"stage": "tuner", "phase_inc": -2 * np.pi * off / a.rate}))
+                else:
+                    running.handle.post_sync(xlate, "freq", off)
             except ValueError:
                 print("not a number")
     except (EOFError, KeyboardInterrupt):

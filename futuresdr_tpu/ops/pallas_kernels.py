@@ -74,9 +74,11 @@ def pallas_fir_continue(hist: jnp.ndarray, x: jnp.ndarray, taps: np.ndarray,
     """Streaming continuation: filter frame ``x`` given the previous ``n_taps-1``
     input samples in ``hist``. Pads to the kernel's block granularity, runs complex
     frames as two real passes, and returns exactly ``len(x)`` aligned outputs.
-    Shared by :func:`pallas_fir_stage` and ``stages.fir_stage(impl="pallas")``."""
-    taps = np.asarray(taps, dtype=np.float32)
-    nt = len(taps)
+    Shared by :func:`pallas_fir_stage` and ``stages.fir_stage(impl="pallas")``.
+    ``taps`` may be a traced device array (carry-resident, for runtime tap swap) —
+    only its static shape is read here."""
+    taps = jnp.asarray(taps, dtype=jnp.float32)
+    nt = taps.shape[0]
     ext = jnp.concatenate([hist, x])               # [(nt-1) + n]
     pad = (-ext.shape[0]) % block
     if pad:

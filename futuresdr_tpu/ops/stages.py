@@ -45,6 +45,9 @@ class Stage:
     name: str = "stage"
     lti: Optional[Tuple[np.ndarray, int, int]] = None  # (taps, decim, fft_len) when the
     #   stage is a linear time-invariant FIR — lets Pipeline merge adjacent FIRs into one
+    update: Optional[Callable[..., Any]] = None   # host-side ``(carry, **params) -> carry``
+    #   runtime control hook: parameters (taps, phase_inc, …) live in the carry, so a
+    #   retune is carry surgery between dispatches — NO recompile, frames stay in flight
 
     def __repr__(self):
         return f"Stage({self.name}, ratio={self.ratio})"
@@ -120,6 +123,32 @@ class Pipeline:
         q = Fraction(in_items) * self.ratio
         assert q.denominator == 1
         return int(q)
+
+    def update_stage(self, carries, stage, **params):
+        """Runtime control: apply a stage's ``update`` hook to its slot in ``carries``.
+
+        ``stage``: post-merge index or stage ``name`` (LTI merging may have renamed a
+        FIR to ``"a*b"`` — address the pipeline you built, check ``.stages``). Returns
+        the new carries tuple; the in-flight frames that captured the old carry are
+        untouched, every later dispatch sees the new parameters — the device-path
+        retune-while-running of ``examples/fm-receiver/src/main.rs:83-155``.
+        """
+        if isinstance(stage, str):
+            hits = [i for i, s in enumerate(self.stages) if s.name == stage]
+            if not hits:
+                raise KeyError(
+                    f"no stage named {stage!r} in {[s.name for s in self.stages]}")
+            if len(hits) > 1:
+                raise KeyError(f"stage name {stage!r} is ambiguous (indices {hits})")
+            idx = hits[0]
+        else:
+            idx = int(stage)
+        s = self.stages[idx]
+        if s.update is None:
+            raise ValueError(f"stage {s.name!r} has no runtime-update hook")
+        carries = list(carries)
+        carries[idx] = s.update(carries[idx], **params)
+        return tuple(carries)
 
 
 def _merge_lti(stages: Sequence[Stage], in_dtype) -> list:
@@ -222,6 +251,8 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
     assert impl in ("auto", "os", "pallas", "poly"), impl
     taps = np.asarray(taps)
     nt = len(taps)
+    built_real = np.isrealobj(taps)     # baked into the traced branches; the update
+    #                                     hook refuses swaps that would change it
     # auto cap nt/D ≤ 32: the poly window matrix materializes ~nt/D × the frame in
     # HBM, so the route stays where both the MACs/input and the intermediate are
     # modest; longer filters keep the OS path's fixed fft_len working set
@@ -237,24 +268,31 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
     while L < 2 * nt:                   # hop must comfortably exceed the tap overlap
         L *= 2
     fft_len = 2 * L
-    H = np.fft.fft(np.concatenate([taps, np.zeros(fft_len - nt)])).astype(np.complex64)
-    # real-input path: half-spectrum taps (real inputs discard the imaginary response,
-    # so conv(x, taps).real == conv(x, taps.real) — same semantics as the full path)
-    Hr = np.fft.rfft(np.concatenate([np.real(taps),
-                                     np.zeros(fft_len - nt)])).astype(np.complex64)
+
+    def _spectra(t):
+        # full spectrum, and the real-input half spectrum (real inputs discard the
+        # imaginary response, so conv(x, t).real == conv(x, t.real) — same semantics)
+        full = np.fft.fft(np.concatenate([t, np.zeros(fft_len - nt)])
+                          ).astype(np.complex64)
+        half = np.fft.rfft(np.concatenate([np.real(t), np.zeros(fft_len - nt)])
+                           ).astype(np.complex64)
+        return full, half
+
+    H, Hr = _spectra(taps)
 
     def fn(carry, x):
-        Hc, tail = carry
+        Hc, tt, tail = carry
         ext = jnp.concatenate([tail, x])             # [(S+1)·L], S = n // L
         is_c = jnp.iscomplexobj(x)
         if impl != "os" and np.isrealobj(taps) and nt >= 2 and (
                 impl == "pallas" or _pallas_fir_wins(nt, is_c)):
             from .pallas_kernels import pallas_fir_continue
-            y = pallas_fir_continue(ext[L - (nt - 1):L], x,
-                                    np.real(taps).astype(np.float32))
+            # time-domain taps come from the CARRY (not the closure) so a runtime
+            # tap swap reaches the pallas path too — same shape, no recompile
+            y = pallas_fir_continue(ext[L - (nt - 1):L], x, tt)
             if decim > 1:
                 y = y[::decim]
-            return (Hc, ext[ext.shape[0] - L:]), y
+            return (Hc, tt, ext[ext.shape[0] - L:]), y
         # block s = ext[sL : sL+2L] = rows[s] ++ rows[s+1]: built from two strided
         # slices + concat, NOT a gather — TPU gathers run ~9× slower than this form
         rows = ext.reshape(-1, L)
@@ -275,7 +313,7 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
         y = seg.reshape(-1).astype(x.dtype)
         if decim > 1:
             y = y[::decim]
-        return (Hc, ext[ext.shape[0] - L:]), y
+        return (Hc, tt, ext[ext.shape[0] - L:]), y
 
     def init_carry(dtype):
         dt = np.dtype(dtype)
@@ -285,12 +323,41 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
         # complex H2D (incl. eager jnp.zeros, which is a host device_put!) must ride
         # the pair shim — broken complex transfers on axon, see ops/xfer.py
         from .xfer import to_device
-        return (to_device(Hsel), to_device(np.zeros(L, dtype=dt)))
+        return (to_device(Hsel), to_device(np.real(taps).astype(np.float32)),
+                to_device(np.zeros(L, dtype=dt)))
+
+    def update(carry, taps=None):
+        """Swap the filter while frames are in flight: same tap COUNT (shapes are
+        static under jit), new response. Rebuilds the spectrum matching the carry's
+        layout (full vs half, inferred from the carried H's length) and the
+        time-domain taps the pallas branch reads; history is preserved, so the
+        transition is seamless after nt-1 samples. New arrays land on the device
+        the carry lives on."""
+        if taps is None:
+            return carry
+        new = np.asarray(taps)
+        if len(new) != nt:
+            raise ValueError(
+                f"tap swap must keep the tap count ({nt}); got {len(new)} — "
+                f"rebuild the stage for a different filter length")
+        if np.iscomplexobj(new) and built_real:
+            # realness is baked at trace time (pallas branch, half-spectrum path);
+            # a complex swap on a real-built stage would silently drop .imag there
+            raise ValueError(
+                "stage was built with real taps; swapping to complex taps "
+                "requires rebuilding the stage")
+        Hc_old, _tt, tail = carry
+        full, half = _spectra(new)
+        from .xfer import to_device
+        dev = next(iter(tail.devices())) if isinstance(tail, jax.Array) else None
+        Hn = full if Hc_old.shape[0] == fft_len else half
+        return (to_device(Hn, dev),
+                to_device(np.real(new).astype(np.float32), dev), tail)
 
     # frame must be a multiple of the hop (and of decim at the output side)
     multiple = int(np.lcm(L, decim))
     return Stage(fn, init_carry, Fraction(1, decim), None, multiple, name,
-                 lti=(taps, decim, fft_len, impl))
+                 lti=(taps, decim, fft_len, impl), update=update)
 
 
 def _stride_windows(ext: jnp.ndarray, D: int, m: int, nq: int) -> jnp.ndarray:
@@ -312,6 +379,7 @@ def _poly_decim_fir_stage(taps: np.ndarray, decim: int, fft_len: int,
     """
     D = int(decim)
     nt = len(taps)
+    built_real = np.isrealobj(taps)
     m = max(1, -(-(nt - 1) // D))       # history rows so windows never underflow
     H = m * D
 
@@ -325,18 +393,42 @@ def _poly_decim_fir_stage(taps: np.ndarray, decim: int, fft_len: int,
                        precision=jax.lax.Precision.HIGHEST)
         return (trev, ext[ext.shape[0] - H:]), y.astype(x.dtype)
 
-    def init_carry(dtype):
-        dt = np.dtype(dtype)
+    def _rev(t, complex_stream: bool):
         # a real stream takes .real at the stage boundary (same semantics as the OS
         # path's half-spectrum Hr) — bake that into the carried taps
-        teff = taps if np.issubdtype(dt, np.complexfloating) else np.real(taps)
-        trev = np.ascontiguousarray(teff[::-1]).astype(
+        teff = t if complex_stream else np.real(t)
+        return np.ascontiguousarray(teff[::-1]).astype(
             np.complex64 if np.iscomplexobj(teff) else np.float32)
+
+    def init_carry(dtype):
+        dt = np.dtype(dtype)
         from .xfer import to_device
-        return (to_device(trev), to_device(np.zeros(H, dtype=dt)))
+        return (to_device(_rev(taps, np.issubdtype(dt, np.complexfloating))),
+                to_device(np.zeros(H, dtype=dt)))
+
+    def update(carry, taps=None):
+        """Runtime tap swap (same count — shapes are static under jit); the carried
+        reversed taps are rebuilt with the SAME complex/real treatment init_carry
+        applied, keyed on the stream dtype (the carried history's dtype)."""
+        if taps is None:
+            return carry
+        new = np.asarray(taps)
+        if len(new) != nt:
+            raise ValueError(
+                f"tap swap must keep the tap count ({nt}); got {len(new)} — "
+                f"rebuild the stage for a different filter length")
+        if np.iscomplexobj(new) and built_real:
+            raise ValueError(
+                "stage was built with real taps; swapping to complex taps "
+                "requires rebuilding the stage")
+        _trev_old, hist = carry
+        from .xfer import to_device
+        dev = next(iter(hist.devices())) if isinstance(hist, jax.Array) else None
+        complex_stream = np.issubdtype(hist.dtype, np.complexfloating)
+        return (to_device(_rev(new, complex_stream), dev), hist)
 
     return Stage(fn, init_carry, Fraction(1, D), None, D, name,
-                 lti=(taps, D, fft_len, impl))
+                 lti=(taps, D, fft_len, impl), update=update)
 
 
 def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,
@@ -473,21 +565,37 @@ def log10_stage(scale: float = 10.0, floor: float = 1e-20) -> Stage:
     return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), np.float32, 1, "log10")
 
 
-def rotator_stage(phase_inc: float) -> Stage:
-    """Complex rotator with phase carry (futuredsp `Rotator` as a stage)."""
-    inc = float(phase_inc)
+def rotator_stage(phase_inc: float, name: str = "rotator") -> Stage:
+    """Complex rotator with phase carry (futuredsp `Rotator` as a stage).
+
+    The increment rides the CARRY (not the trace), so a runtime retune —
+    ``pipeline.update_stage(carries, "rotator", phase_inc=…)`` or the TpuKernel
+    ``ctrl`` port — takes effect on the next dispatched frame with phase
+    continuity, no recompile: the device-path analog of the fm-receiver's
+    ``freq`` handler (``examples/fm-receiver/src/main.rs:83-155``)."""
 
     def fn(carry, x):
+        ph0, inc = carry
         n = x.shape[0]
-        ph = carry + inc * jnp.arange(n, dtype=jnp.float32)
+        ph = ph0 + inc * jnp.arange(n, dtype=jnp.float32)
         y = x * jnp.exp(1j * ph).astype(x.dtype)
-        new = jnp.mod(carry + inc * n, 2 * np.pi)
-        return new, y
+        new = jnp.mod(ph0 + inc * n, 2 * np.pi)
+        return (new, inc), y
 
     def init_carry(dtype):
-        return jnp.zeros((), dtype=jnp.float32)
+        return (jnp.zeros((), dtype=jnp.float32),
+                jnp.asarray(float(phase_inc), dtype=jnp.float32))
 
-    return Stage(fn, init_carry, Fraction(1, 1), None, 1, "rotator")
+    def update(carry, phase_inc=None):
+        if phase_inc is None:
+            return carry
+        ph0, _inc = carry
+        new_inc = jnp.asarray(float(phase_inc), dtype=jnp.float32)
+        if isinstance(ph0, jax.Array):          # land beside the carry's phase
+            new_inc = jax.device_put(new_inc, next(iter(ph0.devices())))
+        return (ph0, new_inc)
+
+    return Stage(fn, init_carry, Fraction(1, 1), None, 1, name, update=update)
 
 
 def quad_demod_stage(gain: float = 1.0) -> Stage:

@@ -29,8 +29,9 @@ import numpy as np
 
 from ..log import logger
 from ..ops.stages import Pipeline, Stage
-from ..runtime.kernel import Kernel
+from ..runtime.kernel import Kernel, message_handler
 from ..runtime.tag import ItemTag
+from ..types import Pmt
 from .frames import emit_with_tags, rebase_frame_tags
 from .instance import TpuInstance, instance
 
@@ -84,6 +85,41 @@ class TpuKernel(Kernel):
         y.block_until_ready()
         del warm_carry  # donated buffers; fresh carry below
         _, self._carry = self.pipeline.compile(self.frame_size, device=self.inst.device)
+
+    @message_handler(name="ctrl")
+    async def ctrl_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        """Runtime stage control: ``{"stage": <name-or-index>, <param>: <value>, …}``.
+
+        Swaps carry-resident parameters (FIR taps, rotator phase_inc, …) between
+        dispatches — frames already in flight finish with the old values, every
+        later frame uses the new ones; no recompile, no pipeline stall. The
+        device-path retune of the reference's fm-receiver ``freq`` handler
+        (``examples/fm-receiver/src/main.rs:83-155``)."""
+        try:
+            d = dict(p.to_map())
+            stage = d.pop("stage").value
+            if not isinstance(stage, str):
+                stage = int(stage)
+            params = {}
+            for k, v in d.items():
+                val = v.value
+                if isinstance(val, (list, tuple)):
+                    # Pmt.map wraps list elements as Pmt (VecPmt) — unwrap them
+                    val = [e.value if isinstance(e, Pmt) else e for e in val]
+                    params[k] = np.asarray(val)
+                elif isinstance(val, np.ndarray):
+                    params[k] = val
+                else:
+                    params[k] = float(val)
+            if self._carry is None:
+                # the runtime's init barrier answers pre-init messages itself, so
+                # this only triggers on direct handler calls before init
+                raise RuntimeError("ctrl before init")
+            self._carry = self.pipeline.update_stage(self._carry, stage, **params)
+        except Exception as e:
+            log.warning("ctrl update rejected: %r", e)
+            return Pmt.invalid_value()
+        return Pmt.ok()
 
     # -- helpers ---------------------------------------------------------------
     def _dispatch(self, frame: np.ndarray, valid_in: int,
