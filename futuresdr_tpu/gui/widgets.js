@@ -243,16 +243,180 @@ FSDR.ListSelector = function (root, handle, fgId, blkId, handler, options) {
   return sel;
 };
 
+/* ---------------- WebGL2 plumbing ------------------------------------------ */
+/* Shared helpers for the GPU sinks (the prophecy crate renders its Waterfall and
+ * ConstellationSinkDensity with WebGL2 shaders, crates/prophecy/src/waterfall.rs /
+ * constellation_sink_density.rs — same capability here, independent design:
+ * scalar fields live in R32F textures, color is applied by sampling a 256x1
+ * colormap LUT texture in the fragment shader, so colormaps are swappable
+ * without touching GLSL). */
+FSDR.GL = {};
+FSDR.GL.context = function (canvas) {
+  try {
+    return canvas.getContext('webgl2', {antialias: false, depth: false,
+                                        premultipliedAlpha: false});
+  } catch (e) { return null; }
+};
+FSDR.GL.program = function (gl, vertSrc, fragSrc) {
+  const mk = (type, src) => {
+    const sh = gl.createShader(type);
+    gl.shaderSource(sh, src); gl.compileShader(sh);
+    if (!gl.getShaderParameter(sh, gl.COMPILE_STATUS))
+      throw new Error('shader: ' + gl.getShaderInfoLog(sh));
+    return sh;
+  };
+  const prog = gl.createProgram();
+  gl.attachShader(prog, mk(gl.VERTEX_SHADER, vertSrc));
+  gl.attachShader(prog, mk(gl.FRAGMENT_SHADER, fragSrc));
+  gl.linkProgram(prog);
+  if (!gl.getProgramParameter(prog, gl.LINK_STATUS))
+    throw new Error('link: ' + gl.getProgramInfoLog(prog));
+  return prog;
+};
+FSDR.GL.quad = function (gl, prog, attrib) {
+  const buf = gl.createBuffer();
+  gl.bindBuffer(gl.ARRAY_BUFFER, buf);
+  gl.bufferData(gl.ARRAY_BUFFER,
+                new Float32Array([-1, -1, 1, -1, -1, 1, 1, 1]), gl.STATIC_DRAW);
+  const loc = gl.getAttribLocation(prog, attrib);
+  gl.enableVertexAttribArray(loc);
+  gl.vertexAttribPointer(loc, 2, gl.FLOAT, false, 0, 0);
+};
+FSDR.GL.fieldTexture = function (gl, unit, w, h) {
+  const tex = gl.createTexture();
+  gl.activeTexture(gl.TEXTURE0 + unit);
+  gl.bindTexture(gl.TEXTURE_2D, tex);
+  gl.texParameteri(gl.TEXTURE_2D, gl.TEXTURE_WRAP_S, gl.CLAMP_TO_EDGE);
+  gl.texParameteri(gl.TEXTURE_2D, gl.TEXTURE_WRAP_T, gl.REPEAT);
+  gl.texParameteri(gl.TEXTURE_2D, gl.TEXTURE_MIN_FILTER, gl.NEAREST);
+  gl.texParameteri(gl.TEXTURE_2D, gl.TEXTURE_MAG_FILTER, gl.NEAREST);
+  gl.pixelStorei(gl.UNPACK_ALIGNMENT, 1);
+  gl.texImage2D(gl.TEXTURE_2D, 0, gl.R32F, w, h, 0, gl.RED, gl.FLOAT,
+                new Float32Array(w * h));
+  return tex;
+};
+/* Default colormap: a perceptually-ordered dark-violet -> teal -> yellow ramp
+ * built procedurally (piecewise-linear through anchor colors, then gamma-eased),
+ * uploaded as a 256x1 RGBA LUT. opts.colormap may replace it with any
+ * [[r,g,b],...] 0..255 anchor list. */
+FSDR.GL.lutTexture = function (gl, unit, anchors) {
+  anchors = anchors || [[13, 8, 65], [84, 39, 143], [35, 110, 145],
+                        [28, 170, 128], [122, 209, 81], [253, 231, 37]];
+  const n = 256, data = new Uint8Array(4 * n);
+  for (let i = 0; i < n; i++) {
+    const t = i / (n - 1), f = t * (anchors.length - 1);
+    const a = Math.min(Math.floor(f), anchors.length - 2), u = f - a;
+    for (let c = 0; c < 3; c++)
+      data[4 * i + c] = Math.round(anchors[a][c] * (1 - u) + anchors[a + 1][c] * u);
+    data[4 * i + 3] = 255;
+  }
+  const tex = gl.createTexture();
+  gl.activeTexture(gl.TEXTURE0 + unit);
+  gl.bindTexture(gl.TEXTURE_2D, tex);
+  gl.texParameteri(gl.TEXTURE_2D, gl.TEXTURE_WRAP_S, gl.CLAMP_TO_EDGE);
+  gl.texParameteri(gl.TEXTURE_2D, gl.TEXTURE_WRAP_T, gl.CLAMP_TO_EDGE);
+  gl.texParameteri(gl.TEXTURE_2D, gl.TEXTURE_MIN_FILTER, gl.LINEAR);
+  gl.texParameteri(gl.TEXTURE_2D, gl.TEXTURE_MAG_FILTER, gl.LINEAR);
+  gl.texImage2D(gl.TEXTURE_2D, 0, gl.RGBA, n, 1, 0, gl.RGBA, gl.UNSIGNED_BYTE, data);
+  return tex;
+};
+FSDR.GL.VERT = [
+  '#version 300 es',
+  'in vec2 pos;',
+  'out vec2 uv;',
+  'void main() { uv = pos * 0.5 + 0.5; gl_Position = vec4(pos, 0.0, 1.0); }',
+].join('\n');
+
 /* ---------------- stream sinks -------------------------------------------- */
-FSDR.Waterfall = function (canvas) {
-  this.cv = canvas; this.ctx = canvas.getContext('2d');
+/* Waterfall: scrolling spectrogram. WebGL2 path keeps the full history in an
+ * R32F ring texture (one texSubImage2D row upload per frame; the scroll is a
+ * yoffset uniform + REPEAT wrap — zero row copies, sustains 2048-bin full-rate
+ * feeds). Falls back to the canvas-2D implementation where WebGL2 is missing. */
+FSDR.WATERFALL_FRAG = [
+  '#version 300 es',
+  /* highp: the ring lookup needs 1/history (1/1024) y-resolution, below the
+   * fp16 precision step on mobile GPUs where mediump is 16-bit */
+  'precision highp float;',
+  'in vec2 uv;',
+  'uniform sampler2D field;',
+  'uniform sampler2D lut;',
+  'uniform float u_min;',
+  'uniform float u_max;',
+  'uniform float yoffset;',
+  'out vec4 rgba;',
+  'void main() {',
+  '  float v = texture(field, vec2(uv.x, uv.y + yoffset)).r;',
+  '  float t = clamp((v - u_min) / (u_max - u_min), 0.0, 1.0);',
+  '  rgba = vec4(texture(lut, vec2(t, 0.5)).rgb, 1.0);',
+  '}',
+].join('\n');
+FSDR.Waterfall = function (canvas, opts) {
+  opts = opts || {};
+  this.cv = canvas;
+  this.history = opts.history || 1024;
+  this.autorange = opts.autorange !== false;
+  this.min = opts.min ?? 0; this.max = opts.max ?? 1;
+  const gl = FSDR.GL.context(canvas);
+  if (!gl || !gl.texImage2D) {           // no WebGL2: canvas-2D fallback
+    this.fallback = new FSDR.Waterfall2D(canvas, opts);
+    return;
+  }
+  this.gl = gl; this.bins = 0; this.row = 0;
+  this.prog = FSDR.GL.program(gl, FSDR.GL.VERT, FSDR.WATERFALL_FRAG);
+  gl.useProgram(this.prog);
+  FSDR.GL.quad(gl, this.prog, 'pos');
+  this.lut = FSDR.GL.lutTexture(gl, 1, opts.colormap);
+  gl.uniform1i(gl.getUniformLocation(this.prog, 'field'), 0);
+  gl.uniform1i(gl.getUniformLocation(this.prog, 'lut'), 1);
+  this.uMin = gl.getUniformLocation(this.prog, 'u_min');
+  this.uMax = gl.getUniformLocation(this.prog, 'u_max');
+  this.uOff = gl.getUniformLocation(this.prog, 'yoffset');
 };
 FSDR.Waterfall.prototype.frame = function (data) {
+  if (this.fallback) return this.fallback.frame(data);
+  const gl = this.gl;
+  if (this.bins !== data.length) {       // (re)size the ring to the feed
+    this.bins = data.length; this.row = 0;
+    if (this.tex) gl.deleteTexture(this.tex);   // don't leak the old ring
+    this.tex = FSDR.GL.fieldTexture(gl, 0, this.bins, this.history);
+  }
+  if (this.autorange) {                  // smoothed auto-range (decays ~1s)
+    let lo = Infinity, hi = -Infinity;
+    for (const v of data) { if (v < lo) lo = v; if (v > hi) hi = v; }
+    this.min = this.min * 0.97 + lo * 0.03;
+    this.max = this.max * 0.97 + (hi + 1e-9) * 0.03;
+  }
+  gl.activeTexture(gl.TEXTURE0);
+  gl.texSubImage2D(gl.TEXTURE_2D, 0, 0, this.row, this.bins, 1, gl.RED, gl.FLOAT,
+                   data instanceof Float32Array ? data : new Float32Array(data));
+  this.row = (this.row + 1) % this.history;
+  gl.viewport(0, 0, this.cv.width, this.cv.height);
+  gl.uniform1f(this.uMin, this.min);
+  gl.uniform1f(this.uMax, this.max);
+  gl.uniform1f(this.uOff, this.row / this.history);
+  gl.drawArrays(gl.TRIANGLE_STRIP, 0, 4);
+};
+/* canvas-2D waterfall (fallback + headless CI) — honors the same
+ * min/max/autorange contract as the GL path so a calibrated display renders
+ * identically with or without a GPU */
+FSDR.Waterfall2D = function (canvas, opts) {
+  opts = opts || {};
+  this.cv = canvas; this.ctx = canvas.getContext('2d');
+  this.autorange = opts.autorange !== false;
+  this.min = opts.min ?? 0; this.max = opts.max ?? 1;
+};
+FSDR.Waterfall2D.prototype.frame = function (data) {
   const cv = this.cv, ctx = this.ctx;
   ctx.drawImage(cv, 0, -1);
   const img = ctx.createImageData(cv.width, 1);
-  let lo = Infinity, hi = -Infinity;
-  for (const v of data) { if (v < lo) lo = v; if (v > hi) hi = v; }
+  let lo = this.min, hi = this.max;
+  if (this.autorange) {
+    lo = Infinity; hi = -Infinity;
+    for (const v of data) { if (v < lo) lo = v; if (v > hi) hi = v; }
+    this.min = this.min * 0.97 + lo * 0.03;
+    this.max = this.max * 0.97 + hi * 0.03;
+    lo = this.min; hi = this.max;
+  }
   const span = Math.max(hi - lo, 1e-9);
   for (let x = 0; x < cv.width; x++) {
     const i = Math.floor(x * data.length / cv.width);
@@ -297,9 +461,72 @@ FSDR.ConstellationSink.prototype.frame = function (iq) {
   for (let i = 0; i + 1 < iq.length; i += 2)
     ctx.fillRect(cv.width / 2 + iq[i] * s, cv.height / 2 - iq[i + 1] * s, 2, 2);
 };
-/* Density mode: 2D histogram with exponential decay + inferno-ish colormap
- * (`constellation_sink_density.rs` role). */
+/* Density mode: 2D histogram with exponential decay, rendered by the GPU
+ * (`constellation_sink_density.rs` role): the histogram lives in an R32F
+ * texture, the fragment shader normalizes by the peak, sqrt-eases for
+ * perceptual density, and samples the colormap LUT. Canvas-2D fallback kept
+ * for WebGL2-less environments. */
+FSDR.DENSITY_FRAG = [
+  '#version 300 es',
+  'precision highp float;',
+  'in vec2 uv;',
+  'uniform sampler2D field;',
+  'uniform sampler2D lut;',
+  'uniform float u_peak;',
+  'out vec4 rgba;',
+  'void main() {',
+  '  float h = texture(field, uv).r;',
+  '  float t = sqrt(clamp(h / u_peak, 0.0, 1.0));',
+  '  rgba = vec4(texture(lut, vec2(t, 0.5)).rgb, 1.0);',
+  '}',
+].join('\n');
 FSDR.ConstellationSinkDensity = function (canvas, opts) {
+  opts = opts || {};
+  this.cv = canvas;
+  const gl = FSDR.GL.context(canvas);
+  if (!gl || !gl.texImage2D) {           // delegate fully: no dead duplicate hist
+    this.fallback = new FSDR.ConstellationSinkDensity2D(canvas, opts);
+    return;
+  }
+  this.n = opts.bins || 128;
+  this.decay = opts.decay ?? 0.9;
+  this.hist = new Float32Array(this.n * this.n);
+  this.gl = gl;
+  this.prog = FSDR.GL.program(gl, FSDR.GL.VERT, FSDR.DENSITY_FRAG);
+  gl.useProgram(this.prog);
+  FSDR.GL.quad(gl, this.prog, 'pos');
+  this.tex = FSDR.GL.fieldTexture(gl, 0, this.n, this.n);
+  this.lut = FSDR.GL.lutTexture(gl, 1, opts.colormap);
+  gl.uniform1i(gl.getUniformLocation(this.prog, 'field'), 0);
+  gl.uniform1i(gl.getUniformLocation(this.prog, 'lut'), 1);
+  this.uPeak = gl.getUniformLocation(this.prog, 'u_peak');
+};
+FSDR.ConstellationSinkDensity.prototype.accumulate = function (iq) {
+  const n = this.n, h = this.hist;
+  for (let i = 0; i < h.length; i++) h[i] *= this.decay;
+  let peak = 1e-9;
+  for (let i = 0; i < iq.length; i++) peak = Math.max(peak, Math.abs(iq[i]));
+  const s = n / (2.2 * peak);
+  for (let i = 0; i + 1 < iq.length; i += 2) {
+    const x = Math.round(n / 2 + iq[i] * s), y = Math.round(n / 2 - iq[i + 1] * s);
+    if (x >= 0 && x < n && y >= 0 && y < n) h[y * n + x] += 1;
+  }
+  let hi = 1e-9;
+  for (let i = 0; i < h.length; i++) if (h[i] > hi) hi = h[i];
+  return hi;
+};
+FSDR.ConstellationSinkDensity.prototype.frame = function (iq) {
+  if (this.fallback) return this.fallback.frame(iq);
+  const gl = this.gl, peak = this.accumulate(iq);
+  gl.activeTexture(gl.TEXTURE0);
+  gl.texSubImage2D(gl.TEXTURE_2D, 0, 0, 0, this.n, this.n, gl.RED, gl.FLOAT,
+                   this.hist);
+  gl.viewport(0, 0, this.cv.width, this.cv.height);
+  gl.uniform1f(this.uPeak, peak);
+  gl.drawArrays(gl.TRIANGLE_STRIP, 0, 4);
+};
+/* canvas-2D density (fallback + headless CI) */
+FSDR.ConstellationSinkDensity2D = function (canvas, opts) {
   opts = opts || {};
   this.cv = canvas; this.ctx = canvas.getContext('2d');
   this.n = opts.bins || 128;
@@ -315,18 +542,10 @@ FSDR.ConstellationSinkDensity = function (canvas, opts) {
   this.offCtx = this.off.getContext('2d');
   this.img = this.offCtx.createImageData(this.n, this.n);
 };
-FSDR.ConstellationSinkDensity.prototype.frame = function (iq) {
-  const n = this.n, h = this.hist;
-  for (let i = 0; i < h.length; i++) h[i] *= this.decay;
-  let peak = 1e-9;
-  for (let i = 0; i < iq.length; i++) peak = Math.max(peak, Math.abs(iq[i]));
-  const s = n / (2.2 * peak);
-  for (let i = 0; i + 1 < iq.length; i += 2) {
-    const x = Math.round(n / 2 + iq[i] * s), y = Math.round(n / 2 - iq[i + 1] * s);
-    if (x >= 0 && x < n && y >= 0 && y < n) h[y * n + x] += 1;
-  }
-  let hi = 1e-9;
-  for (let i = 0; i < h.length; i++) if (h[i] > hi) hi = h[i];
+FSDR.ConstellationSinkDensity2D.prototype.accumulate =
+  FSDR.ConstellationSinkDensity.prototype.accumulate;
+FSDR.ConstellationSinkDensity2D.prototype.frame = function (iq) {
+  const n = this.n, h = this.hist, hi = this.accumulate(iq);
   const img = this.img;
   for (let i = 0; i < h.length; i++) {
     const t = Math.pow(h[i] / hi, 0.5);         // sqrt for perceptual density

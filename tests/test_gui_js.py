@@ -1,0 +1,137 @@
+"""CI coverage for gui/widgets.js (VERDICT r2 item 7 / weak 8).
+
+Two layers:
+- *structural validation* (always runs, no JS engine needed): brace balance
+  outside strings/comments, the full widget-export inventory, and GLSL
+  cross-checks — shader pairs share the vertex->fragment varying, every
+  declared uniform is used AND fetched from JS by the same name, `#version
+  300 es` leads each shader, outputs are written.
+- *execution smoke* (``tests/gui_smoke.js``): runs the widget code headless
+  under node with stub canvas/DOM — gated on a JS runtime being on PATH,
+  because this image ships none.
+"""
+
+import re
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+WIDGETS = Path(__file__).resolve().parent.parent / "futuresdr_tpu/gui/widgets.js"
+SRC = WIDGETS.read_text()
+
+EXPORTS = [
+    "Handle", "Pmt", "pollPeriodically", "callPeriodically",
+    "FlowgraphCanvas", "FlowgraphTable", "PmtEditor",
+    "Slider", "RadioSelector", "ListSelector",
+    "GL", "Waterfall", "Waterfall2D", "TimeSink",
+    "ConstellationSink", "ConstellationSinkDensity", "ConstellationSinkDensity2D",
+    "ArrayView",
+]
+
+
+def _strip(src: str) -> str:
+    """Remove comments and string/template literals (leaving brace-free stubs)."""
+    out, i, n = [], 0, len(src)
+    while i < n:
+        c = src[i]
+        if c == "/" and i + 1 < n and src[i + 1] == "*":
+            j = src.find("*/", i + 2)
+            i = (j + 2) if j != -1 else n
+        elif c == "/" and i + 1 < n and src[i + 1] == "/":
+            j = src.find("\n", i)
+            i = j if j != -1 else n
+        elif c in "'\"`":
+            q, j = c, i + 1
+            while j < n and src[j] != q:
+                j += 2 if src[j] == "\\" else 1
+            out.append("''")
+            i = j + 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def test_brace_balance():
+    stripped = _strip(SRC)
+    for o, c in ("{}", "()", "[]"):
+        assert stripped.count(o) == stripped.count(c), f"unbalanced {o}{c}"
+    # nesting never goes negative (catches transposed closers)
+    depth = 0
+    for ch in stripped:
+        depth += ch == "{"
+        depth -= ch == "}"
+        assert depth >= 0
+    assert depth == 0
+
+
+def test_widget_inventory_complete():
+    for name in EXPORTS:
+        assert re.search(rf"FSDR\.{re.escape(name)}\s*=", SRC), f"missing FSDR.{name}"
+    assert "module.exports = FSDR" in SRC
+
+
+def _shader(name: str) -> str:
+    """Extract a shader built as FSDR.NAME = [ '...', ... ].join('\\n')."""
+    m = re.search(rf"FSDR\.{name}\s*=\s*\[(.*?)\]\.join", SRC, re.S)
+    assert m, f"shader {name} not found"
+    lines = re.findall(r"'((?:[^'\\]|\\.)*)'", m.group(1))
+    return "\n".join(lines)
+
+
+@pytest.mark.parametrize("frag", ["WATERFALL_FRAG", "DENSITY_FRAG"])
+def test_glsl_structure(frag):
+    vert, f = _shader("GL.VERT"), _shader(frag)
+    for sh in (vert, f):
+        assert sh.splitlines()[0].strip() == "#version 300 es"
+        assert re.search(r"void\s+main\s*\(\s*\)", sh)
+    # vertex out == fragment in (the varying)
+    v_outs = set(re.findall(r"out\s+vec\d\s+(\w+)\s*;", vert))
+    f_ins = set(re.findall(r"in\s+vec\d\s+(\w+)\s*;", f))
+    assert v_outs == f_ins == {"uv"}
+    assert "gl_Position" in vert
+    # the fragment output is declared and written
+    f_out = re.findall(r"out\s+vec4\s+(\w+)\s*;", f)
+    assert len(f_out) == 1 and f"{f_out[0]} =" in f
+    # every declared uniform is used in the body
+    for u in re.findall(r"uniform\s+\w+\s+(\w+)\s*;", f):
+        body = f.split("void main()", 1)[1]
+        assert u in body, f"uniform {u} declared but unused in {frag}"
+
+
+@pytest.mark.parametrize("frag,widget", [("WATERFALL_FRAG", "Waterfall"),
+                                         ("DENSITY_FRAG", "ConstellationSinkDensity")])
+def test_js_uniforms_match_glsl(frag, widget):
+    """Every getUniformLocation(...) name in the widget's constructor exists in
+    its shader — a renamed uniform fails CI instead of silently returning null."""
+    f = _shader(frag)
+    declared = set(re.findall(r"uniform\s+\w+\s+(\w+)\s*;", f))
+    m = re.search(rf"FSDR\.{widget} = function(.*?)FSDR\.{widget}\.prototype",
+                  SRC, re.S)
+    assert m, widget
+    fetched = set(re.findall(r"getUniformLocation\([^,]+,\s*'(\w+)'\)", m.group(1)))
+    assert fetched <= declared, f"{widget} fetches unknown uniforms {fetched - declared}"
+    assert declared <= fetched, f"{widget} never binds uniforms {declared - fetched}"
+
+
+def test_gl_paths_guarded_by_fallback():
+    """Both GPU sinks construct a canvas-2D fallback when WebGL2 is missing."""
+    for widget in ("Waterfall", "ConstellationSinkDensity"):
+        m = re.search(rf"FSDR\.{widget} = function(.*?)FSDR\.{widget}\.prototype",
+                      SRC, re.S)
+        assert "this.fallback" in m.group(1), f"{widget} lacks a fallback"
+
+
+NODE = shutil.which("node") or shutil.which("nodejs")
+
+
+@pytest.mark.skipif(NODE is None, reason="no JS runtime in this image")
+def test_execution_smoke_under_node():
+    r = subprocess.run(
+        [NODE, str(Path(__file__).resolve().parent / "gui_smoke.js"), str(WIDGETS)],
+        capture_output=True, text=True, timeout=60)
+    sys.stdout.write(r.stdout)
+    assert r.returncode == 0, r.stdout + r.stderr
