@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["to_device", "to_host", "split_complex_platform"]
+__all__ = ["to_device", "to_host", "start_host_transfer", "split_complex_platform"]
 
 _join_jit = None
 _split_jit = None
@@ -80,12 +80,25 @@ def to_device(arr, device=None):
 
 def to_host(arr) -> np.ndarray:
     """``np.asarray`` that reads complex device arrays back as two float transfers."""
+    return start_host_transfer(arr)()
+
+
+def start_host_transfer(arr):
+    """Begin a NON-blocking D2H of ``arr``; returns a zero-arg ``finish()`` that
+    blocks until the copy lands and yields the numpy array.
+
+    This is how a drain loop overlaps transfers: start transfers for every
+    completed frame first, then finish them oldest-first — frame t+1's D2H rides
+    the wire while the caller is still consuming frame t (the role of the
+    reference's circulating empty/full staging buffers, ``buffer/vulkan/d2h.rs``).
+    :func:`to_host` is this with an immediate finish; all complex-pair-shim and
+    platform logic lives here, once."""
     import jax
 
     if not isinstance(arr, jax.Array):
         # host data: the jitted split() would device_put the raw complex array —
         # the exact broken path this shim avoids
-        return np.asarray(arr)
+        return lambda: np.asarray(arr)
     dt = np.dtype(getattr(arr, "dtype", np.float32))
     if np.issubdtype(dt, np.complexfloating):
         try:
@@ -95,9 +108,18 @@ def to_host(arr) -> np.ndarray:
             platform = _device_platform()
         if split_complex_platform(platform):
             _, split = _jits()
-            r, i = split(arr)
-            out = np.empty(arr.shape, dtype=dt)
-            out.real = np.asarray(r)
-            out.imag = np.asarray(i)
-            return out
-    return np.asarray(arr)
+            r, i = split(arr)                    # async device-side split
+            for part in (r, i):
+                if hasattr(part, "copy_to_host_async"):
+                    part.copy_to_host_async()
+
+            def finish(r=r, i=i):
+                out = np.empty(r.shape, dtype=dt)
+                out.real = np.asarray(r)
+                out.imag = np.asarray(i)
+                return out
+
+            return finish
+    if hasattr(arr, "copy_to_host_async"):
+        arr.copy_to_host_async()
+    return lambda: np.asarray(arr)

@@ -126,6 +126,7 @@ class Flowgraph:
                 kernels.append(it)
             else:
                 raise ConnectError(f"cannot connect {it!r}")
+        from .buffer.circuit import InplaceInput, InplaceOutput
         for a, b in zip(kernels, kernels[1:]):
             out = a.stream_outputs
             inp = b.stream_inputs
@@ -133,7 +134,18 @@ class Flowgraph:
                 raise ConnectError(f"{a!r} has no stream outputs")
             if not inp:
                 raise ConnectError(f"{b!r} has no stream inputs")
-            self.connect_stream(a, out[0].name, b, inp[0].name)
+            # dispatch on port kind: inplace (frame-plane) edges need the circuit
+            # wiring — a silent stream edge over them deadlocks the graph
+            o_inpl = isinstance(out[0], InplaceOutput)
+            i_inpl = isinstance(inp[0], InplaceInput)
+            if o_inpl and i_inpl:
+                self.connect_inplace(a, out[0].name, b, inp[0].name)
+            elif o_inpl or i_inpl:
+                raise ConnectError(
+                    f"port kind mismatch: {a!r}.{out[0].name} -> {b!r}.{inp[0].name} "
+                    f"connects an inplace port to a stream port")
+            else:
+                self.connect_stream(a, out[0].name, b, inp[0].name)
 
     def connect_stream(self, src: Kernel, src_port: str, dst: Kernel, dst_port: str,
                        buffer: Optional[type] = None,
@@ -149,6 +161,12 @@ class Flowgraph:
         self.add(dst)
         op = src.stream_output(src_port)   # raises on bad name
         ip = dst.stream_input(dst_port)
+        from .buffer.circuit import InplaceInput, InplaceOutput
+        if isinstance(op, InplaceOutput) or isinstance(ip, InplaceInput):
+            raise ConnectError(
+                f"{src!r}.{src_port} -> {dst!r}.{dst_port} involves an inplace "
+                f"(frame-plane) port; use connect_inplace (or plain connect, "
+                f"which dispatches on port kind)")
         if op.dtype is not None and ip.dtype is not None and op.dtype != ip.dtype:
             raise ConnectError(
                 f"dtype mismatch: {src!r}.{src_port} is {op.dtype}, {dst!r}.{dst_port} is {ip.dtype}")

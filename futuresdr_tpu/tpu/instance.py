@@ -75,6 +75,12 @@ class TpuInstance:
         from ..ops.xfer import to_host
         return to_host(arr)
 
+    def get_async(self, arr):
+        """Start a non-blocking D2H; returns ``finish() -> np.ndarray`` (see
+        ``ops/xfer.start_host_transfer`` — lets drains overlap transfers)."""
+        from ..ops.xfer import start_host_transfer
+        return start_host_transfer(arr)
+
 
 _instance: Optional[TpuInstance] = None
 _lock = threading.Lock()

@@ -129,15 +129,19 @@ class TpuKernel(Kernel):
         rebased by the rate contract here, at dispatch time."""
         x = self.inst.put(frame)
         self._carry, y = self._compiled(self._carry, x)
+        # start the D2H immediately: copy_to_host_async enqueues behind the
+        # compute, so the transfer rides the wire the moment the frame finishes
+        # instead of waiting for _drain_one's sync (read-ahead, VERDICT r2 weak 2)
+        finish = self.inst.get_async(y)
         valid_out = min(self.pipeline.out_items(valid_in), self.out_frame)
-        self._inflight.append((y, valid_out,
+        self._inflight.append((finish, valid_out,
                                tuple(rebase_frame_tags(tags, self.pipeline,
                                                        valid_out))))
         self._frames_dispatched += 1
 
     def _drain_one(self) -> Tuple[np.ndarray, tuple]:
-        y, valid, tags = self._inflight.popleft()
-        arr = self.inst.get(y)    # sync point: blocks only this block's thread
+        finish, valid, tags = self._inflight.popleft()
+        arr = finish()            # sync point: blocks only this block's thread
         return arr[:valid], tags
 
     async def work(self, io, mio, meta):

@@ -56,3 +56,31 @@ def test_frame_pipeline_spectrum():
     spec = snk.items()
     assert len(spec) == 65536
     assert np.argmax(spec[:n_fft]) == round(0.2 * n_fft)
+
+
+def test_plain_connect_dispatches_inplace_edges():
+    """fg.connect() must wire frame-plane (inplace) edges through the circuit
+    path — it used to create silent stream edges over them, deadlocking the
+    graph — and must reject a stream<->inplace port mix loudly."""
+    import pytest
+    from futuresdr_tpu.runtime.flowgraph import ConnectError
+
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    data = np.random.default_rng(1).standard_normal(65536).astype(np.float32)
+    fg = Flowgraph()
+    src, snk = VectorSource(data), VectorSink(np.float32)
+    h2d = TpuH2D(np.float32, frame_size=16384)
+    st = TpuStage([fir_stage(taps, fft_len=1024)], np.float32)
+    d2h = TpuD2H(np.float32)
+    fg.connect(src, h2d, st, d2h, snk)          # mixed chain, one call
+    assert len(fg.inplace_edges) == 2 and len(fg.stream_edges) == 2
+    Runtime().run(fg)
+    got = snk.items()
+    assert len(got) == 65536
+    np.testing.assert_allclose(got[:1000], np.convolve(data, taps)[:1000],
+                               rtol=1e-3, atol=1e-4)
+
+    fg2 = Flowgraph()
+    with pytest.raises(ConnectError, match="inplace"):
+        fg2.connect_stream(TpuH2D(np.float32, frame_size=1024), "out",
+                           VectorSink(np.float32), "in")
