@@ -55,12 +55,18 @@ for k, s in _sts_idx.items():
 STS_FREQ = _sts
 
 
-def _freq_to_time(freq_m26_26: np.ndarray) -> np.ndarray:
-    """Map subcarriers -26..26 into a 64-bin spectrum and IFFT (one symbol)."""
+def carriers_to_grid(freq_m26_26: np.ndarray) -> np.ndarray:
+    """Map subcarriers -26..26 onto the 64-bin fft grid (THE grid convention —
+    every consumer of a -26..26 sequence must route through here)."""
     spec = np.zeros(FFT_SIZE, dtype=np.complex128)
     for i, k in enumerate(range(-26, 27)):
         spec[k % FFT_SIZE] = freq_m26_26[i]
-    return np.fft.ifft(spec)
+    return spec
+
+
+def _freq_to_time(freq_m26_26: np.ndarray) -> np.ndarray:
+    """Map subcarriers -26..26 into a 64-bin spectrum and IFFT (one symbol)."""
+    return np.fft.ifft(carriers_to_grid(freq_m26_26))
 
 
 def sts_time() -> np.ndarray:

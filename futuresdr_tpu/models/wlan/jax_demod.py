@@ -1,10 +1,12 @@
-"""Jitted OFDM body demodulation: CFO → batched FFT → equalize → CPE → max-log demap.
+"""Jitted OFDM demodulation: CFO → batched FFT → equalize → CPE → max-log demap.
 
-Completes the XLA residency of the WLAN RX hot path (detection and SIGNAL stay host-side;
-Viterbi already runs as a lax.scan): all data symbols of a frame demap in one jit call,
-bucketed by symbol count and cached per modulation. Constant tables (constellation,
-carrier indices) are passed as device arguments rather than embedded constants (the axon
-backend mis-compiles some large embedded constants).
+XLA residency of the WLAN RX hot path (only packet detection stays host-side;
+Viterbi already runs as a lax.scan): the frame HEAD (LTS channel estimate +
+SIGNAL demap) is one jit call, all data symbols of a frame demap in another,
+bucketed by symbol count and cached per modulation. Constant tables
+(constellation, carrier indices, LTS reference) are passed as device arguments
+rather than embedded constants (the axon backend mis-compiles some large
+embedded constants).
 """
 
 from __future__ import annotations
@@ -13,10 +15,10 @@ from functools import lru_cache
 
 import numpy as np
 
-from .consts import (CP_LEN, DATA_CARRIERS, FFT_SIZE, MODULATION_TABLES,
+from .consts import (CP_LEN, DATA_CARRIERS, FFT_SIZE, LTS_FREQ, MODULATION_TABLES,
                      PILOT_CARRIERS, PILOT_VALUES, SYM_LEN)
 
-__all__ = ["demod_body_jax"]
+__all__ = ["demod_body_jax", "demod_head_jax"]
 
 _DATA_IDX = (DATA_CARRIERS % FFT_SIZE).astype(np.int32)
 _PIL_IDX = (PILOT_CARRIERS % FFT_SIZE).astype(np.int32)
@@ -59,6 +61,54 @@ def _compiled(modulation: str, bucket: int):
 
     consts = (table, _DATA_IDX, _PIL_IDX, one_masks)
     return run, consts
+
+
+@lru_cache(maxsize=None)
+def _compiled_head():
+    import jax
+    import jax.numpy as jnp
+
+    # LTS reference spectrum on the fft grid + the used-carrier mask, host-built
+    from .consts import carriers_to_grid
+    ref = carriers_to_grid(LTS_FREQ).astype(np.complex64)
+    used = (ref != 0)
+    ref_safe = np.where(used, ref, 1.0).astype(np.complex64)
+
+    @jax.jit
+    def run(head, cfo, ref_c, used_c, pil_idx, data_idx):
+        # head = [208] raw samples from lts_start (2x LTS, then SIGNAL with CP),
+        # CFO applied in-trace with phase reference 0 at lts_start — the same
+        # convention demod_body_jax uses via its phase0 argument
+        k = jnp.arange(head.shape[0])
+        x = head * jnp.exp(-1j * cfo * k)
+        s1 = jnp.fft.fft(x[0:64])
+        s2 = jnp.fft.fft(x[64:128])
+        avg = (s1 + s2) * 0.5
+        H = jnp.where(used_c, avg / ref_c, 1.0 + 0j)
+        spec = jnp.fft.fft(x[128 + CP_LEN:128 + SYM_LEN])
+        eq = spec / H
+        pilots = eq[pil_idx]
+        # SIGNAL symbol: pilot polarity index 0 => +1 on all four pilots
+        expected = jnp.asarray(PILOT_VALUES.astype(np.complex64))
+        cpe = jnp.angle((pilots * jnp.conj(expected)).sum())
+        eq = eq * jnp.exp(-1j * cpe)
+        llrs = 4.0 * eq[data_idx].real          # BPSK max-log, closed form
+        return H, llrs.astype(jnp.float32)
+
+    return run, (ref_safe, used, _PIL_IDX, _DATA_IDX)
+
+
+def demod_head_jax(head: np.ndarray, cfo: float):
+    """LTS channel estimate + SIGNAL-symbol LLRs in ONE jit call.
+
+    ``head``: the 208 raw samples from ``lts_start`` (two LTS symbols + the
+    SIGNAL symbol with CP), WITHOUT host-side CFO correction. Returns
+    ``(H[64] complex64 ndarray, llrs[48] float32 ndarray)`` matching the host
+    path (``ofdm.estimate_channel`` + ``ofdm.equalize`` + BPSK demap)."""
+    run, consts = _compiled_head()
+    H, llrs = run(np.asarray(head[:208], dtype=np.complex64), np.float32(cfo),
+                  *consts)
+    return np.asarray(H), np.asarray(llrs)
 
 
 def demod_body_jax(body: np.ndarray, H: np.ndarray, n_sym: int, symbol_offset: int,

@@ -155,9 +155,8 @@ def estimate_channel(samples: np.ndarray, lts_start: int) -> np.ndarray:
     """Average the two LTS symbols and divide by the known sequence → H[64]."""
     s1 = np.fft.fft(samples[lts_start:lts_start + 64])
     s2 = np.fft.fft(samples[lts_start + 64:lts_start + 128])
-    ref = np.zeros(FFT_SIZE, dtype=np.complex128)
-    for i, k in enumerate(range(-26, 27)):
-        ref[k % FFT_SIZE] = LTS_FREQ[i]
+    from .consts import carriers_to_grid
+    ref = carriers_to_grid(LTS_FREQ)
     avg = (s1 + s2) / 2.0
     H = np.ones(FFT_SIZE, dtype=np.complex128)
     used = ref != 0

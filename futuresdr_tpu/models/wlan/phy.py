@@ -134,12 +134,24 @@ def _prepare_frame(samples: np.ndarray, lts_start: int, cfo: float):
     if data_start + SYM_LEN > len(samples):
         return None
     head = samples[lts_start:data_start + SYM_LEN]
-    if cfo != 0.0:
-        head = head * np.exp(-1j * cfo * np.arange(len(head)))
-    H = ofdm.estimate_channel(head, 0)
-    spec = ofdm.ofdm_demodulate_symbols(head[128:], 1)
-    eq = ofdm.equalize(spec, H, symbol_offset=0)
-    sig_llrs = ofdm.demap_llrs(eq.reshape(-1), "bpsk")
+    use_jax = False
+    try:
+        from ...ops.viterbi import backend_ready
+        use_jax = backend_ready()
+    except Exception:       # pragma: no cover
+        pass
+    if use_jax:
+        # channel estimate + SIGNAL demap in one jit call (XLA residency of the
+        # frame head; CFO applied in-trace with the lts_start phase reference)
+        from .jax_demod import demod_head_jax
+        H, sig_llrs = demod_head_jax(head, cfo)
+    else:
+        if cfo != 0.0:
+            head = head * np.exp(-1j * cfo * np.arange(len(head)))
+        H = ofdm.estimate_channel(head, 0)
+        spec = ofdm.ofdm_demodulate_symbols(head[128:], 1)
+        eq = ofdm.equalize(spec, H, symbol_offset=0)
+        sig_llrs = ofdm.demap_llrs(eq.reshape(-1), "bpsk")
     sig_bits = coding.viterbi_decode(coding.deinterleave(sig_llrs, 48, 1), 24)
     parsed = _parse_signal(sig_bits)
     if parsed is None:
@@ -152,14 +164,7 @@ def _prepare_frame(samples: np.ndarray, lts_start: int, cfo: float):
         return None
     off = data_start + SYM_LEN
     body = samples[off:off + n_sym * SYM_LEN]
-    use_jax = False
-    if n_sym >= 8:
-        try:
-            from ...ops.viterbi import backend_ready
-            use_jax = backend_ready()
-        except Exception:       # pragma: no cover
-            pass
-    if use_jax:
+    if use_jax and n_sym >= 8:
         # the whole body demod (CFO, batched FFT, equalize, CPE, demap) in one jit
         from .jax_demod import demod_body_jax
         llrs = demod_body_jax(body, H, n_sym, 1, cfo, off - lts_start, mcs.modulation)
