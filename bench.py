@@ -199,7 +199,8 @@ def run_device_resident(frame_sizes=(1 << 18, 1 << 19, 1 << 20),
         # the tunnel's ~100 ms dispatch latency needs hundreds of frames per scan to
         # amortize; the CPU backend dispatches in µs, so short scans keep the
         # fallback bench under a minute
-        k_pair = (512, 1024) if inst_.platform == "tpu" else (8, 16)
+        from futuresdr_tpu.utils.measure import default_k_pair
+        k_pair = default_k_pair(inst_.platform)
     rng = np.random.default_rng(7)
     best_rate, best_frame = 0.0, frame_sizes[0]
 
@@ -238,6 +239,65 @@ def run_streamed(n_samples: int, frame_size: int, depth: int = 8) -> float:
     return n_samples / dt / 1e6
 
 
+_CHAINS = ("fm", "wlan", "lora")        # keys: <name>_msps (input Msamples/s)
+
+
+def _run_chain_child(name: str) -> None:
+    """Child mode (``--run-chain``): measure ONE BASELINE chain and print its rate.
+    Runs in its own process so a wedged tunnel RPC can be killed from outside —
+    an in-process alarm cannot interrupt a blocked C++ call."""
+    import importlib.util
+    from pathlib import Path
+
+    from futuresdr_tpu.utils.measure import default_k_pair
+
+    path = Path(__file__).resolve().parent / "perf" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(f"perf_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    k_pair = default_k_pair(instance().platform)
+    if name == "fm":
+        rate = mod.run_device_resident(1024, k_pair)[0]
+    elif name == "wlan":
+        rate = mod.run_device_resident(128, "qam16", k_pair)[0]
+    else:                                   # lora: SF7 = the BASELINE #5 config
+        rate = mod.run_device_resident(7, 64, k_pair)[0]
+    print(f"CHAIN_RATE {rate}")
+
+
+def run_baseline_chains() -> dict:
+    """BASELINE targets #3/#4/#5 as device-resident scan-marginal rates, reusing the
+    perf/ harnesses' own chain constructions (perf/fm.py, perf/wlan.py, perf/lora.py)
+    so the driver-captured artifact carries the on-chip numbers for the FM front end,
+    the WLAN demod hot loop, and the LoRa dechirp — not just the headline chain.
+
+    Each chain runs in a SUBPROCESS with a hard timeout (same isolation as
+    ``_probe_tpu_once``): a half-alive tunnel wedging one chain is killed from
+    outside and becomes an "<key>_error" note — never a dead bench with no JSON."""
+    import re
+
+    out = {}
+    budget = float(os.environ.get("FSDR_BENCH_CHAIN_TIMEOUT", "300"))
+    for name in _CHAINS:
+        key = f"{name}_msps"
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run-chain", name],
+                timeout=budget, capture_output=True, text=True,
+                env=dict(os.environ, FSDR_BENCH_PROBED="1"))
+            m = re.search(r"CHAIN_RATE ([0-9.eE+-]+)", r.stdout)
+            if r.returncode == 0 and m:
+                out[key] = round(float(m.group(1)), 1)
+            else:
+                out[f"{key}_error"] = (r.stderr.strip() or r.stdout.strip())[-160:]
+        except subprocess.TimeoutExpired:
+            out[f"{key}_error"] = f"timeout after {budget:.0f}s"
+        print(f"# baseline chain {name}: {out.get(key, 'FAILED')} "
+              f"({time.perf_counter() - t0:.0f}s)", file=sys.stderr)
+    return out
+
+
 def main():
     import argparse
     p = argparse.ArgumentParser()
@@ -248,7 +308,15 @@ def main():
     p.add_argument("--depth", type=int, default=8)
     p.add_argument("--autotune", action="store_true",
                    help="compat alias: the frame sweep now runs by default")
+    p.add_argument("--skip-extra-chains", action="store_true",
+                   help="measure only the headline chain")
+    p.add_argument("--run-chain", choices=_CHAINS, default=None,
+                   help="internal child mode: measure one BASELINE chain and exit")
     args = p.parse_args()
+
+    if args.run_chain:
+        _run_chain_child(args.run_chain)
+        return
 
     inst_ = instance()
     cpu_rate = run_cpu(args.cpu_samples)
@@ -278,6 +346,9 @@ def main():
         "streamed_vs_baseline": round(stream_rate / cpu_rate, 2),
         "frame": best_frame,
     }
+    if not args.skip_extra_chains:
+        # on-chip evidence for BASELINE #3/#4/#5 rides the same driver artifact
+        result.update(run_baseline_chains())
     print(json.dumps(result))
 
 

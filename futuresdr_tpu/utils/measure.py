@@ -83,6 +83,14 @@ def run_marginal(step: Callable, carry0, x, k_pair: Tuple[int, int] = (512, 1024
     return (k_hi - k_lo) * int(np.prod(np.shape(x))) / (times[k_hi] - times[k_lo])
 
 
+def default_k_pair(platform: str) -> Tuple[int, int]:
+    """Scan-length pair for the marginal methodology: hundreds of frames per scan
+    amortize the tunnel's ~100 ms dispatch latency on TPU; the CPU backend
+    dispatches in µs, so short scans keep fallback runs fast. THE single source of
+    these constants — bench.py and every perf/ harness route through here."""
+    return (512, 1024) if platform == "tpu" else (8, 16)
+
+
 def run_marginal_retry(step: Callable, carry0, x,
                        k_pair: Tuple[int, int] = (512, 1024),
                        attempts: int = 3, grow: int = 2) -> float:

@@ -78,7 +78,8 @@ def main():
         print(f"cpu_blocks,{backend},-,{r},{rate:.2f}", flush=True)
 
     if a.device_resident:
-        k_pair = (512, 1024) if backend == "tpu" else (8, 16)
+        from futuresdr_tpu.utils.measure import default_k_pair
+        k_pair = default_k_pair(backend)
         for r in range(a.runs):
             rate, frame = run_device_resident(a.frame_frames, k_pair)
             print(f"device_resident,{backend},{frame},{r},{rate:.1f}", flush=True)

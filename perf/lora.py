@@ -54,7 +54,8 @@ def main():
         from futuresdr_tpu.utils.backend import ensure_backend
         backend = ensure_backend()
         print(f"# backend: {backend}", file=sys.stderr)
-        k_pair = (512, 1024) if backend == "tpu" else (8, 16)
+        from futuresdr_tpu.utils.measure import default_k_pair
+        k_pair = default_k_pair(backend)
         print("mode,backend,sf,frame,run,msamples_per_sec")
         for r in range(a.runs):
             rate, frame = run_device_resident(a.sf, a.symbols_per_frame, k_pair)

@@ -73,7 +73,8 @@ def main():
         print(f"# backend: {backend}", file=sys.stderr)
         from futuresdr_tpu.models.wlan.consts import MCS_TABLE
         modulation = MCS_TABLE[a.mcs].modulation
-        k_pair = (512, 1024) if backend == "tpu" else (8, 16)
+        from futuresdr_tpu.utils.measure import default_k_pair
+        k_pair = default_k_pair(backend)
         print("mode,backend,modulation,frame,run,msamples_per_sec")
         for r in range(a.runs):
             rate, frame = run_device_resident(a.bucket, modulation, k_pair)
