@@ -68,13 +68,18 @@ class ZigbeeTransmitter(Kernel):
 class ZigbeeReceiver(Kernel):
     """Baseband stream → validated payloads on ``rx``."""
 
-    def __init__(self, chunk: int = 1 << 15):
+    def __init__(self, chunk: Optional[int] = None, timing: str = "phase"):
         super().__init__()
         self.OVERLAP = 160 * 8 * SAMPLES_PER_CHIP
         self.frames = []
+        self.timing = timing        # "phase" | "mm" | "coherent" (phy.demodulate_stream)
+        # coherent mode amortizes its FFT correlation + overlap over big chunks:
+        # 256k chunks run ~7.9 Msps vs 4.2 at 32k (real-time at 2 Mchip/s x 4 sps)
+        self.chunk = chunk or ((1 << 18) if timing == "coherent" else 1024)
         self._tail = np.zeros(0, np.complex64)
         self._seen_payloads: Deque[bytes] = deque(maxlen=16)
-        self.input = self.add_stream_input("in", np.complex64, min_items=1024)
+        self.input = self.add_stream_input("in", np.complex64,
+                                           min_items=self.chunk)
         self.add_message_output("rx")
 
     async def work(self, io, mio, meta):
@@ -85,7 +90,7 @@ class ZigbeeReceiver(Kernel):
                 io.finished = True
             return
         buf = np.concatenate([self._tail, inp[:n]])
-        for psdu in demodulate_stream(buf):
+        for psdu in demodulate_stream(buf, timing=self.timing):
             payload = mac_deframe(psdu)
             if payload is None or psdu in self._seen_payloads:
                 continue

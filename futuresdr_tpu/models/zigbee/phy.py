@@ -188,6 +188,134 @@ def _scan_soft_chips(soft: np.ndarray, frames: List[bytes]) -> None:
     return
 
 
+_PM_CHIPS = (CHIP_SEQUENCES.astype(np.float64) * 2 - 1)      # ±1 chip tables
+
+
+def _shr_template(sps_chip: int = SAMPLES_PER_CHIP) -> np.ndarray:
+    """Complex baseband of the SHR (8 zero preamble nibbles + SFD 0xA7)."""
+    nibs = [0] * 8 + [0x7, 0xA]
+    chips = np.concatenate([CHIP_SEQUENCES[n] for n in nibs])
+    return _oqpsk_modulate(chips, sps_chip)[:len(chips) * sps_chip]
+
+
+def demodulate_coherent(samples: np.ndarray,
+                        sps_chip: int = SAMPLES_PER_CHIP) -> List[bytes]:
+    """Coherent O-QPSK RX — beyond the reference's discriminator architecture.
+
+    Burst-synchronized matched reception: complex cross-correlation against the
+    known SHR gives sample timing; the correlation split in halves gives CFO
+    (phase slope) and absolute carrier phase, so chips are COHERENT I/Q decisions
+    at the half-sine pulse peaks (no ISI there by construction) despread against
+    the ±1 PN tables — worth ~2-3 dB of sensitivity over the discriminator path,
+    which squares the noise.
+    """
+    tmpl = _shr_template(sps_chip)
+    L = len(tmpl)
+    if len(samples) < L + 64 * sps_chip:
+        return []
+    # CFO decoheres a full-length complex correlation (5 rad across the SHR at
+    # 0.004 rad/sample), so DETECTION combines four template segments
+    # non-coherently; the segment phase slope then estimates CFO with a pull-in
+    # range of ±pi/(L/4) rad/sample. Beyond that range use the discriminator
+    # paths, which are CFO-insensitive by construction.
+    n_seg = 4
+    seg = L // n_seg
+    segs = [tmpl[k * seg:(k + 1) * seg].astype(np.complex64) for k in range(n_seg)]
+    n_lag = len(samples) - L + 1
+    m_lag = (n_lag + 1) // 2
+    # FFT overlap-add correlation at complex64, EVEN lags only via the polyphase
+    # split (corr[2m] = conv(x_even, t_even) + conv(x_odd, t_odd)) — the
+    # time-domain form is O(N·L) and falls below the 8 Msps stream rate
+    # (2 Mchip/s × 4 sps) with four 320-tap segments, and a one-sample timing
+    # offset from stride-2 detection costs <2% at the half-sine peak
+    from scipy.signal import oaconvolve
+
+    def corr_even(k):
+        y = samples[k * seg:k * seg + n_lag + seg - 1]
+        t = segs[k]
+        ye, yo = y[0::2], y[1::2]
+        te, to = np.conj(t[0::2][::-1]), np.conj(t[1::2][::-1])
+        a = oaconvolve(ye[:m_lag + len(te) - 1], te, mode="valid")[:m_lag]
+        b = oaconvolve(yo[:m_lag + len(to) - 1], to, mode="valid")[:m_lag]
+        n = min(len(a), len(b), m_lag)
+        return a[:n] + b[:n]
+
+    cs0 = [corr_even(k) for k in range(n_seg)]
+    m_lag = min(len(c) for c in cs0)
+    seg_corr = np.stack([c[:m_lag] for c in cs0])             # [n_seg, m_lag]
+    e_t = float(np.sum(np.abs(tmpl) ** 2))
+    p = np.concatenate([[0.0], np.cumsum(np.abs(samples) ** 2)])
+    e_x = (p[L:] - p[:-L])[0::2][:m_lag]
+    metric = np.abs(seg_corr).sum(axis=0) / np.sqrt(np.maximum(e_x * e_t, 1e-12))
+    # energy gate (as in detect_packets): windows with ~no power can't host a
+    # burst — without it, FFT numerical noise over silent spans divided by the
+    # tiny denominator floor reads as ~10^6 false candidates
+    floor = 1e-4 * float(e_x.max()) if len(e_x) else 0.0
+    metric = np.where(e_x > floor, metric, 0.0)
+    cand = np.flatnonzero(metric > 0.5)
+    frames: List[bytes] = []
+    T = 2 * sps_chip
+    next_free = -1
+    sym_len_e = 16 * sps_chip           # one symbol in even-lag units
+
+    def chips_at(i: int, cfo: float, n_win: int):
+        """Derotate ``n_win`` samples from lag ``i`` and slice the coherent chip
+        decisions at the half-sine pulse peaks (I at kT+T/2, the half-chip-
+        delayed Q at kT+T — abutting half-sines make the peak sample ISI-free)."""
+        k = np.arange(n_win)
+        x = samples[i:i + n_win] * np.exp(-1j * cfo * k)
+        ph = np.angle(np.vdot(tmpl, x[:L]))      # residual carrier phase
+        x = x * np.exp(-1j * ph)
+        # pair k needs samples kT+T/2 (I) and kT+T (Q): max k with kT+T <= n_win-1
+        n_pairs = (n_win - 1) // T
+        soft = np.empty(2 * n_pairs)
+        soft[0::2] = np.sign(x.real[(np.arange(n_pairs) * T) + T // 2])
+        soft[1::2] = np.sign(x.imag[(np.arange(n_pairs) * T) + T])
+        return soft
+
+    for m in cand:
+        if m < next_free:
+            continue
+        # refine across 5 symbols: the 8x-repeated zero-symbol preamble puts
+        # correlation sidelobes above threshold up to ~4 symbols BEFORE the true
+        # peak, and a symbol-aligned mislock despreads VALID PN nibbles into
+        # consistent garbage — the (strictly larger) main peak must win
+        hi = min(len(metric), m + 5 * sym_len_e)
+        m = int(m + np.argmax(metric[m:hi]))
+        # collapse the sidelobe cluster: every candidate before this refined peak
+        # lands on the same window — one check, not hundreds of expensive ones
+        next_free = max(next_free, m + 1)
+        i = 2 * m                       # sample-domain lag of the refined peak
+        cs = seg_corr[:, m]
+        if np.min(np.abs(cs)) < 1e-9:
+            continue
+        # phase advances cfo·seg between successive segments
+        cfo = float(np.angle(np.sum(cs[1:] * np.conj(cs[:-1])))) / seg
+        if len(samples) - i < L + T:
+            continue
+        # cheap structural lock check FIRST, on the SHR span only: the despread
+        # SFD (chips 256..320) must read the nibbles 0x7, 0xA — a symbol-aligned
+        # mislock reads preamble zeros there and is rejected before paying for
+        # the full-burst derotation
+        head = chips_at(i, cfo, L + T)
+        sfd = [int(np.argmax(_PM_CHIPS @ head[p:p + 32]))
+               for p in (256, 288) if len(head) >= p + 32]
+        if sfd != [0x7, 0xA]:
+            continue
+        # burst window: SHR + length byte + max PSDU (127 B = 254 nibbles)
+        n_win = min(len(samples) - i, (10 + 2 + 254) * 32 * sps_chip + T)
+        soft = chips_at(i, cfo, n_win)
+        # chip 0 of the burst is at sample 0; SHR spans 10 nibbles = 320 chips
+        psdu = _despread_from(soft, 320, tables=_PM_CHIPS, skip_boundary=False)
+        if psdu is not None:
+            # advance past the burst even for a duplicate payload — otherwise
+            # every above-threshold lag inside it re-refines and re-despreads
+            next_free = (i + (10 + 2 + 2 * len(psdu)) * 32 * sps_chip) // 2
+            if psdu not in frames:
+                frames.append(psdu)
+    return frames
+
+
 def demodulate_stream(samples: np.ndarray, sps_chip: int = SAMPLES_PER_CHIP,
                       timing: str = "phase") -> List[bytes]:
     """Full RX (`demodulator.rs` role): quadrature discriminator → chip timing →
@@ -195,8 +323,12 @@ def demodulate_stream(samples: np.ndarray, sps_chip: int = SAMPLES_PER_CHIP,
 
     ``timing``: "phase" (default) — fully vectorized: boxcar matched filter, then try
     every integer sample phase at chip rate (sps small) and dedup; "mm" — the adaptive
-    Mueller-Müller loop (`clock_recovery_mm.rs`), for drifting clocks.
+    Mueller-Müller loop (`clock_recovery_mm.rs`), for drifting clocks; "coherent" —
+    burst-synchronized coherent matched reception (:func:`demodulate_coherent`),
+    ~2-3 dB more sensitive than the discriminator paths.
     """
+    if timing == "coherent":
+        return demodulate_coherent(samples, sps_chip)
     if len(samples) < 64 * sps_chip:
         return []
     d = samples[1:] * np.conj(samples[:-1])
@@ -215,15 +347,25 @@ def demodulate_stream(samples: np.ndarray, sps_chip: int = SAMPLES_PER_CHIP,
     return frames
 
 
-def _despread_from(soft: np.ndarray, start: int) -> Optional[bytes]:
+def _despread_from(soft: np.ndarray, start: int, tables: Optional[np.ndarray] = None,
+                   skip_boundary: bool = True) -> Optional[bytes]:
+    if tables is None:
+        tables = _FREQ_TEMPLATES
+
     def nibble_at(pos: int) -> Optional[int]:
         seg = soft[pos:pos + 32]
         if len(seg) < 32:
             return None
-        # skip the boundary chip (depends on the previous symbol's last chip)
-        scores = _FREQ_TEMPLATES[:, 1:] @ seg[1:]
+        if skip_boundary:
+            # skip the boundary chip (depends on the previous symbol's last chip —
+            # a discriminator-domain artifact; coherent chips have no such memory)
+            scores = tables[:, 1:] @ seg[1:]
+            full = 31
+        else:
+            scores = tables @ seg
+            full = 32
         best = int(np.argmax(scores))
-        if scores[best] < 31 - 2 * 6:        # ≤6 chip errors tolerated
+        if scores[best] < full - 2 * 6:      # ≤6 chip errors tolerated
             return None
         return best
 
