@@ -10,8 +10,9 @@ training for the in-flowgraph ML path.
 from .mesh import make_mesh, factor_devices, shard_params, P, NamedSharding
 from .stream_sp import (sp_fir, sp_fir_fft_mag2, sp_fir_stream,
                         sp_fir_fft_mag2_stream, sp_channelizer, sp_channelizer_a2a)
+from .pipeline_pp import make_pp_pipeline
 from . import multihost
 
 __all__ = ["make_mesh", "factor_devices", "shard_params", "P", "NamedSharding",
            "sp_fir", "sp_fir_fft_mag2", "sp_fir_stream", "sp_fir_fft_mag2_stream",
-           "sp_channelizer", "sp_channelizer_a2a", "multihost"]
+           "sp_channelizer", "sp_channelizer_a2a", "make_pp_pipeline", "multihost"]
