@@ -30,12 +30,40 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..log import logger
 from ..ops.stages import Pipeline, Stage
-from ..runtime.kernel import Kernel
+from ..runtime.kernel import Kernel, message_handler
 from ..runtime.tag import ItemTag, rebase_tags
+from ..types import Pmt
 from .instance import TpuInstance, instance
 
-__all__ = ["TpuH2D", "TpuStage", "TpuD2H", "rebase_frame_tags", "emit_with_tags"]
+__all__ = ["TpuH2D", "TpuStage", "TpuD2H", "rebase_frame_tags", "emit_with_tags",
+           "parse_ctrl"]
+
+log = logger("tpu.frames")
+
+
+def parse_ctrl(p: Pmt):
+    """``{"stage": <name-or-index>, <param>: <value>, …}`` → ``(stage, params)``.
+
+    The shared grammar of the TpuKernel/TpuStage ``ctrl`` ports; raises on
+    malformed input (callers translate to ``Pmt.invalid_value()``). Pmt.map
+    wraps list elements as Pmt (VecPmt) — unwrapped here."""
+    d = dict(p.to_map())
+    stage = d.pop("stage").value
+    if not isinstance(stage, str):
+        stage = int(stage)
+    params = {}
+    for k, v in d.items():
+        val = v.value
+        if isinstance(val, (list, tuple)):
+            val = [e.value if isinstance(e, Pmt) else e for e in val]
+            params[k] = np.asarray(val)
+        elif isinstance(val, np.ndarray):
+            params[k] = val
+        else:
+            params[k] = float(val)
+    return stage, params
 
 
 def rebase_frame_tags(tags: Sequence[ItemTag], pipeline: Pipeline,
@@ -110,7 +138,11 @@ class TpuH2D(Kernel):
 
 class TpuStage(Kernel):
     """Device frame → device frame through a jitted stage pipeline; the frame never
-    leaves HBM (`blocks/vulkan.rs` compute role, minus its D2H hop)."""
+    leaves HBM (`blocks/vulkan.rs` compute role, minus its D2H hop).
+
+    Carries a ``ctrl`` message port with the same carry-surgery retune contract
+    as :class:`~futuresdr_tpu.tpu.TpuKernel` — frame-plane pipelines retune
+    while frames are in flight too."""
 
     BLOCKING = True
 
@@ -121,8 +153,26 @@ class TpuStage(Kernel):
         self.pipeline = Pipeline(stages, in_dtype)
         self._compiled = None
         self._carry = None
+        self._pending_ctrl: List[tuple] = []   # ctrl before the first frame
         self.input = self.add_inplace_input("in")
         self.output = self.add_inplace_output("out")
+
+    @message_handler(name="ctrl")
+    async def ctrl_handler(self, io, mio, meta, p):
+        try:
+            stage, params = parse_ctrl(p)
+            if self._carry is None:
+                # unlike TpuKernel (eager compile in init), the carry here is
+                # compiled at the FIRST frame — queue the update; work() applies
+                # it the moment the carry exists, so an early retune is not lost
+                self._pending_ctrl.append((stage, params))
+            else:
+                self._carry = self.pipeline.update_stage(self._carry, stage,
+                                                         **params)
+        except Exception as e:
+            log.warning("ctrl update rejected: %r", e)
+            return Pmt.invalid_value()
+        return Pmt.ok()
 
     async def work(self, io, mio, meta):
         while True:
@@ -136,6 +186,13 @@ class TpuStage(Kernel):
                     f"frame {n} not a multiple of {self.pipeline.frame_multiple}"
                 self._compiled, self._carry = self.pipeline.compile(
                     n, device=self.inst.device)
+                for stage, params in self._pending_ctrl:
+                    try:
+                        self._carry = self.pipeline.update_stage(
+                            self._carry, stage, **params)
+                    except Exception as e:          # validated only now
+                        log.warning("queued ctrl update rejected: %r", e)
+                self._pending_ctrl.clear()
             self._carry, y = self._compiled(self._carry, frame)   # async dispatch
             out_valid = self.pipeline.out_items(
                 valid - valid % self.pipeline.frame_multiple)

@@ -95,25 +95,13 @@ class TpuKernel(Kernel):
         later frame uses the new ones; no recompile, no pipeline stall. The
         device-path retune of the reference's fm-receiver ``freq`` handler
         (``examples/fm-receiver/src/main.rs:83-155``)."""
+        from .frames import parse_ctrl
         try:
-            d = dict(p.to_map())
-            stage = d.pop("stage").value
-            if not isinstance(stage, str):
-                stage = int(stage)
-            params = {}
-            for k, v in d.items():
-                val = v.value
-                if isinstance(val, (list, tuple)):
-                    # Pmt.map wraps list elements as Pmt (VecPmt) — unwrap them
-                    val = [e.value if isinstance(e, Pmt) else e for e in val]
-                    params[k] = np.asarray(val)
-                elif isinstance(val, np.ndarray):
-                    params[k] = val
-                else:
-                    params[k] = float(val)
+            stage, params = parse_ctrl(p)
             if self._carry is None:
-                # the runtime's init barrier answers pre-init messages itself, so
-                # this only triggers on direct handler calls before init
+                # the runtime's init barrier answers pre-init messages itself
+                # (init() compiles the carry eagerly), so this only triggers on
+                # direct handler calls before init
                 raise RuntimeError("ctrl before init")
             self._carry = self.pipeline.update_stage(self._carry, stage, **params)
         except Exception as e:
