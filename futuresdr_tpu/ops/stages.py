@@ -124,7 +124,7 @@ class Pipeline:
         assert q.denominator == 1
         return int(q)
 
-    def update_stage(self, carries, stage, **params):
+    def update_stage(self, carries, stage, _validate_only: bool = False, **params):
         """Runtime control: apply a stage's ``update`` hook to its slot in ``carries``.
 
         ``stage``: post-merge index or stage ``name`` (LTI merging may have renamed a
@@ -132,6 +132,11 @@ class Pipeline:
         the new carries tuple; the in-flight frames that captured the old carry are
         untouched, every later dispatch sees the new parameters — the device-path
         retune-while-running of ``examples/fm-receiver/src/main.rs:83-155``.
+
+        ``_validate_only``: resolve the stage and check it has an update hook
+        WITHOUT touching carries (which may be None) — for callers that must
+        queue an update before any carry exists (TpuStage's lazy compile) but
+        still want to reject a bad stage name immediately.
         """
         if isinstance(stage, str):
             hits = [i for i, s in enumerate(self.stages) if s.name == stage]
@@ -143,9 +148,14 @@ class Pipeline:
             idx = hits[0]
         else:
             idx = int(stage)
+            if not 0 <= idx < len(self.stages):
+                raise KeyError(f"stage index {idx} out of range "
+                               f"({len(self.stages)} stages)")
         s = self.stages[idx]
         if s.update is None:
             raise ValueError(f"stage {s.name!r} has no runtime-update hook")
+        if _validate_only:
+            return carries
         carries = list(carries)
         carries[idx] = s.update(carries[idx], **params)
         return tuple(carries)

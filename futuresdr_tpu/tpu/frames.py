@@ -164,7 +164,12 @@ class TpuStage(Kernel):
             if self._carry is None:
                 # unlike TpuKernel (eager compile in init), the carry here is
                 # compiled at the FIRST frame — queue the update; work() applies
-                # it the moment the carry exists, so an early retune is not lost
+                # it the moment the carry exists, so an early retune is not
+                # lost. Validate what CAN be validated now (stage resolution +
+                # update hook exist without a carry) so a bad stage name is
+                # rejected here, not silently dropped at compile time.
+                self.pipeline.update_stage(None, stage, _validate_only=True,
+                                           **params)
                 self._pending_ctrl.append((stage, params))
             else:
                 self._carry = self.pipeline.update_stage(self._carry, stage,

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""perf/pp — pipeline-parallel scaling probe (GPipe bubble efficiency).
+
+Measures `make_pp_pipeline` throughput vs microbatch count: the schedule has
+``n_micro + n_stages - 1`` ticks for ``n_micro`` microbatches of work, so the
+ideal efficiency is ``M / (M + S - 1)`` — the probe reports measured vs ideal
+so pipeline regressions (extra collectives, broken overlaps) show up as an
+efficiency gap rather than a silent slowdown.
+
+CSV: ``stages,micro,ideal_eff,msamples_per_sec``.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--stages", type=int, nargs="+", default=[4, 8])
+    p.add_argument("--micro", type=int, nargs="+", default=[2, 8, 32])
+    p.add_argument("--width", type=int, default=256)
+    p.add_argument("--mb", type=int, default=64, help="rows per microbatch")
+    p.add_argument("--reps", type=int, default=5)
+    a = p.parse_args()
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            f"{flags} --xla_force_host_platform_device_count={a.devices}".strip()
+
+    import jax
+    from futuresdr_tpu.tpu.instance import force_cpu_platform
+    force_cpu_platform()
+    import jax.numpy as jnp
+    import numpy as np
+    from futuresdr_tpu.parallel import (NamedSharding, P, make_mesh,
+                                        make_pp_pipeline)
+
+    print("stages,micro,ideal_eff,msamples_per_sec")
+    rng = np.random.default_rng(0)
+    d = a.width
+    for S in a.stages:
+        if S > len(jax.devices()):
+            print(f"# skipping stages={S}: only {len(jax.devices())} devices",
+                  file=sys.stderr)
+            continue
+        mesh = make_mesh(("pp",), shape=(S,), devices=jax.devices()[:S])
+        W = jax.device_put(
+            (rng.standard_normal((S, d, d)) / np.sqrt(d)).astype(np.float32),
+            NamedSharding(mesh, P("pp")))
+        for M in a.micro:
+            fn = jax.jit(make_pp_pipeline(
+                lambda w, x: jnp.tanh(x @ w), S, M, mesh))
+            xm = jnp.asarray(rng.standard_normal((M, a.mb, d)),
+                             dtype=jnp.float32)
+            jax.block_until_ready(fn(W, xm))          # compile
+            t0 = time.perf_counter()
+            for _ in range(a.reps):
+                y = fn(W, xm)
+            jax.block_until_ready(y)
+            dt = (time.perf_counter() - t0) / a.reps
+            rate = M * a.mb * d / dt / 1e6
+            print(f"{S},{M},{M / (M + S - 1):.3f},{rate:.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -272,3 +272,17 @@ def test_tpu_stage_ctrl_before_first_frame():
     assert len(got) == n
     ref2 = np.convolve(x, t2)[:n].astype(np.float32)
     np.testing.assert_allclose(got[nt:], ref2[nt:], atol=2e-3)
+
+
+def test_tpu_stage_early_ctrl_rejects_bad_stage():
+    """An early (pre-carry) ctrl with a bad stage name must reply InvalidValue
+    immediately — not ok-then-silently-dropped at first-frame compile."""
+    import asyncio
+    from futuresdr_tpu.tpu import TpuStage
+    from futuresdr_tpu.types import Pmt
+
+    st = TpuStage([fir_stage(np.ones(8, np.float32), name="f")], np.float32)
+    r = asyncio.run(st.ctrl_handler(None, None, None,
+                                    Pmt.map({"stage": "nope", "taps": [1.0] * 8})))
+    assert r == Pmt.invalid_value()
+    assert not st._pending_ctrl
