@@ -29,13 +29,6 @@ def _load_mm_native():
     (``clock_recovery_mm.rs``); here the same loop is C++ behind ctypes, with the
     Python loop kept as a portable fallback (``FSDR_NO_NATIVE=1`` forces it)."""
     import ctypes
-    import os
-    if os.environ.get("FSDR_NO_NATIVE"):
-        return None
-    from ..runtime.buffer.circular import load_native
-    lib = load_native()
-    if lib is None or not hasattr(lib, "fsdr_mm_work"):
-        return None
 
     class MmState(ctypes.Structure):
         _fields_ = [("omega", ctypes.c_double), ("omega0", ctypes.c_double),
@@ -43,11 +36,13 @@ def _load_mm_native():
                     ("last_d", ctypes.c_double), ("gain_omega", ctypes.c_double),
                     ("gain_mu", ctypes.c_double), ("limit", ctypes.c_double)]
 
+    from ..runtime.buffer.circular import probe_native
     f32p = ctypes.POINTER(ctypes.c_float)
-    lib.fsdr_mm_work.restype = ctypes.c_int64
-    lib.fsdr_mm_work.argtypes = [f32p, ctypes.c_int64, f32p, ctypes.c_int64,
-                                 ctypes.POINTER(MmState),
-                                 ctypes.POINTER(ctypes.c_int64)]
+    lib = probe_native("fsdr_mm_work", ctypes.c_int64,
+                       [f32p, ctypes.c_int64, f32p, ctypes.c_int64,
+                        ctypes.POINTER(MmState), ctypes.POINTER(ctypes.c_int64)])
+    if lib is None:
+        return None
     return lib, MmState
 
 

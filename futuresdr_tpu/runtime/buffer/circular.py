@@ -86,6 +86,24 @@ def available() -> bool:
     return load_native() is not None
 
 
+def probe_native(symbol: str, restype, argtypes) -> Optional[ctypes.CDLL]:
+    """Shared native-kernel probe: honors the ``FSDR_NO_NATIVE=1`` escape hatch
+    (forces every portable fallback — rule out the C++ toolchain when debugging
+    or benchmarking the pure-Python/XLA paths), loads the library, checks the
+    symbol, binds its signature, and returns the CDLL (or None). Every native
+    kernel (MM clock recovery, Viterbi, …) routes through here so the fallback
+    convention cannot silently diverge per call site."""
+    if os.environ.get("FSDR_NO_NATIVE"):
+        return None
+    lib = load_native()
+    if lib is None or not hasattr(lib, symbol):
+        return None
+    fn = getattr(lib, symbol)
+    fn.restype = restype
+    fn.argtypes = argtypes
+    return lib
+
+
 class CircularWriter(BufferWriter):
     """1 writer → N broadcast readers over a double-mapped region."""
 

@@ -217,3 +217,30 @@ def test_short_frame_jax_head_host_body():
     frames = decode_stream(sig)
     assert len(frames) == 1 and frames[0].psdu == psdu
     assert frames[0].n_symbols < 8        # really the mixed path
+
+
+def test_native_viterbi_bit_matches_numpy():
+    """The C++ ACS loop decodes bit-identically to the numpy trellis (same tie
+    convention), across short/long frames and noisy LLRs."""
+    import futuresdr_tpu.models.wlan.coding as c
+    if c._native_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(0)
+    for n in (24, 97, 511, 513, 3000):
+        bits = rng.integers(0, 2, n).astype(np.uint8)
+        bits[-6:] = 0
+        llrs = (c.conv_encode(bits).astype(np.float64) * 2 - 1
+                + 0.5 * rng.standard_normal(2 * n))
+        native = c.viterbi_decode(llrs, n)
+        saved, c._NATIVE = c._NATIVE, 0          # force the numpy path
+        try:
+            import futuresdr_tpu.ops.viterbi as ov
+            saved_br, ov.backend_ready = ov.backend_ready, lambda: False
+            try:
+                ref = c.viterbi_decode(llrs, n)
+            finally:
+                ov.backend_ready = saved_br
+        finally:
+            c._NATIVE = saved
+        assert np.array_equal(native, ref), n
+        assert np.array_equal(native, bits), f"decode errors at n={n}"
