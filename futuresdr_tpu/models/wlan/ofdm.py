@@ -118,10 +118,16 @@ def detect_packets(samples: np.ndarray, threshold: float = 0.56,
     return starts
 
 
-def sync_long(samples: np.ndarray, search_start: int, search_len: int = 320 + 80):
+def sync_long(samples: np.ndarray, search_start: int, search_len: int = 320 + 224):
     """Fine timing via cross-correlation with the known LTS symbol; returns the index
     of the first data (SIGNAL) symbol and the coarse+fine CFO estimate
-    (`sync_long.rs` role)."""
+    (`sync_long.rs` role).
+
+    The window must reach past BOTH LTS symbols even when detection fires early
+    (the STS autocorrelation plateau can trigger ~100+ samples before the burst);
+    a too-short window truncates the LTS2 peak and the cyclic-prefix ghost (64
+    samples before LTS1, same spacing) wins the pairing — a deterministic
+    64-sample mislock whose garbage SIGNAL can still pass parity."""
     lts = lts_time()
     ref = lts[32 + 64:32 + 128]            # one clean long symbol
     seg = samples[search_start:search_start + search_len]
@@ -140,6 +146,11 @@ def sync_long(samples: np.ndarray, search_start: int, search_len: int = 320 + 80
         # fall back: assume exact structure from the stronger peak
         first = p1 - 64 if p1 >= 64 and mag[p1 - 64] > 0.5 * mag[p1] else p1
         second = first + 64
+    # CP-ghost guard: the pair (ghost, LTS1) is also 64 apart — if another
+    # strong peak sits 64 AFTER `second`, the true pair is one symbol later
+    while second + 64 < len(mag) and \
+            mag[second + 64] > 0.8 * max(mag[first], 1e-12):
+        first, second = second, second + 64
     # CFO from phase drift between the two long symbols
     a = seg[first:first + 64]
     b = seg[second:second + 64]

@@ -96,6 +96,10 @@ class DecodedFrame:
     start: int
     cfo: float
     n_symbols: int
+    seed_ok: bool = True   # scrambler seed recovered from the SERVICE prefix.
+    #   A correct decode matches its seed with P≈1; a GARBAGE decode matches
+    #   some seed with P≈127/2^16≈0.2% (the gate's false-accept rate) — so
+    #   seed_ok=False means parity-lucky garbage, essentially always
 
 
 def decode_frame(samples: np.ndarray, lts_start: int,
@@ -109,16 +113,35 @@ def decode_frame(samples: np.ndarray, lts_start: int,
     return _finish_frame(decoded, *p[2:])
 
 
+def _frame_end(lts_start: int, n_symbols: int) -> int:
+    """Last sample of a decoded frame: LTS (128) + SIGNAL (80) + data symbols."""
+    return lts_start + 128 + SYM_LEN * (1 + n_symbols)
+
+
 def decode_stream(samples: np.ndarray) -> List[DecodedFrame]:
-    """Full RX: detect (`sync_short`), align (`sync_long`), decode every frame."""
+    """Full RX: detect (`sync_short`), align (`sync_long`), decode every frame.
+
+    Detections whose sync resolves INSIDE an already-decoded frame's span are
+    skipped — noise can re-trigger the plateau detector on one burst, and a
+    false sync into the data region otherwise yields a duplicate or a
+    parity-lucky garbage frame. Only frames whose scrambler seed was recovered
+    (``seed_ok``) claim their span: a garbage decode with a bogus long length
+    must not swallow the NEXT real burst's preamble."""
     out: List[DecodedFrame] = []
+    claimed_to = -1
     for start in ofdm.detect_packets(samples):
         r = ofdm.sync_long(samples, start)
         if r is None:
             continue
         data_start, lts_start, cfo = r
+        if lts_start < claimed_to:
+            continue
         frame = decode_frame(samples, lts_start, cfo)
-        if frame is not None:
+        if frame is not None and frame.seed_ok:
+            # a frame whose SERVICE prefix matches no scrambler seed was
+            # descrambled with a GUESS — its bytes are meaningless; dropping it
+            # here equals the reference's seed-derivation + MAC-FCS rejection
+            claimed_to = _frame_end(lts_start, frame.n_symbols)
             out.append(frame)
     return out
 
@@ -195,7 +218,8 @@ def _finish_frame(decoded_bits: np.ndarray, mcs, length, lts_start, cfo,
     seed = int(match[0]) + 1 if len(match) else 0b1011101
     descrambled = coding.descramble(decoded_bits, seed)
     psdu_bits = descrambled[16:16 + 8 * length]
-    return DecodedFrame(bits_to_bytes(psdu_bits), mcs, lts_start, cfo, n_sym)
+    return DecodedFrame(bits_to_bytes(psdu_bits), mcs, lts_start, cfo, n_sym,
+                        seed_ok=bool(len(match)))
 
 
 def decode_stream_batch(samples: np.ndarray) -> List[DecodedFrame]:
@@ -221,9 +245,17 @@ def decode_stream_batch(samples: np.ndarray) -> List[DecodedFrame]:
                                        _PREV_S, _PREV_B, _BM0, _BM1)
     except Exception:
         bits_list = [coding.viterbi_decode(p[0], p[1]) for p in preps]
+    # the seed check needs the Viterbi output, so the batch path applies the
+    # span/dedup policy AFTER decoding (same semantics as decode_stream: only
+    # seed_ok frames claim; detections inside a claimed span are dropped)
     out = []
+    claimed_to = -1
     for p, bits in zip(preps, bits_list):
+        lts_start = p[4]
+        if lts_start < claimed_to:
+            continue
         f = _finish_frame(bits, *p[2:])
-        if f is not None:
+        if f is not None and f.seed_ok:
+            claimed_to = _frame_end(lts_start, f.n_symbols)
             out.append(f)
     return out

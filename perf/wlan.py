@@ -102,7 +102,12 @@ def main():
     print("run,n_frames,payload_len,decoded,elapsed_secs,frames_per_sec,msamples_per_sec")
     for r in range(a.runs):
         t0 = time.perf_counter()
-        decoded = decode(sig)
+        raw = decode(sig)
+        # full RX includes the MAC FCS check (reference decoder.rs validates
+        # before announcing) — a lucky SIGNAL parity on a false sync must not
+        # count as a decoded frame
+        from futuresdr_tpu.models.wlan.mac import payload_from_mpdu
+        decoded = [f for f in raw if payload_from_mpdu(f.psdu) is not None]
         dt = time.perf_counter() - t0
         print(f"{r},{a.frames},{a.payload},{len(decoded)},{dt:.3f},"
               f"{len(decoded) / dt:.1f},{len(sig) / dt / 1e6:.2f}", flush=True)

@@ -244,3 +244,27 @@ def test_native_viterbi_bit_matches_numpy():
             c._NATIVE = saved
         assert np.array_equal(native, ref), n
         assert np.array_equal(native, bits), f"decode errors at n={n}"
+
+
+def test_noisy_burst_train_no_mislock_no_dup():
+    """Regression for two RX-chain defects found at 25 dB: (1) sync_long's
+    search window ended before LTS2 when detection fired early, so the
+    cyclic-prefix ghost won the 64-apart pairing — a deterministic one-symbol
+    mislock whose garbage SIGNAL passed parity and LOST the real frame;
+    (2) noise re-triggering the plateau detector inside a burst produced
+    duplicate/garbage decodes. 60 noisy frames must come back exactly once
+    each, nothing else."""
+    rng = np.random.default_rng(1234)
+    mac = Mac()
+    parts, sent = [], []
+    for i in range(60):
+        psdu = mac.frame(bytes(rng.integers(0, 256, 256, dtype=np.uint8)))
+        sent.append(psdu)
+        parts += [encode_frame(psdu, "qpsk_1_2"), np.zeros(300, np.complex64)]
+    sig = np.concatenate(parts)
+    sigma = np.sqrt(np.mean(np.abs(sig) ** 2) * 10 ** (-25 / 10) / 2)
+    sig = (sig + sigma * (rng.standard_normal(len(sig))
+                          + 1j * rng.standard_normal(len(sig)))
+           ).astype(np.complex64)
+    got = [f.psdu for f in decode_stream(sig)]
+    assert got == sent, (len(got), len(set(got) & set(sent)))
