@@ -155,7 +155,10 @@ class Modem:
         self.params = params
 
     def tx(self, payload: bytes) -> np.ndarray:
-        assert len(payload) <= self.size
+        if len(payload) > self.size:
+            raise ValueError(
+                f"payload is {len(payload)} bytes but the modem was built for "
+                f"payload_size={self.size}; rebuild with a larger size")
         return modulate(payload.ljust(self.size, b"\x00"), self.params)
 
     def rx(self, audio: np.ndarray) -> Optional[bytes]:
@@ -188,10 +191,10 @@ class ModemTransmitter(Kernel):
             return Pmt.ok()
         try:
             payload = p.to_blob()
-        except Exception:
+            tx = self.modem.tx(payload)     # ValueError on oversize: bad input,
+        except Exception:                   # not a flowgraph-killing fault
             return Pmt.invalid_value()
-        burst = np.concatenate([self.modem.tx(payload),
-                                np.zeros(self.gap, np.float32)])
+        burst = np.concatenate([tx, np.zeros(self.gap, np.float32)])
         self._pending.append(burst)
         io.call_again = True
         return Pmt.ok()
