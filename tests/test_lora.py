@@ -184,3 +184,29 @@ def test_clock_offset_long_frame_decode(sf, ldro, ppm):
     ok = any((r := demodulate_frame(x, s, p)) is not None and r[0] == payload and r[1]
              for s in detect_frames(x, p))
     assert ok, f"sf={sf} ldro={ldro} ppm={ppm} failed to decode"
+
+
+def test_noisy_burst_train_exact_once():
+    """Same interrogation standard as the WLAN/ZigBee trains: 12 noisy bursts
+    with CFO and random phase decode exactly once each, in order, CRC-valid."""
+    p = LoraParams(sf=7, cr=2)
+    rng = np.random.default_rng(3)
+    parts, sent = [], []
+    for i in range(12):
+        payload = f"lora train {i}".encode()
+        sent.append(payload)
+        b = modulate_frame(payload, p)
+        parts += [np.zeros(400 + 67 * i, np.complex64), b.astype(np.complex64)]
+    parts.append(np.zeros(500, np.complex64))
+    sig = np.concatenate(parts)
+    sig = sig * np.exp(1j * (0.4 + 1e-4 * np.arange(len(sig))))
+    rms = np.sqrt(np.mean(np.abs(sig[np.abs(sig) > 0]) ** 2))
+    sigma = rms * 10 ** (-15 / 20) / np.sqrt(2)
+    sig = (sig + sigma * (rng.standard_normal(len(sig))
+                          + 1j * rng.standard_normal(len(sig)))
+           ).astype(np.complex64)
+    starts = detect_frames(sig, p)
+    assert len(starts) == 12
+    got = [demodulate_frame(sig, s, p) for s in starts]
+    assert all(g is not None and g[1] for g in got), "CRC failures"
+    assert [g[0] for g in got] == sent
