@@ -99,7 +99,8 @@ def demodulate_stream(samples: np.ndarray, sps: int = SPS) -> List[Lsf]:
     delay = len(h) - 1
     sync = _sync_symbols(SYNC_LSF)
     n_frame_syms = 8 + 184
-    results: List[Lsf] = []
+    found: List[tuple] = []                # (sample_position, Lsf)
+    seen: set = set()                      # serialized LSFs (one to_bytes each)
     # correlate sync at symbol-rate hypotheses over all sample phases
     for phase in range(sps):
         sym_stream = mf[delay + phase::sps] / gain
@@ -113,10 +114,14 @@ def demodulate_stream(samples: np.ndarray, sps: int = SPS) -> List[Lsf]:
             if len(frame_syms) < 184:
                 continue
             lsf = _decode_lsf_symbols(frame_syms)
-            if lsf is not None and not any(r.to_bytes() == lsf.to_bytes()
-                                           for r in results):
-                results.append(lsf)
-    return results
+            if lsf is not None:
+                raw = lsf.to_bytes()
+                if raw not in seen:
+                    seen.add(raw)
+                    found.append((idx * sps + phase, lsf))
+    # the phase loop visits frames phase-major — return them in TIME order, as
+    # a streaming receiver must
+    return [lsf for _, lsf in sorted(found, key=lambda t: t[0])]
 
 
 def _decode_lsf_symbols(syms: np.ndarray) -> Optional[Lsf]:

@@ -102,3 +102,20 @@ def test_4fsk_loopback_noise():
     sig = sig + 0.1 * rng.standard_normal(len(sig)).astype(np.float32)
     found = demodulate_stream(sig)
     assert len(found) == 1 and found[0].src == "SP5WWP"
+
+
+def test_stream_returns_frames_in_time_order():
+    """Interrogation standard: 8 noisy bursts decode exactly once each, IN TIME
+    ORDER — the per-phase sync search used to return them phase-major."""
+    rng = np.random.default_rng(4)
+    parts, sent = [], []
+    for i in range(8):
+        lsf = Lsf(src=f"N{i}CALL", dst="ALLCALL")
+        sent.append(lsf.src)
+        parts += [np.zeros(500 + 53 * i, np.float32),
+                  modulate(build_lsf_frame(lsf)).astype(np.float32)]
+    parts.append(np.zeros(600, np.float32))
+    sig = np.concatenate(parts)
+    sig = (sig + 0.08 * rng.standard_normal(len(sig))).astype(np.float32)
+    got = [l.src for l in demodulate_stream(sig)]
+    assert got == sent, got
