@@ -5,7 +5,7 @@
 ``polar``: systematic polar(2048) + CRC32-aided list-32 SCL decoding (payload path).
 """
 
-from .modem import (Modem, ModemParams, ModemReceiver, ModemTransmitter, demodulate,
+from .modem import (Modem, ModemParams, ModemReceiver, ModemTransmitter, demodulate, demodulate_all,
                     mls, modulate)
 from .fec import (BCH_K, BCH_N, bch_generator_matrix, bch_genpoly, bch_parity,
                   crc16_rattlegram, crc32_rattlegram, mls_bits, osd_decode, Xorshift32)

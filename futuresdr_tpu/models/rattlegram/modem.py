@@ -21,7 +21,7 @@ from ...runtime.kernel import Kernel, message_handler
 from ...types import Pmt
 from ..wlan import coding as wcoding
 
-__all__ = ["mls", "ModemParams", "modulate", "demodulate", "Modem",
+__all__ = ["mls", "ModemParams", "modulate", "demodulate", "demodulate_all", "Modem",
            "ModemTransmitter", "ModemReceiver"]
 
 
@@ -102,18 +102,60 @@ def modulate(payload: bytes, p: ModemParams = ModemParams()) -> np.ndarray:
     return (burst / np.abs(burst).max() * 0.8).astype(np.float32)
 
 
-def demodulate(audio: np.ndarray, n_payload: int,
-               p: ModemParams = ModemParams()) -> Optional[bytes]:
-    """Locate the MLS sync symbol, equalize, demap, Viterbi-decode, CRC-check."""
+def _sync_norm(audio: np.ndarray, p: ModemParams) -> np.ndarray:
+    """Normalized MLS sync correlation metric over every start position —
+    the single source of the detection normalization for both demodulators."""
     ref = _sym_to_audio(_sync_spectrum(p), p)[p.cp:]
     corr = np.correlate(audio.astype(np.float64), ref, mode="valid")
     energy = np.convolve(audio.astype(np.float64) ** 2, np.ones(len(ref)), "full")
     energy = energy[len(ref) - 1:len(ref) - 1 + len(corr)]
-    norm = np.abs(corr) / np.maximum(np.sqrt(energy * np.sum(ref ** 2)), 1e-12)
+    return np.abs(corr) / np.maximum(np.sqrt(energy * np.sum(ref ** 2)), 1e-12)
+
+
+def demodulate_all(audio: np.ndarray, n_payload: int,
+                   p: ModemParams = ModemParams()):
+    """Every decodable burst in ``audio``, in time order: ``[(sync_start,
+    payload), …]``. Sync peaks above threshold are tried oldest-first and a
+    successful decode claims its burst span, so a long recording with many
+    bursts yields them all (``demodulate`` is the single-burst view)."""
+    norm = _sync_norm(audio, p)
+    n_bits = 8 * (n_payload + 4) + 6
+    n_sym = -(-2 * n_bits // (2 * p.n_carriers))
+    burst_span = (1 + n_sym) * p.sym_len
+    out = []
+    cand = np.flatnonzero(norm > 0.5)
+    next_free = -1
+    for i in cand:
+        if i < next_free:
+            continue
+        # refine to the local peak within one symbol
+        hi = min(len(norm), i + p.sym_len)
+        peak = int(i + np.argmax(norm[i:hi]))
+        payload = _decode_at(audio, peak, n_payload, p)
+        if payload is not None:
+            out.append((peak, payload))
+            next_free = peak + burst_span
+        else:
+            # skip the rest of this correlation lobe — retrying the same
+            # corrupted burst once per above-threshold sample would run the
+            # Viterbi tens of times for nothing
+            next_free = max(next_free, peak + p.sym_len)
+    return out
+
+
+def demodulate(audio: np.ndarray, n_payload: int,
+               p: ModemParams = ModemParams()) -> Optional[bytes]:
+    """Locate the strongest MLS sync symbol, equalize, demap, Viterbi-decode,
+    CRC-check — the single-burst window API (streams: :func:`demodulate_all`)."""
+    norm = _sync_norm(audio, p)
     peak = int(np.argmax(norm))
     if norm[peak] < 0.5:
         return None
-    sync_start = peak
+    return _decode_at(audio, peak, n_payload, p)
+
+
+def _decode_at(audio: np.ndarray, sync_start: int, n_payload: int,
+               p: ModemParams) -> Optional[bytes]:
     # channel estimate from the sync symbol
     sync_spec = np.fft.fft(audio[sync_start:sync_start + p.fft])
     ref_spec = _sync_spectrum(p)
@@ -164,6 +206,11 @@ class Modem:
     def rx(self, audio: np.ndarray) -> Optional[bytes]:
         r = demodulate(audio, self.size, self.params)
         return None if r is None else r.rstrip(b"\x00")
+
+    def rx_all(self, audio: np.ndarray):
+        """All bursts in a recording, time-ordered: ``[(position, payload), …]``."""
+        return [(pos, r.rstrip(b"\x00"))
+                for pos, r in demodulate_all(audio, self.size, self.params)]
 
     def burst_samples(self) -> int:
         """Length of one TX burst in samples (for RX windowing)."""
@@ -228,7 +275,8 @@ class ModemReceiver(Kernel):
         self.OVERLAP = self.modem.burst_samples() + 4 * params.sym_len
         self.frames = []
         self._tail = np.zeros(0, np.float32)
-        self._recent = []
+        self._recent = []                  # (absolute_position, payload)
+        self._buf_abs = 0                  # absolute stream index of buf[0]
         self.input = self.add_stream_input("in", np.float32,
                                            min_items=4 * params.sym_len)
         self.add_message_output("rx")
@@ -241,12 +289,21 @@ class ModemReceiver(Kernel):
                 io.finished = True
             return
         buf = np.concatenate([self._tail, inp[:n]])
-        payload = self.modem.rx(buf)
-        if payload is not None and payload not in self._recent:
-            self._recent = (self._recent + [payload])[-8:]
+        # ALL bursts in the window, time-ordered — one rx() per work() call
+        # used to drop every burst but one when big chunks arrived. Dedup is by
+        # absolute POSITION (tail overlap re-decodes the same burst), so a
+        # genuinely retransmitted identical payload still comes through.
+        span = self.modem.burst_samples()
+        for pos, payload in self.modem.rx_all(buf):
+            abs_pos = self._buf_abs + pos
+            if any(pay == payload and abs(abs_pos - p) < span
+                   for p, pay in self._recent):
+                continue
+            self._recent = (self._recent + [(abs_pos, payload)])[-8:]
             self.frames.append(payload)
             mio.post("rx", Pmt.blob(payload))
         keep = min(len(buf), self.OVERLAP)
+        self._buf_abs += len(buf) - keep
         self._tail = buf[len(buf) - keep:].copy()
         self.input.consume(n)
         if self.input.finished() and self.input.available() == 0:

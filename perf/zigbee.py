@@ -46,7 +46,7 @@ def run_mm_block(n_samples: int) -> tuple:
     return n_samples / dt / 1e6, bool(ClockRecoveryMm._native)
 
 
-def run_rx_chain(n_frames: int) -> tuple:
+def run_rx_chain(n_frames: int, timing: str = "phase") -> tuple:
     from futuresdr_tpu.models.zigbee import demodulate_stream, modulate_frame
 
     rng = np.random.default_rng(1)
@@ -58,7 +58,7 @@ def run_rx_chain(n_frames: int) -> tuple:
     sig = (sig + 0.02 * (rng.standard_normal(len(sig))
                          + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
     t0 = time.perf_counter()
-    frames = demodulate_stream(sig)
+    frames = demodulate_stream(sig, timing=timing)
     dt = time.perf_counter() - t0
     return len(frames) / dt, len(sig) / dt / 1e6
 
@@ -78,6 +78,10 @@ def main():
     for r in range(a.runs):
         fps, msps = run_rx_chain(a.frames)
         print(f"rx_chain,{native},{r},{fps:.1f},{msps:.2f}", flush=True)
+        fps, msps = run_rx_chain(a.frames, timing="coherent")
+        # the coherent path never touches the MM block; "-" avoids implying a
+        # native-vs-fallback distinction that does not exist for this row
+        print(f"rx_chain_coherent,-,{r},{fps:.1f},{msps:.2f}", flush=True)
 
 
 if __name__ == "__main__":
