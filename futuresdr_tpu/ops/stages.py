@@ -525,7 +525,7 @@ def decimate_stage(decim: int) -> Stage:
     def fn(carry, x):
         return carry, x[::decim]
 
-    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, decim), None, decim, f"decim{decim}")
+    return Stage(fn, lambda d: jnp.zeros(()), Fraction(1, decim), None, decim, f"decim{decim}")
 
 
 def fft_stage(n: int, direction: str = "forward", shift: bool = False,
@@ -551,28 +551,28 @@ def fft_stage(n: int, direction: str = "forward", shift: bool = False,
             y = jnp.fft.fftshift(y, axes=1)
         return carry, y.reshape(-1).astype(jnp.complex64)
 
-    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), np.complex64, n, f"fft{n}")
+    return Stage(fn, lambda d: jnp.zeros(()), Fraction(1, 1), np.complex64, n, f"fft{n}")
 
 
 def fftshift_stage(n: int) -> Stage:
     def fn(carry, x):
         return carry, jnp.fft.fftshift(x.reshape(-1, n), axes=1).reshape(-1)
 
-    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), None, n, "fftshift")
+    return Stage(fn, lambda d: jnp.zeros(()), Fraction(1, 1), None, n, "fftshift")
 
 
 def mag2_stage() -> Stage:
     def fn(carry, x):
         return carry, (x.real * x.real + x.imag * x.imag).astype(jnp.float32)
 
-    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), np.float32, 1, "mag2")
+    return Stage(fn, lambda d: jnp.zeros(()), Fraction(1, 1), np.float32, 1, "mag2")
 
 
 def log10_stage(scale: float = 10.0, floor: float = 1e-20) -> Stage:
     def fn(carry, x):
         return carry, (scale * jnp.log10(jnp.maximum(x, floor))).astype(jnp.float32)
 
-    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), np.float32, 1, "log10")
+    return Stage(fn, lambda d: jnp.zeros(()), Fraction(1, 1), np.float32, 1, "log10")
 
 
 def rotator_stage(phase_inc: float, name: str = "rotator") -> Stage:
@@ -632,7 +632,7 @@ def apply_stage(f: Callable[[jnp.ndarray], jnp.ndarray], out_dtype=None,
     def fn(carry, x):
         return carry, f(x)
 
-    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, 1), out_dtype, 1, name)
+    return Stage(fn, lambda d: jnp.zeros(()), Fraction(1, 1), out_dtype, 1, name)
 
 
 def channelizer_stage(n_channels: int, taps=None, name: str = "channelizer") -> Stage:
@@ -690,7 +690,7 @@ def lora_demod_stage(sf: int, name: str = "lora_demod") -> Stage:
         spec = jnp.abs(jnp.fft.fft(blocks, axis=1))
         return carry, jnp.argmax(spec, axis=1).astype(jnp.int32)
 
-    return Stage(fn, lambda d: jnp.zeros(0), Fraction(1, n), np.int32, n, name)
+    return Stage(fn, lambda d: jnp.zeros(()), Fraction(1, n), np.int32, n, name)
 
 
 def agc_stage(reference: float = 1.0, rate: float = 0.1, block: int = 256,

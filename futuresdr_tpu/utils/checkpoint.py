@@ -87,18 +87,50 @@ def save_pytree(path: str, tree: Any) -> None:
 
 
 def load_pytree(path: str, like: Optional[Any] = None) -> Any:
+    """Restore a pytree. With ``like``, leaves are restored HOST-side (numpy)
+    and re-placed onto ``like``'s devices through the transfer pair shim
+    (``ops/xfer.to_device``) — orbax's own restore device_puts raw complex
+    buffers, the exact H2D path that is broken on the axon TPU backend, which
+    would poison a restored device-pipeline carry (e.g. a FIR stage's
+    frequency-domain taps). No-op on backends with working complex transfers."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     ckptr = ocp.StandardCheckpointer()
     if like is not None:
         import jax
-        target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, like) \
-            if hasattr(ocp.utils, "to_shape_dtype_struct") else like
+        import numpy as np
+
+        from ..ops.xfer import to_device
+
+        def host_struct(a):
+            if hasattr(a, "shape") and hasattr(a, "dtype"):
+                return np.zeros(a.shape, a.dtype)
+            return a
+
+        def place(restored, ref):
+            if isinstance(ref, jax.Array):
+                devs = list(ref.devices())
+                if len(devs) > 1:
+                    # multi-device leaf: restore the reference's SHARDING (a
+                    # single-device put would concentrate the carry on one chip
+                    # and break the next sharded dispatch). Sharded complex on a
+                    # split-complex backend cannot transfer either way — let
+                    # device_put raise loudly rather than mis-place silently.
+                    return jax.device_put(np.asarray(restored), ref.sharding)
+                return to_device(np.asarray(restored),
+                                 devs[0] if devs else None)
+            return restored
+
         try:
-            return ckptr.restore(path, target)
-        except Exception:
-            pass
+            host = ckptr.restore(
+                path, jax.tree_util.tree_map(host_struct, like))
+            return jax.tree_util.tree_map(place, host, like)
+        except Exception as e:
+            # falling back means RAW device_puts — the complex-broken path on
+            # axon; the swallowed reason must not vanish with it
+            log.warning("host-side checkpoint restore failed (%r); falling "
+                        "back to direct orbax restore", e)
     return ckptr.restore(path)
 
 
