@@ -265,3 +265,22 @@ def test_modem_receiver_delivers_retransmissions():
                       (rx := ModemReceiver(payload_size=32)), "in")
     Runtime().run(fg)
     assert rx.frames == [b"same"] * 3, rx.frames
+
+
+def test_corrupted_burst_does_not_eat_neighbors():
+    """A CRC-failing burst in the middle of a train must not claim samples past
+    its own correlation lobe — both neighbors still decode."""
+    from futuresdr_tpu.models.rattlegram.modem import Modem, demodulate_all
+
+    m = Modem(payload_size=32)
+    rng = np.random.default_rng(9)
+    b0, b1, b2 = m.tx(b"first"), m.tx(b"corrupt-me"), m.tx(b"third")
+    mid = b1.copy()
+    mid[len(mid) // 3:] += 0.8 * rng.standard_normal(
+        len(mid) - len(mid) // 3).astype(np.float32)
+    sig = np.concatenate([np.zeros(1500, np.float32), b0,
+                          np.zeros(1500, np.float32), mid,
+                          np.zeros(1500, np.float32), b2,
+                          np.zeros(1500, np.float32)]).astype(np.float32)
+    got = [p.rstrip(b"\x00") for _, p in demodulate_all(sig, 32)]
+    assert b"first" in got and b"third" in got, got
