@@ -67,12 +67,23 @@ class LoraTransmitter(Kernel):
 class LoraReceiver(Kernel):
     """Chirp stream → decoded payload messages on ``rx`` (+ ``crc_ok`` flag in a map)."""
 
-    def __init__(self, params: LoraParams = LoraParams(), max_payload: int = 256):
+    def __init__(self, params: LoraParams = LoraParams(), max_payload: int = 256,
+                 implicit_payload_len: Optional[int] = None):
         super().__init__()
         self.params = params
+        # implicit-header frames carry no length field — the receiver must be
+        # told (decoder.rs:36); required iff params.implicit_header
+        self.implicit_payload_len = implicit_payload_len
+        if params.implicit_header and (implicit_payload_len is None
+                                       or implicit_payload_len < 0):
+            raise ValueError("LoraReceiver with implicit_header params needs "
+                             "implicit_payload_len >= 0")
         n = params.n
-        # worst-case frame length in samples, for the inter-window overlap
-        n_sym = 8 + (4 + params.cr) * (2 * (max_payload + 2) // params.sf + 2)
+        # worst-case frame length in samples, for the inter-window overlap;
+        # ldro payload blocks carry only sf-2 nibbles per column
+        max_payload = max(max_payload, implicit_payload_len or 0)
+        sf_app = params.sf - 2 if params.ldro else params.sf
+        n_sym = 8 + (4 + params.cr) * (2 * (max_payload + 2) // sf_app + 2)
         self.OVERLAP = (params.n_preamble + 5 + n_sym) * n
         self.frames = []
         self.crc_flags = []
@@ -96,7 +107,8 @@ class LoraReceiver(Kernel):
             key = abs_start // (self.params.n // 2)   # quantized dedup key
             if key in self._seen:
                 continue
-            r = phy.demodulate_frame(buf, start, self.params)
+            r = phy.demodulate_frame(buf, start, self.params,
+                                     n_payload=self.implicit_payload_len)
             if r is None:
                 continue
             payload, crc_ok, hdr = r

@@ -30,6 +30,9 @@ class LoraParams:
     sync_word: int = 0x12
     has_crc: bool = True
     ldro: bool = False          # low-data-rate optimize: payload at sf-2 too
+    implicit_header: bool = False   # no in-band header: RX must know length/cr/crc
+    #   a priori (`decoder.rs:36` — the reference's implicit_header mode); the
+    #   first block is still the reduced-rate CR4/8 sf-2 block, all payload
 
     @property
     def n(self) -> int:
@@ -58,12 +61,17 @@ def encode_payload_symbols(payload: bytes, p: LoraParams) -> np.ndarray:
     nibbles = np.array(nibbles, dtype=np.uint8)
 
     sf_app_hdr = p.sf - 2
-    header = coding.build_header(len(payload), p.cr, p.has_crc)
-    hdr_nibbles = np.concatenate([header, nibbles[:max(0, sf_app_hdr - 5)]])
+    if p.implicit_header:
+        # no header nibbles: the reduced-rate first block carries payload only
+        hdr_nibbles = nibbles[:sf_app_hdr]
+        used = min(len(nibbles), sf_app_hdr)
+    else:
+        header = coding.build_header(len(payload), p.cr, p.has_crc)
+        hdr_nibbles = np.concatenate([header, nibbles[:max(0, sf_app_hdr - 5)]])
+        used = max(0, sf_app_hdr - 5)
     if len(hdr_nibbles) < sf_app_hdr:
         hdr_nibbles = np.concatenate(
             [hdr_nibbles, np.zeros(sf_app_hdr - len(hdr_nibbles), np.uint8)])
-    used = max(0, sf_app_hdr - 5)
     rest = nibbles[used:]
 
     symbols: List[int] = []
@@ -184,17 +192,29 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
     # the uniform group domain
     qbins = (((bins + 2) >> 2) % nq).astype(np.int64)
     hdr_cands = _best_profile(qbins[:n_hdr_sym], (0, 1, -1), sf_app_hdr, 4, 0, nq)
-    cw, o_hdr_q, _ = hdr_cands[0]
-    hdr_nibbles = coding.hamming_decode(cw, 4)
-    parsed = coding.parse_header(hdr_nibbles[:5])
-    if parsed is None:
-        return None
-    length, cr, has_crc = parsed
+    o_hdr_q = hdr_cands[0][1]
+    if p.implicit_header:
+        # no in-band header (`decoder.rs:36`): length comes from the caller,
+        # cr/crc from params; the whole first block is payload nibbles — so its
+        # tied candidates join the CRC arbitration like any other payload block
+        if n_payload is None or int(n_payload) < 0:
+            raise ValueError("implicit_header decode needs n_payload >= 0")
+        length, cr, has_crc = int(n_payload), p.cr, p.has_crc
+        hdr_alts = [list(coding.hamming_decode(cw_, 4)[:sf_app_hdr])
+                    for cw_, _, _ in hdr_cands]
+    else:
+        hdr_nibbles = coding.hamming_decode(hdr_cands[0][0], 4)
+        parsed = coding.parse_header(hdr_nibbles[:5])
+        if parsed is None:
+            return None
+        length, cr, has_crc = parsed
+        # parse_header's checksum already vouches for this block: single candidate
+        hdr_alts = [list(hdr_nibbles[5:])]
 
     sf_app = p.sf - 2 if p.ldro else p.sf
     n_crc = 2 if has_crc else 0
     n_nibbles_needed = 2 * (length + n_crc)
-    n_from_hdr = max(0, sf_app_hdr - 5)
+    n_from_hdr = len(hdr_alts[0])
     blk_len = 4 + cr
     n_blocks = max(0, -(-(n_nibbles_needed - n_from_hdr) // sf_app))
     if n_hdr_sym + n_blocks * blk_len > len(bins):
@@ -213,7 +233,8 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
         o_run = 4 * o_hdr_q
         first_starts = tuple(o_run + r for r in (0, 1, -1, 2, -2, 3, -3))
 
-    block_alts: List[List[np.ndarray]] = []       # per-block candidate nibble lists
+    # per-block candidate nibble lists; the header block leads with its own alts
+    block_alts: List[List[np.ndarray]] = [hdr_alts]
     cached = None                                 # lookahead reuse: (start, cands)
     for b in range(n_blocks):
         i = n_hdr_sym + b * blk_len
@@ -238,7 +259,7 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
         block_alts.append([coding.hamming_decode(cw_, cr) for cw_, _, _ in cands])
 
     def assemble(choice) -> tuple:
-        nibbles = list(hdr_nibbles[5:])
+        nibbles = []
         for alt in choice:
             nibbles += list(alt)
         if len(nibbles) < n_nibbles_needed:
@@ -317,7 +338,8 @@ def detect_frames(samples: np.ndarray, p: LoraParams) -> List[int]:
     return starts
 
 
-def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams):
+def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams,
+                     n_payload: Optional[int] = None):
     """Demodulate from a symbol-aligned position anywhere inside the preamble.
 
     CFO-aware sync (`frame_sync.rs` state machine): under a carrier offset of ``f``
@@ -383,4 +405,4 @@ def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams):
     # raw argmax bins; decode_symbols absorbs the constant sync bias AND the per-symbol
     # clock drift (SFO) via parity-arbitrated offset tracking — see its docstring
     bins = (np.argmax(np.abs(spec), axis=1) - f_bin) % n
-    return decode_symbols(bins, p)
+    return decode_symbols(bins, p, n_payload=n_payload)

@@ -210,3 +210,66 @@ def test_noisy_burst_train_exact_once():
     got = [demodulate_frame(sig, s, p) for s in starts]
     assert all(g is not None and g[1] for g in got), "CRC failures"
     assert [g[0] for g in got] == sent
+
+
+def test_implicit_header_loopback():
+    """Implicit-header mode (`decoder.rs:36`): no in-band header — the receiver
+    is told length/cr/crc a priori; loops back across sf/cr/ldro with CFO+noise,
+    and a wrong a-priori length fails CRC instead of decoding garbage as ok."""
+    rng = np.random.default_rng(5)
+    for sf, cr, ldro in ((7, 1, False), (7, 4, False), (9, 2, False), (8, 2, True)):
+        p = LoraParams(sf=sf, cr=cr, ldro=ldro, implicit_header=True)
+        payload = f"implicit sf{sf} cr{cr}".encode()
+        sig = np.concatenate([np.zeros(300, np.complex64),
+                              modulate_frame(payload, p),
+                              np.zeros(300, np.complex64)])
+        sig = sig * np.exp(1j * (0.3 + 5e-5 * np.arange(len(sig))))
+        sig = (sig + 0.05 * (rng.standard_normal(len(sig))
+                             + 1j * rng.standard_normal(len(sig)))
+               ).astype(np.complex64)
+        start = detect_frames(sig, p)[0]
+        r = demodulate_frame(sig, start, p, n_payload=len(payload))
+        assert r is not None and r[0] == payload and r[1], (sf, cr, ldro)
+        # wrong a-priori length: must not pass CRC
+        rbad = demodulate_frame(sig, start, p, n_payload=len(payload) - 3)
+        assert rbad is None or not rbad[1]
+
+    with pytest.raises(ValueError, match="n_payload"):
+        demodulate_frame(sig, start, p)
+    with pytest.raises(ValueError, match="n_payload"):
+        demodulate_frame(sig, start, p, n_payload=-2)
+
+
+def test_receiver_overlap_covers_worst_case_frame():
+    """OVERLAP must retain a full max-length frame across work() windows — incl.
+    ldro (payload columns carry sf-2 nibbles) and implicit_payload_len > max_payload."""
+    for p, kw in ((LoraParams(sf=8, ldro=True, cr=2), {}),
+                  (LoraParams(sf=7, ldro=True, cr=4), {"max_payload": 200}),
+                  (LoraParams(sf=7, cr=2, implicit_header=True),
+                   {"max_payload": 16, "implicit_payload_len": 200})):
+        rx = LoraReceiver(params=p, **kw)
+        longest = kw.get("implicit_payload_len") or kw.get("max_payload", 256)
+        frame = modulate_frame(bytes(longest), p)
+        assert rx.OVERLAP >= len(frame), (p, kw, rx.OVERLAP, len(frame))
+
+    with pytest.raises(ValueError, match="implicit_payload_len"):
+        LoraReceiver(params=LoraParams(implicit_header=True), implicit_payload_len=-1)
+
+
+def test_implicit_header_receiver_block():
+    """LoraReceiver(implicit_payload_len=...) decodes implicit frames; building
+    it without the length raises."""
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSource
+
+    p = LoraParams(sf=7, cr=2, implicit_header=True)
+    payload = b"implicit block"
+    sig = np.concatenate([np.zeros(400, np.complex64), modulate_frame(payload, p),
+                          np.zeros(400, np.complex64)]).astype(np.complex64)
+    with pytest.raises(ValueError, match="implicit_payload_len"):
+        LoraReceiver(params=p)
+    rx = LoraReceiver(params=p, implicit_payload_len=len(payload))
+    fg = Flowgraph()
+    fg.connect_stream(VectorSource(sig), "out", rx, "in")
+    Runtime().run(fg)
+    assert rx.frames == [payload], rx.frames
