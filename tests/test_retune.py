@@ -129,7 +129,7 @@ def test_tpu_kernel_ctrl_port_retune():
     other while frames are in flight."""
     import time
     from futuresdr_tpu import Flowgraph, Runtime
-    from futuresdr_tpu.blocks import VectorSink, VectorSource
+    from futuresdr_tpu.blocks import Throttle, VectorSink, VectorSource
     from futuresdr_tpu.tpu import TpuKernel
     from futuresdr_tpu.types import Pmt
 
@@ -148,9 +148,12 @@ def test_tpu_kernel_ctrl_port_retune():
 
     fg = Flowgraph()
     src = VectorSource(x)
+    # pace the stream so the mid-flight retune lands before the tail is
+    # processed — without this, a loaded machine can drain all frames first
+    thr = Throttle(np.complex64, rate=250_000.0)
     tk = TpuKernel(stages, np.complex64, frame_size=16384, frames_in_flight=2)
     snk = VectorSink(np.float32)
-    fg.connect(src, tk, snk)
+    fg.connect(src, thr, tk, snk)
     rt = Runtime()
     running = rt.start(fg)
 
