@@ -12,7 +12,7 @@ Frame layout: ``n_pre`` upchirps, 2 sync-word chirps, 2.25 downchirps, then head
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -27,7 +27,8 @@ class LoraParams:
     sf: int = 7                 # spreading factor: 2^sf chips/symbol
     cr: int = 1                 # coding rate 4/(4+cr)
     n_preamble: int = 8
-    sync_word: int = 0x12
+    sync_word: Union[int, Tuple[int, ...]] = 0x12   # RX may accept several ids;
+    #   TX modulates the first (`frame_sync.rs:1098` initial_sync_words)
     has_crc: bool = True
     ldro: bool = False          # low-data-rate optimize: payload at sf-2 too
     implicit_header: bool = False   # no in-band header: RX must know length/cr/crc
@@ -104,9 +105,11 @@ def modulate_frame(payload: bytes, p: LoraParams) -> np.ndarray:
     up = _upchirp(n)
     down = _downchirp(n)
     parts = [np.tile(up, p.n_preamble)]
-    # sync word as two shifted chirps (gr-lora_sdr: nibbles ×8)
-    parts.append(_upchirp(n, ((p.sync_word >> 4) & 0xF) * 8))
-    parts.append(_upchirp(n, (p.sync_word & 0xF) * 8))
+    # sync word as two shifted chirps (gr-lora_sdr: nibbles ×8); a multi-id RX
+    # params object transmits its first id
+    w = p.sync_word[0] if isinstance(p.sync_word, tuple) else p.sync_word
+    parts.append(_upchirp(n, ((w >> 4) & 0xF) * 8))
+    parts.append(_upchirp(n, (w & 0xF) * 8))
     parts.append(np.concatenate([down, down, down[:n // 4]]))
     for s in encode_payload_symbols(payload, p):
         parts.append(_upchirp(n, int(s)))
@@ -387,6 +390,41 @@ def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams,
         hops += 1
     if hops == 0:
         return None                 # not on a preamble
+    # sync-word gate (`frame_sync.rs:1098-1101` known_valid_net_ids): the two sync
+    # chirps carry the network id as bins nibble*8, riding the same (f-d) offset as
+    # the preamble bin c_up — so (k - c_up) mod n is 8*nibble exactly, independent
+    # of CFO/timing. An unknown id is another network's frame: reject, like the
+    # reference. ``sync_word`` may be an int or a tuple of accepted ids.
+    valid = p.sync_word if isinstance(p.sync_word, tuple) else (p.sync_word,)
+
+    def sync_nibble(q: int):
+        k, conc = bin_conc(q, down)
+        r = (k - c_up) % n
+        s = int(round(r / 8.0)) % (n // 8)
+        err = min((r - 8 * s) % n, (8 * s - r) % n)
+        return s, err, conc
+
+    matched = noisy = False
+    for off in (0, n, 2 * n):       # the preamble walk can undershoot ≤2 chirps
+        q = pos + off
+        if q + 2 * n > len(samples):
+            break
+        s1, e1, c1 = sync_nibble(q)
+        s2, e2, c2 = sync_nibble(q + n)
+        if c1 < 0.10 or c2 < 0.10:
+            noisy = True            # too weak to judge the id: stay permissive
+            break
+        if any(s1 == ((w >> 4) & 0xF) and s2 == (w & 0xF) and e1 <= 2 and e2 <= 2
+               for w in valid):
+            matched = True
+            pos = q                 # re-anchor on the true sync position
+            break
+        if s1 != 0:
+            break                   # confident foreign id
+        # s1 == 0: first window still preamble-shaped (walk undershot — the pair
+        # may be (preamble, preamble) or the boundary (preamble, nib_hi)): slide
+    if not matched and not noisy:
+        return None
     pos += 2 * n                    # sync word chirps
     # downchirp section: dechirp against an upchirp to split CFO from timing
     f_bin = 0

@@ -273,3 +273,56 @@ def test_implicit_header_receiver_block():
     fg.connect_stream(VectorSource(sig), "out", rx, "in")
     Runtime().run(fg)
     assert rx.frames == [payload], rx.frames
+
+
+def test_sync_word_gate():
+    """Sync-word validation (`frame_sync.rs:1098-1101`): a frame from another
+    network (different sync word) is rejected; a tuple of accepted ids admits
+    any of them; the gate survives CFO + noise."""
+    rng = np.random.default_rng(11)
+
+    def impaired(payload, p):
+        sig = np.concatenate([np.zeros(300, np.complex64), modulate_frame(payload, p),
+                              np.zeros(300, np.complex64)])
+        sig = sig * np.exp(1j * (0.4 + 4e-5 * np.arange(len(sig))))
+        return (sig + 0.05 * (rng.standard_normal(len(sig))
+                              + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+
+    tx_pub = LoraParams(sf=7, cr=2, sync_word=0x34)     # public-network id
+    tx_prv = LoraParams(sf=7, cr=2, sync_word=0x12)
+    sig_pub = impaired(b"public net", tx_pub)
+    sig_prv = impaired(b"private net", tx_prv)
+
+    # private receiver: decodes its own, rejects the foreign id
+    rx_prv = LoraParams(sf=7, cr=2, sync_word=0x12)
+    s = detect_frames(sig_prv, rx_prv)[0]
+    r = demodulate_frame(sig_prv, s, rx_prv)
+    assert r is not None and r[0] == b"private net" and r[1]
+    s = detect_frames(sig_pub, rx_prv)[0]
+    assert demodulate_frame(sig_pub, s, rx_prv) is None, "foreign sync word accepted"
+
+    # multi-id receiver accepts both networks
+    rx_multi = LoraParams(sf=7, cr=2, sync_word=(0x12, 0x34))
+    for sig, want in ((sig_prv, b"private net"), (sig_pub, b"public net")):
+        s = detect_frames(sig, rx_multi)[0]
+        r = demodulate_frame(sig, s, rx_multi)
+        assert r is not None and r[0] == want and r[1]
+
+
+def test_sync_gate_survives_preamble_undershoot():
+    """A TX with a longer preamble than the RX expects leaves the walk short of
+    the sync chirps; the gate must slide to the true sync position instead of
+    misreading the boundary (preamble, nib_hi) pair as a foreign id. A params
+    object with a tuple sync_word must also transmit (first id)."""
+    rng = np.random.default_rng(21)
+    tx = LoraParams(sf=7, cr=2, n_preamble=12, sync_word=(0x12, 0x34))
+    rx = LoraParams(sf=7, cr=2, n_preamble=8, sync_word=0x12)
+    payload = b"long preamble"
+    sig = np.concatenate([np.zeros(300, np.complex64), modulate_frame(payload, tx),
+                          np.zeros(300, np.complex64)])
+    sig = sig * np.exp(1j * (0.5 + 3e-5 * np.arange(len(sig))))
+    sig = (sig + 0.05 * (rng.standard_normal(len(sig))
+                         + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+    ok = any((r := demodulate_frame(sig, s, rx)) is not None
+             and r[0] == payload and r[1] for s in detect_frames(sig, rx))
+    assert ok, "undershoot recovery failed"
