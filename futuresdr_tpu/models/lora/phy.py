@@ -34,6 +34,8 @@ class LoraParams:
     implicit_header: bool = False   # no in-band header: RX must know length/cr/crc
     #   a priori (`decoder.rs:36` — the reference's implicit_header mode); the
     #   first block is still the reduced-rate CR4/8 sf-2 block, all payload
+    soft_decoding: bool = False     # LLR demod + soft Hamming (`fft_demod.rs` soft
+    #   buffers): adds max-correlation candidates to the CRC arbitration
 
     @property
     def n(self) -> int:
@@ -133,6 +135,36 @@ def _block_cw(bins: np.ndarray, o, sf_app: int, cr: int, shift_bits: int,
     return coding.deinterleave_block(sym, sf_app, cr)
 
 
+def _soft_nibbles(mags: np.ndarray, o: int, sf_app: int, cr: int,
+                  reduced: bool, n: int) -> np.ndarray:
+    """Soft-decision decode of one interleave block (`fft_demod.rs` soft buffers +
+    `hamming_dec.rs:170-173` soft path).
+
+    Per symbol and bit, the LLR is max |X_k| over wire bins whose demapped value has
+    the bit set minus max over bins where it's clear; the diagonal deinterleaver is
+    applied to LLRs in closed form (cwLLR[r, j] = LLR[j, (r - j) mod sf_app]); each
+    codeword row picks the nibble whose Hamming codeword best correlates.
+    """
+    k = np.arange(n)
+    if reduced:
+        nq = n >> 2
+        v = coding.gray(((((k + 2) >> 2) % nq) - o) % nq)
+    else:
+        v = coding.gray((k - o) % n)
+    v &= (1 << sf_app) - 1
+    bits = ((v[None, :] >> np.arange(sf_app)[:, None]) & 1).astype(bool)  # [sf,n]
+    blk = len(mags)
+    llr = np.empty((blk, sf_app), dtype=np.float64)
+    for i in range(sf_app):
+        llr[:, i] = mags[:, bits[i]].max(axis=1) - mags[:, ~bits[i]].max(axis=1)
+    r_idx = np.arange(sf_app)[:, None]                       # codeword row
+    j_idx = np.arange(blk)[None, :]                          # bit position
+    cw_llr = llr[j_idx, (r_idx - j_idx) % sf_app]            # [sf_app, blk]
+    cb = coding.hamming_encode(np.arange(16, dtype=np.uint8), cr)
+    cb_sign = (2.0 * ((cb[:, None] >> np.arange(blk)[None, :]) & 1) - 1.0)  # [16,blk]
+    return np.argmax(cw_llr @ cb_sign.T, axis=1).astype(np.uint8)
+
+
 def _best_profile(bins: np.ndarray, starts, sf_app: int, cr: int, shift_bits: int,
                   n: int):
     """Arbitrate the per-symbol integer bin offset over one interleave block.
@@ -171,7 +203,8 @@ def _best_profile(bins: np.ndarray, starts, sf_app: int, cr: int, shift_bits: in
     return out
 
 
-def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] = None):
+def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] = None,
+                   mags: Optional[np.ndarray] = None):
     """Demodulated symbol bins → (payload, crc_ok, header) or None.
 
     Tracks residual symbol-timing drift (SFO, `frame_sync.rs` sfo_cum role): a clock
@@ -205,6 +238,11 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
         length, cr, has_crc = int(n_payload), p.cr, p.has_crc
         hdr_alts = [list(coding.hamming_decode(cw_, 4)[:sf_app_hdr])
                     for cw_, _, _ in hdr_cands]
+        if p.soft_decoding and mags is not None:
+            soft = list(_soft_nibbles(mags[:n_hdr_sym], o_hdr_q, sf_app_hdr, 4,
+                                      True, n)[:sf_app_hdr])
+            if soft not in hdr_alts:
+                hdr_alts.insert(0, soft)
     else:
         hdr_nibbles = coding.hamming_decode(hdr_cands[0][0], 4)
         parsed = coding.parse_header(hdr_nibbles[:5])
@@ -231,10 +269,11 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
     else:
         p_n = n
         pbins = bins
-        # the header's group offset pins the bin offset only to ±2 within a group;
-        # the first payload block re-searches the residual
+        # the header's group offset pins the bin offset only to ±2 within a group —
+        # and under noise o_hdr_q itself can be off by one group (±4 bins): the
+        # first payload block re-searches the residual wide enough to cover both
         o_run = 4 * o_hdr_q
-        first_starts = tuple(o_run + r for r in (0, 1, -1, 2, -2, 3, -3))
+        first_starts = tuple(o_run + r for r in (0, 1, -1, 2, -2, 3, -3, 4, -4, 5, -5))
 
     # per-block candidate nibble lists; the header block leads with its own alts
     block_alts: List[List[np.ndarray]] = [hdr_alts]
@@ -259,7 +298,21 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
             cached = ((o_run,), nxt[o_run])       # next iteration reuses this sweep
         else:
             o_run = cands[0][1]
-        block_alts.append([coding.hamming_decode(cw_, cr) for cw_, _, _ in cands])
+        alts = [coding.hamming_decode(cw_, cr) for cw_, _, _ in cands]
+        if p.soft_decoding and mags is not None:
+            # soft decode at each candidate end-offset, in candidate-preference
+            # order: the PREFERRED offset's soft leads (it equals the hard decode on
+            # clean signals, so no-CRC frames stay correct), hard profiles follow,
+            # and speculative other-offset softs trail as CRC-arbitrated fallbacks
+            offs = list(dict.fromkeys(o_end for _, o_end, _ in cands))
+            softs = [_soft_nibbles(mags[i:i + blk_len], o, sf_app, cr, p.ldro, n)
+                     for o in offs]
+            lead = [softs[0]] if not any(np.array_equal(softs[0], a)
+                                         for a in alts) else []
+            trail = [s for s in softs[1:]
+                     if not any(np.array_equal(s, a) for a in alts + lead)]
+            alts = lead + alts + trail
+        block_alts.append(alts)
 
     def assemble(choice) -> tuple:
         nibbles = []
@@ -276,10 +329,12 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
             crc_ok = coding.crc16(payload) == rx_crc
         return payload, crc_ok, (length, cr, has_crc)
 
-    # CRC arbitrates among the per-block ambiguities (bounded search)
+    # CRC arbitrates among the per-block ambiguities (bounded search; the soft
+    # candidates enlarge the per-block alternative sets, so the budget grows too)
     import itertools
+    cap = 4096 if (p.soft_decoding and mags is not None) else 1024
     first = None
-    for combo in itertools.islice(itertools.product(*block_alts), 1024):
+    for combo in itertools.islice(itertools.product(*block_alts), cap):
         r = assemble(combo)
         if r is None:
             return None
@@ -442,5 +497,8 @@ def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams,
         return None
     # raw argmax bins; decode_symbols absorbs the constant sync bias AND the per-symbol
     # clock drift (SFO) via parity-arbitrated offset tracking — see its docstring
-    bins = (np.argmax(np.abs(spec), axis=1) - f_bin) % n
-    return decode_symbols(bins, p, n_payload=n_payload)
+    amags = np.abs(spec)
+    bins = (np.argmax(amags, axis=1) - f_bin) % n
+    # soft path wants the spectra in the same de-rotated domain as the bins
+    mags = np.roll(amags, -f_bin, axis=1) if p.soft_decoding else None
+    return decode_symbols(bins, p, n_payload=n_payload, mags=mags)
