@@ -43,17 +43,23 @@ class AdsbReceiver(Kernel):
             if abs_start in self._seen:
                 continue
             msg = decode_frame(bits)
-            if msg is None or not msg.crc_ok:
+            if msg is None or not (msg.crc_ok or msg.icao_derived):
+                continue
+            ac = self.tracker.update(msg)
+            if msg.icao_derived and ac is None:
+                # AP-overlay frames can't be CRC-verified: only surface them for
+                # aircraft already acquired via a checked frame (tracker gate)
                 continue
             self._seen.add(abs_start)
             self.n_frames += 1
-            self.tracker.update(msg)
             mio.post("rx", Pmt.map({
                 "icao": msg.icao,
+                "df": msg.df,
                 "type_code": msg.type_code,
                 **({"callsign": msg.callsign} if msg.callsign else {}),
                 **({"altitude_ft": msg.altitude_ft}
                    if msg.altitude_ft is not None else {}),
+                **({"squawk": msg.squawk} if msg.squawk is not None else {}),
             }))
         keep = min(len(buf), self.OVERLAP)
         self._tail = buf[len(buf) - keep:].copy()

@@ -52,18 +52,65 @@ class AdsbMessage:
     type_code: int = 0
     callsign: Optional[str] = None
     altitude_ft: Optional[float] = None
+    squawk: Optional[str] = None
     cpr: Optional[tuple] = None         # (odd_flag, lat_cpr, lon_cpr)
     ground_speed_kt: Optional[float] = None
     track_deg: Optional[float] = None
     vertical_rate_fpm: Optional[float] = None
     crc_ok: bool = False
+    icao_derived: bool = False          # ICAO recovered from the AP overlay, not
+    #                                     CRC-verified (DF4/5/20/21)
+
+
+def _ac13_feet(f: np.ndarray) -> Optional[float]:
+    """13-bit Mode S altitude code (AC) → feet. Q=1: 25 ft LSB grid; M (metric)
+    and Q=0 Gillham codings are rare — return None rather than guess."""
+    if int(f[6]):                        # M bit: metric altitude, not decoded
+        return None
+    if not int(f[8]):                    # Q=0: 100 ft Gillham gray code
+        return None
+    n = _bits_to_int(np.concatenate([f[:6], f[7:8], f[9:]]))
+    return n * 25 - 1000
+
+
+def _id13_squawk(f: np.ndarray) -> str:
+    """13-bit identity code (Gillham order C1 A1 C2 A2 C4 A4 X B1 D1 B2 D2 B4 D4)
+    → 4-digit squawk string."""
+    c1, a1, c2, a2, c4, a4, _, b1, d1, b2, d2, b4, d4 = (int(b) for b in f)
+    a = a4 * 4 + a2 * 2 + a1
+    b = b4 * 4 + b2 * 2 + b1
+    c = c4 * 4 + c2 * 2 + c1
+    d = d4 * 4 + d2 * 2 + d1
+    return f"{a}{b}{c}{d}"
 
 
 def decode_frame(bits: np.ndarray) -> Optional[AdsbMessage]:
-    """Decode a 112-bit DF17/18 extended squitter (56-bit frames: header only)."""
+    """Decode Mode S downlink frames: DF17/18 extended squitter (identification,
+    CPR position, velocity), DF11 all-call (acquisition), and the surveillance
+    replies DF4/20 (altitude) / DF5/21 (identity) whose ICAO rides the AP parity
+    overlay (address ⊕ parity ⇒ the CRC remainder IS the address)."""
     if len(bits) < 56:
         return None
     df = _bits_to_int(bits[0:5])
+    if df in (4, 5, 20, 21):
+        nb = 112 if df in (20, 21) else 56
+        if len(bits) < nb:
+            return None
+        # crc_ok stays False: no parity check can run when the AP field is the
+        # parity ⊕ address overlay — consumers gate these via icao_derived
+        msg = AdsbMessage(df=df, icao=crc24(bits[:nb]), icao_derived=True)
+        field = bits[19:32]
+        if df in (4, 20):
+            msg.altitude_ft = _ac13_feet(field)
+        else:
+            msg.squawk = _id13_squawk(field)
+        return msg
+    if df == 11:
+        # acquisition squitter: PI = parity (remainder 0); an interrogator-
+        # addressed reply leaves the 7-bit IC in the low remainder bits
+        rem = crc24(bits[:56])
+        return AdsbMessage(df=df, icao=_bits_to_int(bits[8:32]),
+                           crc_ok=(rem & ~0x7F) == 0)
     if df not in (17, 18) or len(bits) < 112:
         icao = _bits_to_int(bits[8:32]) if len(bits) >= 32 else 0
         return AdsbMessage(df=df, icao=icao, crc_ok=False)
@@ -161,6 +208,7 @@ def cpr_global_decode(even: tuple, odd: tuple, most_recent_odd: bool = True):
 class Aircraft:
     icao: int
     callsign: Optional[str] = None
+    squawk: Optional[str] = None
     altitude_ft: Optional[float] = None
     lat: Optional[float] = None
     lon: Optional[float] = None
@@ -181,14 +229,20 @@ class Tracker:
         self.timeout = timeout_s
 
     def update(self, msg: AdsbMessage, now: Optional[float] = None) -> Optional[Aircraft]:
-        if not msg.crc_ok:
+        if not msg.crc_ok and not msg.icao_derived:
             return None
         now = time.monotonic() if now is None else now
+        if msg.icao_derived and msg.icao not in self.aircraft:
+            # AP-overlay addresses are not CRC-verified: only update aircraft
+            # already acquired via a checked frame (DF11/17/18), never create
+            return None
         ac = self.aircraft.setdefault(msg.icao, Aircraft(icao=msg.icao))
         ac.last_seen = now
         ac.n_messages += 1
         if msg.callsign:
             ac.callsign = msg.callsign
+        if msg.squawk is not None:
+            ac.squawk = msg.squawk
         if msg.altitude_ft is not None:
             ac.altitude_ft = msg.altitude_ft
         if msg.ground_speed_kt is not None:

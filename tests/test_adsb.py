@@ -125,3 +125,81 @@ def test_noisy_burst_train_exact_once():
     msgs = [m for _, b in decoded
             if (m := decode_frame(b)) is not None and m.crc_ok]
     assert [m.icao for m in msgs] == sent
+
+
+def _hexbits(h):
+    v = int(h, 16)
+    n = len(h) * 4
+    return np.array([(v >> (n - 1 - i)) & 1 for i in range(n)], dtype=np.uint8)
+
+
+def _df11_frame(icao):
+    """Parity-consistent DF11 acquisition squitter for the given address."""
+    from futuresdr_tpu.models.adsb.decoder import crc24
+    head = np.zeros(32, dtype=np.uint8)
+    head[0:5] = [0, 1, 0, 1, 1]                     # DF=11
+    head[8:32] = [(icao >> (23 - i)) & 1 for i in range(24)]
+    rem = crc24(np.concatenate([head, np.zeros(24, np.uint8)]))
+    return np.concatenate([head, np.array([(rem >> (23 - i)) & 1
+                                           for i in range(24)], np.uint8)])
+
+
+def test_surveillance_replies_published_vectors():
+    """DF4/DF5 surveillance replies (published pyModeS vectors): altitude and
+    squawk decode, with the ICAO recovered from the AP parity overlay."""
+    m = decode_frame(_hexbits("2000171806A983"))
+    assert m.df == 4 and m.altitude_ft == 36000 and m.icao_derived
+    assert m.icao == 0x4CA7E8
+    m = decode_frame(_hexbits("2A00516D492B80"))
+    assert m.df == 5 and m.squawk == "0356" and m.icao_derived
+
+
+def test_df11_all_call_roundtrip():
+    """A parity-consistent DF11 acquisition squitter validates and yields the
+    announced ICAO; a corrupted one fails the CRC gate."""
+    icao = 0x4840D6
+    frame = _df11_frame(icao)
+    m = decode_frame(frame)
+    assert m.df == 11 and m.crc_ok and m.icao == icao and not m.icao_derived
+    bad = frame.copy(); bad[40] ^= 1
+    assert not decode_frame(bad).crc_ok
+
+
+def test_tracker_gates_derived_icao():
+    """AP-overlay (unverified) addresses must never create aircraft — only
+    update ones already acquired through a CRC-checked frame."""
+    from futuresdr_tpu.models.adsb.decoder import Tracker
+    t = Tracker()
+    alt = decode_frame(_hexbits("2000171806A983"))          # DF4, derived icao
+    assert t.update(alt, now=0.0) is None and not t.aircraft
+    # acquire via a valid DF11, then the DF4 altitude applies
+    assert t.update(decode_frame(_df11_frame(alt.icao)), now=1.0) is not None
+    ac = t.update(alt, now=2.0)
+    assert ac is not None and ac.altitude_ft == 36000
+    # identity reply fills the squawk on the same aircraft-acquisition rule
+    sq = decode_frame(_hexbits("2A00516D492B80"))
+    assert t.update(sq, now=3.0) is None                    # unknown icao: gated
+
+
+def test_receiver_block_mode_s_surveillance():
+    """Streamed DF11 acquisition then DF4 altitude updates the tracker; an
+    AP-overlay reply for an unknown aircraft is gated (not posted, not counted)."""
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSource
+    from futuresdr_tpu.models.adsb import AdsbReceiver
+    from futuresdr_tpu.models.adsb.phy import modulate_frame
+
+    icao = 0x4CA7E8
+    df11 = _df11_frame(icao)
+    parts = [np.zeros(400, np.float32)]
+    for bits in (_hexbits("2A00516D492B80"),    # DF5, unknown icao: gated
+                 df11, _hexbits("2000171806A983")):
+        parts += [modulate_frame(bits, amplitude=2.0), np.zeros(300, np.float32)]
+    rx = AdsbReceiver()
+    fg = Flowgraph()
+    fg.connect_stream(VectorSource(np.concatenate(parts).astype(np.float32)),
+                      "out", rx, "in")
+    Runtime().run(fg)
+    assert rx.n_frames == 2
+    assert rx.tracker.aircraft[icao].altitude_ft == 36000
+    assert 0x510AF9 not in rx.tracker.aircraft
