@@ -18,10 +18,11 @@ class AdsbReceiver(Kernel):
 
     OVERLAP = 1024
 
-    def __init__(self, threshold: float = 3.0):
+    def __init__(self, threshold: float = 3.0, ref_pos=None):
         super().__init__()
         self.threshold = threshold
-        self.tracker = Tracker()
+        # ref_pos = receiver site (lat, lon): single-message local CPR decode
+        self.tracker = Tracker(ref_pos=ref_pos)
         self.n_frames = 0
         self._tail = np.zeros(0, np.float32)
         self._tail_abs = 0

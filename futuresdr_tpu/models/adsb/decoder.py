@@ -15,7 +15,7 @@ from typing import Dict, Optional
 import numpy as np
 
 __all__ = ["crc24", "decode_frame", "AdsbMessage", "Tracker", "Aircraft",
-           "cpr_global_decode"]
+           "cpr_global_decode", "cpr_local_decode"]
 
 _CRC24_POLY = 0xFFF409
 
@@ -204,6 +204,36 @@ def cpr_global_decode(even: tuple, odd: tuple, most_recent_odd: bool = True):
     return lat, lon
 
 
+def _dist_nm(lat1: float, lon1: float, lat2: float, lon2: float) -> float:
+    """Great-circle distance in nautical miles (haversine)."""
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dp = p2 - p1
+    dl = math.radians(lon2 - lon1)
+    a = math.sin(dp / 2) ** 2 + math.cos(p1) * math.cos(p2) * math.sin(dl / 2) ** 2
+    return 2 * 3440.065 * math.asin(min(1.0, math.sqrt(a)))
+
+
+def cpr_local_decode(cpr: tuple, ref_lat: float, ref_lon: float):
+    """Locally-unambiguous position from a SINGLE CPR message plus a reference
+    position within 180 NM (the standard receiver-site-aided decode): the
+    reference selects the CPR zone, the message supplies the in-zone fraction.
+    """
+    odd, lat_cpr, lon_cpr = cpr
+    yz = lat_cpr / 131072.0
+    dlat = 360.0 / (59 if odd else 60)
+    j = math.floor(ref_lat / dlat) + math.floor(
+        0.5 + (ref_lat % dlat) / dlat - yz)
+    lat = dlat * (j + yz)
+    nl = _cpr_nl(lat)
+    ni = max(nl - (1 if odd else 0), 1)
+    dlon = 360.0 / ni
+    xz = lon_cpr / 131072.0
+    m = math.floor(ref_lon / dlon) + math.floor(
+        0.5 + (ref_lon % dlon) / dlon - xz)
+    lon = dlon * (m + xz)
+    return lat, ((lon + 180.0) % 360.0) - 180.0   # same [-180, 180) as global
+
+
 @dataclass
 class Aircraft:
     icao: int
@@ -224,9 +254,12 @@ class Aircraft:
 class Tracker:
     """Aircraft registry fed by decoded messages (`tracker.rs` role)."""
 
-    def __init__(self, timeout_s: float = 60.0):
+    def __init__(self, timeout_s: float = 60.0,
+                 ref_pos: Optional[tuple] = None):
         self.aircraft: Dict[int, Aircraft] = {}
         self.timeout = timeout_s
+        # receiver site (lat, lon): enables single-message local CPR decode
+        self.ref_pos = ref_pos
 
     def update(self, msg: AdsbMessage, now: Optional[float] = None) -> Optional[Aircraft]:
         if not msg.crc_ok and not msg.icao_derived:
@@ -255,10 +288,17 @@ class Tracker:
                 ac._cpr_odd = msg.cpr
             else:
                 ac._cpr_even = msg.cpr
+            pos = None
             if ac._cpr_even and ac._cpr_odd:
                 pos = cpr_global_decode(ac._cpr_even, ac._cpr_odd, bool(odd))
-                if pos is not None:
-                    ac.lat, ac.lon = pos
+            if pos is None and self.ref_pos is not None:
+                # local decode is unambiguous only within ~half a zone of the
+                # site: range-check before accepting (as real decoders do)
+                cand = cpr_local_decode(msg.cpr, *self.ref_pos)
+                if _dist_nm(*cand, *self.ref_pos) < 180.0:
+                    pos = cand
+            if pos is not None:
+                ac.lat, ac.lon = pos
         self._expire(now)
         return ac
 

@@ -203,3 +203,40 @@ def test_receiver_block_mode_s_surveillance():
     assert rx.n_frames == 2
     assert rx.tracker.aircraft[icao].altitude_ft == 36000
     assert 0x510AF9 not in rx.tracker.aircraft
+
+
+def test_cpr_local_decode_with_reference():
+    """Receiver-site-aided single-message position (canonical 1090-riddle
+    vectors): the even frame with a nearby reference reproduces the global-pair
+    solution; a ref_pos-equipped tracker gets a position from ONE message."""
+    from futuresdr_tpu.models.adsb.decoder import Tracker, cpr_local_decode
+    me = decode_frame(_hexbits(POS_EVEN))
+    lat, lon = cpr_local_decode(me.cpr, 52.25, 3.92)
+    assert abs(lat - 52.2572021) < 1e-6 and abs(lon - 3.9193725) < 1e-6
+    mo = decode_frame(_hexbits(POS_ODD))
+    lat, lon = cpr_local_decode(mo.cpr, 52.25, 3.92)
+    assert abs(lat - 52.2657801) < 1e-6 and abs(lon - 3.9389125) < 1e-6
+
+    t = Tracker(ref_pos=(52.25, 3.92))
+    ac = t.update(me, now=0.0)
+    assert ac.lat is not None and abs(ac.lat - 52.2572021) < 1e-6
+    t2 = Tracker()                       # without a reference: needs the pair
+    assert t2.update(me, now=0.0).lat is None
+
+
+def test_cpr_local_decode_guards():
+    """Local decode wraps longitude to [-180, 180) and the tracker rejects
+    local solutions landing beyond the 180 NM unambiguity range of the site
+    (zone-corner decodes; aliasing by a whole zone is undetectable from one
+    message — that is inherent to receiver-aided CPR)."""
+    from futuresdr_tpu.models.adsb.decoder import (Tracker, cpr_local_decode,
+                                                   _dist_nm)
+    lat, lon = cpr_local_decode((0, 60000, 1500), 45.0, 179.98)
+    assert -180.0 <= lon < 180.0
+    # a site whose zone estimate throws the solution >180 NM out: rejected
+    me = decode_frame(_hexbits(POS_EVEN))
+    ref = (48.6, -2.0)
+    cand = cpr_local_decode(me.cpr, *ref)
+    assert _dist_nm(*cand, *ref) > 180.0          # the guard's trigger condition
+    t = Tracker(ref_pos=ref)
+    assert t.update(me, now=0.0).lat is None, "out-of-range local CPR accepted"
