@@ -3,10 +3,13 @@ Golay(24,12), CRC16, K=5 convolutional code, LSF framing, 4FSK RRC PHY."""
 
 from .codec import (encode_callsign, decode_callsign, crc16_m17, golay24_encode,
                     golay24_decode, conv_encode_m17, viterbi_decode_m17)
-from .phy import Lsf, build_lsf_frame, modulate, demodulate_stream, SYNC_LSF
+from .phy import (Lsf, build_lsf_frame, build_stream_frames, modulate,
+                  demodulate_stream, demodulate_payload_stream, SYNC_LSF,
+                  SYNC_STR)
 from .blocks import M17Transmitter, M17Receiver
 
 __all__ = ["encode_callsign", "decode_callsign", "crc16_m17", "golay24_encode",
            "golay24_decode", "conv_encode_m17", "viterbi_decode_m17",
-           "Lsf", "build_lsf_frame", "modulate", "demodulate_stream", "SYNC_LSF",
-           "M17Transmitter", "M17Receiver"]
+           "Lsf", "build_lsf_frame", "build_stream_frames", "modulate",
+           "demodulate_stream", "demodulate_payload_stream", "SYNC_LSF",
+           "SYNC_STR", "M17Transmitter", "M17Receiver"]

@@ -12,7 +12,8 @@ import numpy as np
 
 from ...runtime.kernel import Kernel, message_handler
 from ...types import Pmt
-from .phy import Lsf, SPS, build_lsf_frame, demodulate_stream, modulate
+from .phy import (Lsf, SPS, build_lsf_frame, build_stream_frames,
+                  demodulate_payload_stream, demodulate_stream, modulate)
 
 __all__ = ["M17Transmitter", "M17Receiver"]
 
@@ -40,9 +41,14 @@ class M17Transmitter(Kernel):
             lsf = Lsf(dst=m.get("dst", Pmt.string("@ALL")).to_str(),
                       src=m.get("src", Pmt.string(self.src_callsign)).to_str(),
                       meta=m["meta"].to_blob() if "meta" in m else bytes(14))
+            payload = m["payload"].to_blob() if "payload" in m else None
         except Exception:
             return Pmt.invalid_value()
-        wave = modulate(build_lsf_frame(lsf))
+        # a payload selects stream mode (LSF + LICH-chunked payload frames);
+        # without one this is the plain LSF beacon
+        syms = (build_stream_frames(lsf, payload) if payload is not None
+                else build_lsf_frame(lsf))
+        wave = modulate(syms)
         self._pending.append(np.concatenate([wave, np.zeros(self.gap, np.float32)]))
         io.call_again = True
         return Pmt.ok()
@@ -68,14 +74,24 @@ class M17Transmitter(Kernel):
 
 
 class M17Receiver(Kernel):
-    """4FSK baseband stream → decoded LSF messages on ``rx``."""
+    """4FSK baseband stream → decoded LSF beacons and stream transmissions on
+    ``rx`` (payload transmissions carry a ``payload`` blob).
 
-    def __init__(self):
+    ``max_payload_frames`` bounds a stream transmission's length (it sizes the
+    inter-window overlap; `decoder.rs` streams unbounded because its state
+    machine is per-frame — here the window must hold a whole transmission).
+    """
+
+    def __init__(self, max_payload_frames: int = 16):
         super().__init__()
-        self.OVERLAP = (8 + 184 + 16) * SPS + 200
+        n_stream = (8 + 48 + 136) * SPS
+        self.OVERLAP = (8 + 184 + 16) * SPS + 200 + max_payload_frames * n_stream
         self.frames = []
+        self.transmissions = []
         self._tail = np.zeros(0, np.float32)
-        self._recent = deque(maxlen=8)
+        # a finished transmission stays inside the (large) tail across many
+        # windows: the dedup memory must outlive it even on a busy channel
+        self._recent = deque(maxlen=16 + 4 * max_payload_frames)
         self.input = self.add_stream_input("in", np.float32, min_items=64 * SPS)
         self.add_message_output("rx")
 
@@ -95,6 +111,19 @@ class M17Receiver(Kernel):
             self.frames.append(lsf)
             mio.post("rx", Pmt.map({"dst": lsf.dst, "src": lsf.src,
                                     "meta": Pmt.blob(lsf.meta)}))
+        for lsf, payload, complete in demodulate_payload_stream(buf):
+            if not complete:
+                # EOS not seen (still arriving) or fn-gapped (truncated by the
+                # window or torn by noise): never surface a partial transmission
+                continue
+            key = (lsf.to_bytes() if lsf else b"?") + payload
+            if key in self._recent:
+                continue
+            self._recent.append(key)
+            self.transmissions.append((lsf, payload))
+            mio.post("rx", Pmt.map({
+                **({"dst": lsf.dst, "src": lsf.src} if lsf else {}),
+                "payload": Pmt.blob(payload)}))
         keep = min(len(buf), self.OVERLAP)
         self._tail = buf[len(buf) - keep:].copy()
         self.input.consume(n)

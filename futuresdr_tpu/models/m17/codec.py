@@ -210,3 +210,57 @@ def depuncture_p1(llrs: np.ndarray, n_coded: int) -> np.ndarray:
     pos = np.nonzero(mask)[0][:len(llrs)]
     full[pos] = llrs[:len(pos)]
     return full
+
+
+# P2 puncture matrix for stream frames: drop every 12th bit, 296 coded
+# (FN+payload+flush) → 272 transmitted (M17 spec §2.5.2, `encoder.rs` P2 role)
+_P2 = np.array([1] * 11 + [0], dtype=bool)
+
+
+def puncture_p2(coded: np.ndarray) -> np.ndarray:
+    mask = np.resize(_P2, len(coded))
+    return coded[mask]
+
+
+def depuncture_p2(llrs: np.ndarray, n_coded: int) -> np.ndarray:
+    mask = np.resize(_P2, n_coded)
+    full = np.zeros(n_coded, dtype=np.float64)
+    pos = np.nonzero(mask)[0][:len(llrs)]
+    full[pos] = llrs[:len(pos)]
+    return full
+
+
+def lich_encode(lsf_bytes: bytes, index: int) -> np.ndarray:
+    """One LICH chunk: 5 LSF bytes + (index << 5) byte → 4 Golay(24,12) words
+    = 96 bits (`encoder.rs:232-249`)."""
+    chunk = list(lsf_bytes[5 * index:5 * index + 5]) + [index << 5]
+    words = [(chunk[0] << 4) | (chunk[1] >> 4),
+             ((chunk[1] & 0x0F) << 8) | chunk[2],
+             (chunk[3] << 4) | (chunk[4] >> 4),
+             ((chunk[4] & 0x0F) << 8) | chunk[5]]
+    out = np.zeros(96, dtype=np.uint8)
+    for i, w in enumerate(words):
+        g = golay24_encode(w)
+        out[24 * i:24 * (i + 1)] = [(g >> (23 - j)) & 1 for j in range(24)]
+    return out
+
+
+def lich_decode(bits: np.ndarray):
+    """96 LICH bits → (index, 5 LSF bytes) or None if any Golay word fails."""
+    words = []
+    for i in range(4):
+        w = 0
+        for j in range(24):
+            w = (w << 1) | int(bits[24 * i + j])
+        d = golay24_decode(w)
+        if d is None:
+            return None
+        words.append(d)
+    chunk = [words[0] >> 4, ((words[0] & 0xF) << 4) | (words[1] >> 8),
+             words[1] & 0xFF, words[2] >> 4,
+             ((words[2] & 0xF) << 4) | (words[3] >> 8), words[3] & 0xFF]
+    # byte 5 is (index << 5): a nonzero low field or index > 5 is not a LICH —
+    # this also rejects correlation sidelobes that Golay "corrects" into garbage
+    if chunk[5] & 0x1F or (chunk[5] >> 5) > 5:
+        return None
+    return chunk[5] >> 5, bytes(chunk[:5])

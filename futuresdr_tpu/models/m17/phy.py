@@ -15,11 +15,13 @@ import numpy as np
 from ...dsp import firdes
 from . import codec
 
-__all__ = ["Lsf", "build_lsf_frame", "modulate", "demodulate_stream", "SPS",
-           "SYNC_LSF"]
+__all__ = ["Lsf", "build_lsf_frame", "build_stream_frames", "modulate",
+           "demodulate_stream", "demodulate_payload_stream", "SPS",
+           "SYNC_LSF", "SYNC_STR"]
 
 SPS = 10                      # samples per symbol
 SYNC_LSF = 0x55F7             # LSF sync word (M17 spec §3.2)
+SYNC_STR = 0xFF5D             # stream-frame sync word
 
 _DIBIT_TO_SYM = {0b01: 3.0, 0b00: 1.0, 0b10: -1.0, 0b11: -3.0}
 _SYM_LEVELS = np.array([3.0, 1.0, -1.0, -3.0])
@@ -78,6 +80,33 @@ def build_lsf_frame(lsf: Lsf) -> np.ndarray:
     return np.concatenate([_sync_symbols(SYNC_LSF), syms])
 
 
+def _dibits_to_syms(bits: np.ndarray) -> np.ndarray:
+    dib = bits.reshape(-1, 2)
+    return np.array([_DIBIT_TO_SYM[(a << 1) | b] for a, b in dib])
+
+
+def build_stream_frames(lsf: Lsf, payload: bytes) -> np.ndarray:
+    """Stream mode (`encoder.rs:226-289`): LSF frame, then one 192-symbol frame
+    per 16-byte payload chunk — sync + Golay-coded LICH (1/6 of the LSF, cycling)
+    + conv-coded P2-punctured (frame-number ‖ chunk); the last frame sets the
+    EOS bit (0x8000) in its frame number."""
+    lsf_bytes = lsf.to_bytes()
+    chunks = [payload[i:i + 16] for i in range(0, max(len(payload), 1), 16)]
+    parts = [build_lsf_frame(lsf)]
+    for fn, chunk in enumerate(chunks):
+        lich_bits = codec.lich_encode(lsf_bytes, fn % 6)
+        # frame numbers wrap below the EOS bit (real M17 wraps at 0x8000; a
+        # >512 KiB transmission will mis-sort on reassembly, but never crash)
+        fn_field = (fn % 0x8000) | (0x8000 if fn == len(chunks) - 1 else 0)
+        body = fn_field.to_bytes(2, "big") + chunk.ljust(16, b"\x00")
+        bits = np.concatenate([_bits(body), np.zeros(4, np.uint8)])   # 148
+        punct = codec.puncture_p2(codec.conv_encode_m17(bits))        # 272
+        parts.append(np.concatenate([_sync_symbols(SYNC_STR),
+                                     _dibits_to_syms(lich_bits),
+                                     _dibits_to_syms(punct)]))
+    return np.concatenate(parts)
+
+
 def _rrc(sps: int = SPS, span: int = 8, rolloff: float = 0.5) -> np.ndarray:
     return firdes.root_raised_cosine(span, sps, rolloff)
 
@@ -91,17 +120,31 @@ def modulate(symbols: np.ndarray, sps: int = SPS) -> np.ndarray:
 
 
 def demodulate_stream(samples: np.ndarray, sps: int = SPS) -> List[Lsf]:
-    """Matched filter → sync correlation → symbol slicing → depuncture/Viterbi/CRC."""
+    """Matched filter → sync correlation → symbol slicing → depuncture/Viterbi/CRC;
+    LSF frames in time order (see ``_lsf_positions`` for the scan itself)."""
+    return [lsf for _, lsf in _lsf_positions(samples, sps)]
+
+
+def _hard_bits(syms: np.ndarray) -> np.ndarray:
+    """Symbols → hard dibits (level map: 3→01, 1→00, −1→10, −3→11)."""
+    out = np.empty(2 * len(syms), dtype=np.uint8)
+    out[0::2] = (syms < 0).astype(np.uint8)
+    out[1::2] = (np.abs(syms) > 2).astype(np.uint8)
+    return out
+
+
+def demodulate_payload_stream(samples: np.ndarray, sps: int = SPS):
+    """Stream-mode receiver (`decoder.rs` role): returns [(lsf, payload)] per
+    transmission. Frames are gated by their LICH Golay decode; the LSF comes from
+    the link-setup frame when decodable, else reassembled from the six cycling
+    LICH chunks (CRC-checked either way)."""
     h = _rrc(sps)
     mf = np.convolve(samples.astype(np.float64), h, mode="full")
-    # matched filter pair has unit peak at symbol instants after normalization
     gain = np.sum(h * h) if len(h) else 1.0
     delay = len(h) - 1
-    sync = _sync_symbols(SYNC_LSF)
-    n_frame_syms = 8 + 184
-    found: List[tuple] = []                # (sample_position, Lsf)
-    seen: set = set()                      # serialized LSFs (one to_bytes each)
-    # correlate sync at symbol-rate hypotheses over all sample phases
+    sync = _sync_symbols(SYNC_STR)
+    n_frame_syms = 8 + 48 + 136
+    hits: List[tuple] = []                 # (norm, pos, fn, eos, chunk, lich)
     for phase in range(sps):
         sym_stream = mf[delay + phase::sps] / gain
         if len(sym_stream) < n_frame_syms:
@@ -110,18 +153,114 @@ def demodulate_stream(samples: np.ndarray, sps: int = SPS) -> List[Lsf]:
         e = np.convolve(sym_stream ** 2, np.ones(8), mode="full")[7:7 + len(c)]
         norm = c / np.maximum(np.sqrt(e * np.sum(sync ** 2)), 1e-9)
         for idx in np.nonzero(norm > 0.9)[0]:
-            frame_syms = sym_stream[idx + 8: idx + n_frame_syms]
-            if len(frame_syms) < 184:
+            syms = sym_stream[idx + 8: idx + n_frame_syms]
+            if len(syms) < 48 + 136:
                 continue
-            lsf = _decode_lsf_symbols(frame_syms)
-            if lsf is not None:
-                raw = lsf.to_bytes()
-                if raw not in seen:
-                    seen.add(raw)
-                    found.append((idx * sps + phase, lsf))
-    # the phase loop visits frames phase-major — return them in TIME order, as
-    # a streaming receiver must
-    return [lsf for _, lsf in sorted(found, key=lambda t: t[0])]
+            lich = codec.lich_decode(_hard_bits(syms[:48]))
+            if lich is None:
+                continue                    # Golay gate: not a real stream frame
+            d = -np.abs(syms[48:, None] - _SYM_LEVELS[None, :]) ** 2
+            msb = np.maximum(d[:, 2], d[:, 3]) - np.maximum(d[:, 0], d[:, 1])
+            lsb = np.maximum(d[:, 0], d[:, 3]) - np.maximum(d[:, 1], d[:, 2])
+            llrs = np.empty(2 * 136)
+            llrs[0::2] = msb
+            llrs[1::2] = lsb
+            bits = codec.viterbi_decode_m17(codec.depuncture_p2(llrs, 296), 148)
+            body = np.packbits(bits[:144]).tobytes()
+            fn_field = int.from_bytes(body[:2], "big")
+            hits.append((float(norm[idx]), idx * sps + phase, fn_field & 0x7FFF,
+                         bool(fn_field & 0x8000), body[2:18], lich))
+    # a correlation sidelobe or off-phase hit can pass the Golay gate while
+    # garbling the un-CRC'd payload: non-maximum suppression in time keeps only
+    # the best-correlated hit within each frame-length window
+    hits.sort(key=lambda t: -t[0])
+    min_gap = n_frame_syms * sps * 3 // 4
+    accepted: List[tuple] = []
+    for hit in hits:
+        if all(abs(hit[1] - a[1]) >= min_gap for a in accepted):
+            accepted.append(hit)
+    frames = {a[1]: a[1:] for a in accepted}
+    # group frames into transmissions (EOS closes a group)
+    lsfs = dict(_lsf_positions(samples, sps, content_dedup=False))
+    out = []
+    group: List[tuple] = []
+    for key in sorted(frames):
+        group.append(frames[key])
+        if group[-1][2]:                   # EOS
+            out.append(_finish_group(group, lsfs))
+            group = []
+    if group:
+        out.append(_finish_group(group, lsfs))
+    return out
+
+
+def _lsf_positions(samples: np.ndarray, sps: int, content_dedup: bool = True):
+    """LSF frames with their sample positions, in time order.
+
+    ``content_dedup=True`` is the ``demodulate_stream`` semantic: each distinct
+    LSF once per buffer. ``False`` keeps every occurrence (deduped only across
+    sample phases of the same frame) — stream-mode attribution needs the
+    repeated link-setup frame before EACH transmission, even when identical.
+    """
+    h = _rrc(sps)
+    mf = np.convolve(samples.astype(np.float64), h, mode="full")
+    gain = np.sum(h * h) if len(h) else 1.0
+    delay = len(h) - 1
+    sync = _sync_symbols(SYNC_LSF)
+    n_frame_syms = 8 + 184
+    found = []
+    seen = set()
+    for phase in range(sps):
+        sym_stream = mf[delay + phase::sps] / gain
+        if len(sym_stream) < n_frame_syms:
+            continue
+        c = np.correlate(sym_stream, sync, mode="valid")
+        e = np.convolve(sym_stream ** 2, np.ones(8), mode="full")[7:7 + len(c)]
+        norm = c / np.maximum(np.sqrt(e * np.sum(sync ** 2)), 1e-9)
+        for idx in np.nonzero(norm > 0.9)[0]:
+            syms = sym_stream[idx + 8: idx + n_frame_syms]
+            if len(syms) < 184:
+                continue
+            lsf = _decode_lsf_symbols(syms)
+            if lsf is None:
+                continue
+            pos = idx * sps + phase
+            key = (lsf.to_bytes() if content_dedup
+                   else pos // (n_frame_syms * sps // 2))
+            if key not in seen:
+                seen.add(key)
+                found.append((pos, lsf))
+    return sorted(found)
+
+
+def _finish_group(group, lsfs) -> tuple:
+    """Frames of one transmission → (Lsf | None, payload in FN order, complete).
+
+    ``complete`` is True iff the group closed with an EOS frame AND its frame
+    numbers form the contiguous run 0..k — a truncated or gapped group must not
+    masquerade as a whole transmission (a window that catches only the tail of
+    one would otherwise emit a silently corrupted payload)."""
+    start = group[0][0]
+    lsf = None
+    # the link-setup frame immediately precedes frame 0: only attribute an LSF
+    # that is adjacent to this group, never an unrelated earlier beacon
+    max_lsf_gap = (8 + 184 + 40) * SPS
+    for pos, cand in sorted(lsfs.items()):
+        if pos <= start and start - pos <= max_lsf_gap:
+            lsf = cand
+    if lsf is None:
+        # reassemble from the cycling Golay-protected LICH chunks; the LSF CRC
+        # (checked in Lsf.from_bytes) arbitrates
+        chunks = {}
+        for _, _, _, _, (li, five) in group:
+            chunks.setdefault(li, five)
+        if set(chunks) == set(range(6)):
+            lsf = Lsf.from_bytes(b"".join(chunks[i] for i in range(6)))
+    ordered = sorted(group, key=lambda f: f[1])
+    payload = b"".join(c for _, _, _, c, _ in ordered)
+    fns = [f[1] for f in ordered]
+    complete = group[-1][2] and fns == list(range(len(fns)))
+    return lsf, payload, complete
 
 
 def _decode_lsf_symbols(syms: np.ndarray) -> Optional[Lsf]:

@@ -119,3 +119,89 @@ def test_stream_returns_frames_in_time_order():
     sig = (sig + 0.08 * rng.standard_normal(len(sig))).astype(np.float32)
     got = [l.src for l in demodulate_stream(sig)]
     assert got == sent, got
+
+
+def test_stream_mode_loopback():
+    """Stream mode (`encoder.rs:226-289`): LSF + LICH-chunked payload frames
+    with P2-punctured conv coding and EOS; two noisy transmissions decode
+    exactly once each, in time order."""
+    from futuresdr_tpu.models.m17 import (Lsf, build_stream_frames, modulate,
+                                          demodulate_payload_stream)
+    rng = np.random.default_rng(4)
+    lsf = Lsf(dst="SP5WWP", src="N0CALL")
+    pl_a = b"M17 stream mode carries voice or data frames end to end!"
+    pl_b = b"second transmission"
+    parts = [np.zeros(400, np.float32)]
+    for pl in (pl_a, pl_b):
+        parts += [modulate(build_stream_frames(lsf, pl)).astype(np.float32),
+                  np.zeros(700, np.float32)]
+    x = np.concatenate(parts)
+    x = (x + 0.08 * rng.standard_normal(len(x))).astype(np.float32)
+    out = demodulate_payload_stream(x)
+    assert len(out) == 2, len(out)
+    for (l, p, complete), pl in zip(out, (pl_a, pl_b)):
+        assert complete
+        assert l is not None and l.src == "N0CALL" and l.dst == "SP5WWP"
+        assert p[:len(pl)] == pl and len(p) % 16 == 0
+
+
+def test_stream_mode_lsf_from_lich():
+    """With the link-setup frame unusable (mid-LSF cut), the LSF reassembles
+    from the six cycling Golay-protected LICH chunks, CRC-checked."""
+    from futuresdr_tpu.models.m17 import (Lsf, build_stream_frames, modulate,
+                                          demodulate_payload_stream)
+    rng = np.random.default_rng(5)
+    lsf = Lsf(dst="SP5WWP", src="N0CALL")
+    payload = bytes(range(112))                  # 7 frames: full LICH cycle
+    sig = modulate(build_stream_frames(lsf, payload))
+    x = np.concatenate([np.zeros(300, np.float32), sig.astype(np.float32),
+                        np.zeros(300, np.float32)])
+    x = (x + 0.06 * rng.standard_normal(len(x))).astype(np.float32)
+    out = demodulate_payload_stream(x[300 + 1000:])
+    assert len(out) == 1
+    l, p, complete = out[0]
+    assert complete and p[:len(payload)] == payload
+    assert l is not None and l.src == "N0CALL" and l.dst == "SP5WWP"
+
+
+def test_stream_mode_through_blocks():
+    """Transmitter tx message with a payload blob → stream-mode frames →
+    receiver posts the transmission with dst/src/payload."""
+    from futuresdr_tpu import Flowgraph, Runtime, Pmt
+    from futuresdr_tpu.blocks import Apply
+    from futuresdr_tpu.models.m17 import M17Receiver, M17Transmitter
+
+    rng = np.random.default_rng(6)
+    tx = M17Transmitter(src_callsign="N0CALL")
+    chan = Apply(lambda v: (v + 0.05 * rng.standard_normal(len(v))
+                            ).astype(np.float32), np.float32)
+    rx = M17Receiver()
+    fg = Flowgraph()
+    fg.connect(tx, chan, rx)
+    rt = Runtime()
+    running = rt.start(fg)
+    payload = b"hello from the stream path"
+    rt.scheduler.run_coro_sync(running.handle.call(
+        tx, "tx", Pmt.map({"dst": "@ALL", "payload": Pmt.blob(payload)})))
+    rt.scheduler.run_coro_sync(running.handle.call(tx, "tx", Pmt.finished()))
+    running.wait_sync()
+    assert len(rx.transmissions) == 1, rx.transmissions
+    l, p = rx.transmissions[0]
+    assert l is not None and l.src == "N0CALL" and l.dst == "@ALL"
+    assert p[:len(payload)] == payload
+
+
+def test_stream_mode_rejects_truncated_group():
+    """A window catching only the TAIL of a transmission (fn 2..) must not
+    report a complete — and therefore silently corrupted — payload."""
+    from futuresdr_tpu.models.m17 import (Lsf, build_stream_frames, modulate,
+                                          demodulate_payload_stream)
+    lsf = Lsf(dst="SP5WWP", src="N0CALL")
+    payload = bytes(range(64))                    # 4 frames
+    sig = modulate(build_stream_frames(lsf, payload)).astype(np.float32)
+    n_lsf = (8 + 184) * 10
+    n_frame = (8 + 48 + 136) * 10
+    # cut into frame 1: only fn 2,3 (incl. EOS) remain decodable
+    x = sig[n_lsf + n_frame + n_frame // 2:]
+    out = demodulate_payload_stream(np.concatenate([x, np.zeros(200, np.float32)]))
+    assert all(not complete for _, _, complete in out), out
