@@ -8,6 +8,7 @@ frame is one batched computation, and the streaming blocks in ``blocks.py`` wrap
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -100,6 +101,8 @@ class DecodedFrame:
     #   A correct decode matches its seed with P≈1; a GARBAGE decode matches
     #   some seed with P≈127/2^16≈0.2% (the gate's false-accept rate) — so
     #   seed_ok=False means parity-lucky garbage, essentially always
+    snr_db: float = float("nan")   # LTS-repetition SNR estimate
+    #   (`frame_equalizer.rs:64` snr() role)
 
 
 def decode_frame(samples: np.ndarray, lts_start: int,
@@ -200,14 +203,27 @@ def _prepare_frame(samples: np.ndarray, lts_start: int, cfo: float):
         llrs = ofdm.demap_llrs(eq.reshape(-1), mcs.modulation)
     deint = coding.deinterleave(llrs, mcs.n_cbps, mcs.n_bpsc)
     depunct = coding.depuncture(deint, mcs.coding_rate)
-    return depunct, n_sym * mcs.n_dbps, mcs, length, lts_start, cfo, n_sym
+    return (depunct, n_sym * mcs.n_dbps, mcs, length, lts_start, cfo, n_sym,
+            _lts_snr_db(samples, lts_start, cfo))
+
+
+def _lts_snr_db(samples: np.ndarray, lts_start: int, cfo: float) -> float:
+    """SNR from the two identical LTS repetitions (`frame_equalizer.rs:64`):
+    their difference is pure noise, their mean power is signal + noise."""
+    lts = samples[lts_start:lts_start + 128]
+    if cfo != 0.0:
+        lts = lts * np.exp(-1j * cfo * np.arange(128))
+    l1, l2 = lts[:64], lts[64:]
+    noise = float(np.mean(np.abs(l1 - l2) ** 2)) / 2 + 1e-20
+    total = float(np.mean(np.abs(lts) ** 2))
+    return 10.0 * math.log10(max(total - noise, 1e-20) / noise)
 
 
 _SEED_TABLE: Optional[np.ndarray] = None   # [127, 16] keystream prefixes for seeds 1..127
 
 
 def _finish_frame(decoded_bits: np.ndarray, mcs, length, lts_start, cfo,
-                  n_sym) -> Optional[DecodedFrame]:
+                  n_sym, snr_db=float("nan")) -> Optional[DecodedFrame]:
     # the 16 SERVICE bits are zeros pre-scrambling: recover the TX seed by matching
     # the received prefix against all 127 keystream prefixes at once (the reference
     # derives it in closed form from the first 7 bits — equivalent, vectorized)
@@ -219,7 +235,7 @@ def _finish_frame(decoded_bits: np.ndarray, mcs, length, lts_start, cfo,
     descrambled = coding.descramble(decoded_bits, seed)
     psdu_bits = descrambled[16:16 + 8 * length]
     return DecodedFrame(bits_to_bytes(psdu_bits), mcs, lts_start, cfo, n_sym,
-                        seed_ok=bool(len(match)))
+                        seed_ok=bool(len(match)), snr_db=snr_db)
 
 
 def decode_stream_batch(samples: np.ndarray) -> List[DecodedFrame]:

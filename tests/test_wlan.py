@@ -268,3 +268,25 @@ def test_noisy_burst_train_no_mislock_no_dup():
            ).astype(np.complex64)
     got = [f.psdu for f in decode_stream(sig)]
     assert got == sent, (len(got), len(set(got) & set(sent)))
+
+
+def test_frame_snr_estimate():
+    """Per-frame SNR from the LTS repetitions (`frame_equalizer.rs:64` snr()):
+    tracks the actual channel SNR within a few dB, and orders clean vs noisy."""
+    from futuresdr_tpu.models.wlan.phy import decode_stream, encode_frame
+    rng = np.random.default_rng(8)
+    psdu = b"snr probe frame" * 3
+    burst = encode_frame(psdu, "qpsk_1_2")
+    sig_p = np.mean(np.abs(burst) ** 2)
+    got = {}
+    for snr_db in (30.0, 10.0):
+        sigma = np.sqrt(sig_p / (2 * 10 ** (snr_db / 10)))
+        x = np.concatenate([np.zeros(300, np.complex64), burst,
+                            np.zeros(300, np.complex64)])
+        x = (x + sigma * (rng.standard_normal(len(x))
+                          + 1j * rng.standard_normal(len(x)))).astype(np.complex64)
+        frames = decode_stream(x)
+        assert len(frames) == 1 and frames[0].psdu == psdu
+        got[snr_db] = frames[0].snr_db
+        assert abs(frames[0].snr_db - snr_db) < 6.0, (snr_db, frames[0].snr_db)
+    assert got[30.0] > got[10.0]
