@@ -16,15 +16,31 @@ from futuresdr_tpu.blocks import FileSource, VectorSource
 from futuresdr_tpu.models.adsb import AdsbReceiver, modulate_frame
 
 
+def _df11(icao: int) -> np.ndarray:
+    """Parity-consistent DF11 all-call so the AP-overlay replies get through
+    the tracker's acquisition gate."""
+    from futuresdr_tpu.models.adsb.decoder import crc24
+    head = np.zeros(32, dtype=np.uint8)
+    head[0:5] = [0, 1, 0, 1, 1]
+    head[8:32] = [(icao >> (23 - i)) & 1 for i in range(24)]
+    rem = crc24(np.concatenate([head, np.zeros(24, np.uint8)]))
+    return np.concatenate([head, np.array([(rem >> (23 - i)) & 1
+                                           for i in range(24)], np.uint8)])
+
+
 def synth_stream() -> np.ndarray:
     frames = ["8D4840D6202CC371C32CE0576098",      # KLM1023 ident
               "8D40621D58C382D690C8AC2863A7",      # position even
               "8D40621D58C386435CC412692AD6",      # position odd
-              "8D485020994409940838175B284F"]      # velocity
+              "8D485020994409940838175B284F",      # velocity
+              _df11(0x4CA7E8),                     # all-call: acquire 4CA7E8
+              "2000171806A983",                    # DF4 altitude (AP icao 4CA7E8)
+              "2A00516D492B80"]                    # DF5 squawk — foreign icao: gated
     rng = np.random.default_rng(0)
     parts = []
-    for h in frames:
-        bits = np.unpackbits(np.frombuffer(bytes.fromhex(h), np.uint8)).astype(np.uint8)
+    for f in frames:
+        bits = (f if isinstance(f, np.ndarray) else
+                np.unpackbits(np.frombuffer(bytes.fromhex(f), np.uint8)).astype(np.uint8))
         parts += [0.03 * rng.random(1000).astype(np.float32), modulate_frame(bits)]
     parts.append(0.03 * rng.random(500).astype(np.float32))
     return np.concatenate(parts)
@@ -34,17 +50,22 @@ def main():
     import argparse
     p = argparse.ArgumentParser()
     p.add_argument("--file", default=None, help="float32 magnitude stream @2 Msps")
+    p.add_argument("--ref-pos", default="52.25,3.92",
+                   help="receiver site lat,lon for single-message CPR "
+                        "(empty string disables)")
     a = p.parse_args()
 
+    ref = tuple(float(v) for v in a.ref_pos.split(",")) if a.ref_pos else None
     fg = Flowgraph()
     src = FileSource(a.file, np.float32) if a.file else VectorSource(synth_stream())
-    rx = AdsbReceiver()
+    rx = AdsbReceiver(ref_pos=ref)
     fg.connect_stream(src, "out", rx, "in")
     Runtime().run(fg)
     print(f"decoded {rx.n_frames} frames; aircraft:")
     for ac in rx.tracker.aircraft.values():
-        print(f"  {ac.icao:06X} callsign={ac.callsign} alt={ac.altitude_ft} "
-              f"pos=({ac.lat}, {ac.lon}) gs={ac.ground_speed_kt}")
+        print(f"  {ac.icao:06X} callsign={ac.callsign} squawk={ac.squawk} "
+              f"alt={ac.altitude_ft} pos=({ac.lat}, {ac.lon}) "
+              f"gs={ac.ground_speed_kt}")
 
 
 if __name__ == "__main__":

@@ -41,13 +41,23 @@ def main():
                        "meta": Pmt.blob(f"beacon {i}".ljust(14).encode())})
         r = rt.scheduler.run_coro_sync(running.handle.call(tx, "tx", msg))
         assert r == Pmt.ok()
+    # stream mode: a payload blob rides LICH-chunked frames after the LSF
+    payload = b"M17 stream-mode payload over the air"
+    r = rt.scheduler.run_coro_sync(running.handle.call(
+        tx, "tx", Pmt.map({"dst": "SP5WWP", "payload": Pmt.blob(payload)})))
+    assert r == Pmt.ok()
     rt.scheduler.run_coro_sync(running.handle.call(tx, "tx", Pmt.finished()))
     running.wait_sync()
 
-    print(f"decoded {len(rx.frames)}/{a.frames} LSFs:")
+    print(f"decoded {len(rx.frames)}/{a.frames + 1} LSFs:")
     for f in rx.frames:
         print(f"  {f.src} -> {f.dst}  meta={f.meta!r}")
-    assert len(rx.frames) == a.frames
+    assert len(rx.frames) >= a.frames
+    print(f"stream transmissions: {len(rx.transmissions)}")
+    for lsf, pl in rx.transmissions:
+        print(f"  {lsf.src if lsf else '?'} -> {lsf.dst if lsf else '?'}: {pl!r}")
+    assert len(rx.transmissions) == 1
+    assert rx.transmissions[0][1][:len(payload)] == payload
 
 
 if __name__ == "__main__":
