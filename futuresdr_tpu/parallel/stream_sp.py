@@ -24,7 +24,7 @@ except ImportError:                    # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 __all__ = ["sp_fir", "sp_fir_fft_mag2", "sp_fir_stream", "sp_fir_fft_mag2_stream",
-           "sp_channelizer", "sp_channelizer_a2a"]
+           "sp_channelizer", "sp_channelizer_a2a", "sp_dechirp_scan"]
 
 
 def _halo_from_left(local: jnp.ndarray, halo: int, axis_name: str,
@@ -175,6 +175,60 @@ def sp_channelizer(n_channels: int, taps: np.ndarray, mesh: Mesh,
 
     return shard_map(local, mesh=mesh, in_specs=P(axis),
                      out_specs=P(None, axis))
+
+
+def _halo_from_right(local: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarray:
+    """Append the NEXT shard's head — the mirror of :func:`_halo_from_left`, for
+    operators whose windows extend rightward past the shard boundary. The last
+    shard pads with zeros (stream edge)."""
+    if halo <= 0:
+        return local
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    head = local[:halo]
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    right_head = jax.lax.ppermute(head, axis_name, perm)  # shard i gets i+1's head
+    right_head = jnp.where(idx == n - 1, jnp.zeros_like(right_head), right_head)
+    return jnp.concatenate([local, right_head])
+
+
+def sp_dechirp_scan(sf: int, mesh: Mesh, hop: int = None, axis: str = "sp"):
+    """LoRa preamble-scan primitive, time-sharded: dechirp every ``hop``-spaced
+    window of a long capture and return each window's peak FFT bin and energy
+    concentration — the hot loop of `frame_sync.rs` (and this framework's
+    ``detect_frames``) scaled across chips.
+
+    Input [n] complex64 sharded over ``axis`` (per-shard length must be a
+    multiple of ``hop``); windows anchored near a shard's end extend into the
+    next shard, so each device fetches a window-length right halo with one
+    ``ppermute`` — O(2^sf) bytes over ICI per frame — then computes purely
+    locally. Output: (bins [n/hop], conc [n/hop]), identically time-sharded.
+    Windows whose span crosses the stream end are reported from zero-padding
+    (conc ≈ 0), matching how the host scan bounds its probe count.
+    """
+    n = 1 << sf
+    hop = hop or n // 4
+    if n % hop != 0:
+        raise ValueError(f"window length {n} must be a multiple of hop {hop}")
+    from ..models.lora.phy import _downchirp     # the host scan's exact chirp
+    down = jnp.asarray(_downchirp(n).astype(np.complex64))
+
+    def local(x_local):
+        if x_local.shape[0] < n:                 # trace-time: a truncated halo
+            raise ValueError(                    # would silently garble windows
+                f"per-shard length {x_local.shape[0]} < window {n}: "
+                f"grow the capture or reduce sf/devices")
+        ext = _halo_from_right(x_local, n, axis)
+        idx = jnp.arange(x_local.shape[0] // hop)[:, None] * hop + jnp.arange(n)
+        spec = jnp.fft.fft(ext[idx] * down[None, :], axis=1)
+        pw = spec.real ** 2 + spec.imag ** 2     # |X|^2: argmax and conc need no sqrt
+        peak = jnp.argmax(pw, axis=1)
+        p2 = jnp.take_along_axis(pw, peak[:, None], axis=1)[:, 0]
+        conc = p2 / jnp.maximum(jnp.sum(pw, axis=1), 1e-12)
+        return peak.astype(jnp.int32), conc.astype(jnp.float32)
+
+    return shard_map(local, mesh=mesh, in_specs=P(axis),
+                     out_specs=(P(axis), P(axis)))
 
 
 def sp_channelizer_a2a(n_channels: int, taps: np.ndarray, mesh: Mesh,
