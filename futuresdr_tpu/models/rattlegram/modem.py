@@ -20,6 +20,8 @@ import numpy as np
 from ...runtime.kernel import Kernel, message_handler
 from ...types import Pmt
 from ..wlan import coding as wcoding
+from . import fec as rfec
+from . import polar
 
 __all__ = ["mls", "ModemParams", "modulate", "demodulate", "demodulate_all", "Modem",
            "ModemTransmitter", "ModemReceiver"]
@@ -50,6 +52,13 @@ class ModemParams:
     cp: int = 32
     first_carrier: int = 36        # ≈1.1 kHz
     n_carriers: int = 64           # → up to ≈3.2 kHz
+    fec: str = "conv"              # "conv" (K=7 + CRC32) or "polar" — the
+    #   reference's actual pipeline: xorshift scramble → systematic polar
+    #   (CRC32-aided SCL-32) over the mode's frozen set (`encoder.rs:162-180`)
+
+    def __post_init__(self):
+        if self.fec not in ("conv", "polar"):
+            raise ValueError(f"unknown fec {self.fec!r}: use 'conv' or 'polar'")
 
     @property
     def sym_len(self) -> int:
@@ -58,6 +67,21 @@ class ModemParams:
     @property
     def carriers(self) -> np.ndarray:
         return np.arange(self.first_carrier, self.first_carrier + self.n_carriers)
+
+
+def _polar_mode_bits(n_payload: int) -> int:
+    """Operation mode by payload size (`encoder.rs:136-141`): Mode16/15/14."""
+    if n_payload <= 0 or n_payload > 170:
+        raise ValueError(f"polar fec carries 1..170 bytes, got {n_payload}")
+    return 680 if n_payload <= 85 else 1024 if n_payload <= 128 else 1360
+
+
+def _coded_len(n_payload: int, p: ModemParams) -> int:
+    """Transmitted coded bits for a payload of ``n_payload`` bytes."""
+    if p.fec == "polar":
+        _polar_mode_bits(n_payload)            # size must fit an operation mode
+        return polar.CODE_LEN
+    return 2 * (8 * (n_payload + 4) + 6)
 
 
 _QPSK = np.array([1 + 1j, -1 + 1j, 1 - 1j, -1 - 1j]) / np.sqrt(2)
@@ -82,10 +106,16 @@ def _sym_to_audio(spec: np.ndarray, p: ModemParams) -> np.ndarray:
 
 def modulate(payload: bytes, p: ModemParams = ModemParams()) -> np.ndarray:
     """Payload bytes → audio samples (sync symbol + QPSK payload symbols)."""
-    body = payload + zlib.crc32(payload).to_bytes(4, "little")
-    bits = np.unpackbits(np.frombuffer(body, np.uint8))
-    bits = np.concatenate([bits, np.zeros(6, np.uint8)])        # flush the trellis
-    coded = wcoding.conv_encode(bits)
+    if p.fec == "polar":
+        data_bits = _polar_mode_bits(len(payload))
+        mesg = np.frombuffer(payload.ljust(data_bits // 8, b"\x00"), np.uint8)
+        mesg = (mesg ^ rfec.Xorshift32().bytes(len(mesg))).tobytes()
+        coded = (polar.polar_encode(mesg, data_bits) < 0).astype(np.uint8)  # −1 ⇒ 1
+    else:
+        body = payload + zlib.crc32(payload).to_bytes(4, "little")
+        bits = np.unpackbits(np.frombuffer(body, np.uint8))
+        bits = np.concatenate([bits, np.zeros(6, np.uint8)])    # flush the trellis
+        coded = wcoding.conv_encode(bits)
     bits_per_sym = 2 * p.n_carriers
     n_sym = -(-len(coded) // bits_per_sym)
     padded = np.zeros(n_sym * bits_per_sym, dtype=np.uint8)
@@ -119,8 +149,7 @@ def demodulate_all(audio: np.ndarray, n_payload: int,
     successful decode claims its burst span, so a long recording with many
     bursts yields them all (``demodulate`` is the single-burst view)."""
     norm = _sync_norm(audio, p)
-    n_bits = 8 * (n_payload + 4) + 6
-    n_sym = -(-2 * n_bits // (2 * p.n_carriers))
+    n_sym = -(-_coded_len(n_payload, p) // (2 * p.n_carriers))
     burst_span = (1 + n_sym) * p.sym_len
     out = []
     cand = np.flatnonzero(norm > 0.5)
@@ -161,8 +190,7 @@ def _decode_at(audio: np.ndarray, sync_start: int, n_payload: int,
     ref_spec = _sync_spectrum(p)
     H = sync_spec[p.carriers] / ref_spec[p.carriers]
 
-    n_bits = 8 * (n_payload + 4) + 6
-    n_coded = 2 * n_bits
+    n_coded = _coded_len(n_payload, p)
     bits_per_sym = 2 * p.n_carriers
     n_sym = -(-n_coded // bits_per_sym)
     llrs = np.zeros(n_sym * bits_per_sym)
@@ -180,6 +208,16 @@ def _decode_at(audio: np.ndarray, sync_start: int, n_payload: int,
         seg[1::2] = b1
         llrs[s * bits_per_sym:(s + 1) * bits_per_sym] = seg
         pos += p.sym_len
+    if p.fec == "polar":
+        data_bits = _polar_mode_bits(n_payload)
+        # polar soft convention: negative ⇒ bit 1; our llrs: positive ⇒ bit 1
+        soft = np.clip(-llrs[:n_coded] * 32.0, -127, 127).astype(np.int8)
+        decoded, _flips = polar.polar_decode(soft, data_bits)
+        if decoded is None:
+            return None                      # no surviving path passed CRC32
+        ks = rfec.Xorshift32().bytes(data_bits // 8)
+        return (np.frombuffer(decoded, np.uint8) ^ ks).tobytes()[:n_payload]
+    n_bits = n_coded // 2
     bits = wcoding.viterbi_decode(llrs[:n_coded], n_bits)
     body = np.packbits(bits[:8 * (n_payload + 4)]).tobytes()
     payload, crc = body[:n_payload], body[n_payload:n_payload + 4]
@@ -193,7 +231,8 @@ class Modem:
     fixed 170-byte payload; configurable here)."""
 
     def __init__(self, payload_size: int = 170, params: ModemParams = ModemParams()):
-        self.size = payload_size
+        _coded_len(payload_size, params)   # polar: size must fit a mode — fail
+        self.size = payload_size           # at build time, not mid-rx
         self.params = params
 
     def tx(self, payload: bytes) -> np.ndarray:
