@@ -23,8 +23,8 @@ from ..wlan import coding as wcoding
 from . import fec as rfec
 from . import polar
 
-__all__ = ["mls", "ModemParams", "modulate", "demodulate", "demodulate_all", "Modem",
-           "ModemTransmitter", "ModemReceiver"]
+__all__ = ["mls", "ModemParams", "modulate", "demodulate", "demodulate_all",
+           "demodulate_auto", "Modem", "ModemTransmitter", "ModemReceiver"]
 
 
 def mls(poly: int = 0b1000011, state: int = 1) -> np.ndarray:
@@ -104,8 +104,86 @@ def _sym_to_audio(spec: np.ndarray, p: ModemParams) -> np.ndarray:
     return np.concatenate([t[-p.cp:], t])
 
 
-def modulate(payload: bytes, p: ModemParams = ModemParams()) -> np.ndarray:
-    """Payload bytes → audio samples (sync symbol + QPSK payload symbols)."""
+# ---- in-band metadata (`encoder.rs:144-145` meta_data + preamble symbol role):
+# 55 bits = base37(callsign) << 8 | operation mode, + CRC16 → 71 data bits,
+# BCH(255,71)-protected, BPSK over ceil(255/n_carriers) symbols after the sync
+
+_MODE_BY_BITS = {680: 16, 1024: 15, 1360: 14}
+_BITS_BY_MODE = {m: b for b, m in _MODE_BY_BITS.items()}
+
+
+def _base37(callsign: str) -> int:
+    """aicodix base-37 callsign packing (' ' 0, digits 1-10, letters 11-36)."""
+    if len(callsign) > 9:
+        raise ValueError(f"callsign {callsign!r} exceeds 9 characters")
+    v = 0
+    for c in callsign.upper()[::-1]:
+        d = (0 if c == " " else ord(c) - ord("0") + 1 if "0" <= c <= "9"
+             else ord(c) - ord("A") + 11 if "A" <= c <= "Z" else None)
+        if d is None:
+            raise ValueError(f"callsign char {c!r} not in base-37 alphabet")
+        v = v * 37 + d
+    return v
+
+
+def _base37_str(v: int) -> str:
+    out = []
+    while v:
+        v, d = divmod(v, 37)
+        out.append(" " if d == 0 else chr(d - 1 + ord("0")) if d <= 10
+                   else chr(d - 11 + ord("A")))
+    return "".join(out).rstrip()
+
+
+def _meta_symbols(p: ModemParams) -> int:
+    return -(-rfec.BCH_N // p.n_carriers)          # BPSK: 1 bit per carrier
+
+
+def _meta_encode(callsign: str, mode: int) -> np.ndarray:
+    """(callsign, mode) → 255 hard bits (systematic BCH codeword)."""
+    meta = (_base37(callsign) << 8) | mode
+    if meta >> 55:
+        raise ValueError("callsign packs beyond 55 bits")
+    bits55 = ((meta >> np.arange(55)) & 1).astype(np.uint8)
+    crc = rfec.crc16_rattlegram(np.packbits(bits55, bitorder="little").tobytes())
+    data71 = np.concatenate([bits55, ((crc >> np.arange(16)) & 1).astype(np.uint8)])
+    return np.concatenate([data71, rfec.bch_parity(data71)])
+
+
+def _meta_decode(soft255: np.ndarray):
+    """Soft codeword → (callsign, mode) or None (OSD + CRC16 gate)."""
+    hard, _conf = rfec.osd_decode(
+        np.clip(soft255, -127, 127).astype(np.int8), _META_GEN())
+    data71 = hard[:rfec.BCH_K]
+    crc = rfec.crc16_rattlegram(
+        np.packbits(data71[:55], bitorder="little").tobytes())
+    if not np.array_equal(data71[55:71],
+                          ((crc >> np.arange(16)) & 1).astype(np.uint8)):
+        return None
+    meta = int(sum(int(b) << i for i, b in enumerate(data71[:55])))
+    mode = meta & 0xFF
+    if mode not in _BITS_BY_MODE:
+        return None
+    return _base37_str(meta >> 8), mode
+
+
+_META_GEN_CACHE = None
+
+
+def _META_GEN():
+    global _META_GEN_CACHE
+    if _META_GEN_CACHE is None:
+        _META_GEN_CACHE = rfec.bch_generator_matrix(systematic=True)
+    return _META_GEN_CACHE
+
+
+def modulate(payload: bytes, p: ModemParams = ModemParams(),
+             callsign: Optional[str] = None) -> np.ndarray:
+    """Payload bytes → audio samples (sync symbol + QPSK payload symbols).
+
+    With ``callsign`` (polar fec only), BPSK metadata symbols carrying
+    callsign+mode follow the sync — the receiver then needs no a-priori
+    payload size (:func:`demodulate_auto`)."""
     if p.fec == "polar":
         data_bits = _polar_mode_bits(len(payload))
         mesg = np.frombuffer(payload.ljust(data_bits // 8, b"\x00"), np.uint8)
@@ -122,6 +200,17 @@ def modulate(payload: bytes, p: ModemParams = ModemParams()) -> np.ndarray:
     padded[:len(coded)] = coded
     sync = _sync_spectrum(p)
     parts = [_sym_to_audio(sync, p)]
+    if callsign is not None:
+        if p.fec != "polar":
+            raise ValueError("in-band metadata needs fec='polar' (mode field)")
+        mbits = _meta_encode(callsign, _MODE_BY_BITS[data_bits])
+        mpad = np.zeros(_meta_symbols(p) * p.n_carriers, np.uint8)
+        mpad[:len(mbits)] = mbits
+        for s in range(_meta_symbols(p)):
+            spec = np.zeros(p.fft, dtype=np.complex128)
+            spec[p.carriers] = np.where(
+                mpad[s * p.n_carriers:(s + 1) * p.n_carriers] > 0, -1.0, 1.0)
+            parts.append(_sym_to_audio(spec, p))
     for s in range(n_sym):
         seg = padded[s * bits_per_sym:(s + 1) * bits_per_sym].reshape(-1, 2)
         idx = seg[:, 0] + 2 * seg[:, 1]
@@ -143,14 +232,15 @@ def _sync_norm(audio: np.ndarray, p: ModemParams) -> np.ndarray:
 
 
 def demodulate_all(audio: np.ndarray, n_payload: int,
-                   p: ModemParams = ModemParams()):
+                   p: ModemParams = ModemParams(), skip_symbols: int = 0):
     """Every decodable burst in ``audio``, in time order: ``[(sync_start,
     payload), …]``. Sync peaks above threshold are tried oldest-first and a
     successful decode claims its burst span, so a long recording with many
-    bursts yields them all (``demodulate`` is the single-burst view)."""
+    bursts yields them all (``demodulate`` is the single-burst view).
+    ``skip_symbols``: in-band metadata symbols between sync and payload."""
     norm = _sync_norm(audio, p)
     n_sym = -(-_coded_len(n_payload, p) // (2 * p.n_carriers))
-    burst_span = (1 + n_sym) * p.sym_len
+    burst_span = (1 + skip_symbols + n_sym) * p.sym_len
     out = []
     cand = np.flatnonzero(norm > 0.5)
     next_free = -1
@@ -160,7 +250,7 @@ def demodulate_all(audio: np.ndarray, n_payload: int,
         # refine to the local peak within one symbol
         hi = min(len(norm), i + p.sym_len)
         peak = int(i + np.argmax(norm[i:hi]))
-        payload = _decode_at(audio, peak, n_payload, p)
+        payload = _decode_at(audio, peak, n_payload, p, skip_symbols)
         if payload is not None:
             out.append((peak, payload))
             next_free = peak + burst_span
@@ -173,28 +263,64 @@ def demodulate_all(audio: np.ndarray, n_payload: int,
 
 
 def demodulate(audio: np.ndarray, n_payload: int,
-               p: ModemParams = ModemParams()) -> Optional[bytes]:
+               p: ModemParams = ModemParams(),
+               skip_symbols: int = 0) -> Optional[bytes]:
     """Locate the strongest MLS sync symbol, equalize, demap, Viterbi-decode,
     CRC-check — the single-burst window API (streams: :func:`demodulate_all`)."""
     norm = _sync_norm(audio, p)
     peak = int(np.argmax(norm))
     if norm[peak] < 0.5:
         return None
-    return _decode_at(audio, peak, n_payload, p)
+    return _decode_at(audio, peak, n_payload, p, skip_symbols)
+
+
+def demodulate_auto(audio: np.ndarray, p: ModemParams = ModemParams()):
+    """Single burst with in-band metadata: → (callsign, payload) or None.
+
+    No a-priori payload size: the BPSK metadata symbols after the sync carry
+    callsign + operation mode (BCH(255,71), OSD-decoded, CRC16-gated); the mode
+    then sizes the polar payload decode."""
+    if p.fec != "polar":
+        raise ValueError("demodulate_auto needs fec='polar' (mode metadata)")
+    norm = _sync_norm(audio, p)
+    peak = int(np.argmax(norm))
+    if norm[peak] < 0.5:
+        return None
+    sync_spec = np.fft.fft(audio[peak:peak + p.fft])
+    H = sync_spec[p.carriers] / _sync_spectrum(p)[p.carriers]
+    soft = []
+    pos = peak + p.sym_len
+    for _ in range(_meta_symbols(p)):
+        if pos + p.fft > len(audio):
+            return None
+        eq = np.fft.fft(audio[pos:pos + p.fft])[p.carriers] / H
+        soft.append(eq.real)                 # carrier −1 ⇔ bit 1; OSD: +1 ⇔ bit 0
+        pos += p.sym_len
+    meta = _meta_decode(np.concatenate(soft)[:rfec.BCH_N] * 48.0)
+    if meta is None:
+        return None
+    callsign, mode = meta
+    n_payload = _BITS_BY_MODE[mode] // 8
+    payload = _decode_at(audio, peak, n_payload, p,
+                         skip_symbols=_meta_symbols(p), H=H)
+    if payload is None:
+        return None
+    return callsign, payload
 
 
 def _decode_at(audio: np.ndarray, sync_start: int, n_payload: int,
-               p: ModemParams) -> Optional[bytes]:
-    # channel estimate from the sync symbol
-    sync_spec = np.fft.fft(audio[sync_start:sync_start + p.fft])
-    ref_spec = _sync_spectrum(p)
-    H = sync_spec[p.carriers] / ref_spec[p.carriers]
+               p: ModemParams, skip_symbols: int = 0,
+               H: Optional[np.ndarray] = None) -> Optional[bytes]:
+    if H is None:
+        # channel estimate from the sync symbol
+        sync_spec = np.fft.fft(audio[sync_start:sync_start + p.fft])
+        H = sync_spec[p.carriers] / _sync_spectrum(p)[p.carriers]
 
     n_coded = _coded_len(n_payload, p)
     bits_per_sym = 2 * p.n_carriers
     n_sym = -(-n_coded // bits_per_sym)
     llrs = np.zeros(n_sym * bits_per_sym)
-    pos = sync_start + p.fft + p.cp
+    pos = sync_start + (1 + skip_symbols) * p.sym_len
     for s in range(n_sym):
         if pos + p.fft > len(audio):
             return None
@@ -230,26 +356,41 @@ class Modem:
     """Convenience TX/RX pairing over a fixed payload size (rattlegram bursts carry a
     fixed 170-byte payload; configurable here)."""
 
-    def __init__(self, payload_size: int = 170, params: ModemParams = ModemParams()):
+    def __init__(self, payload_size: int = 170, params: ModemParams = ModemParams(),
+                 callsign: Optional[str] = None):
         _coded_len(payload_size, params)   # polar: size must fit a mode — fail
         self.size = payload_size           # at build time, not mid-rx
         self.params = params
+        self.callsign = callsign           # set → tx embeds in-band metadata
+        if callsign is not None and params.fec != "polar":
+            raise ValueError("in-band metadata (callsign) needs fec='polar'")
 
     def tx(self, payload: bytes) -> np.ndarray:
         if len(payload) > self.size:
             raise ValueError(
                 f"payload is {len(payload)} bytes but the modem was built for "
                 f"payload_size={self.size}; rebuild with a larger size")
-        return modulate(payload.ljust(self.size, b"\x00"), self.params)
+        return modulate(payload.ljust(self.size, b"\x00"), self.params,
+                        callsign=self.callsign)
+
+    def rx_auto(self, audio: np.ndarray):
+        """Metadata-signalled burst → (callsign, payload) or None — the RX
+        needs no payload size; see :func:`demodulate_auto`."""
+        r = demodulate_auto(audio, self.params)
+        return None if r is None else (r[0], r[1].rstrip(b"\x00"))
+
+    def _skip(self) -> int:
+        return _meta_symbols(self.params) if self.callsign is not None else 0
 
     def rx(self, audio: np.ndarray) -> Optional[bytes]:
-        r = demodulate(audio, self.size, self.params)
+        r = demodulate(audio, self.size, self.params, skip_symbols=self._skip())
         return None if r is None else r.rstrip(b"\x00")
 
     def rx_all(self, audio: np.ndarray):
         """All bursts in a recording, time-ordered: ``[(position, payload), …]``."""
         return [(pos, r.rstrip(b"\x00"))
-                for pos, r in demodulate_all(audio, self.size, self.params)]
+                for pos, r in demodulate_all(audio, self.size, self.params,
+                                             skip_symbols=self._skip())]
 
     def burst_samples(self) -> int:
         """Length of one TX burst in samples (for RX windowing)."""

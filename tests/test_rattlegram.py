@@ -104,3 +104,67 @@ def test_polar_fec_config_validation():
     with pytest.raises(ValueError, match="170"):
         Modem(payload_size=200, params=ModemParams(fec="polar"))
     Modem(payload_size=200)                        # conv: any size is fine
+
+
+def test_in_band_metadata_auto_rx():
+    """In-band metadata (`encoder.rs:144-145` meta_data role): BPSK BCH(255,71)
+    symbols carry callsign + operation mode, so the receiver sizes the polar
+    decode from the air — no a-priori payload size."""
+    from futuresdr_tpu.models.rattlegram import Modem, ModemParams
+    from futuresdr_tpu.models.rattlegram.modem import (demodulate_auto, _base37,
+                                                       _base37_str)
+    for cs in ("N0CALL", "SP5WWP", "X", "DF9XYZ 1"):
+        assert _base37_str(_base37(cs)) == cs.upper().rstrip()
+
+    rng = np.random.default_rng(1)
+    p = ModemParams(fec="polar")
+    for size, pl in ((85, b"small"), (128, b"medium sized payload"),
+                     (170, b"large payload rides mode 14")):
+        m = Modem(payload_size=size, params=p, callsign="DF9XYZ")
+        x = np.concatenate([np.zeros(300, np.float32), m.tx(pl),
+                            np.zeros(300, np.float32)])
+        x = (x + 0.05 * rng.standard_normal(len(x))).astype(np.float32)
+        cs, got = demodulate_auto(x, p)      # NB: no size passed anywhere
+        assert cs == "DF9XYZ" and got.rstrip(b"\x00") == pl, (size, cs)
+        assert m.rx_auto(x) == ("DF9XYZ", pl)
+
+    # config guards: metadata requires the polar pipeline (mode field)
+    with pytest.raises(ValueError, match="polar"):
+        Modem(payload_size=85, callsign="N0CALL")
+    with pytest.raises(ValueError, match="polar"):
+        demodulate_auto(np.zeros(4096, np.float32), ModemParams())
+    # erasing HALF the metadata symbols still decodes — BCH(255,71) designed
+    # distance 47 + OSD handles erasures; that robustness is the point
+    m = Modem(payload_size=85, params=p, callsign="N0CALL")
+    audio = m.tx(b"x")
+    erased = audio.copy()
+    erased[m.params.sym_len:3 * m.params.sym_len] = 0.0
+    assert demodulate_auto(erased, p) is not None
+    # but confidently-random metadata must fail the CRC16 gate, not pass garbage
+    garbled = audio.copy()
+    sl = m.params.sym_len
+    garbled[sl:5 * sl] = 0.5 * rng.standard_normal(4 * sl).astype(np.float32)
+    assert demodulate_auto(garbled, p) is None
+
+
+def test_metadata_modem_fixed_rx_paths_still_work():
+    """A callsign-equipped Modem's rx()/rx_all() skip the metadata symbols, so
+    the fixed-size paths decode their own tx() too; callsign input validation
+    rejects non-base37 characters and overlong signs."""
+    from futuresdr_tpu.models.rattlegram import Modem, ModemParams
+    from futuresdr_tpu.models.rattlegram.modem import _base37
+    m = Modem(payload_size=85, params=ModemParams(fec="polar"), callsign="N0CALL")
+    rng = np.random.default_rng(5)
+    parts = [np.zeros(200, np.float32)]
+    for pl in (b"first", b"second"):
+        parts += [m.tx(pl), np.zeros(300, np.float32)]
+    x = np.concatenate(parts)
+    x = (x + 0.04 * rng.standard_normal(len(x))).astype(np.float32)
+    # rx() decodes the strongest single burst; rx_all() returns both in order
+    assert m.rx(x[:200 + m.burst_samples() + 200]) == b"first"
+    assert [pl for _, pl in m.rx_all(x)] == [b"first", b"second"]
+
+    with pytest.raises(ValueError, match="base-37|9 char"):
+        _base37("LONGCALL10")
+    with pytest.raises(ValueError, match="base-37"):
+        _base37("٥")                       # non-ASCII digit must not pass
