@@ -6,14 +6,16 @@
 """
 
 from .modem import (Modem, ModemParams, ModemReceiver, ModemTransmitter, demodulate,
-                    demodulate_all, demodulate_auto, mls, modulate)
+                    demodulate_all, demodulate_auto, demodulate_all_auto, mls,
+                    modulate)
 from .fec import (BCH_K, BCH_N, bch_generator_matrix, bch_genpoly, bch_parity,
                   crc16_rattlegram, crc32_rattlegram, mls_bits, osd_decode, Xorshift32)
 from .polar import (CODE_LEN, FROZEN_2048_712, FROZEN_2048_1056, FROZEN_2048_1392,
                     frozen_mask, polar_decode, polar_encode)
 
 __all__ = ["Modem", "ModemParams", "ModemReceiver", "ModemTransmitter", "demodulate",
-           "demodulate_all", "demodulate_auto", "mls", "modulate",
+           "demodulate_all", "demodulate_auto", "demodulate_all_auto", "mls",
+           "modulate",
            "BCH_K", "BCH_N", "bch_generator_matrix", "bch_genpoly", "bch_parity",
            "crc16_rattlegram", "crc32_rattlegram", "mls_bits", "osd_decode",
            "Xorshift32",

@@ -24,7 +24,8 @@ from . import fec as rfec
 from . import polar
 
 __all__ = ["mls", "ModemParams", "modulate", "demodulate", "demodulate_all",
-           "demodulate_auto", "Modem", "ModemTransmitter", "ModemReceiver"]
+           "demodulate_auto", "demodulate_all_auto", "Modem", "ModemTransmitter",
+           "ModemReceiver"]
 
 
 def mls(poly: int = 0b1000011, state: int = 1) -> np.ndarray:
@@ -238,26 +239,36 @@ def demodulate_all(audio: np.ndarray, n_payload: int,
     successful decode claims its burst span, so a long recording with many
     bursts yields them all (``demodulate`` is the single-burst view).
     ``skip_symbols``: in-band metadata symbols between sync and payload."""
-    norm = _sync_norm(audio, p)
     n_sym = -(-_coded_len(n_payload, p) // (2 * p.n_carriers))
     burst_span = (1 + skip_symbols + n_sym) * p.sym_len
+
+    def decode(peak):
+        payload = _decode_at(audio, peak, n_payload, p, skip_symbols)
+        return None if payload is None else ((peak, payload), burst_span)
+
+    return _scan_bursts(audio, p, decode)
+
+
+def _scan_bursts(audio: np.ndarray, p: ModemParams, decode_at_peak):
+    """Shared burst scanner: try every above-threshold sync candidate oldest-
+    first; a successful decode claims its burst span; a failed one skips the
+    rest of its correlation lobe (retrying the same corrupted burst once per
+    above-threshold sample would run the decoder tens of times for nothing).
+    ``decode_at_peak(peak) -> (result, span) | None``."""
+    norm = _sync_norm(audio, p)
     out = []
-    cand = np.flatnonzero(norm > 0.5)
     next_free = -1
-    for i in cand:
+    for i in np.flatnonzero(norm > 0.5):
         if i < next_free:
             continue
         # refine to the local peak within one symbol
         hi = min(len(norm), i + p.sym_len)
         peak = int(i + np.argmax(norm[i:hi]))
-        payload = _decode_at(audio, peak, n_payload, p, skip_symbols)
-        if payload is not None:
-            out.append((peak, payload))
-            next_free = peak + burst_span
+        r = decode_at_peak(peak)
+        if r is not None:
+            out.append(r[0])
+            next_free = peak + r[1]
         else:
-            # skip the rest of this correlation lobe — retrying the same
-            # corrupted burst once per above-threshold sample would run the
-            # Viterbi tens of times for nothing
             next_free = max(next_free, peak + p.sym_len)
     return out
 
@@ -274,18 +285,8 @@ def demodulate(audio: np.ndarray, n_payload: int,
     return _decode_at(audio, peak, n_payload, p, skip_symbols)
 
 
-def demodulate_auto(audio: np.ndarray, p: ModemParams = ModemParams()):
-    """Single burst with in-band metadata: → (callsign, payload) or None.
-
-    No a-priori payload size: the BPSK metadata symbols after the sync carry
-    callsign + operation mode (BCH(255,71), OSD-decoded, CRC16-gated); the mode
-    then sizes the polar payload decode."""
-    if p.fec != "polar":
-        raise ValueError("demodulate_auto needs fec='polar' (mode metadata)")
-    norm = _sync_norm(audio, p)
-    peak = int(np.argmax(norm))
-    if norm[peak] < 0.5:
-        return None
+def _decode_auto_at(audio: np.ndarray, peak: int, p: ModemParams):
+    """Metadata burst at a known sync peak → (callsign, payload, span) or None."""
     sync_spec = np.fft.fft(audio[peak:peak + p.fft])
     H = sync_spec[p.carriers] / _sync_spectrum(p)[p.carriers]
     soft = []
@@ -305,7 +306,39 @@ def demodulate_auto(audio: np.ndarray, p: ModemParams = ModemParams()):
                          skip_symbols=_meta_symbols(p), H=H)
     if payload is None:
         return None
-    return callsign, payload
+    n_sym = -(-_coded_len(n_payload, p) // (2 * p.n_carriers))
+    span = (1 + _meta_symbols(p) + n_sym) * p.sym_len
+    return callsign, payload, span
+
+
+def demodulate_auto(audio: np.ndarray, p: ModemParams = ModemParams()):
+    """Single burst with in-band metadata: → (callsign, payload) or None.
+
+    No a-priori payload size: the BPSK metadata symbols after the sync carry
+    callsign + operation mode (BCH(255,71), OSD-decoded, CRC16-gated); the mode
+    then sizes the polar payload decode."""
+    if p.fec != "polar":
+        raise ValueError("demodulate_auto needs fec='polar' (mode metadata)")
+    norm = _sync_norm(audio, p)
+    peak = int(np.argmax(norm))
+    if norm[peak] < 0.5:
+        return None
+    r = _decode_auto_at(audio, peak, p)
+    return None if r is None else (r[0], r[1])
+
+
+def demodulate_all_auto(audio: np.ndarray, p: ModemParams = ModemParams()):
+    """Every metadata burst in ``audio``, in time order:
+    ``[(sync_start, callsign, payload), …]`` — senders may use different
+    operation modes; each burst's own metadata sizes its decode and span."""
+    if p.fec != "polar":
+        raise ValueError("demodulate_all_auto needs fec='polar' (mode metadata)")
+
+    def decode(peak):
+        r = _decode_auto_at(audio, peak, p)
+        return None if r is None else ((peak, r[0], r[1]), r[2])
+
+    return _scan_bursts(audio, p, decode)
 
 
 def _decode_at(audio: np.ndarray, sync_start: int, n_payload: int,
@@ -401,9 +434,9 @@ class ModemTransmitter(Kernel):
     """Message port ``tx`` (Blob) → audio sample stream (float32 @ params.fs)."""
 
     def __init__(self, payload_size: int = 64, params: ModemParams = ModemParams(),
-                 gap_samples: int = 2000):
+                 gap_samples: int = 2000, callsign: Optional[str] = None):
         super().__init__()
-        self.modem = Modem(payload_size, params)
+        self.modem = Modem(payload_size, params, callsign=callsign)
         self.gap = gap_samples
         self._pending = []
         self._current: Optional[np.ndarray] = None
@@ -447,12 +480,23 @@ class ModemTransmitter(Kernel):
 
 
 class ModemReceiver(Kernel):
-    """Audio stream → decoded payload messages on ``rx``."""
+    """Audio stream → decoded payload messages on ``rx``.
 
-    def __init__(self, payload_size: int = 64, params: ModemParams = ModemParams()):
+    ``auto=True`` (polar fec): size-free metadata reception — bursts carry
+    callsign + mode in-band, ``frames`` holds (callsign, payload) tuples and
+    ``rx`` posts maps; senders of different modes coexist on one receiver."""
+
+    def __init__(self, payload_size: int = 64, params: ModemParams = ModemParams(),
+                 auto: bool = False):
         super().__init__()
-        self.modem = Modem(payload_size, params)
-        self.OVERLAP = self.modem.burst_samples() + 4 * params.sym_len
+        if auto and params.fec != "polar":
+            raise ValueError("auto metadata reception needs fec='polar'")
+        self.auto = auto
+        # auto: size the window for the LARGEST mode (170 B) + metadata symbols
+        self.modem = Modem(170 if auto else payload_size, params,
+                           callsign="X" if auto else None)
+        self._span = self.modem.burst_samples()
+        self.OVERLAP = self._span + 4 * params.sym_len
         self.frames = []
         self._tail = np.zeros(0, np.float32)
         self._recent = []                  # (absolute_position, payload)
@@ -473,15 +517,24 @@ class ModemReceiver(Kernel):
         # used to drop every burst but one when big chunks arrived. Dedup is by
         # absolute POSITION (tail overlap re-decodes the same burst), so a
         # genuinely retransmitted identical payload still comes through.
-        span = self.modem.burst_samples()
-        for pos, payload in self.modem.rx_all(buf):
+        span = self._span
+        if self.auto:
+            decoded = [(pos, (cs, pl.rstrip(b"\x00")))
+                       for pos, cs, pl in demodulate_all_auto(buf, self.modem.params)]
+        else:
+            decoded = self.modem.rx_all(buf)
+        for pos, payload in decoded:
             abs_pos = self._buf_abs + pos
             if any(pay == payload and abs(abs_pos - p) < span
                    for p, pay in self._recent):
                 continue
             self._recent = (self._recent + [(abs_pos, payload)])[-8:]
             self.frames.append(payload)
-            mio.post("rx", Pmt.blob(payload))
+            if self.auto:
+                mio.post("rx", Pmt.map({"callsign": payload[0],
+                                        "payload": Pmt.blob(payload[1])}))
+            else:
+                mio.post("rx", Pmt.blob(payload))
         keep = min(len(buf), self.OVERLAP)
         self._buf_abs += len(buf) - keep
         self._tail = buf[len(buf) - keep:].copy()

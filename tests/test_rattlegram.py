@@ -168,3 +168,33 @@ def test_metadata_modem_fixed_rx_paths_still_work():
         _base37("LONGCALL10")
     with pytest.raises(ValueError, match="base-37"):
         _base37("٥")                       # non-ASCII digit must not pass
+
+
+def test_auto_receiver_block_mixed_modes():
+    """ModemReceiver(auto=True): one receiver block decodes senders of
+    DIFFERENT operation modes from the stream, posting (callsign, payload)."""
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSource
+    from futuresdr_tpu.models.rattlegram import (Modem, ModemParams,
+                                                 ModemReceiver)
+    rng = np.random.default_rng(9)
+    p = ModemParams(fec="polar")
+    small = Modem(payload_size=85, params=p, callsign="N0CALL")
+    large = Modem(payload_size=170, params=p, callsign="SP5WWP")
+    parts = [np.zeros(400, np.float32)]
+    for m, pl in ((small, b"small mode burst"), (large, b"large mode burst"),
+                  (small, b"small again")):
+        parts += [m.tx(pl), np.zeros(500, np.float32)]
+    x = np.concatenate(parts)
+    x = (x + 0.04 * rng.standard_normal(len(x))).astype(np.float32)
+
+    rx = ModemReceiver(params=p, auto=True)
+    fg = Flowgraph()
+    fg.connect_stream(VectorSource(x), "out", rx, "in")
+    Runtime().run(fg)
+    assert rx.frames == [("N0CALL", b"small mode burst"),
+                         ("SP5WWP", b"large mode burst"),
+                         ("N0CALL", b"small again")], rx.frames
+
+    with pytest.raises(ValueError, match="polar"):
+        ModemReceiver(auto=True)                  # conv params: rejected
