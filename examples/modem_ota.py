@@ -8,7 +8,7 @@ sys.path.insert(0, "..")
 
 import numpy as np
 
-from futuresdr_tpu.models.rattlegram import Modem
+from futuresdr_tpu.models.rattlegram import Modem, ModemParams, demodulate_auto
 
 
 def main():
@@ -16,17 +16,26 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("message", nargs="?", default="hello through the speaker")
     p.add_argument("--noise", type=float, default=0.02)
+    p.add_argument("--callsign", default=None,
+                   help="polar fec + in-band metadata: RX needs no payload size")
     a = p.parse_args()
 
     rng = np.random.default_rng(0)
-    m = Modem(payload_size=64)
+    if a.callsign:
+        m = Modem(payload_size=85, params=ModemParams(fec="polar"),
+                  callsign=a.callsign)
+    else:
+        m = Modem(payload_size=64)
     audio = m.tx(a.message.encode())
     print(f"burst: {len(audio)} samples @8 kHz = {len(audio)/8000:.2f} s")
     channel = np.concatenate([np.zeros(1000, np.float32), 0.5 * audio,
                               np.zeros(500, np.float32)])
     channel += a.noise * rng.standard_normal(len(channel)).astype(np.float32)
-    got = m.rx(channel)
-    print("decoded:", got)
+    if a.callsign:
+        cs, payload = demodulate_auto(channel, m.params)
+        print(f"decoded from {cs}:", payload.rstrip(b"\x00"))
+    else:
+        print("decoded:", m.rx(channel))
 
 
 if __name__ == "__main__":
