@@ -82,7 +82,7 @@ class LoraReceiver(Kernel):
         # worst-case frame length in samples, for the inter-window overlap;
         # ldro payload blocks carry only sf-2 nibbles per column
         max_payload = max(max_payload, implicit_payload_len or 0)
-        sf_app = params.sf - 2 if params.ldro else params.sf
+        sf_app = params.sf - 2 if params.ldro_on else params.sf
         n_sym = 8 + (4 + params.cr) * (2 * (max_payload + 2) // sf_app + 2)
         self.OVERLAP = (params.n_preamble + 5 + n_sym) * n
         self.frames = []

@@ -35,6 +35,7 @@ class MeshtasticConfig:
 
     def lora_params(self, **kw) -> LoraParams:
         return LoraParams(sf=self.sf, cr=self.cr, ldro=self.ldro,
+                          bw_hz=self.bandwidth_hz,
                           sync_word=0x2B, **kw)     # Meshtastic sync word 0x2B
 
 

@@ -30,7 +30,10 @@ class LoraParams:
     sync_word: Union[int, Tuple[int, ...]] = 0x12   # RX may accept several ids;
     #   TX modulates the first (`frame_sync.rs:1098` initial_sync_words)
     has_crc: bool = True
-    ldro: bool = False          # low-data-rate optimize: payload at sf-2 too
+    ldro: Optional[bool] = False    # low-data-rate optimize: payload at sf-2 too;
+    #   None = auto — on iff the symbol exceeds 16 ms at ``bw_hz``
+    #   (`default_values.rs:15` LDRO_MAX_DURATION_MS), e.g. SF11+ at 125 kHz
+    bw_hz: int = 125_000        # only used by the LDRO auto rule
     implicit_header: bool = False   # no in-band header: RX must know length/cr/crc
     #   a priori (`decoder.rs:36` — the reference's implicit_header mode); the
     #   first block is still the reduced-rate CR4/8 sf-2 block, all payload
@@ -40,6 +43,12 @@ class LoraParams:
     @property
     def n(self) -> int:
         return 1 << self.sf
+
+    @property
+    def ldro_on(self) -> bool:
+        if self.ldro is not None:
+            return self.ldro
+        return 1000.0 * self.n / self.bw_hz > 16.0
 
 
 def _upchirp(n: int, shift: int = 0) -> np.ndarray:
@@ -87,8 +96,8 @@ def encode_payload_symbols(payload: bytes, p: LoraParams) -> np.ndarray:
     sym = coding.interleave_block(cw, sf_app_hdr, 4)
     symbols += [int(g) << 2 for g in coding.degray(sym)]
     # payload blocks
-    sf_app = p.sf - 2 if p.ldro else p.sf
-    shift_bits = 2 if p.ldro else 0
+    sf_app = p.sf - 2 if p.ldro_on else p.sf
+    shift_bits = 2 if p.ldro_on else 0
     i = 0
     while i < len(rest):
         blk = rest[i:i + sf_app]
@@ -252,7 +261,7 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
         # parse_header's checksum already vouches for this block: single candidate
         hdr_alts = [list(hdr_nibbles[5:])]
 
-    sf_app = p.sf - 2 if p.ldro else p.sf
+    sf_app = p.sf - 2 if p.ldro_on else p.sf
     n_crc = 2 if has_crc else 0
     n_nibbles_needed = 2 * (length + n_crc)
     n_from_hdr = len(hdr_alts[0])
@@ -261,7 +270,7 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
     if n_hdr_sym + n_blocks * blk_len > len(bins):
         return None
 
-    if p.ldro:
+    if p.ldro_on:
         p_n = nq
         pbins = qbins
         o_run = o_hdr_q
@@ -305,7 +314,7 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
             # clean signals, so no-CRC frames stay correct), hard profiles follow,
             # and speculative other-offset softs trail as CRC-arbitrated fallbacks
             offs = list(dict.fromkeys(o_end for _, o_end, _ in cands))
-            softs = [_soft_nibbles(mags[i:i + blk_len], o, sf_app, cr, p.ldro, n)
+            softs = [_soft_nibbles(mags[i:i + blk_len], o, sf_app, cr, p.ldro_on, n)
                      for o in offs]
             lead = [softs[0]] if not any(np.array_equal(softs[0], a)
                                          for a in alts) else []

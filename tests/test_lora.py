@@ -389,3 +389,20 @@ def test_soft_decoding_no_crc_clean_exact():
                            + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
         r = demodulate_frame(x, 0, p)
         assert r is not None and r[0] == payload, (cr, "noisy", r)
+
+
+def test_ldro_auto_rule():
+    """ldro=None auto-enables low-data-rate optimize when the symbol exceeds
+    16 ms at the configured bandwidth (`default_values.rs` LDRO_MAX_DURATION_MS):
+    SF11+ at 125 kHz on, SF12 at 500 kHz off; a loopback under auto works."""
+    assert not LoraParams(sf=10, ldro=None).ldro_on          # 8.2 ms
+    assert LoraParams(sf=11, ldro=None).ldro_on              # 16.4 ms
+    assert LoraParams(sf=12, ldro=None).ldro_on
+    assert not LoraParams(sf=12, ldro=None, bw_hz=500_000).ldro_on
+    assert LoraParams(sf=12, ldro=True, bw_hz=500_000).ldro_on   # manual wins
+
+    p = LoraParams(sf=11, cr=2, ldro=None)
+    payload = b"auto ldro frame"
+    sig = modulate_frame(payload, p)
+    r = demodulate_frame(sig, 0, p)
+    assert r is not None and r[0] == payload and r[1]
