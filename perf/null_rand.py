@@ -25,20 +25,32 @@ from futuresdr_tpu.runtime.buffer import circular
 from futuresdr_tpu.runtime.scheduler import AsyncScheduler, ThreadedScheduler
 
 
-def run_once(pipes, stages, samples, max_copy, backend, sched) -> float:
+def run_once(pipes, stages, samples, max_copy, backend, sched_name) -> float:
+    import os
     fg = Flowgraph()
+    pinned = {}                    # whole pipe → one worker (`buffer_rand.rs:44-54`
+    n_workers = os.cpu_count() or 1    # flow_mapping: pipe_idx % n_executors)
     for p in range(pipes):
-        src = NullSource(np.float32)
-        head = Head(np.float32, samples)
-        fg.connect_stream(src, "out", head, "in", buffer=backend)
-        last = head
+        blocks = [NullSource(np.float32), Head(np.float32, samples)]
+        fg.connect_stream(blocks[0], "out", blocks[1], "in", buffer=backend)
+        last = blocks[1]
         for s in range(stages):
             c = CopyRand(np.float32, max_copy=max_copy, seed=1 + p * stages + s)
             fg.connect_stream(last, "out", c, "in", buffer=backend)
+            blocks.append(c)
             last = c
         snk = NullSink(np.float32)
         fg.connect_stream(last, "out", snk, "in", buffer=backend)
-    rt = Runtime(scheduler=sched())
+        blocks.append(snk)
+        for i, b in enumerate(blocks):
+            b.meta.instance_name = f"pipe{p}_blk{i}"
+            pinned[b.meta.instance_name] = p % n_workers
+    if sched_name == "async":
+        rt = Runtime(scheduler=AsyncScheduler())
+    elif sched_name == "pinned":
+        rt = Runtime(scheduler=ThreadedScheduler(pinned=pinned))
+    else:
+        rt = Runtime(scheduler=ThreadedScheduler())
     t0 = time.perf_counter()
     rt.run(fg)
     dt = time.perf_counter() - t0
@@ -55,12 +67,14 @@ def main():
     p.add_argument("--max-copy", type=int, default=512,
                    help="max items one work() call forwards (small = max stress)")
     p.add_argument("--buffers", nargs="+", default=["circular", "ring"])
-    p.add_argument("--schedulers", nargs="+", default=["async", "threaded"])
+    p.add_argument("--schedulers", nargs="+",
+                   default=["async", "threaded", "pinned"],
+                   help="'pinned' maps whole pipes to workers, the reference "
+                        "buffer_rand/flow_mapping strategy")
     a = p.parse_args()
     backends = {"ring": RingWriter}
     if circular.available():
         backends["circular"] = circular.CircularWriter
-    scheds = {"async": AsyncScheduler, "threaded": ThreadedScheduler}
     print("run,pipes,stages,samples,max_copy,buffer,scheduler,elapsed_secs,msps_total")
     for r in range(a.runs):
         for bname in a.buffers:
@@ -70,7 +84,7 @@ def main():
                 for pipes in a.pipes:
                     for stages in a.stages:
                         dt = run_once(pipes, stages, a.samples, a.max_copy,
-                                      backends[bname], scheds[sname])
+                                      backends[bname], sname)
                         print(f"{r},{pipes},{stages},{a.samples},{a.max_copy},"
                               f"{bname},{sname},{dt:.3f},"
                               f"{pipes * a.samples / dt / 1e6:.1f}", flush=True)
