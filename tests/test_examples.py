@@ -23,6 +23,7 @@ _EXAMPLES = [
     ("wlan_loopback.py", ["--frames", "2"]),
     ("zigbee_loopback.py", ["--frames", "2"]),
     ("modem_ota.py", ["hello"]),
+    ("modem_ota.py", ["metadata in band", "--callsign", "N0CALL"]),
     ("adsb_rx.py", []),                      # synthesizes its own stream
     ("sharded_spectrum.py", ["--devices", "2", "--frames", "2",
                              "--frame-size", "16384"]),
