@@ -202,3 +202,54 @@ def test_multichannel_rx_two_channels():
             got[d["payload"].to_blob()] = d["freq"].to_float()
     assert got.get(b"chan-A-frame") == 867.7e6
     assert got.get(b"chan-B-frame") == 868.1e6
+
+
+def test_multichannel_rx_channelizer_front_end():
+    """use_channelizer=True: ONE PFB channelizer + per-channel arb resampler
+    (the reference `rx_all_channels_eu.rs:109-144` chain) decodes frames on two
+    grid channels with the right frequency tags."""
+    from futuresdr_tpu.blocks import VectorSource
+    from futuresdr_tpu.models.lora.phy import modulate_frame
+
+    p = LoraParams(sf=7)
+    rate = 1e6
+    center = 867.9e6
+    channels = [867.65e6, 868.15e6]            # ±250 kHz: on the 4-slot grid
+    decim = int(rate // 125e3)
+
+    payloads = [b"grid-chan-lo", b"grid-chan-hi"]
+    base = np.zeros(int(rate * 0.06), np.complex64)
+    t = np.arange(len(base)) / rate
+    from scipy import signal as sps
+    for f, payload in zip(channels, payloads):
+        chips = modulate_frame(payload, p)
+        up = np.zeros(len(chips) * decim, np.complex64)
+        up[::decim] = chips
+        lp = sps.firwin(8 * decim + 1, 0.9 / decim)
+        up = sps.lfilter(lp, 1.0, up).astype(np.complex64) * decim
+        k = 3000
+        seg = min(len(up), len(base) - k)
+        base[k:k + seg] += (up[:seg]
+                            * np.exp(2j * np.pi * (f - center) * t[:seg])
+                            ).astype(np.complex64)
+
+    fg = Flowgraph()
+    src = VectorSource(base)
+    fg, receivers, tags = build_multichannel_rx(src, rate, center, p,
+                                                channels_hz=channels, fg=fg,
+                                                use_channelizer=True,
+                                                spacing_hz=250e3)
+    sinks = []
+    for tag in tags:
+        snk = MessageSink()
+        fg.connect_message(tag, "out", snk, "in")
+        sinks.append(snk)
+    Runtime().run(fg)
+
+    got = {}
+    for snk in sinks:
+        for m in snk.received:
+            d = m.to_map()
+            got[d["payload"].to_blob()] = d["freq"].to_float()
+    assert got.get(b"grid-chan-lo") == 867.65e6
+    assert got.get(b"grid-chan-hi") == 868.15e6
