@@ -179,12 +179,15 @@ def _META_GEN():
 
 
 def modulate(payload: bytes, p: ModemParams = ModemParams(),
-             callsign: Optional[str] = None) -> np.ndarray:
+             callsign: Optional[str] = None,
+             noise_symbols: int = 0) -> np.ndarray:
     """Payload bytes → audio samples (sync symbol + QPSK payload symbols).
 
     With ``callsign`` (polar fec only), BPSK metadata symbols carrying
     callsign+mode follow the sync — the receiver then needs no a-priori
-    payload size (:func:`demodulate_auto`)."""
+    payload size (:func:`demodulate_auto`). ``noise_symbols`` prepends
+    MLS-seeded random-QPSK symbols before the sync (`encoder.rs:308-319`
+    noise_symbol role: opens squelch/AGC before the data arrives)."""
     if p.fec == "polar":
         data_bits = _polar_mode_bits(len(payload))
         mesg = np.frombuffer(payload.ljust(data_bits // 8, b"\x00"), np.uint8)
@@ -200,7 +203,17 @@ def modulate(payload: bytes, p: ModemParams = ModemParams(),
     padded = np.zeros(n_sym * bits_per_sym, dtype=np.uint8)
     padded[:len(coded)] = coded
     sync = _sync_spectrum(p)
-    parts = [_sym_to_audio(sync, p)]
+    parts = []
+    if noise_symbols:
+        seq = rfec.Mls(0b100101010001)     # long-period MLS bit source (ref's
+        #                                    noise_seq role)
+        for _ in range(noise_symbols):
+            spec = np.zeros(p.fft, dtype=np.complex128)
+            vals = np.array([(2.0 * seq.next() - 1) + 1j * (2.0 * seq.next() - 1)
+                             for _ in range(p.n_carriers)]) / np.sqrt(2)
+            spec[p.carriers] = vals
+            parts.append(_sym_to_audio(spec, p))
+    parts.append(_sym_to_audio(sync, p))
     if callsign is not None:
         if p.fec != "polar":
             raise ValueError("in-band metadata needs fec='polar' (mode field)")

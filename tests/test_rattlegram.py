@@ -198,3 +198,21 @@ def test_auto_receiver_block_mixed_modes():
 
     with pytest.raises(ValueError, match="polar"):
         ModemReceiver(auto=True)                  # conv params: rejected
+
+
+def test_noise_symbol_prefix():
+    """noise_symbols prepends squelch/AGC-opening symbols (`encoder.rs:308`)
+    of comparable power that do not disturb sync or decoding."""
+    from futuresdr_tpu.models.rattlegram.modem import (ModemParams, demodulate,
+                                                       modulate)
+    p = ModemParams()
+    payload = b"squelch opener".ljust(32, b"\x00")
+    plain = modulate(payload, p)
+    noisy = modulate(payload, p, noise_symbols=5)
+    assert len(noisy) == len(plain) + 5 * p.sym_len
+    pw_prefix = float(np.mean(noisy[:5 * p.sym_len] ** 2))
+    pw_data = float(np.mean(plain ** 2))
+    assert 0.3 * pw_data < pw_prefix < 3 * pw_data
+    x = np.concatenate([np.zeros(400, np.float32), noisy,
+                        np.zeros(200, np.float32)]).astype(np.float32)
+    assert demodulate(x, 32, p) == payload
