@@ -2,7 +2,8 @@
 
 from .phy import (CHIP_SEQUENCES, modulate_frame, demodulate_stream, mac_frame,
                   mac_deframe, crc16_802154)
-from .blocks import ZigbeeTransmitter, ZigbeeReceiver
+from .blocks import IqDelay, ZigbeeTransmitter, ZigbeeReceiver
 
 __all__ = ["CHIP_SEQUENCES", "modulate_frame", "demodulate_stream", "mac_frame",
-           "mac_deframe", "crc16_802154", "ZigbeeTransmitter", "ZigbeeReceiver"]
+           "mac_deframe", "crc16_802154", "IqDelay", "ZigbeeTransmitter",
+           "ZigbeeReceiver"]

@@ -12,7 +12,43 @@ from ...runtime.kernel import Kernel, message_handler
 from ...types import Pmt
 from .phy import SAMPLES_PER_CHIP, demodulate_stream, mac_deframe, mac_frame, modulate_frame
 
-__all__ = ["ZigbeeTransmitter", "ZigbeeReceiver"]
+__all__ = ["IqDelay", "ZigbeeTransmitter", "ZigbeeReceiver"]
+
+
+class IqDelay(Kernel):
+    """Half-chip O-QPSK offset as a stream block (`iq_delay.rs` role): the
+    imaginary rail is delayed by ``delay`` samples relative to the real rail
+    (zeros seed the line). The reference wraps this in burst padding for its
+    hardware TX framing; here the transmitter blocks own inter-burst gaps, so
+    the delay is continuous."""
+
+    def __init__(self, delay: int = 2):
+        super().__init__()
+        assert delay >= 0
+        self.delay = int(delay)
+        self._line = np.zeros(self.delay, np.float32)
+        self.input = self.add_stream_input("in", np.complex64)
+        self.output = self.add_stream_output("out", np.complex64)
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        out = self.output.slice()
+        n = min(len(inp), len(out))
+        if n == 0:
+            if self.input.finished() and self.input.available() == 0:
+                io.finished = True
+            return
+        x = inp[:n]
+        q = np.concatenate([self._line, x.imag.astype(np.float32)])
+        out[:n] = x.real + 1j * q[:n]
+        if self.delay:
+            self._line = q[n:n + self.delay].copy()
+        self.input.consume(n)
+        self.output.produce(n)
+        if self.input.finished() and self.input.available() == 0:
+            io.finished = True
+        elif len(inp) > n:
+            io.call_again = True
 
 
 class ZigbeeTransmitter(Kernel):

@@ -143,3 +143,24 @@ def test_coherent_multi_burst():
                         + 1j * rng.standard_normal(len(sig))) / np.sqrt(2)
            ).astype(np.complex64)
     assert demodulate_stream(sig, timing="coherent") == sent
+
+
+def test_iq_delay_block():
+    """IqDelay (`iq_delay.rs` role): the Q rail is delayed by `delay` samples
+    relative to I, seeded with zeros, streaming across work() windows."""
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSink, VectorSource
+    from futuresdr_tpu.models.zigbee import IqDelay
+
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal(10000) + 1j * rng.standard_normal(10000)
+         ).astype(np.complex64)
+    fg = Flowgraph()
+    snk = VectorSink(np.complex64)
+    fg.connect(VectorSource(x), IqDelay(delay=2), snk)
+    Runtime().run(fg)
+    y = np.asarray(snk.items())
+    assert len(y) == len(x)
+    np.testing.assert_allclose(y.real, x.real, atol=0)
+    np.testing.assert_allclose(y.imag[:2], 0.0)
+    np.testing.assert_allclose(y.imag[2:], x.imag[:-2], atol=0)
