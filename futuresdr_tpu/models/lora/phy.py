@@ -468,11 +468,17 @@ def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams,
         err = min((r - 8 * s) % n, (8 * s - r) % n)
         return s, err, conc
 
-    matched = noisy = False
-    for off in (0, n, 2 * n):       # the preamble walk can undershoot ≤2 chirps
+    matched_q = None
+    noisy = False
+    # the preamble walk can undershoot ≤2 chirps — or OVERSHOOT one when the
+    # sync word's high nibble is 0 (its first chirp dechirps like preamble), so
+    # the scan starts one chirp back. A match at the -n slot is TENTATIVE: the
+    # boundary pair (preamble, sync_hi) there can alias a 0x0X id in the
+    # accepted set, so a later aligned match overrides it.
+    for off in (-n, 0, n, 2 * n):
         q = pos + off
-        if q + 2 * n > len(samples):
-            break
+        if q < 0 or q + 2 * n > len(samples):
+            continue
         s1, e1, c1 = sync_nibble(q)
         s2, e2, c2 = sync_nibble(q + n)
         if c1 < 0.10 or c2 < 0.10:
@@ -480,14 +486,18 @@ def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams,
             break
         if any(s1 == ((w >> 4) & 0xF) and s2 == (w & 0xF) and e1 <= 2 and e2 <= 2
                for w in valid):
-            matched = True
-            pos = q                 # re-anchor on the true sync position
-            break
-        if s1 != 0:
-            break                   # confident foreign id
+            matched_q = q
+            if off >= 0:
+                break               # aligned match: authoritative
+            continue                # -n match: keep scanning for an aligned one
+        if off >= 0 and s1 != 0:
+            break                   # confident foreign id (a tentative -n match,
+            #                         if any, still stands — overshoot case)
         # s1 == 0: first window still preamble-shaped (walk undershot — the pair
         # may be (preamble, preamble) or the boundary (preamble, nib_hi)): slide
-    if not matched and not noisy:
+    if matched_q is not None:
+        pos = matched_q             # re-anchor on the true sync position
+    elif not noisy:
         return None
     pos += 2 * n                    # sync word chirps
     # downchirp section: dechirp against an upchirp to split CFO from timing

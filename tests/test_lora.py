@@ -406,3 +406,65 @@ def test_ldro_auto_rule():
     sig = modulate_frame(payload, p)
     r = demodulate_frame(sig, 0, p)
     assert r is not None and r[0] == payload and r[1]
+
+
+def test_random_config_roundtrip_fuzz():
+    """Seeded sweep over random (sf, cr, ldro, implicit, soft, sync) configs:
+    every combination must loop back through the full demodulator under mild
+    noise + CFO — breadth regression across the feature matrix."""
+    rng = np.random.default_rng(2026)
+    for trial in range(20):
+        sf = int(rng.integers(7, 11))
+        cr = int(rng.integers(1, 5))
+        p = LoraParams(
+            sf=sf, cr=cr,
+            ldro=bool(rng.integers(0, 2)) if rng.integers(0, 2) else None,
+            implicit_header=bool(rng.integers(0, 2)),
+            soft_decoding=bool(rng.integers(0, 2)),
+            sync_word=int(rng.integers(1, 256)),
+        )
+        n_pay = int(rng.integers(1, 40))
+        payload = rng.integers(0, 256, n_pay).astype(np.uint8).tobytes()
+        sig = np.concatenate([np.zeros(300, np.complex64), modulate_frame(payload, p),
+                              np.zeros(300, np.complex64)])
+        sig = sig * np.exp(1j * (float(rng.uniform(0, 6)) +
+                                 float(rng.uniform(-5e-5, 5e-5)) * np.arange(len(sig))))
+        sig = (sig + 0.05 * (rng.standard_normal(len(sig))
+                             + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+        npay = n_pay if p.implicit_header else None
+        ok = False
+        for s in detect_frames(sig, p):
+            r = demodulate_frame(sig, s, p, n_payload=npay)
+            if r is not None and r[0] == payload and r[1]:
+                ok = True
+                break
+        assert ok, (trial, sf, cr, p.ldro, p.implicit_header, p.soft_decoding,
+                    hex(p.sync_word))
+
+
+def test_multi_id_with_zero_hi_nibble_does_not_alias():
+    """A multi-id RX accepting a 0x0X word must not let the overshoot scan slot
+    alias the (preamble, sync_hi) boundary of a 0x12 frame onto 0x01 — the
+    legitimate frame still decodes, and a real 0x04 frame is still accepted."""
+    rng = np.random.default_rng(31)
+    rx = LoraParams(sf=7, cr=2, sync_word=(0x01, 0x12))
+    for tx_word, payload in ((0x12, b"normal id frame"), ):
+        tx = LoraParams(sf=7, cr=2, sync_word=tx_word)
+        sig = np.concatenate([np.zeros(300, np.complex64),
+                              modulate_frame(payload, tx),
+                              np.zeros(300, np.complex64)])
+        sig = (sig + 0.03 * (rng.standard_normal(len(sig))
+                             + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+        ok = any((r := demodulate_frame(sig, s, rx)) is not None
+                 and r[0] == payload and r[1] for s in detect_frames(sig, rx))
+        assert ok, hex(tx_word)
+    # zero-high-nibble word still decodes via the overshoot slot
+    p4 = LoraParams(sf=9, cr=4, sync_word=0x04)
+    payload = b"zero hi nibble"
+    sig = np.concatenate([np.zeros(300, np.complex64), modulate_frame(payload, p4),
+                          np.zeros(300, np.complex64)])
+    sig = (sig + 0.03 * (rng.standard_normal(len(sig))
+                         + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+    ok = any((r := demodulate_frame(sig, s, p4)) is not None
+             and r[0] == payload and r[1] for s in detect_frames(sig, p4))
+    assert ok
