@@ -164,3 +164,22 @@ def test_iq_delay_block():
     np.testing.assert_allclose(y.real, x.real, atol=0)
     np.testing.assert_allclose(y.imag[:2], 0.0)
     np.testing.assert_allclose(y.imag[2:], x.imag[:-2], atol=0)
+
+
+def test_random_payload_roundtrip_fuzz():
+    """Seeded sweep over random payload lengths/content and timing modes."""
+    from futuresdr_tpu.models.zigbee import (demodulate_stream, mac_deframe,
+                                             mac_frame, modulate_frame)
+    rng = np.random.default_rng(154)
+    for trial in range(8):
+        timing = ("phase", "mm", "coherent")[int(rng.integers(0, 3))]
+        n_pay = int(rng.integers(1, 100))
+        payload = rng.integers(0, 256, n_pay).astype(np.uint8).tobytes()
+        sig = modulate_frame(mac_frame(payload, seq=trial))
+        x = np.concatenate([np.zeros(int(rng.integers(64, 600)), np.complex64),
+                            sig, np.zeros(256, np.complex64)])
+        x = (x * np.exp(1j * float(rng.uniform(0, 6.28)))
+             + 0.05 * (rng.standard_normal(len(x))
+                       + 1j * rng.standard_normal(len(x)))).astype(np.complex64)
+        got = [mac_deframe(ps) for ps in demodulate_stream(x, timing=timing)]
+        assert payload in got, (trial, timing, n_pay)
