@@ -240,3 +240,34 @@ def test_cpr_local_decode_guards():
     assert _dist_nm(*cand, *ref) > 180.0          # the guard's trigger condition
     t = Tracker(ref_pos=ref)
     assert t.update(me, now=0.0).lat is None, "out-of-range local CPR accepted"
+
+
+def test_random_frame_train_fuzz():
+    """Seeded sweep: random DF17 trains with interleaved surveillance replies
+    decode exactly once each through the magnitude-stream receiver."""
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSource
+    from futuresdr_tpu.models.adsb import AdsbReceiver, build_df17_frame
+    from futuresdr_tpu.models.adsb.phy import modulate_frame
+
+    rng = np.random.default_rng(1090)
+    icaos = [int(rng.integers(1, 1 << 24)) for _ in range(4)]
+    parts = [np.zeros(300, np.float32)]
+    n_expected = 0
+    for i in range(10):
+        icao = icaos[int(rng.integers(0, len(icaos)))]
+        if rng.integers(0, 4) == 0:
+            bits = _df11_frame(icao)
+        else:
+            me = rng.integers(0, 2, 56).astype(np.uint8)
+            bits = build_df17_frame(icao, me)
+        parts += [modulate_frame(bits, amplitude=2.0),
+                  np.zeros(int(rng.integers(250, 800)), np.float32)]
+        n_expected += 1
+    sig = np.concatenate(parts)
+    sig = (sig + 0.08 * np.abs(rng.standard_normal(len(sig)))).astype(np.float32)
+    rx = AdsbReceiver()
+    fg = Flowgraph()
+    fg.connect_stream(VectorSource(sig), "out", rx, "in")
+    Runtime().run(fg)
+    assert rx.n_frames == n_expected, (rx.n_frames, n_expected)
