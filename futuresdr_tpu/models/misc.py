@@ -140,7 +140,11 @@ def ook_demodulate(env: np.ndarray, fs: float, bit_rate: float,
     for i, v in enumerate(k):
         if v:
             if seen_activity and low_run >= 3 * spb:
-                start = i
+                # anchor on the run START + its fixed length (the preamble's
+                # trailing low half + the 4-half-bit sync gap): a payload
+                # beginning with a 0-bit (low-first Manchester) extends the low
+                # run, so the first HIGH after it is NOT the payload edge
+                start = i - low_run + 5 * spb
                 break
             low_run = 0
             seen_activity = True
