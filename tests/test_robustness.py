@@ -75,3 +75,60 @@ def test_soak_stream_minutes_of_samples():
     dt = time.perf_counter() - t0
     assert snk.n_received >= n
     assert dt < 60
+
+
+def test_random_topology_fuzz():
+    """Seeded sweep of random flowgraph topologies: chains with random fan-out
+    splits/joins, random chunk sizes (CopyRand) and buffer backends — every
+    graph completes with exact sample counts at every sink."""
+    import numpy as np
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import (Combine, CopyRand, Head, NullSource,
+                                      Sink, Split)
+    from futuresdr_tpu.runtime.buffer.ring import RingWriter
+    from futuresdr_tpu.runtime.buffer import circular
+
+    backends = [RingWriter]
+    if circular.available():
+        backends.append(circular.CircularWriter)
+    rng = np.random.default_rng(12321)
+    for trial in range(6):
+        fg = Flowgraph()
+        samples = int(rng.integers(50_000, 400_000))
+        buf = backends[int(rng.integers(0, len(backends)))]
+        src = NullSource(np.float32)
+        head = Head(np.float32, samples)
+        fg.connect_stream(src, "out", head, "in", buffer=buf)
+        last = head
+        n_stages = int(rng.integers(1, 5))
+        for s in range(n_stages):
+            c = CopyRand(np.float32, max_copy=int(rng.integers(64, 2048)),
+                         seed=trial * 10 + s)
+            fg.connect_stream(last, "out", c, "in", buffer=buf)
+            last = c
+        counts = []
+
+        def counting_sink():
+            c = [0]
+            counts.append(c)
+            return Sink(lambda chunk, c=c: c.__setitem__(0, c[0] + len(chunk)),
+                        np.float32)
+
+        if rng.integers(0, 2):
+            # fan out, process each arm, rejoin, then sink
+            sp = Split(lambda x: (x, x), np.float32)
+            fg.connect_stream(last, "out", sp, "in", buffer=buf)
+            arms = []
+            for arm in ("out0", "out1"):
+                c = CopyRand(np.float32, max_copy=512, seed=99)
+                fg.connect_stream(sp, arm, c, "in", buffer=buf)
+                arms.append(c)
+            comb = Combine(lambda a, b: a + b, np.float32)
+            fg.connect_stream(arms[0], "out", comb, "in0", buffer=buf)
+            fg.connect_stream(arms[1], "out", comb, "in1", buffer=buf)
+            fg.connect_stream(comb, "out", counting_sink(), "in", buffer=buf)
+        else:
+            fg.connect_stream(last, "out", counting_sink(), "in", buffer=buf)
+        Runtime().run(fg)
+        for c in counts:
+            assert c[0] == samples, (trial, c[0], samples)
