@@ -34,7 +34,13 @@ def test_metrics_live_query():
     rt = Runtime()
     running = rt.start(fg)
     import time
-    time.sleep(0.05)
-    m = running.handle.metrics_sync()
+    # poll: a fixed nap is flake-bait on a loaded box
+    deadline = time.perf_counter() + 10.0
+    m = {}
+    while time.perf_counter() < deadline:
+        m = running.handle.metrics_sync()
+        if any(v["work_calls"] > 0 for v in m.values()):
+            break
+        time.sleep(0.01)
     assert any(v["work_calls"] > 0 for v in m.values())
     running.stop_sync()
