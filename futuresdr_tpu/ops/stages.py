@@ -43,8 +43,9 @@ class Stage:
     out_dtype: Optional[np.dtype] = None          # None = same as input
     frame_multiple: int = 1                       # input frame must divide this
     name: str = "stage"
-    lti: Optional[Tuple[np.ndarray, int, int]] = None  # (taps, decim, fft_len) when the
-    #   stage is a linear time-invariant FIR — lets Pipeline merge adjacent FIRs into one
+    lti: Optional[Tuple[np.ndarray, int, int, str]] = None  # (taps, decim, fft_len, impl)
+    #   when the stage is a linear time-invariant FIR — lets Pipeline merge adjacent
+    #   FIRs into one (impl: the builder used for the merged stage, see _merge_lti)
     update: Optional[Callable[..., Any]] = None   # host-side ``(carry, **params) -> carry``
     #   runtime control hook: parameters (taps, phase_inc, …) live in the carry, so a
     #   retune is carry surgery between dispatches — NO recompile, frames stay in flight

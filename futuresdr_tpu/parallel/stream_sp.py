@@ -218,6 +218,10 @@ def sp_dechirp_scan(sf: int, mesh: Mesh, hop: int = None, axis: str = "sp"):
             raise ValueError(                    # would silently garble windows
                 f"per-shard length {x_local.shape[0]} < window {n}: "
                 f"grow the capture or reduce sf/devices")
+        if x_local.shape[0] % hop:               # trace-time: a non-multiple would
+            raise ValueError(                    # drop scan windows at shard seams
+                f"per-shard length {x_local.shape[0]} must be a multiple of "
+                f"hop {hop}")
         ext = _halo_from_right(x_local, n, axis)
         idx = jnp.arange(x_local.shape[0] // hop)[:, None] * hop + jnp.arange(n)
         spec = jnp.fft.fft(ext[idx] * down[None, :], axis=1)

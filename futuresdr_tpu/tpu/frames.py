@@ -61,8 +61,10 @@ def parse_ctrl(p: Pmt):
             params[k] = np.asarray(val)
         elif isinstance(val, np.ndarray):
             params[k] = val
+        elif isinstance(val, (float, np.floating)):
+            params[k] = float(val)        # genuine numerics normalize to float
         else:
-            params[k] = float(val)
+            params[k] = val               # ints/bools/strs pass through untouched
     return stage, params
 
 
@@ -224,7 +226,10 @@ class TpuD2H(Kernel):
         super().__init__()
         from collections import deque
         self.inst = inst or instance()
-        self.read_ahead = read_ahead or self.inst.frames_in_flight
+        # read_ahead=0 disables read-ahead = serial drain (pull one, sync it);
+        # the work loop needs bound >= 1 to make progress at all
+        self.read_ahead = max(1, read_ahead if read_ahead is not None
+                              else self.inst.frames_in_flight)
         self.input = self.add_inplace_input("in")
         self.output = self.add_stream_output("out", dtype)
         self._pending: Optional[np.ndarray] = None

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Opportunistic TPU-tunnel probe (round 4). Appends one line per attempt to
+# perf/probes/tpu_probe_r4.log; on first success the builder runs the full
+# device suite (see STATUS.md runbook) and commits BENCH_TPU_r4.json.
+TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+OUT=$(timeout 80 python -c "
+import jax
+try:
+    d = jax.devices('tpu')
+    print('ALIVE', [str(x) for x in d])
+except Exception as e:
+    print('DEAD', type(e).__name__, str(e)[:120])
+" 2>&1 | tail -1)
+[ -z "$OUT" ] && OUT="DEAD timeout-80s"
+echo "$TS $OUT" >> "$(dirname "$0")/probes/tpu_probe_r4.log"
+echo "$TS $OUT"

@@ -84,3 +84,43 @@ def test_plain_connect_dispatches_inplace_edges():
     with pytest.raises(ConnectError, match="inplace"):
         fg2.connect_stream(TpuH2D(np.float32, frame_size=1024), "out",
                            VectorSink(np.float32), "in")
+
+
+def test_d2h_read_ahead_zero_is_serial_drain():
+    """read_ahead=0 must mean 'no read-ahead' (serial drain), not silently
+    substitute frames_in_flight — and the graph must still make progress."""
+    taps = firdes.lowpass(0.25, 32).astype(np.float32)
+    data = np.random.default_rng(2).standard_normal(65536).astype(np.float32)
+    fg = Flowgraph()
+    src, snk = VectorSource(data), VectorSink(np.float32)
+    h2d = TpuH2D(np.float32, frame_size=8192)
+    st = TpuStage([fir_stage(taps, fft_len=1024)], np.float32)
+    d2h = TpuD2H(np.float32, read_ahead=0)
+    assert d2h.read_ahead == 1          # 0 clamps to the minimum progress bound
+    fg.connect(src, h2d, st, d2h, snk)
+    Runtime().run(fg)
+    got = snk.items()
+    assert len(got) == 65536
+    np.testing.assert_allclose(got[:4096], np.convolve(data, taps)[:4096],
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_parse_ctrl_preserves_int_bool_str():
+    """Non-float scalars must pass through parse_ctrl unchanged; floats (and
+    numpy floats) normalize to Python float (ADVICE r3)."""
+    from futuresdr_tpu.tpu.frames import parse_ctrl
+    from futuresdr_tpu.types import Pmt
+
+    stage, params = parse_ctrl(Pmt.map({
+        "stage": Pmt.string("st"),
+        "phase_inc": Pmt.f64(0.25),
+        "count": Pmt.u64(7),
+        "enable": Pmt.bool_(True),
+        "mode": Pmt.string("soft"),
+    }))
+    assert stage == "st"
+    assert params["phase_inc"] == 0.25 and type(params["phase_inc"]) is float
+    assert params["count"] == 7 and isinstance(params["count"], int) \
+        and not isinstance(params["count"], bool)
+    assert params["enable"] is True
+    assert params["mode"] == "soft"
