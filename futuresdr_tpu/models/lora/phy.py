@@ -37,8 +37,12 @@ class LoraParams:
     implicit_header: bool = False   # no in-band header: RX must know length/cr/crc
     #   a priori (`decoder.rs:36` — the reference's implicit_header mode); the
     #   first block is still the reduced-rate CR4/8 sf-2 block, all payload
-    soft_decoding: bool = False     # LLR demod + soft Hamming (`fft_demod.rs` soft
-    #   buffers): adds max-correlation candidates to the CRC arbitration
+    soft_decoding: bool = True      # LLR demod + soft Hamming (`fft_demod.rs` soft
+    #   buffers): adds max-correlation candidates to the CRC arbitration.
+    #   Default-ON to match the reference's receiver binaries, which hardwire
+    #   `build_lora_rx_soft_decoding` (`examples/lora/src/bin/rx.rs:65`,
+    #   `rx_meshtastic.rs:76`, `rx_all_channels_eu.rs:156`); set False for the
+    #   ~10%-faster hard path (documented opt-out, perf/RESULTS_r4.md)
 
     @property
     def n(self) -> int:

@@ -48,6 +48,11 @@ def main():
     p.add_argument("--device-resident", action="store_true",
                    help="scan-marginal dechirp+FFT+argmax hot loop on the device")
     p.add_argument("--symbols-per-frame", type=int, default=2048)
+    p.add_argument("--soft", dest="soft", action="store_true", default=None,
+                   help="force soft decoding (LoraParams default is soft-on)")
+    p.add_argument("--no-soft", dest="soft", action="store_false",
+                   help="force the hard path — pin this to compare across "
+                        "rounds that straddled the r4 soft-default flip")
     a = p.parse_args()
 
     if a.device_resident:
@@ -63,7 +68,8 @@ def main():
                   flush=True)
         return
 
-    params = LoraParams(sf=a.sf, cr=a.cr)
+    params = (LoraParams(sf=a.sf, cr=a.cr) if a.soft is None
+              else LoraParams(sf=a.sf, cr=a.cr, soft_decoding=a.soft))
     rng = np.random.default_rng(0)
     parts = []
     for i in range(a.frames):
