@@ -84,7 +84,7 @@ class LoraReceiver(Kernel):
         max_payload = max(max_payload, implicit_payload_len or 0)
         sf_app = params.sf - 2 if params.ldro_on else params.sf
         n_sym = 8 + (4 + params.cr) * (2 * (max_payload + 2) // sf_app + 2)
-        self.OVERLAP = (params.n_preamble + 5 + n_sym) * n
+        self.OVERLAP = (params.n_preamble + 5 + params.n_null + n_sym) * n
         self.frames = []
         self.crc_flags = []
         self._tail = np.zeros(0, np.complex64)

@@ -6,7 +6,11 @@ Re-design of the reference LoRa example's signal path (``examples/lora/src/``:
 dechirped and FFT'd as one batched [n_sym, 2^sf] computation.
 
 Frame layout: ``n_pre`` upchirps, 2 sync-word chirps, 2.25 downchirps, then header block
-(CR 4/8 at sf-2 bits/symbol) and payload blocks (CR 4/cr at sf bits/symbol).
+(CR 4/8 at sf-2 bits/symbol, reduced rate) and payload blocks (CR 4/cr at sf bits/
+symbol). SF5/SF6 (SX126x, the reference's default range start): the header block runs
+FULL rate (sf rows, no ×4 bins), two null upchirps sit between the downchirps and the
+first data symbol, and LDRO never applies to the header (`deinterleaver.rs:202-208`,
+`fft_demod.rs:72-75`, `modulator.rs:118-130`, `encoder.rs:195-215`).
 """
 
 from __future__ import annotations
@@ -44,6 +48,19 @@ class LoraParams:
     #   `rx_meshtastic.rs:76`, `rx_all_channels_eu.rs:156`); set False for the
     #   ~10%-faster hard path (documented opt-out, perf/RESULTS_r4.md)
 
+    def __post_init__(self):
+        if not 5 <= self.sf <= 12:
+            raise ValueError(f"sf must be in 5..12 (SX126x range), got {self.sf}")
+        # sync chirps ride bins nibble*8: a nibble with 8*nib >= 2^sf cannot be
+        # encoded (`utils.rs:465-489` SynchWord::verify "symbol space too small"
+        # — bites at SF5/6 where n is 32/64)
+        for w in self.sync_words:
+            for nib in ((w >> 4) & 0xF, w & 0xF):
+                if nib * 8 >= self.n:
+                    raise ValueError(
+                        f"sync word {w:#04x}: symbol {nib * 8} does not fit the "
+                        f"sf{self.sf} symbol space [0, {self.n})")
+
     @property
     def n(self) -> int:
         return 1 << self.sf
@@ -53,6 +70,32 @@ class LoraParams:
         if self.ldro is not None:
             return self.ldro
         return 1000.0 * self.n / self.bw_hz > 16.0
+
+    @property
+    def sync_words(self) -> Tuple[int, ...]:
+        """Accepted network ids as a tuple (``sync_word`` may be a single int)."""
+        return self.sync_word if isinstance(self.sync_word, tuple) \
+            else (self.sync_word,)
+
+    @property
+    def hdr_reduced(self) -> bool:
+        """SF≥7 header blocks ride reduced rate (sf−2 rows, bins ×4); SF5/6 have
+        no headroom — their header block runs FULL rate (`deinterleaver.rs:202-208`,
+        `fft_demod.rs:72-75`: ``reduced_rate = is_header && sf >= SF7``)."""
+        return self.sf >= 7
+
+    @property
+    def sf_app_hdr(self) -> int:
+        """Nibble rows in the first (header) interleave block: sf−2 at SF≥7,
+        sf at SF5/6 (`encoder.rs:195-215` first-block special case)."""
+        return self.sf - 2 if self.sf >= 7 else self.sf
+
+    @property
+    def n_null(self) -> int:
+        """SF5/6 frames carry two null upchirps between the 2.25 downchirps and
+        the first data symbol (`modulator.rs:118-130`; `frame_sync.rs:695-699`
+        "Semtech adds two null symbols in the beginning")."""
+        return 2 if self.sf < 7 else 0
 
 
 def _upchirp(n: int, shift: int = 0) -> np.ndarray:
@@ -76,7 +119,7 @@ def encode_payload_symbols(payload: bytes, p: LoraParams) -> np.ndarray:
         nibbles += [byte & 0xF, byte >> 4]
     nibbles = np.array(nibbles, dtype=np.uint8)
 
-    sf_app_hdr = p.sf - 2
+    sf_app_hdr = p.sf_app_hdr
     if p.implicit_header:
         # no header nibbles: the reduced-rate first block carries payload only
         hdr_nibbles = nibbles[:sf_app_hdr]
@@ -91,14 +134,16 @@ def encode_payload_symbols(payload: bytes, p: LoraParams) -> np.ndarray:
     rest = nibbles[used:]
 
     symbols: List[int] = []
-    # header block: CR 4/8, sf-2 bits per symbol, reduced rate — the inverse Gray map
-    # runs over the sf-2-bit field and the result rides on bins ×4
-    # (degray(s) << 2, NOT degray(s << 2): multiples of 4 on the wire are what give
-    # the reduced-rate mode its ±2-bin drift immunity, `gray_demap`/`fft_demod` of
-    # gr-lora_sdr)
+    # header block: CR 4/8. At SF≥7: sf-2 bits per symbol, reduced rate — the
+    # inverse Gray map runs over the sf-2-bit field and the result rides on bins
+    # ×4 (degray(s) << 2, NOT degray(s << 2): multiples of 4 on the wire are what
+    # give the reduced-rate mode its ±2-bin drift immunity, `gray_demap`/
+    # `fft_demod` of gr-lora_sdr). At SF5/6: FULL rate, sf bits per symbol, no
+    # bin scaling (`fft_demod.rs:72-75` reduced_rate requires sf >= SF7).
+    hdr_shift = 2 if p.hdr_reduced else 0
     cw = coding.hamming_encode(hdr_nibbles, 4)
     sym = coding.interleave_block(cw, sf_app_hdr, 4)
-    symbols += [int(g) << 2 for g in coding.degray(sym)]
+    symbols += [int(g) << hdr_shift for g in coding.degray(sym)]
     # payload blocks
     sf_app = p.sf - 2 if p.ldro_on else p.sf
     shift_bits = 2 if p.ldro_on else 0
@@ -122,10 +167,13 @@ def modulate_frame(payload: bytes, p: LoraParams) -> np.ndarray:
     parts = [np.tile(up, p.n_preamble)]
     # sync word as two shifted chirps (gr-lora_sdr: nibbles ×8); a multi-id RX
     # params object transmits its first id
-    w = p.sync_word[0] if isinstance(p.sync_word, tuple) else p.sync_word
+    w = p.sync_words[0]
     parts.append(_upchirp(n, ((w >> 4) & 0xF) * 8))
     parts.append(_upchirp(n, (w & 0xF) * 8))
     parts.append(np.concatenate([down, down, down[:n // 4]]))
+    # SF5/6: two null (symbol-0) upchirps before the data (`modulator.rs:118-130`)
+    for _ in range(p.n_null):
+        parts.append(up)
     for s in encode_payload_symbols(payload, p):
         parts.append(_upchirp(n, int(s)))
     return np.concatenate(parts).astype(np.complex64)
@@ -232,7 +280,7 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
     bins = np.asarray(symbols, dtype=np.int64)
     n = p.n
     nq = n >> 2
-    sf_app_hdr = p.sf - 2
+    sf_app_hdr = p.sf_app_hdr
     n_hdr_sym = 8                                  # CR 4/8 header block
     if len(bins) < n_hdr_sym:
         return None
@@ -240,7 +288,14 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
     # the nearest group absorbs ±2 bins of drift/noise, and drift tracking runs in
     # the uniform group domain
     qbins = (((bins + 2) >> 2) % nq).astype(np.int64)
-    hdr_cands = _best_profile(qbins[:n_hdr_sym], (0, 1, -1), sf_app_hdr, 4, 0, nq)
+    if p.hdr_reduced:
+        hdr_cands = _best_profile(qbins[:n_hdr_sym], (0, 1, -1), sf_app_hdr, 4,
+                                  0, nq)
+    else:
+        # SF5/6: the header block is FULL rate — arbitrate the sync bias directly
+        # in the bin domain (no ×4 group absorption, so search a bin wider)
+        hdr_cands = _best_profile(bins[:n_hdr_sym], (0, 1, -1, 2, -2), sf_app_hdr,
+                                  4, 0, n)
     o_hdr_q = hdr_cands[0][1]
     if p.implicit_header:
         # no in-band header (`decoder.rs:36`): length comes from the caller,
@@ -253,7 +308,7 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
                     for cw_, _, _ in hdr_cands]
         if p.soft_decoding and mags is not None:
             soft = list(_soft_nibbles(mags[:n_hdr_sym], o_hdr_q, sf_app_hdr, 4,
-                                      True, n)[:sf_app_hdr])
+                                      p.hdr_reduced, n)[:sf_app_hdr])
             if soft not in hdr_alts:
                 hdr_alts.insert(0, soft)
     else:
@@ -277,6 +332,15 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
     if p.ldro_on:
         p_n = nq
         pbins = qbins
+        # SF≥7: the header offset is already in the group domain; SF5/6's
+        # full-rate header offset maps to groups by rounding (|o_hdr| ≤ 2 ⇒ ~0)
+        o_run = o_hdr_q if p.hdr_reduced else int(np.round(o_hdr_q / 4.0))
+        first_starts = (o_run, o_run + 1, o_run - 1)
+    elif not p.hdr_reduced:
+        # SF5/6 non-ldro: header and payload share the bin domain — the header
+        # arbitration already pinned the bias exactly, chain it directly
+        p_n = n
+        pbins = bins
         o_run = o_hdr_q
         first_starts = (o_run, o_run + 1, o_run - 1)
     else:
@@ -299,15 +363,20 @@ def decode_symbols(symbols: np.ndarray, p: LoraParams, n_payload: Optional[int] 
         else:
             cands = _best_profile(pbins[i:i + blk_len], starts, sf_app, cr, 0, p_n)
         cached = None
-        ends = {c[1] for c in cands}
+        # end offsets in candidate-preference order (constant profile first):
+        # ties below MUST fall back to this order, not a numeric sort — at cr1
+        # in a small group domain (SF5/6 ldro: nq=8) every chain can show zero
+        # violations, and picking the numerically smallest offset follows a
+        # wrong chain straight through the whole payload
+        ends = list(dict.fromkeys(c[1] for c in cands))
         if len(ends) > 1 and b + 1 < n_blocks:
             # tied candidates disagree on the end offset (a low-rate block can hide a
             # ±1 error entirely on parity-uncovered bits): let the NEXT block's
             # violations arbitrate which chain to follow
             j = i + blk_len
             nxt = {e: _best_profile(pbins[j:j + blk_len], (e,), sf_app, cr, 0, p_n)
-                   for e in sorted(ends)}
-            o_run = min(sorted(ends), key=lambda e: nxt[e][0][2])
+                   for e in ends}
+            o_run = min(ends, key=lambda e: nxt[e][0][2])  # stable: pref order
             cached = ((o_run,), nxt[o_run])       # next iteration reuses this sweep
         else:
             o_run = cands[0][1]
@@ -364,7 +433,7 @@ def detect_frames(samples: np.ndarray, p: LoraParams) -> List[int]:
     symbol = upchirp train); refine timing from the bin index."""
     n = p.n
     hop = n // 4
-    limit = len(samples) - (p.n_preamble + 5) * n
+    limit = len(samples) - (p.n_preamble + 5 + p.n_null) * n
     if limit <= 0:
         return []
     n_probe = (limit + hop - 1) // hop + 4
@@ -390,18 +459,21 @@ def detect_frames(samples: np.ndarray, p: LoraParams) -> List[int]:
             if start < 0:
                 start += n
             # validate: two data symbols can match by chance; a real preamble shows a
-            # CONSTANT bin over ≥3 aligned consecutive chirps from `start`
+            # CONSTANT bin over aligned consecutive chirps from `start`. Small
+            # symbol spaces (SF5/6: n=32/64) collide far more often — equal data
+            # symbols mimic a short preamble — so they must confirm a longer run
+            n_confirm = 3 if n >= 128 else max(3, min(5, p.n_preamble))
             bins = []
-            for s in range(3):
+            for s in range(n_confirm):
                 q = start + s * n
                 if q + n > len(samples):
                     break
                 bins.append(int(np.argmax(np.abs(np.fft.fft(
                     samples[q:q + n] * _downchirp(n))))))
-            if len(bins) == 3 and all((b - bins[0]) % n in (0, 1, n - 1)
-                                      for b in bins):
+            if len(bins) == n_confirm and all((b - bins[0]) % n in (0, 1, n - 1)
+                                              for b in bins):
                 starts.append(start)
-                i = (start + (p.n_preamble + 5) * n + hop - 1) // hop  # skip the frame head
+                i = (start + (p.n_preamble + 5 + p.n_null) * n + hop - 1) // hop  # skip the frame head
             else:
                 i += 1
         else:
@@ -463,7 +535,7 @@ def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams,
     # the preamble bin c_up — so (k - c_up) mod n is 8*nibble exactly, independent
     # of CFO/timing. An unknown id is another network's frame: reject, like the
     # reference. ``sync_word`` may be an int or a tuple of accepted ids.
-    valid = p.sync_word if isinstance(p.sync_word, tuple) else (p.sync_word,)
+    valid = p.sync_words
 
     def sync_nibble(q: int):
         k, conc = bin_conc(q, down)
@@ -513,6 +585,8 @@ def demodulate_frame(samples: np.ndarray, start: int, p: LoraParams,
             f_bin = int(round(half(c_up + c_dn) / 2.0))
             d_shift = int(round(half(c_dn - c_up) / 2.0))
     pos += 2 * n + n // 4 + d_shift # 2.25 downchirps + timing correction
+    pos += p.n_null * n             # SF5/6: skip the two null symbols
+    #                                 (`frame_sync.rs:695-699` consumes them)
     if pos < 0 or pos + n > len(samples):
         return None
     spec = _dechirp_bins(samples[pos:], p)

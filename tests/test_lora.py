@@ -414,14 +414,18 @@ def test_random_config_roundtrip_fuzz():
     noise + CFO — breadth regression across the feature matrix."""
     rng = np.random.default_rng(2026)
     for trial in range(20):
-        sf = int(rng.integers(7, 11))
+        sf = int(rng.integers(5, 11))   # SX126x range incl. SF5/6 (r4)
         cr = int(rng.integers(1, 5))
         p = LoraParams(
             sf=sf, cr=cr,
             ldro=bool(rng.integers(0, 2)) if rng.integers(0, 2) else None,
             implicit_header=bool(rng.integers(0, 2)),
             soft_decoding=bool(rng.integers(0, 2)),
-            sync_word=int(rng.integers(1, 256)),
+            # only nibbles with 8*nib < 2^sf are encodable (bites at SF5/6);
+            # hi nibble may be 0 (keeps the overshoot-alias class in coverage),
+            # the all-zero word is excluded
+            sync_word=int(max(1, (rng.integers(0, min(16, (1 << sf) // 8)) << 4)
+                              | rng.integers(0, min(16, (1 << sf) // 8)))),
         )
         n_pay = int(rng.integers(1, 40))
         payload = rng.integers(0, 256, n_pay).astype(np.uint8).tobytes()
@@ -468,3 +472,108 @@ def test_multi_id_with_zero_hi_nibble_does_not_alias():
     ok = any((r := demodulate_frame(sig, s, p4)) is not None
              and r[0] == payload and r[1] for s in detect_frames(sig, p4))
     assert ok
+
+
+# ---- SF5/SF6 (SX126x additions — the reference's DEFAULT SF, `utils.rs:515-525`) ----
+
+def test_sf5_sf6_loopback_matrix():
+    """SF5/6 end-to-end across cr/implicit/ldro: the header block runs FULL rate
+    (sf rows, no x4 bins — `deinterleaver.rs:202-208`, `fft_demod.rs:72-75`) and
+    the frame carries two null symbols after the downchirps (`modulator.rs:118-130`)."""
+    rng = np.random.default_rng(54)
+    for sf in (5, 6):
+        for cr in (1, 2, 3, 4):
+            for imp in (False, True):
+                for ldro in (False, True):
+                    p = LoraParams(sf=sf, cr=cr, implicit_header=imp, ldro=ldro)
+                    payload = bytes(rng.integers(0, 256, 13, dtype=np.uint8))
+                    sig = np.concatenate([np.zeros(200, np.complex64),
+                                          modulate_frame(payload, p),
+                                          np.zeros(200, np.complex64)])
+                    sig = sig * np.exp(1j * (0.3 + 5e-5 * np.arange(len(sig))))
+                    sig = (sig + 0.05 * (rng.standard_normal(len(sig))
+                                         + 1j * rng.standard_normal(len(sig)))
+                           ).astype(np.complex64)
+                    starts = detect_frames(sig, p)
+                    assert starts, (sf, cr, imp, ldro)
+                    r = demodulate_frame(sig, starts[0], p,
+                                         n_payload=len(payload) if imp else None)
+                    assert r is not None and r[0] == payload and r[1], \
+                        (sf, cr, imp, ldro)
+
+
+def test_sf5_header_spill_layout():
+    """At SF5 the full-rate header block carries exactly the 5 header nibbles
+    (zero payload spill); at SF6, one payload nibble rides the first block; at
+    SF7, sf-2-5 = 0 spill again — symbol counts must match the reference's
+    m_symb_numb formula (`frame_sync.rs:1309-1320`)."""
+    from futuresdr_tpu.models.lora.phy import encode_payload_symbols
+    for sf, pay_len, cr, has_crc in ((5, 11, 1, True), (6, 11, 1, True),
+                                     (5, 4, 4, False), (6, 4, 4, False),
+                                     (7, 11, 1, True)):
+        p = LoraParams(sf=sf, cr=cr, has_crc=has_crc, ldro=False)
+        syms = encode_payload_symbols(bytes(range(pay_len)), p)
+        nibbles = 2 * pay_len + 5 + (4 if has_crc else 0)
+        first_rows = sf if sf < 7 else sf - 2
+        import math
+        expect = 8 + math.ceil(max(0, nibbles - first_rows) / sf) * (4 + cr)
+        assert len(syms) == expect, (sf, len(syms), expect)
+
+
+def test_sf5_noisy_burst_train_exact_once():
+    """The exact-once interrogation standard at the reference's default SF."""
+    p = LoraParams(sf=5, cr=2)
+    rng = np.random.default_rng(9)
+    parts, sent = [], []
+    for i in range(10):
+        payload = f"sf5 train {i}".encode()
+        sent.append(payload)
+        parts += [np.zeros(150 + 31 * i, np.complex64),
+                  modulate_frame(payload, p).astype(np.complex64)]
+    parts.append(np.zeros(300, np.complex64))
+    sig = np.concatenate(parts)
+    sig = sig * np.exp(1j * (0.4 + 1e-4 * np.arange(len(sig))))
+    rms = np.sqrt(np.mean(np.abs(sig[np.abs(sig) > 0]) ** 2))
+    sigma = rms * 10 ** (-15 / 20) / np.sqrt(2)
+    sig = (sig + sigma * (rng.standard_normal(len(sig))
+                          + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+    starts = detect_frames(sig, p)
+    # at n=32 a run of equal payload symbols IS locally a preamble, so the scan
+    # may surface a few extra candidates — the sync-word gate must kill them
+    # (reference behavior: frame_sync triggers on any constant run, the net-id
+    # check rejects); the decode-level standard stays exact-once in order
+    assert 10 <= len(starts) <= 14
+    got = [r for r in (demodulate_frame(sig, s, p) for s in starts)
+           if r is not None]
+    assert all(g[1] for g in got), "CRC failures"
+    assert [g[0] for g in got] == sent
+
+
+def test_sf5_sync_word_gate():
+    """The network-id gate holds at SF5: a foreign id is rejected, the
+    configured id decodes. Only nibbles 0..3 are encodable at n=32
+    (`utils.rs:465-489`) — ids above that must be rejected at construction."""
+    rng = np.random.default_rng(77)
+    p_tx = LoraParams(sf=5, cr=1, sync_word=0x23)
+    payload = b"sf5 gate"
+    sig = np.concatenate([np.zeros(100, np.complex64),
+                          modulate_frame(payload, p_tx),
+                          np.zeros(100, np.complex64)])
+    sig = (sig + 0.03 * (rng.standard_normal(len(sig))
+                         + 1j * rng.standard_normal(len(sig)))).astype(np.complex64)
+    p_ok = LoraParams(sf=5, cr=1, sync_word=0x23)
+    p_foreign = LoraParams(sf=5, cr=1, sync_word=0x12)
+    s = detect_frames(sig, p_ok)[0]
+    r = demodulate_frame(sig, s, p_ok)
+    assert r is not None and r[0] == payload and r[1]
+    assert demodulate_frame(sig, s, p_foreign) is None
+    with pytest.raises(ValueError, match="symbol space"):
+        LoraParams(sf=5, sync_word=0x34)     # nibble 4 -> bin 32 >= n
+    LoraParams(sf=6, sync_word=0x34)         # fits at n=64
+
+
+def test_sf_out_of_range_rejected():
+    with pytest.raises(ValueError, match="sf"):
+        LoraParams(sf=4)
+    with pytest.raises(ValueError, match="sf"):
+        LoraParams(sf=13)
