@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Custom REST routes beside the control port (reference: examples/custom-routes).
+
+The reference builds an axum ``Router`` with two extra routes and hands it to
+``Runtime::with_custom_routes`` (`examples/custom-routes/src/main.rs:33-46`):
+``/my_route/`` serves a static HTML page, ``/start_fg/`` launches a second
+flowgraph on the SAME runtime from inside a handler. Same shape here: the
+``Runtime(extra_routes=…)`` tuples are mounted on the control-port aiohttp app
+beside the ``/api/fg/`` families, and the handler starts a flowgraph through
+the runtime handle.
+
+Run it, then:  curl http://127.0.0.1:1337/my_route/
+               curl http://127.0.0.1:1337/start_fg/
+               curl http://127.0.0.1:1337/api/fg/
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import MessageSource, MessageSink
+from futuresdr_tpu.config import config
+from futuresdr_tpu.types import Pmt
+
+PAGE = """<html>
+  <head><meta charset='utf-8'/><title>FutureSDR TPU</title></head>
+  <body><h1>My Custom Route</h1></body>
+</html>"""
+
+
+def build_beacon(n_messages=None) -> Flowgraph:
+    fg = Flowgraph()
+    src = MessageSource(Pmt.string("foo"), interval=0.1, count=n_messages)
+    snk = MessageSink()
+    fg.connect_message(src, "out", snk, "in")
+    return fg
+
+
+def main():
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=18137,
+                   help="dedicated port (default off 1337 so a leaked server "
+                        "can't shadow the CI smoke)")
+    a = p.parse_args()
+    config().ctrlport_enable = True
+    config().ctrlport_bind = f"127.0.0.1:{a.port}"
+
+    runtime_box = {}
+
+    async def my_route(request):
+        from aiohttp import web
+        return web.Response(text=PAGE, content_type="text/html")
+
+    async def start_fg(request):
+        # launch a SECOND flowgraph on the same runtime from a handler
+        # (`main.rs:65-76` start_fg): respond with its descriptor
+        from aiohttp import web
+        rt = runtime_box["rt"]
+        running = await rt.start_async(build_beacon(n_messages=50))
+        desc = await running.handle.describe()
+        return web.json_response(desc.to_json())
+
+    rt = Runtime(extra_routes=[("GET", "/my_route/", my_route),
+                               ("GET", "/start_fg/", start_fg)])
+    runtime_box["rt"] = rt
+
+    print("custom routes at http://%s/my_route/ and /start_fg/"
+          % config().ctrlport_bind)
+    running = rt.start(build_beacon(n_messages=20))
+    time.sleep(0.5)
+
+    # self-demonstrate (the CI smoke runs exactly this)
+    import urllib.request
+    base = "http://" + config().ctrlport_bind
+    html = urllib.request.urlopen(base + "/my_route/", timeout=5).read().decode()
+    assert "My Custom Route" in html
+    desc = urllib.request.urlopen(base + "/start_fg/", timeout=5).read().decode()
+    assert "blocks" in desc
+    fgs = urllib.request.urlopen(base + "/api/fg/", timeout=5).read().decode()
+    assert fgs.strip() == "[0, 1]", fgs   # handler-launched fg registered too
+    print("GET /my_route/ ->", html.splitlines()[2].strip())
+    print("GET /start_fg/ -> launched:", desc[:72], "...")
+    print("GET /api/fg/   ->", fgs.strip())
+    running.stop_sync()
+
+
+if __name__ == "__main__":
+    main()

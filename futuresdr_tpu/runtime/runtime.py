@@ -283,7 +283,16 @@ class RunningFlowgraph:
         self._scheduler = scheduler
 
     async def wait(self) -> Flowgraph:
-        """Await completion; returns the flowgraph with final block state."""
+        """Await completion; returns the flowgraph with final block state.
+
+        Loop-safe: the join task lives on the SCHEDULER loop (start_async
+        delegates launches there), so awaiting from any other loop bridges via
+        ``run_coroutine_threadsafe`` — awaiting a foreign-loop task directly is
+        a RuntimeError in asyncio."""
+        if asyncio.get_running_loop() is not self._scheduler.loop:
+            fut = asyncio.run_coroutine_threadsafe(self._wrap(),
+                                                   self._scheduler.loop)
+            return await asyncio.wrap_future(fut)
         return await self._task
 
     def wait_sync(self) -> Flowgraph:
@@ -333,7 +342,12 @@ class RuntimeHandle:
 class Runtime:
     """Owns the scheduler and (optionally) the REST control port (`runtime.rs:55-207`)."""
 
-    def __init__(self, scheduler: Optional[Scheduler] = None):
+    def __init__(self, scheduler: Optional[Scheduler] = None, extra_routes=None):
+        """``extra_routes``: ``[(method, path, async_handler), …]`` mounted on the
+        control-port aiohttp app beside the ``/api/fg/`` families — the
+        ``Runtime::with_custom_routes`` extension point
+        (`examples/custom-routes/src/main.rs:33-42`); see
+        ``examples/custom_routes.py``. Ignored when the control port is disabled."""
         if scheduler is None:
             if config().default_scheduler == "threaded":
                 from .scheduler import ThreadedScheduler
@@ -345,12 +359,26 @@ class Runtime:
         self._ctrl_port = None
         if config().ctrlport_enable:
             from .ctrl_port import ControlPort
-            self._ctrl_port = ControlPort(self.handle)
+            self._ctrl_port = ControlPort(self.handle, extra_routes=extra_routes)
             self._ctrl_port.start()
 
     # -- async API -------------------------------------------------------------
     async def start_async(self, fg: Flowgraph) -> RunningFlowgraph:
-        """Launch; resolves once all blocks passed the init barrier (`runtime.rs:169-191`)."""
+        """Launch; resolves once all blocks passed the init barrier (`runtime.rs:169-191`).
+
+        Callable from ANY event loop: when invoked off the scheduler loop (e.g.
+        inside a control-port handler — ``examples/custom_routes.py``, reference
+        `examples/custom-routes/src/main.rs:65-76`), the launch is delegated to
+        the scheduler loop so the supervisor and block tasks land where
+        ``run_flowgraph_blocks`` and every sync bridge expect them."""
+        self.scheduler.start()
+        if asyncio.get_running_loop() is not self.scheduler.loop:
+            fut = asyncio.run_coroutine_threadsafe(
+                self._start_on_scheduler(fg), self.scheduler.loop)
+            return await asyncio.wrap_future(fut)
+        return await self._start_on_scheduler(fg)
+
+    async def _start_on_scheduler(self, fg: Flowgraph) -> RunningFlowgraph:
         fg_inbox = BlockInbox()
         initialized = ReplySlot()
         loop = asyncio.get_running_loop()
