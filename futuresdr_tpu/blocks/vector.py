@@ -115,6 +115,7 @@ class CopyRand(Kernel):
         self.input = self.add_stream_input("in", dtype)
         self.output = self.add_stream_output("out", dtype)
         self.max_copy = max_copy
+        self._seed = seed              # native fastchain driver re-seeds its own rng
         self._rng = np.random.default_rng(seed)
 
     async def work(self, io, mio, meta):

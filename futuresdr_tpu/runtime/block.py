@@ -41,6 +41,16 @@ class WrappedKernel:
 
     def metrics(self) -> dict:
         k = self.kernel
+        # extra_metrics FIRST: hooks may refresh the base counters (the native
+        # fast-chain's live bridge does) — reading them afterwards keeps one-shot
+        # snapshots current; the update() below still lets hooks override keys
+        extra = getattr(k, "extra_metrics", None)
+        extra_out = {}
+        if callable(extra):
+            try:
+                extra_out = extra() or {}
+            except Exception:
+                pass
         m = {
             "work_calls": self.work_calls,
             "work_time_s": round(self.work_time_s, 6),
@@ -50,12 +60,7 @@ class WrappedKernel:
             "items_out": {p.name: getattr(p, "items_produced", 0)
                           for p in k.stream_outputs},
         }
-        extra = getattr(k, "extra_metrics", None)
-        if callable(extra):
-            try:
-                m.update(extra())
-            except Exception:
-                pass
+        m.update(extra_out)
         return m
 
     @property

@@ -1,0 +1,274 @@
+"""Native fast-chain substitution: run whole pipes of trivial stream blocks in C++.
+
+Reference role: ``src/runtime/scheduler/flow.rs:265-442`` — the reference's
+FlowScheduler exists because per-work-call executor overhead dominates when
+blocks forward tiny chunks (its ``perf/null_rand`` regime). Python's asyncio
+actor loop costs ~10 µs per ``work()`` call there; no amount of scheduling
+fixes that floor. This module takes the reference's answer one step further on
+the runtime side: a maximal LINEAR chain whose members are all native-capable
+(NullSource/Head/Copy/CopyRand/NullSink), with no message ports, taps,
+broadcasts, or inplace edges, is lifted out of the actor plane entirely and
+executed by ``native/fastchain.cpp`` — one C++ thread round-robining the whole
+pipe over plain ring buffers (one pinned flow.rs worker that owns every block
+of the pipe).
+
+The substitution is transparent to the supervisor protocol: the chain task
+answers the init barrier for each member, watches for Terminate (the native
+loop honors a stop flag), and reports per-member BlockDone with item counters
+filled in, so describe/metrics/REST see the same flowgraph. Opt out with
+``FSDR_NO_NATIVE=1`` (everything native) or ``FSDR_NO_FASTCHAIN=1`` (just this).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import os
+from typing import List, Optional, Sequence
+
+from ..log import logger
+from .inbox import Callback, Initialize, Terminate
+
+__all__ = ["find_native_chains", "run_chain_task", "fastchain_available"]
+
+log = logger("runtime.fastchain")
+
+# stage kinds — keep in sync with native/fastchain.cpp
+FC_NULL_SOURCE, FC_HEAD, FC_COPY, FC_COPY_RAND, FC_NULL_SINK = range(5)
+
+
+class _FcStage(ctypes.Structure):
+    _fields_ = [("kind", ctypes.c_int32), ("_pad", ctypes.c_int32),
+                ("p0", ctypes.c_int64), ("p1", ctypes.c_int64)]
+
+
+_lib = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if os.environ.get("FSDR_NO_FASTCHAIN"):
+        return None
+    from .buffer.circular import probe_native
+    lib = probe_native(
+        "fsdr_fastchain_run", ctypes.c_int64,
+        [ctypes.POINTER(_FcStage), ctypes.c_int32, ctypes.c_int64,
+         ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)])
+    _lib = lib
+    return lib
+
+
+def fastchain_available() -> bool:
+    return _load() is not None
+
+
+def _native_stage(kernel) -> Optional[tuple]:
+    """(kind, p0, p1) for natively runnable kernels; None otherwise.
+
+    Central registry rather than per-class methods: the chain driver owns the
+    exact semantics it re-implements, so a behavioral change to one of these
+    blocks must be mirrored HERE or the kernel dropped from the registry."""
+    from ..blocks.stream import Copy, Head
+    from ..blocks.vector import CopyRand, NullSink, NullSource
+
+    if type(kernel) is NullSource:
+        return (FC_NULL_SOURCE, 0, 0)
+    if type(kernel) is Head:
+        return (FC_HEAD, int(kernel.remaining), 0)
+    if type(kernel) is Copy:
+        return (FC_COPY, 0, 0)
+    if type(kernel) is CopyRand:
+        if int(kernel.max_copy) < 1:
+            return None                # let the actor path raise its ValueError
+        return (FC_COPY_RAND, int(kernel.max_copy), int(kernel._seed))
+    if type(kernel) is NullSink:
+        return (FC_NULL_SINK,
+                -1 if kernel.count is None else int(kernel.count), 0)
+    return None
+
+
+def find_native_chains(fg) -> List[List[object]]:
+    """Maximal source→sink linear chains of native-capable kernels in ``fg``.
+
+    A member must: be native-capable, touch no message or inplace edges, have
+    every stream port wired exactly once (no taps/broadcasts), and the chain
+    must start at a no-input source and end at a no-output sink — so no tags
+    can enter the chain and no Python block shares its buffers."""
+    # env checked per call (not just at lib load) so perf probes can A/B the
+    # Python actor path vs the native chain inside one process
+    if os.environ.get("FSDR_NO_FASTCHAIN") or not fastchain_available():
+        return []
+    msg_touched = {id(e.src) for e in fg.message_edges} | \
+                  {id(e.dst) for e in fg.message_edges}
+    inp_touched = {id(e.src) for e in fg.inplace_edges} | \
+                  {id(e.dst) for e in fg.inplace_edges}
+    out_edges: dict = {}
+    in_deg: dict = {}
+    for e in fg.stream_edges:
+        out_edges.setdefault(id(e.src), []).append(e)
+        in_deg[id(e.dst)] = in_deg.get(id(e.dst), 0) + 1
+
+    def eligible(k) -> bool:
+        return (_native_stage(k) is not None
+                and id(k) not in msg_touched and id(k) not in inp_touched
+                and len(k.stream_inputs) <= 1 and len(k.stream_outputs) <= 1
+                and len(out_edges.get(id(k), [])) == len(k.stream_outputs)
+                and in_deg.get(id(k), 0) == len(k.stream_inputs))
+
+    chains = []
+    for k in (b.kernel for b in fg._blocks if b is not None):
+        if not (eligible(k) and not k.stream_inputs and k.stream_outputs):
+            continue                                   # chain heads: sources
+        chain = [k]
+        cur = k
+        while True:
+            outs = out_edges.get(id(cur), [])
+            if len(outs) != 1:
+                break
+            nxt = outs[0].dst
+            if not eligible(nxt):
+                break
+            chain.append(nxt)
+            if not nxt.stream_outputs:
+                break                                  # reached a sink
+            cur = nxt
+        if len(chain) >= 2 and not chain[-1].stream_outputs:
+            chains.append(chain)
+    return chains
+
+
+async def run_chain_task(members: Sequence, fg_inbox, scheduler,
+                         ring_items: int = 1 << 16) -> None:
+    """Impersonate ``members`` (WrappedKernels) at the supervisor protocol level
+    while the native driver runs the chain: answer the init barrier per member,
+    watch for Terminate, then report per-member BlockDone with counters."""
+    from .runtime import BlockDoneMsg, BlockErrorMsg, InitializedMsg
+    from ..types import Pmt
+
+    def _finish_all():
+        for b in members:
+            fg_inbox.send(BlockDoneMsg(b.id, b))
+
+    async def _next_msg(inbox):
+        """Next inbox message, parking on the coalescing notifier. Returns None
+        on a bare notify (the supervisor's start signal is a notify with no
+        message)."""
+        msg = inbox.try_recv()
+        if msg is not None:
+            return msg
+        await inbox.wait()
+        inbox.take_pending()
+        return inbox.try_recv()
+
+    # ---- init barrier for every member --------------------------------------
+    for b in members:
+        while True:
+            msg = await _next_msg(b.inbox)
+            if isinstance(msg, Initialize):
+                break
+            if isinstance(msg, Terminate):
+                _finish_all()
+                return
+            if isinstance(msg, Callback):
+                msg.reply.set(Pmt.invalid_value())
+        fg_inbox.send(InitializedMsg(b.id, ok=True))
+
+    # ---- start signal ---------------------------------------------------------
+    # Do NOT run (or send BlockDone) before the supervisor releases the barrier:
+    # each block must emit exactly one of Initialized/BlockError/BlockDone
+    # before the start notify, or a fast chain's BlockDones double-decrement the
+    # barrier counter and init failures elsewhere stop propagating from start()
+    # (`runtime.rs:380-429` contract; actor blocks park the same way).
+    while True:
+        msg = await _next_msg(members[0].inbox)
+        if isinstance(msg, Terminate):
+            _finish_all()
+            return
+        if isinstance(msg, Callback):
+            msg.reply.set(Pmt.invalid_value())
+        if msg is None:
+            break                       # bare notify = the start signal
+
+    lib = _load()
+    n = len(members)
+    stages = (_FcStage * n)()
+    for i, b in enumerate(members):
+        kind, p0, p1 = _native_stage(b.kernel)
+        stages[i] = _FcStage(kind, 0, p0, p1)
+    item_size = 1
+    for b in members:
+        for p in b.kernel.stream_outputs:
+            if p.dtype is not None:
+                item_size = max(item_size, int(p.dtype.itemsize))
+    per_stage = (ctypes.c_int64 * n)()
+    per_calls = (ctypes.c_int64 * n)()
+    stop = ctypes.c_int32(0)
+
+    # live metrics bridge: the native driver updates the shared counter arrays
+    # DURING the run, so /metrics/ and handle.metrics() observe a fused chain
+    # in flight exactly like actor-run blocks (work_calls = chunks moved)
+    def _bridge(i, b):
+        k = b.kernel
+        base_extra = getattr(k, "extra_metrics", None)
+
+        def refresh():
+            b.work_calls = int(per_calls[i])
+            moved = int(per_stage[i])
+            for p in k.stream_outputs:
+                p.items_produced = moved
+            for p in k.stream_inputs:
+                p.items_consumed = moved
+            if hasattr(k, "n_received") and k.stream_inputs:
+                k.n_received = moved               # NullSink contract
+        k.extra_metrics = lambda: (refresh() or dict(
+            (base_extra() if callable(base_extra) else {}), fused_native=True))
+        return refresh
+
+    refreshers = [_bridge(i, b) for i, b in enumerate(members)]
+
+    # Inbox watchers, one per member: Terminate (broadcast to every member)
+    # sets the native stop flag; Callbacks to ANY fused member are answered
+    # with invalid_value instead of hanging the caller (fused blocks have no
+    # handlers — the same answer an actor block gives for an unknown port).
+    async def watch(b):
+        while True:
+            msg = await _next_msg(b.inbox)
+            if isinstance(msg, Terminate):
+                stop.value = 1
+                return
+            if isinstance(msg, Callback):
+                msg.reply.set(Pmt.invalid_value())
+
+    watchers = [asyncio.ensure_future(watch(b)) for b in members]
+
+    def _cancel_watchers():
+        for w in watchers:
+            w.cancel()
+
+    try:
+        rc = await scheduler.spawn_blocking(
+            lambda: lib.fsdr_fastchain_run(stages, n, item_size, ring_items,
+                                           ctypes.byref(stop), per_stage,
+                                           per_calls))
+    except Exception as e:                              # noqa: BLE001
+        _cancel_watchers()
+        log.error("fastchain failed (%r)", e)
+        fg_inbox.send(BlockErrorMsg(members[0].id, e))
+        for b in members[1:]:
+            fg_inbox.send(BlockDoneMsg(b.id, b))
+        return
+    _cancel_watchers()
+    if rc < 0:
+        e = RuntimeError(f"fastchain returned {rc} (malformed chain)")
+        fg_inbox.send(BlockErrorMsg(members[0].id, e))
+        for b in members[1:]:
+            fg_inbox.send(BlockDoneMsg(b.id, b))
+        return
+
+    # ---- final counter sync (the live bridge stays installed) ----------------
+    for r in refreshers:
+        r()
+    _finish_all()

@@ -97,9 +97,25 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                                    fg_inbox: BlockInbox,
                                    initialized: ReplySlot) -> Flowgraph:
     """The per-flowgraph supervisor (`runtime.rs:363-597`)."""
+    from .fastchain import find_native_chains, run_chain_task
+    chain_kernels = find_native_chains(fg)
     blocks = fg.take_blocks()
     by_id: Dict[int, WrappedKernel] = {b.id: b for b in blocks}
-    handles = scheduler.run_flowgraph_blocks(blocks, fg_inbox)
+    # native fast-chain substitution (see fastchain.py): whole pipes of trivial
+    # stream blocks run in one C++ thread instead of per-block actor tasks; the
+    # chain task speaks the same supervisor protocol for every member
+    wk = {id(b.kernel): b for b in blocks}
+    fused: set = set()
+    chain_tasks = []
+    for ch in chain_kernels:
+        members = [wk[id(k)] for k in ch]
+        fused.update(id(b) for b in members)
+        chain_tasks.append(members)
+    handles = scheduler.run_flowgraph_blocks(
+        [b for b in blocks if id(b) not in fused], fg_inbox)
+    for members in chain_tasks:
+        handles.append(scheduler.spawn(
+            run_chain_task(members, fg_inbox, scheduler)))
 
     # ---- init barrier (`runtime.rs:380-415`) --------------------------------
     for b in blocks:

@@ -1,0 +1,199 @@
+// fastchain: single-threaded round-robin executor for linear chains of trivial
+// stream blocks — the native work-loop driver for the small-chunk regime.
+//
+// Reference role: src/runtime/scheduler/flow.rs:265-442 — the reference's
+// FlowScheduler runs pinned workers with LOCAL run queues precisely because
+// per-work-call executor overhead dominates when blocks forward tiny chunks
+// (perf/null_rand: 512-item CopyRand chains). Python's asyncio actor loop costs
+// ~10 us per work() call in that regime; this driver runs a WHOLE pipe
+// (source → head → copies → sink) inside one C++ thread with plain ring
+// buffers between stages (single-threaded: no atomics, no wakeups — the
+// round-robin IS the schedule, like one pinned flow.rs worker that owns every
+// block of the pipe).
+//
+// The Python runtime substitutes eligible chains at launch
+// (futuresdr_tpu/runtime/fastchain.py): whole pipes whose members are all
+// native-capable, with no message ports, taps, or broadcasts. Data content
+// matches the Python path (zeros from NullSource, byte-wise copies); CopyRand
+// chunk SIZES come from a different RNG than numpy's — the stress pattern is
+// equivalent, the per-chunk split is not bit-identical (documented in
+// perf/null_rand.py).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Stage kinds (keep in sync with futuresdr_tpu/runtime/fastchain.py)
+enum {
+    FC_NULL_SOURCE = 0,   // produce zeros forever
+    FC_HEAD = 1,          // p0 = max items to forward, then EOS downstream
+    FC_COPY = 2,          // forward everything
+    FC_COPY_RAND = 3,     // p0 = max_copy (forward 1..=max_copy per pass), p1 = seed
+    FC_NULL_SINK = 4,     // consume; p0 = count to finish after (-1 = until EOS)
+};
+
+struct FcStage {
+    int32_t kind;
+    int32_t _pad;
+    int64_t p0;
+    int64_t p1;
+};
+
+}  // extern "C"
+
+namespace {
+
+struct Ring {
+    char* buf = nullptr;
+    int64_t cap = 0;       // items
+    int64_t head = 0;      // write index (items, not wrapped)
+    int64_t tail = 0;      // read index
+    bool eos = false;
+
+    int64_t count() const { return head - tail; }
+    int64_t space() const { return cap - count(); }
+};
+
+// xorshift64* — per-stage chunk-size RNG for FC_COPY_RAND
+inline uint64_t xs(uint64_t& s) {
+    s ^= s >> 12;
+    s ^= s << 25;
+    s ^= s >> 27;
+    return s * 0x2545F4914F6CDD1DULL;
+}
+
+// copy k items from src ring tail to dst ring head, handling both wraps
+inline void ring_copy(Ring& src, Ring& dst, int64_t k, int64_t isz) {
+    while (k > 0) {
+        int64_t s_off = src.tail % src.cap;
+        int64_t d_off = dst.head % dst.cap;
+        int64_t c = k;
+        if (src.cap - s_off < c) c = src.cap - s_off;
+        if (dst.cap - d_off < c) c = dst.cap - d_off;
+        std::memcpy(dst.buf + d_off * isz, src.buf + s_off * isz,
+                    static_cast<size_t>(c * isz));
+        src.tail += c;
+        dst.head += c;
+        k -= c;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Run the chain to completion (sink finished) or until *stop becomes nonzero.
+// per_stage_out[i] accumulates items produced (for sinks: consumed) by stage i;
+// per_stage_calls[i] counts chunks moved (the work-call analog). Both arrays
+// are updated DURING the run, so the Python side reads them live for metrics.
+// Returns items the sink consumed, or -1 on malformed input / stall.
+int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
+                           int64_t ring_items, volatile int32_t* stop,
+                           int64_t* per_stage_out, int64_t* per_stage_calls) {
+    if (n < 2 || item_size <= 0 || ring_items <= 0) return -1;
+    for (int i = 0; i < n; ++i)
+        if (st[i].kind == FC_COPY_RAND && st[i].p0 <= 0)
+            return -1;                   // modulo-by-zero guard (max_copy >= 1)
+    if (st[0].kind != FC_NULL_SOURCE) return -1;
+    if (st[n - 1].kind != FC_NULL_SINK) return -1;
+    for (int i = 1; i + 1 < n; ++i)
+        if (st[i].kind != FC_HEAD && st[i].kind != FC_COPY &&
+            st[i].kind != FC_COPY_RAND)
+            return -1;
+
+    std::vector<Ring> rings(n - 1);
+    for (auto& r : rings) {
+        // calloc: rings start zeroed, so the zero-producing source can advance
+        // indices without writing (same fast path as the Python NullSource)
+        r.buf = static_cast<char*>(
+            std::calloc(static_cast<size_t>(ring_items), static_cast<size_t>(item_size)));
+        if (!r.buf) {
+            for (auto& q : rings) std::free(q.buf);
+            return -1;
+        }
+        r.cap = ring_items;
+    }
+
+    std::vector<int64_t> head_left(n, -1);   // FC_HEAD remaining budget
+    std::vector<uint64_t> rng(n, 0);
+    std::vector<bool> done(n, false);
+    for (int i = 0; i < n; ++i) {
+        if (st[i].kind == FC_HEAD) head_left[i] = st[i].p0;
+        if (st[i].kind == FC_COPY_RAND)
+            rng[i] = static_cast<uint64_t>(st[i].p1) * 0x9E3779B97F4A7C15ULL + 1;
+    }
+    int64_t sink_count = st[n - 1].p0;       // -1 = until EOS
+    int64_t sink_items = 0;
+
+    // relaxed atomic load: the flag is written from a Python thread; plain
+    // volatile is a data race under the C++ memory model
+    while (!__atomic_load_n(stop, __ATOMIC_RELAXED) && !done[n - 1]) {
+        bool progress = false;
+        for (int i = 0; i < n; ++i) {
+            if (done[i]) continue;
+            if (i == 0) {
+                Ring& out = rings[0];
+                int64_t k = out.space();
+                if (k > 0) {
+                    out.head += k;                    // zeros pre-filled
+                    progress = true;
+                    if (per_stage_out) per_stage_out[0] += k;
+                    if (per_stage_calls) per_stage_calls[0] += 1;
+                }
+                continue;
+            }
+            Ring& in = rings[i - 1];
+            if (i == n - 1) {
+                int64_t k = in.count();
+                if (sink_count >= 0 && sink_items + k > sink_count)
+                    k = sink_count - sink_items;
+                if (k > 0) {
+                    in.tail += k;
+                    sink_items += k;
+                    progress = true;
+                    if (per_stage_out) per_stage_out[i] += k;
+                    if (per_stage_calls) per_stage_calls[i] += 1;
+                }
+                if ((in.eos && in.count() == 0) ||
+                    (sink_count >= 0 && sink_items >= sink_count))
+                    done[i] = true;
+                continue;
+            }
+            Ring& out = rings[i];
+            int64_t k = in.count();
+            if (out.space() < k) k = out.space();
+            if (st[i].kind == FC_HEAD) {
+                if (head_left[i] < k) k = head_left[i];
+            } else if (st[i].kind == FC_COPY_RAND && k > 0) {
+                int64_t cap = 1 + static_cast<int64_t>(
+                    xs(rng[i]) % static_cast<uint64_t>(st[i].p0));
+                if (cap < k) k = cap;
+            }
+            if (k > 0) {
+                ring_copy(in, out, k, item_size);
+                progress = true;
+                if (per_stage_out) per_stage_out[i] += k;
+                if (per_stage_calls) per_stage_calls[i] += 1;
+                if (st[i].kind == FC_HEAD) head_left[i] -= k;
+            }
+            bool upstream_over = in.eos && in.count() == 0;
+            if (upstream_over || (st[i].kind == FC_HEAD && head_left[i] == 0)) {
+                out.eos = true;
+                done[i] = true;
+            }
+        }
+        if (!progress && !done[n - 1]) {
+            // single-threaded chains always progress unless malformed; never spin
+            for (auto& r : rings) std::free(r.buf);
+            return -1;
+        }
+    }
+
+    for (auto& r : rings) std::free(r.buf);
+    return sink_items;
+}
+
+}  // extern "C"

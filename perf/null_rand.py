@@ -6,7 +6,13 @@ every ``work()`` forwards a random 1..=max_copy chunk). Variable chunk sizes are
 where scheduler wake/backpressure and buffer wrap-around edge cases live — a
 fixed-size Copy chain never exercises them.
 
-CSV: ``run,pipes,stages,samples,max_copy,buffer,scheduler,elapsed_secs,msps_total``.
+CSV: ``run,pipes,stages,samples,max_copy,buffer,scheduler,fastchain,elapsed_secs,msps_total``.
+``fastchain=1`` rows run whole pipes in the native C++ chain driver (the
+default runtime behavior; ``runtime/fastchain.py``); ``fastchain=0`` rows pin
+FSDR_NO_FASTCHAIN to measure the Python actor/scheduler path the probe
+originally targeted. CopyRand chunk SIZES under the native driver come from a
+different RNG than numpy's — equivalent stress pattern, not bit-identical
+splits.
 """
 
 import argparse
@@ -75,19 +81,31 @@ def main():
     backends = {"ring": RingWriter}
     if circular.available():
         backends["circular"] = circular.CircularWriter
-    print("run,pipes,stages,samples,max_copy,buffer,scheduler,elapsed_secs,msps_total")
+    import os
+    print("run,pipes,stages,samples,max_copy,buffer,scheduler,fastchain,"
+          "elapsed_secs,msps_total")
     for r in range(a.runs):
-        for bname in a.buffers:
-            if bname not in backends:
-                continue
-            for sname in a.schedulers:
+        for fc in (1, 0):
+            if fc:
+                os.environ.pop("FSDR_NO_FASTCHAIN", None)
+                # fused pipes never touch the Python buffers or scheduler —
+                # one row per (pipes, stages), not one per combo
+                combos = [(a.buffers[0] if a.buffers[0] in backends
+                           else next(iter(backends)), a.schedulers[0])]
+            else:
+                os.environ["FSDR_NO_FASTCHAIN"] = "1"
+                combos = [(b, s) for b in a.buffers if b in backends
+                          for s in a.schedulers]
+            for bname, sname in combos:
                 for pipes in a.pipes:
                     for stages in a.stages:
                         dt = run_once(pipes, stages, a.samples, a.max_copy,
                                       backends[bname], sname)
+                        lb, ls = ("-", "-") if fc else (bname, sname)
                         print(f"{r},{pipes},{stages},{a.samples},{a.max_copy},"
-                              f"{bname},{sname},{dt:.3f},"
+                              f"{lb},{ls},{fc},{dt:.3f},"
                               f"{pipes * a.samples / dt / 1e6:.1f}", flush=True)
+    os.environ.pop("FSDR_NO_FASTCHAIN", None)
 
 
 if __name__ == "__main__":
