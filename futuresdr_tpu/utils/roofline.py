@@ -1,0 +1,96 @@
+"""Roofline accounting for fused stage pipelines — XLA's own cost model, not hand
+math.
+
+VERDICT r3 item 7: a bare "2,944 Msps" is not auditable; ops/sample and
+bytes/sample turn it into an efficiency claim. The numbers come from the
+compiled program's ``cost_analysis()`` (XLA's flop/byte counts for exactly the
+HLO that runs), so they track fusion decisions instead of a paper formula.
+Caveat: the analysis is per-backend — a CPU-compiled pipeline fuses differently
+than the TPU one, so artifacts must carry the backend they were derived on.
+
+Peak table: the only figures used are the PUBLIC v5e chip specs (197e12 bf16
+FLOP/s, 819e9 B/s HBM) — MFU is reported against the bf16 matmul peak, the
+standard MFU convention. There is no official f32 peak; f32 matmuls lower to
+multiple bf16 passes, so the same denominator is used and f32 chains simply
+show proportionally lower MFU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["cost_of", "pipeline_roofline", "PEAKS"]
+
+# public chip specs (per chip). "tpu" maps the tunneled TPU v5 lite to v5e;
+# "axon" is the tunnel plugin's own platform name for the same chip.
+PEAKS = {
+    "tpu": {"flops": 197e12, "hbm_bytes": 819e9},     # v5e, bf16 matmul peak
+}
+PEAKS["axon"] = PEAKS["tpu"]
+
+
+def cost_of(fn, *args) -> dict:
+    """flops + bytes accessed of ``jit(fn)(*args)`` from XLA's cost analysis."""
+    import jax
+
+    comp = jax.jit(fn).lower(*args).compile()
+    ca = comp.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return {"flops": float(ca.get("flops", 0.0)),
+            "bytes": float(ca.get("bytes accessed", 0.0))}
+
+
+def pipeline_roofline(stages: Sequence, in_dtype, frame: int,
+                      rate_sps: Optional[float] = None,
+                      backend: str = "cpu") -> dict:
+    """Ops/sample + bytes/sample for the FUSED pipeline and per-stage prefixes.
+
+    Per-stage numbers are DIFFERENCES of compiled prefixes (stage k's cost =
+    cost(stages[:k+1]) − cost(stages[:k])), so each stage is charged exactly
+    what adding it to the fused program costs — fusion across the boundary
+    lands on the stage that triggered it. With ``rate_sps`` the achieved
+    FLOP/s, bandwidth, and (for TPU) MFU vs the public bf16 peak are filled in.
+    """
+    import jax
+
+    from ..ops.stages import Pipeline
+
+    out = {"frame": frame, "backend": backend, "stages": []}
+    prev = {"flops": 0.0, "bytes": 0.0}
+    rng = np.random.default_rng(0)
+    if np.issubdtype(np.dtype(in_dtype), np.complexfloating):
+        host = (rng.standard_normal(frame)
+                + 1j * rng.standard_normal(frame)).astype(in_dtype)
+    else:
+        host = rng.standard_normal(frame).astype(in_dtype)
+
+    for k in range(1, len(stages) + 1):
+        pipe = Pipeline(list(stages[:k]), in_dtype)
+        carry = pipe.init_carry()
+        cost = cost_of(pipe.fn(), carry, host)
+        out["stages"].append({
+            "name": stages[k - 1].name,
+            "flops_per_sample": (cost["flops"] - prev["flops"]) / frame,
+            "bytes_per_sample": (cost["bytes"] - prev["bytes"]) / frame,
+        })
+        prev = cost
+    out["flops_per_sample"] = prev["flops"] / frame
+    out["bytes_per_sample"] = prev["bytes"] / frame
+    ridge = None
+    peak = PEAKS.get(backend)
+    if peak:
+        ridge = peak["flops"] / peak["hbm_bytes"]      # flop/byte ridge point
+        for s in out["stages"]:
+            ai = s["flops_per_sample"] / max(s["bytes_per_sample"], 1e-12)
+            s["arith_intensity"] = ai
+            s["bound"] = "hbm" if ai < ridge else "compute"
+    if rate_sps:
+        out["achieved_flops"] = rate_sps * out["flops_per_sample"]
+        out["achieved_bw_bytes"] = rate_sps * out["bytes_per_sample"]
+        if peak:
+            out["mfu"] = out["achieved_flops"] / peak["flops"]
+            out["hbm_util"] = out["achieved_bw_bytes"] / peak["hbm_bytes"]
+    return out

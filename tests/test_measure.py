@@ -15,3 +15,28 @@ def test_run_marginal_positive_rate():
     rate = run_marginal(pipe.fn(), jax.device_put(pipe.init_carry()),
                         jax.device_put(x), k_pair=(4, 64), reps=2)
     assert rate > 0
+
+
+def test_pipeline_roofline_accounting():
+    """utils/roofline: XLA cost analysis per fused prefix; stage numbers are
+    differences, totals match the full program, and rate_sps fills in the
+    achieved-flops fields (mfu only on backends with a known peak)."""
+    import numpy as np
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import fft_stage, fir_stage, mag2_stage
+    from futuresdr_tpu.utils.roofline import pipeline_roofline
+
+    stages = [fir_stage(firdes.lowpass(0.2, 64).astype(np.float32)),
+              fft_stage(1024), mag2_stage()]
+    r = pipeline_roofline(stages, np.complex64, 1 << 16, rate_sps=1e6,
+                          backend="cpu")
+    assert [s["name"] for s in r["stages"]] == ["fir", "fft1024", "mag2"]
+    assert r["flops_per_sample"] > 50            # an FFT chain is not free
+    assert r["bytes_per_sample"] >= 12           # >= read cx64 + write f32
+    total = sum(s["flops_per_sample"] for s in r["stages"])
+    assert abs(total - r["flops_per_sample"]) < 1e-6
+    assert r["achieved_flops"] == 1e6 * r["flops_per_sample"]
+    assert "mfu" not in r                        # no public CPU peak
+    r2 = pipeline_roofline(stages, np.complex64, 1 << 16, rate_sps=1e9,
+                           backend="tpu")
+    assert 0 < r2["mfu"] < 1 and "bound" in r2["stages"][0]
