@@ -153,6 +153,14 @@ def demodulate_payload_stream(samples: np.ndarray, sps: int = SPS):
         e = np.convolve(sym_stream ** 2, np.ones(8), mode="full")[7:7 + len(c)]
         norm = c / np.maximum(np.sqrt(e * np.sum(sync ** 2)), 1e-9)
         for idx in np.nonzero(norm > 0.9)[0]:
+            # absolute energy gate: the NORMALIZED correlation passes on pure
+            # noise windows by chance, and the un-CRC'd Golay gate accepts
+            # ~57% of random words — require the sync window to carry real
+            # symbol energy (levels are ±1/±3; noise-only windows sit orders
+            # of magnitude below). Found by the r4 seeded fuzz campaign: a
+            # ghost frame in the leading pad broke fn contiguity under noise.
+            if e[idx] < 8 * 0.25:
+                continue
             syms = sym_stream[idx + 8: idx + n_frame_syms]
             if len(syms) < 48 + 136:
                 continue
@@ -176,12 +184,23 @@ def demodulate_payload_stream(samples: np.ndarray, sps: int = SPS):
     hits.sort(key=lambda t: -t[0])
     min_gap = n_frame_syms * sps * 3 // 4
     accepted: List[tuple] = []
+    lsfs = dict(_lsf_positions(samples, sps, content_dedup=False))
+    # a stream frame cannot START inside a decoded link-setup frame: the LSF
+    # body can correlate > 0.9 against the stream sync AND pass the (un-CRC'd)
+    # Golay gate by chance, injecting a ghost frame whose fn breaks the
+    # contiguity check (found by the r4 seeded fuzz campaign, clean signal).
+    # Guard margin: under noise the LSF position lands a few samples late, and
+    # the FIRST stream frame starts exactly at lsf+span — only reject hits
+    # clearly interior to the LSF span, never the adjacent legitimate frame.
+    lsf_span = (8 + 184) * sps
+    guard = 8 * sps
     for hit in hits:
+        if any(p + guard <= hit[1] < p + lsf_span - guard for p in lsfs):
+            continue
         if all(abs(hit[1] - a[1]) >= min_gap for a in accepted):
             accepted.append(hit)
     frames = {a[1]: a[1:] for a in accepted}
     # group frames into transmissions (EOS closes a group)
-    lsfs = dict(_lsf_positions(samples, sps, content_dedup=False))
     out = []
     group: List[tuple] = []
     for key in sorted(frames):

@@ -111,8 +111,8 @@ def decode_frame(samples: np.ndarray, lts_start: int,
     p = _prepare_frame(samples, lts_start, cfo)
     if p is None:
         return None
-    depunct, n_code_bits = p[0], p[1]
-    decoded = coding.viterbi_decode(depunct, n_code_bits)
+    depunct, n_info_bits = p[0], p[1]
+    decoded = coding.viterbi_decode(depunct, n_info_bits)
     return _finish_frame(decoded, *p[2:])
 
 
@@ -151,7 +151,10 @@ def decode_stream(samples: np.ndarray) -> List[DecodedFrame]:
 
 def _prepare_frame(samples: np.ndarray, lts_start: int, cfo: float):
     """Front half of decode_frame: everything up to the DATA Viterbi. Returns
-    (mother-code llrs, n_coded_bits, mcs, length) or None.
+    (mother-code llrs, n_info_bits, mcs, length) or None — n_info_bits is
+    SERVICE+PSDU+tail (16 + 8·length + 6), the terminated-trellis decode
+    length, NOT the padded n_sym·n_dbps (the pad stays scrambled; decoding
+    into it corrupts the tail — see the comment at the return).
 
     CFO correction is applied only to the spans actually demodulated (LTS+SIGNAL,
     then the data symbols) — correcting the whole remaining stream per frame would
@@ -203,7 +206,13 @@ def _prepare_frame(samples: np.ndarray, lts_start: int, cfo: float):
         llrs = ofdm.demap_llrs(eq.reshape(-1), mcs.modulation)
     deint = coding.deinterleave(llrs, mcs.n_cbps, mcs.n_bpsc)
     depunct = coding.depuncture(deint, mcs.coding_rate)
-    return (depunct, n_sym * mcs.n_dbps, mcs, length, lts_start, cfo, n_sym,
+    # decode exactly SERVICE+PSDU+tail (n_bits), NOT the padded n_sym·n_dbps:
+    # the pad bits after the tail stay SCRAMBLED (encode_frame zeroes only the
+    # tail), so the trellis is terminated in state 0 at n_bits and nowhere
+    # later — tracing back from state 0 at the padded length corrupted the
+    # last bytes whenever the scrambled pad bits were nonzero (found by the
+    # r4 seeded fuzz campaign; content/seed-dependent, clean-signal).
+    return (depunct, n_bits, mcs, length, lts_start, cfo, n_sym,
             _lts_snr_db(samples, lts_start, cfo))
 
 

@@ -229,3 +229,23 @@ def test_random_stream_roundtrip_fuzz():
         l, p, complete = out[0]
         assert complete and l is not None and (l.src, l.dst) == (src, dst), trial
         assert p[:n_pay] == payload, trial
+
+
+def test_stream_frame_ghost_inside_lsf_rejected():
+    """Regression (r4 fuzz campaign): the LSF frame body can correlate > 0.9
+    against the STREAM sync and pass the un-CRC'd Golay gate, injecting a ghost
+    frame whose fn breaks contiguity (clean signal, (SQ8485->RHHIUD, 44 B)).
+    Stream hits starting inside a decoded LSF span must be rejected."""
+    from futuresdr_tpu.models.m17 import (Lsf, build_stream_frames, modulate,
+                                          demodulate_payload_stream)
+    lsf = Lsf(dst="RHHIUD", src="SQ8485")
+    payload = bytes(range(44))
+    sig = modulate(build_stream_frames(lsf, payload)).astype(np.float32)
+    for pad in (0, 784):
+        x = np.concatenate([np.zeros(pad, np.float32), sig,
+                            np.zeros(300, np.float32)])
+        out = demodulate_payload_stream(x)
+        assert len(out) == 1
+        l, p, complete = out[0]
+        assert complete and (l.src, l.dst) == ("SQ8485", "RHHIUD")
+        assert p[:44] == payload
