@@ -69,7 +69,9 @@ FSDR.FlowgraphCanvas = function (canvas, opts) {
   this.cv = canvas; this.ctx = canvas.getContext('2d');
   this.opts = opts || {}; this.desc = null; this.boxes = [];
   this.selected = null;
+  this.custom = {};                      // user-dragged positions, by block id
   canvas.addEventListener('click', (ev) => {
+    if (this._suppressClick) { this._suppressClick = false; return; }
     const r = canvas.getBoundingClientRect();
     const x = ev.clientX - r.left, y = ev.clientY - r.top;
     for (const b of this.boxes) {
@@ -81,6 +83,40 @@ FSDR.FlowgraphCanvas = function (canvas, opts) {
       }
     }
   });
+  /* draggable blocks (prophecy flowgraph_canvas.rs:597 on_mousedown): dragged
+   * positions persist across update() via this.custom; a drag that moved
+   * beyond the click threshold suppresses the synthesized click so moving a
+   * block never rewrites the selection/editor panel */
+  let drag = null;
+  canvas.addEventListener('mousedown', (ev) => {
+    const r = canvas.getBoundingClientRect();
+    const x = ev.clientX - r.left, y = ev.clientY - r.top;
+    for (const b of this.boxes) {
+      if (x >= b.x && x <= b.x + b.w && y >= b.y && y <= b.y + b.h) {
+        drag = {b, dx: x - b.x, dy: y - b.y, moved: 0, px: x, py: y};
+        return;
+      }
+    }
+  });
+  canvas.addEventListener('mousemove', (ev) => {
+    if (!drag) return;
+    const r = canvas.getBoundingClientRect();
+    const x = ev.clientX - r.left, y = ev.clientY - r.top;
+    const b = drag.b;
+    drag.moved += Math.abs(x - drag.px) + Math.abs(y - drag.py);
+    drag.px = x; drag.py = y;
+    b.x = Math.min(Math.max(x - drag.dx, 0), this.cv.width - b.w);
+    b.y = Math.min(Math.max(y - drag.dy, 0), this.cv.height - b.h);
+    this.custom[b.blk.id] = {x: b.x, y: b.y};
+    this.draw();
+  });
+  const endDrag = () => {
+    this._suppressClick = !!(drag && drag.moved > 3);
+    drag = null;
+  };
+  const upTarget = (typeof window !== 'undefined' && window
+                    && window.addEventListener) ? window : canvas;
+  upTarget.addEventListener('mouseup', endDrag);
 };
 FSDR.FlowgraphCanvas.prototype.update = function (desc) {
   this.desc = desc; this.layout(); this.draw();
@@ -106,8 +142,10 @@ FSDR.FlowgraphCanvas.prototype.layout = function () {
     const rh = H / bs.length;
     bs.forEach((b, i) => {
       const w = Math.min(cw - 24, 150), h = Math.min(rh - 14, 44);
-      this.boxes.push({blk: b, x: c * cw + (cw - w) / 2,
-                       y: i * rh + (rh - h) / 2, w, h});
+      const cust = this.custom[b.id];
+      this.boxes.push({blk: b,
+                       x: cust ? cust.x : c * cw + (cw - w) / 2,
+                       y: cust ? cust.y : i * rh + (rh - h) / 2, w, h});
     });
   }
 };
@@ -243,6 +281,85 @@ FSDR.ListSelector = function (root, handle, fgId, blkId, handler, options) {
   return sel;
 };
 
+/* ---------------- interaction: frequency zoom / pan / range controls ------- */
+/* Prophecy counterpart: the leptos waterfall takes reactive min/max Signals and
+ * re-uploads them per frame (crates/prophecy/src/waterfall.rs:40-162); its
+ * flowgraph canvas drags blocks with on:mousedown (flowgraph_canvas.rs:597).
+ * Same capabilities here: wheel zooms the frequency axis around the cursor,
+ * drag pans, double-click resets; WaterfallControls wires live min/max/auto/dB
+ * inputs to a running sink. */
+FSDR.attachZoom = function (wf, canvas) {
+  canvas.addEventListener('wheel', (ev) => {
+    const r = canvas.getBoundingClientRect();
+    const denom = (r.width || canvas.width || 1);
+    const f = Math.min(Math.max((ev.clientX - r.left) / denom, 0), 1);
+    const c = wf.x0 + f * (wf.x1 - wf.x0);
+    const scale = ev.deltaY > 0 ? 1.25 : 0.8;
+    let w = (wf.x1 - wf.x0) * scale;
+    w = Math.min(1, Math.max(1 / 64, w));
+    wf.x0 = Math.min(Math.max(c - f * w, 0), 1 - w);
+    wf.x1 = wf.x0 + w;
+    if (ev.preventDefault) ev.preventDefault();
+  });
+  let drag = null;
+  canvas.addEventListener('mousedown', (ev) => {
+    drag = {x: ev.clientX, x0: wf.x0, x1: wf.x1};
+  });
+  canvas.addEventListener('mousemove', (ev) => {
+    if (!drag) return;
+    const r = canvas.getBoundingClientRect();
+    const w = drag.x1 - drag.x0;
+    const dx = (ev.clientX - drag.x) / (r.width || canvas.width || 1) * w;
+    wf.x0 = Math.min(Math.max(drag.x0 - dx, 0), 1 - w);
+    wf.x1 = wf.x0 + w;
+  });
+  // releasing OUTSIDE the canvas must still end the pan: listen on window
+  // where one exists (browser); headless stubs fall back to the canvas
+  const upTarget = (typeof window !== 'undefined' && window
+                    && window.addEventListener) ? window : canvas;
+  upTarget.addEventListener('mouseup', () => { drag = null; });
+  canvas.addEventListener('dblclick', () => { wf.x0 = 0; wf.x1 = 1; });
+};
+FSDR.toDb = function (data) {
+  const out = new Float32Array(data.length);
+  for (let i = 0; i < data.length; i++)
+    out[i] = 10 * Math.log10(Math.max(data[i], 1e-12));
+  return out;
+};
+/* Live display controls for a running Waterfall/Waterfall2D — the reactive
+ * min/max wiring of the prophecy waterfall as plain DOM inputs. */
+FSDR.WaterfallControls = function (root, wf) {
+  const mk = (label, value, onchange) => {
+    const lab = document.createElement('label');
+    lab.textContent = label;
+    const inp = document.createElement('input');
+    inp.size = 6; inp.value = value;
+    inp.onchange = () => onchange(inp);
+    lab.appendChild(inp); root.appendChild(lab);
+    return inp;
+  };
+  const setRange = (field) => (i) => {
+    const v = parseFloat(i.value);
+    if (!Number.isFinite(v)) return;     // don't poison the render range
+    wf[field] = v;
+    wf.autorange = false;
+    this.autoInp.checked = false;
+  };
+  this.minInp = mk('min', wf.min, setRange('min'));
+  this.maxInp = mk('max', wf.max, setRange('max'));
+  const lab = document.createElement('label');
+  lab.textContent = 'auto';
+  const cb = document.createElement('input');
+  cb.type = 'checkbox'; cb.checked = !!wf.autorange;
+  cb.onchange = () => { wf.autorange = !!cb.checked; };
+  lab.appendChild(cb); root.appendChild(lab);
+  this.autoInp = cb;
+  const btn = document.createElement('button');
+  btn.textContent = 'reset zoom';
+  btn.onclick = () => { wf.x0 = 0; wf.x1 = 1; };
+  root.appendChild(btn);
+};
+
 /* ---------------- WebGL2 plumbing ------------------------------------------ */
 /* Shared helpers for the GPU sinks (the prophecy crate renders its Waterfall and
  * ConstellationSinkDensity with WebGL2 shaders, crates/prophecy/src/waterfall.rs /
@@ -343,9 +460,12 @@ FSDR.WATERFALL_FRAG = [
   'uniform float u_min;',
   'uniform float u_max;',
   'uniform float yoffset;',
+  'uniform float u_x0;',
+  'uniform float u_x1;',
   'out vec4 rgba;',
   'void main() {',
-  '  float v = texture(field, vec2(uv.x, uv.y + yoffset)).r;',
+  '  float fx = u_x0 + uv.x * (u_x1 - u_x0);',
+  '  float v = texture(field, vec2(fx, uv.y + yoffset)).r;',
   '  float t = clamp((v - u_min) / (u_max - u_min), 0.0, 1.0);',
   '  rgba = vec4(texture(lut, vec2(t, 0.5)).rgb, 1.0);',
   '}',
@@ -356,10 +476,13 @@ FSDR.Waterfall = function (canvas, opts) {
   this.history = opts.history || 1024;
   this.autorange = opts.autorange !== false;
   this.min = opts.min ?? 0; this.max = opts.max ?? 1;
+  this.db = !!opts.db;                   // display 10·log10(v) like prophecy
+  this.x0 = 0; this.x1 = 1;              // frequency zoom window (fractions)
   const gl = FSDR.GL.context(canvas);
-  if (!gl || !gl.texImage2D) {           // no WebGL2: canvas-2D fallback
-    this.fallback = new FSDR.Waterfall2D(canvas, opts);
-    return;
+  if (!gl || !gl.texImage2D) {
+    // no WebGL2: construct AS the canvas-2D sink (constructor return value)
+    // so zoom state and WaterfallControls operate on the object that renders
+    return new FSDR.Waterfall2D(canvas, opts);
   }
   this.gl = gl; this.bins = 0; this.row = 0;
   this.prog = FSDR.GL.program(gl, FSDR.GL.VERT, FSDR.WATERFALL_FRAG);
@@ -371,9 +494,12 @@ FSDR.Waterfall = function (canvas, opts) {
   this.uMin = gl.getUniformLocation(this.prog, 'u_min');
   this.uMax = gl.getUniformLocation(this.prog, 'u_max');
   this.uOff = gl.getUniformLocation(this.prog, 'yoffset');
+  this.uX0 = gl.getUniformLocation(this.prog, 'u_x0');
+  this.uX1 = gl.getUniformLocation(this.prog, 'u_x1');
+  FSDR.attachZoom(this, canvas);
 };
 FSDR.Waterfall.prototype.frame = function (data) {
-  if (this.fallback) return this.fallback.frame(data);
+  if (this.db) data = FSDR.toDb(data);
   const gl = this.gl;
   if (this.bins !== data.length) {       // (re)size the ring to the feed
     this.bins = data.length; this.row = 0;
@@ -394,6 +520,8 @@ FSDR.Waterfall.prototype.frame = function (data) {
   gl.uniform1f(this.uMin, this.min);
   gl.uniform1f(this.uMax, this.max);
   gl.uniform1f(this.uOff, this.row / this.history);
+  gl.uniform1f(this.uX0, this.x0);
+  gl.uniform1f(this.uX1, this.x1);
   gl.drawArrays(gl.TRIANGLE_STRIP, 0, 4);
 };
 /* canvas-2D waterfall (fallback + headless CI) — honors the same
@@ -404,9 +532,13 @@ FSDR.Waterfall2D = function (canvas, opts) {
   this.cv = canvas; this.ctx = canvas.getContext('2d');
   this.autorange = opts.autorange !== false;
   this.min = opts.min ?? 0; this.max = opts.max ?? 1;
+  this.db = !!opts.db;
+  this.x0 = 0; this.x1 = 1;
+  FSDR.attachZoom(this, canvas);
 };
 FSDR.Waterfall2D.prototype.frame = function (data) {
   const cv = this.cv, ctx = this.ctx;
+  if (this.db) data = FSDR.toDb(data);
   ctx.drawImage(cv, 0, -1);
   const img = ctx.createImageData(cv.width, 1);
   let lo = this.min, hi = this.max;
@@ -419,7 +551,8 @@ FSDR.Waterfall2D.prototype.frame = function (data) {
   }
   const span = Math.max(hi - lo, 1e-9);
   for (let x = 0; x < cv.width; x++) {
-    const i = Math.floor(x * data.length / cv.width);
+    const fx = this.x0 + (x / cv.width) * (this.x1 - this.x0);
+    const i = Math.min(Math.floor(fx * data.length), data.length - 1);
     const t = (data[i] - lo) / span;
     img.data[4 * x] = 255 * Math.min(1, 2 * t);
     img.data[4 * x + 1] = 255 * Math.max(0, 2 * t - 1);
@@ -484,9 +617,8 @@ FSDR.ConstellationSinkDensity = function (canvas, opts) {
   opts = opts || {};
   this.cv = canvas;
   const gl = FSDR.GL.context(canvas);
-  if (!gl || !gl.texImage2D) {           // delegate fully: no dead duplicate hist
-    this.fallback = new FSDR.ConstellationSinkDensity2D(canvas, opts);
-    return;
+  if (!gl || !gl.texImage2D) {           // construct AS the 2D sink (see Waterfall)
+    return new FSDR.ConstellationSinkDensity2D(canvas, opts);
   }
   this.n = opts.bins || 128;
   this.decay = opts.decay ?? 0.9;
@@ -516,7 +648,6 @@ FSDR.ConstellationSinkDensity.prototype.accumulate = function (iq) {
   return hi;
 };
 FSDR.ConstellationSinkDensity.prototype.frame = function (iq) {
-  if (this.fallback) return this.fallback.frame(iq);
   const gl = this.gl, peak = this.accumulate(iq);
   gl.activeTexture(gl.TEXTURE0);
   gl.texSubImage2D(gl.TEXTURE_2D, 0, 0, 0, this.n, this.n, gl.RED, gl.FLOAT,

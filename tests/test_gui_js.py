@@ -122,11 +122,14 @@ def test_js_uniforms_match_glsl(frag, widget):
 
 
 def test_gl_paths_guarded_by_fallback():
-    """Both GPU sinks construct a canvas-2D fallback when WebGL2 is missing."""
+    """Both GPU sinks construct AS their canvas-2D sibling when WebGL2 is
+    missing (constructor return value — state and controls then operate on the
+    object that actually renders)."""
     for widget in ("Waterfall", "ConstellationSinkDensity"):
         m = re.search(rf"FSDR\.{widget} = function(.*?)FSDR\.{widget}\.prototype",
                       SRC, re.S)
-        assert "this.fallback" in m.group(1), f"{widget} lacks a fallback"
+        assert re.search(rf"return new FSDR\.\w+2D\(", m.group(1)), \
+            f"{widget} lacks a 2D fallback construction"
 
 
 NODE = shutil.which("node") or shutil.which("nodejs")
@@ -658,3 +661,138 @@ def test_jsmini_language_semantics():
     assert i.eval("'abc'.replace(/b/, m => m.toUpperCase())") == "aBc"
     assert i.eval("parseInt('42px', 10)") == 42.0
     assert i.eval("'a-b'.replace(/(\\w)-(\\w)/, '$2-$1')") == "b-a"
+
+
+def _mkev(i, **kw):
+    ev = JSObject()
+    for k, v in kw.items():
+        ev.set(k, float(v) if isinstance(v, (int, float)) else v)
+    return ev
+
+
+def test_exec_waterfall_zoom_pan_controls():
+    """Frequency zoom (wheel around cursor), drag pan, double-click reset, dB
+    mode and live range controls — the prophecy-parity interaction layer,
+    executed on both the GL and 2D paths."""
+    i = _interp()
+    gl = _GLRec()
+    cv = _canvas(128, 64)
+    cv.getContext = lambda kind, *a: gl if kind == "webgl2" else None
+    i.genv.vars["__cv"] = cv
+    i.run("const wf = new FSDR.Waterfall(__cv, {history: 8, db: true});")
+    wf = i.get("wf")
+    assert wf.get("x0") == 0.0 and wf.get("x1") == 1.0
+    # wheel-in at the 3/4 point: window shrinks, cursor fraction preserved
+    i.call(cv._listeners["wheel"], UNDEF, _mkev(i, clientX=96, deltaY=-1))
+    x0, x1 = wf.get("x0"), wf.get("x1")
+    assert 0.0 < x0 < x1 < 1.0 and abs((x1 - x0) - 0.8) < 1e-6
+    assert abs((0.75 - x0) / (x1 - x0) - 0.75) < 1e-6   # cursor-centred
+    # drag pans left within bounds
+    i.call(cv._listeners["mousedown"], UNDEF, _mkev(i, clientX=64))
+    i.call(cv._listeners["mousemove"], UNDEF, _mkev(i, clientX=32))
+    i.call(cv._listeners["mouseup"], UNDEF, _mkev(i))
+    x0b = wf.get("x0")
+    assert x0b > x0                                     # moved right (pan left)
+    assert abs((wf.get("x1") - x0b) - (x1 - x0)) < 1e-9  # width preserved
+    # frame uploads dB data and the window uniforms
+    i.genv.vars["__d"] = [1.0, 10.0, 100.0, 1000.0] * 8
+    i.run("wf.frame(__d);")
+    up = [c for c in gl.calls if c[0] == "texSubImage2D"][-1]
+    row = list(up[-1])
+    assert abs(row[0] - 0.0) < 1e-6 and abs(row[3] - 30.0) < 1e-5  # 10log10
+    assert abs(gl.uniforms["u_x0"] - x0b) < 1e-9
+    # double-click resets the window
+    i.call(cv._listeners["dblclick"], UNDEF, _mkev(i))
+    assert wf.get("x0") == 0.0 and wf.get("x1") == 1.0
+
+    # 2D path shares the contract: zoomed window remaps the painted indices
+    cv2 = _canvas(64, 32)
+    i.genv.vars["__cv2"] = cv2
+    i.run("const w2 = new FSDR.Waterfall2D(__cv2, {autorange: false, "
+          "min: 0, max: 63});")
+    w2 = i.get("w2")
+    i.genv.vars["__ramp"] = list(range(64))
+    i.run("w2.x0 = 0.5; w2.x1 = 1.0; w2.frame(__ramp);")
+    img = cv2.getContext("2d").last_image
+    # left edge of the painted row now shows the MIDDLE of the spectrum
+    t_left = img.data[0] / 255 / 2            # red = min(1, 2t) inverse for t<0.5
+    assert abs(t_left - 32 / 63) < 0.05
+
+    # live controls drive the running sink (prophecy Signal<f32> wiring)
+    root = _El("div")
+    i.genv.vars["__root"] = root
+    i.run("const ctl = new FSDR.WaterfallControls(__root, w2);")
+    min_inp = root.children[0].children[0]
+    min_inp.value = "-40"
+    i.call(min_inp.onchange, UNDEF)
+    assert w2.get("min") == -40.0 and w2.get("autorange") is False
+    auto_cb = root.children[2].children[0]
+    auto_cb.checked = True
+    i.call(auto_cb.onchange, UNDEF)
+    assert w2.get("autorange") is True
+    reset_btn = root.children[3]
+    i.run("w2.x0 = 0.25; w2.x1 = 0.75;")
+    i.call(reset_btn.onclick, UNDEF)
+    assert w2.get("x0") == 0.0 and w2.get("x1") == 1.0
+
+
+def test_exec_flowgraph_canvas_drag_blocks():
+    """Blocks drag with the mouse and the position persists across update()
+    (prophecy flowgraph_canvas on_mousedown parity)."""
+    desc_py = {
+        "id": 0,
+        "blocks": [
+            {"id": 0, "instance_name": "a", "stream_inputs": [],
+             "stream_outputs": ["out"], "message_inputs": [], "blocking": False},
+            {"id": 1, "instance_name": "b", "stream_inputs": ["in"],
+             "stream_outputs": [], "message_inputs": [], "blocking": False},
+        ],
+        "stream_edges": [[0, "out", 1, "in"]],
+        "message_edges": [],
+    }
+    i = _interp()
+    cv = _canvas(300, 120)
+    i.genv.vars["__cv"] = cv
+    i.run("const fgc = new FSDR.FlowgraphCanvas(__cv, {});")
+    i.run(f"fgc.update(JSON.parse({json_mod.dumps(json_mod.dumps(desc_py))}));")
+    fgc = i.get("fgc")
+    b0 = fgc.get("boxes")[0]
+    ox, oy = b0.get("x"), b0.get("y")
+    i.call(cv._listeners["mousedown"], UNDEF, _mkev(i, clientX=ox + 5,
+                                                    clientY=oy + 5))
+    i.call(cv._listeners["mousemove"], UNDEF, _mkev(i, clientX=ox + 45,
+                                                    clientY=oy + 25))
+    i.call(cv._listeners["mouseup"], UNDEF, _mkev(i))
+    nb = fgc.get("boxes")[0]
+    assert abs(nb.get("x") - (ox + 40)) < 1e-6
+    assert abs(nb.get("y") - (oy + 20)) < 1e-6
+    # the dragged position survives a fresh update()
+    i.run(f"fgc.update(JSON.parse({json_mod.dumps(json_mod.dumps(desc_py))}));")
+    nb2 = fgc.get("boxes")[0]
+    assert abs(nb2.get("x") - (ox + 40)) < 1e-6
+
+
+def test_exec_waterfall_fallback_is_the_renderer():
+    """Without WebGL2, new FSDR.Waterfall() IS the 2D sink (constructor return)
+    so zoom state + WaterfallControls operate on the rendering object."""
+    i = _interp()
+    cv = _canvas(64, 32)                  # getContext('webgl2') -> None
+    i.genv.vars["__cv"] = cv
+    i.run("const wf = new FSDR.Waterfall(__cv, {min: 1, max: 9});")
+    assert i.eval("wf instanceof FSDR.Waterfall2D") is True
+    root = _El("div")
+    i.genv.vars["__root"] = root
+    i.run("const c = new FSDR.WaterfallControls(__root, wf);")
+    min_inp = root.children[0].children[0]
+    min_inp.value = "3.5"
+    i.call(min_inp.onchange, UNDEF)
+    assert i.eval("wf.min") == 3.5        # the control reached the renderer
+    min_inp.value = "garbage"
+    i.call(min_inp.onchange, UNDEF)
+    assert i.eval("wf.min") == 3.5        # NaN guard held
+    # stuck-drag guard: after a block... (waterfall) pan drag ends on mouseup
+    i.call(cv._listeners["mousedown"], UNDEF, _mkev(i, clientX=10))
+    i.call(cv._listeners["mouseup"], UNDEF, _mkev(i))
+    x0 = i.eval("wf.x0")
+    i.call(cv._listeners["mousemove"], UNDEF, _mkev(i, clientX=50))
+    assert i.eval("wf.x0") == x0          # no pan without a held button
