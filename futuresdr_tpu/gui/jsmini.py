@@ -1369,6 +1369,10 @@ def _mk_typed(kind):
         t = TypedArray(arg, clamp=kind)
         return t
     ctor.js_name = "Float32Array" if kind is None else "Uint8Array"
+    setattr(ctor, "from", lambda it, fn=None: TypedArray(
+        [fn(v, float(i)) if fn else v for i, v in enumerate(list(it))],
+        clamp=kind))
+    setattr(ctor, "of", lambda *vs: TypedArray(list(vs), clamp=kind))
     return ctor
 
 

@@ -110,13 +110,10 @@ FSDR.FlowgraphCanvas = function (canvas, opts) {
     this.custom[b.blk.id] = {x: b.x, y: b.y};
     this.draw();
   });
-  const endDrag = () => {
+  this.dispose = FSDR.onGlobalMouseUp(canvas, () => {
     this._suppressClick = !!(drag && drag.moved > 3);
     drag = null;
-  };
-  const upTarget = (typeof window !== 'undefined' && window
-                    && window.addEventListener) ? window : canvas;
-  upTarget.addEventListener('mouseup', endDrag);
+  });
 };
 FSDR.FlowgraphCanvas.prototype.update = function (desc) {
   this.desc = desc; this.layout(); this.draw();
@@ -288,6 +285,15 @@ FSDR.ListSelector = function (root, handle, fgId, blkId, handler, options) {
  * Same capabilities here: wheel zooms the frequency axis around the cursor,
  * drag pans, double-click resets; WaterfallControls wires live min/max/auto/dB
  * inputs to a running sink. */
+/* Register a mouseup listener on window (browser) or the canvas (headless
+ * stubs); returns an unsubscribe so widgets are disposable — window-level
+ * listeners otherwise pin discarded widgets for the page lifetime. */
+FSDR.onGlobalMouseUp = function (canvas, fn) {
+  const t = (typeof window !== 'undefined' && window
+             && window.addEventListener) ? window : canvas;
+  t.addEventListener('mouseup', fn);
+  return () => { if (t.removeEventListener) t.removeEventListener('mouseup', fn); };
+};
 FSDR.attachZoom = function (wf, canvas) {
   canvas.addEventListener('wheel', (ev) => {
     const r = canvas.getBoundingClientRect();
@@ -313,15 +319,18 @@ FSDR.attachZoom = function (wf, canvas) {
     wf.x0 = Math.min(Math.max(drag.x0 - dx, 0), 1 - w);
     wf.x1 = wf.x0 + w;
   });
-  // releasing OUTSIDE the canvas must still end the pan: listen on window
-  // where one exists (browser); headless stubs fall back to the canvas
-  const upTarget = (typeof window !== 'undefined' && window
-                    && window.addEventListener) ? window : canvas;
-  upTarget.addEventListener('mouseup', () => { drag = null; });
+  // releasing OUTSIDE the canvas must still end the pan
+  wf.dispose = FSDR.onGlobalMouseUp(canvas, () => { drag = null; });
   canvas.addEventListener('dblclick', () => { wf.x0 = 0; wf.x1 = 1; });
 };
-FSDR.toDb = function (data) {
-  const out = new Float32Array(data.length);
+FSDR.toDb = function (data, scratchOwner) {
+  // per-sink scratch: a fresh Float32Array per frame would churn the GC on
+  // full-rate feeds (same rule as the density sink's offscreen surfaces)
+  let out = scratchOwner && scratchOwner._dbBuf;
+  if (!out || out.length !== data.length) {
+    out = new Float32Array(data.length);
+    if (scratchOwner) scratchOwner._dbBuf = out;
+  }
   for (let i = 0; i < data.length; i++)
     out[i] = 10 * Math.log10(Math.max(data[i], 1e-12));
   return out;
@@ -499,7 +508,7 @@ FSDR.Waterfall = function (canvas, opts) {
   FSDR.attachZoom(this, canvas);
 };
 FSDR.Waterfall.prototype.frame = function (data) {
-  if (this.db) data = FSDR.toDb(data);
+  if (this.db) data = FSDR.toDb(data, this);
   const gl = this.gl;
   if (this.bins !== data.length) {       // (re)size the ring to the feed
     this.bins = data.length; this.row = 0;
@@ -534,22 +543,15 @@ FSDR.Waterfall2D = function (canvas, opts) {
   this.min = opts.min ?? 0; this.max = opts.max ?? 1;
   this.db = !!opts.db;
   this.x0 = 0; this.x1 = 1;
+  // raw row history (canvas-height rows): zoom/pan repaints RETROACTIVELY so
+  // the whole spectrogram shows one frequency window, matching the GL path
+  // (which remaps the full ring texture per draw)
+  this.rows = []; this._paintedX = [0, 1];
   FSDR.attachZoom(this, canvas);
 };
-FSDR.Waterfall2D.prototype.frame = function (data) {
+FSDR.Waterfall2D.prototype._paintRow = function (data, y, lo, span) {
   const cv = this.cv, ctx = this.ctx;
-  if (this.db) data = FSDR.toDb(data);
-  ctx.drawImage(cv, 0, -1);
   const img = ctx.createImageData(cv.width, 1);
-  let lo = this.min, hi = this.max;
-  if (this.autorange) {
-    lo = Infinity; hi = -Infinity;
-    for (const v of data) { if (v < lo) lo = v; if (v > hi) hi = v; }
-    this.min = this.min * 0.97 + lo * 0.03;
-    this.max = this.max * 0.97 + hi * 0.03;
-    lo = this.min; hi = this.max;
-  }
-  const span = Math.max(hi - lo, 1e-9);
   for (let x = 0; x < cv.width; x++) {
     const fx = this.x0 + (x / cv.width) * (this.x1 - this.x0);
     const i = Math.min(Math.floor(fx * data.length), data.length - 1);
@@ -559,7 +561,33 @@ FSDR.Waterfall2D.prototype.frame = function (data) {
     img.data[4 * x + 2] = 96 * (1 - t);
     img.data[4 * x + 3] = 255;
   }
-  ctx.putImageData(img, 0, cv.height - 1);
+  ctx.putImageData(img, 0, y);
+};
+FSDR.Waterfall2D.prototype.frame = function (data) {
+  const cv = this.cv, ctx = this.ctx;
+  if (this.db) data = FSDR.toDb(data, this);
+  this.rows.push(data instanceof Float32Array ? data.slice() :
+                 Float32Array.from(data));
+  if (this.rows.length > cv.height) this.rows.shift();
+  let lo = this.min, hi = this.max;
+  if (this.autorange) {
+    lo = Infinity; hi = -Infinity;
+    for (const v of data) { if (v < lo) lo = v; if (v > hi) hi = v; }
+    this.min = this.min * 0.97 + lo * 0.03;
+    this.max = this.max * 0.97 + hi * 0.03;
+    lo = this.min; hi = this.max;
+  }
+  const span = Math.max(hi - lo, 1e-9);
+  const zoomed = this._paintedX[0] !== this.x0 || this._paintedX[1] !== this.x1;
+  if (zoomed) {
+    // window changed: repaint the WHOLE history in the new mapping
+    this._paintedX = [this.x0, this.x1];
+    for (let k = 0; k < this.rows.length; k++)
+      this._paintRow(this.rows[k], cv.height - this.rows.length + k, lo, span);
+    return;
+  }
+  ctx.drawImage(cv, 0, -1);
+  this._paintRow(data, cv.height - 1, lo, span);
 };
 FSDR.TimeSink = function (canvas, mode) {     // mode: 'line' | 'dots'
   this.cv = canvas; this.ctx = canvas.getContext('2d'); this.mode = mode || 'line';

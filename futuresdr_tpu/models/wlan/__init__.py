@@ -11,8 +11,10 @@ from .phy import (encode_frame, decode_frame, decode_stream, decode_stream_batch
                   DecodedFrame)
 from .mac import Mac, mpdu_from_payload, payload_from_mpdu
 from .blocks import WlanEncoder, WlanDecoder
+from .channels import channel_to_freq, freq_to_channel, parse_channel
 from . import coding, ofdm
 
 __all__ = ["MCS_TABLE", "Mcs", "encode_frame", "decode_frame", "decode_stream",
            "decode_stream_batch", "DecodedFrame", "Mac", "mpdu_from_payload",
-           "payload_from_mpdu", "WlanEncoder", "WlanDecoder", "coding", "ofdm"]
+           "payload_from_mpdu", "WlanEncoder", "WlanDecoder", "coding", "ofdm",
+           "channel_to_freq", "freq_to_channel", "parse_channel"]

@@ -175,6 +175,10 @@ class _El:
     def addEventListener(self, name, fn):
         self._listeners[name] = fn
 
+    def removeEventListener(self, name, fn):
+        if self._listeners.get(name) is fn:
+            del self._listeners[name]
+
     def getBoundingClientRect(self):
         o = JSObject()
         o.set("left", 0.0)
@@ -796,3 +800,38 @@ def test_exec_waterfall_fallback_is_the_renderer():
     x0 = i.eval("wf.x0")
     i.call(cv._listeners["mousemove"], UNDEF, _mkev(i, clientX=50))
     assert i.eval("wf.x0") == x0          # no pan without a held button
+
+
+def test_exec_waterfall2d_zoom_is_retroactive_and_disposable():
+    """Zooming repaints the WHOLE 2D history in the new window (GL-path parity),
+    and dispose() detaches the global mouseup listener."""
+    i = _interp()
+    cv = _canvas(32, 8)
+    i.genv.vars["__cv"] = cv
+    i.run("const wf = new FSDR.Waterfall2D(__cv, {autorange: false, "
+          "min: 0, max: 31});")
+    ramp = list(range(32))
+    i.genv.vars["__r"] = ramp
+    for _ in range(4):
+        i.run("wf.frame(__r);")
+    ctx = cv.getContext("2d")
+    n_paints_before = len([o for o in ctx.ops if o[0] == "putImageData"])
+    # zoom to the right half, then ONE frame must repaint history rows
+    i.run("wf.x0 = 0.5; wf.x1 = 1.0; wf.frame(__r);")
+    paints = [o for o in ctx.ops if o[0] == "putImageData"][n_paints_before:]
+    assert len(paints) == 5                  # 5 stored rows, all repainted
+    img = ctx.last_image
+    t_left = img.data[0] / 255 / 2           # red channel inverse for t < 0.5
+    assert abs(t_left - 16 / 31) < 0.06      # left edge shows mid-spectrum
+    # steady-state zoomed frames go back to incremental painting
+    i.run("wf.frame(__r);")
+    paints2 = [o for o in ctx.ops if o[0] == "putImageData"][n_paints_before:]
+    assert len(paints2) == 6                 # just one more row
+    # dispose detaches the pan listener
+    assert i.eval("typeof wf.dispose") == "function"
+    i.run("wf.dispose();")
+    assert "mouseup" not in cv._listeners
+    # dB scratch is reused across frames (no per-frame allocation)
+    i.run("const wd = new FSDR.Waterfall2D(__cv, {db: true});")
+    i.run("wd.frame(__r); const b1 = wd._dbBuf; wd.frame(__r);")
+    assert i.eval("b1 === wd._dbBuf") is True

@@ -344,3 +344,30 @@ def test_viterbi_terminates_at_tail_not_pad():
                                  np.zeros(150, np.complex64)])
             f2 = decode_stream(x2)
             assert len(f2) == 1 and f2[0].psdu == p2, (mcs, n_pay)
+
+
+def test_channel_table_matches_reference():
+    """models/wlan/channels.py: the 67-channel table equals `channels.rs:1-72`
+    entry by entry (derived arithmetic vs the reference's literal list), and
+    the parse API mirrors its error semantics."""
+    import re
+    from pathlib import Path
+
+    import pytest
+
+    from futuresdr_tpu.models.wlan.channels import (CHANNELS, channel_to_freq,
+                                                    freq_to_channel,
+                                                    parse_channel)
+    assert len(CHANNELS) == 67
+    assert channel_to_freq(1) == 2412e6 and channel_to_freq(14) == 2484e6
+    assert channel_to_freq(36) == 5180e6 and channel_to_freq(184) == 5920e6
+    assert channel_to_freq(35) is None          # gaps stay gaps
+    assert freq_to_channel(5860e6) == 172
+    assert parse_channel("165") == 5825e6
+    for bad in ("x", "35", "0"):
+        with pytest.raises(ValueError, match="WLAN channel"):
+            parse_channel(bad)
+    ref = Path("/root/reference/examples/wlan/src/channels.rs")
+    if ref.exists():                            # full parity check when present
+        pairs = re.findall(r"\((\d+),\s*([\d.]+)e6\)", ref.read_text())
+        assert CHANNELS == {int(c): float(f) * 1e6 for c, f in pairs}
