@@ -11,6 +11,7 @@ from .kernel_block import TpuKernel
 from .frames import TpuH2D, TpuStage, TpuD2H
 from .autotune import autotune
 from .sp_block import SpKernel
+from .pp_block import PpKernel
 
 __all__ = ["TpuInstance", "instance", "TpuKernel", "TpuH2D", "TpuStage", "TpuD2H",
-           "autotune", "SpKernel"]
+           "autotune", "SpKernel", "PpKernel"]

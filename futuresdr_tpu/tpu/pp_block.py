@@ -1,0 +1,137 @@
+"""PpKernel: a flowgraph block whose per-frame compute is a GPipe pipeline
+across the mesh's ``pp`` axis.
+
+The sibling of :class:`SpKernel` for PIPELINE parallelism: SpKernel time-shards
+each frame over every device (sequence parallelism); PpKernel shards a MODEL —
+each device on the ``pp`` axis owns one stage's weights, frames are split into
+microbatches that stream through the stages with ``ppermute`` hops between
+devices (:func:`futuresdr_tpu.parallel.make_pp_pipeline` — one jitted shard_map,
+so the whole schedule is a single XLA program per frame).
+
+This closes the runtime-integration loop for the last parallelism axis: data
+(multi-pipe), tensor (shard_params), sequence (SpKernel), and now pipeline
+parallelism all run through the SAME actor runtime and stream buffers
+(SURVEY §2.7 — the reference pipelines blocks over CPU threads; the TPU-native
+form pipelines a model over the mesh and feeds it from a flowgraph).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Deque, Optional, Sequence
+
+import numpy as np
+
+from ..runtime.kernel import Kernel
+
+__all__ = ["PpKernel"]
+
+
+def _check_stage_leading(stage_params, n_stages: int) -> None:
+    """Every leaf must lead with exactly n_stages: a larger multiple shards
+    without error but each device then uses only its FIRST stage — half the
+    model silently ignored."""
+    import jax
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if np.ndim(leaf) < 1 or np.shape(leaf)[0] != n_stages:
+            raise ValueError(
+                f"stage_params leaves must lead with n_stages={n_stages}; "
+                f"got leaf shape {np.shape(leaf)}")
+
+
+class PpKernel(Kernel):
+    """Stream → microbatched pipeline over ``mesh[axis]`` → stream.
+
+    - ``apply_stage(params_one_stage, x) -> y``: one stage's computation;
+      input/output share shape+dtype (activations ride one ppermute channel).
+    - ``stage_params``: pytree with a leading ``n_stages`` axis on every leaf,
+      placed one-stage-per-device along ``axis``.
+    - ``micro_shape``: shape of ONE microbatch (e.g. ``(batch, features)``);
+      each frame carries ``n_micro`` of them, so
+      ``frame_size = n_micro * prod(micro_shape)`` items.
+
+    Frames are independent (stateless model application); ``frames_in_flight``
+    overlaps H2D/compute/D2H via XLA async dispatch like TpuKernel.
+    """
+
+    BLOCKING = True
+
+    def __init__(self, apply_stage: Callable, stage_params, mesh, in_dtype,
+                 out_dtype, micro_shape: Sequence[int], n_micro: int,
+                 axis: str = "pp", frames_in_flight: int = 2):
+        super().__init__()
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import make_pp_pipeline
+
+        self.mesh = mesh
+        self.axis = axis
+        n_stages = mesh.shape[axis]
+        self.micro_shape = tuple(int(m) for m in micro_shape)
+        self.n_micro = int(n_micro)
+        self.frame_size = self.n_micro * int(np.prod(self.micro_shape))
+        self._fn = jax.jit(make_pp_pipeline(apply_stage, n_stages,
+                                            self.n_micro, mesh, axis))
+        _check_stage_leading(stage_params, n_stages)
+        self._W = jax.device_put(stage_params, NamedSharding(mesh, P(axis)))
+        self._x_shard = NamedSharding(mesh, P())        # microbatches replicated
+        self.depth = int(frames_in_flight)
+        self._inflight: Deque = deque()
+        self._pending: Optional[np.ndarray] = None
+        self.input = self.add_stream_input("in", in_dtype,
+                                           min_items=self.frame_size)
+        self.output = self.add_stream_output(
+            "out", out_dtype, min_items=self.frame_size,
+            min_buffer_size=(self.depth + 1) * self.frame_size
+            * np.dtype(out_dtype).itemsize)
+
+    def update_params(self, stage_params) -> None:
+        """Swap the pipeline weights between frames (same pytree structure;
+        frames already dispatched finish with the old weights)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        _check_stage_leading(stage_params, self.mesh.shape[self.axis])
+        self._W = jax.device_put(stage_params,
+                                 NamedSharding(self.mesh, P(self.axis)))
+
+    def _dispatch(self, frame: np.ndarray) -> None:
+        from ..ops.xfer import to_device
+        # to_device: the complex-pair shim — raw device_put of host complex64
+        # poisons readback on the tunneled TPU backend (ops/xfer.py)
+        x = to_device(frame.reshape((self.n_micro,) + self.micro_shape),
+                      self._x_shard)
+        self._inflight.append(self._fn(self._W, x))
+
+    async def work(self, io, mio, meta):
+        if self._pending is not None:
+            out = self.output.slice()
+            k = min(len(out), len(self._pending))
+            out[:k] = self._pending[:k]
+            self.output.produce(k)
+            self._pending = self._pending[k:] if k < len(self._pending) else None
+            if self._pending is not None:
+                return
+        inp = self.input.slice()
+        while len(self._inflight) < self.depth and len(inp) >= self.frame_size:
+            self._dispatch(np.asarray(inp[:self.frame_size]).copy())
+            self.input.consume(self.frame_size)
+            inp = self.input.slice()
+        eos = self.input.finished()
+        if self._inflight and (len(self._inflight) >= self.depth or eos
+                               or len(inp) < self.frame_size):
+            from ..ops.xfer import to_host
+            result = to_host(self._inflight.popleft()).reshape(-1)
+            out = self.output.slice()
+            k = min(len(out), len(result))
+            out[:k] = result[:k]
+            self.output.produce(k)
+            if k < len(result):
+                self._pending = result[k:].copy()
+            io.call_again = True
+            return
+        if eos and not self._inflight and self._pending is None:
+            if self.input.available():
+                # partial tail below one frame cannot microbatch; dropped at EOS
+                self.input.consume(self.input.available())
+            io.finished = True
