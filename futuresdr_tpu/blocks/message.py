@@ -24,7 +24,9 @@ class MessageCopy(Kernel):
         self.add_message_output("out")
 
     @message_handler(name="in")
-    async def in_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+    def in_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        # sync handler: the hot message-plane path skips the per-message
+        # coroutine allocation (call_handler supports both forms)
         if p.is_finished():
             io.finished = True
             return Pmt.ok()

@@ -11,6 +11,7 @@ registered with ``add_message_input`` or marked with the :func:`message_handler`
 from __future__ import annotations
 
 import inspect
+import types
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
@@ -33,11 +34,14 @@ class BlockMeta:
 
 
 def message_handler(fn=None, *, name: Optional[str] = None):
-    """Mark an async method as a message-input handler.
+    """Mark a method as a message-input handler.
 
-    Handler signature: ``async def h(self, io: WorkIo, mio: MessageOutputs, meta: BlockMeta,
-    pmt: Pmt) -> Pmt``. The handler gets the live WorkIo so it can set ``finished`` /
-    ``call_again`` (reference: handlers take ``&mut WorkIo``, ``tests/flowgraph.rs:30-39``).
+    Handler signature: ``def h(self, io: WorkIo, mio: MessageOutputs, meta: BlockMeta,
+    pmt: Pmt) -> Pmt`` — plain OR ``async def`` (both are dispatched by
+    ``call_handler``; prefer plain for hot paths, it skips the per-message
+    coroutine allocation — only go async to ``await`` something). The handler
+    gets the live WorkIo so it can set ``finished`` / ``call_again``
+    (reference: handlers take ``&mut WorkIo``, ``tests/flowgraph.rs:30-39``).
     """
 
     def mark(f):
@@ -61,6 +65,7 @@ class Kernel:
         self._stream_inputs: List[StreamInput] = []
         self._stream_outputs: List[StreamOutput] = []
         self._message_handlers: Dict[str, Callable] = {}
+        self._handler_names = None       # index->name cache (call_handler)
         self._mio = MessageOutputs([])
         self.meta = BlockMeta(
             type_name=type_name or type(self).__name__,
@@ -102,6 +107,7 @@ class Kernel:
 
     def add_message_input(self, name: str, handler: Callable) -> None:
         self._message_handlers[name] = handler
+        self._handler_names = None
 
     def add_message_output(self, name: str) -> None:
         self._mio.add_port(name)
@@ -143,18 +149,24 @@ class Kernel:
         return list(self._message_handlers)
 
     async def call_handler(self, io: WorkIo, meta: BlockMeta, port: PortId, pmt: Pmt) -> Pmt:
-        """Dispatch a message to the named handler (`macros/lib.rs:1092-1114`)."""
+        """Dispatch a message to the named handler (`macros/lib.rs:1092-1114`).
+
+        Handlers may be async OR plain functions — sync handlers skip the
+        per-message coroutine allocation (the message-plane hot path)."""
         pid = port.id if isinstance(port, PortId) else port
         if isinstance(pid, int):
+            names = self._handler_names
+            if names is None:
+                names = self._handler_names = tuple(self._message_handlers)
             try:
-                pid = list(self._message_handlers)[pid]
+                pid = names[pid]
             except IndexError:
                 return Pmt.invalid_value()
         handler = self._message_handlers.get(pid)
         if handler is None:
             return Pmt.invalid_value()
         result = handler(io, self._mio, meta, pmt)
-        if inspect.isawaitable(result):
+        if type(result) is types.CoroutineType or inspect.isawaitable(result):
             result = await result
         return result if isinstance(result, Pmt) else Pmt.from_py(result)
 
