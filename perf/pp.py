@@ -7,7 +7,10 @@ ideal efficiency is ``M / (M + S - 1)`` — the probe reports measured vs ideal
 so pipeline regressions (extra collectives, broken overlaps) show up as an
 efficiency gap rather than a silent slowdown.
 
-CSV: ``stages,micro,ideal_eff,msamples_per_sec``.
+CSV: ``stages,micro,ideal_eff,msamples_per_sec``; with ``--flowgraph``, extra
+``flowgraph,stages,micro,frames,msamples_per_sec`` rows run PpKernel through
+the actor runtime (stream buffers + microbatching around the same mesh
+program).
 """
 
 import argparse
@@ -27,6 +30,8 @@ def main():
     p.add_argument("--width", type=int, default=256)
     p.add_argument("--mb", type=int, default=64, help="rows per microbatch")
     p.add_argument("--reps", type=int, default=5)
+    p.add_argument("--flowgraph", action="store_true",
+                   help="also run PpKernel through the actor runtime")
     a = p.parse_args()
 
     flags = os.environ.get("XLA_FLAGS", "")
@@ -67,6 +72,43 @@ def main():
             dt = (time.perf_counter() - t0) / a.reps
             rate = M * a.mb * d / dt / 1e6
             print(f"{S},{M},{M / (M + S - 1):.3f},{rate:.1f}", flush=True)
+
+    if a.flowgraph:
+        # the same pipeline THROUGH the actor runtime: PpKernel streams frames
+        # from a flowgraph (ring buffer -> microbatch -> pp mesh -> ring)
+        from futuresdr_tpu import Flowgraph, Runtime
+        from futuresdr_tpu.blocks import Head, NullSink, NullSource
+        from futuresdr_tpu.tpu import PpKernel
+
+        print("# flowgraph PpKernel rows: stages,micro,frames,msamples_per_sec",
+              file=sys.stderr)
+        for S in a.stages:
+            if S > len(jax.devices()):
+                print(f"# skipping flowgraph stages={S}: only "
+                      f"{len(jax.devices())} devices", file=sys.stderr)
+                continue
+            mesh = make_mesh(("pp",), shape=(S,), devices=jax.devices()[:S])
+            Wh = (rng.standard_normal((S, d, d)) / np.sqrt(d)).astype(np.float32)
+            M = a.micro[-1]
+            frame_items = M * a.mb * d
+            # enough frames that actor spawn/teardown amortizes below ~10%
+            n_frames = max(16, 4 * a.reps)
+            fg = Flowgraph()
+            src = NullSource(np.float32)
+            head = Head(np.float32, n_frames * frame_items)
+            ppk = PpKernel(lambda w, x: jnp.tanh(x @ w), Wh, mesh,
+                           np.float32, np.float32, micro_shape=(a.mb, d),
+                           n_micro=M)
+            snk = NullSink(np.float32)
+            fg.connect(src, head, ppk, snk)
+            ppk.warmup()       # compile outside the timed region, through
+            #                      the real dispatch path (raw rows also time
+            #                      post-compile)
+            t0 = time.perf_counter()
+            Runtime().run(fg)
+            dt = time.perf_counter() - t0
+            print(f"flowgraph,{S},{M},{n_frames},"
+                  f"{n_frames * frame_items / dt / 1e6:.1f}", flush=True)
 
 
 if __name__ == "__main__":
