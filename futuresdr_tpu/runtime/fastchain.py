@@ -34,12 +34,14 @@ __all__ = ["find_native_chains", "run_chain_task", "fastchain_available"]
 log = logger("runtime.fastchain")
 
 # stage kinds — keep in sync with native/fastchain.cpp
-FC_NULL_SOURCE, FC_HEAD, FC_COPY, FC_COPY_RAND, FC_NULL_SINK = range(5)
+(FC_NULL_SOURCE, FC_HEAD, FC_COPY, FC_COPY_RAND, FC_NULL_SINK,
+ FC_VEC_SOURCE, FC_VEC_SINK) = range(7)
 
 
 class _FcStage(ctypes.Structure):
     _fields_ = [("kind", ctypes.c_int32), ("_pad", ctypes.c_int32),
-                ("p0", ctypes.c_int64), ("p1", ctypes.c_int64)]
+                ("p0", ctypes.c_int64), ("p1", ctypes.c_int64),
+                ("data", ctypes.c_void_p)]
 
 
 _lib = None
@@ -66,28 +68,61 @@ def fastchain_available() -> bool:
 
 
 def _native_stage(kernel) -> Optional[tuple]:
-    """(kind, p0, p1) for natively runnable kernels; None otherwise.
+    """(kind, p0, p1, data|None) for natively runnable kernels; None otherwise.
 
     Central registry rather than per-class methods: the chain driver owns the
     exact semantics it re-implements, so a behavioral change to one of these
     blocks must be mirrored HERE or the kernel dropped from the registry."""
+    import numpy as np
+
     from ..blocks.stream import Copy, Head
-    from ..blocks.vector import CopyRand, NullSink, NullSource
+    from ..blocks.vector import CopyRand, NullSink, NullSource, VectorSink, \
+        VectorSource
 
     if type(kernel) is NullSource:
-        return (FC_NULL_SOURCE, 0, 0)
+        return (FC_NULL_SOURCE, 0, 0, None)
     if type(kernel) is Head:
-        return (FC_HEAD, int(kernel.remaining), 0)
+        return (FC_HEAD, int(kernel.remaining), 0, None)
     if type(kernel) is Copy:
-        return (FC_COPY, 0, 0)
+        return (FC_COPY, 0, 0, None)
     if type(kernel) is CopyRand:
         if int(kernel.max_copy) < 1:
             return None                # let the actor path raise its ValueError
-        return (FC_COPY_RAND, int(kernel.max_copy), int(kernel._seed))
+        return (FC_COPY_RAND, int(kernel.max_copy), int(kernel._seed), None)
     if type(kernel) is NullSink:
         return (FC_NULL_SINK,
-                -1 if kernel.count is None else int(kernel.count), 0)
+                -1 if kernel.count is None else int(kernel.count), 0, None)
+    if type(kernel) is VectorSource:
+        period = len(kernel.items)
+        if period == 0 or int(kernel.repeat) < 0 or kernel._pos or kernel._round:
+            return None                # degenerate/pre-consumed: actor path
+        if period * int(kernel.repeat) >= 2 ** 62:
+            return None                # int64 budget overflow: actor path
+        # data materialized ONCE in run_chain_task — this predicate runs
+        # several times per launch and must not copy the vector
+        return (FC_VEC_SOURCE, period * int(kernel.repeat), period, None)
+    if type(kernel) is VectorSink:
+        if kernel._chunks:
+            return None                # already holds data: actor path
+        return (FC_VEC_SINK, -1, 0, None)   # capacity bound resolved per chain
     return None
+
+
+def _chain_bound(chain) -> Optional[int]:
+    """Exact item count a chain's sink receives (None = unbounded): the min of
+    every finite source/Head budget along the pipe (Copy/CopyRand are
+    count-preserving)."""
+    bound = None
+    for k in chain:
+        spec = _native_stage(k)
+        if spec is None:
+            return None
+        kind, p0 = spec[0], spec[1]
+        if kind in (FC_VEC_SOURCE, FC_HEAD):
+            bound = p0 if bound is None else min(bound, p0)
+        elif kind == FC_NULL_SINK and p0 >= 0:
+            bound = p0 if bound is None else min(bound, p0)
+    return bound
 
 
 def find_native_chains(fg) -> List[List[object]]:
@@ -135,8 +170,20 @@ def find_native_chains(fg) -> List[List[object]]:
             if not nxt.stream_outputs:
                 break                                  # reached a sink
             cur = nxt
-        if len(chain) >= 2 and not chain[-1].stream_outputs:
-            chains.append(chain)
+        if len(chain) < 2 or chain[-1].stream_outputs:
+            continue
+        from ..blocks.vector import VectorSink
+        if type(chain[-1]) is VectorSink and _chain_bound(chain) is None:
+            continue                   # unbounded into a collecting sink
+        dtypes = {p.dtype for k in chain
+                  for p in list(k.stream_inputs) + list(k.stream_outputs)
+                  if p.dtype is not None}
+        if len(dtypes) != 1:
+            # heterogeneous OR fully-untyped chain: the sink buffer and the C
+            # item_size must agree on ONE dtype, or the driver would write
+            # item_size-wide items into a differently-sized buffer
+            continue
+        chains.append(chain)
     return chains
 
 
@@ -192,17 +239,48 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
         if msg is None:
             break                       # bare notify = the start signal
 
-    lib = _load()
+    import numpy as np
+
+    def _build_stages():
+        """Everything that can raise (allocation, int64 bounds) — called inside
+        the guarded region below so a failure becomes BlockError, not a
+        silently dead task and a hung supervisor."""
+        lib = _load()
+        n = len(members)
+        # the ONE chain dtype (find_native_chains guarantees exactly one
+        # non-None dtype across the chain's ports): sizes both the C item
+        # width and the sink buffer — deriving them separately corrupted
+        # memory when the sink port was untyped
+        chain_dt = next(p.dtype for b in members
+                        for p in list(b.kernel.stream_inputs)
+                        + list(b.kernel.stream_outputs) if p.dtype is not None)
+        stages = (_FcStage * n)()
+        keepalive = []                 # numpy buffers the C side points into
+        sink_buf = None
+        bound = _chain_bound([b.kernel for b in members])
+        for i, b in enumerate(members):
+            kind, p0, p1, data = _native_stage(b.kernel)
+            if kind == FC_VEC_SOURCE:
+                data = np.ascontiguousarray(b.kernel.items)
+            elif kind == FC_VEC_SINK:
+                sink_buf = np.empty(int(bound), dtype=chain_dt)
+                data, p0 = sink_buf, int(bound)
+            ptr = None
+            if data is not None:
+                keepalive.append(data)
+                ptr = data.ctypes.data_as(ctypes.c_void_p)
+            stages[i] = _FcStage(kind, 0, p0, p1, ptr)
+        return lib, stages, keepalive, sink_buf, int(chain_dt.itemsize)
+
+    try:
+        lib, stages, keepalive, sink_buf, item_size = _build_stages()
+    except Exception as e:                              # noqa: BLE001
+        log.error("fastchain stage build failed (%r)", e)
+        fg_inbox.send(BlockErrorMsg(members[0].id, e))
+        for b in members[1:]:
+            fg_inbox.send(BlockDoneMsg(b.id, b))
+        return
     n = len(members)
-    stages = (_FcStage * n)()
-    for i, b in enumerate(members):
-        kind, p0, p1 = _native_stage(b.kernel)
-        stages[i] = _FcStage(kind, 0, p0, p1)
-    item_size = 1
-    for b in members:
-        for p in b.kernel.stream_outputs:
-            if p.dtype is not None:
-                item_size = max(item_size, int(p.dtype.itemsize))
     per_stage = (ctypes.c_int64 * n)()
     per_calls = (ctypes.c_int64 * n)()
     stop = ctypes.c_int32(0)
@@ -271,4 +349,7 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
     # ---- final counter sync (the live bridge stays installed) ----------------
     for r in refreshers:
         r()
+    if sink_buf is not None:
+        members[-1].kernel._chunks = [sink_buf[:int(per_stage[n - 1])]]
+    del keepalive
     _finish_all()

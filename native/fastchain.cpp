@@ -33,6 +33,8 @@ enum {
     FC_COPY = 2,          // forward everything
     FC_COPY_RAND = 3,     // p0 = max_copy (forward 1..=max_copy per pass), p1 = seed
     FC_NULL_SINK = 4,     // consume; p0 = count to finish after (-1 = until EOS)
+    FC_VEC_SOURCE = 5,    // emit data cyclically: p0 = total items, p1 = period
+    FC_VEC_SINK = 6,      // collect into data: p0 = capacity (exact bound)
 };
 
 struct FcStage {
@@ -40,6 +42,7 @@ struct FcStage {
     int32_t _pad;
     int64_t p0;
     int64_t p1;
+    uint8_t* data;        // FC_VEC_SOURCE: items to emit; FC_VEC_SINK: out buf
 };
 
 }  // extern "C"
@@ -65,20 +68,29 @@ inline uint64_t xs(uint64_t& s) {
     return s * 0x2545F4914F6CDD1DULL;
 }
 
-// copy k items from src ring tail to dst ring head, handling both wraps
-inline void ring_copy(Ring& src, Ring& dst, int64_t k, int64_t isz) {
+// copy k items between buffers; a cap of 0 means LINEAR (no wrap), nonzero
+// means ring with that capacity. The single audited wrap-splitting loop for
+// ring->ring (inter-stage), vec->ring (source) and ring->vec (sink) paths.
+inline void span_copy(const uint8_t* sb, int64_t scap, int64_t& si,
+                      uint8_t* db, int64_t dcap, int64_t& di,
+                      int64_t k, int64_t isz) {
     while (k > 0) {
-        int64_t s_off = src.tail % src.cap;
-        int64_t d_off = dst.head % dst.cap;
+        int64_t s_off = scap ? si % scap : si;
+        int64_t d_off = dcap ? di % dcap : di;
         int64_t c = k;
-        if (src.cap - s_off < c) c = src.cap - s_off;
-        if (dst.cap - d_off < c) c = dst.cap - d_off;
-        std::memcpy(dst.buf + d_off * isz, src.buf + s_off * isz,
+        if (scap && scap - s_off < c) c = scap - s_off;
+        if (dcap && dcap - d_off < c) c = dcap - d_off;
+        std::memcpy(db + d_off * isz, sb + s_off * isz,
                     static_cast<size_t>(c * isz));
-        src.tail += c;
-        dst.head += c;
+        si += c;
+        di += c;
         k -= c;
     }
+}
+
+inline void ring_copy(Ring& src, Ring& dst, int64_t k, int64_t isz) {
+    span_copy(reinterpret_cast<const uint8_t*>(src.buf), src.cap, src.tail,
+              reinterpret_cast<uint8_t*>(dst.buf), dst.cap, dst.head, k, isz);
 }
 
 }  // namespace
@@ -94,11 +106,18 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
                            int64_t ring_items, volatile int32_t* stop,
                            int64_t* per_stage_out, int64_t* per_stage_calls) {
     if (n < 2 || item_size <= 0 || ring_items <= 0) return -1;
-    for (int i = 0; i < n; ++i)
+    for (int i = 0; i < n; ++i) {
         if (st[i].kind == FC_COPY_RAND && st[i].p0 <= 0)
             return -1;                   // modulo-by-zero guard (max_copy >= 1)
-    if (st[0].kind != FC_NULL_SOURCE) return -1;
-    if (st[n - 1].kind != FC_NULL_SINK) return -1;
+        if (st[i].kind == FC_VEC_SOURCE &&
+            (st[i].p1 <= 0 || st[i].data == nullptr))
+            return -1;                   // empty/unbacked source
+        if (st[i].kind == FC_VEC_SINK && st[i].data == nullptr)
+            return -1;
+    }
+    if (st[0].kind != FC_NULL_SOURCE && st[0].kind != FC_VEC_SOURCE) return -1;
+    if (st[n - 1].kind != FC_NULL_SINK && st[n - 1].kind != FC_VEC_SINK)
+        return -1;
     for (int i = 1; i + 1 < n; ++i)
         if (st[i].kind != FC_HEAD && st[i].kind != FC_COPY &&
             st[i].kind != FC_COPY_RAND)
@@ -120,12 +139,14 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
     std::vector<int64_t> head_left(n, -1);   // FC_HEAD remaining budget
     std::vector<uint64_t> rng(n, 0);
     std::vector<bool> done(n, false);
+    int64_t src_emitted = 0;                 // FC_VEC_SOURCE progress (stage 0)
     for (int i = 0; i < n; ++i) {
         if (st[i].kind == FC_HEAD) head_left[i] = st[i].p0;
         if (st[i].kind == FC_COPY_RAND)
             rng[i] = static_cast<uint64_t>(st[i].p1) * 0x9E3779B97F4A7C15ULL + 1;
     }
-    int64_t sink_count = st[n - 1].p0;       // -1 = until EOS
+    int64_t sink_count =
+        (st[n - 1].kind == FC_VEC_SINK) ? -1 : st[n - 1].p0;  // -1 = until EOS
     int64_t sink_items = 0;
 
     // relaxed atomic load: the flag is written from a Python thread; plain
@@ -136,6 +157,21 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
             if (done[i]) continue;
             if (i == 0) {
                 Ring& out = rings[0];
+                if (st[0].kind == FC_VEC_SOURCE) {
+                    int64_t k = out.space();
+                    if (st[0].p0 - src_emitted < k) k = st[0].p0 - src_emitted;
+                    if (k > 0) {
+                        // source data is a RING of period p1 (cyclic repeat)
+                        span_copy(st[0].data, st[0].p1, src_emitted,
+                                  reinterpret_cast<uint8_t*>(out.buf), out.cap,
+                                  out.head, k, item_size);
+                        progress = true;
+                        if (per_stage_out) per_stage_out[0] += k;
+                        if (per_stage_calls) per_stage_calls[0] += 1;
+                    }
+                    if (src_emitted >= st[0].p0) { out.eos = true; done[0] = true; }
+                    continue;
+                }
                 int64_t k = out.space();
                 if (k > 0) {
                     out.head += k;                    // zeros pre-filled
@@ -148,6 +184,22 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
             Ring& in = rings[i - 1];
             if (i == n - 1) {
                 int64_t k = in.count();
+                if (st[i].kind == FC_VEC_SINK) {
+                    if (sink_items + k > st[i].p0) {
+                        for (auto& r : rings) std::free(r.buf);
+                        return -2;        // capacity bound violated (bug)
+                    }
+                    span_copy(reinterpret_cast<const uint8_t*>(in.buf),
+                              in.cap, in.tail, st[i].data, 0, sink_items,
+                              k, item_size);
+                    if (k > 0) {
+                        progress = true;
+                        if (per_stage_out) per_stage_out[i] += k;
+                        if (per_stage_calls) per_stage_calls[i] += 1;
+                    }
+                    if (in.eos && in.count() == 0) done[i] = true;
+                    continue;
+                }
                 if (sink_count >= 0 && sink_items + k > sink_count)
                     k = sink_count - sink_items;
                 if (k > 0) {

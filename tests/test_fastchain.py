@@ -78,15 +78,46 @@ def test_not_fused_with_message_edge_or_tap():
     del probe
 
 
-def test_not_fused_when_sink_is_python_block():
+def test_vector_endpoints_fuse_with_exact_data():
+    """VectorSource/VectorSink are native-capable: a real data pipe fuses and
+    the collected samples are BIT-exact — the data-integrity check the Null
+    chains cannot provide."""
+    from futuresdr_tpu.blocks import VectorSink, VectorSource
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal(50_000).astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data, repeat=2)
+    cp = CopyRand(np.float32, max_copy=512, seed=9)
+    vs = VectorSink(np.float32)
+    fg.connect(src, cp, vs)
+    assert len(find_native_chains(fg)) == 1
+    Runtime().run(fg)
+    got = vs.items()
+    np.testing.assert_array_equal(got, np.concatenate([data, data]))
+    m = fg.wrapped(vs).metrics()
+    assert m["fused_native"] is True and m["items_in"]["in"] == 100_000
+
+    # a Head mid-chain clamps the collected count exactly
+    fg2 = Flowgraph()
+    src2 = VectorSource(data)
+    h2 = Head(np.float32, 12_345)
+    vs2 = VectorSink(np.float32)
+    fg2.connect(src2, h2, Copy(np.float32), vs2)
+    assert len(find_native_chains(fg2)) == 1
+    Runtime().run(fg2)
+    np.testing.assert_array_equal(vs2.items(), data[:12_345])
+
+
+def test_unbounded_into_vector_sink_not_fused():
+    """NullSource (infinite) into a collecting VectorSink must NOT fuse — the
+    capacity bound would be unbounded."""
     from futuresdr_tpu.blocks import VectorSink
     fg = Flowgraph()
-    src, head = NullSource(np.float32), Head(np.float32, 4096)
+    src, cp = NullSource(np.float32), Copy(np.float32)
     vs = VectorSink(np.float32)
-    fg.connect(src, head, vs)
-    assert find_native_chains(fg) == []    # chain must END at a native sink
-    Runtime().run(fg)
-    assert len(vs.items()) == 4096
+    fg.connect(src, cp, vs)
+    assert find_native_chains(fg) == []
+    # (not run: the python path would stream forever without a Head)
 
 
 def test_terminate_stops_unbounded_fused_chain():
@@ -117,7 +148,24 @@ def test_fused_beside_python_pipe():
     data = np.arange(5000, dtype=np.float32)
     vsrc, vsnk = VectorSource(data), VectorSink(np.float32)
     fg.connect(vsrc, Copy(np.float32), vsnk)
-    assert len(find_native_chains(fg)) == 1
+    assert len(find_native_chains(fg)) == 2    # the vector pipe fuses too now
     Runtime().run(fg)
     assert snk_native.n_received == 20_000
     np.testing.assert_array_equal(vsnk.items(), data)
+
+
+def test_untyped_sink_port_uses_chain_dtype():
+    """Regression (review): the sink buffer must be sized by the CHAIN dtype,
+    not the sink port's own (possibly None) dtype — deriving them separately
+    wrote item_size-wide items into a uint8 buffer (heap corruption)."""
+    from futuresdr_tpu.blocks import VectorSink
+    fg = Flowgraph()
+    src = NullSource(np.float64)
+    head = Head(np.float64, 1000)
+    vs = VectorSink(None)                   # untyped collecting port
+    fg.connect(src, head, vs)
+    assert len(find_native_chains(fg)) == 1
+    Runtime().run(fg)
+    got = vs.items()
+    assert got.dtype == np.float64 and len(got) == 1000
+    assert not got.any()                    # NullSource emits zeros
