@@ -26,6 +26,7 @@ _EXAMPLES = [
     ("modem_ota.py", ["metadata in band", "--callsign", "N0CALL"]),
     ("adsb_rx.py", []),                      # synthesizes its own stream
     ("custom_routes.py", []),                # self-curls its extra REST routes
+    ("file_trx.py", ["rx", "--out", "{tmp}/cap.cs8", "--samples", "50000"]),
     ("sharded_spectrum.py", ["--devices", "2", "--frames", "2",
                              "--frame-size", "16384"]),
 ]
