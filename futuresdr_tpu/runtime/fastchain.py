@@ -1,22 +1,41 @@
-"""Native fast-chain substitution: run whole pipes of trivial stream blocks in C++.
+"""Native fast-chain substitution: run whole pipes of stream blocks in C++.
 
 Reference role: ``src/runtime/scheduler/flow.rs:265-442`` — the reference's
 FlowScheduler exists because per-work-call executor overhead dominates when
-blocks forward tiny chunks (its ``perf/null_rand`` regime). Python's asyncio
-actor loop costs ~10 µs per ``work()`` call there; no amount of scheduling
-fixes that floor. This module takes the reference's answer one step further on
-the runtime side: a maximal LINEAR chain whose members are all native-capable
-(NullSource/Head/Copy/CopyRand/NullSink), with no message ports, taps,
-broadcasts, or inplace edges, is lifted out of the actor plane entirely and
-executed by ``native/fastchain.cpp`` — one C++ thread round-robining the whole
-pipe over plain ring buffers (one pinned flow.rs worker that owns every block
-of the pipe).
+blocks forward tiny chunks (its ``perf/null_rand`` regime, and the north-star
+``perf/fir/fir.rs:49-95`` grid that interleaves CopyRands with 64-tap FIRs).
+Python's asyncio actor loop costs ~10 µs per ``work()`` call there; no amount
+of scheduling fixes that floor. This module takes the reference's answer one
+step further on the runtime side: a maximal LINEAR chain whose members are all
+native-capable (NullSource/Head/Copy/CopyRand/NullSink/VectorSource/VectorSink
+plus the DSP set: plain/decimating Fir over f32/c64 with f32/c64 taps, and
+QuadratureDemod), with no message ports, taps, broadcasts, or inplace edges,
+is lifted out of the actor plane entirely and executed by
+``native/fastchain.cpp`` — one C++ thread round-robining the whole pipe over
+plain ring buffers (one pinned flow.rs worker that owns every block of the
+pipe). Stages carry their own output item size, so dtype-changing members
+(complex FIR → f32 demod) fuse too.
 
 The substitution is transparent to the supervisor protocol: the chain task
 answers the init barrier for each member, watches for Terminate (the native
 loop honors a stop flag), and reports per-member BlockDone with item counters
 filled in, so describe/metrics/REST see the same flowgraph. Opt out with
 ``FSDR_NO_NATIVE=1`` (everything native) or ``FSDR_NO_FASTCHAIN=1`` (just this).
+
+Known divergences from the actor path (documented per the round-4 advisory):
+
+- NullSink with a ``count`` consumes EXACTLY ``count`` items natively; the
+  actor path may overshoot by up to one work window (``n_received > count``).
+- FIR outputs match numpy to float32 rounding (~1e-6 relative), not
+  bit-exactly: the native kernel accumulates taps in ascending order while
+  ``np.convolve`` routes through BLAS dot. Copy-class chains stay bit-exact.
+- CopyRand chunk SIZES come from a different RNG (stress pattern equivalent,
+  per-chunk split not identical); data content is identical either way.
+- After a fused run, kernel-visible state is written back (``Head.remaining``,
+  ``VectorSource._pos/_round``, ``NullSink.n_received``); FIR history and the
+  demod's last-sample carry are NOT (the chain ran to completion — a fused
+  flowgraph is not resumable mid-stream, same as the reference's drained
+  executors).
 """
 
 from __future__ import annotations
@@ -35,13 +54,16 @@ log = logger("runtime.fastchain")
 
 # stage kinds — keep in sync with native/fastchain.cpp
 (FC_NULL_SOURCE, FC_HEAD, FC_COPY, FC_COPY_RAND, FC_NULL_SINK,
- FC_VEC_SOURCE, FC_VEC_SINK) = range(7)
+ FC_VEC_SOURCE, FC_VEC_SINK, FC_FIR_FF, FC_FIR_CF, FC_FIR_CC,
+ FC_QUAD_DEMOD) = range(11)
+
+_FIR_KINDS = (FC_FIR_FF, FC_FIR_CF, FC_FIR_CC)
 
 
 class _FcStage(ctypes.Structure):
-    _fields_ = [("kind", ctypes.c_int32), ("_pad", ctypes.c_int32),
+    _fields_ = [("kind", ctypes.c_int32), ("isz_out", ctypes.c_int32),
                 ("p0", ctypes.c_int64), ("p1", ctypes.c_int64),
-                ("data", ctypes.c_void_p)]
+                ("f0", ctypes.c_double), ("data", ctypes.c_void_p)]
 
 
 _lib = None
@@ -54,11 +76,23 @@ def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("FSDR_NO_FASTCHAIN"):
         return None
     from .buffer.circular import probe_native
+    # v2 symbol: struct layout changed (per-stage item sizes, float param) —
+    # a stale .so simply lacks the symbol and the chain path degrades to the
+    # actor loop instead of driving the old ABI with the new struct. The abi
+    # probe is checked too, so the NEXT struct change only has to bump the
+    # version constant for stale-library protection to hold.
     lib = probe_native(
-        "fsdr_fastchain_run", ctypes.c_int64,
+        "fsdr_fastchain_run_v2", ctypes.c_int64,
         [ctypes.POINTER(_FcStage), ctypes.c_int32, ctypes.c_int64,
-         ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
          ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)])
+    if lib is not None:
+        try:
+            lib.fsdr_fastchain_abi.restype = ctypes.c_int64
+            if lib.fsdr_fastchain_abi() != 2:
+                lib = None
+        except AttributeError:
+            lib = None
     _lib = lib
     return lib
 
@@ -68,30 +102,35 @@ def fastchain_available() -> bool:
 
 
 def _native_stage(kernel) -> Optional[tuple]:
-    """(kind, p0, p1, data|None) for natively runnable kernels; None otherwise.
+    """(kind, p0, p1, f0, data|None) for natively runnable kernels; None
+    otherwise.
 
     Central registry rather than per-class methods: the chain driver owns the
     exact semantics it re-implements, so a behavioral change to one of these
     blocks must be mirrored HERE or the kernel dropped from the registry."""
     import numpy as np
 
+    from ..blocks.dsp import Fir, QuadratureDemod
     from ..blocks.stream import Copy, Head
     from ..blocks.vector import CopyRand, NullSink, NullSource, VectorSink, \
         VectorSource
+    from ..dsp.kernels import DecimatingFirFilter, FirFilter
 
     if type(kernel) is NullSource:
-        return (FC_NULL_SOURCE, 0, 0, None)
+        return (FC_NULL_SOURCE, 0, 0, 0.0, None)
     if type(kernel) is Head:
-        return (FC_HEAD, int(kernel.remaining), 0, None)
+        return (FC_HEAD, int(kernel.remaining), 0, 0.0, None)
     if type(kernel) is Copy:
-        return (FC_COPY, 0, 0, None)
+        return (FC_COPY, 0, 0, 0.0, None)
     if type(kernel) is CopyRand:
         if int(kernel.max_copy) < 1:
             return None                # let the actor path raise its ValueError
-        return (FC_COPY_RAND, int(kernel.max_copy), int(kernel._seed), None)
+        return (FC_COPY_RAND, int(kernel.max_copy), int(kernel._seed), 0.0,
+                None)
     if type(kernel) is NullSink:
         return (FC_NULL_SINK,
-                -1 if kernel.count is None else int(kernel.count), 0, None)
+                -1 if kernel.count is None else int(kernel.count), 0, 0.0,
+                None)
     if type(kernel) is VectorSource:
         period = len(kernel.items)
         if period == 0 or int(kernel.repeat) < 0 or kernel._pos or kernel._round:
@@ -100,28 +139,69 @@ def _native_stage(kernel) -> Optional[tuple]:
             return None                # int64 budget overflow: actor path
         # data materialized ONCE in run_chain_task — this predicate runs
         # several times per launch and must not copy the vector
-        return (FC_VEC_SOURCE, period * int(kernel.repeat), period, None)
+        return (FC_VEC_SOURCE, period * int(kernel.repeat), period, 0.0, None)
     if type(kernel) is VectorSink:
         if kernel._chunks:
             return None                # already holds data: actor path
-        return (FC_VEC_SINK, -1, 0, None)   # capacity bound resolved per chain
+        return (FC_VEC_SINK, -1, 0, 0.0, None)  # capacity bound resolved per chain
+    if type(kernel) is Fir:
+        core = kernel.core
+        if isinstance(core, DecimatingFirFilter):
+            if core.fir._hist is not None or core._phase != 0:
+                return None            # mid-stream state: actor path
+            taps, decim = core.fir.taps, int(core.decim)
+        elif isinstance(core, FirFilter):
+            if core._hist is not None:
+                return None
+            taps, decim = core.taps, 1
+        else:
+            return None                # polyphase resampler: actor path
+        port_dt = kernel.input.dtype
+        if port_dt == np.float32 and taps.dtype == np.float32:
+            kind = FC_FIR_FF
+        elif port_dt == np.complex64 and taps.dtype == np.float32:
+            kind = FC_FIR_CF
+        elif port_dt == np.complex64 and taps.dtype == np.complex64:
+            kind = FC_FIR_CC
+        else:
+            return None                # f64 taps compute in f64 on the actor
+        if not (1 <= len(taps) <= 1 << 14):
+            return None
+        # linear-phase (palindromic, even-length) f32 taps take the folded
+        # kernel: half the multiplies, and the fold's ADDs issue beside the
+        # FMAs — bit 32 of p1 flags it (low word stays the decimation)
+        sym = (kind in (FC_FIR_FF, FC_FIR_CF) and len(taps) % 2 == 0
+               and np.array_equal(taps, taps[::-1]))
+        return (kind, len(taps), decim | (int(sym) << 32), 0.0, taps)
+    if type(kernel) is QuadratureDemod:
+        if complex(kernel._last) != 1.0:
+            return None                # mid-stream carry: actor path
+        return (FC_QUAD_DEMOD, 0, 0, float(kernel.gain), None)
     return None
 
 
-def _chain_bound(chain) -> Optional[int]:
-    """Exact item count a chain's sink receives (None = unbounded): the min of
-    every finite source/Head budget along the pipe (Copy/CopyRand are
-    count-preserving)."""
+def _sink_bound(chain) -> Optional[int]:
+    """Exact item count a chain's sink receives (None = unbounded): walk the
+    pipe in order, capping at every finite source/Head/sink budget and
+    applying each stage's rate transform (Copy/CopyRand/plain-FIR/demod are
+    count-preserving; a decimating FIR with fresh phase yields ceil(n/decim),
+    chunk-invariantly — `dsp/kernels.py:70-81`)."""
     bound = None
     for k in chain:
         spec = _native_stage(k)
         if spec is None:
             return None
-        kind, p0 = spec[0], spec[1]
-        if kind in (FC_VEC_SOURCE, FC_HEAD):
+        kind, p0, p1 = spec[0], spec[1], spec[2]
+        if kind == FC_VEC_SOURCE:
+            bound = p0
+        elif kind == FC_HEAD:
             bound = p0 if bound is None else min(bound, p0)
         elif kind == FC_NULL_SINK and p0 >= 0:
             bound = p0 if bound is None else min(bound, p0)
+        elif kind in _FIR_KINDS and bound is not None:
+            decim = p1 & 0xFFFFFFFF          # high bits carry the sym flag
+            if decim > 1:
+                bound = -(-bound // decim)
     return bound
 
 
@@ -173,18 +253,43 @@ def find_native_chains(fg) -> List[List[object]]:
         if len(chain) < 2 or chain[-1].stream_outputs:
             continue
         from ..blocks.vector import VectorSink
-        if type(chain[-1]) is VectorSink and _chain_bound(chain) is None:
+        if type(chain[-1]) is VectorSink and _sink_bound(chain) is None:
             continue                   # unbounded into a collecting sink
-        dtypes = {p.dtype for k in chain
-                  for p in list(k.stream_inputs) + list(k.stream_outputs)
-                  if p.dtype is not None}
-        if len(dtypes) != 1:
-            # heterogeneous OR fully-untyped chain: the sink buffer and the C
-            # item_size must agree on ONE dtype, or the driver would write
-            # item_size-wide items into a differently-sized buffer
-            continue
+        if _edge_dtypes(chain) is None:
+            continue                   # an edge's item width is unresolvable
         chains.append(chain)
     return chains
+
+
+def _edge_dtypes(chain) -> Optional[list]:
+    """Resolve the ONE dtype of every inter-stage edge (len(chain)-1 entries).
+
+    Each edge takes the src output port's dtype or, if untyped, the dst input
+    port's; an edge where both are set but disagree, or neither is set, makes
+    the chain ineligible (the C ring's item width would be a guess). Per-edge
+    widths are what let dtype-changing stages (c64 FIR → f32 demod) fuse —
+    the v1 driver required one dtype chain-wide."""
+    out = []
+    for a, b in zip(chain[:-1], chain[1:]):
+        src = a.stream_outputs[0].dtype if a.stream_outputs else None
+        dst = b.stream_inputs[0].dtype if b.stream_inputs else None
+        if src is not None and dst is not None and src != dst:
+            return None
+        dt = src if src is not None else dst
+        if dt is None:
+            return None
+        out.append(dt)
+    # item-width conservation through width-preserving stages: an UNTYPED
+    # pass-through (Copy(None)) between a c64 edge and an f32 edge would
+    # otherwise fuse and make the C driver memcpy 8-byte items into a 4-byte
+    # ring (heap overflow, caught by review + ASan). Only stages whose kind
+    # legitimately changes the item width (quad demod) may differ.
+    for i, k in enumerate(chain[1:-1], start=1):
+        spec = _native_stage(k)
+        if spec is not None and spec[0] != FC_QUAD_DEMOD \
+                and out[i - 1].itemsize != out[i].itemsize:
+            return None
+    return out
 
 
 async def run_chain_task(members: Sequence, fg_inbox, scheduler,
@@ -247,33 +352,35 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
         silently dead task and a hung supervisor."""
         lib = _load()
         n = len(members)
-        # the ONE chain dtype (find_native_chains guarantees exactly one
-        # non-None dtype across the chain's ports): sizes both the C item
-        # width and the sink buffer — deriving them separately corrupted
-        # memory when the sink port was untyped
-        chain_dt = next(p.dtype for b in members
-                        for p in list(b.kernel.stream_inputs)
-                        + list(b.kernel.stream_outputs) if p.dtype is not None)
+        kernels = [b.kernel for b in members]
+        # per-edge dtypes (find_native_chains guarantees resolvability): edge
+        # i sizes stage i's output ring; the LAST edge sizes the sink buffer —
+        # deriving them separately corrupted memory when the sink port was
+        # untyped
+        edges = _edge_dtypes(kernels)
         stages = (_FcStage * n)()
         keepalive = []                 # numpy buffers the C side points into
         sink_buf = None
-        bound = _chain_bound([b.kernel for b in members])
+        bound = _sink_bound(kernels)
         for i, b in enumerate(members):
-            kind, p0, p1, data = _native_stage(b.kernel)
+            kind, p0, p1, f0, data = _native_stage(b.kernel)
             if kind == FC_VEC_SOURCE:
                 data = np.ascontiguousarray(b.kernel.items)
             elif kind == FC_VEC_SINK:
-                sink_buf = np.empty(int(bound), dtype=chain_dt)
+                sink_buf = np.empty(int(bound), dtype=edges[-1])
                 data, p0 = sink_buf, int(bound)
+            elif kind in _FIR_KINDS:
+                data = np.ascontiguousarray(data)   # taps
             ptr = None
             if data is not None:
                 keepalive.append(data)
                 ptr = data.ctypes.data_as(ctypes.c_void_p)
-            stages[i] = _FcStage(kind, 0, p0, p1, ptr)
-        return lib, stages, keepalive, sink_buf, int(chain_dt.itemsize)
+            isz = int(edges[i].itemsize if i < n - 1 else edges[-1].itemsize)
+            stages[i] = _FcStage(kind, isz, p0, p1, f0, ptr)
+        return lib, stages, keepalive, sink_buf
 
     try:
-        lib, stages, keepalive, sink_buf, item_size = _build_stages()
+        lib, stages, keepalive, sink_buf = _build_stages()
     except Exception as e:                              # noqa: BLE001
         log.error("fastchain stage build failed (%r)", e)
         fg_inbox.send(BlockErrorMsg(members[0].id, e))
@@ -281,26 +388,28 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
             fg_inbox.send(BlockDoneMsg(b.id, b))
         return
     n = len(members)
-    per_stage = (ctypes.c_int64 * n)()
+    per_in = (ctypes.c_int64 * n)()
+    per_out = (ctypes.c_int64 * n)()
     per_calls = (ctypes.c_int64 * n)()
     stop = ctypes.c_int32(0)
 
     # live metrics bridge: the native driver updates the shared counter arrays
     # DURING the run, so /metrics/ and handle.metrics() observe a fused chain
-    # in flight exactly like actor-run blocks (work_calls = chunks moved)
+    # in flight exactly like actor-run blocks (work_calls = chunks moved);
+    # consumed/produced are tracked separately so rate-changing stages
+    # (decimating FIR) report honest per-port counts
     def _bridge(i, b):
         k = b.kernel
         base_extra = getattr(k, "extra_metrics", None)
 
         def refresh():
             b.work_calls = int(per_calls[i])
-            moved = int(per_stage[i])
             for p in k.stream_outputs:
-                p.items_produced = moved
+                p.items_produced = int(per_out[i])
             for p in k.stream_inputs:
-                p.items_consumed = moved
+                p.items_consumed = int(per_in[i])
             if hasattr(k, "n_received") and k.stream_inputs:
-                k.n_received = moved               # NullSink contract
+                k.n_received = int(per_in[i])       # NullSink contract
         k.extra_metrics = lambda: (refresh() or dict(
             (base_extra() if callable(base_extra) else {}), fused_native=True))
         return refresh
@@ -328,9 +437,9 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
 
     try:
         rc = await scheduler.spawn_blocking(
-            lambda: lib.fsdr_fastchain_run(stages, n, item_size, ring_items,
-                                           ctypes.byref(stop), per_stage,
-                                           per_calls))
+            lambda: lib.fsdr_fastchain_run_v2(stages, n, ring_items,
+                                              ctypes.byref(stop), per_in,
+                                              per_out, per_calls))
     except Exception as e:                              # noqa: BLE001
         _cancel_watchers()
         log.error("fastchain failed (%r)", e)
@@ -349,7 +458,17 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
     # ---- final counter sync (the live bridge stays installed) ----------------
     for r in refreshers:
         r()
+    # kernel-state write-back: post-run attribute reads (Head.remaining,
+    # VectorSource position) match what the actor path would have left behind
+    from ..blocks.stream import Head
+    from ..blocks.vector import VectorSource
+    for i, b in enumerate(members):
+        k = b.kernel
+        if type(k) is Head:
+            k.remaining = max(0, int(k.remaining) - int(per_out[i]))
+        elif type(k) is VectorSource and len(k.items):
+            k._round, k._pos = divmod(int(per_out[i]), len(k.items))
     if sink_buf is not None:
-        members[-1].kernel._chunks = [sink_buf[:int(per_stage[n - 1])]]
+        members[-1].kernel._chunks = [sink_buf[:int(per_in[n - 1])]]
     del keepalive
     _finish_all()

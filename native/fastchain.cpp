@@ -1,28 +1,41 @@
-// fastchain: single-threaded round-robin executor for linear chains of trivial
-// stream blocks — the native work-loop driver for the small-chunk regime.
+// fastchain: single-threaded round-robin executor for linear chains of stream
+// blocks — the native work-loop driver for the small-chunk regime, now with
+// real DSP stages (FIR with carried history + decimation, quadrature demod).
 //
 // Reference role: src/runtime/scheduler/flow.rs:265-442 — the reference's
 // FlowScheduler runs pinned workers with LOCAL run queues precisely because
 // per-work-call executor overhead dominates when blocks forward tiny chunks
-// (perf/null_rand: 512-item CopyRand chains). Python's asyncio actor loop costs
-// ~10 us per work() call in that regime; this driver runs a WHOLE pipe
-// (source → head → copies → sink) inside one C++ thread with plain ring
-// buffers between stages (single-threaded: no atomics, no wakeups — the
-// round-robin IS the schedule, like one pinned flow.rs worker that owns every
-// block of the pipe).
+// (perf/null_rand: 512-item CopyRand chains) — and its north-star perf grid
+// (perf/fir/fir.rs:49-95) interleaves those CopyRands with 64-tap FIRs.
+// Python's asyncio actor loop costs ~10 us per work() call in that regime;
+// this driver runs a WHOLE pipe (source → head → copyrands/firs/demod → sink)
+// inside one C++ thread with plain ring buffers between stages
+// (single-threaded: no atomics, no wakeups — the round-robin IS the schedule,
+// like one pinned flow.rs worker that owns every block of the pipe).
+//
+// v2 protocol: stages carry their OWN output item size (isz_out), so
+// rate/dtype-changing stages (complex FIR → f32 demod) fuse too. Stateful
+// stages carry their state across chunks exactly like the Python cores
+// (dsp/kernels.py FirFilter/DecimatingFirFilter, blocks/dsp.py
+// QuadratureDemod): FIR history is nt-1 zero-initialized items, decimation
+// phase is chunk-invariant, demod seeds last=1+0j. Numeric note: FIR
+// accumulation order differs from numpy's np.convolve (BLAS dot), so outputs
+// match to float32 rounding (~1e-6 relative), not bit-exactly — the A/B
+// tests use allclose for FIR/demod chains and exact equality for copy chains.
 //
 // The Python runtime substitutes eligible chains at launch
-// (futuresdr_tpu/runtime/fastchain.py): whole pipes whose members are all
-// native-capable, with no message ports, taps, or broadcasts. Data content
-// matches the Python path (zeros from NullSource, byte-wise copies); CopyRand
-// chunk SIZES come from a different RNG than numpy's — the stress pattern is
-// equivalent, the per-chunk split is not bit-identical (documented in
-// perf/null_rand.py).
+// (futuresdr_tpu/runtime/fastchain.py). Opt out with FSDR_NO_NATIVE=1 or
+// FSDR_NO_FASTCHAIN=1.
 
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <vector>
+
+#ifdef __AVX512F__
+#include <immintrin.h>
+#endif
 
 extern "C" {
 
@@ -35,14 +48,19 @@ enum {
     FC_NULL_SINK = 4,     // consume; p0 = count to finish after (-1 = until EOS)
     FC_VEC_SOURCE = 5,    // emit data cyclically: p0 = total items, p1 = period
     FC_VEC_SINK = 6,      // collect into data: p0 = capacity (exact bound)
+    FC_FIR_FF = 7,        // f32 FIR, f32 taps: p0 = ntaps, p1 = decim, data = taps
+    FC_FIR_CF = 8,        // c64 FIR, f32 taps: p0 = ntaps, p1 = decim, data = taps
+    FC_FIR_CC = 9,        // c64 FIR, c64 taps: p0 = ntaps, p1 = decim, data = taps
+    FC_QUAD_DEMOD = 10,   // c64 → f32: f0 = gain; y = gain*arg(x[n]*conj(x[n-1]))
 };
 
 struct FcStage {
     int32_t kind;
-    int32_t _pad;
+    int32_t isz_out;      // bytes per item on this stage's OUTPUT (sink: on input)
     int64_t p0;
     int64_t p1;
-    uint8_t* data;        // FC_VEC_SOURCE: items to emit; FC_VEC_SINK: out buf
+    double f0;            // float parameter (FC_QUAD_DEMOD: gain)
+    uint8_t* data;        // vec data / taps / sink out buf
 };
 
 }  // extern "C"
@@ -52,6 +70,7 @@ namespace {
 struct Ring {
     char* buf = nullptr;
     int64_t cap = 0;       // items
+    int64_t isz = 0;       // bytes per item
     int64_t head = 0;      // write index (items, not wrapped)
     int64_t tail = 0;      // read index
     bool eos = false;
@@ -70,7 +89,8 @@ inline uint64_t xs(uint64_t& s) {
 
 // copy k items between buffers; a cap of 0 means LINEAR (no wrap), nonzero
 // means ring with that capacity. The single audited wrap-splitting loop for
-// ring->ring (inter-stage), vec->ring (source) and ring->vec (sink) paths.
+// ring->ring (inter-stage), vec->ring (source), ring->linear (FIR gather) and
+// linear->ring (FIR scatter) paths.
 inline void span_copy(const uint8_t* sb, int64_t scap, int64_t& si,
                       uint8_t* db, int64_t dcap, int64_t& di,
                       int64_t k, int64_t isz) {
@@ -88,25 +108,162 @@ inline void span_copy(const uint8_t* sb, int64_t scap, int64_t& si,
     }
 }
 
-inline void ring_copy(Ring& src, Ring& dst, int64_t k, int64_t isz) {
+inline void ring_copy(Ring& src, Ring& dst, int64_t k) {
     span_copy(reinterpret_cast<const uint8_t*>(src.buf), src.cap, src.tail,
-              reinterpret_cast<uint8_t*>(dst.buf), dst.cap, dst.head, k, isz);
+              reinterpret_cast<uint8_t*>(dst.buf), dst.cap, dst.head, k,
+              src.isz);
 }
+
+// ---- FIR compute kernels ----------------------------------------------------
+//
+// Layout trick that makes every variant a pure float saxpy the compiler
+// auto-vectorizes WITHOUT -ffast-math: outer loop over taps, inner loop over
+// outputs (independent accumulations — no float reduction reordering needed),
+// blocked so the accumulator tile and its input window stay in L1. A
+// complex64 stream with real taps is the SAME kernel on the interleaved float
+// view with the tap offset doubled.
+
+constexpr int64_t FIR_BLK = 1024;   // floats per accumulator tile (4 KiB)
+
+// y[j] = sum_t taps[t] * x[j - t*stride], j in [0, n) — x may be read back to
+// x[-(nt-1)*stride] (history prefix guaranteed by the caller).
+//
+// Tap-unrolled 8-wide: one accumulator load/store services 8 FMAs instead of
+// 1, lifting the loop from load/store-bound (~3 memory ops per FMA) to
+// FMA-bound. The per-output accumulation ORDER stays ascending-t — the 8 adds
+// are sequential on the same lane — so results are bit-identical to the
+// straight loop.
+inline void fir_real_taps(const float* x, const float* taps, int64_t nt,
+                          int64_t stride, float* y, int64_t n) {
+    float acc[FIR_BLK];
+    for (int64_t j0 = 0; j0 < n; j0 += FIR_BLK) {
+        int64_t jb = n - j0 < FIR_BLK ? n - j0 : FIR_BLK;
+        std::memset(acc, 0, static_cast<size_t>(jb) * sizeof(float));
+        int64_t t = 0;
+        for (; t + 8 <= nt; t += 8) {
+            const float c0 = taps[t], c1 = taps[t + 1], c2 = taps[t + 2],
+                        c3 = taps[t + 3], c4 = taps[t + 4], c5 = taps[t + 5],
+                        c6 = taps[t + 6], c7 = taps[t + 7];
+            const float* xs = x + j0 - t * stride;
+            for (int64_t j = 0; j < jb; ++j) {
+                float a = acc[j];
+                a += c0 * xs[j];
+                a += c1 * xs[j - stride];
+                a += c2 * xs[j - 2 * stride];
+                a += c3 * xs[j - 3 * stride];
+                a += c4 * xs[j - 4 * stride];
+                a += c5 * xs[j - 5 * stride];
+                a += c6 * xs[j - 6 * stride];
+                a += c7 * xs[j - 7 * stride];
+                acc[j] = a;
+            }
+        }
+        for (; t < nt; ++t) {
+            const float c = taps[t];
+            const float* xs = x + j0 - t * stride;
+            for (int64_t j = 0; j < jb; ++j) acc[j] += c * xs[j];
+        }
+        std::memcpy(y + j0, acc, static_cast<size_t>(jb) * sizeof(float));
+    }
+}
+
+// Folded symmetric FIR (taps palindromic, nt even): y[f] = Σ_{k<nt/2}
+// taps[k] · (x[f−k·stride] + x[f−(nt−1−k)·stride]) on the float view —
+// halves the multiplies, and the ADD issues on a different port than the FMA,
+// which matters on parts with a single 512-bit FMA unit (this box: folded
+// ~480 Msps vs ~375 straight at 64 taps). Accumulation order: ascending k
+// with the mirror pair pre-added — a third numeric order besides numpy's and
+// the straight kernel's, all within float32 rounding of each other.
+inline void fir_sym(const float* x, const float* taps, int64_t nt,
+                    int64_t stride, float* y, int64_t nf) {
+    const int64_t h = nt / 2;
+    const int64_t Ls = (nt - 1) * stride;
+    int64_t j0 = 0;
+#ifdef __AVX512F__
+    for (; j0 + 64 <= nf; j0 += 64) {
+        __m512 a0 = _mm512_setzero_ps(), a1 = a0, a2 = a0, a3 = a0;
+        for (int64_t k = 0; k < h; ++k) {
+            const float* xa = x + j0 - k * stride;
+            const float* xb = x + j0 - Ls + k * stride;
+            const __m512 c = _mm512_set1_ps(taps[k]);
+            a0 = _mm512_fmadd_ps(
+                c, _mm512_add_ps(_mm512_loadu_ps(xa), _mm512_loadu_ps(xb)), a0);
+            a1 = _mm512_fmadd_ps(
+                c, _mm512_add_ps(_mm512_loadu_ps(xa + 16),
+                                 _mm512_loadu_ps(xb + 16)), a1);
+            a2 = _mm512_fmadd_ps(
+                c, _mm512_add_ps(_mm512_loadu_ps(xa + 32),
+                                 _mm512_loadu_ps(xb + 32)), a2);
+            a3 = _mm512_fmadd_ps(
+                c, _mm512_add_ps(_mm512_loadu_ps(xa + 48),
+                                 _mm512_loadu_ps(xb + 48)), a3);
+        }
+        _mm512_storeu_ps(y + j0, a0);
+        _mm512_storeu_ps(y + j0 + 16, a1);
+        _mm512_storeu_ps(y + j0 + 32, a2);
+        _mm512_storeu_ps(y + j0 + 48, a3);
+    }
+#endif
+    for (; j0 < nf; ++j0) {
+        float s = 0;
+        for (int64_t k = 0; k < h; ++k)
+            s += taps[k] * (x[j0 - k * stride] + x[j0 - Ls + k * stride]);
+        y[j0] = s;
+    }
+}
+
+// complex64 stream, complex64 taps: yr = Σ tr·xr − ti·xi ; yi = Σ tr·xi + ti·xr
+// on the interleaved float view (x/y are float pointers, n complex items).
+inline void fir_cc(const float* x, const float* taps, int64_t nt,
+                   float* y, int64_t n) {
+    float acc[FIR_BLK];                      // interleaved re/im tile
+    const int64_t n2 = 2 * n;
+    for (int64_t j0 = 0; j0 < n2; j0 += FIR_BLK) {
+        int64_t jb = n2 - j0 < FIR_BLK ? n2 - j0 : FIR_BLK;
+        std::memset(acc, 0, static_cast<size_t>(jb) * sizeof(float));
+        for (int64_t t = 0; t < nt; ++t) {
+            const float tr = taps[2 * t], ti = taps[2 * t + 1];
+            const float* xs = x + j0 - 2 * t;
+            // even lanes (re): tr·xr − ti·xi ; odd lanes (im): tr·xi + ti·xr
+            for (int64_t j = 0; j + 1 < jb; j += 2) {
+                acc[j] += tr * xs[j] - ti * xs[j + 1];
+                acc[j + 1] += tr * xs[j + 1] + ti * xs[j];
+            }
+        }
+        std::memcpy(y + j0, acc, static_cast<size_t>(jb) * sizeof(float));
+    }
+}
+
+// Per-stage mutable state for compute stages.
+struct StageState {
+    std::vector<uint8_t> hist;   // FIR: nt-1 items (zero-init = virtual history)
+    std::vector<uint8_t> xbuf;   // FIR: linear gather buffer (hist ++ chunk)
+    std::vector<uint8_t> ybuf;   // FIR/demod: linear output before ring scatter
+    int64_t phase = 0;           // decimation phase (dsp/kernels.py:64 contract)
+    float last_re = 1.0f;        // quad demod x[n-1] seed (blocks/dsp.py:407)
+    float last_im = 0.0f;
+};
 
 }  // namespace
 
 extern "C" {
 
+// ABI version, checked by fastchain.py's _load(): bump on ANY FcStage layout
+// or protocol change so a stale .so can never be driven with a newer struct.
+int64_t fsdr_fastchain_abi(void) { return 2; }
+
 // Run the chain to completion (sink finished) or until *stop becomes nonzero.
-// per_stage_out[i] accumulates items produced (for sinks: consumed) by stage i;
-// per_stage_calls[i] counts chunks moved (the work-call analog). Both arrays
-// are updated DURING the run, so the Python side reads them live for metrics.
-// Returns items the sink consumed, or -1 on malformed input / stall.
-int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
-                           int64_t ring_items, volatile int32_t* stop,
-                           int64_t* per_stage_out, int64_t* per_stage_calls) {
-    if (n < 2 || item_size <= 0 || ring_items <= 0) return -1;
+// per_in[i]/per_out[i] accumulate items consumed/produced by stage i (sources
+// consume 0, sinks produce 0); per_calls[i] counts chunks moved (the
+// work-call analog). All arrays are updated DURING the run, so the Python
+// side reads them live for metrics. Returns items the sink consumed, or -1 on
+// malformed input / stall (-2: sink capacity bound violated).
+int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
+                              volatile int32_t* stop, int64_t* per_in,
+                              int64_t* per_out, int64_t* per_calls) {
+    if (n < 2 || ring_items <= 0) return -1;
     for (int i = 0; i < n; ++i) {
+        if (st[i].isz_out <= 0) return -1;
         if (st[i].kind == FC_COPY_RAND && st[i].p0 <= 0)
             return -1;                   // modulo-by-zero guard (max_copy >= 1)
         if (st[i].kind == FC_VEC_SOURCE &&
@@ -114,21 +271,36 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
             return -1;                   // empty/unbacked source
         if (st[i].kind == FC_VEC_SINK && st[i].data == nullptr)
             return -1;
+        if (st[i].kind >= FC_FIR_FF && st[i].kind <= FC_FIR_CC &&
+            (st[i].p0 < 1 || (st[i].p1 & 0xFFFFFFFFLL) < 1 ||
+             st[i].data == nullptr))
+            return -1;                   // ntaps/decim/taps sanity
     }
     if (st[0].kind != FC_NULL_SOURCE && st[0].kind != FC_VEC_SOURCE) return -1;
     if (st[n - 1].kind != FC_NULL_SINK && st[n - 1].kind != FC_VEC_SINK)
         return -1;
-    for (int i = 1; i + 1 < n; ++i)
-        if (st[i].kind != FC_HEAD && st[i].kind != FC_COPY &&
-            st[i].kind != FC_COPY_RAND)
+    for (int i = 1; i + 1 < n; ++i) {
+        if (st[i].kind < FC_HEAD || st[i].kind > FC_QUAD_DEMOD ||
+            st[i].kind == FC_NULL_SINK || st[i].kind == FC_VEC_SOURCE ||
+            st[i].kind == FC_VEC_SINK)
             return -1;
+        // width conservation: every middle stage except the dtype-changing
+        // demod must see equal in/out item sizes, or ring_copy would write
+        // src-width items into a dst-width ring (defense in depth — the
+        // Python chain finder enforces the same rule)
+        if (st[i].kind != FC_QUAD_DEMOD && st[i - 1].isz_out != st[i].isz_out)
+            return -1;
+    }
 
     std::vector<Ring> rings(n - 1);
-    for (auto& r : rings) {
+    for (int i = 0; i < n - 1; ++i) {
+        Ring& r = rings[i];
+        r.isz = st[i].isz_out;
         // calloc: rings start zeroed, so the zero-producing source can advance
         // indices without writing (same fast path as the Python NullSource)
         r.buf = static_cast<char*>(
-            std::calloc(static_cast<size_t>(ring_items), static_cast<size_t>(item_size)));
+            std::calloc(static_cast<size_t>(ring_items),
+                        static_cast<size_t>(r.isz)));
         if (!r.buf) {
             for (auto& q : rings) std::free(q.buf);
             return -1;
@@ -139,11 +311,24 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
     std::vector<int64_t> head_left(n, -1);   // FC_HEAD remaining budget
     std::vector<uint64_t> rng(n, 0);
     std::vector<bool> done(n, false);
+    std::vector<StageState> ss(n);
     int64_t src_emitted = 0;                 // FC_VEC_SOURCE progress (stage 0)
     for (int i = 0; i < n; ++i) {
         if (st[i].kind == FC_HEAD) head_left[i] = st[i].p0;
         if (st[i].kind == FC_COPY_RAND)
             rng[i] = static_cast<uint64_t>(st[i].p1) * 0x9E3779B97F4A7C15ULL + 1;
+        if (st[i].kind >= FC_FIR_FF && st[i].kind <= FC_FIR_CC) {
+            const int64_t in_isz = rings[i - 1].isz;
+            ss[i].hist.assign(
+                static_cast<size_t>((st[i].p0 - 1) * in_isz), 0);
+            ss[i].xbuf.resize(
+                static_cast<size_t>((st[i].p0 - 1 + ring_items) * in_isz));
+            std::memset(ss[i].xbuf.data(), 0,
+                        static_cast<size_t>((st[i].p0 - 1) * in_isz));
+            ss[i].ybuf.resize(static_cast<size_t>(ring_items * st[i].isz_out));
+        }
+        if (st[i].kind == FC_QUAD_DEMOD)
+            ss[i].ybuf.resize(static_cast<size_t>(ring_items * st[i].isz_out));
     }
     int64_t sink_count =
         (st[n - 1].kind == FC_VEC_SINK) ? -1 : st[n - 1].p0;  // -1 = until EOS
@@ -164,10 +349,10 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
                         // source data is a RING of period p1 (cyclic repeat)
                         span_copy(st[0].data, st[0].p1, src_emitted,
                                   reinterpret_cast<uint8_t*>(out.buf), out.cap,
-                                  out.head, k, item_size);
+                                  out.head, k, out.isz);
                         progress = true;
-                        if (per_stage_out) per_stage_out[0] += k;
-                        if (per_stage_calls) per_stage_calls[0] += 1;
+                        if (per_out) per_out[0] += k;
+                        if (per_calls) per_calls[0] += 1;
                     }
                     if (src_emitted >= st[0].p0) { out.eos = true; done[0] = true; }
                     continue;
@@ -176,8 +361,8 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
                 if (k > 0) {
                     out.head += k;                    // zeros pre-filled
                     progress = true;
-                    if (per_stage_out) per_stage_out[0] += k;
-                    if (per_stage_calls) per_stage_calls[0] += 1;
+                    if (per_out) per_out[0] += k;
+                    if (per_calls) per_calls[0] += 1;
                 }
                 continue;
             }
@@ -191,11 +376,11 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
                     }
                     span_copy(reinterpret_cast<const uint8_t*>(in.buf),
                               in.cap, in.tail, st[i].data, 0, sink_items,
-                              k, item_size);
+                              k, in.isz);
                     if (k > 0) {
                         progress = true;
-                        if (per_stage_out) per_stage_out[i] += k;
-                        if (per_stage_calls) per_stage_calls[i] += 1;
+                        if (per_in) per_in[i] += k;
+                        if (per_calls) per_calls[i] += 1;
                     }
                     if (in.eos && in.count() == 0) done[i] = true;
                     continue;
@@ -206,8 +391,8 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
                     in.tail += k;
                     sink_items += k;
                     progress = true;
-                    if (per_stage_out) per_stage_out[i] += k;
-                    if (per_stage_calls) per_stage_calls[i] += 1;
+                    if (per_in) per_in[i] += k;
+                    if (per_calls) per_calls[i] += 1;
                 }
                 if ((in.eos && in.count() == 0) ||
                     (sink_count >= 0 && sink_items >= sink_count))
@@ -215,6 +400,116 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
                 continue;
             }
             Ring& out = rings[i];
+
+            // ---- compute middle stages -------------------------------------
+            if (st[i].kind >= FC_FIR_FF && st[i].kind <= FC_FIR_CC) {
+                const int64_t nt = st[i].p0;
+                const int64_t decim = st[i].p1 & 0xFFFFFFFFLL;
+                const bool sym = ((st[i].p1 >> 32) & 1) != 0;
+                const int64_t isz_in = in.isz;
+                StageState& s = ss[i];
+                // inputs we may consume so outputs fit: with phase p, n inputs
+                // yield (n > p) ? (n-1-p)/decim + 1 : 0 outputs → n ≤ p + space·decim
+                int64_t k = in.count();
+                int64_t lim = s.phase + out.space() * decim;
+                if (lim < k) k = lim;
+                if (k > 0) {
+                    uint8_t* xb = s.xbuf.data();
+                    // linear gather: [hist | chunk]
+                    std::memcpy(xb, s.hist.data(), s.hist.size());
+                    int64_t xi = nt - 1;
+                    span_copy(reinterpret_cast<const uint8_t*>(in.buf), in.cap,
+                              in.tail, xb, 0, xi, k, isz_in);
+                    const float* x0 = reinterpret_cast<const float*>(
+                        xb + (nt - 1) * isz_in);
+                    float* yb = reinterpret_cast<float*>(s.ybuf.data());
+                    const float* taps =
+                        reinterpret_cast<const float*>(st[i].data);
+                    if (st[i].kind == FC_FIR_FF)
+                        sym ? fir_sym(x0, taps, nt, 1, yb, k)
+                            : fir_real_taps(x0, taps, nt, 1, yb, k);
+                    else if (st[i].kind == FC_FIR_CF)
+                        // interleaved float view: same saxpy, tap offset ×2
+                        sym ? fir_sym(x0, taps, nt, 2, yb, 2 * k)
+                            : fir_real_taps(x0, taps, nt, 2, yb, 2 * k);
+                    else
+                        fir_cc(x0, taps, nt, yb, k);
+                    // decimate y[phase::decim] (dsp/kernels.py:70-81 contract)
+                    int64_t m = (k > s.phase)
+                                    ? (k - 1 - s.phase) / decim + 1 : 0;
+                    if (decim > 1 && m > 0) {
+                        const int64_t osz = st[i].isz_out;
+                        for (int64_t j = 0; j < m; ++j)
+                            std::memmove(s.ybuf.data() + j * osz,
+                                         s.ybuf.data() +
+                                             (s.phase + j * decim) * osz,
+                                         static_cast<size_t>(osz));
+                    }
+                    if (decim > 1) {
+                        if (m > 0) {
+                            int64_t last = s.phase + (m - 1) * decim;
+                            s.phase = last + decim - k;
+                        } else {
+                            s.phase -= k;
+                        }
+                    }
+                    // carry history: last nt-1 items of [hist | chunk]
+                    std::memcpy(s.hist.data(),
+                                xb + (k) * isz_in,   // = (nt-1+k)-(nt-1) items in
+                                s.hist.size());
+                    int64_t yi = 0;
+                    span_copy(s.ybuf.data(), 0, yi,
+                              reinterpret_cast<uint8_t*>(out.buf), out.cap,
+                              out.head, m, st[i].isz_out);
+                    progress = true;
+                    if (per_in) per_in[i] += k;
+                    if (per_out) per_out[i] += m;
+                    if (per_calls) per_calls[i] += 1;
+                }
+                if (in.eos && in.count() == 0) {
+                    out.eos = true;      // history tail dropped, like the actor
+                    done[i] = true;
+                }
+                continue;
+            }
+            if (st[i].kind == FC_QUAD_DEMOD) {
+                StageState& s = ss[i];
+                int64_t k = in.count();
+                if (out.space() < k) k = out.space();
+                if (k > 0) {
+                    const float gain = static_cast<float>(st[i].f0);
+                    float* yb = reinterpret_cast<float*>(s.ybuf.data());
+                    const float* rb = reinterpret_cast<const float*>(in.buf);
+                    float pr = s.last_re, pi = s.last_im;
+                    for (int64_t j = 0; j < k; ++j) {
+                        int64_t off = (in.tail + j) % in.cap;
+                        const float xr = rb[2 * off], xi_ = rb[2 * off + 1];
+                        // x·conj(prev) = (xr·pr + xi·pi) + j(xi·pr − xr·pi)
+                        yb[j] = gain * std::atan2(xi_ * pr - xr * pi,
+                                                  xr * pr + xi_ * pi);
+                        pr = xr;
+                        pi = xi_;
+                    }
+                    s.last_re = pr;
+                    s.last_im = pi;
+                    in.tail += k;
+                    int64_t yi = 0;
+                    span_copy(s.ybuf.data(), 0, yi,
+                              reinterpret_cast<uint8_t*>(out.buf), out.cap,
+                              out.head, k, out.isz);
+                    progress = true;
+                    if (per_in) per_in[i] += k;
+                    if (per_out) per_out[i] += k;
+                    if (per_calls) per_calls[i] += 1;
+                }
+                if (in.eos && in.count() == 0) {
+                    out.eos = true;
+                    done[i] = true;
+                }
+                continue;
+            }
+
+            // ---- copy-class middle stages ----------------------------------
             int64_t k = in.count();
             if (out.space() < k) k = out.space();
             if (st[i].kind == FC_HEAD) {
@@ -225,10 +520,11 @@ int64_t fsdr_fastchain_run(const FcStage* st, int32_t n, int64_t item_size,
                 if (cap < k) k = cap;
             }
             if (k > 0) {
-                ring_copy(in, out, k, item_size);
+                ring_copy(in, out, k);
                 progress = true;
-                if (per_stage_out) per_stage_out[i] += k;
-                if (per_stage_calls) per_stage_calls[i] += 1;
+                if (per_in) per_in[i] += k;
+                if (per_out) per_out[i] += k;
+                if (per_calls) per_calls[i] += 1;
                 if (st[i].kind == FC_HEAD) head_left[i] -= k;
             }
             bool upstream_over = in.eos && in.count() == 0;

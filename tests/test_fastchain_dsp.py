@@ -1,0 +1,180 @@
+"""Native fast-chain v2 DSP stages (`native/fastchain.cpp` FC_FIR_*/FC_QUAD_DEMOD):
+whole pipes containing real filters run as one C++ thread, A/B-checked against
+the Python actor path. FIR outputs match to float32 rounding (the native kernel
+accumulates taps in ascending order; `np.convolve` routes through BLAS), so the
+comparisons use allclose; copy-class chains elsewhere stay bit-exact."""
+
+import os
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import CopyRand, Fir, Head, NullSink, NullSource, \
+    QuadratureDemod, VectorSink, VectorSource
+from futuresdr_tpu.dsp import firdes
+from futuresdr_tpu.runtime.fastchain import fastchain_available, find_native_chains
+
+pytestmark = pytest.mark.skipif(not fastchain_available(),
+                                reason="native fastchain unavailable")
+
+
+def _run_ab(build):
+    """Run `build()`-produced (fg, sink) twice — fused and actor — and return
+    both collected arrays."""
+    fg, vs = build()
+    assert len(find_native_chains(fg)) == 1, "chain did not fuse"
+    Runtime().run(fg)
+    got_native = vs.items().copy()
+    os.environ["FSDR_NO_FASTCHAIN"] = "1"
+    try:
+        fg2, vs2 = build()
+        assert find_native_chains(fg2) == []
+        Runtime().run(fg2)
+    finally:
+        os.environ.pop("FSDR_NO_FASTCHAIN", None)
+    return got_native, vs2.items()
+
+
+def test_fir_chain_matches_actor_path():
+    taps = firdes.lowpass(0.2, 64).astype(np.float32)
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal(30_000).astype(np.float32)
+
+    def build():
+        fg = Flowgraph()
+        src = VectorSource(data)
+        vs = VectorSink(np.float32)
+        fg.connect(src, CopyRand(np.float32, max_copy=777, seed=3),
+                   Fir(taps, np.float32),
+                   CopyRand(np.float32, max_copy=129, seed=5),
+                   Fir(taps, np.float32), vs)
+        return fg, vs
+
+    native, actor = _run_ab(build)
+    assert len(native) == len(actor) == len(data)
+    np.testing.assert_allclose(native, actor, rtol=2e-5, atol=1e-6)
+
+
+def test_decimating_fir_chain_counts_and_values():
+    taps = firdes.lowpass(0.1, 48).astype(np.float32)
+    rng = np.random.default_rng(12)
+    data = rng.standard_normal(10_001).astype(np.float32)   # odd length on purpose
+
+    def build():
+        fg = Flowgraph()
+        vs = VectorSink(np.float32)
+        fg.connect(VectorSource(data), Fir(taps, np.float32, decim=4), vs)
+        return fg, vs
+
+    native, actor = _run_ab(build)
+    assert len(native) == len(actor) == -(-len(data) // 4)   # ceil(n/decim)
+    np.testing.assert_allclose(native, actor, rtol=2e-5, atol=1e-6)
+
+
+def test_complex_fir_quad_demod_fm_chain():
+    """The FM front-end shape: c64 stream → decimating FIR (f32 taps) → quad
+    demod (c64 → f32) — exercises per-edge item sizes across a dtype change."""
+    taps = firdes.lowpass(0.15, 64).astype(np.float32)
+    rng = np.random.default_rng(13)
+    iq = (rng.standard_normal(20_000) + 1j * rng.standard_normal(20_000)) \
+        .astype(np.complex64)
+
+    def build():
+        fg = Flowgraph()
+        vs = VectorSink(np.float32)
+        fg.connect(VectorSource(iq), Fir(taps, np.complex64, decim=2),
+                   QuadratureDemod(gain=0.7), vs)
+        return fg, vs
+
+    native, actor = _run_ab(build)
+    assert len(native) == len(actor) == 10_000
+    # atan2 near small-magnitude arguments amplifies the f32 FIR rounding
+    np.testing.assert_allclose(native, actor, rtol=2e-4, atol=1e-5)
+
+
+def test_complex_taps_xlating_fir():
+    base = firdes.lowpass(0.2, 32).astype(np.float32)
+    taps = (base * np.exp(2j * np.pi * 0.05 * np.arange(32))).astype(np.complex64)
+    rng = np.random.default_rng(14)
+    iq = (rng.standard_normal(8_000) + 1j * rng.standard_normal(8_000)) \
+        .astype(np.complex64)
+
+    def build():
+        fg = Flowgraph()
+        vs = VectorSink(np.complex64)
+        fg.connect(VectorSource(iq), CopyRand(np.complex64, max_copy=333, seed=7),
+                   Fir(taps, np.complex64), vs)
+        return fg, vs
+
+    native, actor = _run_ab(build)
+    np.testing.assert_allclose(native, actor, rtol=3e-5, atol=2e-6)
+
+
+def test_kernel_state_writeback_after_fused_run():
+    """Round-4 advisory: post-run attribute reads must match the actor path —
+    Head.remaining hits 0, VectorSource shows its position consumed."""
+    taps = firdes.lowpass(0.2, 16).astype(np.float32)
+    data = np.arange(4_000, dtype=np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data, repeat=3)
+    head = Head(np.float32, 7_000)
+    snk = NullSink(np.float32)
+    fg.connect(src, head, Fir(taps, np.float32), snk)
+    assert len(find_native_chains(fg)) == 1
+    Runtime().run(fg)
+    assert head.remaining == 0
+    # the source EMITS its full budget into the (64k-item) ring even though the
+    # Head only forwards 7000 — exactly like the actor path, whose 256 KiB
+    # stream buffer also swallows all 12000 before the Head stops consuming
+    assert (src._round, src._pos) == (3, 0)
+    assert snk.n_received == 7_000
+
+
+def test_mid_stream_fir_state_not_eligible():
+    taps = firdes.lowpass(0.2, 16).astype(np.float32)
+    fir = Fir(taps, np.float32)
+    fir.core.process(np.zeros(10, dtype=np.float32))   # leaves history behind
+    fg = Flowgraph()
+    fg.connect(NullSource(np.float32), Head(np.float32, 1000), fir,
+               NullSink(np.float32))
+    assert find_native_chains(fg) == []
+
+
+def test_f64_taps_not_eligible():
+    taps = firdes.lowpass(0.2, 16)                     # float64 by default
+    assert taps.dtype == np.float64
+    fg = Flowgraph()
+    fg.connect(NullSource(np.float32), Head(np.float32, 1000),
+               Fir(taps, np.float32), NullSink(np.float32))
+    assert find_native_chains(fg) == []
+
+
+def test_untyped_passthrough_between_widths_not_fused():
+    """Review regression (heap overflow): an UNTYPED Copy between a c64 edge
+    and an f32 edge must not fuse — the C driver would memcpy 8-byte items
+    into a 4-byte ring."""
+    from futuresdr_tpu.blocks import Copy
+    taps = firdes.lowpass(0.2, 16).astype(np.float32)
+    iq = np.zeros(1000, dtype=np.complex64)
+    fg = Flowgraph()
+    fg.connect(VectorSource(iq), Fir(taps, np.complex64), Copy(None),
+               NullSink(np.float32))
+    assert find_native_chains(fg) == []
+
+
+def test_rate_changing_stage_metrics_are_per_port():
+    """A decimating FIR reports consumed ≠ produced through the live bridge."""
+    taps = firdes.lowpass(0.1, 32).astype(np.float32)
+    fg = Flowgraph()
+    fir = Fir(taps, np.float32, decim=8)
+    snk = NullSink(np.float32)
+    fg.connect(NullSource(np.float32), Head(np.float32, 80_000), fir, snk)
+    assert len(find_native_chains(fg)) == 1
+    Runtime().run(fg)
+    w = fg.wrapped(fir)
+    m = w.metrics()
+    assert m["fused_native"] is True
+    assert m["items_in"]["in"] == 80_000
+    assert m["items_out"]["out"] == 10_000
+    assert snk.n_received == 10_000
