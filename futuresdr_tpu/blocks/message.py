@@ -44,7 +44,8 @@ class MessageAnnotator(Kernel):
         self.add_message_output("out")
 
     @message_handler(name="in")
-    async def in_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+    def in_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        # sync handler: direct-dispatch eligible (no awaits in the body)
         if p.is_finished():
             io.finished = True
             return Pmt.ok()
@@ -83,10 +84,15 @@ class MessageBurst(Kernel):
         self.add_message_output("out")
 
     async def work(self, io, mio, meta):
-        for _ in range(self.n):
+        for i in range(self.n):
             # backpressured: a large burst parks here instead of growing the
             # consumer's inbox without bound
             await mio.post_async("out", self.message)
+            if (i & 0xFFF) == 0xFFF:
+                # the direct-dispatch path never suspends on its own; yield
+                # periodically so ctrl-port/supervisor traffic stays live
+                # during a long burst
+                await asyncio.sleep(0)
         io.finished = True
 
 
@@ -98,7 +104,8 @@ class MessageSink(Kernel):
         self.received: List[Pmt] = []
 
     @message_handler(name="in")
-    async def in_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+    def in_handler(self, io, mio, meta, p: Pmt) -> Pmt:
+        # sync handler: stays on the direct-dispatch fast path end to end
         if p.is_finished():
             io.finished = True
             return Pmt.ok()

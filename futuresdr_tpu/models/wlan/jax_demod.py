@@ -31,12 +31,27 @@ def _compiled(modulation: str, bucket: int):
 
     table = MODULATION_TABLES[modulation].astype(np.complex64)
     n_bpsc = int(np.log2(len(table)))
-    idx = np.arange(len(table))
-    one_masks = np.stack([((idx >> b) & 1).astype(np.float32)
-                          for b in range(n_bpsc)])            # [n_bpsc, M]
+    # Per-axis max-log decomposition: every 802.11 constellation is a product
+    # of two gray PAMs with the LOW idx-bit group selecting the I level and
+    # the HIGH group the Q level (consts.py `_qam16`/`_qam64`), so
+    # d(z, a+jb) = dI(re, a) + dQ(im, b) and the axis-orthogonal term cancels
+    # in l1−l0: LLR_b = max_{lvl: bit set} −(re−lvl)² − max_{clear} −(re−lvl)²
+    # (resp. imag). √M REAL point distances per axis instead of M complex
+    # ones — 4× (qam16) to 8× (qam64) less demap work, identical LLRs up to
+    # float rounding.
+    n_i = (n_bpsc + 1) // 2                    # I-group bit count (bpsk: 1)
+    n_q = n_bpsc - n_i
+    lvl_i = table[np.arange(1 << n_i)].real.astype(np.float32)
+    lvl_q = table[(np.arange(1 << n_q)) << n_i].imag.astype(np.float32)
+    mask_i = np.stack([(((np.arange(1 << n_i) >> b) & 1)).astype(np.float32)
+                       for b in range(n_i)])                  # [n_i, Li]
+    mask_q = np.stack([(((np.arange(1 << n_q) >> b) & 1)).astype(np.float32)
+                       for b in range(n_q)]) if n_q else \
+        np.zeros((0, 1), np.float32)                          # [n_q, Lq]
 
     @jax.jit
-    def run(body, H, pol, sym_mask, cfo, phase0, tbl, data_idx, pil_idx, masks):
+    def run(body, H, pol, sym_mask, cfo, phase0, li, lq, data_idx, pil_idx,
+            mi, mq):
         k = jnp.arange(bucket * SYM_LEN)
         x = body * jnp.exp(-1j * cfo * (k + phase0))
         sym = x.reshape(bucket, SYM_LEN)[:, CP_LEN:]
@@ -47,19 +62,20 @@ def _compiled(modulation: str, bucket: int):
         cpe = jnp.angle((pilots * jnp.conj(expected)).sum(axis=1))
         eq = eq * jnp.exp(-1j * cpe)[:, None]
         data = eq[:, data_idx]                                # [bucket, 48]
-        d = -jnp.abs(data[..., None] - tbl[None, None, :]) ** 2  # [bucket, 48, M]
-        big = 1e30
-        # per-bit max-log: max over set-bit points minus max over clear-bit points
-        llrs = []
-        for b in range(n_bpsc):
-            m = masks[b][None, None, :]
-            l1 = jnp.max(jnp.where(m > 0, d, -big), axis=2)
-            l0 = jnp.max(jnp.where(m > 0, -big, d), axis=2)
-            llrs.append(l1 - l0)
+        big = jnp.float32(1e30)
+        d_i = -(data.real[..., None] - li[None, None, :]) ** 2  # [bucket,48,Li]
+        llrs = [jnp.max(jnp.where(mi[b] > 0, d_i, -big), axis=2)
+                - jnp.max(jnp.where(mi[b] > 0, -big, d_i), axis=2)
+                for b in range(n_i)]
+        if n_q:
+            d_q = -(data.imag[..., None] - lq[None, None, :]) ** 2
+            llrs += [jnp.max(jnp.where(mq[b] > 0, d_q, -big), axis=2)
+                     - jnp.max(jnp.where(mq[b] > 0, -big, d_q), axis=2)
+                     for b in range(n_q)]
         out = jnp.stack(llrs, axis=2).reshape(bucket, -1)     # [bucket, 48*n_bpsc]
         return (out * sym_mask[:, None]).reshape(-1)
 
-    consts = (table, _DATA_IDX, _PIL_IDX, one_masks)
+    consts = (lvl_i, lvl_q, _DATA_IDX, _PIL_IDX, mask_i, mask_q)
     return run, consts
 
 

@@ -632,26 +632,43 @@ def xlating_fir_stage(taps, phase_inc: float, decim: int,
         ct = (base * np.exp(-1j * theta * np.arange(nt))).astype(np.complex64)
         return _poly_decim_weights(ct, D, m)
 
+    # The exact translation theta rides the CARRY as a float32 hi/lo pair
+    # (double-double split, ~48 significant bits): the carry only holds the
+    # float32 decimated increment otherwise, and re-deriving theta from it on
+    # a taps-only update() would rebuild the weights with a rounded theta
+    # (round-4 advisory). Closure state would alias across carries built from
+    # the same Stage (round-5 review) — every other piece of stage state rides
+    # the carry, so this does too.
+    def _theta_split(theta: float):
+        hi = np.float32(theta)
+        return hi, np.float32(theta - float(hi))
+
+    def _theta_join(hi, lo) -> float:
+        return float(hi) + float(lo)
+
     def fn(carry, x):
-        W, base, ph0, inc_d, hist = carry
+        W, base, ph0, inc_d, th_hi, th_lo, hist = carry
         ext = jnp.concatenate([hist, x])
         nq = x.shape[0] // D
         y = _shifted_matvec(ext, W, m, nq)
         ph = ph0 + inc_d * jnp.arange(nq, dtype=jnp.float32)
         y = y * jnp.exp(1j * ph).astype(y.dtype)
         ph_new = jnp.mod(ph0 + inc_d * nq, 2 * np.pi)
-        return (W, base, ph_new, inc_d, ext[ext.shape[0] - H:]), y.astype(x.dtype)
+        return (W, base, ph_new, inc_d, th_hi, th_lo,
+                ext[ext.shape[0] - H:]), y.astype(x.dtype)
 
     def init_carry(dtype):
         from .xfer import to_device
+        hi, lo = _theta_split(float(phase_inc))
         return (to_device(_weights(base0, float(phase_inc))),
                 to_device(base0),
                 jnp.zeros((), jnp.float32),
                 jnp.asarray(float(phase_inc) * D, jnp.float32),
+                jnp.asarray(hi), jnp.asarray(lo),
                 to_device(np.zeros(H, dtype=np.dtype(dtype))))
 
     def update(carry, phase_inc=None, taps=None):
-        W, base, ph0, inc_d, hist = carry
+        W, base, ph0, inc_d, th_hi, th_lo, hist = carry
         from .xfer import to_device
         dev = next(iter(hist.devices())) if isinstance(hist, jax.Array) else None
         nbase = np.asarray(jax.device_get(base), np.float32)
@@ -665,13 +682,17 @@ def xlating_fir_stage(taps, phase_inc: float, decim: int,
                                  "the translation rides phase_inc")
             nbase = new.astype(np.float32)
             base = to_device(nbase, dev)
-        theta = (float(phase_inc) if phase_inc is not None
-                 else float(jax.device_get(inc_d)) / D)
         if phase_inc is not None:
-            inc_d = jax.device_put(jnp.asarray(theta * D, jnp.float32), dev) \
-                if dev is not None else jnp.asarray(theta * D, jnp.float32)
+            theta = float(phase_inc)
+            hi, lo = _theta_split(theta)
+            def _dev(v):
+                return jax.device_put(v, dev) if dev is not None else jnp.asarray(v)
+            inc_d = _dev(jnp.asarray(theta * D, jnp.float32))
+            th_hi, th_lo = _dev(jnp.asarray(hi)), _dev(jnp.asarray(lo))
+        else:
+            theta = _theta_join(jax.device_get(th_hi), jax.device_get(th_lo))
         W = to_device(_weights(nbase, theta), dev)
-        return (W, base, ph0, inc_d, hist)
+        return (W, base, ph0, inc_d, th_hi, th_lo, hist)
 
     return Stage(fn, init_carry, Fraction(1, D), None, D, name, update=update)
 

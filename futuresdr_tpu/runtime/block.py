@@ -38,6 +38,13 @@ class WrappedKernel:
         self.work_calls = 0
         self.work_time_s = 0.0
         self.messages_handled = 0
+        # direct message dispatch state (message_output.py fast path): the
+        # event loop publishes its WorkIo, owning loop and liveness so a
+        # same-loop sender can invoke a sync handler in its own stack frame
+        self.io = WorkIo()
+        self.loop = None
+        self.live = False
+        self._in_direct = False
 
     def metrics(self) -> dict:
         k = self.kernel
@@ -96,7 +103,7 @@ class WrappedKernel:
 
         kernel = self.kernel
         meta = kernel.meta
-        io = WorkIo()
+        io = self.io
         block_on_task: Optional[asyncio.Task] = None
 
         # ---- init barrier (`wrapped_kernel.rs:84-99`) ------------------------
@@ -124,6 +131,8 @@ class WrappedKernel:
 
         # ---- event loop (`wrapped_kernel.rs:106-229`) ------------------------
         error: Optional[Exception] = None
+        self.loop = asyncio.get_running_loop()
+        self.live = True                    # direct dispatch may target us now
         try:
             while True:
                 io.call_again |= self.inbox.take_pending()
@@ -187,6 +196,7 @@ class WrappedKernel:
             log.error("block %s failed in work: %r", self.instance_name, e)
             error = e
         finally:
+            self.live = False               # direct dispatch falls back to inbox
             if block_on_task is not None:
                 block_on_task.cancel()
             leftover = io.take_block_on()

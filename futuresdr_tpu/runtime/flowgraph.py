@@ -267,10 +267,10 @@ class Flowgraph:
             ip.bind_producer(self.wrapped(e.src).inbox)
         for circuit, source in self._circuits:
             circuit.attach_source(self.wrapped(source).inbox)
-        # message edges
+        # message edges (wrapped enables direct same-loop sync dispatch)
         for e in self.message_edges:
             dw = self.wrapped(e.dst)
-            e.src.mio.connect(e.src_port, dw.inbox, e.dst_port)
+            e.src.mio.connect(e.src_port, dw.inbox, e.dst_port, wrapped=dw)
 
     def take_blocks(self) -> List[WrappedKernel]:
         """Materialize and hand the blocks to the runtime (`flowgraph.rs:614-620`)."""

@@ -76,6 +76,18 @@ class Kernel:
             hname = getattr(member, "_message_handler_name", None)
             if hname:
                 self._message_handlers[hname] = getattr(self, attr_name)
+        # direct-dispatch eligibility (message_output.py fast path): a kernel
+        # with the BASE no-op work() has no work coroutine a synchronously
+        # delivered handler could interleave with, so its SYNC handlers may be
+        # invoked in the sender's stack frame instead of through the inbox
+        self._direct_ok = type(self).work is Kernel.work
+
+    def _sync_handler(self, name: str) -> Optional[Callable]:
+        """The named handler if it is a plain (non-coroutine) function."""
+        fn = self._message_handlers.get(name)
+        if fn is not None and not inspect.iscoroutinefunction(fn):
+            return fn
+        return None
 
     # -- port declaration ------------------------------------------------------
     def add_stream_input(self, name: str, dtype, min_items: int = 1,

@@ -104,13 +104,14 @@ class PpKernel(Kernel):
         self._dispatch(np.zeros(self.frame_size, dtype=self.input.dtype))
         jax.block_until_ready(self._inflight.pop())
 
-    def _dispatch(self, frame: np.ndarray) -> None:
+    def _dispatch(self, frame: np.ndarray, valid: Optional[int] = None) -> None:
         from ..ops.xfer import to_device
         # to_device: the complex-pair shim — raw device_put of host complex64
         # poisons readback on the tunneled TPU backend (ops/xfer.py)
         x = to_device(frame.reshape((self.n_micro,) + self.micro_shape),
                       self._x_shard)
-        self._inflight.append(self._fn(self._W, x))
+        self._inflight.append((self._fn(self._W, x),
+                               self.frame_size if valid is None else valid))
 
     async def work(self, io, mio, meta):
         if self._pending is not None:
@@ -127,10 +128,22 @@ class PpKernel(Kernel):
             self.input.consume(self.frame_size)
             inp = self.input.slice()
         eos = self.input.finished()
+        if eos and 0 < len(inp) < self.frame_size and \
+                len(self._inflight) < self.depth:
+            # final partial frame: zero-pad and emit only the valid prefix —
+            # the TpuKernel tail contract (`kernel_block.py:155-165`); the
+            # siblings previously disagreed (round-4 advisory: PpKernel
+            # silently dropped up to frame_size-1 items at EOS)
+            frame = np.zeros(self.frame_size, dtype=self.input.dtype)
+            frame[:len(inp)] = inp
+            self._dispatch(frame, valid=len(inp))
+            self.input.consume(len(inp))
+            inp = self.input.slice()
         if self._inflight and (len(self._inflight) >= self.depth or eos
                                or len(inp) < self.frame_size):
             from ..ops.xfer import to_host
-            result = to_host(self._inflight.popleft()).reshape(-1)
+            y, valid = self._inflight.popleft()
+            result = to_host(y).reshape(-1)[:valid]
             out = self.output.slice()
             k = min(len(out), len(result))
             out[:k] = result[:k]
@@ -139,8 +152,6 @@ class PpKernel(Kernel):
                 self._pending = result[k:].copy()
             io.call_again = True
             return
-        if eos and not self._inflight and self._pending is None:
-            if self.input.available():
-                # partial tail below one frame cannot microbatch; dropped at EOS
-                self.input.consume(self.input.available())
+        if eos and not self._inflight and self._pending is None \
+                and not self.input.available():
             io.finished = True

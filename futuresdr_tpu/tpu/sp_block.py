@@ -28,7 +28,12 @@ class SpKernel(Kernel):
     frame's global tail is carried on-device and fed to shard 0 as left context, so
     sharded streaming bit-matches a single-device streaming stage across frames.
     Stateless fns (``fn(x) -> y``) restart filter history at each frame edge — fine
-    when frames ≫ taps."""
+    when frames ≫ taps.
+
+    Tail contract: a final partial frame below ``frame_size`` is DROPPED at
+    EOS — a sharded frame cannot shrink without recompiling per-shard shapes
+    (unlike TpuKernel/PpKernel, which zero-pad and emit the valid prefix).
+    Size the stream so totals are frame multiples, or accept the tail loss."""
 
     BLOCKING = True
 

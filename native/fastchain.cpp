@@ -413,6 +413,18 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                 int64_t k = in.count();
                 int64_t lim = s.phase + out.space() * decim;
                 if (lim < k) k = lim;
+                // keep chunks tile-aligned while upstream is live: the
+                // vector kernels fall back to a ~10x-slower scalar loop for
+                // the k%tile tail, and CopyRand-sized chunks (~2k items)
+                // would pay that on EVERY pass; the remainder just waits in
+                // the ring until EOS drains it. Rings smaller than one tile
+                // could never satisfy the gate (review: livelock), so they
+                // skip alignment entirely.
+                const int64_t tile =
+                    (ring_items < 64) ? 1
+                                      : (st[i].kind == FC_FIR_CF) ? 32 : 64;
+                if (!in.eos && k > tile) k -= k % tile;
+                else if (!in.eos && k < tile) k = 0;
                 if (k > 0) {
                     uint8_t* xb = s.xbuf.data();
                     // linear gather: [hist | chunk]

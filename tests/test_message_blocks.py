@@ -95,3 +95,50 @@ def test_large_burst_bounded_inbox_delivers_all():
     fg.connect_message(burst, "out", snk, "in")
     Runtime().run(fg)
     assert len(snk.received) == n
+
+
+def test_direct_dispatch_eligibility_gates():
+    """The direct (same-frame) message path only targets PURE message blocks:
+    base no-op work() + plain-function handler. Anything with a custom work
+    coroutine or an async handler keeps the actor inbox path."""
+    from futuresdr_tpu.blocks import MessageCopy, MessagePipe
+    assert MessageCopy()._direct_ok
+    assert MessageCopy()._sync_handler("in") is not None
+    assert MessageSink()._direct_ok
+    assert MessageSink()._sync_handler("in") is not None
+    assert not MessageBurst(Pmt.usize(1), 1)._direct_ok     # custom work()
+    pipe = MessagePipe()
+    assert pipe._sync_handler("in") is None                 # async handler
+    from futuresdr_tpu.blocks import Fft
+    assert not Fft()._direct_ok                             # stream block
+
+
+def test_direct_dispatch_preserves_order_and_metrics():
+    """Distinct messages through a copy chain arrive exactly once, in order,
+    and per-block messages_handled counts them (direct calls bump the same
+    counter the actor loop does)."""
+    from futuresdr_tpu.runtime.kernel import Kernel
+
+    n = 5_000
+
+    class CountSource(Kernel):
+        def __init__(self):
+            super().__init__()
+            self.add_message_output("out")
+
+        async def work(self, io, mio, meta):
+            for i in range(n):
+                await mio.post_async("out", Pmt.usize(i))
+            io.finished = True
+
+    fg = Flowgraph()
+    src = CountSource()
+    c1, c2 = MessageCopy(), MessageCopy()
+    snk = MessageSink()
+    fg.connect_message(src, "out", c1, "in")
+    fg.connect_message(c1, "out", c2, "in")
+    fg.connect_message(c2, "out", snk, "in")
+    Runtime().run(fg)
+    assert [p.to_int() for p in snk.received] == list(range(n))
+    w1 = fg.wrapped(c1)
+    assert w1.metrics()["messages_handled"] >= n            # + finished marker

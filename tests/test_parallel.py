@@ -333,6 +333,48 @@ def test_sp_fir_random_shapes_fuzz():
         np.testing.assert_allclose(y, ref, atol=2e-3), (trial, nt, per_shard)
 
 
+def test_pp_kernel_partial_tail_zero_padded():
+    """Round-4 advisory: PpKernel must zero-pad the final partial frame and
+    emit the valid prefix (the TpuKernel tail contract) instead of silently
+    dropping up to frame_size-1 items at EOS."""
+    import jax
+    import jax.numpy as jnp
+
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSink, VectorSource
+    from futuresdr_tpu.parallel import make_mesh
+    from futuresdr_tpu.tpu import PpKernel
+
+    n_stages, d, micro_b, n_micro = 2, 4, 2, 3
+    mesh = make_mesh(("pp",), shape=(n_stages,),
+                     devices=jax.devices()[:n_stages])
+    rng = np.random.default_rng(5)
+    W = (rng.standard_normal((n_stages, d, d)) / 4.0).astype(np.float32)
+
+    def apply_stage(w, a):
+        return jnp.tanh(a @ w)
+
+    frame_items = n_micro * micro_b * d
+    tail = 10                                  # < frame_items, not a row multiple
+    data = rng.standard_normal(frame_items + tail).astype(np.float32)
+
+    fg = Flowgraph()
+    src, snk = VectorSource(data), VectorSink(np.float32)
+    fg.connect(src, PpKernel(apply_stage, W, mesh, np.float32, np.float32,
+                             micro_shape=(micro_b, d), n_micro=n_micro), snk)
+    Runtime().run(fg)
+    got = np.asarray(snk.items())
+    assert got.shape == (frame_items + tail,), "partial tail was dropped"
+
+    padded = np.zeros(2 * frame_items, dtype=np.float32)
+    padded[:len(data)] = data
+    ref = padded.reshape(-1, micro_b, d)
+    for s in range(n_stages):
+        ref = np.tanh(ref @ W[s])
+    np.testing.assert_allclose(got, ref.reshape(-1)[:len(data)],
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_pp_kernel_flowgraph_matches_host():
     """PpKernel: a GPipe pipeline across the mesh's pp axis, fed from a REAL
     flowgraph — output matches applying the stages sequentially on the host,

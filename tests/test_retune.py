@@ -347,3 +347,29 @@ def test_xlating_fir_stage_matches_unfolded_chain():
         pB.update_stage(cb, "tuner", taps=t2.astype(np.complex64) * 1j)
     with pytest.raises(ValueError, match="tap count"):
         pB.update_stage(cb, "tuner", taps=t2[:64])
+
+
+def test_xlating_taps_update_preserves_exact_theta():
+    """Round-4 advisory: update(taps=...) without phase_inc must rebuild the
+    complex weights with the EXACT translation theta, not a value re-derived
+    from the carried float32 increment — the weights must be bit-identical to
+    a fresh stage built at the same theta."""
+    import jax
+    import numpy as np
+
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import xlating_fir_stage
+    from futuresdr_tpu.ops.stages import Pipeline
+
+    theta = -2 * np.pi * 0.1234567891234  # poorly representable in float32
+    taps = firdes.lowpass(0.1, 64).astype(np.float32)
+    t2 = firdes.lowpass(0.05, 64).astype(np.float32)
+
+    pipe = Pipeline([xlating_fir_stage(taps, theta, 4, name="x")], np.complex64)
+    c = pipe.init_carry()
+    c = pipe.update_stage(c, "x", taps=t2)
+    fresh = Pipeline([xlating_fir_stage(t2, theta, 4, name="x")],
+                     np.complex64).init_carry()
+    got_W = np.asarray(jax.device_get(c[0][0]))
+    want_W = np.asarray(jax.device_get(fresh[0][0]))
+    np.testing.assert_array_equal(got_W, want_W)
