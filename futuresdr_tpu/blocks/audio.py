@@ -4,21 +4,98 @@ Reference: ``src/blocks/audio/`` (cpal ``AudioSink``/``AudioSource``, hound wav 
 source/sink). WAV handling uses the stdlib ``wave`` module; the soundcard path is gated on
 ``sounddevice`` availability (not present in CI images) and degrades to a null sink with a
 warning — the hardware-without-hardware pattern of SURVEY §4.
+
+Device plugability: :func:`set_audio_backend` swaps the device layer (cpal's
+host-API abstraction role). :class:`FakeAudioBackend` is the in-memory device —
+deterministic capture/playback so the REAL ``work()`` stream loops run in CI
+instead of being skipped for lack of hardware (round-4 verdict item 7: the
+device path previously had zero coverage without a soundcard).
 """
 
 from __future__ import annotations
 
 import wave
-from typing import Optional
+from typing import Callable, List, Optional
 
 import numpy as np
 
 from ..log import logger
 from ..runtime.kernel import Kernel
 
-__all__ = ["WavSource", "WavSink", "AudioSink"]
+__all__ = ["WavSource", "WavSink", "AudioSource", "AudioSink",
+           "FakeAudioBackend", "set_audio_backend"]
 
 log = logger("blocks.audio")
+
+_backend = None          # None → probe sounddevice at stream-open time
+
+
+def set_audio_backend(backend) -> None:
+    """Install a device backend (``None`` restores the sounddevice probe).
+
+    A backend exposes ``open(kind, samplerate, channels) -> stream`` where
+    ``kind`` is ``"input"``/``"output"`` and the stream duck-types the
+    sounddevice API used here: ``start()``, ``stop()``, ``close()``,
+    ``read(n) -> (frames[n, ch], overflowed)`` (input) and
+    ``write(frames[n, ch])`` (output)."""
+    global _backend
+    _backend = backend
+
+
+def _open_stream(kind: str, samplerate: int, channels: int):
+    if _backend is not None:
+        return _backend.open(kind, samplerate, channels)
+    import sounddevice as sd
+    cls = sd.InputStream if kind == "input" else sd.OutputStream
+    return cls(samplerate=samplerate, channels=channels, dtype="float32")
+
+
+class FakeAudioBackend:
+    """Deterministic in-memory audio device (CI twin of a soundcard).
+
+    - capture: ``capture_fn(n, channels) -> float32 [n, channels]`` supplies
+      input frames (``None`` → silence); return an empty array for "no more".
+    - playback: every written chunk is appended to :attr:`played`.
+    """
+
+    def __init__(self, capture_fn: Optional[Callable] = None):
+        self.capture_fn = capture_fn
+        self.played: List[np.ndarray] = []
+        self.opened: List[str] = []
+
+    def open(self, kind: str, samplerate: int, channels: int):
+        self.opened.append(kind)
+        return _FakeStream(self, kind, channels)
+
+    def played_samples(self) -> np.ndarray:
+        return (np.concatenate([p.reshape(-1) for p in self.played])
+                if self.played else np.zeros(0, np.float32))
+
+
+class _FakeStream:
+    def __init__(self, backend: FakeAudioBackend, kind: str, channels: int):
+        self._b = backend
+        self._kind = kind
+        self._ch = channels
+        self.started = False
+
+    def start(self):
+        self.started = True
+
+    def stop(self):
+        self.started = False
+
+    def close(self):
+        pass
+
+    def read(self, n: int):
+        fn = self._b.capture_fn
+        frames = (np.zeros((n, self._ch), np.float32) if fn is None
+                  else np.asarray(fn(n, self._ch), np.float32))
+        return frames, False
+
+    def write(self, frames: np.ndarray):
+        self._b.played.append(np.array(frames, np.float32, copy=True))
 
 
 class WavSource(Kernel):
@@ -115,9 +192,8 @@ class AudioSource(Kernel):
 
     async def init(self, mio, meta):
         try:
-            import sounddevice as sd
-            self._stream = sd.InputStream(
-                samplerate=self.sample_rate, channels=self.n_channels, dtype="float32")
+            self._stream = _open_stream("input", self.sample_rate,
+                                        self.n_channels)
             self._stream.start()
         except Exception as e:
             if not self.allow_null:
@@ -141,6 +217,12 @@ class AudioSource(Kernel):
         if self._stream is not None:
             frames, _ = self._stream.read(min(want, 4096))
             data = frames.reshape(-1)
+            if len(data) == 0:
+                # a real device blocks in read(); only a backend signalling
+                # end-of-capture (FakeAudioBackend capture_fn exhausted)
+                # returns empty — finish like a drained file source
+                io.finished = True
+                return
         else:
             # silence at roughly real-time pace
             n = min(want, self.sample_rate // 20)
@@ -174,9 +256,8 @@ class AudioSink(Kernel):
 
     async def init(self, mio, meta):
         try:
-            import sounddevice as sd
-            self._stream = sd.OutputStream(
-                samplerate=self.sample_rate, channels=self.n_channels, dtype="float32")
+            self._stream = _open_stream("output", self.sample_rate,
+                                        self.n_channels)
             self._stream.start()
         except Exception as e:
             if not self.allow_null:
@@ -194,9 +275,19 @@ class AudioSink(Kernel):
     async def work(self, io, mio, meta):
         inp = self.input.slice()
         if len(inp):
-            if self._stream is not None:
-                frames = inp[:len(inp) - len(inp) % self.n_channels]
-                self._stream.write(frames.reshape(-1, self.n_channels).copy())
-            self.input.consume(len(inp))
+            # consume only whole frames: consuming a dangling sub-frame
+            # remainder would permanently flip channel alignment for the rest
+            # of playback (review); the remainder waits for its partner
+            # sample(s) in the ring
+            k = len(inp) - len(inp) % self.n_channels
+            if self._stream is not None and k:
+                self._stream.write(inp[:k].reshape(-1, self.n_channels).copy())
+            if self._stream is None:
+                k = len(inp)                     # null sink: drop everything
+            if k:
+                self.input.consume(k)
         if self.input.finished():
+            # a trailing sub-frame at EOS can never complete — drop it
+            if self.input.available():
+                self.input.consume(self.input.available())
             io.finished = True

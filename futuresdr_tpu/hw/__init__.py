@@ -149,7 +149,10 @@ class FileDriver(Driver):
         data = self._f.read(n * 8)
         if len(data) < 8:
             if not self.repeat:
-                return np.zeros(0, np.complex64)
+                # end-of-recording IS end-of-stream for a non-repeating
+                # replay: the read contract reserves None for EOS — an empty
+                # array means "no data yet" and would spin the source forever
+                return None
             self._f.seek(0)
             data = self._f.read(n * 8)
         out = np.frombuffer(data[:(len(data) // 8) * 8], dtype=np.complex64)

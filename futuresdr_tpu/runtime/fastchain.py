@@ -296,7 +296,13 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
                          ring_items: int = 1 << 16) -> None:
     """Impersonate ``members`` (WrappedKernels) at the supervisor protocol level
     while the native driver runs the chain: answer the init barrier per member,
-    watch for Terminate, then report per-member BlockDone with counters."""
+    watch for Terminate, then report per-member BlockDone with counters.
+
+    ``FSDR_FASTCHAIN_RING`` overrides the inter-stage ring size in items
+    (perf/buffer_rand.py sweeps it the way the reference sweeps buffer sizes)."""
+    ring_env = os.environ.get("FSDR_FASTCHAIN_RING")
+    if ring_env:
+        ring_items = max(1, int(ring_env))
     from .runtime import BlockDoneMsg, BlockErrorMsg, InitializedMsg
     from ..types import Pmt
 

@@ -147,7 +147,14 @@ class TpuKernel(Kernel):
         #    overwriting consumed space — the frame must leave the ring before consume().
         while len(self._inflight) < self.depth and len(inp) >= self.frame_size:
             tags = self.input.tags(self.frame_size)
-            self._dispatch(inp[:self.frame_size].copy(), self.frame_size, tags)
+            frame = inp[:self.frame_size]
+            if self.inst.platform != "cpu":
+                # async H2D: the frame must leave the ring before consume()
+                # (device_put through the tunnel reads the buffer later); the
+                # CPU backend's device_put copies eagerly, so the ring view is
+                # safe to hand over and the staging copy is pure overhead
+                frame = frame.copy()
+            self._dispatch(frame, self.frame_size, tags)
             self.input.consume(self.frame_size)
             inp = self.input.slice()
 

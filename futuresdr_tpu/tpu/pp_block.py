@@ -27,6 +27,16 @@ from ..runtime.kernel import Kernel
 __all__ = ["PpKernel"]
 
 
+def _platform_needs_staging() -> bool:
+    """True when device_put is async (accelerators) and a ring view must be
+    copied out before consume(); the CPU backend copies eagerly."""
+    import jax
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:                                   # noqa: BLE001
+        return True
+
+
 def _check_stage_leading(stage_params, n_stages: int) -> None:
     """Every leaf must lead with exactly n_stages: a larger multiple shards
     without error but each device then uses only its FIRST stage — half the
@@ -77,6 +87,7 @@ class PpKernel(Kernel):
         self._W = jax.device_put(stage_params, NamedSharding(mesh, P(axis)))
         self._x_shard = NamedSharding(mesh, P())        # microbatches replicated
         self.depth = int(frames_in_flight)
+        self._needs_staging = _platform_needs_staging()   # process constant
         self._inflight: Deque = deque()
         self._pending: Optional[np.ndarray] = None
         self.input = self.add_stream_input("in", in_dtype,
@@ -124,7 +135,10 @@ class PpKernel(Kernel):
                 return
         inp = self.input.slice()
         while len(self._inflight) < self.depth and len(inp) >= self.frame_size:
-            self._dispatch(np.asarray(inp[:self.frame_size]).copy())
+            frame = np.asarray(inp[:self.frame_size])
+            if self._needs_staging:
+                frame = frame.copy()   # async H2D must leave the ring first
+            self._dispatch(frame)
             self.input.consume(self.frame_size)
             inp = self.input.slice()
         eos = self.input.finished()

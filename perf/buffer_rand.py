@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""perf/buffer_rand — randomized-chunk × buffer-size cross sweep.
+
+Reference: ``perf/buffer_rand/`` (the buffer-size sweep run with randomized
+max-copy chunking — the missing cross of ``perf/buffer_size`` and
+``perf/null_rand``). Runs BOTH execution paths per point:
+
+- ``native``: the fast-chain driver, with ``FSDR_FASTCHAIN_RING`` sweeping the
+  inter-stage ring size (this doubles as the validation sweep for the native
+  FIR stages: the chain is the north-star CopyRand→FIR pipe);
+- ``actor``: the Python block path with the same size as the stream-buffer
+  byte budget (``FSDR_NO_FASTCHAIN=1``).
+
+Each point also measures a small-burst end-to-end completion latency (4096
+samples through the whole chain, p50/p99 over repeats) — the fast-chain
+latency number the actor path gets from ``perf/latency.py``.
+
+CSV: ``run,path,ring_items,max_copy,stages,samples,elapsed_secs,msps,``
+``burst_p50_us,burst_p99_us``.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+import numpy as np
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import CopyRand, Fir, Head, NullSink, NullSource
+from futuresdr_tpu.config import config
+from futuresdr_tpu.dsp import firdes
+
+
+def _build(samples: int, stages: int, max_copy: int, with_fir: bool):
+    taps = firdes.lowpass(0.2, 64).astype(np.float32)
+    fg = Flowgraph()
+    src, head = NullSource(np.float32), Head(np.float32, samples)
+    fg.connect(src, head)
+    last = head
+    for s in range(stages):
+        cr = CopyRand(np.float32, max_copy, seed=s + 1)
+        fg.connect(last, cr)
+        last = cr
+        if with_fir:
+            f = Fir(taps, np.float32)
+            fg.connect(last, f)
+            last = f
+    snk = NullSink(np.float32)
+    fg.connect(last, snk)
+    return fg, snk
+
+
+def run_once(samples: int, stages: int, max_copy: int, with_fir: bool) -> float:
+    fg, snk = _build(samples, stages, max_copy, with_fir)
+    rt = Runtime()
+    t0 = time.perf_counter()
+    rt.run(fg)
+    dt = time.perf_counter() - t0
+    rt.shutdown()
+    assert snk.n_received > 0
+    return dt
+
+
+def burst_latency_us(stages: int, max_copy: int, with_fir: bool,
+                     reps: int = 9) -> tuple:
+    """End-to-end wall time for a 4096-sample burst through the whole chain
+    (launch → drain), p50/p99 across reps — completion latency, the metric a
+    burst-mode user feels; steady-state per-sample latency on the actor path
+    is perf/latency.py's job."""
+    times = []
+    for _ in range(reps):
+        times.append(run_once(4096, stages, max_copy, with_fir) * 1e6)
+    times.sort()
+    return times[len(times) // 2], times[int(len(times) * 0.99)]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=2)
+    p.add_argument("--samples", type=int, default=5_000_000)
+    p.add_argument("--stages", type=int, default=6)
+    p.add_argument("--max-copy", type=int, nargs="+", default=[512, 4096])
+    p.add_argument("--rings", type=int, nargs="+",
+                   default=[1 << 12, 1 << 14, 1 << 16, 1 << 18])
+    p.add_argument("--no-fir", action="store_true",
+                   help="pure copy chains (the reference's null_rand shape)")
+    a = p.parse_args()
+    with_fir = not a.no_fir
+    print("run,path,ring_items,max_copy,stages,samples,elapsed_secs,msps,"
+          "burst_p50_us,burst_p99_us")
+    for r in range(a.runs):
+        for ring in a.rings:
+            for mc in a.max_copy:
+                for path in ("native", "actor"):
+                    saved_bs = config().buffer_size
+                    if path == "native":
+                        os.environ.pop("FSDR_NO_FASTCHAIN", None)
+                        os.environ["FSDR_FASTCHAIN_RING"] = str(ring)
+                    else:
+                        os.environ["FSDR_NO_FASTCHAIN"] = "1"
+                        config().buffer_size = ring * 4     # f32 items → bytes
+                    try:
+                        dt = run_once(a.samples, a.stages, mc, with_fir)
+                        p50, p99 = burst_latency_us(a.stages, mc, with_fir)
+                    finally:
+                        os.environ.pop("FSDR_NO_FASTCHAIN", None)
+                        os.environ.pop("FSDR_FASTCHAIN_RING", None)
+                        config().buffer_size = saved_bs     # review: leak
+                        # contaminated later native points otherwise
+                    print(f"{r},{path},{ring},{mc},{a.stages},{a.samples},"
+                          f"{dt:.3f},{a.samples / dt / 1e6:.1f},"
+                          f"{p50:.0f},{p99:.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

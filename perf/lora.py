@@ -30,6 +30,11 @@ def run_device_resident(sf: int, symbols_per_frame: int, k_pair) -> tuple:
 
     pipe = Pipeline([lora_demod_stage(sf)], np.complex64)
     frame = (1 << sf) * symbols_per_frame
+    # small frames (SF7: 8k samples) at the CPU k_pair make sub-ms timed
+    # windows where scheduler noise dominated (r4: 58-182 Msps spread);
+    # scale the scan lengths so one k_lo scan covers ≥2M samples (~20 ms)
+    scale = max(1, -(-2_000_000 // (k_pair[0] * frame)))
+    k_pair = (k_pair[0] * scale, k_pair[1] * scale)
     rng = np.random.default_rng(11)
     host = (rng.standard_normal(frame)
             + 1j * rng.standard_normal(frame)).astype(np.complex64)

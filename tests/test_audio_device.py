@@ -1,0 +1,98 @@
+"""Audio device path through the REAL work() loops (`blocks/audio.py`):
+FakeAudioBackend stands in for the soundcard so the stream read/write branches
+— previously unreachable in CI — execute in actual flowgraphs (reference:
+`src/blocks/audio/audio_sink.rs` / `audio_source.rs` cpal streams)."""
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import AudioSink, AudioSource, Head, VectorSink, \
+    VectorSource
+from futuresdr_tpu.blocks.audio import FakeAudioBackend, set_audio_backend
+
+
+@pytest.fixture
+def fake_backend():
+    b = FakeAudioBackend()
+    set_audio_backend(b)
+    yield b
+    set_audio_backend(None)
+
+
+def test_tone_to_audio_sink_captured(fake_backend):
+    """Round-4 verdict item 7's done-criterion: tone → AudioSink → captured
+    buffer asserted in a flowgraph test (the real write() path)."""
+    fs = 8000
+    t = np.arange(fs, dtype=np.float32) / fs
+    tone = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)
+    fg = Flowgraph()
+    snk = AudioSink(fs)
+    fg.connect(VectorSource(tone), snk)
+    Runtime().run(fg)
+    got = fake_backend.played_samples()
+    np.testing.assert_array_equal(got, tone)
+    assert fake_backend.opened == ["output"]
+    assert snk._stream is not None                 # device path, not null path
+
+
+def test_audio_source_captures_from_device(fake_backend):
+    """AudioSource pulls frames from the device read() loop; a bounded capture
+    drains into a VectorSink sample-exact."""
+    fs = 8000
+    n_total = 20_000
+    src_data = np.linspace(-1, 1, n_total, dtype=np.float32)
+    pos = [0]
+
+    def capture(n, ch):
+        a, b = pos[0], min(pos[0] + n, n_total)
+        pos[0] = b
+        return src_data[a:b].reshape(-1, 1)
+
+    fake_backend.capture_fn = capture
+    fg = Flowgraph()
+    vs = VectorSink(np.float32)
+    fg.connect(AudioSource(fs), Head(np.float32, 15_000), vs)
+    Runtime().run(fg)
+    np.testing.assert_array_equal(vs.items(), src_data[:15_000])
+
+
+def test_audio_source_finishes_when_capture_exhausted(fake_backend):
+    fs = 8000
+    chunks = [np.ones((500, 1), np.float32), np.zeros((0, 1), np.float32)]
+
+    def capture(n, ch):
+        return chunks.pop(0) if chunks else np.zeros((0, ch), np.float32)
+
+    fake_backend.capture_fn = capture
+    fg = Flowgraph()
+    vs = VectorSink(np.float32)
+    fg.connect(AudioSource(fs), vs)
+    Runtime().run(fg)                    # EOS from the device, not a Head
+    assert len(vs.items()) == 500
+
+
+def test_stereo_sink_preserves_interleaving(fake_backend):
+    """Odd-length chunks mid-stream (CopyRand) must not flip L/R alignment:
+    the sink consumes only whole frames and leaves the dangling sample for
+    its partner (review regression)."""
+    from futuresdr_tpu.blocks import CopyRand
+    fs = 4000
+    inter = np.arange(1000, dtype=np.float32)      # L0 R0 L1 R1 …
+    fg = Flowgraph()
+    snk = AudioSink(fs, n_channels=2)
+    fg.connect(VectorSource(inter), CopyRand(np.float32, max_copy=7, seed=3),
+               snk)
+    Runtime().run(fg)
+    got = fake_backend.played_samples()
+    np.testing.assert_array_equal(got, inter)
+    # frames written as [n, 2]
+    assert all(p.ndim == 2 and p.shape[1] == 2 for p in fake_backend.played)
+
+
+def test_without_backend_still_raises_without_allow_null():
+    set_audio_backend(None)
+    fg = Flowgraph()
+    fg.connect(AudioSource(8000), VectorSink(np.float32))
+    with pytest.raises(Exception, match="audio backend"):
+        Runtime().run(fg)

@@ -27,6 +27,9 @@ _EXAMPLES = [
     ("adsb_rx.py", []),                      # synthesizes its own stream
     ("custom_routes.py", []),                # self-curls its extra REST routes
     ("file_trx.py", ["rx", "--out", "{tmp}/cap.cs8", "--samples", "50000"]),
+    ("ssb_rx.py", ["--wav", "{tmp}/ssb.wav"]),   # self-validating loopback
+    ("keyfob_rx.py", []),                        # tx → rx loopback, code checked
+    ("keyfob_rx.py", ["tx", "--out", "{tmp}/burst.cf32"]),
     ("sharded_spectrum.py", ["--devices", "2", "--frames", "2",
                              "--frame-size", "16384"]),
 ]
