@@ -97,21 +97,31 @@ def main(argv=None):
         return 0
 
     loopback = a.input is None
-    if loopback:
-        tmp = tempfile.NamedTemporaryFile(suffix=".cf32", delete=False)
-        run_tx(tmp.name, a.code, a.bits, a.rate, a.bit_rate, a.carrier)
-        a.input = tmp.name
+    tmp_path = None
+    try:
+        if loopback:
+            tmp = tempfile.NamedTemporaryFile(suffix=".cf32", delete=False)
+            run_tx(tmp.name, a.code, a.bits, a.rate, a.bit_rate, a.carrier)
+            a.input = tmp_path = tmp.name
 
-    bits = run_rx(a.input, a.bits, a.rate, a.bit_rate)
-    if bits is None:
-        print("# no keyfob burst found")
-        return 1
-    code = int("".join(map(str, bits)), 2)
-    print(f"# decoded {a.bits}-bit code: 0x{code:X}")
-    if loopback:
-        assert code == a.code, f"loopback mismatch: 0x{code:X} != 0x{a.code:X}"
-        print("# loopback OK: code round-tripped")
-    return 0
+        bits = run_rx(a.input, a.bits, a.rate, a.bit_rate)
+        if bits is None:
+            print("# no keyfob burst found")
+            return 1
+        code = int("".join(map(str, bits)), 2)
+        print(f"# decoded {a.bits}-bit code: 0x{code:X}")
+        if loopback:
+            assert code == a.code, \
+                f"loopback mismatch: 0x{code:X} != 0x{a.code:X}"
+            print("# loopback OK: code round-tripped")
+        return 0
+    finally:
+        if tmp_path is not None:
+            import os
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
 
 
 if __name__ == "__main__":

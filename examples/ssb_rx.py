@@ -80,12 +80,25 @@ def main(argv=None):
     ensure_backend()
 
     synthesized = a.input is None
+    tmp_path = None
     if synthesized:
         tmp = tempfile.NamedTemporaryFile(suffix=".cf32", delete=False)
         synthesize_usb(a.rate, a.bfo, 0.6).tofile(tmp.name)
-        a.input = tmp.name
+        a.input = tmp_path = tmp.name
         print(f"# no --input: synthesized two-tone USB test signal → {a.input}")
 
+    try:
+        return _run(a, synthesized)
+    finally:
+        if tmp_path is not None:
+            import os
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+
+
+def _run(a, synthesized: bool) -> int:
     fs_audio = a.rate / a.decim
     fg = Flowgraph()
     src = (SeifyBuilder()

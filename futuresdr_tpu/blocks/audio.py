@@ -249,6 +249,11 @@ class AudioSink(Kernel):
         self.n_channels = n_channels
         self.allow_null = allow_null
         self._stream = None
+        # sub-frame remainder carried across work() calls: consuming a
+        # wrap-capped or odd-length chunk is safe because channel identity is
+        # absolute stream position mod n_channels — the dangling sample(s)
+        # wait here for their partners instead of being dropped (review)
+        self._pend = np.zeros(0, np.float32)
         # short queue: at 48 kHz a 16 KiB float buffer is already 85 ms of audio —
         # real-time playback wants the low-latency profile by default
         self.input = self.add_stream_input("in", np.float32,
@@ -275,19 +280,19 @@ class AudioSink(Kernel):
     async def work(self, io, mio, meta):
         inp = self.input.slice()
         if len(inp):
-            # consume only whole frames: consuming a dangling sub-frame
-            # remainder would permanently flip channel alignment for the rest
-            # of playback (review); the remainder waits for its partner
-            # sample(s) in the ring
-            k = len(inp) - len(inp) % self.n_channels
-            if self._stream is not None and k:
-                self._stream.write(inp[:k].reshape(-1, self.n_channels).copy())
-            if self._stream is None:
-                k = len(inp)                     # null sink: drop everything
-            if k:
-                self.input.consume(k)
+            if self._stream is not None:
+                buf = np.concatenate([self._pend, inp]) if len(self._pend) \
+                    else np.asarray(inp)
+                k = len(buf) - len(buf) % self.n_channels
+                if k:
+                    self._stream.write(
+                        buf[:k].reshape(-1, self.n_channels).copy())
+                self._pend = buf[k:].copy()
+            self.input.consume(len(inp))
         if self.input.finished():
-            # a trailing sub-frame at EOS can never complete — drop it
             if self.input.available():
-                self.input.consume(self.input.available())
-            io.finished = True
+                # the readable slice was wrap-capped below what is buffered —
+                # keep draining (we consumed above, so this always progresses)
+                io.call_again = True
+            else:
+                io.finished = True       # a sub-frame _pend tail is dropped

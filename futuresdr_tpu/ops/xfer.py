@@ -44,6 +44,26 @@ def split_complex_platform(platform: str) -> bool:
     return platform != "cpu"
 
 
+def h2d_needs_staging(platform: str) -> bool:
+    """Must a ring-buffer view be copied out before being handed to
+    ``device_put`` (and the ring position consumed)? ALWAYS — on every
+    platform. Single source of truth for TpuKernel/PpKernel.
+
+    On accelerators the H2D is async and reads the source buffer later. The
+    CPU backend is the trap: ``device_put`` of a numpy view usually copies
+    eagerly, but a 64-BYTE-ALIGNED view is zero-copy BORROWED
+    (``unsafe_buffer_pointer() == view.ctypes.data``) — and ring buffers are
+    page-aligned memfd mappings, so frame-sized slices are almost always
+    aligned. A borrowed frame aliases ring memory the upstream writer then
+    overwrites → flaky corruption of in-flight frames (round-5 regression:
+    ``test_tpu_kernel_block_in_flowgraph`` failed ~50% after the copy was
+    elided on "cpu"; probes with ``np.zeros`` buffers missed it because the
+    allocator happened to return misaligned bases). Forcing misalignment
+    would just move the same copy inside jax, so the explicit staging copy
+    stays."""
+    return True
+
+
 def _device_platform(device=None) -> str:
     import jax
 

@@ -55,6 +55,8 @@ class TpuKernel(Kernel):
         self.frame_size = max(m, (fs // m) * m)
         self.out_frame = self.pipeline.out_items(self.frame_size)
         self.depth = frames_in_flight or self.inst.frames_in_flight
+        from ..ops.xfer import h2d_needs_staging
+        self._needs_staging = h2d_needs_staging(self.inst.platform)
         self._compiled = None
         self._carry = None
         # (device result, valid_out, rebased tags)
@@ -148,11 +150,10 @@ class TpuKernel(Kernel):
         while len(self._inflight) < self.depth and len(inp) >= self.frame_size:
             tags = self.input.tags(self.frame_size)
             frame = inp[:self.frame_size]
-            if self.inst.platform != "cpu":
-                # async H2D: the frame must leave the ring before consume()
-                # (device_put through the tunnel reads the buffer later); the
-                # CPU backend's device_put copies eagerly, so the ring view is
-                # safe to hand over and the staging copy is pure overhead
+            if self._needs_staging:
+                # the frame must leave the ring before consume(): async H2D on
+                # accelerators, and the CPU client zero-copy BORROWS aligned
+                # views (ops/xfer.h2d_needs_staging — always True)
                 frame = frame.copy()
             self._dispatch(frame, self.frame_size, tags)
             self.input.consume(self.frame_size)

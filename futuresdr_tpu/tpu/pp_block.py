@@ -27,16 +27,6 @@ from ..runtime.kernel import Kernel
 __all__ = ["PpKernel"]
 
 
-def _platform_needs_staging() -> bool:
-    """True when device_put is async (accelerators) and a ring view must be
-    copied out before consume(); the CPU backend copies eagerly."""
-    import jax
-    try:
-        return jax.default_backend() != "cpu"
-    except Exception:                                   # noqa: BLE001
-        return True
-
-
 def _check_stage_leading(stage_params, n_stages: int) -> None:
     """Every leaf must lead with exactly n_stages: a larger multiple shards
     without error but each device then uses only its FIRST stage — half the
@@ -87,7 +77,9 @@ class PpKernel(Kernel):
         self._W = jax.device_put(stage_params, NamedSharding(mesh, P(axis)))
         self._x_shard = NamedSharding(mesh, P())        # microbatches replicated
         self.depth = int(frames_in_flight)
-        self._needs_staging = _platform_needs_staging()   # process constant
+        from ..ops.xfer import h2d_needs_staging
+        self._needs_staging = h2d_needs_staging(
+            next(iter(np.asarray(mesh.devices).flat)).platform)
         self._inflight: Deque = deque()
         self._pending: Optional[np.ndarray] = None
         self.input = self.add_stream_input("in", in_dtype,

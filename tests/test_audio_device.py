@@ -90,9 +90,20 @@ def test_stereo_sink_preserves_interleaving(fake_backend):
     assert all(p.ndim == 2 and p.shape[1] == 2 for p in fake_backend.played)
 
 
-def test_without_backend_still_raises_without_allow_null():
-    set_audio_backend(None)
-    fg = Flowgraph()
-    fg.connect(AudioSource(8000), VectorSink(np.float32))
-    with pytest.raises(Exception, match="audio backend"):
-        Runtime().run(fg)
+def test_device_open_failure_raises_without_allow_null():
+    """A backend whose open() fails must surface at init (trap, not silence).
+    A failing stub is installed rather than clearing the backend: on a machine
+    with a working soundcard, sounddevice would open a REAL stream and the
+    unbounded source flowgraph would run forever (review)."""
+    class NoDevice:
+        def open(self, kind, samplerate, channels):
+            raise RuntimeError("simulated absent device")
+
+    set_audio_backend(NoDevice())
+    try:
+        fg = Flowgraph()
+        fg.connect(AudioSource(8000), VectorSink(np.float32))
+        with pytest.raises(Exception, match="audio backend"):
+            Runtime().run(fg)
+    finally:
+        set_audio_backend(None)
