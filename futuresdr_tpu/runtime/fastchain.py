@@ -8,8 +8,10 @@ Python's asyncio actor loop costs ~10 µs per ``work()`` call there; no amount
 of scheduling fixes that floor. This module takes the reference's answer one
 step further on the runtime side: a maximal LINEAR chain whose members are all
 native-capable (NullSource/Head/Copy/CopyRand/NullSink/VectorSource/VectorSink
-plus the DSP set: plain/decimating Fir over f32/c64 with f32/c64 taps, and
-QuadratureDemod), with no message ports, taps, broadcasts, or inplace edges,
+plus the DSP set: plain/decimating Fir over f32/c64 with f32/c64 taps,
+QuadratureDemod, and — with the explicit ``fastchain_static = True`` opt-in,
+because its live ``freq`` handler cannot reach a fused chain — XlatingFir),
+with no message edges, taps, broadcasts, or inplace edges,
 is lifted out of the actor plane entirely and executed by
 ``native/fastchain.cpp`` — one C++ thread round-robining the whole pipe over
 plain ring buffers (one pinned flow.rs worker that owns every block of the
@@ -36,6 +38,10 @@ Known divergences from the actor path (documented per the round-4 advisory):
   demod's last-sample carry are NOT (the chain ran to completion — a fused
   flowgraph is not resumable mid-stream, same as the reference's drained
   executors).
+- Callbacks (``handle.call``) addressed to a fused member are answered with
+  ``Pmt.invalid_value()`` — a fused chain is static. This is why
+  handler-bearing blocks (XlatingFir's ``freq``) require the
+  ``fastchain_static`` opt-in to fuse at all.
 """
 
 from __future__ import annotations
@@ -55,9 +61,9 @@ log = logger("runtime.fastchain")
 # stage kinds — keep in sync with native/fastchain.cpp
 (FC_NULL_SOURCE, FC_HEAD, FC_COPY, FC_COPY_RAND, FC_NULL_SINK,
  FC_VEC_SOURCE, FC_VEC_SINK, FC_FIR_FF, FC_FIR_CF, FC_FIR_CC,
- FC_QUAD_DEMOD) = range(11)
+ FC_QUAD_DEMOD, FC_XLATING) = range(12)
 
-_FIR_KINDS = (FC_FIR_FF, FC_FIR_CF, FC_FIR_CC)
+_FIR_KINDS = (FC_FIR_FF, FC_FIR_CF, FC_FIR_CC, FC_XLATING)
 
 
 class _FcStage(ctypes.Structure):
@@ -89,7 +95,7 @@ def _load() -> Optional[ctypes.CDLL]:
     if lib is not None:
         try:
             lib.fsdr_fastchain_abi.restype = ctypes.c_int64
-            if lib.fsdr_fastchain_abi() != 2:
+            if lib.fsdr_fastchain_abi() != 3:
                 lib = None
         except AttributeError:
             lib = None
@@ -110,7 +116,7 @@ def _native_stage(kernel) -> Optional[tuple]:
     blocks must be mirrored HERE or the kernel dropped from the registry."""
     import numpy as np
 
-    from ..blocks.dsp import Fir, QuadratureDemod
+    from ..blocks.dsp import Fir, QuadratureDemod, XlatingFir
     from ..blocks.stream import Copy, Head
     from ..blocks.vector import CopyRand, NullSink, NullSource, VectorSink, \
         VectorSource
@@ -177,6 +183,26 @@ def _native_stage(kernel) -> Optional[tuple]:
         if complex(kernel._last) != 1.0:
             return None                # mid-stream carry: actor path
         return (FC_QUAD_DEMOD, 0, 0, float(kernel.gain), None)
+    if type(kernel) is XlatingFir:
+        # A fused chain is STATIC: the xlating block's live `freq` handler
+        # could not retune it (the chain watcher answers Callbacks with
+        # invalid_value), so a block with runtime handlers only fuses when the
+        # user explicitly promises not to use them (review: silently ignoring
+        # handle.call(freq) would be a behavioral regression, not a fast path)
+        if not getattr(kernel, "fastchain_static", False):
+            return None
+        fir = kernel.fir               # always a DecimatingFirFilter
+        if fir.fir._hist is not None or fir._phase != 0 \
+                or kernel.rotator._phase != 0.0:
+            return None                # mid-stream state: actor path
+        taps = fir.fir.taps
+        if taps.dtype != np.float32 or kernel.input.dtype != np.complex64 \
+                or not (1 <= len(taps) <= 1 << 14):
+            return None
+        sym = len(taps) % 2 == 0 and np.array_equal(taps, taps[::-1])
+        return (FC_XLATING, len(taps),
+                int(fir.decim) | (int(sym) << 32),
+                float(kernel.rotator.phase_inc), taps)
     return None
 
 

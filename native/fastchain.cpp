@@ -1,6 +1,9 @@
 // fastchain: single-threaded round-robin executor for linear chains of stream
 // blocks — the native work-loop driver for the small-chunk regime, now with
-// real DSP stages (FIR with carried history + decimation, quadrature demod).
+// real DSP stages (FIR with carried history + decimation, quadrature demod,
+// and the rotate→FIR→decimate xlating stage — which Python only fuses behind
+// an explicit fastchain_static opt-in, since a fused chain cannot service the
+// block's live freq retune handler).
 //
 // Reference role: src/runtime/scheduler/flow.rs:265-442 — the reference's
 // FlowScheduler runs pinned workers with LOCAL run queues precisely because
@@ -52,6 +55,7 @@ enum {
     FC_FIR_CF = 8,        // c64 FIR, f32 taps: p0 = ntaps, p1 = decim, data = taps
     FC_FIR_CC = 9,        // c64 FIR, c64 taps: p0 = ntaps, p1 = decim, data = taps
     FC_QUAD_DEMOD = 10,   // c64 → f32: f0 = gain; y = gain*arg(x[n]*conj(x[n-1]))
+    FC_XLATING = 11,      // c64 rotate(f0=phase_inc) → f32-tap FIR → decim
 };
 
 struct FcStage {
@@ -242,6 +246,7 @@ struct StageState {
     int64_t phase = 0;           // decimation phase (dsp/kernels.py:64 contract)
     float last_re = 1.0f;        // quad demod x[n-1] seed (blocks/dsp.py:407)
     float last_im = 0.0f;
+    double rot_phase = 0.0;      // FC_XLATING rotator phase (dsp Rotator carry)
 };
 
 }  // namespace
@@ -250,7 +255,7 @@ extern "C" {
 
 // ABI version, checked by fastchain.py's _load(): bump on ANY FcStage layout
 // or protocol change so a stale .so can never be driven with a newer struct.
-int64_t fsdr_fastchain_abi(void) { return 2; }
+int64_t fsdr_fastchain_abi(void) { return 3; }
 
 // Run the chain to completion (sink finished) or until *stop becomes nonzero.
 // per_in[i]/per_out[i] accumulate items consumed/produced by stage i (sources
@@ -271,7 +276,8 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
             return -1;                   // empty/unbacked source
         if (st[i].kind == FC_VEC_SINK && st[i].data == nullptr)
             return -1;
-        if (st[i].kind >= FC_FIR_FF && st[i].kind <= FC_FIR_CC &&
+        if (st[i].kind >= FC_FIR_FF && st[i].kind <= FC_XLATING &&
+            st[i].kind != FC_QUAD_DEMOD &&
             (st[i].p0 < 1 || (st[i].p1 & 0xFFFFFFFFLL) < 1 ||
              st[i].data == nullptr))
             return -1;                   // ntaps/decim/taps sanity
@@ -280,7 +286,7 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
     if (st[n - 1].kind != FC_NULL_SINK && st[n - 1].kind != FC_VEC_SINK)
         return -1;
     for (int i = 1; i + 1 < n; ++i) {
-        if (st[i].kind < FC_HEAD || st[i].kind > FC_QUAD_DEMOD ||
+        if (st[i].kind < FC_HEAD || st[i].kind > FC_XLATING ||
             st[i].kind == FC_NULL_SINK || st[i].kind == FC_VEC_SOURCE ||
             st[i].kind == FC_VEC_SINK)
             return -1;
@@ -317,7 +323,8 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
         if (st[i].kind == FC_HEAD) head_left[i] = st[i].p0;
         if (st[i].kind == FC_COPY_RAND)
             rng[i] = static_cast<uint64_t>(st[i].p1) * 0x9E3779B97F4A7C15ULL + 1;
-        if (st[i].kind >= FC_FIR_FF && st[i].kind <= FC_FIR_CC) {
+        if ((st[i].kind >= FC_FIR_FF && st[i].kind <= FC_FIR_CC) ||
+            st[i].kind == FC_XLATING) {
             const int64_t in_isz = rings[i - 1].isz;
             ss[i].hist.assign(
                 static_cast<size_t>((st[i].p0 - 1) * in_isz), 0);
@@ -402,7 +409,8 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
             Ring& out = rings[i];
 
             // ---- compute middle stages -------------------------------------
-            if (st[i].kind >= FC_FIR_FF && st[i].kind <= FC_FIR_CC) {
+            if ((st[i].kind >= FC_FIR_FF && st[i].kind <= FC_FIR_CC) ||
+                st[i].kind == FC_XLATING) {
                 const int64_t nt = st[i].p0;
                 const int64_t decim = st[i].p1 & 0xFFFFFFFFLL;
                 const bool sym = ((st[i].p1 >> 32) & 1) != 0;
@@ -422,7 +430,8 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                 // skip alignment entirely.
                 const int64_t tile =
                     (ring_items < 64) ? 1
-                                      : (st[i].kind == FC_FIR_CF) ? 32 : 64;
+                    : (st[i].kind == FC_FIR_CF || st[i].kind == FC_XLATING)
+                        ? 32 : 64;
                 if (!in.eos && k > tile) k -= k % tile;
                 else if (!in.eos && k < tile) k = 0;
                 if (k > 0) {
@@ -432,6 +441,28 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     int64_t xi = nt - 1;
                     span_copy(reinterpret_cast<const uint8_t*>(in.buf), in.cap,
                               in.tail, xb, 0, xi, k, isz_in);
+                    if (st[i].kind == FC_XLATING) {
+                        // rotate the fresh chunk in place BEFORE the filter:
+                        // downstream (kernel, history carry) then sees the
+                        // rotated stream, exactly like blocks.XlatingFir
+                        // feeding Rotator output into its DecimatingFirFilter
+                        float* xc = reinterpret_cast<float*>(
+                            xb + (nt - 1) * isz_in);
+                        const double inc = st[i].f0;
+                        for (int64_t j = 0; j < k; ++j) {
+                            // phase0 + inc*j, like the numpy Rotator's ramp
+                            // (NOT sequential accumulation — same rounding)
+                            const double ph =
+                                s.rot_phase + inc * static_cast<double>(j);
+                            const float cr = static_cast<float>(std::cos(ph));
+                            const float ci = static_cast<float>(std::sin(ph));
+                            const float xr = xc[2 * j], xi_ = xc[2 * j + 1];
+                            xc[2 * j] = xr * cr - xi_ * ci;
+                            xc[2 * j + 1] = xr * ci + xi_ * cr;
+                        }
+                        s.rot_phase = std::fmod(s.rot_phase + inc * k,
+                                                2.0 * M_PI);
+                    }
                     const float* x0 = reinterpret_cast<const float*>(
                         xb + (nt - 1) * isz_in);
                     float* yb = reinterpret_cast<float*>(s.ybuf.data());
@@ -440,7 +471,8 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     if (st[i].kind == FC_FIR_FF)
                         sym ? fir_sym(x0, taps, nt, 1, yb, k)
                             : fir_real_taps(x0, taps, nt, 1, yb, k);
-                    else if (st[i].kind == FC_FIR_CF)
+                    else if (st[i].kind == FC_FIR_CF ||
+                             st[i].kind == FC_XLATING)
                         // interleaved float view: same saxpy, tap offset ×2
                         sym ? fir_sym(x0, taps, nt, 2, yb, 2 * k)
                             : fir_real_taps(x0, taps, nt, 2, yb, 2 * k);

@@ -111,6 +111,57 @@ def test_complex_taps_xlating_fir():
     np.testing.assert_allclose(native, actor, rtol=3e-5, atol=2e-6)
 
 
+def test_xlating_fir_chain_matches_actor_path():
+    """FC_XLATING: rotate→FIR→decimate in one native stage — the front half of
+    every receiver (blocks.XlatingFir) now fuses."""
+    from futuresdr_tpu.blocks import XlatingFir
+    fs = 250e3
+    taps = firdes.lowpass(0.1, 64).astype(np.float32)
+    rng = np.random.default_rng(21)
+    iq = (rng.standard_normal(24_000) + 1j * rng.standard_normal(24_000)) \
+        .astype(np.complex64)
+
+    def build():
+        fg = Flowgraph()
+        vs = VectorSink(np.complex64)
+        xf = XlatingFir(taps, decim=5, offset_freq=12e3, sample_rate=fs)
+        xf.fastchain_static = True     # promise: no runtime freq retunes
+        fg.connect(VectorSource(iq),
+                   CopyRand(np.complex64, max_copy=513, seed=4), xf, vs)
+        return fg, vs
+
+    native, actor = _run_ab(build)
+    assert len(native) == len(actor) == -(-24_000 // 5)
+    np.testing.assert_allclose(native, actor, rtol=2e-4, atol=2e-5)
+
+
+def test_xlating_fir_not_fused_without_static_optin():
+    """Default: a block with a live retune handler stays on the actor path —
+    a fused chain cannot service handle.call(freq) (review regression)."""
+    from futuresdr_tpu.blocks import XlatingFir
+    taps = firdes.lowpass(0.1, 32).astype(np.float32)
+    fg = Flowgraph()
+    fg.connect(VectorSource(np.zeros(1000, np.complex64)),
+               XlatingFir(taps, decim=2, offset_freq=1e3, sample_rate=48e3),
+               NullSink(np.complex64))
+    assert find_native_chains(fg) == []
+
+
+def test_xlating_fir_with_connected_freq_port_not_fused():
+    """A message EDGE into the xlating block's freq port must keep it on the
+    actor path (retunes need the live handler)."""
+    from futuresdr_tpu.blocks import MessageBurst, XlatingFir
+    from futuresdr_tpu import Pmt
+    taps = firdes.lowpass(0.1, 32).astype(np.float32)
+    fg = Flowgraph()
+    xf = XlatingFir(taps, decim=2, offset_freq=1e3, sample_rate=48e3)
+    fg.connect(VectorSource(np.zeros(1000, np.complex64)), xf,
+               NullSink(np.complex64))
+    tuner = MessageBurst(Pmt.f64(2e3), 1)
+    fg.connect_message(tuner, "out", xf, "freq")
+    assert find_native_chains(fg) == []
+
+
 def test_kernel_state_writeback_after_fused_run():
     """Round-4 advisory: post-run attribute reads must match the actor path —
     Head.remaining hits 0, VectorSource shows its position consumed."""
