@@ -451,11 +451,16 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                         const double inc = st[i].f0;
                         for (int64_t j = 0; j < k; ++j) {
                             // phase0 + inc*j, like the numpy Rotator's ramp
-                            // (NOT sequential accumulation — same rounding)
+                            // (NOT sequential accumulation — same rounding);
+                            // one fused sincos per sample instead of two
+                            // libm calls (glibc extension, present under
+                            // g++'s default _GNU_SOURCE)
                             const double ph =
                                 s.rot_phase + inc * static_cast<double>(j);
-                            const float cr = static_cast<float>(std::cos(ph));
-                            const float ci = static_cast<float>(std::sin(ph));
+                            double sd, cd;
+                            ::sincos(ph, &sd, &cd);
+                            const float cr = static_cast<float>(cd);
+                            const float ci = static_cast<float>(sd);
                             const float xr = xc[2 * j], xi_ = xc[2 * j + 1];
                             xc[2 * j] = xr * cr - xi_ * ci;
                             xc[2 * j + 1] = xr * ci + xi_ * cr;

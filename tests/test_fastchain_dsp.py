@@ -155,6 +155,10 @@ def test_xlating_fir_with_connected_freq_port_not_fused():
     taps = firdes.lowpass(0.1, 32).astype(np.float32)
     fg = Flowgraph()
     xf = XlatingFir(taps, decim=2, offset_freq=1e3, sample_rate=48e3)
+    # opt-in SET: the message-EDGE exclusion must hold even when the user
+    # promised static operation (the edge proves they lied) — without this
+    # line the opt-in gate already excludes the block and the test is vacuous
+    xf.fastchain_static = True
     fg.connect(VectorSource(np.zeros(1000, np.complex64)), xf,
                NullSink(np.complex64))
     tuner = MessageBurst(Pmt.f64(2e3), 1)
