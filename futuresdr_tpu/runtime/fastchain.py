@@ -61,7 +61,7 @@ log = logger("runtime.fastchain")
 # stage kinds — keep in sync with native/fastchain.cpp
 (FC_NULL_SOURCE, FC_HEAD, FC_COPY, FC_COPY_RAND, FC_NULL_SINK,
  FC_VEC_SOURCE, FC_VEC_SINK, FC_FIR_FF, FC_FIR_CF, FC_FIR_CC,
- FC_QUAD_DEMOD, FC_XLATING) = range(12)
+ FC_QUAD_DEMOD, FC_XLATING, FC_AGC) = range(13)
 
 _FIR_KINDS = (FC_FIR_FF, FC_FIR_CF, FC_FIR_CC, FC_XLATING)
 
@@ -95,7 +95,7 @@ def _load() -> Optional[ctypes.CDLL]:
     if lib is not None:
         try:
             lib.fsdr_fastchain_abi.restype = ctypes.c_int64
-            if lib.fsdr_fastchain_abi() != 3:
+            if lib.fsdr_fastchain_abi() != 4:
                 lib = None
         except AttributeError:
             lib = None
@@ -116,7 +116,7 @@ def _native_stage(kernel) -> Optional[tuple]:
     blocks must be mirrored HERE or the kernel dropped from the registry."""
     import numpy as np
 
-    from ..blocks.dsp import Fir, QuadratureDemod, XlatingFir
+    from ..blocks.dsp import Agc, Fir, QuadratureDemod, XlatingFir
     from ..blocks.stream import Copy, Head
     from ..blocks.vector import CopyRand, NullSink, NullSource, VectorSink, \
         VectorSource
@@ -203,6 +203,22 @@ def _native_stage(kernel) -> Optional[tuple]:
         return (FC_XLATING, len(taps),
                 int(fir.decim) | (int(sym) << 32),
                 float(kernel.rotator.phase_inc), taps)
+    if type(kernel) is Agc:
+        # same static opt-in as XlatingFir: Agc has live gain_lock /
+        # reference_power handlers a fused chain cannot service
+        if not getattr(kernel, "fastchain_static", False):
+            return None
+        if kernel.mode != "sample" or kernel.locked:
+            return None                # block mode / locked: actor path
+        dt = kernel.input.dtype
+        if dt not in (np.float32, np.complex64):
+            return None
+        # params block [reference, rate, max_gain, gain]: the C stage reads
+        # it AND writes the live gain back into slot 3 (post-run write-back
+        # of kernel.gain, live visibility meanwhile)
+        params = np.array([kernel.reference, kernel.rate, kernel.max_gain,
+                           kernel.gain], dtype=np.float64)
+        return (FC_AGC, int(dt == np.complex64), 0, 0.0, params)
     return None
 
 
@@ -393,6 +409,7 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
         stages = (_FcStage * n)()
         keepalive = []                 # numpy buffers the C side points into
         sink_buf = None
+        agc_params = {}                # member idx → live params block
         bound = _sink_bound(kernels)
         for i, b in enumerate(members):
             kind, p0, p1, f0, data = _native_stage(b.kernel)
@@ -403,16 +420,18 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
                 data, p0 = sink_buf, int(bound)
             elif kind in _FIR_KINDS:
                 data = np.ascontiguousarray(data)   # taps
+            elif kind == FC_AGC:
+                agc_params[i] = data   # C writes the live gain into slot 3
             ptr = None
             if data is not None:
                 keepalive.append(data)
                 ptr = data.ctypes.data_as(ctypes.c_void_p)
             isz = int(edges[i].itemsize if i < n - 1 else edges[-1].itemsize)
             stages[i] = _FcStage(kind, isz, p0, p1, f0, ptr)
-        return lib, stages, keepalive, sink_buf
+        return lib, stages, keepalive, sink_buf, agc_params
 
     try:
-        lib, stages, keepalive, sink_buf = _build_stages()
+        lib, stages, keepalive, sink_buf, agc_params = _build_stages()
     except Exception as e:                              # noqa: BLE001
         log.error("fastchain stage build failed (%r)", e)
         fg_inbox.send(BlockErrorMsg(members[0].id, e))
@@ -500,6 +519,8 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
             k.remaining = max(0, int(k.remaining) - int(per_out[i]))
         elif type(k) is VectorSource and len(k.items):
             k._round, k._pos = divmod(int(per_out[i]), len(k.items))
+        elif i in agc_params:
+            k.gain = float(agc_params[i][3])   # final feedback state
     if sink_buf is not None:
         members[-1].kernel._chunks = [sink_buf[:int(per_in[n - 1])]]
     del keepalive

@@ -56,6 +56,8 @@ enum {
     FC_FIR_CC = 9,        // c64 FIR, c64 taps: p0 = ntaps, p1 = decim, data = taps
     FC_QUAD_DEMOD = 10,   // c64 → f32: f0 = gain; y = gain*arg(x[n]*conj(x[n-1]))
     FC_XLATING = 11,      // c64 rotate(f0=phase_inc) → f32-tap FIR → decim
+    FC_AGC = 12,          // per-sample AGC: p0 = 1 if complex items,
+                          // data = double[4]{reference, rate, max_gain, gain0}
 };
 
 struct FcStage {
@@ -247,6 +249,7 @@ struct StageState {
     float last_re = 1.0f;        // quad demod x[n-1] seed (blocks/dsp.py:407)
     float last_im = 0.0f;
     double rot_phase = 0.0;      // FC_XLATING rotator phase (dsp Rotator carry)
+    double agc_gain = 1.0;       // FC_AGC feedback state (blocks/dsp.py Agc)
 };
 
 }  // namespace
@@ -255,7 +258,7 @@ extern "C" {
 
 // ABI version, checked by fastchain.py's _load(): bump on ANY FcStage layout
 // or protocol change so a stale .so can never be driven with a newer struct.
-int64_t fsdr_fastchain_abi(void) { return 3; }
+int64_t fsdr_fastchain_abi(void) { return 4; }
 
 // Run the chain to completion (sink finished) or until *stop becomes nonzero.
 // per_in[i]/per_out[i] accumulate items consumed/produced by stage i (sources
@@ -286,10 +289,12 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
     if (st[n - 1].kind != FC_NULL_SINK && st[n - 1].kind != FC_VEC_SINK)
         return -1;
     for (int i = 1; i + 1 < n; ++i) {
-        if (st[i].kind < FC_HEAD || st[i].kind > FC_XLATING ||
+        if (st[i].kind < FC_HEAD || st[i].kind > FC_AGC ||
             st[i].kind == FC_NULL_SINK || st[i].kind == FC_VEC_SOURCE ||
             st[i].kind == FC_VEC_SINK)
             return -1;
+        if (st[i].kind == FC_AGC && st[i].data == nullptr)
+            return -1;                  // params block required
         // width conservation: every middle stage except the dtype-changing
         // demod must see equal in/out item sizes, or ring_copy would write
         // src-width items into a dst-width ring (defense in depth — the
@@ -334,8 +339,11 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                         static_cast<size_t>((st[i].p0 - 1) * in_isz));
             ss[i].ybuf.resize(static_cast<size_t>(ring_items * st[i].isz_out));
         }
-        if (st[i].kind == FC_QUAD_DEMOD)
+        if (st[i].kind == FC_QUAD_DEMOD || st[i].kind == FC_AGC)
             ss[i].ybuf.resize(static_cast<size_t>(ring_items * st[i].isz_out));
+        if (st[i].kind == FC_AGC)
+            ss[i].agc_gain =
+                reinterpret_cast<const double*>(st[i].data)[3];   // gain0
     }
     int64_t sink_count =
         (st[n - 1].kind == FC_VEC_SINK) ? -1 : st[n - 1].p0;  // -1 = until EOS
@@ -541,6 +549,64 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     }
                     s.last_re = pr;
                     s.last_im = pi;
+                    in.tail += k;
+                    int64_t yi = 0;
+                    span_copy(s.ybuf.data(), 0, yi,
+                              reinterpret_cast<uint8_t*>(out.buf), out.cap,
+                              out.head, k, out.isz);
+                    progress = true;
+                    if (per_in) per_in[i] += k;
+                    if (per_out) per_out[i] += k;
+                    if (per_calls) per_calls[i] += 1;
+                }
+                if (in.eos && in.count() == 0) {
+                    out.eos = true;
+                    done[i] = true;
+                }
+                continue;
+            }
+            if (st[i].kind == FC_AGC) {
+                StageState& s = ss[i];
+                int64_t k = in.count();
+                if (out.space() < k) k = out.space();
+                if (k > 0) {
+                    double* pr = reinterpret_cast<double*>(st[i].data);
+                    // FLOAT32 feedback, exactly like the actor loop under
+                    // NumPy 2 weak promotion: mag(f32)*g makes every update
+                    // f32 there, so the sequential gain trajectory is f32 —
+                    // double here would drift from the actor path's values
+                    const float ref = static_cast<float>(pr[0]);
+                    const float rate = static_cast<float>(pr[1]);
+                    const float mg = static_cast<float>(pr[2]);
+                    const bool cx = st[i].p0 != 0;
+                    const float* rb = reinterpret_cast<const float*>(in.buf);
+                    float* yb = reinterpret_cast<float*>(s.ybuf.data());
+                    float g = static_cast<float>(s.agc_gain);
+                    for (int64_t j = 0; j < k; ++j) {
+                        const int64_t off = (in.tail + j) % in.cap;
+                        // |x| like np.abs: hypotf for complex64, fabsf real
+                        float mag;
+                        if (cx) {
+                            const float xr = rb[2 * off], xi = rb[2 * off + 1];
+                            mag = hypotf(xr, xi);
+                            // output multiply in f64 like numpy's
+                            // gains(f64-array) * complex64 → complex128 → f32
+                            yb[2 * j] = static_cast<float>(
+                                static_cast<double>(g) * xr);
+                            yb[2 * j + 1] = static_cast<float>(
+                                static_cast<double>(g) * xi);
+                        } else {
+                            const float xr = rb[off];
+                            mag = fabsf(xr);
+                            yb[j] = static_cast<float>(
+                                static_cast<double>(g) * xr);
+                        }
+                        g += rate * (ref - mag * g);
+                        if (g < 0.0f) g = 0.0f;
+                        if (g > mg) g = mg;
+                    }
+                    s.agc_gain = g;
+                    pr[3] = g;          // live gain, read back by Python
                     in.tail += k;
                     int64_t yi = 0;
                     span_copy(s.ybuf.data(), 0, yi,

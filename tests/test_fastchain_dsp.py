@@ -166,6 +166,54 @@ def test_xlating_fir_with_connected_freq_port_not_fused():
     assert find_native_chains(fg) == []
 
 
+def test_agc_chain_matches_actor_path_and_writes_back_gain():
+    """FC_AGC: the per-sample feedback loop (blocks.Agc mode='sample') runs
+    natively; the final gain is written back to kernel.gain like the actor
+    path leaves it."""
+    from futuresdr_tpu.blocks import Agc
+    rng = np.random.default_rng(31)
+    iq = (0.25 * (rng.standard_normal(20_000) + 1j * rng.standard_normal(20_000))
+          ).astype(np.complex64)
+    gains = {}
+
+    def build():
+        fg = Flowgraph()
+        vs = VectorSink(np.complex64)
+        agc = Agc(np.complex64, reference=1.0, adjustment_rate=1e-3)
+        agc.fastchain_static = True    # promise: no gain_lock/reference calls
+        fg.connect(VectorSource(iq), CopyRand(np.complex64, max_copy=601,
+                                              seed=6), agc, vs)
+        gains["last"] = agc
+        return fg, vs
+
+    native, actor = _run_ab(build)
+    # _run_ab's second run was the actor build — its kernel holds actor gain
+    actor_gain = gains["last"].gain
+    fg_n, _ = build()
+    Runtime().run(fg_n)
+    native_gain = gains["last"].gain
+
+    np.testing.assert_allclose(native, actor, rtol=2e-5, atol=1e-6)
+    assert native_gain > 1.0           # quiet input: gain climbed
+    # glibc hypotf and numpy's npy_hypotf can differ by 1 ulp on |x|, so the
+    # 20k-step feedback trajectory lands within a few ulps, not bit-equal
+    np.testing.assert_allclose(native_gain, actor_gain, rtol=1e-6)
+
+
+def test_agc_not_fused_without_static_optin_or_in_block_mode():
+    from futuresdr_tpu.blocks import Agc
+    fg = Flowgraph()
+    fg.connect(VectorSource(np.zeros(1000, np.complex64)),
+               Agc(np.complex64), NullSink(np.complex64))
+    assert find_native_chains(fg) == []          # no opt-in
+    fg2 = Flowgraph()
+    a2 = Agc(np.complex64, mode="block")
+    a2.fastchain_static = True
+    fg2.connect(VectorSource(np.zeros(1000, np.complex64)), a2,
+                NullSink(np.complex64))
+    assert find_native_chains(fg2) == []         # block mode stays actor
+
+
 def test_kernel_state_writeback_after_fused_run():
     """Round-4 advisory: post-run attribute reads must match the actor path —
     Head.remaining hits 0, VectorSource shows its position consumed."""
