@@ -49,6 +49,10 @@ def run_device_resident(frame_frames: int, k_pair) -> tuple:
 
     pipe = Pipeline(front_end_stages(offset=100e3), np.complex64)
     frame = pipe.frame_multiple * frame_frames
+    # scale scan lengths so one k_lo scan covers ≥2M samples — sub-ms timed
+    # windows made fm_msps host-load sensitive (same fix as perf/lora.py)
+    scale = max(1, -(-2_000_000 // (k_pair[0] * frame)))
+    k_pair = (k_pair[0] * scale, k_pair[1] * scale)
     rng = np.random.default_rng(3)
     host = (rng.standard_normal(frame)
             + 1j * rng.standard_normal(frame)).astype(np.complex64)
