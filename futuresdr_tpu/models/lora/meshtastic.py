@@ -129,8 +129,16 @@ def encode_data_proto(portnum: int, payload: bytes) -> bytes:
 
 
 def decode_data_proto(b: bytes) -> Optional[Tuple[int, bytes]]:
-    """Parse (portnum, payload) from a Data message; None if malformed."""
+    """Parse (portnum, payload) from a Data message; None if malformed.
+
+    The portnum field must actually be PRESENT and nonzero: portnum 0 is
+    UNKNOWN_APP (never a deliverable packet — every real sender sets ≥ 1),
+    and a defaulted/zero portnum is exactly what a wrong-key decrypt looks
+    like when a 1-byte channel-hash collision lets garbage reach this parser
+    (round-5 fuzz campaign, offset 23253: a random channel's xor hash
+    collided with another channel's and the lenient parse returned (0, b''))."""
     portnum, payload = 0, b""
+    saw_port = False
     i = 0
     try:
         while i < len(b):
@@ -140,14 +148,19 @@ def decode_data_proto(b: bytes) -> Optional[Tuple[int, bytes]]:
                 v, i = _read_varint(b, i)
                 if field == 1:
                     portnum = v
+                    saw_port = True
             elif wire == 2:
                 ln, i = _read_varint(b, i)
+                if i + ln > len(b):
+                    return None        # truncated length: malformed, not short
                 if field == 2:
                     payload = b[i:i + ln]
                 i += ln
             else:
                 return None
     except IndexError:
+        return None
+    if not saw_port or portnum == 0:
         return None
     return portnum, payload
 
@@ -195,6 +208,10 @@ class MeshtasticChannel:
     def encode(self, text: str, sender: int = 0x3A48290E, packet_id: int = 1,
                dest: int = 0xFFFFFFFF, portnum: int = 1) -> MeshPacket:
         """Build an encrypted text packet (portnum 1 = TextMessageApp)."""
+        if portnum < 1:
+            # the decoder rejects portnum 0 (UNKNOWN_APP — the signature of a
+            # wrong-key decrypt); refuse to emit a packet no receiver takes
+            raise ValueError("portnum must be >= 1 (0 = UNKNOWN_APP)")
         plain = encode_data_proto(portnum, text.encode())
         enc = self._ctr(packet_id, sender).encryptor()
         return MeshPacket(dest=dest, sender=sender, packet_id=packet_id, flags=0,

@@ -272,3 +272,27 @@ def test_meshtastic_random_roundtrip_fuzz():
         assert back is not None and back[2].decode() == text, trial
         other = meshtastic.MeshtasticChannel("Other", "AQ==")
         assert other.decode(meshtastic.MeshPacket.parse(wire)) is None, trial
+
+
+def test_hash_collision_wrong_key_garbage_rejected():
+    """Regression (r5 fuzz campaign, offset 23253 trial 5): when a random
+    channel's 1-byte xor hash COLLIDES with another channel's, the wrong-key
+    decrypt reaches the Data parser — garbage must not parse as a packet.
+    The exact colliding configuration is pinned here."""
+    rng = np.random.default_rng(20101 + 23253)
+    key = sender = pid = text = None
+    for trial in range(6):
+        key = base64.b64encode(rng.integers(0, 256, 16).astype(np.uint8)
+                               .tobytes()).decode()
+        ch = meshtastic.MeshtasticChannel(f"Chan{trial}", key)
+        text = bytes(rng.integers(32, 127, int(rng.integers(1, 60)))
+                     .astype(np.uint8)).decode()
+        sender = int(rng.integers(1, 1 << 32))
+        pid = int(rng.integers(1, 1 << 32))
+    other = meshtastic.MeshtasticChannel("Other", "AQ==")
+    assert ch.hash == other.hash          # the collision that let garbage in
+    wire = ch.encode(text, sender=sender, packet_id=pid).to_bytes()
+    assert other.decode(meshtastic.MeshPacket.parse(wire)) is None
+    # the right channel still decodes (portnum-presence gate is not too strict)
+    got = meshtastic.decode_any([ch], wire)
+    assert got is not None and got[2].decode() == text
