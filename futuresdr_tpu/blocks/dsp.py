@@ -151,8 +151,15 @@ class Fir(Kernel):
     async def work(self, io, mio, meta):
         inp = self.input.slice()
         out = self.output.slice()
-        # consume what certainly fits: n_in such that ceil(n_in * interp / decim) <= len(out)
-        n_in = min(len(inp), (len(out) * self.decim) // self.interp)
+        if self.interp > 1:
+            # the resampler emits up to (I·n−1)//D + 1 outputs for n inputs
+            # (closed-form m_hi marginal); bound n so that never exceeds the
+            # out window
+            n_in = min(len(inp),
+                       max(0, ((len(out) - 1) * self.decim + 1) // self.interp))
+        else:
+            # decimating/plain: ceil(n/decim) outputs for n inputs
+            n_in = min(len(inp), len(out) * self.decim)
         if n_in > 0:
             y = self.core.process(inp[:n_in])
             assert len(y) <= len(out), "resampler produced more than negotiated"

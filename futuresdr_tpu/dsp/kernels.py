@@ -16,7 +16,24 @@ import numpy as np
 from scipy.signal import lfilter
 
 __all__ = ["FirFilter", "DecimatingFirFilter", "PolyphaseResamplingFir", "IirFilter",
-           "Rotator"]
+           "Rotator", "poly_resample_m_hi"]
+
+
+def poly_resample_m_hi(total: int, interp: int, decim: int) -> int:
+    """Outputs producible once ``total`` absolute inputs are visible: the
+    largest m with ``(m·D)//I ≤ total−1`` is ``(I·total−1)//D``, plus one.
+
+    THE single Python source of the resampler's producible-output contract
+    (used by :class:`PolyphaseResamplingFir` and the native fast-chain's sink
+    bound; mirrored once in C, ``native/fastchain.cpp resample_m_hi``). The
+    closed form also guarantees ``n_{m_hi} ≥ total``, so K−1 kept history
+    always covers the next chunk's windows — the former decrement-loop could
+    undershoot the boundary (e.g. I=12, D=5, total=37), deferring a producible
+    output past the kept history and making results CHUNK-DEPENDENT (round-5
+    fast-chain A/B finding)."""
+    if total <= 0:
+        return 0
+    return (interp * total - 1) // decim + 1
 
 
 class FirFilter:
@@ -119,14 +136,10 @@ class PolyphaseResamplingFir:
             self._consumed = -(self.K - 1)   # history is virtual zero-padding
         buf = np.concatenate([self._hist, x])
         total = self._consumed + len(buf)     # inputs available: absolute indices < total
-        # produce all m with n_m <= total - 1
-        if total <= 0:
-            m_hi = 0
-        else:
-            m_hi = ((total - 1) * self.interp + self.decim) // self.decim
-            while (m_hi * self.decim) // self.interp > total - 1:
-                m_hi -= 1
-            m_hi += 1
+        # produce ALL m with n_m <= total - 1 (see poly_resample_m_hi for why
+        # the closed form, and why the former decrement-loop was a
+        # chunk-dependence bug)
+        m_hi = poly_resample_m_hi(total, self.interp, self.decim)
         ms = np.arange(self._m, m_hi)
         if len(ms) == 0:
             out = np.zeros(0, dtype=buf.dtype)

@@ -61,7 +61,19 @@ log = logger("runtime.fastchain")
 # stage kinds — keep in sync with native/fastchain.cpp
 (FC_NULL_SOURCE, FC_HEAD, FC_COPY, FC_COPY_RAND, FC_NULL_SINK,
  FC_VEC_SOURCE, FC_VEC_SINK, FC_FIR_FF, FC_FIR_CF, FC_FIR_CC,
- FC_QUAD_DEMOD, FC_XLATING, FC_AGC) = range(13)
+ FC_QUAD_DEMOD, FC_XLATING, FC_AGC, FC_RESAMPLE) = range(14)
+
+
+def _resample_m_hi(total: int, interp: int, decim: int) -> int:
+    """Single-sourced from dsp.kernels (the C mirror lives in fastchain.cpp)."""
+    from ..dsp.kernels import poly_resample_m_hi
+    return poly_resample_m_hi(total, interp, decim)
+
+
+def _ring_items() -> int:
+    """The native chain's inter-stage ring size (perf override honored)."""
+    ring_env = os.environ.get("FSDR_FASTCHAIN_RING")
+    return max(1, int(ring_env)) if ring_env else 1 << 16
 
 _FIR_KINDS = (FC_FIR_FF, FC_FIR_CF, FC_FIR_CC, FC_XLATING)
 
@@ -95,7 +107,7 @@ def _load() -> Optional[ctypes.CDLL]:
     if lib is not None:
         try:
             lib.fsdr_fastchain_abi.restype = ctypes.c_int64
-            if lib.fsdr_fastchain_abi() != 4:
+            if lib.fsdr_fastchain_abi() != 5:
                 lib = None
         except AttributeError:
             lib = None
@@ -120,7 +132,8 @@ def _native_stage(kernel) -> Optional[tuple]:
     from ..blocks.stream import Copy, Head
     from ..blocks.vector import CopyRand, NullSink, NullSource, VectorSink, \
         VectorSource
-    from ..dsp.kernels import DecimatingFirFilter, FirFilter
+    from ..dsp.kernels import DecimatingFirFilter, FirFilter, \
+        PolyphaseResamplingFir
 
     if type(kernel) is NullSource:
         return (FC_NULL_SOURCE, 0, 0, 0.0, None)
@@ -160,8 +173,24 @@ def _native_stage(kernel) -> Optional[tuple]:
             if core._hist is not None:
                 return None
             taps, decim = core.taps, 1
+        elif isinstance(core, PolyphaseResamplingFir):
+            if core._hist is not None or core._m or core._consumed:
+                return None            # mid-stream state: actor path
+            if core.poly.dtype != np.float32 or \
+                    kernel.input.dtype not in (np.float32, np.complex64):
+                return None
+            # one input's output burst must fit the out ring with headroom,
+            # or the C driver's space-limited consume gets stuck at k=0
+            # forever (review: FSDR_FASTCHAIN_RING=8 + interp=16 would abort
+            # the flowgraph instead of falling back to the actor path)
+            if _resample_m_hi(1, int(core.interp), int(core.decim)) \
+                    > _ring_items() // 2:
+                return None
+            return (FC_RESAMPLE, int(core.K),
+                    int(core.interp) | (int(core.decim) << 32), 0.0,
+                    core.poly)         # [interp, K] row-major f32
         else:
-            return None                # polyphase resampler: actor path
+            return None
         port_dt = kernel.input.dtype
         if port_dt == np.float32 and taps.dtype == np.float32:
             kind = FC_FIR_FF
@@ -244,6 +273,8 @@ def _sink_bound(chain) -> Optional[int]:
             decim = p1 & 0xFFFFFFFF          # high bits carry the sym flag
             if decim > 1:
                 bound = -(-bound // decim)
+        elif kind == FC_RESAMPLE and bound is not None:
+            bound = _resample_m_hi(bound, p1 & 0xFFFFFFFF, p1 >> 32)
     return bound
 
 
@@ -342,9 +373,8 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
 
     ``FSDR_FASTCHAIN_RING`` overrides the inter-stage ring size in items
     (perf/buffer_rand.py sweeps it the way the reference sweeps buffer sizes)."""
-    ring_env = os.environ.get("FSDR_FASTCHAIN_RING")
-    if ring_env:
-        ring_items = max(1, int(ring_env))
+    ring_items = _ring_items() if os.environ.get("FSDR_FASTCHAIN_RING") \
+        else ring_items
     from .runtime import BlockDoneMsg, BlockErrorMsg, InitializedMsg
     from ..types import Pmt
 
@@ -418,8 +448,9 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
             elif kind == FC_VEC_SINK:
                 sink_buf = np.empty(int(bound), dtype=edges[-1])
                 data, p0 = sink_buf, int(bound)
-            elif kind in _FIR_KINDS:
-                data = np.ascontiguousarray(data)   # taps
+            elif kind in _FIR_KINDS or kind == FC_RESAMPLE:
+                data = np.ascontiguousarray(data)   # taps / poly matrix
+                # (the resampler's poly is a .T view — never hand C a stride)
             elif kind == FC_AGC:
                 agc_params[i] = data   # C writes the live gain into slot 3
             ptr = None

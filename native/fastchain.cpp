@@ -58,6 +58,9 @@ enum {
     FC_XLATING = 11,      // c64 rotate(f0=phase_inc) → f32-tap FIR → decim
     FC_AGC = 12,          // per-sample AGC: p0 = 1 if complex items,
                           // data = double[4]{reference, rate, max_gain, gain0}
+    FC_RESAMPLE = 13,     // rational polyphase resampler: p0 = K (sub-filter
+                          // len), p1 = interp | decim<<32, data = poly[I][K]
+                          // f32 row-major (dsp/kernels.py:88 layout)
 };
 
 struct FcStage {
@@ -250,7 +253,19 @@ struct StageState {
     float last_im = 0.0f;
     double rot_phase = 0.0;      // FC_XLATING rotator phase (dsp Rotator carry)
     double agc_gain = 1.0;       // FC_AGC feedback state (blocks/dsp.py Agc)
+    int64_t rs_m = 0;            // FC_RESAMPLE absolute output index
+    int64_t rs_total = 0;        // FC_RESAMPLE absolute inputs seen
 };
+
+// Outputs producible once `total` absolute inputs are visible: the largest m
+// with (m·D)//I ≤ total−1 is (I·total−1)//D, plus one — the closed form of
+// PolyphaseResamplingFir.process's m_hi (dsp/kernels.py; the core's former
+// decrement-loop undershot it for some I>D alignments, which the fast-chain
+// A/B exposed as chunk-dependent results — fixed together).
+inline int64_t resample_m_hi(int64_t total, int64_t I, int64_t D) {
+    if (total <= 0) return 0;
+    return (I * total - 1) / D + 1;
+}
 
 }  // namespace
 
@@ -258,7 +273,7 @@ extern "C" {
 
 // ABI version, checked by fastchain.py's _load(): bump on ANY FcStage layout
 // or protocol change so a stale .so can never be driven with a newer struct.
-int64_t fsdr_fastchain_abi(void) { return 4; }
+int64_t fsdr_fastchain_abi(void) { return 5; }
 
 // Run the chain to completion (sink finished) or until *stop becomes nonzero.
 // per_in[i]/per_out[i] accumulate items consumed/produced by stage i (sources
@@ -289,12 +304,16 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
     if (st[n - 1].kind != FC_NULL_SINK && st[n - 1].kind != FC_VEC_SINK)
         return -1;
     for (int i = 1; i + 1 < n; ++i) {
-        if (st[i].kind < FC_HEAD || st[i].kind > FC_AGC ||
+        if (st[i].kind < FC_HEAD || st[i].kind > FC_RESAMPLE ||
             st[i].kind == FC_NULL_SINK || st[i].kind == FC_VEC_SOURCE ||
             st[i].kind == FC_VEC_SINK)
             return -1;
         if (st[i].kind == FC_AGC && st[i].data == nullptr)
             return -1;                  // params block required
+        if (st[i].kind == FC_RESAMPLE &&
+            (st[i].p0 < 1 || (st[i].p1 & 0xFFFFFFFFLL) < 1 ||
+             (st[i].p1 >> 32) < 1 || st[i].data == nullptr))
+            return -1;                  // K / interp / decim / poly sanity
         // width conservation: every middle stage except the dtype-changing
         // demod must see equal in/out item sizes, or ring_copy would write
         // src-width items into a dst-width ring (defense in depth — the
@@ -344,6 +363,17 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
         if (st[i].kind == FC_AGC)
             ss[i].agc_gain =
                 reinterpret_cast<const double*>(st[i].data)[3];   // gain0
+        if (st[i].kind == FC_RESAMPLE) {
+            const int64_t in_isz = rings[i - 1].isz;
+            const int64_t K = st[i].p0;
+            ss[i].hist.assign(static_cast<size_t>((K - 1) * in_isz), 0);
+            ss[i].xbuf.resize(
+                static_cast<size_t>((K - 1 + ring_items) * in_isz));
+            std::memset(ss[i].xbuf.data(), 0,
+                        static_cast<size_t>((K - 1) * in_isz));
+            // per-chunk outputs are limited by out.space() ≤ ring_items
+            ss[i].ybuf.resize(static_cast<size_t>(ring_items * st[i].isz_out));
+        }
     }
     int64_t sink_count =
         (st[n - 1].kind == FC_VEC_SINK) ? -1 : st[n - 1].p0;  // -1 = until EOS
@@ -557,6 +587,80 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     progress = true;
                     if (per_in) per_in[i] += k;
                     if (per_out) per_out[i] += k;
+                    if (per_calls) per_calls[i] += 1;
+                }
+                if (in.eos && in.count() == 0) {
+                    out.eos = true;
+                    done[i] = true;
+                }
+                continue;
+            }
+            if (st[i].kind == FC_RESAMPLE) {
+                StageState& s = ss[i];
+                const int64_t K = st[i].p0;
+                const int64_t I = st[i].p1 & 0xFFFFFFFFLL;
+                const int64_t D = st[i].p1 >> 32;
+                const int64_t isz_in = in.isz;
+                const bool cx = isz_in == 8;
+                // max inputs consumable so producible outputs fit out.space():
+                // binary search the monotone m_hi(total_in + n') − m ≤ space
+                int64_t n_av = in.count(), space = out.space();
+                int64_t lo = 0, hi = n_av;
+                while (lo < hi) {
+                    const int64_t mid = (lo + hi + 1) / 2;
+                    if (resample_m_hi(s.rs_total + mid, I, D) - s.rs_m <= space)
+                        lo = mid;
+                    else
+                        hi = mid - 1;
+                }
+                const int64_t k = lo;
+                if (k > 0) {
+                    uint8_t* xb = s.xbuf.data();
+                    std::memcpy(xb, s.hist.data(), s.hist.size());
+                    int64_t xi = K - 1;
+                    span_copy(reinterpret_cast<const uint8_t*>(in.buf), in.cap,
+                              in.tail, xb, 0, xi, k, isz_in);
+                    const int64_t total = s.rs_total + k;
+                    const int64_t m_hi = resample_m_hi(total, I, D);
+                    const int64_t mcount = m_hi - s.rs_m;
+                    const float* poly =
+                        reinterpret_cast<const float*>(st[i].data);
+                    const float* xf = reinterpret_cast<const float*>(xb);
+                    float* yb = reinterpret_cast<float*>(s.ybuf.data());
+                    // abs index of xbuf[0] is rs_total − (K−1); windows never
+                    // reach below it (n_m ≥ rs_total for the first pending
+                    // output by m_hi's construction — the virtual-zero region
+                    // is the zeroed history prefix)
+                    const int64_t base = s.rs_total - (K - 1);
+                    for (int64_t j = 0; j < mcount; ++j) {
+                        const int64_t mj = s.rs_m + j;
+                        const int64_t pos = (mj * D) / I - base;
+                        const float* row = poly + ((mj * D) % I) * K;
+                        if (cx) {
+                            float ar = 0.0f, ai = 0.0f;
+                            for (int64_t t = 0; t < K; ++t) {
+                                ar += row[t] * xf[2 * (pos - t)];
+                                ai += row[t] * xf[2 * (pos - t) + 1];
+                            }
+                            yb[2 * j] = ar;
+                            yb[2 * j + 1] = ai;
+                        } else {
+                            float a = 0.0f;
+                            for (int64_t t = 0; t < K; ++t)
+                                a += row[t] * xf[pos - t];
+                            yb[j] = a;
+                        }
+                    }
+                    s.rs_m = m_hi;
+                    s.rs_total = total;
+                    std::memcpy(s.hist.data(), xb + k * isz_in, s.hist.size());
+                    int64_t yi = 0;
+                    span_copy(s.ybuf.data(), 0, yi,
+                              reinterpret_cast<uint8_t*>(out.buf), out.cap,
+                              out.head, mcount, st[i].isz_out);
+                    progress = true;
+                    if (per_in) per_in[i] += k;
+                    if (per_out) per_out[i] += mcount;
                     if (per_calls) per_calls[i] += 1;
                 }
                 if (in.eos && in.count() == 0) {

@@ -327,3 +327,40 @@ def test_fused_dsp_chain_live_metrics_over_rest():
     finally:
         running.stop_sync()
         cp.stop()
+
+
+@pytest.mark.parametrize("interp,decim,dtype", [
+    (3, 2, np.float32), (2, 5, np.float32), (12, 5, np.complex64),
+    (5, 12, np.complex64)])
+def test_rational_resampler_chain_matches_actor(interp, decim, dtype):
+    """FC_RESAMPLE: Fir(interp≠1) — the rational polyphase resampler — fuses
+    with exact output counts (the m_hi contract of dsp/kernels.py) and
+    allclose values across up/down ratios and both dtypes."""
+    taps = firdes.lowpass(0.4 / max(interp, decim), 48).astype(np.float32)
+    rng = np.random.default_rng(41)
+    n = 10_007                                     # odd on purpose
+    if dtype == np.complex64:
+        data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)) \
+            .astype(np.complex64)
+    else:
+        data = rng.standard_normal(n).astype(np.float32)
+
+    def build():
+        fg = Flowgraph()
+        vs = VectorSink(dtype)
+        fg.connect(VectorSource(data),
+                   CopyRand(dtype, max_copy=431, seed=9),
+                   Fir(taps, dtype, decim=decim, interp=interp), vs)
+        return fg, vs
+
+    native, actor = _run_ab(build)
+    assert len(native) == len(actor), (len(native), len(actor))
+    np.testing.assert_allclose(native, actor, rtol=3e-5, atol=3e-6)
+
+
+def test_resampler_f64_taps_not_fused():
+    taps = firdes.lowpass(0.1, 32)                 # float64
+    fg = Flowgraph()
+    fg.connect(VectorSource(np.zeros(1000, np.float32)),
+               Fir(taps, np.float32, interp=2, decim=3), NullSink(np.float32))
+    assert find_native_chains(fg) == []

@@ -47,3 +47,23 @@ def test_complex_taps_fall_back_to_stuffed():
     x = (np.random.default_rng(3).standard_normal(st.frame_multiple * 4)).astype(np.complex64)
     _, y = st.fn(st.init_carry(np.complex64), x)
     assert np.asarray(y).shape[0] == x.shape[0] * 2 // 3
+
+
+def test_chunked_processing_is_chunk_invariant():
+    """Regression (r5, found by the fast-chain A/B): the m_hi decrement-loop
+    undershot the producible-output boundary for some interp>decim alignments
+    (e.g. I=12, D=5, total=37), deferring an output past the K-1 kept history;
+    the next chunk then zero-filled part of its window, making results depend
+    on work-call chunking. The closed form (I*total-1)//D + 1 fixes it —
+    chunked processing must equal one-shot, bit for bit, at every split."""
+    from futuresdr_tpu.dsp.kernels import PolyphaseResamplingFir
+    rng = np.random.default_rng(55)
+    x = rng.standard_normal(300).astype(np.float32)
+    for interp, decim in ((12, 5), (5, 12), (3, 2), (7, 3), (1, 4)):
+        taps = rng.standard_normal(4 * interp).astype(np.float32)
+        ref = PolyphaseResamplingFir(interp, decim, taps).process(x)
+        for split in (1, 7, 37, 123, 299):
+            ch = PolyphaseResamplingFir(interp, decim, taps)
+            got = np.concatenate([ch.process(x[:split]),
+                                  ch.process(x[split:])])
+            np.testing.assert_array_equal(got, ref, err_msg=f"{interp}/{decim}@{split}")
