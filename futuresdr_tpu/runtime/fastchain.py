@@ -129,6 +129,7 @@ def _native_stage(kernel) -> Optional[tuple]:
     import numpy as np
 
     from ..blocks.dsp import Agc, Fir, QuadratureDemod, XlatingFir
+    from ..blocks.io import FileSource
     from ..blocks.stream import Copy, Head
     from ..blocks.vector import CopyRand, NullSink, NullSource, VectorSink, \
         VectorSource
@@ -163,6 +164,29 @@ def _native_stage(kernel) -> Optional[tuple]:
         if kernel._chunks:
             return None                # already holds data: actor path
         return (FC_VEC_SINK, -1, 0, 0.0, None)  # capacity bound resolved per chain
+    if type(kernel) is FileSource:
+        # replayed as a cyclic vector source over a one-shot RAM snapshot of
+        # the file (np.fromfile at build — NOT a memmap: a file truncated
+        # mid-run would SIGBUS the process through a map, where the actor
+        # path ends the stream gracefully; review). Semantics otherwise match
+        # the actor path: floor-division drops a trailing partial item,
+        # repeat loops the whole file, and a missing/empty/oversized file
+        # stays on the actor path. p0/p1 here are PROVISIONAL — _build_stages
+        # re-derives them from the bytes actually snapshotted, so a file that
+        # grows between launch and build cannot desynchronize the sink bound.
+        if kernel._f is not None or kernel.output.dtype is None:
+            return None                # already open / untyped: actor path
+        try:
+            size = os.path.getsize(kernel.path)
+        except OSError:
+            return None
+        if size > (256 << 20):
+            return None                # RAM snapshot too big: actor streams it
+        period = size // kernel.output.dtype.itemsize
+        if period == 0:
+            return None
+        return (FC_VEC_SOURCE, -1 if kernel.repeat else period, period, 0.0,
+                None)
     if type(kernel) is Fir:
         core = kernel.core
         if isinstance(core, DecimatingFirFilter):
@@ -251,20 +275,19 @@ def _native_stage(kernel) -> Optional[tuple]:
     return None
 
 
-def _sink_bound(chain) -> Optional[int]:
+def _sink_bound_specs(specs) -> Optional[int]:
     """Exact item count a chain's sink receives (None = unbounded): walk the
-    pipe in order, capping at every finite source/Head/sink budget and
+    stage specs in order, capping at every finite source/Head/sink budget and
     applying each stage's rate transform (Copy/CopyRand/plain-FIR/demod are
     count-preserving; a decimating FIR with fresh phase yields ceil(n/decim),
     chunk-invariantly — `dsp/kernels.py:70-81`)."""
     bound = None
-    for k in chain:
-        spec = _native_stage(k)
+    for spec in specs:
         if spec is None:
             return None
         kind, p0, p1 = spec[0], spec[1], spec[2]
         if kind == FC_VEC_SOURCE:
-            bound = p0
+            bound = None if p0 < 0 else p0   # p0 < 0 = infinite cyclic
         elif kind == FC_HEAD:
             bound = p0 if bound is None else min(bound, p0)
         elif kind == FC_NULL_SINK and p0 >= 0:
@@ -276,6 +299,10 @@ def _sink_bound(chain) -> Optional[int]:
         elif kind == FC_RESAMPLE and bound is not None:
             bound = _resample_m_hi(bound, p1 & 0xFFFFFFFF, p1 >> 32)
     return bound
+
+
+def _sink_bound(chain) -> Optional[int]:
+    return _sink_bound_specs([_native_stage(k) for k in chain])
 
 
 def find_native_chains(fg) -> List[List[object]]:
@@ -440,19 +467,42 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
         keepalive = []                 # numpy buffers the C side points into
         sink_buf = None
         agc_params = {}                # member idx → live params block
-        bound = _sink_bound(kernels)
+        from ..blocks.io import FileSource
+        # ONE _native_stage pass; FileSource budgets are then corrected from
+        # the bytes actually snapshotted, and the sink bound derives from the
+        # SAME corrected specs — a file growing between launch and build can
+        # no longer desynchronize the VectorSink capacity from the source
+        # budget (review)
+        specs = [list(_native_stage(b.kernel)) for b in members]
+        datas: list = [spec[4] for spec in specs]
         for i, b in enumerate(members):
-            kind, p0, p1, f0, data = _native_stage(b.kernel)
+            kind = specs[i][0]
             if kind == FC_VEC_SOURCE:
-                data = np.ascontiguousarray(b.kernel.items)
-            elif kind == FC_VEC_SINK:
-                sink_buf = np.empty(int(bound), dtype=edges[-1])
-                data, p0 = sink_buf, int(bound)
+                if type(b.kernel) is FileSource:
+                    # one-shot RAM snapshot (NOT a memmap: truncation mid-run
+                    # would SIGBUS through a map; the ≤256 MB gate is in the
+                    # registry)
+                    snap = np.fromfile(b.kernel.path, dtype=edges[0])
+                    if len(snap) == 0:
+                        raise ValueError(
+                            f"{b.kernel.path} emptied between launch and build")
+                    specs[i][2] = len(snap)
+                    specs[i][1] = -1 if b.kernel.repeat else len(snap)
+                    datas[i] = snap
+                else:
+                    datas[i] = np.ascontiguousarray(b.kernel.items)
             elif kind in _FIR_KINDS or kind == FC_RESAMPLE:
-                data = np.ascontiguousarray(data)   # taps / poly matrix
+                datas[i] = np.ascontiguousarray(datas[i])  # taps / poly
                 # (the resampler's poly is a .T view — never hand C a stride)
             elif kind == FC_AGC:
-                agc_params[i] = data   # C writes the live gain into slot 3
+                agc_params[i] = datas[i]  # C writes the live gain into slot 3
+        bound = _sink_bound_specs(specs)
+        for i, b in enumerate(members):
+            kind, p0, p1, f0, _ = specs[i]
+            data = datas[i]
+            if kind == FC_VEC_SINK:
+                sink_buf = np.empty(int(bound), dtype=edges[-1])
+                data, p0 = sink_buf, int(bound)
             ptr = None
             if data is not None:
                 keepalive.append(data)

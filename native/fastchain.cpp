@@ -61,6 +61,8 @@ enum {
     FC_RESAMPLE = 13,     // rational polyphase resampler: p0 = K (sub-filter
                           // len), p1 = interp | decim<<32, data = poly[I][K]
                           // f32 row-major (dsp/kernels.py:88 layout)
+    // FC_VEC_SOURCE with p0 < 0 = INFINITE cyclic emission (FileSource
+    // repeat=true over a memmap; bounded downstream by Head/sink count)
 };
 
 struct FcStage {
@@ -389,7 +391,9 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                 Ring& out = rings[0];
                 if (st[0].kind == FC_VEC_SOURCE) {
                     int64_t k = out.space();
-                    if (st[0].p0 - src_emitted < k) k = st[0].p0 - src_emitted;
+                    const bool finite = st[0].p0 >= 0;
+                    if (finite && st[0].p0 - src_emitted < k)
+                        k = st[0].p0 - src_emitted;
                     if (k > 0) {
                         // source data is a RING of period p1 (cyclic repeat)
                         span_copy(st[0].data, st[0].p1, src_emitted,
@@ -399,7 +403,10 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                         if (per_out) per_out[0] += k;
                         if (per_calls) per_calls[0] += 1;
                     }
-                    if (src_emitted >= st[0].p0) { out.eos = true; done[0] = true; }
+                    if (finite && src_emitted >= st[0].p0) {
+                        out.eos = true;
+                        done[0] = true;
+                    }
                     continue;
                 }
                 int64_t k = out.space();
