@@ -129,7 +129,7 @@ def _native_stage(kernel) -> Optional[tuple]:
     import numpy as np
 
     from ..blocks.dsp import Agc, Fir, QuadratureDemod, XlatingFir
-    from ..blocks.io import FileSource
+    from ..blocks.io import FileSink, FileSource
     from ..blocks.stream import Copy, Head
     from ..blocks.vector import CopyRand, NullSink, NullSource, VectorSink, \
         VectorSource
@@ -164,6 +164,15 @@ def _native_stage(kernel) -> Optional[tuple]:
         if kernel._chunks:
             return None                # already holds data: actor path
         return (FC_VEC_SINK, -1, 0, 0.0, None)  # capacity bound resolved per chain
+    if type(kernel) is FileSink:
+        # bounded chains only (same rule as VectorSink): the native sink
+        # collects into RAM and the final sync writes the file in one shot —
+        # a mid-run Terminate still flushes what was consumed, but an
+        # UNBOUNDED fused sink would buffer forever, so those stay streaming
+        # on the actor path
+        if kernel._f is not None or kernel.n_written:
+            return None                # already open/written: actor path
+        return (FC_VEC_SINK, -1, 0, 0.0, None)
     if type(kernel) is FileSource:
         # replayed as a cyclic vector source over a one-shot RAM snapshot of
         # the file (np.fromfile at build — NOT a memmap: a file truncated
@@ -352,9 +361,20 @@ def find_native_chains(fg) -> List[List[object]]:
             cur = nxt
         if len(chain) < 2 or chain[-1].stream_outputs:
             continue
+        from ..blocks.io import FileSink
         from ..blocks.vector import VectorSink
-        if type(chain[-1]) is VectorSink and _sink_bound(chain) is None:
-            continue                   # unbounded into a collecting sink
+        if type(chain[-1]) in (VectorSink, FileSink):
+            bound = _sink_bound(chain)
+            if bound is None:
+                continue               # unbounded into a collecting sink
+            if type(chain[-1]) is FileSink:
+                dts = _edge_dtypes(chain)
+                # the fused sink buffers the WHOLE bounded output in RAM
+                # before the one-shot flush; large bounded files stream
+                # O(ring) on the actor path instead (same 256 MB gate as
+                # the FileSource snapshot)
+                if dts is None or bound * dts[-1].itemsize > (256 << 20):
+                    continue
         if _edge_dtypes(chain) is None:
             continue                   # an edge's item width is unresolvable
         chains.append(chain)
@@ -467,7 +487,7 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
         keepalive = []                 # numpy buffers the C side points into
         sink_buf = None
         agc_params = {}                # member idx → live params block
-        from ..blocks.io import FileSource
+        from ..blocks.io import FileSink, FileSource
         # ONE _native_stage pass; FileSource budgets are then corrected from
         # the bytes actually snapshotted, and the sink bound derives from the
         # SAME corrected specs — a file growing between launch and build can
@@ -497,6 +517,12 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
             elif kind == FC_AGC:
                 agc_params[i] = datas[i]  # C writes the live gain into slot 3
         bound = _sink_bound_specs(specs)
+        if type(members[-1].kernel) is FileSink:
+            # actor-init parity: FileSink.init opens "wb" (creates/truncates
+            # the file even if the run later terminates early) — and doing it
+            # HERE, inside the guarded build, surfaces an unwritable path as
+            # BlockError exactly like the actor path's init failure
+            open(members[-1].kernel.path, "wb").close()
         for i, b in enumerate(members):
             kind, p0, p1, f0, _ = specs[i]
             data = datas[i]
@@ -603,6 +629,23 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
         elif i in agc_params:
             k.gain = float(agc_params[i][3])   # final feedback state
     if sink_buf is not None:
-        members[-1].kernel._chunks = [sink_buf[:int(per_in[n - 1])]]
+        from ..blocks.io import FileSink
+        sk = members[-1].kernel
+        got = sink_buf[:int(per_in[n - 1])]
+        if type(sk) is FileSink:
+            try:
+                # one-shot flush of the collected items — same bytes the
+                # actor path would have streamed out incrementally
+                got.tofile(sk.path)
+                sk.n_written = int(per_in[n - 1])
+            except OSError as e:       # disk full / path vanished mid-run:
+                # surface like an actor write failure, never hang the
+                # supervisor by dying before the done/error messages
+                fg_inbox.send(BlockErrorMsg(members[-1].id, e))
+                for b in members[:-1]:
+                    fg_inbox.send(BlockDoneMsg(b.id, b))
+                return
+        else:
+            sk._chunks = [got]
     del keepalive
     _finish_all()
