@@ -142,3 +142,36 @@ def test_direct_dispatch_preserves_order_and_metrics():
     assert [p.to_int() for p in snk.received] == list(range(n))
     w1 = fg.wrapped(c1)
     assert w1.metrics()["messages_handled"] >= n            # + finished marker
+
+
+def test_direct_dispatch_under_threaded_scheduler():
+    """Multi-loop scheduler: same-loop pairs may direct-dispatch, cross-loop
+    pairs must fall back to the inbox — either way every message arrives
+    exactly once, in per-sender order, across worker assignments."""
+    from futuresdr_tpu import ThreadedScheduler
+    from futuresdr_tpu.runtime.kernel import Kernel
+
+    n = 3_000
+
+    class CountSource(Kernel):
+        def __init__(self):
+            super().__init__()
+            self.add_message_output("out")
+
+        async def work(self, io, mio, meta):
+            for i in range(n):
+                await mio.post_async("out", Pmt.usize(i))
+            io.finished = True
+
+    fg = Flowgraph()
+    src = CountSource()
+    chain = [MessageCopy() for _ in range(4)]
+    snk = MessageSink()
+    fg.connect_message(src, "out", chain[0], "in")
+    for a, b in zip(chain, chain[1:]):
+        fg.connect_message(a, "out", b, "in")
+    fg.connect_message(chain[-1], "out", snk, "in")
+    rt = Runtime(ThreadedScheduler(workers=3))
+    rt.run(fg)
+    rt.shutdown()
+    assert [p.to_int() for p in snk.received] == list(range(n))
