@@ -144,7 +144,7 @@ def demodulate_payload_stream(samples: np.ndarray, sps: int = SPS):
     delay = len(h) - 1
     sync = _sync_symbols(SYNC_STR)
     n_frame_syms = 8 + 48 + 136
-    hits: List[tuple] = []                 # (norm, pos, fn, eos, chunk, lich)
+    hits: List[tuple] = []         # (norm, pos, fn, eos, chunk, lich, agree)
     for phase in range(sps):
         sym_stream = mf[delay + phase::sps] / gain
         if len(sym_stream) < n_frame_syms:
@@ -174,14 +174,31 @@ def demodulate_payload_stream(samples: np.ndarray, sps: int = SPS):
             llrs[0::2] = msb
             llrs[1::2] = lsb
             bits = codec.viterbi_decode_m17(codec.depuncture_p2(llrs, 296), 148)
+            # codeword validity score: re-encode the decoded bits and measure
+            # sign-agreement with the received LLRs. A correctly-framed hit
+            # re-encodes to ~100%; outright garbage sits near 50% (hard gate
+            # below). A MISFRAMED ghost is subtler — conv codes are
+            # time-invariant, so a shifted window still decodes to a mostly
+            # consistent codeword (~0.95) — but it never beats the true
+            # frame's exact agreement, so the score is the primary NMS rank
+            # (r5 fuzz campaign, offset 62682: a saturated-correlation ghost
+            # 330 samples early out-ranked the real EOS frame under noise
+            # when the rank was correlation alone, suppressing it).
+            recoded = codec.puncture_p2(codec.conv_encode_m17(bits))
+            k_cmp = min(len(recoded), len(llrs))
+            agree = float(np.mean((llrs[:k_cmp] > 0) == recoded[:k_cmp]))
+            if agree < 0.8:
+                continue                    # not a codeword at all
             body = np.packbits(bits[:144]).tobytes()
             fn_field = int.from_bytes(body[:2], "big")
             hits.append((float(norm[idx]), idx * sps + phase, fn_field & 0x7FFF,
-                         bool(fn_field & 0x8000), body[2:18], lich))
+                         bool(fn_field & 0x8000), body[2:18], lich, agree))
     # a correlation sidelobe or off-phase hit can pass the Golay gate while
     # garbling the un-CRC'd payload: non-maximum suppression in time keeps only
-    # the best-correlated hit within each frame-length window
-    hits.sort(key=lambda t: -t[0])
+    # the best hit within each frame-length window, ranked by codeword
+    # agreement FIRST (the sync correlation saturates at high SNR and cannot
+    # separate a misframed ghost from the true frame), correlation second
+    hits.sort(key=lambda t: (-t[6], -t[0]))
     min_gap = n_frame_syms * sps * 3 // 4
     accepted: List[tuple] = []
     lsfs = dict(_lsf_positions(samples, sps, content_dedup=False))
@@ -271,12 +288,12 @@ def _finish_group(group, lsfs) -> tuple:
         # reassemble from the cycling Golay-protected LICH chunks; the LSF CRC
         # (checked in Lsf.from_bytes) arbitrates
         chunks = {}
-        for _, _, _, _, (li, five) in group:
+        for _, _, _, _, (li, five), _agree in group:
             chunks.setdefault(li, five)
         if set(chunks) == set(range(6)):
             lsf = Lsf.from_bytes(b"".join(chunks[i] for i in range(6)))
     ordered = sorted(group, key=lambda f: f[1])
-    payload = b"".join(c for _, _, _, c, _ in ordered)
+    payload = b"".join(c for _, _, _, c, _, _ in ordered)
     fns = [f[1] for f in ordered]
     complete = group[-1][2] and fns == list(range(len(fns)))
     return lsf, payload, complete

@@ -249,3 +249,37 @@ def test_stream_frame_ghost_inside_lsf_rejected():
         l, p, complete = out[0]
         assert complete and (l.src, l.dst) == ("SQ8485", "RHHIUD")
         assert p[:44] == payload
+
+
+def test_misframed_ghost_does_not_suppress_eos_frame():
+    """Regression (r5 fuzz campaign, offset 62682 trial 7): a misframed hit
+    330 samples before the final frame correlated at saturation against the
+    stream sync, passed the Golay gate, and decoded a mostly-consistent
+    (shifted) codeword — under this exact noise draw it out-ranked the true
+    EOS frame in the NMS and suppressed it, so the transmission never
+    completed. Hits are now ranked by re-encode codeword agreement first
+    (the true frame is exact; a shifted window never is)."""
+    from futuresdr_tpu.models.m17 import (Lsf, build_stream_frames, modulate,
+                                          demodulate_payload_stream)
+    rng = np.random.default_rng(1717 + 62682)
+    alphabet = "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789"
+    cfg = None
+    for trial in range(8):
+        src = "".join(alphabet[int(rng.integers(0, 36))] for _ in range(6))
+        dst = "".join(alphabet[int(rng.integers(0, 36))] for _ in range(6))
+        n_pay = int(rng.integers(1, 97))
+        payload = rng.integers(0, 256, n_pay).astype(np.uint8).tobytes()
+        sig = modulate(build_stream_frames(Lsf(dst=dst, src=src), payload)) \
+            .astype(np.float32)
+        pad = int(rng.integers(100, 800))
+        x = np.concatenate([np.zeros(pad, np.float32), sig,
+                            np.zeros(300, np.float32)])
+        noise = 0.05 * rng.standard_normal(len(x))
+        if trial == 7:
+            cfg = (src, dst, n_pay, payload, (x + noise).astype(np.float32))
+    src, dst, n_pay, payload, x = cfg
+    out = demodulate_payload_stream(x)
+    assert len(out) == 1
+    l, p, complete = out[0]
+    assert complete and (l.src, l.dst) == (src, dst)
+    assert p[:n_pay] == payload
