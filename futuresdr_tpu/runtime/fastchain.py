@@ -7,12 +7,14 @@ blocks forward tiny chunks (its ``perf/null_rand`` regime, and the north-star
 Python's asyncio actor loop costs ~10 µs per ``work()`` call there; no amount
 of scheduling fixes that floor. This module takes the reference's answer one
 step further on the runtime side: a maximal LINEAR chain whose members are all
-native-capable (NullSource/Head/Copy/CopyRand/NullSink/VectorSource/VectorSink
-plus the DSP set: plain/decimating Fir over f32/c64 with f32/c64 taps,
-QuadratureDemod, and — with the explicit ``fastchain_static = True`` opt-in,
-because its live ``freq`` handler cannot reach a fused chain — XlatingFir),
-with no message edges, taps, broadcasts, or inplace edges,
-is lifted out of the actor plane entirely and executed by
+native-capable (NullSource/Head/Copy/CopyRand/NullSink/VectorSource/VectorSink,
+FileSource (≤256 MB RAM snapshot) and bounded FileSink (≤256 MB, one-shot
+flush), plus the DSP set: plain/decimating/rational-resampling Fir over
+f32/c64 with f32/c64 taps, QuadratureDemod, and — with the explicit
+``fastchain_static = True`` opt-in, because their live retune handlers cannot
+reach a fused chain — XlatingFir and sample-mode Agc), with no message edges,
+taps, broadcasts, or inplace edges, is lifted out of the actor plane entirely
+and executed by
 ``native/fastchain.cpp`` — one C++ thread round-robining the whole pipe over
 plain ring buffers (one pinned flow.rs worker that owns every block of the
 pipe). Stages carry their own output item size, so dtype-changing members
@@ -40,8 +42,13 @@ Known divergences from the actor path (documented per the round-4 advisory):
   executors).
 - Callbacks (``handle.call``) addressed to a fused member are answered with
   ``Pmt.invalid_value()`` — a fused chain is static. This is why
-  handler-bearing blocks (XlatingFir's ``freq``) require the
-  ``fastchain_static`` opt-in to fuse at all.
+  handler-bearing blocks (XlatingFir's ``freq``, Agc's ``gain_lock``/
+  ``reference_power``) require the ``fastchain_static`` opt-in to fuse at all.
+- A fused FileSink writes its file once at the END of the run (a mid-run
+  Terminate still flushes what was consumed; the file is created at stage
+  build for actor-init parity); the actor path streams writes incrementally.
+- A fused FileSource emits a launch-time SNAPSHOT of the file; bytes appended
+  after launch are not seen (the actor path would read them).
 """
 
 from __future__ import annotations
