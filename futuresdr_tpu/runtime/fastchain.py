@@ -68,7 +68,7 @@ log = logger("runtime.fastchain")
 # stage kinds — keep in sync with native/fastchain.cpp
 (FC_NULL_SOURCE, FC_HEAD, FC_COPY, FC_COPY_RAND, FC_NULL_SINK,
  FC_VEC_SOURCE, FC_VEC_SINK, FC_FIR_FF, FC_FIR_CF, FC_FIR_CC,
- FC_QUAD_DEMOD, FC_XLATING, FC_AGC, FC_RESAMPLE) = range(14)
+ FC_QUAD_DEMOD, FC_XLATING, FC_AGC, FC_RESAMPLE, FC_SIG) = range(15)
 
 
 def _resample_m_hi(total: int, interp: int, decim: int) -> int:
@@ -114,7 +114,7 @@ def _load() -> Optional[ctypes.CDLL]:
     if lib is not None:
         try:
             lib.fsdr_fastchain_abi.restype = ctypes.c_int64
-            if lib.fsdr_fastchain_abi() != 5:
+            if lib.fsdr_fastchain_abi() != 6:
                 lib = None
         except AttributeError:
             lib = None
@@ -135,7 +135,8 @@ def _native_stage(kernel) -> Optional[tuple]:
     blocks must be mirrored HERE or the kernel dropped from the registry."""
     import numpy as np
 
-    from ..blocks.dsp import Agc, Fir, QuadratureDemod, XlatingFir
+    from ..blocks.dsp import Agc, Fir, QuadratureDemod, SignalSource, \
+        XlatingFir
     from ..blocks.io import FileSink, FileSource
     from ..blocks.stream import Copy, Head
     from ..blocks.vector import CopyRand, NullSink, NullSource, VectorSink, \
@@ -272,6 +273,30 @@ def _native_stage(kernel) -> Optional[tuple]:
         return (FC_XLATING, len(taps),
                 int(fir.decim) | (int(sym) << 32),
                 float(kernel.rotator.phase_inc), taps)
+    if type(kernel) is SignalSource:
+        # same static opt-in rule: SignalSource has live freq/amplitude
+        # handlers a fused chain cannot service. Only the fxpt NCO fuses —
+        # its wrapping-u32 phase schedule is integer, so the native ramp is
+        # BIT-exact vs the Python block (the float-accumulator variant would
+        # drift differently and stays on the actor path).
+        if not getattr(kernel, "fastchain_static", False):
+            return None
+        if kernel.nco != "fxpt":
+            return None
+        wf = {"sin": 0, "cos": 1, "complex": 2, "square": 3}[kernel.waveform]
+        dt = kernel.output.dtype
+        if dt not in (np.float32, np.complex64) or \
+                (wf == 2) != (dt == np.complex64):
+            return None
+        params = np.array([kernel.amplitude, kernel.offset], dtype=np.float64)
+        packed = (int(kernel._inc_i) & 0xFFFFFFFF) \
+            | ((int(kernel._phase_i) & 0xFFFFFFFF) << 32)
+        # two's-complement wrap: a start phase with the high bit set would
+        # overflow ctypes' c_int64 otherwise (review); C recovers the words
+        # with unsigned casts either way
+        if packed >= 1 << 63:
+            packed -= 1 << 64
+        return (FC_SIG, wf, packed, 0.0, params)
     if type(kernel) is Agc:
         # same static opt-in as XlatingFir: Agc has live gain_lock /
         # reference_power handlers a fused chain cannot service
@@ -635,6 +660,11 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
             k._round, k._pos = divmod(int(per_out[i]), len(k.items))
         elif i in agc_params:
             k.gain = float(agc_params[i][3])   # final feedback state
+        elif stages[i].kind == FC_SIG:
+            from ..dsp import fxpt
+            # same wrap-advance the actor work() applies per chunk
+            k._phase_i = fxpt.advance_u32(k._phase_i, k._inc_i,
+                                          int(per_out[i]))
     if sink_buf is not None:
         from ..blocks.io import FileSink
         sk = members[-1].kernel

@@ -63,6 +63,11 @@ enum {
                           // f32 row-major (dsp/kernels.py:88 layout)
     // FC_VEC_SOURCE with p0 < 0 = INFINITE cyclic emission (FileSource
     // repeat=true over a memmap; bounded downstream by Head/sink count)
+    FC_SIG = 14,          // fxpt NCO source: p0 = waveform (0 sin, 1 cos,
+                          // 2 complex, 3 square), p1 = inc_u32 | start<<32,
+                          // data = double[2]{amplitude, offset}. The phase is
+                          // a wrapping u32 (dsp/fxpt.py) — integer, so the
+                          // native ramp is BIT-exact vs the Python block.
 };
 
 struct FcStage {
@@ -275,7 +280,7 @@ extern "C" {
 
 // ABI version, checked by fastchain.py's _load(): bump on ANY FcStage layout
 // or protocol change so a stale .so can never be driven with a newer struct.
-int64_t fsdr_fastchain_abi(void) { return 5; }
+int64_t fsdr_fastchain_abi(void) { return 6; }
 
 // Run the chain to completion (sink finished) or until *stop becomes nonzero.
 // per_in[i]/per_out[i] accumulate items consumed/produced by stage i (sources
@@ -302,7 +307,10 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
              st[i].data == nullptr))
             return -1;                   // ntaps/decim/taps sanity
     }
-    if (st[0].kind != FC_NULL_SOURCE && st[0].kind != FC_VEC_SOURCE) return -1;
+    if (st[0].kind != FC_NULL_SOURCE && st[0].kind != FC_VEC_SOURCE &&
+        st[0].kind != FC_SIG)
+        return -1;
+    if (st[0].kind == FC_SIG && st[0].data == nullptr) return -1;
     if (st[n - 1].kind != FC_NULL_SINK && st[n - 1].kind != FC_VEC_SINK)
         return -1;
     for (int i = 1; i + 1 < n; ++i) {
@@ -360,7 +368,8 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                         static_cast<size_t>((st[i].p0 - 1) * in_isz));
             ss[i].ybuf.resize(static_cast<size_t>(ring_items * st[i].isz_out));
         }
-        if (st[i].kind == FC_QUAD_DEMOD || st[i].kind == FC_AGC)
+        if (st[i].kind == FC_QUAD_DEMOD || st[i].kind == FC_AGC ||
+            st[i].kind == FC_SIG)
             ss[i].ybuf.resize(static_cast<size_t>(ring_items * st[i].isz_out));
         if (st[i].kind == FC_AGC)
             ss[i].agc_gain =
@@ -408,6 +417,51 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                         done[0] = true;
                     }
                     continue;
+                }
+                if (st[0].kind == FC_SIG) {
+                    int64_t k = out.space();
+                    if (k > 0) {
+                        const double* pr =
+                            reinterpret_cast<const double*>(st[0].data);
+                        const double amp = pr[0], off = pr[1];
+                        const uint32_t inc =
+                            static_cast<uint32_t>(st[0].p1 & 0xFFFFFFFFLL);
+                        const uint32_t ph0 =
+                            static_cast<uint32_t>(st[0].p1 >> 32);
+                        const int64_t wf = st[0].p0;
+                        float* yb = reinterpret_cast<float*>(ss[0].ybuf.data());
+                        for (int64_t j = 0; j < k; ++j) {
+                            // wrapping-u32 ramp: ph0 + inc*(emitted + j), the
+                            // EXACT integer schedule of fxpt.phase_ramp_i32
+                            const uint32_t pu = ph0 + inc *
+                                static_cast<uint32_t>(
+                                    (src_emitted + j) & 0xFFFFFFFFLL);
+                            const double ph =
+                                static_cast<double>(static_cast<int32_t>(pu)) *
+                                (M_PI / 2147483648.0);
+                            if (wf == 2) {            // complex exponential
+                                double sd, cd;
+                                ::sincos(ph, &sd, &cd);
+                                yb[2 * j] = static_cast<float>(amp * cd + off);
+                                yb[2 * j + 1] = static_cast<float>(amp * sd);
+                            } else {
+                                double y = std::sin(ph);
+                                if (wf == 1) y = std::cos(ph);
+                                else if (wf == 3)
+                                    y = (y > 0) - (y < 0);    // np.sign(sin)
+                                yb[j] = static_cast<float>(amp * y + off);
+                            }
+                        }
+                        int64_t yi = 0;
+                        span_copy(ss[0].ybuf.data(), 0, yi,
+                                  reinterpret_cast<uint8_t*>(out.buf), out.cap,
+                                  out.head, k, out.isz);
+                        src_emitted += k;
+                        progress = true;
+                        if (per_out) per_out[0] += k;
+                        if (per_calls) per_calls[0] += 1;
+                    }
+                    continue;                         // never EOS on its own
                 }
                 int64_t k = out.space();
                 if (k > 0) {

@@ -527,3 +527,64 @@ def test_terminate_stops_fused_dsp_chain():
     m = w.metrics()
     # consumed ≈ produced × decim (within one in-flight chunk)
     assert m["items_in"]["in"] >= 4 * m["items_out"]["out"] > 0
+
+
+def test_signal_source_chain_bit_exact():
+    """FC_SIG: the fxpt NCO source fuses with a BIT-exact phase schedule (the
+    wrapping-u32 ramp is integer) — sample values match the actor path to
+    float32 rounding of the same f64 trig, and the tone lands on frequency."""
+    from futuresdr_tpu.blocks import SignalSource
+
+    from futuresdr_tpu.dsp import fxpt
+    sigs = {}
+
+    def build(waveform, dtype):
+        fg = Flowgraph()
+        vs = VectorSink(dtype)
+        sig = SignalSource(waveform, 12_500.0, 250e3, amplitude=0.8,
+                           offset=0.1)
+        sig.fastchain_static = True    # promise: no runtime freq/amp calls
+        sigs["last"] = sig
+        fg.connect(sig, Head(dtype, 50_000), vs)
+        return fg, vs
+
+    for waveform, dtype in (("complex", np.complex64), ("sin", np.float32),
+                            ("square", np.float32)):
+        fg, vs = build(waveform, dtype)
+        assert len(find_native_chains(fg)) == 1, waveform
+        Runtime().run(fg)
+        native = vs.items().copy()
+        # NCO phase write-back: post-fused-run state matches the actor
+        # path's wrap-advance over everything the source EMITTED (the ring
+        # swallows more than Head forwards)
+        sig_n = sigs["last"]
+        assert sig_n._phase_i == fxpt.advance_u32(
+            0, sig_n._inc_i, sig_n.output.items_produced)
+        os.environ["FSDR_NO_FASTCHAIN"] = "1"
+        try:
+            fg2, vs2 = build(waveform, dtype)
+            Runtime().run(fg2)
+        finally:
+            os.environ.pop("FSDR_NO_FASTCHAIN", None)
+        actor = vs2.items()
+        assert len(native) == len(actor) == 50_000
+        np.testing.assert_allclose(native, actor, rtol=1e-6, atol=1e-6,
+                                   err_msg=waveform)
+        if waveform == "complex":
+            # single-sided spectral check: the complex tone lands on its bin
+            # (a real waveform would have an equal mirror bin — review)
+            spec = np.abs(np.fft.fft(native[:16384]))
+            assert np.argmax(spec) == round(12_500.0 / 250e3 * 16384)
+
+
+def test_signal_source_not_fused_without_optin_or_float_nco():
+    from futuresdr_tpu.blocks import SignalSource
+    fg = Flowgraph()
+    fg.connect(SignalSource("sin", 1e3, 48e3), Head(np.float32, 100),
+               NullSink(np.float32))
+    assert find_native_chains(fg) == []          # no opt-in
+    fg2 = Flowgraph()
+    s2 = SignalSource("sin", 1e3, 48e3, nco="float")
+    s2.fastchain_static = True
+    fg2.connect(s2, Head(np.float32, 100), NullSink(np.float32))
+    assert find_native_chains(fg2) == []         # float NCO stays actor

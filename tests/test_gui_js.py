@@ -388,7 +388,19 @@ def test_exec_handle_against_real_rest_server():
         fg.connect_message(src, "out", snk, "in")
         rt = Runtime()
         running = rt.start(fg)
-        time.sleep(0.3)
+        # readiness poll: the control-port server binds on the scheduler loop
+        # asynchronously — a fixed sleep raced it under full-suite load (the
+        # one flaky failure of round 5's suite runs)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(
+                    "http://127.0.0.1:18339/api/fg/0/", timeout=2).read()
+                break
+            except Exception:
+                time.sleep(0.1)
+        else:
+            raise RuntimeError("control port never became ready")
 
         def fetch(url, opts=UNDEF):
             req = urllib.request.Request(url)
