@@ -12,7 +12,8 @@ FileSource (≤256 MB RAM snapshot) and bounded FileSink (≤256 MB, one-shot
 flush), plus the DSP set: plain/decimating/rational-resampling Fir over
 f32/c64 with f32/c64 taps, QuadratureDemod, and — with the explicit
 ``fastchain_static = True`` opt-in, because their live retune handlers cannot
-reach a fused chain — XlatingFir and sample-mode Agc), with no message edges,
+reach a fused chain — XlatingFir, sample-mode Agc, and the fxpt-NCO
+SignalSource), with no message edges,
 taps, broadcasts, or inplace edges, is lifted out of the actor plane entirely
 and executed by
 ``native/fastchain.cpp`` — one C++ thread round-robining the whole pipe over

@@ -430,26 +430,58 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                             static_cast<uint32_t>(st[0].p1 >> 32);
                         const int64_t wf = st[0].p0;
                         float* yb = reinterpret_cast<float*>(ss[0].ybuf.data());
-                        for (int64_t j = 0; j < k; ++j) {
-                            // wrapping-u32 ramp: ph0 + inc*(emitted + j), the
-                            // EXACT integer schedule of fxpt.phase_ramp_i32
-                            const uint32_t pu = ph0 + inc *
-                                static_cast<uint32_t>(
-                                    (src_emitted + j) & 0xFFFFFFFFLL);
-                            const double ph =
-                                static_cast<double>(static_cast<int32_t>(pu)) *
-                                (M_PI / 2147483648.0);
-                            if (wf == 2) {            // complex exponential
-                                double sd, cd;
-                                ::sincos(ph, &sd, &cd);
-                                yb[2 * j] = static_cast<float>(amp * cd + off);
-                                yb[2 * j + 1] = static_cast<float>(amp * sd);
-                            } else {
-                                double y = std::sin(ph);
-                                if (wf == 1) y = std::cos(ph);
-                                else if (wf == 3)
-                                    y = (y > 0) - (y < 0);    // np.sign(sin)
+                        const double scale = M_PI / 2147483648.0;
+                        // square: the sign of sin(ph) is exactly the sign of
+                        // the int32 phase (ph in [-pi, pi); sin(-pi) in f64 is
+                        // a tiny negative, matching numpy) — no trig at all
+                        if (wf == 3) {
+                            for (int64_t j = 0; j < k; ++j) {
+                                const uint32_t pu = ph0 + inc *
+                                    static_cast<uint32_t>(
+                                        (src_emitted + j) & 0xFFFFFFFFLL);
+                                const int32_t pi_ = static_cast<int32_t>(pu);
+                                const double y = (pi_ > 0) - (pi_ < 0);
                                 yb[j] = static_cast<float>(amp * y + off);
+                            }
+                        } else {
+                            // chunk-anchored rotation: one exact sincos per
+                            // 256 samples (re-anchored on the INTEGER phase,
+                            // so error never exceeds ~256 rotations of f64
+                            // eps ≈ 1e-13 — far inside the f32 cast), then a
+                            // complex recurrence — ~10x over per-sample libm
+                            // trig, which lost to numpy's SIMD sin otherwise
+                            const double inc_rad =
+                                static_cast<double>(static_cast<int32_t>(inc))
+                                * scale;
+                            double rs, rc;
+                            ::sincos(inc_rad, &rs, &rc);
+                            for (int64_t j0 = 0; j0 < k; j0 += 256) {
+                                const int64_t jb =
+                                    (k - j0 < 256) ? k - j0 : 256;
+                                const uint32_t pu = ph0 + inc *
+                                    static_cast<uint32_t>(
+                                        (src_emitted + j0) & 0xFFFFFFFFLL);
+                                double cs, cc;
+                                ::sincos(static_cast<double>(
+                                             static_cast<int32_t>(pu)) * scale,
+                                         &cs, &cc);
+                                for (int64_t j = 0; j < jb; ++j) {
+                                    if (wf == 2) {
+                                        yb[2 * (j0 + j)] = static_cast<float>(
+                                            amp * cc + off);
+                                        yb[2 * (j0 + j) + 1] =
+                                            static_cast<float>(amp * cs);
+                                    } else if (wf == 1) {
+                                        yb[j0 + j] = static_cast<float>(
+                                            amp * cc + off);
+                                    } else {
+                                        yb[j0 + j] = static_cast<float>(
+                                            amp * cs + off);
+                                    }
+                                    const double nc = cc * rc - cs * rs;
+                                    cs = cc * rs + cs * rc;
+                                    cc = nc;
+                                }
                             }
                         }
                         int64_t yi = 0;
