@@ -4,7 +4,7 @@ round; r5: 200/200 clean). Monkeypatches np.random.default_rng so each
 hardcoded seed lands on fresh sweep configurations.
 
 Usage: python perf/fuzz_campaign.py [comma-separated offsets]
-(default: 10 offsets x 10 family fuzzes)."""
+(default: 10 offsets x 11 family fuzzes)."""
 import importlib
 import os
 import sys
@@ -29,6 +29,7 @@ FUZZES = [
     ("tests.test_robustness", "test_random_topology_fuzz"),
     ("tests.test_wlan", "test_random_config_roundtrip_fuzz"),
     ("tests.test_zigbee", "test_random_payload_roundtrip_fuzz"),
+    ("tests.test_fastchain_dsp", "test_random_chain_shapes_fuzz"),
 ]
 
 _orig_rng = np.random.default_rng

@@ -588,3 +588,92 @@ def test_signal_source_not_fused_without_optin_or_float_nco():
     s2.fastchain_static = True
     fg2.connect(s2, Head(np.float32, 100), NullSink(np.float32))
     assert find_native_chains(fg2) == []         # float NCO stays actor
+
+
+def test_random_chain_shapes_fuzz():
+    """Seeded sweep over random ELIGIBLE chain shapes: stage mixes across
+    both dtype lanes (copies, plain/decim/resampling FIRs, xlating, AGC,
+    quad demod), random data and chunking — every fused chain must match its
+    actor twin. The chain-composition analog of the receiver family fuzzes;
+    also run by perf/fuzz_campaign.py with shifted seeds."""
+    from futuresdr_tpu.blocks import Agc, XlatingFir
+    if not fastchain_available():
+        return          # campaign calls this directly, bypassing the skipif
+    rng = np.random.default_rng(4242)
+    for trial in range(6):
+        complex_lane = bool(rng.integers(0, 2))
+        dt = np.complex64 if complex_lane else np.float32
+        n = int(rng.integers(6_000, 20_000))
+        if complex_lane:
+            data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)) \
+                .astype(np.complex64)
+        else:
+            data = rng.standard_normal(n).astype(np.float32)
+        n_stages = int(rng.integers(1, 5))
+        spec = []
+        for _ in range(n_stages):
+            kind = rng.choice(["copyrand", "fir", "decim", "resample",
+                               "xlating", "agc"] if complex_lane else
+                              ["copyrand", "fir", "decim", "resample"])
+            spec.append(str(kind))
+        demod_tail = complex_lane and bool(rng.integers(0, 2))
+
+        def build():
+            nonlocal rng_b
+            rng_b = np.random.default_rng(pseed)   # identical params per path
+            fg = Flowgraph()
+            src = VectorSource(data)
+            last = src
+            cur_dt = dt
+            for kind in spec:
+                if kind == "copyrand":
+                    b = CopyRand(cur_dt, int(rng_b.integers(64, 1024)),
+                                 seed=int(rng_b.integers(1, 99)))
+                elif kind == "fir":
+                    b = Fir(firdes.lowpass(0.2, int(rng_b.integers(8, 65))
+                                           ).astype(np.float32), cur_dt)
+                elif kind == "decim":
+                    b = Fir(firdes.lowpass(0.1, 32).astype(np.float32),
+                            cur_dt, decim=int(rng_b.integers(2, 5)))
+                elif kind == "resample":
+                    b = Fir(firdes.lowpass(0.1, 24).astype(np.float32),
+                            cur_dt, interp=int(rng_b.integers(2, 4)),
+                            decim=int(rng_b.integers(2, 6)))
+                elif kind == "xlating":
+                    b = XlatingFir(firdes.lowpass(0.1, 32).astype(np.float32),
+                                   decim=int(rng_b.integers(1, 4)),
+                                   offset_freq=float(rng_b.uniform(-2e4, 2e4)),
+                                   sample_rate=250e3)
+                    b.fastchain_static = True
+                else:
+                    b = Agc(cur_dt, reference=0.8, adjustment_rate=1e-3)
+                    b.fastchain_static = True
+                fg.connect(last, b)
+                last = b
+            if demod_tail:
+                b = QuadratureDemod(gain=float(rng_b.uniform(0.3, 2.0)))
+                gains["demod"] = b.gain
+                fg.connect(last, b)
+                last = b
+                cur_dt = np.float32
+            vs = VectorSink(cur_dt)
+            fg.connect(last, vs)
+            return fg, vs
+
+        gains = {}
+        pseed = int(rng.integers(0, 1 << 30))
+        rng_b = None
+        native, actor = _run_ab(build)
+        assert len(native) == len(actor), (trial, spec)
+        bad = ~np.isclose(native, actor, rtol=5e-4, atol=5e-5)
+        if demod_tail and bad.any():
+            # the demod's ±π branch cut: a 1-ulp FIR difference can flip
+            # atan2 across the cut, giving wrap-EQUIVALENT outputs that
+            # differ by exactly 2π·gain — both are correct demod values
+            wrap = 2 * np.pi * gains["demod"]
+            np.testing.assert_allclose(
+                np.abs(np.asarray(native)[bad] - np.asarray(actor)[bad]),
+                wrap, rtol=1e-3,
+                err_msg=f"{trial} {spec} non-wrap mismatch")
+        else:
+            assert not bad.any(), (trial, spec, int(bad.sum()))
