@@ -69,7 +69,8 @@ log = logger("runtime.fastchain")
 # stage kinds — keep in sync with native/fastchain.cpp
 (FC_NULL_SOURCE, FC_HEAD, FC_COPY, FC_COPY_RAND, FC_NULL_SINK,
  FC_VEC_SOURCE, FC_VEC_SINK, FC_FIR_FF, FC_FIR_CF, FC_FIR_CC,
- FC_QUAD_DEMOD, FC_XLATING, FC_AGC, FC_RESAMPLE, FC_SIG) = range(15)
+ FC_QUAD_DEMOD, FC_XLATING, FC_AGC, FC_RESAMPLE, FC_SIG,
+ FC_DELAY) = range(16)
 
 
 def _resample_m_hi(total: int, interp: int, decim: int) -> int:
@@ -115,7 +116,7 @@ def _load() -> Optional[ctypes.CDLL]:
     if lib is not None:
         try:
             lib.fsdr_fastchain_abi.restype = ctypes.c_int64
-            if lib.fsdr_fastchain_abi() != 6:
+            if lib.fsdr_fastchain_abi() != 7:
                 lib = None
         except AttributeError:
             lib = None
@@ -139,7 +140,7 @@ def _native_stage(kernel) -> Optional[tuple]:
     from ..blocks.dsp import Agc, Fir, QuadratureDemod, SignalSource, \
         XlatingFir
     from ..blocks.io import FileSink, FileSource
-    from ..blocks.stream import Copy, Head
+    from ..blocks.stream import Copy, Delay, Head
     from ..blocks.vector import CopyRand, NullSink, NullSource, VectorSink, \
         VectorSource
     from ..dsp.kernels import DecimatingFirFilter, FirFilter, \
@@ -274,6 +275,12 @@ def _native_stage(kernel) -> Optional[tuple]:
         return (FC_XLATING, len(taps),
                 int(fir.decim) | (int(sym) << 32),
                 float(kernel.rotator.phase_inc), taps)
+    if type(kernel) is Delay:
+        # static opt-in: Delay has a live new_value handler a fused chain
+        # cannot service (the same rule as every handler-bearing block)
+        if not getattr(kernel, "fastchain_static", False):
+            return None
+        return (FC_DELAY, int(kernel._pad), int(kernel._skip), 0.0, None)
     if type(kernel) is SignalSource:
         # same static opt-in rule: SignalSource has live freq/amplitude
         # handlers a fused chain cannot service. Only the fxpt NCO fuses —
@@ -340,6 +347,8 @@ def _sink_bound_specs(specs) -> Optional[int]:
                 bound = -(-bound // decim)
         elif kind == FC_RESAMPLE and bound is not None:
             bound = _resample_m_hi(bound, p1 & 0xFFFFFFFF, p1 >> 32)
+        elif kind == FC_DELAY and bound is not None:
+            bound = p0 + max(0, bound - p1)   # pad + post-skip passthrough
     return bound
 
 

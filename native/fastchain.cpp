@@ -68,6 +68,8 @@ enum {
                           // data = double[2]{amplitude, offset}. The phase is
                           // a wrapping u32 (dsp/fxpt.py) — integer, so the
                           // native ramp is BIT-exact vs the Python block.
+    FC_DELAY = 15,        // p0 = pad (leading zero items), p1 = skip
+                          // (leading input items dropped); then 1:1 copy
 };
 
 struct FcStage {
@@ -280,7 +282,7 @@ extern "C" {
 
 // ABI version, checked by fastchain.py's _load(): bump on ANY FcStage layout
 // or protocol change so a stale .so can never be driven with a newer struct.
-int64_t fsdr_fastchain_abi(void) { return 6; }
+int64_t fsdr_fastchain_abi(void) { return 7; }
 
 // Run the chain to completion (sink finished) or until *stop becomes nonzero.
 // per_in[i]/per_out[i] accumulate items consumed/produced by stage i (sources
@@ -314,7 +316,8 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
     if (st[n - 1].kind != FC_NULL_SINK && st[n - 1].kind != FC_VEC_SINK)
         return -1;
     for (int i = 1; i + 1 < n; ++i) {
-        if (st[i].kind < FC_HEAD || st[i].kind > FC_RESAMPLE ||
+        if (st[i].kind < FC_HEAD || st[i].kind > FC_DELAY ||
+            st[i].kind == FC_SIG ||
             st[i].kind == FC_NULL_SINK || st[i].kind == FC_VEC_SOURCE ||
             st[i].kind == FC_VEC_SINK)
             return -1;
@@ -374,6 +377,10 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
         if (st[i].kind == FC_AGC)
             ss[i].agc_gain =
                 reinterpret_cast<const double*>(st[i].data)[3];   // gain0
+        if (st[i].kind == FC_DELAY) {
+            ss[i].rs_m = st[i].p0;       // pad remaining
+            ss[i].rs_total = st[i].p1;   // skip remaining
+        }
         if (st[i].kind == FC_RESAMPLE) {
             const int64_t in_isz = rings[i - 1].isz;
             const int64_t K = st[i].p0;
@@ -759,6 +766,49 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                 if (in.eos && in.count() == 0) {
                     out.eos = true;
                     done[i] = true;
+                }
+                continue;
+            }
+            if (st[i].kind == FC_DELAY) {
+                StageState& s = ss[i];
+                // 1. flush leading zero padding (delay.rs Pad state)
+                if (s.rs_m > 0) {
+                    int64_t k = out.space() < s.rs_m ? out.space() : s.rs_m;
+                    if (k > 0 && per_calls) per_calls[i] += 1;
+                    while (k > 0) {
+                        const int64_t off = out.head % out.cap;
+                        int64_t c = out.cap - off < k ? out.cap - off : k;
+                        std::memset(out.buf + off * out.isz, 0,
+                                    static_cast<size_t>(c * out.isz));
+                        out.head += c;
+                        s.rs_m -= c;
+                        k -= c;
+                        progress = true;
+                        if (per_out) per_out[i] += c;
+                    }
+                }
+                // 2. drop leading inputs (negative delay)
+                if (s.rs_total > 0 && in.count() > 0) {
+                    int64_t k = in.count() < s.rs_total ? in.count()
+                                                        : s.rs_total;
+                    in.tail += k;
+                    s.rs_total -= k;
+                    progress = true;
+                    if (per_in) per_in[i] += k;
+                }
+                // 3. 1:1 copy
+                int64_t k = in.count();
+                if (out.space() < k) k = out.space();
+                if (k > 0) {
+                    ring_copy(in, out, k);
+                    progress = true;
+                    if (per_in) per_in[i] += k;
+                    if (per_out) per_out[i] += k;
+                    if (per_calls) per_calls[i] += 1;
+                }
+                if (in.eos && in.count() == 0 && s.rs_m == 0) {
+                    out.eos = true;   // pad must flush before EOS, like the
+                    done[i] = true;   // actor's `_pad == 0` finish condition
                 }
                 continue;
             }

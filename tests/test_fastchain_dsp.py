@@ -677,3 +677,36 @@ def test_random_chain_shapes_fuzz():
                 err_msg=f"{trial} {spec} non-wrap mismatch")
         else:
             assert not bad.any(), (trial, spec, int(bad.sum()))
+
+
+def test_delay_chain_pad_and_skip_match_actor():
+    """FC_DELAY: positive delay zero-pads the front, negative skips inputs —
+    both through the native chain bit-exactly (copy-class data)."""
+    from futuresdr_tpu.blocks import Delay
+    data = np.arange(1, 9_001, dtype=np.float32)
+    for n in (137, -251):
+        def build():
+            fg = Flowgraph()
+            vs = VectorSink(np.float32)
+            d = Delay(np.float32, n)
+            d.fastchain_static = True   # promise: no new_value retunes
+            fg.connect(VectorSource(data),
+                       CopyRand(np.float32, max_copy=333, seed=2), d, vs)
+            return fg, vs
+
+        native, actor = _run_ab(build)
+        np.testing.assert_array_equal(native, actor)
+        if n > 0:
+            assert len(native) == len(data) + n
+            assert not native[:n].any() and native[n] == 1.0
+        else:
+            assert len(native) == len(data) + n
+            assert native[0] == float(-n + 1)
+
+
+def test_delay_not_fused_without_static_optin():
+    from futuresdr_tpu.blocks import Delay
+    fg = Flowgraph()
+    fg.connect(VectorSource(np.zeros(100, np.float32)),
+               Delay(np.float32, 5), NullSink(np.float32))
+    assert find_native_chains(fg) == []
