@@ -302,7 +302,15 @@ def run_baseline_chains() -> dict:
                 if mr:
                     out[f"{key}_runs"] = [float(v) for v in mr.group(1).split()]
             else:
-                out[f"{key}_error"] = (r.stderr.strip() or r.stdout.strip())[-160:]
+                text = (r.stderr.strip() or r.stdout.strip())
+                # the last lines of a JAX traceback are filtering boilerplate; the
+                # artifact must carry the exception itself (the r5 wlan failure
+                # recorded 160 chars of boilerplate and had to be re-diagnosed live)
+                err_lines = [ln for ln in text.splitlines()
+                             if re.search(r"Error|UNIMPLEMENTED|Exception|assert",
+                                          ln)]
+                out[f"{key}_error"] = (err_lines[-1].strip() if err_lines
+                                       else text[-160:])[:300]
         except subprocess.TimeoutExpired:
             out[f"{key}_error"] = f"timeout after {budget:.0f}s"
         print(f"# baseline chain {name}: {out.get(key, 'FAILED')} "

@@ -24,7 +24,6 @@ def run_device_resident(bucket: int, modulation: str, k_pair) -> tuple:
     demap, ``models/wlan/jax_demod.py``) carry-chained over HBM-resident symbol
     frames, scan-marginal methodology (BASELINE target #4; reference hot loop:
     ``examples/wlan/src/bin/loopback.rs:60-95`` / ``perf/wlan/rx.rs``)."""
-    import jax
     from futuresdr_tpu.models.wlan.consts import PILOT_POLARITY, SYM_LEN
     from futuresdr_tpu.models.wlan.jax_demod import _compiled
     from futuresdr_tpu.ops.xfer import to_device
@@ -43,10 +42,15 @@ def run_device_resident(bucket: int, modulation: str, k_pair) -> tuple:
     dconsts = tuple(to_device(np.asarray(c)) for c in consts)
     cfo, ph0 = np.float32(1e-4), np.float32(0.0)
 
+    # dH rides in the scan CARRY, not the closure: a complex device array captured
+    # as a jit closure constant forces a host readback at MLIR-embedding time, and
+    # the round-5 tunnel fails D2H of complex arrays even when they were created
+    # on device (docs/tpu_notes.md "Complex transfers, round-5 update"). Arguments
+    # and carries never take that path. The remaining captures are all real-valued.
     def step(carry, body):
-        return carry, run(body, dH, dpol, dmask, cfo, ph0, *dconsts)
+        return carry, run(body, carry, dpol, dmask, cfo, ph0, *dconsts)
 
-    carry0 = jax.device_put(np.zeros((), np.float32))
+    carry0 = dH
     x = to_device(host)
     rate = run_marginal_retry(step, carry0, x, k_pair) / 1e6
     return rate, frame
