@@ -222,14 +222,17 @@ def _merge_lti(stages: Sequence[Stage], in_dtype) -> list:
 def _pallas_fir_wins(nt: int, is_complex: bool) -> bool:
     """Trace-time choice of the direct pallas FIR over FFT overlap-save.
 
-    Measured on a v5e chip (docs/tpu_notes.md): the unrolled shifted-MAC pallas kernel
-    runs ~13.5 Gsps at 16 taps and ~5.0 Gsps at 64 taps vs ~2.7-4.6 Gsps for the
-    overlap-save form — a clear win for short real filters; complex frames pay two
-    real passes, halving the crossover.
+    Round-5 on-chip sweep (v5e through the tunnel, `perf/probes/ab_r5.py`,
+    frame 512k, marginal methodology): real 16 taps the pallas kernel is a
+    decisive 3.3x over overlap-save (9.5 vs 2.9 Gsps, far outside the tunnel's
+    ~±2x per-draw dispersion); the advantage decays with tap count and the
+    median-of-3 crossover sits between 48 (pallas +12%) and 64 (OS +17%).
+    Complex frames pay two real passes: a tie at 16 taps, OS-favored by 32 —
+    at a tie OS wins (one pass, no split). Hence real <= 48, complex never.
     """
     if jax.default_backend() != "tpu":
         return False
-    return nt <= (32 if is_complex else 64)
+    return (not is_complex) and nt <= 48
 
 
 def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",

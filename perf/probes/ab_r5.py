@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""On-chip A/B of the r3-r5 stage rewrites (runbook step 3).
+
+Each configuration runs in a fresh subprocess (the FFT impl/precision knobs are
+read at module import). Two child modes:
+
+- ``--child chain <frame>``: the bench headline chain device-resident;
+- ``--child fir <ntaps> <impl> <dtype>``: a single fir_stage device-resident at
+  frame 512k (validates the `_pallas_fir_wins` heuristic numbers on-chip).
+"""
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, ".")
+
+CHAIN_CONFIGS = [
+    ("fft=mxu f32 (default)", {}),
+    ("fft=xla", {"FUTURESDR_TPU_FFT_IMPL": "xla"}),
+    ("fft=mxu bf16", {"FUTURESDR_TPU_FFT_PRECISION": "bf16"}),
+]
+
+FIR_CONFIGS = [
+    # ntaps, impl, dtype — the heuristic boundary cases from ops/stages.py
+    (16, "pallas", "float32"),
+    (16, "os", "float32"),
+    (64, "pallas", "float32"),
+    (64, "os", "float32"),
+    (64, "os", "complex64"),
+    (16, "poly4", "float32"),   # decim=4 polyphase einsum vs os at the same decim
+    (16, "os4", "float32"),
+]
+
+# crossover sweep: where does the direct pallas kernel stop beating overlap-save?
+FIR_CROSSOVER = [
+    (24, "pallas", "float32"),
+    (24, "os", "float32"),
+    (32, "pallas", "float32"),
+    (32, "os", "float32"),
+    (48, "pallas", "float32"),
+    (48, "os", "float32"),
+    (16, "pallas", "complex64"),
+    (16, "os", "complex64"),
+    (32, "pallas", "complex64"),
+    (32, "os", "complex64"),
+]
+
+
+def child_chain(frame: int) -> None:
+    import bench
+    from futuresdr_tpu.tpu.instance import instance
+    rate, f, _sweep = bench.run_device_resident(frame_sizes=(frame,))
+    print(f"RESULT {rate:.1f} {f} {instance().platform}", flush=True)
+
+
+def child_fir(ntaps: int, impl: str, dtype: str) -> None:
+    import jax
+    import numpy as np
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops.stages import fir_stage
+    from futuresdr_tpu.ops.xfer import to_device
+    from futuresdr_tpu.tpu.instance import instance
+    from futuresdr_tpu.utils.measure import default_k_pair, run_marginal_retry
+
+    decim = 1
+    if impl.endswith("4"):
+        impl, decim = impl[:-1], 4
+    inst = instance()
+    st = fir_stage(firdes.lowpass(0.2, ntaps).astype(np.float32),
+                   decim=decim, impl=impl)
+    frame = 1 << 19
+    rng = np.random.default_rng(3)
+    host = rng.standard_normal(frame).astype(dtype) if dtype == "float32" else \
+        (rng.standard_normal(frame)
+         + 1j * rng.standard_normal(frame)).astype(np.complex64)
+    carry0 = jax.device_put(st.init_carry(host.dtype), inst.device)
+    x = to_device(host, inst.device)
+    rate = run_marginal_retry(st.fn, carry0, x,
+                              default_k_pair(inst.platform)) / 1e6
+    print(f"RESULT {rate:.1f} {frame} {inst.platform}", flush=True)
+
+
+def run_one(argv: list, label: str, env: dict) -> None:
+    import re
+    e = dict(os.environ, **env)
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)] + argv,
+                           capture_output=True, text=True, timeout=900, env=e)
+    except subprocess.TimeoutExpired:
+        # a wedged tunnel child must not abort the rest of the sweep
+        print(f"\"{label}\",,FAILED  # timeout 900s", flush=True)
+        return
+    row = [ln for ln in r.stdout.splitlines() if ln.startswith("RESULT")]
+    if row:
+        rate, f, plat = row[0].split()[1:]
+        print(f"\"{label}\",{f},{rate}  # {plat}", flush=True)
+    else:
+        # last line matching the exception, not JAX's traceback-filtering
+        # boilerplate (same extraction as bench.run_baseline_chains)
+        text = (r.stderr or r.stdout).strip()
+        errs = [ln for ln in text.splitlines()
+                if re.search(r"Error|UNIMPLEMENTED|Exception|assert", ln)]
+        tail = errs[-1].strip() if errs else text[-160:]
+        print(f"\"{label}\",,FAILED  # {tail[:300]}", flush=True)
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        if sys.argv[2] == "chain":
+            child_chain(int(sys.argv[3]))
+        else:
+            child_fir(int(sys.argv[3]), sys.argv[4], sys.argv[5])
+        return
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    print("config,frame,msps")
+    if which in ("all", "chain"):
+        for label, env in CHAIN_CONFIGS:
+            run_one(["--child", "chain", str(1 << 19)], label, env)
+    if which in ("all", "fir"):
+        for ntaps, impl, dtype in FIR_CONFIGS:
+            run_one(["--child", "fir", str(ntaps), impl, dtype],
+                    f"fir nt={ntaps} impl={impl} {dtype}", {})
+    if which == "crossover":
+        for ntaps, impl, dtype in FIR_CROSSOVER:
+            run_one(["--child", "fir", str(ntaps), impl, dtype],
+                    f"fir nt={ntaps} impl={impl} {dtype}", {})
+
+
+if __name__ == "__main__":
+    main()
