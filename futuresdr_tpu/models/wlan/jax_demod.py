@@ -111,7 +111,11 @@ def _compiled_head():
         llrs = 4.0 * eq[data_idx].real          # BPSK max-log, closed form
         return H, llrs.astype(jnp.float32)
 
-    return run, (ref_safe, used, _PIL_IDX, _DATA_IDX)
+    # ship the complex constant to the device ONCE here (lru-cached with the
+    # jit): raw complex jit args are broken on axon, and per-call to_device
+    # would pay the tunnel's ~100 ms dispatch for an unchanging table
+    from ...ops.xfer import to_device
+    return run, (to_device(ref_safe), used, _PIL_IDX, _DATA_IDX)
 
 
 def demod_head_jax(head: np.ndarray, cfo: float):
@@ -120,11 +124,17 @@ def demod_head_jax(head: np.ndarray, cfo: float):
     ``head``: the 208 raw samples from ``lts_start`` (two LTS symbols + the
     SIGNAL symbol with CP), WITHOUT host-side CFO correction. Returns
     ``(H[64] complex64 ndarray, llrs[48] float32 ndarray)`` matching the host
-    path (``ofdm.estimate_channel`` + ``ofdm.equalize`` + BPSK demap)."""
-    run, consts = _compiled_head()
-    H, llrs = run(np.asarray(head[:208], dtype=np.complex64), np.float32(cfo),
-                  *consts)
-    return np.asarray(H), np.asarray(llrs)
+    path (``ofdm.estimate_channel`` + ``ofdm.equalize`` + BPSK demap).
+
+    Every complex host↔device crossing rides the xfer shim: raw complex jit
+    arguments/readbacks are broken through the axon tunnel in BOTH directions
+    (docs/tpu_notes.md), and on sane platforms the shim is one fused kernel."""
+    from ...ops.xfer import to_device, to_host
+
+    run, consts = _compiled_head()       # consts already device-resident
+    H, llrs = run(to_device(np.asarray(head[:208], dtype=np.complex64)),
+                  np.float32(cfo), *consts)
+    return to_host(H), np.asarray(llrs)
 
 
 def demod_body_jax(body: np.ndarray, H: np.ndarray, n_sym: int, symbol_offset: int,
@@ -139,7 +149,10 @@ def demod_body_jax(body: np.ndarray, H: np.ndarray, n_sym: int, symbol_offset: i
     padded[:n_sym * SYM_LEN] = body
     pol = PILOT_POLARITY[(symbol_offset + np.arange(bucket)) % len(PILOT_POLARITY)]
     mask = (np.arange(bucket) < n_sym).astype(np.float32)
-    out = np.asarray(run(padded, H.astype(np.complex64), pol.astype(np.float32),
+    # complex jit args through the xfer shim (broken raw complex H2D on axon)
+    from ...ops.xfer import to_device
+    out = np.asarray(run(to_device(padded), to_device(H.astype(np.complex64)),
+                         pol.astype(np.float32),
                          mask, np.float32(cfo), np.float32(phase0), *consts))
     n_bpsc = int(np.log2(len(MODULATION_TABLES[modulation])))
     return out[:n_sym * 48 * n_bpsc]

@@ -12,7 +12,8 @@ import os
 import subprocess
 import sys
 
-sys.path.insert(0, ".")
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, _ROOT)
 
 CHAIN_CONFIGS = [
     ("fft=mxu f32 (default)", {}),

@@ -2,15 +2,21 @@
 
 Multi-chip hardware is not available in CI; shardings are validated on a virtual CPU mesh
 (SURVEY §2.7 / environment notes). Must run before any jax import.
+
+``FSDR_TEST_TPU=1`` skips the CPU forcing so a curated subset can run against a
+live chip when the tunnel answers (round-5 practice: single-chip compute-plane
+tests only — mesh/sharding tests still need the 8-device CPU run).
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"   # override axon: tests are deterministic-CPU
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+if not os.environ.get("FSDR_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"   # override axon: tests are deterministic-CPU
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -18,4 +24,5 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # environment pre-set another platform before this conftest ran.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not os.environ.get("FSDR_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")

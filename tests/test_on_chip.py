@@ -1,0 +1,221 @@
+"""Curated on-chip validation (``FSDR_TEST_TPU=1`` + a live chip).
+
+The main suite runs on a forced 8-device virtual CPU mesh (conftest.py). This
+module is the live-tunnel practice established in round 5: the compute plane
+driven on the REAL chip with TPU-calibrated workload sizes (the tunnel's
+~100 ms dispatch latency makes CPU-sized workloads ill-conditioned) and
+TPU-calibrated tolerances (MXU f32 accumulates differently than host f64).
+
+Run: ``FSDR_TEST_TPU=1 python -m pytest tests/test_on_chip.py -q``
+(expect ~100 ms per dispatch through the tunnel; the module is a no-op skip
+in the normal CPU-forced suite).
+
+These tests exist because two tunnel-only bug classes never show on the CPU
+mesh: broken complex transfers (both directions since round 5 — the
+closure-constant trap caught live in perf/wlan.py), and numerical deltas of
+the MXU matmul-FFT path that only engages when ``jax.default_backend()`` is
+tpu.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+if not os.environ.get("FSDR_TEST_TPU"):
+    pytest.skip("FSDR_TEST_TPU not set (suite runs on the virtual CPU mesh)",
+                allow_module_level=True)
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "tpu":
+    pytest.skip("no live TPU behind FSDR_TEST_TPU", allow_module_level=True)
+
+from futuresdr_tpu.dsp import firdes  # noqa: E402
+from futuresdr_tpu.ops import fft_stage, fir_stage, mag2_stage  # noqa: E402
+from futuresdr_tpu.ops.stages import Pipeline, _pallas_fir_wins  # noqa: E402
+from futuresdr_tpu.ops.xfer import to_device, to_host  # noqa: E402
+from futuresdr_tpu.tpu.instance import instance  # noqa: E402
+
+# MXU f32 (and the bf16x3 matmul decomposition inside the four-step FFT) land
+# within ~1e-4 relative of the host-f64 reference at these sizes; 1e-3 is the
+# assertion line — loose enough for accumulation-order noise, tight enough
+# that a wrong twiddle/layout (the bugs these tests exist for) blows through.
+REL_TOL = 1e-3
+
+
+def _rel_err(got, want):
+    scale = max(1e-9, float(np.max(np.abs(want))))
+    return float(np.max(np.abs(got - want))) / scale
+
+
+def test_complex_xfer_roundtrip_exact():
+    """H2D + D2H of complex64 through the shim is bit-exact (the raw path is
+    UNIMPLEMENTED on the tunnel in both directions — docs/tpu_notes.md)."""
+    rng = np.random.default_rng(1)
+    host = (rng.standard_normal(4096)
+            + 1j * rng.standard_normal(4096)).astype(np.complex64)
+    dev = to_device(host)
+    assert dev.dtype == np.complex64
+    back = to_host(dev)
+    np.testing.assert_array_equal(back, host)
+
+
+@pytest.mark.parametrize("nt,dtype", [(16, np.float32), (48, np.float32),
+                                      (64, np.float32), (16, np.complex64)])
+def test_fir_auto_impl_matches_numpy(nt, dtype):
+    """fir_stage(impl='auto') across the r5-measured routing boundaries
+    (pallas for real <=48 taps, overlap-save beyond and for complex) against
+    a host f64 convolution."""
+    taps = firdes.lowpass(0.2, nt).astype(np.float32)
+    st = fir_stage(taps)
+    rng = np.random.default_rng(5)
+    n = 8192
+    if dtype == np.float32:
+        host = rng.standard_normal(n).astype(np.float32)
+    else:
+        host = (rng.standard_normal(n)
+                + 1j * rng.standard_normal(n)).astype(np.complex64)
+    carry = jax.device_put(st.init_carry(host.dtype), instance().device)
+    fn = jax.jit(st.fn)
+    _, y = fn(carry, to_device(host, instance().device))
+    got = to_host(y)
+    want = np.convolve(np.concatenate([np.zeros(nt - 1, dtype), host]),
+                       taps)[nt - 1:nt - 1 + n].astype(dtype)
+    assert _rel_err(got, want) < REL_TOL
+
+
+def test_fir_routing_is_the_measured_crossover():
+    assert _pallas_fir_wins(16, False)
+    assert _pallas_fir_wins(48, False)
+    assert not _pallas_fir_wins(64, False)
+    assert not _pallas_fir_wins(16, True)
+
+
+def test_fir_carry_chunk_invariance_on_chip():
+    """One 8192-frame vs two 4096-frames produce identical outputs (the
+    carried tail is correct on the device path, not just the CPU mesh)."""
+    taps = firdes.lowpass(0.25, 32).astype(np.float32)
+    rng = np.random.default_rng(9)
+    host = (rng.standard_normal(8192)
+            + 1j * rng.standard_normal(8192)).astype(np.complex64)
+    st = fir_stage(taps)
+    fn = jax.jit(st.fn)
+
+    c = jax.device_put(st.init_carry(host.dtype), instance().device)
+    _, y_once = fn(c, to_device(host))
+
+    c = jax.device_put(st.init_carry(host.dtype), instance().device)
+    c, y_a = fn(c, to_device(host[:4096]))
+    _, y_b = fn(c, to_device(host[4096:]))
+    got = np.concatenate([to_host(y_a), to_host(y_b)])
+    want = to_host(y_once)
+    assert _rel_err(got, want) < 1e-6      # same kernel, same math: ~bit-equal
+
+
+def test_mxu_fft_matches_numpy():
+    """The four-step matmul FFT (auto-engaged on TPU at 2048) vs np.fft."""
+    from futuresdr_tpu.ops import mxu_fft
+    rng = np.random.default_rng(2)
+    x = (rng.standard_normal((8, 2048))
+         + 1j * rng.standard_normal((8, 2048))).astype(np.complex64)
+    got = to_host(jax.jit(mxu_fft.fft)(to_device(x)))
+    want = np.fft.fft(x)
+    assert _rel_err(got, want) < REL_TOL
+
+
+def test_mxu_ifft_roundtrip():
+    from futuresdr_tpu.ops import mxu_fft
+    rng = np.random.default_rng(3)
+    x = (rng.standard_normal((4, 2048))
+         + 1j * rng.standard_normal((4, 2048))).astype(np.complex64)
+    y = jax.jit(lambda v: mxu_fft.ifft(mxu_fft.fft(v)))(to_device(x))
+    assert _rel_err(to_host(y), x) < REL_TOL
+
+
+def test_headline_pipeline_matches_numpy():
+    """The bench chain (fir64 → fft2048 → |x|²) fused, one frame, vs a host
+    reference of the same math."""
+    taps = firdes.lowpass(0.2, 64).astype(np.float32)
+    pipe = Pipeline([fir_stage(taps), fft_stage(2048), mag2_stage()],
+                    np.complex64)
+    rng = np.random.default_rng(4)
+    host = (rng.standard_normal(16384)
+            + 1j * rng.standard_normal(16384)).astype(np.complex64)
+    carry = jax.device_put(pipe.init_carry(), instance().device)
+    _, y = jax.jit(pipe.fn())(carry, to_device(host))
+    got = to_host(y)
+
+    fir = np.convolve(np.concatenate([np.zeros(63, np.complex64), host]),
+                      taps)[63:63 + 16384]
+    spec = np.fft.fft(fir.reshape(-1, 2048), axis=1).reshape(-1)
+    want = (spec.real ** 2 + spec.imag ** 2).astype(np.float32)
+    assert _rel_err(got, want) < REL_TOL
+
+
+def test_wlan_demod_body_recovers_bits_on_chip():
+    """demod_body_jax (the fixed shim-riding entry point) on a clean
+    constructed OFDM symbol: BPSK LLR signs must equal the transmitted bits.
+
+    Regression scope: the round-5 live failure was complex arrays reaching
+    jit as raw args/closure constants — this drives the repaired crossing
+    end to end on the chip."""
+    from futuresdr_tpu.models.wlan.consts import (CP_LEN, DATA_CARRIERS,
+                                                  FFT_SIZE, PILOT_CARRIERS,
+                                                  PILOT_VALUES, PILOT_POLARITY)
+    from futuresdr_tpu.models.wlan.jax_demod import demod_body_jax
+
+    rng = np.random.default_rng(6)
+    bits = rng.integers(0, 2, 48)
+    spec = np.zeros(FFT_SIZE, np.complex64)
+    spec[DATA_CARRIERS % FFT_SIZE] = 2.0 * bits - 1.0
+    spec[PILOT_CARRIERS % FFT_SIZE] = PILOT_VALUES * PILOT_POLARITY[1]
+    sym = np.fft.ifft(spec).astype(np.complex64) * FFT_SIZE
+    body = np.concatenate([sym[-CP_LEN:], sym])          # one 80-sample symbol
+    llrs = demod_body_jax(body, np.ones(64, np.complex64), 1, 1,
+                          0.0, 0.0, "bpsk")
+    assert llrs.shape == (48,)
+    assert np.all((llrs > 0) == (bits == 1))
+
+
+def test_wlan_demod_head_runs_on_chip():
+    """demod_head_jax end to end on the chip (complex in AND complex out —
+    the H readback exercises the to_host split)."""
+    from futuresdr_tpu.models.wlan.jax_demod import demod_head_jax
+    rng = np.random.default_rng(7)
+    head = (rng.standard_normal(208)
+            + 1j * rng.standard_normal(208)).astype(np.complex64)
+    H, llrs = demod_head_jax(head, 1e-4)
+    assert H.shape == (64,) and H.dtype == np.complex64
+    assert llrs.shape == (48,) and np.all(np.isfinite(llrs))
+    assert np.all(np.isfinite(H))
+
+
+def test_streamed_tpu_kernel_flowgraph():
+    """The actor-runtime streamed path (host ring → H2D staging → fused chain
+    → D2H → host ring) against the real chip: VectorSource → TpuKernel(fir)
+    → VectorSink, output checked vs numpy. Drives h2d_needs_staging and the
+    frame-chaining drain loop on real hardware."""
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSink, VectorSource
+    from futuresdr_tpu.tpu import TpuKernel
+
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    rng = np.random.default_rng(8)
+    n = 4 * 4096
+    host = (rng.standard_normal(n)
+            + 1j * rng.standard_normal(n)).astype(np.complex64)
+
+    fg = Flowgraph()
+    src = VectorSource(host)
+    tk = TpuKernel([fir_stage(taps)], np.complex64, frame_size=4096,
+                   frames_in_flight=2)
+    snk = VectorSink(np.complex64)
+    fg.connect(src, tk, snk)
+    Runtime().run(fg)
+
+    got = snk.items()
+    assert got.shape == (n,)
+    want = np.convolve(np.concatenate([np.zeros(31, np.complex64), host]),
+                       taps)[31:31 + n].astype(np.complex64)
+    assert _rel_err(got, want) < REL_TOL
