@@ -1,0 +1,212 @@
+// FIR kernel design-space microbench (build: g++ -O3 -march=native -o bench_fir
+// bench_fir.cpp && ./bench_fir [ntaps] [reps] [stride]).
+//
+// Round-5 measured space (2.1 GHz single-core VM, AVX-512, one 512-bit FMA
+// unit): straight 8-wide tap-unrolled 360-395 Msps @64 taps; phase-major
+// 440-455; folded symmetric 465-507; folded 128-wide tile 437. Port math for
+// the folded kernel says ~2 cycles/output (4 loads/output on 2 load ports; 2
+// fma + 2 add split across ports) but it measures ~4.2 — the gap is split
+// (cache-line-crossing) unaligned loads: at 64 taps every 16-float loadu
+// walks one float per tap, so 15 of 16 issues split a cache line and the
+// load ports replay. The valignd variant loads each side's window ONCE per
+// 16-tap group and synthesizes the 16 shifted views with register alignment
+// (valignd, port-5) ops — split-load replays disappear and the FMA unit
+// becomes the binding port. Hybrid: any tap remainder (h % group) falls back
+// to the loadu step IN THE SAME accumulation order, so results stay
+// bit-identical to the plain folded kernel for every tap count.
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+#ifdef __AVX512F__
+#include <immintrin.h>
+#endif
+
+// ---- baseline: folded symmetric (round-5 production kernel) ----------------
+inline void fir_sym(const float* x, const float* taps, int64_t nt,
+                    int64_t stride, float* y, int64_t nf) {
+    const int64_t h = nt / 2;
+    const int64_t Ls = (nt - 1) * stride;
+    int64_t j0 = 0;
+#ifdef __AVX512F__
+    for (; j0 + 64 <= nf; j0 += 64) {
+        __m512 a0 = _mm512_setzero_ps(), a1 = a0, a2 = a0, a3 = a0;
+        for (int64_t k = 0; k < h; ++k) {
+            const float* xa = x + j0 - k * stride;
+            const float* xb = x + j0 - Ls + k * stride;
+            const __m512 c = _mm512_set1_ps(taps[k]);
+            a0 = _mm512_fmadd_ps(
+                c, _mm512_add_ps(_mm512_loadu_ps(xa), _mm512_loadu_ps(xb)), a0);
+            a1 = _mm512_fmadd_ps(
+                c, _mm512_add_ps(_mm512_loadu_ps(xa + 16),
+                                 _mm512_loadu_ps(xb + 16)), a1);
+            a2 = _mm512_fmadd_ps(
+                c, _mm512_add_ps(_mm512_loadu_ps(xa + 32),
+                                 _mm512_loadu_ps(xb + 32)), a2);
+            a3 = _mm512_fmadd_ps(
+                c, _mm512_add_ps(_mm512_loadu_ps(xa + 48),
+                                 _mm512_loadu_ps(xb + 48)), a3);
+        }
+        _mm512_storeu_ps(y + j0, a0);
+        _mm512_storeu_ps(y + j0 + 16, a1);
+        _mm512_storeu_ps(y + j0 + 32, a2);
+        _mm512_storeu_ps(y + j0 + 48, a3);
+    }
+#endif
+    for (; j0 < nf; ++j0) {
+        float s = 0;
+        for (int64_t k = 0; k < h; ++k)
+            s += taps[k] * (x[j0 - k * stride] + x[j0 - Ls + k * stride]);
+        y[j0] = s;
+    }
+}
+
+#ifdef __AVX512F__
+// ---- valignd folded symmetric, hybrid ---------------------------------------
+//
+// concat[lo:hi][IMM + i] for i in [0,16): the window starting IMM floats into
+// the 32-float register pair. IMM is an immediate, so the per-group tap loop
+// is unrolled by template recursion.
+template <int IMM>
+static inline __m512 pair_view(__m512 lo, __m512 hi) {
+    return _mm512_castsi512_ps(_mm512_alignr_epi32(
+        _mm512_castps_si512(hi), _mm512_castps_si512(lo), IMM));
+}
+
+// One tap inside a group: xa side descends S floats per tap from ha's base
+// (la:ha covers [base-16, base+16)), xb side ascends S floats per tap from
+// lb's base (lb:hb covers [base2, base2+32)).
+template <int K, int G, int S>
+struct TapG {
+    static inline void run(const float* tp, __m512 la, __m512 ha, __m512 lb,
+                           __m512 hb, __m512& acc) {
+        const __m512 c = _mm512_set1_ps(tp[K]);
+        const __m512 va = K == 0 ? ha : pair_view<(16 - K * S) & 15>(la, ha);
+        const __m512 vb = K == 0 ? lb : pair_view<(K * S) & 15>(lb, hb);
+        acc = _mm512_fmadd_ps(c, _mm512_add_ps(va, vb), acc);
+        TapG<K + 1, G, S>::run(tp, la, ha, lb, hb, acc);
+    }
+};
+template <int G, int S>
+struct TapG<G, G, S> {
+    static inline void run(const float*, __m512, __m512, __m512, __m512,
+                           __m512&) {}
+};
+
+// Folded symmetric with valignd groups; S = float stride (1 = f32 stream,
+// 2 = interleaved c64 stream with real taps). Group size G = 16/S taps spans
+// exactly one 16-float register width per side. Remainder taps (h % G) run
+// the loadu step; per-lane accumulation order is ascending k throughout, so
+// output is bit-identical to fir_sym.
+template <int S>
+inline void fir_sym_valign_s(const float* x, const float* taps, int64_t nt,
+                             float* y, int64_t nf) {
+    constexpr int G = 16 / S;
+    const int64_t h = nt / 2;
+    const int64_t Ls = (nt - 1) * S;
+    const int64_t hg = (h / G) * G;
+    int64_t j0 = 0;
+    for (; j0 + 64 <= nf; j0 += 64) {
+        __m512 acc[4] = {_mm512_setzero_ps(), _mm512_setzero_ps(),
+                         _mm512_setzero_ps(), _mm512_setzero_ps()};
+        for (int64_t g = 0; g < hg; g += G) {
+            const float* pa = x + j0 - g * S;
+            const float* pb = x + j0 - Ls + g * S;
+            for (int r = 0; r < 4; ++r) {
+                const __m512 la = _mm512_loadu_ps(pa + 16 * r - 16);
+                const __m512 ha = _mm512_loadu_ps(pa + 16 * r);
+                const __m512 lb = _mm512_loadu_ps(pb + 16 * r);
+                const __m512 hb = _mm512_loadu_ps(pb + 16 * r + 16);
+                TapG<0, G, S>::run(taps + g, la, ha, lb, hb, acc[r]);
+            }
+        }
+        for (int64_t k = hg; k < h; ++k) {           // remainder taps
+            const float* xa = x + j0 - k * S;
+            const float* xb = x + j0 - Ls + k * S;
+            const __m512 c = _mm512_set1_ps(taps[k]);
+            for (int r = 0; r < 4; ++r)
+                acc[r] = _mm512_fmadd_ps(
+                    c,
+                    _mm512_add_ps(_mm512_loadu_ps(xa + 16 * r),
+                                  _mm512_loadu_ps(xb + 16 * r)),
+                    acc[r]);
+        }
+        for (int r = 0; r < 4; ++r) _mm512_storeu_ps(y + j0 + 16 * r, acc[r]);
+    }
+    for (; j0 < nf; ++j0) {
+        float s = 0;
+        for (int64_t k = 0; k < h; ++k)
+            s += taps[k] * (x[j0 - k * S] + x[j0 - Ls + k * S]);
+        y[j0] = s;
+    }
+}
+#endif  // __AVX512F__
+
+using Fn = void (*)(const float*, const float*, int64_t, int64_t, float*,
+                    int64_t);
+
+static void sym_wrap(const float* x, const float* taps, int64_t nt,
+                     int64_t stride, float* y, int64_t n) {
+    fir_sym(x, taps, nt, stride, y, n);
+}
+#ifdef __AVX512F__
+static void valign_wrap(const float* x, const float* taps, int64_t nt,
+                        int64_t stride, float* y, int64_t n) {
+    if (stride == 1)
+        fir_sym_valign_s<1>(x, taps, nt, y, n);
+    else
+        fir_sym_valign_s<2>(x, taps, nt, y, n);
+}
+#endif
+
+static double bench(Fn fn, const float* x, const float* taps, int64_t nt,
+                    int64_t stride, float* y, int64_t n, int reps) {
+    using clk = std::chrono::steady_clock;
+    fn(x, taps, nt, stride, y, n);  // warm
+    double best = 0;
+    for (int outer = 0; outer < 3; ++outer) {
+        auto t0 = clk::now();
+        for (int r = 0; r < reps; ++r) fn(x, taps, nt, stride, y, n);
+        double dt = std::chrono::duration<double>(clk::now() - t0).count();
+        double rate = n * double(reps) / dt / 1e6 / stride;  // items/s
+        if (rate > best) best = rate;
+    }
+    return best;
+}
+
+int main(int argc, char** argv) {
+    int nt = argc > 1 ? atoi(argv[1]) : 64;
+    int reps = argc > 2 ? atoi(argv[2]) : 40;
+    int64_t stride = argc > 3 ? atoi(argv[3]) : 1;
+    int64_t n = (int64_t(1) << 21) * stride;     // floats in the output span
+    std::vector<float> xs(n + 4 * nt, 0.0f), y1(n), y2(n), taps(nt);
+    for (size_t i = 0; i < xs.size(); ++i)
+        xs[i] = float((i * 2654435761u) % 1000) / 1000.f;
+    for (int i = 0; i < nt / 2; ++i) taps[i] = taps[nt - 1 - i] = 1.f / (i + 1);
+    const float* x = xs.data() + 4 * nt;
+
+    double r1 = bench(sym_wrap, x, taps.data(), nt, stride, y1.data(), n, reps);
+    printf("folded-loadu   %4d taps stride %d: %7.1f Msps\n", nt, int(stride),
+           r1);
+#ifdef __AVX512F__
+    double r2 =
+        bench(valign_wrap, x, taps.data(), nt, stride, y2.data(), n, reps);
+    printf("folded-valignd %4d taps stride %d: %7.1f Msps  (%+.0f%%)\n", nt,
+           int(stride), r2, 100.0 * (r2 / r1 - 1.0));
+    if (std::memcmp(y1.data(), y2.data(), size_t(n) * sizeof(float)) == 0)
+        printf("bit-identical\n");
+    else {
+        double md = 0;
+        for (int64_t i = 0; i < n; ++i) {
+            double d = double(y1[i]) - double(y2[i]);
+            if (d < 0) d = -d;
+            if (d > md) md = d;
+        }
+        printf("MISMATCH max |diff| = %g\n", md);
+        return 1;
+    }
+#endif
+    return 0;
+}

@@ -1,0 +1,72 @@
+"""Measure the axon tunnel's raw transfer envelope: H2D bandwidth, D2H bandwidth,
+and per-op round-trip latency, as a function of transfer size.
+
+This establishes the ceiling for the STREAMED TpuKernel path (host ring → H2D →
+chain → D2H → host ring): if the tunnel moves ~N MB/s, the streamed rate cannot
+exceed N/8 Msps for a complex64 input regardless of frame size or in-flight
+depth. bench.py's ``streamed_*`` fields on the tunnel measure this envelope,
+not the framework (docs/tpu_notes.md).
+
+Run on a live tunnel: ``python perf/probes/tunnel_xfer.py``; prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def main() -> None:
+    import jax
+
+    from futuresdr_tpu.ops.xfer import to_device, to_host
+
+    dev = jax.devices()[0]
+    out = {"device": str(dev.device_kind) if hasattr(dev, "device_kind") else str(dev),
+           "platform": dev.platform}
+
+    # RTT: tiny f32 roundtrip (put + block + get), median of 9
+    tiny = np.zeros(8, np.float32)
+    rtts = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        y = to_device(tiny, dev)
+        y.block_until_ready()
+        np.asarray(to_host(y))
+        rtts.append(time.perf_counter() - t0)
+    rtts.sort()
+    out["rtt_ms"] = round(rtts[len(rtts) // 2] * 1e3, 1)
+
+    # Bandwidth vs size, f32 payloads (the wire format — complex ships as pairs)
+    h2d, d2h = {}, {}
+    for mb in (1, 4, 16, 64):
+        n = mb * (1 << 20) // 4
+        host = np.zeros(n, np.float32)
+        runs_u, runs_d = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            y = to_device(host, dev)
+            y.block_until_ready()
+            runs_u.append(mb / (time.perf_counter() - t0))
+            t0 = time.perf_counter()
+            np.asarray(to_host(y))
+            runs_d.append(mb / (time.perf_counter() - t0))
+        h2d[str(mb)] = round(sorted(runs_u)[1], 1)
+        d2h[str(mb)] = round(sorted(runs_d)[1], 1)
+        print(f"# {mb} MB: H2D {h2d[str(mb)]} MB/s, D2H {d2h[str(mb)]} MB/s",
+              file=sys.stderr)
+    out["h2d_MBps"] = h2d
+    out["d2h_MBps"] = d2h
+    big = max(h2d.values())
+    out["streamed_ceiling_msps_c64"] = round(big / 8, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
