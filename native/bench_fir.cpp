@@ -64,84 +64,8 @@ inline void fir_sym(const float* x, const float* taps, int64_t nt,
 }
 
 #ifdef __AVX512F__
-// ---- valignd folded symmetric, hybrid ---------------------------------------
-//
-// concat[lo:hi][IMM + i] for i in [0,16): the window starting IMM floats into
-// the 32-float register pair. IMM is an immediate, so the per-group tap loop
-// is unrolled by template recursion.
-template <int IMM>
-static inline __m512 pair_view(__m512 lo, __m512 hi) {
-    return _mm512_castsi512_ps(_mm512_alignr_epi32(
-        _mm512_castps_si512(hi), _mm512_castps_si512(lo), IMM));
-}
-
-// One tap inside a group: xa side descends S floats per tap from ha's base
-// (la:ha covers [base-16, base+16)), xb side ascends S floats per tap from
-// lb's base (lb:hb covers [base2, base2+32)).
-template <int K, int G, int S>
-struct TapG {
-    static inline void run(const float* tp, __m512 la, __m512 ha, __m512 lb,
-                           __m512 hb, __m512& acc) {
-        const __m512 c = _mm512_set1_ps(tp[K]);
-        const __m512 va = K == 0 ? ha : pair_view<(16 - K * S) & 15>(la, ha);
-        const __m512 vb = K == 0 ? lb : pair_view<(K * S) & 15>(lb, hb);
-        acc = _mm512_fmadd_ps(c, _mm512_add_ps(va, vb), acc);
-        TapG<K + 1, G, S>::run(tp, la, ha, lb, hb, acc);
-    }
-};
-template <int G, int S>
-struct TapG<G, G, S> {
-    static inline void run(const float*, __m512, __m512, __m512, __m512,
-                           __m512&) {}
-};
-
-// Folded symmetric with valignd groups; S = float stride (1 = f32 stream,
-// 2 = interleaved c64 stream with real taps). Group size G = 16/S taps spans
-// exactly one 16-float register width per side. Remainder taps (h % G) run
-// the loadu step; per-lane accumulation order is ascending k throughout, so
-// output is bit-identical to fir_sym.
-template <int S>
-inline void fir_sym_valign_s(const float* x, const float* taps, int64_t nt,
-                             float* y, int64_t nf) {
-    constexpr int G = 16 / S;
-    const int64_t h = nt / 2;
-    const int64_t Ls = (nt - 1) * S;
-    const int64_t hg = (h / G) * G;
-    int64_t j0 = 0;
-    for (; j0 + 64 <= nf; j0 += 64) {
-        __m512 acc[4] = {_mm512_setzero_ps(), _mm512_setzero_ps(),
-                         _mm512_setzero_ps(), _mm512_setzero_ps()};
-        for (int64_t g = 0; g < hg; g += G) {
-            const float* pa = x + j0 - g * S;
-            const float* pb = x + j0 - Ls + g * S;
-            for (int r = 0; r < 4; ++r) {
-                const __m512 la = _mm512_loadu_ps(pa + 16 * r - 16);
-                const __m512 ha = _mm512_loadu_ps(pa + 16 * r);
-                const __m512 lb = _mm512_loadu_ps(pb + 16 * r);
-                const __m512 hb = _mm512_loadu_ps(pb + 16 * r + 16);
-                TapG<0, G, S>::run(taps + g, la, ha, lb, hb, acc[r]);
-            }
-        }
-        for (int64_t k = hg; k < h; ++k) {           // remainder taps
-            const float* xa = x + j0 - k * S;
-            const float* xb = x + j0 - Ls + k * S;
-            const __m512 c = _mm512_set1_ps(taps[k]);
-            for (int r = 0; r < 4; ++r)
-                acc[r] = _mm512_fmadd_ps(
-                    c,
-                    _mm512_add_ps(_mm512_loadu_ps(xa + 16 * r),
-                                  _mm512_loadu_ps(xb + 16 * r)),
-                    acc[r]);
-        }
-        for (int r = 0; r < 4; ++r) _mm512_storeu_ps(y + j0 + 16 * r, acc[r]);
-    }
-    for (; j0 < nf; ++j0) {
-        float s = 0;
-        for (int64_t k = 0; k < h; ++k)
-            s += taps[k] * (x[j0 - k * S] + x[j0 - Ls + k * S]);
-        y[j0] = s;
-    }
-}
+// The candidate kernel under test IS the production kernel (shared header).
+#include "fir_valign.h"
 #endif  // __AVX512F__
 
 using Fn = void (*)(const float*, const float*, int64_t, int64_t, float*,
@@ -155,9 +79,9 @@ static void sym_wrap(const float* x, const float* taps, int64_t nt,
 static void valign_wrap(const float* x, const float* taps, int64_t nt,
                         int64_t stride, float* y, int64_t n) {
     if (stride == 1)
-        fir_sym_valign_s<1>(x, taps, nt, y, n);
+        fir_sym_valign<1>(x, taps, nt, y, n);
     else
-        fir_sym_valign_s<2>(x, taps, nt, y, n);
+        fir_sym_valign<2>(x, taps, nt, y, n);
 }
 #endif
 

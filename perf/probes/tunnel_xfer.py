@@ -63,8 +63,13 @@ def main() -> None:
               file=sys.stderr)
     out["h2d_MBps"] = h2d
     out["d2h_MBps"] = d2h
-    big = max(h2d.values())
-    out["streamed_ceiling_msps_c64"] = round(big / 8, 1)
+    # Same duplex model as bench.py's streamed_link_ceiling_msps (in-flight
+    # frames overlap the directions; a c64 frame ships 8 B/sample up and its
+    # f32 result 4 B/sample down), evaluated at the largest probed size —
+    # the regime streamed frames actually run in.
+    mb = max(h2d, key=lambda m: int(m))
+    out["streamed_ceiling_msps_c64"] = round(
+        min(h2d[mb] / 8.0, d2h[mb] / 4.0), 1)
     print(json.dumps(out))
 
 
