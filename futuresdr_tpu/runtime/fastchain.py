@@ -6,20 +6,25 @@ blocks forward tiny chunks (its ``perf/null_rand`` regime, and the north-star
 ``perf/fir/fir.rs:49-95`` grid that interleaves CopyRands with 64-tap FIRs).
 Python's asyncio actor loop costs ~10 µs per ``work()`` call there; no amount
 of scheduling fixes that floor. This module takes the reference's answer one
-step further on the runtime side: a maximal LINEAR chain whose members are all
-native-capable (NullSource/Head/Copy/CopyRand/NullSink/VectorSource/VectorSink,
-FileSource (≤256 MB RAM snapshot) and bounded FileSink (≤256 MB, one-shot
-flush), plus the DSP set: plain/decimating/rational-resampling Fir over
-f32/c64 with f32/c64 taps, QuadratureDemod, and — with the explicit
+step further on the runtime side: a maximal source-rooted TREE whose members
+are all native-capable (NullSource/Head/Copy/CopyRand/NullSink/VectorSource/
+VectorSink, FileSource (≤256 MB RAM snapshot) and bounded FileSink (≤256 MB,
+one-shot flush), plus the DSP set: plain/decimating/rational-resampling Fir
+over f32/c64 with f32/c64 taps, QuadratureDemod, and — with the explicit
 ``fastchain_static = True`` opt-in, because their live retune handlers cannot
-reach a fused chain — XlatingFir, sample-mode Agc, and the fxpt-NCO
-SignalSource), with no message edges,
-taps, broadcasts, or inplace edges, is lifted out of the actor plane entirely
-and executed by
-``native/fastchain.cpp`` — one C++ thread round-robining the whole pipe over
-plain ring buffers (one pinned flow.rs worker that owns every block of the
-pipe). Stages carry their own output item size, so dtype-changing members
-(complex FIR → f32 demod) fuse too.
+reach a fused chain — XlatingFir, sample-mode Agc, the fxpt-NCO SignalSource,
+Delay, and Throttle), with no message or inplace edges, is lifted out of the
+actor plane entirely and executed by ``native/fastchain.cpp`` — one C++
+thread round-robining the whole tree over plain ring buffers (one pinned
+flow.rs worker that owns every block of the pipe). Stages carry their own
+output item size, so dtype-changing members (complex FIR → f32 demod) fuse
+too. Since v3 (round 5), an output port wired to SEVERAL edges fuses as a
+broadcast ring: every consumer sees every item with its own read index — the
+actor runtime's 1-writer→N-reader port-group semantics — and a finished
+consumer's slot is released so an early-finishing Head branch cannot wedge
+its siblings (the actor runtime likewise drops a finished reader). Leaves
+must all be sinks; each collecting sink's capacity derives from its own
+source→sink path.
 
 The substitution is transparent to the supervisor protocol: the chain task
 answers the init barrier for each member, watches for Terminate (the native
@@ -70,7 +75,7 @@ log = logger("runtime.fastchain")
 (FC_NULL_SOURCE, FC_HEAD, FC_COPY, FC_COPY_RAND, FC_NULL_SINK,
  FC_VEC_SOURCE, FC_VEC_SINK, FC_FIR_FF, FC_FIR_CF, FC_FIR_CC,
  FC_QUAD_DEMOD, FC_XLATING, FC_AGC, FC_RESAMPLE, FC_SIG,
- FC_DELAY) = range(16)
+ FC_DELAY, FC_THROTTLE) = range(17)
 
 
 def _resample_m_hi(total: int, interp: int, decim: int) -> int:
@@ -109,14 +114,15 @@ def _load() -> Optional[ctypes.CDLL]:
     # probe is checked too, so the NEXT struct change only has to bump the
     # version constant for stale-library protection to hold.
     lib = probe_native(
-        "fsdr_fastchain_run_v2", ctypes.c_int64,
-        [ctypes.POINTER(_FcStage), ctypes.c_int32, ctypes.c_int64,
+        "fsdr_fastchain_run_v3", ctypes.c_int64,
+        [ctypes.POINTER(_FcStage), ctypes.c_int32,
+         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
          ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
          ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)])
     if lib is not None:
         try:
             lib.fsdr_fastchain_abi.restype = ctypes.c_int64
-            if lib.fsdr_fastchain_abi() != 7:
+            if lib.fsdr_fastchain_abi() != 8:
                 lib = None
         except AttributeError:
             lib = None
@@ -140,7 +146,7 @@ def _native_stage(kernel) -> Optional[tuple]:
     from ..blocks.dsp import Agc, Fir, QuadratureDemod, SignalSource, \
         XlatingFir
     from ..blocks.io import FileSink, FileSource
-    from ..blocks.stream import Copy, Delay, Head
+    from ..blocks.stream import Copy, Delay, Head, Throttle
     from ..blocks.vector import CopyRand, NullSink, NullSource, VectorSink, \
         VectorSource
     from ..dsp.kernels import DecimatingFirFilter, FirFilter, \
@@ -281,6 +287,19 @@ def _native_stage(kernel) -> Optional[tuple]:
         if not getattr(kernel, "fastchain_static", False):
             return None
         return (FC_DELAY, int(kernel._pad), int(kernel._skip), 0.0, None)
+    if type(kernel) is Throttle:
+        # static opt-in: Throttle has a live rate retune handler a fused
+        # chain cannot service; the native stage reproduces the actor's
+        # budget math (elapsed*rate - sent) against the monotonic clock
+        if not getattr(kernel, "fastchain_static", False):
+            return None
+        import math
+        if kernel._t0 is not None or not (kernel.rate > 0) \
+                or not math.isfinite(kernel.rate):
+            # mid-stream anchor / degenerate rate (inf·elapsed → NaN budget:
+            # the actor path raises on it; the fused loop must not hang)
+            return None
+        return (FC_THROTTLE, 0, 0, float(kernel.rate), None)
     if type(kernel) is SignalSource:
         # same static opt-in rule: SignalSource has live freq/amplitude
         # handlers a fused chain cannot service. Only the fxpt NCO fuses —
@@ -352,17 +371,37 @@ def _sink_bound_specs(specs) -> Optional[int]:
     return bound
 
 
-def _sink_bound(chain) -> Optional[int]:
-    return _sink_bound_specs([_native_stage(k) for k in chain])
+class NativeTree(list):
+    """Fusable kernels in topological order. ``in_ring[i]`` is the index of
+    the member whose output ring member i consumes (-1 = the tree's single
+    source). A ring consumed by several members BROADCASTS: every consumer
+    sees every item with its own read index — the same semantics the actor
+    runtime gives one output port wired to several edges
+    (`runtime/buffer/circular.py:108`, 1 writer → N readers). A plain linear
+    chain is the degenerate tree ``in_ring = [-1, 0, 1, ...]``."""
+
+    def __init__(self, members, in_ring):
+        super().__init__(members)
+        self.in_ring = list(in_ring)
 
 
-def find_native_chains(fg) -> List[List[object]]:
-    """Maximal source→sink linear chains of native-capable kernels in ``fg``.
+def _tree_path(in_ring, i) -> List[int]:
+    """Stage indices from the source down to (and including) stage i."""
+    path = []
+    while i >= 0:
+        path.append(i)
+        i = in_ring[i]
+    return path[::-1]
+
+
+def find_native_chains(fg) -> List[NativeTree]:
+    """Maximal source-rooted TREES of native-capable kernels in ``fg``.
 
     A member must: be native-capable, touch no message or inplace edges, have
-    every stream port wired exactly once (no taps/broadcasts), and the chain
-    must start at a no-input source and end at a no-output sink — so no tags
-    can enter the chain and no Python block shares its buffers."""
+    every stream port wired (an output port wired to several edges becomes a
+    broadcast ring), and every leaf must be a no-output sink — so no tags can
+    enter the tree and no Python block shares its buffers. Returns a
+    ``NativeTree`` per fusable source (linear chains included)."""
     # env checked per call (not just at lib load) so perf probes can A/B the
     # Python actor path vs the native chain inside one process
     if os.environ.get("FSDR_NO_FASTCHAIN") or not fastchain_available():
@@ -381,87 +420,115 @@ def find_native_chains(fg) -> List[List[object]]:
         return (_native_stage(k) is not None
                 and id(k) not in msg_touched and id(k) not in inp_touched
                 and len(k.stream_inputs) <= 1 and len(k.stream_outputs) <= 1
-                and len(out_edges.get(id(k), [])) == len(k.stream_outputs)
+                and (not k.stream_outputs
+                     or len(out_edges.get(id(k), [])) >= 1)
                 and in_deg.get(id(k), 0) == len(k.stream_inputs))
 
-    chains = []
+    from ..blocks.io import FileSink
+    from ..blocks.vector import VectorSink
+
+    trees = []
     for k in (b.kernel for b in fg._blocks if b is not None):
         if not (eligible(k) and not k.stream_inputs and k.stream_outputs):
-            continue                                   # chain heads: sources
-        chain = [k]
-        cur = k
-        while True:
-            outs = out_edges.get(id(cur), [])
-            if len(outs) != 1:
-                break
-            nxt = outs[0].dst
-            if not eligible(nxt):
-                break
-            chain.append(nxt)
-            if not nxt.stream_outputs:
-                break                                  # reached a sink
-            cur = nxt
-        if len(chain) < 2 or chain[-1].stream_outputs:
+            continue                                   # tree roots: sources
+        members, inr, ok = [k], [-1], True
+        seen = {id(k)}
+        frontier = [(k, 0)]
+        while frontier and ok:
+            cur, ci = frontier.pop()
+            for e in out_edges.get(id(cur), []):
+                nxt = e.dst
+                if id(nxt) in seen or not eligible(nxt):
+                    ok = False         # a leaf that is not a fusable sink, a
+                    break              # merge, or a cycle: the tree cannot fuse
+                seen.add(id(nxt))
+                members.append(nxt)
+                inr.append(ci)
+                if nxt.stream_outputs:
+                    frontier.append((nxt, len(members) - 1))
+        if not ok or len(members) < 2:
             continue
-        from ..blocks.io import FileSink
-        from ..blocks.vector import VectorSink
-        if type(chain[-1]) in (VectorSink, FileSink):
-            bound = _sink_bound(chain)
+        dts = _tree_dtypes(members, inr)
+        if dts is None:
+            continue                   # an edge's item width is unresolvable
+        ok = True
+        for i, m in enumerate(members):
+            if m.stream_outputs or type(m) not in (VectorSink, FileSink):
+                continue
+            bound = _sink_bound_specs(
+                [_native_stage(members[j]) for j in _tree_path(inr, i)])
             if bound is None:
-                continue               # unbounded into a collecting sink
-            if type(chain[-1]) is FileSink:
-                dts = _edge_dtypes(chain)
+                ok = False             # unbounded into a collecting sink
+                break
+            if type(m) is FileSink and \
+                    bound * dts[i].itemsize > (256 << 20):
                 # the fused sink buffers the WHOLE bounded output in RAM
                 # before the one-shot flush; large bounded files stream
                 # O(ring) on the actor path instead (same 256 MB gate as
                 # the FileSource snapshot)
-                if dts is None or bound * dts[-1].itemsize > (256 << 20):
-                    continue
-        if _edge_dtypes(chain) is None:
-            continue                   # an edge's item width is unresolvable
-        chains.append(chain)
-    return chains
+                ok = False
+                break
+        if ok:
+            trees.append(NativeTree(members, inr))
+    return trees
 
 
-def _edge_dtypes(chain) -> Optional[list]:
-    """Resolve the ONE dtype of every inter-stage edge (len(chain)-1 entries).
+def _tree_dtypes(members, in_ring) -> Optional[list]:
+    """Per-stage OUT dtype (sinks: their input dtype). None if unresolvable.
 
-    Each edge takes the src output port's dtype or, if untyped, the dst input
-    port's; an edge where both are set but disagree, or neither is set, makes
-    the chain ineligible (the C ring's item width would be a guess). Per-edge
-    widths are what let dtype-changing stages (c64 FIR → f32 demod) fuse —
-    the v1 driver required one dtype chain-wide."""
-    out = []
-    for a, b in zip(chain[:-1], chain[1:]):
-        src = a.stream_outputs[0].dtype if a.stream_outputs else None
-        dst = b.stream_inputs[0].dtype if b.stream_inputs else None
-        if src is not None and dst is not None and src != dst:
-            return None
-        dt = src if src is not None else dst
+    A producer's dtype comes from its output port or, if untyped, its
+    consumers' input ports — every consumer of a broadcast ring must agree
+    (the C ring has ONE item width). Width conservation through
+    width-preserving stages is enforced per consumer edge: an UNTYPED
+    pass-through (Copy(None)) between a c64 edge and an f32 edge would
+    otherwise fuse and make the C driver memcpy 8-byte items into a 4-byte
+    ring (heap overflow, caught by review + ASan). Only stages whose kind
+    legitimately changes the item width (quad demod) may differ."""
+    n = len(members)
+    cons: List[List[int]] = [[] for _ in range(n)]
+    for i in range(1, n):
+        cons[in_ring[i]].append(i)
+    dts: list = [None] * n
+    for i, k in enumerate(members):
+        if not k.stream_outputs:
+            continue
+        dt = k.stream_outputs[0].dtype
+        for j in cons[i]:
+            dst_dt = members[j].stream_inputs[0].dtype
+            if dst_dt is None:
+                continue
+            if dt is None:
+                dt = dst_dt
+            elif dst_dt != dt:
+                return None
         if dt is None:
             return None
-        out.append(dt)
-    # item-width conservation through width-preserving stages: an UNTYPED
-    # pass-through (Copy(None)) between a c64 edge and an f32 edge would
-    # otherwise fuse and make the C driver memcpy 8-byte items into a 4-byte
-    # ring (heap overflow, caught by review + ASan). Only stages whose kind
-    # legitimately changes the item width (quad demod) may differ.
-    for i, k in enumerate(chain[1:-1], start=1):
-        spec = _native_stage(k)
+        dts[i] = dt
+    for i in range(1, n):
+        if not members[i].stream_outputs:
+            dts[i] = dts[in_ring[i]]
+    for i in range(1, n):
+        if not members[i].stream_outputs:
+            continue
+        spec = _native_stage(members[i])
         if spec is not None and spec[0] != FC_QUAD_DEMOD \
-                and out[i - 1].itemsize != out[i].itemsize:
+                and dts[in_ring[i]].itemsize != dts[i].itemsize:
             return None
-    return out
+    return dts
 
 
 async def run_chain_task(members: Sequence, fg_inbox, scheduler,
-                         ring_items: int = 1 << 16) -> None:
+                         ring_items: int = 1 << 16,
+                         in_ring: Optional[Sequence[int]] = None) -> None:
     """Impersonate ``members`` (WrappedKernels) at the supervisor protocol level
     while the native driver runs the chain: answer the init barrier per member,
     watch for Terminate, then report per-member BlockDone with counters.
 
+    ``in_ring`` is the tree topology from ``NativeTree`` (None = linear chain);
     ``FSDR_FASTCHAIN_RING`` overrides the inter-stage ring size in items
     (perf/buffer_rand.py sweeps it the way the reference sweeps buffer sizes)."""
+    inr = (list(in_ring) if in_ring is not None
+           else [-1] + list(range(len(members) - 1)))
     ring_items = _ring_items() if os.environ.get("FSDR_FASTCHAIN_RING") \
         else ring_items
     from .runtime import BlockDoneMsg, BlockErrorMsg, InitializedMsg
@@ -520,14 +587,14 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
         lib = _load()
         n = len(members)
         kernels = [b.kernel for b in members]
-        # per-edge dtypes (find_native_chains guarantees resolvability): edge
-        # i sizes stage i's output ring; the LAST edge sizes the sink buffer —
-        # deriving them separately corrupted memory when the sink port was
-        # untyped
-        edges = _edge_dtypes(kernels)
+        # per-stage OUT dtypes (find_native_chains guarantees resolvability):
+        # dts[i] sizes stage i's output ring (sinks: their input = the sink
+        # buffer) — deriving them separately corrupted memory when the sink
+        # port was untyped
+        dts = _tree_dtypes(kernels, inr)
         stages = (_FcStage * n)()
         keepalive = []                 # numpy buffers the C side points into
-        sink_buf = None
+        sink_bufs = {}                 # sink stage idx → collect buffer
         agc_params = {}                # member idx → live params block
         from ..blocks.io import FileSink, FileSource
         # ONE _native_stage pass; FileSource budgets are then corrected from
@@ -544,7 +611,7 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
                     # one-shot RAM snapshot (NOT a memmap: truncation mid-run
                     # would SIGBUS through a map; the ≤256 MB gate is in the
                     # registry)
-                    snap = np.fromfile(b.kernel.path, dtype=edges[0])
+                    snap = np.fromfile(b.kernel.path, dtype=dts[0])
                     if len(snap) == 0:
                         raise ValueError(
                             f"{b.kernel.path} emptied between launch and build")
@@ -558,29 +625,35 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
                 # (the resampler's poly is a .T view — never hand C a stride)
             elif kind == FC_AGC:
                 agc_params[i] = datas[i]  # C writes the live gain into slot 3
-        bound = _sink_bound_specs(specs)
-        if type(members[-1].kernel) is FileSink:
-            # actor-init parity: FileSink.init opens "wb" (creates/truncates
-            # the file even if the run later terminates early) — and doing it
-            # HERE, inside the guarded build, surfaces an unwritable path as
-            # BlockError exactly like the actor path's init failure
-            open(members[-1].kernel.path, "wb").close()
+        # per-sink bounds over each sink's own source→sink path (a tree can
+        # hold several collecting sinks)
+        bounds = {i: _sink_bound_specs([specs[j] for j in _tree_path(inr, i)])
+                  for i in range(n) if specs[i][0] == FC_VEC_SINK}
+        for i, b in enumerate(members):
+            if specs[i][0] == FC_VEC_SINK and type(b.kernel) is FileSink:
+                # actor-init parity: FileSink.init opens "wb" (creates/
+                # truncates the file even if the run later terminates early)
+                # — and doing it HERE, inside the guarded build, surfaces an
+                # unwritable path as BlockError exactly like the actor path's
+                # init failure
+                open(b.kernel.path, "wb").close()
         for i, b in enumerate(members):
             kind, p0, p1, f0, _ = specs[i]
             data = datas[i]
             if kind == FC_VEC_SINK:
-                sink_buf = np.empty(int(bound), dtype=edges[-1])
-                data, p0 = sink_buf, int(bound)
+                buf = np.empty(int(bounds[i]), dtype=dts[i])
+                sink_bufs[i] = buf
+                data, p0 = buf, int(bounds[i])
             ptr = None
             if data is not None:
                 keepalive.append(data)
                 ptr = data.ctypes.data_as(ctypes.c_void_p)
-            isz = int(edges[i].itemsize if i < n - 1 else edges[-1].itemsize)
+            isz = int(dts[i].itemsize)
             stages[i] = _FcStage(kind, isz, p0, p1, f0, ptr)
-        return lib, stages, keepalive, sink_buf, agc_params
+        return lib, stages, keepalive, sink_bufs, agc_params
 
     try:
-        lib, stages, keepalive, sink_buf, agc_params = _build_stages()
+        lib, stages, keepalive, sink_bufs, agc_params = _build_stages()
     except Exception as e:                              # noqa: BLE001
         log.error("fastchain stage build failed (%r)", e)
         fg_inbox.send(BlockErrorMsg(members[0].id, e))
@@ -636,8 +709,9 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
             w.cancel()
 
     try:
+        inr_arr = (ctypes.c_int32 * n)(*inr)
         rc = await scheduler.spawn_blocking(
-            lambda: lib.fsdr_fastchain_run_v2(stages, n, ring_items,
+            lambda: lib.fsdr_fastchain_run_v3(stages, n, inr_arr, ring_items,
                                               ctypes.byref(stop), per_in,
                                               per_out, per_calls))
     except Exception as e:                              # noqa: BLE001
@@ -675,24 +749,32 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
             # same wrap-advance the actor work() applies per chunk
             k._phase_i = fxpt.advance_u32(k._phase_i, k._inc_i,
                                           int(per_out[i]))
-    if sink_buf is not None:
+    flush_errors = {}                  # sink stage idx → OSError
+    for si, buf in sink_bufs.items():
         from ..blocks.io import FileSink
-        sk = members[-1].kernel
-        got = sink_buf[:int(per_in[n - 1])]
+        sk = members[si].kernel
+        got = buf[:int(per_in[si])]
         if type(sk) is FileSink:
             try:
                 # one-shot flush of the collected items — same bytes the
                 # actor path would have streamed out incrementally
                 got.tofile(sk.path)
-                sk.n_written = int(per_in[n - 1])
+                sk.n_written = int(per_in[si])
             except OSError as e:       # disk full / path vanished mid-run:
-                # surface like an actor write failure, never hang the
-                # supervisor by dying before the done/error messages
-                fg_inbox.send(BlockErrorMsg(members[-1].id, e))
-                for b in members[:-1]:
-                    fg_inbox.send(BlockDoneMsg(b.id, b))
-                return
+                # surface like an actor write failure — but keep flushing the
+                # OTHER sinks of the tree first (each streams independently
+                # on the actor path; one full disk must not drop its
+                # siblings' data), and never hang the supervisor by dying
+                # before the done/error messages
+                flush_errors[si] = e
         else:
             sk._chunks = [got]
+    if flush_errors:
+        for si, e in flush_errors.items():
+            fg_inbox.send(BlockErrorMsg(members[si].id, e))
+        for i, b in enumerate(members):
+            if i not in flush_errors:
+                fg_inbox.send(BlockDoneMsg(b.id, b))
+        return
     del keepalive
     _finish_all()

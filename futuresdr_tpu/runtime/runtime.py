@@ -110,12 +110,12 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
     for ch in chain_kernels:
         members = [wk[id(k)] for k in ch]
         fused.update(id(b) for b in members)
-        chain_tasks.append(members)
+        chain_tasks.append((members, getattr(ch, "in_ring", None)))
     handles = scheduler.run_flowgraph_blocks(
         [b for b in blocks if id(b) not in fused], fg_inbox)
-    for members in chain_tasks:
+    for members, inr in chain_tasks:
         handles.append(scheduler.spawn(
-            run_chain_task(members, fg_inbox, scheduler)))
+            run_chain_task(members, fg_inbox, scheduler, in_ring=inr)))
 
     # ---- init barrier (`runtime.rs:380-415`) --------------------------------
     for b in blocks:

@@ -1,9 +1,12 @@
-// fastchain: single-threaded round-robin executor for linear chains of stream
-// blocks — the native work-loop driver for the small-chunk regime, now with
-// real DSP stages (FIR with carried history + decimation, quadrature demod,
-// and the rotate→FIR→decimate xlating stage — which Python only fuses behind
-// an explicit fastchain_static opt-in, since a fused chain cannot service the
-// block's live freq retune handler).
+// fastchain: single-threaded round-robin executor for source-rooted TREES of
+// stream blocks (linear chains as the degenerate case) — the native work-loop
+// driver for the small-chunk regime, with real DSP stages (FIR with carried
+// history + decimation, quadrature demod, and the rotate→FIR→decimate
+// xlating stage — which Python only fuses behind an explicit fastchain_static
+// opt-in, since a fused chain cannot service the block's live freq retune
+// handler). v3 protocol: an in_ring[] topology array; a ring consumed by
+// several stages BROADCASTS (per-consumer read indices, finished consumers
+// released) — the actor runtime's 1-writer→N-reader port groups.
 //
 // Reference role: src/runtime/scheduler/flow.rs:265-442 — the reference's
 // FlowScheduler runs pinned workers with LOCAL run queues precisely because
@@ -34,6 +37,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <vector>
 
 #ifdef __AVX512F__
@@ -70,6 +74,10 @@ enum {
                           // native ramp is BIT-exact vs the Python block.
     FC_DELAY = 15,        // p0 = pad (leading zero items), p1 = skip
                           // (leading input items dropped); then 1:1 copy
+    FC_THROTTLE = 16,     // wall-clock rate limit: f0 = items/s. Python fuses
+                          // it only behind the fastchain_static opt-in (the
+                          // block has a live rate retune handler, like
+                          // FC_XLATING/FC_AGC).
 };
 
 struct FcStage {
@@ -90,11 +98,27 @@ struct Ring {
     int64_t cap = 0;       // items
     int64_t isz = 0;       // bytes per item
     int64_t head = 0;      // write index (items, not wrapped)
-    int64_t tail = 0;      // read index
     bool eos = false;
+    // v3 topology: one read index per consumer (broadcast ring — every
+    // consumer sees every item, like the actor runtime's 1-writer→N-reader
+    // port groups, `runtime/buffer/circular.py:108`). Linear chains have
+    // exactly one entry.
+    std::vector<int64_t> tails;
+    // A finished consumer's slot is RELEASED so its frozen tail no longer
+    // constrains the writer — the actor runtime likewise drops a finished
+    // block's reader from the port group (an early-finishing Head branch
+    // must not wedge its broadcast siblings).
+    std::vector<char> released;
 
-    int64_t count() const { return head - tail; }
-    int64_t space() const { return cap - count(); }
+    int64_t min_tail() const {
+        int64_t m = head;
+        for (size_t c = 0; c < tails.size(); ++c)
+            if (!released[c] && tails[c] < m) m = tails[c];
+        return m;
+    }
+    int64_t count(int c) const { return head - tails[static_cast<size_t>(c)]; }
+    int64_t space() const { return cap - (head - min_tail()); }
+    void release(int c) { released[static_cast<size_t>(c)] = 1; }
 };
 
 // xorshift64* — per-stage chunk-size RNG for FC_COPY_RAND
@@ -126,8 +150,9 @@ inline void span_copy(const uint8_t* sb, int64_t scap, int64_t& si,
     }
 }
 
-inline void ring_copy(Ring& src, Ring& dst, int64_t k) {
-    span_copy(reinterpret_cast<const uint8_t*>(src.buf), src.cap, src.tail,
+inline void ring_copy(Ring& src, int ci, Ring& dst, int64_t k) {
+    span_copy(reinterpret_cast<const uint8_t*>(src.buf), src.cap,
+              src.tails[static_cast<size_t>(ci)],
               reinterpret_cast<uint8_t*>(dst.buf), dst.cap, dst.head, k,
               src.isz);
 }
@@ -281,7 +306,15 @@ struct StageState {
     double agc_gain = 1.0;       // FC_AGC feedback state (blocks/dsp.py Agc)
     int64_t rs_m = 0;            // FC_RESAMPLE absolute output index
     int64_t rs_total = 0;        // FC_RESAMPLE absolute inputs seen
+    double thr_t0 = -1.0;        // FC_THROTTLE clock anchor (monotonic s; <0 unset)
+    int64_t thr_sent = 0;        // FC_THROTTLE items forwarded since anchor
 };
+
+inline double mono_seconds() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<double>(ts.tv_sec) + 1e-9 * static_cast<double>(ts.tv_nsec);
+}
 
 // Outputs producible once `total` absolute inputs are visible: the largest m
 // with (m·D)//I ≤ total−1 is (I·total−1)//D, plus one — the closed form of
@@ -293,24 +326,27 @@ inline int64_t resample_m_hi(int64_t total, int64_t I, int64_t D) {
     return (I * total - 1) / D + 1;
 }
 
-}  // namespace
-
-extern "C" {
-
-// ABI version, checked by fastchain.py's _load(): bump on ANY FcStage layout
-// or protocol change so a stale .so can never be driven with a newer struct.
-int64_t fsdr_fastchain_abi(void) { return 7; }
-
-// Run the chain to completion (sink finished) or until *stop becomes nonzero.
-// per_in[i]/per_out[i] accumulate items consumed/produced by stage i (sources
-// consume 0, sinks produce 0); per_calls[i] counts chunks moved (the
-// work-call analog). All arrays are updated DURING the run, so the Python
-// side reads them live for metrics. Returns items the sink consumed, or -1 on
-// malformed input / stall (-2: sink capacity bound violated).
-int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
-                              volatile int32_t* stop, int64_t* per_in,
-                              int64_t* per_out, int64_t* per_calls) {
+// Run a chain/tree to completion (every sink finished) or until *stop becomes
+// nonzero. ``inr[i]`` is the index of the stage whose output ring stage i
+// consumes (-1 for the source at index 0); stages listed in topological order.
+// A ring read by several stages is a BROADCAST ring: every consumer sees every
+// item, own read index each (the actor runtime's 1-writer→N-reader port
+// groups). per_in[i]/per_out[i] accumulate items consumed/produced by stage i
+// (sources consume 0, sinks produce 0); per_calls[i] counts chunks moved (the
+// work-call analog). All arrays are updated DURING the run, so the Python side
+// reads them live for metrics. Returns total items consumed across sinks, or
+// -1 on malformed input / stall (-2: sink capacity bound violated).
+int64_t fc_run_core(const FcStage* st, int32_t n, const int32_t* inr,
+                    int64_t ring_items, volatile int32_t* stop,
+                    int64_t* per_in, int64_t* per_out, int64_t* per_calls) {
     if (n < 2 || ring_items <= 0) return -1;
+    // ---- topology: consumer counts + per-stage consumer slot ---------------
+    std::vector<int> n_cons(n, 0), slot(n, 0);
+    if (inr[0] != -1) return -1;
+    for (int i = 1; i < n; ++i) {
+        if (inr[i] < 0 || inr[i] >= i) return -1;   // topo order, single root
+        slot[i] = n_cons[inr[i]]++;
+    }
     for (int i = 0; i < n; ++i) {
         if (st[i].isz_out <= 0) return -1;
         if (st[i].kind == FC_COPY_RAND && st[i].p0 <= 0)
@@ -325,15 +361,26 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
             (st[i].p0 < 1 || (st[i].p1 & 0xFFFFFFFFLL) < 1 ||
              st[i].data == nullptr))
             return -1;                   // ntaps/decim/taps sanity
+        if (st[i].kind == FC_THROTTLE &&
+            !(st[i].f0 > 0.0 && std::isfinite(st[i].f0)))
+            return -1;    // rate must be positive finite (inf·elapsed → NaN
+                          // budget → int64 min → permanent 0-item passes: the
+                          // loop would sleep forever instead of erroring)
     }
     if (st[0].kind != FC_NULL_SOURCE && st[0].kind != FC_VEC_SOURCE &&
         st[0].kind != FC_SIG)
         return -1;
     if (st[0].kind == FC_SIG && st[0].data == nullptr) return -1;
-    if (st[n - 1].kind != FC_NULL_SINK && st[n - 1].kind != FC_VEC_SINK)
-        return -1;
-    for (int i = 1; i + 1 < n; ++i) {
-        if (st[i].kind < FC_HEAD || st[i].kind > FC_DELAY ||
+    int n_sinks = 0;
+    for (int i = 1; i < n; ++i) {
+        if (n_cons[i] == 0) {            // leaf: must be a sink kind
+            if (st[i].kind != FC_NULL_SINK && st[i].kind != FC_VEC_SINK)
+                return -1;
+            ++n_sinks;
+            continue;
+        }
+        // middle stage (has both an input ring and consumers)
+        if (st[i].kind < FC_HEAD || st[i].kind > FC_THROTTLE ||
             st[i].kind == FC_SIG ||
             st[i].kind == FC_NULL_SINK || st[i].kind == FC_VEC_SOURCE ||
             st[i].kind == FC_VEC_SINK)
@@ -348,12 +395,16 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
         // demod must see equal in/out item sizes, or ring_copy would write
         // src-width items into a dst-width ring (defense in depth — the
         // Python chain finder enforces the same rule)
-        if (st[i].kind != FC_QUAD_DEMOD && st[i - 1].isz_out != st[i].isz_out)
+        if (st[i].kind != FC_QUAD_DEMOD &&
+            st[inr[i]].isz_out != st[i].isz_out)
             return -1;
     }
+    if (n_sinks == 0) return -1;
 
-    std::vector<Ring> rings(n - 1);
-    for (int i = 0; i < n - 1; ++i) {
+    // one output ring per stage with consumers (ring index = producer index)
+    std::vector<Ring> rings(n);
+    for (int i = 0; i < n; ++i) {
+        if (n_cons[i] == 0) continue;
         Ring& r = rings[i];
         r.isz = st[i].isz_out;
         // calloc: rings start zeroed, so the zero-producing source can advance
@@ -366,6 +417,8 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
             return -1;
         }
         r.cap = ring_items;
+        r.tails.assign(static_cast<size_t>(n_cons[i]), 0);
+        r.released.assign(static_cast<size_t>(n_cons[i]), 0);
     }
 
     std::vector<int64_t> head_left(n, -1);   // FC_HEAD remaining budget
@@ -379,7 +432,7 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
             rng[i] = static_cast<uint64_t>(st[i].p1) * 0x9E3779B97F4A7C15ULL + 1;
         if ((st[i].kind >= FC_FIR_FF && st[i].kind <= FC_FIR_CC) ||
             st[i].kind == FC_XLATING) {
-            const int64_t in_isz = rings[i - 1].isz;
+            const int64_t in_isz = st[inr[i]].isz_out;
             ss[i].hist.assign(
                 static_cast<size_t>((st[i].p0 - 1) * in_isz), 0);
             ss[i].xbuf.resize(
@@ -399,7 +452,7 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
             ss[i].rs_total = st[i].p1;   // skip remaining
         }
         if (st[i].kind == FC_RESAMPLE) {
-            const int64_t in_isz = rings[i - 1].isz;
+            const int64_t in_isz = st[inr[i]].isz_out;
             const int64_t K = st[i].p0;
             ss[i].hist.assign(static_cast<size_t>((K - 1) * in_isz), 0);
             ss[i].xbuf.resize(
@@ -410,14 +463,18 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
             ss[i].ybuf.resize(static_cast<size_t>(ring_items * st[i].isz_out));
         }
     }
-    int64_t sink_count =
-        (st[n - 1].kind == FC_VEC_SINK) ? -1 : st[n - 1].p0;  // -1 = until EOS
-    int64_t sink_items = 0;
+    // per-sink finish bounds (-1 = until EOS) and consumed counters
+    std::vector<int64_t> snk_count(n, -1), snk_items(n, 0);
+    for (int i = 1; i < n; ++i)
+        if (n_cons[i] == 0 && st[i].kind == FC_NULL_SINK)
+            snk_count[i] = st[i].p0;
+    int sinks_left = n_sinks;
 
     // relaxed atomic load: the flag is written from a Python thread; plain
     // volatile is a data race under the C++ memory model
-    while (!__atomic_load_n(stop, __ATOMIC_RELAXED) && !done[n - 1]) {
+    while (!__atomic_load_n(stop, __ATOMIC_RELAXED) && sinks_left > 0) {
         bool progress = false;
+        bool throttled = false;    // a throttle is pacing (not a stall)
         for (int i = 0; i < n; ++i) {
             if (done[i]) continue;
             if (i == 0) {
@@ -528,37 +585,45 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                 }
                 continue;
             }
-            Ring& in = rings[i - 1];
-            if (i == n - 1) {
-                int64_t k = in.count();
+            Ring& in = rings[inr[i]];
+            const int ci = slot[i];
+            if (n_cons[i] == 0) {                      // sink leaf
+                int64_t k = in.count(ci);
                 if (st[i].kind == FC_VEC_SINK) {
-                    if (sink_items + k > st[i].p0) {
+                    if (snk_items[i] + k > st[i].p0) {
                         for (auto& r : rings) std::free(r.buf);
                         return -2;        // capacity bound violated (bug)
                     }
                     span_copy(reinterpret_cast<const uint8_t*>(in.buf),
-                              in.cap, in.tail, st[i].data, 0, sink_items,
-                              k, in.isz);
+                              in.cap, in.tails[ci], st[i].data, 0,
+                              snk_items[i], k, in.isz);
                     if (k > 0) {
                         progress = true;
                         if (per_in) per_in[i] += k;
                         if (per_calls) per_calls[i] += 1;
                     }
-                    if (in.eos && in.count() == 0) done[i] = true;
+                    if (in.eos && in.count(ci) == 0) {
+                        done[i] = true;
+                        in.release(ci);
+                        --sinks_left;
+                    }
                     continue;
                 }
-                if (sink_count >= 0 && sink_items + k > sink_count)
-                    k = sink_count - sink_items;
+                if (snk_count[i] >= 0 && snk_items[i] + k > snk_count[i])
+                    k = snk_count[i] - snk_items[i];
                 if (k > 0) {
-                    in.tail += k;
-                    sink_items += k;
+                    in.tails[ci] += k;
+                    snk_items[i] += k;
                     progress = true;
                     if (per_in) per_in[i] += k;
                     if (per_calls) per_calls[i] += 1;
                 }
-                if ((in.eos && in.count() == 0) ||
-                    (sink_count >= 0 && sink_items >= sink_count))
+                if ((in.eos && in.count(ci) == 0) ||
+                    (snk_count[i] >= 0 && snk_items[i] >= snk_count[i])) {
                     done[i] = true;
+                    in.release(ci);
+                    --sinks_left;
+                }
                 continue;
             }
             Ring& out = rings[i];
@@ -573,7 +638,7 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                 StageState& s = ss[i];
                 // inputs we may consume so outputs fit: with phase p, n inputs
                 // yield (n > p) ? (n-1-p)/decim + 1 : 0 outputs → n ≤ p + space·decim
-                int64_t k = in.count();
+                int64_t k = in.count(ci);
                 int64_t lim = s.phase + out.space() * decim;
                 if (lim < k) k = lim;
                 // keep chunks tile-aligned while upstream is live: the
@@ -595,7 +660,7 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     std::memcpy(xb, s.hist.data(), s.hist.size());
                     int64_t xi = nt - 1;
                     span_copy(reinterpret_cast<const uint8_t*>(in.buf), in.cap,
-                              in.tail, xb, 0, xi, k, isz_in);
+                              in.tails[ci], xb, 0, xi, k, isz_in);
                     if (st[i].kind == FC_XLATING) {
                         // rotate the fresh chunk in place BEFORE the filter:
                         // downstream (kernel, history carry) then sees the
@@ -670,15 +735,16 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     if (per_out) per_out[i] += m;
                     if (per_calls) per_calls[i] += 1;
                 }
-                if (in.eos && in.count() == 0) {
+                if (in.eos && in.count(ci) == 0) {
                     out.eos = true;      // history tail dropped, like the actor
                     done[i] = true;
+                    in.release(ci);
                 }
                 continue;
             }
             if (st[i].kind == FC_QUAD_DEMOD) {
                 StageState& s = ss[i];
-                int64_t k = in.count();
+                int64_t k = in.count(ci);
                 if (out.space() < k) k = out.space();
                 if (k > 0) {
                     const float gain = static_cast<float>(st[i].f0);
@@ -686,7 +752,7 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     const float* rb = reinterpret_cast<const float*>(in.buf);
                     float pr = s.last_re, pi = s.last_im;
                     for (int64_t j = 0; j < k; ++j) {
-                        int64_t off = (in.tail + j) % in.cap;
+                        int64_t off = (in.tails[ci] + j) % in.cap;
                         const float xr = rb[2 * off], xi_ = rb[2 * off + 1];
                         // x·conj(prev) = (xr·pr + xi·pi) + j(xi·pr − xr·pi)
                         yb[j] = gain * std::atan2(xi_ * pr - xr * pi,
@@ -696,7 +762,7 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     }
                     s.last_re = pr;
                     s.last_im = pi;
-                    in.tail += k;
+                    in.tails[ci] += k;
                     int64_t yi = 0;
                     span_copy(s.ybuf.data(), 0, yi,
                               reinterpret_cast<uint8_t*>(out.buf), out.cap,
@@ -706,9 +772,10 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     if (per_out) per_out[i] += k;
                     if (per_calls) per_calls[i] += 1;
                 }
-                if (in.eos && in.count() == 0) {
+                if (in.eos && in.count(ci) == 0) {
                     out.eos = true;
                     done[i] = true;
+                    in.release(ci);
                 }
                 continue;
             }
@@ -721,7 +788,7 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                 const bool cx = isz_in == 8;
                 // max inputs consumable so producible outputs fit out.space():
                 // binary search the monotone m_hi(total_in + n') − m ≤ space
-                int64_t n_av = in.count(), space = out.space();
+                int64_t n_av = in.count(ci), space = out.space();
                 int64_t lo = 0, hi = n_av;
                 while (lo < hi) {
                     const int64_t mid = (lo + hi + 1) / 2;
@@ -736,7 +803,7 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     std::memcpy(xb, s.hist.data(), s.hist.size());
                     int64_t xi = K - 1;
                     span_copy(reinterpret_cast<const uint8_t*>(in.buf), in.cap,
-                              in.tail, xb, 0, xi, k, isz_in);
+                              in.tails[ci], xb, 0, xi, k, isz_in);
                     const int64_t total = s.rs_total + k;
                     const int64_t m_hi = resample_m_hi(total, I, D);
                     const int64_t mcount = m_hi - s.rs_m;
@@ -780,9 +847,10 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     if (per_out) per_out[i] += mcount;
                     if (per_calls) per_calls[i] += 1;
                 }
-                if (in.eos && in.count() == 0) {
+                if (in.eos && in.count(ci) == 0) {
                     out.eos = true;
                     done[i] = true;
+                    in.release(ci);
                 }
                 continue;
             }
@@ -805,33 +873,34 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     }
                 }
                 // 2. drop leading inputs (negative delay)
-                if (s.rs_total > 0 && in.count() > 0) {
-                    int64_t k = in.count() < s.rs_total ? in.count()
+                if (s.rs_total > 0 && in.count(ci) > 0) {
+                    int64_t k = in.count(ci) < s.rs_total ? in.count(ci)
                                                         : s.rs_total;
-                    in.tail += k;
+                    in.tails[ci] += k;
                     s.rs_total -= k;
                     progress = true;
                     if (per_in) per_in[i] += k;
                 }
                 // 3. 1:1 copy
-                int64_t k = in.count();
+                int64_t k = in.count(ci);
                 if (out.space() < k) k = out.space();
                 if (k > 0) {
-                    ring_copy(in, out, k);
+                    ring_copy(in, ci, out, k);
                     progress = true;
                     if (per_in) per_in[i] += k;
                     if (per_out) per_out[i] += k;
                     if (per_calls) per_calls[i] += 1;
                 }
-                if (in.eos && in.count() == 0 && s.rs_m == 0) {
+                if (in.eos && in.count(ci) == 0 && s.rs_m == 0) {
                     out.eos = true;   // pad must flush before EOS, like the
                     done[i] = true;   // actor's `_pad == 0` finish condition
+                    in.release(ci);
                 }
                 continue;
             }
             if (st[i].kind == FC_AGC) {
                 StageState& s = ss[i];
-                int64_t k = in.count();
+                int64_t k = in.count(ci);
                 if (out.space() < k) k = out.space();
                 if (k > 0) {
                     double* pr = reinterpret_cast<double*>(st[i].data);
@@ -847,7 +916,7 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     float* yb = reinterpret_cast<float*>(s.ybuf.data());
                     float g = static_cast<float>(s.agc_gain);
                     for (int64_t j = 0; j < k; ++j) {
-                        const int64_t off = (in.tail + j) % in.cap;
+                        const int64_t off = (in.tails[ci] + j) % in.cap;
                         // |x| like np.abs: hypotf for complex64, fabsf real
                         float mag;
                         if (cx) {
@@ -871,7 +940,7 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     }
                     s.agc_gain = g;
                     pr[3] = g;          // live gain, read back by Python
-                    in.tail += k;
+                    in.tails[ci] += k;
                     int64_t yi = 0;
                     span_copy(s.ybuf.data(), 0, yi,
                               reinterpret_cast<uint8_t*>(out.buf), out.cap,
@@ -881,15 +950,53 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                     if (per_out) per_out[i] += k;
                     if (per_calls) per_calls[i] += 1;
                 }
-                if (in.eos && in.count() == 0) {
+                if (in.eos && in.count(ci) == 0) {
                     out.eos = true;
                     done[i] = true;
+                    in.release(ci);
+                }
+                continue;
+            }
+
+            if (st[i].kind == FC_THROTTLE) {
+                // wall-clock pacing, the actor Throttle's exact budget math
+                // (blocks/stream.py:94-106): budget = elapsed·rate − sent.
+                // The anchor starts at the first pass, like the actor's
+                // first work() call.
+                StageState& s = ss[i];
+                const double now = mono_seconds();
+                if (s.thr_t0 < 0.0) {
+                    s.thr_t0 = now;
+                    s.thr_sent = 0;
+                }
+                int64_t budget = static_cast<int64_t>(
+                                     (now - s.thr_t0) * st[i].f0) -
+                                 s.thr_sent;
+                if (budget < 0) budget = 0;
+                int64_t k = in.count(ci);
+                if (out.space() < k) k = out.space();
+                const bool starved_by_rate = k > budget;
+                if (k > budget) k = budget;
+                if (k > 0) {
+                    ring_copy(in, ci, out, k);
+                    s.thr_sent += k;
+                    progress = true;
+                    if (per_in) per_in[i] += k;
+                    if (per_out) per_out[i] += k;
+                    if (per_calls) per_calls[i] += 1;
+                }
+                if (in.eos && in.count(ci) == 0) {
+                    out.eos = true;
+                    done[i] = true;
+                    in.release(ci);
+                } else if (starved_by_rate) {
+                    throttled = true;   // pacing, not a stall
                 }
                 continue;
             }
 
             // ---- copy-class middle stages ----------------------------------
-            int64_t k = in.count();
+            int64_t k = in.count(ci);
             if (out.space() < k) k = out.space();
             if (st[i].kind == FC_HEAD) {
                 if (head_left[i] < k) k = head_left[i];
@@ -899,20 +1006,28 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
                 if (cap < k) k = cap;
             }
             if (k > 0) {
-                ring_copy(in, out, k);
+                ring_copy(in, ci, out, k);
                 progress = true;
                 if (per_in) per_in[i] += k;
                 if (per_out) per_out[i] += k;
                 if (per_calls) per_calls[i] += 1;
                 if (st[i].kind == FC_HEAD) head_left[i] -= k;
             }
-            bool upstream_over = in.eos && in.count() == 0;
+            bool upstream_over = in.eos && in.count(ci) == 0;
             if (upstream_over || (st[i].kind == FC_HEAD && head_left[i] == 0)) {
                 out.eos = true;
                 done[i] = true;
+                in.release(ci);
             }
         }
-        if (!progress && !done[n - 1]) {
+        if (!progress && sinks_left > 0) {
+            if (throttled) {
+                // every idle stage is waiting on a throttle's clock: sleep a
+                // beat instead of spinning the core or mis-reporting a stall
+                struct timespec ts = {0, 200 * 1000};   // 200 µs
+                nanosleep(&ts, nullptr);
+                continue;
+            }
             // single-threaded chains always progress unless malformed; never spin
             for (auto& r : rings) std::free(r.buf);
             return -1;
@@ -920,7 +1035,40 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
     }
 
     for (auto& r : rings) std::free(r.buf);
-    return sink_items;
+    int64_t total = 0;
+    for (int i = 0; i < n; ++i) total += snk_items[i];
+    return total;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ABI version, checked by fastchain.py's _load(): bump on ANY FcStage layout
+// or protocol change so a stale .so can never be driven with a newer struct.
+int64_t fsdr_fastchain_abi(void) { return 8; }
+
+// v2 entry: a linear chain (stage i consumes stage i-1's ring).
+int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
+                              volatile int32_t* stop, int64_t* per_in,
+                              int64_t* per_out, int64_t* per_calls) {
+    if (n < 2) return -1;
+    std::vector<int32_t> inr(static_cast<size_t>(n));
+    for (int i = 0; i < n; ++i) inr[static_cast<size_t>(i)] = i - 1;
+    return fc_run_core(st, n, inr.data(), ring_items, stop, per_in, per_out,
+                       per_calls);
+}
+
+// v3 entry: a tree — in_ring[i] names the stage whose output ring stage i
+// consumes (-1 for the single source at index 0; stages in topological
+// order). Rings with several consumers broadcast: every consumer sees every
+// item (the 1-writer→N-reader semantics of the actor runtime's port groups).
+int64_t fsdr_fastchain_run_v3(const FcStage* st, int32_t n,
+                              const int32_t* in_ring, int64_t ring_items,
+                              volatile int32_t* stop, int64_t* per_in,
+                              int64_t* per_out, int64_t* per_calls) {
+    return fc_run_core(st, n, in_ring, ring_items, stop, per_in, per_out,
+                       per_calls);
 }
 
 }  // extern "C"

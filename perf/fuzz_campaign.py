@@ -30,6 +30,7 @@ FUZZES = [
     ("tests.test_wlan", "test_random_config_roundtrip_fuzz"),
     ("tests.test_zigbee", "test_random_payload_roundtrip_fuzz"),
     ("tests.test_fastchain_dsp", "test_random_chain_shapes_fuzz"),
+    ("tests.test_fastchain_tree", "test_random_tree_shapes_fuzz"),
 ]
 
 _orig_rng = np.random.default_rng

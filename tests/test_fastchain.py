@@ -59,23 +59,21 @@ def test_opt_out_env_runs_python_path():
         os.environ.pop("FSDR_NO_FASTCHAIN", None)
 
 
-def test_not_fused_with_message_edge_or_tap():
-    from futuresdr_tpu.blocks import MessageSink
-
-    # a message edge on a member disqualifies the chain
+def test_broadcast_tap_fuses_as_tree():
+    """A broadcast tap (one output port wired to two sinks) fuses as a TREE
+    since the v3 driver (round 5): every consumer of the tapped ring sees
+    every item, matching the actor runtime's 1-writer→N-reader port groups."""
     fg = Flowgraph()
     src, head = NullSource(np.float32), Head(np.float32, 1000)
     cp, snk = Copy(np.float32), NullSink(np.float32)
     fg.connect(src, head, cp, snk)
-    probe = MessageSink()
-    # no native block HAS message ports, so craft the other disqualifier:
-    # a broadcast tap on the copy output
     snk2 = NullSink(np.float32)
     fg.connect_stream(cp, "out", snk2, "in")
-    assert find_native_chains(fg) == []
-    Runtime().run(fg)                      # python path still works
+    trees = find_native_chains(fg)
+    assert len(trees) == 1 and len(trees[0]) == 5
+    assert trees[0].in_ring == [-1, 0, 1, 2, 2]
+    Runtime().run(fg)
     assert snk.n_received == 1000 and snk2.n_received == 1000
-    del probe
 
 
 def test_vector_endpoints_fuse_with_exact_data():

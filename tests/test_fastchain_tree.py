@@ -1,0 +1,224 @@
+"""Fast-chain v3 trees: broadcast rings and the native Throttle stage.
+
+The v3 driver (`native/fastchain.cpp fc_run_core`) runs source-rooted TREES,
+not just linear chains: a ring consumed by several stages broadcasts — every
+consumer sees every item with its own read index, the actor runtime's
+1-writer→N-reader port-group semantics (`runtime/buffer/circular.py:108`,
+reference: one output port wired to several edges). A finished consumer's
+slot is released so an early-finishing branch cannot wedge its siblings
+(the actor runtime likewise drops a finished block's reader)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import (Copy, CopyRand, Fir, Head, NullSink,
+                                  NullSource, Throttle, VectorSink,
+                                  VectorSource)
+from futuresdr_tpu.dsp import firdes
+from futuresdr_tpu.runtime.fastchain import fastchain_available, find_native_chains
+
+pytestmark = pytest.mark.skipif(not fastchain_available(),
+                                reason="native fastchain unavailable")
+
+
+def _tree_fg(n=30_000, seed=5):
+    """VectorSource → CopyRand → broadcast{VectorSink, Fir64 → VectorSink}."""
+    rng = np.random.default_rng(seed)
+    data = rng.standard_normal(n).astype(np.float32)
+    taps = firdes.lowpass(0.25, 64).astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    cr = CopyRand(np.float32, max_copy=700, seed=seed)
+    raw = VectorSink(np.float32)
+    fir = Fir(taps)
+    filt = VectorSink(np.float32)
+    fg.connect(src, cr)
+    fg.connect_stream(cr, "out", raw, "in")
+    fg.connect(cr, fir, filt)
+    return fg, data, taps, raw, filt
+
+
+def test_broadcast_tree_data_exact_vs_actor():
+    """Both branches of a fused broadcast see every item: the raw branch is
+    BIT-exact vs the source data, the FIR branch matches the actor path run
+    of the same flowgraph to float32 rounding."""
+    fg, data, taps, raw, filt = _tree_fg()
+    trees = find_native_chains(fg)
+    assert len(trees) == 1 and len(trees[0]) == 5
+    Runtime().run(fg)
+    got_raw = raw.items()
+    got_filt = filt.items()
+    assert np.array_equal(got_raw, data)          # broadcast copy is bit-exact
+
+    os.environ["FSDR_NO_FASTCHAIN"] = "1"
+    try:
+        fg2, data2, _, raw2, filt2 = _tree_fg()
+        assert find_native_chains(fg2) == []
+        Runtime().run(fg2)
+    finally:
+        os.environ.pop("FSDR_NO_FASTCHAIN", None)
+    assert np.array_equal(raw2.items(), got_raw)
+    np.testing.assert_allclose(filt2.items(), got_filt, rtol=2e-5, atol=2e-6)
+
+
+def test_broadcast_counters_per_branch():
+    """Per-member metrics stay honest on a tree: the broadcast producer
+    reports its items once, each branch its own consumed/produced counts."""
+    fg, data, taps, raw, filt = _tree_fg(n=10_000)
+    Runtime().run(fg)
+    w_cr = fg.wrapped(next(k for k in (b.kernel for b in fg._blocks
+                                       if b is not None)
+                           if isinstance(k, CopyRand)))
+    m = w_cr.metrics()
+    assert m["fused_native"] is True
+    assert m["items_out"]["out"] == 10_000
+    assert fg.wrapped(raw).metrics()["items_in"]["in"] == 10_000
+    assert fg.wrapped(filt).metrics()["items_in"]["in"] == 10_000
+
+
+def test_early_finishing_branch_releases_ring():
+    """A Head-bounded branch that finishes first must not wedge its broadcast
+    sibling: its ring slot is released (the actor runtime drops a finished
+    reader the same way)."""
+    fg = Flowgraph()
+    src = NullSource(np.float32)
+    cp = Copy(np.float32)
+    h_short = Head(np.float32, 512)          # finishes almost immediately
+    snk_short = NullSink(np.float32)
+    h_long = Head(np.float32, 3_000_000)     # many ring generations later
+    snk_long = NullSink(np.float32)
+    fg.connect(src, cp)
+    fg.connect(cp, h_short, snk_short)
+    fg.connect_stream(cp, "out", h_long, "in")
+    fg.connect(h_long, snk_long)
+    assert len(find_native_chains(fg)) == 1
+    Runtime().run(fg)
+    assert snk_short.n_received == 512
+    assert snk_long.n_received == 3_000_000
+
+
+def test_throttle_fuses_behind_static_opt_in_and_paces():
+    """Throttle fuses only with the fastchain_static promise (it has a live
+    rate retune handler), and the native stage paces by the same wall-clock
+    budget math as the actor work() loop."""
+    def build(static):
+        fg = Flowgraph()
+        src = VectorSource(np.ones(20_000, np.float32))
+        th = Throttle(np.float32, 40_000.0)
+        if static:
+            th.fastchain_static = True
+        snk = NullSink(np.float32)
+        fg.connect(src, th, snk)
+        return fg, snk
+
+    fg, _ = build(static=False)
+    assert find_native_chains(fg) == []      # no opt-in → actor path
+
+    fg, snk = build(static=True)
+    assert len(find_native_chains(fg)) == 1
+    t0 = time.perf_counter()
+    Runtime().run(fg)
+    dt = time.perf_counter() - t0
+    assert snk.n_received == 20_000
+    # 20k items at 40k/s ≈ 0.5 s; generous upper bound for a loaded host
+    assert 0.4 <= dt <= 5.0, dt
+
+
+def test_tree_with_collecting_sinks_bounded_per_path():
+    """Each collecting sink's capacity derives from its OWN source→sink path
+    (a decimating branch collects fewer items than its sibling)."""
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal(8_192).astype(np.float32)
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    cp = Copy(np.float32)
+    full = VectorSink(np.float32)
+    dec = Fir(taps, decim=4)
+    quarter = VectorSink(np.float32)
+    fg.connect(src, cp)
+    fg.connect_stream(cp, "out", full, "in")
+    fg.connect(cp, dec, quarter)
+    assert len(find_native_chains(fg)) == 1
+    Runtime().run(fg)
+    assert len(full.items()) == 8_192
+    assert len(quarter.items()) == 2_048
+    assert np.array_equal(full.items(), data)
+
+
+def test_random_tree_shapes_fuzz():
+    """Seeded sweep over random ELIGIBLE trees: a random linear prefix, a
+    fan-out point broadcasting to 2-3 branches, each branch a random stage
+    suffix into its own VectorSink — every fused tree must match its actor
+    twin per branch. The tree-composition analog of the chain fuzz
+    (`test_fastchain_dsp.test_random_chain_shapes_fuzz`); also run by
+    perf/fuzz_campaign.py with shifted seeds."""
+    if not fastchain_available():
+        return          # campaign calls this directly, bypassing the skipif
+    rng = np.random.default_rng(24242)
+    for trial in range(5):
+        n = int(rng.integers(5_000, 16_000))
+        data = rng.standard_normal(n).astype(np.float32)
+        n_branches = int(rng.integers(2, 4))
+        pre = [str(k) for k in rng.choice(["copyrand", "fir"],
+                                          size=rng.integers(0, 3))]
+        suff = [[str(k) for k in rng.choice(["copyrand", "fir", "decim"],
+                                            size=rng.integers(0, 3))]
+                for _ in range(n_branches)]
+        pseed = int(rng.integers(0, 1 << 30))
+
+        def stage(kind, r):
+            if kind == "copyrand":
+                return CopyRand(np.float32, int(r.integers(64, 1024)),
+                                seed=int(r.integers(1, 99)))
+            if kind == "fir":
+                return Fir(firdes.lowpass(0.2, int(r.integers(8, 65))
+                                          ).astype(np.float32))
+            return Fir(firdes.lowpass(0.1, 32).astype(np.float32),
+                       decim=int(r.integers(2, 5)))
+
+        def build():
+            r = np.random.default_rng(pseed)   # identical params per path
+            fg = Flowgraph()
+            last = VectorSource(data)
+            fg.add(last)
+            for k in pre:
+                b = stage(k, r)
+                fg.connect(last, b)
+                last = b
+            fan = Copy(np.float32)
+            fg.connect(last, fan)
+            sinks = []
+            for br in suff:
+                cur = fan
+                for k in br:
+                    b = stage(k, r)
+                    fg.connect_stream(cur, "out", b, "in")
+                    cur = b
+                vs = VectorSink(np.float32)
+                fg.connect_stream(cur, "out", vs, "in")
+                sinks.append(vs)
+            return fg, sinks
+
+        fg, sinks = build()
+        trees = find_native_chains(fg)
+        assert len(trees) == 1, (trial, pre, suff)
+        Runtime().run(fg)
+        native = [vs.items() for vs in sinks]
+
+        os.environ["FSDR_NO_FASTCHAIN"] = "1"
+        try:
+            fg2, sinks2 = build()
+            assert find_native_chains(fg2) == []
+            Runtime().run(fg2)
+        finally:
+            os.environ.pop("FSDR_NO_FASTCHAIN", None)
+        for bi, (got, want_sink) in enumerate(zip(native, sinks2)):
+            want = want_sink.items()
+            assert len(got) == len(want), (trial, bi, pre, suff)
+            np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-5,
+                                       err_msg=f"{trial} branch {bi}")
