@@ -416,8 +416,18 @@ def find_native_chains(fg) -> List[NativeTree]:
         out_edges.setdefault(id(e.src), []).append(e)
         in_deg[id(e.dst)] = in_deg.get(id(e.dst), 0) + 1
 
+    # one spec per kernel per launch: eligible(), _tree_dtypes and the
+    # per-sink bound walks would otherwise rebuild specs O(sinks × depth)
+    # times (FIR specs scan their whole tap vector for symmetry)
+    spec_memo: dict = {}
+
+    def spec_of(k):
+        if id(k) not in spec_memo:
+            spec_memo[id(k)] = _native_stage(k)
+        return spec_memo[id(k)]
+
     def eligible(k) -> bool:
-        return (_native_stage(k) is not None
+        return (spec_of(k) is not None
                 and id(k) not in msg_touched and id(k) not in inp_touched
                 and len(k.stream_inputs) <= 1 and len(k.stream_outputs) <= 1
                 and (not k.stream_outputs
@@ -448,7 +458,7 @@ def find_native_chains(fg) -> List[NativeTree]:
                     frontier.append((nxt, len(members) - 1))
         if not ok or len(members) < 2:
             continue
-        dts = _tree_dtypes(members, inr)
+        dts = _tree_dtypes(members, inr, spec_of)
         if dts is None:
             continue                   # an edge's item width is unresolvable
         ok = True
@@ -456,7 +466,7 @@ def find_native_chains(fg) -> List[NativeTree]:
             if m.stream_outputs or type(m) not in (VectorSink, FileSink):
                 continue
             bound = _sink_bound_specs(
-                [_native_stage(members[j]) for j in _tree_path(inr, i)])
+                [spec_of(members[j]) for j in _tree_path(inr, i)])
             if bound is None:
                 ok = False             # unbounded into a collecting sink
                 break
@@ -473,7 +483,7 @@ def find_native_chains(fg) -> List[NativeTree]:
     return trees
 
 
-def _tree_dtypes(members, in_ring) -> Optional[list]:
+def _tree_dtypes(members, in_ring, spec_of=_native_stage) -> Optional[list]:
     """Per-stage OUT dtype (sinks: their input dtype). None if unresolvable.
 
     A producer's dtype comes from its output port or, if untyped, its
@@ -510,7 +520,7 @@ def _tree_dtypes(members, in_ring) -> Optional[list]:
     for i in range(1, n):
         if not members[i].stream_outputs:
             continue
-        spec = _native_stage(members[i])
+        spec = spec_of(members[i])
         if spec is not None and spec[0] != FC_QUAD_DEMOD \
                 and dts[in_ring[i]].itemsize != dts[i].itemsize:
             return None

@@ -969,9 +969,15 @@ int64_t fc_run_core(const FcStage* st, int32_t n, const int32_t* inr,
                     s.thr_t0 = now;
                     s.thr_sent = 0;
                 }
-                int64_t budget = static_cast<int64_t>(
-                                     (now - s.thr_t0) * st[i].f0) -
-                                 s.thr_sent;
+                // the elapsed·rate draw in double first: a finite-but-huge
+                // rate (1e19) would overflow the int64 cast (UB → INT64_MIN
+                // on x86) and freeze the loop in a permanent throttled sleep;
+                // clamp far above any real budget instead
+                const double draw = (now - s.thr_t0) * st[i].f0;
+                int64_t budget =
+                    (draw >= 4.0e18 ? (int64_t)4000000000000000000LL
+                                    : static_cast<int64_t>(draw)) -
+                    s.thr_sent;
                 if (budget < 0) budget = 0;
                 int64_t k = in.count(ci);
                 if (out.space() < k) k = out.space();

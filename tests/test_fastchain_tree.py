@@ -127,6 +127,20 @@ def test_throttle_fuses_behind_static_opt_in_and_paces():
     # 20k items at 40k/s ≈ 0.5 s; generous upper bound for a loaded host
     assert 0.4 <= dt <= 5.0, dt
 
+    # degenerate rates must not freeze the fused loop: inf is rejected at the
+    # gate (actor path raises on it), a finite-but-huge rate fuses and runs
+    # effectively unthrottled (the C budget clamps instead of overflowing
+    # the int64 cast into a permanent 0-item sleep)
+    fg3 = Flowgraph()
+    src3 = VectorSource(np.ones(5_000, np.float32))
+    th3 = Throttle(np.float32, 1e19)
+    th3.fastchain_static = True
+    snk3 = NullSink(np.float32)
+    fg3.connect(src3, th3, snk3)
+    assert len(find_native_chains(fg3)) == 1
+    Runtime().run(fg3)
+    assert snk3.n_received == 5_000
+
 
 def test_tree_with_collecting_sinks_bounded_per_path():
     """Each collecting sink's capacity derives from its OWN source→sink path
