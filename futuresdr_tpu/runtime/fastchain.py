@@ -146,7 +146,7 @@ def _native_stage(kernel) -> Optional[tuple]:
     from ..blocks.dsp import Agc, Fir, QuadratureDemod, SignalSource, \
         XlatingFir
     from ..blocks.io import FileSink, FileSource
-    from ..blocks.stream import Copy, Delay, Head, Throttle
+    from ..blocks.stream import Copy, Delay, Head, StreamDuplicator, Throttle
     from ..blocks.vector import CopyRand, NullSink, NullSource, VectorSink, \
         VectorSource
     from ..dsp.kernels import DecimatingFirFilter, FirFilter, \
@@ -157,6 +157,12 @@ def _native_stage(kernel) -> Optional[tuple]:
     if type(kernel) is Head:
         return (FC_HEAD, int(kernel.remaining), 0, 0.0, None)
     if type(kernel) is Copy:
+        return (FC_COPY, 0, 0, 0.0, None)
+    if type(kernel) is StreamDuplicator:
+        # N output ports all carrying every input item = exactly one
+        # broadcast ring with the union of the ports' consumers; the actor
+        # block's lockstep forward (min over outputs) is the ring's
+        # min_tail. The finder special-cases its multi-port shape.
         return (FC_COPY, 0, 0, 0.0, None)
     if type(kernel) is CopyRand:
         if int(kernel.max_copy) < 1:
@@ -426,10 +432,21 @@ def find_native_chains(fg) -> List[NativeTree]:
             spec_memo[id(k)] = _native_stage(k)
         return spec_memo[id(k)]
 
+    from ..blocks.stream import StreamDuplicator
+
     def eligible(k) -> bool:
+        if type(k) is StreamDuplicator:
+            # EVERY output port must be wired, or the fused path would
+            # silently run a graph the actor path rejects (an unwired port's
+            # work() raises there) — the substitution must stay invisible
+            wired = {e.src_port for e in out_edges.get(id(k), [])}
+            if wired != {p.name for p in k.stream_outputs}:
+                return False
+        elif len(k.stream_outputs) > 1:
+            return False
         return (spec_of(k) is not None
                 and id(k) not in msg_touched and id(k) not in inp_touched
-                and len(k.stream_inputs) <= 1 and len(k.stream_outputs) <= 1
+                and len(k.stream_inputs) <= 1
                 and (not k.stream_outputs
                      or len(out_edges.get(id(k), [])) >= 1)
                 and in_deg.get(id(k), 0) == len(k.stream_inputs))

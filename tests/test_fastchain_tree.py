@@ -236,3 +236,42 @@ def test_random_tree_shapes_fuzz():
             assert len(got) == len(want), (trial, bi, pre, suff)
             np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-5,
                                        err_msg=f"{trial} branch {bi}")
+
+
+def test_stream_duplicator_fuses_as_broadcast():
+    """StreamDuplicator (1→N duplicate block, `stream_duplicator.rs`) fuses
+    as one broadcast ring — N ports all carrying every item is exactly the
+    per-consumer-tails ring; per-port produced counters match the actor's."""
+    from futuresdr_tpu.blocks import StreamDuplicator
+    rng = np.random.default_rng(7)
+    data = rng.standard_normal(12_000).astype(np.float32)
+
+    def build():
+        fg = Flowgraph()
+        src = VectorSource(data)
+        dup = StreamDuplicator(np.float32, n_outputs=2)
+        a, b = VectorSink(np.float32), VectorSink(np.float32)
+        fg.connect(src, dup)
+        fg.connect_stream(dup, "out0", a, "in")
+        fg.connect_stream(dup, "out1", b, "in")
+        return fg, dup, a, b
+
+    fg, dup, a, b = build()
+    assert len(find_native_chains(fg)) == 1
+    Runtime().run(fg)
+    assert np.array_equal(a.items(), data)
+    assert np.array_equal(b.items(), data)
+    m = fg.wrapped(dup).metrics()
+    assert m["fused_native"] is True
+    assert m["items_out"]["out0"] == 12_000
+    assert m["items_out"]["out1"] == 12_000
+
+    # an UNWIRED duplicator port must not fuse: the actor path raises on it,
+    # and the substitution must stay invisible (review regression)
+    fg2 = Flowgraph()
+    dup2 = StreamDuplicator(np.float32, n_outputs=3)
+    a2 = VectorSink(np.float32)
+    fg2.connect(VectorSource(data), dup2)
+    fg2.connect_stream(dup2, "out0", a2, "in")
+    fg2.connect_stream(dup2, "out1", VectorSink(np.float32), "in")
+    assert find_native_chains(fg2) == []
