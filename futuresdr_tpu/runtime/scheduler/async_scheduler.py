@@ -21,6 +21,28 @@ __all__ = ["AsyncScheduler"]
 log = logger("scheduler.async")
 
 
+def _finalize_loop_on_drop(owner, loop, pool=None) -> None:
+    """Stop ``loop`` (and shut ``pool``) when ``owner`` is garbage-collected.
+
+    CPython's refcounting fires this as soon as the last reference to the
+    scheduler goes away, so short-lived ``Runtime().run(fg)`` uses release
+    their event-loop fds immediately; explicit ``shutdown()`` remains the
+    graceful path (the finalizer then finds the loop already closed and does
+    nothing)."""
+    import weakref
+
+    def stop(l=loop, p=pool):
+        try:
+            if not l.is_closed():
+                l.call_soon_threadsafe(l.stop)
+        except RuntimeError:
+            pass                       # already stopping/closed
+        if p is not None:
+            p.shutdown(wait=False, cancel_futures=True)
+
+    weakref.finalize(owner, stop)
+
+
 class AsyncScheduler(Scheduler):
     def __init__(self, blocking_workers: int = 32):
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -32,25 +54,50 @@ class AsyncScheduler(Scheduler):
 
     # -- lifecycle -------------------------------------------------------------
     def start(self) -> None:
+        import weakref
+        spawned = False
         with self._lock:
-            if self._loop_thread is not None and self._loop_thread.is_alive():
-                return
-            self._started.clear()
+            if self._loop_thread is None or not self._loop_thread.is_alive():
+                spawned = True
+                self._started.clear()
+                # the thread target must NOT capture ``self`` strongly: the
+                # loop thread outlives this frame, and a strong scheduler
+                # reference from its closure would keep the scheduler alive
+                # forever — defeating the dropped-without-shutdown finalizer
+                # below. The weakref publish keeps the original ordering
+                # (``_loop`` set before ``_started``), so anyone who passed
+                # the wait sees the loop.
+                started, wself = self._started, weakref.ref(self)
 
-            def run():
-                loop = asyncio.new_event_loop()
-                asyncio.set_event_loop(loop)
-                self._loop = loop
-                self._started.set()
-                try:
-                    loop.run_forever()
-                finally:
-                    loop.close()
+                def run():
+                    loop = asyncio.new_event_loop()
+                    asyncio.set_event_loop(loop)
+                    s = wself()
+                    if s is not None:
+                        s._loop = loop
+                    del s          # the frame outlives this point by the whole
+                    started.set()  # run_forever — a live local would pin the
+                    try:           # scheduler exactly like the closure would
+                        loop.run_forever()
+                    finally:
+                        loop.close()
 
-            self._loop_thread = threading.Thread(
-                target=run, name="fsdr-scheduler", daemon=True)
-            self._loop_thread.start()
+                self._loop_thread = threading.Thread(
+                    target=run, name="fsdr-scheduler", daemon=True)
+                self._loop_thread.start()
+        # EVERY caller waits — a concurrent start() that found the thread
+        # already alive must not return before ``_loop`` is published
         self._started.wait()
+        if spawned:
+            # Deterministic cleanup when the scheduler is dropped WITHOUT an
+            # explicit shutdown(): the ubiquitous ``Runtime().run(fg)``
+            # pattern otherwise leaks the loop thread and its 3 fds (epoll +
+            # self-pipe socketpair) per Runtime — found by the robustness fd
+            # soak. The finalizer fires only when the LAST owner (Runtime /
+            # RunningFlowgraph / FlowgraphHandle all hold the scheduler) lets
+            # go, so an in-flight flowgraph keeps its loop. Captures the
+            # loop+pool, never ``self``; registered once per spawned loop.
+            _finalize_loop_on_drop(self, self._loop, self._blocking_pool)
 
     def shutdown(self) -> None:
         with self._lock:

@@ -71,6 +71,14 @@ class ThreadedScheduler(Scheduler):
                 w.thread.start()
             for w in self._workers:
                 w.ready.wait()
+            # same dropped-without-shutdown() cleanup as AsyncScheduler (the
+            # fd soak found 3 leaked fds per worker loop per Runtime); the
+            # pool rides worker 0's finalizer
+            from .async_scheduler import _finalize_loop_on_drop
+            for w in self._workers:
+                _finalize_loop_on_drop(
+                    self, w.loop,
+                    self._blocking_pool if w.index == 0 else None)
 
     def shutdown(self) -> None:
         # Stop loops and snapshot under the lock, but join OUTSIDE it: a worker

@@ -132,3 +132,64 @@ def test_random_topology_fuzz():
         Runtime().run(fg)
         for c in counts:
             assert c[0] == samples, (trial, c[0], samples)
+
+
+def test_no_fd_or_thread_leak_across_launches():
+    """Resource-leak soak: many sequential launches across the actor path,
+    the fused fast-chain path, and a control-port flowgraph must leave the
+    process fd count and thread count where they started — a leaked socket,
+    ring memfd, or executor thread per launch would compound in any
+    long-lived deployment (the reference's runtime reuses one executor for
+    the process lifetime; ours must be as clean across Runtime() cycles)."""
+    import gc
+    import os
+    import threading
+
+    def fd_count():
+        gc.collect()       # cycle-pending handles are not leaks; unreachable
+        return len(os.listdir("/proc/self/fd"))
+
+    def one_actor():
+        fg = Flowgraph()
+        fg.connect(VectorSource(np.ones(4096, np.float32)),
+                   Copy(np.float32), NullSink(np.float32))
+        Runtime().run(fg)
+
+    def one_fused():
+        fg = Flowgraph()
+        fg.connect(NullSource(np.float32), Head(np.float32, 50_000),
+                   NullSink(np.float32))
+        Runtime().run(fg)
+
+    def one_ctrl():
+        from futuresdr_tpu.runtime.ctrl_port import ControlPort
+        rt = Runtime()
+        cp = ControlPort(rt.handle, bind="127.0.0.1:29641")
+        cp.start()
+        try:
+            fg = Flowgraph()
+            fg.connect(VectorSource(np.ones(1024, np.float32)),
+                       NullSink(np.float32))
+            rt.run(fg)
+        finally:
+            cp.stop()
+
+    for fn in (one_actor, one_fused, one_ctrl):
+        fn()                                  # warm lazy imports/singletons
+    fd0 = fd_count()
+    thr0 = threading.active_count()
+    for _ in range(15):
+        one_actor()
+        one_fused()
+        one_ctrl()
+    # teardown is asynchronous (the finalizer posts loop.stop; the daemon
+    # thread closes the epoll/socketpair fds afterwards) — poll with a
+    # deadline instead of racing it; small slack since a GC-pending socket
+    # can linger one cycle
+    deadline = time.time() + 10
+    while time.time() < deadline and (
+            fd_count() > fd0 + 3 or threading.active_count() > thr0 + 2):
+        time.sleep(0.1)
+    assert fd_count() <= fd0 + 3, (fd0, fd_count())
+    assert threading.active_count() <= thr0 + 2, (thr0,
+                                                  threading.active_count())
