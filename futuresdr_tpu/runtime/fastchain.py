@@ -118,11 +118,12 @@ def _load() -> Optional[ctypes.CDLL]:
         [ctypes.POINTER(_FcStage), ctypes.c_int32,
          ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
          ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
-         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)])
+         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+         ctypes.POINTER(ctypes.c_int64)])
     if lib is not None:
         try:
             lib.fsdr_fastchain_abi.restype = ctypes.c_int64
-            if lib.fsdr_fastchain_abi() != 8:
+            if lib.fsdr_fastchain_abi() != 9:
                 lib = None
         except AttributeError:
             lib = None
@@ -691,6 +692,7 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
     per_in = (ctypes.c_int64 * n)()
     per_out = (ctypes.c_int64 * n)()
     per_calls = (ctypes.c_int64 * n)()
+    per_ns = (ctypes.c_int64 * n)()
     stop = ctypes.c_int32(0)
 
     # live metrics bridge: the native driver updates the shared counter arrays
@@ -700,7 +702,13 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
     # (decimating FIR) report honest per-port counts
     def _bridge(i, b):
         k = b.kernel
-        base_extra = getattr(k, "extra_metrics", None)
+        # stash the PRE-FUSION extra_metrics exactly once: re-running the
+        # same flowgraph re-bridges, and chaining off the previous bridge
+        # would re-apply the prior run's counters after refresh() (stale
+        # values win) while pinning every prior run's ctypes arrays alive
+        if not hasattr(k, "_fc_base_extra"):
+            k._fc_base_extra = getattr(k, "extra_metrics", None)
+        base_extra = k._fc_base_extra
 
         def refresh():
             b.work_calls = int(per_calls[i])
@@ -711,7 +719,8 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
             if hasattr(k, "n_received") and k.stream_inputs:
                 k.n_received = int(per_in[i])       # NullSink contract
         k.extra_metrics = lambda: (refresh() or dict(
-            (base_extra() if callable(base_extra) else {}), fused_native=True))
+            (base_extra() if callable(base_extra) else {}), fused_native=True,
+            busy_ns=int(per_ns[i])))
         return refresh
 
     refreshers = [_bridge(i, b) for i, b in enumerate(members)]
@@ -740,7 +749,7 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
         rc = await scheduler.spawn_blocking(
             lambda: lib.fsdr_fastchain_run_v3(stages, n, inr_arr, ring_items,
                                               ctypes.byref(stop), per_in,
-                                              per_out, per_calls))
+                                              per_out, per_calls, per_ns))
     except Exception as e:                              # noqa: BLE001
         _cancel_watchers()
         log.error("fastchain failed (%r)", e)

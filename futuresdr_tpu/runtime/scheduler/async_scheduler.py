@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Awaitable, Callable, List, Optional
 
@@ -29,11 +30,10 @@ def _finalize_loop_on_drop(owner, loop, pool=None) -> None:
     their event-loop fds immediately; explicit ``shutdown()`` remains the
     graceful path (the finalizer then finds the loop already closed and does
     nothing)."""
-    import weakref
 
     def stop(l=loop, p=pool):
         try:
-            if not l.is_closed():
+            if l is not None and not l.is_closed():
                 l.call_soon_threadsafe(l.stop)
         except RuntimeError:
             pass                       # already stopping/closed
@@ -54,7 +54,6 @@ class AsyncScheduler(Scheduler):
 
     # -- lifecycle -------------------------------------------------------------
     def start(self) -> None:
-        import weakref
         spawned = False
         with self._lock:
             if self._loop_thread is None or not self._loop_thread.is_alive():
@@ -89,6 +88,13 @@ class AsyncScheduler(Scheduler):
         # already alive must not return before ``_loop`` is published
         self._started.wait()
         if spawned:
+            # snapshot under the lock: a shutdown() racing this frame could
+            # null self._loop, and the finalizer must bind the real loop (or
+            # nothing — shutdown already stopped it)
+            with self._lock:
+                loop_now = self._loop
+            if loop_now is None:
+                return
             # Deterministic cleanup when the scheduler is dropped WITHOUT an
             # explicit shutdown(): the ubiquitous ``Runtime().run(fg)``
             # pattern otherwise leaks the loop thread and its 3 fds (epoll +
@@ -97,7 +103,7 @@ class AsyncScheduler(Scheduler):
             # RunningFlowgraph / FlowgraphHandle all hold the scheduler) lets
             # go, so an in-flight flowgraph keeps its loop. Captures the
             # loop+pool, never ``self``; registered once per spawned loop.
-            _finalize_loop_on_drop(self, self._loop, self._blocking_pool)
+            _finalize_loop_on_drop(self, loop_now, self._blocking_pool)
 
     def shutdown(self) -> None:
         with self._lock:

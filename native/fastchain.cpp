@@ -316,6 +316,27 @@ inline double mono_seconds() {
     return static_cast<double>(ts.tv_sec) + 1e-9 * static_cast<double>(ts.tv_nsec);
 }
 
+inline int64_t mono_ns() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<int64_t>(ts.tv_sec) * 1000000000LL + ts.tv_nsec;
+}
+
+// Per-stage busy-time attribution (the tracing/profiling plane reaching into
+// the native driver): times every scheduling pass of a stage — including its
+// cheap no-work polls, so the round-robin's bookkeeping is attributed where
+// it is spent — via a scope guard that fires on every `continue`. One vDSO
+// clock pair (~50 ns) per stage pass against passes that move hundreds to
+// thousands of items; per_ns == nullptr disables entirely.
+struct ProfGuard {
+    int64_t* slot;
+    int64_t t0;
+    explicit ProfGuard(int64_t* s) : slot(s), t0(s ? mono_ns() : 0) {}
+    ~ProfGuard() {
+        if (slot) *slot += mono_ns() - t0;
+    }
+};
+
 // Outputs producible once `total` absolute inputs are visible: the largest m
 // with (m·D)//I ≤ total−1 is (I·total−1)//D, plus one — the closed form of
 // PolyphaseResamplingFir.process's m_hi (dsp/kernels.py; the core's former
@@ -338,7 +359,8 @@ inline int64_t resample_m_hi(int64_t total, int64_t I, int64_t D) {
 // -1 on malformed input / stall (-2: sink capacity bound violated).
 int64_t fc_run_core(const FcStage* st, int32_t n, const int32_t* inr,
                     int64_t ring_items, volatile int32_t* stop,
-                    int64_t* per_in, int64_t* per_out, int64_t* per_calls) {
+                    int64_t* per_in, int64_t* per_out, int64_t* per_calls,
+                    int64_t* per_ns) {
     if (n < 2 || ring_items <= 0) return -1;
     // ---- topology: consumer counts + per-stage consumer slot ---------------
     std::vector<int> n_cons(n, 0), slot(n, 0);
@@ -477,6 +499,7 @@ int64_t fc_run_core(const FcStage* st, int32_t n, const int32_t* inr,
         bool throttled = false;    // a throttle is pacing (not a stall)
         for (int i = 0; i < n; ++i) {
             if (done[i]) continue;
+            ProfGuard prof_(per_ns ? &per_ns[i] : nullptr);
             if (i == 0) {
                 Ring& out = rings[0];
                 if (st[0].kind == FC_VEC_SOURCE) {
@@ -1052,7 +1075,7 @@ extern "C" {
 
 // ABI version, checked by fastchain.py's _load(): bump on ANY FcStage layout
 // or protocol change so a stale .so can never be driven with a newer struct.
-int64_t fsdr_fastchain_abi(void) { return 8; }
+int64_t fsdr_fastchain_abi(void) { return 9; }
 
 // v2 entry: a linear chain (stage i consumes stage i-1's ring).
 int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
@@ -1062,19 +1085,23 @@ int64_t fsdr_fastchain_run_v2(const FcStage* st, int32_t n, int64_t ring_items,
     std::vector<int32_t> inr(static_cast<size_t>(n));
     for (int i = 0; i < n; ++i) inr[static_cast<size_t>(i)] = i - 1;
     return fc_run_core(st, n, inr.data(), ring_items, stop, per_in, per_out,
-                       per_calls);
+                       per_calls, nullptr);
 }
 
 // v3 entry: a tree — in_ring[i] names the stage whose output ring stage i
 // consumes (-1 for the single source at index 0; stages in topological
 // order). Rings with several consumers broadcast: every consumer sees every
 // item (the 1-writer→N-reader semantics of the actor runtime's port groups).
+// per_ns (nullable): per-stage busy-time accumulation in nanoseconds — every
+// scheduling pass of a live stage is attributed, productive or not, so the
+// sum across stages approaches the driver thread's wall time.
 int64_t fsdr_fastchain_run_v3(const FcStage* st, int32_t n,
                               const int32_t* in_ring, int64_t ring_items,
                               volatile int32_t* stop, int64_t* per_in,
-                              int64_t* per_out, int64_t* per_calls) {
+                              int64_t* per_out, int64_t* per_calls,
+                              int64_t* per_ns) {
     return fc_run_core(st, n, in_ring, ring_items, stop, per_in, per_out,
-                       per_calls);
+                       per_calls, per_ns);
 }
 
 }  // extern "C"

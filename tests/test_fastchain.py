@@ -167,3 +167,48 @@ def test_untyped_sink_port_uses_chain_dtype():
     got = vs.items()
     assert got.dtype == np.float64 and len(got) == 1000
     assert not got.any()                    # NullSource emits zeros
+
+
+def test_fused_chain_busy_ns_profile():
+    """The native driver attributes per-stage busy time (every scheduling
+    pass, productive or not) into the metrics bridge: a 64-tap FIR stage must
+    dominate the copies, and the per-stage sum must stay within the run's
+    wall time (nothing double-counted)."""
+    import time as _t
+
+    from futuresdr_tpu.blocks import Fir
+    from futuresdr_tpu.dsp import firdes
+
+    fg = Flowgraph()
+    src = NullSource(np.float32)
+    head = Head(np.float32, 4_000_000)
+    fir = Fir(firdes.lowpass(0.2, 64).astype(np.float32))
+    cp = Copy(np.float32)
+    snk = NullSink(np.float32)
+    fg.connect(src, head, fir, cp, snk)
+    assert len(find_native_chains(fg)) == 1
+    t0 = _t.perf_counter()
+    Runtime().run(fg)
+    wall_ns = (_t.perf_counter() - t0) * 1e9
+    busy = {type(b.kernel).__name__: b.metrics().get("busy_ns", 0)
+            for b in (fg.wrapped(k) for k in (src, head, fir, cp, snk))}
+    assert all(v > 0 for v in busy.values()), busy
+    assert busy["Fir"] > busy["Copy"], busy          # the FIR does the FLOPs
+    assert sum(busy.values()) <= wall_ns * 1.1, (busy, wall_ns)
+
+
+def test_refused_flowgraph_metrics_stay_fresh():
+    """Re-running the SAME flowgraph re-bridges the fused members: the second
+    run's counters must reflect the second run (review regression: chaining
+    off the previous bridge re-applied run 1's counters after refresh, so
+    stale values won and every re-fuse pinned another set of arrays)."""
+    fg = Flowgraph()
+    src, head = NullSource(np.float32), Head(np.float32, 100_000)
+    cp, snk = Copy(np.float32), NullSink(np.float32)
+    fg.connect(src, head, cp, snk)
+    Runtime().run(fg)
+    assert fg.wrapped(cp).metrics()["items_in"]["in"] == 100_000
+    # second run: the Head is exhausted, so the actor semantics are 0 items
+    Runtime().run(fg)
+    m = fg.wrapped(cp).metrics()
+    assert m["items_in"]["in"] == 0, m
