@@ -111,8 +111,24 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
         members = [wk[id(k)] for k in ch]
         fused.update(id(b) for b in members)
         chain_tasks.append((members, getattr(ch, "in_ring", None)))
-    handles = scheduler.run_flowgraph_blocks(
-        [b for b in blocks if id(b) not in fused], fg_inbox)
+    actor_blocks = [b for b in blocks if id(b) not in fused]
+    for b in actor_blocks:
+        # a kernel that fused in a PREVIOUS flowgraph but runs the actor path
+        # now must shed its stale metrics bridge, or every metrics() read
+        # would stomp the live port counters with the old fused run's frozen
+        # values (review finding; the bridge stays installed after a fused
+        # run so post-run reads keep their numbers)
+        if hasattr(b.kernel, "_fc_base_extra"):
+            base = b.kernel._fc_base_extra
+            if base is None:
+                try:
+                    del b.kernel.extra_metrics
+                except AttributeError:
+                    pass
+            else:
+                b.kernel.extra_metrics = base
+            del b.kernel._fc_base_extra
+    handles = scheduler.run_flowgraph_blocks(actor_blocks, fg_inbox)
     for members, inr in chain_tasks:
         handles.append(scheduler.spawn(
             run_chain_task(members, fg_inbox, scheduler, in_ring=inr)))

@@ -212,3 +212,27 @@ def test_refused_flowgraph_metrics_stay_fresh():
     Runtime().run(fg)
     m = fg.wrapped(cp).metrics()
     assert m["items_in"]["in"] == 0, m
+
+
+def test_fused_then_actor_relaunch_metrics_not_stomped():
+    """A kernel that fused once and is then relaunched on the ACTOR path (new
+    flowgraph, FSDR_NO_FASTCHAIN A/B pattern) must shed the stale bridge:
+    the actor run's live counters, not the old fused run's frozen values."""
+    src, head = NullSource(np.float32), Head(np.float32, 70_000)
+    cp, snk = Copy(np.float32), NullSink(np.float32)
+    fg = Flowgraph()
+    fg.connect(src, head, cp, snk)
+    Runtime().run(fg)
+    assert fg.wrapped(cp).metrics()["fused_native"] is True
+
+    os.environ["FSDR_NO_FASTCHAIN"] = "1"     # same fg, actor path this time
+    try:
+        head.remaining = 12_000                # rearm for the second run
+        Runtime().run(fg)
+        m = fg.wrapped(cp).metrics()
+        assert "fused_native" not in m, m
+        # port counters are kernel-lifetime cumulative (70k fused + 12k
+        # actor); the stale bridge would have frozen this at 70k
+        assert m["items_in"]["in"] == 82_000, m
+    finally:
+        os.environ.pop("FSDR_NO_FASTCHAIN", None)
