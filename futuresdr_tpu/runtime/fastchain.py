@@ -67,7 +67,8 @@ from typing import List, Optional, Sequence
 from ..log import logger
 from .inbox import Callback, Initialize, Terminate
 
-__all__ = ["find_native_chains", "run_chain_task", "fastchain_available"]
+__all__ = ["find_native_chains", "run_chain_task", "fastchain_available",
+           "shed_metrics_bridge"]
 
 log = logger("runtime.fastchain")
 
@@ -133,6 +134,27 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def fastchain_available() -> bool:
     return _load() is not None
+
+
+def shed_metrics_bridge(kernel) -> None:
+    """Restore a kernel's pre-fusion ``extra_metrics`` if a fused run's bridge
+    is installed. The supervisor calls this for every ACTOR-path block at
+    launch: a kernel that fused in a previous flowgraph must shed the stale
+    bridge, or every metrics() read would stomp the live port counters with
+    the old fused run's frozen values. Owns the ``_fc_base_extra`` stash
+    convention together with ``_bridge`` below — keep install and uninstall
+    in this module."""
+    if not hasattr(kernel, "_fc_base_extra"):
+        return
+    base = kernel._fc_base_extra
+    if base is None:
+        try:
+            del kernel.extra_metrics
+        except AttributeError:
+            pass
+    else:
+        kernel.extra_metrics = base
+    del kernel._fc_base_extra
 
 
 def _native_stage(kernel) -> Optional[tuple]:

@@ -97,7 +97,8 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                                    fg_inbox: BlockInbox,
                                    initialized: ReplySlot) -> Flowgraph:
     """The per-flowgraph supervisor (`runtime.rs:363-597`)."""
-    from .fastchain import find_native_chains, run_chain_task
+    from .fastchain import (find_native_chains, run_chain_task,
+                            shed_metrics_bridge)
     chain_kernels = find_native_chains(fg)
     blocks = fg.take_blocks()
     by_id: Dict[int, WrappedKernel] = {b.id: b for b in blocks}
@@ -114,20 +115,8 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
     actor_blocks = [b for b in blocks if id(b) not in fused]
     for b in actor_blocks:
         # a kernel that fused in a PREVIOUS flowgraph but runs the actor path
-        # now must shed its stale metrics bridge, or every metrics() read
-        # would stomp the live port counters with the old fused run's frozen
-        # values (review finding; the bridge stays installed after a fused
-        # run so post-run reads keep their numbers)
-        if hasattr(b.kernel, "_fc_base_extra"):
-            base = b.kernel._fc_base_extra
-            if base is None:
-                try:
-                    del b.kernel.extra_metrics
-                except AttributeError:
-                    pass
-            else:
-                b.kernel.extra_metrics = base
-            del b.kernel._fc_base_extra
+        # now sheds its stale metrics bridge (fastchain owns the convention)
+        shed_metrics_bridge(b.kernel)
     handles = scheduler.run_flowgraph_blocks(actor_blocks, fg_inbox)
     for members, inr in chain_tasks:
         handles.append(scheduler.spawn(

@@ -19,6 +19,55 @@ import pytest
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+
+def _run_two_workers(worker_src: str, tmp_path):
+    """Spawn two worker processes on a fresh coordinator port, retry once on a
+    port race, and assert both print their OK line (shared flake handling —
+    a fix to the timeout/retry behavior applies to every scenario)."""
+    wf = tmp_path / "worker.py"
+    wf.write_text(worker_src)
+    pypath = _REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               JAX_PLATFORMS="", PYTHONPATH=pypath.rstrip(os.pathsep))
+
+    def attempt():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [subprocess.Popen([sys.executable, str(wf), str(i), str(port)],
+                                  stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                                  text=True, env=env)
+                 for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=220)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            # a wedged first attempt (e.g. the port raced) must count as a
+            # failed attempt eligible for the retry, not propagate straight
+            # to failure
+            for p in procs:
+                p.kill()
+            for p in procs:
+                p.wait(timeout=10)
+            return procs, ["<timeout after 220s>"] * len(procs)
+        finally:
+            for p in procs:
+                p.kill()
+        return procs, outs
+
+    procs, outs = attempt()
+    if any(p.returncode != 0 for p in procs):
+        # bind-then-close port probing races other processes on busy hosts;
+        # one retry with a fresh port removes the flake
+        procs, outs = attempt()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} rc={p.returncode}\n{out[-2000:]}"
+        assert f"proc {i} OK" in out, out[-2000:]
+
+
 WORKER = r"""
 import sys
 import jax
@@ -57,45 +106,83 @@ print(f"proc {pid} OK err={err:.2e}", flush=True)
 
 @pytest.mark.integration
 def test_two_process_global_mesh_sp_fir(tmp_path):
-    # bounded by the communicate(timeout=220) below — no pytest-timeout dependency
-    wf = tmp_path / "worker.py"
-    wf.write_text(WORKER)
-    pypath = _REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
-    env = dict(os.environ,
-               XLA_FLAGS="--xla_force_host_platform_device_count=4",
-               JAX_PLATFORMS="", PYTHONPATH=pypath.rstrip(os.pathsep))
+    _run_two_workers(WORKER, tmp_path)
 
-    def attempt():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        procs = [subprocess.Popen([sys.executable, str(wf), str(i), str(port)],
-                                  stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                                  text=True, env=env)
-                 for i in range(2)]
-        outs = []
-        try:
-            for p in procs:
-                out, _ = p.communicate(timeout=220)
-                outs.append(out)
-        except subprocess.TimeoutExpired:
-            # a wedged first attempt (e.g. the port raced) must count as a failed
-            # attempt eligible for the retry, not propagate straight to failure
-            for p in procs:
-                p.kill()
-            for p in procs:
-                p.wait(timeout=10)
-            return procs, ["<timeout after 220s>"] * len(procs)
-        finally:
-            for p in procs:
-                p.kill()
-        return procs, outs
 
-    procs, outs = attempt()
-    if any(p.returncode != 0 for p in procs):
-        # bind-then-close port probing races other processes on busy hosts; one
-        # retry with a fresh port removes the flake
-        procs, outs = attempt()
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc {i} rc={p.returncode}\n{out[-2000:]}"
-        assert f"proc {i} OK" in out, out[-2000:]
+WORKER_TRAIN = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+from futuresdr_tpu.parallel import multihost
+multihost.initialize(coordinator=f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from futuresdr_tpu.models import MCLDNN, init_params, make_train_step
+from futuresdr_tpu.parallel.stream_sp import sp_fir_stream
+
+assert jax.process_count() == 2
+
+# ---- cross-process DATA-PARALLEL train step: the gradient all-reduce (psum
+# over "dp") crosses the process boundary — the NCCL/MPI role of the
+# reference's distributed story, expressed as an XLA collective over the
+# jax distributed runtime
+mesh = multihost.global_mesh(("dp",))
+model = MCLDNN(n_classes=11, conv_features=8, lstm_features=16)
+params = init_params(model, n=64)
+params = jax.device_put(params, NamedSharding(mesh, P()))
+opt = optax.adam(1e-3)
+opt_state = jax.device_put(opt.init(params), NamedSharding(mesh, P()))
+step = jax.jit(make_train_step(model, opt))
+
+rng = np.random.default_rng(7)           # same seed -> same global batch
+b = 2 * 8
+iq = rng.standard_normal((b, 2, 64)).astype(np.float32)
+labels = (np.arange(b) % 11).astype(np.int32)
+iq_g = jax.make_array_from_callback(
+    iq.shape, NamedSharding(mesh, P("dp")), lambda idx: iq[idx])
+lab_g = jax.make_array_from_callback(
+    labels.shape, NamedSharding(mesh, P("dp")), lambda idx: labels[idx])
+params, opt_state, loss, acc = step(params, opt_state, iq_g, lab_g)
+jax.block_until_ready(loss)
+l = float(loss)
+assert np.isfinite(l), l
+
+# every process must see the SAME loss (the psum made the update global)
+from jax.experimental import multihost_utils
+ls = np.asarray(multihost_utils.process_allgather(jnp.asarray([l])))
+assert np.allclose(ls, ls.reshape(-1)[0]), ls
+
+# ---- cross-process STATEFUL stream: carry chained over frames, the halo
+# ppermute crossing the process boundary on every frame
+mesh_sp = multihost.global_mesh(("sp",))
+taps = rng.standard_normal(31).astype(np.float32)
+fn, init_c = sp_fir_stream(taps, mesh_sp)
+jfn = jax.jit(fn, donate_argnums=(0,))
+carry = init_c(np.float32)
+F = 8 * 512
+xs = rng.standard_normal(2 * F).astype(np.float32)
+outs = []
+for k in range(2):
+    xk = xs[k * F:(k + 1) * F]
+    xg = jax.make_array_from_callback(
+        xk.shape, NamedSharding(mesh_sp, P("sp")), lambda idx, xk=xk: xk[idx])
+    carry, yg = jfn(carry, xg)
+    outs.append(np.asarray(multihost_utils.process_allgather(yg, tiled=True)))
+y = np.concatenate(outs)
+ref = np.convolve(np.concatenate([np.zeros(30, np.float32), xs]), taps,
+                  mode="valid").astype(np.float32)
+err = np.abs(y - ref).max()
+assert err < 1e-3, err
+print(f"proc {pid} OK loss={l:.4f} err={err:.2e}", flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_two_process_train_and_stateful_stream(tmp_path):
+    """Cross-process dp-train (gradient psum over the process boundary; every
+    process observes the identical loss) and a carry-chained stateful stream
+    whose halo exchange crosses processes on every frame."""
+    _run_two_workers(WORKER_TRAIN, tmp_path)
