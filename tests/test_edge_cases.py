@@ -76,3 +76,12 @@ def test_empty_blob_message():
     Runtime().run(fg)
     assert len(snk.received) == 3
     assert all(p.to_blob() == b"" for p in snk.received)
+
+
+def test_autotune_default_frame_grid_per_platform():
+    """Accelerator platforms sweep up to 2M-sample frames; the CPU grid
+    stays at 1M (measured rationale: ``autotune.default_frames``)."""
+    from futuresdr_tpu.tpu.autotune import default_frames
+    assert (1 << 21) not in default_frames("cpu")
+    assert (1 << 21) in default_frames("tpu")
+    assert default_frames("tpu")[:4] == default_frames("cpu")
