@@ -164,6 +164,8 @@ def _native_stage(kernel) -> Optional[tuple]:
     Central registry rather than per-class methods: the chain driver owns the
     exact semantics it re-implements, so a behavioral change to one of these
     blocks must be mirrored HERE or the kernel dropped from the registry."""
+    import math
+
     import numpy as np
 
     from ..blocks.dsp import Agc, Fir, QuadratureDemod, SignalSource, \
@@ -322,7 +324,6 @@ def _native_stage(kernel) -> Optional[tuple]:
         # budget math (elapsed*rate - sent) against the monotonic clock
         if not getattr(kernel, "fastchain_static", False):
             return None
-        import math
         if kernel._t0 is not None or not (kernel.rate > 0) \
                 or not math.isfinite(kernel.rate):
             # mid-stream anchor / degenerate rate (inf·elapsed → NaN budget:

@@ -48,7 +48,7 @@ class InplaceAdd(Kernel):
             item = self.input.get_full()
             if item is None:
                 break
-            buf, n = item
+            buf, n, _tags = item   # tags ride the circuit since the tag-transport round
             buf[:n] += 1.0
             self.output.put_full(buf, n)
         if self.input.finished() and len(self.input) == 0:
@@ -67,7 +67,7 @@ class InplaceSink(Kernel):
             item = self.input.get_full()
             if item is None:
                 break
-            buf, n = item
+            buf, n, _tags = item   # tags ride the circuit since the tag-transport round
             self.n += n
             self.circuit.put_empty(buf)
         if self.input.finished() and len(self.input) == 0:
