@@ -243,6 +243,47 @@ def run_streamed(n_samples: int, frame_size: int, depth: int = 8) -> float:
 _CHAINS = ("fm", "wlan", "lora")        # keys: <name>_msps (input Msamples/s)
 
 
+def _run_dev_child(frame: int) -> None:
+    """Child mode (``--run-dev``): one device-resident frame point. Isolated in
+    a subprocess on accelerator backends so a tunnel RPC that wedges mid-scan
+    is killed from outside — an in-process hang would leave the driver's
+    end-of-round artifact with NO JSON at all."""
+    rate, _f, sweep = run_device_resident((frame,))
+    if not sweep:
+        sys.exit(3)      # the frame failed in-child (OOM etc.): the parent
+    print(f"DEV_RATE {rate}")  # must record an error note, not a 0.0 rate
+
+
+def _run_streamed_child(frame: int, n: int, depth: int) -> None:
+    """Child mode (``--run-streamed``): one streamed measurement (same
+    isolation rationale as ``--run-dev``)."""
+    print(f"STREAM_RATE {run_streamed(n, frame, depth)}")
+
+
+def _sub_rate(argv, pattern, timeout):
+    """Run this script in child mode; return (rate|None, error|None, stdout).
+
+    The single subprocess/regex/error-extraction path for EVERY guarded
+    measurement (dev frames, streamed runs, baseline chains): the last lines
+    of a JAX traceback are filtering boilerplate, so the error note carries
+    the exception line itself (the r5 wlan failure recorded 160 chars of
+    boilerplate and had to be re-diagnosed live)."""
+    import re
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)] + argv,
+                           timeout=timeout, capture_output=True, text=True,
+                           env=dict(os.environ, FSDR_BENCH_PROBED="1"))
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout:.0f}s", ""
+    m = re.search(pattern + r" ([0-9.eE+-]+)", r.stdout)
+    if r.returncode == 0 and m:
+        return float(m.group(1)), None, r.stdout
+    text = (r.stderr.strip() or r.stdout.strip())
+    lines = [ln for ln in text.splitlines()
+             if re.search(r"Error|UNIMPLEMENTED|Exception|assert", ln)]
+    return None, (lines[-1].strip() if lines else text[-160:])[:300], r.stdout
+
+
 def _run_chain_child(name: str) -> None:
     """Child mode (``--run-chain``): measure ONE BASELINE chain and print its rate.
     Runs in its own process so a wedged tunnel RPC can be killed from outside —
@@ -290,29 +331,15 @@ def run_baseline_chains() -> dict:
     for name in _CHAINS:
         key = f"{name}_msps"
         t0 = time.perf_counter()
-        try:
-            r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--run-chain", name],
-                timeout=budget, capture_output=True, text=True,
-                env=dict(os.environ, FSDR_BENCH_PROBED="1"))
-            m = re.search(r"CHAIN_RATE ([0-9.eE+-]+)", r.stdout)
-            if r.returncode == 0 and m:
-                out[key] = round(float(m.group(1)), 1)
-                mr = re.search(r"CHAIN_RUNS ([0-9. ]+)", r.stdout)
-                if mr:
-                    out[f"{key}_runs"] = [float(v) for v in mr.group(1).split()]
-            else:
-                text = (r.stderr.strip() or r.stdout.strip())
-                # the last lines of a JAX traceback are filtering boilerplate; the
-                # artifact must carry the exception itself (the r5 wlan failure
-                # recorded 160 chars of boilerplate and had to be re-diagnosed live)
-                err_lines = [ln for ln in text.splitlines()
-                             if re.search(r"Error|UNIMPLEMENTED|Exception|assert",
-                                          ln)]
-                out[f"{key}_error"] = (err_lines[-1].strip() if err_lines
-                                       else text[-160:])[:300]
-        except subprocess.TimeoutExpired:
-            out[f"{key}_error"] = f"timeout after {budget:.0f}s"
+        rate, err, stdout = _sub_rate(["--run-chain", name], "CHAIN_RATE",
+                                      budget)
+        if rate is not None:
+            out[key] = round(rate, 1)
+            mr = re.search(r"CHAIN_RUNS ([0-9. ]+)", stdout)
+            if mr:
+                out[f"{key}_runs"] = [float(v) for v in mr.group(1).split()]
+        else:
+            out[f"{key}_error"] = err
         print(f"# baseline chain {name}: {out.get(key, 'FAILED')} "
               f"({time.perf_counter() - t0:.0f}s)", file=sys.stderr)
     return out
@@ -332,10 +359,21 @@ def main():
                    help="measure only the headline chain")
     p.add_argument("--run-chain", choices=_CHAINS, default=None,
                    help="internal child mode: measure one BASELINE chain and exit")
+    p.add_argument("--run-dev", type=int, default=0,
+                   help="internal child mode: one device-resident frame point")
+    p.add_argument("--run-streamed", nargs=3, type=int, default=None,
+                   metavar=("FRAME", "N", "DEPTH"),
+                   help="internal child mode: one streamed measurement")
     args = p.parse_args()
 
     if args.run_chain:
         _run_chain_child(args.run_chain)
+        return
+    if args.run_dev:
+        _run_dev_child(args.run_dev)
+        return
+    if args.run_streamed:
+        _run_streamed_child(*args.run_streamed)
         return
 
     inst_ = instance()
@@ -348,7 +386,30 @@ def main():
           f"runs {['%.1f' % r for r in cpu_runs]}", file=sys.stderr)
 
     frames = (args.frame,) if args.frame else (1 << 19, 1 << 20, 1 << 21)
-    dev_rate, best_frame, dev_sweep = run_device_resident(frames)
+    # On accelerator backends every tunnel-touching measurement runs in a
+    # guarded SUBPROCESS: a half-alive tunnel wedging one scan is killed from
+    # outside and becomes an error note — never a dead bench with no JSON
+    # (the chains already had this; the r5 hardening extends it to the
+    # device-resident sweep and the streamed loop). The CPU backend cannot
+    # wedge, so it keeps the cheaper in-process path.
+    guarded = inst_.platform != "cpu"
+    errors = {}
+    if guarded:
+        dev_rate, best_frame, dev_sweep = 0.0, frames[0], {}
+        for f in frames:
+            r, err, _out = _sub_rate(["--run-dev", str(f)], "DEV_RATE", 600)
+            if r is None:
+                errors[f"dev_{f}_error"] = err
+                print(f"# device-resident frame={f} child failed: {err}",
+                      file=sys.stderr)
+                continue
+            print(f"# device-resident frame={f}: {r:.0f} Msps marginal",
+                  file=sys.stderr)
+            dev_sweep[str(f)] = round(r, 1)
+            if r > dev_rate:
+                dev_rate, best_frame = r, f
+    else:
+        dev_rate, best_frame, dev_sweep = run_device_resident(frames)
 
     # streamed: pick the streamed path's OWN frame. The device-resident winner
     # optimizes a different regime (scan-amortized HBM residency); measuring the
@@ -365,9 +426,21 @@ def main():
     big = ((1 << 21),) if inst_.platform != "cpu" else ()
     cand = ((args.frame,) if args.frame          # explicit --frame pins BOTH paths
             else tuple(dict.fromkeys(((1 << 18), (1 << 19)) + big + (best_frame,))))
+    def _streamed(frame, n, depth):
+        if not guarded:
+            return run_streamed(n, frame, depth), None
+        r, err, _out = _sub_rate(
+            ["--run-streamed", str(frame), str(n), str(depth)],
+            "STREAM_RATE", 600)
+        return r, err
+
     stream_frame, probe_best = best_frame, 0.0
     for f in cand:
-        r = run_streamed(f * 4 * args.depth, f, args.depth)
+        r, err = _streamed(f, f * 4 * args.depth, args.depth)
+        if r is None:
+            errors[f"streamed_probe_{f}_error"] = err
+            print(f"# streamed probe frame={f} failed: {err}", file=sys.stderr)
+            continue
         print(f"# streamed probe frame={f}: {r:.1f} Msps", file=sys.stderr)
         if r > probe_best:
             probe_best, stream_frame = r, f
@@ -377,9 +450,15 @@ def main():
         n_stream = int(min(max(probe_best * 1e6 * per_run, stream_frame * 4 * args.depth),
                            200_000_000))
         n_stream = (n_stream // stream_frame) * stream_frame
-        runs.append(run_streamed(n_stream, stream_frame, args.depth))
+        r, err = _streamed(stream_frame, n_stream, args.depth)
+        if r is None:
+            errors["streamed_error"] = err
+            print(f"# streamed run failed: {err}", file=sys.stderr)
+            continue
+        runs.append(r)
     runs.sort()
-    stream_rate = runs[1]                                   # median of 3
+    stream_rate = runs[(len(runs) - 1) // 2] if runs else 0.0  # lower-middle:
+    # never report the max as "median" when a degraded tunnel drops a run
     print(f"# streamed ({inst_.platform}, frame={stream_frame}): "
           f"median {stream_rate:.1f} Msps, runs {['%.1f' % r for r in runs]}",
           file=sys.stderr)
@@ -458,6 +537,7 @@ def main():
         "dev_frame_sweep": dev_sweep,
         **link,
         **roof,
+        **errors,
     }
     if not args.skip_extra_chains:
         # on-chip evidence for BASELINE #3/#4/#5 rides the same driver artifact
