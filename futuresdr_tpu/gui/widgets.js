@@ -193,6 +193,48 @@ FSDR.FlowgraphTable.prototype.update = function (desc) {
   }
 };
 
+/* ---------------- MetricsTable: live per-block counters -------------------- */
+/* One row per block from /api/fg/N/metrics/: work calls, summed per-port
+ * in/out items, and — for natively fused members — the driver's busy_ns
+ * attribution rendered as a busy-share bar across the fused chain (where a
+ * pipe spends its thread; the 64-tap FIR visibly dominating its copies).
+ * Poll with FSDR.pollPeriodically(() => handle.metrics(0).then(m =>
+ * table.update(m)), 500). */
+FSDR.MetricsTable = function (tbl) { this.tbl = tbl; };
+FSDR.MetricsTable.prototype.update = function (metrics) {
+  const tbl = this.tbl;
+  while (tbl.rows.length > 1) tbl.deleteRow(1);
+  const sum = (obj) => {
+    let s = 0;
+    for (const k of Object.keys(obj)) s += obj[k];
+    return s;
+  };
+  let totalBusy = 0;
+  for (const name of Object.keys(metrics)) totalBusy += metrics[name].busy_ns || 0;
+  for (const name of Object.keys(metrics)) {
+    const m = metrics[name];
+    const r = tbl.insertRow();
+    r.insertCell().textContent = name;
+    r.insertCell().textContent = m.work_calls;
+    r.insertCell().textContent = sum(m.items_in || {});
+    r.insertCell().textContent = sum(m.items_out || {});
+    const c = r.insertCell();
+    if (m.busy_ns !== undefined && totalBusy > 0) {
+      const share = (m.busy_ns || 0) / totalBusy;
+      const bar = document.createElement('div');
+      bar.className = 'busybar';
+      bar.style.width = Math.round(share * 100) + '%';
+      const label = document.createElement('span');
+      label.textContent = ' ' + Math.round(share * 100) + '% (' +
+                          ((m.busy_ns || 0) / 1e6).toFixed(1) + ' ms)';
+      c.appendChild(bar);
+      c.appendChild(label);
+    } else {
+      c.textContent = m.fused_native ? '' : '—';
+    }
+  }
+};
+
 /* ---------------- PmtEditor: typed Pmt forms → POST call ------------------- */
 /* One row per message handler of the selected block: kind selector + value input +
  * send; the reply renders next to the row (`prophecy/src/pmt.rs` PmtEditor role). */

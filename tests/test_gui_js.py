@@ -28,7 +28,7 @@ SRC = WIDGETS.read_text()
 
 EXPORTS = [
     "Handle", "Pmt", "pollPeriodically", "callPeriodically",
-    "FlowgraphCanvas", "FlowgraphTable", "PmtEditor",
+    "FlowgraphCanvas", "FlowgraphTable", "MetricsTable", "PmtEditor",
     "Slider", "RadioSelector", "ListSelector",
     "GL", "Waterfall", "Waterfall2D", "TimeSink",
     "ConstellationSink", "ConstellationSinkDensity", "ConstellationSinkDensity2D",
@@ -167,6 +167,7 @@ class _El:
         self.value = ""
         self.rows = []
         self._listeners = {}
+        self.style = JSObject()          # e.g. the MetricsTable busy bar width
 
     def appendChild(self, el):
         self.children.append(el)
@@ -847,3 +848,75 @@ def test_exec_waterfall2d_zoom_is_retroactive_and_disposable():
     i.run("const wd = new FSDR.Waterfall2D(__cv, {db: true});")
     i.run("wd.frame(__r); const b1 = wd._dbBuf; wd.frame(__r);")
     assert i.eval("b1 === wd._dbBuf") is True
+
+
+def test_exec_metrics_table_busy_share_against_fused_chain():
+    """FSDR.MetricsTable EXECUTES against a live control port serving a FUSED
+    chain: the per-block rows render real counters, and the busy-share bars
+    derive from the native driver's busy_ns — the FIR row must dominate its
+    neighboring copy stage, matching what /metrics/ reports."""
+    import json as json_mod
+    import time
+    import urllib.request
+
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import Copy, Fir, Head, NullSink, NullSource
+    from futuresdr_tpu.config import config
+    from futuresdr_tpu.dsp import firdes
+
+    config().ctrlport_enable = True
+    old_bind = config().ctrlport_bind
+    config().ctrlport_bind = "127.0.0.1:18341"
+    running = None
+    try:
+        fg = Flowgraph()
+        fg.connect(NullSource(np.float32), Head(np.float32, 600_000_000),
+                   Fir(firdes.lowpass(0.2, 64).astype(np.float32)),
+                   Copy(np.float32), NullSink(np.float32))
+        rt = Runtime()
+        running = rt.start(fg)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(
+                    "http://127.0.0.1:18341/api/fg/0/", timeout=2).read()
+                break
+            except Exception:
+                time.sleep(0.1)
+        else:
+            raise RuntimeError("control port never became ready")
+        time.sleep(0.3)                       # let busy_ns accumulate
+
+        def fetch(url, opts=UNDEF):
+            body = urllib.request.urlopen(url, timeout=5).read().decode()
+            resp = JSObject()
+            resp.set("json", lambda: i.eval(
+                f"JSON.parse({json_mod.dumps(body)})"))
+            return resp
+
+        i = _interp(fetch=fetch)
+        i.run("const h = new FSDR.Handle('http://127.0.0.1:18341/');")
+        tbl = _El("table")
+        tbl.rows.append(_El("tr"))            # header row
+        i.genv.vars["__tbl"] = tbl
+        i.run("new FSDR.MetricsTable(__tbl).update(h.metrics(0));")
+        assert len(tbl.rows) == 1 + 5         # one row per block
+        shares = {}
+        for r in tbl.rows[1:]:
+            cells = [c for c in r.children]
+            name = cells[0].textContent
+            bar_cell = cells[4]
+            if bar_cell.children:             # busy bar rendered
+                width = bar_cell.children[0].style.get("width")
+                shares[name] = int(str(width).rstrip("%"))
+        assert shares, "no busy bars rendered"
+        fir_share = next(v for k, v in shares.items() if "Fir" in k)
+        copy_share = next(v for k, v in shares.items() if "Copy_" in k
+                          or k.startswith("Copy"))
+        assert fir_share > copy_share, shares
+        assert fir_share > 30, shares         # the FIR owns the chain's time
+    finally:
+        if running is not None:
+            running.stop_sync()
+        config().ctrlport_enable = False
+        config().ctrlport_bind = old_bind
