@@ -8,7 +8,7 @@ with root-raised-cosine shaping; frames start with a known 16-bit sync word.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -122,7 +122,7 @@ def modulate(symbols: np.ndarray, sps: int = SPS) -> np.ndarray:
 def demodulate_stream(samples: np.ndarray, sps: int = SPS) -> List[Lsf]:
     """Matched filter → sync correlation → symbol slicing → depuncture/Viterbi/CRC;
     LSF frames in time order (see ``_lsf_positions`` for the scan itself)."""
-    return [lsf for _, lsf in _lsf_positions(samples, sps)]
+    return [lsf for _, lsf, _agree in _lsf_positions(samples, sps)]
 
 
 def _hard_bits(syms: np.ndarray) -> np.ndarray:
@@ -184,9 +184,7 @@ def demodulate_payload_stream(samples: np.ndarray, sps: int = SPS):
             # (r5 fuzz campaign, offset 62682: a saturated-correlation ghost
             # 330 samples early out-ranked the real EOS frame under noise
             # when the rank was correlation alone, suppressing it).
-            recoded = codec.puncture_p2(codec.conv_encode_m17(bits))
-            k_cmp = min(len(recoded), len(llrs))
-            agree = float(np.mean((llrs[:k_cmp] > 0) == recoded[:k_cmp]))
+            agree = _codeword_agreement(llrs, bits, codec.puncture_p2)
             if agree < 0.8:
                 continue                    # not a codeword at all
             body = np.packbits(bits[:144]).tobytes()
@@ -201,7 +199,9 @@ def demodulate_payload_stream(samples: np.ndarray, sps: int = SPS):
     hits.sort(key=lambda t: (-t[6], -t[0]))
     min_gap = n_frame_syms * sps * 3 // 4
     accepted: List[tuple] = []
-    lsfs = dict(_lsf_positions(samples, sps, content_dedup=False))
+    lsf_cands = _lsf_positions(samples, sps, content_dedup=False)
+    lsfs = {pos: lsf for pos, lsf, _a in lsf_cands}
+    lsf_agree = {pos: a for pos, _l, a in lsf_cands}
     # a stream frame cannot START inside a decoded link-setup frame: the LSF
     # body can correlate > 0.9 against the stream sync AND pass the (un-CRC'd)
     # Golay gate by chance, injecting a ghost frame whose fn breaks the
@@ -212,7 +212,17 @@ def demodulate_payload_stream(samples: np.ndarray, sps: int = SPS):
     lsf_span = (8 + 184) * sps
     guard = 8 * sps
     for hit in hits:
-        if any(p + guard <= hit[1] < p + lsf_span - guard for p in lsfs):
+        # comparative guard (r5 campaign offset 166156, the eighth finding):
+        # CRC16 alone admits one chance ghost LSF in ~65k candidate windows,
+        # and a hard rejection inside ANY LSF span let that ghost suppress a
+        # REAL stream frame (its whole span was quarantined). An LSF only
+        # suppresses the stream hits it OUT-SCORES on codeword agreement —
+        # the true-LSF case still rejects misframed stream ghosts (LSF ~1.0
+        # vs ghost ≤0.95), while a weak chance ghost (0.905) cannot veto a
+        # perfect frame (1.0)
+        if any(p + guard <= hit[1] < p + lsf_span - guard
+               and lsf_agree[p] > hit[6]
+               for p in lsfs):
             continue
         if all(abs(hit[1] - a[1]) >= min_gap for a in accepted):
             accepted.append(hit)
@@ -244,8 +254,11 @@ def _lsf_positions(samples: np.ndarray, sps: int, content_dedup: bool = True):
     delay = len(h) - 1
     sync = _sync_symbols(SYNC_LSF)
     n_frame_syms = 8 + 184
-    found = []
-    seen = set()
+    # per dedup key keep the MAX-agreement candidate (first-found kept an
+    # off-center phase's weaker decode); the floor mirrors the stream path's
+    # not-a-codeword gate — plausibility RANKING between an LSF and the
+    # stream hits inside its span happens in demodulate_payload_stream
+    best: dict = {}
     for phase in range(sps):
         sym_stream = mf[delay + phase::sps] / gain
         if len(sym_stream) < n_frame_syms:
@@ -257,16 +270,17 @@ def _lsf_positions(samples: np.ndarray, sps: int, content_dedup: bool = True):
             syms = sym_stream[idx + 8: idx + n_frame_syms]
             if len(syms) < 184:
                 continue
-            lsf = _decode_lsf_symbols(syms)
-            if lsf is None:
+            dec = _decode_lsf_symbols(syms)
+            if dec is None:
                 continue
+            lsf, agree = dec
             pos = idx * sps + phase
             key = (lsf.to_bytes() if content_dedup
                    else pos // (n_frame_syms * sps // 2))
-            if key not in seen:
-                seen.add(key)
-                found.append((pos, lsf))
-    return sorted(found)
+            if key not in best or agree > best[key][2]:
+                best[key] = (pos, lsf, agree)
+    return sorted((pos, lsf, agree) for pos, lsf, agree in best.values()
+                  if agree >= 0.8)
 
 
 def _finish_group(group, lsfs) -> tuple:
@@ -299,7 +313,29 @@ def _finish_group(group, lsfs) -> tuple:
     return lsf, payload, complete
 
 
-def _decode_lsf_symbols(syms: np.ndarray) -> Optional[Lsf]:
+def _codeword_agreement(llrs: np.ndarray, bits: np.ndarray, puncture_fn) -> float:
+    """Re-encode ``bits`` and measure the fraction of received LLR signs the
+    codeword matches — the plausibility score shared by the stream-frame and
+    LSF candidate paths. A correctly-framed decode reads ~1.0; a MISFRAMED
+    window's Viterbi output is still a self-consistent codeword but only
+    ~0.85–0.95 against the received signs; outright garbage is ~0.5."""
+    recoded = puncture_fn(codec.conv_encode_m17(bits))
+    k = min(len(recoded), len(llrs))
+    return float(np.mean((llrs[:k] > 0) == recoded[:k]))
+
+
+def _decode_lsf_symbols(syms: np.ndarray) -> Optional[Tuple[Lsf, float]]:
+    """Decode one LSF candidate window → (lsf, codeword agreement), or None.
+
+    The agreement score (re-encode the decoded bits, fraction of received
+    LLR signs matched) is the same plausibility measure the stream-frame
+    path ranks by. It exists because CRC16 alone is NOT a sufficient gate at
+    campaign scale: one in ~65k random decodes passes by chance, and the
+    r5 fuzz campaign (offset 166156, its eighth real finding) drew exactly
+    that — a stream-frame body decoding as a CRC-valid ghost LSF with
+    garbage callsigns, whose interior guard then suppressed the REAL frame
+    fn=2 sitting inside its span. A true LSF re-encodes at ~1.0 (0.95 at
+    off-center sample phases); the chance-CRC ghost measured 0.905."""
     # soft dibit LLRs from symbol amplitude: sym > 0 ⇒ msb 0; |sym| > 2 ⇒ lsb... use
     # per-bit distances to the four levels
     d = -np.abs(syms[:, None] - _SYM_LEVELS[None, :]) ** 2    # [n, 4]
@@ -310,5 +346,8 @@ def _decode_lsf_symbols(syms: np.ndarray) -> Optional[Lsf]:
     llrs[0::2] = msb
     llrs[1::2] = lsb
     dep = codec.depuncture_p1(llrs, 488)
-    bits = codec.viterbi_decode_m17(dep, 244)[:240]
-    return Lsf.from_bytes(np.packbits(bits).tobytes())
+    bits244 = codec.viterbi_decode_m17(dep, 244)
+    lsf = Lsf.from_bytes(np.packbits(bits244[:240]).tobytes())
+    if lsf is None:
+        return None
+    return lsf, _codeword_agreement(llrs, bits244, codec.puncture_p1)

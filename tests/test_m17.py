@@ -283,3 +283,34 @@ def test_misframed_ghost_does_not_suppress_eos_frame():
     l, p, complete = out[0]
     assert complete and (l.src, l.dst) == (src, dst)
     assert p[:n_pay] == payload
+
+
+def test_chance_crc_ghost_lsf_cannot_suppress_stream_frames():
+    """Regression (r5 fuzz campaign, offset 166156 — the practice's eighth
+    finding): a stream-frame body decoded as a CRC16-VALID ghost LSF with
+    garbage callsigns (one random decode in ~65k passes CRC by chance at
+    campaign scale), and the LSF-interior guard then rejected the REAL frame
+    fn=2 inside the ghost's span — an incomplete payload from a clean
+    transmission. LSF candidates are now gated by re-encode codeword
+    agreement (true ≥0.95, misframed chance-CRC ghosts ≤0.91), the same
+    plausibility measure the stream-frame path ranks by."""
+    from futuresdr_tpu.models.m17 import (Lsf, build_stream_frames, modulate,
+                                          demodulate_payload_stream)
+
+    # the exact campaign draw, reproduced via the shifted-seed convention
+    rng = np.random.default_rng(1717 + 166156)
+    alphabet = "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789"
+    src = "".join(alphabet[int(rng.integers(0, 36))] for _ in range(6))
+    dst = "".join(alphabet[int(rng.integers(0, 36))] for _ in range(6))
+    n_pay = int(rng.integers(1, 97))
+    payload = rng.integers(0, 256, n_pay).astype(np.uint8).tobytes()
+    sig = modulate(build_stream_frames(Lsf(dst=dst, src=src),
+                                       payload)).astype(np.float32)
+    x = np.concatenate([np.zeros(int(rng.integers(100, 800)), np.float32),
+                        sig, np.zeros(300, np.float32)])
+    x = (x + 0.05 * rng.standard_normal(len(x))).astype(np.float32)
+    out = demodulate_payload_stream(x)
+    assert len(out) == 1
+    lsf, p, complete = out[0]
+    assert complete and (lsf.src, lsf.dst) == (src, dst)
+    assert p[:n_pay] == payload
