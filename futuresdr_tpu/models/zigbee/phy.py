@@ -98,7 +98,8 @@ def modulate_frame(psdu: bytes, sps_chip: int = SAMPLES_PER_CHIP) -> np.ndarray:
 
 def _mm_clock_recovery(x: np.ndarray, sps: float, mu0: float = 0.5,
                        gain_step: float = 0.002, gain_phase: float = 0.15,
-                       block: int = 32) -> np.ndarray:
+                       block: int = 32,
+                       energy: Optional[np.ndarray] = None) -> np.ndarray:
     """Mueller-Müller timing recovery, block-vectorized
     (`ClockRecoveryMm` block, `examples/zigbee/src/clock_recovery_mm.rs` role).
 
@@ -111,8 +112,33 @@ def _mm_clock_recovery(x: np.ndarray, sps: float, mu0: float = 0.5,
     once. Converges like the per-sample loop with a ``block``-symbol control delay —
     drift within one block is ≪ a sample for any realistic clock (±100 ppm × 32
     symbols × 4 sps ≈ 0.01 samples).
+
+    ``energy`` (optional, aligned with ``x``): per-sample signal magnitude.
+    When given, blocks whose mean magnitude sits below the capture's
+    burst/noise decision level FREEZE the loop (no step/phase adaptation):
+    on a noise-only prefix the discriminator angles are random, and letting
+    them drag the clock estimate before the burst arrives occasionally
+    wrecked acquisition entirely — the r5 campaign's fourth finding (batch
+    12, offset 2112168: one σ=0.05 draw where the MM path returned zero
+    candidates while phase/coherent both recovered the frame).
     """
     n = len(x)
+    if energy is not None:
+        # Burst/noise decision level, robust to ANY burst duty cycle. The low
+        # tail estimates the noise floor: for Rayleigh noise q10 ≈ 0.459σ, so
+        # 1.6·(q10/0.459) sits ABOVE the noise-block mean (≈1.25σ) with
+        # margin, and far below any usable-SNR burst. Two failure regimes
+        # bound it: an (almost-)all-signal capture inflates the q10-derived
+        # floor toward the signal level — the 0.5·q99.9 cap keeps the gate
+        # under the burst so adaptation still runs; a capture that is pure
+        # noise keeps q99.9 ≈ 4.8σ, cap 2.4σ > 1.6σ, so the floor term wins
+        # and (most) noise blocks freeze. (The first cut used
+        # gmean(q10, q90), which collapses onto ≈σ — BELOW the noise-block
+        # mean — whenever the burst covers <10% of the capture; review
+        # caught it with a direct simulation.)
+        q10, q999 = np.quantile(energy, (0.1, 0.999))
+        e_gate = float(min(1.6 * max(q10, 1e-12) / 0.459,
+                           0.5 * max(q999, 1e-12)))
     out_parts = []
     pos = mu0
     step = float(sps)
@@ -133,10 +159,14 @@ def _mm_clock_recovery(x: np.ndarray, sps: float, mu0: float = 0.5,
         frac = t - i
         s = x[i] * (1.0 - frac) + x[i + 1] * frac          # vectorized lerp
         d = np.sign(s)
-        # MM error over the block incl. the boundary pair with the previous block
-        sl = np.concatenate(([prev_s], s))
-        dl = np.concatenate(([prev_d], d))
-        err = float(np.mean(dl[:-1] * sl[1:] - dl[1:] * sl[:-1]))
+        if energy is not None and float(np.mean(energy[i])) < e_gate:
+            err = 0.0                     # noise-only block: hold the clock
+        else:
+            # MM error over the block incl. the boundary pair with the
+            # previous block
+            sl = np.concatenate(([prev_s], s))
+            dl = np.concatenate(([prev_d], d))
+            err = float(np.mean(dl[:-1] * sl[1:] - dl[1:] * sl[:-1]))
         out_parts.append(s)
         prev_s, prev_d = float(s[-1]), float(d[-1])
         step = min(max(sps + gain_step * err * sps, lo), hi)
@@ -335,7 +365,8 @@ def demodulate_stream(samples: np.ndarray, sps_chip: int = SAMPLES_PER_CHIP,
     freq = np.angle(d)
     frames: List[bytes] = []
     if timing == "mm":
-        soft = _mm_clock_recovery(freq, sps_chip)
+        soft = _mm_clock_recovery(freq, sps_chip,
+                                  energy=np.abs(samples[1:]))
         _scan_soft_chips(np.sign(soft), frames)
         return frames
     # phase search: chip-rate matched filter (boxcar over one chip) at each phase
