@@ -96,10 +96,29 @@ def modulate_frame(psdu: bytes, sps_chip: int = SAMPLES_PER_CHIP) -> np.ndarray:
     return _oqpsk_modulate(chips, sps_chip)
 
 
+def mm_energy_gate(energy: np.ndarray) -> float:
+    """Burst/noise decision level for the MM loop, robust to ANY burst duty
+    cycle. The low tail estimates the noise floor: for Rayleigh noise
+    q10 ≈ 0.459σ, so 1.6·(q10/0.459) sits ABOVE the noise-block mean
+    (≈1.25σ) with margin, and far below any usable-SNR burst. Two failure
+    regimes bound it: an (almost-)all-signal capture inflates the
+    q10-derived floor toward the signal level — the 0.5·q99.9 cap keeps the
+    gate under the burst so adaptation still runs; a capture that is pure
+    noise has q99.9 = σ·√(2·ln 1000) ≈ 3.72σ, cap ≈1.86σ > the 1.6σ floor,
+    so the floor term wins and (most) noise blocks freeze. (The first cut
+    used gmean(q10, q90), which collapses onto ≈σ — BELOW the noise-block
+    mean — whenever the burst covers <10% of the capture; review caught it
+    with a direct simulation.)"""
+    q10, q999 = np.quantile(energy, (0.1, 0.999))
+    return float(min(1.6 * max(q10, 1e-12) / 0.459,
+                     0.5 * max(q999, 1e-12)))
+
+
 def _mm_clock_recovery(x: np.ndarray, sps: float, mu0: float = 0.5,
                        gain_step: float = 0.002, gain_phase: float = 0.15,
                        block: int = 32,
-                       energy: Optional[np.ndarray] = None) -> np.ndarray:
+                       energy: Optional[np.ndarray] = None,
+                       e_gate: Optional[float] = None) -> np.ndarray:
     """Mueller-Müller timing recovery, block-vectorized
     (`ClockRecoveryMm` block, `examples/zigbee/src/clock_recovery_mm.rs` role).
 
@@ -123,22 +142,8 @@ def _mm_clock_recovery(x: np.ndarray, sps: float, mu0: float = 0.5,
     candidates while phase/coherent both recovered the frame).
     """
     n = len(x)
-    if energy is not None:
-        # Burst/noise decision level, robust to ANY burst duty cycle. The low
-        # tail estimates the noise floor: for Rayleigh noise q10 ≈ 0.459σ, so
-        # 1.6·(q10/0.459) sits ABOVE the noise-block mean (≈1.25σ) with
-        # margin, and far below any usable-SNR burst. Two failure regimes
-        # bound it: an (almost-)all-signal capture inflates the q10-derived
-        # floor toward the signal level — the 0.5·q99.9 cap keeps the gate
-        # under the burst so adaptation still runs; a capture that is pure
-        # noise keeps q99.9 ≈ 4.8σ, cap 2.4σ > 1.6σ, so the floor term wins
-        # and (most) noise blocks freeze. (The first cut used
-        # gmean(q10, q90), which collapses onto ≈σ — BELOW the noise-block
-        # mean — whenever the burst covers <10% of the capture; review
-        # caught it with a direct simulation.)
-        q10, q999 = np.quantile(energy, (0.1, 0.999))
-        e_gate = float(min(1.6 * max(q10, 1e-12) / 0.459,
-                           0.5 * max(q999, 1e-12)))
+    if energy is not None and e_gate is None:
+        e_gate = mm_energy_gate(energy)
     out_parts = []
     pos = mu0
     step = float(sps)
@@ -365,9 +370,20 @@ def demodulate_stream(samples: np.ndarray, sps_chip: int = SAMPLES_PER_CHIP,
     freq = np.angle(d)
     frames: List[bytes] = []
     if timing == "mm":
-        soft = _mm_clock_recovery(freq, sps_chip,
-                                  energy=np.abs(samples[1:]))
-        _scan_soft_chips(np.sign(soft), frames)
+        # two starting phases a half chip apart: with the loop frozen during
+        # the noise prefix (energy gate), the INITIAL phase persists to the
+        # burst — and the MM pull-in range is about a quarter chip, so one
+        # unlucky mu0 occasionally produced chips too poor for the SFD scan
+        # (r5 campaign batch 13, offset 5528176: the default start failed
+        # while every start ≥1.5 samples recovered the frame). One of two
+        # half-chip-spaced starts is always within pull-in;
+        # _scan_soft_chips dedups the PSDUs when both converge.
+        en = np.abs(samples[1:])
+        gate = mm_energy_gate(en)        # one quantile pass for both starts
+        for mu0 in (0.5, 0.5 + sps_chip / 2.0):
+            soft = _mm_clock_recovery(freq, sps_chip, mu0=mu0, energy=en,
+                                      e_gate=gate)
+            _scan_soft_chips(np.sign(soft), frames)
         return frames
     # phase search: chip-rate matched filter (boxcar over one chip) at each phase
     kernel = np.ones(sps_chip, dtype=np.float32) / sps_chip

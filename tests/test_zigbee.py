@@ -230,3 +230,30 @@ def test_mm_acquisition_survives_noise_only_prefix():
           ).astype(np.complex64)
     assert payload in [mac_deframe(ps)
                        for ps in demodulate_stream(x2, timing="mm")]
+
+
+def test_mm_dual_start_phase_covers_pull_in_range():
+    """Regression (r5 campaign batch 13, offset 5528176 — the fifth finding):
+    with adaptation frozen during the noise prefix, the MM loop's INITIAL
+    phase persists to the burst, and its pull-in range is only ~a quarter
+    chip — one draw's default start produced chips too poor for the SFD scan
+    while every start ≥1.5 samples recovered the frame. The mm path now runs
+    two half-chip-spaced starts (one is always within pull-in). Exact
+    campaign draw."""
+    from futuresdr_tpu.models.zigbee import (demodulate_stream, mac_deframe,
+                                             mac_frame, modulate_frame)
+    rng = np.random.default_rng(154 + 5528176)
+    for trial in range(4):
+        timing = ("phase", "mm", "coherent")[int(rng.integers(0, 3))]
+        n_pay = int(rng.integers(1, 100))
+        payload = rng.integers(0, 256, n_pay).astype(np.uint8).tobytes()
+        sig = modulate_frame(mac_frame(payload, seq=trial))
+        x = np.concatenate([np.zeros(int(rng.integers(64, 600)), np.complex64),
+                            sig, np.zeros(256, np.complex64)])
+        x = (x * np.exp(1j * float(rng.uniform(0, 6.28)))
+             + 0.05 * (rng.standard_normal(len(x))
+                       + 1j * rng.standard_normal(len(x)))).astype(np.complex64)
+        if trial == 3:
+            assert timing == "mm"
+            got = [mac_deframe(ps) for ps in demodulate_stream(x, timing="mm")]
+            assert payload in got
