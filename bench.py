@@ -222,15 +222,17 @@ def run_device_resident(frame_sizes=(1 << 18, 1 << 19, 1 << 20),
     return best_rate, best_frame, sweep
 
 
-def run_streamed(n_samples: int, frame_size: int, depth: int = 8) -> float:
-    """TPU path through the actor runtime: host ring → TpuKernel → host ring."""
+def run_streamed(n_samples: int, frame_size: int, depth: int = 8,
+                 wire: str = "f32") -> float:
+    """TPU path through the actor runtime: host ring → TpuKernel → host ring.
+    ``wire`` picks the host↔device codec (ops/wire.py) for both crossings."""
     from futuresdr_tpu.config import config
     config().buffer_size = max(config().buffer_size, 4 * frame_size * 8)
     fg = Flowgraph()
     src = NullSource(np.complex64)
     head = Head(np.complex64, n_samples)
     tk = TpuKernel(_stages(), np.complex64, frame_size=frame_size,
-                   frames_in_flight=depth)
+                   frames_in_flight=depth, wire=wire)
     snk = NullSink(np.float32)
     fg.connect(src, head, tk, snk)
     t0 = time.perf_counter()
@@ -254,10 +256,11 @@ def _run_dev_child(frame: int) -> None:
     print(f"DEV_RATE {rate}")  # must record an error note, not a 0.0 rate
 
 
-def _run_streamed_child(frame: int, n: int, depth: int) -> None:
+def _run_streamed_child(frame: int, n: int, depth: int,
+                        wire: str = "f32") -> None:
     """Child mode (``--run-streamed``): one streamed measurement (same
     isolation rationale as ``--run-dev``)."""
-    print(f"STREAM_RATE {run_streamed(n, frame, depth)}")
+    print(f"STREAM_RATE {run_streamed(n, frame, depth, wire)}")
 
 
 def _sub_rate(argv, pattern, timeout, extra_env=None):
@@ -365,6 +368,8 @@ def main():
     p.add_argument("--run-streamed", nargs=3, type=int, default=None,
                    metavar=("FRAME", "N", "DEPTH"),
                    help="internal child mode: one streamed measurement")
+    p.add_argument("--wire", default="f32",
+                   help="wire format for --run-streamed (ops/wire.py)")
     args = p.parse_args()
 
     if args.run_chain:
@@ -374,7 +379,7 @@ def main():
         _run_dev_child(args.run_dev)
         return
     if args.run_streamed:
-        _run_streamed_child(*args.run_streamed)
+        _run_streamed_child(*args.run_streamed, wire=args.wire)
         return
 
     inst_ = instance()
@@ -444,11 +449,12 @@ def main():
     big = ((1 << 21),) if inst_.platform != "cpu" else ()
     cand = ((args.frame,) if args.frame          # explicit --frame pins BOTH paths
             else tuple(dict.fromkeys(((1 << 18), (1 << 19)) + big + (best_frame,))))
-    def _streamed(frame, n, depth):
+    def _streamed(frame, n, depth, wire="f32"):
         if not guarded:
-            return run_streamed(n, frame, depth), None
+            return run_streamed(n, frame, depth, wire), None
         r, err, _out = _sub_rate(
-            ["--run-streamed", str(frame), str(n), str(depth)],
+            ["--run-streamed", str(frame), str(n), str(depth),
+             "--wire", wire],
             "STREAM_RATE", 600)
         return r, err
 
@@ -514,19 +520,14 @@ def main():
     link = {}
     if inst_.platform != "cpu":
         try:
-            from futuresdr_tpu.ops.xfer import to_device, to_host
+            from futuresdr_tpu.tpu.autotune import measure_link
+            # one shared link-measurement discipline (median-of-3, pair-shim
+            # path): the stamped envelope and what autotune_streamed feeds to
+            # pick_wire must be the same number
             sz = stream_frame * np.dtype(np.complex64).itemsize
-            payload = np.zeros(stream_frame, np.complex64)
-            ups, downs = [], []
-            for _ in range(3):                       # link draws are noisy ±2x
-                t0 = time.perf_counter()
-                y = to_device(payload, inst_.device)
-                y.block_until_ready()
-                ups.append(sz / (time.perf_counter() - t0) / 1e6)
-                t0 = time.perf_counter()
-                np.asarray(to_host(y))
-                downs.append(sz / (time.perf_counter() - t0) / 1e6)
-            up, down = sorted(ups)[1], sorted(downs)[1]
+            up_Bps, down_Bps = measure_link(inst_, nbytes=sz,
+                                            dtype=np.complex64)
+            up, down = up_Bps / 1e6, down_Bps / 1e6
             # one frame crosses up as 8 B/sample and back as 4 B/sample (f32
             # spectrum out); in-flight frames overlap the two directions, so
             # the duplex bound is the binding one
@@ -537,6 +538,63 @@ def main():
                   f"→ streamed ceiling ≈ {ceiling:.1f} Msps", file=sys.stderr)
         except Exception as e:                          # noqa: BLE001
             print(f"# link envelope unavailable: {e!r}", file=sys.stderr)
+
+    # wire-format streamed A/B: the SAME loop at the same frame/depth, through
+    # the codec the measured link envelope picks (pick_wire; sc16 when there is
+    # no link to measure — the CPU backend's memcpy "link" never picks a lossy
+    # format on its own, but the artifact must still carry the codec number so
+    # the f32↔wire trajectory stays comparable round over round. The f32 number
+    # above is untouched.)
+    wire_extra = {}
+    try:
+        from futuresdr_tpu.ops.wire import measure_snr_db
+        from futuresdr_tpu.tpu.autotune import pick_wire
+        if link:
+            wire_pick = pick_wire(link["h2d_MBps"] * 1e6,
+                                  link["d2h_MBps"] * 1e6,
+                                  np.complex64, np.float32)
+        else:
+            wire_pick = "sc16"
+        # size runs from the f32 probe scaled by the pick's wire-byte ratio —
+        # but only when a real link was measured: link-bound, a 2x-compact
+        # format runs ~2x faster and each run should still last ~per_run
+        # seconds; on the CPU backend's memcpy "link" the codec buys nothing,
+        # so scaling would only double the bench wall time
+        from futuresdr_tpu.ops.wire import get_wire
+        ratio = ((np.dtype(np.complex64).itemsize
+                  / get_wire(wire_pick).bytes_per_sample(np.complex64))
+                 if link else 1.0)
+        n_wire = int(min(max(probe_best * ratio * 1e6 * per_run,
+                             stream_frame * 4 * args.depth),
+                         200_000_000))
+        n_wire = (n_wire // stream_frame) * stream_frame
+        wire_runs = []
+        for _ in range(3):
+            r, err = _streamed(stream_frame, n_wire, args.depth, wire_pick)
+            if r is None:
+                wire_extra["streamed_wire_error"] = err
+                print(f"# streamed wire run failed: {err}", file=sys.stderr)
+                continue
+            wire_runs.append(r)
+        wire_runs.sort()
+        snr = measure_snr_db(wire_pick, np.complex64)
+        wire_extra.update({
+            "streamed_wire": wire_pick,
+            "streamed_wire_msps": round(
+                wire_runs[(len(wire_runs) - 1) // 2], 1) if wire_runs else 0.0,
+            "streamed_wire_runs": [round(r, 1) for r in wire_runs],
+            # MEASURED codec SNR (host round trip == one link crossing's
+            # quantization); null for exact formats, not inf (JSON)
+            "streamed_wire_snr_db": (round(snr, 1) if np.isfinite(snr)
+                                     else None),
+        })
+        print(f"# streamed wire={wire_pick} "
+              f"(snr {wire_extra['streamed_wire_snr_db']} dB): "
+              f"median {wire_extra['streamed_wire_msps']:.1f} Msps, "
+              f"runs {['%.1f' % r for r in wire_runs]}", file=sys.stderr)
+    except Exception as e:                              # noqa: BLE001
+        print(f"# streamed wire A/B unavailable: {e!r}", file=sys.stderr)
+        wire_extra["streamed_wire_error"] = repr(e)
 
     result = {
         "metric": f"fir64+fft{FFT_SIZE}+mag2 fused chain, device-resident ({inst_.platform})",
@@ -554,6 +612,7 @@ def main():
         "frame": best_frame,
         "dev_frame_sweep": dev_sweep,
         **link,
+        **wire_extra,
         **roof,
         **extras,
     }

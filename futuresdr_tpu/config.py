@@ -8,7 +8,11 @@ Typed knobs plus a free-form ``misc`` map with typed ``get``.
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib                      # Python >= 3.11
+except ImportError:                     # pragma: no cover - py3.10 fallback
+    import tomli as tomllib
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Optional
@@ -35,6 +39,8 @@ class Config:
     # TPU-specific knobs (no reference analog; this is the compute-plane config).
     tpu_frame_size: int = 1 << 18          # samples per device frame
     tpu_frames_in_flight: int = 4          # dispatch pipeline depth
+    tpu_wire_format: str = "auto"          # host↔device wire codec (ops/wire.py):
+    #   "auto" | "f32" | "bf16" | "sc16" | "sc8"; env FUTURESDR_TPU_WIRE_FORMAT
     misc: dict = field(default_factory=dict)
 
     def get(self, key: str, default: Any = None) -> Any:
@@ -43,8 +49,15 @@ class Config:
             return getattr(self, key)
         return self.misc.get(key, default)
 
-    def _apply(self, d: dict):
+    def _apply(self, d: dict, env: bool = False):
         for k, v in d.items():
+            if env and not hasattr(self, k) and hasattr(self, "tpu_" + k):
+                # FUTURESDR_TPU_WIRE_FORMAT etc.: the env prefix already spells
+                # the plane, so the stripped key lacks the ``tpu_`` head. Env
+                # vars only — a TOML ``wire_format`` key stays in misc (it was
+                # never a typed knob, and silently promoting it would change
+                # existing configs' behavior)
+                k = "tpu_" + k
             if hasattr(self, k) and k != "misc":
                 cur = getattr(self, k)
                 if isinstance(cur, bool) and isinstance(v, str):
@@ -73,7 +86,7 @@ def _load() -> Config:
         for k, v in os.environ.items()
         if k.startswith(_ENV_PREFIX)
     }
-    c._apply(env)
+    c._apply(env, env=True)
     return c
 
 

@@ -9,9 +9,13 @@ from .stages import (Stage, Pipeline, fir_stage, fft_stage, mag2_stage, log10_st
                      rotator_stage, quad_demod_stage, apply_stage, fftshift_stage,
                      decimate_stage, moving_avg_stage, resample_stage, agc_stage,
                      channelizer_stage, lora_demod_stage)
+from .wire import (Wire, WIRE_FORMATS, get_wire, resolve_wire, wire_names,
+                   measure_snr_db, streamed_ceiling_msps)
 
 __all__ = ["Stage", "Pipeline", "fir_stage", "fft_stage", "mag2_stage", "log10_stage",
            "xlating_fir_stage",
            "rotator_stage", "quad_demod_stage", "apply_stage", "fftshift_stage",
            "decimate_stage", "moving_avg_stage", "resample_stage", "agc_stage",
-           "channelizer_stage", "lora_demod_stage"]
+           "channelizer_stage", "lora_demod_stage",
+           "Wire", "WIRE_FORMATS", "get_wire", "resolve_wire", "wire_names",
+           "measure_snr_db", "streamed_ceiling_msps"]

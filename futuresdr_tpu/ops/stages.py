@@ -82,6 +82,7 @@ class Pipeline:
         self.ratio = r
         self.out_dtype = dtype
         self._fn = None
+        self._wired_fns = {}        # wire name -> wrapped fn (stable for jit cache)
 
     def init_carry(self):
         dtype = self.in_dtype
@@ -115,6 +116,36 @@ class Pipeline:
         assert frame_size % self.frame_multiple == 0, \
             f"frame_size {frame_size} not a multiple of {self.frame_multiple}"
         fn = jax.jit(self.fn(), donate_argnums=(0,) if donate else ())
+        carry = self.init_carry()
+        if device is not None:
+            carry = jax.device_put(carry, device)
+        return fn, carry
+
+    def wired_fn(self, wire):
+        """The stage chain with the wire codec's decode PROLOG and encode EPILOG
+        fused in: ``(carries, *in_parts) -> (carries, out_parts)``. Dequantized
+        frames exist only inside the XLA program — they never round-trip
+        through HBM as a separate dispatch (``ops/wire.py``)."""
+        from .wire import get_wire
+        wire = get_wire(wire)
+        if wire.name not in self._wired_fns:
+            inner = self.fn()
+            in_dt, w = self.in_dtype, wire
+
+            def run(carries, *parts):
+                carries, y = inner(carries, w.decode_jax(parts, in_dt))
+                return carries, w.encode_jax(y)
+
+            self._wired_fns[wire.name] = run
+        return self._wired_fns[wire.name]
+
+    def compile_wired(self, frame_size: int, wire, device=None,
+                      donate: bool = True):
+        """:meth:`compile` for the wired form: the compiled fn consumes/produces
+        wire parts (see :meth:`wired_fn`); returns (compiled_fn, initial carry)."""
+        assert frame_size % self.frame_multiple == 0, \
+            f"frame_size {frame_size} not a multiple of {self.frame_multiple}"
+        fn = jax.jit(self.wired_fn(wire), donate_argnums=(0,) if donate else ())
         carry = self.init_carry()
         if device is not None:
             carry = jax.device_put(carry, device)

@@ -21,9 +21,15 @@ portable wire layout; the "complex" view is formed device-side.
 
 from __future__ import annotations
 
+import threading
+import time
+from typing import Optional
+
 import numpy as np
 
-__all__ = ["to_device", "to_host", "start_host_transfer", "split_complex_platform"]
+__all__ = ["to_device", "to_host", "start_host_transfer", "start_device_transfer",
+           "start_device_transfer_parts", "start_host_transfer_parts",
+           "split_complex_platform", "set_fake_link", "fake_link"]
 
 _join_jit = None
 _split_jit = None
@@ -37,6 +43,93 @@ def _jits():
         _join_jit = jax.jit(lambda p: jax.lax.complex(p[..., 0], p[..., 1]))
         _split_jit = jax.jit(lambda x: (x.real, x.imag))
     return _join_jit, _split_jit
+
+
+class _FakeLink:
+    """Rate-throttled fake link for deterministic CI pipelining tests.
+
+    Models each direction as a serial wire: a transfer of ``nbytes`` occupies
+    the direction for ``nbytes/rate`` seconds starting when the wire frees up.
+    ``reserve`` is called at transfer START and returns the wall-clock deadline
+    the bytes land at; ``finish()`` sleeps out the remainder. No threads — the
+    timeline alone decides whether a drain loop overlapped its transfers:
+    serialized loops pay Σ(h2d+compute+d2h), pipelined ones pay ≈ the max."""
+
+    def __init__(self, h2d_bps: Optional[float], d2h_bps: Optional[float]):
+        self.h2d_bps = h2d_bps
+        self.d2h_bps = d2h_bps
+        self._lock = threading.Lock()
+        self._busy = {"h2d": 0.0, "d2h": 0.0}
+
+    def reserve(self, direction: str, nbytes: int) -> float:
+        rate = self.h2d_bps if direction == "h2d" else self.d2h_bps
+        if not rate:
+            return 0.0
+        with self._lock:
+            start = max(time.perf_counter(), self._busy[direction])
+            self._busy[direction] = start + nbytes / rate
+            return self._busy[direction]
+
+
+_fake_link: Optional[_FakeLink] = None
+
+
+def set_fake_link(h2d_bps: Optional[float] = None,
+                  d2h_bps: Optional[float] = None):
+    """Install (or with no args remove) a throttled fake link on every transfer
+    started through this module; returns the previous link for restoration.
+    CI/testing only — lets the CPU backend reproduce the tunnel's link-bound
+    streamed regime deterministically."""
+    global _fake_link
+    prev = _fake_link
+    _fake_link = _FakeLink(h2d_bps, d2h_bps) if (h2d_bps or d2h_bps) else None
+    return prev
+
+
+def fake_link() -> Optional[_FakeLink]:
+    return _fake_link
+
+
+def _reserve(direction: str, nbytes: int) -> float:
+    return _fake_link.reserve(direction, nbytes) if _fake_link else 0.0
+
+
+def _wait_deadline(deadline: float) -> None:
+    """Wait out a fake-link deadline PRECISELY: plain ``time.sleep`` overshoots
+    by 1-4 ms on Linux, a proportionally larger tax on short (small-frame /
+    compact-wire) transfers — enough to skew A/B wire-format ratios. Sleep to
+    ~1.5 ms short of the deadline, then yield-spin the remainder."""
+    if not deadline:
+        return
+    while True:
+        d = deadline - time.perf_counter()
+        if d <= 0:
+            return
+        time.sleep(d - 0.0015 if d > 0.0015 else 0.0)
+
+
+_fetch_pool = None
+_fetch_pool_lock = threading.Lock()
+
+
+def _start_fetch(part):
+    """Begin the D2H of one device array NOW; returns ``thunk() -> np.ndarray``.
+
+    ``copy_to_host_async`` when the array type has it; otherwise the fetch is
+    submitted to a small thread pool immediately — the fallback used to fetch
+    synchronously inside ``finish()``, serializing oldest-first and losing the
+    overlap the caller staged for (round-6 fix)."""
+    if hasattr(part, "copy_to_host_async"):
+        part.copy_to_host_async()
+        return lambda: np.asarray(part)
+    global _fetch_pool
+    if _fetch_pool is None:
+        with _fetch_pool_lock:   # BLOCKING kernel threads race the first fetch
+            if _fetch_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _fetch_pool = ThreadPoolExecutor(max_workers=2,
+                                                 thread_name_prefix="fsdr-d2h")
+    return _fetch_pool.submit(np.asarray, part).result
 
 
 def split_complex_platform(platform: str) -> bool:
@@ -80,22 +173,66 @@ def _device_platform(device=None) -> str:
     return jax.default_backend()
 
 
-def to_device(arr, device=None):
-    """``jax.device_put`` that is safe for complex dtypes on broken-transfer backends."""
+def start_device_transfer_parts(parts, device=None):
+    """Begin a NON-blocking H2D of pre-encoded wire parts (``ops/wire.py``
+    layouts — plain real/int numpy arrays, never complex); returns a zero-arg
+    ``finish()`` that blocks until the payload is device-resident and yields
+    the tuple of device arrays.
+
+    This is the H2D symmetric of :func:`start_host_transfer` — the primitive
+    that lets a drain loop keep H2D(t+1) on the wire while frame t computes
+    (``device_put`` is async on accelerator backends; the fake link models the
+    wire time for deterministic CPU-backend tests). ``device`` may be a Device
+    or a Sharding."""
+    import jax
+
+    host = [np.asarray(p) for p in parts]
+    deadline = _reserve("h2d", sum(p.nbytes for p in host))
+    devs = tuple(jax.device_put(p, device) for p in host)
+
+    def finish():
+        _wait_deadline(deadline)
+        return devs
+
+    return finish
+
+
+def start_device_transfer(arr, device=None):
+    """Begin a NON-blocking H2D of one host array (complex rides the pair shim);
+    returns ``finish() -> device array``. :func:`to_device` is this with an
+    immediate finish."""
     import jax
 
     if isinstance(arr, jax.Array):
         # already device-resident: device_put is a same-device no-op (or a safe D2D
         # move); forcing it through np.asarray would be a blocking D2H round-trip
-        return jax.device_put(arr, device) if device is not None else arr
+        x = jax.device_put(arr, device) if device is not None else arr
+        return lambda: x
     a = np.asarray(arr)
     if np.issubdtype(a.dtype, np.complexfloating) and \
             split_complex_platform(_device_platform(device)):
-        f = np.float64 if a.dtype == np.complex128 else np.float32
-        pairs = np.ascontiguousarray(a).view(f).reshape(a.shape + (2,))
+        from .wire import _pairs_view
+        pairs = _pairs_view(a)   # the ONE copy of the regression-locked trick
+        put = start_device_transfer_parts((pairs,), device)
         join, _ = _jits()
-        return join(jax.device_put(pairs, device))
-    return jax.device_put(a, device)
+
+        def finish():
+            (p,) = put()
+            return join(p)
+
+        return finish
+    put = start_device_transfer_parts((a,), device)
+
+    def finish():
+        (x,) = put()
+        return x
+
+    return finish
+
+
+def to_device(arr, device=None):
+    """``jax.device_put`` that is safe for complex dtypes on broken-transfer backends."""
+    return start_device_transfer(arr, device)()
 
 
 def to_host(arr) -> np.ndarray:
@@ -129,17 +266,34 @@ def start_host_transfer(arr):
         if split_complex_platform(platform):
             _, split = _jits()
             r, i = split(arr)                    # async device-side split
-            for part in (r, i):
-                if hasattr(part, "copy_to_host_async"):
-                    part.copy_to_host_async()
+            deadline = _reserve("d2h", r.nbytes + i.nbytes)
+            # both halves start NOW (async copy, or eager pool fetch when the
+            # array type has no copy_to_host_async) — never serially in finish
+            fr, fi = _start_fetch(r), _start_fetch(i)
 
-            def finish(r=r, i=i):
+            def finish():
                 out = np.empty(r.shape, dtype=dt)
-                out.real = np.asarray(r)
-                out.imag = np.asarray(i)
+                out.real = fr()
+                out.imag = fi()
+                _wait_deadline(deadline)
                 return out
 
             return finish
-    if hasattr(arr, "copy_to_host_async"):
-        arr.copy_to_host_async()
-    return lambda: np.asarray(arr)
+    deadline = _reserve("d2h", getattr(arr, "nbytes", 0))
+    fetch = _start_fetch(arr)
+
+    def finish():
+        out = fetch()
+        _wait_deadline(deadline)
+        return out
+
+    return finish
+
+
+def start_host_transfer_parts(parts):
+    """Begin a NON-blocking D2H of a tuple of wire parts (a jitted epilog's
+    output, ``ops/wire.py``); returns ``finish() -> tuple of np arrays``.
+    Every part's transfer starts immediately, so in-flight frames' payloads
+    ride the wire together (per-direction fake-link accounting included)."""
+    fins = [start_host_transfer(p) for p in parts]
+    return lambda: tuple(f() for f in fins)

@@ -27,6 +27,16 @@ __all__ = ["sp_fir", "sp_fir_fft_mag2", "sp_fir_stream", "sp_fir_fft_mag2_stream
            "sp_channelizer", "sp_channelizer_a2a", "sp_dechirp_scan"]
 
 
+def _axis_size(axis_name: str) -> int:
+    """Static mapped-axis size. ``jax.lax.axis_size`` where it exists (jax ≥
+    0.4.38-ish); older jax exposes the same trace-time axis env through
+    ``jax.core.axis_frame``."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:             # pragma: no cover - version-dependent
+        return jax.core.axis_frame(axis_name)
+
+
 def _halo_from_left(local: jnp.ndarray, halo: int, axis_name: str,
                     carry: jnp.ndarray = None) -> jnp.ndarray:
     """Prepend the previous shard's tail — the halo exchange.
@@ -37,7 +47,7 @@ def _halo_from_left(local: jnp.ndarray, halo: int, axis_name: str,
     reference keeps implicitly in its ring buffers, `fir.rs:49` min_items)."""
     if halo <= 0:
         return local                    # 1-tap FIR: no history needed
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     tail = local[-halo:]
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -183,7 +193,7 @@ def _halo_from_right(local: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarr
     shard pads with zeros (stream edge)."""
     if halo <= 0:
         return local
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     head = local[:halo]
     perm = [(i, (i - 1) % n) for i in range(n)]

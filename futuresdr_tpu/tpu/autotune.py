@@ -1,23 +1,35 @@
-"""Frame-size / pipeline-depth autotuning for TPU stage pipelines.
+"""Frame-size / depth / wire-format autotuning for TPU stage pipelines.
 
 The throughput of a fused stage chain depends on frame size (dispatch amortization vs
-HBM residency) and in-flight depth (transfer/compute overlap). This sweeps a small grid
-with the real pipeline (device dispatch + host staging, as TpuKernel does) and returns
-the best configuration — run once at deploy time, feed the result to ``TpuKernel``.
+HBM residency), in-flight depth (transfer/compute overlap), and — for the STREAMED
+path — the wire format (``ops/wire.py``: bytes/sample vs codec SNR). This sweeps a
+small grid with the real pipeline (device dispatch + host staging, as TpuKernel does)
+and returns the best configuration — run once at deploy time, feed the result to
+``TpuKernel``.
+
+Streamed tuning is two-stage: :func:`measure_link` stamps the link envelope,
+:func:`pick_wire` turns it into the analytic format choice (each format's
+link-bounded ceiling, filtered by an SNR floor), and :func:`autotune_streamed`
+verifies the pick by measuring the REAL wired drain loop over the grid. The
+config/env override ``FUTURESDR_TPU_WIRE_FORMAT`` (``config.tpu_wire_format``)
+short-circuits all of it.
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..log import logger
+from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
 from .instance import TpuInstance, instance
 
-__all__ = ["autotune", "default_frames"]
+__all__ = ["autotune", "autotune_streamed", "default_frames", "measure_link",
+           "pick_wire"]
 
 log = logger("tpu.autotune")
 
@@ -91,3 +103,157 @@ def autotune(stages: Sequence[Stage], in_dtype,
                 best = (f, d)
     log.info("autotune best: frame=%d depth=%d (%.1f Msps)", *best, best_rate)
     return best[0], best[1], results
+
+
+# ---------------------------------------------------------------------------
+# streamed-path tuning: link envelope → wire format → verified grid point
+# ---------------------------------------------------------------------------
+
+def measure_link(inst: Optional[TpuInstance] = None, nbytes: int = 4 << 20,
+                 repeats: int = 3, dtype=np.float32) -> Tuple[float, float]:
+    """Measured (h2d_Bps, d2h_Bps) of the host↔device link, median of
+    ``repeats`` payload crossings of ``dtype`` (complex rides the pair shim,
+    exactly as streamed frames do; the fake link is honored, so CI can
+    exercise the whole tuning path deterministically)."""
+    inst = inst or instance()
+    dt = np.dtype(dtype)
+    payload = np.zeros(max(1, nbytes // dt.itemsize), dt)
+    ups, downs = [], []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        y = xfer.to_device(payload, inst.device)
+        y.block_until_ready()
+        ups.append(payload.nbytes / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        xfer.to_host(y)
+        downs.append(payload.nbytes / (time.perf_counter() - t0))
+    return sorted(ups)[repeats // 2], sorted(downs)[repeats // 2]
+
+
+def pick_wire(h2d_Bps: float, d2h_Bps: float, in_dtype, out_dtype,
+              out_per_in: float = 1.0, compute_msps: Optional[float] = None,
+              min_snr_db: Optional[float] = 60.0,
+              wires: Optional[Sequence[str]] = None) -> str:
+    """Analytic wire-format choice from a measured link envelope.
+
+    Each format's streamed ceiling is ``min(h2d/up_bytes, d2h/down_bytes,
+    compute)`` (:func:`futuresdr_tpu.ops.wire.streamed_ceiling_msps`); formats
+    whose MEASURED codec SNR falls below ``min_snr_db`` are excluded (the
+    default 60 dB keeps quantization ≥ ~20 dB under a strong RF signal's own
+    noise floor — sc16 passes at ~89 dB, sc8/bf16 don't). Ties go to the
+    higher-fidelity format, so a compute-bound link never trades SNR for
+    nothing."""
+    from ..ops.wire import get_wire, measure_snr_db, streamed_ceiling_msps
+    cand = []
+    for name in (wires or ("f32", "sc16", "sc8", "bf16")):
+        w = get_wire(name)
+        snr = measure_snr_db(w, in_dtype)
+        if min_snr_db is not None and snr < min_snr_db:
+            continue
+        ceil = streamed_ceiling_msps(w, h2d_Bps, d2h_Bps, in_dtype, out_dtype,
+                                     out_per_in)
+        if compute_msps:
+            ceil = min(ceil, compute_msps)
+        cand.append((ceil, snr, w.name))
+    if not cand:
+        return "f32"
+    # sort by ceiling, then SNR: a 1% ceiling edge must not beat 40 dB of SNR
+    cand.sort(key=lambda c: (round(c[0], 2), c[1]), reverse=True)
+    return cand[0][2]
+
+
+def _measure_wired(pipe: Pipeline, wire, frame: int, depth: int,
+                   inst: TpuInstance, min_seconds: float) -> float:
+    """Msamples/s through the PIPELINED wired drain loop (encode → staged H2D →
+    fused decode/compute/encode → read-ahead D2H → decode), the loop TpuKernel
+    runs — so the number includes host codec cost and honors any fake link."""
+    from ..ops.wire import get_wire
+    wire = get_wire(wire)
+    fn, carry = pipe.compile_wired(frame, wire, device=inst.device)
+    host = np.zeros(frame, dtype=pipe.in_dtype)
+    parts = wire.encode_host(host)
+    import jax
+    dev = tuple(jax.device_put(np.asarray(p), inst.device) for p in parts)
+    carry, y = fn(carry, *dev)              # warmup compile off the clock
+    jax.block_until_ready(y)
+    staged: deque = deque()
+    inflight: deque = deque()
+    n_frames = 0
+    t0 = time.perf_counter()
+    while True:
+        staged.append(xfer.start_device_transfer_parts(
+            wire.encode_host(host), inst.device))
+        while staged and len(inflight) < depth:
+            carry, y_parts = fn(carry, *staged.popleft()())
+            inflight.append(xfer.start_host_transfer_parts(y_parts))
+            n_frames += 1
+        if len(inflight) >= depth:
+            wire.decode_host(inflight.popleft()(), pipe.out_dtype)
+        if n_frames % 4 == 0 and time.perf_counter() - t0 > min_seconds:
+            break
+        if n_frames > 10000:
+            break
+    for fin in inflight:
+        wire.decode_host(fin(), pipe.out_dtype)
+    dt = time.perf_counter() - t0
+    return n_frames * frame / dt / 1e6
+
+
+def autotune_streamed(stages: Sequence[Stage], in_dtype,
+                      wires: Optional[Sequence[str]] = None,
+                      frames: Optional[Sequence[int]] = None,
+                      depths: Sequence[int] = (2, 4, 8),
+                      min_seconds: float = 0.3,
+                      min_snr_db: Optional[float] = 60.0,
+                      inst: Optional[TpuInstance] = None
+                      ) -> Tuple[str, int, int, Dict]:
+    """Returns ``(best_wire, best_frame, best_depth, results)`` for the
+    STREAMED path; ``results[(wire, frame, depth)] = Msps``.
+
+    An explicit (non-"auto") ``config.tpu_wire_format`` /
+    ``FUTURESDR_TPU_WIRE_FORMAT`` pins the wire and only (frame, depth) are
+    swept. Otherwise the candidate set is the analytic pick from the measured
+    link envelope (:func:`pick_wire`) plus ``f32`` as the exact baseline, so
+    the sweep stays small and the chosen format's advantage is measured, not
+    assumed."""
+    from ..config import config
+    inst = inst or instance()
+    # ONE Pipeline for everything: wired_fn caches per wire name on the
+    # instance, so the jit function identity stays stable and each (wire,
+    # frame) shape compiles once — not once per depth (compile_wired hands out
+    # a fresh carry per call, so reuse across measurements is safe)
+    pipe = Pipeline(list(stages), in_dtype)
+    if wires is None:
+        pinned = config().tpu_wire_format
+        if pinned != "auto":
+            wires = (pinned,)
+        else:
+            up, down = measure_link(inst)
+            picked = pick_wire(up, down, pipe.in_dtype, pipe.out_dtype,
+                               float(pipe.ratio), min_snr_db=min_snr_db)
+            wires = ("f32",) if picked == "f32" else ("f32", picked)
+            log.info("link %.1f/%.1f MB/s → wire candidates %s",
+                     up / 1e6, down / 1e6, wires)
+    if frames is None:
+        frames = default_frames(inst.platform)
+    results: Dict[Tuple[str, int, int], float] = {}
+    best = ("f32", 0, 0)
+    best_rate = -1.0
+    m = pipe.frame_multiple
+    for wname in wires:
+        for f in frames:
+            f = max(m, (f // m) * m)
+            for d in depths:
+                try:
+                    rate = _measure_wired(pipe, wname, f, d, inst, min_seconds)
+                except Exception as e:   # OOM at large frames, etc.
+                    log.warning("autotune_streamed (%s, %d, %d) failed: %r",
+                                wname, f, d, e)
+                    continue
+                results[(wname, f, d)] = round(rate, 1)
+                if rate > best_rate:
+                    best_rate = rate
+                    best = (wname, f, d)
+    log.info("autotune_streamed best: wire=%s frame=%d depth=%d (%.1f Msps)",
+             *best, best_rate)
+    return best[0], best[1], best[2], results

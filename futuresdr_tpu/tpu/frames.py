@@ -31,6 +31,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..log import logger
+from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
 from ..runtime.kernel import Kernel, message_handler
 from ..runtime.tag import ItemTag, rebase_tags
@@ -99,39 +100,80 @@ def emit_with_tags(output, data: np.ndarray,
 
 
 class TpuH2D(Kernel):
-    """Sample stream → device frames (`vulkan/h2d.rs` writer role)."""
+    """Sample stream → device frames (`vulkan/h2d.rs` writer role).
+
+    Frames cross the link in a configurable wire format (``ops/wire.py``;
+    ``wire=None`` resolves via config/platform) and are dequantized by a tiny
+    jitted prolog before entering the frame plane. Transfers are STAGED:
+    every frame the queue bound allows has its H2D started before the oldest
+    one is decoded, so frame t+1 rides the wire while t's decode dispatches
+    and downstream stages compute (the reference's circulating empty-buffer
+    half, `vulkan/h2d.rs:29-37`)."""
 
     BLOCKING = True
 
     def __init__(self, dtype, frame_size: Optional[int] = None,
-                 inst: Optional[TpuInstance] = None, max_inflight: int = 8):
+                 inst: Optional[TpuInstance] = None, max_inflight: int = 8,
+                 wire=None):
         super().__init__()
+        from collections import deque
+        from ..ops.wire import resolve_wire
         self.inst = inst or instance()
         self.frame_size = frame_size or self.inst.frame_size
         self.max_inflight = max_inflight
+        # staging read-ahead BEYOND the queue bound (TpuKernel contract,
+        # kernel_block.py): without it a frame is staged and launched in the
+        # same work cycle at steady state, serializing its wire time behind
+        # the previous frame's decode instead of riding under it
+        self.stage_ahead = 1 if max_inflight > 1 else 0
+        self.dtype = np.dtype(dtype)
+        self.wire = resolve_wire(wire, self.inst.platform)
+        self._staged = deque()                    # (h2d_finish, valid, tags)
         self.input = self.add_stream_input("in", dtype, min_items=self.frame_size)
         self.output = self.add_inplace_output("out")
+
+    def _stage(self, frame: np.ndarray, valid: int, tags) -> None:
+        parts = self.wire.encode_host(frame)
+        self._staged.append((xfer.start_device_transfer_parts(
+            parts, self.inst.device), valid, tags))
+
+    def _decode_frame(self, parts):
+        return self.wire.jit_decode(self.dtype)(*parts)
 
     async def work(self, io, mio, meta):
         inp = self.input.slice()
         sent = 0
-        while (len(inp) >= self.frame_size
-               and self.output.queue_depth() < self.max_inflight):
+
+        def slots() -> int:
+            return self.max_inflight + self.stage_ahead \
+                - self.output.queue_depth() - len(self._staged)
+
+        # stage: start the wire transfer of every frame the queue bound allows
+        while len(inp) >= self.frame_size and slots() > 0:
             tags = self.input.tags(self.frame_size)   # frame-relative indices
-            frame = self.inst.put(inp[:self.frame_size].copy())
-            self.output.put_full(frame, self.frame_size, tags)
+            frame = inp[:self.frame_size]
+            if self.wire.encode_may_alias(frame.dtype):
+                # async H2D must leave the ring before consume(); quantizing
+                # wires materialize fresh arrays in encode_host already
+                frame = frame.copy()
+            self._stage(frame, self.frame_size, tags)
             self.input.consume(self.frame_size)
             inp = self.input.slice()
-            sent += 1
         eos = self.input.finished()
         if eos and 0 < len(inp) < self.frame_size:
             tags = self.input.tags(len(inp))
             host = np.zeros(self.frame_size, dtype=self.input.dtype)
             host[:len(inp)] = inp
-            self.output.put_full(self.inst.put(host), len(inp), tags)
+            self._stage(host, len(inp), tags)
             self.input.consume(len(inp))
             inp = self.input.slice()
-        if eos and len(inp) == 0:
+        # launch: decode landed transfers onto the frame plane, oldest first —
+        # waiting only on the oldest frame's remaining wire time
+        while self._staged and self.output.queue_depth() < self.max_inflight:
+            h2d, valid, tags = self._staged.popleft()
+            self.output.put_full(self._decode_frame(h2d()), valid, tags)
+            sent += 1
+        if eos and len(inp) == 0 and not self._staged:
             io.finished = True
         elif sent and len(inp) >= self.frame_size:
             io.call_again = True
@@ -213,28 +255,36 @@ class TpuD2H(Kernel):
     """Device frames → sample stream (`vulkan/d2h.rs` reader role); the only sync
     point of the device pipeline.
 
-    Read-ahead drain: every completed frame waiting in the inplace queue has its
-    host transfer STARTED (``copy_to_host_async`` via the pair shim) before the
-    oldest one is synced — frame t+1's D2H rides the wire while frame t's samples
-    are being emitted, instead of serializing transfer-after-transfer behind the
-    per-frame sync (VERDICT r2 weak-item 2)."""
+    Results cross the link in a configurable wire format: a tiny jitted EPILOG
+    quantizes the device frame into wire parts (``ops/wire.py``) and the host
+    dequantizes after the transfer lands. Read-ahead drain: every completed
+    frame waiting in the inplace queue has its host transfer STARTED before the
+    oldest one is synced — frame t+1's D2H rides the wire while frame t's
+    samples are being emitted, instead of serializing transfer-after-transfer
+    behind the per-frame sync (VERDICT r2 weak-item 2)."""
 
     BLOCKING = True
 
     def __init__(self, dtype, inst: Optional[TpuInstance] = None,
-                 read_ahead: Optional[int] = None):
+                 read_ahead: Optional[int] = None, wire=None):
         super().__init__()
         from collections import deque
+        from ..ops.wire import resolve_wire
         self.inst = inst or instance()
         # read_ahead=0 disables read-ahead = serial drain (pull one, sync it);
         # the work loop needs bound >= 1 to make progress at all
         self.read_ahead = max(1, read_ahead if read_ahead is not None
                               else self.inst.frames_in_flight)
+        self.dtype = np.dtype(dtype)
+        self.wire = resolve_wire(wire, self.inst.platform)
         self.input = self.add_inplace_input("in")
         self.output = self.add_stream_output("out", dtype)
         self._pending: Optional[np.ndarray] = None
         self._pending_tags: List[ItemTag] = []
         self._inflight = deque()                  # (finish, valid, tags)
+
+    def _start_d2h(self, frame):
+        return xfer.start_host_transfer_parts(self.wire.jit_encode()(frame))
 
     async def work(self, io, mio, meta):
         if self._pending is not None:
@@ -249,10 +299,11 @@ class TpuD2H(Kernel):
             if item is None:
                 break
             frame, valid, tags = item
-            self._inflight.append((self.inst.get_async(frame), valid, tags))
+            self._inflight.append((self._start_d2h(frame), valid, tags))
         if self._inflight:
             finish, valid, tags = self._inflight.popleft()
-            host = finish()[:valid]               # sync point (oldest frame only)
+            # sync point (oldest frame only)
+            host = self.wire.decode_host(finish(), self.dtype)[:valid]
             self._pending, self._pending_tags = emit_with_tags(
                 self.output, host, tags)
             io.call_again = True

@@ -28,6 +28,7 @@ from typing import Deque, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..log import logger
+from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
 from ..runtime.kernel import Kernel, message_handler
 from ..runtime.tag import ItemTag
@@ -46,7 +47,8 @@ class TpuKernel(Kernel):
     def __init__(self, stages: Sequence[Stage], in_dtype,
                  frame_size: Optional[int] = None,
                  inst: Optional[TpuInstance] = None,
-                 frames_in_flight: Optional[int] = None):
+                 frames_in_flight: Optional[int] = None,
+                 wire=None):
         super().__init__()
         self.inst = inst or instance()
         self.pipeline = Pipeline(stages, in_dtype)
@@ -55,11 +57,22 @@ class TpuKernel(Kernel):
         self.frame_size = max(m, (fs // m) * m)
         self.out_frame = self.pipeline.out_items(self.frame_size)
         self.depth = frames_in_flight or self.inst.frames_in_flight
-        from ..ops.xfer import h2d_needs_staging
-        self._needs_staging = h2d_needs_staging(self.inst.platform)
+        # H2D staging read-ahead BEYOND the in-flight budget: at steady state
+        # the in-flight deque is full, so without extra headroom a frame would
+        # be staged and launched in the same work cycle — its wire time would
+        # serialize after the previous frame's compute instead of riding under
+        # it (depth=1 keeps 0: strictly serial semantics for A/B baselines)
+        self.stage_ahead = 1 if self.depth > 1 else 0
+        from ..ops.wire import resolve_wire
+        # wire codec for both link crossings (None → config/auto, ops/wire.py):
+        # decode/encode ride INSIDE the jitted program (compile_wired)
+        self.wire = resolve_wire(wire, self.inst.platform)
+        self._needs_staging = xfer.h2d_needs_staging(self.inst.platform)
         self._compiled = None
         self._carry = None
-        # (device result, valid_out, rebased tags)
+        # H2D started, compute not yet dispatched: (h2d_finish, valid_in, tags)
+        self._staged: Deque[Tuple[object, int, tuple]] = deque()
+        # compute dispatched, D2H riding: (d2h_finish, valid_out, rebased tags)
         self._inflight: Deque[Tuple[object, int, tuple]] = deque()
         self._pending_out: Optional[np.ndarray] = None
         self._pending_tags: List[ItemTag] = []
@@ -73,20 +86,27 @@ class TpuKernel(Kernel):
     def extra_metrics(self) -> dict:
         return {
             "frame_size": self.frame_size,
+            "wire": self.wire.name,
+            "frames_staged": len(self._staged),
             "frames_in_flight": len(self._inflight),
             "frames_dispatched": self._frames_dispatched,
         }
 
     async def init(self, mio, meta):
-        self._compiled, self._carry = self.pipeline.compile(
-            self.frame_size, device=self.inst.device)
-        # warm the compile cache off the hot path, then reset the carry state
-        warm_carry, y = self._compiled(self._carry,
-                                       self.inst.put(np.zeros(self.frame_size,
-                                                              dtype=self.pipeline.in_dtype)))
-        y.block_until_ready()
+        import jax
+        self._compiled, self._carry = self.pipeline.compile_wired(
+            self.frame_size, self.wire, device=self.inst.device)
+        # warm the compile cache off the hot path (raw device_put: the fake
+        # link must not bill warmup bytes), then reset the carry state
+        parts = self.wire.encode_host(
+            np.zeros(self.frame_size, dtype=self.pipeline.in_dtype))
+        dev = tuple(jax.device_put(np.asarray(p), self.inst.device)
+                    for p in parts)
+        warm_carry, y = self._compiled(self._carry, *dev)
+        jax.block_until_ready(y)
         del warm_carry  # donated buffers; fresh carry below
-        _, self._carry = self.pipeline.compile(self.frame_size, device=self.inst.device)
+        _, self._carry = self.pipeline.compile_wired(
+            self.frame_size, self.wire, device=self.inst.device)
 
     @message_handler(name="ctrl")
     async def ctrl_handler(self, io, mio, meta, p: Pmt) -> Pmt:
@@ -112,26 +132,41 @@ class TpuKernel(Kernel):
         return Pmt.ok()
 
     # -- helpers ---------------------------------------------------------------
-    def _dispatch(self, frame: np.ndarray, valid_in: int,
-                  tags: Sequence[ItemTag] = ()) -> None:
-        """Enqueue one frame; ``valid_in`` (a frame_multiple multiple) bounds how much of
-        the output is real data vs zero-pad tail. ``tags`` are frame-relative and are
-        rebased by the rate contract here, at dispatch time."""
-        x = self.inst.put(frame)
-        self._carry, y = self._compiled(self._carry, x)
-        # start the D2H immediately: copy_to_host_async enqueues behind the
-        # compute, so the transfer rides the wire the moment the frame finishes
-        # instead of waiting for _drain_one's sync (read-ahead, VERDICT r2 weak 2)
-        finish = self.inst.get_async(y)
-        valid_out = min(self.pipeline.out_items(valid_in), self.out_frame)
-        self._inflight.append((finish, valid_out,
-                               tuple(rebase_frame_tags(tags, self.pipeline,
-                                                       valid_out))))
-        self._frames_dispatched += 1
+    def _stage(self, frame: np.ndarray, valid_in: int,
+               tags: Sequence[ItemTag] = ()) -> None:
+        """Encode one frame into wire parts and START its H2D; compute dispatch
+        waits for :meth:`_launch_staged`. ``valid_in`` (a frame_multiple
+        multiple) bounds how much of the output is real data vs zero-pad tail;
+        ``tags`` are frame-relative."""
+        parts = self.wire.encode_host(frame)
+        self._staged.append((xfer.start_device_transfer_parts(
+            parts, self.inst.device), valid_in, tags))
+
+    def _launch_staged(self) -> None:
+        """Dispatch compute for staged frames, oldest first, and start each
+        result's D2H immediately. Waiting happens only on the OLDEST frame's
+        remaining H2D wire time — younger frames keep transferring, dispatched
+        frames keep computing, finished frames' D2H keeps draining: the
+        H2D(t+1) ∥ compute(t) ∥ D2H(t−1) overlap of the reference's circulating
+        h2d/d2h staging pairs, on XLA's async dispatch queue."""
+        while self._staged and len(self._inflight) < self.depth:
+            h2d, valid_in, tags = self._staged.popleft()
+            x_parts = h2d()
+            self._carry, y_parts = self._compiled(self._carry, *x_parts)
+            # start the D2H immediately: the transfer rides the wire the moment
+            # the frame finishes instead of waiting for _drain_one's sync
+            # (read-ahead, VERDICT r2 weak 2)
+            finish = xfer.start_host_transfer_parts(y_parts)
+            valid_out = min(self.pipeline.out_items(valid_in), self.out_frame)
+            self._inflight.append((finish, valid_out,
+                                   tuple(rebase_frame_tags(tags, self.pipeline,
+                                                           valid_out))))
+            self._frames_dispatched += 1
 
     def _drain_one(self) -> Tuple[np.ndarray, tuple]:
         finish, valid, tags = self._inflight.popleft()
-        arr = finish()            # sync point: blocks only this block's thread
+        # sync point: blocks only this block's thread
+        arr = self.wire.decode_host(finish(), self.pipeline.out_dtype)
         return arr[:valid], tags
 
     async def work(self, io, mio, meta):
@@ -143,25 +178,31 @@ class TpuKernel(Kernel):
                 return  # downstream full; its consume() will wake us
 
         inp = self.input.slice()
-        # 2. enqueue as many full frames as the pipeline depth allows.
+        # 2. stage as many full frames as the pipeline depth allows: each one's
+        #    H2D starts NOW, so while the oldest frame's compute is dispatched
+        #    below, the younger frames' payloads are already on the wire.
         #    The copy is the H2D staging write (reference `vulkan/h2d.rs:29-37`): device_put
         #    is async, so handing it a live ring-buffer view would race with the writer
         #    overwriting consumed space — the frame must leave the ring before consume().
-        while len(self._inflight) < self.depth and len(inp) >= self.frame_size:
+        budget = self.depth + self.stage_ahead
+        while len(self._staged) + len(self._inflight) < budget and \
+                len(inp) >= self.frame_size:
             tags = self.input.tags(self.frame_size)
             frame = inp[:self.frame_size]
-            if self._needs_staging:
+            if self._needs_staging and self.wire.encode_may_alias(frame.dtype):
                 # the frame must leave the ring before consume(): async H2D on
                 # accelerators, and the CPU client zero-copy BORROWS aligned
-                # views (ops/xfer.h2d_needs_staging — always True)
+                # views (ops/xfer.h2d_needs_staging — always True). Quantizing
+                # wires already materialize fresh arrays in encode_host, so
+                # only aliasing encodes (f32 pairs view) pay the copy.
                 frame = frame.copy()
-            self._dispatch(frame, self.frame_size, tags)
+            self._stage(frame, self.frame_size, tags)
             self.input.consume(self.frame_size)
             inp = self.input.slice()
 
         eos = self.input.finished()
         if eos and len(inp) > 0 and len(inp) < self.frame_size and \
-                len(self._inflight) < self.depth:
+                len(self._staged) + len(self._inflight) < budget:
             # final partial frame: zero-pad, emit only the valid prefix
             frame = np.zeros(self.frame_size, dtype=self.pipeline.in_dtype)
             frame[:len(inp)] = inp
@@ -169,11 +210,15 @@ class TpuKernel(Kernel):
             tags = self.input.tags(n)
             # items beyond the last frame_multiple boundary cannot produce integral
             # output and are dropped at EOS (streaming frame contract)
-            self._dispatch(frame, n - (n % self.pipeline.frame_multiple), tags)
+            self._stage(frame, n - (n % self.pipeline.frame_multiple), tags)
             self.input.consume(n)
             inp = self.input.slice()
 
-        # 3. retrieve: when the pipe is full, when the input is starved (no full frame
+        # 3. launch compute on staged frames (their transfers have been riding
+        #    since step 2) and start each result's D2H
+        self._launch_staged()
+
+        # 4. retrieve: when the pipe is full, when the input is starved (no full frame
         #    waiting — flush for latency; when saturated the depth gate keeps overlap),
         #    or on EOS drain
         should_drain = bool(self._inflight) and (
@@ -185,8 +230,8 @@ class TpuKernel(Kernel):
             io.call_again = True
             return
 
-        if eos and not self._inflight and self._pending_out is None and \
-                len(inp) < self.frame_size and len(inp) == 0:
+        if eos and not self._inflight and not self._staged and \
+                self._pending_out is None and len(inp) == 0:
             io.finished = True
-        elif eos and self._inflight:
+        elif eos and (self._inflight or self._staged):
             io.call_again = True

