@@ -370,7 +370,16 @@ def main():
                    help="internal child mode: one streamed measurement")
     p.add_argument("--wire", default="f32",
                    help="wire format for --run-streamed (ops/wire.py)")
+    p.add_argument("--trace", default=None, metavar="OUT_JSON",
+                   help="record telemetry spans (telemetry/spans.py) and write "
+                        "a Chrome-trace JSON artifact; covers the in-process "
+                        "measurements (guarded subprocess children record "
+                        "their own rings and are not merged)")
     args = p.parse_args()
+
+    if args.trace:
+        from futuresdr_tpu.telemetry import spans as _spans
+        _spans.enable(True)
 
     if args.run_chain:
         _run_chain_child(args.run_chain)
@@ -433,6 +442,32 @@ def main():
             extras["bf16_error"] = err
     else:
         dev_rate, best_frame, dev_sweep = run_device_resident(frames)
+
+    # min/median/max triplet for the HEADLINE metric (VERDICT item 3: the
+    # max/min ≤ 1.3 stability bar must be auditable from the artifact alone —
+    # every other *_msps already stamps its runs): re-measure the winning
+    # frame twice more and report the median as `value`
+    dev_runs = [dev_rate] if dev_rate else []
+    for _ in range(2 if dev_runs else 0):
+        if guarded:
+            r, err, _out = _sub_rate(["--run-dev", str(best_frame)],
+                                     "DEV_RATE", 600)
+            if r is None:
+                extras.setdefault("value_runs_errors", []).append(err)
+                continue
+        else:
+            r, _f, sweep = run_device_resident((best_frame,))
+            if not sweep:
+                continue
+        dev_runs.append(r)
+    dev_runs.sort()
+    if dev_runs:
+        # lower-middle, same policy (and same caveat) as the streamed median
+        # below: when a degraded run drops out of an even-length list, report
+        # the conservative middle, never the max
+        dev_rate = dev_runs[(len(dev_runs) - 1) // 2]
+        print(f"# device-resident @{best_frame}: lower-median {dev_rate:.1f} "
+              f"Msps, runs {['%.1f' % r for r in dev_runs]}", file=sys.stderr)
 
     # streamed: pick the streamed path's OWN frame. The device-resident winner
     # optimizes a different regime (scan-amortized HBM residency); measuring the
@@ -599,6 +634,7 @@ def main():
     result = {
         "metric": f"fir64+fft{FFT_SIZE}+mag2 fused chain, device-resident ({inst_.platform})",
         "value": round(dev_rate, 1),
+        "value_runs": [round(r, 1) for r in dev_runs],
         "unit": "Msamples/s",
         "vs_baseline": round(dev_rate / cpu_rate, 2),
         "backend": inst_.platform,
@@ -619,6 +655,10 @@ def main():
     if not args.skip_extra_chains:
         # on-chip evidence for BASELINE #3/#4/#5 rides the same driver artifact
         result.update(run_baseline_chains())
+    if args.trace:
+        from futuresdr_tpu.telemetry import spans as _spans
+        _spans.export(args.trace)
+        print(f"# trace artifact written to {args.trace}", file=sys.stderr)
     print(json.dumps(result))
 
 

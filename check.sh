@@ -8,6 +8,10 @@ cd "$(dirname "$0")"
 echo "== native =="
 make -C native test
 
+echo "== telemetry overhead gate (docs/observability.md budget) =="
+JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_telemetry.py::test_telemetry_disabled_overhead_null_rand
+
 echo "== python suite =="
 python -m pytest tests/ -q
 

@@ -23,10 +23,11 @@ __all__ = [
     "message_handler", "AsyncScheduler", "ThreadedScheduler", "TpbScheduler", "FlowgraphError",
     "ConnectError",
     "blocks", "dsp", "ops", "tpu", "parallel", "models", "utils", "hw", "ctrl", "apps",
+    "telemetry",
 ]
 
 _LAZY_SUBMODULES = {"blocks", "dsp", "ops", "tpu", "parallel", "models", "utils",
-                    "hw", "ctrl", "apps"}
+                    "hw", "ctrl", "apps", "telemetry"}
 
 
 def __getattr__(name):

@@ -36,6 +36,10 @@ class Config:
     ctrlport_enable: bool = False
     ctrlport_bind: str = "127.0.0.1:1337"
     frontend_path: Optional[str] = None
+    # Telemetry (telemetry/spans.py): span recording off by default — the
+    # metrics registry (telemetry/prom.py) is always on, spans are opt-in.
+    trace: bool = False                    # FUTURESDR_TPU_TRACE=1 records spans
+    trace_ring: int = 1 << 16              # per-thread span ring capacity
     # TPU-specific knobs (no reference analog; this is the compute-plane config).
     tpu_frame_size: int = 1 << 18          # samples per device frame
     tpu_frames_in_flight: int = 4          # dispatch pipeline depth

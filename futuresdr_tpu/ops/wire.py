@@ -373,8 +373,35 @@ def resolve_wire(w, platform: str) -> Wire:
         from ..config import config
         w = config().tpu_wire_format
     if isinstance(w, str) and w == "auto":
-        return WIRE_FORMATS["f32" if platform == "cpu" else "sc16"]
-    return get_wire(w)
+        w = "f32" if platform == "cpu" else "sc16"
+    wire = get_wire(w)
+    _note_wire_gauges(wire)
+    return wire
+
+
+_noted_wires: set = set()
+
+
+def _note_wire_gauges(wire: Wire) -> None:
+    """Stamp the telemetry gauges for a wire format the first time a block
+    resolves it: measured codec SNR (one host round trip, ~ms) and the
+    per-sample byte widths — so ``GET /metrics`` carries the rate/fidelity
+    tradeoff of every codec actually in use."""
+    if wire.name in _noted_wires:
+        return
+    _noted_wires.add(wire.name)
+    try:
+        from ..telemetry import prom
+        prom.gauge("fsdr_wire_snr_db",
+                   "measured codec SNR of one link crossing (c64 payload)",
+                   ("wire",)).set(measure_snr_db(wire), wire=wire.name)
+        prom.gauge("fsdr_wire_bytes_per_sample",
+                   "wire bytes per complex64 sample",
+                   ("wire",)).set(wire.bytes_per_sample(np.complex64),
+                                  wire=wire.name)
+    except Exception:                    # pragma: no cover — never block a
+        _noted_wires.discard(wire.name)  # kernel build on telemetry
+
 
 
 def measure_snr_db(wire, dtype=np.complex64, n: int = 8192,

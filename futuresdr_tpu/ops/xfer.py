@@ -27,9 +27,41 @@ from typing import Optional
 
 import numpy as np
 
+from ..telemetry import prom as _prom
+from ..telemetry.spans import recorder as _trace_recorder
+
 __all__ = ["to_device", "to_host", "start_host_transfer", "start_device_transfer",
            "start_device_transfer_parts", "start_host_transfer_parts",
            "split_complex_platform", "set_fake_link", "fake_link"]
+
+_trace = _trace_recorder()
+# link-plane metrics (always on; updates are per-frame, not per-sample)
+_XFER_BYTES = _prom.counter(
+    "fsdr_xfer_bytes_total", "bytes started on the host-device link",
+    ("direction",))
+_XFER_TRANSFERS = _prom.counter(
+    "fsdr_xfer_transfers_total", "transfers started on the host-device link",
+    ("direction",))
+
+
+def _span_bounds_ns(t0_ns: int, service: float, deadline: float) -> tuple:
+    """``(start_ns, end_ns)`` of a transfer span, clamped to the fake link's
+    modeled wire occupancy when one exists: the span STARTS when the wire
+    begins servicing these bytes (not when they were queued behind an earlier
+    frame — same-lane queue wait double-counted into span sums would inflate
+    the overlap ratio) and ENDS at the landing deadline (a finish() called
+    late must not inflate the lane's busy interval either)."""
+    end = time.perf_counter_ns()
+    if deadline:
+        dl = int(deadline * 1e9)       # perf_counter and perf_counter_ns share
+        if t0_ns < dl < end:           # one epoch (time module contract)
+            end = dl
+    start = t0_ns
+    if service:
+        sv = int(service * 1e9)
+        if t0_ns < sv:
+            start = min(sv, end)
+    return start, end
 
 _join_jit = None
 _split_jit = None
@@ -61,14 +93,17 @@ class _FakeLink:
         self._lock = threading.Lock()
         self._busy = {"h2d": 0.0, "d2h": 0.0}
 
-    def reserve(self, direction: str, nbytes: int) -> float:
+    def reserve(self, direction: str, nbytes: int) -> tuple:
+        """Returns ``(service_start, deadline)``: the wire begins moving these
+        bytes at ``service_start`` (after any queued predecessor) and lands
+        them at ``deadline`` — both wall-clock ``perf_counter`` values."""
         rate = self.h2d_bps if direction == "h2d" else self.d2h_bps
         if not rate:
-            return 0.0
+            return (0.0, 0.0)
         with self._lock:
             start = max(time.perf_counter(), self._busy[direction])
             self._busy[direction] = start + nbytes / rate
-            return self._busy[direction]
+            return (start, self._busy[direction])
 
 
 _fake_link: Optional[_FakeLink] = None
@@ -90,8 +125,9 @@ def fake_link() -> Optional[_FakeLink]:
     return _fake_link
 
 
-def _reserve(direction: str, nbytes: int) -> float:
-    return _fake_link.reserve(direction, nbytes) if _fake_link else 0.0
+def _reserve(direction: str, nbytes: int) -> tuple:
+    """``(service_start, deadline)`` of the modeled wire; zeros without a link."""
+    return _fake_link.reserve(direction, nbytes) if _fake_link else (0.0, 0.0)
 
 
 def _wait_deadline(deadline: float) -> None:
@@ -187,11 +223,18 @@ def start_device_transfer_parts(parts, device=None):
     import jax
 
     host = [np.asarray(p) for p in parts]
-    deadline = _reserve("h2d", sum(p.nbytes for p in host))
+    nbytes = sum(p.nbytes for p in host)
+    _XFER_BYTES.inc(nbytes, direction="h2d")
+    _XFER_TRANSFERS.inc(direction="h2d")
+    service, deadline = _reserve("h2d", nbytes)
+    t0 = time.perf_counter_ns() if _trace.enabled else 0
     devs = tuple(jax.device_put(p, device) for p in host)
 
     def finish():
         _wait_deadline(deadline)
+        if t0:
+            s, e = _span_bounds_ns(t0, service, deadline)
+            _trace.complete("tpu", "H2D", s, end_ns=e, args={"bytes": nbytes})
         return devs
 
     return finish
@@ -240,9 +283,12 @@ def to_host(arr) -> np.ndarray:
     return start_host_transfer(arr)()
 
 
-def start_host_transfer(arr):
+def start_host_transfer(arr, _instrument: bool = True):
     """Begin a NON-blocking D2H of ``arr``; returns a zero-arg ``finish()`` that
     blocks until the copy lands and yields the numpy array.
+    ``_instrument=False`` (module-private) suppresses the per-call telemetry so
+    :func:`start_host_transfer_parts` can bill one frame's parts as ONE
+    transfer — symmetric with the H2D side, which reserves per frame.
 
     This is how a drain loop overlaps transfers: start transfers for every
     completed frame first, then finish them oldest-first — frame t+1's D2H rides
@@ -266,7 +312,13 @@ def start_host_transfer(arr):
         if split_complex_platform(platform):
             _, split = _jits()
             r, i = split(arr)                    # async device-side split
-            deadline = _reserve("d2h", r.nbytes + i.nbytes)
+            nbytes = r.nbytes + i.nbytes
+            if _instrument:
+                _XFER_BYTES.inc(nbytes, direction="d2h")
+                _XFER_TRANSFERS.inc(direction="d2h")
+            service, deadline = _reserve("d2h", nbytes)
+            t0 = time.perf_counter_ns() if (_instrument and _trace.enabled) \
+                else 0
             # both halves start NOW (async copy, or eager pool fetch when the
             # array type has no copy_to_host_async) — never serially in finish
             fr, fi = _start_fetch(r), _start_fetch(i)
@@ -276,17 +328,31 @@ def start_host_transfer(arr):
                 out.real = fr()
                 out.imag = fi()
                 _wait_deadline(deadline)
+                if t0:
+                    s, e = _span_bounds_ns(t0, service, deadline)
+                    _trace.complete("tpu", "D2H", s, end_ns=e,
+                                    args={"bytes": nbytes})
                 return out
 
+            finish._wire = (service, deadline)
             return finish
-    deadline = _reserve("d2h", getattr(arr, "nbytes", 0))
+    nbytes = int(getattr(arr, "nbytes", 0))
+    if _instrument:
+        _XFER_BYTES.inc(nbytes, direction="d2h")
+        _XFER_TRANSFERS.inc(direction="d2h")
+    service, deadline = _reserve("d2h", nbytes)
+    t0 = time.perf_counter_ns() if (_instrument and _trace.enabled) else 0
     fetch = _start_fetch(arr)
 
     def finish():
         out = fetch()
         _wait_deadline(deadline)
+        if t0:
+            s, e = _span_bounds_ns(t0, service, deadline)
+            _trace.complete("tpu", "D2H", s, end_ns=e, args={"bytes": nbytes})
         return out
 
+    finish._wire = (service, deadline)
     return finish
 
 
@@ -294,6 +360,26 @@ def start_host_transfer_parts(parts):
     """Begin a NON-blocking D2H of a tuple of wire parts (a jitted epilog's
     output, ``ops/wire.py``); returns ``finish() -> tuple of np arrays``.
     Every part's transfer starts immediately, so in-flight frames' payloads
-    ride the wire together (per-direction fake-link accounting included)."""
-    fins = [start_host_transfer(p) for p in parts]
-    return lambda: tuple(f() for f in fins)
+    ride the wire together (per-direction fake-link accounting included).
+
+    Telemetry bills the WHOLE frame as one D2H transfer/span (symmetric with
+    :func:`start_device_transfer_parts`): per-part billing would make the
+    d2h counters and lane span counts scale with the wire's part count
+    instead of the frame count."""
+    fins = [start_host_transfer(p, _instrument=False) for p in parts]
+    nbytes = sum(int(getattr(p, "nbytes", 0)) for p in parts)
+    _XFER_BYTES.inc(nbytes, direction="d2h")
+    _XFER_TRANSFERS.inc(direction="d2h")
+    t0 = time.perf_counter_ns() if _trace.enabled else 0
+
+    def finish():
+        out = tuple(f() for f in fins)
+        if t0:
+            wires = [getattr(f, "_wire", (0.0, 0.0)) for f in fins]
+            service = min((s for s, _ in wires if s), default=0.0)
+            deadline = max((d for _, d in wires), default=0.0)
+            s, e = _span_bounds_ns(t0, service, deadline)
+            _trace.complete("tpu", "D2H", s, end_ns=e, args={"bytes": nbytes})
+        return out
+
+    return finish

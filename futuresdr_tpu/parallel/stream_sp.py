@@ -111,6 +111,16 @@ def _make_stream(local: Callable, nt: int, mesh: Mesh, axis: str):
             raise ValueError(                # shard_map broadcast failure
                 f"per-shard length {x.shape[0] // n_dev} < halo {nt - 1}: "
                 f"grow the frame or reduce taps/devices")
+        # fn is jitted by its consumers (SpKernel), so this body only runs at
+        # TRACE time — mark each (re)trace in the span stream: silent retraces
+        # (shape drift, carry dtype churn) are the classic sharded-pipeline
+        # stall and otherwise invisible from the host
+        from ..telemetry.spans import recorder
+        rec = recorder()
+        if rec.enabled and isinstance(x, jax.core.Tracer):
+            rec.instant("jit", "sp_trace",
+                        args={"frame": int(x.shape[0]),
+                              "devices": int(n_dev), "halo": int(nt - 1)})
         y = inner(x, carry)
         # new carry: global frame tail (x[-0:] would be the WHOLE frame at nt=1)
         return x[x.shape[0] - (nt - 1):], y

@@ -13,11 +13,14 @@ import time
 from typing import Optional
 
 from ..log import logger
+from ..telemetry.spans import recorder as _trace_recorder
 from ..types import Pmt
 from .inbox import (BlockInbox, Call, Callback, Initialize, StreamInputDone,
                     StreamOutputDone, Terminate)
 from .kernel import Kernel
 from .work_io import WorkIo
+
+_trace = _trace_recorder()
 
 __all__ = ["WrappedKernel"]
 
@@ -66,9 +69,38 @@ class WrappedKernel:
                          for p in k.stream_inputs},
             "items_out": {p.name: getattr(p, "items_produced", 0)
                           for p in k.stream_outputs},
+            # buffer plane (telemetry): input ring occupancy sampled at scrape
+            # time, plus park classifications counted by the event loop below
+            # (inplace frame-plane ports duck-type only part of the stream
+            # surface — getattr-guard everything)
+            "buffer_fill": {p.name: round(f, 4) for p in k.stream_inputs
+                            if (f := getattr(p, "fill", lambda: None)())
+                            is not None},
+            "stalls": {p.name: getattr(p, "stalls", 0)
+                       for p in k.stream_outputs},
+            "starved": {p.name: getattr(p, "starved", 0)
+                        for p in k.stream_inputs},
         }
         m.update(extra_out)
         return m
+
+    def _note_park(self) -> tuple:
+        """Classify a park (backpressure vs starvation) into the port counters;
+        returns the (stalled, starved) port-name lists for the park span."""
+        k = self.kernel
+        stalled, starved = [], []
+        for p in k.stream_outputs:
+            space = getattr(p, "space", None)   # inplace ports have no ring
+            if space is not None and p.connected and space() < p.min_items:
+                p.stalls += 1
+                stalled.append(p.name)
+        for p in k.stream_inputs:
+            avail = getattr(p, "available", None)
+            if avail is not None and p.connected and not p.finished() \
+                    and avail() < p.min_items:
+                p.starved += 1
+                starved.append(p.name)
+        return stalled, starved
 
     @property
     def id(self) -> int:
@@ -184,14 +216,26 @@ class WrappedKernel:
                         if inbox_t not in done:
                             inbox_t.cancel()
                     else:
+                        # park: classify into backpressure/starvation counters
+                        # (parks are off the hot path — the loop only lands
+                        # here when there is NO work to run)
+                        stalled, starved = self._note_park()
+                        t_park = time.perf_counter_ns()
                         await self.inbox.wait()
+                        if _trace.enabled:
+                            _trace.complete(
+                                "park", self.instance_name, t_park,
+                                args={"stalled": stalled, "starved": starved})
                     continue
 
                 io.reset()
-                t0 = time.perf_counter()
+                t0 = time.perf_counter_ns()
                 await kernel.work(io, kernel.mio, meta)
-                self.work_time_s += time.perf_counter() - t0
+                end = time.perf_counter_ns()
+                self.work_time_s += (end - t0) * 1e-9
                 self.work_calls += 1
+                if _trace.enabled:
+                    _trace.complete("block", self.instance_name, t0, end_ns=end)
         except Exception as e:
             log.error("block %s failed in work: %r", self.instance_name, e)
             error = e

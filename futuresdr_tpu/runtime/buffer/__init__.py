@@ -125,6 +125,8 @@ class StreamOutput:
         self.writer: Optional[BufferWriter] = None
         self._pending_tags: List[ItemTag] = []
         self.items_produced = 0       # observability counter (SURVEY §5 metrics)
+        self.stalls = 0               # parks while this output ring was full
+        #                               (counted by the block event loop)
 
     # -- work()-time API -------------------------------------------------------
     def slice(self) -> np.ndarray:
@@ -165,6 +167,7 @@ class StreamInput:
         self.reader: Optional[BufferReader] = None
         self._finished = False        # StreamInputDone received (upstream writer done)
         self.items_consumed = 0       # observability counter (SURVEY §5 metrics)
+        self.starved = 0              # parks while this input was below min_items
 
     # -- work()-time API -------------------------------------------------------
     def slice(self) -> np.ndarray:
@@ -180,6 +183,16 @@ class StreamInput:
     def consume(self, n: int) -> None:
         self.items_consumed += n
         self.reader.consume(n)
+
+    def fill(self) -> Optional[float]:
+        """Ring occupancy in [0, 1] (None when the backend hides its capacity) —
+        the buffer-occupancy gauge sampled by ``WrappedKernel.metrics``."""
+        if self.reader is None:
+            return None
+        cap = self.reader.capacity_items()
+        if not cap:
+            return None
+        return min(1.0, self.reader.items_available() / cap)
 
     def finished(self) -> bool:
         """Upstream signalled EOS; buffered data may remain (`apply.rs:122-124` pattern)."""

@@ -59,6 +59,8 @@ class InplaceOutput:
         self.name = name
         self.dtype = np.dtype(dtype) if dtype is not None else None
         self.min_items = 1
+        self.stalls = 0             # telemetry parity with StreamOutput (the
+        #                             park classifier skips queue ports)
         self._peer: Optional["InplaceInput"] = None
         self._finished = False
 
@@ -96,6 +98,7 @@ class InplaceInput:
         self.name = name
         self.dtype = np.dtype(dtype) if dtype is not None else None
         self.min_items = 1
+        self.starved = 0            # telemetry parity with StreamInput
         self._q: Deque[Tuple[np.ndarray, int, tuple]] = deque()
         self._lock = threading.Lock()
         self._inbox: Optional[BlockInbox] = None

@@ -9,8 +9,16 @@ aiohttp server on its own thread + event loop, exposing the same four endpoint f
   GET  /api/fg/{fg}/block/{blk}/call/{handler}/   → call with Pmt::Null
   POST /api/fg/{fg}/block/{blk}/call/{handler}/   → call with JSON-Pmt body
 
+plus the telemetry plane (docs/observability.md):
+
+  GET  /metrics                → Prometheus text exposition: registry counters
+                                 + per-block families for every live flowgraph
+  GET  /api/fg/{fg}/trace/     → drain the span ring as Chrome trace-event JSON
+                                 (open in Perfetto / chrome://tracing)
+
 Pmt values are serialized with the same externally-tagged JSON as the reference's serde.
-CORS is permissive; graceful shutdown on ``stop()``.
+CORS is permissive (including on error responses raised as ``web.HTTPException``);
+graceful shutdown on ``stop()``.
 """
 
 from __future__ import annotations
@@ -81,14 +89,24 @@ class ControlPort:
 
         @web.middleware
         async def cors(request, handler):
-            resp = await handler(request)
+            try:
+                resp = await handler(request)
+            except web.HTTPException as e:
+                # a handler (extra_routes especially) may RAISE its error
+                # response; aiohttp serves the exception object directly, so
+                # it must carry the CORS header too or browser clients see an
+                # opaque failure instead of the 4xx/5xx body
+                e.headers["Access-Control-Allow-Origin"] = "*"
+                raise
             resp.headers["Access-Control-Allow-Origin"] = "*"
             return resp
 
         app.middlewares.append(cors)
+        app.router.add_get("/metrics", self._prometheus)
         app.router.add_get("/api/fg/", self._list_fgs)
         app.router.add_get("/api/fg/{fg}/", self._describe_fg)
         app.router.add_get("/api/fg/{fg}/metrics/", self._metrics)
+        app.router.add_get("/api/fg/{fg}/trace/", self._trace)
         app.router.add_get("/api/fg/{fg}/block/{blk}/", self._describe_block)
         app.router.add_get("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
         app.router.add_post("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
@@ -135,6 +153,42 @@ class ControlPort:
         if fg is None:
             return web.json_response({"error": "flowgraph not found"}, status=404)
         return web.json_response(await fg.metrics())
+
+    async def _prometheus(self, request):
+        """Prometheus text exposition: global registry + per-block families of
+        every live flowgraph (``WrappedKernel.metrics()`` stays the single
+        source; ``telemetry/prom.py`` only renders the dicts)."""
+        from aiohttp import web
+
+        from ..telemetry import prom
+        fg_metrics = {}
+        for fg_id in self.handle.flowgraph_ids():
+            fg = self.handle.get_flowgraph(fg_id)
+            if fg is None:
+                continue
+            try:
+                fg_metrics[fg_id] = await fg.metrics()
+            except Exception as e:               # noqa: BLE001 — scrape must
+                log.warning("metrics scrape of fg %d failed: %r", fg_id, e)
+        return web.Response(body=prom.render_all(fg_metrics).encode(),
+                            headers={"Content-Type": prom.CONTENT_TYPE})
+
+    async def _trace(self, request):
+        """Drain the span ring as Chrome trace-event JSON (Perfetto-loadable).
+        404 for unknown flowgraphs to match the /api/fg/ family; the ring is
+        process-global, so any live fg id drains the same recorder. The drain
+        is a DESTRUCTIVE read — a poller that must not steal events from
+        another trace consumer (e.g. ``bench.py --trace``) passes ``?keep=1``
+        for a non-draining snapshot instead."""
+        from aiohttp import web
+
+        from ..telemetry import spans
+        fg = self._fg(request)
+        if fg is None:
+            return web.json_response({"error": "flowgraph not found"}, status=404)
+        rec = spans.recorder()
+        events = rec.snapshot() if request.query.get("keep") else rec.drain()
+        return web.json_response(rec.chrome_trace(events))
 
     async def _describe_block(self, request):
         from aiohttp import web

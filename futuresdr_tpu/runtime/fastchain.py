@@ -65,12 +65,14 @@ import os
 from typing import List, Optional, Sequence
 
 from ..log import logger
+from ..telemetry.spans import recorder as _trace_recorder
 from .inbox import Callback, Initialize, Terminate
 
 __all__ = ["find_native_chains", "run_chain_task", "fastchain_available",
            "shed_metrics_bridge"]
 
 log = logger("runtime.fastchain")
+_trace = _trace_recorder()
 
 # stage kinds — keep in sync with native/fastchain.cpp
 (FC_NULL_SOURCE, FC_HEAD, FC_COPY, FC_COPY_RAND, FC_NULL_SINK,
@@ -769,6 +771,7 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
 
     try:
         inr_arr = (ctypes.c_int32 * n)(*inr)
+        t_chain = _trace.now()
         rc = await scheduler.spawn_blocking(
             lambda: lib.fsdr_fastchain_run_v3(stages, n, inr_arr, ring_items,
                                               ctypes.byref(stop), per_in,
@@ -781,6 +784,17 @@ async def run_chain_task(members: Sequence, fg_inbox, scheduler,
             fg_inbox.send(BlockDoneMsg(b.id, b))
         return
     _cancel_watchers()
+    # one span for the whole native run; per-member chunk/busy counters ride in
+    # args (the same numbers the extra_metrics bridge above serves live), so a
+    # trace shows WHERE a fused chain's time went without per-chunk callbacks
+    # crossing the C++ boundary
+    _trace.complete(
+        "fastchain", f"chain[{members[0].instance_name}…x{n}]", t_chain,
+        args={"members": n,
+              "chunks": {b.instance_name: int(per_calls[i])
+                         for i, b in enumerate(members)},
+              "busy_ns": {b.instance_name: int(per_ns[i])
+                          for i, b in enumerate(members)}})
     if rc < 0:
         e = RuntimeError(f"fastchain returned {rc} (malformed chain)")
         fg_inbox.send(BlockErrorMsg(members[0].id, e))

@@ -16,6 +16,7 @@ from typing import Any, Awaitable, Dict, List, Optional, Union
 
 from ..config import config
 from ..log import logger
+from ..telemetry.spans import recorder as _trace_recorder
 from ..types import FlowgraphDescription, Pmt
 from .block import WrappedKernel
 from .flowgraph import Flowgraph
@@ -34,6 +35,7 @@ __all__ = [
 ]
 
 log = logger("runtime")
+_trace = _trace_recorder()
 
 
 # ---- FlowgraphMessage equivalents (`src/runtime/mod.rs` FlowgraphMessage) ----
@@ -99,6 +101,7 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
     """The per-flowgraph supervisor (`runtime.rs:363-597`)."""
     from .fastchain import (find_native_chains, run_chain_task,
                             shed_metrics_bridge)
+    t_sup = _trace.now()
     chain_kernels = find_native_chains(fg)
     blocks = fg.take_blocks()
     by_id: Dict[int, WrappedKernel] = {b.id: b for b in blocks}
@@ -123,6 +126,7 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
             run_chain_task(members, fg_inbox, scheduler, in_ring=inr)))
 
     # ---- init barrier (`runtime.rs:380-415`) --------------------------------
+    t_barrier = _trace.now()
     for b in blocks:
         b.inbox.send(Initialize())
     waiting = len(blocks)
@@ -151,6 +155,9 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
             b.inbox.send(Terminate())
         terminated = True
 
+    _trace.complete("runtime", "init_barrier", t_barrier,
+                    args={"blocks": len(blocks), "errors": len(errors)})
+
     # ---- start signal (`runtime.rs:418-429`) --------------------------------
     for b in blocks:
         b.inbox.notify()
@@ -175,6 +182,8 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
             msg.reply.set({b.instance_name: b.metrics() for b in blocks})
         elif isinstance(msg, TerminateMsg):
             if not terminated:
+                _trace.instant("runtime", "terminate_cascade",
+                               args={"reason": "requested"})
                 for b in blocks:
                     b.inbox.send(Terminate())
                 terminated = True
@@ -187,6 +196,9 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
             if not terminated:
                 log.error("block %d errored (%r): terminating flowgraph",
                           msg.block_id, msg.error)
+                _trace.instant("runtime", "terminate_cascade",
+                               args={"reason": "block_error",
+                                     "block": msg.block_id})
                 for b in blocks:
                     b.inbox.send(Terminate())
                 terminated = True
@@ -213,7 +225,16 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
             msg.reply.set(Pmt.invalid_value())
         elif isinstance(msg, DescribeMsg):
             msg.reply.set(_describe(fg, blocks))
+        elif isinstance(msg, MetricsMsg):
+            # a metrics() racing flowgraph completion landed here after the
+            # main loop exited — answer with the FINAL per-block snapshot
+            # instead of silently dropping the reply (the caller would await
+            # forever; `FlowgraphHandle.metrics` only short-circuits to {}
+            # when the send itself fails)
+            msg.reply.set({b.instance_name: b.metrics() for b in blocks})
     fg.restore_blocks(finished)
+    _trace.complete("runtime", "flowgraph", t_sup,
+                    args={"blocks": len(blocks), "errors": len(errors)})
     if errors:
         raise FlowgraphError(str(errors[0])) from errors[0]
     return fg

@@ -1,0 +1,30 @@
+"""Telemetry: span tracing (Perfetto/Chrome trace export) + Prometheus metrics.
+
+Two independent planes (SURVEY §5 — block metrics were ad hoc in the reference;
+here they are first-class):
+
+* :mod:`.spans` — a lock-cheap, thread-aware ring-buffer span recorder. Gated by
+  config/env (``FUTURESDR_TPU_TRACE``, default off); when off the hot-path cost
+  is one attribute check. Drained as Chrome trace-event JSON loadable in
+  Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``.
+* :mod:`.prom` — a counters/gauges registry with Prometheus text exposition,
+  always on (counter bumps are frame-rate, not sample-rate). Per-block families
+  are NOT duplicated here: :meth:`WrappedKernel.metrics` stays the single
+  source, and the control port's ``GET /metrics`` renders those dicts into
+  Prometheus families beside the registry's own counters.
+
+See ``docs/observability.md`` for the span categories, metric names, endpoints
+and the overhead budget.
+"""
+
+from . import prom, spans
+from .prom import Counter, Gauge, Registry, counter, gauge, registry
+from .spans import (SpanEvent, SpanRecorder, chrome_trace, drain, enable,
+                    enabled, export, overlap_report, recorder, union_ns)
+
+__all__ = [
+    "spans", "prom",
+    "SpanRecorder", "SpanEvent", "recorder", "enable", "enabled", "drain",
+    "chrome_trace", "export", "overlap_report", "union_ns",
+    "Registry", "Counter", "Gauge", "registry", "counter", "gauge",
+]

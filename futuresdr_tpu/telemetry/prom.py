@@ -1,0 +1,267 @@
+"""Prometheus metrics: a minimal counters/gauges registry + text exposition.
+
+Always on (unlike span tracing): updates happen at frame/transfer rate, not
+sample rate, so a per-metric lock is cheap. Two sources feed the ``/metrics``
+endpoint (``runtime/ctrl_port.py``):
+
+* the **registry** here — process-global counters/gauges (link bytes, wire SNR,
+  span-ring drops, …) registered by any module via :func:`counter` /
+  :func:`gauge`;
+* **per-block families** rendered from :meth:`WrappedKernel.metrics` dicts by
+  :func:`render_block_metrics` — the existing metrics dict API stays the single
+  source of per-block truth (work counters, port items, buffer occupancy,
+  stall counts, kernel ``extra_metrics``), and this module only translates it
+  into exposition text at scrape time.
+
+Exposition follows the Prometheus text format v0.0.4 (``# HELP``/``# TYPE``
+headers, ``name{label="v"} value`` samples, ``+Inf``/``NaN`` literals).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Registry", "registry", "counter", "gauge",
+           "render_block_metrics", "render_all", "CONTENT_TYPE"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize_name(name: str) -> str:
+    name = _NAME_FIX.sub("_", name)
+    if not name or not _NAME_OK.match(name):
+        name = "_" + name
+    return name
+
+
+def _escape_label(v) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _fmt_value(v: float) -> str:
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _sample_line(name: str, labels: Dict[str, object], value: float) -> str:
+    if labels:
+        lab = ",".join(f'{_sanitize_name(str(k))}="{_escape_label(v)}"'
+                       for k, v in sorted(labels.items()))
+        return f"{name}{{{lab}}} {_fmt_value(value)}"
+    return f"{name} {_fmt_value(value)}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = _sanitize_name(name)
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._vals: Dict[Tuple, float] = {}
+
+    def _key(self, labels: Dict[str, object]) -> Tuple:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(f"{self.name}: expected labels {self.labelnames}, "
+                             f"got {tuple(labels)}")
+        return tuple(labels[k] for k in self.labelnames)
+
+    def get(self, **labels) -> float:
+        with self._lock:
+            return self._vals.get(self._key(labels), 0.0)
+
+    def samples(self) -> List[Tuple[Dict[str, object], float]]:
+        with self._lock:
+            items = list(self._vals.items())
+        return [(dict(zip(self.labelnames, k)), v) for k, v in items]
+
+    def render(self) -> List[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        samples = self.samples()
+        if not samples and not self.labelnames:
+            samples = [({}, 0.0)]      # unlabelled metrics expose their zero
+        for labels, v in samples:
+            lines.append(_sample_line(self.name, labels, v))
+        return lines
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        k = self._key(labels)
+        with self._lock:
+            self._vals[k] = self._vals.get(k, 0.0) + amount
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._vals[k] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._vals[k] = self._vals.get(k, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str]) -> _Metric:
+        name = _sanitize_name(name)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, labelnames)
+                self._metrics[name] = m
+            elif not isinstance(m, cls) or m.labelnames != tuple(labelnames):
+                raise ValueError(f"metric {name} re-registered with a "
+                                 f"different type or label set")
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_registry = Registry()
+
+
+def registry() -> Registry:
+    """The process-global registry."""
+    return _registry
+
+
+def counter(name: str, help: str = "",
+            labelnames: Sequence[str] = ()) -> Counter:
+    return _registry.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "", labelnames: Sequence[str] = ()) -> Gauge:
+    return _registry.gauge(name, help, labelnames)
+
+
+# ---------------------------------------------------------------------------
+# per-block families from WrappedKernel.metrics() dicts
+# ---------------------------------------------------------------------------
+
+# metrics() keys with fixed meanings → (family suffix, type, help, port label?)
+_BLOCK_SCALARS = {
+    "work_calls": ("work_calls_total", "counter", "work() invocations"),
+    "work_time_s": ("work_time_seconds_total", "counter",
+                    "cumulative seconds inside work()"),
+    "messages_handled": ("messages_handled_total", "counter",
+                         "message-port handler invocations"),
+}
+_BLOCK_PORT_MAPS = {
+    "items_in": ("items_in_total", "counter", "items consumed per input port"),
+    "items_out": ("items_out_total", "counter",
+                  "items produced per output port"),
+    "buffer_fill": ("buffer_fill_ratio", "gauge",
+                    "input ring occupancy (available/capacity)"),
+    "stalls": ("buffer_stalls_total", "counter",
+               "parks with a backpressured (full) output ring"),
+    "starved": ("buffer_starved_total", "counter",
+                "parks waiting on an input ring below min_items"),
+}
+
+
+def render_block_metrics(fg_metrics: Dict[int, Dict[str, dict]],
+                         prefix: str = "fsdr_block") -> str:
+    """Render ``{fg_id: {block_name: metrics_dict}}`` as Prometheus families.
+
+    Fixed keys map to typed families (above); any OTHER numeric scalar a
+    kernel's ``extra_metrics`` contributed becomes a ``<prefix>_extra`` gauge
+    with a ``key`` label, and string values become ``<prefix>_attr`` info
+    samples — so new kernel metrics surface without touching this table.
+    """
+    # family name → (type, help, [lines])
+    fams: Dict[str, Tuple[str, str, List[str]]] = {}
+
+    def add(family: str, kind: str, help: str, labels: dict, value) -> None:
+        fam = fams.setdefault(f"{prefix}_{family}", (kind, help, []))
+        fam[2].append(_sample_line(f"{prefix}_{family}", labels, value))
+
+    for fg_id, blocks in fg_metrics.items():
+        for bname, m in (blocks or {}).items():
+            if not isinstance(m, dict):
+                continue
+            base = {"fg": fg_id, "block": bname}
+            handled = set()
+            for key, (fam, kind, help) in _BLOCK_SCALARS.items():
+                if key in m:
+                    add(fam, kind, help, base, m[key])
+                    handled.add(key)
+            for key, (fam, kind, help) in _BLOCK_PORT_MAPS.items():
+                if isinstance(m.get(key), dict):
+                    for port, v in m[key].items():
+                        add(fam, kind, help, {**base, "port": port}, v)
+                    handled.add(key)
+            for key, v in m.items():
+                if key in handled:
+                    continue
+                if isinstance(v, bool):
+                    v = int(v)
+                if isinstance(v, (int, float)):
+                    add("extra", "gauge",
+                        "kernel extra_metrics numeric values",
+                        {**base, "key": key}, v)
+                elif isinstance(v, str):
+                    add("attr", "gauge", "kernel string attributes",
+                        {**base, "key": key, "value": v}, 1)
+    lines: List[str] = []
+    for fam in sorted(fams):
+        kind, help, samples = fams[fam]
+        lines.append(f"# HELP {fam} {help}")
+        lines.append(f"# TYPE {fam} {kind}")
+        lines.extend(samples)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_all(fg_metrics: Optional[Dict[int, Dict[str, dict]]] = None) -> str:
+    """Registry + per-block families in one exposition document."""
+    text = _registry.render()
+    if fg_metrics:
+        text += render_block_metrics(fg_metrics)
+    return text

@@ -35,6 +35,7 @@ from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
 from ..runtime.kernel import Kernel, message_handler
 from ..runtime.tag import ItemTag, rebase_tags
+from ..telemetry.spans import recorder as _trace_recorder
 from ..types import Pmt
 from .instance import TpuInstance, instance
 
@@ -42,6 +43,7 @@ __all__ = ["TpuH2D", "TpuStage", "TpuD2H", "rebase_frame_tags", "emit_with_tags"
            "parse_ctrl"]
 
 log = logger("tpu.frames")
+_trace = _trace_recorder()
 
 
 def parse_ctrl(p: Pmt):
@@ -133,12 +135,20 @@ class TpuH2D(Kernel):
         self.output = self.add_inplace_output("out")
 
     def _stage(self, frame: np.ndarray, valid: int, tags) -> None:
+        t0 = _trace.now() if _trace.enabled else 0
         parts = self.wire.encode_host(frame)
+        if t0:
+            _trace.complete("tpu", "encode", t0,
+                            args={"wire": self.wire.name, "items": len(frame)})
         self._staged.append((xfer.start_device_transfer_parts(
             parts, self.inst.device), valid, tags))
 
     def _decode_frame(self, parts):
-        return self.wire.jit_decode(self.dtype)(*parts)
+        t0 = _trace.now() if _trace.enabled else 0
+        y = self.wire.jit_decode(self.dtype)(*parts)
+        if t0:
+            _trace.complete("tpu", "decode", t0, args={"wire": self.wire.name})
+        return y
 
     async def work(self, io, mio, meta):
         inp = self.input.slice()
@@ -242,7 +252,11 @@ class TpuStage(Kernel):
                     except Exception as e:          # validated only now
                         log.warning("queued ctrl update rejected: %r", e)
                 self._pending_ctrl.clear()
+            t0 = _trace.now() if _trace.enabled else 0
             self._carry, y = self._compiled(self._carry, frame)   # async dispatch
+            if t0:
+                _trace.complete("tpu", "compute", t0,
+                                args={"frame": int(frame.shape[0])})
             out_valid = self.pipeline.out_items(
                 valid - valid % self.pipeline.frame_multiple)
             self.output.put_full(y, out_valid,
@@ -284,7 +298,11 @@ class TpuD2H(Kernel):
         self._inflight = deque()                  # (finish, valid, tags)
 
     def _start_d2h(self, frame):
-        return xfer.start_host_transfer_parts(self.wire.jit_encode()(frame))
+        t0 = _trace.now() if _trace.enabled else 0
+        parts = self.wire.jit_encode()(frame)       # device-side epilog dispatch
+        if t0:
+            _trace.complete("tpu", "encode", t0, args={"wire": self.wire.name})
+        return xfer.start_host_transfer_parts(parts)
 
     async def work(self, io, mio, meta):
         if self._pending is not None:
@@ -303,7 +321,12 @@ class TpuD2H(Kernel):
         if self._inflight:
             finish, valid, tags = self._inflight.popleft()
             # sync point (oldest frame only)
-            host = self.wire.decode_host(finish(), self.dtype)[:valid]
+            raw = finish()
+            t0 = _trace.now() if _trace.enabled else 0
+            host = self.wire.decode_host(raw, self.dtype)[:valid]
+            if t0:
+                _trace.complete("tpu", "decode", t0,
+                                args={"wire": self.wire.name, "items": valid})
             self._pending, self._pending_tags = emit_with_tags(
                 self.output, host, tags)
             io.call_again = True

@@ -30,6 +30,7 @@ import numpy as np
 from ..log import logger
 from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
+from ..telemetry.spans import recorder as _trace_recorder
 from ..runtime.kernel import Kernel, message_handler
 from ..runtime.tag import ItemTag
 from ..types import Pmt
@@ -39,6 +40,7 @@ from .instance import TpuInstance, instance
 __all__ = ["TpuKernel"]
 
 log = logger("tpu.kernel")
+_trace = _trace_recorder()
 
 
 class TpuKernel(Kernel):
@@ -138,7 +140,11 @@ class TpuKernel(Kernel):
         waits for :meth:`_launch_staged`. ``valid_in`` (a frame_multiple
         multiple) bounds how much of the output is real data vs zero-pad tail;
         ``tags`` are frame-relative."""
+        t0 = _trace.now() if _trace.enabled else 0
         parts = self.wire.encode_host(frame)
+        if t0:
+            _trace.complete("tpu", "encode", t0,
+                            args={"wire": self.wire.name, "items": len(frame)})
         self._staged.append((xfer.start_device_transfer_parts(
             parts, self.inst.device), valid_in, tags))
 
@@ -152,7 +158,14 @@ class TpuKernel(Kernel):
         while self._staged and len(self._inflight) < self.depth:
             h2d, valid_in, tags = self._staged.popleft()
             x_parts = h2d()
+            t0 = _trace.now() if _trace.enabled else 0
             self._carry, y_parts = self._compiled(self._carry, *x_parts)
+            if t0:
+                # dispatch on accelerators, actual execution on the CPU
+                # backend (synchronous jit) — either way this is the compute
+                # lane's occupancy as this host thread observes it
+                _trace.complete("tpu", "compute", t0,
+                                args={"frame": self.frame_size})
             # start the D2H immediately: the transfer rides the wire the moment
             # the frame finishes instead of waiting for _drain_one's sync
             # (read-ahead, VERDICT r2 weak 2)
@@ -166,7 +179,12 @@ class TpuKernel(Kernel):
     def _drain_one(self) -> Tuple[np.ndarray, tuple]:
         finish, valid, tags = self._inflight.popleft()
         # sync point: blocks only this block's thread
-        arr = self.wire.decode_host(finish(), self.pipeline.out_dtype)
+        raw = finish()
+        t0 = _trace.now() if _trace.enabled else 0
+        arr = self.wire.decode_host(raw, self.pipeline.out_dtype)
+        if t0:
+            _trace.complete("tpu", "decode", t0,
+                            args={"wire": self.wire.name, "items": valid})
         return arr[:valid], tags
 
     async def work(self, io, mio, meta):

@@ -24,8 +24,11 @@ import numpy as np
 
 from ..ops import xfer
 from ..runtime.kernel import Kernel
+from ..telemetry.spans import recorder as _trace_recorder
 
 __all__ = ["PpKernel"]
+
+_trace = _trace_recorder()
 
 
 def _check_stage_leading(stage_params, n_stages: int) -> None:
@@ -136,8 +139,12 @@ class PpKernel(Kernel):
         # wire-encoded parts are plain reals/ints — the complex-pair shim's
         # broken-tunnel rule (ops/xfer.py) is satisfied by construction; the
         # complex frame is formed in-trace by the wired prolog
-        h2d = xfer.start_device_transfer_parts(self.wire.encode_host(frame),
-                                               self._x_shard)
+        t0 = _trace.now() if _trace.enabled else 0
+        parts = self.wire.encode_host(frame)
+        if t0:
+            _trace.complete("tpu", "encode", t0,
+                            args={"wire": self.wire.name, "items": len(frame)})
+        h2d = xfer.start_device_transfer_parts(parts, self._x_shard)
         self._staged.append((h2d, self.frame_size if valid is None else valid))
 
     def _launch_staged(self) -> None:
@@ -145,7 +152,12 @@ class PpKernel(Kernel):
         each result's D2H — H2D(t+1) ∥ pipeline(t) ∥ D2H(t−1), like TpuKernel."""
         while self._staged and len(self._inflight) < self.depth:
             h2d, valid = self._staged.popleft()
-            y_parts = self._fn(self._W, *h2d())
+            x_parts = h2d()
+            t0 = _trace.now() if _trace.enabled else 0
+            y_parts = self._fn(self._W, *x_parts)
+            if t0:
+                _trace.complete("tpu", "compute", t0,
+                                args={"frame": self.frame_size})
             self._inflight.append((xfer.start_host_transfer_parts(y_parts),
                                    valid))
 
@@ -186,8 +198,13 @@ class PpKernel(Kernel):
         if self._inflight and (len(self._inflight) >= self.depth or eos
                                or len(inp) < self.frame_size):
             finish, valid = self._inflight.popleft()
-            result = self.wire.decode_host(finish(), self._out_dt
+            raw = finish()
+            t0 = _trace.now() if _trace.enabled else 0
+            result = self.wire.decode_host(raw, self._out_dt
                                            ).reshape(-1)[:valid]
+            if t0:
+                _trace.complete("tpu", "decode", t0,
+                                args={"wire": self.wire.name, "items": valid})
             out = self.output.slice()
             k = min(len(out), len(result))
             out[:k] = result[:k]

@@ -15,8 +15,11 @@ from typing import Callable, Deque, Optional
 import numpy as np
 
 from ..runtime.kernel import Kernel
+from ..telemetry.spans import recorder as _trace_recorder
 
 __all__ = ["SpKernel"]
+
+_trace = _trace_recorder()
 
 
 class SpKernel(Kernel):
@@ -68,11 +71,17 @@ class SpKernel(Kernel):
     def _dispatch(self, frame: np.ndarray) -> None:
         from ..ops.xfer import to_device
         x = to_device(frame, self._in_sharding)        # scatter shards over the mesh
+        t0 = _trace.now() if _trace.enabled else 0
         if self._stateful:
             self._carry, y = self._fn(self._carry, x)  # carry chains on-device
             self._inflight.append(y)
         else:
             self._inflight.append(self._fn(x))
+        if t0:
+            _trace.complete("tpu", "compute", t0,
+                            args={"frame": self.frame_size,
+                                  "devices": int(np.prod(
+                                      list(self.mesh.shape.values())))})
 
     async def work(self, io, mio, meta):
         if self._pending is not None:

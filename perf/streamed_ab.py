@@ -73,11 +73,18 @@ def main():
                    help="throttle transfers through the fake link at these "
                         "MB/s (CPU-backend link-bound reproduction; 96,62 "
                         "replays the measured tunnel envelope)")
+    p.add_argument("--trace", default=None, metavar="OUT_JSON",
+                   help="record telemetry spans across the whole matrix and "
+                        "write a Chrome-trace JSON artifact (open in Perfetto; "
+                        "per-run overlap summaries go to stderr)")
     a = p.parse_args()
 
     from futuresdr_tpu.utils.backend import ensure_backend
     backend = ensure_backend()
     print(f"# backend: {backend}", file=sys.stderr)
+    if a.trace:
+        from futuresdr_tpu.telemetry import spans
+        spans.enable(True)
     if a.link_mbps:
         from futuresdr_tpu.ops.xfer import set_fake_link
         h2d, d2h = (float(x) * 1e6 for x in a.link_mbps.split(","))
@@ -87,6 +94,7 @@ def main():
 
     frames = ([int(f) for f in a.frames.split(",")] if a.frames
               else [1 << 19, 1 << 21])
+    all_events = []
     print("wire,frame,depth,run,msamples_per_sec")
     for wire in a.wires.split(","):
         for frame in frames:
@@ -96,8 +104,22 @@ def main():
                 n = int(max(rate * 1e6 * a.seconds, frame * 2 * max(depth, 2)))
                 n = (n // frame) * frame
                 for r in range(a.runs):
+                    if a.trace:
+                        from futuresdr_tpu.telemetry import spans
+                        all_events.extend(spans.drain())  # pre-run leftovers
                     rate = run_one(wire, frame, depth, n)
                     print(f"{wire},{frame},{depth},{r},{rate:.2f}", flush=True)
+                    if a.trace:
+                        evs = spans.drain()
+                        rep = spans.overlap_report(evs)
+                        all_events.extend(evs)
+                        print(f"# overlap {wire}/{frame}/{depth}/{r}: "
+                              f"union/sum = {rep['ratio']:.2f} "
+                              f"(sum {rep['sum_s']:.2f}s)", file=sys.stderr)
+    if a.trace:
+        from futuresdr_tpu.telemetry import spans
+        spans.export(a.trace, all_events)
+        print(f"# trace artifact written to {a.trace}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -362,15 +362,19 @@ def test_streamed_kernel_every_wire_format(name, fake_link):
 
 
 def test_streamed_pipelining_overlaps_link(fake_link):
-    """Wall-clock evidence of H2D ∥ compute ∥ D2H: with both link directions
-    throttled, the pipelined drain loop (frames_in_flight=4) must beat the
-    serialized one (depth=1) — serial pays h2d+d2h per frame, pipelined pays
-    ≈ the slower direction. A trivial compute stage (mag²) keeps compile time
-    out of the signal; threshold 0.75 leaves margin over the ideal ~0.5 and
-    the measured ~0.48 on an idle CPU runner."""
+    """Trace-measured evidence of H2D ∥ compute ∥ D2H: the span recorder's
+    per-frame lane intervals prove the overlap directly — union(all lanes) <
+    Σ(durations) — instead of the old wall-clock `pipelined ≤ 0.75×serialized`
+    heuristic (which conflated scheduler noise with overlap and could not say
+    WHICH lane hid under which). Serialized (depth=1) must read ≈ 1.0 and the
+    pipelined loop ≤ 0.75: with the fake link's per-direction wire occupancy
+    deterministically modeled, the ideal pipelined ratio here is ~0.5 (D2H
+    fully hidden under H2D, compute ≈ 0) and the serialized one exactly 1.0
+    (lanes strictly alternate on one frame in flight)."""
     from futuresdr_tpu import Flowgraph, Runtime
     from futuresdr_tpu.blocks import VectorSink, VectorSource
     from futuresdr_tpu.ops import mag2_stage
+    from futuresdr_tpu.telemetry import spans
     from futuresdr_tpu.tpu import TpuKernel
 
     n, frame = 1 << 19, 1 << 15
@@ -383,18 +387,32 @@ def test_streamed_pipelining_overlaps_link(fake_link):
                        frames_in_flight=depth, wire="f32")
         snk = VectorSink(np.float32)
         fg.connect(src, tk, snk)
-        t0 = time.perf_counter()
+        spans.drain()                            # fresh ring for this run
         Runtime().run(fg)
-        return time.perf_counter() - t0
+        return spans.overlap_report(spans.drain())
 
-    # f32 wire: 256 KiB/frame up (16 ms at 16 MB/s), 128 KiB down (16 ms at
-    # 8 MB/s); 16 frames → serial ≈ 512 ms of wire, pipelined ≈ 256 ms
-    fake_link(h2d_bps=16e6, d2h_bps=8e6)
-    t_serial = run(1)
-    fake_link(h2d_bps=16e6, d2h_bps=8e6)         # fresh timeline
-    t_pipe = run(4)
-    assert t_pipe <= 0.75 * t_serial, \
-        f"no overlap: pipelined {t_pipe:.3f}s vs serialized {t_serial:.3f}s"
+    was = spans.enabled()
+    spans.enable(True)
+    try:
+        # f32 wire: 256 KiB/frame up (16 ms at 16 MB/s), 128 KiB down (16 ms
+        # at 8 MB/s); 16 frames → ≈512 ms of modeled wire time per run
+        fake_link(h2d_bps=16e6, d2h_bps=8e6)
+        serial = run(1)
+        fake_link(h2d_bps=16e6, d2h_bps=8e6)     # fresh timeline
+        pipe = run(4)
+    finally:
+        spans.enable(was)
+    # every lane actually recorded every frame
+    for rep in (serial, pipe):
+        for lane in ("H2D", "compute", "D2H"):
+            assert rep["lanes"][lane]["spans"] == n // frame, (lane, rep)
+    # the wire time is real (≈0.13 s per direction at these rates), so the
+    # ratio is measuring modeled link occupancy, not noise-scale intervals
+    assert pipe["sum_s"] >= 0.2, pipe
+    assert serial["ratio"] >= 0.9, \
+        f"serialized lanes overlapped: {serial}"
+    assert pipe["ratio"] <= 0.75, \
+        f"no overlap: pipelined union/sum {pipe['ratio']:.2f} ({pipe})"
 
 
 def test_frame_plane_wire_round_trip(fake_link):
