@@ -225,7 +225,9 @@ def run_device_resident(frame_sizes=(1 << 18, 1 << 19, 1 << 20),
 def run_streamed(n_samples: int, frame_size: int, depth: int = 8,
                  wire: str = "f32") -> float:
     """TPU path through the actor runtime: host ring → TpuKernel → host ring.
-    ``wire`` picks the host↔device codec (ops/wire.py) for both crossings."""
+    ``wire`` picks the host↔device codec (ops/wire.py) for both crossings.
+    Dispatch counters of the run land in ``run_streamed.last_stats`` (the
+    devchain/megabatch dispatch-count stamps of the artifact)."""
     from futuresdr_tpu.config import config
     config().buffer_size = max(config().buffer_size, 4 * frame_size * 8)
     fg = Flowgraph()
@@ -239,6 +241,9 @@ def run_streamed(n_samples: int, frame_size: int, depth: int = 8,
     Runtime().run(fg)
     dt = time.perf_counter() - t0
     assert snk.n_received >= (n_samples // frame_size) * frame_size, snk.n_received
+    run_streamed.last_stats = {
+        "frames": tk._frames_dispatched, "dispatches": tk._dispatches,
+        "frames_per_dispatch": tk.k_batch}
     return n_samples / dt / 1e6
 
 
@@ -260,7 +265,11 @@ def _run_streamed_child(frame: int, n: int, depth: int,
                         wire: str = "f32") -> None:
     """Child mode (``--run-streamed``): one streamed measurement (same
     isolation rationale as ``--run-dev``)."""
-    print(f"STREAM_RATE {run_streamed(n, frame, depth, wire)}")
+    rate = run_streamed(n, frame, depth, wire)
+    s = getattr(run_streamed, "last_stats", {})
+    print(f"STREAM_STATS {s.get('frames', 0)} {s.get('dispatches', 0)} "
+          f"{s.get('frames_per_dispatch', 1)}")
+    print(f"STREAM_RATE {rate}")
 
 
 def _sub_rate(argv, pattern, timeout, extra_env=None):
@@ -310,6 +319,11 @@ def _run_chain_child(name: str) -> None:
             return mod.run_device_resident(128, "qam16", k_pair)[0]
         return mod.run_device_resident(7, 64, k_pair)[0]  # lora: BASELINE #5
 
+    if instance().platform != "cpu":
+        # untimed warmup: the FIRST accelerator measurement of a process pays
+        # tunnel dial + compile and lands as a cold outlier in the runs
+        # triplet (r5: wlan run 1) — burn it off the record
+        once()
     # median of 3 with the spread alongside: a single draw on a shared host
     # is not a benchmark (r4: lora_msps 58-182 across rounds)
     runs = sorted(once() for _ in range(3))
@@ -485,17 +499,25 @@ def main():
     cand = ((args.frame,) if args.frame          # explicit --frame pins BOTH paths
             else tuple(dict.fromkeys(((1 << 18), (1 << 19)) + big + (best_frame,))))
     def _streamed(frame, n, depth, wire="f32"):
+        import re
         if not guarded:
-            return run_streamed(n, frame, depth, wire), None
-        r, err, _out = _sub_rate(
+            r = run_streamed(n, frame, depth, wire)
+            return r, None, dict(getattr(run_streamed, "last_stats", {}))
+        r, err, out = _sub_rate(
             ["--run-streamed", str(frame), str(n), str(depth),
              "--wire", wire],
             "STREAM_RATE", 600)
-        return r, err
+        stats = {}
+        ms = re.search(r"STREAM_STATS (\d+) (\d+) (\d+)", out)
+        if ms:
+            stats = {"frames": int(ms.group(1)),
+                     "dispatches": int(ms.group(2)),
+                     "frames_per_dispatch": int(ms.group(3))}
+        return r, err, stats
 
     stream_frame, probe_best = best_frame, 0.0
     for f in cand:
-        r, err = _streamed(f, f * 4 * args.depth, args.depth)
+        r, err, _s = _streamed(f, f * 4 * args.depth, args.depth)
         if r is None:
             extras[f"streamed_probe_{f}_error"] = err
             print(f"# streamed probe frame={f} failed: {err}", file=sys.stderr)
@@ -504,16 +526,19 @@ def main():
         if r > probe_best:
             probe_best, stream_frame = r, f
     runs = []
+    stream_stats = {}
     per_run = max(args.stream_seconds / 3.0, 5.0)
     for _ in range(3):
         n_stream = int(min(max(probe_best * 1e6 * per_run, stream_frame * 4 * args.depth),
                            200_000_000))
         n_stream = (n_stream // stream_frame) * stream_frame
-        r, err = _streamed(stream_frame, n_stream, args.depth)
+        r, err, s = _streamed(stream_frame, n_stream, args.depth)
         if r is None:
             extras["streamed_error"] = err
             print(f"# streamed run failed: {err}", file=sys.stderr)
             continue
+        if s:
+            stream_stats = s
         runs.append(r)
     runs.sort()
     stream_rate = runs[(len(runs) - 1) // 2] if runs else 0.0  # lower-middle:
@@ -605,7 +630,7 @@ def main():
         n_wire = (n_wire // stream_frame) * stream_frame
         wire_runs = []
         for _ in range(3):
-            r, err = _streamed(stream_frame, n_wire, args.depth, wire_pick)
+            r, err, _s = _streamed(stream_frame, n_wire, args.depth, wire_pick)
             if r is None:
                 wire_extra["streamed_wire_error"] = err
                 print(f"# streamed wire run failed: {err}", file=sys.stderr)
@@ -645,6 +670,12 @@ def main():
         "streamed_vs_baseline": round(stream_rate / cpu_rate, 2),
         "streamed_runs": [round(r, 1) for r in runs],
         "streamed_frame": stream_frame,
+        # dispatch-count stamps (device-graph fusion PR): program invocations
+        # vs frames moved — frames/dispatches = the effective megabatch K
+        "streamed_frames": stream_stats.get("frames", 0),
+        "streamed_dispatches": stream_stats.get("dispatches", 0),
+        "streamed_frames_per_dispatch": stream_stats.get(
+            "frames_per_dispatch", 1),
         "frame": best_frame,
         "dev_frame_sweep": dev_sweep,
         **link,

@@ -12,6 +12,16 @@ echo "== telemetry overhead gate (docs/observability.md budget) =="
 JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_telemetry.py::test_telemetry_disabled_overhead_null_rand
 
+echo "== device-graph fusion gate (docs/tpu_notes.md 'Device-graph fusion') =="
+# fused A/B smoke: the pass engages, dispatches drop 3x -> 1x per frame
+JAX_PLATFORMS=cpu python perf/devchain_ab.py --smoke
+# fusion equality tests, then the DECLINED mode (FSDR_NO_DEVCHAIN=1) over the
+# device-plane suite: the per-hop fallback must stand alone
+JAX_PLATFORMS=cpu python -m pytest -q tests/test_devchain.py
+FSDR_NO_DEVCHAIN=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_devchain.py tests/test_tpu_stages.py tests/test_tpu_tags.py \
+    tests/test_tpu_frames.py tests/test_retune.py
+
 echo "== python suite =="
 python -m pytest tests/ -q
 

@@ -45,6 +45,9 @@ class Config:
     tpu_frames_in_flight: int = 4          # dispatch pipeline depth
     tpu_wire_format: str = "auto"          # host↔device wire codec (ops/wire.py):
     #   "auto" | "f32" | "bf16" | "sc16" | "sc8"; env FUTURESDR_TPU_WIRE_FORMAT
+    tpu_frames_per_dispatch: int = 1       # megabatch K: frames lax.scan'ed through
+    #   the compiled pipeline per program call (amortizes per-dispatch host
+    #   overhead; K=1 = one dispatch per frame); env FUTURESDR_TPU_FRAMES_PER_DISPATCH
     misc: dict = field(default_factory=dict)
 
     def get(self, key: str, default: Any = None) -> Any:

@@ -82,7 +82,7 @@ class Pipeline:
         self.ratio = r
         self.out_dtype = dtype
         self._fn = None
-        self._wired_fns = {}        # wire name -> wrapped fn (stable for jit cache)
+        self._wired_fns = {}        # (wire name, k) -> wrapped fn (stable for jit cache)
 
     def init_carry(self):
         dtype = self.in_dtype
@@ -121,14 +121,23 @@ class Pipeline:
             carry = jax.device_put(carry, device)
         return fn, carry
 
-    def wired_fn(self, wire):
+    def wired_fn(self, wire, k: int = 1):
         """The stage chain with the wire codec's decode PROLOG and encode EPILOG
         fused in: ``(carries, *in_parts) -> (carries, out_parts)``. Dequantized
         frames exist only inside the XLA program — they never round-trip
-        through HBM as a separate dispatch (``ops/wire.py``)."""
+        through HBM as a separate dispatch (``ops/wire.py``).
+
+        ``k > 1`` returns the MEGABATCH form: each wire part gains a leading
+        ``[k]`` axis and a ``lax.scan`` runs the k frames through the chain in
+        ONE program call with the carry chained frame-to-frame — per-call host
+        dispatch overhead is amortized k× (the ``frames_per_dispatch`` knob of
+        ``TpuKernel``/``tpu/autotune.py``). Output parts carry the same leading
+        axis. Functions are cached per ``(wire, k)`` so the jit identity stays
+        stable across compiles."""
         from .wire import get_wire
         wire = get_wire(wire)
-        if wire.name not in self._wired_fns:
+        key = (wire.name, int(k))
+        if key not in self._wired_fns:
             inner = self.fn()
             in_dt, w = self.in_dtype, wire
 
@@ -136,16 +145,27 @@ class Pipeline:
                 carries, y = inner(carries, w.decode_jax(parts, in_dt))
                 return carries, w.encode_jax(y)
 
-            self._wired_fns[wire.name] = run
-        return self._wired_fns[wire.name]
+            if k == 1:
+                self._wired_fns[key] = run
+            else:
+                def run_scan(carries, *parts):
+                    def body(c, p):
+                        return run(c, *p)
+                    return jax.lax.scan(body, carries, tuple(parts))
+
+                self._wired_fns[key] = run_scan
+        return self._wired_fns[key]
 
     def compile_wired(self, frame_size: int, wire, device=None,
-                      donate: bool = True):
+                      donate: bool = True, k: int = 1):
         """:meth:`compile` for the wired form: the compiled fn consumes/produces
-        wire parts (see :meth:`wired_fn`); returns (compiled_fn, initial carry)."""
+        wire parts (see :meth:`wired_fn`); returns (compiled_fn, initial carry).
+        ``k > 1`` compiles the megabatch scan form (parts carry a leading
+        ``[k]`` frame axis)."""
         assert frame_size % self.frame_multiple == 0, \
             f"frame_size {frame_size} not a multiple of {self.frame_multiple}"
-        fn = jax.jit(self.wired_fn(wire), donate_argnums=(0,) if donate else ())
+        fn = jax.jit(self.wired_fn(wire, k),
+                     donate_argnums=(0,) if donate else ())
         carry = self.init_carry()
         if device is not None:
             carry = jax.device_put(carry, device)

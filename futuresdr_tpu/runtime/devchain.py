@@ -1,0 +1,712 @@
+"""Device-graph fusion: collapse device-plane chains into ONE dispatch per frame.
+
+The device-plane analog of the native fast chain (``fastchain.py``): where that
+module lifts pipes of trivial CPU blocks out of the actor plane into one C++
+thread, this one lifts runs of DEVICE blocks out of the per-block dispatch
+regime into one jitted XLA program. The bench artifact shows why
+(`BENCH_r05.json`: MFU 0.058, fir/fft rooflines "hbm-bound"): every
+``TpuStage`` in a flowgraph is its own per-frame jit dispatch, and every stage
+boundary materializes the full intermediate frame in HBM — so a k-stage device
+chain pays k dispatches and k-1 HBM round trips per frame where the proven
+single-``TpuKernel`` path pays one and zero.
+
+At launch the supervisor calls :func:`find_device_chains`; each detected run —
+
+* a linear ``TpuH2D → TpuStage* → TpuD2H`` frame-plane pipeline, or
+* adjacent ``TpuKernel`` blocks chained by stream edges (whose intermediate
+  hops each cross the host↔device link BOTH ways per frame)
+
+— is collapsed into one fused :class:`~futuresdr_tpu.tpu.TpuKernel` whose
+``Pipeline`` is the concatenation of the member stage lists (composed with
+``optimize=False`` and carry-stash fences at member boundaries, so each
+member's own numerics are preserved BIT-for-bit — see
+:func:`_boundary_stage`). The fused
+kernel drives the ORIGINAL boundary ports (the first member's stream input,
+the last member's stream output), so buffers, tags and backpressure are the
+live flowgraph's own; :func:`run_devchain_task` impersonates every member at
+the supervisor protocol level exactly like ``fastchain.run_chain_task`` (init
+barrier, Terminate, per-member BlockDone), and a metrics bridge keeps
+``metrics()``/REST reporting per ORIGINAL block.
+
+Semantics preserved per block:
+
+* **tags** rebase through the composed rate contract (the same
+  ``rebase_frame_tags`` math the members apply hop-by-hop — composition of the
+  per-member remaps equals the composed remap);
+* **carries** concatenate (each member's stages keep their own carry slots);
+* **wire codec** is applied once at the fused edges. For a ``TpuKernel`` run
+  with a lossy wire (sc16/sc8) this REMOVES the intermediate hops'
+  quantization — strictly higher fidelity, and the reason lossy-wire fused
+  output is not bit-identical to the unfused actor path (f32 is).
+
+Refusals (the run stays on the actor path):
+
+* a member whose ``ctrl`` port is wired to a message edge — unless the kernel
+  carries the explicit ``devchain_static = True`` opt-in (the
+  ``fastchain_static`` convention; see the retune paragraph below for why
+  edges refuse while direct ``handle.call`` retunes are serviced);
+* members on different ``TpuInstance`` objects (different devices);
+* mismatched wire formats at the fused edges;
+* branching/merging ports anywhere inside the run;
+* a first-member frame size that is not a multiple of the COMPOSED pipeline's
+  frame multiple;
+* a per-kernel ``devchain = False`` opt-out, or ``FSDR_NO_DEVCHAIN=1``
+  (everything declines — the fallback per-hop path must stand alone, and perf
+  probes A/B the two inside one process).
+
+Unlike the native fastchain, ``ctrl`` retunes addressed DIRECTLY to a fused
+member (``handle.call(stage, "ctrl", …)``) keep working: each member's stages
+occupy a known slice of the composed stage list, so the retune is translated
+into carry surgery on the FUSED pipeline between dispatches
+(``Pipeline.update_stage`` — same no-recompile contract as ``TpuKernel``'s own
+ctrl port), and a ``TpuStage``'s pre-launch queued ctrl (lazy-carry contract)
+is applied to the fused carry at compile. Only message-EDGE-wired ctrl ports
+refuse to fuse: an edge means another block retunes at stream-synchronized
+times, and the fused chain's in-flight batching would shift where the swap
+lands.
+
+Known divergences from the unfused actor path (same spirit as fastchain's):
+
+* Calls/Callbacks to ports OTHER than a member's ``ctrl`` answer
+  ``Pmt.invalid_value()`` (members have no other handlers today).
+* EOS tail handling applies the COMPOSED frame contract once instead of each
+  member's contract per hop, so a final partial frame may yield up to one
+  frame-multiple fewer tail items than the hop-by-hop path.
+* With ``frames_per_dispatch > 1`` the fused kernel adds up to K-1 frames of
+  latency while the input trickles (megabatch contract, ``tpu/kernel_block``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from fractions import Fraction
+from typing import List, Optional, Sequence
+
+from ..log import logger
+from ..telemetry.spans import recorder as _trace_recorder
+from .inbox import (Call, Callback, Initialize, StreamInputDone,
+                    StreamOutputDone, Terminate)
+from .work_io import WorkIo
+
+__all__ = ["DevChain", "find_device_chains", "run_devchain_task",
+           "shed_devchain_bridge", "devchain_enabled"]
+
+log = logger("runtime.devchain")
+_trace = _trace_recorder()
+
+
+def devchain_enabled() -> bool:
+    """Env gate, checked per launch (not at import) so perf probes can A/B the
+    fused vs per-hop path inside one process."""
+    return not os.environ.get("FSDR_NO_DEVCHAIN")
+
+
+class DevChain(list):
+    """Fusable device-plane run in topological order. ``kind`` is ``"frames"``
+    (TpuH2D → TpuStage* → TpuD2H) or ``"kernels"`` (adjacent TpuKernels)."""
+
+    def __init__(self, members, kind: str):
+        super().__init__(members)
+        self.kind = kind
+
+
+class _FwdCtrl:
+    """A member-addressed Call/Callback forwarded by an intermediate-member
+    watcher into the drive loop's inbox (carry surgery must happen on the
+    drive thread, between dispatches)."""
+
+    __slots__ = ("idx", "msg")
+
+    def __init__(self, idx: int, msg):
+        self.idx = idx
+        self.msg = msg
+
+
+def _member_ratio(k) -> Fraction:
+    pipe = getattr(k, "pipeline", None)
+    return pipe.ratio if pipe is not None else Fraction(1, 1)
+
+
+def find_device_chains(fg) -> List[DevChain]:
+    """Maximal fusable device-plane runs in ``fg`` (see module docstring for
+    the eligibility/refusal rules)."""
+    if not devchain_enabled():
+        return []
+    from ..ops.stages import Pipeline
+    from ..tpu.frames import TpuD2H, TpuH2D, TpuStage
+    from ..tpu.kernel_block import TpuKernel
+
+    msg_touched = {id(e.src) for e in fg.message_edges} | \
+                  {id(e.dst) for e in fg.message_edges}
+    s_out: dict = {}
+    s_in: dict = {}
+    for e in fg.stream_edges:
+        s_out.setdefault(id(e.src), []).append(e)
+        s_in.setdefault(id(e.dst), []).append(e)
+    i_out: dict = {}
+    i_in: dict = {}
+    for e in fg.inplace_edges:
+        i_out.setdefault(id(e.src), []).append(e)
+        i_in.setdefault(id(e.dst), []).append(e)
+
+    def member_ok(k) -> bool:
+        """Common per-member gate: opt-out attr, wired-ctrl refusal."""
+        if getattr(k, "devchain", True) is False:
+            return False
+        if id(k) in msg_touched and not getattr(k, "devchain_static", False):
+            # a wired ctrl (or any message port) means live retunes are
+            # expected; the fused chain is static — fastchain_static rule
+            return False
+        return True
+
+    claimed: set = set()
+    chains: List[DevChain] = []
+
+    def _close(members, kind) -> None:
+        first = members[0]
+        # one wire at both fused edges
+        last = members[-1]
+        if first.wire.name != last.wire.name:
+            log.debug("devchain refuses %s: wire mismatch (%s vs %s)",
+                      members, first.wire.name, last.wire.name)
+            return
+        # one device: instance identity, not equality
+        insts = {id(m.inst) for m in members}
+        if len(insts) != 1:
+            log.debug("devchain refuses %s: mismatched TpuInstances", members)
+            return
+        stages = [s for m in members
+                  if getattr(m, "pipeline", None) is not None
+                  for s in m.pipeline.stages]
+        in_dtype = first.dtype if kind == "frames" else first.pipeline.in_dtype
+        composed = Pipeline(stages, in_dtype, optimize=False)
+        if first.frame_size % composed.frame_multiple != 0:
+            log.debug("devchain refuses %s: frame %d not a multiple of the "
+                      "composed contract %d", members, first.frame_size,
+                      composed.frame_multiple)
+            return
+        if kind == "frames":
+            import numpy as np
+            if np.dtype(composed.out_dtype) != np.dtype(last.dtype):
+                # the unfused TpuD2H casts to ITS dtype at decode; a fused run
+                # would emit the pipeline dtype — refuse rather than diverge
+                log.debug("devchain refuses %s: D2H dtype %s != composed %s",
+                          members, last.dtype, composed.out_dtype)
+                return
+        claimed.update(id(m) for m in members)
+        chains.append(DevChain(members, kind))
+
+    kernels = [b.kernel for b in fg._blocks if b is not None]
+
+    # ---- frame-plane runs: TpuH2D → TpuStage* → TpuD2H ----------------------
+    for k in kernels:
+        if type(k) is not TpuH2D or id(k) in claimed or not member_ok(k):
+            continue
+        if len(s_in.get(id(k), [])) != 1 or len(i_out.get(id(k), [])) != 1:
+            continue                     # unwired or branching H2D
+        members, cur, ok = [k], k, True
+        while True:
+            outs = i_out.get(id(cur), [])
+            if len(outs) != 1:
+                ok = False               # branching frame fan-out: refuse
+                break
+            nxt = outs[0].dst
+            if id(nxt) in claimed or not member_ok(nxt) \
+                    or len(i_in.get(id(nxt), [])) != 1:
+                ok = False
+                break
+            if type(nxt) is TpuStage:
+                if nxt._carry is not None:
+                    ok = False   # mid-stream state from a previous run: the
+                    break        # actor path resumes it, a fused fresh carry
+                                 # would not (fastchain's _hist rule)
+                members.append(nxt)
+                cur = nxt
+                continue
+            if type(nxt) is TpuD2H:
+                if i_out.get(id(nxt)) or not s_out.get(id(nxt)):
+                    ok = False           # D2H must exit to the stream plane
+                    break
+                members.append(nxt)
+                break
+            ok = False                   # a foreign consumer on the plane
+            break
+        if ok and len(members) >= 2:
+            _close(members, "frames")
+
+    # ---- adjacent TpuKernel runs over stream edges --------------------------
+    def _kernel_ok(k) -> bool:
+        return (type(k) is TpuKernel and id(k) not in claimed and member_ok(k)
+                and not i_out.get(id(k)) and not i_in.get(id(k)))
+
+    def _link(a) -> Optional[object]:
+        """The next TpuKernel if ``a``'s single output edge feeds one."""
+        outs = s_out.get(id(a), [])
+        if len(outs) != 1:
+            return None                  # broadcast between members: refuse
+        nxt = outs[0].dst
+        if not _kernel_ok(nxt) or len(s_in.get(id(nxt), [])) != 1:
+            return None
+        if id(nxt.inst) != id(a.inst) or nxt.wire.name != a.wire.name:
+            return None
+        return nxt
+
+    for k in kernels:
+        if not _kernel_ok(k):
+            continue
+        # only start at run heads: the upstream is not itself a fusable link
+        ups = s_in.get(id(k), [])
+        if len(ups) == 1 and _kernel_ok(ups[0].src) \
+                and _link(ups[0].src) is k:
+            continue
+        members, cur = [k], k
+        while True:
+            nxt = _link(cur)
+            if nxt is None:
+                break
+            members.append(nxt)
+            cur = nxt
+        if len(members) >= 2:
+            _close(members, "kernels")
+    return chains
+
+
+# ---------------------------------------------------------------------------
+# fused kernel construction + metrics bridge
+# ---------------------------------------------------------------------------
+
+def _boundary_stage(n_items: int, dtype):
+    """Identity stage fencing a member boundary: the boundary frame is stashed
+    into the CARRY (``return x, x``), which makes it a program OUTPUT root —
+    XLA then materializes exactly the value the standalone member program
+    would have produced, so each member's segment of the fused program
+    compiles to the member's own numerics bit-for-bit (the fused-vs-actor
+    bit-equality contract; a bare ``lax.optimization_barrier`` proved
+    insufficient — consumer-side fusion still reassociated the rounding).
+    The frame never leaves the device or the program — the cost is one
+    donated HBM buffer write per boundary per dispatch, not a host hop or an
+    extra dispatch."""
+    import numpy as np
+
+    from ..ops.stages import Stage
+
+    def fn(carry, x):
+        return x, x
+
+    def init_carry(_dt):
+        from ..ops.xfer import to_device
+        # to_device, not eager jnp.zeros: complex host constants must ride
+        # the pair shim on the tunnel platform (ops/xfer.py)
+        return to_device(np.zeros(n_items, dtype=dtype))
+
+    return Stage(fn, init_carry, name="devchain_boundary")
+
+
+def _build_fused(chain: DevChain):
+    """One TpuKernel over the members' concatenated stage lists, driving the
+    chain's ORIGINAL boundary ports (the live, already-materialized buffers)."""
+    import numpy as np
+
+    from ..ops.stages import Pipeline
+    from ..tpu.kernel_block import TpuKernel
+
+    members = list(chain)
+    first, last = members[0], members[-1]
+    in_dtype = first.dtype if chain.kind == "frames" \
+        else first.pipeline.in_dtype
+    pipes = [m.pipeline for m in members
+             if getattr(m, "pipeline", None) is not None]
+    frame = first.frame_size
+    # "frames" runs also fence the wire codec off the member stages: the
+    # unfused TpuH2D/TpuD2H run decode/encode as STANDALONE programs, so the
+    # fused segments must match those numerics too ("kernels" members fuse
+    # their own codec edges in the unfused path already — no edge fence there)
+    fence_edges = chain.kind == "frames"
+    stages: list = []
+    slices: list = []        # per MEMBER: (start, stop) into the composed list
+    cum = Fraction(1, 1)
+    dt = np.dtype(in_dtype)
+    seen_pipes = 0
+    if fence_edges and pipes:
+        stages.append(_boundary_stage(frame, dt))
+    for m in members:
+        p = getattr(m, "pipeline", None)
+        if p is None:
+            slices.append((len(stages), len(stages)))
+            continue
+        if seen_pipes > 0:
+            q = Fraction(frame) * cum
+            assert q.denominator == 1, (frame, cum)   # finder checked the lcm
+            stages.append(_boundary_stage(int(q), dt))
+        slices.append((len(stages), len(stages) + len(p.stages)))
+        stages.extend(p.stages)
+        cum *= p.ratio
+        dt = np.dtype(p.out_dtype)
+        seen_pipes += 1
+    if fence_edges and pipes:
+        q = Fraction(frame) * cum
+        assert q.denominator == 1, (frame, cum)
+        stages.append(_boundary_stage(int(q), dt))
+    if chain.kind == "frames":
+        in_dtype = first.dtype
+        depth = first.max_inflight
+        k_batch = None                   # config default (frame plane has no knob)
+    else:
+        in_dtype = first.pipeline.in_dtype
+        depth = first.depth
+        k_batch = first.k_batch
+    # optimize=False: each member's internal numerics stay stage-for-stage
+    # identical to the unfused run (cross-member LTI merging would convolve
+    # taps and break the bit-equality contract); XLA still fuses elementwise
+    # work across the boundaries inside the single program
+    composed = Pipeline(stages, in_dtype, optimize=False)
+    fused = TpuKernel((), in_dtype, frame_size=first.frame_size,
+                      inst=first.inst, frames_in_flight=depth,
+                      wire=first.wire, frames_per_dispatch=k_batch,
+                      _pipeline=composed)
+    assert fused.frame_size == first.frame_size, \
+        (fused.frame_size, first.frame_size)    # finder checked the multiple
+    # steal the boundary ports: the fused kernel works the chain's own buffers
+    fused._stream_inputs = [first.input]
+    fused._stream_outputs = [last.output]
+    fused.input = first.input
+    fused.output = last.output
+    fused.meta.instance_name = \
+        f"devchain[{type(first).__name__}…x{len(members)}]"
+    fused._dc_slices = slices    # per-member stage ranges for ctrl translation
+    return fused
+
+
+def _port_name(kernel, port):
+    """Resolve a Call/Callback port id to a handler NAME the way
+    ``Kernel.call_handler`` does (PortId / int index / str)."""
+    from ..types import PortId
+    pid = port.id if isinstance(port, PortId) else port
+    if isinstance(pid, int):
+        names = kernel.message_input_names()
+        return names[pid] if 0 <= pid < len(names) else None
+    return pid
+
+
+def _apply_stage_update(fused, idx: int, stage, params: dict) -> None:
+    """Translate a MEMBER-local stage address (name or index) into the fused
+    pipeline's composed index and apply the carry surgery. Raises on a bad
+    address — callers answer ``Pmt.invalid_value()`` exactly like the member's
+    own handler would."""
+    start, stop = fused._dc_slices[idx]
+    if isinstance(stage, str):
+        hits = [j for j in range(start, stop)
+                if fused.pipeline.stages[j].name == stage]
+        if not hits:
+            raise KeyError(f"no stage named {stage!r} in fused member {idx}")
+        if len(hits) > 1:
+            raise KeyError(f"stage name {stage!r} is ambiguous")
+        j = hits[0]
+    else:
+        j = start + int(stage)
+        if not start <= j < stop:
+            raise KeyError(f"stage index {stage} out of member range")
+    fused._carry = fused.pipeline.update_stage(fused._carry, j, **params)
+
+
+def _apply_ctrl(fused, member_kernels, idx: int, port, p):
+    """Service a ``ctrl`` retune addressed to fused member ``idx`` (the
+    TpuKernel/TpuStage retune contract survives fusion — frames already in
+    flight keep the old parameters, later dispatches see the new ones).
+    Non-ctrl ports answer invalid, as the member itself would for an unknown
+    handler."""
+    from ..tpu.frames import parse_ctrl
+    from ..types import Pmt
+    k = member_kernels[idx]
+    if _port_name(k, port) != "ctrl" or "ctrl" not in k.message_input_names():
+        return Pmt.invalid_value()
+    try:
+        stage, params = parse_ctrl(p)
+        _apply_stage_update(fused, idx, stage, params)
+    except Exception as e:                             # noqa: BLE001
+        log.warning("devchain ctrl rejected: %r", e)
+        return Pmt.invalid_value()
+    return Pmt.ok()
+
+
+def shed_devchain_bridge(kernel) -> None:
+    """Restore a kernel's pre-fusion ``extra_metrics`` if a fused devchain run's
+    bridge is installed (the exact counterpart of
+    ``fastchain.shed_metrics_bridge`` — the supervisor calls both for every
+    actor-path block at launch)."""
+    if not hasattr(kernel, "_dc_base_extra"):
+        return
+    base = kernel._dc_base_extra
+    if base is None:
+        try:
+            del kernel.extra_metrics
+        except AttributeError:
+            pass
+    else:
+        kernel.extra_metrics = base
+    del kernel._dc_base_extra
+
+
+def _member_rates(members) -> list:
+    """Per member: (kernel, cumulative in-rate, cumulative out-rate) relative
+    to the fused chain's input."""
+    out, r_in = [], Fraction(1, 1)
+    for m in members:
+        r_out = r_in * _member_ratio(m)
+        out.append((m, r_in, r_out))
+        r_in = r_out
+    return out
+
+
+def _set_member_counters(m, boundary, items: int, r_in: Fraction,
+                         r_out: Fraction) -> None:
+    for p in m.stream_inputs:
+        if id(p) not in boundary:          # boundary counters are live
+            p.items_consumed = int(items * r_in)
+    for p in m.stream_outputs:
+        if id(p) not in boundary:
+            p.items_produced = int(items * r_out)
+
+
+def _install_bridge(members: Sequence, fused) -> None:
+    """Per-member metrics bridge: each ORIGINAL block keeps reporting its own
+    item counters (derived from the fused frame counter through the composed
+    rate contract) plus ``fused_devchain`` provenance — the devchain analog of
+    fastchain's live counter bridge."""
+    boundary = {id(fused.input), id(fused.output)}
+    for m, r_in, r_out in _member_rates(members):
+        if not hasattr(m, "_dc_base_extra"):
+            m._dc_base_extra = getattr(m, "extra_metrics", None)
+        base_extra = m._dc_base_extra
+
+        def make_extra(m=m, r_in=r_in, r_out=r_out, base_extra=base_extra):
+            def extra():
+                frames = fused._frames_dispatched
+                _set_member_counters(m, boundary, frames * fused.frame_size,
+                                     r_in, r_out)
+                return dict(
+                    (base_extra() if callable(base_extra) else {}),
+                    fused_devchain=True,
+                    devchain_frames=frames,
+                    devchain_dispatches=fused._dispatches,
+                    frames_per_dispatch=fused.k_batch,
+                )
+            return extra
+
+        m.extra_metrics = make_extra()
+
+
+def _freeze_bridge(members: Sequence, fused) -> None:
+    """Swap the LIVE bridge for a frozen snapshot once the run is over: the
+    live closures capture the fused kernel, which would pin its compiled
+    executable and device carry (one frame-sized boundary-stash buffer per
+    member fence) for as long as anyone keeps the flowgraph around. Post-run
+    metrics only need the final numbers."""
+    boundary = {id(fused.input), id(fused.output)}
+    frames = fused._frames_dispatched
+    for m, r_in, r_out in _member_rates(members):
+        _set_member_counters(m, boundary, frames * fused.frame_size,
+                             r_in, r_out)
+        base_extra = getattr(m, "_dc_base_extra", None)
+        snap = dict(
+            (base_extra() if callable(base_extra) else {}),
+            fused_devchain=True,
+            devchain_frames=frames,
+            devchain_dispatches=fused._dispatches,
+            frames_per_dispatch=fused.k_batch,
+        )
+        m.extra_metrics = (lambda s=snap: dict(s))
+
+
+# ---------------------------------------------------------------------------
+# supervisor-protocol impersonation + the fused drive loop
+# ---------------------------------------------------------------------------
+
+async def _next_msg(inbox):
+    """Next inbox message, parking on the coalescing notifier. Returns None on
+    a bare notify (the supervisor's start signal is a notify with no message)."""
+    msg = inbox.try_recv()
+    if msg is not None:
+        return msg
+    await inbox.wait()
+    inbox.take_pending()
+    return inbox.try_recv()
+
+
+async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
+                            scheduler) -> None:
+    """Impersonate ``members`` (WrappedKernels) at the supervisor protocol
+    level while the fused kernel drives the chain: answer the init barrier per
+    member (compiling the composed program inside it), run the fused
+    TpuKernel's drain loop on a dedicated thread against the chain's own
+    boundary buffers, then report per-member BlockDone with counters bridged."""
+    from ..types import Pmt
+    from .runtime import BlockDoneMsg, BlockErrorMsg, InitializedMsg
+
+    def _finish_all():
+        for b in members:
+            fg_inbox.send(BlockDoneMsg(b.id, b))
+
+    def _error_out(e):
+        log.error("devchain failed (%r)", e)
+        fg_inbox.send(BlockErrorMsg(members[0].id, e))
+        for b in members[1:]:
+            fg_inbox.send(BlockDoneMsg(b.id, b))
+
+    # ---- init barrier for every member (fastchain contract) -----------------
+    for b in members:
+        while True:
+            msg = await _next_msg(b.inbox)
+            if isinstance(msg, Initialize):
+                break
+            if isinstance(msg, Terminate):
+                _finish_all()
+                return
+            if isinstance(msg, Callback):
+                msg.reply.set(Pmt.invalid_value())
+    member_kernels = [b.kernel for b in members]
+    try:
+        fused = _build_fused(chain)
+        # compile + warm OFF the supervisor loop: the fused kernel is a
+        # BLOCKING block whose init the actor path would run on a dedicated
+        # thread — compiling here inline would stall every same-loop block
+        # task and serialize multiple devchains' compiles
+        await scheduler.spawn_blocking(
+            lambda: asyncio.run(fused.init(fused.mio, fused.meta)))
+        # a TpuStage queues pre-launch ctrl until its (lazy) carry exists —
+        # apply the queue to the FUSED carry now, exactly where the actor
+        # path would apply it at first-frame compile (invalid updates were
+        # already rejected at queue time; a failure here only logs, as there)
+        for idx, k in enumerate(member_kernels):
+            for stage, params in getattr(k, "_pending_ctrl", ()):
+                try:
+                    _apply_stage_update(fused, idx, stage, params)
+                except Exception as e:                 # noqa: BLE001
+                    log.warning("queued ctrl update rejected: %r", e)
+            if getattr(k, "_pending_ctrl", None):
+                k._pending_ctrl.clear()
+        _install_bridge(member_kernels, fused)
+    except Exception as e:                             # noqa: BLE001
+        _error_out(e)
+        return
+    for b in members:
+        fg_inbox.send(InitializedMsg(b.id, ok=True))
+
+    # No separate start-wait phase: actor blocks enter their event loop right
+    # after init too (WrappedKernel.run), parking until the supervisor's start
+    # notify — the drive loop below does the same. A dedicated start phase
+    # would have to drain the inbox to find the bare notify and would swallow
+    # a StreamInputDone racing it (a fast source can produce AND finish within
+    # the first scheduler slice after the barrier releases — observed live;
+    # the lost EOS deadlocked the chain). BlockDone before the barrier
+    # releases is impossible on the happy path: it needs upstream EOS or
+    # Terminate, and producers only run after start.
+
+    # Intermediate members' inboxes: nothing routes data there, but ctrl
+    # Calls/Callbacks must reach the drive thread (carry surgery happens
+    # between dispatches there) — forward them with the member index.
+    async def watch(b, idx):
+        while True:
+            msg = await _next_msg(b.inbox)
+            if isinstance(msg, (Call, Callback)):
+                members[0].inbox.send(_FwdCtrl(idx, msg))
+            if isinstance(msg, Terminate):
+                return                   # the drive loop gets its own copy
+
+    watchers = [asyncio.ensure_future(watch(b, i + 1))
+                for i, b in enumerate(members[1:-1])]
+
+    first_ib = members[0].inbox
+    last_ib = members[-1].inbox
+
+    async def _drive():
+        """The fused block event loop (WrappedKernel.run's loop, merged over
+        the first and last members' inboxes — produce/consume notifications
+        land on THOSE, because the boundary buffers were bound to them at
+        materialize time)."""
+        io = WorkIo()
+        kernel = fused
+
+        def ctrl(idx, msg):
+            res = _apply_ctrl(kernel, member_kernels, idx, msg.port, msg.data)
+            if isinstance(msg, Callback):
+                msg.reply.set(res)
+
+        while True:
+            p1 = first_ib.take_pending()
+            p2 = last_ib.take_pending()
+            io.call_again = io.call_again or p1 or p2
+            for ib in (first_ib, last_ib):
+                while True:
+                    msg = ib.try_recv()
+                    if msg is None:
+                        break
+                    if isinstance(msg, _FwdCtrl):
+                        ctrl(msg.idx, msg.msg)
+                    elif isinstance(msg, (Call, Callback)):
+                        ctrl(0 if ib is first_ib else len(members) - 1, msg)
+                    elif isinstance(msg, StreamInputDone):
+                        kernel.input.set_finished()
+                        io.call_again = True
+                    elif isinstance(msg, (StreamOutputDone, Terminate)):
+                        io.finished = True
+            if io.finished:
+                break
+            if not io.call_again:
+                w1 = asyncio.ensure_future(first_ib.wait())
+                w2 = asyncio.ensure_future(last_ib.wait())
+                await asyncio.wait({w1, w2},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                for w in (w1, w2):
+                    if not w.done():
+                        w.cancel()
+                continue
+            io.reset()
+            await kernel.work(io, kernel.mio, kernel.meta)
+
+    def _drive_thread():
+        # the fused kernel is BLOCKING (host syncs in the drain): a dedicated
+        # thread with a private loop, exactly how the scheduler runs BLOCKING
+        # actor blocks
+        asyncio.run(_drive())
+
+    t_chain = _trace.now()
+    try:
+        await scheduler.spawn_blocking(_drive_thread)
+    except Exception as e:                             # noqa: BLE001
+        for w in watchers:
+            w.cancel()
+        try:
+            fused.output.notify_finished()
+            fused.input.notify_finished()
+        except Exception:                              # noqa: BLE001
+            pass
+        _freeze_bridge(member_kernels, fused)
+        _error_out(e)
+        return
+    for w in watchers:
+        w.cancel()
+    # orderly shutdown: EOS downstream, detach upstream (block.py contract)
+    try:
+        fused.output.notify_finished()
+        fused.input.notify_finished()
+    except Exception as e:                             # noqa: BLE001
+        _freeze_bridge(member_kernels, fused)
+        _error_out(e)
+        return
+    # drop the live bridge's reference to the fused kernel (compiled program +
+    # boundary-stash device buffers) — final counters are frozen in place
+    _freeze_bridge(member_kernels, fused)
+    # one span for the whole fused run, per-member frame counters in args —
+    # the devchain lane of docs/observability.md
+    _trace.complete(
+        "devchain",
+        f"devchain[{members[0].instance_name}…x{len(members)}]", t_chain,
+        args={"members": len(members),
+              "frames": fused._frames_dispatched,
+              "dispatches": fused._dispatches,
+              "frames_per_dispatch": fused.k_batch,
+              "per_member": {b.instance_name: fused._frames_dispatched
+                             for b in members}})
+    _finish_all()

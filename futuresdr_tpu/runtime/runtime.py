@@ -99,15 +99,20 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                                    fg_inbox: BlockInbox,
                                    initialized: ReplySlot) -> Flowgraph:
     """The per-flowgraph supervisor (`runtime.rs:363-597`)."""
+    from .devchain import (find_device_chains, run_devchain_task,
+                           shed_devchain_bridge)
     from .fastchain import (find_native_chains, run_chain_task,
                             shed_metrics_bridge)
     t_sup = _trace.now()
     chain_kernels = find_native_chains(fg)
+    dev_chains = find_device_chains(fg)
     blocks = fg.take_blocks()
     by_id: Dict[int, WrappedKernel] = {b.id: b for b in blocks}
     # native fast-chain substitution (see fastchain.py): whole pipes of trivial
     # stream blocks run in one C++ thread instead of per-block actor tasks; the
-    # chain task speaks the same supervisor protocol for every member
+    # chain task speaks the same supervisor protocol for every member.
+    # Device-graph fusion (see devchain.py) does the same for device-plane
+    # runs: one fused TpuKernel dispatch per frame instead of one per hop.
     wk = {id(b.kernel): b for b in blocks}
     fused: set = set()
     chain_tasks = []
@@ -115,15 +120,24 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
         members = [wk[id(k)] for k in ch]
         fused.update(id(b) for b in members)
         chain_tasks.append((members, getattr(ch, "in_ring", None)))
+    dev_tasks = []
+    for ch in dev_chains:
+        members = [wk[id(k)] for k in ch]
+        fused.update(id(b) for b in members)
+        dev_tasks.append((members, ch))
     actor_blocks = [b for b in blocks if id(b) not in fused]
     for b in actor_blocks:
         # a kernel that fused in a PREVIOUS flowgraph but runs the actor path
-        # now sheds its stale metrics bridge (fastchain owns the convention)
+        # now sheds its stale metrics bridge (each pass owns its convention)
         shed_metrics_bridge(b.kernel)
+        shed_devchain_bridge(b.kernel)
     handles = scheduler.run_flowgraph_blocks(actor_blocks, fg_inbox)
     for members, inr in chain_tasks:
         handles.append(scheduler.spawn(
             run_chain_task(members, fg_inbox, scheduler, in_ring=inr)))
+    for members, ch in dev_tasks:
+        handles.append(scheduler.spawn(
+            run_devchain_task(members, ch, fg_inbox, scheduler)))
 
     # ---- init barrier (`runtime.rs:380-415`) --------------------------------
     t_barrier = _trace.now()

@@ -29,7 +29,7 @@ from ..ops.stages import Pipeline, Stage
 from .instance import TpuInstance, instance
 
 __all__ = ["autotune", "autotune_streamed", "default_frames", "measure_link",
-           "pick_wire"]
+           "pick_wire", "StreamedResults"]
 
 log = logger("tpu.autotune")
 
@@ -163,17 +163,28 @@ def pick_wire(h2d_Bps: float, d2h_Bps: float, in_dtype, out_dtype,
 
 
 def _measure_wired(pipe: Pipeline, wire, frame: int, depth: int,
-                   inst: TpuInstance, min_seconds: float) -> float:
+                   inst: TpuInstance, min_seconds: float,
+                   k: int = 1) -> float:
     """Msamples/s through the PIPELINED wired drain loop (encode → staged H2D →
     fused decode/compute/encode → read-ahead D2H → decode), the loop TpuKernel
-    runs — so the number includes host codec cost and honors any fake link."""
+    runs — so the number includes host codec cost and honors any fake link.
+    ``k`` is the megabatch frames-per-dispatch (``Pipeline.compile_wired(k=)``):
+    each program call scans k frames, so dispatch overhead is paid once per k."""
     from ..ops.wire import get_wire
     wire = get_wire(wire)
-    fn, carry = pipe.compile_wired(frame, wire, device=inst.device)
+    fn, carry = pipe.compile_wired(frame, wire, device=inst.device, k=k)
     host = np.zeros(frame, dtype=pipe.in_dtype)
-    parts = wire.encode_host(host)
+
+    def encode_group():
+        if k == 1:
+            return wire.encode_host(host)
+        groups = [wire.encode_host(host) for _ in range(k)]
+        return tuple(np.stack([np.asarray(g[j]) for g in groups])
+                     for j in range(len(groups[0])))
+
     import jax
-    dev = tuple(jax.device_put(np.asarray(p), inst.device) for p in parts)
+    dev = tuple(jax.device_put(np.asarray(p), inst.device)
+                for p in encode_group())
     carry, y = fn(carry, *dev)              # warmup compile off the clock
     jax.block_until_ready(y)
     staged: deque = deque()
@@ -182,46 +193,71 @@ def _measure_wired(pipe: Pipeline, wire, frame: int, depth: int,
     t0 = time.perf_counter()
     while True:
         staged.append(xfer.start_device_transfer_parts(
-            wire.encode_host(host), inst.device))
+            encode_group(), inst.device))
         while staged and len(inflight) < depth:
             carry, y_parts = fn(carry, *staged.popleft()())
             inflight.append(xfer.start_host_transfer_parts(y_parts))
-            n_frames += 1
+            n_frames += k
         if len(inflight) >= depth:
-            wire.decode_host(inflight.popleft()(), pipe.out_dtype)
+            raw = inflight.popleft()()
+            if k == 1:
+                wire.decode_host(raw, pipe.out_dtype)
+            else:                           # stacked parts decode per frame
+                for i in range(k):
+                    wire.decode_host(tuple(p[i] for p in raw), pipe.out_dtype)
         if n_frames % 4 == 0 and time.perf_counter() - t0 > min_seconds:
             break
         if n_frames > 10000:
             break
     for fin in inflight:
-        wire.decode_host(fin(), pipe.out_dtype)
+        fin()                               # land the tail transfers
     dt = time.perf_counter() - t0
     return n_frames * frame / dt / 1e6
+
+
+class StreamedResults(dict):
+    """The ``autotune_streamed`` sweep matrix: a plain dict keyed by
+    ``(wire, frame, depth, k)`` (so it iterates/sorts uniformly), with the
+    winning megabatch size stamped as the ``frames_per_dispatch`` ATTRIBUTE —
+    feed it to ``TpuKernel(frames_per_dispatch=…)``."""
+
+    frames_per_dispatch: int = 1
 
 
 def autotune_streamed(stages: Sequence[Stage], in_dtype,
                       wires: Optional[Sequence[str]] = None,
                       frames: Optional[Sequence[int]] = None,
                       depths: Sequence[int] = (2, 4, 8),
+                      ks: Sequence[int] = (1, 4),
                       min_seconds: float = 0.3,
                       min_snr_db: Optional[float] = 60.0,
                       inst: Optional[TpuInstance] = None
                       ) -> Tuple[str, int, int, Dict]:
     """Returns ``(best_wire, best_frame, best_depth, results)`` for the
-    STREAMED path; ``results[(wire, frame, depth)] = Msps``.
+    STREAMED path; ``results[(wire, frame, depth, k)] = Msps`` (a
+    :class:`StreamedResults`), and the winning megabatch size is stamped at
+    ``results.frames_per_dispatch`` (an attribute, so the dict itself stays a
+    uniformly tuple-keyed matrix).
+
+    ``ks`` sweeps the megabatch frames-per-dispatch axis (``lax.scan`` of k
+    frames per program call, ``ops/stages.py``): K>1 amortizes per-dispatch
+    host overhead, which dominates small-frame throughput on the CPU backend
+    and behind high-RTT links — but the scan's static shape costs padding at
+    EOS and K-1 frames of trickle latency, so K=1 stays the default whenever
+    the measured gain does not beat it.
 
     An explicit (non-"auto") ``config.tpu_wire_format`` /
-    ``FUTURESDR_TPU_WIRE_FORMAT`` pins the wire and only (frame, depth) are
+    ``FUTURESDR_TPU_WIRE_FORMAT`` pins the wire and only (frame, depth, k) are
     swept. Otherwise the candidate set is the analytic pick from the measured
     link envelope (:func:`pick_wire`) plus ``f32`` as the exact baseline, so
     the sweep stays small and the chosen format's advantage is measured, not
     assumed."""
     from ..config import config
     inst = inst or instance()
-    # ONE Pipeline for everything: wired_fn caches per wire name on the
+    # ONE Pipeline for everything: wired_fn caches per (wire name, k) on the
     # instance, so the jit function identity stays stable and each (wire,
-    # frame) shape compiles once — not once per depth (compile_wired hands out
-    # a fresh carry per call, so reuse across measurements is safe)
+    # frame, k) shape compiles once — not once per depth (compile_wired hands
+    # out a fresh carry per call, so reuse across measurements is safe)
     pipe = Pipeline(list(stages), in_dtype)
     if wires is None:
         pinned = config().tpu_wire_format
@@ -236,24 +272,29 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
                      up / 1e6, down / 1e6, wires)
     if frames is None:
         frames = default_frames(inst.platform)
-    results: Dict[Tuple[str, int, int], float] = {}
-    best = ("f32", 0, 0)
+    results = StreamedResults()
+    best = ("f32", 0, 0, 1)
     best_rate = -1.0
     m = pipe.frame_multiple
     for wname in wires:
         for f in frames:
             f = max(m, (f // m) * m)
             for d in depths:
-                try:
-                    rate = _measure_wired(pipe, wname, f, d, inst, min_seconds)
-                except Exception as e:   # OOM at large frames, etc.
-                    log.warning("autotune_streamed (%s, %d, %d) failed: %r",
-                                wname, f, d, e)
-                    continue
-                results[(wname, f, d)] = round(rate, 1)
-                if rate > best_rate:
-                    best_rate = rate
-                    best = (wname, f, d)
-    log.info("autotune_streamed best: wire=%s frame=%d depth=%d (%.1f Msps)",
-             *best, best_rate)
+                for k in dict.fromkeys(ks):
+                    try:
+                        rate = _measure_wired(pipe, wname, f, d, inst,
+                                              min_seconds, k=k)
+                    except Exception as e:   # OOM at large frames, etc.
+                        log.warning(
+                            "autotune_streamed (%s, %d, %d, k=%d) failed: %r",
+                            wname, f, d, k, e)
+                        continue
+                    results[(wname, f, d, k)] = round(rate, 1)
+                    # ties go to K=1: scan overhead must EARN its latency
+                    if rate > best_rate:
+                        best_rate = rate
+                        best = (wname, f, d, k)
+    results.frames_per_dispatch = best[3]
+    log.info("autotune_streamed best: wire=%s frame=%d depth=%d k=%d "
+             "(%.1f Msps)", *best, best_rate)
     return best[0], best[1], best[2], results

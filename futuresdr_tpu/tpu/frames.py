@@ -207,9 +207,13 @@ class TpuStage(Kernel):
         self.pipeline = Pipeline(stages, in_dtype)
         self._compiled = None
         self._carry = None
+        self._dispatches = 0                   # per-frame program invocations
         self._pending_ctrl: List[tuple] = []   # ctrl before the first frame
         self.input = self.add_inplace_input("in")
         self.output = self.add_inplace_output("out")
+
+    def extra_metrics(self) -> dict:
+        return {"dispatches": self._dispatches}
 
     @message_handler(name="ctrl")
     async def ctrl_handler(self, io, mio, meta, p):
@@ -254,6 +258,7 @@ class TpuStage(Kernel):
                 self._pending_ctrl.clear()
             t0 = _trace.now() if _trace.enabled else 0
             self._carry, y = self._compiled(self._carry, frame)   # async dispatch
+            self._dispatches += 1
             if t0:
                 _trace.complete("tpu", "compute", t0,
                                 args={"frame": int(frame.shape[0])})

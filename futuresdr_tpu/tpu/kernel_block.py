@@ -50,15 +50,27 @@ class TpuKernel(Kernel):
                  frame_size: Optional[int] = None,
                  inst: Optional[TpuInstance] = None,
                  frames_in_flight: Optional[int] = None,
-                 wire=None):
+                 wire=None, frames_per_dispatch: Optional[int] = None,
+                 _pipeline: Optional[Pipeline] = None):
         super().__init__()
+        from ..config import config
         self.inst = inst or instance()
-        self.pipeline = Pipeline(stages, in_dtype)
+        self.pipeline = _pipeline if _pipeline is not None \
+            else Pipeline(stages, in_dtype)
         fs = frame_size or self.inst.frame_size
         m = self.pipeline.frame_multiple
         self.frame_size = max(m, (fs // m) * m)
         self.out_frame = self.pipeline.out_items(self.frame_size)
         self.depth = frames_in_flight or self.inst.frames_in_flight
+        # megabatch K: lax.scan K frames through the compiled program per
+        # dispatch (ops/stages.py wired_fn(k)) — per-call host overhead is paid
+        # once per K frames instead of once per frame. A partial batch is only
+        # flushed at EOS (zero-padded; pad outputs dropped): padding mid-stream
+        # would corrupt the stage carries (filter history, oscillator phase)
+        # of every later real frame, so K>1 trades up to K-1 frames of latency
+        # while the input trickles.
+        self.k_batch = max(1, int(frames_per_dispatch
+                                  or config().tpu_frames_per_dispatch))
         # H2D staging read-ahead BEYOND the in-flight budget: at steady state
         # the in-flight deque is full, so without extra headroom a frame would
         # be staged and launched in the same work cycle — its wire time would
@@ -72,43 +84,57 @@ class TpuKernel(Kernel):
         self._needs_staging = xfer.h2d_needs_staging(self.inst.platform)
         self._compiled = None
         self._carry = None
-        # H2D started, compute not yet dispatched: (h2d_finish, valid_in, tags)
-        self._staged: Deque[Tuple[object, int, tuple]] = deque()
-        # compute dispatched, D2H riding: (d2h_finish, valid_out, rebased tags)
-        self._inflight: Deque[Tuple[object, int, tuple]] = deque()
+        # frames consumed from the ring, awaiting a full K-batch (k_batch > 1
+        # only): (host frame, valid_in, tags)
+        self._accum: List[Tuple[np.ndarray, int, tuple]] = []
+        # H2D started, compute not yet dispatched: (h2d_finish, metas) with
+        # metas = one (valid_in, tags) per real frame of the dispatch group
+        self._staged: Deque[Tuple[object, tuple]] = deque()
+        # compute dispatched, D2H riding: (d2h_finish, out_metas) with
+        # out_metas = one (valid_out, rebased tags) per real frame
+        self._inflight: Deque[Tuple[object, tuple]] = deque()
         self._pending_out: Optional[np.ndarray] = None
         self._pending_tags: List[ItemTag] = []
         self._frames_dispatched = 0
+        self._dispatches = 0
         self.input = self.add_stream_input("in", in_dtype, min_items=self.frame_size)
         self.output = self.add_stream_output(
             "out", self.pipeline.out_dtype, min_items=self.out_frame,
-            min_buffer_size=(self.depth + 1) * self.out_frame *
+            min_buffer_size=(self.depth * self.k_batch + 1) * self.out_frame *
             np.dtype(self.pipeline.out_dtype).itemsize)
 
     def extra_metrics(self) -> dict:
         return {
             "frame_size": self.frame_size,
             "wire": self.wire.name,
-            "frames_staged": len(self._staged),
-            "frames_in_flight": len(self._inflight),
+            "frames_per_dispatch": self.k_batch,
+            "frames_staged": sum(len(m) for _, m in self._staged)
+            + len(self._accum),
+            "frames_in_flight": sum(len(m) for _, m in self._inflight),
             "frames_dispatched": self._frames_dispatched,
+            "dispatches": self._dispatches,
         }
 
     async def init(self, mio, meta):
         import jax
         self._compiled, self._carry = self.pipeline.compile_wired(
-            self.frame_size, self.wire, device=self.inst.device)
+            self.frame_size, self.wire, device=self.inst.device,
+            k=self.k_batch)
         # warm the compile cache off the hot path (raw device_put: the fake
         # link must not bill warmup bytes), then reset the carry state
         parts = self.wire.encode_host(
             np.zeros(self.frame_size, dtype=self.pipeline.in_dtype))
+        if self.k_batch > 1:
+            parts = tuple(np.stack([np.asarray(p)] * self.k_batch)
+                          for p in parts)
         dev = tuple(jax.device_put(np.asarray(p), self.inst.device)
                     for p in parts)
         warm_carry, y = self._compiled(self._carry, *dev)
         jax.block_until_ready(y)
         del warm_carry  # donated buffers; fresh carry below
         _, self._carry = self.pipeline.compile_wired(
-            self.frame_size, self.wire, device=self.inst.device)
+            self.frame_size, self.wire, device=self.inst.device,
+            k=self.k_batch)
 
     @message_handler(name="ctrl")
     async def ctrl_handler(self, io, mio, meta, p: Pmt) -> Pmt:
@@ -136,27 +162,61 @@ class TpuKernel(Kernel):
     # -- helpers ---------------------------------------------------------------
     def _stage(self, frame: np.ndarray, valid_in: int,
                tags: Sequence[ItemTag] = ()) -> None:
-        """Encode one frame into wire parts and START its H2D; compute dispatch
-        waits for :meth:`_launch_staged`. ``valid_in`` (a frame_multiple
-        multiple) bounds how much of the output is real data vs zero-pad tail;
-        ``tags`` are frame-relative."""
+        """Queue one frame toward a dispatch group. ``k_batch == 1``: encode
+        into wire parts and START its H2D immediately (compute dispatch waits
+        for :meth:`_launch_staged`). ``k_batch > 1``: accumulate until the
+        group fills, then :meth:`_flush_accum` ships the whole batch as one
+        transfer. ``valid_in`` (a frame_multiple multiple) bounds how much of
+        the output is real data vs zero-pad tail; ``tags`` are frame-relative."""
+        if self.k_batch == 1:
+            t0 = _trace.now() if _trace.enabled else 0
+            parts = self.wire.encode_host(frame)
+            if t0:
+                _trace.complete("tpu", "encode", t0,
+                                args={"wire": self.wire.name,
+                                      "items": len(frame)})
+            self._staged.append((xfer.start_device_transfer_parts(
+                parts, self.inst.device), ((valid_in, tuple(tags)),)))
+            return
+        self._accum.append((frame, valid_in, tuple(tags)))
+        if len(self._accum) >= self.k_batch:
+            self._flush_accum()
+
+    def _flush_accum(self) -> None:
+        """Encode the accumulated frames, stack each wire part along a leading
+        ``[k]`` frame axis and start ONE H2D for the dispatch group. A partial
+        group (EOS only) is zero-padded to the static scan length; the pad
+        frames' outputs are dropped at drain (no meta entry) and their carry
+        effect is moot — nothing real follows them."""
+        if not self._accum:
+            return
+        group, self._accum = self._accum, []
+        frames = [f for f, _, _ in group]
+        while len(frames) < self.k_batch:
+            frames.append(np.zeros(self.frame_size,
+                                   dtype=self.pipeline.in_dtype))
         t0 = _trace.now() if _trace.enabled else 0
-        parts = self.wire.encode_host(frame)
+        parts_list = [self.wire.encode_host(f) for f in frames]
+        stacked = tuple(np.stack([np.asarray(p[j]) for p in parts_list])
+                        for j in range(len(parts_list[0])))
         if t0:
             _trace.complete("tpu", "encode", t0,
-                            args={"wire": self.wire.name, "items": len(frame)})
+                            args={"wire": self.wire.name,
+                                  "items": len(group) * self.frame_size,
+                                  "frames": len(group)})
+        metas = tuple((v, t) for _, v, t in group)
         self._staged.append((xfer.start_device_transfer_parts(
-            parts, self.inst.device), valid_in, tags))
+            stacked, self.inst.device), metas))
 
     def _launch_staged(self) -> None:
-        """Dispatch compute for staged frames, oldest first, and start each
-        result's D2H immediately. Waiting happens only on the OLDEST frame's
+        """Dispatch compute for staged groups, oldest first, and start each
+        result's D2H immediately. Waiting happens only on the OLDEST group's
         remaining H2D wire time — younger frames keep transferring, dispatched
         frames keep computing, finished frames' D2H keeps draining: the
         H2D(t+1) ∥ compute(t) ∥ D2H(t−1) overlap of the reference's circulating
         h2d/d2h staging pairs, on XLA's async dispatch queue."""
         while self._staged and len(self._inflight) < self.depth:
-            h2d, valid_in, tags = self._staged.popleft()
+            h2d, metas = self._staged.popleft()
             x_parts = h2d()
             t0 = _trace.now() if _trace.enabled else 0
             self._carry, y_parts = self._compiled(self._carry, *x_parts)
@@ -165,27 +225,46 @@ class TpuKernel(Kernel):
                 # backend (synchronous jit) — either way this is the compute
                 # lane's occupancy as this host thread observes it
                 _trace.complete("tpu", "compute", t0,
-                                args={"frame": self.frame_size})
+                                args={"frame": self.frame_size,
+                                      "frames": len(metas)})
             # start the D2H immediately: the transfer rides the wire the moment
             # the frame finishes instead of waiting for _drain_one's sync
             # (read-ahead, VERDICT r2 weak 2)
             finish = xfer.start_host_transfer_parts(y_parts)
-            valid_out = min(self.pipeline.out_items(valid_in), self.out_frame)
-            self._inflight.append((finish, valid_out,
-                                   tuple(rebase_frame_tags(tags, self.pipeline,
-                                                           valid_out))))
-            self._frames_dispatched += 1
+            out_metas = []
+            for valid_in, tags in metas:
+                valid_out = min(self.pipeline.out_items(valid_in),
+                                self.out_frame)
+                out_metas.append((valid_out,
+                                  tuple(rebase_frame_tags(tags, self.pipeline,
+                                                          valid_out))))
+            self._inflight.append((finish, tuple(out_metas)))
+            self._frames_dispatched += len(metas)
+            self._dispatches += 1
 
-    def _drain_one(self) -> Tuple[np.ndarray, tuple]:
-        finish, valid, tags = self._inflight.popleft()
+    def _drain_one(self) -> Tuple[np.ndarray, list]:
+        finish, out_metas = self._inflight.popleft()
         # sync point: blocks only this block's thread
         raw = finish()
         t0 = _trace.now() if _trace.enabled else 0
-        arr = self.wire.decode_host(raw, self.pipeline.out_dtype)
+        if self.k_batch == 1:
+            ((valid, tags),) = out_metas
+            arr = self.wire.decode_host(raw, self.pipeline.out_dtype)
+            result, all_tags = arr[:valid], list(tags)
+        else:
+            chunks, all_tags, off = [], [], 0
+            for i, (valid, tags) in enumerate(out_metas):
+                row = tuple(p[i] for p in raw)
+                chunks.append(
+                    self.wire.decode_host(row, self.pipeline.out_dtype)[:valid])
+                all_tags.extend(ItemTag(t.index + off, t.tag) for t in tags)
+                off += valid
+            result = (np.concatenate(chunks) if chunks
+                      else np.empty(0, dtype=self.pipeline.out_dtype))
         if t0:
             _trace.complete("tpu", "decode", t0,
-                            args={"wire": self.wire.name, "items": valid})
-        return arr[:valid], tags
+                            args={"wire": self.wire.name, "items": len(result)})
+        return result, all_tags
 
     async def work(self, io, mio, meta):
         # 1. flush pending host-side output first
@@ -231,6 +310,10 @@ class TpuKernel(Kernel):
             self._stage(frame, n - (n % self.pipeline.frame_multiple), tags)
             self.input.consume(n)
             inp = self.input.slice()
+        if eos and self._accum:
+            # EOS: a partial dispatch group cannot wait for more frames —
+            # zero-pad it to the scan length and ship (pad outputs dropped)
+            self._flush_accum()
 
         # 3. launch compute on staged frames (their transfers have been riding
         #    since step 2) and start each result's D2H
@@ -249,7 +332,7 @@ class TpuKernel(Kernel):
             return
 
         if eos and not self._inflight and not self._staged and \
-                self._pending_out is None and len(inp) == 0:
+                not self._accum and self._pending_out is None and len(inp) == 0:
             io.finished = True
-        elif eos and (self._inflight or self._staged):
+        elif eos and (self._inflight or self._staged or self._accum):
             io.call_again = True

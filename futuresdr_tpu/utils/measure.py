@@ -28,7 +28,8 @@ import numpy as np
 
 from ..ops.xfer import to_host
 
-__all__ = ["run_marginal", "run_marginal_retry"]
+__all__ = ["run_marginal", "run_marginal_retry", "default_k_pair",
+           "scaled_k_pair"]
 
 
 def run_marginal(step: Callable, carry0, x, k_pair: Tuple[int, int] = (512, 1024),
@@ -89,6 +90,25 @@ def default_k_pair(platform: str) -> Tuple[int, int]:
     dispatches in µs, so short scans keep fallback runs fast. THE single source of
     these constants — bench.py and every perf/ harness route through here."""
     return (512, 1024) if platform == "tpu" else (8, 16)
+
+
+def scaled_k_pair(k_pair: Tuple[int, int], frame_items: int, platform: str,
+                  min_lo_items: int = None) -> Tuple[int, int]:
+    """Grow a scan pair so ONE ``k_lo`` scan covers a worthwhile timed window.
+
+    Small frames make sub-ms scans where scheduler noise dominates the
+    marginal (r4: lora_msps 58–182 across rounds on the CPU backend); behind
+    an accelerator dispatch path, per-RPC jitter (tens of ms through the
+    tunnel) swamps a tens-of-ms scan delta the same way (r5:
+    ``lora_msps_runs`` spread ±80%, ``wlan`` run 1 a cold outlier). Scale the
+    pair so the k_lo scan covers ≥2M samples on the CPU backend and ≥512M on
+    accelerators (≈0.2 s of compute at the measured ~2.9 Gsps chain rate —
+    the k_hi−k_lo delta then dwarfs per-dispatch jitter). THE shared window
+    discipline of bench.py / perf/lora.py / perf/wlan.py."""
+    if min_lo_items is None:
+        min_lo_items = 2_000_000 if platform == "cpu" else 512_000_000
+    scale = max(1, -(-min_lo_items // (k_pair[0] * max(1, frame_items))))
+    return (k_pair[0] * scale, k_pair[1] * scale)
 
 
 def run_marginal_retry(step: Callable, carry0, x,

@@ -31,6 +31,7 @@ FUZZES = [
     ("tests.test_zigbee", "test_random_payload_roundtrip_fuzz"),
     ("tests.test_fastchain_dsp", "test_random_chain_shapes_fuzz"),
     ("tests.test_fastchain_tree", "test_random_tree_shapes_fuzz"),
+    ("tests.test_devchain", "test_random_devchain_shapes_fuzz"),
     ("tests.test_integrity_fuzz", "test_zigbee_accepts_are_exact_at_any_snr"),
     ("tests.test_integrity_fuzz", "test_lora_crc_flagged_accepts_are_exact_at_any_snr"),
     ("tests.test_integrity_fuzz", "test_rattlegram_accepts_are_exact_at_any_snr"),

@@ -26,15 +26,15 @@ def run_device_resident(sf: int, symbols_per_frame: int, k_pair) -> tuple:
     import jax
     from futuresdr_tpu.ops.stages import Pipeline, lora_demod_stage
     from futuresdr_tpu.ops.xfer import to_device
-    from futuresdr_tpu.utils.measure import run_marginal_retry
+    from futuresdr_tpu.utils.measure import run_marginal_retry, scaled_k_pair
 
     pipe = Pipeline([lora_demod_stage(sf)], np.complex64)
     frame = (1 << sf) * symbols_per_frame
-    # small frames (SF7: 8k samples) at the CPU k_pair make sub-ms timed
-    # windows where scheduler noise dominated (r4: 58-182 Msps spread);
-    # scale the scan lengths so one k_lo scan covers ≥2M samples (~20 ms)
-    scale = max(1, -(-2_000_000 // (k_pair[0] * frame)))
-    k_pair = (k_pair[0] * scale, k_pair[1] * scale)
+    # scan-window scaling (shared discipline, utils/measure.scaled_k_pair):
+    # small frames make sub-ms timed windows where scheduler noise dominated
+    # (r4: 58-182 Msps spread on CPU); accelerator dispatch jitter needs far
+    # larger windows still (r5: lora_msps_runs spread ±80% on the tunnel)
+    k_pair = scaled_k_pair(k_pair, frame, jax.default_backend())
     rng = np.random.default_rng(11)
     host = (rng.standard_normal(frame)
             + 1j * rng.standard_normal(frame)).astype(np.complex64)

@@ -1,0 +1,358 @@
+"""Device-graph fusion (runtime/devchain.py): fused-vs-actor equivalence.
+
+The fusion pass collapses ``TpuH2D → TpuStage* → TpuD2H`` runs (and adjacent
+``TpuKernel`` pairs) into ONE fused TpuKernel dispatch per frame. The contract
+tested here is the hard one: the fused flowgraph's output must be
+BIT-IDENTICAL to the per-hop actor flowgraph (boundary carry-stash fences pin
+each member segment's numerics), tags must rebase through the composed rate
+contract, refusal cases must stay on the actor path, and the declined mode
+(``FSDR_NO_DEVCHAIN=1``) must stand alone.
+"""
+
+import os
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import VectorSink, VectorSource
+from futuresdr_tpu.dsp import firdes
+from futuresdr_tpu.ops import fir_stage, mag2_stage, rotator_stage
+from futuresdr_tpu.runtime.devchain import find_device_chains
+from futuresdr_tpu.tpu import TpuD2H, TpuH2D, TpuKernel, TpuStage
+
+
+@contextmanager
+def _no_devchain(on: bool = True):
+    old = os.environ.pop("FSDR_NO_DEVCHAIN", None)
+    if on:
+        os.environ["FSDR_NO_DEVCHAIN"] = "1"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("FSDR_NO_DEVCHAIN", None)
+        else:
+            os.environ["FSDR_NO_DEVCHAIN"] = old
+
+
+def _stage_lists(split: str):
+    """The same 3-stage compute chain under different member splits."""
+    t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+    t2 = firdes.lowpass(0.2, 32).astype(np.float32)
+    s1 = fir_stage(t1, name="a")
+    s2 = fir_stage(t2, decim=4, name="b")
+    s3 = mag2_stage()
+    return {
+        "1|1|1": [[s1], [s2], [s3]],
+        "2|1": [[s1, s2], [s3]],
+        "1|2": [[s1], [s2, s3]],
+    }[split]
+
+
+def _frame_plane_fg(split: str, data, frame: int):
+    fg = Flowgraph()
+    src = VectorSource(data)
+    h2d = TpuH2D(np.complex64, frame_size=frame)
+    stages = [TpuStage(sl, np.complex64) for sl in _stage_lists(split)]
+    d2h = TpuD2H(np.float32)
+    snk = VectorSink(np.float32)
+    fg.connect_stream(src, "out", h2d, "in")
+    prev = h2d
+    for st in stages:
+        fg.connect_inplace(prev, "out", st, "in")
+        prev = st
+    fg.connect_inplace(prev, "out", d2h, "in")
+    fg.connect_stream(d2h, "out", snk, "in")
+    return fg, snk
+
+
+@pytest.mark.parametrize("split", ["1|1|1", "2|1", "1|2"])
+@pytest.mark.parametrize("frames_n", [1, 3])      # one-shot vs chunked stream
+def test_frame_plane_fused_bit_equals_actor(split, frames_n):
+    frame = 4096
+    rng = np.random.default_rng(7)
+    n = frames_n * frame
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+    with _no_devchain():
+        fg, snk = _frame_plane_fg(split, data, frame)
+        Runtime().run(fg)
+        ref = snk.items()
+    with _no_devchain(False):
+        fg, snk = _frame_plane_fg(split, data, frame)
+        assert len(find_device_chains(fg)) == 1     # the run actually fuses
+        Runtime().run(fg)
+        got = snk.items()
+    assert len(ref) == n // 4
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_kernel_run_fused_bit_equals_actor():
+    """Adjacent TpuKernels (stream-plane hops) fuse into one kernel too."""
+    t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+    rng = np.random.default_rng(8)
+    n = 4 * 4096
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+
+    def build():
+        fg = Flowgraph()
+        src = VectorSource(data)
+        k1 = TpuKernel([fir_stage(t1, decim=4)], np.complex64, frame_size=4096)
+        k2 = TpuKernel([mag2_stage()], np.complex64, frame_size=1024)
+        snk = VectorSink(np.float32)
+        fg.connect(src, k1, k2, snk)
+        return fg, snk
+
+    with _no_devchain():
+        fg, snk = build()
+        Runtime().run(fg)
+        ref = snk.items()
+    with _no_devchain(False):
+        fg, snk = build()
+        chains = find_device_chains(fg)
+        assert len(chains) == 1 and chains[0].kind == "kernels"
+        Runtime().run(fg)
+        got = snk.items()
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_fused_megabatch_bit_equals_actor():
+    """frames_per_dispatch > 1 (lax.scan megabatch) through the fused chain
+    keeps bit-equality, including the EOS partial batch padding."""
+    from futuresdr_tpu.config import config
+    frame = 4096
+    rng = np.random.default_rng(9)
+    n = 5 * frame                     # 5 frames: one K=2 batch stays partial
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+    with _no_devchain():
+        fg, snk = _frame_plane_fg("1|1|1", data, frame)
+        Runtime().run(fg)
+        ref = snk.items()
+    old = config().tpu_frames_per_dispatch
+    config().tpu_frames_per_dispatch = 2
+    try:
+        with _no_devchain(False):
+            fg, snk = _frame_plane_fg("1|1|1", data, frame)
+            Runtime().run(fg)
+            got = snk.items()
+    finally:
+        config().tpu_frames_per_dispatch = old
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_tags_rebase_through_decimating_fused_run():
+    """A tag crossing the FUSED device segment lands on the same rebased
+    output index as on the per-hop path (test_tpu_tags contract)."""
+    from tests.test_tpu_tags import (DECIM, TagRecordingSink,
+                                     TaggedRampSource, _expect)
+
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    n = 3 * 4096
+    with _no_devchain(False):
+        fg = Flowgraph()
+        src = TaggedRampSource(n)
+        h2d = TpuH2D(np.complex64, frame_size=4096)
+        st1 = TpuStage([fir_stage(taps, decim=DECIM)], np.complex64)
+        st2 = TpuStage([mag2_stage()], np.complex64)
+        d2h = TpuD2H(np.float32)
+        snk = TagRecordingSink(np.float32)
+        fg.connect_stream(src, "out", h2d, "in")
+        fg.connect_inplace(h2d, "out", st1, "in")
+        fg.connect_inplace(st1, "out", st2, "in")
+        fg.connect_inplace(st2, "out", d2h, "in")
+        fg.connect_stream(d2h, "out", snk, "in")
+        assert len(find_device_chains(fg)) == 1
+        Runtime().run(fg)
+    assert snk.n_received == n // DECIM
+    _expect(snk.seen)
+
+
+def test_fused_member_metrics_bridge():
+    """metrics() keeps reporting PER ORIGINAL BLOCK: fused provenance plus
+    item counters derived through the composed rate contract."""
+    frame = 4096
+    data = np.zeros(3 * frame, np.complex64)
+    with _no_devchain(False):
+        fg, snk = _frame_plane_fg("1|1|1", data, frame)
+        rt = Runtime()
+        running = rt.start(fg)
+        running.wait_sync()
+    wrapped = {b.instance_name: b for b in fg._blocks if b is not None}
+    mets = {n: b.metrics() for n, b in wrapped.items()}
+    fused = {n: m for n, m in mets.items() if m.get("fused_devchain")}
+    assert len(fused) == 5            # h2d + 3 stages + d2h
+    for m in fused.values():
+        assert m["devchain_frames"] == 3
+        assert m["devchain_dispatches"] >= 1
+    # rate contract: the decimating member (stage "b", block 3) reports in/4
+    st_dec = next(m for n, m in fused.items() if "TpuStage_3" in n)
+    assert st_dec["items_in"] == {"in": 3 * frame}
+    assert st_dec["items_out"] == {"out": 3 * frame // 4}
+
+
+# ---------------------------------------------------------------------------
+# refuse-to-fuse cases: the run must stay on the actor path
+# ---------------------------------------------------------------------------
+
+def test_refuses_wired_retune_handler_without_static_optin():
+    """A ctrl port wired to a MESSAGE EDGE refuses to fuse (live retunes are
+    stream-synchronized there); the fastchain_static-style ``devchain_static``
+    opt-in overrides."""
+    from futuresdr_tpu.blocks.message import MessageSource
+
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+
+    def build(static):
+        fg = Flowgraph()
+        src = VectorSource(np.zeros(8192, np.complex64))
+        h2d = TpuH2D(np.complex64, frame_size=4096)
+        st = TpuStage([fir_stage(taps, name="f")], np.complex64)
+        if static:
+            st.devchain_static = True
+        d2h = TpuD2H(np.complex64)
+        snk = VectorSink(np.complex64)
+        msg = MessageSource({"stage": "f", "taps": taps.tolist()}, interval=1.0)
+        fg.connect_stream(src, "out", h2d, "in")
+        fg.connect_inplace(h2d, "out", st, "in")
+        fg.connect_inplace(st, "out", d2h, "in")
+        fg.connect_stream(d2h, "out", snk, "in")
+        fg.connect_message(msg, "out", st, "ctrl")
+        return fg
+
+    with _no_devchain(False):
+        assert find_device_chains(build(static=False)) == []
+        assert len(find_device_chains(build(static=True))) == 1
+
+
+def test_refuses_mismatched_instances():
+    from futuresdr_tpu.tpu import TpuInstance
+
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(8192, np.complex64))
+    h2d = TpuH2D(np.complex64, frame_size=4096)
+    st = TpuStage([fir_stage(taps)], np.complex64, inst=TpuInstance())
+    d2h = TpuD2H(np.complex64)
+    snk = VectorSink(np.complex64)
+    fg.connect_stream(src, "out", h2d, "in")
+    fg.connect_inplace(h2d, "out", st, "in")
+    fg.connect_inplace(st, "out", d2h, "in")
+    fg.connect_stream(d2h, "out", snk, "in")
+    with _no_devchain(False):
+        assert find_device_chains(fg) == []
+
+
+def test_refuses_branching_port():
+    """A member output wired to several edges (broadcast) cannot fuse."""
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(8192, np.complex64))
+    k1 = TpuKernel([fir_stage(taps)], np.complex64, frame_size=4096)
+    k2 = TpuKernel([mag2_stage()], np.complex64, frame_size=4096)
+    snk = VectorSink(np.float32)
+    tap_snk = VectorSink(np.complex64)
+    fg.connect(src, k1, k2, snk)
+    fg.connect_stream(k1, "out", tap_snk, "in")   # second reader on the hop
+    with _no_devchain(False):
+        assert find_device_chains(fg) == []
+
+
+def test_refuses_frame_not_multiple_of_composed_contract():
+    """H2D frame below the composed frame multiple stays per-hop."""
+    from futuresdr_tpu.ops import fft_stage
+
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(8192, np.complex64))
+    h2d = TpuH2D(np.complex64, frame_size=1024)
+    st = TpuStage([fft_stage(2048)], np.complex64)   # needs 2048-multiples
+    d2h = TpuD2H(np.complex64)
+    snk = VectorSink(np.complex64)
+    fg.connect_stream(src, "out", h2d, "in")
+    fg.connect_inplace(h2d, "out", st, "in")
+    fg.connect_inplace(st, "out", d2h, "in")
+    fg.connect_stream(d2h, "out", snk, "in")
+    with _no_devchain(False):
+        assert find_device_chains(fg) == []
+
+
+def test_no_devchain_env_declines_everything():
+    frame = 4096
+    data = np.zeros(2 * frame, np.complex64)
+    with _no_devchain():
+        fg, snk = _frame_plane_fg("1|1|1", data, frame)
+        assert find_device_chains(fg) == []
+        Runtime().run(fg)                # the fallback path stands alone
+        assert len(snk.items()) == 2 * frame // 4
+
+
+# ---------------------------------------------------------------------------
+# fuzz family entry (perf/fuzz_campaign.py)
+# ---------------------------------------------------------------------------
+
+def test_random_devchain_shapes_fuzz():
+    """Randomized chain shapes: random stage mixes, member splits and frame
+    sizes — every fused run must bit-equal its per-hop actor run."""
+    master = np.random.default_rng(20250802)
+    for case in range(4):
+        rng = np.random.default_rng(master.integers(1 << 62))
+        frame = int(rng.choice([2048, 4096]))
+        n_frames = int(rng.integers(2, 5))
+        decim = int(rng.choice([1, 2, 4]))
+        nt = int(rng.choice([16, 33, 48]))
+        taps = firdes.lowpass(0.3, nt).astype(np.float32)
+        pool = [
+            # fft_len=512 keeps the OS hop (and so the composed frame
+            # multiple) at 256 — below every frame in the sweep
+            fir_stage(taps, fft_len=512, name="fa"),
+            fir_stage(firdes.lowpass(0.2, 24).astype(np.float32),
+                      decim=decim, fft_len=512, name="fb"),
+            rotator_stage(float(rng.uniform(-0.3, 0.3))),
+            mag2_stage(),
+        ]
+        n_stages = int(rng.integers(2, len(pool) + 1))
+        stages = pool[:n_stages]       # prefix keeps dtype contract valid
+        # random split into 1..n_stages member groups
+        cuts = sorted(rng.choice(range(1, n_stages),
+                                 size=int(rng.integers(0, n_stages)),
+                                 replace=False).tolist())
+        groups, lo = [], 0
+        for c in cuts + [n_stages]:
+            groups.append(stages[lo:c])
+            lo = c
+        n = n_frames * frame
+        data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+                ).astype(np.complex64)
+
+        def build():
+            fg = Flowgraph()
+            src = VectorSource(data)
+            h2d = TpuH2D(np.complex64, frame_size=frame)
+            sts = [TpuStage(list(g), np.complex64) for g in groups if g]
+            out_dt = np.float32 if any(
+                s.name == "mag2" for g in groups for s in g) else np.complex64
+            d2h = TpuD2H(out_dt)
+            snk = VectorSink(out_dt)
+            fg.connect_stream(src, "out", h2d, "in")
+            prev = h2d
+            for st in sts:
+                fg.connect_inplace(prev, "out", st, "in")
+                prev = st
+            fg.connect_inplace(prev, "out", d2h, "in")
+            fg.connect_stream(d2h, "out", snk, "in")
+            return fg, snk
+
+        with _no_devchain():
+            fg, snk = build()
+            Runtime().run(fg)
+            ref = snk.items()
+        with _no_devchain(False):
+            fg, snk = build()
+            Runtime().run(fg)
+            got = snk.items()
+        np.testing.assert_array_equal(
+            got, ref, err_msg=f"case {case}: frame={frame} groups="
+                              f"{[len(g) for g in groups]}")
