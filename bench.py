@@ -389,11 +389,19 @@ def main():
                         "a Chrome-trace JSON artifact; covers the in-process "
                         "measurements (guarded subprocess children record "
                         "their own rings and are not merged)")
+    p.add_argument("--doctor", action="store_true",
+                   help="run the flowgraph doctor over the streamed chain "
+                        "(telemetry/doctor.py): stamps bottleneck_lane and "
+                        "e2e_latency_p50/p99 into the result JSON and keeps "
+                        "the stall watchdog armed for the whole bench")
     args = p.parse_args()
 
-    if args.trace:
+    if args.trace or args.doctor:
         from futuresdr_tpu.telemetry import spans as _spans
         _spans.enable(True)
+    if args.doctor:
+        from futuresdr_tpu.telemetry import doctor as _doctor_mod
+        _doctor_mod.enable()
 
     if args.run_chain:
         _run_chain_child(args.run_chain)
@@ -525,6 +533,16 @@ def main():
         print(f"# streamed probe frame={f}: {r:.1f} Msps", file=sys.stderr)
         if r > probe_best:
             probe_best, stream_frame = r, f
+    doctor_scope_ns = 0
+    if args.doctor and not guarded:
+        # scope the attribution window to the sustained streamed runs: the CPU
+        # baseline and probe spans would otherwise dilute the lane unions.
+        # With --trace the ring must survive for the export, so the window is
+        # cut by timestamp instead of a destructive drain.
+        from futuresdr_tpu.telemetry import spans as _spans
+        doctor_scope_ns = _spans.SpanRecorder.now()
+        if not args.trace:
+            _spans.recorder().drain()
     runs = []
     stream_stats = {}
     per_run = max(args.stream_seconds / 3.0, 5.0)
@@ -546,6 +564,51 @@ def main():
     print(f"# streamed ({inst_.platform}, frame={stream_frame}): "
           f"median {stream_rate:.1f} Msps, runs {['%.1f' % r for r in runs]}",
           file=sys.stderr)
+
+    # flowgraph-doctor stamp (--doctor): bottleneck attribution over the
+    # streamed chain's trace window + e2e latency percentiles from the
+    # always-on histogram (telemetry/doctor.py). On guarded backends the
+    # triplet ran in subprocesses (own span rings), so one modest in-process
+    # run provides the trace window — same chain, same frame/depth.
+    doctor_extra = {}
+    if args.doctor:
+        from futuresdr_tpu.telemetry import doctor as _doctor_mod
+        from futuresdr_tpu.telemetry import spans as _spans
+        if guarded:
+            doctor_scope_ns = _spans.SpanRecorder.now()
+            if not args.trace:
+                _spans.recorder().drain()
+            try:
+                run_streamed(stream_frame * 4 * args.depth, stream_frame,
+                             args.depth)
+            except Exception as e:                      # noqa: BLE001
+                print(f"# doctor in-process streamed run failed: {e!r}",
+                      file=sys.stderr)
+        if args.trace:
+            # --trace keeps draining rights: report over a snapshot (cut to
+            # the streamed window by timestamp) so the export at the end
+            # still carries every recorded event
+            events = [e for e in _spans.recorder().snapshot()
+                      if e.t0_ns >= doctor_scope_ns]
+        else:
+            events = None          # report() drains the scoped ring itself
+        rep = _doctor_mod.report(events=events)
+        e2e = rep.get("e2e_latency") or {}
+        doctor_extra = {
+            "bottleneck_lane": rep.get("bottleneck_lane"),
+            "bottleneck_busy_frac": rep.get("bottleneck_busy_frac"),
+            "e2e_latency_p50": (round(e2e["p50_s"], 6)
+                                if e2e.get("p50_s") is not None else None),
+            "e2e_latency_p99": (round(e2e["p99_s"], 6)
+                                if e2e.get("p99_s") is not None else None),
+            "doctor_lanes": {n: round(v["busy_frac"], 4)
+                             for n, v in rep.get("lanes", {}).items()
+                             if v["spans"]},
+        }
+        print(f"# doctor: bottleneck={doctor_extra['bottleneck_lane']} "
+              f"({doctor_extra['bottleneck_busy_frac']}), e2e p50/p99 = "
+              f"{doctor_extra['e2e_latency_p50']}/"
+              f"{doctor_extra['e2e_latency_p99']} s", file=sys.stderr)
 
     # roofline accounting (VERDICT r3 item 7): XLA's own cost analysis of the
     # fused program turns the rate into an auditable efficiency claim; mfu is
@@ -681,6 +744,7 @@ def main():
         **link,
         **wire_extra,
         **roof,
+        **doctor_extra,
         **extras,
     }
     if not args.skip_extra_chains:

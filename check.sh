@@ -22,6 +22,12 @@ FSDR_NO_DEVCHAIN=1 JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_devchain.py tests/test_tpu_stages.py tests/test_tpu_tags.py \
     tests/test_tpu_frames.py tests/test_retune.py
 
+echo "== perf-regression gate (non-fatal; perf/regress.py vs BENCH_r*.json) =="
+# quick reduced bench on the CPU backend, graded against the committed
+# trajectory with a generous tolerance — warnings only, never fails the check
+FSDR_FORCE_CPU=1 JAX_PLATFORMS=cpu python perf/regress.py --run --quick || \
+    echo "WARNING: perf-regression gate could not be graded (non-fatal)"
+
 echo "== python suite =="
 python -m pytest tests/ -q
 

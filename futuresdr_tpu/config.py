@@ -40,14 +40,27 @@ class Config:
     # metrics registry (telemetry/prom.py) is always on, spans are opt-in.
     trace: bool = False                    # FUTURESDR_TPU_TRACE=1 records spans
     trace_ring: int = 1 << 16              # per-thread span ring capacity
+    # Flowgraph doctor (telemetry/doctor.py): the watchdog thread is opt-in;
+    # the latency histograms it reads are always on (metrics-plane contract).
+    doctor: bool = False                   # FUTURESDR_TPU_DOCTOR=1 starts the
+    #   stall watchdog when the first Runtime is constructed
+    doctor_interval: float = 1.0           # watchdog sampling period, seconds
+    doctor_window: int = 5                 # consecutive no-progress samples
+    #   before a trip (trip latency ≈ interval × window)
+    doctor_dir: str = ""                   # write flight-recorder dumps here
+    #   ("" = keep in memory only; served via GET /api/fg/{fg}/doctor/)
     # TPU-specific knobs (no reference analog; this is the compute-plane config).
     tpu_frame_size: int = 1 << 18          # samples per device frame
     tpu_frames_in_flight: int = 4          # dispatch pipeline depth
     tpu_wire_format: str = "auto"          # host↔device wire codec (ops/wire.py):
     #   "auto" | "f32" | "bf16" | "sc16" | "sc8"; env FUTURESDR_TPU_WIRE_FORMAT
-    tpu_frames_per_dispatch: int = 1       # megabatch K: frames lax.scan'ed through
+    tpu_frames_per_dispatch: int = 0       # megabatch K: frames lax.scan'ed through
     #   the compiled pipeline per program call (amortizes per-dispatch host
-    #   overhead; K=1 = one dispatch per frame); env FUTURESDR_TPU_FRAMES_PER_DISPATCH
+    #   overhead); env FUTURESDR_TPU_FRAMES_PER_DISPATCH.
+    #   0 = auto: one dispatch per frame, EXCEPT a device-graph-fused chain
+    #   that autotune_streamed already tuned in this process, which launches
+    #   with its measured K (runtime/devchain.py). An explicit 1 pins
+    #   dispatch-per-frame everywhere (latency-critical deployments).
     misc: dict = field(default_factory=dict)
 
     def get(self, key: str, default: Any = None) -> Any:
@@ -71,6 +84,8 @@ class Config:
                     v = v.lower() in ("1", "true", "yes", "on")
                 elif isinstance(cur, int) and not isinstance(cur, bool):
                     v = int(v)
+                elif isinstance(cur, float):
+                    v = float(v)
                 setattr(self, k, v)
             else:
                 self.misc[k] = v

@@ -42,6 +42,18 @@ _XFER_BYTES = _prom.counter(
 _XFER_TRANSFERS = _prom.counter(
     "fsdr_xfer_transfers_total", "transfers started on the host-device link",
     ("direction",))
+# per-transfer duration histogram (telemetry/hist.py log2 buckets) — always
+# on like the counters. Under the fake link the observed duration clamps to
+# the modeled wire window (true occupancy); on real backends it is the
+# stage→finish() DWELL as the drain loop experiences it, which includes any
+# read-ahead queue wait — a latency signal, not a pure wire-time measurement
+# (same semantics as the H2D/D2H trace spans, docs/observability.md)
+_XFER_HIST = _prom.histogram(
+    "fsdr_xfer_seconds",
+    "host-device transfer duration, start to landing (fake link: modeled "
+    "wire window)", ("direction",))
+_H2D_HIST = _XFER_HIST.labels(direction="h2d")
+_D2H_HIST = _XFER_HIST.labels(direction="d2h")
 
 
 def _span_bounds_ns(t0_ns: int, service: float, deadline: float) -> tuple:
@@ -227,13 +239,14 @@ def start_device_transfer_parts(parts, device=None):
     _XFER_BYTES.inc(nbytes, direction="h2d")
     _XFER_TRANSFERS.inc(direction="h2d")
     service, deadline = _reserve("h2d", nbytes)
-    t0 = time.perf_counter_ns() if _trace.enabled else 0
+    t0 = time.perf_counter_ns()
     devs = tuple(jax.device_put(p, device) for p in host)
 
     def finish():
         _wait_deadline(deadline)
-        if t0:
-            s, e = _span_bounds_ns(t0, service, deadline)
+        s, e = _span_bounds_ns(t0, service, deadline)
+        _H2D_HIST.observe((e - s) * 1e-9)
+        if _trace.enabled:
             _trace.complete("tpu", "H2D", s, end_ns=e, args={"bytes": nbytes})
         return devs
 
@@ -317,8 +330,7 @@ def start_host_transfer(arr, _instrument: bool = True):
                 _XFER_BYTES.inc(nbytes, direction="d2h")
                 _XFER_TRANSFERS.inc(direction="d2h")
             service, deadline = _reserve("d2h", nbytes)
-            t0 = time.perf_counter_ns() if (_instrument and _trace.enabled) \
-                else 0
+            t0 = time.perf_counter_ns() if _instrument else 0
             # both halves start NOW (async copy, or eager pool fetch when the
             # array type has no copy_to_host_async) — never serially in finish
             fr, fi = _start_fetch(r), _start_fetch(i)
@@ -330,8 +342,10 @@ def start_host_transfer(arr, _instrument: bool = True):
                 _wait_deadline(deadline)
                 if t0:
                     s, e = _span_bounds_ns(t0, service, deadline)
-                    _trace.complete("tpu", "D2H", s, end_ns=e,
-                                    args={"bytes": nbytes})
+                    _D2H_HIST.observe((e - s) * 1e-9)
+                    if _trace.enabled:
+                        _trace.complete("tpu", "D2H", s, end_ns=e,
+                                        args={"bytes": nbytes})
                 return out
 
             finish._wire = (service, deadline)
@@ -341,7 +355,7 @@ def start_host_transfer(arr, _instrument: bool = True):
         _XFER_BYTES.inc(nbytes, direction="d2h")
         _XFER_TRANSFERS.inc(direction="d2h")
     service, deadline = _reserve("d2h", nbytes)
-    t0 = time.perf_counter_ns() if (_instrument and _trace.enabled) else 0
+    t0 = time.perf_counter_ns() if _instrument else 0
     fetch = _start_fetch(arr)
 
     def finish():
@@ -349,7 +363,10 @@ def start_host_transfer(arr, _instrument: bool = True):
         _wait_deadline(deadline)
         if t0:
             s, e = _span_bounds_ns(t0, service, deadline)
-            _trace.complete("tpu", "D2H", s, end_ns=e, args={"bytes": nbytes})
+            _D2H_HIST.observe((e - s) * 1e-9)
+            if _trace.enabled:
+                _trace.complete("tpu", "D2H", s, end_ns=e,
+                                args={"bytes": nbytes})
         return out
 
     finish._wire = (service, deadline)
@@ -370,15 +387,16 @@ def start_host_transfer_parts(parts):
     nbytes = sum(int(getattr(p, "nbytes", 0)) for p in parts)
     _XFER_BYTES.inc(nbytes, direction="d2h")
     _XFER_TRANSFERS.inc(direction="d2h")
-    t0 = time.perf_counter_ns() if _trace.enabled else 0
+    t0 = time.perf_counter_ns()
 
     def finish():
         out = tuple(f() for f in fins)
-        if t0:
-            wires = [getattr(f, "_wire", (0.0, 0.0)) for f in fins]
-            service = min((s for s, _ in wires if s), default=0.0)
-            deadline = max((d for _, d in wires), default=0.0)
-            s, e = _span_bounds_ns(t0, service, deadline)
+        wires = [getattr(f, "_wire", (0.0, 0.0)) for f in fins]
+        service = min((s for s, _ in wires if s), default=0.0)
+        deadline = max((d for _, d in wires), default=0.0)
+        s, e = _span_bounds_ns(t0, service, deadline)
+        _D2H_HIST.observe((e - s) * 1e-9)
+        if _trace.enabled:
             _trace.complete("tpu", "D2H", s, end_ns=e, args={"bytes": nbytes})
         return out
 

@@ -13,6 +13,7 @@ import time
 from typing import Optional
 
 from ..log import logger
+from ..telemetry.doctor import WORK_DURATION as _WORK_DURATION
 from ..telemetry.spans import recorder as _trace_recorder
 from ..types import Pmt
 from .inbox import (BlockInbox, Call, Callback, Initialize, StreamInputDone,
@@ -41,6 +42,11 @@ class WrappedKernel:
         self.work_calls = 0
         self.work_time_s = 0.0
         self.messages_handled = 0
+        # bound histogram child, resolved ONCE (labels() takes the family
+        # lock); the per-work-call observe_sampled (1-in-8 systematic) is
+        # billed by the ≤3% telemetry overhead gate alongside the span guard
+        self._work_hist = _WORK_DURATION.labels(
+            block=kernel.meta.instance_name)
         # direct message dispatch state (message_output.py fast path): the
         # event loop publishes its WorkIo, owning loop and liveness so a
         # same-loop sender can invoke a sync handler in its own stack frame
@@ -234,6 +240,7 @@ class WrappedKernel:
                 end = time.perf_counter_ns()
                 self.work_time_s += (end - t0) * 1e-9
                 self.work_calls += 1
+                self._work_hist.observe_sampled((end - t0) * 1e-9)
                 if _trace.enabled:
                     _trace.complete("block", self.instance_name, t0, end_ns=end)
         except Exception as e:

@@ -15,6 +15,9 @@ plus the telemetry plane (docs/observability.md):
                                  + per-block families for every live flowgraph
   GET  /api/fg/{fg}/trace/     → drain the span ring as Chrome trace-event JSON
                                  (open in Perfetto / chrome://tracing)
+  GET  /api/fg/{fg}/doctor/    → flight-recorder dump + bottleneck attribution
+                                 (telemetry/doctor.py; ``?md=1`` renders
+                                 markdown instead of JSON)
 
 Pmt values are serialized with the same externally-tagged JSON as the reference's serde.
 CORS is permissive (including on error responses raised as ``web.HTTPException``);
@@ -107,6 +110,7 @@ class ControlPort:
         app.router.add_get("/api/fg/{fg}/", self._describe_fg)
         app.router.add_get("/api/fg/{fg}/metrics/", self._metrics)
         app.router.add_get("/api/fg/{fg}/trace/", self._trace)
+        app.router.add_get("/api/fg/{fg}/doctor/", self._doctor)
         app.router.add_get("/api/fg/{fg}/block/{blk}/", self._describe_block)
         app.router.add_get("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
         app.router.add_post("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
@@ -189,6 +193,34 @@ class ControlPort:
         rec = spans.recorder()
         events = rec.snapshot() if request.query.get("keep") else rec.drain()
         return web.json_response(rec.chrome_trace(events))
+
+    async def _doctor(self, request):
+        """Explicit flight-recorder trigger + bottleneck attribution (the
+        operator's "why is this flowgraph stuck" endpoint). Uses the
+        NON-destructive span snapshot so a concurrent trace consumer
+        (``bench.py --trace``, ``GET …/trace/``) keeps its events; 404s for
+        unknown flowgraphs to match the ``/api/fg/`` family (the doctor is
+        process-global, like the trace ring)."""
+        import json as _json
+
+        from aiohttp import web
+
+        from ..telemetry import doctor as doc
+        from ..telemetry import spans
+        fg = self._fg(request)
+        if fg is None:
+            return web.json_response({"error": "flowgraph not found"},
+                                     status=404)
+        d = doc.doctor()
+        record = d.flight_record("endpoint")
+        if request.query.get("md"):
+            return web.Response(text=doc.render_markdown(record),
+                                content_type="text/markdown")
+        body = {"report": d.report(events=spans.recorder().snapshot()),
+                "flight_record": record}
+        # default=str: span args / extra_metrics may carry numpy scalars
+        return web.json_response(
+            body, dumps=lambda o: _json.dumps(o, default=str))
 
     async def _describe_block(self, request):
         from aiohttp import web

@@ -356,6 +356,27 @@ def _build_fused(chain: DevChain):
         in_dtype = first.pipeline.in_dtype
         depth = first.depth
         k_batch = first.k_batch
+    if k_batch is None or (k_batch == 1 and not first._k_explicit):
+        from ..config import config
+        if int(config().tpu_frames_per_dispatch) == 0:
+            # ROADMAP follow-up: with the config knob unset (the default K=1),
+            # a chain that `autotune_streamed` already tuned in this process
+            # launches with ITS measured megabatch K — the sweep's verdict
+            # carries over to the fused dispatch without re-measuring (the
+            # cache key ignores the boundary fences, so the composed stage
+            # list maps back to the tuned chain). This inherits megabatching's
+            # latency contract: partial K-groups flush only at EOS, so a
+            # trickle/bursty source buffers up to K-1 frames — set
+            # tpu_frames_per_dispatch=1 explicitly to pin dispatch-per-frame
+            # for latency-critical chains (an explicit config always wins
+            # over the cache).
+            from ..tpu.autotune import cached_frames_per_dispatch
+            k = cached_frames_per_dispatch(stages, in_dtype,
+                                           first.inst.platform)
+            if k and k > 1:
+                log.info("devchain: frames_per_dispatch=%d from cached "
+                         "autotune_streamed pick", k)
+                k_batch = k
     # optimize=False: each member's internal numerics stay stage-for-stage
     # identical to the unfused run (cross-member LTI merging would convolve
     # taps and break the bit-equality contract); XLA still fuses elementwise

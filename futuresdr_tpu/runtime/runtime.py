@@ -99,6 +99,7 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                                    fg_inbox: BlockInbox,
                                    initialized: ReplySlot) -> Flowgraph:
     """The per-flowgraph supervisor (`runtime.rs:363-597`)."""
+    from ..telemetry.doctor import doctor as _doctor
     from .devchain import (find_device_chains, run_devchain_task,
                            shed_devchain_bridge)
     from .fastchain import (find_native_chains, run_chain_task,
@@ -114,144 +115,164 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
     # Device-graph fusion (see devchain.py) does the same for device-plane
     # runs: one fused TpuKernel dispatch per frame instead of one per hop.
     wk = {id(b.kernel): b for b in blocks}
-    fused: set = set()
-    chain_tasks = []
-    for ch in chain_kernels:
-        members = [wk[id(k)] for k in ch]
-        fused.update(id(b) for b in members)
-        chain_tasks.append((members, getattr(ch, "in_ring", None)))
-    dev_tasks = []
-    for ch in dev_chains:
-        members = [wk[id(k)] for k in ch]
-        fused.update(id(b) for b in members)
-        dev_tasks.append((members, ch))
-    actor_blocks = [b for b in blocks if id(b) not in fused]
-    for b in actor_blocks:
-        # a kernel that fused in a PREVIOUS flowgraph but runs the actor path
-        # now sheds its stale metrics bridge (each pass owns its convention)
-        shed_metrics_bridge(b.kernel)
-        shed_devchain_bridge(b.kernel)
-    handles = scheduler.run_flowgraph_blocks(actor_blocks, fg_inbox)
-    for members, inr in chain_tasks:
-        handles.append(scheduler.spawn(
-            run_chain_task(members, fg_inbox, scheduler, in_ring=inr)))
-    for members, ch in dev_tasks:
-        handles.append(scheduler.spawn(
-            run_devchain_task(members, ch, fg_inbox, scheduler)))
+    # flowgraph-doctor attachment (telemetry/doctor.py): the stall watchdog
+    # samples these blocks' progress counters and classifies wedges over the
+    # resolved stream edges; the finally below keeps completed flowgraphs out
+    # of the watch list, and an unexpected supervisor exit flight-records the
+    # terminal state before propagating
+    _doc = _doctor()
+    _doc_token = _doc.attach(blocks, [
+        (wk[id(e.src)], e.src_port, wk[id(e.dst)], e.dst_port)
+        for e in fg.stream_edges if id(e.src) in wk and id(e.dst) in wk])
+    try:
+        fused: set = set()
+        chain_tasks = []
+        for ch in chain_kernels:
+            members = [wk[id(k)] for k in ch]
+            fused.update(id(b) for b in members)
+            chain_tasks.append((members, getattr(ch, "in_ring", None)))
+        dev_tasks = []
+        for ch in dev_chains:
+            members = [wk[id(k)] for k in ch]
+            fused.update(id(b) for b in members)
+            dev_tasks.append((members, ch))
+        actor_blocks = [b for b in blocks if id(b) not in fused]
+        for b in actor_blocks:
+            # a kernel that fused in a PREVIOUS flowgraph but runs the actor
+            # path now sheds its stale metrics bridge (each pass owns its
+            # convention)
+            shed_metrics_bridge(b.kernel)
+            shed_devchain_bridge(b.kernel)
+        handles = scheduler.run_flowgraph_blocks(actor_blocks, fg_inbox)
+        for members, inr in chain_tasks:
+            handles.append(scheduler.spawn(
+                run_chain_task(members, fg_inbox, scheduler, in_ring=inr)))
+        for members, ch in dev_tasks:
+            handles.append(scheduler.spawn(
+                run_devchain_task(members, ch, fg_inbox, scheduler)))
 
-    # ---- init barrier (`runtime.rs:380-415`) --------------------------------
-    t_barrier = _trace.now()
-    for b in blocks:
-        b.inbox.send(Initialize())
-    waiting = len(blocks)
-    active = len(blocks)
-    finished: List[WrappedKernel] = []
-    errors: List[Exception] = []
-    queued: List[FlowgraphMessage] = []
-    while waiting > 0:
-        msg = await fg_inbox.recv()
-        if isinstance(msg, InitializedMsg):
-            waiting -= 1
-        elif isinstance(msg, BlockErrorMsg):
-            waiting -= 1
-            active -= 1
-            errors.append(msg.error)
-        elif isinstance(msg, BlockDoneMsg):
-            waiting -= 1
-            active -= 1
-            finished.append(msg.block)
-        else:
-            queued.append(msg)   # early control messages; replay after barrier
-
-    terminated = False
-    if errors:
+        # ---- init barrier (`runtime.rs:380-415`) ----------------------------
+        t_barrier = _trace.now()
         for b in blocks:
-            b.inbox.send(Terminate())
-        terminated = True
-
-    _trace.complete("runtime", "init_barrier", t_barrier,
-                    args={"blocks": len(blocks), "errors": len(errors)})
-
-    # ---- start signal (`runtime.rs:418-429`) --------------------------------
-    for b in blocks:
-        b.inbox.notify()
-    initialized.set(errors[0] if errors else None)
-
-    # ---- main loop (`runtime.rs:440-571`) -----------------------------------
-    def handle(msg: FlowgraphMessage):
-        nonlocal active, terminated
-        if isinstance(msg, BlockCallMsg):
-            blk = by_id.get(msg.block_id)
-            if blk is not None:
-                blk.inbox.send(Call(msg.port, msg.data))
-        elif isinstance(msg, BlockCallbackMsg):
-            blk = by_id.get(msg.block_id)
-            if blk is None:
-                msg.reply.set(Pmt.invalid_value())
+            b.inbox.send(Initialize())
+        waiting = len(blocks)
+        active = len(blocks)
+        finished: List[WrappedKernel] = []
+        errors: List[Exception] = []
+        queued: List[FlowgraphMessage] = []
+        while waiting > 0:
+            msg = await fg_inbox.recv()
+            if isinstance(msg, InitializedMsg):
+                waiting -= 1
+            elif isinstance(msg, BlockErrorMsg):
+                waiting -= 1
+                active -= 1
+                errors.append(msg.error)
+            elif isinstance(msg, BlockDoneMsg):
+                waiting -= 1
+                active -= 1
+                finished.append(msg.block)
             else:
-                blk.inbox.send(Callback(msg.port, msg.data, msg.reply))
-        elif isinstance(msg, DescribeMsg):
-            msg.reply.set(_describe(fg, blocks))
-        elif isinstance(msg, MetricsMsg):
-            msg.reply.set({b.instance_name: b.metrics() for b in blocks})
-        elif isinstance(msg, TerminateMsg):
-            if not terminated:
-                _trace.instant("runtime", "terminate_cascade",
-                               args={"reason": "requested"})
-                for b in blocks:
-                    b.inbox.send(Terminate())
-                terminated = True
-        elif isinstance(msg, BlockDoneMsg):
-            active -= 1
-            finished.append(msg.block)
-        elif isinstance(msg, BlockErrorMsg):
-            active -= 1
-            errors.append(msg.error)
-            if not terminated:
-                log.error("block %d errored (%r): terminating flowgraph",
-                          msg.block_id, msg.error)
-                _trace.instant("runtime", "terminate_cascade",
-                               args={"reason": "block_error",
-                                     "block": msg.block_id})
-                for b in blocks:
-                    b.inbox.send(Terminate())
-                terminated = True
+                queued.append(msg)  # early control messages; replay after barrier
 
-    for msg in queued:
-        handle(msg)
-    while active > 0:
-        handle(await fg_inbox.recv())
+        terminated = False
+        if errors:
+            for b in blocks:
+                b.inbox.send(Terminate())
+            terminated = True
 
-    # ---- join + restore (`runtime.rs:589-596`) ------------------------------
-    for h in handles:
-        try:
-            await h
-        except Exception as e:
-            log.error("block task raised: %r", e)
-    # refuse new control sends, then answer anything still queued: a call into a
-    # finished flowgraph returns InvalidValue instead of hanging the caller
-    fg_inbox.close()
-    while True:
-        msg = fg_inbox.try_recv()
-        if msg is None:
-            break
-        if isinstance(msg, BlockCallbackMsg):
-            msg.reply.set(Pmt.invalid_value())
-        elif isinstance(msg, DescribeMsg):
-            msg.reply.set(_describe(fg, blocks))
-        elif isinstance(msg, MetricsMsg):
-            # a metrics() racing flowgraph completion landed here after the
-            # main loop exited — answer with the FINAL per-block snapshot
-            # instead of silently dropping the reply (the caller would await
-            # forever; `FlowgraphHandle.metrics` only short-circuits to {}
-            # when the send itself fails)
-            msg.reply.set({b.instance_name: b.metrics() for b in blocks})
-    fg.restore_blocks(finished)
-    _trace.complete("runtime", "flowgraph", t_sup,
-                    args={"blocks": len(blocks), "errors": len(errors)})
-    if errors:
-        raise FlowgraphError(str(errors[0])) from errors[0]
-    return fg
+        _trace.complete("runtime", "init_barrier", t_barrier,
+                        args={"blocks": len(blocks), "errors": len(errors)})
+
+        # ---- start signal (`runtime.rs:418-429`) ----------------------------
+        for b in blocks:
+            b.inbox.notify()
+        initialized.set(errors[0] if errors else None)
+
+        # ---- main loop (`runtime.rs:440-571`) -------------------------------
+        def handle(msg: FlowgraphMessage):
+            nonlocal active, terminated
+            if isinstance(msg, BlockCallMsg):
+                blk = by_id.get(msg.block_id)
+                if blk is not None:
+                    blk.inbox.send(Call(msg.port, msg.data))
+            elif isinstance(msg, BlockCallbackMsg):
+                blk = by_id.get(msg.block_id)
+                if blk is None:
+                    msg.reply.set(Pmt.invalid_value())
+                else:
+                    blk.inbox.send(Callback(msg.port, msg.data, msg.reply))
+            elif isinstance(msg, DescribeMsg):
+                msg.reply.set(_describe(fg, blocks))
+            elif isinstance(msg, MetricsMsg):
+                msg.reply.set({b.instance_name: b.metrics() for b in blocks})
+            elif isinstance(msg, TerminateMsg):
+                if not terminated:
+                    _trace.instant("runtime", "terminate_cascade",
+                                   args={"reason": "requested"})
+                    for b in blocks:
+                        b.inbox.send(Terminate())
+                    terminated = True
+            elif isinstance(msg, BlockDoneMsg):
+                active -= 1
+                finished.append(msg.block)
+            elif isinstance(msg, BlockErrorMsg):
+                active -= 1
+                errors.append(msg.error)
+                if not terminated:
+                    log.error("block %d errored (%r): terminating flowgraph",
+                              msg.block_id, msg.error)
+                    _trace.instant("runtime", "terminate_cascade",
+                                   args={"reason": "block_error",
+                                         "block": msg.block_id})
+                    for b in blocks:
+                        b.inbox.send(Terminate())
+                    terminated = True
+
+        for msg in queued:
+            handle(msg)
+        while active > 0:
+            handle(await fg_inbox.recv())
+
+        # ---- join + restore (`runtime.rs:589-596`) --------------------------
+        for h in handles:
+            try:
+                await h
+            except Exception as e:
+                log.error("block task raised: %r", e)
+        # refuse new control sends, then answer anything still queued: a call
+        # into a finished flowgraph returns InvalidValue instead of hanging
+        # the caller
+        fg_inbox.close()
+        while True:
+            msg = fg_inbox.try_recv()
+            if msg is None:
+                break
+            if isinstance(msg, BlockCallbackMsg):
+                msg.reply.set(Pmt.invalid_value())
+            elif isinstance(msg, DescribeMsg):
+                msg.reply.set(_describe(fg, blocks))
+            elif isinstance(msg, MetricsMsg):
+                # a metrics() racing flowgraph completion landed here after the
+                # main loop exited — answer with the FINAL per-block snapshot
+                # instead of silently dropping the reply (the caller would
+                # await forever; `FlowgraphHandle.metrics` only short-circuits
+                # to {} when the send itself fails)
+                msg.reply.set({b.instance_name: b.metrics() for b in blocks})
+        fg.restore_blocks(finished)
+        _trace.complete("runtime", "flowgraph", t_sup,
+                        args={"blocks": len(blocks), "errors": len(errors)})
+        if errors:
+            raise FlowgraphError(str(errors[0])) from errors[0]
+        return fg
+    except BaseException as e:
+        # unhandled supervisor exit (incl. the FlowgraphError raise above):
+        # flight-record the terminal state BEFORE detaching — watchdog-enabled
+        # processes get a black box for post-mortem, others skip silently
+        _doc.on_supervisor_error(e)
+        raise
+    finally:
+        _doc.detach(_doc_token)
 
 
 def _describe(fg: Flowgraph, blocks: List[WrappedKernel]) -> FlowgraphDescription:
@@ -412,6 +433,11 @@ class Runtime:
                 scheduler = AsyncScheduler()
         self.scheduler = scheduler
         self.handle = RuntimeHandle(self.scheduler)
+        if config().doctor:
+            # FUTURESDR_TPU_DOCTOR=1: the stall watchdog runs for the life of
+            # the process (enable() is idempotent across Runtime constructions)
+            from ..telemetry.doctor import enable as _doctor_enable
+            _doctor_enable()
         self._ctrl_port = None
         if config().ctrlport_enable:
             from .ctrl_port import ControlPort

@@ -1,30 +1,37 @@
 """Telemetry: span tracing (Perfetto/Chrome trace export) + Prometheus metrics.
 
 Two independent planes (SURVEY §5 — block metrics were ad hoc in the reference;
-here they are first-class):
+here they are first-class), plus the doctor that diagnoses from both:
 
 * :mod:`.spans` — a lock-cheap, thread-aware ring-buffer span recorder. Gated by
   config/env (``FUTURESDR_TPU_TRACE``, default off); when off the hot-path cost
   is one attribute check. Drained as Chrome trace-event JSON loadable in
   Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``.
-* :mod:`.prom` — a counters/gauges registry with Prometheus text exposition,
-  always on (counter bumps are frame-rate, not sample-rate). Per-block families
-  are NOT duplicated here: :meth:`WrappedKernel.metrics` stays the single
-  source, and the control port's ``GET /metrics`` renders those dicts into
-  Prometheus families beside the registry's own counters.
+* :mod:`.prom` — a counters/gauges/histograms registry with Prometheus text
+  exposition, always on (metric bumps are frame-rate, not sample-rate;
+  :mod:`.hist` holds the log2 histogram math). Per-block families are NOT
+  duplicated here: :meth:`WrappedKernel.metrics` stays the single source, and
+  the control port's ``GET /metrics`` renders those dicts into Prometheus
+  families beside the registry's own counters.
+* :mod:`.doctor` — latency histograms (e2e / work() / link), the stall
+  watchdog with structured stall diagnosis, black-box flight-recorder dumps,
+  and bottleneck attribution over drained spans.
 
 See ``docs/observability.md`` for the span categories, metric names, endpoints
 and the overhead budget.
 """
 
-from . import prom, spans
-from .prom import Counter, Gauge, Registry, counter, gauge, registry
+from . import hist, prom, spans
+from .prom import (Counter, Gauge, Histogram, Registry, counter, gauge,
+                   histogram, registry)
 from .spans import (SpanEvent, SpanRecorder, chrome_trace, drain, enable,
                     enabled, export, overlap_report, recorder, union_ns)
+from . import doctor  # noqa: E402 — after prom/spans: doctor builds on both
 
 __all__ = [
-    "spans", "prom",
+    "spans", "prom", "hist", "doctor",
     "SpanRecorder", "SpanEvent", "recorder", "enable", "enabled", "drain",
     "chrome_trace", "export", "overlap_report", "union_ns",
-    "Registry", "Counter", "Gauge", "registry", "counter", "gauge",
+    "Registry", "Counter", "Gauge", "Histogram", "registry", "counter",
+    "gauge", "histogram",
 ]

@@ -24,8 +24,9 @@ import re
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-__all__ = ["Counter", "Gauge", "Registry", "registry", "counter", "gauge",
-           "render_block_metrics", "render_all", "CONTENT_TYPE"]
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "registry", "counter",
+           "gauge", "histogram", "render_block_metrics", "render_all",
+           "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -130,6 +131,86 @@ class Gauge(_Metric):
         self.inc(-amount, **labels)
 
 
+class Histogram(_Metric):
+    """Fixed-bucket log2 histogram family (``telemetry/hist.py`` children).
+
+    Unlike Counter/Gauge the per-observation path must survive the work()
+    hot loop, so the label resolution is hoisted out of it: call
+    :meth:`labels` ONCE per site to get the bound :class:`~.hist.Log2Hist`
+    child and ``observe()`` on that — one frexp + three adds per event.
+    Exposition follows the Prometheus histogram convention: cumulative
+    ``<name>_bucket{le="…"}`` samples per child plus ``_sum``/``_count``.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        from .hist import Log2Hist
+        self._cls = Log2Hist
+        self._hists: Dict[Tuple, "Log2Hist"] = {}
+
+    def labels(self, **labels):
+        """The (created-on-first-use) bound child for one label set."""
+        k = self._key(labels)
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = self._cls()
+            return h
+
+    def observe(self, v: float, **labels) -> None:
+        self.labels(**labels).observe(v)
+
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Estimated quantile of one child — or, called WITHOUT labels on a
+        labelled family, of all children merged (the aggregate the doctor
+        stamps as ``e2e_latency_p50``/``p99``)."""
+        from .hist import log2_bounds, quantile_from_buckets
+        if labels or not self.labelnames:
+            return self.labels(**labels).quantile(q)
+        with self._lock:
+            children = list(self._hists.values())
+        if not children:
+            return None
+        merged: Optional[list] = None
+        total = 0
+        for h in children:
+            counts, _s, n = h.snapshot()
+            total += n
+            merged = counts if merged is None else \
+                [a + b for a, b in zip(merged, counts)]
+        return quantile_from_buckets(merged or [], log2_bounds(), total, q)
+
+    def samples(self):               # _Metric contract: flat (labels, value)
+        with self._lock:
+            items = list(self._hists.items())
+        return [(dict(zip(self.labelnames, k)), h.count) for k, h in items]
+
+    def render(self) -> List[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        with self._lock:
+            items = list(self._hists.items())
+        for k, h in items:
+            base = dict(zip(self.labelnames, k))
+            counts, total_sum, total = h.snapshot()
+            cum = 0
+            for bound, c in zip(h.bounds, counts):
+                cum += c
+                lines.append(_sample_line(f"{self.name}_bucket",
+                                          {**base, "le": _fmt_value(bound)},
+                                          cum))
+            lines.append(_sample_line(f"{self.name}_bucket",
+                                      {**base, "le": "+Inf"}, total))
+            lines.append(_sample_line(f"{self.name}_sum", base, total_sum))
+            lines.append(_sample_line(f"{self.name}_count", base, total))
+        return lines
+
+
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -156,6 +237,10 @@ class Registry:
               labelnames: Sequence[str] = ()) -> Gauge:
         return self._get_or_create(Gauge, name, help, labelnames)
 
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = ()) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames)
+
     def render(self) -> str:
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
@@ -180,6 +265,11 @@ def counter(name: str, help: str = "",
 
 def gauge(name: str, help: str = "", labelnames: Sequence[str] = ()) -> Gauge:
     return _registry.gauge(name, help, labelnames)
+
+
+def histogram(name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Histogram:
+    return _registry.histogram(name, help, labelnames)
 
 
 # ---------------------------------------------------------------------------

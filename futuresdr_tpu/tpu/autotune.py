@@ -29,7 +29,8 @@ from ..ops.stages import Pipeline, Stage
 from .instance import TpuInstance, instance
 
 __all__ = ["autotune", "autotune_streamed", "default_frames", "measure_link",
-           "pick_wire", "StreamedResults"]
+           "pick_wire", "StreamedResults", "record_streamed_pick",
+           "cached_frames_per_dispatch"]
 
 log = logger("tpu.autotune")
 
@@ -215,6 +216,39 @@ def _measure_wired(pipe: Pipeline, wire, frame: int, depth: int,
     return n_frames * frame / dt / 1e6
 
 
+# ---------------------------------------------------------------------------
+# streamed-pick cache: autotune_streamed results survive for later launches
+# ---------------------------------------------------------------------------
+
+#: ``(platform, in_dtype, stage names) -> frames_per_dispatch`` — recorded by
+#: :func:`autotune_streamed`, consumed by the device-graph fusion pass
+#: (``runtime/devchain.py``) when config leaves ``tpu_frames_per_dispatch``
+#: unset, so a deploy that autotuned once keeps its megabatch K on every
+#: later fused launch of the same chain without re-measuring
+_streamed_cache: Dict[tuple, int] = {}
+
+
+def _streamed_sig(stages, in_dtype, platform: str) -> tuple:
+    """Cache key for one tuned chain: devchain boundary fences are ignored so
+    a FUSED composition of the same member stages maps to the same entry."""
+    names = tuple(str(getattr(s, "name", "?")) for s in stages
+                  if getattr(s, "name", "") != "devchain_boundary")
+    return (platform, str(np.dtype(in_dtype)), names)
+
+
+def record_streamed_pick(stages, in_dtype, platform: str,
+                         frames_per_dispatch: int) -> None:
+    _streamed_cache[_streamed_sig(stages, in_dtype, platform)] = \
+        int(frames_per_dispatch)
+
+
+def cached_frames_per_dispatch(stages, in_dtype,
+                               platform: str) -> Optional[int]:
+    """The cached megabatch K of a previously autotuned chain (None when the
+    chain was never tuned in this process)."""
+    return _streamed_cache.get(_streamed_sig(stages, in_dtype, platform))
+
+
 class StreamedResults(dict):
     """The ``autotune_streamed`` sweep matrix: a plain dict keyed by
     ``(wire, frame, depth, k)`` (so it iterates/sorts uniformly), with the
@@ -295,6 +329,11 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
                         best_rate = rate
                         best = (wname, f, d, k)
     results.frames_per_dispatch = best[3]
+    # record under BOTH the caller's raw stage list and the optimized pipeline
+    # stages: TpuStage/TpuKernel instances carry post-optimize stage lists, so
+    # the devchain lookup sees those names
+    for sig_stages in (list(stages), pipe.stages):
+        record_streamed_pick(sig_stages, pipe.in_dtype, inst.platform, best[3])
     log.info("autotune_streamed best: wire=%s frame=%d depth=%d k=%d "
              "(%.1f Msps)", *best, best_rate)
     return best[0], best[1], best[2], results

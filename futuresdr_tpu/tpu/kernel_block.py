@@ -22,6 +22,7 @@ reference, whose GPU staging buffers drop tags.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Deque, List, Optional, Sequence, Tuple
 
@@ -30,6 +31,7 @@ import numpy as np
 from ..log import logger
 from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
+from ..telemetry.doctor import E2E_LATENCY as _E2E_LATENCY
 from ..telemetry.spans import recorder as _trace_recorder
 from ..runtime.kernel import Kernel, message_handler
 from ..runtime.tag import ItemTag
@@ -71,6 +73,9 @@ class TpuKernel(Kernel):
         # while the input trickles.
         self.k_batch = max(1, int(frames_per_dispatch
                                   or config().tpu_frames_per_dispatch))
+        # explicit per-kernel K (even K=1) must not be second-guessed by the
+        # devchain's cached-autotune pick
+        self._k_explicit = frames_per_dispatch is not None
         # H2D staging read-ahead BEYOND the in-flight budget: at steady state
         # the in-flight deque is full, so without extra headroom a frame would
         # be staged and launched in the same work cycle — its wire time would
@@ -85,14 +90,17 @@ class TpuKernel(Kernel):
         self._compiled = None
         self._carry = None
         # frames consumed from the ring, awaiting a full K-batch (k_batch > 1
-        # only): (host frame, valid_in, tags)
-        self._accum: List[Tuple[np.ndarray, int, tuple]] = []
+        # only): (host frame, valid_in, tags, t_in_ns)
+        self._accum: List[Tuple[np.ndarray, int, tuple, int]] = []
         # H2D started, compute not yet dispatched: (h2d_finish, metas) with
-        # metas = one (valid_in, tags) per real frame of the dispatch group
+        # metas = one (valid_in, tags, t_in_ns) per real frame of the group;
+        # t_in_ns is the frame's ingestion stamp — the doctor's end-to-end
+        # latency histogram measures ring-exit → host-side decode per frame
         self._staged: Deque[Tuple[object, tuple]] = deque()
         # compute dispatched, D2H riding: (d2h_finish, out_metas) with
-        # out_metas = one (valid_out, rebased tags) per real frame
+        # out_metas = one (valid_out, rebased tags, t_in_ns) per real frame
         self._inflight: Deque[Tuple[object, tuple]] = deque()
+        self._e2e_hist = None         # bound at init (instance name is final)
         self._pending_out: Optional[np.ndarray] = None
         self._pending_tags: List[ItemTag] = []
         self._frames_dispatched = 0
@@ -117,6 +125,8 @@ class TpuKernel(Kernel):
 
     async def init(self, mio, meta):
         import jax
+        self._e2e_hist = _E2E_LATENCY.labels(
+            source=self.meta.instance_name or "TpuKernel")
         self._compiled, self._carry = self.pipeline.compile_wired(
             self.frame_size, self.wire, device=self.inst.device,
             k=self.k_batch)
@@ -168,6 +178,7 @@ class TpuKernel(Kernel):
         group fills, then :meth:`_flush_accum` ships the whole batch as one
         transfer. ``valid_in`` (a frame_multiple multiple) bounds how much of
         the output is real data vs zero-pad tail; ``tags`` are frame-relative."""
+        t_in = time.perf_counter_ns()
         if self.k_batch == 1:
             t0 = _trace.now() if _trace.enabled else 0
             parts = self.wire.encode_host(frame)
@@ -176,9 +187,9 @@ class TpuKernel(Kernel):
                                 args={"wire": self.wire.name,
                                       "items": len(frame)})
             self._staged.append((xfer.start_device_transfer_parts(
-                parts, self.inst.device), ((valid_in, tuple(tags)),)))
+                parts, self.inst.device), ((valid_in, tuple(tags), t_in),)))
             return
-        self._accum.append((frame, valid_in, tuple(tags)))
+        self._accum.append((frame, valid_in, tuple(tags), t_in))
         if len(self._accum) >= self.k_batch:
             self._flush_accum()
 
@@ -191,7 +202,7 @@ class TpuKernel(Kernel):
         if not self._accum:
             return
         group, self._accum = self._accum, []
-        frames = [f for f, _, _ in group]
+        frames = [f for f, _, _, _ in group]
         while len(frames) < self.k_batch:
             frames.append(np.zeros(self.frame_size,
                                    dtype=self.pipeline.in_dtype))
@@ -204,7 +215,7 @@ class TpuKernel(Kernel):
                             args={"wire": self.wire.name,
                                   "items": len(group) * self.frame_size,
                                   "frames": len(group)})
-        metas = tuple((v, t) for _, v, t in group)
+        metas = tuple((v, t, tin) for _, v, t, tin in group)
         self._staged.append((xfer.start_device_transfer_parts(
             stacked, self.inst.device), metas))
 
@@ -232,12 +243,13 @@ class TpuKernel(Kernel):
             # (read-ahead, VERDICT r2 weak 2)
             finish = xfer.start_host_transfer_parts(y_parts)
             out_metas = []
-            for valid_in, tags in metas:
+            for valid_in, tags, t_in in metas:
                 valid_out = min(self.pipeline.out_items(valid_in),
                                 self.out_frame)
                 out_metas.append((valid_out,
                                   tuple(rebase_frame_tags(tags, self.pipeline,
-                                                          valid_out))))
+                                                          valid_out)),
+                                  t_in))
             self._inflight.append((finish, tuple(out_metas)))
             self._frames_dispatched += len(metas)
             self._dispatches += 1
@@ -248,12 +260,13 @@ class TpuKernel(Kernel):
         raw = finish()
         t0 = _trace.now() if _trace.enabled else 0
         if self.k_batch == 1:
-            ((valid, tags),) = out_metas
+            ((valid, tags, t_in),) = out_metas
             arr = self.wire.decode_host(raw, self.pipeline.out_dtype)
             result, all_tags = arr[:valid], list(tags)
+            t_ins = (t_in,)
         else:
             chunks, all_tags, off = [], [], 0
-            for i, (valid, tags) in enumerate(out_metas):
+            for i, (valid, tags, _tin) in enumerate(out_metas):
                 row = tuple(p[i] for p in raw)
                 chunks.append(
                     self.wire.decode_host(row, self.pipeline.out_dtype)[:valid])
@@ -261,8 +274,18 @@ class TpuKernel(Kernel):
                 off += valid
             result = (np.concatenate(chunks) if chunks
                       else np.empty(0, dtype=self.pipeline.out_dtype))
+            t_ins = tuple(tin for _, _, tin in out_metas)
+        end = time.perf_counter_ns()
+        if self._e2e_hist is not None:
+            # per-frame end-to-end latency: ring exit → decoded host result
+            # (encode + H2D queue/wire + compute + D2H + decode; the doctor's
+            # p50/p99 stamp and ``fsdr_e2e_latency_seconds{source}``). Frames
+            # of one megabatch group land together — each still observes its
+            # OWN ingestion stamp, so K>1 trickle latency stays visible.
+            for tin in t_ins:
+                self._e2e_hist.observe((end - tin) * 1e-9)
         if t0:
-            _trace.complete("tpu", "decode", t0,
+            _trace.complete("tpu", "decode", t0, end_ns=end,
                             args={"wire": self.wire.name, "items": len(result)})
         return result, all_tags
 

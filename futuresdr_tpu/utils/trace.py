@@ -16,6 +16,7 @@ import numpy as np
 
 from ..runtime.kernel import Kernel
 from ..runtime.tag import Tag, filter_tags
+from ..telemetry.doctor import E2E_LATENCY as _E2E_LATENCY
 
 __all__ = ["LatencyProbeSource", "LatencyProbeSink", "latency_stats"]
 
@@ -60,6 +61,10 @@ class LatencyProbeSink(Kernel):
         self.input = self.add_stream_input("in", dtype)
         self.records: List[Tuple[int, float, float]] = []   # (abs_index, sent, seen)
         self._abs = 0
+        # every probe latency also feeds the doctor's e2e histogram
+        # (telemetry/doctor.py), so `GET /metrics` and flight records carry
+        # stream-plane percentiles without the raw records leaving the sink
+        self._hist = _E2E_LATENCY.labels(source="latency_probe")
 
     async def work(self, io, mio, meta):
         inp = self.input.slice()
@@ -69,6 +74,7 @@ class LatencyProbeSink(Kernel):
             for t in filter_tags(self.input.tags(), n):
                 if t.tag.name == _TAG_NAME:
                     self.records.append((self._abs + t.index, t.tag.value, now))
+                    self._hist.observe(max(0.0, now - t.tag.value))
             self._abs += n
             self.input.consume(n)
         if self.input.finished():
@@ -76,6 +82,9 @@ class LatencyProbeSink(Kernel):
 
 
 def latency_stats(records) -> dict:
+    """Exact percentiles over raw probe records (p50/p95/p99 — the
+    ``perf/latency.py`` CSV columns); the log2-bucket estimates of the same
+    latencies live in the always-on ``fsdr_e2e_latency_seconds`` histogram."""
     if not records:
         return {"count": 0}
     lat = np.array([seen - sent for _, sent, seen in records])
@@ -83,6 +92,7 @@ def latency_stats(records) -> dict:
         "count": len(lat),
         "mean_us": float(lat.mean() * 1e6),
         "p50_us": float(np.percentile(lat, 50) * 1e6),
+        "p95_us": float(np.percentile(lat, 95) * 1e6),
         "p99_us": float(np.percentile(lat, 99) * 1e6),
         "max_us": float(lat.max() * 1e6),
     }

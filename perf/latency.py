@@ -2,7 +2,7 @@
 """perf/latency — per-sample pipeline latency via timestamp tracepoints.
 
 Reference: ``perf/null_rand_latency`` (LTTng tracepoints every probe_granularity
-samples). CSV: ``run,stages,granularity,count,p50_us,p99_us,max_us``.
+samples). CSV: ``run,stages,granularity,count,p50_us,p95_us,p99_us,max_us``.
 """
 
 import argparse
@@ -31,7 +31,7 @@ def main():
                         "low-latency profile is --buffer-size 16384")
     a = p.parse_args()
     bs = a.buffer_size or None
-    print("run,stages,granularity,count,p50_us,p99_us,max_us")
+    print("run,stages,granularity,count,p50_us,p95_us,p99_us,max_us")
     for r in range(a.runs):
         fg = Flowgraph()
         src = NullSource(np.float32)
@@ -49,7 +49,8 @@ def main():
         Runtime().run(fg)
         s = latency_stats(snk.records)
         print(f"{r},{a.stages},{a.granularity},{s['count']},"
-              f"{s['p50_us']:.1f},{s['p99_us']:.1f},{s['max_us']:.1f}", flush=True)
+              f"{s['p50_us']:.1f},{s['p95_us']:.1f},{s['p99_us']:.1f},"
+              f"{s['max_us']:.1f}", flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""perf/regress — compare a bench stamp against the committed BENCH trajectory.
+
+The repo root carries one ``BENCH_r*.json`` per round (the driver's captured
+``bench.py`` artifact; since PR 2 every headline field is a median-of-3 with
+its runs triplet alongside). This gate loads that trajectory, picks the most
+recent stamp measured on the SAME backend as the current one, and flags any
+compared field that fell more than ``--tolerance`` below the reference.
+
+Field policy:
+
+* ``cpu_baseline_msps`` is always compared — it is measured on the host CPU
+  regardless of which backend the bench targeted, so it is comparable across
+  the whole trajectory (reference: the latest stamp that carries it).
+* The backend-bound fields (``value``, ``streamed_msps``,
+  ``streamed_wire_msps``, ``fm_msps``/``wlan_msps``/``lora_msps``) compare
+  only against a same-backend reference — a CPU-fallback run must not be
+  graded against a TPU round.
+* Only fields present in BOTH stamps compare (``--skip-extra-chains`` quick
+  runs simply skip the chain fields).
+
+Exit status: 0 unless ``--strict`` AND a regression was found — ``check.sh``
+wires this as a NON-fatal warning on CPU backends, where short-window noise
+and shared-host load make a hard gate flaky (the committed trajectory itself
+shows ±15% round-over-round wobble on some chains).
+
+Usage:
+  python perf/regress.py --stamp out.json            # compare a saved stamp
+  python bench.py ... | python perf/regress.py       # compare from stdin
+  python perf/regress.py --run --quick               # run a reduced bench
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FIELDS_ANY_BACKEND = ("cpu_baseline_msps",)
+FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
+                       "fm_msps", "wlan_msps", "lora_msps")
+
+
+def load_trajectory(root=_ROOT):
+    """``[(round, stamp_dict)]`` oldest-first from the committed artifacts.
+    Driver artifacts wrap the stamp as ``{"n", "cmd", "rc", "tail",
+    "parsed"}``; bare stamps (a local ``bench.py > out.json``) load as-is."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"# skipping unreadable {path}: {e!r}", file=sys.stderr)
+            continue
+        stamp = d.get("parsed") if isinstance(d.get("parsed"), dict) else d
+        if isinstance(stamp, dict) and "value" in stamp:
+            out.append((int(m.group(1)), stamp))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def pick_references(trajectory, backend):
+    """(same_backend_ref, any_ref) — each the LATEST qualifying stamp (with
+    its round) or None. Stamps without a ``backend`` key predate the field
+    and only qualify as the any-backend (cpu-baseline) reference."""
+    same = any_ = None
+    for rnd, s in trajectory:
+        if s.get("cpu_baseline_msps") is not None:
+            any_ = (rnd, s)
+        if s.get("backend") == backend:
+            same = (rnd, s)
+    return same, any_
+
+
+def compare(current, trajectory, tolerance):
+    """``[(field, cur, ref, ref_round, ratio, regressed)]`` for every
+    comparable field; ``regressed`` when cur < ref × (1 - tolerance)."""
+    backend = current.get("backend")
+    same, any_ = pick_references(trajectory, backend)
+    rows = []
+
+    def one(field, ref_pair):
+        if ref_pair is None:
+            return
+        rnd, ref = ref_pair
+        cur_v, ref_v = current.get(field), ref.get(field)
+        if not isinstance(cur_v, (int, float)) or \
+                not isinstance(ref_v, (int, float)) or ref_v <= 0:
+            return
+        ratio = cur_v / ref_v
+        rows.append((field, cur_v, ref_v, rnd, ratio,
+                     ratio < 1.0 - tolerance))
+
+    for f in FIELDS_ANY_BACKEND:
+        one(f, any_)
+    for f in FIELDS_SAME_BACKEND:
+        one(f, same)
+    return rows, (same[0] if same else None)
+
+
+def _quick_bench_stamp(quick):
+    """Run bench.py (reduced workload with --quick) and parse its stamp."""
+    argv = [sys.executable, os.path.join(_ROOT, "bench.py"),
+            "--skip-extra-chains"]
+    if quick:
+        argv += ["--cpu-samples", "4000000", "--stream-seconds", "6"]
+    r = subprocess.run(argv, capture_output=True, text=True,
+                       timeout=int(os.environ.get("FSDR_REGRESS_TIMEOUT",
+                                                  "1800")))
+    sys.stderr.write(r.stderr)
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"bench.py produced no stamp (rc={r.returncode})")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--stamp", default=None, metavar="JSON",
+                   help="bench stamp file to grade ('-' or omitted with "
+                        "piped stdin reads the stamp from stdin)")
+    p.add_argument("--run", action="store_true",
+                   help="run bench.py now and grade its stamp")
+    p.add_argument("--quick", action="store_true",
+                   help="with --run: reduced workload (noisier; pair with a "
+                        "generous tolerance)")
+    p.add_argument("--tolerance", type=float, default=None,
+                   help="allowed fractional drop vs the reference "
+                        "(default 0.25, or 0.5 with --quick)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit non-zero on regression (default: warn only — "
+                        "the check.sh wiring is a non-fatal gate)")
+    a = p.parse_args()
+    tol = a.tolerance if a.tolerance is not None else (0.5 if a.quick
+                                                      else 0.25)
+
+    if a.run:
+        current = _quick_bench_stamp(a.quick)
+    elif a.stamp and a.stamp != "-":
+        with open(a.stamp) as f:
+            current = json.load(f)
+        current = current.get("parsed", current) \
+            if "value" not in current else current
+    elif not sys.stdin.isatty():
+        current = json.loads(sys.stdin.read())
+    else:
+        p.error("need --stamp, --run, or a stamp on stdin")
+
+    trajectory = load_trajectory()
+    if not trajectory:
+        print("# no BENCH_r*.json trajectory found; nothing to grade",
+              file=sys.stderr)
+        return 0
+    rows, ref_round = compare(current, trajectory, tol)
+    backend = current.get("backend", "?")
+    if not rows:
+        print(f"# no comparable fields (backend={backend}, "
+              f"same-backend ref round: {ref_round}); nothing to grade",
+              file=sys.stderr)
+        return 0
+
+    regressed = [r for r in rows if r[5]]
+    print(f"# perf regression gate: backend={backend}, "
+          f"tolerance={tol:.0%}, reference rounds per field below")
+    print(f"{'field':24} {'current':>10} {'ref':>10} {'ref_rnd':>7} "
+          f"{'ratio':>7}  verdict")
+    for field, cur, ref, rnd, ratio, bad in rows:
+        print(f"{field:24} {cur:10.1f} {ref:10.1f} {rnd:7d} {ratio:7.2f}  "
+              f"{'REGRESSED' if bad else 'ok'}")
+    for field, cur, ref, rnd, ratio, _ in regressed:
+        print(f"WARNING: perf regression: {field} {cur:.1f} vs {ref:.1f} "
+              f"(r{rnd:02d}) = {ratio:.0%} of reference "
+              f"(floor {1 - tol:.0%})", file=sys.stderr)
+    if regressed and a.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

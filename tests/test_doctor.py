@@ -1,0 +1,533 @@
+"""Flowgraph doctor (telemetry/doctor.py + telemetry/hist.py): histogram
+bucket/percentile math, watchdog trip/classification/re-arm, the
+no-false-positive contract on slow-but-progressing graphs, flight-recorder
+dump shape, bottleneck attribution, the doctor REST endpoint, the devchain
+pick of a cached ``autotune_streamed`` megabatch K, and the perf-regression
+gate's compare logic."""
+
+import json
+import math
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu.telemetry import doctor as doc
+from futuresdr_tpu.telemetry import prom, spans
+from futuresdr_tpu.telemetry.hist import Log2Hist, log2_bounds
+from futuresdr_tpu.telemetry.spans import SpanEvent
+
+
+@pytest.fixture
+def watchdog():
+    """Arm the process doctor's watchdog for a test; always disarm + clear."""
+    d = doc.doctor()
+    d.last_trip = None
+
+    def arm(interval, window):
+        d.enable(interval=interval, window=window)
+        return d
+
+    yield arm
+    d.disable()
+    d.last_trip = None
+
+
+@pytest.fixture
+def fake_link():
+    from futuresdr_tpu.ops import xfer
+    installed = []
+
+    def install(h2d_bps, d2h_bps):
+        installed.append(xfer.set_fake_link(h2d_bps, d2h_bps))
+
+    yield install
+    from futuresdr_tpu.ops import xfer as _x
+    _x.set_fake_link()
+
+
+# ---------------------------------------------------------------------------
+# histogram bucket / percentile math
+# ---------------------------------------------------------------------------
+
+def test_log2_bucket_indexing():
+    h = Log2Hist(lo_exp=-4, hi_exp=2)          # bounds 1/16 … 4
+    assert h.bounds == (0.0625, 0.125, 0.25, 0.5, 1.0, 2.0, 4.0)
+    # (lo, hi] membership, exact powers land in their OWN bucket (le is
+    # inclusive), overflow past the top bound, underflow clamps to bucket 0
+    for v, idx in ((0.001, 0), (0.0625, 0), (0.1, 1), (0.125, 1),
+                   (0.2, 2), (1.0, 4), (1.5, 5), (4.0, 6), (100.0, 7)):
+        assert h._index(v) == idx, (v, idx)
+
+
+def test_log2_hist_observe_and_quantile():
+    h = Log2Hist()
+    for v in (0.001, 0.001, 0.001, 0.001, 0.010, 0.010, 0.010, 0.100, 0.100,
+              1.000):
+        h.observe(v)
+    assert h.count == 10
+    assert h.sum == pytest.approx(1.234)
+    b = log2_bounds()
+    # p50 falls in the 0.010 bucket, p99 in the 1.0 bucket — each estimate
+    # must stay inside its bucket's (lo, hi] envelope (log2 precision bound)
+    def bucket_of(v):
+        i = h._index(v)
+        return (b[i - 1] if i else 0.0), b[i]
+    for q, v_true in ((0.5, 0.010), (0.99, 1.000)):
+        lo, hi = bucket_of(v_true)
+        est = h.quantile(q)
+        assert lo <= est <= hi, (q, est, lo, hi)
+    # degenerate / invalid inputs
+    assert Log2Hist().quantile(0.5) is None
+    h.observe(-1.0)                  # negative (clock skew): dropped
+    h.observe(float("nan"))
+    assert h.count == 10
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_prom_histogram_exposition_and_merge():
+    reg = prom.Registry()
+    H = reg.histogram("t_lat_seconds", "latency", ("src",))
+    a = H.labels(src="a")
+    for v in (0.001, 0.004, 0.004):
+        a.observe(v)
+    H.observe(2.0, src="b")
+    text = reg.render()
+    assert "# TYPE t_lat_seconds histogram" in text
+    # cumulative buckets per child + _sum/_count, +Inf carries the total
+    assert 't_lat_seconds_bucket{le="+Inf",src="a"} 3' in text
+    assert 't_lat_seconds_count{src="a"} 3' in text
+    assert 't_lat_seconds_count{src="b"} 1' in text
+    assert f't_lat_seconds_sum{{src="a"}} {0.009}' in text
+    # the le="0.001953125" cumulative count covers 0.001 + both 0.004 values?
+    # no: 0.004 > 0.001953125 → cumulative there is exactly 1
+    assert 't_lat_seconds_bucket{le="0.001953125",src="a"} 1' in text
+    # child quantile vs merged-family quantile
+    qa = H.quantile(0.5, src="a")
+    assert 0.001953125 <= qa <= 0.0078125
+    qall = H.quantile(1.0)            # merged across children: max bucket 2.0
+    assert qall >= 1.0
+    # registry re-registration guard covers histograms too
+    with pytest.raises(ValueError, match="re-registered"):
+        reg.counter("t_lat_seconds", "", ("src",))
+
+
+def test_observe_sampled_stride():
+    """The work-duration site samples 1-in-8 systematically: counts reflect
+    the sampled observations (exact totals live on the work_calls/work_time_s
+    counters), and every sampled value lands in the right bucket."""
+    h = Log2Hist()
+    for _ in range(64):
+        h.observe_sampled(0.002)
+    assert h.count == 64 // Log2Hist.SAMPLE_STRIDE
+    assert h.quantile(0.5) == pytest.approx(0.002, rel=1.0)  # right bucket
+    h2 = Log2Hist()
+    for _ in range(Log2Hist.SAMPLE_STRIDE - 1):
+        h2.observe_sampled(1.0)
+    assert h2.count == 0              # below one stride: nothing recorded yet
+
+
+def test_histogram_observe_is_cheap():
+    """The per-work-call observe must stay O(100ns)-class: the ≤3% telemetry
+    gate multiplies this by the chain's call rate (coarse 5µs bound so CI
+    noise cannot flake it)."""
+    h = Log2Hist()
+    n = 50_000
+    t0 = time.perf_counter_ns()
+    for _ in range(n):
+        h.observe(1.5e-4)
+    per_call = (time.perf_counter_ns() - t0) / n
+    assert per_call < 5000, f"observe costs {per_call:.0f} ns"
+
+
+# ---------------------------------------------------------------------------
+# watchdog strike machinery + classification (unit, no threads)
+# ---------------------------------------------------------------------------
+
+def _fake_wk(name="fake_0"):
+    wk = types.SimpleNamespace()
+    wk.instance_name = name
+    wk.kernel = types.SimpleNamespace(stream_inputs=(), stream_outputs=())
+    wk.counters = {"work_calls": 0}
+    wk.metrics = lambda: dict(wk.counters)
+    return wk
+
+
+def test_watchdog_strikes_trip_and_rearm():
+    d = doc.Doctor()
+    d.interval, d.window = 0.01, 3
+    wk = _fake_wk()
+    token = d.attach([wk], [])
+    d.tick()                          # baseline sample, no strike
+    for _ in range(2):
+        d.tick()
+    assert d.last_trip is None        # window not reached yet
+    d.tick()
+    assert d.last_trip is not None
+    assert d.last_trip["state"] == "deadlocked"   # no edges to classify over
+    assert d.last_trip["suspect_block"] is None
+    # progress resumes → re-armed, diagnosis flips to progressing
+    wk.counters["work_calls"] = 7
+    d.tick()
+    att = d._fgs[token]
+    assert not att.tripped and att.diagnosis["state"] == "progressing"
+    d.detach(token)
+    assert d.attached() == []
+
+
+# ---------------------------------------------------------------------------
+# watchdog integration: wedged sink, starved sink, slow-but-progressing
+# ---------------------------------------------------------------------------
+
+def _make_kernel_cls(consume):
+    from futuresdr_tpu.runtime.kernel import Kernel
+
+    class _Sink(Kernel):
+        def __init__(self, dtype):
+            super().__init__()
+            self.input = self.add_stream_input("in", dtype)
+
+        async def work(self, io, mio, meta):
+            if consume:
+                n = len(self.input.slice())
+                if n:
+                    self.input.consume(n)
+            if self.input.finished() and not len(self.input.slice()):
+                io.finished = True
+
+    return _Sink
+
+
+def test_watchdog_trips_on_wedged_sink(watchdog, monkeypatch):
+    """A blocked sink backpressures the whole chain: the trip names the
+    blocked edge and the sink as the suspect, and the flight record carries
+    the diagnosis (acceptance: wedged flowgraph trips within its window)."""
+    monkeypatch.setenv("FSDR_NO_FASTCHAIN", "1")
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import Copy, NullSource
+    d = watchdog(interval=0.03, window=3)
+    Wedge = _make_kernel_cls(consume=False)
+    fg = Flowgraph()
+    src, cp, snk = NullSource(np.float32), Copy(np.float32), Wedge(np.float32)
+    fg.connect(src, cp, snk)
+    running = Runtime().start(fg)
+    try:
+        deadline = time.perf_counter() + 15.0
+        while d.last_trip is None and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        diag = d.last_trip
+        assert diag is not None, "watchdog never tripped on a wedged sink"
+        assert diag["state"] == "backpressured"
+        assert diag["suspect_block"] == snk.meta.instance_name
+        # the suspect edge is the blocked one: Copy.out → Wedge.in
+        assert diag["suspect_edge"] == [cp.meta.instance_name, "out",
+                                        snk.meta.instance_name, "in"]
+        assert diag["no_progress_for_s"] >= 3 * 0.03 * 0.99
+        # the flight recorder fired on the trip and names the blocked edge
+        rep = d.last_report
+        assert rep is not None and rep["reason"] == "watchdog:backpressured"
+        fg_dump = list(rep["flowgraphs"].values())
+        assert any(f["diagnosis"] == diag for f in fg_dump)
+    finally:
+        running.stop_sync()
+
+
+def test_watchdog_classifies_starvation(watchdog, monkeypatch):
+    """A source that stops producing (without EOS) starves the sink: state is
+    ``starved`` and the silent SOURCE is the suspect — distinguished from the
+    backpressure case above."""
+    monkeypatch.setenv("FSDR_NO_FASTCHAIN", "1")
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.runtime.kernel import Kernel
+
+    class SilentSource(Kernel):
+        def __init__(self, dtype):
+            super().__init__()
+            self.output = self.add_stream_output("out", dtype)
+
+        async def work(self, io, mio, meta):
+            pass                      # never produces, never finishes
+
+    d = watchdog(interval=0.03, window=3)
+    Sink = _make_kernel_cls(consume=True)
+    fg = Flowgraph()
+    src, snk = SilentSource(np.float32), Sink(np.float32)
+    fg.connect(src, snk)
+    running = Runtime().start(fg)
+    try:
+        deadline = time.perf_counter() + 15.0
+        while d.last_trip is None and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        diag = d.last_trip
+        assert diag is not None
+        assert diag["state"] == "starved"
+        assert diag["suspect_block"] == src.meta.instance_name
+    finally:
+        running.stop_sync()
+
+
+def test_watchdog_no_false_positive_on_slow_link(watchdog, fake_link):
+    """Acceptance + satellite: a rate-throttled fake link makes every frame
+    slow (~70 ms of modeled wire time) but the chain keeps progressing — the
+    watchdog must NOT trip; afterwards the doctor's attribution must name the
+    throttled H2D lane as the bottleneck and carry e2e percentiles."""
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSink, VectorSource
+    from futuresdr_tpu.ops import mag2_stage
+    from futuresdr_tpu.tpu import TpuKernel
+
+    n, frame = 1 << 18, 1 << 14
+    # f32 pair wire: 128 KiB per frame up at 2 MB/s ≈ 65 ms/frame H2D;
+    # D2H fast — H2D is the known dominant lane
+    fake_link(h2d_bps=2e6, d2h_bps=400e6)
+    d = watchdog(interval=0.05, window=8)     # trip needs 0.4 s of silence;
+    #                                           progress lands every ~70 ms
+    tone = np.exp(2j * np.pi * 0.1 * np.arange(n)).astype(np.complex64)
+    fg = Flowgraph()
+    src = VectorSource(tone)
+    tk = TpuKernel([mag2_stage()], np.complex64, frame_size=frame,
+                   frames_in_flight=2, wire="f32")
+    snk = VectorSink(np.float32)
+    fg.connect(src, tk, snk)
+    was = spans.enabled()
+    spans.enable(True)
+    spans.drain()
+    try:
+        Runtime().run(fg)
+        evs = spans.drain()
+    finally:
+        spans.enable(was)
+    assert d.last_trip is None, \
+        f"false positive on a slow-but-progressing chain: {d.last_trip}"
+    assert len(snk.items()) == n
+    rep = doc.report(events=evs)
+    assert rep["bottleneck_lane"] == "H2D", rep["lanes"]
+    assert rep["lanes"]["H2D"]["busy_frac"] > \
+        2 * rep["lanes"]["compute"]["busy_frac"]
+    e2e = rep["e2e_latency"]
+    assert e2e is not None and e2e["p50_s"] > 0
+    assert e2e["p99_s"] >= e2e["p50_s"]
+
+
+# ---------------------------------------------------------------------------
+# bottleneck attribution over synthetic spans
+# ---------------------------------------------------------------------------
+
+def _span(name, s_ms, e_ms, cat="tpu"):
+    return SpanEvent(1, "t", int(s_ms * 1e6), int((e_ms - s_ms) * 1e6),
+                     cat, name, None)
+
+
+def test_attribution_lane_unions():
+    # H2D busy 80 of 100 ms (overlapping spans union, not sum), compute 20,
+    # D2H 10; one actor block's work lane exists but must not outrank the
+    # device lanes (a BLOCKING work span contains its own waits)
+    evs = [_span("H2D", 0, 50), _span("H2D", 40, 80),
+           _span("compute", 10, 30), _span("D2H", 50, 60),
+           _span("blk_1", 0, 100, cat="block")]
+    rep = doc.doctor().report(events=evs)
+    assert rep["bottleneck_lane"] == "H2D"
+    assert rep["lanes"]["H2D"]["busy_frac"] == pytest.approx(0.8, abs=0.01)
+    assert rep["lanes"]["H2D"]["busy_s"] == pytest.approx(0.08, rel=0.01)
+    assert rep["lanes"]["compute"]["busy_frac"] == pytest.approx(0.2,
+                                                                abs=0.01)
+    assert rep["blocks"]["work:blk_1"]["busy_frac"] == pytest.approx(1.0)
+    assert rep["wall_s"] == pytest.approx(0.1, rel=0.01)
+
+
+def test_attribution_falls_back_to_work_lanes():
+    evs = [_span("blk_a", 0, 90, cat="block"),
+           _span("blk_b", 0, 30, cat="block")]
+    rep = doc.doctor().report(events=evs)
+    assert rep["bottleneck_lane"] == "work:blk_a"
+    assert doc.doctor().report(events=[])["bottleneck_lane"] is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder shape + markdown + REST endpoint
+# ---------------------------------------------------------------------------
+
+def _start_live_fg():
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import NullSink, NullSource
+    fg = Flowgraph()
+    fg.connect(NullSource(np.float32), NullSink(np.float32))
+    rt = Runtime()
+    return rt, rt.start(fg)
+
+
+def test_flight_record_shape_and_markdown(tmp_path, monkeypatch):
+    rt, running = _start_live_fg()
+    try:
+        d = doc.doctor()
+        rep = d.flight_record("shape-test")
+        # golden shape: every black-box section present
+        assert set(rep) == {"reason", "unix_time", "threads", "flowgraphs",
+                            "spans", "span_drops", "e2e_latency", "metrics"}
+        assert rep["reason"] == "shape-test"
+        # the calling thread's stack is recorded down to this test
+        main = next(t for t in rep["threads"] if t["name"] == "MainThread")
+        assert any("test_doctor" in ln for ln in main["stack"])
+        # the live flowgraph's blocks carry port occupancy + counters
+        fgd = list(rep["flowgraphs"].values())
+        assert fgd, "running flowgraph not attached"
+        blocks = [b for f in fgd for b in f["blocks"].values()]
+        assert any("inputs" in b and "outputs" in b for b in blocks)
+        src_out = [b["outputs"] for f in fgd for n, b in f["blocks"].items()
+                   if "NullSource" in n]
+        assert src_out and "space" in list(src_out[0].values())[0]
+        assert any(f["edges"] for f in fgd)
+        # JSON-serializable end to end, and the prom snapshot is exposition
+        assert json.loads(json.dumps(rep, default=str))
+        assert "fsdr_xfer_bytes_total" in rep["metrics"]
+        assert "fsdr_block_work_duration_seconds" in rep["metrics"]
+        md = doc.render_markdown(rep)
+        for section in ("# Flight record — shape-test", "## Flowgraph",
+                        "## Threads", "| block |"):
+            assert section in md, section
+        # dump honors doctor_dir (written as .json + .md)
+        from futuresdr_tpu.config import config
+        monkeypatch.setattr(config(), "doctor_dir", str(tmp_path))
+        paths = d.dump(rep)
+        assert paths is not None
+        assert json.load(open(paths[0]))["reason"] == "shape-test"
+        assert open(paths[1]).read().startswith("# Flight record")
+    finally:
+        running.stop_sync()
+
+
+def test_doctor_endpoint_round_trip():
+    from futuresdr_tpu.runtime.ctrl_port import ControlPort
+    rt, running = _start_live_fg()
+    cp = ControlPort(rt.handle, bind="127.0.0.1:29473")
+    cp.start()
+    base = "http://127.0.0.1:29473"
+    try:
+        body = json.load(urllib.request.urlopen(base + "/api/fg/0/doctor/"))
+        assert set(body) == {"report", "flight_record"}
+        assert body["flight_record"]["reason"] == "endpoint"
+        assert body["flight_record"]["flowgraphs"]
+        assert "bottleneck_lane" in body["report"]
+        assert "lanes" in body["report"]
+        md = urllib.request.urlopen(
+            base + "/api/fg/0/doctor/?md=1").read().decode()
+        assert md.startswith("# Flight record")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/api/fg/99/doctor/")
+        assert ei.value.code == 404
+    finally:
+        running.stop_sync()
+        cp.stop()
+
+
+# ---------------------------------------------------------------------------
+# latency probes feed the e2e histogram; latency_stats percentiles
+# ---------------------------------------------------------------------------
+
+def test_latency_probes_feed_e2e_histogram():
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import Copy, VectorSource
+    from futuresdr_tpu.utils import (LatencyProbeSink, LatencyProbeSource,
+                                     latency_stats)
+    before = doc.E2E_LATENCY.labels(source="latency_probe").count
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(200_000, np.float32))
+    probe = LatencyProbeSource(np.float32, granularity=16_384)
+    sink = LatencyProbeSink(np.float32)
+    fg.connect(src, probe, Copy(np.float32), sink)
+    Runtime().run(fg)
+    stats = latency_stats(sink.records)
+    # p95 satellite: full percentile ladder, ordered
+    assert stats["count"] == len(sink.records) > 0
+    assert stats["max_us"] >= stats["p99_us"] >= stats["p95_us"] \
+        >= stats["p50_us"] >= 0
+    child = doc.E2E_LATENCY.labels(source="latency_probe")
+    assert child.count == before + stats["count"]
+    assert child.quantile(0.5) > 0
+
+
+# ---------------------------------------------------------------------------
+# devchain picks frames_per_dispatch from a cached autotune_streamed result
+# ---------------------------------------------------------------------------
+
+def test_devchain_uses_cached_autotune_k():
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import VectorSink, VectorSource
+    from futuresdr_tpu.ops import mag2_stage
+    from futuresdr_tpu.tpu import TpuD2H, TpuH2D, TpuStage, instance
+    from futuresdr_tpu.tpu.autotune import (_streamed_cache,
+                                            cached_frames_per_dispatch,
+                                            record_streamed_pick)
+    frame, k = 4096, 2
+    n = 4 * frame
+    tone = np.exp(2j * np.pi * 0.05 * np.arange(n)).astype(np.complex64)
+    fg = Flowgraph()
+    src = VectorSource(tone)
+    h2d = TpuH2D(np.complex64, frame_size=frame)
+    st = TpuStage([mag2_stage()], np.complex64)
+    d2h = TpuD2H(np.float32)
+    snk = VectorSink(np.float32)
+    fg.connect(src, h2d, st, d2h, snk)
+    # the "cached autotune_streamed result" for this chain (the member's
+    # post-optimize stage list is what the fused composition will carry)
+    record_streamed_pick(st.pipeline.stages, np.complex64,
+                         instance().platform, k)
+    assert cached_frames_per_dispatch(st.pipeline.stages, np.complex64,
+                                      instance().platform) == k
+    try:
+        done = Runtime().run(fg)
+        m = done.wrapped(st).metrics()
+        assert m.get("fused_devchain") is True, m
+        assert m.get("frames_per_dispatch") == k, m
+        # 4 frames at K=2 → 2 dispatches
+        assert m.get("devchain_frames") == 4 and \
+            m.get("devchain_dispatches") == 2, m
+        assert len(snk.items()) == n
+        np.testing.assert_allclose(
+            np.asarray(snk.items()),
+            (tone.real ** 2 + tone.imag ** 2).astype(np.float32), rtol=1e-5)
+    finally:
+        _streamed_cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# perf-regression gate compare logic
+# ---------------------------------------------------------------------------
+
+def test_regress_compare_logic():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "perf", "regress.py")
+    spec = importlib.util.spec_from_file_location("perf_regress", path)
+    regress = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(regress)
+    traj = [
+        (3, {"backend": "cpu", "value": 40.0, "cpu_baseline_msps": 24.0,
+             "streamed_msps": 20.0}),
+        (5, {"backend": "tpu", "value": 2000.0, "cpu_baseline_msps": 23.0,
+             "streamed_msps": 5.0}),
+    ]
+    # cpu stamp: backend fields graded against r03, cpu baseline against the
+    # LATEST stamp that carries it (r05) — never cpu `value` vs tpu `value`
+    cur = {"backend": "cpu", "value": 25.0, "cpu_baseline_msps": 22.0,
+           "streamed_msps": 19.0}
+    rows, ref_round = regress.compare(cur, traj, tolerance=0.25)
+    by = {r[0]: r for r in rows}
+    assert ref_round == 3
+    assert by["value"][2] == 40.0 and by["value"][5] is True      # 0.62 < 0.75
+    assert by["cpu_baseline_msps"][2] == 23.0 and \
+        by["cpu_baseline_msps"][5] is False
+    assert by["streamed_msps"][5] is False                        # 0.95
+    # fields absent from either side are skipped, unknown backend → only the
+    # backend-agnostic cpu baseline is graded
+    rows2, ref2 = regress.compare({"backend": "rocm",
+                                   "cpu_baseline_msps": 23.0}, traj, 0.25)
+    assert ref2 is None and [r[0] for r in rows2] == ["cpu_baseline_msps"]
+
+    traj_loaded = regress.load_trajectory()
+    assert traj_loaded and all(isinstance(s, dict) for _, s in traj_loaded)
