@@ -22,6 +22,13 @@ FSDR_NO_DEVCHAIN=1 JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_devchain.py tests/test_tpu_stages.py tests/test_tpu_tags.py \
     tests/test_tpu_frames.py tests/test_retune.py
 
+echo "== chaos smoke (docs/robustness.md invariants) =="
+# seeded fault injection at every site × every failure policy on the CPU
+# backend: restart recovers bit-correct, isolate finishes independent
+# branches, fail_fast keeps today's behavior, transfer retries are
+# deterministic, and no run hangs past its deadline or leaks threads
+JAX_PLATFORMS=cpu python perf/chaos.py --smoke
+
 echo "== perf-regression gate (non-fatal; perf/regress.py vs BENCH_r*.json) =="
 # quick reduced bench on the CPU backend, graded against the committed
 # trajectory with a generous tolerance — warnings only, never fails the check

@@ -15,13 +15,13 @@ from .config import config
 from .log import logger
 from .runtime import (Flowgraph, Runtime, Kernel, WorkIo, Mocker, Tag, ItemTag,
                       message_handler, AsyncScheduler, ThreadedScheduler, TpbScheduler, FlowgraphError,
-                      ConnectError)
+                      FlowgraphCancelled, BlockPolicy, ConnectError)
 
 __all__ = [
     "Pmt", "PmtKind", "config", "logger",
     "Flowgraph", "Runtime", "Kernel", "WorkIo", "Mocker", "Tag", "ItemTag",
     "message_handler", "AsyncScheduler", "ThreadedScheduler", "TpbScheduler", "FlowgraphError",
-    "ConnectError",
+    "FlowgraphCancelled", "BlockPolicy", "ConnectError",
     "blocks", "dsp", "ops", "tpu", "parallel", "models", "utils", "hw", "ctrl", "apps",
     "telemetry",
 ]

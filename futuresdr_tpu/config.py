@@ -49,6 +49,31 @@ class Config:
     #   before a trip (trip latency ≈ interval × window)
     doctor_dir: str = ""                   # write flight-recorder dumps here
     #   ("" = keep in memory only; served via GET /api/fg/{fg}/doctor/)
+    doctor_action: str = "record"          # watchdog-trip escalation
+    #   (telemetry/doctor.py): "record" keeps today's flight-record-only
+    #   behavior; "cancel" additionally cancels the wedged flowgraph after
+    #   recording — the run raises FlowgraphError instead of hanging
+    # Fault tolerance (docs/robustness.md): per-block failure policies
+    # (runtime/block.py BlockPolicy — a kernel's own .policy attribute wins
+    # over these process defaults), transfer retry (ops/xfer.py), and run
+    # deadlines (runtime/runtime.py).
+    block_policy: str = "fail_fast"        # default on_error policy:
+    #   "fail_fast" | "restart" | "isolate"; env FUTURESDR_TPU_BLOCK_POLICY
+    block_max_restarts: int = 3            # restart budget per block
+    block_backoff: float = 0.05            # restart backoff base, seconds
+    #   (exponential per attempt, capped at BlockPolicy.backoff_cap)
+    xfer_retries: int = 3                  # transient H2D/D2H retries per transfer
+    xfer_backoff: float = 0.005            # transfer retry backoff base, seconds
+    #   (jittered exponential; jitter never changes the retry COUNT)
+    xfer_deadline: float = 30.0            # per-transfer deadline, seconds (0 = none):
+    #   retries stop once the next backoff would cross it
+    run_timeout: float = 0.0               # Runtime.run deadline, seconds (0 = none):
+    #   on expiry the run is flight-recorded and cancelled (EOS drain + join)
+    #   and raises FlowgraphError instead of hanging the caller
+    run_timeout_grace: float = 5.0         # post-cancel join grace before the
+    #   deadline path gives up and raises with the flowgraph still wedged
+    autotune_cache_dir: str = "~/.cache/futuresdr_tpu"   # persisted
+    #   autotune_streamed picks (JSON, tpu/autotune.py); "off"/"" disables
     # TPU-specific knobs (no reference analog; this is the compute-plane config).
     tpu_frame_size: int = 1 << 18          # samples per device frame
     tpu_frames_in_flight: int = 4          # dispatch pipeline depth

@@ -21,19 +21,23 @@ portable wire layout; the "complex" view is formed device-side.
 
 from __future__ import annotations
 
+import random as _random
 import threading
 import time
 from typing import Optional
 
 import numpy as np
 
+from ..log import logger
 from ..telemetry import prom as _prom
 from ..telemetry.spans import recorder as _trace_recorder
 
 __all__ = ["to_device", "to_host", "start_host_transfer", "start_device_transfer",
            "start_device_transfer_parts", "start_host_transfer_parts",
-           "split_complex_platform", "set_fake_link", "fake_link"]
+           "split_complex_platform", "set_fake_link", "fake_link",
+           "TransferError", "FakeLinkFault", "classify_transfer_error"]
 
+log = logger("ops.xfer")
 _trace = _trace_recorder()
 # link-plane metrics (always on; updates are per-frame, not per-sample)
 _XFER_BYTES = _prom.counter(
@@ -54,6 +58,116 @@ _XFER_HIST = _prom.histogram(
     "wire window)", ("direction",))
 _H2D_HIST = _XFER_HIST.labels(direction="h2d")
 _D2H_HIST = _XFER_HIST.labels(direction="d2h")
+# transient-retry billing (docs/robustness.md): one tick per retried attempt,
+# so a seeded fault campaign's retry count is auditable from /metrics
+_RETRIES = _prom.counter(
+    "fsdr_retries_total", "transient host-device transfer retries",
+    ("direction",))
+_RETRY_H2D = _RETRIES.labels(direction="h2d")
+_RETRY_D2H = _RETRIES.labels(direction="d2h")
+
+
+# ---------------------------------------------------------------------------
+# transfer retry: transient-vs-fatal classification + backoff under deadline
+# ---------------------------------------------------------------------------
+
+class TransferError(RuntimeError):
+    """Fatal transfer failure: non-transient cause, retry budget exhausted,
+    or the per-transfer deadline (``xfer_deadline``) blown."""
+
+
+class FakeLinkFault(RuntimeError):
+    """Transient fault injected by the seeded fake link (CI retry testing)."""
+
+
+#: lowercase substrings marking a backend/driver error as WORTH retrying —
+#: gRPC retryable codes the tunnel surfaces plus classic socket transients
+_TRANSIENT_MARKERS = ("unavailable", "resource_exhausted", "deadline_exceeded",
+                      "aborted", "connection reset", "temporarily",
+                      "try again", "timed out")
+
+
+def classify_transfer_error(e: BaseException) -> bool:
+    """True when ``e`` is transient (worth a retry): injected link faults
+    (``FakeLinkFault``, transient ``runtime/faults.py`` injections) and
+    backend errors matching :data:`_TRANSIENT_MARKERS`. A ``TransferError``
+    is always fatal (it already wraps an exhausted retry loop)."""
+    if isinstance(e, FakeLinkFault):
+        return True
+    if isinstance(e, TransferError):
+        return False
+    transient = getattr(e, "transient", None)     # InjectedFault carries it
+    if transient is not None:
+        return bool(transient)
+    msg = str(e).lower()
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+#: jitter source for retry backoff — deliberately NOT the fault-injection rng:
+#: jitter shifts retry *timing*, never the retry *count*, so seeded campaigns
+#: stay deterministic in their observable outcome
+_jitter_rng = _random.Random(0x5FDB7)
+
+
+def _with_retry(direction: str, attempt_fn):
+    """Run one transfer attempt with transient-classified retries: jittered
+    exponential backoff (``xfer_backoff`` base) under the retry budget
+    (``xfer_retries``) and the per-transfer deadline (``xfer_deadline``).
+    ``attempt_fn`` must be idempotent — H2D re-puts the host STAGING copies
+    (the non-aliasing encode path makes the frames immutable by contract) and
+    D2H re-reads the still-resident device array, so a retried frame is
+    bit-identical to an unfaulted one."""
+    from ..config import config
+    c = config()
+    retries = int(c.get("xfer_retries", 3))
+    backoff = float(c.get("xfer_backoff", 0.005))
+    deadline_s = float(c.get("xfer_deadline", 30.0))
+    t0 = time.perf_counter()
+    attempt = 0
+    ctr = _RETRY_H2D if direction == "h2d" else _RETRY_D2H
+    while True:
+        try:
+            return attempt_fn()
+        except Exception as e:
+            attempt += 1
+            if not classify_transfer_error(e):
+                raise
+            pause = min(backoff * (1 << (attempt - 1)), 1.0)
+            pause *= 0.5 + _jitter_rng.random()
+            out_of_budget = attempt > retries
+            past_deadline = deadline_s > 0 and \
+                time.perf_counter() - t0 + pause > deadline_s
+            if out_of_budget or past_deadline:
+                raise TransferError(
+                    f"{direction} transfer failed after {attempt} attempt(s) "
+                    f"({'retry budget' if out_of_budget else 'deadline'} "
+                    f"exhausted): {e!r}") from e
+            ctr.inc()
+            log.warning("%s transfer attempt %d failed transiently (%r): "
+                        "retrying in %.1f ms", direction, attempt, e,
+                        pause * 1e3)
+            time.sleep(pause)
+
+
+_faults_mod = None
+
+
+def _check_injected(direction: str) -> None:
+    """Raise any armed injected fault for this crossing: the fake link's own
+    seeded fault model plus the ``h2d``/``d2h``/``link`` sites of
+    ``runtime/faults.py`` (imported lazily — ops must not import runtime at
+    module level)."""
+    link = _fake_link
+    if link is not None:
+        link.maybe_fault(direction)
+    global _faults_mod
+    if _faults_mod is None:
+        from ..runtime import faults as _fm
+        _faults_mod = _fm
+    p = _faults_mod.plan()
+    if p.armed():
+        p.maybe(direction)
+        p.maybe("link")
 
 
 def _span_bounds_ns(t0_ns: int, service: float, deadline: float) -> tuple:
@@ -97,13 +211,50 @@ class _FakeLink:
     ``reserve`` is called at transfer START and returns the wall-clock deadline
     the bytes land at; ``finish()`` sleeps out the remainder. No threads — the
     timeline alone decides whether a drain loop overlapped its transfers:
-    serialized loops pay Σ(h2d+compute+d2h), pipelined ones pay ≈ the max."""
+    serialized loops pay Σ(h2d+compute+d2h), pipelined ones pay ≈ the max.
 
-    def __init__(self, h2d_bps: Optional[float], d2h_bps: Optional[float]):
+    ``fault_rate``/``fault_seed`` add a seeded fault model: each transfer
+    START draws from a per-direction ``random.Random(f"{seed}:{dir}")``
+    stream and raises a transient :class:`FakeLinkFault` on a hit — so the
+    retry path is CI-testable deterministically (same seed + same transfer
+    sequence → same faults → same retry count, billed on
+    ``fsdr_retries_total{direction}``). Per-direction streams keep the draw
+    order independent of h2d/d2h thread interleaving."""
+
+    def __init__(self, h2d_bps: Optional[float], d2h_bps: Optional[float],
+                 fault_rate: float = 0.0, fault_seed: int = 0):
         self.h2d_bps = h2d_bps
         self.d2h_bps = d2h_bps
         self._lock = threading.Lock()
         self._busy = {"h2d": 0.0, "d2h": 0.0}
+        self.fault_rate = float(fault_rate or 0.0)
+        self.fault_seed = int(fault_seed)
+        # the draw machinery IS runtime/faults.py's SiteInjector (one seeded
+        # Bernoulli implementation in the codebase, billed on
+        # fsdr_faults_injected_total{site="link:<dir>"}); this class only
+        # wraps the fire into its own FakeLinkFault surface
+        from ..runtime.faults import SiteInjector
+        self._injectors = {
+            d: SiteInjector(f"link:{d}", self.fault_rate, self.fault_seed,
+                            max_faults=None, transient=True)
+            for d in ("h2d", "d2h")}
+
+    @property
+    def faults(self):
+        """``{direction: fired}`` — campaign introspection."""
+        return {d: inj.fired for d, inj in self._injectors.items()}
+
+    def maybe_fault(self, direction: str) -> None:
+        """One seeded per-direction draw at transfer start; raises on a hit."""
+        if not self.fault_rate:
+            return
+        from ..runtime.faults import InjectedFault
+        try:
+            self._injectors[direction].check()
+        except InjectedFault as e:
+            raise FakeLinkFault(
+                f"injected fake-link fault on {direction} (#{e.seq}, "
+                f"seed {self.fault_seed})") from e
 
     def reserve(self, direction: str, nbytes: int) -> tuple:
         """Returns ``(service_start, deadline)``: the wire begins moving these
@@ -122,14 +273,18 @@ _fake_link: Optional[_FakeLink] = None
 
 
 def set_fake_link(h2d_bps: Optional[float] = None,
-                  d2h_bps: Optional[float] = None):
+                  d2h_bps: Optional[float] = None,
+                  fault_rate: float = 0.0, fault_seed: int = 0):
     """Install (or with no args remove) a throttled fake link on every transfer
     started through this module; returns the previous link for restoration.
     CI/testing only — lets the CPU backend reproduce the tunnel's link-bound
-    streamed regime deterministically."""
+    streamed regime deterministically. ``fault_rate``/``fault_seed`` arm the
+    link's seeded fault model (see :class:`_FakeLink`) so the transfer-retry
+    path is exercised deterministically too."""
     global _fake_link
     prev = _fake_link
-    _fake_link = _FakeLink(h2d_bps, d2h_bps) if (h2d_bps or d2h_bps) else None
+    _fake_link = _FakeLink(h2d_bps, d2h_bps, fault_rate, fault_seed) \
+        if (h2d_bps or d2h_bps or fault_rate) else None
     return prev
 
 
@@ -166,10 +321,18 @@ def _start_fetch(part):
     ``copy_to_host_async`` when the array type has it; otherwise the fetch is
     submitted to a small thread pool immediately — the fallback used to fetch
     synchronously inside ``finish()``, serializing oldest-first and losing the
-    overlap the caller staged for (round-6 fix)."""
+    overlap the caller staged for (round-6 fix).
+
+    The thunk RETRIES transient materialization failures: on a real flaky
+    link the error surfaces when the bytes land (inside ``finish()``), not at
+    start — the device array stays resident, so re-reading it is idempotent
+    and the retried frame is bit-identical."""
     if hasattr(part, "copy_to_host_async"):
         part.copy_to_host_async()
-        return lambda: np.asarray(part)
+        # the FIRST _with_retry attempt is the original materialization, so
+        # the budget/billing contract matches the transfer-start paths
+        # exactly: xfer_retries retries, each billed once
+        return lambda p=part: _with_retry("d2h", lambda: np.asarray(p))
     global _fetch_pool
     if _fetch_pool is None:
         with _fetch_pool_lock:   # BLOCKING kernel threads race the first fetch
@@ -177,7 +340,21 @@ def _start_fetch(part):
                 from concurrent.futures import ThreadPoolExecutor
                 _fetch_pool = ThreadPoolExecutor(max_workers=2,
                                                  thread_name_prefix="fsdr-d2h")
-    return _fetch_pool.submit(np.asarray, part).result
+    fut = _fetch_pool.submit(np.asarray, part)
+
+    def pool_thunk(p=part, f=fut):
+        # first attempt consumes the already-started pool fetch; retries
+        # re-read the still-resident device array inline — same standard
+        # budget/billing as every other retry path
+        pending = [f]
+
+        def attempt():
+            if pending:
+                return pending.pop().result()
+            return np.asarray(p)
+
+        return _with_retry("d2h", attempt)
+    return pool_thunk
 
 
 def split_complex_platform(platform: str) -> bool:
@@ -238,9 +415,18 @@ def start_device_transfer_parts(parts, device=None):
     nbytes = sum(p.nbytes for p in host)
     _XFER_BYTES.inc(nbytes, direction="h2d")
     _XFER_TRANSFERS.inc(direction="h2d")
+
+    def attempt():
+        # idempotent: re-puts the immutable host STAGING copies — a retried
+        # frame lands bit-identical to an unfaulted one
+        _check_injected("h2d")
+        return tuple(jax.device_put(p, device) for p in host)
+
+    devs = _with_retry("h2d", attempt)
+    # the wire is reserved AFTER the attempt succeeds: faulted attempts spend
+    # backoff wall-clock, not modeled wire occupancy
     service, deadline = _reserve("h2d", nbytes)
     t0 = time.perf_counter_ns()
-    devs = tuple(jax.device_put(p, device) for p in host)
 
     def finish():
         _wait_deadline(deadline)
@@ -329,11 +515,19 @@ def start_host_transfer(arr, _instrument: bool = True):
             if _instrument:
                 _XFER_BYTES.inc(nbytes, direction="d2h")
                 _XFER_TRANSFERS.inc(direction="d2h")
+
+            def attempt():
+                # idempotent: the split halves stay device-resident, so a
+                # retried fetch re-reads the same bits
+                _check_injected("d2h")
+                # both halves start NOW (async copy, or eager pool fetch when
+                # the array type has no copy_to_host_async) — never serially
+                # in finish
+                return _start_fetch(r), _start_fetch(i)
+
+            fr, fi = _with_retry("d2h", attempt)
             service, deadline = _reserve("d2h", nbytes)
             t0 = time.perf_counter_ns() if _instrument else 0
-            # both halves start NOW (async copy, or eager pool fetch when the
-            # array type has no copy_to_host_async) — never serially in finish
-            fr, fi = _start_fetch(r), _start_fetch(i)
 
             def finish():
                 out = np.empty(r.shape, dtype=dt)
@@ -354,9 +548,14 @@ def start_host_transfer(arr, _instrument: bool = True):
     if _instrument:
         _XFER_BYTES.inc(nbytes, direction="d2h")
         _XFER_TRANSFERS.inc(direction="d2h")
+
+    def attempt():
+        _check_injected("d2h")
+        return _start_fetch(arr)
+
+    fetch = _with_retry("d2h", attempt)
     service, deadline = _reserve("d2h", nbytes)
     t0 = time.perf_counter_ns() if _instrument else 0
-    fetch = _start_fetch(arr)
 
     def finish():
         out = fetch()

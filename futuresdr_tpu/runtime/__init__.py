@@ -10,10 +10,10 @@ from .work_io import WorkIo
 from .kernel import Kernel, BlockMeta, message_handler
 from .message_output import MessageOutputs
 from .inbox import BlockInbox
-from .block import WrappedKernel
+from .block import WrappedKernel, BlockPolicy
 from .flowgraph import Flowgraph, Chain, ConnectError, default_buffer
 from .runtime import (Runtime, FlowgraphHandle, RunningFlowgraph, RuntimeHandle,
-                      FlowgraphError)
+                      FlowgraphError, FlowgraphCancelled)
 from .scheduler import Scheduler, AsyncScheduler, ThreadedScheduler, TpbScheduler
 from .mocker import Mocker
 from .buffer import StreamInput, StreamOutput
@@ -27,9 +27,10 @@ if _circular.available():
 
 __all__ = [
     "Tag", "ItemTag", "WorkIo", "Kernel", "BlockMeta", "message_handler",
-    "MessageOutputs", "BlockInbox", "WrappedKernel",
+    "MessageOutputs", "BlockInbox", "WrappedKernel", "BlockPolicy",
     "Flowgraph", "Chain", "ConnectError", "default_buffer",
     "Runtime", "FlowgraphHandle", "RunningFlowgraph", "RuntimeHandle", "FlowgraphError",
+    "FlowgraphCancelled",
     "Scheduler", "AsyncScheduler", "ThreadedScheduler", "TpbScheduler",
     "Mocker", "StreamInput", "StreamOutput",
 ]

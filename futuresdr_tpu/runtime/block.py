@@ -4,18 +4,34 @@ Re-design of ``src/runtime/wrapped_kernel.rs:27-309`` (``run_impl``): the loop d
 (Call/Callback/StreamInputDone/Terminate), runs orderly shutdown when finished, parks on the
 coalescing notifier (or a ``WorkIo.block_on`` awaitable) when no work is requested, and otherwise
 calls ``kernel.work``.
+
+Failure policy (docs/robustness.md): each block resolves a :class:`BlockPolicy`
+— its kernel's own ``policy`` attribute, else the ``block_policy`` config
+default. ``fail_fast`` keeps the reference behavior (one error terminates the
+flowgraph). ``restart`` re-initializes the block in place — capped exponential
+backoff, ``kernel.deinit``+``kernel.init`` (fresh carry for device kernels),
+``fsdr_block_restarts_total{block}`` billed, a ``BlockRestartMsg`` informing
+the supervisor — without tearing down the rest of the graph; a restart forfeits
+nothing when the fault fired before ``work()`` consumed input (the
+``work:<block>`` injection site guarantees exactly that). ``isolate`` is
+decided by the SUPERVISOR (runtime.py): this loop's error path already
+EOSes the block's ports, so an isolated block retires gracefully while
+independent branches finish.
 """
 
 from __future__ import annotations
 
 import asyncio
 import time
+from dataclasses import dataclass
 from typing import Optional
 
 from ..log import logger
+from ..telemetry import prom as _prom
 from ..telemetry.doctor import WORK_DURATION as _WORK_DURATION
 from ..telemetry.spans import recorder as _trace_recorder
 from ..types import Pmt
+from . import faults as _faults
 from .inbox import (BlockInbox, Call, Callback, Initialize, StreamInputDone,
                     StreamOutputDone, Terminate)
 from .kernel import Kernel
@@ -23,9 +39,85 @@ from .work_io import WorkIo
 
 _trace = _trace_recorder()
 
-__all__ = ["WrappedKernel"]
+__all__ = ["WrappedKernel", "BlockPolicy", "policy_allows_fusion",
+           "fusion_degraded"]
 
 log = logger("runtime.block")
+
+_RESTARTS = _prom.counter(
+    "fsdr_block_restarts_total",
+    "block restarts under the restart failure policy", ("block",))
+
+_POLICIES = ("fail_fast", "restart", "isolate")
+
+
+@dataclass(frozen=True)
+class BlockPolicy:
+    """Per-block failure policy (set ``kernel.policy = BlockPolicy(...)``).
+
+    * ``fail_fast`` — any error terminates the whole flowgraph (default, the
+      reference's behavior).
+    * ``restart`` — re-initialize the block in place up to ``max_restarts``
+      times with capped exponential backoff (``backoff * 2**(attempt-1)``,
+      ≤ ``backoff_cap``); the budget covers init AND work failures. Exhausted
+      budget escalates to fail_fast.
+    * ``isolate`` — retire the failed block (its ports EOS, downstream drains,
+      upstream detaches) and let independent branches finish; the run still
+      raises a structured :class:`~.runtime.FlowgraphError` at the end.
+
+    Blocks carrying a non-fail_fast policy refuse fastchain/devchain fusion —
+    the fused paths cannot restart or isolate one member.
+    """
+
+    on_error: str = "fail_fast"
+    max_restarts: int = 3
+    backoff: float = 0.05
+    backoff_cap: float = 2.0
+
+    def __post_init__(self):
+        if self.on_error not in _POLICIES:
+            raise ValueError(
+                f"on_error must be one of {_POLICIES}, got {self.on_error!r}")
+
+    @staticmethod
+    def from_config() -> "BlockPolicy":
+        """The process-default policy (``block_policy`` / ``block_max_restarts``
+        / ``block_backoff`` config knobs). A typo'd ``block_policy`` value
+        falls back to fail_fast with an error log — it must NOT raise: this
+        resolves lazily inside the block error paths, where an exception
+        would kill the actor coroutine without a BlockErrorMsg and wedge the
+        supervisor forever."""
+        from ..config import config
+        c = config()
+        on_error = str(c.get("block_policy", "fail_fast"))
+        if on_error not in _POLICIES:
+            log.error("invalid block_policy config %r (want one of %s): "
+                      "using fail_fast", on_error, _POLICIES)
+            on_error = "fail_fast"
+        return BlockPolicy(on_error=on_error,
+                           max_restarts=int(c.get("block_max_restarts", 3)),
+                           backoff=float(c.get("block_backoff", 0.05)))
+
+
+def policy_allows_fusion(kernel) -> bool:
+    """Per-member fusion gate shared by the fastchain/devchain finders: a
+    kernel carrying a non-fail_fast policy must stay on the actor path (the
+    fused substitutes can neither restart nor isolate ONE member)."""
+    pol = getattr(kernel, "policy", None)
+    return pol is None or getattr(pol, "on_error", "fail_fast") == "fail_fast"
+
+
+def fusion_degraded(fault_sites=("work",)) -> bool:
+    """Process-global fusion degrade shared by the fastchain/devchain
+    finders: a non-fail_fast ``block_policy`` config default, or an armed
+    fault campaign on any of ``fault_sites``, keeps every block on the
+    per-hop actor path (the fused substitutes bypass per-block supervision
+    and injection points)."""
+    from ..config import config
+    if str(config().get("block_policy", "fail_fast")) != "fail_fast":
+        return True
+    p = _faults.plan()
+    return any(p.has_site(s) for s in fault_sites)
 
 
 class WrappedKernel:
@@ -42,6 +134,11 @@ class WrappedKernel:
         self.work_calls = 0
         self.work_time_s = 0.0
         self.messages_handled = 0
+        # failure-policy state: resolved lazily (config may not be final at
+        # construction); restarts counts BOTH init and work restart attempts
+        self.restarts = 0
+        self._policy: Optional[BlockPolicy] = None
+        self._restart_ctr = None
         # bound histogram child, resolved ONCE (labels() takes the family
         # lock); the per-work-call observe_sampled (1-in-8 systematic) is
         # billed by the ≤3% telemetry overhead gate alongside the span guard
@@ -54,6 +151,19 @@ class WrappedKernel:
         self.loop = None
         self.live = False
         self._in_direct = False
+
+    @property
+    def policy(self) -> BlockPolicy:
+        """The block's failure policy: the kernel's own ``policy`` attribute
+        when it is a :class:`BlockPolicy`, else the config default (resolved
+        once per WrappedKernel)."""
+        p = self._policy
+        if p is None:
+            p = getattr(self.kernel, "policy", None)
+            if not isinstance(p, BlockPolicy):
+                p = BlockPolicy.from_config()
+            self._policy = p
+        return p
 
     def metrics(self) -> dict:
         k = self.kernel
@@ -71,6 +181,7 @@ class WrappedKernel:
             "work_calls": self.work_calls,
             "work_time_s": round(self.work_time_s, 6),
             "messages_handled": self.messages_handled,
+            "restarts": self.restarts,
             "items_in": {p.name: getattr(p, "items_consumed", 0)
                          for p in k.stream_inputs},
             "items_out": {p.name: getattr(p, "items_produced", 0)
@@ -107,6 +218,66 @@ class WrappedKernel:
                 p.starved += 1
                 starved.append(p.name)
         return stalled, starved
+
+    # -- restart machinery (BlockPolicy on_error="restart") --------------------
+    async def _note_restart(self, err: Exception, fg_inbox, phase: str) -> None:
+        """Bill one restart attempt (counter + supervisor notification) and
+        sleep out the capped exponential backoff."""
+        from .runtime import BlockRestartMsg
+        pol = self.policy
+        self.restarts += 1
+        if self._restart_ctr is None:
+            self._restart_ctr = _RESTARTS.labels(block=self.instance_name)
+        self._restart_ctr.inc()
+        log.warning("block %s failed in %s (%r): restart %d/%d",
+                    self.instance_name, phase, err, self.restarts,
+                    pol.max_restarts)
+        _trace.instant("runtime", "block_restart",
+                       args={"block": self.instance_name, "phase": phase,
+                             "attempt": self.restarts})
+        fg_inbox.send(BlockRestartMsg(self.id, self.restarts, err, phase))
+        delay = min(pol.backoff * (2 ** (self.restarts - 1)), pol.backoff_cap)
+        if delay > 0:
+            await asyncio.sleep(delay)
+
+    async def _reinit_for_restart(self, err: Exception,
+                                  fg_inbox) -> Optional[Exception]:
+        """Restart the kernel in place after a work-loop error: backoff, then
+        deinit (best-effort, before EVERY attempt — init need not be
+        idempotent) + init — a fresh carry/compiled state for device kernels
+        (``TpuKernel.init`` drops in-flight dispatch state). Returns None on
+        success, or the TERMINAL exception when re-init keeps failing past
+        the restart budget (the caller reports that one — the operator needs
+        the failure that actually ended the block, not the work error the
+        restarts were trying to recover from)."""
+        kernel = self.kernel
+        await self._note_restart(err, fg_inbox, phase="work")
+        while True:
+            try:
+                await kernel.deinit(kernel.mio, kernel.meta)
+            except Exception as e:                     # noqa: BLE001 — the old
+                log.debug("deinit of failed block %s raised: %r",  # incarnation
+                          self.instance_name, e)                   # best-effort
+            try:
+                await kernel.init(kernel.mio, kernel.meta)
+                return None
+            except Exception as e2:                    # noqa: BLE001
+                if self.restarts >= self.policy.max_restarts:
+                    log.error("block %s re-init failed on final restart: %r",
+                              self.instance_name, e2)
+                    return e2
+                await self._note_restart(e2, fg_inbox, phase="init")
+
+    def _notify_ports_finished(self) -> None:
+        """EOS every port (downstream drains, upstream detaches). Used by the
+        orderly-shutdown path AND the init-failure path — an isolated block
+        that never came up must still release its neighbours."""
+        kernel = self.kernel
+        for p in kernel.stream_outputs:
+            p.notify_finished()
+        for p in kernel.stream_inputs:
+            p.notify_finished()
+        kernel.mio.notify_finished()
 
     @property
     def id(self) -> int:
@@ -160,10 +331,37 @@ class WrappedKernel:
                 if msg is None:
                     await self.inbox.wait()
                     self.inbox.take_pending()
-            await kernel.init(kernel.mio, meta)
+            while True:
+                try:
+                    await kernel.init(kernel.mio, meta)
+                    break
+                except Exception as e:
+                    # restart policy covers init too: retry with backoff out
+                    # of the same budget (fresh deploys against flaky links
+                    # fail here first)
+                    pol = self.policy
+                    if pol.on_error != "restart" or \
+                            self.restarts >= pol.max_restarts:
+                        raise
+                    try:
+                        # release whatever the failed attempt allocated —
+                        # init need not be idempotent (same contract as
+                        # _reinit_for_restart's deinit-then-init)
+                        await kernel.deinit(kernel.mio, meta)
+                    except Exception as e2:            # noqa: BLE001
+                        log.debug("deinit after failed init raised: %r", e2)
+                    await self._note_restart(e, fg_inbox, phase="init")
             fg_inbox.send(InitializedMsg(self.id, ok=True))
         except Exception as e:  # init failure → BlockError (`runtime.rs:501-505`)
             log.error("block %s failed in init: %r", self.instance_name, e)
+            try:
+                # EOS the ports even though the block never came up: under an
+                # `isolate` policy the supervisor keeps the graph running, so
+                # neighbours must not wait on a dead block (fail_fast's
+                # terminate cascade makes this a harmless no-op)
+                self._notify_ports_finished()
+            except Exception as e2:                    # noqa: BLE001
+                log.debug("port EOS after init failure raised: %r", e2)
             fg_inbox.send(BlockErrorMsg(self.id, e))
             return
 
@@ -171,81 +369,114 @@ class WrappedKernel:
         error: Optional[Exception] = None
         self.loop = asyncio.get_running_loop()
         self.live = True                    # direct dispatch may target us now
+        # fault injection (runtime/faults.py): resolve the work:<block> site
+        # ONCE — the armed-check is one attribute read, the unarmed path costs
+        # a None compare per work call (inside the ≤3% telemetry budget)
+        fplan = _faults.plan()
+        work_fault = fplan.resolve("work", self.instance_name) \
+            if fplan.armed() else None
         try:
+            # restart wrapper: a work-loop error under an on_error="restart"
+            # policy re-initializes the kernel in place and re-enters the
+            # event loop instead of retiring the block (see BlockPolicy)
             while True:
-                io.call_again |= self.inbox.take_pending()
-                while True:
-                    msg = self.inbox.try_recv()
-                    if msg is None:
-                        break
-                    if isinstance(msg, Call):
-                        try:
-                            await kernel.call_handler(io, meta, msg.port, msg.data)
-                        except Exception as e:
-                            log.error("block %s handler error: %r", self.instance_name, e)
-                        self.messages_handled += 1
-                        io.call_again = True
-                    elif isinstance(msg, Callback):
-                        try:
-                            result = await kernel.call_handler(io, meta, msg.port, msg.data)
-                        except Exception as e:
-                            log.error("block %s handler error: %r", self.instance_name, e)
-                            result = Pmt.invalid_value()
-                        msg.reply.set(result)
-                        self.messages_handled += 1
-                        io.call_again = True
-                    elif isinstance(msg, StreamInputDone):
-                        kernel.stream_inputs[msg.port_index].set_finished()
-                        io.call_again = True
-                    elif isinstance(msg, StreamOutputDone):
-                        # downstream reader detached → finish (`wrapped_kernel.rs:136-138`)
-                        io.finished = True
-                    elif isinstance(msg, Terminate):
-                        io.finished = True
+                try:
+                    # ---- one incarnation of the event loop -----------------
+                    while True:
+                        io.call_again |= self.inbox.take_pending()
+                        while True:
+                            msg = self.inbox.try_recv()
+                            if msg is None:
+                                break
+                            if isinstance(msg, Call):
+                                try:
+                                    await kernel.call_handler(io, meta, msg.port, msg.data)
+                                except Exception as e:
+                                    log.error("block %s handler error: %r", self.instance_name, e)
+                                self.messages_handled += 1
+                                io.call_again = True
+                            elif isinstance(msg, Callback):
+                                try:
+                                    result = await kernel.call_handler(io, meta, msg.port, msg.data)
+                                except Exception as e:
+                                    log.error("block %s handler error: %r", self.instance_name, e)
+                                    result = Pmt.invalid_value()
+                                msg.reply.set(result)
+                                self.messages_handled += 1
+                                io.call_again = True
+                            elif isinstance(msg, StreamInputDone):
+                                kernel.stream_inputs[msg.port_index].set_finished()
+                                io.call_again = True
+                            elif isinstance(msg, StreamOutputDone):
+                                # downstream reader detached → finish (`wrapped_kernel.rs:136-138`)
+                                io.finished = True
+                            elif isinstance(msg, Terminate):
+                                io.finished = True
 
-                if io.finished:
-                    break
+                        if io.finished:
+                            break
 
-                if not io.call_again:
-                    if block_on_task is None:
-                        aw = io.take_block_on()
-                        if aw is not None:
-                            block_on_task = asyncio.ensure_future(aw)
-                    if block_on_task is not None:
-                        # select(block_on_future, inbox.notified()) — `wrapped_kernel.rs:207-222`
-                        inbox_t = asyncio.ensure_future(self.inbox.wait())
-                        done, _ = await asyncio.wait(
-                            {block_on_task, inbox_t}, return_when=asyncio.FIRST_COMPLETED)
-                        if block_on_task in done:
-                            block_on_task = None
-                            io.call_again = True
-                        if inbox_t not in done:
-                            inbox_t.cancel()
-                    else:
-                        # park: classify into backpressure/starvation counters
-                        # (parks are off the hot path — the loop only lands
-                        # here when there is NO work to run)
-                        stalled, starved = self._note_park()
-                        t_park = time.perf_counter_ns()
-                        await self.inbox.wait()
+                        if not io.call_again:
+                            if block_on_task is None:
+                                aw = io.take_block_on()
+                                if aw is not None:
+                                    block_on_task = asyncio.ensure_future(aw)
+                            if block_on_task is not None:
+                                # select(block_on_future, inbox.notified()) — `wrapped_kernel.rs:207-222`
+                                inbox_t = asyncio.ensure_future(self.inbox.wait())
+                                done, _ = await asyncio.wait(
+                                    {block_on_task, inbox_t}, return_when=asyncio.FIRST_COMPLETED)
+                                if block_on_task in done:
+                                    block_on_task = None
+                                    io.call_again = True
+                                if inbox_t not in done:
+                                    inbox_t.cancel()
+                            else:
+                                # park: classify into backpressure/starvation counters
+                                # (parks are off the hot path — the loop only lands
+                                # here when there is NO work to run)
+                                stalled, starved = self._note_park()
+                                t_park = time.perf_counter_ns()
+                                await self.inbox.wait()
+                                if _trace.enabled:
+                                    _trace.complete(
+                                        "park", self.instance_name, t_park,
+                                        args={"stalled": stalled, "starved": starved})
+                            continue
+
+                        io.reset()
+                        if work_fault is not None:
+                            # before work() touches any port: a restart after
+                            # this fault loses no consumed input
+                            work_fault.check()
+                        t0 = time.perf_counter_ns()
+                        await kernel.work(io, kernel.mio, meta)
+                        end = time.perf_counter_ns()
+                        self.work_time_s += (end - t0) * 1e-9
+                        self.work_calls += 1
+                        self._work_hist.observe_sampled((end - t0) * 1e-9)
                         if _trace.enabled:
-                            _trace.complete(
-                                "park", self.instance_name, t_park,
-                                args={"stalled": stalled, "starved": starved})
-                    continue
-
-                io.reset()
-                t0 = time.perf_counter_ns()
-                await kernel.work(io, kernel.mio, meta)
-                end = time.perf_counter_ns()
-                self.work_time_s += (end - t0) * 1e-9
-                self.work_calls += 1
-                self._work_hist.observe_sampled((end - t0) * 1e-9)
-                if _trace.enabled:
-                    _trace.complete("block", self.instance_name, t0, end_ns=end)
-        except Exception as e:
-            log.error("block %s failed in work: %r", self.instance_name, e)
-            error = e
+                            _trace.complete("block", self.instance_name, t0, end_ns=end)
+                except Exception as e:
+                    pol = self.policy
+                    if pol.on_error == "restart" and \
+                            self.restarts < pol.max_restarts:
+                        if block_on_task is not None:
+                            block_on_task.cancel()
+                            block_on_task = None
+                        leftover = io.take_block_on()
+                        if leftover is not None and hasattr(leftover, "close"):
+                            leftover.close()
+                        terminal = await self._reinit_for_restart(e, fg_inbox)
+                        if terminal is None:
+                            io.reset()
+                            io.finished = False
+                            io.call_again = True    # re-examine ports now
+                            continue
+                        e = terminal    # report what actually ended the block
+                    log.error("block %s failed: %r", self.instance_name, e)
+                    error = e
+                break
         finally:
             self.live = False               # direct dispatch falls back to inbox
             if block_on_task is not None:
@@ -256,11 +487,7 @@ class WrappedKernel:
 
         # ---- orderly shutdown (`wrapped_kernel.rs:188-205`) ------------------
         try:
-            for p in kernel.stream_outputs:
-                p.notify_finished()
-            for p in kernel.stream_inputs:
-                p.notify_finished()
-            kernel.mio.notify_finished()
+            self._notify_ports_finished()
             await kernel.deinit(kernel.mio, meta)
         except Exception as e:
             log.error("block %s failed in deinit: %r", self.instance_name, e)

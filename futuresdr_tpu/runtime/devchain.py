@@ -98,8 +98,19 @@ _trace = _trace_recorder()
 
 def devchain_enabled() -> bool:
     """Env gate, checked per launch (not at import) so perf probes can A/B the
-    fused vs per-hop path inside one process."""
-    return not os.environ.get("FSDR_NO_DEVCHAIN")
+    fused vs per-hop path inside one process. Fault-tolerance degrades fusion
+    too (docs/robustness.md): a process-default restart/isolate policy, or an
+    armed ``work``/``dispatch`` fault campaign, falls back to the per-hop
+    actor path — the fused chain can neither restart/isolate one member nor
+    inject at per-member sites."""
+    if os.environ.get("FSDR_NO_DEVCHAIN"):
+        return False
+    from .block import fusion_degraded
+    if fusion_degraded(("work", "dispatch")):
+        log.info("devchain: failure policy / fault injection armed — "
+                 "degrading to per-hop actor mode")
+        return False
+    return True
 
 
 class DevChain(list):
@@ -151,12 +162,19 @@ def find_device_chains(fg) -> List[DevChain]:
         i_in.setdefault(id(e.dst), []).append(e)
 
     def member_ok(k) -> bool:
-        """Common per-member gate: opt-out attr, wired-ctrl refusal."""
+        """Common per-member gate: opt-out attr, wired-ctrl refusal, and a
+        non-fail_fast failure policy (restart must re-init ONE member's
+        carry and isolate must retire ONE member — the fused kernel is all
+        members or none, so such chains stay on the per-hop actor path)."""
         if getattr(k, "devchain", True) is False:
             return False
         if id(k) in msg_touched and not getattr(k, "devchain_static", False):
             # a wired ctrl (or any message port) means live retunes are
             # expected; the fused chain is static — fastchain_static rule
+            return False
+        from .block import policy_allows_fusion
+        if not policy_allows_fusion(k):
+            log.debug("devchain refuses %s: non-fail_fast failure policy", k)
             return False
         return True
 

@@ -438,6 +438,13 @@ def find_native_chains(fg) -> List[NativeTree]:
     # Python actor path vs the native chain inside one process
     if os.environ.get("FSDR_NO_FASTCHAIN") or not fastchain_available():
         return []
+    # fault-tolerance degrade (docs/robustness.md): the C++ chain can neither
+    # restart/isolate one member nor hit the per-block work injection site —
+    # a process-default restart/isolate policy or an armed work-fault
+    # campaign keeps every block on the Python actor path
+    from .block import fusion_degraded
+    if fusion_degraded(("work",)):
+        return []
     msg_touched = {id(e.src) for e in fg.message_edges} | \
                   {id(e.dst) for e in fg.message_edges}
     inp_touched = {id(e.src) for e in fg.inplace_edges} | \
@@ -460,7 +467,11 @@ def find_native_chains(fg) -> List[NativeTree]:
 
     from ..blocks.stream import StreamDuplicator
 
+    from .block import policy_allows_fusion
+
     def eligible(k) -> bool:
+        if not policy_allows_fusion(k):
+            return False      # restart/isolate needs per-block actor supervision
         if type(k) is StreamDuplicator:
             # EVERY output port must be wired, or the fused path would
             # silently run a graph the actor path rejects (an unwired port's

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Awaitable, Dict, List, Optional, Union
 
@@ -32,6 +33,10 @@ __all__ = [
     "InitializedMsg",
     "BlockDoneMsg",
     "BlockErrorMsg",
+    "BlockRestartMsg",
+    "CancelMsg",
+    "FlowgraphError",
+    "FlowgraphCancelled",
 ]
 
 log = logger("runtime")
@@ -59,6 +64,27 @@ class BlockDoneMsg(FlowgraphMessage):
 class BlockErrorMsg(FlowgraphMessage):
     block_id: int
     error: Exception
+
+
+@dataclass(frozen=True)
+class BlockRestartMsg(FlowgraphMessage):
+    """A block restarted itself under its ``restart`` policy (informational —
+    the supervisor records the decision; the block handles the re-init)."""
+    block_id: int
+    attempt: int
+    error: Exception
+    phase: str                       # "init" | "work"
+
+
+@dataclass(frozen=True)
+class CancelMsg(FlowgraphMessage):
+    """Cancel the run WITH an error: terminate cascade + a
+    :class:`FlowgraphCancelled` in the final error set — unlike TerminateMsg,
+    which is a *successful* early stop. Sent by the run-deadline path
+    (``Runtime.run(timeout=)``) and the doctor's ``doctor_action: cancel``
+    escalation; ``flight_record`` is the dump path when one was written."""
+    reason: str
+    flight_record: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -92,7 +118,46 @@ class TerminateMsg(FlowgraphMessage):
 
 
 class FlowgraphError(RuntimeError):
-    """A block errored; the flowgraph was terminated (`tests/fail.rs` behavior)."""
+    """A block errored (or the run was cancelled) and the flowgraph ended
+    (`tests/fail.rs` behavior), carrying the structured failure story:
+
+    * ``errors`` — every collected exception (multi-block failures are
+      aggregated, not dropped; concurrent errors each appear once);
+    * ``blocks`` — the faulted block's instance name per error (None for
+      non-block errors such as a cancel);
+    * ``policy_decisions`` — the per-block policy actions the supervisor took
+      (restart attempts, isolations, restart-exhausted escalations, cancels);
+    * ``flight_record`` — path of the doctor's flight-record dump when one was
+      written for this failure (None otherwise).
+    """
+
+    def __init__(self, message: str, *, errors=(), blocks=(),
+                 policy_decisions=(), flight_record: Optional[str] = None):
+        super().__init__(message)
+        self.errors: List[Exception] = list(errors)
+        self.blocks: List[Optional[str]] = list(blocks)
+        self.policy_decisions: List[dict] = list(policy_decisions)
+        self.flight_record = flight_record
+
+
+class FlowgraphCancelled(RuntimeError):
+    """The error recorded when a run is cancelled by deadline or doctor."""
+
+
+def _make_flowgraph_error(errors, blocks, decisions,
+                          flight_record=None) -> FlowgraphError:
+    """Aggregate the collected block errors into ONE structured error.
+    Single-error message stays ``str(error)`` (the historical contract tests
+    match on); multi-error messages carry the count and every block."""
+    pairs = list(zip(blocks, errors))
+    if len(errors) == 1:
+        msg = str(errors[0])
+    else:
+        msg = f"{len(errors)} blocks failed: " + "; ".join(
+            f"{b or '<runtime>'}: {e!r}" for b, e in pairs)
+    return FlowgraphError(msg, errors=errors, blocks=blocks,
+                          policy_decisions=decisions,
+                          flight_record=flight_record)
 
 
 async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
@@ -121,9 +186,25 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
     # of the watch list, and an unexpected supervisor exit flight-records the
     # terminal state before propagating
     _doc = _doctor()
+
+    def _doctor_cancel(diag: dict, path: Optional[str]) -> None:
+        # doctor_action=cancel escalation: called from the watchdog thread
+        # AFTER the flight record landed — the send is thread-safe, and the
+        # supervisor converts it into a FlowgraphError carrying the record
+        fg_inbox.send(CancelMsg(
+            f"doctor watchdog: {diag.get('state')} — {diag.get('detail')}",
+            path))
+
     _doc_token = _doc.attach(blocks, [
         (wk[id(e.src)], e.src_port, wk[id(e.dst)], e.dst_port)
-        for e in fg.stream_edges if id(e.src) in wk and id(e.dst) in wk])
+        for e in fg.stream_edges if id(e.src) in wk and id(e.dst) in wk],
+        cancel=_doctor_cancel)
+    # failure bookkeeping (read by the except clause below — defined before
+    # the try so an early supervisor error still reports sane state)
+    errors: List[Exception] = []
+    err_blocks: List[Optional[str]] = []       # instance name per error
+    decisions: List[dict] = []                 # policy actions taken
+    flight_paths: List[str] = []               # CancelMsg-attached dumps
     try:
         fused: set = set()
         chain_tasks = []
@@ -158,8 +239,13 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
         waiting = len(blocks)
         active = len(blocks)
         finished: List[WrappedKernel] = []
-        errors: List[Exception] = []
+        failed: List[WrappedKernel] = []       # errored blocks — restored too,
+        #   so post-mortem metrics/ports stay readable (chaos invariant)
         queued: List[FlowgraphMessage] = []
+        fatal_init: Optional[Exception] = None
+        abandoned = False      # a cancel arrived while a block sat inside
+        #   init(): that block can never be joined — the supervisor abandons
+        #   the barrier (and the joins) instead of hanging with it
         while waiting > 0:
             msg = await fg_inbox.recv()
             if isinstance(msg, InitializedMsg):
@@ -168,15 +254,49 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                 waiting -= 1
                 active -= 1
                 errors.append(msg.error)
+                blk = by_id.get(msg.block_id)
+                name = blk.instance_name if blk else str(msg.block_id)
+                err_blocks.append(name)
+                if blk is not None:
+                    failed.append(blk)
+                if blk is not None and blk.policy.on_error == "isolate":
+                    # the block EOSed its ports before reporting (block.py
+                    # init-failure path) — the rest of the graph runs on
+                    decisions.append({"block": name, "action": "isolate",
+                                      "phase": "init",
+                                      "error": repr(msg.error)})
+                    log.error("block %s failed in init (%r): isolated by "
+                              "policy, flowgraph continues", name, msg.error)
+                else:
+                    fatal_init = fatal_init or msg.error
             elif isinstance(msg, BlockDoneMsg):
                 waiting -= 1
                 active -= 1
                 finished.append(msg.block)
+            elif isinstance(msg, BlockRestartMsg):
+                _record_restart(decisions, by_id, msg)
+            elif isinstance(msg, CancelMsg):
+                # doctor_action=cancel / run-deadline cancel while the
+                # barrier waits: the wedged init will never report, so
+                # waiting it out would hang the very path that promises not
+                # to — record the cancel and abandon the barrier
+                errors.append(FlowgraphCancelled(msg.reason))
+                err_blocks.append(None)
+                decisions.append({"block": None, "action": "cancel",
+                                  "reason": msg.reason})
+                if msg.flight_record:
+                    flight_paths.append(msg.flight_record)
+                fatal_init = fatal_init or errors[-1]
+                abandoned = True
+                log.error("flowgraph cancelled during the init barrier "
+                          "(%s): abandoning blocks still inside init()",
+                          msg.reason)
+                break
             else:
                 queued.append(msg)  # early control messages; replay after barrier
 
         terminated = False
-        if errors:
+        if fatal_init is not None:
             for b in blocks:
                 b.inbox.send(Terminate())
             terminated = True
@@ -187,7 +307,7 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
         # ---- start signal (`runtime.rs:418-429`) ----------------------------
         for b in blocks:
             b.inbox.notify()
-        initialized.set(errors[0] if errors else None)
+        initialized.set(fatal_init)
 
         # ---- main loop (`runtime.rs:440-571`) -------------------------------
         def handle(msg: FlowgraphMessage):
@@ -213,13 +333,53 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                     for b in blocks:
                         b.inbox.send(Terminate())
                     terminated = True
+            elif isinstance(msg, CancelMsg):
+                # deadline / doctor escalation: a terminate cascade that ALSO
+                # records an error, so the run raises instead of "succeeding"
+                errors.append(FlowgraphCancelled(msg.reason))
+                err_blocks.append(None)
+                decisions.append({"block": None, "action": "cancel",
+                                  "reason": msg.reason})
+                if msg.flight_record:
+                    flight_paths.append(msg.flight_record)
+                if not terminated:
+                    log.error("flowgraph cancelled: %s", msg.reason)
+                    _trace.instant("runtime", "terminate_cascade",
+                                   args={"reason": "cancel"})
+                    for b in blocks:
+                        b.inbox.send(Terminate())
+                    terminated = True
+            elif isinstance(msg, BlockRestartMsg):
+                _record_restart(decisions, by_id, msg)
             elif isinstance(msg, BlockDoneMsg):
                 active -= 1
                 finished.append(msg.block)
             elif isinstance(msg, BlockErrorMsg):
                 active -= 1
                 errors.append(msg.error)
-                if not terminated:
+                blk = by_id.get(msg.block_id)
+                name = blk.instance_name if blk else str(msg.block_id)
+                err_blocks.append(name)
+                if blk is not None:
+                    failed.append(blk)
+                action = blk.policy.on_error if blk is not None else "fail_fast"
+                if action == "isolate" and not terminated:
+                    # the block's own error path already EOSed its ports —
+                    # downstream drains, upstream detaches, independent
+                    # branches keep running; the error still surfaces in the
+                    # final structured FlowgraphError
+                    decisions.append({"block": name, "action": "isolate",
+                                      "error": repr(msg.error)})
+                    log.error("block %s errored (%r): isolated by policy, "
+                              "flowgraph continues", name, msg.error)
+                    _trace.instant("runtime", "block_isolated",
+                                   args={"block": msg.block_id})
+                elif not terminated:
+                    decisions.append(
+                        {"block": name,
+                         "action": ("restarts_exhausted"
+                                    if action == "restart" else "fail_fast"),
+                         "error": repr(msg.error)})
                     log.error("block %d errored (%r): terminating flowgraph",
                               msg.block_id, msg.error)
                     _trace.instant("runtime", "terminate_cascade",
@@ -231,15 +391,19 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
 
         for msg in queued:
             handle(msg)
-        while active > 0:
+        while active > 0 and not abandoned:
             handle(await fg_inbox.recv())
 
         # ---- join + restore (`runtime.rs:589-596`) --------------------------
-        for h in handles:
-            try:
-                await h
-            except Exception as e:
-                log.error("block task raised: %r", e)
+        if not abandoned:
+            for h in handles:
+                try:
+                    await h
+                except Exception as e:
+                    log.error("block task raised: %r", e)
+        # abandoned: the block wedged inside init() cannot be joined; the
+        # healthy blocks got Terminate and wind down in the background
+        # against the closed inbox below (their late sends return False)
         # refuse new control sends, then answer anything still queued: a call
         # into a finished flowgraph returns InvalidValue instead of hanging
         # the caller
@@ -259,20 +423,38 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                 # await forever; `FlowgraphHandle.metrics` only short-circuits
                 # to {} when the send itself fails)
                 msg.reply.set({b.instance_name: b.metrics() for b in blocks})
-        fg.restore_blocks(finished)
+        fg.restore_blocks(finished + failed)
         _trace.complete("runtime", "flowgraph", t_sup,
                         args={"blocks": len(blocks), "errors": len(errors)})
         if errors:
-            raise FlowgraphError(str(errors[0])) from errors[0]
+            raise _make_flowgraph_error(
+                errors, err_blocks, decisions,
+                flight_record=flight_paths[0] if flight_paths else None,
+            ) from errors[0]
         return fg
     except BaseException as e:
         # unhandled supervisor exit (incl. the FlowgraphError raise above):
         # flight-record the terminal state BEFORE detaching — watchdog-enabled
-        # processes get a black box for post-mortem, others skip silently
-        _doc.on_supervisor_error(e)
+        # processes get a black box for post-mortem, others skip silently.
+        # The record's `supervisor` section surfaces the aggregated error
+        # count and policy decisions (multi-block failures are not dropped).
+        paths = _doc.on_supervisor_error(
+            e, extra={"block_errors": len(errors),
+                      "blocks": [b for b in err_blocks if b],
+                      "policy_decisions": list(decisions)})
+        if isinstance(e, FlowgraphError) and e.flight_record is None and paths:
+            e.flight_record = paths[0]
         raise
     finally:
         _doc.detach(_doc_token)
+
+
+def _record_restart(decisions: List[dict], by_id, msg: "BlockRestartMsg"):
+    blk = by_id.get(msg.block_id)
+    name = blk.instance_name if blk else str(msg.block_id)
+    decisions.append({"block": name, "action": "restart",
+                      "attempt": msg.attempt, "phase": msg.phase,
+                      "error": repr(msg.error)})
 
 
 def _describe(fg: Flowgraph, blocks: List[WrappedKernel]) -> FlowgraphDescription:
@@ -336,6 +518,16 @@ class FlowgraphHandle:
     async def terminate(self) -> None:
         self._inbox.send(TerminateMsg())
 
+    async def cancel(self, reason: str = "requested",
+                     flight_record: Optional[str] = None) -> None:
+        """Terminate WITH an error: the run raises a FlowgraphError carrying
+        ``reason`` (and ``flight_record``) instead of completing cleanly."""
+        self._inbox.send(CancelMsg(reason, flight_record))
+
+    def cancel_sync(self, reason: str = "requested",
+                    flight_record: Optional[str] = None) -> None:
+        self._inbox.send(CancelMsg(reason, flight_record))
+
     # -- sync bridges ----------------------------------------------------------
     def post_sync(self, block, port, data: Pmt = None) -> None:
         data = Pmt.from_py(data) if not isinstance(data, Pmt) else data
@@ -359,21 +551,76 @@ class RunningFlowgraph:
         self._task = task
         self._scheduler = scheduler
 
-    async def wait(self) -> Flowgraph:
+    @staticmethod
+    def _resolve_timeout(timeout: Optional[float]) -> Optional[float]:
+        """Explicit argument wins; else the ``run_timeout`` config knob
+        (0 = no deadline)."""
+        if timeout is not None:
+            return float(timeout) or None
+        from ..config import config
+        return float(config().get("run_timeout", 0.0)) or None
+
+    async def wait(self, timeout: Optional[float] = None) -> Flowgraph:
         """Await completion; returns the flowgraph with final block state.
+
+        ``timeout`` (or the ``run_timeout`` config knob) bounds the wait: on
+        expiry the run is flight-recorded, cancelled (EOS drain + join, the
+        graceful path), and raises a structured FlowgraphError instead of
+        hanging the caller — a wedged pytest gets a diagnosis, not a kill.
 
         Loop-safe: the join task lives on the SCHEDULER loop (start_async
         delegates launches there), so awaiting from any other loop bridges via
         ``run_coroutine_threadsafe`` — awaiting a foreign-loop task directly is
         a RuntimeError in asyncio."""
+        timeout = self._resolve_timeout(timeout)
         if asyncio.get_running_loop() is not self._scheduler.loop:
-            fut = asyncio.run_coroutine_threadsafe(self._wrap(),
+            fut = asyncio.run_coroutine_threadsafe(self._wait_impl(timeout),
                                                    self._scheduler.loop)
             return await asyncio.wrap_future(fut)
-        return await self._task
+        return await self._wait_impl(timeout)
 
-    def wait_sync(self) -> Flowgraph:
-        return self._scheduler.run_coro_sync(self._wrap())
+    def wait_sync(self, timeout: Optional[float] = None) -> Flowgraph:
+        return self._scheduler.run_coro_sync(
+            self._wait_impl(self._resolve_timeout(timeout)))
+
+    async def _wait_impl(self, timeout: Optional[float]) -> Flowgraph:
+        if timeout is None:
+            return await self._task
+        try:
+            return await asyncio.wait_for(asyncio.shield(self._task), timeout)
+        except asyncio.TimeoutError:
+            pass
+        # deadline blown: record the black box FIRST (live state), then
+        # cancel — the supervisor converts the CancelMsg into a structured
+        # FlowgraphError carrying the record path
+        from ..config import config
+        from ..telemetry.doctor import doctor as _doctor
+        d = _doctor()
+        paths = d.dump(d.flight_record(f"run_timeout:{timeout}s"))
+        path = paths[0] if paths else None
+        log.error("flowgraph exceeded its %.3fs run deadline: cancelling "
+                  "(flight record: %s)", timeout, path or "in memory")
+        self.handle.cancel_sync(f"run deadline exceeded ({timeout}s)", path)
+        # grace=0 means "give up right after the cancel", never "wait forever"
+        grace = max(0.0, float(config().get("run_timeout_grace", 5.0)))
+        try:
+            if grace > 0:
+                return await asyncio.wait_for(asyncio.shield(self._task),
+                                              grace)
+            raise asyncio.TimeoutError
+        except asyncio.TimeoutError:
+            # a block is wedged INSIDE work() and cannot see Terminate — give
+            # the caller its thread back with the story attached; the block
+            # thread is abandoned (the flight record has its stack)
+            raise FlowgraphError(
+                f"flowgraph did not terminate within {grace}s of the "
+                f"deadline cancel (run deadline {timeout}s) — a block is "
+                "wedged inside work(); see the flight record",
+                errors=[FlowgraphCancelled("run deadline exceeded")],
+                blocks=[None],
+                policy_decisions=[{"block": None, "action": "cancel",
+                                   "reason": "run deadline exceeded"}],
+                flight_record=path) from None
 
     async def _wrap(self):
         return await self._task
@@ -468,7 +715,27 @@ class Runtime:
             run_flowgraph_supervisor(fg, self.scheduler, fg_inbox, initialized))
         handle = FlowgraphHandle(fg, fg_inbox, self.scheduler)
         fg_id = self.handle.register(handle)
-        err = await initialized.get()
+        try:
+            err = await initialized.get()
+        except asyncio.CancelledError:
+            # launch abandoned (run_async's init deadline): a LATE-completing
+            # barrier must terminate instead of running detached — the
+            # CancelMsg queues during the barrier and replays right after it.
+            # A sweeper owns the join: it retrieves the supervisor's
+            # (expected) FlowgraphError and unregisters the handle.
+            fg_inbox.send(CancelMsg(
+                "launch abandoned: run deadline exceeded in init"))
+
+            async def _sweep():
+                try:
+                    await task
+                except BaseException:          # noqa: BLE001 — expected
+                    pass                       # cancel-induced FlowgraphError
+                finally:
+                    self.handle.unregister(fg_id)
+
+            loop.create_task(_sweep())
+            raise
         join = loop.create_task(_unregister_on_done(task, self.handle, fg_id))
         running = RunningFlowgraph(handle, join, self.scheduler)
         if err is not None:
@@ -480,14 +747,46 @@ class Runtime:
             raise FlowgraphError(str(err)) from err
         return running
 
-    async def run_async(self, fg: Flowgraph) -> Flowgraph:
-        running = await self.start_async(fg)
-        return await running.wait()
+    async def run_async(self, fg: Flowgraph,
+                        timeout: Optional[float] = None) -> Flowgraph:
+        timeout = RunningFlowgraph._resolve_timeout(timeout)
+        if timeout is None:
+            running = await self.start_async(fg)
+            return await running.wait(timeout=None)
+        # the deadline is a TOTAL budget: it bounds the launch too — a
+        # kernel.init wedged on a dead link must not hang run() any more
+        # than a wedged work() may. A launch that blows the deadline is
+        # flight-recorded and abandoned (blocks stuck inside init cannot
+        # see Terminate; the record's thread stacks carry the post-mortem).
+        t0 = time.monotonic()
+        try:
+            running = await asyncio.wait_for(self.start_async(fg), timeout)
+        except asyncio.TimeoutError:
+            from ..telemetry.doctor import doctor as _doctor
+            d = _doctor()
+            paths = d.dump(d.flight_record(f"run_timeout:init:{timeout}s"))
+            path = paths[0] if paths else None
+            log.error("flowgraph launch exceeded the %.3fs run deadline "
+                      "inside the init barrier (flight record: %s)",
+                      timeout, path or "in memory")
+            raise FlowgraphError(
+                f"flowgraph did not pass the init barrier within the "
+                f"{timeout}s run deadline — a block is wedged inside "
+                "init(); see the flight record",
+                errors=[FlowgraphCancelled("run deadline exceeded in init")],
+                blocks=[None],
+                policy_decisions=[{"block": None, "action": "cancel",
+                                   "reason": "run deadline exceeded in init"}],
+                flight_record=path) from None
+        remaining = max(0.05, timeout - (time.monotonic() - t0))
+        return await running.wait(timeout=remaining)
 
     # -- sync API --------------------------------------------------------------
-    def run(self, fg: Flowgraph) -> Flowgraph:
-        """Run to completion (`runtime.rs:204-207`)."""
-        return self.scheduler.run_coro_sync(self.run_async(fg))
+    def run(self, fg: Flowgraph, timeout: Optional[float] = None) -> Flowgraph:
+        """Run to completion (`runtime.rs:204-207`). ``timeout`` (or the
+        ``run_timeout`` config knob) is the graceful run deadline: flight
+        record + cancel + FlowgraphError instead of a hang."""
+        return self.scheduler.run_coro_sync(self.run_async(fg, timeout=timeout))
 
     def start(self, fg: Flowgraph) -> RunningFlowgraph:
         return self.scheduler.run_coro_sync(self.start_async(fg))

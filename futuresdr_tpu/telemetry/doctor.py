@@ -67,8 +67,10 @@ log = logger("telemetry.doctor")
 #: the streamed-pipeline lanes attribution unions (cat="tpu" span names)
 LANES = ("encode", "H2D", "compute", "D2H", "decode")
 
-#: every state a watchdog diagnosis can carry
-WATCHDOG_STATES = ("progressing", "backpressured", "starved", "deadlocked")
+#: every state a watchdog diagnosis can carry (``idle``: a message-plane-only
+#: flowgraph with drained inboxes — waiting for events, not wedged)
+WATCHDOG_STATES = ("progressing", "backpressured", "starved", "deadlocked",
+                   "idle")
 
 # always-on histogram families (the metrics plane contract: frame-rate
 # updates, never per-sample) — observation sites bind children once
@@ -86,12 +88,14 @@ class _Attached:
     """One supervised flowgraph under watch."""
 
     __slots__ = ("key", "blocks", "edges", "t_attach", "progress", "strikes",
-                 "tripped", "diagnosis")
+                 "tripped", "diagnosis", "cancel")
 
-    def __init__(self, key: int, blocks, edges):
+    def __init__(self, key: int, blocks, edges, cancel=None):
         self.key = key
         self.blocks = list(blocks)        # WrappedKernels
         self.edges = list(edges)          # (src_wk, src_port, dst_wk, dst_port)
+        self.cancel = cancel              # fn(diag, flight_record_path) — the
+        #   supervisor's CancelMsg hook for doctor_action=cancel escalation
         self.t_attach = time.monotonic()
         self.progress: Optional[int] = None   # None = no baseline sample yet
         self.strikes = 0
@@ -203,14 +207,17 @@ class Doctor:
         self._signal_dump = False
 
     # -- attachment (called by the flowgraph supervisor) -----------------------
-    def attach(self, blocks: Sequence, edges: Sequence) -> int:
+    def attach(self, blocks: Sequence, edges: Sequence, cancel=None) -> int:
         """Register a launching flowgraph's WrappedKernels + resolved stream
         edges ``(src_wk, src_port, dst_wk, dst_port)``; returns the detach
-        token. Cheap enough to run unconditionally per launch."""
+        token. ``cancel`` is the supervisor's escalation hook — called with
+        ``(diagnosis, flight_record_path)`` on a trip when the
+        ``doctor_action`` config knob is ``"cancel"``. Cheap enough to run
+        unconditionally per launch."""
         with self._lock:
             key = self._next_key
             self._next_key += 1
-            self._fgs[key] = _Attached(key, blocks, edges)
+            self._fgs[key] = _Attached(key, blocks, edges, cancel)
             return key
 
     def detach(self, token: int) -> None:
@@ -318,15 +325,51 @@ class Doctor:
             if att.strikes >= self.window and not att.tripped:
                 att.tripped = True
                 diag = self.diagnose(att)
+                prev_state = (att.diagnosis or {}).get("state")
                 att.diagnosis = diag
-                _TRIPS.inc(state=diag["state"])
+                if diag["state"] != "idle" or prev_state != "idle":
+                    # idle re-fires every window (the re-arm below) but is
+                    # not a stall: count only the TRANSITION, so alerting on
+                    # rate(fsdr_doctor_trips_total) stays meaningful
+                    _TRIPS.inc(state=diag["state"])
+                if diag["state"] == "idle":
+                    # a quiet message-plane flowgraph is not a wedge: no
+                    # flight record, no escalation — and the window RE-ARMS
+                    # (tripped stays clear), so a later genuine deadlock
+                    # (queued messages a wedged handler never drains, which
+                    # never advances progress) still gets diagnosed, dumped
+                    # and escalated
+                    att.tripped = False
+                    att.strikes = 0
+                    if prev_state != "idle":      # first idle verdict only —
+                        log.info("watchdog: fg %d is idle (message-plane, "
+                                 "inboxes drained)", att.key)   # no log spam
+                    self.last_trip = diag
+                    continue
                 log.error("watchdog trip (fg %d): %s — suspect %s via %s",
                           att.key, diag["state"], diag.get("suspect_block"),
                           diag.get("suspect_edge"))
-                self.dump(self.flight_record(f"watchdog:{diag['state']}"))
+                paths = self.dump(
+                    self.flight_record(f"watchdog:{diag['state']}"))
+                self._maybe_cancel(att, diag, paths)
                 # published LAST: a waiter seeing last_trip can rely on the
                 # flight record (last_report) being complete
                 self.last_trip = diag
+
+    def _maybe_cancel(self, att: _Attached, diag: dict, paths) -> None:
+        """``doctor_action: cancel`` escalation — after recording, cancel the
+        wedged flowgraph through the supervisor's hook (the run then raises a
+        FlowgraphError carrying the flight-record path instead of hanging)."""
+        from ..config import config
+        if att.cancel is None or \
+                str(config().get("doctor_action", "record")) != "cancel":
+            return
+        log.error("doctor_action=cancel: cancelling wedged flowgraph %d",
+                  att.key)
+        try:
+            att.cancel(diag, paths[0] if paths else None)
+        except Exception as e:                         # noqa: BLE001 — the
+            log.error("doctor cancel hook failed: %r", e)   # dog must not die
 
     # -- diagnosis -------------------------------------------------------------
     def diagnose(self, att: _Attached) -> dict:
@@ -342,8 +385,37 @@ class Doctor:
         * ``deadlocked``: neither pattern (message-plane cycles, a wedged
           BLOCKING thread with empty rings, …) — the flight recorder's thread
           stacks carry the rest of the story.
+        * ``idle``: a message-plane-ONLY flowgraph (no stream edges, no block
+          with stream ports) whose inboxes are drained — it is waiting for
+          events, not wedged, so no flight record fires. Queued-but-undrained
+          messages instead classify ``deadlocked`` naming the stuck block
+          (progress already samples ``messages_handled``, so a handler that IS
+          draining never gets here).
         """
         window_s = round(att.strikes * self.interval, 3)
+        if not att.edges and not any(
+                getattr(b.kernel, "stream_inputs", ()) or
+                getattr(b.kernel, "stream_outputs", ())
+                for b in att.blocks):
+            queued = {}
+            for b in att.blocks:
+                try:
+                    n = len(getattr(b, "inbox", ()))
+                except TypeError:
+                    n = 0
+                if n:
+                    queued[b.instance_name] = n
+            if queued:
+                worst = max(queued, key=queued.get)
+                return self._diag(
+                    "deadlocked", att, None, suspect=worst,
+                    window_s=window_s,
+                    detail=f"message-plane flowgraph: {queued[worst]} queued "
+                           f"message(s) at {worst} are not draining")
+            return self._diag(
+                "idle", att, None, suspect=None, window_s=window_s,
+                detail="message-plane flowgraph with drained inboxes — "
+                       "waiting for events, not wedged")
         full = [e for e in att.edges if _edge_full(e[0], e[1])]
         if full:
             full_src = {id(e[0]) for e in full}
@@ -383,8 +455,12 @@ class Doctor:
         }
 
     # -- flight recorder -------------------------------------------------------
-    def flight_record(self, reason: str, max_spans: int = 64) -> dict:
-        """The black-box dump (JSON-serializable; see module docstring)."""
+    def flight_record(self, reason: str, max_spans: int = 64,
+                      extra: Optional[dict] = None) -> dict:
+        """The black-box dump (JSON-serializable; see module docstring).
+        ``extra`` lands under a ``supervisor`` key — the supervisor's error
+        path surfaces its aggregated block-error count and policy decisions
+        there."""
         frames = sys._current_frames()
         threads = []
         for t in threading.enumerate():
@@ -436,6 +512,8 @@ class Doctor:
             "e2e_latency": e2e if e2e.get("p50_s") is not None else None,
             "metrics": prom.registry().render(),
         }
+        if extra is not None:
+            report["supervisor"] = extra
         self.last_report = report
         return report
 
@@ -461,11 +539,18 @@ class Doctor:
             log.error("flight record write failed: %r", e)
             return None
 
-    def on_supervisor_error(self, err: BaseException) -> None:
+    def on_supervisor_error(self, err: BaseException,
+                            extra: Optional[dict] = None
+                            ) -> Optional[Tuple[str, str]]:
         """Supervisor-exception trigger: only records when the watchdog is
-        enabled (an expected test-suite FlowgraphError must not spam dumps)."""
+        enabled (an expected test-suite FlowgraphError must not spam dumps).
+        Returns the dump paths (if written) so the supervisor can attach them
+        to its structured FlowgraphError; ``extra`` (error counts, policy
+        decisions) lands under the record's ``supervisor`` key."""
         if self.enabled:
-            self.dump(self.flight_record(f"supervisor_error:{err!r}"))
+            return self.dump(self.flight_record(
+                f"supervisor_error:{err!r}", extra=extra))
+        return None
 
     # -- bottleneck attribution ------------------------------------------------
     def report(self, events: Optional[Sequence[spans.SpanEvent]] = None,

@@ -103,6 +103,31 @@ class _Metric:
         return lines
 
 
+class _BoundCounter:
+    """One label set of a :class:`Counter`, key pre-resolved — the same
+    hoist-out-of-the-hot-path pattern as :meth:`Histogram.labels` (the
+    restart/retry sites bill through these)."""
+
+    __slots__ = ("_metric", "_k")
+
+    def __init__(self, metric: "Counter", k: Tuple):
+        self._metric = metric
+        self._k = k
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        m = self._metric
+        with m._lock:
+            m._vals[self._k] = m._vals.get(self._k, 0.0) + amount
+
+    @property
+    def value(self) -> float:
+        m = self._metric
+        with m._lock:
+            return m._vals.get(self._k, 0.0)
+
+
 class Counter(_Metric):
     kind = "counter"
 
@@ -112,6 +137,10 @@ class Counter(_Metric):
         k = self._key(labels)
         with self._lock:
             self._vals[k] = self._vals.get(k, 0.0) + amount
+
+    def labels(self, **labels) -> _BoundCounter:
+        """A bound child for one label set (label validation paid once)."""
+        return _BoundCounter(self, self._key(labels))
 
 
 class Gauge(_Metric):

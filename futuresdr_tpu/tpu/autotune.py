@@ -17,6 +17,8 @@ short-circuits all of it.
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from collections import deque
 from typing import Dict, Optional, Sequence, Tuple
@@ -224,7 +226,10 @@ def _measure_wired(pipe: Pipeline, wire, frame: int, depth: int,
 #: :func:`autotune_streamed`, consumed by the device-graph fusion pass
 #: (``runtime/devchain.py``) when config leaves ``tpu_frames_per_dispatch``
 #: unset, so a deploy that autotuned once keeps its megabatch K on every
-#: later fused launch of the same chain without re-measuring
+#: later fused launch of the same chain without re-measuring. The in-memory
+#: layer is authoritative within a process; picks also persist as JSON under
+#: the ``autotune_cache_dir`` config knob (ROADMAP follow-up), so a deploy
+#: that autotuned once keeps its K across PROCESSES too.
 _streamed_cache: Dict[tuple, int] = {}
 
 
@@ -236,17 +241,93 @@ def _streamed_sig(stages, in_dtype, platform: str) -> tuple:
     return (platform, str(np.dtype(in_dtype)), names)
 
 
+def _cache_file() -> Optional[str]:
+    """The persisted streamed-pick store (None = persistence disabled via
+    ``autotune_cache_dir`` set to ""/off/none/0)."""
+    from ..config import config
+    d = str(config().get("autotune_cache_dir", "") or "")
+    if not d or d.lower() in ("0", "off", "none", "false"):
+        return None
+    return os.path.join(os.path.expanduser(d), "streamed_picks.json")
+
+
+def _sig_str(sig: tuple) -> str:
+    platform, dtype, names = sig
+    return "|".join((platform, dtype, ",".join(names)))
+
+
+#: one disk read per process (keyed by path so a test that repoints
+#: ``autotune_cache_dir`` re-reads); the memory layer is authoritative
+#: in-process, so stale memo entries only cost a re-measure, never correctness
+_disk_memo: Dict[str, Dict[str, int]] = {}
+
+
+def _disk_load(refresh: bool = False) -> Dict[str, int]:
+    path = _cache_file()
+    if not path:
+        return {}
+    if not refresh and path in _disk_memo:
+        return _disk_memo[path]
+    out: Dict[str, int] = {}
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        if isinstance(d, dict):
+            for key, v in d.items():
+                try:
+                    out[str(key)] = int(v)
+                except (TypeError, ValueError):
+                    # hand-edited / foreign value: skip the entry, keep the
+                    # rest — a bad cache line must never fail a launch
+                    log.warning("streamed-pick cache: ignoring bad value "
+                                "%r for %r", v, key)
+    except (OSError, ValueError):
+        pass
+    _disk_memo[path] = out
+    return out
+
+
+def _disk_store(sig: tuple, k: int) -> None:
+    """Best-effort read-modify-write with an atomic rename: concurrent
+    processes see the old or the new file, never a torn one (a lost
+    concurrent update costs one re-measure, not correctness)."""
+    path = _cache_file()
+    if not path:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        d = dict(_disk_load(refresh=True))    # fresh read for the RMW
+        d[_sig_str(sig)] = int(k)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(d, f, sort_keys=True, indent=0)
+        os.replace(tmp, path)
+        _disk_memo[path] = d
+    except OSError as e:
+        log.debug("streamed-pick cache write failed: %r", e)
+
+
 def record_streamed_pick(stages, in_dtype, platform: str,
                          frames_per_dispatch: int) -> None:
-    _streamed_cache[_streamed_sig(stages, in_dtype, platform)] = \
-        int(frames_per_dispatch)
+    sig = _streamed_sig(stages, in_dtype, platform)
+    _streamed_cache[sig] = int(frames_per_dispatch)
+    _disk_store(sig, int(frames_per_dispatch))
 
 
 def cached_frames_per_dispatch(stages, in_dtype,
                                platform: str) -> Optional[int]:
-    """The cached megabatch K of a previously autotuned chain (None when the
-    chain was never tuned in this process)."""
-    return _streamed_cache.get(_streamed_sig(stages, in_dtype, platform))
+    """The cached megabatch K of a previously autotuned chain — the
+    in-process memory layer first (authoritative), then the persisted store;
+    None when the chain was never tuned."""
+    sig = _streamed_sig(stages, in_dtype, platform)
+    k = _streamed_cache.get(sig)
+    if k is not None:
+        return k
+    k = _disk_load().get(_sig_str(sig))
+    if k is not None:
+        k = int(k)
+        _streamed_cache[sig] = k      # promote: later lookups stay in memory
+    return k
 
 
 class StreamedResults(dict):

@@ -33,6 +33,7 @@ from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
 from ..telemetry.doctor import E2E_LATENCY as _E2E_LATENCY
 from ..telemetry.spans import recorder as _trace_recorder
+from ..runtime import faults as _faults
 from ..runtime.kernel import Kernel, message_handler
 from ..runtime.tag import ItemTag
 from ..types import Pmt
@@ -125,6 +126,18 @@ class TpuKernel(Kernel):
 
     async def init(self, mio, meta):
         import jax
+        # restart contract (runtime/block.py BlockPolicy): a re-init after a
+        # work-loop failure drops every trace of the failed incarnation —
+        # staged/in-flight dispatch groups, accumulated megabatch frames,
+        # pending host output — and recompiles a FRESH carry below. In-flight
+        # frames are forfeited (their input was already consumed), which is
+        # why device-plane faults prefer transfer retry or fail_fast/isolate
+        # (docs/robustness.md policy matrix).
+        self._accum.clear()
+        self._staged.clear()
+        self._inflight.clear()
+        self._pending_out = None
+        self._pending_tags = []
         self._e2e_hist = _E2E_LATENCY.labels(
             source=self.meta.instance_name or "TpuKernel")
         self._compiled, self._carry = self.pipeline.compile_wired(
@@ -226,7 +239,13 @@ class TpuKernel(Kernel):
         frames keep computing, finished frames' D2H keeps draining: the
         H2D(t+1) ∥ compute(t) ∥ D2H(t−1) overlap of the reference's circulating
         h2d/d2h staging pairs, on XLA's async dispatch queue."""
+        fplan = _faults.plan()
         while self._staged and len(self._inflight) < self.depth:
+            if fplan.armed():
+                # `dispatch` site (runtime/faults.py): fault BEFORE the group
+                # leaves the staging deque, so fail_fast/isolate forfeit a
+                # deterministic amount of in-flight work
+                fplan.maybe("dispatch", self.meta.instance_name)
             h2d, metas = self._staged.popleft()
             x_parts = h2d()
             t0 = _trace.now() if _trace.enabled else 0

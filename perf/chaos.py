@@ -1,0 +1,467 @@
+#!/usr/bin/env python
+"""perf/chaos — seeded chaos campaign for the fault-tolerant runtime (ISSUE 6).
+
+Injects faults at every documented site (``runtime/faults.py``: work,
+dispatch, h2d, d2h, link) into small flowgraphs under every failure policy
+(``BlockPolicy``: fail_fast, restart, isolate) and asserts the core
+robustness invariants on EVERY run:
+
+  I1  **no hang**: every run completes or errors within its deadline
+      (``Runtime.run(timeout=)`` — the deadline path itself is under test);
+  I2  **correct or honest**: the output is bit-correct, OR the run raised a
+      structured ``FlowgraphError`` naming the faulted block/site;
+  I3  **no leaked threads**: after teardown (plus gc for the scheduler
+      finalizers), every non-daemon thread spawned by the trial is gone;
+  I4  **state drained**: the flowgraph is restored (blocks readable), every
+      block's metrics() answers, and no input ring still holds data unless
+      the run errored.
+
+Scenario × policy compatibility (docs/robustness.md policy matrix): restart
+recovery is only *bit-correct* for faults that fire before ``work()``
+consumes input — exactly what the ``work:<block>`` site guarantees — so the
+campaign pairs restart with work faults, pairs transfer faults (h2d/d2h/link)
+with the retry plane (bit-correct by idempotent re-encode), and pairs
+dispatch faults with fail_fast/isolate (in-flight frames are forfeited, so
+the only honest outcomes are a structured error or isolation).
+
+``--smoke`` (the check.sh gate) runs the five named scenarios plus a short
+randomized campaign at a fixed seed on the CPU backend.  ``--trials N
+--seed S`` runs a longer randomized campaign.  Exit code 0 = every invariant
+held.
+"""
+
+import argparse
+import gc
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "..")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the campaign must neither read nor pollute the user-level autotune store,
+# and fusion passes would bypass the per-block injection sites
+os.environ.setdefault("FUTURESDR_TPU_AUTOTUNE_CACHE_DIR", "off")
+os.environ.setdefault("FSDR_NO_FASTCHAIN", "1")
+
+import numpy as np
+
+DEADLINE_S = 30.0          # per-trial run deadline (I1); generous for CI boxes
+GRACE_S = 5.0
+
+
+# ---------------------------------------------------------------------------
+# invariant helpers
+# ---------------------------------------------------------------------------
+
+def _threads_now():
+    return set(threading.enumerate())
+
+
+def _assert_no_leaked_threads(before, label):
+    """I3: poll (with gc for the dropped-scheduler finalizers) until every
+    trial-spawned non-daemon thread is gone."""
+    deadline = time.monotonic() + 10.0
+    while True:
+        gc.collect()
+        leaked = [t for t in threading.enumerate()
+                  if t not in before and t.is_alive() and not t.daemon
+                  and not t.name.startswith("fsdr-d2h")]
+        if not leaked:
+            return
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"[{label}] I3 violated — leaked threads: "
+                f"{sorted(t.name for t in leaked)}")
+        time.sleep(0.05)
+
+
+def _assert_state_drained(fg, label, errored):
+    """I4: blocks restored + metrics readable; healthy runs leave no input
+    ring occupied."""
+    for i in range(len(fg)):
+        wk = fg.wrapped(i)                      # raises if not restored
+        m = wk.metrics()
+        assert isinstance(m, dict) and "work_calls" in m, (label, m)
+        if not errored:
+            for port, fill in (m.get("buffer_fill") or {}).items():
+                assert fill == 0.0, \
+                    f"[{label}] I4 violated — {wk.instance_name}.{port} " \
+                    f"still holds data (fill={fill})"
+
+
+def _run_trial(build, label, expect=None):
+    """Build → run under deadline → assert I1..I4.
+
+    ``build()`` returns ``(fg, check)`` where ``check(error)`` asserts the
+    scenario-specific I2 outcome (bit-correct output or a structured error
+    naming the fault). ``expect`` ("error"/"ok"/None=either) guards the
+    run-level outcome."""
+    from futuresdr_tpu import FlowgraphCancelled, FlowgraphError, Runtime
+    from futuresdr_tpu.config import config
+    before = _threads_now()
+    config().run_timeout_grace = GRACE_S
+    fg, check = build()
+    t0 = time.perf_counter()
+    error = None
+    try:
+        Runtime().run(fg, timeout=DEADLINE_S)
+    except FlowgraphError as e:
+        error = e
+    elapsed = time.perf_counter() - t0
+    assert elapsed < DEADLINE_S + GRACE_S + 5.0, \
+        f"[{label}] I1 violated — run took {elapsed:.1f}s"
+    if error is not None:
+        # only the RUN deadline counts as a hang — a transfer-plane
+        # TransferError("... deadline exhausted") is a legitimate I2 outcome
+        hung = any(isinstance(x, FlowgraphCancelled) and
+                   "run deadline" in str(x) for x in error.errors)
+        assert not hung, f"[{label}] I1 violated — run hit its deadline: " \
+                         f"{error}"
+    if expect == "error":
+        assert error is not None, f"[{label}] expected a FlowgraphError"
+    elif expect == "ok":
+        assert error is None, f"[{label}] unexpected error: {error!r}"
+    check(error)
+    _assert_state_drained(fg, label, errored=error is not None)
+    _assert_no_leaked_threads(before, label)
+    return error
+
+
+# ---------------------------------------------------------------------------
+# named scenarios (the check.sh smoke gate)
+# ---------------------------------------------------------------------------
+
+def scenario_fail_fast_baseline():
+    """No policy set anywhere: today's fail-fast cascade, byte-for-byte — the
+    structured error still names the faulted block and the partial output is
+    a prefix of the expected stream."""
+    from futuresdr_tpu import Flowgraph
+    from futuresdr_tpu.blocks import VectorSink, VectorSource
+    from futuresdr_tpu.runtime import faults
+    data = np.arange(100_000, dtype=np.float32)
+
+    def build():
+        from futuresdr_tpu.blocks import Copy
+        fg = Flowgraph()
+        src = VectorSource(data)
+        cp = Copy(np.float32)
+        snk = VectorSink(np.float32)
+        fg.connect(src, cp, snk)
+        name = fg.wrapped(cp).instance_name
+        faults.reset().arm(f"work:{name}", rate=1.0, max_faults=1, seed=11)
+
+        def check(error):
+            assert error is not None
+            assert error.blocks == [name], (error.blocks, name)
+            assert [d["action"] for d in error.policy_decisions] == \
+                ["fail_fast"]
+            got = np.asarray(snk.items())
+            np.testing.assert_array_equal(got, data[:len(got)])
+        return fg, check
+
+    try:
+        _run_trial(build, "fail_fast_baseline", expect="error")
+    finally:
+        faults.reset()
+
+
+def scenario_restart_recovers():
+    """Acceptance: `restart` + a transient single work fault → bit-correct
+    output, one billed restart, no graph teardown."""
+    from futuresdr_tpu import BlockPolicy, Flowgraph
+    from futuresdr_tpu.blocks import Copy, VectorSink, VectorSource
+    from futuresdr_tpu.runtime import faults
+    data = np.arange(150_000, dtype=np.float32)
+    state = {}
+
+    def build():
+        fg = Flowgraph()
+        src = VectorSource(data)
+        cp = Copy(np.float32)
+        cp.policy = BlockPolicy(on_error="restart", max_restarts=3,
+                                backoff=0.002)
+        snk = VectorSink(np.float32)
+        fg.connect(src, cp, snk)
+        name = fg.wrapped(cp).instance_name
+        faults.reset().arm(f"work:{name}", rate=1.0, max_faults=1, seed=23)
+        state["fg"], state["cp"] = fg, cp
+
+        def check(error):
+            assert error is None, repr(error)
+            np.testing.assert_array_equal(np.asarray(snk.items()), data)
+            assert fg.wrapped(cp).restarts == 1
+        return fg, check
+
+    try:
+        _run_trial(build, "restart_recovers", expect="ok")
+    finally:
+        faults.reset()
+
+
+def scenario_isolate_branches():
+    """Acceptance: `isolate` retires the faulted branch; the independent
+    branch finishes bit-correct; the error names the isolated block."""
+    from futuresdr_tpu import BlockPolicy, Flowgraph
+    from futuresdr_tpu.blocks import Copy, VectorSink, VectorSource
+    from futuresdr_tpu.runtime import faults
+    data = np.arange(120_000, dtype=np.float32)
+
+    def build():
+        fg = Flowgraph()
+        snk_a = VectorSink(np.float32)
+        fg.connect(VectorSource(data), Copy(np.float32), snk_a)
+        bad = Copy(np.float32)
+        bad.policy = BlockPolicy(on_error="isolate")
+        snk_b = VectorSink(np.float32)
+        fg.connect(VectorSource(np.zeros(60_000, np.float32)), bad, snk_b)
+        name = fg.wrapped(bad).instance_name
+        faults.reset().arm(f"work:{name}", rate=1.0, max_faults=1, seed=31)
+
+        def check(error):
+            assert error is not None
+            assert error.blocks == [name]
+            assert [d["action"] for d in error.policy_decisions] == \
+                ["isolate"]
+            np.testing.assert_array_equal(np.asarray(snk_a.items()), data)
+        return fg, check
+
+    try:
+        _run_trial(build, "isolate_branches", expect="error")
+    finally:
+        faults.reset()
+
+
+def scenario_transfer_retry_deterministic():
+    """Acceptance: seeded fake-link faults on the TPU chain — retries recover
+    to output bit-identical to the unfaulted run, and the same seed bills the
+    same retry count twice."""
+    from futuresdr_tpu import Flowgraph
+    from futuresdr_tpu.blocks import VectorSink, VectorSource
+    from futuresdr_tpu.ops import mag2_stage, xfer
+    from futuresdr_tpu.tpu import TpuKernel
+    n, frame = 1 << 16, 1 << 13
+    tone = np.exp(2j * np.pi * 0.1 * np.arange(n)).astype(np.complex64)
+    expected = (tone.real ** 2 + tone.imag ** 2).astype(np.float32)
+
+    def retries():
+        return xfer._RETRIES.get(direction="h2d") + \
+            xfer._RETRIES.get(direction="d2h")
+
+    def one_run(seed):
+        from futuresdr_tpu.config import config
+        config().xfer_backoff = 0.0005
+        xfer.set_fake_link(fault_rate=0.35, fault_seed=seed)
+
+        def build():
+            fg = Flowgraph()
+            snk = VectorSink(np.float32)
+            fg.connect(VectorSource(tone),
+                       TpuKernel([mag2_stage()], np.complex64,
+                                 frame_size=frame, frames_in_flight=2),
+                       snk)
+
+            def check(error):
+                assert error is None, repr(error)
+                got = np.asarray(snk.items())
+                np.testing.assert_allclose(got, expected, rtol=1e-5)
+                one_run.last = got
+            return fg, check
+
+        before = retries()
+        _run_trial(build, f"transfer_retry(seed={seed})", expect="ok")
+        return retries() - before, one_run.last
+
+    try:
+        d1, out1 = one_run(seed=5)
+        d2, out2 = one_run(seed=5)
+        assert d1 == d2 and d1 > 0, \
+            f"retry count not deterministic: {d1} vs {d2}"
+        np.testing.assert_array_equal(out1, out2)
+    finally:
+        xfer.set_fake_link()
+
+
+def scenario_deadline_bounds_wedge():
+    """Acceptance: a wedged sink + run deadline → structured FlowgraphError
+    within deadline+grace instead of an indefinite hang."""
+    from futuresdr_tpu import (Flowgraph, FlowgraphCancelled, FlowgraphError,
+                               Kernel, Runtime)
+    from futuresdr_tpu.blocks import Copy, NullSource
+    from futuresdr_tpu.config import config
+
+    class Wedge(Kernel):
+        def __init__(self, dtype):
+            super().__init__()
+            self.input = self.add_stream_input("in", dtype)
+
+        async def work(self, io, mio, meta):
+            pass
+
+    before = _threads_now()
+    config().run_timeout_grace = 3.0
+    fg = Flowgraph()
+    fg.connect(NullSource(np.float32), Copy(np.float32), Wedge(np.float32))
+    t0 = time.perf_counter()
+    try:
+        Runtime().run(fg, timeout=1.0)
+    except FlowgraphError as e:
+        assert any(isinstance(x, FlowgraphCancelled) for x in e.errors), e
+    else:
+        raise AssertionError("wedged run did not error")
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 1.0 + 3.0 + 3.0, f"deadline not honored: {elapsed:.1f}s"
+    _assert_no_leaked_threads(before, "deadline_bounds_wedge")
+
+
+# ---------------------------------------------------------------------------
+# randomized campaign
+# ---------------------------------------------------------------------------
+
+def _random_trial(rng: random.Random, idx: int):
+    """One seeded random trial: host chain or TPU chain × compatible
+    (site, policy) pairing (module docstring matrix)."""
+    from futuresdr_tpu import BlockPolicy, Flowgraph
+    from futuresdr_tpu.blocks import Copy, VectorSink, VectorSource
+    from futuresdr_tpu.ops import xfer
+    from futuresdr_tpu.runtime import faults
+    label = f"trial_{idx}"
+    topology = rng.choice(("host", "tpu"))
+    n = rng.choice((50_000, 120_000))
+    seed = rng.randrange(1 << 16)
+
+    if topology == "host":
+        data = np.arange(n, dtype=np.float32)
+        site_kind = rng.choice(("work", "none"))
+        policy = rng.choice(("fail_fast", "restart", "isolate"))
+        max_faults = rng.choice((1, 2))
+
+        def build():
+            fg = Flowgraph()
+            cp = Copy(np.float32)
+            if policy != "fail_fast":
+                cp.policy = BlockPolicy(on_error=policy, max_restarts=3,
+                                        backoff=0.002)
+            snk = VectorSink(np.float32)
+            fg.connect(VectorSource(data), cp, snk)
+            name = fg.wrapped(cp).instance_name
+            plan = faults.reset()
+            if site_kind == "work":
+                plan.arm(f"work:{name}", rate=1.0, max_faults=max_faults,
+                         seed=seed)
+
+            def check(error):
+                if error is not None:
+                    # I2 (honest error): the faulted block is named
+                    assert name in error.blocks, (label, error.blocks)
+                    got = np.asarray(snk.items())
+                    np.testing.assert_array_equal(got, data[:len(got)])
+                else:
+                    # I2 (correct): only reachable when recovery succeeded
+                    np.testing.assert_array_equal(np.asarray(snk.items()),
+                                                  data)
+            return fg, check
+
+        expect = None
+        if site_kind == "none":
+            expect = "ok"
+        elif policy == "restart":
+            expect = "ok"           # work faults fire pre-consume: recoverable
+        else:
+            expect = "error"
+        try:
+            _run_trial(build, label, expect=expect)
+        finally:
+            faults.reset()
+        return
+
+    # tpu topology: transfer faults ride the retry plane (recovered), or a
+    # dispatch fault under fail_fast/isolate (honest structured error)
+    from futuresdr_tpu.config import config
+    from futuresdr_tpu.ops import mag2_stage
+    from futuresdr_tpu.tpu import TpuKernel
+    tone = np.exp(2j * np.pi * 0.07 * np.arange(n)).astype(np.complex64)
+    expected = (tone.real ** 2 + tone.imag ** 2).astype(np.float32)
+    site = rng.choice(("h2d", "d2h", "link", "dispatch"))
+    config().xfer_backoff = 0.0005
+
+    def build():
+        fg = Flowgraph()
+        tk = TpuKernel([mag2_stage()], np.complex64, frame_size=1 << 13,
+                       frames_in_flight=2)
+        snk = VectorSink(np.float32)
+        fg.connect(VectorSource(tone), tk, snk)
+        name = fg.wrapped(tk).instance_name
+        plan = faults.reset()
+        if site == "dispatch":
+            plan.arm(f"dispatch:{name}", rate=1.0, max_faults=1, seed=seed)
+        else:
+            plan.arm(site, rate=1.0, max_faults=rng.choice((1, 2)), seed=seed)
+
+        def check(error):
+            if site == "dispatch":
+                assert error is not None
+                assert name in error.blocks, (label, error.blocks)
+            else:
+                assert error is None, (label, repr(error))
+                np.testing.assert_allclose(np.asarray(snk.items()), expected,
+                                           rtol=1e-5)
+        return fg, check
+
+    try:
+        _run_trial(build, label,
+                   expect="error" if site == "dispatch" else "ok")
+    finally:
+        faults.reset()
+
+
+def campaign(trials: int, seed: int) -> None:
+    rng = random.Random(seed)
+    for i in range(trials):
+        t0 = time.perf_counter()
+        _random_trial(rng, i)
+        print(f"  trial {i}: ok ({time.perf_counter() - t0:.2f}s)")
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+SCENARIOS = (
+    ("fail_fast_baseline", scenario_fail_fast_baseline),
+    ("restart_recovers", scenario_restart_recovers),
+    ("isolate_branches", scenario_isolate_branches),
+    ("transfer_retry_deterministic", scenario_transfer_retry_deterministic),
+    ("deadline_bounds_wedge", scenario_deadline_bounds_wedge),
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="named scenarios + a short fixed-seed campaign "
+                         "(the check.sh gate)")
+    ap.add_argument("--trials", type=int, default=12,
+                    help="randomized campaign length (ignored with --smoke)")
+    ap.add_argument("--seed", type=int, default=1234)
+    args = ap.parse_args(argv)
+    import jax
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    t_all = time.perf_counter()
+    for name, fn in SCENARIOS:
+        t0 = time.perf_counter()
+        fn()
+        print(f"chaos scenario {name}: ok ({time.perf_counter() - t0:.2f}s)")
+    n = 4 if args.smoke else args.trials
+    print(f"chaos campaign: {n} randomized trials (seed {args.seed})")
+    campaign(n, args.seed)
+    print(f"CHAOS OK — every invariant held "
+          f"({time.perf_counter() - t_all:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -168,8 +168,12 @@ def test_watchdog_strikes_trip_and_rearm():
     assert d.last_trip is None        # window not reached yet
     d.tick()
     assert d.last_trip is not None
-    assert d.last_trip["state"] == "deadlocked"   # no edges to classify over
+    # no stream ports anywhere + drained inboxes = a message-plane flowgraph
+    # waiting for events: reported `idle`, NOT `deadlocked` (ROADMAP
+    # follow-up), and no flight record fires for it
+    assert d.last_trip["state"] == "idle"
     assert d.last_trip["suspect_block"] is None
+    assert d.last_report is None
     # progress resumes → re-armed, diagnosis flips to progressing
     wk.counters["work_calls"] = 7
     d.tick()
@@ -177,6 +181,77 @@ def test_watchdog_strikes_trip_and_rearm():
     assert not att.tripped and att.diagnosis["state"] == "progressing"
     d.detach(token)
     assert d.attached() == []
+
+
+def test_watchdog_message_plane_classification():
+    """Satellite (ROADMAP follow-up): message-plane-only flowgraphs are no
+    longer blanket-`deadlocked` — drained inboxes report `idle`; queued
+    messages that are not draining report `deadlocked` naming the stuck
+    block."""
+    d = doc.Doctor()
+    d.interval, d.window = 0.01, 2
+    wk = _fake_wk("msg_sink_0")
+    wk.inbox = []                     # duck-typed: len() is the queue depth
+    token = d.attach([wk], [])
+    d.tick()
+    for _ in range(2):
+        d.tick()
+    assert d.last_trip["state"] == "idle"
+    assert "waiting for events" in d.last_trip["detail"]
+    # idle does NOT latch the trip: if messages later queue up and the
+    # handler wedges (progress still flat), the re-armed window escalates to
+    # a real deadlocked diagnosis (with flight record)
+    wk.inbox = ["m1", "m2"]
+    for _ in range(2):
+        d.tick()
+    assert d.last_trip["state"] == "deadlocked"
+    assert d.last_trip["suspect_block"] == "msg_sink_0"
+    assert d.last_report is not None  # the escalation dumped a flight record
+    # same graph, but now messages are queued and the handler isn't draining
+    d2 = doc.Doctor()
+    d2.interval, d2.window = 0.01, 2
+    wk2 = _fake_wk("msg_sink_1")
+    wk2.inbox = ["m1", "m2", "m3"]
+    d2.attach([wk2], [])
+    d2.tick()
+    for _ in range(2):
+        d2.tick()
+    diag = d2.last_trip
+    assert diag["state"] == "deadlocked"
+    assert diag["suspect_block"] == "msg_sink_1"
+    assert "3 queued" in diag["detail"]
+    d.detach(token)
+
+
+def test_watchdog_idle_on_live_message_flowgraph(watchdog):
+    """Integration regression: a real message-plane-only flowgraph (periodic
+    source → sink) between events samples as `idle`, never `deadlocked`."""
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import MessageSink, MessageSource
+    d = doc.doctor()
+    fg = Flowgraph()
+    src = MessageSource("tick", interval=60.0, count=3)   # one event, then quiet
+    snk = MessageSink()
+    fg.connect_message(src, "out", snk, "in")
+    running = Runtime().start(fg)
+    try:
+        # deterministic stepping: sample well past the window while the
+        # source sleeps out its 60 s interval (no watchdog thread needed —
+        # the fixture arms one at a long interval to keep enable/disable
+        # lifecycle covered, but ticks are driven here)
+        watchdog(interval=30.0, window=3)
+        for _ in range(5):
+            d.tick()
+        # assert on THIS flowgraph's attachment only: other tests may leave
+        # legitimately-live graphs attached to the process doctor
+        ours = [a for a in d._fgs.values()
+                if {b.instance_name for b in a.blocks} ==
+                {src.meta.instance_name, snk.meta.instance_name}]
+        assert ours, "flowgraph not attached"
+        states = {a.diagnosis["state"] for a in ours if a.diagnosis}
+        assert states == {"idle"}, states
+    finally:
+        running.stop_sync()
 
 
 # ---------------------------------------------------------------------------
@@ -490,6 +565,44 @@ def test_devchain_uses_cached_autotune_k():
         np.testing.assert_allclose(
             np.asarray(snk.items()),
             (tone.real ** 2 + tone.imag ** 2).astype(np.float32), rtol=1e-5)
+    finally:
+        _streamed_cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# streamed-pick cache persists across processes (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+def test_streamed_pick_cache_persists_across_processes(tmp_path, monkeypatch):
+    import json as _json
+    import os as _os
+
+    from futuresdr_tpu.config import config
+    from futuresdr_tpu.ops import mag2_stage
+    from futuresdr_tpu.tpu.autotune import (_streamed_cache,
+                                            cached_frames_per_dispatch,
+                                            record_streamed_pick)
+    monkeypatch.setattr(config(), "autotune_cache_dir", str(tmp_path))
+    stages = [mag2_stage()]
+    try:
+        record_streamed_pick(stages, np.complex64, "cpu", 4)
+        path = _os.path.join(str(tmp_path), "streamed_picks.json")
+        assert _os.path.exists(path)
+        disk = _json.load(open(path))
+        assert list(disk.values()) == [4]
+        # simulate a NEW process: the in-memory layer is empty, the lookup
+        # falls through to the persisted store and promotes the hit
+        _streamed_cache.clear()
+        assert cached_frames_per_dispatch(stages, np.complex64, "cpu") == 4
+        assert _streamed_cache, "disk hit not promoted to the memory layer"
+        # in-memory stays authoritative within a process: a newer in-process
+        # record wins over what the file said
+        record_streamed_pick(stages, np.complex64, "cpu", 2)
+        assert cached_frames_per_dispatch(stages, np.complex64, "cpu") == 2
+        # persistence disabled → no disk fallback
+        _streamed_cache.clear()
+        monkeypatch.setattr(config(), "autotune_cache_dir", "off")
+        assert cached_frames_per_dispatch(stages, np.complex64, "cpu") is None
     finally:
         _streamed_cache.clear()
 

@@ -1,0 +1,460 @@
+"""Fault-tolerant runtime (ISSUE 6 tentpole): per-block failure policies
+(restart / isolate / fail_fast), structured multi-error FlowgraphError,
+``Runtime.run(timeout=)`` graceful deadlines, and the doctor's
+``doctor_action: cancel`` escalation."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu import (BlockPolicy, Flowgraph, FlowgraphCancelled,
+                           FlowgraphError, Kernel, Runtime)
+from futuresdr_tpu.blocks import Copy, NullSource, VectorSink, VectorSource
+from futuresdr_tpu.config import config
+from futuresdr_tpu.telemetry import doctor as doc
+
+
+class FlakyCopy(Kernel):
+    """Copies input, raising on chosen work calls BEFORE touching any port —
+    the same fault point as the ``work:<block>`` injection site, so a restart
+    loses no consumed input."""
+
+    def __init__(self, dtype, fail_on=(), always=False):
+        super().__init__()
+        self.input = self.add_stream_input("in", dtype)
+        self.output = self.add_stream_output("out", dtype)
+        self.fail_on = set(fail_on)
+        self.always = always
+        self.calls = 0
+        self.init_calls = 0
+
+    async def init(self, mio, meta):
+        self.init_calls += 1
+
+    async def work(self, io, mio, meta):
+        self.calls += 1
+        if self.always or self.calls in self.fail_on:
+            raise RuntimeError(f"flaky boom #{self.calls}")
+        inp = self.input.slice()
+        out = self.output.slice()
+        n = min(len(inp), len(out))
+        if n:
+            out[:n] = inp[:n]
+            self.input.consume(n)
+            self.output.produce(n)
+        if self.input.finished() and n == len(inp):
+            io.finished = True
+
+
+class FlakyInit(Kernel):
+    """Init fails ``fail_times`` times, then comes up and copies."""
+
+    def __init__(self, dtype, fail_times: int):
+        super().__init__()
+        self.input = self.add_stream_input("in", dtype)
+        self.output = self.add_stream_output("out", dtype)
+        self.fail_times = fail_times
+        self.init_calls = 0
+
+    async def init(self, mio, meta):
+        self.init_calls += 1
+        if self.init_calls <= self.fail_times:
+            raise RuntimeError(f"init boom #{self.init_calls}")
+
+    async def work(self, io, mio, meta):
+        inp = self.input.slice()
+        out = self.output.slice()
+        n = min(len(inp), len(out))
+        if n:
+            out[:n] = inp[:n]
+            self.input.consume(n)
+            self.output.produce(n)
+        if self.input.finished() and n == len(inp):
+            io.finished = True
+
+
+class WedgeSink(Kernel):
+    """Never consumes, never finishes — the canonical wedged flowgraph."""
+
+    def __init__(self, dtype):
+        super().__init__()
+        self.input = self.add_stream_input("in", dtype)
+
+    async def work(self, io, mio, meta):
+        pass
+
+
+def _restarts(block_name: str) -> float:
+    from futuresdr_tpu.runtime.block import _RESTARTS
+    return _RESTARTS.get(block=block_name)
+
+
+# ---------------------------------------------------------------------------
+# restart policy
+# ---------------------------------------------------------------------------
+
+def test_restart_recovers_bit_correct():
+    """Acceptance: `restart` recovers to bit-correct output for a transient
+    single-fault run — fresh init, billed restart counter, no graph teardown."""
+    data = np.arange(200_000, dtype=np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    fc = FlakyCopy(np.float32, fail_on=(2,))
+    fc.policy = BlockPolicy(on_error="restart", max_restarts=3, backoff=0.002)
+    snk = VectorSink(np.float32)
+    fg.connect(src, fc, snk)
+    before = _restarts(f"FlakyCopy_{fg.block_id(fc)}")
+    Runtime().run(fg)
+    np.testing.assert_array_equal(np.asarray(snk.items()), data)
+    wk = fg.wrapped(fc)
+    assert wk.restarts == 1
+    assert fc.init_calls == 2             # original init + one restart re-init
+    assert _restarts(wk.instance_name) - before == 1
+    assert wk.metrics()["restarts"] == 1
+
+
+def test_restart_exhausted_escalates_to_failure():
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(10_000, np.float32))
+    fc = FlakyCopy(np.float32, always=True)
+    fc.policy = BlockPolicy(on_error="restart", max_restarts=2, backoff=0.002)
+    snk = VectorSink(np.float32)
+    fg.connect(src, fc, snk)
+    with pytest.raises(FlowgraphError) as ei:
+        Runtime().run(fg)
+    e = ei.value
+    wk = fg.wrapped(fc)
+    assert wk.restarts == 2
+    assert e.blocks == [wk.instance_name]
+    actions = [d["action"] for d in e.policy_decisions]
+    assert actions.count("restart") == 2
+    assert actions[-1] == "restarts_exhausted"
+
+
+def test_restart_covers_init_failures():
+    data = np.arange(50_000, dtype=np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    fi = FlakyInit(np.float32, fail_times=2)
+    fi.policy = BlockPolicy(on_error="restart", max_restarts=3, backoff=0.002)
+    snk = VectorSink(np.float32)
+    fg.connect(src, fi, snk)
+    Runtime().run(fg)
+    np.testing.assert_array_equal(np.asarray(snk.items()), data)
+    assert fi.init_calls == 3
+    assert fg.wrapped(fi).restarts == 2
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        BlockPolicy(on_error="explode")
+    assert BlockPolicy.from_config().on_error == "fail_fast"
+
+
+# ---------------------------------------------------------------------------
+# isolate policy
+# ---------------------------------------------------------------------------
+
+def test_isolate_lets_independent_branches_finish():
+    """Acceptance: `isolate` retires the failed block (EOS downstream,
+    upstream detach) while an independent branch completes bit-correct; the
+    run still raises a structured FlowgraphError naming the faulted block."""
+    data = np.arange(100_000, dtype=np.float32)
+    fg = Flowgraph()
+    src_a = VectorSource(data)
+    cp = Copy(np.float32)
+    snk_a = VectorSink(np.float32)
+    fg.connect(src_a, cp, snk_a)
+    src_b = VectorSource(np.zeros(50_000, np.float32))
+    bad = FlakyCopy(np.float32, always=True)
+    bad.policy = BlockPolicy(on_error="isolate")
+    snk_b = VectorSink(np.float32)
+    fg.connect(src_b, bad, snk_b)
+    with pytest.raises(FlowgraphError) as ei:
+        Runtime().run(fg)
+    e = ei.value
+    # the healthy branch finished ALL its data despite the peer failure
+    np.testing.assert_array_equal(np.asarray(snk_a.items()), data)
+    assert e.blocks == [fg.wrapped(bad).instance_name]
+    assert [d["action"] for d in e.policy_decisions] == ["isolate"]
+    assert isinstance(e.errors[0], RuntimeError)
+
+
+def test_isolate_covers_init_failures():
+    data = np.arange(60_000, dtype=np.float32)
+    fg = Flowgraph()
+    src_a = VectorSource(data)
+    snk_a = VectorSink(np.float32)
+    fg.connect(src_a, Copy(np.float32), snk_a)
+    src_b = VectorSource(np.zeros(1000, np.float32))
+    bad = FlakyInit(np.float32, fail_times=99)
+    bad.policy = BlockPolicy(on_error="isolate")
+    snk_b = VectorSink(np.float32)
+    fg.connect(src_b, bad, snk_b)
+    with pytest.raises(FlowgraphError) as ei:
+        Runtime().run(fg)
+    np.testing.assert_array_equal(np.asarray(snk_a.items()), data)
+    dec = ei.value.policy_decisions
+    assert dec and dec[0]["action"] == "isolate" and dec[0]["phase"] == "init"
+
+
+# ---------------------------------------------------------------------------
+# fail_fast default + multi-error aggregation (satellite: errors[0]-only bug)
+# ---------------------------------------------------------------------------
+
+def test_fail_fast_default_structured_error():
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(10_000, np.float32))
+    bad = FlakyCopy(np.float32, always=True)     # no policy set anywhere
+    snk = VectorSink(np.float32)
+    fg.connect(src, bad, snk)
+    with pytest.raises(FlowgraphError) as ei:
+        Runtime().run(fg)
+    e = ei.value
+    assert str(e) == str(e.errors[0])            # single-error message contract
+    assert e.blocks == [fg.wrapped(bad).instance_name]
+    assert [d["action"] for d in e.policy_decisions] == ["fail_fast"]
+    assert e.flight_record is None
+    assert len(fg) == 3                          # blocks restored
+
+
+def test_multi_block_failures_are_aggregated():
+    """Satellite: FlowgraphError used to stringify only errors[0] — concurrent
+    failures must all surface, with the count in the message."""
+    fg = Flowgraph()
+    src = NullSource(np.float32)
+    bad1 = FlakyInit(np.float32, fail_times=99)
+    bad2 = FlakyInit(np.float32, fail_times=99)
+    snk = VectorSink(np.float32)
+    fg.connect(src, bad1, bad2, snk)
+    with pytest.raises(FlowgraphError) as ei:
+        Runtime().run(fg)
+    e = ei.value
+    assert len(e.errors) == 2
+    assert "2 blocks failed" in str(e)
+    names = {fg.wrapped(bad1).instance_name, fg.wrapped(bad2).instance_name}
+    assert set(e.blocks) == names
+    for n in names:
+        assert n in str(e)
+
+
+# ---------------------------------------------------------------------------
+# run deadlines (Runtime.run(timeout=) / run_timeout config)
+# ---------------------------------------------------------------------------
+
+def _wedged_fg():
+    fg = Flowgraph()
+    fg.connect(NullSource(np.float32), Copy(np.float32),
+               WedgeSink(np.float32))
+    return fg
+
+
+def test_run_timeout_converts_hang_to_error(monkeypatch):
+    monkeypatch.setattr(config(), "run_timeout_grace", 3.0)
+    t0 = time.perf_counter()
+    with pytest.raises(FlowgraphError) as ei:
+        Runtime().run(_wedged_fg(), timeout=0.6)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 8.0, f"deadline did not bound the run ({elapsed:.1f}s)"
+    e = ei.value
+    assert any(isinstance(x, FlowgraphCancelled) for x in e.errors)
+    assert any(d["action"] == "cancel" for d in e.policy_decisions)
+    assert "deadline" in str(e)
+
+
+def test_run_timeout_config_knob(monkeypatch):
+    monkeypatch.setattr(config(), "run_timeout", 0.6)
+    monkeypatch.setattr(config(), "run_timeout_grace", 3.0)
+    with pytest.raises(FlowgraphError):
+        Runtime().run(_wedged_fg())
+
+
+def test_run_timeout_bounds_wedged_init():
+    """The deadline is a TOTAL budget: a kernel.init wedged on a dead link
+    must not hang run() any more than a wedged work() may."""
+    import asyncio
+
+    class WedgedInit(Kernel):
+        def __init__(self, dtype):
+            super().__init__()
+            self.input = self.add_stream_input("in", dtype)
+
+        async def init(self, mio, meta):
+            await asyncio.sleep(3600)
+
+    fg = Flowgraph()
+    fg.connect(NullSource(np.float32), WedgedInit(np.float32))
+    t0 = time.perf_counter()
+    with pytest.raises(FlowgraphError, match="init barrier"):
+        Runtime().run(fg, timeout=0.5)
+    assert time.perf_counter() - t0 < 4.0
+    e_ok = False
+    try:
+        Runtime().run(fg, timeout=0.5)
+    except FlowgraphError as e:
+        e_ok = any(isinstance(x, FlowgraphCancelled) for x in e.errors)
+    except RuntimeError:
+        e_ok = True        # second launch of a taken flowgraph also raises
+    assert e_ok
+
+
+def test_run_timeout_not_triggered_on_healthy_run():
+    data = np.arange(10_000, dtype=np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    snk = VectorSink(np.float32)
+    fg.connect(src, Copy(np.float32), snk)
+    Runtime().run(fg, timeout=30.0)
+    np.testing.assert_array_equal(np.asarray(snk.items()), data)
+
+
+# ---------------------------------------------------------------------------
+# doctor escalation (doctor_action: cancel) — acceptance
+# ---------------------------------------------------------------------------
+
+def test_doctor_cancel_converts_wedge_to_error(tmp_path, monkeypatch):
+    """Acceptance: with `doctor_action: cancel` a wedged-sink flowgraph turns
+    from an indefinite hang into a FlowgraphError with an attached flight
+    record."""
+    monkeypatch.setenv("FSDR_NO_FASTCHAIN", "1")
+    monkeypatch.setattr(config(), "doctor_action", "cancel")
+    monkeypatch.setattr(config(), "doctor_dir", str(tmp_path))
+    d = doc.doctor()
+    d.enable(interval=0.05, window=3)
+    try:
+        with pytest.raises(FlowgraphError) as ei:
+            Runtime().run(_wedged_fg())
+        e = ei.value
+        assert any(isinstance(x, FlowgraphCancelled) for x in e.errors)
+        assert "doctor watchdog: backpressured" in str(e)
+        assert e.flight_record is not None and os.path.exists(e.flight_record)
+    finally:
+        d.disable()
+        d.last_trip = None
+
+
+def test_doctor_cancel_unwedges_init_barrier(monkeypatch):
+    """A block wedged inside init() never answers the barrier — the doctor's
+    cancel must still convert the run into a FlowgraphError (the supervisor
+    abandons the barrier) instead of queueing the cancel forever."""
+    import asyncio
+
+    class WedgedInit(Kernel):
+        def __init__(self, dtype):
+            super().__init__()
+            self.input = self.add_stream_input("in", dtype)
+
+        async def init(self, mio, meta):
+            await asyncio.sleep(3600)
+
+    monkeypatch.setenv("FSDR_NO_FASTCHAIN", "1")
+    monkeypatch.setattr(config(), "doctor_action", "cancel")
+    d = doc.doctor()
+    d.enable(interval=0.05, window=3)
+    try:
+        fg = Flowgraph()
+        fg.connect(NullSource(np.float32), WedgedInit(np.float32))
+        t0 = time.perf_counter()
+        with pytest.raises(FlowgraphError) as ei:
+            Runtime().run(fg)
+        assert time.perf_counter() - t0 < 15.0
+        assert any(isinstance(x, FlowgraphCancelled) for x in ei.value.errors)
+    finally:
+        d.disable()
+        d.last_trip = None
+
+
+def test_supervisor_flight_record_carries_error_count():
+    """Satellite: the supervisor's on-error flight record surfaces how many
+    blocks failed and which policy decisions were taken."""
+    d = doc.doctor()
+    d.enable(interval=30.0, window=5)     # enabled → supervisor errors dump
+    try:
+        fg = Flowgraph()
+        src = VectorSource(np.zeros(1000, np.float32))
+        bad = FlakyCopy(np.float32, always=True)
+        snk = VectorSink(np.float32)
+        fg.connect(src, bad, snk)
+        with pytest.raises(FlowgraphError):
+            Runtime().run(fg)
+        sup = (d.last_report or {}).get("supervisor")
+        assert sup is not None
+        assert sup["block_errors"] == 1
+        assert sup["blocks"] == [fg.wrapped(bad).instance_name]
+        assert sup["policy_decisions"][0]["action"] == "fail_fast"
+    finally:
+        d.disable()
+        d.last_trip = None
+
+
+# ---------------------------------------------------------------------------
+# fusion degrades for policy-bearing members
+# ---------------------------------------------------------------------------
+
+def test_devchain_refuses_policy_members():
+    from futuresdr_tpu.ops import mag2_stage
+    from futuresdr_tpu.tpu import TpuD2H, TpuH2D, TpuStage
+    frame = 4096
+    n = 4 * frame
+    tone = np.exp(2j * np.pi * 0.05 * np.arange(n)).astype(np.complex64)
+    fg = Flowgraph()
+    src = VectorSource(tone)
+    h2d = TpuH2D(np.complex64, frame_size=frame)
+    st = TpuStage([mag2_stage()], np.complex64)
+    st.policy = BlockPolicy(on_error="restart")
+    d2h = TpuD2H(np.float32)
+    snk = VectorSink(np.float32)
+    fg.connect(src, h2d, st, d2h, snk)
+    done = Runtime().run(fg)
+    m = done.wrapped(st).metrics()
+    assert not m.get("fused_devchain"), \
+        "a restart-policy member must refuse device-graph fusion"
+    np.testing.assert_allclose(
+        np.asarray(snk.items()),
+        (tone.real ** 2 + tone.imag ** 2).astype(np.float32), rtol=1e-5)
+
+
+def test_devchain_degrades_under_global_policy(monkeypatch):
+    from futuresdr_tpu.runtime.devchain import devchain_enabled
+    assert devchain_enabled()
+    monkeypatch.setattr(config(), "block_policy", "restart")
+    assert not devchain_enabled()
+
+
+def test_devchain_degrades_under_work_faults():
+    from futuresdr_tpu.runtime import faults
+    from futuresdr_tpu.runtime.devchain import devchain_enabled
+    faults.reset().arm("work:some_block", rate=0.5)
+    try:
+        assert not devchain_enabled()
+    finally:
+        faults.reset()
+    assert devchain_enabled()
+
+
+# ---------------------------------------------------------------------------
+# injected work faults drive the same machinery end to end
+# ---------------------------------------------------------------------------
+
+def test_injected_work_fault_with_restart_policy(monkeypatch):
+    """The chaos harness's core recovery path as a unit test: a seeded
+    single-shot work fault + restart policy → bit-correct output."""
+    from futuresdr_tpu.runtime import faults
+    monkeypatch.setenv("FSDR_NO_FASTCHAIN", "1")
+    data = np.arange(120_000, dtype=np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    cp = Copy(np.float32)
+    cp.policy = BlockPolicy(on_error="restart", max_restarts=2, backoff=0.002)
+    snk = VectorSink(np.float32)
+    fg.connect(src, cp, snk)
+    name = fg.wrapped(cp).instance_name
+    faults.reset().arm(f"work:{name}", rate=1.0, max_faults=1, seed=3)
+    try:
+        Runtime().run(fg)
+    finally:
+        faults.reset()
+    np.testing.assert_array_equal(np.asarray(snk.items()), data)
+    assert fg.wrapped(cp).restarts == 1
