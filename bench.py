@@ -247,6 +247,51 @@ def run_streamed(n_samples: int, frame_size: int, depth: int = 8,
     return n_samples / dt / 1e6
 
 
+def run_streamed_fanout(n_samples: int, frame_size: int,
+                        depth: int = 8) -> tuple:
+    """1→2 device fan-out through the actor runtime: the bench FIR feeds a
+    decimating-FIR branch and a |x|² branch over a broadcast stream edge; the
+    device-graph fusion pass collapses the region into ONE multi-output
+    dispatch per frame (``runtime/devchain.py`` fan-out fusion). Returns
+    ``(msps, dispatches_per_frame)`` — the trajectory stamp for the
+    broadcast-fusion win (H2D billed once instead of once per branch)."""
+    from futuresdr_tpu.config import config
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import fir_stage, mag2_stage
+
+    config().buffer_size = max(config().buffer_size, 4 * frame_size * 8)
+    taps = firdes.lowpass(0.2, N_TAPS).astype(np.float32)
+    t2 = firdes.lowpass(0.15, N_TAPS).astype(np.float32)
+    fg = Flowgraph()
+    src = NullSource(np.complex64)
+    head = Head(np.complex64, n_samples)
+    prod = TpuKernel([fir_stage(taps, name="p")], np.complex64,
+                     frame_size=frame_size, frames_in_flight=depth)
+    b1 = TpuKernel([fir_stage(t2, decim=4, name="b1")], np.complex64,
+                   frame_size=frame_size, frames_in_flight=depth)
+    b2 = TpuKernel([mag2_stage()], np.complex64, frame_size=frame_size,
+                   frames_in_flight=depth)
+    s1 = NullSink(np.complex64)
+    s2 = NullSink(np.float32)
+    fg.connect_stream(src, "out", head, "in")
+    fg.connect_stream(head, "out", prod, "in")
+    fg.connect_stream(prod, "out", b1, "in")     # broadcast port group
+    fg.connect_stream(prod, "out", b2, "in")
+    fg.connect_stream(b1, "out", s1, "in")
+    fg.connect_stream(b2, "out", s2, "in")
+    t0 = time.perf_counter()
+    Runtime().run(fg)
+    dt = time.perf_counter() - t0
+    n_frames = n_samples // frame_size
+    assert s2.n_received >= n_frames * frame_size, s2.n_received
+    m = prod.extra_metrics()
+    if m.get("fused_devchain"):
+        dpf = m["devchain_dispatches"] / max(1, m["devchain_frames"])
+    else:   # declined (FSDR_NO_DEVCHAIN, policy degrade): per-hop dispatches
+        dpf = sum(k._dispatches for k in (prod, b1, b2)) / max(1, n_frames)
+    return n_samples / dt / 1e6, dpf
+
+
 _CHAINS = ("fm", "wlan", "lora")        # keys: <name>_msps (input Msamples/s)
 
 
@@ -270,6 +315,13 @@ def _run_streamed_child(frame: int, n: int, depth: int,
     print(f"STREAM_STATS {s.get('frames', 0)} {s.get('dispatches', 0)} "
           f"{s.get('frames_per_dispatch', 1)}")
     print(f"STREAM_RATE {rate}")
+
+
+def _run_fanout_child(frame: int, n: int, depth: int) -> None:
+    """Child mode (``--run-fanout``): one streamed 1→2 fan-out measurement."""
+    rate, dpf = run_streamed_fanout(n, frame, depth)
+    print(f"FANOUT_DPF {dpf}")
+    print(f"FANOUT_RATE {rate}")
 
 
 def _sub_rate(argv, pattern, timeout, extra_env=None):
@@ -382,6 +434,10 @@ def main():
     p.add_argument("--run-streamed", nargs=3, type=int, default=None,
                    metavar=("FRAME", "N", "DEPTH"),
                    help="internal child mode: one streamed measurement")
+    p.add_argument("--run-fanout", nargs=3, type=int, default=None,
+                   metavar=("FRAME", "N", "DEPTH"),
+                   help="internal child mode: one streamed 1→2 fan-out "
+                        "measurement")
     p.add_argument("--wire", default="f32",
                    help="wire format for --run-streamed (ops/wire.py)")
     p.add_argument("--trace", default=None, metavar="OUT_JSON",
@@ -411,6 +467,9 @@ def main():
         return
     if args.run_streamed:
         _run_streamed_child(*args.run_streamed, wire=args.wire)
+        return
+    if args.run_fanout:
+        _run_fanout_child(*args.run_fanout)
         return
 
     inst_ = instance()
@@ -719,6 +778,53 @@ def main():
         print(f"# streamed wire A/B unavailable: {e!r}", file=sys.stderr)
         wire_extra["streamed_wire_error"] = repr(e)
 
+    # streamed 1→2 fan-out (broadcast fusion, runtime/devchain.py): the same
+    # frame/depth regime, a producer FIR feeding two device branches over a
+    # broadcast stream edge — fused into ONE multi-output dispatch per frame
+    # with the input uploaded once. Stamped so the trajectory captures the
+    # fan-out fusion win (and perf/regress.py grades it round over round).
+    fanout_extra = {}
+    try:
+        import re as _re
+        n_fan = int(min(max(probe_best * 1e6 * per_run,
+                            stream_frame * 4 * args.depth), 200_000_000))
+        n_fan = (n_fan // stream_frame) * stream_frame
+        fan_runs, fan_dpf = [], None
+        for _ in range(3):
+            if guarded:
+                r, err, out = _sub_rate(
+                    ["--run-fanout", str(stream_frame), str(n_fan),
+                     str(args.depth)], "FANOUT_RATE", 600)
+                if r is None:
+                    fanout_extra["streamed_fanout_error"] = err
+                    print(f"# streamed fan-out run failed: {err}",
+                          file=sys.stderr)
+                    continue
+                md = _re.search(r"FANOUT_DPF ([0-9.eE+-]+)", out)
+                if md:
+                    fan_dpf = float(md.group(1))
+            else:
+                r, fan_dpf = run_streamed_fanout(n_fan, stream_frame,
+                                                 args.depth)
+            fan_runs.append(r)
+        fan_runs.sort()
+        if fan_runs:
+            fanout_extra.update({
+                "streamed_fanout_msps": round(
+                    fan_runs[(len(fan_runs) - 1) // 2], 1),
+                "streamed_fanout_runs": [round(r, 1) for r in fan_runs],
+                "fanout_dispatches_per_frame": round(fan_dpf, 3)
+                if fan_dpf is not None else None,
+            })
+            print(f"# streamed 1→2 fan-out: median "
+                  f"{fanout_extra['streamed_fanout_msps']:.1f} Msps, "
+                  f"{fanout_extra['fanout_dispatches_per_frame']} "
+                  f"dispatches/frame, runs {['%.1f' % r for r in fan_runs]}",
+                  file=sys.stderr)
+    except Exception as e:                              # noqa: BLE001
+        print(f"# streamed fan-out A/B unavailable: {e!r}", file=sys.stderr)
+        fanout_extra["streamed_fanout_error"] = repr(e)
+
     result = {
         "metric": f"fir64+fft{FFT_SIZE}+mag2 fused chain, device-resident ({inst_.platform})",
         "value": round(dev_rate, 1),
@@ -743,6 +849,7 @@ def main():
         "dev_frame_sweep": dev_sweep,
         **link,
         **wire_extra,
+        **fanout_extra,
         **roof,
         **doctor_extra,
         **extras,

@@ -13,7 +13,10 @@ JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_telemetry.py::test_telemetry_disabled_overhead_null_rand
 
 echo "== device-graph fusion gate (docs/tpu_notes.md 'Device-graph fusion') =="
-# fused A/B smoke: the pass engages, dispatches drop 3x -> 1x per frame
+# fused A/B smoke: the linear pass engages (dispatches drop 3x -> 1x per
+# frame) AND the fan-out pass engages (1->2 broadcast region: H2D bytes bill
+# exactly ONE upload per marginal frame via fsdr_xfer_bytes_total, one
+# multi-output dispatch per frame, replayed-link throughput win)
 JAX_PLATFORMS=cpu python perf/devchain_ab.py --smoke
 # fusion equality tests, then the DECLINED mode (FSDR_NO_DEVCHAIN=1) over the
 # device-plane suite: the per-hop fallback must stand alone

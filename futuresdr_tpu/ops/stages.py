@@ -24,9 +24,27 @@ import numpy as np
 
 from . import mxu_fft
 
-__all__ = ["Stage", "Pipeline", "fir_stage", "fft_stage", "mag2_stage", "log10_stage",
+__all__ = ["Stage", "Pipeline", "FanoutPipeline", "fir_stage", "fft_stage",
+           "mag2_stage", "log10_stage",
            "rotator_stage", "quad_demod_stage", "apply_stage", "fftshift_stage",
            "decimate_stage", "moving_avg_stage"]
+
+
+def _donate_argnums(donate) -> tuple:
+    """Normalize a donation spec into jit ``donate_argnums``.
+
+    ``True`` donates the carries (argnum 0, the historical default), ``False``
+    donates nothing, and a sequence is an explicit per-argnum mask — the knob
+    multi-output fan-out programs need: the carries and the input wire parts
+    are donation-safe (each dispatch consumes them), but a value that is
+    multiply-consumed ACROSS outputs (the fan-out producer boundary) must
+    never be threaded through as a donated argument — it rides the carry as a
+    program output root instead (see :class:`FanoutPipeline`)."""
+    if donate is True:
+        return (0,)
+    if not donate:
+        return ()
+    return tuple(int(i) for i in donate)
 
 
 @dataclass
@@ -107,15 +125,19 @@ class Pipeline:
             self._fn = run
         return self._fn
 
-    def compile(self, frame_size: int, device=None, donate: bool = True):
+    def compile(self, frame_size: int, device=None, donate=True):
         """Jit for a fixed frame size; returns (compiled_fn, initial device carry).
 
         Placement follows the data: put the carry (and inputs) on ``device``; jit then
         dispatches there without a deprecated device= argument.
+
+        ``donate``: ``True`` donates the carries (argnum 0), ``False`` nothing,
+        or an explicit argnum sequence (per-argnum donation mask — see
+        :func:`_donate_argnums`).
         """
         assert frame_size % self.frame_multiple == 0, \
             f"frame_size {frame_size} not a multiple of {self.frame_multiple}"
-        fn = jax.jit(self.fn(), donate_argnums=(0,) if donate else ())
+        fn = jax.jit(self.fn(), donate_argnums=_donate_argnums(donate))
         carry = self.init_carry()
         if device is not None:
             carry = jax.device_put(carry, device)
@@ -157,15 +179,16 @@ class Pipeline:
         return self._wired_fns[key]
 
     def compile_wired(self, frame_size: int, wire, device=None,
-                      donate: bool = True, k: int = 1):
+                      donate=True, k: int = 1):
         """:meth:`compile` for the wired form: the compiled fn consumes/produces
         wire parts (see :meth:`wired_fn`); returns (compiled_fn, initial carry).
         ``k > 1`` compiles the megabatch scan form (parts carry a leading
-        ``[k]`` frame axis)."""
+        ``[k]`` frame axis). ``donate`` accepts the same bool-or-argnums
+        per-argnum mask as :meth:`compile`."""
         assert frame_size % self.frame_multiple == 0, \
             f"frame_size {frame_size} not a multiple of {self.frame_multiple}"
         fn = jax.jit(self.wired_fn(wire, k),
-                     donate_argnums=(0,) if donate else ())
+                     donate_argnums=_donate_argnums(donate))
         carry = self.init_carry()
         if device is not None:
             carry = jax.device_put(carry, device)
@@ -211,6 +234,188 @@ class Pipeline:
         carries = list(carries)
         carries[idx] = s.update(carries[idx], **params)
         return tuple(carries)
+
+
+class FanoutPipeline:
+    """A fan-out stage DAG compiled as ONE multi-output XLA program.
+
+    Shape: ``producer stages → boundary → N branch stage chains``. The
+    producer computes once per frame; its boundary value feeds every branch
+    INSIDE the program (no host round trip, no duplicate H2D — the
+    whole-program fusion argument of arXiv:1810.09868 applied across a
+    broadcast), and the program returns one output frame per branch. This is
+    the compute plane of the device-graph fan-out fusion pass
+    (``runtime/devchain.py``): a ``sync → {demod, channel-est}`` or
+    ``FM → {audio, RDS}`` flowgraph region becomes one dispatch per frame.
+
+    Donation contract (the reason this is its own class and not N stacked
+    Pipelines): the flat carries tuple and the input wire parts stay
+    donation-safe — each dispatch consumes them (``donate=True`` donates the
+    carries; :meth:`donation_mask` is the widest sound per-argnum mask). The
+    producer BOUNDARY value is multiply-consumed (every branch reads it), so
+    it is never threaded through as a donated argument: it rides the carry of
+    a ``devchain_boundary`` fence stage, which makes it a program OUTPUT
+    root — XLA materializes exactly the value the standalone producer would
+    have produced (the fused-vs-actor bit-equality contract) and the donation
+    analysis never sees it as an aliasable input.
+
+    Duck-types the :class:`Pipeline` surface the TPU kernel blocks consume
+    (``in_dtype``/``stages``/``frame_multiple``/``init_carry``/``fn``/
+    ``wired_fn``/``compile``/``compile_wired``/``update_stage``), with the
+    single-output fields generalized per branch: ``out_dtypes[j]``,
+    ``path_ratios[j]`` (producer·branch rate), ``branch_out_items(j, n)``.
+    ``stages`` is the FLAT concatenation (producer then branches in order),
+    which is also the carry layout — ``update_stage`` addresses it exactly
+    like a linear pipeline's (the devchain ctrl-retune contract).
+    """
+
+    def __init__(self, producer_stages: Sequence[Stage],
+                 branch_stage_lists: Sequence[Sequence[Stage]], in_dtype,
+                 optimize: bool = True):
+        if not branch_stage_lists or len(branch_stage_lists) < 2:
+            raise ValueError("FanoutPipeline needs >= 2 branches "
+                             "(use Pipeline for linear chains)")
+        self.in_dtype = np.dtype(in_dtype)
+        # the AS-GIVEN stage lists, before any LTI merging: the streamed-pick
+        # cache records a signature from these too, so a devchain-composed
+        # region (per-member optimized names) still finds the pick when the
+        # caller's optimize=True merged stages across member boundaries
+        self.raw_stage_lists = (list(producer_stages),
+                                [list(bs) for bs in branch_stage_lists])
+        self.producer = Pipeline(list(producer_stages), in_dtype,
+                                 optimize=optimize)
+        self.branches = [Pipeline(list(bs), self.producer.out_dtype,
+                                  optimize=optimize)
+                         for bs in branch_stage_lists]
+        self.stages = list(self.producer.stages)
+        for b in self.branches:
+            self.stages.extend(b.stages)
+        # input-frame contract: the lcm of every producer→branch path's
+        # requirement (each path is a linear pipeline; reuse its math)
+        fm = self.producer.frame_multiple
+        for b in self.branches:
+            path = Pipeline(self.producer.stages + b.stages, in_dtype,
+                            optimize=False)
+            fm = int(np.lcm(fm, path.frame_multiple))
+        self.frame_multiple = fm
+        self.path_ratios = [self.producer.ratio * b.ratio
+                            for b in self.branches]
+        self.out_dtypes = [b.out_dtype for b in self.branches]
+        self.n_branches = len(self.branches)
+        # single-output compatibility surface (wire picking / link budgeting):
+        # total output items per input item, and the first branch's dtype
+        self.ratio = sum(self.path_ratios, Fraction(0, 1))
+        self.out_dtype = self.out_dtypes[0]
+        self._fn = None
+        self._wired_fns = {}
+
+    def branch_out_items(self, branch: int, in_items: int) -> int:
+        q = Fraction(in_items) * self.path_ratios[branch]
+        assert q.denominator == 1, (in_items, self.path_ratios[branch])
+        return int(q)
+
+    def out_items(self, in_items: int) -> int:
+        """TOTAL items across branches per ``in_items`` inputs (the linear
+        surface; per-branch counts come from :meth:`branch_out_items`)."""
+        q = Fraction(in_items) * self.ratio
+        assert q.denominator == 1
+        return int(q)
+
+    def init_carry(self):
+        """Flat carries: producer slots then each branch's, matching
+        ``self.stages`` (the ``update_stage`` addressing contract)."""
+        out = list(self.producer.init_carry())
+        for b in self.branches:
+            out.extend(b.init_carry())
+        return tuple(out)
+
+    def fn(self):
+        """``run(carries, x) -> (carries, (y_0, …, y_{N-1}))``: the producer
+        output is computed once and consumed by every branch in-program."""
+        if self._fn is None:
+            n_p = len(self.producer.stages)
+            pfn = self.producer.fn()
+            bfns = [b.fn() for b in self.branches]
+            sizes = [len(b.stages) for b in self.branches]
+
+            def run(carries, x):
+                pc, mid = pfn(tuple(carries[:n_p]), x)
+                new_c, outs, off = list(pc), [], n_p
+                for bf, sz in zip(bfns, sizes):
+                    bc, y = bf(tuple(carries[off:off + sz]), mid)
+                    new_c.extend(bc)
+                    outs.append(y)
+                    off += sz
+                return tuple(new_c), tuple(outs)
+
+            self._fn = run
+        return self._fn
+
+    def part_counts(self, wire) -> tuple:
+        """Wire parts PER BRANCH of the wired form's flat output (a quantizing
+        wire ships payload + scale; f32/bf16 ship one part) — the re-nesting
+        key for drain loops consuming the flat part tuple."""
+        from .wire import get_wire
+        wire = get_wire(wire)
+        return tuple(wire.part_count(dt) for dt in self.out_dtypes)
+
+    def in_part_count(self, wire) -> int:
+        from .wire import get_wire
+        return get_wire(wire).part_count(self.in_dtype)
+
+    def wired_fn(self, wire, k: int = 1):
+        """The fan-out DAG with the wire codec's decode PROLOG fused in and
+        one encode EPILOG per branch: ``(carries, *in_parts) -> (carries,
+        flat_out_parts)`` where the flat tuple concatenates each branch's
+        parts in branch order (:meth:`part_counts` gives the split). ``k > 1``
+        is the megabatch scan form, exactly as :meth:`Pipeline.wired_fn`."""
+        from .wire import get_wire
+        wire = get_wire(wire)
+        key = (wire.name, int(k))
+        if key not in self._wired_fns:
+            inner = self.fn()
+            in_dt, w = self.in_dtype, wire
+
+            def run(carries, *parts):
+                carries, ys = inner(carries, w.decode_jax(parts, in_dt))
+                flat = []
+                for y in ys:
+                    flat.extend(w.encode_jax(y))
+                return carries, tuple(flat)
+
+            if k == 1:
+                self._wired_fns[key] = run
+            else:
+                def run_scan(carries, *parts):
+                    def body(c, p):
+                        return run(c, *p)
+                    return jax.lax.scan(body, carries, tuple(parts))
+
+                self._wired_fns[key] = run_scan
+        return self._wired_fns[key]
+
+    def donation_mask(self, wire) -> tuple:
+        """The WIDEST sound wired donation mask: the carries AND the input
+        wire parts (every argument is single-consumer per dispatch). The
+        producer boundary value is NOT in this set by construction — it is a
+        program output root (class docstring), so the mask can never alias a
+        multiply-consumed value. Opt-in (``compile_wired(donate=mask)``)
+        rather than the default: XLA only profits when an input part's
+        shape/dtype matches an output's, and warns otherwise."""
+        return (0,) + tuple(range(1, 1 + self.in_part_count(wire)))
+
+    # compile/compile_wired/update_stage are the linear pipeline's own
+    # methods, borrowed: they touch only the duck-typed surface this class
+    # implements (frame_multiple / fn / wired_fn / init_carry / stages, with
+    # the flat carry layout matching self.stages by construction), so one
+    # implementation serves both and can never diverge. The fan-out-specific
+    # donation story lives in :meth:`donation_mask` — pass it as
+    # ``compile_wired(donate=...)`` for the widest sound mask (carries +
+    # input frame parts; the multiply-consumed boundary value can never
+    # appear in any mask because it is not an argument).
+    compile = Pipeline.compile
+    compile_wired = Pipeline.compile_wired
+    update_stage = Pipeline.update_stage
 
 
 def _merge_lti(stages: Sequence[Stage], in_dtype) -> list:

@@ -87,11 +87,25 @@ class Wire:
     def __init__(self):
         self._jit_dec: dict = {}          # np.dtype -> jitted decode prolog
         self._jit_enc = None              # jitted encode epilog
+        self._part_counts: dict = {}      # np.dtype -> parts per frame
 
     def bytes_per_sample(self, dtype) -> int:
         """Bytes ONE logical sample of ``dtype`` occupies on the wire (the
         per-frame scale scalar is amortized away)."""
         raise NotImplementedError
+
+    def part_count(self, dtype) -> int:
+        """How many wire parts one frame of ``dtype`` ships as (quantizing
+        formats ride a scale scalar beside the int payload; f32/bf16 ship one
+        part). Probed once per dtype with a 1-item host encode and cached —
+        the re-nesting key for multi-output (fan-out) programs whose flat
+        part tuple concatenates per-branch parts
+        (:meth:`futuresdr_tpu.ops.stages.FanoutPipeline.part_counts`)."""
+        dt = np.dtype(dtype)
+        n = self._part_counts.get(dt)
+        if n is None:
+            n = self._part_counts[dt] = len(self.encode_host(np.zeros(1, dt)))
+        return n
 
     def encode_may_alias(self, dtype) -> bool:
         """Can :meth:`encode_host` return views ALIASING its input's memory?

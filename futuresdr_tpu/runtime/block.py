@@ -303,6 +303,8 @@ class WrappedKernel:
             message_inputs=k.message_input_names(),
             message_outputs=k.mio.names,
             blocking=k.meta.blocking,
+            policy=self.policy.on_error,
+            restarts=self.restarts,
         )
 
     async def run(self, fg_inbox) -> None:

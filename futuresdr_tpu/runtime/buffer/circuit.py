@@ -50,10 +50,21 @@ class Circuit:
 
 
 class InplaceOutput:
-    """Output port pushing full frames to the connected input (`InplaceWriter`).
+    """Output port pushing full frames to the connected input(s) (`InplaceWriter`).
 
     Duck-types enough of :class:`..StreamOutput` to live in a kernel's port list.
-    """
+
+    An inplace output wired to SEVERAL edges BROADCASTS: every consumer's
+    queue receives every frame (the same 1-writer→N-reader semantics a stream
+    output port group has, ``buffer/circular.py``). Device-plane frames are
+    immutable jax arrays, so sharing the frame object across consumers is
+    safe — this is the per-hop fallback topology the device-graph fan-out
+    fusion pass (``runtime/devchain.py``) collapses into one multi-output
+    dispatch. Backpressure is the SLOWEST consumer's: ``queue_depth`` reports
+    the deepest queue, so a producer's in-flight gate parks until every
+    branch caught up. NOTE for CPU circuit pipelines of MUTATING blocks: a
+    broadcast consumer mutating the shared frame would be visible to its
+    siblings — mutating circuits must stay single-reader (unchanged)."""
 
     def __init__(self, name: str, dtype=None):
         self.name = name
@@ -61,29 +72,49 @@ class InplaceOutput:
         self.min_items = 1
         self.stalls = 0             # telemetry parity with StreamOutput (the
         #                             park classifier skips queue ports)
-        self._peer: Optional["InplaceInput"] = None
+        self._peers: list = []
         self._finished = False
 
     @property
     def connected(self) -> bool:
-        return self._peer is not None
+        return bool(self._peers)
 
     def connect(self, peer: "InplaceInput"):
-        self._peer = peer
+        # idempotent: re-running the same Flowgraph re-materializes its
+        # edges, and appending the same consumer twice would push every
+        # frame twice into its queue (and trip the broadcast guard below
+        # for a single-reader circuit)
+        if not any(p is peer for p in self._peers):
+            self._peers.append(peer)
 
     def put_full(self, buf: np.ndarray, n_items: int, tags: Sequence = ()) -> None:
         """Push a full frame (+ frame-relative stream tags riding alongside it —
-        the TPU plane's item-indexed metadata transport, SURVEY §7)."""
-        self._peer.push(buf, n_items, tags)
+        the TPU plane's item-indexed metadata transport, SURVEY §7). With
+        several peers every queue receives the frame (broadcast)."""
+        if len(self._peers) > 1 and isinstance(buf, np.ndarray) \
+                and buf.flags.writeable:
+            # broadcast shares ONE frame object; the CPU circuit plane's
+            # mutating consumers (and its put_empty pool return) would alias
+            # it across branches — only immutable device-plane frames (jax
+            # arrays) may broadcast. Raise HERE, where the frame kind is
+            # known, rather than corrupt silently (class docstring).
+            raise RuntimeError(
+                f"inplace output {self.name!r} broadcasts to "
+                f"{len(self._peers)} consumers, but the frame is a writable "
+                f"host array — mutable circuit frames must stay "
+                f"single-reader (device-plane jax frames may broadcast)")
+        for p in self._peers:
+            p.push(buf, n_items, tags)
 
     def queue_depth(self) -> int:
-        """Frames waiting at the consumer (backpressure signal)."""
-        return len(self._peer) if self._peer is not None else 0
+        """Frames waiting at the slowest consumer (backpressure signal)."""
+        return max((len(p) for p in self._peers), default=0)
 
     def notify_finished(self) -> None:
-        if self._peer is not None and not self._finished:
+        if self._peers and not self._finished:
             self._finished = True
-            self._peer.mark_finished()
+            for p in self._peers:
+                p.mark_finished()
 
 
 class InplaceInput:

@@ -14,7 +14,14 @@ At launch the supervisor calls :func:`find_device_chains`; each detected run —
 
 * a linear ``TpuH2D → TpuStage* → TpuD2H`` frame-plane pipeline, or
 * adjacent ``TpuKernel`` blocks chained by stream edges (whose intermediate
-  hops each cross the host↔device link BOTH ways per frame)
+  hops each cross the host↔device link BOTH ways per frame), or
+* a FAN-OUT region ``producer-run → broadcast → N consumer-runs`` in either
+  plane (the WLAN ``sync → {demod, channel-est}`` and ``FM → {audio, RDS}``
+  shapes): the producer computes once per frame, its boundary value feeds
+  every branch INSIDE one multi-output program
+  (:class:`~futuresdr_tpu.ops.stages.FanoutPipeline` /
+  :class:`~futuresdr_tpu.tpu.TpuFanoutKernel`), so the scarce H2D link is
+  paid once instead of N times and 2N+1 per-frame dispatches become 1
 
 — is collapsed into one fused :class:`~futuresdr_tpu.tpu.TpuKernel` whose
 ``Pipeline`` is the concatenation of the member stage lists (composed with
@@ -45,9 +52,13 @@ Refusals (the run stays on the actor path):
   carries the explicit ``devchain_static = True`` opt-in (the
   ``fastchain_static`` convention; see the retune paragraph below for why
   edges refuse while direct ``handle.call`` retunes are serviced);
-* members on different ``TpuInstance`` objects (different devices);
+* members on different ``TpuInstance`` objects (different devices) — for a
+  fan-out region this covers every branch (one cross-instance branch declines
+  the WHOLE region to per-hop mode: all-or-nothing);
 * mismatched wire formats at the fused edges;
-* branching/merging ports anywhere inside the run;
+* a broadcast whose edges do not ALL open fusable consumer runs (a tap to a
+  host sink, a policy-bearing branch member, …), a nested fan-out inside a
+  branch (v1 fuses one broadcast level), or any port MERGE;
 * a first-member frame size that is not a multiple of the COMPOSED pipeline's
   frame multiple;
 * a per-kernel ``devchain = False`` opt-out, or ``FSDR_NO_DEVCHAIN=1``
@@ -114,12 +125,23 @@ def devchain_enabled() -> bool:
 
 
 class DevChain(list):
-    """Fusable device-plane run in topological order. ``kind`` is ``"frames"``
-    (TpuH2D → TpuStage* → TpuD2H) or ``"kernels"`` (adjacent TpuKernels)."""
+    """Fusable device-plane region in topological order. ``kind`` is
+    ``"frames"`` (TpuH2D → TpuStage* → TpuD2H) or ``"kernels"`` (adjacent
+    TpuKernels). A LINEAR run is the flat member list; a FAN-OUT region also
+    carries its topology: ``producer`` (the shared head run) and ``branches``
+    (one member list per consumer run), with the flat list being
+    ``producer + branches[0] + … + branches[N-1]`` — the composed-stage /
+    metrics / ctrl addressing order everywhere downstream."""
 
-    def __init__(self, members, kind: str):
+    def __init__(self, members, kind: str, producer=None, branches=None):
         super().__init__(members)
         self.kind = kind
+        self.producer = producer
+        self.branches = branches
+
+    @property
+    def fanout(self) -> bool:
+        return self.branches is not None
 
 
 class _FwdCtrl:
@@ -215,22 +237,123 @@ def find_device_chains(fg) -> List[DevChain]:
         claimed.update(id(m) for m in members)
         chains.append(DevChain(members, kind))
 
+    def _close_fanout(producer, branches, kind) -> None:
+        """Validate and claim one ``producer → broadcast → N branches``
+        region. All-or-nothing: any refusing member already made the caller
+        decline, so only the cross-member contracts are checked here."""
+        members = list(producer) + [m for br in branches for m in br]
+        first = producer[0]
+        # one wire at every fused edge: the region's ingress and each
+        # branch's egress ("frames": H2D vs each D2H; "kernels": every member
+        # carries its own codec edges, so all must agree)
+        if kind == "frames":
+            wired = [first] + [br[-1] for br in branches]
+        else:
+            wired = members
+        if len({m.wire.name for m in wired}) != 1:
+            log.debug("devchain refuses fan-out %s: wire mismatch", members)
+            return
+        if len({id(m.inst) for m in members}) != 1:
+            log.debug("devchain refuses fan-out %s: mismatched TpuInstances",
+                      members)
+            return
+        prod_stages = [s for m in producer
+                       if getattr(m, "pipeline", None) is not None
+                       for s in m.pipeline.stages]
+        in_dtype = first.dtype if kind == "frames" else first.pipeline.in_dtype
+        import numpy as np
+        fm = 1
+        for br in branches:
+            br_stages = [s for m in br
+                         if getattr(m, "pipeline", None) is not None
+                         for s in m.pipeline.stages]
+            path = Pipeline(prod_stages + br_stages, in_dtype, optimize=False)
+            fm = int(np.lcm(fm, path.frame_multiple))
+            if first.frame_size % path.frame_multiple != 0:
+                log.debug("devchain refuses fan-out %s: frame %d not a "
+                          "multiple of branch contract %d", members,
+                          first.frame_size, path.frame_multiple)
+                return
+            if kind == "frames" and \
+                    np.dtype(path.out_dtype) != np.dtype(br[-1].dtype):
+                # the unfused TpuD2H casts to ITS dtype at decode (same rule
+                # as the linear close)
+                log.debug("devchain refuses fan-out %s: D2H dtype %s != "
+                          "composed %s", members, br[-1].dtype,
+                          path.out_dtype)
+                return
+        if first.frame_size % fm != 0:
+            log.debug("devchain refuses fan-out %s: frame %d not a multiple "
+                      "of the composed fan-out contract %d", members,
+                      first.frame_size, fm)
+            return
+        claimed.update(id(m) for m in members)
+        chains.append(DevChain(members, kind,
+                               producer=list(producer),
+                               branches=[list(br) for br in branches]))
+
     kernels = [b.kernel for b in fg._blocks if b is not None]
 
-    # ---- frame-plane runs: TpuH2D → TpuStage* → TpuD2H ----------------------
+    # ---- frame-plane regions: TpuH2D → TpuStage* → (fan-out →) TpuD2H ------
     for k in kernels:
         if type(k) is not TpuH2D or id(k) in claimed or not member_ok(k):
             continue
-        if len(s_in.get(id(k), [])) != 1 or len(i_out.get(id(k), [])) != 1:
-            continue                     # unwired or branching H2D
+        if len(s_in.get(id(k), [])) != 1 or not i_out.get(id(k)):
+            continue                     # unwired H2D
         members, cur, ok = [k], k, True
+        local_seen = {id(k)}             # diamond/merge guard within a region
+        branches = None
+
+        def _frames_branch(edge):
+            """One fan-out branch from the broadcast edge: TpuStage* → TpuD2H
+            (each hop single-in/single-out). Returns members or None."""
+            out, b_cur = [], edge.dst
+            while True:
+                if id(b_cur) in claimed or id(b_cur) in local_seen \
+                        or not member_ok(b_cur) \
+                        or len(i_in.get(id(b_cur), [])) != 1:
+                    return None
+                if type(b_cur) is TpuStage:
+                    if b_cur._carry is not None:
+                        return None      # mid-stream state: actor path
+                    b_outs = i_out.get(id(b_cur), [])
+                    if len(b_outs) != 1:
+                        return None      # nested fan-out: refuse (v1)
+                    out.append(b_cur)
+                    local_seen.add(id(b_cur))
+                    b_cur = b_outs[0].dst
+                    continue
+                if type(b_cur) is TpuD2H:
+                    if i_out.get(id(b_cur)) or not s_out.get(id(b_cur)):
+                        return None      # D2H must exit to the stream plane
+                    out.append(b_cur)
+                    local_seen.add(id(b_cur))
+                    return out
+                return None              # a foreign consumer on the plane
+
         while True:
             outs = i_out.get(id(cur), [])
+            if len(outs) > 1:
+                # fan-out point: EVERY edge must open a fusable branch, or
+                # the whole region declines to per-hop mode (all-or-nothing)
+                brs = []
+                for e in outs:
+                    br = _frames_branch(e)
+                    if br is None:
+                        brs = None
+                        break
+                    brs.append(br)
+                if brs is None:
+                    ok = False
+                else:
+                    branches = brs
+                break
             if len(outs) != 1:
-                ok = False               # branching frame fan-out: refuse
+                ok = False
                 break
             nxt = outs[0].dst
-            if id(nxt) in claimed or not member_ok(nxt) \
+            if id(nxt) in claimed or id(nxt) in local_seen \
+                    or not member_ok(nxt) \
                     or len(i_in.get(id(nxt), [])) != 1:
                 ok = False
                 break
@@ -240,6 +363,7 @@ def find_device_chains(fg) -> List[DevChain]:
                     break        # actor path resumes it, a fused fresh carry
                                  # would not (fastchain's _hist rule)
                 members.append(nxt)
+                local_seen.add(id(nxt))
                 cur = nxt
                 continue
             if type(nxt) is TpuD2H:
@@ -250,11 +374,15 @@ def find_device_chains(fg) -> List[DevChain]:
                 break
             ok = False                   # a foreign consumer on the plane
             break
-        if ok and len(members) >= 2:
+        if ok and branches is not None:
+            _close_fanout(members, branches, "frames")
+        elif ok and len(members) >= 2:
             _close(members, "frames")
 
     # ---- adjacent TpuKernel runs over stream edges --------------------------
     def _kernel_ok(k) -> bool:
+        # exact-type check: a TpuFanoutKernel (or any subclass) manages its
+        # own branches and never joins a chain
         return (type(k) is TpuKernel and id(k) not in claimed and member_ok(k)
                 and not i_out.get(id(k)) and not i_in.get(id(k)))
 
@@ -262,7 +390,7 @@ def find_device_chains(fg) -> List[DevChain]:
         """The next TpuKernel if ``a``'s single output edge feeds one."""
         outs = s_out.get(id(a), [])
         if len(outs) != 1:
-            return None                  # broadcast between members: refuse
+            return None                  # broadcast: the fan-out pass owns it
         nxt = outs[0].dst
         if not _kernel_ok(nxt) or len(s_in.get(id(nxt), [])) != 1:
             return None
@@ -270,13 +398,57 @@ def find_device_chains(fg) -> List[DevChain]:
             return None
         return nxt
 
-    for k in kernels:
-        if not _kernel_ok(k):
-            continue
-        # only start at run heads: the upstream is not itself a fusable link
+    def _is_head(k) -> bool:
+        """A run head: the upstream is not itself a fusable link into k."""
         ups = s_in.get(id(k), [])
-        if len(ups) == 1 and _kernel_ok(ups[0].src) \
-                and _link(ups[0].src) is k:
+        return not (len(ups) == 1 and _kernel_ok(ups[0].src)
+                    and _link(ups[0].src) is k)
+
+    # fan-out pass FIRST: a branch head looks like a run head to the linear
+    # pass (its upstream broadcasts, so _link is None there) — detecting
+    # fan-outs before linear runs keeps a later-listed producer from losing
+    # its branches to premature linear claims
+    for k in kernels:
+        if not _kernel_ok(k) or not _is_head(k):
+            continue
+        members, cur = [k], k
+        while True:
+            nxt = _link(cur)
+            if nxt is None:
+                break
+            members.append(nxt)
+            cur = nxt
+        outs = s_out.get(id(cur), [])
+        if len(outs) <= 1:
+            continue                     # linear run: the next pass owns it
+        local_seen = {id(m) for m in members}
+        branches = []
+        for e in outs:
+            head = e.dst
+            if not _kernel_ok(head) or id(head) in local_seen \
+                    or len(s_in.get(id(head), [])) != 1 \
+                    or id(head.inst) != id(cur.inst) \
+                    or head.wire.name != cur.wire.name:
+                branches = None
+                break
+            br, b_cur = [head], head
+            local_seen.add(id(head))
+            while True:
+                nxt = _link(b_cur)
+                if nxt is None or id(nxt) in local_seen:
+                    break
+                br.append(nxt)
+                local_seen.add(id(nxt))
+                b_cur = nxt
+            if s_out.get(id(b_cur), []) and len(s_out.get(id(b_cur), [])) > 1:
+                branches = None          # nested fan-out: refuse (v1)
+                break
+            branches.append(br)
+        if branches is not None:
+            _close_fanout(members, branches, "kernels")
+
+    for k in kernels:
+        if not _kernel_ok(k) or not _is_head(k):
             continue
         members, cur = [k], k
         while True:
@@ -321,13 +493,42 @@ def _boundary_stage(n_items: int, dtype):
     return Stage(fn, init_carry, name="devchain_boundary")
 
 
+def _resolve_k_batch(first, chain_kind: str, sig_pipe_or_stages, in_dtype):
+    """The megabatch K a fused chain launches with: an explicit per-kernel or
+    config K wins; with the knob unset (0 = auto), a chain that
+    ``autotune_streamed`` already tuned launches with ITS cached pick (the
+    streamed-pick cache, keys ignore devchain boundary fences — fan-out
+    shapes key on their branch structure). Shared by the linear and fan-out
+    builders; see the linear builder's comment for the latency contract."""
+    if chain_kind == "frames":
+        k_batch = None                   # config default (frame plane has no knob)
+    else:
+        k_batch = first.k_batch
+    if k_batch is None or (k_batch == 1 and not first._k_explicit):
+        from ..config import config
+        if int(config().tpu_frames_per_dispatch) == 0:
+            from ..tpu.autotune import cached_frames_per_dispatch
+            k = cached_frames_per_dispatch(sig_pipe_or_stages, in_dtype,
+                                           first.inst.platform)
+            if k and k > 1:
+                log.info("devchain: frames_per_dispatch=%d from cached "
+                         "autotune_streamed pick", k)
+                k_batch = k
+    return k_batch
+
+
 def _build_fused(chain: DevChain):
     """One TpuKernel over the members' concatenated stage lists, driving the
-    chain's ORIGINAL boundary ports (the live, already-materialized buffers)."""
+    chain's ORIGINAL boundary ports (the live, already-materialized buffers).
+    Fan-out regions route to :func:`_build_fused_fanout` (one
+    ``TpuFanoutKernel`` with a multi-output program)."""
     import numpy as np
 
     from ..ops.stages import Pipeline
     from ..tpu.kernel_block import TpuKernel
+
+    if chain.fanout:
+        return _build_fused_fanout(chain)
 
     members = list(chain)
     first, last = members[0], members[-1]
@@ -369,32 +570,19 @@ def _build_fused(chain: DevChain):
     if chain.kind == "frames":
         in_dtype = first.dtype
         depth = first.max_inflight
-        k_batch = None                   # config default (frame plane has no knob)
     else:
         in_dtype = first.pipeline.in_dtype
         depth = first.depth
-        k_batch = first.k_batch
-    if k_batch is None or (k_batch == 1 and not first._k_explicit):
-        from ..config import config
-        if int(config().tpu_frames_per_dispatch) == 0:
-            # ROADMAP follow-up: with the config knob unset (the default K=1),
-            # a chain that `autotune_streamed` already tuned in this process
-            # launches with ITS measured megabatch K — the sweep's verdict
-            # carries over to the fused dispatch without re-measuring (the
-            # cache key ignores the boundary fences, so the composed stage
-            # list maps back to the tuned chain). This inherits megabatching's
-            # latency contract: partial K-groups flush only at EOS, so a
-            # trickle/bursty source buffers up to K-1 frames — set
-            # tpu_frames_per_dispatch=1 explicitly to pin dispatch-per-frame
-            # for latency-critical chains (an explicit config always wins
-            # over the cache).
-            from ..tpu.autotune import cached_frames_per_dispatch
-            k = cached_frames_per_dispatch(stages, in_dtype,
-                                           first.inst.platform)
-            if k and k > 1:
-                log.info("devchain: frames_per_dispatch=%d from cached "
-                         "autotune_streamed pick", k)
-                k_batch = k
+    # ROADMAP follow-up (PR 4): with the config knob unset (the default K=1),
+    # a chain that `autotune_streamed` already tuned in this process launches
+    # with ITS measured megabatch K — the sweep's verdict carries over to the
+    # fused dispatch without re-measuring (the cache key ignores the boundary
+    # fences, so the composed stage list maps back to the tuned chain). This
+    # inherits megabatching's latency contract: partial K-groups flush only
+    # at EOS, so a trickle/bursty source buffers up to K-1 frames — set
+    # tpu_frames_per_dispatch=1 explicitly to pin dispatch-per-frame for
+    # latency-critical chains (an explicit config always wins over the cache).
+    k_batch = _resolve_k_batch(first, chain.kind, stages, in_dtype)
     # optimize=False: each member's internal numerics stay stage-for-stage
     # identical to the unfused run (cross-member LTI merging would convolve
     # taps and break the bit-equality contract); XLA still fuses elementwise
@@ -414,6 +602,98 @@ def _build_fused(chain: DevChain):
     fused.meta.instance_name = \
         f"devchain[{type(first).__name__}…x{len(members)}]"
     fused._dc_slices = slices    # per-member stage ranges for ctrl translation
+    return fused
+
+
+def _build_fused_fanout(chain: DevChain):
+    """One :class:`~futuresdr_tpu.tpu.TpuFanoutKernel` over the region's
+    composed fan-out DAG, driving the producer's ORIGINAL input port and each
+    branch tail's ORIGINAL output port.
+
+    Fences (see :func:`_boundary_stage`): every member boundary is fenced
+    exactly as in the linear builder, and the PRODUCER → BRANCHES boundary
+    always carries one — it pins the multiply-consumed broadcast value to the
+    standalone producer's numerics (every branch then reads the SAME
+    materialized frame the actor path would have broadcast), and doubles as
+    the donation story: the boundary value is a carry-resident program output
+    root, never a donated argument
+    (:class:`~futuresdr_tpu.ops.stages.FanoutPipeline`)."""
+    import numpy as np
+
+    from ..ops.stages import FanoutPipeline
+    from ..tpu.kernel_block import TpuFanoutKernel
+
+    producer, branches = chain.producer, chain.branches
+    first = producer[0]
+    fence_edges = chain.kind == "frames"
+    frame = first.frame_size
+    in_dtype = first.dtype if chain.kind == "frames" \
+        else first.pipeline.in_dtype
+    slices: list = []        # per MEMBER (flat chain order): composed range
+
+    def walk(seg_members, cum0, dt0, base, lead, trail):
+        """Compose one segment's stage list with member fences; returns
+        ``(stages, cum, dt)`` and appends the segment's member slices at flat
+        offset ``base``."""
+        stages: list = []
+        cum, dt, seen = cum0, np.dtype(dt0), 0
+
+        def fence():
+            q = Fraction(frame) * cum
+            assert q.denominator == 1, (frame, cum)  # finder checked the lcm
+            stages.append(_boundary_stage(int(q), dt))
+
+        if lead:
+            fence()
+        for m in seg_members:
+            p = getattr(m, "pipeline", None)
+            if p is None:
+                slices.append((base + len(stages), base + len(stages)))
+                continue
+            if seen > 0:
+                fence()
+            slices.append((base + len(stages),
+                           base + len(stages) + len(p.stages)))
+            stages.extend(p.stages)
+            cum *= p.ratio
+            dt = np.dtype(p.out_dtype)
+            seen += 1
+        if trail and (seen > 0 or not lead):
+            fence()
+        return stages, cum, dt
+
+    # producer: edge fence on the frame plane, and ALWAYS a boundary fence at
+    # the end (the lead fence doubles as it for a stage-less H2D producer)
+    p_stages, cum_p, dt_p = walk(producer, Fraction(1, 1), in_dtype, 0,
+                                 lead=fence_edges, trail=True)
+    base = len(p_stages)
+    branch_lists = []
+    for br in branches:
+        has_pipes = any(getattr(m, "pipeline", None) is not None for m in br)
+        b_stages, _, _ = walk(br, cum_p, dt_p, base, lead=False,
+                              trail=fence_edges and has_pipes)
+        branch_lists.append(b_stages)
+        base += len(b_stages)
+    # optimize=False: the bit-equality contract, exactly as the linear builder
+    fanout = FanoutPipeline(p_stages, branch_lists, in_dtype, optimize=False)
+    depth = first.max_inflight if chain.kind == "frames" else first.depth
+    k_batch = _resolve_k_batch(first, chain.kind, fanout, in_dtype)
+    fused = TpuFanoutKernel(fanout, frame_size=frame, inst=first.inst,
+                            frames_in_flight=depth, wire=first.wire,
+                            frames_per_dispatch=k_batch)
+    assert fused.frame_size == frame, (fused.frame_size, frame)
+    # steal the boundary ports: the region's own input and each branch tail's
+    # own output — buffers, tags and backpressure stay the live flowgraph's
+    tails = [br[-1] for br in branches]
+    fused._stream_inputs = [first.input]
+    fused.input = first.input
+    fused._stream_outputs = [t.output for t in tails]
+    fused.outputs = [t.output for t in tails]
+    fused.output = fused.outputs[0]
+    fused.meta.instance_name = (
+        f"devchain[{type(first).__name__}…x{len(chain)}"
+        f"⇉{len(branches)}]")
+    fused._dc_slices = slices
     return fused
 
 
@@ -487,14 +767,27 @@ def shed_devchain_bridge(kernel) -> None:
     del kernel._dc_base_extra
 
 
-def _member_rates(members) -> list:
-    """Per member: (kernel, cumulative in-rate, cumulative out-rate) relative
-    to the fused chain's input."""
-    out, r_in = [], Fraction(1, 1)
-    for m in members:
+def _chain_rates(chain: DevChain) -> list:
+    """Per member (flat chain order): ``(kernel, cumulative in-rate,
+    cumulative out-rate, branch)`` relative to the fused region's input.
+    ``branch`` is None for linear chains and producer members, else the
+    member's branch index — fan-out branch members restart the cumulative
+    walk from the producer's boundary rate."""
+    out = []
+    producer = chain.producer if chain.fanout else list(chain)
+    r_in = Fraction(1, 1)
+    for m in producer:
         r_out = r_in * _member_ratio(m)
-        out.append((m, r_in, r_out))
+        out.append((m, r_in, r_out, None))
         r_in = r_out
+    if chain.fanout:
+        r_boundary = r_in
+        for j, br in enumerate(chain.branches):
+            r_in = r_boundary
+            for m in br:
+                r_out = r_in * _member_ratio(m)
+                out.append((m, r_in, r_out, j))
+                r_in = r_out
     return out
 
 
@@ -508,43 +801,57 @@ def _set_member_counters(m, boundary, items: int, r_in: Fraction,
             p.items_produced = int(items * r_out)
 
 
-def _install_bridge(members: Sequence, fused) -> None:
+def _boundary_ports(fused) -> set:
+    """The fused kernel's LIVE port identities (their counters are the
+    flowgraph's own; the bridge must not stomp them). Fan-out kernels carry
+    one live output per branch."""
+    outs = getattr(fused, "outputs", None) or [fused.output]
+    return {id(fused.input)} | {id(o) for o in outs}
+
+
+def _install_bridge(chain: DevChain, fused) -> None:
     """Per-member metrics bridge: each ORIGINAL block keeps reporting its own
     item counters (derived from the fused frame counter through the composed
-    rate contract) plus ``fused_devchain`` provenance — the devchain analog of
-    fastchain's live counter bridge."""
-    boundary = {id(fused.input), id(fused.output)}
-    for m, r_in, r_out in _member_rates(members):
+    rate contract — branch members through THEIR branch's path rate) plus
+    ``fused_devchain`` provenance — the devchain analog of fastchain's live
+    counter bridge. Fan-out members also report ``devchain_branch`` (their
+    branch index; producer members report none)."""
+    boundary = _boundary_ports(fused)
+    for m, r_in, r_out, branch in _chain_rates(chain):
         if not hasattr(m, "_dc_base_extra"):
             m._dc_base_extra = getattr(m, "extra_metrics", None)
         base_extra = m._dc_base_extra
 
-        def make_extra(m=m, r_in=r_in, r_out=r_out, base_extra=base_extra):
+        def make_extra(m=m, r_in=r_in, r_out=r_out, branch=branch,
+                       base_extra=base_extra):
             def extra():
                 frames = fused._frames_dispatched
                 _set_member_counters(m, boundary, frames * fused.frame_size,
                                      r_in, r_out)
-                return dict(
+                out = dict(
                     (base_extra() if callable(base_extra) else {}),
                     fused_devchain=True,
                     devchain_frames=frames,
                     devchain_dispatches=fused._dispatches,
                     frames_per_dispatch=fused.k_batch,
                 )
+                if branch is not None:
+                    out["devchain_branch"] = branch
+                return out
             return extra
 
         m.extra_metrics = make_extra()
 
 
-def _freeze_bridge(members: Sequence, fused) -> None:
+def _freeze_bridge(chain: DevChain, fused) -> None:
     """Swap the LIVE bridge for a frozen snapshot once the run is over: the
     live closures capture the fused kernel, which would pin its compiled
     executable and device carry (one frame-sized boundary-stash buffer per
     member fence) for as long as anyone keeps the flowgraph around. Post-run
     metrics only need the final numbers."""
-    boundary = {id(fused.input), id(fused.output)}
+    boundary = _boundary_ports(fused)
     frames = fused._frames_dispatched
-    for m, r_in, r_out in _member_rates(members):
+    for m, r_in, r_out, branch in _chain_rates(chain):
         _set_member_counters(m, boundary, frames * fused.frame_size,
                              r_in, r_out)
         base_extra = getattr(m, "_dc_base_extra", None)
@@ -555,6 +862,8 @@ def _freeze_bridge(members: Sequence, fused) -> None:
             devchain_dispatches=fused._dispatches,
             frames_per_dispatch=fused.k_batch,
         )
+        if branch is not None:
+            snap["devchain_branch"] = branch
         m.extra_metrics = (lambda s=snap: dict(s))
 
 
@@ -625,7 +934,7 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
                     log.warning("queued ctrl update rejected: %r", e)
             if getattr(k, "_pending_ctrl", None):
                 k._pending_ctrl.clear()
-        _install_bridge(member_kernels, fused)
+        _install_bridge(chain, fused)
     except Exception as e:                             # noqa: BLE001
         _error_out(e)
         return
@@ -642,6 +951,21 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
     # releases is impossible on the happy path: it needs upstream EOS or
     # Terminate, and producers only run after start.
 
+    # The drive loop merges the inboxes whose ports the fused kernel WORKS:
+    # the region input (first member) and every branch tail's output —
+    # produce/consume notifications land on THOSE, because the boundary
+    # buffers were bound to them at materialize time. Linear chains have one
+    # tail (the last member); fan-out regions one per branch.
+    if chain.fanout:
+        tail_idx = []
+        off = len(chain.producer)
+        for br in chain.branches:
+            off += len(br)
+            tail_idx.append(off - 1)
+    else:
+        tail_idx = [len(members) - 1]
+    tail_set = set(tail_idx)
+
     # Intermediate members' inboxes: nothing routes data there, but ctrl
     # Calls/Callbacks must reach the drive thread (carry surgery happens
     # between dispatches there) — forward them with the member index.
@@ -653,17 +977,23 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
             if isinstance(msg, Terminate):
                 return                   # the drive loop gets its own copy
 
-    watchers = [asyncio.ensure_future(watch(b, i + 1))
-                for i, b in enumerate(members[1:-1])]
+    watchers = [asyncio.ensure_future(watch(b, i))
+                for i, b in enumerate(members)
+                if i != 0 and i not in tail_set]
 
     first_ib = members[0].inbox
-    last_ib = members[-1].inbox
+    drive_ibs = [first_ib] + [members[i].inbox for i in tail_idx]
+    # inbox identity → the member index its direct Call/Callback addresses,
+    # and (for tails) the branch it retires on StreamOutputDone
+    member_of_ib = {id(first_ib): 0}
+    branch_of_ib = {}
+    for j, i in enumerate(tail_idx):
+        member_of_ib[id(members[i].inbox)] = i
+        branch_of_ib[id(members[i].inbox)] = j
 
     async def _drive():
         """The fused block event loop (WrappedKernel.run's loop, merged over
-        the first and last members' inboxes — produce/consume notifications
-        land on THOSE, because the boundary buffers were bound to them at
-        materialize time)."""
+        the region's boundary inboxes)."""
         io = WorkIo()
         kernel = fused
 
@@ -673,10 +1003,9 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
                 msg.reply.set(res)
 
         while True:
-            p1 = first_ib.take_pending()
-            p2 = last_ib.take_pending()
-            io.call_again = io.call_again or p1 or p2
-            for ib in (first_ib, last_ib):
+            for ib in drive_ibs:
+                io.call_again = ib.take_pending() or io.call_again
+            for ib in drive_ibs:
                 while True:
                     msg = ib.try_recv()
                     if msg is None:
@@ -684,20 +1013,31 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
                     if isinstance(msg, _FwdCtrl):
                         ctrl(msg.idx, msg.msg)
                     elif isinstance(msg, (Call, Callback)):
-                        ctrl(0 if ib is first_ib else len(members) - 1, msg)
+                        ctrl(member_of_ib[id(ib)], msg)
                     elif isinstance(msg, StreamInputDone):
                         kernel.input.set_finished()
                         io.call_again = True
-                    elif isinstance(msg, (StreamOutputDone, Terminate)):
+                    elif isinstance(msg, StreamOutputDone):
+                        if chain.fanout:
+                            # one branch's reader detached: retire THAT
+                            # branch, the survivors keep streaming (the
+                            # port-group rule — a finished reader is dropped,
+                            # not fatal); work() finishes the block when
+                            # every branch retired
+                            kernel.retire_branch(branch_of_ib[id(ib)])
+                            io.call_again = True
+                        else:
+                            io.finished = True
+                    elif isinstance(msg, Terminate):
                         io.finished = True
             if io.finished:
                 break
             if not io.call_again:
-                w1 = asyncio.ensure_future(first_ib.wait())
-                w2 = asyncio.ensure_future(last_ib.wait())
-                await asyncio.wait({w1, w2},
+                waits = [asyncio.ensure_future(ib.wait())
+                         for ib in drive_ibs]
+                await asyncio.wait(waits,
                                    return_when=asyncio.FIRST_COMPLETED)
-                for w in (w1, w2):
+                for w in waits:
                     if not w.done():
                         w.cancel()
                 continue
@@ -710,6 +1050,13 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
         # actor blocks
         asyncio.run(_drive())
 
+    def _eos_ports():
+        # orderly shutdown: EOS every driven output, detach upstream
+        # (block.py contract)
+        for o in (getattr(fused, "outputs", None) or [fused.output]):
+            o.notify_finished()
+        fused.input.notify_finished()
+
     t_chain = _trace.now()
     try:
         await scheduler.spawn_blocking(_drive_thread)
@@ -717,35 +1064,43 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
         for w in watchers:
             w.cancel()
         try:
-            fused.output.notify_finished()
-            fused.input.notify_finished()
+            _eos_ports()
         except Exception:                              # noqa: BLE001
             pass
-        _freeze_bridge(member_kernels, fused)
+        _freeze_bridge(chain, fused)
         _error_out(e)
         return
     for w in watchers:
         w.cancel()
-    # orderly shutdown: EOS downstream, detach upstream (block.py contract)
     try:
-        fused.output.notify_finished()
-        fused.input.notify_finished()
+        _eos_ports()
     except Exception as e:                             # noqa: BLE001
-        _freeze_bridge(member_kernels, fused)
+        _freeze_bridge(chain, fused)
         _error_out(e)
         return
     # drop the live bridge's reference to the fused kernel (compiled program +
     # boundary-stash device buffers) — final counters are frozen in place
-    _freeze_bridge(member_kernels, fused)
+    _freeze_bridge(chain, fused)
     # one span for the whole fused run, per-member frame counters in args —
-    # the devchain lane of docs/observability.md
+    # the devchain lane of docs/observability.md; fan-out runs add per-branch
+    # attribution (tail, member count, items out, retired early?) so the
+    # doctor can say WHICH branch a fused region spent its output on
+    span_args = {"members": len(members),
+                 "frames": fused._frames_dispatched,
+                 "dispatches": fused._dispatches,
+                 "frames_per_dispatch": fused.k_batch,
+                 "per_member": {b.instance_name: fused._frames_dispatched
+                                for b in members}}
+    if chain.fanout:
+        span_args["branches"] = [
+            {"branch": j,
+             "tail": members[i].instance_name,
+             "members": len(chain.branches[j]),
+             "items_out": fused._frames_dispatched * fused.out_frames[j],
+             "retired": bool(fused._branch_done[j])}
+            for j, i in enumerate(tail_idx)]
     _trace.complete(
         "devchain",
         f"devchain[{members[0].instance_name}…x{len(members)}]", t_chain,
-        args={"members": len(members),
-              "frames": fused._frames_dispatched,
-              "dispatches": fused._dispatches,
-              "frames_per_dispatch": fused.k_batch,
-              "per_member": {b.instance_name: fused._frames_dispatched
-                             for b in members}})
+        args=span_args)
     _finish_all()

@@ -301,6 +301,10 @@ class Flowgraph:
                 (self.block_id(e.src), e.src_port, self.block_id(e.dst), e.dst_port)
                 for e in self.message_edges
             ],
+            # the last run's policy story (restarts/isolations/cancels),
+            # stashed by the supervisor at completion — post-mortem describe
+            # (and the REST port's completed-run fallback) keeps it
+            policy_decisions=list(getattr(self, "_policy_decisions", ())),
         )
 
     def __len__(self):

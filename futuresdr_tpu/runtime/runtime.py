@@ -323,7 +323,7 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                 else:
                     blk.inbox.send(Callback(msg.port, msg.data, msg.reply))
             elif isinstance(msg, DescribeMsg):
-                msg.reply.set(_describe(fg, blocks))
+                msg.reply.set(_describe(fg, blocks, decisions))
             elif isinstance(msg, MetricsMsg):
                 msg.reply.set({b.instance_name: b.metrics() for b in blocks})
             elif isinstance(msg, TerminateMsg):
@@ -415,7 +415,7 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
             if isinstance(msg, BlockCallbackMsg):
                 msg.reply.set(Pmt.invalid_value())
             elif isinstance(msg, DescribeMsg):
-                msg.reply.set(_describe(fg, blocks))
+                msg.reply.set(_describe(fg, blocks, decisions))
             elif isinstance(msg, MetricsMsg):
                 # a metrics() racing flowgraph completion landed here after the
                 # main loop exited — answer with the FINAL per-block snapshot
@@ -423,6 +423,10 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                 # await forever; `FlowgraphHandle.metrics` only short-circuits
                 # to {} when the send itself fails)
                 msg.reply.set({b.instance_name: b.metrics() for b in blocks})
+        # post-run describe (REST `GET /api/fg/{fg}/`, fg.describe())
+        # keeps the final policy story: the same decision dicts a
+        # FlowgraphError would carry, surfaced for RECOVERED runs too
+        fg._policy_decisions = list(decisions)
         fg.restore_blocks(finished + failed)
         _trace.complete("runtime", "flowgraph", t_sup,
                         args={"blocks": len(blocks), "errors": len(errors)})
@@ -457,7 +461,8 @@ def _record_restart(decisions: List[dict], by_id, msg: "BlockRestartMsg"):
                       "error": repr(msg.error)})
 
 
-def _describe(fg: Flowgraph, blocks: List[WrappedKernel]) -> FlowgraphDescription:
+def _describe(fg: Flowgraph, blocks: List[WrappedKernel],
+              decisions=()) -> FlowgraphDescription:
     desc = FlowgraphDescription(id=0, blocks=[b.description() for b in blocks])
     desc.stream_edges = [
         (fg.block_id(e.src), e.src_port, fg.block_id(e.dst), e.dst_port)
@@ -467,6 +472,7 @@ def _describe(fg: Flowgraph, blocks: List[WrappedKernel]) -> FlowgraphDescriptio
         (fg.block_id(e.src), e.src_port, fg.block_id(e.dst), e.dst_port)
         for e in fg.message_edges
     ]
+    desc.policy_decisions = list(decisions)
     return desc
 
 

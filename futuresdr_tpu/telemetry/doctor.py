@@ -598,6 +598,24 @@ class Doctor:
             bottleneck, frac = None, 0.0
         e2e = {f"p{int(q * 100)}_s": E2E_LATENCY.quantile(q)
                for q in (0.5, 0.95, 0.99)}
+        # fused device-graph runs (runtime/devchain.py): one entry per
+        # `devchain` span, with the fan-out runs' per-branch attribution
+        # (branch index, tail block, items out, early-retired?) passed
+        # through from the span args — so a report says WHICH branch of a
+        # fused region carried the output, not just that the region ran
+        devchains = []
+        for e in evs:
+            if e.cat != "devchain" or e.dur_ns is None:
+                continue
+            a = e.args or {}
+            entry = {"name": e.name, "dur_s": e.dur_ns / 1e9,
+                     "members": a.get("members"),
+                     "frames": a.get("frames"),
+                     "dispatches": a.get("dispatches"),
+                     "frames_per_dispatch": a.get("frames_per_dispatch")}
+            if a.get("branches"):
+                entry["branches"] = a["branches"]
+            devchains.append(entry)
         return {
             "wall_s": wall / 1e9,
             "lanes": lanes,
@@ -605,6 +623,7 @@ class Doctor:
             "bottleneck_lane": bottleneck,
             "bottleneck_busy_frac": round(frac, 4),
             "e2e_latency": e2e if e2e.get("p50_s") is not None else None,
+            "devchain": devchains or None,
         }
 
 

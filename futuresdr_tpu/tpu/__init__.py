@@ -7,11 +7,11 @@ whole block chains run as single jitted XLA programs (see :mod:`futuresdr_tpu.op
 """
 
 from .instance import TpuInstance, instance
-from .kernel_block import TpuKernel
+from .kernel_block import TpuFanoutKernel, TpuKernel
 from .frames import TpuH2D, TpuStage, TpuD2H
 from .autotune import autotune, autotune_streamed
 from .sp_block import SpKernel
 from .pp_block import PpKernel
 
-__all__ = ["TpuInstance", "instance", "TpuKernel", "TpuH2D", "TpuStage", "TpuD2H",
+__all__ = ["TpuInstance", "instance", "TpuKernel", "TpuFanoutKernel", "TpuH2D", "TpuStage", "TpuD2H",
            "autotune", "autotune_streamed", "SpKernel", "PpKernel"]

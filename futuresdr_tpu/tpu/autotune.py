@@ -172,11 +172,29 @@ def _measure_wired(pipe: Pipeline, wire, frame: int, depth: int,
     fused decode/compute/encode → read-ahead D2H → decode), the loop TpuKernel
     runs — so the number includes host codec cost and honors any fake link.
     ``k`` is the megabatch frames-per-dispatch (``Pipeline.compile_wired(k=)``):
-    each program call scans k frames, so dispatch overhead is paid once per k."""
+    each program call scans k frames, so dispatch overhead is paid once per k.
+
+    ``pipe`` may be a :class:`~futuresdr_tpu.ops.stages.FanoutPipeline`: the
+    wired fan-out program ships ONE input upload and a flat multi-branch
+    output part tuple, decoded per branch here — so a fan-out region tunes
+    through exactly the drain loop ``TpuFanoutKernel`` runs."""
     from ..ops.wire import get_wire
     wire = get_wire(wire)
     fn, carry = pipe.compile_wired(frame, wire, device=inst.device, k=k)
     host = np.zeros(frame, dtype=pipe.in_dtype)
+    n_branches = getattr(pipe, "n_branches", 0)
+    if n_branches:
+        branch_counts = pipe.part_counts(wire)
+
+        def decode_frame(raw_parts):
+            off = 0
+            for j, cnt in enumerate(branch_counts):
+                wire.decode_host(raw_parts[off:off + cnt],
+                                 pipe.out_dtypes[j])
+                off += cnt
+    else:
+        def decode_frame(raw_parts):
+            wire.decode_host(raw_parts, pipe.out_dtype)
 
     def encode_group():
         if k == 1:
@@ -204,10 +222,10 @@ def _measure_wired(pipe: Pipeline, wire, frame: int, depth: int,
         if len(inflight) >= depth:
             raw = inflight.popleft()()
             if k == 1:
-                wire.decode_host(raw, pipe.out_dtype)
+                decode_frame(raw)
             else:                           # stacked parts decode per frame
                 for i in range(k):
-                    wire.decode_host(tuple(p[i] for p in raw), pipe.out_dtype)
+                    decode_frame(tuple(p[i] for p in raw))
         if n_frames % 4 == 0 and time.perf_counter() - t0 > min_seconds:
             break
         if n_frames > 10000:
@@ -233,12 +251,38 @@ def _measure_wired(pipe: Pipeline, wire, frame: int, depth: int,
 _streamed_cache: Dict[tuple, int] = {}
 
 
+def _sig_names(stages) -> tuple:
+    return tuple(str(getattr(s, "name", "?")) for s in stages
+                 if getattr(s, "name", "") != "devchain_boundary")
+
+
+def _fanout_names(producer_stages, branch_stage_lists) -> tuple:
+    """Fan-out SHAPE signature: producer names + per-branch markers, so a
+    1→2 region and the linear chain of the same stages never share a pick."""
+    names = _sig_names(producer_stages)
+    for j, b in enumerate(branch_stage_lists):
+        names += (f"fanout[{j}]",) + _sig_names(b)
+    return names
+
+
+def _make_sig(platform: str, in_dtype, names: tuple) -> tuple:
+    """THE cache-key layout — every signature (linear, fan-out, raw-list)
+    must be assembled here so recorder and lookup can never diverge."""
+    return (platform, str(np.dtype(in_dtype)), names)
+
+
 def _streamed_sig(stages, in_dtype, platform: str) -> tuple:
     """Cache key for one tuned chain: devchain boundary fences are ignored so
-    a FUSED composition of the same member stages maps to the same entry."""
-    names = tuple(str(getattr(s, "name", "?")) for s in stages
-                  if getattr(s, "name", "") != "devchain_boundary")
-    return (platform, str(np.dtype(in_dtype)), names)
+    a FUSED composition of the same member stages maps to the same entry.
+    A :class:`~futuresdr_tpu.ops.stages.FanoutPipeline` keys on its fan-out
+    shape (:func:`_fanout_names`)."""
+    from ..ops.stages import FanoutPipeline
+    if isinstance(stages, FanoutPipeline):
+        names = _fanout_names(stages.producer.stages,
+                              [b.stages for b in stages.branches])
+    else:
+        names = _sig_names(stages)
+    return _make_sig(platform, in_dtype, names)
 
 
 def _cache_file() -> Optional[str]:
@@ -307,11 +351,15 @@ def _disk_store(sig: tuple, k: int) -> None:
         log.debug("streamed-pick cache write failed: %r", e)
 
 
-def record_streamed_pick(stages, in_dtype, platform: str,
-                         frames_per_dispatch: int) -> None:
-    sig = _streamed_sig(stages, in_dtype, platform)
+def _record_sig(sig: tuple, frames_per_dispatch: int) -> None:
     _streamed_cache[sig] = int(frames_per_dispatch)
     _disk_store(sig, int(frames_per_dispatch))
+
+
+def record_streamed_pick(stages, in_dtype, platform: str,
+                         frames_per_dispatch: int) -> None:
+    _record_sig(_streamed_sig(stages, in_dtype, platform),
+                frames_per_dispatch)
 
 
 def cached_frames_per_dispatch(stages, in_dtype,
@@ -366,22 +414,43 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
     swept. Otherwise the candidate set is the analytic pick from the measured
     link envelope (:func:`pick_wire`) plus ``f32`` as the exact baseline, so
     the sweep stays small and the chosen format's advantage is measured, not
-    assumed."""
+    assumed.
+
+    ``stages`` may be a ready-made
+    :class:`~futuresdr_tpu.ops.stages.FanoutPipeline` (a fan-out region):
+    the sweep then measures the multi-output drain loop and records the pick
+    under the region's fan-out SHAPE, which the device-graph fusion pass
+    looks up when it launches the fused ``TpuFanoutKernel``."""
     from ..config import config
+    from ..ops.stages import FanoutPipeline
     inst = inst or instance()
     # ONE Pipeline for everything: wired_fn caches per (wire name, k) on the
     # instance, so the jit function identity stays stable and each (wire,
     # frame, k) shape compiles once — not once per depth (compile_wired hands
     # out a fresh carry per call, so reuse across measurements is safe)
-    pipe = Pipeline(list(stages), in_dtype)
+    pipe = stages if isinstance(stages, FanoutPipeline) \
+        else Pipeline(list(stages), in_dtype)
     if wires is None:
         pinned = config().tpu_wire_format
         if pinned != "auto":
             wires = (pinned,)
         else:
             up, down = measure_link(inst)
+            if isinstance(pipe, FanoutPipeline):
+                # D2H budget across MIXED branch dtypes: weight each branch's
+                # path rate by its dtype width relative to branch 0 (the
+                # complex:real byte ratio is 2:1 under every float wire
+                # format, so the np-itemsize ratio is wire-invariant) —
+                # summing raw ratios against branch 0's dtype alone would
+                # mis-size the down-link by up to 2x
+                base = np.dtype(pipe.out_dtypes[0]).itemsize
+                out_per_in = float(sum(
+                    float(r) * (np.dtype(dt).itemsize / base)
+                    for r, dt in zip(pipe.path_ratios, pipe.out_dtypes)))
+            else:
+                out_per_in = float(pipe.ratio)
             picked = pick_wire(up, down, pipe.in_dtype, pipe.out_dtype,
-                               float(pipe.ratio), min_snr_db=min_snr_db)
+                               out_per_in, min_snr_db=min_snr_db)
             wires = ("f32",) if picked == "f32" else ("f32", picked)
             log.info("link %.1f/%.1f MB/s → wire candidates %s",
                      up / 1e6, down / 1e6, wires)
@@ -410,11 +479,24 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
                         best_rate = rate
                         best = (wname, f, d, k)
     results.frames_per_dispatch = best[3]
-    # record under BOTH the caller's raw stage list and the optimized pipeline
-    # stages: TpuStage/TpuKernel instances carry post-optimize stage lists, so
-    # the devchain lookup sees those names
-    for sig_stages in (list(stages), pipe.stages):
-        record_streamed_pick(sig_stages, pipe.in_dtype, inst.platform, best[3])
+    if isinstance(pipe, FanoutPipeline):
+        # record BOTH fan-out-shaped signatures: the pipeline's (possibly
+        # LTI-merged) stage names AND the caller's raw lists — the devchain
+        # lookup composes from per-member stage lists, which match the raw
+        # names whenever the caller's optimize=True merged across what are
+        # separate members in the flowgraph (the same both-signatures rule
+        # as the linear branch below)
+        record_streamed_pick(pipe, pipe.in_dtype, inst.platform, best[3])
+        raw_p, raw_b = pipe.raw_stage_lists
+        _record_sig(_make_sig(inst.platform, pipe.in_dtype,
+                              _fanout_names(raw_p, raw_b)), best[3])
+    else:
+        # record under BOTH the caller's raw stage list and the optimized
+        # pipeline stages: TpuStage/TpuKernel instances carry post-optimize
+        # stage lists, so the devchain lookup sees those names
+        for sig_stages in (list(stages), pipe.stages):
+            record_streamed_pick(sig_stages, pipe.in_dtype, inst.platform,
+                                 best[3])
     log.info("autotune_streamed best: wire=%s frame=%d depth=%d k=%d "
              "(%.1f Msps)", *best, best_rate)
     return best[0], best[1], best[2], results

@@ -40,7 +40,7 @@ from ..types import Pmt
 from .frames import emit_with_tags, rebase_frame_tags
 from .instance import TpuInstance, instance
 
-__all__ = ["TpuKernel"]
+__all__ = ["TpuKernel", "TpuFanoutKernel"]
 
 log = logger("tpu.kernel")
 _trace = _trace_recorder()
@@ -232,13 +232,33 @@ class TpuKernel(Kernel):
         self._staged.append((xfer.start_device_transfer_parts(
             stacked, self.inst.device), metas))
 
+    def _start_result_d2h(self, y_parts, metas) -> tuple:
+        """Start the D2H of one dispatch group's results and build its
+        in-flight entry ``(finish, out_metas)`` — the single-output form;
+        :class:`TpuFanoutKernel` overrides with the per-branch form. Starting
+        the transfer immediately means it rides the wire the moment the frame
+        finishes instead of waiting for _drain_one's sync (read-ahead,
+        VERDICT r2 weak 2)."""
+        finish = xfer.start_host_transfer_parts(y_parts)
+        out_metas = []
+        for valid_in, tags, t_in in metas:
+            valid_out = min(self.pipeline.out_items(valid_in),
+                            self.out_frame)
+            out_metas.append((valid_out,
+                              tuple(rebase_frame_tags(tags, self.pipeline,
+                                                      valid_out)),
+                              t_in))
+        return (finish, tuple(out_metas))
+
     def _launch_staged(self) -> None:
         """Dispatch compute for staged groups, oldest first, and start each
-        result's D2H immediately. Waiting happens only on the OLDEST group's
-        remaining H2D wire time — younger frames keep transferring, dispatched
-        frames keep computing, finished frames' D2H keeps draining: the
-        H2D(t+1) ∥ compute(t) ∥ D2H(t−1) overlap of the reference's circulating
-        h2d/d2h staging pairs, on XLA's async dispatch queue."""
+        result's D2H immediately (:meth:`_start_result_d2h`). Waiting happens
+        only on the OLDEST group's remaining H2D wire time — younger frames
+        keep transferring, dispatched frames keep computing, finished frames'
+        D2H keeps draining: the H2D(t+1) ∥ compute(t) ∥ D2H(t−1) overlap of
+        the reference's circulating h2d/d2h staging pairs, on XLA's async
+        dispatch queue. Shared verbatim by the fan-out kernel — only the
+        result-side hook differs."""
         fplan = _faults.plan()
         while self._staged and len(self._inflight) < self.depth:
             if fplan.armed():
@@ -257,19 +277,7 @@ class TpuKernel(Kernel):
                 _trace.complete("tpu", "compute", t0,
                                 args={"frame": self.frame_size,
                                       "frames": len(metas)})
-            # start the D2H immediately: the transfer rides the wire the moment
-            # the frame finishes instead of waiting for _drain_one's sync
-            # (read-ahead, VERDICT r2 weak 2)
-            finish = xfer.start_host_transfer_parts(y_parts)
-            out_metas = []
-            for valid_in, tags, t_in in metas:
-                valid_out = min(self.pipeline.out_items(valid_in),
-                                self.out_frame)
-                out_metas.append((valid_out,
-                                  tuple(rebase_frame_tags(tags, self.pipeline,
-                                                          valid_out)),
-                                  t_in))
-            self._inflight.append((finish, tuple(out_metas)))
+            self._inflight.append(self._start_result_d2h(y_parts, metas))
             self._frames_dispatched += len(metas)
             self._dispatches += 1
 
@@ -308,21 +316,16 @@ class TpuKernel(Kernel):
                             args={"wire": self.wire.name, "items": len(result)})
         return result, all_tags
 
-    async def work(self, io, mio, meta):
-        # 1. flush pending host-side output first
-        if self._pending_out is not None:
-            self._pending_out, self._pending_tags = emit_with_tags(
-                self.output, self._pending_out, self._pending_tags)
-            if self._pending_out is not None:
-                return  # downstream full; its consume() will wake us
-
+    def _stage_available_input(self):
+        """Step 2 of the work loop, shared with the fan-out kernel: stage as
+        many full frames as the pipeline depth allows — each one's H2D starts
+        NOW, so while the oldest frame's compute is dispatched the younger
+        frames' payloads are already on the wire. The copy is the H2D staging
+        write (reference `vulkan/h2d.rs:29-37`): device_put is async, so
+        handing it a live ring-buffer view would race with the writer
+        overwriting consumed space — the frame must leave the ring before
+        consume(). Returns ``(remaining input slice, eos)``."""
         inp = self.input.slice()
-        # 2. stage as many full frames as the pipeline depth allows: each one's
-        #    H2D starts NOW, so while the oldest frame's compute is dispatched
-        #    below, the younger frames' payloads are already on the wire.
-        #    The copy is the H2D staging write (reference `vulkan/h2d.rs:29-37`): device_put
-        #    is async, so handing it a live ring-buffer view would race with the writer
-        #    overwriting consumed space — the frame must leave the ring before consume().
         budget = self.depth + self.stage_ahead
         while len(self._staged) + len(self._inflight) < budget and \
                 len(inp) >= self.frame_size:
@@ -356,6 +359,18 @@ class TpuKernel(Kernel):
             # EOS: a partial dispatch group cannot wait for more frames —
             # zero-pad it to the scan length and ship (pad outputs dropped)
             self._flush_accum()
+        return inp, eos
+
+    async def work(self, io, mio, meta):
+        # 1. flush pending host-side output first
+        if self._pending_out is not None:
+            self._pending_out, self._pending_tags = emit_with_tags(
+                self.output, self._pending_out, self._pending_tags)
+            if self._pending_out is not None:
+                return  # downstream full; its consume() will wake us
+
+        # 2. stage everything the depth budget allows (H2D rides now)
+        inp, eos = self._stage_available_input()
 
         # 3. launch compute on staged frames (their transfers have been riding
         #    since step 2) and start each result's D2H
@@ -375,6 +390,240 @@ class TpuKernel(Kernel):
 
         if eos and not self._inflight and not self._staged and \
                 not self._accum and self._pending_out is None and len(inp) == 0:
+            io.finished = True
+        elif eos and (self._inflight or self._staged or self._accum):
+            io.call_again = True
+
+
+class _PathRatio:
+    """Rate-contract shim for :func:`rebase_frame_tags`, which only reads
+    ``.ratio`` — carries one fan-out branch's producer·branch path rate."""
+
+    __slots__ = ("ratio",)
+
+    def __init__(self, ratio):
+        self.ratio = ratio
+
+
+class TpuFanoutKernel(TpuKernel):
+    """ONE fused dispatch driving N branch stream outputs.
+
+    The block form of :class:`~futuresdr_tpu.ops.stages.FanoutPipeline`: a
+    device-plane region shaped ``producer → broadcast → N consumer chains``
+    runs as a single multi-output XLA program per frame (per megabatch
+    window) — the input frame crosses the link ONCE, the producer computes
+    once, and each branch's result streams out its own port. Constructed by
+    the device-graph fusion pass (``runtime/devchain.py``) but usable
+    directly: ``outputs[j]`` carries branch j (ports ``out0…out{N-1}``).
+
+    The staging/megabatch/H2D/dispatch side is inherited unchanged from
+    :class:`TpuKernel` (one input, one upload per frame group); only the
+    result side — D2H metas, drain, emit — generalizes per branch. Under the
+    devchain drive loop a branch whose downstream detaches is RETIRED
+    (:meth:`retire_branch`): its output is dropped while the surviving
+    branches keep streaming — the semantics the actor runtime gives a
+    broadcast port group when one reader finishes early. NOTE: when run as a
+    plain actor block instead (outside the devchain), the generic block
+    event loop cannot attribute a ``StreamOutputDone`` to one port, so the
+    FIRST detaching reader finishes the whole block — per-branch retirement
+    needs the devchain's per-tail inbox routing.
+    """
+
+    def __init__(self, fanout, frame_size: Optional[int] = None,
+                 inst: Optional[TpuInstance] = None,
+                 frames_in_flight: Optional[int] = None,
+                 wire=None, frames_per_dispatch: Optional[int] = None):
+        from ..runtime.kernel import Kernel
+        Kernel.__init__(self)
+        from ..config import config
+        self.inst = inst or instance()
+        self.pipeline = fanout
+        fs = frame_size or self.inst.frame_size
+        m = fanout.frame_multiple
+        self.frame_size = max(m, (fs // m) * m)
+        self.out_frames = [fanout.branch_out_items(j, self.frame_size)
+                           for j in range(fanout.n_branches)]
+        self.out_frame = sum(self.out_frames)      # linear-surface compat
+        self.depth = frames_in_flight or self.inst.frames_in_flight
+        self.k_batch = max(1, int(frames_per_dispatch
+                                  or config().tpu_frames_per_dispatch))
+        self._k_explicit = frames_per_dispatch is not None
+        self.stage_ahead = 1 if self.depth > 1 else 0
+        from ..ops.wire import resolve_wire
+        self.wire = resolve_wire(wire, self.inst.platform)
+        self._needs_staging = xfer.h2d_needs_staging(self.inst.platform)
+        self._compiled = None
+        self._carry = None
+        self._accum = []
+        self._staged = deque()
+        self._inflight = deque()
+        self._e2e_hist = None
+        self._frames_dispatched = 0
+        self._dispatches = 0
+        nb = fanout.n_branches
+        self._pendings: List[Optional[np.ndarray]] = [None] * nb
+        self._pending_tags_n: List[List[ItemTag]] = [[] for _ in range(nb)]
+        self._branch_done = [False] * nb
+        # fixed at compile: parts per branch in the wired program's FLAT
+        # output tuple (the drain re-nesting key)
+        self._part_counts = fanout.part_counts(self.wire)
+        self.input = self.add_stream_input("in", fanout.in_dtype,
+                                           min_items=self.frame_size)
+        self.outputs = [
+            self.add_stream_output(
+                f"out{j}", fanout.out_dtypes[j], min_items=of,
+                min_buffer_size=(self.depth * self.k_batch + 1) * of *
+                np.dtype(fanout.out_dtypes[j]).itemsize)
+            for j, of in enumerate(self.out_frames)]
+        # single-output compat for code that pokes .output (metrics, repr);
+        # work()/drain below always address self.outputs[j]
+        self.output = self.outputs[0]
+        self._pending_out = None
+        self._pending_tags = []
+
+    async def init(self, mio, meta):
+        # restart contract (TpuKernel.init): drop every per-branch trace of
+        # the previous incarnation too
+        nb = self.pipeline.n_branches
+        self._pendings = [None] * nb
+        self._pending_tags_n = [[] for _ in range(nb)]
+        self._branch_done = [False] * nb
+        await super().init(mio, meta)
+
+    def retire_branch(self, j: int) -> None:
+        """Stop emitting branch ``j`` (its downstream detached): produced
+        frames for it are dropped, the other branches keep streaming. When
+        every branch is retired the next work() finishes the block."""
+        self._branch_done[j] = True
+        self._pendings[j] = None
+        self._pending_tags_n[j] = []
+
+    def extra_metrics(self) -> dict:
+        m = super().extra_metrics()
+        m["branches"] = self.pipeline.n_branches
+        m["branches_live"] = sum(not d for d in self._branch_done)
+        return m
+
+    # -- per-branch result side (the only specialization over TpuKernel) ------
+    def _start_result_d2h(self, flat_parts, metas) -> tuple:
+        """ONE D2H for the whole flat part tuple: all branches' results ride
+        the wire together, billed as one frame transfer. Metas carry one
+        per-branch ``(valid_out, rebased tags)`` tuple per frame — each
+        branch's tag indices rebased through ITS path rate."""
+        fo = self.pipeline
+        finish = xfer.start_host_transfer_parts(flat_parts)
+        out_metas = []
+        for valid_in, tags, t_in in metas:
+            per_branch = []
+            for j in range(fo.n_branches):
+                valid_out = min(fo.branch_out_items(j, valid_in),
+                                self.out_frames[j])
+                per_branch.append(
+                    (valid_out,
+                     tuple(rebase_frame_tags(
+                         tags, _PathRatio(fo.path_ratios[j]), valid_out))))
+            out_metas.append((tuple(per_branch), t_in))
+        return (finish, tuple(out_metas))
+
+    def _drain_one(self) -> List[Tuple[np.ndarray, list]]:
+        """Land the oldest dispatch group; returns one ``(result, tags)`` per
+        BRANCH (megabatch groups concatenate their frames per branch, tag
+        indices rebased by the branch's running offset)."""
+        fo = self.pipeline
+        finish, out_metas = self._inflight.popleft()
+        raw = finish()                       # flat: branch parts in order
+        t0 = _trace.now() if _trace.enabled else 0
+        nb = fo.n_branches
+        results: List[Tuple[np.ndarray, list]] = []
+        if self.k_batch == 1:
+            ((per_branch, t_in),) = out_metas
+            off = 0
+            for j, cnt in enumerate(self._part_counts):
+                parts_j = raw[off:off + cnt]
+                off += cnt
+                if self._branch_done[j]:
+                    # retired reader: don't pay the host decode for frames
+                    # work() would drop anyway
+                    results.append((np.empty(0, fo.out_dtypes[j]), []))
+                    continue
+                valid, tags = per_branch[j]
+                arr = self.wire.decode_host(parts_j, fo.out_dtypes[j])
+                results.append((arr[:valid], list(tags)))
+            t_ins = (t_in,)
+        else:
+            chunks = [[] for _ in range(nb)]
+            all_tags: List[List[ItemTag]] = [[] for _ in range(nb)]
+            offsets = [0] * nb
+            for i, (per_branch, _tin) in enumerate(out_metas):
+                off = 0
+                for j, cnt in enumerate(self._part_counts):
+                    parts_j = tuple(p[i] for p in raw[off:off + cnt])
+                    off += cnt
+                    if self._branch_done[j]:
+                        continue         # retired: skip the decode + concat
+                    valid, tags = per_branch[j]
+                    chunks[j].append(self.wire.decode_host(
+                        parts_j, fo.out_dtypes[j])[:valid])
+                    all_tags[j].extend(ItemTag(t.index + offsets[j], t.tag)
+                                       for t in tags)
+                    offsets[j] += valid
+            results = [
+                (np.concatenate(c) if c else np.empty(0, fo.out_dtypes[j]),
+                 all_tags[j])
+                for j, c in enumerate(chunks)]
+            t_ins = tuple(tin for _, tin in out_metas)
+        end = time.perf_counter_ns()
+        if self._e2e_hist is not None:
+            for tin in t_ins:                # one observation per input frame
+                self._e2e_hist.observe((end - tin) * 1e-9)
+        if t0:
+            _trace.complete("tpu", "decode", t0, end_ns=end,
+                            args={"wire": self.wire.name,
+                                  "items": sum(len(r) for r, _ in results),
+                                  "branches": nb})
+        return results
+
+    async def work(self, io, mio, meta):
+        nb = self.pipeline.n_branches
+        # 1. flush pending per-branch host output first; if ANY live branch is
+        #    still blocked downstream, park — its consume() will wake us
+        blocked = False
+        for j in range(nb):
+            if self._branch_done[j]:
+                continue
+            if self._pendings[j] is not None:
+                self._pendings[j], self._pending_tags_n[j] = emit_with_tags(
+                    self.outputs[j], self._pendings[j],
+                    self._pending_tags_n[j])
+                if self._pendings[j] is not None:
+                    blocked = True
+        if blocked:
+            return
+        if all(self._branch_done):
+            io.finished = True               # every reader detached
+            return
+
+        # 2. stage (shared with TpuKernel: one upload per frame group),
+        # 3. dispatch + per-branch D2H (shared loop, per-branch result hook)
+        inp, eos = self._stage_available_input()
+        self._launch_staged()
+
+        # 4. per-branch retrieve/emit
+        should_drain = bool(self._inflight) and (
+            len(self._inflight) >= self.depth or len(inp) < self.frame_size
+            or eos)
+        if should_drain:
+            for j, (result, tags) in enumerate(self._drain_one()):
+                if self._branch_done[j]:
+                    continue                 # retired reader: drop its frames
+                self._pendings[j], self._pending_tags_n[j] = emit_with_tags(
+                    self.outputs[j], result, tags)
+            io.call_again = True
+            return
+
+        if eos and not self._inflight and not self._staged and \
+                not self._accum and all(p is None for p in self._pendings) \
+                and len(inp) == 0:
             io.finished = True
         elif eos and (self._inflight or self._staged or self._accum):
             io.call_again = True

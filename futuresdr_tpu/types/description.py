@@ -22,6 +22,12 @@ class BlockDescription:
     message_inputs: List[str] = field(default_factory=list)
     message_outputs: List[str] = field(default_factory=list)
     blocking: bool = False
+    # failure-policy surface (docs/robustness.md): the resolved per-block
+    # policy and how many restart attempts the supervisor has billed — so
+    # `GET /api/fg/{fg}/` tells an operator WHICH block is flapping without
+    # scraping /metrics
+    policy: str = "fail_fast"
+    restarts: int = 0
 
     def to_json(self):
         return asdict(self)
@@ -33,6 +39,11 @@ class FlowgraphDescription:
     blocks: List[BlockDescription] = field(default_factory=list)
     stream_edges: List[tuple] = field(default_factory=list)  # (src_blk, src_port, dst_blk, dst_port)
     message_edges: List[tuple] = field(default_factory=list)
+    # the supervisor's policy-action log (restart attempts, isolations,
+    # restart-exhausted escalations, cancels) — live during the run, final
+    # after it (the same dicts a FlowgraphError carries on failure, surfaced
+    # here for runs that RECOVERED)
+    policy_decisions: List[dict] = field(default_factory=list)
 
     def to_json(self):
         return {
@@ -40,4 +51,5 @@ class FlowgraphDescription:
             "blocks": [b.to_json() for b in self.blocks],
             "stream_edges": [list(e) for e in self.stream_edges],
             "message_edges": [list(e) for e in self.message_edges],
+            "policy_decisions": list(self.policy_decisions),
         }

@@ -19,27 +19,49 @@ from futuresdr_tpu.models.lora import (LoraParams, modulate_frame, detect_frames
                                        demodulate_frame)
 
 
+_PIPE_CACHE: dict = {}       # sf -> Pipeline (stable jit identity across runs,
+#                              the memoization perf/wlan.py's _compiled has)
+
+
 def run_device_resident(sf: int, symbols_per_frame: int, k_pair) -> tuple:
     """Dechirp + batched FFT + argmax (the ``FftDemod`` hot loop,
     ``examples/lora/src/fft_demod.rs``) as a carry-chained device pipeline over
     HBM-resident frames, scan-marginal methodology (BASELINE target #5)."""
     import jax
     from futuresdr_tpu.ops.stages import Pipeline, lora_demod_stage
-    from futuresdr_tpu.ops.xfer import to_device
+    from futuresdr_tpu.ops.xfer import to_device, to_host
     from futuresdr_tpu.utils.measure import run_marginal_retry, scaled_k_pair
 
-    pipe = Pipeline([lora_demod_stage(sf)], np.complex64)
+    pipe = _PIPE_CACHE.get(sf)
+    if pipe is None:
+        pipe = _PIPE_CACHE[sf] = Pipeline([lora_demod_stage(sf)], np.complex64)
     frame = (1 << sf) * symbols_per_frame
+    backend = jax.default_backend()
     # scan-window scaling (shared discipline, utils/measure.scaled_k_pair):
     # small frames make sub-ms timed windows where scheduler noise dominated
     # (r4: 58-182 Msps spread on CPU); accelerator dispatch jitter needs far
-    # larger windows still (r5: lora_msps_runs spread ±80% on the tunnel)
-    k_pair = scaled_k_pair(k_pair, frame, jax.default_backend())
+    # larger windows still. This is the FASTEST chain in the suite (~2-4 Gsps
+    # on-chip), so the shared 512M-sample accel floor buys only ~0.2 s of
+    # compute per k_lo scan and the tunnel's per-RPC jitter still moved the
+    # marginal ±80% (BENCH_r05: lora_msps_runs 1635-4320, vs wlan's ±16% at
+    # a third the rate) — floor LoRa's window at 2G samples (~1 s scans) so
+    # the k_hi−k_lo delta dwarfs the jitter like the slower chains' already do
+    k_pair = scaled_k_pair(k_pair, frame, backend,
+                           min_lo_items=None if backend == "cpu"
+                           else 2_048_000_000)
     rng = np.random.default_rng(11)
     host = (rng.standard_normal(frame)
             + 1j * rng.standard_normal(frame)).astype(np.complex64)
     carry0 = jax.device_put(pipe.init_carry())
     x = to_device(host)
+    if backend != "cpu":
+        # untimed single-dispatch warmup before the measured scans (the
+        # perf/wlan.py / bench.py `--run-chain` discipline): the FIRST
+        # dispatch of a process pays tunnel dial + transfer setup, and
+        # letting it land inside run_marginal's first timed window made run 1
+        # a cold outlier
+        _, y = pipe.fn()(carry0, x)
+        to_host(y)
     rate = run_marginal_retry(pipe.fn(), carry0, x, k_pair) / 1e6
     return rate, frame
 

@@ -97,3 +97,29 @@ def test_circuit_backpressure():
     fg.close_circuit(circuit, src)
     Runtime().run(fg)
     assert len(snk.received) == 20
+
+
+def test_inplace_reconnect_idempotent_and_mutable_broadcast_refused():
+    """Re-materializing the same flowgraph re-connects the same peer — the
+    port must not double-register it (frames would push twice and the
+    broadcast guard would misfire on a single-reader circuit). A GENUINE
+    broadcast of a writable host frame still refuses (mutable circuit frames
+    are single-reader; immutable device-plane frames may broadcast)."""
+    import numpy as np
+    import pytest
+
+    from futuresdr_tpu.runtime.buffer.circuit import InplaceInput, InplaceOutput
+
+    op, ip = InplaceOutput("out"), InplaceInput("in")
+    op.connect(ip)
+    op.connect(ip)                      # rerun of the same flowgraph
+    buf = np.zeros(4, np.float32)
+    op.put_full(buf, 4)                 # single reader: no raise, ONE frame
+    assert len(ip) == 1 and op.queue_depth() == 1
+    ip2 = InplaceInput("in2")
+    op.connect(ip2)                     # genuine second consumer
+    with pytest.raises(RuntimeError, match="single-reader"):
+        op.put_full(buf, 4)
+    buf.flags.writeable = False         # immutable frames broadcast fine
+    op.put_full(buf, 4)
+    assert len(ip) == 2 and len(ip2) == 1

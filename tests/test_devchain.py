@@ -195,8 +195,389 @@ def test_fused_member_metrics_bridge():
 
 
 # ---------------------------------------------------------------------------
+# fan-out (broadcast) fusion: producer → N branches as ONE dispatch
+# ---------------------------------------------------------------------------
+
+def _fanout_stage_lists(split: str):
+    """producer stages + two branch stage lists under different member splits
+    (how the stages are distributed over TpuStage blocks)."""
+    t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+    t2 = firdes.lowpass(0.2, 32).astype(np.float32)
+    p1 = fir_stage(t1, name="p1")
+    p2 = rotator_stage(0.1, name="p2")
+    b1 = fir_stage(t2, decim=4, name="b1")
+    b2 = mag2_stage()
+    # (producer member stage-lists, branch1 member stage-lists, branch2 ...)
+    return {
+        "1→1|1": ([[p1]], [[b1]], [[b2]]),
+        "2→1|1": ([[p1], [p2]], [[b1]], [[b2]]),
+        "1→2|1": ([[p1]], [[p2, b1]], [[b2]]),
+    }[split]
+
+
+def _fanout_frame_fg(split: str, data, frame: int):
+    """TpuH2D → producer TpuStages → broadcast → two TpuStage chains, each
+    exiting through its own TpuD2H."""
+    prod_lists, br1_lists, br2_lists = _fanout_stage_lists(split)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    h2d = TpuH2D(np.complex64, frame_size=frame)
+    fg.connect_stream(src, "out", h2d, "in")
+    prev = h2d
+    for sl in prod_lists:
+        st = TpuStage(sl, np.complex64)
+        fg.connect_inplace(prev, "out", st, "in")
+        prev = st
+    sinks = []
+    for lists, out_dt in ((br1_lists, np.complex64), (br2_lists, np.float32)):
+        b_prev = prev
+        for sl in lists:
+            st = TpuStage(sl, np.complex64)
+            fg.connect_inplace(b_prev, "out", st, "in")
+            b_prev = st
+        d2h = TpuD2H(out_dt)
+        snk = VectorSink(out_dt)
+        fg.connect_inplace(b_prev, "out", d2h, "in")
+        fg.connect_stream(d2h, "out", snk, "in")
+        sinks.append(snk)
+    return fg, sinks
+
+
+@pytest.mark.parametrize("split", ["1→1|1", "2→1|1", "1→2|1"])
+@pytest.mark.parametrize("frames_n", [1, 3])      # one-shot vs chunked stream
+def test_frames_fanout_fused_bit_equals_actor(split, frames_n):
+    """A frame-plane 1→2 fan-out region fuses into ONE multi-output dispatch
+    whose branch outputs are BIT-identical to the per-hop broadcast run."""
+    frame = 4096
+    rng = np.random.default_rng(17)
+    n = frames_n * frame
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+    with _no_devchain():
+        fg, sinks = _fanout_frame_fg(split, data, frame)
+        assert find_device_chains(fg) == []
+        Runtime().run(fg)
+        refs = [s.items() for s in sinks]
+    with _no_devchain(False):
+        fg, sinks = _fanout_frame_fg(split, data, frame)
+        chains = find_device_chains(fg)
+        assert len(chains) == 1 and chains[0].fanout   # the region fuses
+        Runtime().run(fg)
+        got = [s.items() for s in sinks]
+    assert len(refs[0]) == n // 4 and len(refs[1]) == n
+    for g, r in zip(got, refs):
+        np.testing.assert_array_equal(g, r)
+
+
+def test_kernels_fanout_1to3_bit_equals_actor():
+    """A TpuKernel producer broadcasting to THREE TpuKernel branches over
+    stream edges fuses (one upload, one dispatch) bit-identically."""
+    t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+    t2 = firdes.lowpass(0.2, 32).astype(np.float32)
+    frame = 4096
+    rng = np.random.default_rng(18)
+    n = 4 * frame
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+
+    def build():
+        fg = Flowgraph()
+        src = VectorSource(data)
+        prod = TpuKernel([fir_stage(t1, name="p")], np.complex64,
+                         frame_size=frame)
+        b1 = TpuKernel([fir_stage(t2, decim=4, name="b1")], np.complex64,
+                       frame_size=frame)
+        b2 = TpuKernel([mag2_stage()], np.complex64, frame_size=frame)
+        b3 = TpuKernel([rotator_stage(0.2)], np.complex64, frame_size=frame)
+        snks = [VectorSink(np.complex64), VectorSink(np.float32),
+                VectorSink(np.complex64)]
+        fg.connect(src, prod)
+        for b, s in zip((b1, b2, b3), snks):
+            fg.connect_stream(prod, "out", b, "in")
+            fg.connect(b, s)
+        return fg, snks, prod
+
+    with _no_devchain():
+        fg, snks, _ = build()
+        Runtime().run(fg)
+        refs = [s.items() for s in snks]
+    with _no_devchain(False):
+        fg, snks, prod = build()
+        chains = find_device_chains(fg)
+        assert len(chains) == 1 and chains[0].fanout \
+            and chains[0].kind == "kernels"
+        assert len(chains[0].branches) == 3
+        Runtime().run(fg)
+        got = [s.items() for s in snks]
+        m = prod.extra_metrics()
+        assert m.get("fused_devchain")
+        # ONE dispatch per frame for the whole 1→3 region (was 4 per frame)
+        assert m["devchain_dispatches"] == m["devchain_frames"] == 4
+    for g, r in zip(got, refs):
+        np.testing.assert_array_equal(g, r)
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_fanout_megabatch_bit_equals_actor(k):
+    """frames_per_dispatch K through the fused fan-out keeps bit-equality,
+    including the EOS partial batch and a partial tail frame."""
+    from futuresdr_tpu.config import config
+    frame = 4096
+    rng = np.random.default_rng(19)
+    n = 5 * frame                     # 5 frames: one K=4 batch stays partial
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+    with _no_devchain():
+        fg, sinks = _fanout_frame_fg("1→1|1", data, frame)
+        Runtime().run(fg)
+        refs = [s.items() for s in sinks]
+    old = config().tpu_frames_per_dispatch
+    config().tpu_frames_per_dispatch = k
+    try:
+        with _no_devchain(False):
+            fg, sinks = _fanout_frame_fg("1→1|1", data, frame)
+            Runtime().run(fg)
+            got = [s.items() for s in sinks]
+    finally:
+        config().tpu_frames_per_dispatch = old
+    for g, r in zip(got, refs):
+        np.testing.assert_array_equal(g, r)
+
+
+def test_fanout_tags_rebase_through_decimating_branch():
+    """A tag crossing the fused fan-out lands at the DECIMATED index on the
+    decimating branch and the 1:1 index on the other — each branch applies
+    its own path rate contract."""
+    from tests.test_tpu_tags import (DECIM, TAG_AT, TagRecordingSink,
+                                     TaggedRampSource, _expect)
+
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    n = 3 * 4096
+    with _no_devchain(False):
+        fg = Flowgraph()
+        src = TaggedRampSource(n)
+        h2d = TpuH2D(np.complex64, frame_size=4096)
+        b1 = TpuStage([fir_stage(taps, decim=DECIM)], np.complex64)
+        b2 = TpuStage([mag2_stage()], np.complex64)
+        d1 = TpuD2H(np.complex64)
+        d2 = TpuD2H(np.float32)
+        s1 = TagRecordingSink(np.complex64)
+        s2 = TagRecordingSink(np.float32)
+        fg.connect_stream(src, "out", h2d, "in")
+        fg.connect_inplace(h2d, "out", b1, "in")
+        fg.connect_inplace(h2d, "out", b2, "in")
+        fg.connect_inplace(b1, "out", d1, "in")
+        fg.connect_inplace(b2, "out", d2, "in")
+        fg.connect_stream(d1, "out", s1, "in")
+        fg.connect_stream(d2, "out", s2, "in")
+        chains = find_device_chains(fg)
+        assert len(chains) == 1 and chains[0].fanout
+        Runtime().run(fg)
+    assert s1.n_received == n // DECIM
+    _expect(s1.seen)                   # decimated branch: index // DECIM
+    assert s2.n_received == n
+    got2 = {t.value: idx for idx, t in s2.seen}
+    assert got2 == {a: a for a in TAG_AT}   # 1:1 branch: index unchanged
+
+
+def test_fanout_member_metrics_bridge():
+    """Fan-out members report fused provenance, per-branch identity and item
+    counters derived through THEIR branch's path rate."""
+    frame = 4096
+    data = np.zeros(3 * frame, np.complex64)
+    with _no_devchain(False):
+        fg, _sinks = _fanout_frame_fg("1→1|1", data, frame)
+        rt = Runtime()
+        rt.start(fg).wait_sync()
+    mets = {b.instance_name: b.metrics() for b in fg._blocks if b is not None}
+    fused = {nm: m for nm, m in mets.items() if m.get("fused_devchain")}
+    assert len(fused) == 6            # h2d + producer + 2 branches + 2 d2h
+    branches = {m.get("devchain_branch") for m in fused.values()}
+    assert branches == {None, 0, 1}
+    # the decimating branch member reports in-rate 1:1 and out-rate 1:4
+    dec = next(m for nm, m in fused.items()
+               if m.get("devchain_branch") == 0 and nm.startswith("TpuStage"))
+    assert dec["items_in"] == {"in": 3 * frame}
+    assert dec["items_out"] == {"out": 3 * frame // 4}
+
+
+# ---------------------------------------------------------------------------
 # refuse-to-fuse cases: the run must stay on the actor path
 # ---------------------------------------------------------------------------
+
+
+def test_fanout_refuses_cross_instance_branch():
+    """One branch on a different TpuInstance declines the WHOLE region."""
+    from futuresdr_tpu.tpu import TpuInstance
+
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(8192, np.complex64))
+    h2d = TpuH2D(np.complex64, frame_size=4096)
+    b1 = TpuStage([fir_stage(taps, name="b1")], np.complex64)
+    b2 = TpuStage([mag2_stage()], np.complex64, inst=TpuInstance())
+    d1 = TpuD2H(np.complex64)
+    d2 = TpuD2H(np.float32)
+    s1 = VectorSink(np.complex64)
+    s2 = VectorSink(np.float32)
+    fg.connect_stream(src, "out", h2d, "in")
+    fg.connect_inplace(h2d, "out", b1, "in")
+    fg.connect_inplace(h2d, "out", b2, "in")
+    fg.connect_inplace(b1, "out", d1, "in")
+    fg.connect_inplace(b2, "out", d2, "in")
+    fg.connect_stream(d1, "out", s1, "in")
+    fg.connect_stream(d2, "out", s2, "in")
+    with _no_devchain(False):
+        assert find_device_chains(fg) == []
+
+
+def test_fanout_refuses_policy_bearing_member():
+    """A non-fail_fast failure policy on ANY member (here a branch kernel)
+    declines the whole fan-out region to the per-hop actor path."""
+    from futuresdr_tpu.runtime.block import BlockPolicy
+
+    t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(8192, np.complex64))
+    prod = TpuKernel([fir_stage(t1, name="p")], np.complex64, frame_size=4096)
+    b1 = TpuKernel([mag2_stage()], np.complex64, frame_size=4096)
+    b2 = TpuKernel([rotator_stage(0.1)], np.complex64, frame_size=4096)
+    b2.policy = BlockPolicy(on_error="isolate")
+    s1 = VectorSink(np.float32)
+    s2 = VectorSink(np.complex64)
+    fg.connect(src, prod)
+    fg.connect_stream(prod, "out", b1, "in")
+    fg.connect_stream(prod, "out", b2, "in")
+    fg.connect(b1, s1)
+    fg.connect(b2, s2)
+    with _no_devchain(False):
+        assert find_device_chains(fg) == []
+
+
+def test_no_devchain_env_declines_fanout():
+    """FSDR_NO_DEVCHAIN=1 keeps fan-out regions per-hop too, and the
+    broadcast actor path stands alone."""
+    frame = 4096
+    data = np.zeros(2 * frame, np.complex64)
+    with _no_devchain():
+        fg, sinks = _fanout_frame_fg("1→1|1", data, frame)
+        assert find_device_chains(fg) == []
+        Runtime().run(fg)
+        assert len(sinks[0].items()) == 2 * frame // 4
+        assert len(sinks[1].items()) == 2 * frame
+
+
+def test_fanout_span_and_report_carry_branch_attribution():
+    """The fused run's `devchain` span carries per-branch args, and
+    doctor.report() surfaces them under its `devchain` key."""
+    from futuresdr_tpu.telemetry import doctor as doc
+    from futuresdr_tpu.telemetry import spans
+
+    frame = 4096
+    data = np.zeros(3 * frame, np.complex64)
+    spans.enable(True)
+    try:
+        spans.recorder().drain()
+        with _no_devchain(False):
+            fg, _sinks = _fanout_frame_fg("1→1|1", data, frame)
+            Runtime().run(fg)
+        events = spans.recorder().drain()
+    finally:
+        spans.enable(False)
+    dev = [e for e in events if e.cat == "devchain"]
+    assert len(dev) == 1
+    branches = dev[0].args["branches"]
+    assert [b["branch"] for b in branches] == [0, 1]
+    assert all(not b["retired"] and b["members"] == 2 for b in branches)
+    assert branches[0]["items_out"] == 3 * frame // 4      # decimating branch
+    assert branches[1]["items_out"] == 3 * frame
+    rep = doc.doctor().report(events=events)
+    assert rep["devchain"] and rep["devchain"][0]["frames"] == 3
+    assert rep["devchain"][0]["branches"] == branches
+
+
+def test_fanout_launches_with_cached_autotune_k():
+    """A fan-out region whose SHAPE was tuned by autotune_streamed launches
+    fused with the cached megabatch K (the streamed-pick cache keyed on
+    producer + per-branch markers), and the raw-stage-list signature recorded
+    alongside maps a devchain composition to the same pick even when the
+    tuned pipeline merged stages."""
+    from futuresdr_tpu.ops import FanoutPipeline
+    from futuresdr_tpu.tpu import instance
+    from futuresdr_tpu.tpu.autotune import (_fanout_names, _record_sig,
+                                            _streamed_cache,
+                                            cached_frames_per_dispatch)
+
+    t2 = firdes.lowpass(0.2, 32).astype(np.float32)
+    frame, k = 4096, 2
+    n = 4 * frame
+    rng = np.random.default_rng(23)
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+    with _no_devchain(False):
+        fg, sinks = _fanout_frame_fg("1→1|1", data, frame)
+        # record the pick under the raw fan-out shape the flowgraph's member
+        # stage lists compose to (what autotune_streamed(_record_sig) writes)
+        st_members = [b.kernel for b in fg._blocks if b is not None
+                      and type(b.kernel).__name__ == "TpuStage"]
+        prod = next(m for m in st_members
+                    if any(s.name == "p1" for s in m.pipeline.stages))
+        b1 = next(m for m in st_members
+                  if any(s.name == "b1" for s in m.pipeline.stages))
+        b2 = next(m for m in st_members
+                  if any(s.name == "mag2" for s in m.pipeline.stages))
+        _record_sig((instance().platform, str(np.dtype(np.complex64)),
+                     _fanout_names(prod.pipeline.stages,
+                                   [b1.pipeline.stages, b2.pipeline.stages])),
+                    k)
+        try:
+            Runtime().run(fg)
+            m = fg.wrapped(prod).metrics()
+            assert m.get("fused_devchain") is True, m
+            assert m.get("frames_per_dispatch") == k, m
+            assert m["devchain_frames"] == 4 and m["devchain_dispatches"] == 2
+        finally:
+            _streamed_cache.clear()
+    # the raw-signature alias: a FanoutPipeline built from split raw lists
+    # records under BOTH its merged names and the raw names
+    from futuresdr_tpu.tpu.autotune import autotune_streamed  # noqa: F401
+    fo = FanoutPipeline([fir_stage(t2, name="x1"), fir_stage(t2, name="x2")],
+                        [[mag2_stage()], [rotator_stage(0.1)]], np.complex64)
+    assert [s.name for s in fo.producer.stages] == ["x1*x2"]   # LTI-merged
+    raw_p, raw_b = fo.raw_stage_lists
+    assert [s.name for s in raw_p] == ["x1", "x2"]
+
+
+def test_donation_mask_fanout_compile():
+    """ops/stages donation mask: True donates the carries; an explicit
+    argnum mask donates exactly those argnums; the fan-out's widest mask
+    covers the carries + input parts but can never name the boundary value
+    (it is not an argument)."""
+    import jax
+
+    from futuresdr_tpu.ops import FanoutPipeline
+
+    t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+    fo = FanoutPipeline([fir_stage(t1, name="p")],
+                        [[fir_stage(t1, decim=4, name="b1")], [mag2_stage()]],
+                        np.complex64, optimize=False)
+    # widest mask = carries + the ONE f32-wire input part
+    assert fo.donation_mask("f32") == (0, 1)
+    frame = 4096
+    x = np.zeros(frame, np.complex64)
+    from futuresdr_tpu.ops import get_wire
+    w = get_wire("f32")
+    # donate=False: the input carry stays usable after the call
+    fn, carry = fo.compile_wired(frame, "f32", donate=False)
+    parts = tuple(jax.device_put(np.asarray(p)) for p in w.encode_host(x))
+    c2, _ = fn(carry, *parts)
+    np.asarray(carry[0][0])            # still alive
+    # donate=(0,): the donated carries are consumed
+    fn, carry = fo.compile_wired(frame, "f32", donate=(0,))
+    parts = tuple(jax.device_put(np.asarray(p)) for p in w.encode_host(x))
+    c2, _ = fn(carry, *parts)
+    with pytest.raises(RuntimeError):
+        np.asarray(jax.tree_util.tree_leaves(carry)[0])
 
 def test_refuses_wired_retune_handler_without_static_optin():
     """A ctrl port wired to a MESSAGE EDGE refuses to fuse (live retunes are
@@ -247,7 +628,10 @@ def test_refuses_mismatched_instances():
 
 
 def test_refuses_branching_port():
-    """A member output wired to several edges (broadcast) cannot fuse."""
+    """A broadcast whose edges do NOT all open fusable device runs cannot
+    fuse — here one edge taps straight into a host sink, so the whole region
+    (including the otherwise-linear k1→k2 run) declines to the actor path
+    (all-or-nothing; a clean all-device fan-out DOES fuse since round 11)."""
     taps = firdes.lowpass(0.2, 32).astype(np.float32)
     fg = Flowgraph()
     src = VectorSource(np.zeros(8192, np.complex64))
@@ -356,3 +740,68 @@ def test_random_devchain_shapes_fuzz():
         np.testing.assert_array_equal(
             got, ref, err_msg=f"case {case}: frame={frame} groups="
                               f"{[len(g) for g in groups]}")
+
+    # fan-out shapes: random producer depth × branch count × per-branch stage
+    # mixes — every fused broadcast region must bit-equal its per-hop run
+    for case in range(3):
+        rng = np.random.default_rng(master.integers(1 << 62))
+        frame = int(rng.choice([2048, 4096]))
+        n_frames = int(rng.integers(2, 5))
+        taps = firdes.lowpass(0.3, int(rng.choice([16, 33]))).astype(
+            np.float32)
+        prod_depth = int(rng.integers(0, 3))   # 0 = H2D broadcasts directly
+        n_branches = int(rng.integers(2, 4))
+        decim = int(rng.choice([1, 2, 4]))
+
+        def branch_stages(j, rng=rng, taps=taps, decim=decim):
+            pick = int(rng.integers(0, 3))
+            if pick == 0:
+                return ([fir_stage(taps, decim=decim, fft_len=512,
+                                   name=f"bf{j}")], np.complex64)
+            if pick == 1:
+                return ([mag2_stage()], np.float32)
+            return ([rotator_stage(float(rng.uniform(-0.3, 0.3)))],
+                    np.complex64)
+
+        branch_specs = [branch_stages(j) for j in range(n_branches)]
+        n = n_frames * frame
+        data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+                ).astype(np.complex64)
+
+        def build_fanout():
+            fg = Flowgraph()
+            src = VectorSource(data)
+            h2d = TpuH2D(np.complex64, frame_size=frame)
+            fg.connect_stream(src, "out", h2d, "in")
+            prev = h2d
+            for d in range(prod_depth):
+                st = TpuStage([fir_stage(taps, fft_len=512, name=f"pp{d}")],
+                              np.complex64)
+                fg.connect_inplace(prev, "out", st, "in")
+                prev = st
+            snks = []
+            for sl, out_dt in branch_specs:
+                st = TpuStage(list(sl), np.complex64)
+                d2h = TpuD2H(out_dt)
+                snk = VectorSink(out_dt)
+                fg.connect_inplace(prev, "out", st, "in")
+                fg.connect_inplace(st, "out", d2h, "in")
+                fg.connect_stream(d2h, "out", snk, "in")
+                snks.append(snk)
+            return fg, snks
+
+        with _no_devchain():
+            fg, snks = build_fanout()
+            Runtime().run(fg)
+            refs = [s.items() for s in snks]
+        with _no_devchain(False):
+            fg, snks = build_fanout()
+            chains = find_device_chains(fg)
+            assert len(chains) == 1 and chains[0].fanout, chains
+            Runtime().run(fg)
+            for j, (s, r) in enumerate(zip(snks, refs)):
+                np.testing.assert_array_equal(
+                    s.items(), r,
+                    err_msg=f"fanout case {case} branch {j}: frame={frame} "
+                            f"prod_depth={prod_depth} "
+                            f"branches={n_branches}")

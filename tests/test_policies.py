@@ -458,3 +458,46 @@ def test_injected_work_fault_with_restart_policy(monkeypatch):
         faults.reset()
     np.testing.assert_array_equal(np.asarray(snk.items()), data)
     assert fg.wrapped(cp).restarts == 1
+
+
+# ---------------------------------------------------------------------------
+# policy surface on the control plane (REST describe, ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+def test_describe_carries_policy_decisions_and_restarts(monkeypatch):
+    """A run that RECOVERED via restart leaves its policy story readable:
+    block descriptions carry the resolved policy + restart count and the
+    flowgraph description the supervisor's decision log — the surface
+    ``GET /api/fg/{fg}/`` serves (FlowgraphError only exists for failed
+    runs; recovered runs report here)."""
+    monkeypatch.setenv("FSDR_NO_FASTCHAIN", "1")
+    data = np.arange(50_000, dtype=np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    cp = FlakyCopy(np.float32, fail_on=(1,))
+    cp.policy = BlockPolicy(on_error="restart", max_restarts=3, backoff=0.0)
+    snk = VectorSink(np.float32)
+    fg.connect(src, cp, snk)
+    Runtime().run(fg)
+    np.testing.assert_array_equal(np.asarray(snk.items()), data)
+    desc = fg.describe().to_json()
+    blk = next(b for b in desc["blocks"] if b["type_name"] == "FlakyCopy")
+    assert blk["policy"] == "restart"
+    assert blk["restarts"] == 1
+    others = [b for b in desc["blocks"] if b["type_name"] != "FlakyCopy"]
+    assert all(b["policy"] == "fail_fast" and b["restarts"] == 0
+               for b in others)
+    acts = [d for d in desc["policy_decisions"] if d["action"] == "restart"]
+    assert len(acts) == 1 and acts[0]["block"] == blk["instance_name"]
+    assert acts[0]["attempt"] == 1 and acts[0]["phase"] == "work"
+
+
+def test_describe_policy_decisions_empty_on_clean_run():
+    fg = Flowgraph()
+    src = VectorSource(np.arange(1000, dtype=np.float32))
+    snk = VectorSink(np.float32)
+    fg.connect(src, snk)
+    Runtime().run(fg)
+    desc = fg.describe().to_json()
+    assert desc["policy_decisions"] == []
+    assert all(b["restarts"] == 0 for b in desc["blocks"])
