@@ -223,18 +223,22 @@ def run_device_resident(frame_sizes=(1 << 18, 1 << 19, 1 << 20),
 
 
 def run_streamed(n_samples: int, frame_size: int, depth: int = 8,
-                 wire: str = "f32") -> float:
+                 wire: str = "f32", checkpoint_every=None) -> float:
     """TPU path through the actor runtime: host ring → TpuKernel → host ring.
     ``wire`` picks the host↔device codec (ops/wire.py) for both crossings.
     Dispatch counters of the run land in ``run_streamed.last_stats`` (the
-    devchain/megabatch dispatch-count stamps of the artifact)."""
+    devchain/megabatch dispatch-count stamps of the artifact).
+    ``checkpoint_every`` pins the carry-checkpoint cadence explicitly (the
+    --doctor recovery-overhead probe; None = kernel default, which is OFF
+    here — no restart consumer)."""
     from futuresdr_tpu.config import config
     config().buffer_size = max(config().buffer_size, 4 * frame_size * 8)
     fg = Flowgraph()
     src = NullSource(np.complex64)
     head = Head(np.complex64, n_samples)
     tk = TpuKernel(_stages(), np.complex64, frame_size=frame_size,
-                   frames_in_flight=depth, wire=wire)
+                   frames_in_flight=depth, wire=wire,
+                   checkpoint_every=checkpoint_every)
     snk = NullSink(np.float32)
     fg.connect(src, head, tk, snk)
     t0 = time.perf_counter()
@@ -668,6 +672,33 @@ def main():
               f"({doctor_extra['bottleneck_busy_frac']}), e2e p50/p99 = "
               f"{doctor_extra['e2e_latency_p50']}/"
               f"{doctor_extra['e2e_latency_p99']} s", file=sys.stderr)
+        # recovery-overhead stamp (device-plane recovery PR): the SAME
+        # fault-free streamed chain at the default carry-checkpoint cadence
+        # vs checkpointing off — perf/regress.py grades the fraction across
+        # the BENCH trajectory so a creeping snapshot cost is caught. One
+        # modest in-process run per mode (the doctor runs are diagnostic
+        # stamps, not headline medians).
+        try:
+            from futuresdr_tpu.config import config as _cfg
+            n_ck = stream_frame * 4 * args.depth
+            # explicit per-kernel cadence: checkpointing only self-arms when
+            # a restart consumer exists, which this fault-free probe lacks —
+            # the explicit knob forces the measured cost on
+            cadence = _cfg().tpu_checkpoint_every or 1
+            r_ck_on = run_streamed(n_ck, stream_frame, args.depth,
+                                   checkpoint_every=cadence)
+            r_ck_off = run_streamed(n_ck, stream_frame, args.depth,
+                                    checkpoint_every=0)
+            if r_ck_off > 0:
+                doctor_extra["checkpoint_overhead_frac"] = round(
+                    max(0.0, 1.0 - r_ck_on / r_ck_off), 4)
+                print(f"# doctor: checkpoint overhead "
+                      f"{doctor_extra['checkpoint_overhead_frac']:.1%} "
+                      f"(cadence {cadence}: {r_ck_on:.1f} vs off: "
+                      f"{r_ck_off:.1f} Msps)", file=sys.stderr)
+        except Exception as e:                          # noqa: BLE001
+            print(f"# doctor checkpoint-overhead probe failed: {e!r}",
+                  file=sys.stderr)
 
     # roofline accounting (VERDICT r3 item 7): XLA's own cost analysis of the
     # fused program turns the rate into an auditable efficiency claim; mfu is

@@ -62,6 +62,11 @@ class Config:
     block_max_restarts: int = 3            # restart budget per block
     block_backoff: float = 0.05            # restart backoff base, seconds
     #   (exponential per attempt, capped at BlockPolicy.backoff_cap)
+    block_isolate_groups: str = ""         # isolate-group assignment spec
+    #   "block_name=group;other_block=group2": a member's failure retires the
+    #   WHOLE named subgraph (group-wide port EOS in topological order) while
+    #   independent branches finish — the config-side form of
+    #   BlockPolicy(isolate_group=...); applies to blocks with no own policy
     xfer_retries: int = 3                  # transient H2D/D2H retries per transfer
     xfer_backoff: float = 0.005            # transfer retry backoff base, seconds
     #   (jittered exponential; jitter never changes the retry COUNT)
@@ -86,6 +91,18 @@ class Config:
     #   that autotune_streamed already tuned in this process, which launches
     #   with its measured K (runtime/devchain.py). An explicit 1 pins
     #   dispatch-per-frame everywhere (latency-critical deployments).
+    tpu_checkpoint_every: int = 1          # carry-checkpoint cadence of the
+    #   device-plane recovery contract (docs/robustness.md "Device-plane
+    #   recovery"): snapshot the kernel carry every Nth dispatch group (host
+    #   copy rides the D2H lane) so a `restart` re-inits from the checkpoint
+    #   and REPLAYS the in-flight frames bit-correct instead of forfeiting
+    #   them. 1 (default) = every drained group; 0 = off (restart falls back
+    #   to fresh-carry forfeiture, billed on fsdr_frames_forfeited_total);
+    #   env FUTURESDR_TPU_CHECKPOINT_EVERY. Larger cadences trade snapshot
+    #   D2H bandwidth for a longer replay window. The cadence self-arms only
+    #   when a restart consumer exists (kernel/config restart policy, a
+    #   restartable fused devchain, or an explicit per-kernel cadence) —
+    #   fail_fast runs pay nothing.
     misc: dict = field(default_factory=dict)
 
     def get(self, key: str, default: Any = None) -> Any:

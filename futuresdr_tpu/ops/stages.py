@@ -199,6 +199,58 @@ class Pipeline:
         assert q.denominator == 1
         return int(q)
 
+    # -- carry checkpointing (the device-plane recovery contract) -------------
+    # A pipeline's streaming state is EXPLICIT carry (module docstring), which
+    # makes the whole program a pure function of (carry, frame): snapshotting
+    # the carry at frame N and replaying frames N+1… from their host staging
+    # copies reproduces an unfailed run bit-for-bit. These helpers give the
+    # kernel blocks (tpu/kernel_block.py) a pipeline-owned flatten/validate/
+    # restore surface so checkpoint integrity is checked against the carry
+    # CONTRACT (tree structure + per-leaf shape/dtype), not ad hoc.
+
+    def snapshot_carry(self, carry):
+        """Flatten a live carry into ``(host_fetches, treedef)``: one zero-arg
+        thunk per leaf that yields the host value. Device leaves begin their
+        D2H NOW (``ops/xfer.start_host_transfer`` — the snapshot rides the
+        existing D2H lane, off the dispatch critical path); host leaves pass
+        through. The caller must materialize the thunks before the next
+        dispatch donates the carry buffers (donation fence — a donated buffer
+        read after reuse raises, never silently corrupts)."""
+        import jax
+
+        from .xfer import start_host_transfer
+        leaves, treedef = jax.tree_util.tree_flatten(carry)
+        fins = [start_host_transfer(leaf, _instrument=False)
+                if isinstance(leaf, jax.Array) else (lambda v=leaf: v)
+                for leaf in leaves]
+        return fins, treedef
+
+    def carry_matches(self, leaves, treedef, template) -> bool:
+        """Integrity check of a materialized snapshot against a live carry
+        ``template`` (same pipeline, same compile): tree structure and every
+        leaf's shape/dtype must agree — the restore-path validation that lets
+        a corrupted checkpoint candidate (the ``carry`` fault site) be
+        rejected in favor of the previous one."""
+        import jax
+        t_leaves, t_def = jax.tree_util.tree_flatten(template)
+        if treedef != t_def or len(leaves) != len(t_leaves):
+            return False
+        for leaf, t in zip(leaves, t_leaves):
+            a = np.asarray(leaf)
+            if a.shape != tuple(np.shape(t)) or \
+                    a.dtype != np.dtype(getattr(t, "dtype", a.dtype)):
+                return False
+        return True
+
+    def restore_carry(self, leaves, treedef, device=None):
+        """Rebuild a device carry from a materialized host snapshot (complex
+        leaves ride the pair shim — ``ops/xfer.to_device``)."""
+        import jax
+
+        from .xfer import to_device
+        return jax.tree_util.tree_unflatten(
+            treedef, [to_device(np.asarray(l), device) for l in leaves])
+
     def update_stage(self, carries, stage, _validate_only: bool = False, **params):
         """Runtime control: apply a stage's ``update`` hook to its slot in ``carries``.
 
@@ -416,6 +468,14 @@ class FanoutPipeline:
     compile = Pipeline.compile
     compile_wired = Pipeline.compile_wired
     update_stage = Pipeline.update_stage
+    # carry checkpointing borrows too: the FLAT carries tuple (producer then
+    # branches) is an ordinary pytree, so snapshot/validate/restore of the
+    # composed fan-out carry is exactly the linear pipeline's contract — one
+    # checkpoint covers every branch's state at once (per-branch replay
+    # cursors live in the kernel's drain bookkeeping, not the carry)
+    snapshot_carry = Pipeline.snapshot_carry
+    carry_matches = Pipeline.carry_matches
+    restore_carry = Pipeline.restore_carry
 
 
 def _merge_lti(stages: Sequence[Stage], in_dtype) -> list:

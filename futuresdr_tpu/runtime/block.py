@@ -40,7 +40,7 @@ from .work_io import WorkIo
 _trace = _trace_recorder()
 
 __all__ = ["WrappedKernel", "BlockPolicy", "policy_allows_fusion",
-           "fusion_degraded"]
+           "fusion_degraded", "isolate_groups_from_config"]
 
 log = logger("runtime.block")
 
@@ -60,24 +60,46 @@ class BlockPolicy:
     * ``restart`` — re-initialize the block in place up to ``max_restarts``
       times with capped exponential backoff (``backoff * 2**(attempt-1)``,
       ≤ ``backoff_cap``); the budget covers init AND work failures. Exhausted
-      budget escalates to fail_fast.
+      budget escalates to fail_fast. A kernel exposing a ``recover()``
+      coroutine (the TPU kernels' carry checkpoint/replay,
+      ``tpu/kernel_block.py``) is offered bit-correct in-place recovery
+      first; the forfeiting deinit+init path is the fallback.
     * ``isolate`` — retire the failed block (its ports EOS, downstream drains,
       upstream detaches) and let independent branches finish; the run still
       raises a structured :class:`~.runtime.FlowgraphError` at the end.
+      ``isolate_group="name"`` widens the blast radius from one block to a
+      named SUBGRAPH: any member's failure retires every block carrying the
+      same group (group-wide port EOS in topological order — no survivor
+      waits on a half-dead branch) while unrelated branches finish. The
+      config-side assignment is ``block_isolate_groups =
+      "block_name=group;…"`` for blocks with no own policy.
 
-    Blocks carrying a non-fail_fast policy refuse fastchain/devchain fusion —
-    the fused paths cannot restart or isolate one member.
+    Blocks carrying an ``isolate``/``isolate_group`` policy refuse
+    fastchain/devchain fusion (retiring ONE member of a fused program is not
+    sound). ``restart`` members refuse the native fastchain but are accepted
+    by device-graph fusion: the fused kernel restarts from its composed-carry
+    checkpoint (``policy_allows_fusion(restartable=True)``).
     """
 
     on_error: str = "fail_fast"
     max_restarts: int = 3
     backoff: float = 0.05
     backoff_cap: float = 2.0
+    isolate_group: Optional[str] = None
 
     def __post_init__(self):
         if self.on_error not in _POLICIES:
             raise ValueError(
                 f"on_error must be one of {_POLICIES}, got {self.on_error!r}")
+        if self.isolate_group is not None:
+            if self.on_error == "fail_fast":
+                # isolate_group IS an isolate policy; spelling only the group
+                # is the ergonomic form (BlockPolicy(isolate_group="rx"))
+                object.__setattr__(self, "on_error", "isolate")
+            elif self.on_error != "isolate":
+                raise ValueError(
+                    "isolate_group requires on_error='isolate' "
+                    f"(got {self.on_error!r})")
 
     @staticmethod
     def from_config() -> "BlockPolicy":
@@ -99,22 +121,60 @@ class BlockPolicy:
                            backoff=float(c.get("block_backoff", 0.05)))
 
 
-def policy_allows_fusion(kernel) -> bool:
-    """Per-member fusion gate shared by the fastchain/devchain finders: a
-    kernel carrying a non-fail_fast policy must stay on the actor path (the
-    fused substitutes can neither restart nor isolate ONE member)."""
-    pol = getattr(kernel, "policy", None)
-    return pol is None or getattr(pol, "on_error", "fail_fast") == "fail_fast"
-
-
-def fusion_degraded(fault_sites=("work",)) -> bool:
-    """Process-global fusion degrade shared by the fastchain/devchain
-    finders: a non-fail_fast ``block_policy`` config default, or an armed
-    fault campaign on any of ``fault_sites``, keeps every block on the
-    per-hop actor path (the fused substitutes bypass per-block supervision
-    and injection points)."""
+def isolate_groups_from_config() -> dict:
+    """Parse the ``block_isolate_groups`` config spec
+    (``"block_name=group;other=group2"``; a TOML table works too) into
+    ``{instance_name: group}``. Malformed entries are logged and skipped —
+    this resolves inside block error paths (same no-raise contract as
+    :meth:`BlockPolicy.from_config`)."""
     from ..config import config
-    if str(config().get("block_policy", "fail_fast")) != "fail_fast":
+    spec = config().get("block_isolate_groups", "")
+    if isinstance(spec, dict):
+        return {str(k): str(v) for k, v in spec.items()}
+    out = {}
+    for raw in str(spec or "").replace(",", ";").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        name, sep, group = raw.partition("=")
+        if not sep or not name.strip() or not group.strip():
+            log.error("bad block_isolate_groups entry %r "
+                      "(want name=group)", raw)
+            continue
+        out[name.strip()] = group.strip()
+    return out
+
+
+def policy_allows_fusion(kernel, restartable: bool = False) -> bool:
+    """Per-member fusion gate shared by the fastchain/devchain finders.
+    ``restartable=False`` (the native fastchain): any non-fail_fast policy
+    stays on the actor path. ``restartable=True`` (device-graph fusion):
+    ``restart`` members fuse too — the fused TpuKernel checkpoints its
+    composed carry and the devchain drive loop restarts it in place
+    (``tpu/kernel_block.py`` recover contract) — while ``isolate``/
+    ``isolate_group`` members still refuse (retiring one member of a fused
+    program is not sound)."""
+    pol = getattr(kernel, "policy", None)
+    if pol is None:
+        # a config-side isolate-group assignment is an isolate policy too
+        name = getattr(getattr(kernel, "meta", None), "instance_name", None)
+        if name and name in isolate_groups_from_config():
+            return False
+    on_error = getattr(pol, "on_error", "fail_fast") if pol is not None \
+        else "fail_fast"
+    return on_error == "fail_fast" or (restartable and on_error == "restart")
+
+
+def fusion_degraded(fault_sites=("work",), allow_restart: bool = False) -> bool:
+    """Process-global fusion degrade shared by the fastchain/devchain
+    finders: a non-fail_fast ``block_policy`` config default (``restart``
+    exempted when the caller recovers fused kernels — ``allow_restart``), or
+    an armed fault campaign on any of ``fault_sites``, keeps every block on
+    the per-hop actor path (the fused substitutes bypass per-block
+    supervision and injection points)."""
+    from ..config import config
+    pol = str(config().get("block_policy", "fail_fast"))
+    if pol != "fail_fast" and not (allow_restart and pol == "restart"):
         return True
     p = _faults.plan()
     return any(p.has_site(s) for s in fault_sites)
@@ -155,13 +215,19 @@ class WrappedKernel:
     @property
     def policy(self) -> BlockPolicy:
         """The block's failure policy: the kernel's own ``policy`` attribute
-        when it is a :class:`BlockPolicy`, else the config default (resolved
-        once per WrappedKernel)."""
+        when it is a :class:`BlockPolicy`, else the config default — with the
+        ``block_isolate_groups`` config assignment applied to blocks that
+        carry no own policy (resolved once per WrappedKernel)."""
         p = self._policy
         if p is None:
             p = getattr(self.kernel, "policy", None)
             if not isinstance(p, BlockPolicy):
                 p = BlockPolicy.from_config()
+                group = isolate_groups_from_config().get(self.instance_name)
+                if group:
+                    p = BlockPolicy(on_error="isolate", isolate_group=group,
+                                    max_restarts=p.max_restarts,
+                                    backoff=p.backoff)
             self._policy = p
         return p
 
@@ -243,15 +309,40 @@ class WrappedKernel:
     async def _reinit_for_restart(self, err: Exception,
                                   fg_inbox) -> Optional[Exception]:
         """Restart the kernel in place after a work-loop error: backoff, then
+        — when the kernel exposes a ``recover()`` coroutine (the TPU kernels'
+        carry checkpoint/replay, ``tpu/kernel_block.py``) — bit-correct
+        in-place recovery first; else (or when recovery declines/fails)
         deinit (best-effort, before EVERY attempt — init need not be
-        idempotent) + init — a fresh carry/compiled state for device kernels
-        (``TpuKernel.init`` drops in-flight dispatch state). Returns None on
-        success, or the TERMINAL exception when re-init keeps failing past
-        the restart budget (the caller reports that one — the operator needs
-        the failure that actually ended the block, not the work error the
-        restarts were trying to recover from)."""
+        idempotent) + init — a fresh carry/compiled state for device kernels,
+        which FORFEITS in-flight dispatch state (billed on
+        ``fsdr_frames_forfeited_total``). Returns None on success, or the
+        TERMINAL exception when re-init keeps failing past the restart
+        budget (the caller reports that one — the operator needs the failure
+        that actually ended the block, not the work error the restarts were
+        trying to recover from)."""
         kernel = self.kernel
         await self._note_restart(err, fg_inbox, phase="work")
+        recover = getattr(kernel, "recover", None)
+        while callable(recover):
+            try:
+                if not await recover(err):
+                    break                # declined (no usable checkpoint)
+                log.info("block %s recovered in place from its carry "
+                         "checkpoint (replay)", self.instance_name)
+                return None
+            except Exception as e:                     # noqa: BLE001
+                # a fault DURING recovery (e.g. a fatal transfer failure
+                # while re-staging the replay window) consumes another
+                # restart attempt and retries — recover() is idempotent, the
+                # replay log is intact, and forfeiting here would throw away
+                # a recovery the next attempt could still complete
+                if self.restarts >= self.policy.max_restarts:
+                    log.warning("block %s checkpoint recovery failed on the "
+                                "final restart (%r): falling back to a "
+                                "fresh re-init", self.instance_name, e)
+                    break
+                await self._note_restart(e, fg_inbox, phase="work")
+                err = e
         while True:
             try:
                 await kernel.deinit(kernel.mio, kernel.meta)
@@ -305,6 +396,7 @@ class WrappedKernel:
             blocking=k.meta.blocking,
             policy=self.policy.on_error,
             restarts=self.restarts,
+            isolate_group=self.policy.isolate_group,
         )
 
     async def run(self, fg_inbox) -> None:

@@ -110,14 +110,25 @@ _trace = _trace_recorder()
 def devchain_enabled() -> bool:
     """Env gate, checked per launch (not at import) so perf probes can A/B the
     fused vs per-hop path inside one process. Fault-tolerance degrades fusion
-    too (docs/robustness.md): a process-default restart/isolate policy, or an
-    armed ``work``/``dispatch`` fault campaign, falls back to the per-hop
-    actor path — the fused chain can neither restart/isolate one member nor
-    inject at per-member sites."""
+    where (and ONLY where) fused mode would change the semantics
+    (docs/robustness.md): a process-default ``isolate`` policy or an armed
+    ``work`` / block-addressed ``dispatch:<name>`` campaign falls back to the
+    per-hop actor path — the fused chain cannot retire one member or inject
+    at per-member work sites. A process-default ``restart`` policy and bare
+    ``dispatch`` sites keep fusion ON since the carry-checkpoint/replay PR:
+    the fused kernel checkpoints its composed carry, the drive loop restarts
+    it in place (bit-correct replay), and its own ``_launch_staged`` polls
+    the bare ``dispatch`` site."""
     if os.environ.get("FSDR_NO_DEVCHAIN"):
         return False
+    from . import faults as _faults
     from .block import fusion_degraded
-    if fusion_degraded(("work", "dispatch")):
+    plan = _faults.plan()
+    if fusion_degraded(("work",), allow_restart=True) or \
+            plan.has_named_site("dispatch") or plan.has_named_site("carry"):
+        # block-ADDRESSED dispatch/carry campaigns would silently un-arm in
+        # fused mode (the fused kernel polls those sites under ITS name);
+        # bare sites stay armed and fusion stays on
         log.info("devchain: failure policy / fault injection armed — "
                  "degrading to per-hop actor mode")
         return False
@@ -184,10 +195,13 @@ def find_device_chains(fg) -> List[DevChain]:
         i_in.setdefault(id(e.dst), []).append(e)
 
     def member_ok(k) -> bool:
-        """Common per-member gate: opt-out attr, wired-ctrl refusal, and a
-        non-fail_fast failure policy (restart must re-init ONE member's
-        carry and isolate must retire ONE member — the fused kernel is all
-        members or none, so such chains stay on the per-hop actor path)."""
+        """Common per-member gate: opt-out attr, wired-ctrl refusal, and an
+        ``isolate``/``isolate_group`` failure policy (retiring ONE member of
+        a fused program is not sound — such chains stay on the per-hop actor
+        path). ``restart`` members FUSE: the fused kernel checkpoints its
+        composed carry and the drive loop restarts it in place, replaying
+        bit-correct (``policy_allows_fusion(restartable=True)``) — recovery
+        AND fusion, not one or the other."""
         if getattr(k, "devchain", True) is False:
             return False
         if id(k) in msg_touched and not getattr(k, "devchain_static", False):
@@ -195,8 +209,8 @@ def find_device_chains(fg) -> List[DevChain]:
             # expected; the fused chain is static — fastchain_static rule
             return False
         from .block import policy_allows_fusion
-        if not policy_allows_fusion(k):
-            log.debug("devchain refuses %s: non-fail_fast failure policy", k)
+        if not policy_allows_fusion(k, restartable=True):
+            log.debug("devchain refuses %s: isolate failure policy", k)
             return False
         return True
 
@@ -914,8 +928,18 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
             if isinstance(msg, Callback):
                 msg.reply.set(Pmt.invalid_value())
     member_kernels = [b.kernel for b in members]
+    # restart-capable fused chain: the first member carrying a `restart`
+    # policy (its own BlockPolicy or the config default — member_ok already
+    # refused isolate members) lends the fused kernel its restart
+    # budget/backoff and its billing identity
+    pol_member = next((b for b in members
+                       if b.policy.on_error == "restart"), None)
     try:
         fused = _build_fused(chain)
+        # arm the fused kernel's carry checkpointing when the chain can
+        # actually restart (tpu/kernel_block.py _resolve_ckpt_every — the
+        # fused kernel has no .policy of its own, the members carry it)
+        fused._dc_restartable = pol_member is not None
         # compile + warm OFF the supervisor loop: the fused kernel is a
         # BLOCKING block whose init the actor path would run on a dedicated
         # thread — compiling here inline would stall every same-loop block
@@ -991,11 +1015,42 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
         member_of_ib[id(members[i].inbox)] = i
         branch_of_ib[id(members[i].inbox)] = j
 
+    # On a work-loop fault the drive loop restarts the FUSED kernel in
+    # place: checkpoint restore + replay first (bit-correct), forfeiting
+    # fresh re-init as the fallback — the "recovery AND fusion" contract of
+    # the device-plane recovery PR.
     async def _drive():
         """The fused block event loop (WrappedKernel.run's loop, merged over
         the region's boundary inboxes)."""
         io = WorkIo()
         kernel = fused
+
+        async def _restart_fused(err):
+            """One recovery of the fused kernel per work fault, with retries
+            out of the policy member's restart budget (the actor-path
+            _reinit_for_restart contract): checkpoint restore + replay,
+            falling back to a forfeiting fresh init when recovery declines.
+            Returns None on success, else the TERMINAL exception — the one
+            that actually ended the chain, not the work error the restarts
+            were trying to recover from (same reporting contract as the
+            actor path)."""
+            while pol_member is not None and \
+                    pol_member.restarts < pol_member.policy.max_restarts:
+                await pol_member._note_restart(err, fg_inbox, phase="work")
+                try:
+                    if await kernel.recover(err):
+                        log.info("devchain %s recovered in place from its "
+                                 "composed-carry checkpoint (replay)",
+                                 kernel.meta.instance_name)
+                    else:
+                        # no usable checkpoint: fresh re-init forfeits the
+                        # in-flight window (billed) but keeps the graph alive
+                        await kernel.init(kernel.mio, kernel.meta)
+                    return None
+                except Exception as e2:                # noqa: BLE001
+                    log.warning("devchain restart attempt failed (%r)", e2)
+                    err = e2
+            return err
 
         def ctrl(idx, msg):
             res = _apply_ctrl(kernel, member_kernels, idx, msg.port, msg.data)
@@ -1042,7 +1097,14 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
                         w.cancel()
                 continue
             io.reset()
-            await kernel.work(io, kernel.mio, kernel.meta)
+            try:
+                await kernel.work(io, kernel.mio, kernel.meta)
+            except Exception as e:                     # noqa: BLE001
+                terminal = await _restart_fused(e)
+                if terminal is not None:
+                    raise terminal
+                io.reset()
+                io.call_again = True     # re-examine ports now
 
     def _drive_thread():
         # the fused kernel is BLOCKING (host syncs in the drain): a dedicated

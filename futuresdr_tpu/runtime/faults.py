@@ -21,6 +21,11 @@ site               checked by
 ``link``           also checked by BOTH transfer directions (one knob faults
                    the whole wire); the fake link's own ``fault_rate`` is the
                    other way to model a flaky wire (``set_fake_link``)
+``carry``          ``TpuKernel._note_drained`` at checkpoint COMMIT — a fire
+                   CORRUPTS the checkpoint candidate instead of raising, so
+                   the restore path's integrity check (seq + tree/shape/dtype)
+                   must reject it and fall back to the previous checkpoint
+                   (docs/robustness.md "Device-plane recovery")
 =================  ==========================================================
 
 ``work``/``dispatch``/``h2d``/``d2h`` also accept a bare site (no ``:<name>``)
@@ -38,9 +43,12 @@ per-site determinism holds whenever one thread drives the site (true for the
 transfer sites: one drain-loop thread per kernel).
 
 Fusion passes degrade when injection is armed: the native fastchain declines
-graphs while a ``work`` site is armed and device-graph fusion declines while
-``work``/``dispatch`` sites are armed (the fused paths bypass the per-block
-injection points, which would silently un-arm the campaign).
+graphs while a ``work`` site is armed, and device-graph fusion declines while
+a ``work`` site or a block-ADDRESSED ``dispatch:<name>`` site is armed (the
+fused paths bypass those per-block injection points, which would silently
+un-arm the campaign). A BARE ``dispatch`` site keeps fusion on: the fused
+kernel polls it from its own ``_launch_staged``, so the campaign reaches the
+fused dispatch path too.
 
 This module deliberately imports only config/log/telemetry so ``ops/xfer.py``
 can use it without an ops→runtime import cycle.
@@ -67,7 +75,7 @@ ENV_VAR = "FUTURESDR_TPU_FAULTS"
 
 #: documented injection sites (arbitrary site strings are allowed — these are
 #: the ones the runtime polls)
-SITES = ("work", "dispatch", "h2d", "d2h", "link")
+SITES = ("work", "dispatch", "h2d", "d2h", "link", "carry")
 
 #: sites whose faults default to TRANSIENT (retryable by ops/xfer.py)
 TRANSIENT_SITES = ("h2d", "d2h", "link")
@@ -200,6 +208,17 @@ class FaultPlan:
             return False
         prefix = plane + ":"
         return any(s == plane or s.startswith(prefix) for s in self._sites)
+
+    def has_named_site(self, plane: str) -> bool:
+        """Is a block-ADDRESSED injector (``plane:<name>``) armed? Fusion
+        passes that keep polling the bare site in fused mode (device-graph
+        fusion polls ``dispatch``/``carry`` from the fused kernel itself)
+        only need to decline when a campaign addresses one specific member —
+        the fused instance name would silently never match it."""
+        if not self._armed:
+            return False
+        prefix = plane + ":"
+        return any(s.startswith(prefix) for s in self._sites)
 
     def resolve(self, site: str, name: Optional[str] = None
                 ) -> Optional[SiteInjector]:

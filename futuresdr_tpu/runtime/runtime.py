@@ -205,6 +205,53 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
     err_blocks: List[Optional[str]] = []       # instance name per error
     decisions: List[dict] = []                 # policy actions taken
     flight_paths: List[str] = []               # CancelMsg-attached dumps
+    # isolate groups (docs/robustness.md): blocks sharing an isolate_group
+    # retire TOGETHER — one member's failure EOSes the whole named subgraph
+    # in topological order while unrelated branches finish
+    groups: Dict[str, List[WrappedKernel]] = {}
+    for b in blocks:
+        g = b.policy.isolate_group
+        if g:
+            groups.setdefault(g, []).append(b)
+    if groups:
+        ranks = _topo_ranks(fg, wk)
+        for g in groups:
+            groups[g].sort(key=lambda b: ranks.get(id(b), 0))
+    retired_groups: set = set()
+
+    def retire_group(group: str, origin: str, err) -> None:
+        """Retire every member of ``group`` after ``origin``'s failure:
+        record the GROUP verdict (one decision naming every member — the
+        flight record and `GET /api/fg/{fg}/` surface it), then EOS the
+        surviving members' ports source→sink and terminate them so no
+        survivor waits on a half-dead branch. Idempotent per group."""
+        if group in retired_groups:
+            return
+        retired_groups.add(group)
+        members_g = groups.get(group, [])
+        decisions.append({"block": origin, "action": "isolate_group",
+                          "group": group,
+                          "members": [m.instance_name for m in members_g],
+                          "error": repr(err)})
+        log.error("block %s failed (%r): isolate group %r retires %s; "
+                  "flowgraph continues", origin, err, group,
+                  [m.instance_name for m in members_g])
+        _trace.instant("runtime", "group_isolated",
+                       args={"group": group, "origin": origin,
+                             "members": [m.instance_name
+                                         for m in members_g]})
+        for m in members_g:
+            if m.instance_name == origin:
+                continue                 # its own error path EOSed already
+            m.inbox.send(Terminate())
+            try:
+                # EOS NOW, in topo order, from here: waiting for each
+                # member's own orderly shutdown would release the ports in
+                # scheduler order instead (notify_finished is idempotent —
+                # the member's shutdown repeats it harmlessly)
+                m._notify_ports_finished()
+            except Exception as e2:                    # noqa: BLE001
+                log.debug("group EOS of %s raised: %r", m.instance_name, e2)
     try:
         fused: set = set()
         chain_tasks = []
@@ -262,11 +309,16 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                 if blk is not None and blk.policy.on_error == "isolate":
                     # the block EOSed its ports before reporting (block.py
                     # init-failure path) — the rest of the graph runs on
-                    decisions.append({"block": name, "action": "isolate",
-                                      "phase": "init",
-                                      "error": repr(msg.error)})
-                    log.error("block %s failed in init (%r): isolated by "
-                              "policy, flowgraph continues", name, msg.error)
+                    if blk.policy.isolate_group:
+                        retire_group(blk.policy.isolate_group, name,
+                                     msg.error)
+                    else:
+                        decisions.append({"block": name, "action": "isolate",
+                                          "phase": "init",
+                                          "error": repr(msg.error)})
+                        log.error("block %s failed in init (%r): isolated by "
+                                  "policy, flowgraph continues", name,
+                                  msg.error)
                 else:
                     fatal_init = fatal_init or msg.error
             elif isinstance(msg, BlockDoneMsg):
@@ -368,12 +420,18 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
                     # downstream drains, upstream detaches, independent
                     # branches keep running; the error still surfaces in the
                     # final structured FlowgraphError
-                    decisions.append({"block": name, "action": "isolate",
-                                      "error": repr(msg.error)})
-                    log.error("block %s errored (%r): isolated by policy, "
-                              "flowgraph continues", name, msg.error)
-                    _trace.instant("runtime", "block_isolated",
-                                   args={"block": msg.block_id})
+                    if blk is not None and blk.policy.isolate_group:
+                        # group verdict: the whole named subgraph retires
+                        retire_group(blk.policy.isolate_group, name,
+                                     msg.error)
+                    else:
+                        decisions.append({"block": name, "action": "isolate",
+                                          "error": repr(msg.error)})
+                        log.error("block %s errored (%r): isolated by "
+                                  "policy, flowgraph continues", name,
+                                  msg.error)
+                        _trace.instant("runtime", "block_isolated",
+                                       args={"block": msg.block_id})
                 elif not terminated:
                     decisions.append(
                         {"block": name,
@@ -451,6 +509,41 @@ async def run_flowgraph_supervisor(fg: Flowgraph, scheduler: Scheduler,
         raise
     finally:
         _doc.detach(_doc_token)
+
+
+def _topo_ranks(fg: Flowgraph, wk: Dict[int, WrappedKernel]) -> Dict[int, int]:
+    """Topological rank per WrappedKernel id over the data-plane edges
+    (stream + inplace), sources first; ties keep block order, cycles fall
+    back to block order. Isolate-group retirement EOSes members in this
+    order so the cascade always releases upstream-to-downstream — no
+    survivor waits on a half-dead branch (``runtime/block.py`` isolate
+    contract, widened to subgraphs)."""
+    edges = []
+    for e in list(fg.stream_edges) + list(getattr(fg, "inplace_edges", [])):
+        if id(e.src) in wk and id(e.dst) in wk:
+            edges.append((id(wk[id(e.src)]), id(wk[id(e.dst)])))
+    indeg: Dict[int, int] = {id(b): 0 for b in wk.values()}
+    out: Dict[int, list] = {}
+    for s, d in edges:
+        indeg[d] = indeg.get(d, 0) + 1
+        out.setdefault(s, []).append(d)
+    order = [k for k, v in indeg.items() if v == 0]
+    seen = set(order)
+    i = 0
+    while i < len(order):
+        for d in out.get(order[i], ()):
+            indeg[d] -= 1
+            if indeg[d] == 0 and d not in seen:
+                order.append(d)
+                seen.add(d)
+        i += 1
+    ranks = {k: r for r, k in enumerate(order)}
+    nxt = len(order)
+    for k in indeg:                      # cycle remnants: stable tail
+        if k not in ranks:
+            ranks[k] = nxt
+            nxt += 1
+    return ranks
 
 
 def _record_restart(decisions: List[dict], by_id, msg: "BlockRestartMsg"):

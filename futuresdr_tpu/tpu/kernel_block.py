@@ -18,6 +18,24 @@ tags of its input window, their indices are rebased by the pipeline's rate contr
 (the ``blocks/dsp.py`` remap; reference ``buffer/circular.rs:37-64``), and they are
 re-emitted on the output stream when the frame's results drain — going beyond the
 reference, whose GPU staging buffers drop tags.
+
+Carry checkpoint/replay (docs/robustness.md "Device-plane recovery"): because
+the compiled program is a pure function of (carry, frame), a ``restart``-policy
+recovery does NOT have to forfeit in-flight frames. At a configurable cadence
+(``checkpoint_every``, default each dispatch group; self-armed only when a
+restart consumer exists — see ``_resolve_ckpt_every``) the kernel snapshots the
+post-dispatch carry to the host — the copy rides the existing D2H lane and is
+materialized before the next dispatch donates the buffers — and commits it once
+that group's outputs have safely drained. Every dispatch group's host STAGING
+parts (the same immutable copies the transfer-retry plane re-puts) stay in a
+bounded replay log until a committed checkpoint covers them. :meth:`recover`
+then restores the newest VALID checkpoint (seq + tree/shape/dtype integrity —
+a corrupted candidate falls back to the previous one) and replays the logged
+groups through the same program: outputs land bit-identical to an unfailed
+run, on the actor path and on fused devchains alike. Megabatch groups replay
+their exact shipped (zero-padded) stacks, so partial-batch semantics hold; a
+fan-out kernel's flat composed carry checkpoints as one tree while its
+per-branch drain cursors ride the drop-aware group metadata.
 """
 
 from __future__ import annotations
@@ -31,6 +49,7 @@ import numpy as np
 from ..log import logger
 from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
+from ..telemetry import prom as _prom
 from ..telemetry.doctor import E2E_LATENCY as _E2E_LATENCY
 from ..telemetry.spans import recorder as _trace_recorder
 from ..runtime import faults as _faults
@@ -45,6 +64,19 @@ __all__ = ["TpuKernel", "TpuFanoutKernel"]
 log = logger("tpu.kernel")
 _trace = _trace_recorder()
 
+# recovery-cost accounting (docs/observability.md): a fresh re-init drops the
+# failed incarnation's consumed-but-unemitted frames; a checkpoint restore
+# replays them from the host staging copies instead — both are billed so the
+# cost of every recovery path is auditable from /metrics
+_FORFEITED = _prom.counter(
+    "fsdr_frames_forfeited_total",
+    "in-flight frames dropped by a fresh device-kernel (re-)initialization",
+    ("block",))
+_REPLAYED = _prom.counter(
+    "fsdr_frames_replayed_total",
+    "frames replayed from host staging copies after a checkpoint restore",
+    ("block",))
+
 
 class TpuKernel(Kernel):
     BLOCKING = True
@@ -54,6 +86,7 @@ class TpuKernel(Kernel):
                  inst: Optional[TpuInstance] = None,
                  frames_in_flight: Optional[int] = None,
                  wire=None, frames_per_dispatch: Optional[int] = None,
+                 checkpoint_every: Optional[int] = None,
                  _pipeline: Optional[Pipeline] = None):
         super().__init__()
         from ..config import config
@@ -93,14 +126,18 @@ class TpuKernel(Kernel):
         # frames consumed from the ring, awaiting a full K-batch (k_batch > 1
         # only): (host frame, valid_in, tags, t_in_ns)
         self._accum: List[Tuple[np.ndarray, int, tuple, int]] = []
-        # H2D started, compute not yet dispatched: (h2d_finish, metas) with
-        # metas = one (valid_in, tags, t_in_ns) per real frame of the group;
-        # t_in_ns is the frame's ingestion stamp — the doctor's end-to-end
-        # latency histogram measures ring-exit → host-side decode per frame
-        self._staged: Deque[Tuple[object, tuple]] = deque()
-        # compute dispatched, D2H riding: (d2h_finish, out_metas) with
-        # out_metas = one (valid_out, rebased tags, t_in_ns) per real frame
-        self._inflight: Deque[Tuple[object, tuple]] = deque()
+        # H2D started, compute not yet dispatched: (h2d_finish, metas, seq,
+        # drop) with metas = one (valid_in, tags, t_in_ns) per real frame of
+        # the group; t_in_ns is the frame's ingestion stamp — the doctor's
+        # end-to-end latency histogram measures ring-exit → host-side decode
+        # per frame. seq is the dispatch-group sequence number; drop marks a
+        # replayed group whose outputs were already emitted before the fault
+        # (the replay advances the carry, the emission is suppressed)
+        self._staged: Deque[tuple] = deque()
+        # compute dispatched, D2H riding: (d2h_finish, out_metas, seq, drop)
+        # with out_metas = one (valid_out, rebased tags, t_in_ns) per frame
+        self._inflight: Deque[tuple] = deque()
+        self._init_recovery_state(checkpoint_every)
         self._e2e_hist = None         # bound at init (instance name is final)
         self._pending_out: Optional[np.ndarray] = None
         self._pending_tags: List[ItemTag] = []
@@ -117,27 +154,47 @@ class TpuKernel(Kernel):
             "frame_size": self.frame_size,
             "wire": self.wire.name,
             "frames_per_dispatch": self.k_batch,
-            "frames_staged": sum(len(m) for _, m in self._staged)
+            "frames_staged": sum(len(m) for _, m, _, _ in self._staged)
             + len(self._accum),
-            "frames_in_flight": sum(len(m) for _, m in self._inflight),
+            "frames_in_flight": sum(len(m) for _, m, _, _ in self._inflight),
             "frames_dispatched": self._frames_dispatched,
             "dispatches": self._dispatches,
+            "checkpoint_every": self._ckpt_every,
+            "checkpoint_seq": self._ckpts[-1][0] if self._ckpts else -1,
+            "replay_log_frames": sum(len(m) for _, _, m in self._rlog),
         }
 
     async def init(self, mio, meta):
         import jax
-        # restart contract (runtime/block.py BlockPolicy): a re-init after a
-        # work-loop failure drops every trace of the failed incarnation —
-        # staged/in-flight dispatch groups, accumulated megabatch frames,
-        # pending host output — and recompiles a FRESH carry below. In-flight
-        # frames are forfeited (their input was already consumed), which is
-        # why device-plane faults prefer transfer retry or fail_fast/isolate
-        # (docs/robustness.md policy matrix).
+        # fresh-incarnation contract: init drops every trace of a previous
+        # incarnation — staged/in-flight dispatch groups, accumulated
+        # megabatch frames, pending host output — and recompiles a FRESH
+        # carry below. Dropped frames are billed (their input was already
+        # consumed; fsdr_frames_forfeited_total). The RECOVERY path under a
+        # `restart` policy goes through :meth:`recover` instead, which
+        # restores the last committed checkpoint and replays the logged
+        # groups bit-correct; init is only the fallback when no usable
+        # checkpoint exists (checkpoint_every=0, or every candidate invalid).
+        # drop-flagged replayed groups are excluded everywhere: their outputs
+        # were already emitted, so losing them forfeits nothing
+        forfeit = len(self._accum) \
+            + sum(len(m) for _, m, _, d in self._staged if not d) \
+            + sum(len(m) for _, m, _, d in self._inflight if not d) \
+            + sum(len(m) for _, _, m, d in self._replay_queue if not d)
+        if forfeit:
+            if self._forfeit_ctr is None:
+                self._forfeit_ctr = _FORFEITED.labels(
+                    block=self.meta.instance_name or type(self).__name__)
+            self._forfeit_ctr.inc(forfeit)
+            log.warning("%s: fresh re-init forfeits %d in-flight frame(s)",
+                        self.meta.instance_name, forfeit)
         self._accum.clear()
         self._staged.clear()
         self._inflight.clear()
         self._pending_out = None
         self._pending_tags = []
+        self._recovery_reset()
+        self._ckpt_every = self._resolve_ckpt_every()
         self._e2e_hist = _E2E_LATENCY.labels(
             source=self.meta.instance_name or "TpuKernel")
         self._compiled, self._carry = self.pipeline.compile_wired(
@@ -158,6 +215,11 @@ class TpuKernel(Kernel):
         _, self._carry = self.pipeline.compile_wired(
             self.frame_size, self.wire, device=self.inst.device,
             k=self.k_batch)
+        if self._ckpt_every:
+            # fresh-init sentinel: "restore = recompile the init carry" — a
+            # fault before the first committed checkpoint replays from the
+            # very first group (the log holds everything until a commit)
+            self._ckpts.append((-1, None, None))
 
     @message_handler(name="ctrl")
     async def ctrl_handler(self, io, mio, meta, p: Pmt) -> Pmt:
@@ -199,12 +261,41 @@ class TpuKernel(Kernel):
                 _trace.complete("tpu", "encode", t0,
                                 args={"wire": self.wire.name,
                                       "items": len(frame)})
-            self._staged.append((xfer.start_device_transfer_parts(
-                parts, self.inst.device), ((valid_in, tuple(tags), t_in),)))
+            self._stage_group(parts, ((valid_in, tuple(tags), t_in),))
             return
         self._accum.append((frame, valid_in, tuple(tags), t_in))
         if len(self._accum) >= self.k_batch:
             self._flush_accum()
+
+    def _stage_group(self, parts: tuple, metas: tuple) -> None:
+        """Start one dispatch group's H2D, then assign its sequence number
+        and log it for replay. The log entry is created only AFTER the start
+        succeeds: a fatally-failed start leaves the group's input in its
+        previous retention (the ring for ``k==1`` — consume() runs after
+        ``_stage`` returns — or ``_accum``, restored by ``_flush_accum``), so
+        logging it too would make a later replay process it twice."""
+        fin = xfer.start_device_transfer_parts(parts, self.inst.device)
+        seq = self._seq
+        self._seq = seq + 1
+        if self._ckpt_every:
+            self._rlog.append((seq, parts, metas))
+            # leak guard: commits normally prune the log, but PERSISTENT
+            # snapshot failures would grow it without bound (commits never
+            # advance past the init sentinel). Past several windows' worth,
+            # drop the head — recovery then declines non-contiguous
+            # checkpoints and falls back to the billed forfeiting re-init
+            # instead of the process leaking until OOM.
+            cap = 64 + 4 * (self.depth + self.stage_ahead + self._ckpt_every)
+            if len(self._rlog) > cap:
+                self._rlog.popleft()
+                self._rlog_dropped += 1
+                if self._rlog_dropped == 1:
+                    log.warning(
+                        "%s: replay log exceeded %d groups (checkpoints not "
+                        "committing?) — dropping oldest; a restart may now "
+                        "forfeit instead of replaying",
+                        self.meta.instance_name, cap)
+        self._staged.append((fin, metas, seq, False))
 
     def _flush_accum(self) -> None:
         """Encode the accumulated frames, stack each wire part along a leading
@@ -229,8 +320,16 @@ class TpuKernel(Kernel):
                                   "items": len(group) * self.frame_size,
                                   "frames": len(group)})
         metas = tuple((v, t, tin) for _, v, t, tin in group)
-        self._staged.append((xfer.start_device_transfer_parts(
-            stacked, self.inst.device), metas))
+        # the stacked (zero-padded) parts are what the replay log retains, so
+        # a replayed partial EOS batch re-ships the exact same scan payload.
+        # A fatally-failed start restores the group to _accum: its frames
+        # already left the ring, and only _accum (or the replay log, which
+        # only admits started groups) may retain them.
+        try:
+            self._stage_group(stacked, metas)
+        except Exception:
+            self._accum = group + self._accum
+            raise
 
     def _start_result_d2h(self, y_parts, metas) -> tuple:
         """Start the D2H of one dispatch group's results and build its
@@ -263,11 +362,14 @@ class TpuKernel(Kernel):
         while self._staged and len(self._inflight) < self.depth:
             if fplan.armed():
                 # `dispatch` site (runtime/faults.py): fault BEFORE the group
-                # leaves the staging deque, so fail_fast/isolate forfeit a
-                # deterministic amount of in-flight work
+                # leaves the staging deque, so recovery replays (or
+                # fail_fast/isolate forfeit) a deterministic amount of work
                 fplan.maybe("dispatch", self.meta.instance_name)
-            h2d, metas = self._staged.popleft()
+            h2d, metas, seq, drop = self._staged.popleft()
             x_parts = h2d()
+            # donation fence: the snapshot D2H of the previous carry must be
+            # host-side before this dispatch donates and reuses its buffers
+            self._materialize_pending_ckpts()
             t0 = _trace.now() if _trace.enabled else 0
             self._carry, y_parts = self._compiled(self._carry, *x_parts)
             if t0:
@@ -277,14 +379,21 @@ class TpuKernel(Kernel):
                 _trace.complete("tpu", "compute", t0,
                                 args={"frame": self.frame_size,
                                       "frames": len(metas)})
-            self._inflight.append(self._start_result_d2h(y_parts, metas))
+            self._inflight.append(
+                self._start_result_d2h(y_parts, metas) + (seq, drop))
+            self._checkpoint_tick(seq)
             self._frames_dispatched += len(metas)
             self._dispatches += 1
 
-    def _drain_one(self) -> Tuple[np.ndarray, list]:
-        finish, out_metas = self._inflight.popleft()
+    def _drain_one(self) -> Optional[Tuple[np.ndarray, list]]:
+        finish, out_metas, seq, drop = self._inflight.popleft()
         # sync point: blocks only this block's thread
         raw = finish()
+        if drop:
+            # replayed group whose outputs were emitted before the fault: the
+            # replay only re-advanced the carry — suppress the duplicate
+            self._note_drained(seq)
+            return None
         t0 = _trace.now() if _trace.enabled else 0
         if self.k_batch == 1:
             ((valid, tags, t_in),) = out_metas
@@ -314,7 +423,254 @@ class TpuKernel(Kernel):
         if t0:
             _trace.complete("tpu", "decode", t0, end_ns=end,
                             args={"wire": self.wire.name, "items": len(result)})
+        # mark drained only AFTER the decode succeeded: a fault inside the
+        # decode/rebase window must replay this group WITH its outputs, not
+        # drop them as already-emitted
+        self._note_drained(seq)
         return result, all_tags
+
+    # -- carry checkpoint/replay (docs/robustness.md "Device-plane recovery") --
+    def _init_recovery_state(self, checkpoint_every) -> None:
+        """Checkpoint/replay state (module docstring), shared by TpuKernel and
+        TpuFanoutKernel construction — ONE definition of the recovery-state
+        invariants (cadence clamp, 2-deep checkpoint ring)."""
+        from ..config import config
+        # configured cadence: snapshot every Nth dispatch group; 0 disables
+        # checkpointing entirely (restart falls back to fresh-carry
+        # forfeiture) and MUST be free on the dispatch path (the telemetry
+        # overhead gate covers it)
+        self._ckpt_cadence = max(0, int(
+            checkpoint_every if checkpoint_every is not None
+            else config().tpu_checkpoint_every))
+        self._ckpt_explicit = checkpoint_every is not None
+        # ACTIVE cadence, re-resolved at init(): only a restart consumer (a
+        # restart policy on this kernel / the config default / a restartable
+        # fused chain) or an explicit per-kernel cadence can ever read a
+        # checkpoint, so default fail_fast runs skip the snapshot D2H and
+        # the replay-log staging retention entirely
+        self._ckpt_every = self._ckpt_cadence if self._ckpt_explicit else 0
+        self._seq = 0                    # next dispatch-group sequence number
+        self._drained_seq = -1           # newest group whose outputs drained
+        # replay log: (seq, host wire parts, metas) per un-covered dispatch
+        # group — the parts are the idempotent host STAGING copies the
+        # transfer-retry plane already relies on (no extra copy)
+        self._rlog: Deque[tuple] = deque()
+        # committed checkpoints (seq, host leaves | None, treedef | None),
+        # newest last; ring of 2 so a corrupted candidate can fall back to
+        # the previous one. (seq=-1, None, None) is the fresh-init sentinel.
+        self._ckpts: Deque[tuple] = deque(maxlen=2)
+        # snapshots taken at dispatch, not yet committed: (seq, payload,
+        # treedef) — payload entries are host-fetch thunks until the donation
+        # fence materializes them, host leaves afterwards
+        self._pending_ckpts: Deque[tuple] = deque()
+        # groups queued by recover() awaiting re-staging: (seq, parts, metas,
+        # drop). Drained into _staged under the NORMAL depth budget by
+        # _stage_available_input — re-uploading the whole replay window at
+        # once would burst device memory past what the budget bounds
+        self._replay_queue: Deque[tuple] = deque()
+        self._rlog_dropped = 0           # leak-guard drops (see _stage_group)
+        self._forfeit_ctr = None
+        self._replay_ctr = None
+
+    def _resolve_ckpt_every(self) -> int:
+        """The cadence this incarnation runs at: the configured cadence when
+        a recovery consumer exists, else 0 (checkpointing is pure cost when
+        nothing can ever call :meth:`recover`)."""
+        if not self._ckpt_cadence:
+            return 0
+        if self._ckpt_explicit or getattr(self, "_dc_restartable", False):
+            return self._ckpt_cadence
+        pol = getattr(self, "policy", None)
+        if getattr(pol, "on_error", None) == "restart":
+            return self._ckpt_cadence
+        from ..config import config
+        if str(config().get("block_policy", "fail_fast")) == "restart":
+            return self._ckpt_cadence
+        return 0
+
+    def _checkpoint_tick(self, seq: int) -> None:
+        """Per-dispatch checkpoint hook. With ``checkpoint_every=0`` this is
+        ONE falsy-int check and a return — the telemetry overhead gate holds
+        checkpointing-off to the same ≤3% budget as the disabled span hooks."""
+        if not self._ckpt_every:
+            return
+        if (seq + 1) % self._ckpt_every == 0:
+            self._start_ckpt(seq)
+
+    def _start_ckpt(self, seq: int) -> None:
+        """Snapshot the post-dispatch carry (= the restore point for replaying
+        groups > ``seq``): the host copies start NOW and ride the D2H lane
+        with the result transfers; commit waits until group ``seq``'s outputs
+        have drained (a checkpoint must never skip outputs that were lost
+        with the failed incarnation). A snapshot failure only narrows the
+        restore window — it must not fail the dispatch path."""
+        try:
+            fins, treedef = self.pipeline.snapshot_carry(self._carry)
+        except Exception as e:                         # noqa: BLE001
+            log.warning("%s: carry snapshot @%d failed (%r) — skipped",
+                        self.meta.instance_name, seq, e)
+            return
+        self._pending_ckpts.append((seq, fins, treedef))
+
+    def _materialize_snapshot(self, seq: int, payload) -> Optional[list]:
+        """Turn one snapshot payload's fetch thunks into host leaves; None
+        (logged) on failure — a dropped snapshot only narrows the restore
+        window. The ONE materialization/error-handling implementation shared
+        by the donation fence and the commit loop."""
+        try:
+            return [p() if callable(p) else p for p in payload]
+        except Exception as e:                         # noqa: BLE001
+            log.warning("%s: carry snapshot @%d dropped (%r)",
+                        self.meta.instance_name, seq, e)
+            return None
+
+    def _materialize_pending_ckpts(self) -> None:
+        """Donation fence: turn pending snapshot thunks into host leaves
+        before the next dispatch donates (and reuses) the carry buffers a
+        thunk would still read. Runs at most once per cadence interval."""
+        if not self._pending_ckpts:
+            return
+        keep: Deque[tuple] = deque()
+        for seq, payload, treedef in self._pending_ckpts:
+            payload = self._materialize_snapshot(seq, payload)
+            if payload is not None:
+                keep.append((seq, payload, treedef))
+        self._pending_ckpts = keep
+
+    def _note_drained(self, seq: int) -> None:
+        """Group ``seq``'s outputs are host-side: advance the drain cursor,
+        commit every snapshot it covers, and prune the replay log back to the
+        PREVIOUS committed checkpoint (kept so a corrupted newest candidate
+        can still fall back and replay from the older restore point)."""
+        if seq > self._drained_seq:
+            self._drained_seq = seq
+        if not self._ckpt_every:
+            return
+        fplan = _faults.plan()
+        while self._pending_ckpts and self._pending_ckpts[0][0] <= seq:
+            s, payload, treedef = self._pending_ckpts.popleft()
+            leaves = self._materialize_snapshot(s, payload)
+            if leaves is None:
+                continue
+            if fplan.armed():
+                try:
+                    # `carry` site (runtime/faults.py): corrupt this
+                    # checkpoint CANDIDATE — the restore-path integrity check
+                    # must reject it and fall back to the previous checkpoint
+                    fplan.maybe("carry", self.meta.instance_name)
+                except _faults.InjectedFault as e:
+                    log.warning("%s: checkpoint @%d corrupted by injected "
+                                "fault (%r)", self.meta.instance_name, s, e)
+                    leaves = [np.zeros(int(np.size(l)) + 1, np.uint8)
+                              for l in leaves] or [np.zeros(1, np.uint8)]
+            if self._ckpts and self._ckpts[-1][0] >= s:
+                continue                 # replay re-commit of a covered seq
+            self._ckpts.append((s, leaves, treedef))
+            if len(self._ckpts) >= 2:
+                floor = self._ckpts[0][0]
+                while self._rlog and self._rlog[0][0] <= floor:
+                    self._rlog.popleft()
+
+    def _recovery_reset(self) -> None:
+        """Drop every checkpoint/replay artifact (fresh incarnation, or a
+        cleanly finished stream — a later re-run must not replay stale
+        groups into a new flowgraph's buffers)."""
+        self._seq = 0
+        self._drained_seq = -1
+        self._rlog.clear()
+        self._ckpts.clear()
+        self._pending_ckpts.clear()
+        self._replay_queue.clear()
+
+    def _restore_candidates(self):
+        """Committed checkpoints newest-first, each validated lazily by
+        :meth:`recover`."""
+        return reversed(list(self._ckpts))
+
+    async def recover(self, err) -> bool:
+        """Restart recovery WITHOUT forfeiting in-flight work: restore the
+        newest VALID committed checkpoint and re-stage every logged dispatch
+        group after it from its host staging parts — the replayed program is
+        a pure function of (carry, frame), so outputs land bit-identical to
+        an unfailed run. Returns False (caller falls back to the forfeiting
+        fresh re-init) when checkpointing is off or no candidate passes the
+        integrity check. Called by the restart machinery
+        (``runtime/block.py _reinit_for_restart``, the devchain drive loop);
+        host-side state (_accum frames, pending output) is deliberately
+        untouched — it was never lost."""
+        if not self._ckpt_every or not self._ckpts:
+            return False
+        # integrity template: the pipeline's OWN fresh carry for this compile
+        # (cached jit — no recompilation); also re-resolves self._compiled if
+        # the failed incarnation never finished init
+        self._compiled, fresh = self.pipeline.compile_wired(
+            self.frame_size, self.wire, device=self.inst.device,
+            k=self.k_batch)
+        chosen = None
+        invalid: set = set()
+        for seq, leaves, treedef in self._restore_candidates():
+            if leaves is None:           # fresh-init sentinel (seq == -1)
+                if not self._rlog or self._rlog[0][0] == 0:
+                    chosen = (seq, None, None)
+                    break
+                log.warning("%s: init-sentinel checkpoint unusable (replay "
+                            "log starts at %d)", self.meta.instance_name,
+                            self._rlog[0][0])
+                invalid.add(seq)
+                continue
+            if not self.pipeline.carry_matches(leaves, treedef, fresh):
+                log.warning("%s: checkpoint @%d failed integrity check "
+                            "(seq/shape/dtype) — falling back to the "
+                            "previous checkpoint", self.meta.instance_name,
+                            seq)
+                invalid.add(seq)
+                continue
+            if self._rlog and self._rlog[0][0] > seq + 1:
+                log.warning("%s: checkpoint @%d not contiguous with the "
+                            "replay log (starts at %d)",
+                            self.meta.instance_name, seq, self._rlog[0][0])
+                invalid.add(seq)
+                continue
+            chosen = (seq, leaves, treedef)
+            break
+        if invalid:
+            # evict failed candidates so a corrupted entry can never become
+            # a later recovery's fallback
+            self._ckpts = deque((c for c in self._ckpts
+                                 if c[0] not in invalid), maxlen=2)
+        if chosen is None:
+            return False
+        seq, leaves, treedef = chosen
+        self._carry = fresh if leaves is None else \
+            self.pipeline.restore_carry(leaves, treedef, self.inst.device)
+        # rebuild the dispatch window purely from the log: every group after
+        # the checkpoint re-ships its exact staging parts; groups that had
+        # already drained only re-advance the carry (drop=True). QUEUED, not
+        # uploaded: _stage_available_input re-stages them under the normal
+        # depth budget, so a long replay window (sparse cadence) cannot
+        # burst device memory past what steady state is sized for.
+        self._staged.clear()
+        self._inflight.clear()
+        self._pending_ckpts.clear()
+        self._replay_queue.clear()
+        replayed = 0
+        for s, parts, metas in self._rlog:
+            if s <= seq:
+                continue
+            self._replay_queue.append((s, parts, metas,
+                                       s <= self._drained_seq))
+            replayed += len(metas)
+        if replayed:
+            if self._replay_ctr is None:
+                self._replay_ctr = _REPLAYED.labels(
+                    block=self.meta.instance_name or type(self).__name__)
+            self._replay_ctr.inc(replayed)
+        log.info("%s: restored carry checkpoint @%d, replaying %d frame(s) "
+                 "after %r", self.meta.instance_name, seq, replayed, err)
+        _trace.instant("tpu", "checkpoint_restore",
+                       args={"block": self.meta.instance_name,
+                             "checkpoint_seq": seq, "replayed": replayed})
+        return True
 
     def _stage_available_input(self):
         """Step 2 of the work loop, shared with the fan-out kernel: stage as
@@ -325,8 +681,19 @@ class TpuKernel(Kernel):
         handing it a live ring-buffer view would race with the writer
         overwriting consumed space — the frame must leave the ring before
         consume(). Returns ``(remaining input slice, eos)``."""
-        inp = self.input.slice()
         budget = self.depth + self.stage_ahead
+        # replayed groups re-enter the dispatch window FIRST (sequence
+        # order), under the same budget as live staging
+        while self._replay_queue and \
+                len(self._staged) + len(self._inflight) < budget:
+            s, parts, metas, drop = self._replay_queue.popleft()
+            self._staged.append((xfer.start_device_transfer_parts(
+                parts, self.inst.device), metas, s, drop))
+        if self._replay_queue:
+            # the window is full of replays; no NEW input may be staged
+            # before they re-enter (their sequence numbers precede it)
+            return self.input.slice(), self.input.finished()
+        inp = self.input.slice()
         while len(self._staged) + len(self._inflight) < budget and \
                 len(inp) >= self.frame_size:
             tags = self.input.tags(self.frame_size)
@@ -382,16 +749,23 @@ class TpuKernel(Kernel):
         should_drain = bool(self._inflight) and (
             len(self._inflight) >= self.depth or len(inp) < self.frame_size or eos)
         if should_drain:
-            result, tags = self._drain_one()
-            self._pending_out, self._pending_tags = emit_with_tags(
-                self.output, result, tags)
+            drained = self._drain_one()
+            if drained is not None:      # None = replayed already-emitted group
+                result, tags = drained
+                self._pending_out, self._pending_tags = emit_with_tags(
+                    self.output, result, tags)
             io.call_again = True
             return
 
         if eos and not self._inflight and not self._staged and \
-                not self._accum and self._pending_out is None and len(inp) == 0:
+                not self._accum and not self._replay_queue and \
+                self._pending_out is None and len(inp) == 0:
             io.finished = True
-        elif eos and (self._inflight or self._staged or self._accum):
+            # stream cleanly finished: a later re-run of this kernel must
+            # start from a fresh carry, never replay this stream's tail
+            self._recovery_reset()
+        elif eos and (self._inflight or self._staged or self._accum
+                      or self._replay_queue):
             io.call_again = True
 
 
@@ -432,7 +806,8 @@ class TpuFanoutKernel(TpuKernel):
     def __init__(self, fanout, frame_size: Optional[int] = None,
                  inst: Optional[TpuInstance] = None,
                  frames_in_flight: Optional[int] = None,
-                 wire=None, frames_per_dispatch: Optional[int] = None):
+                 wire=None, frames_per_dispatch: Optional[int] = None,
+                 checkpoint_every: Optional[int] = None):
         from ..runtime.kernel import Kernel
         Kernel.__init__(self)
         from ..config import config
@@ -460,6 +835,10 @@ class TpuFanoutKernel(TpuKernel):
         self._e2e_hist = None
         self._frames_dispatched = 0
         self._dispatches = 0
+        # checkpoint/replay state — the FLAT composed carry (producer +
+        # branches) snapshots as one tree, so one checkpoint covers every
+        # branch; per-branch replay cursors ride each group's drop flag
+        self._init_recovery_state(checkpoint_every)
         nb = fanout.n_branches
         self._pendings: List[Optional[np.ndarray]] = [None] * nb
         self._pending_tags_n: List[List[ItemTag]] = [[] for _ in range(nb)]
@@ -525,13 +904,17 @@ class TpuFanoutKernel(TpuKernel):
             out_metas.append((tuple(per_branch), t_in))
         return (finish, tuple(out_metas))
 
-    def _drain_one(self) -> List[Tuple[np.ndarray, list]]:
+    def _drain_one(self) -> Optional[List[Tuple[np.ndarray, list]]]:
         """Land the oldest dispatch group; returns one ``(result, tags)`` per
         BRANCH (megabatch groups concatenate their frames per branch, tag
-        indices rebased by the branch's running offset)."""
+        indices rebased by the branch's running offset), or None for a
+        replayed group every branch already emitted."""
         fo = self.pipeline
-        finish, out_metas = self._inflight.popleft()
+        finish, out_metas, seq, drop = self._inflight.popleft()
         raw = finish()                       # flat: branch parts in order
+        if drop:
+            self._note_drained(seq)
+            return None
         t0 = _trace.now() if _trace.enabled else 0
         nb = fo.n_branches
         results: List[Tuple[np.ndarray, list]] = []
@@ -581,6 +964,8 @@ class TpuFanoutKernel(TpuKernel):
                             args={"wire": self.wire.name,
                                   "items": sum(len(r) for r, _ in results),
                                   "branches": nb})
+        # drained only after every branch decoded (the base-class contract)
+        self._note_drained(seq)
         return results
 
     async def work(self, io, mio, meta):
@@ -613,7 +998,8 @@ class TpuFanoutKernel(TpuKernel):
             len(self._inflight) >= self.depth or len(inp) < self.frame_size
             or eos)
         if should_drain:
-            for j, (result, tags) in enumerate(self._drain_one()):
+            drained = self._drain_one()
+            for j, (result, tags) in enumerate(drained or ()):
                 if self._branch_done[j]:
                     continue                 # retired reader: drop its frames
                 self._pendings[j], self._pending_tags_n[j] = emit_with_tags(
@@ -622,8 +1008,11 @@ class TpuFanoutKernel(TpuKernel):
             return
 
         if eos and not self._inflight and not self._staged and \
-                not self._accum and all(p is None for p in self._pendings) \
+                not self._accum and not self._replay_queue \
+                and all(p is None for p in self._pendings) \
                 and len(inp) == 0:
             io.finished = True
-        elif eos and (self._inflight or self._staged or self._accum):
+            self._recovery_reset()           # same clean-EOS contract as base
+        elif eos and (self._inflight or self._staged or self._accum
+                      or self._replay_queue):
             io.call_again = True

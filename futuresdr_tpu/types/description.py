@@ -7,7 +7,7 @@ Reference: ``crates/types/src/description.rs:12-46`` (``FlowgraphDescription``,
 from __future__ import annotations
 
 from dataclasses import dataclass, field, asdict
-from typing import List
+from typing import List, Optional
 
 __all__ = ["BlockDescription", "FlowgraphDescription"]
 
@@ -28,6 +28,9 @@ class BlockDescription:
     # scraping /metrics
     policy: str = "fail_fast"
     restarts: int = 0
+    # isolate-group membership (docs/robustness.md): a member's failure
+    # retires the whole named subgraph — None when the block has no group
+    isolate_group: Optional[str] = None
 
     def to_json(self):
         return asdict(self)

@@ -16,18 +16,23 @@ robustness invariants on EVERY run:
       block's metrics() answers, and no input ring still holds data unless
       the run errored.
 
-Scenario × policy compatibility (docs/robustness.md policy matrix): restart
-recovery is only *bit-correct* for faults that fire before ``work()``
-consumes input — exactly what the ``work:<block>`` site guarantees — so the
-campaign pairs restart with work faults, pairs transfer faults (h2d/d2h/link)
-with the retry plane (bit-correct by idempotent re-encode), and pairs
-dispatch faults with fail_fast/isolate (in-flight frames are forfeited, so
-the only honest outcomes are a structured error or isolation).
+Scenario × policy compatibility (docs/robustness.md policy matrix): host
+blocks pair restart with work faults (fire before ``work()`` consumes input —
+bit-correct by construction); transfer faults (h2d/d2h/link) ride the retry
+plane (bit-correct by idempotent re-encode); device-plane ``dispatch`` faults
+pair with fail_fast (honest structured error) OR, since the device-plane
+recovery PR, with restart — the kernel's carry checkpoint/replay restores
+the last committed checkpoint and replays the in-flight window from host
+staging copies, so the recovered output is bit-identical too.
 
-``--smoke`` (the check.sh gate) runs the five named scenarios plus a short
-randomized campaign at a fixed seed on the CPU backend.  ``--trials N
---seed S`` runs a longer randomized campaign.  Exit code 0 = every invariant
-held.
+``--smoke`` (the check.sh gate) runs the named scenarios — including
+``stateful-restart-replay`` (a carry-bearing device chain with a mid-stream
+dispatch fault recovers BIT-IDENTICAL to the fault-free run via carry
+checkpoint/replay, docs/robustness.md "Device-plane recovery") and
+``isolate-group`` (one member's death retires the whole named subgraph while
+the sibling branch finishes) — plus a short randomized campaign at a fixed
+seed on the CPU backend.  ``--trials N --seed S`` runs a longer randomized
+campaign.  Exit code 0 = every invariant held.
 """
 
 import argparse
@@ -285,6 +290,105 @@ def scenario_transfer_retry_deterministic():
         xfer.set_fake_link()
 
 
+def scenario_stateful_restart_replay():
+    """Acceptance (device-plane recovery): a CARRY-BEARING device chain
+    (FIR history + rotator phase) with `restart` policy and a seeded
+    mid-stream `dispatch` fault produces output BIT-IDENTICAL to the
+    fault-free run — the checkpoint/replay contract, not the old
+    forfeit-in-flight behavior."""
+    from futuresdr_tpu import BlockPolicy, Flowgraph
+    from futuresdr_tpu.blocks import VectorSink, VectorSource
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import fir_stage, rotator_stage
+    from futuresdr_tpu.runtime import faults
+    from futuresdr_tpu.tpu import TpuKernel
+    frame = 1 << 11
+    n = frame * 21 + 517                 # partial tail frame too
+    rng = np.random.default_rng(7)
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)) \
+        .astype(np.complex64)
+    taps = firdes.lowpass(0.2, 31).astype(np.float32)
+
+    def one_run(fault: bool):
+        out = {}
+
+        def build():
+            fg = Flowgraph()
+            tk = TpuKernel([fir_stage(taps, fft_len=256),
+                            rotator_stage(0.05)], np.complex64,
+                           frame_size=frame, frames_in_flight=2)
+            tk.policy = BlockPolicy(on_error="restart", max_restarts=3,
+                                    backoff=0.002)
+            snk = VectorSink(np.complex64)
+            fg.connect(VectorSource(data), tk, snk)
+            name = fg.wrapped(tk).instance_name
+            plan = faults.reset()
+            if fault:
+                # rate 0.12 @ seed 9 fires MID-STREAM (a committed
+                # checkpoint exists, frames are in flight)
+                plan.arm(f"dispatch:{name}", rate=0.12, max_faults=1,
+                         seed=9, transient=False)
+
+            def check(error):
+                assert error is None, repr(error)
+                out["got"] = np.asarray(snk.items())
+                out["restarts"] = fg.wrapped(tk).restarts
+            return fg, check
+
+        try:
+            _run_trial(build, f"stateful_restart_replay(fault={fault})",
+                       expect="ok")
+        finally:
+            faults.reset()
+        return out
+
+    clean = one_run(fault=False)
+    faulted = one_run(fault=True)
+    assert faulted["restarts"] >= 1, "the dispatch fault did not fire"
+    np.testing.assert_array_equal(faulted["got"], clean["got"])
+
+
+def scenario_isolate_group():
+    """Acceptance (isolate groups): one member of a named 3-block subgraph
+    dies → the WHOLE group retires (topo-order port EOS, clean drain), the
+    sibling branch finishes bit-correct, and the structured error carries
+    the group verdict naming every member."""
+    from futuresdr_tpu import BlockPolicy, Flowgraph
+    from futuresdr_tpu.blocks import Copy, VectorSink, VectorSource
+    from futuresdr_tpu.runtime import faults
+    data = np.arange(120_000, dtype=np.float32)
+
+    def build():
+        fg = Flowgraph()
+        snk_a = VectorSink(np.float32)
+        fg.connect(VectorSource(data), Copy(np.float32), snk_a)
+        g1, g2, g3 = (Copy(np.float32) for _ in range(3))
+        for g in (g1, g2, g3):
+            g.policy = BlockPolicy(isolate_group="rx-branch")
+        snk_b = VectorSink(np.float32)
+        fg.connect(VectorSource(np.zeros(200_000, np.float32)),
+                   g1, g2, g3, snk_b)
+        name = fg.wrapped(g2).instance_name
+        members = [fg.wrapped(g).instance_name for g in (g1, g2, g3)]
+        faults.reset().arm(f"work:{name}", rate=1.0, max_faults=1, seed=5)
+
+        def check(error):
+            assert error is not None
+            np.testing.assert_array_equal(np.asarray(snk_a.items()), data)
+            dec = [d for d in error.policy_decisions
+                   if d["action"] == "isolate_group"]
+            assert len(dec) == 1, error.policy_decisions
+            assert dec[0]["group"] == "rx-branch"
+            assert dec[0]["block"] == name
+            assert dec[0]["members"] == members
+        return fg, check
+
+    try:
+        _run_trial(build, "isolate_group", expect="error")
+    finally:
+        faults.reset()
+
+
 def scenario_deadline_bounds_wedge():
     """Acceptance: a wedged sink + run deadline → structured FlowgraphError
     within deadline+grace instead of an indefinite hang."""
@@ -378,20 +482,27 @@ def _random_trial(rng: random.Random, idx: int):
             faults.reset()
         return
 
-    # tpu topology: transfer faults ride the retry plane (recovered), or a
-    # dispatch fault under fail_fast/isolate (honest structured error)
+    # tpu topology: transfer faults ride the retry plane (recovered); a
+    # dispatch fault under fail_fast is an honest structured error, under
+    # `restart` it recovers via checkpoint/replay (device-plane recovery) —
+    # either way the output is bit-correct or the error names the block
     from futuresdr_tpu.config import config
     from futuresdr_tpu.ops import mag2_stage
     from futuresdr_tpu.tpu import TpuKernel
     tone = np.exp(2j * np.pi * 0.07 * np.arange(n)).astype(np.complex64)
     expected = (tone.real ** 2 + tone.imag ** 2).astype(np.float32)
     site = rng.choice(("h2d", "d2h", "link", "dispatch"))
+    policy = rng.choice(("fail_fast", "restart")) if site == "dispatch" \
+        else "fail_fast"
     config().xfer_backoff = 0.0005
 
     def build():
         fg = Flowgraph()
         tk = TpuKernel([mag2_stage()], np.complex64, frame_size=1 << 13,
                        frames_in_flight=2)
+        if policy == "restart":
+            tk.policy = BlockPolicy(on_error="restart", max_restarts=3,
+                                    backoff=0.002)
         snk = VectorSink(np.float32)
         fg.connect(VectorSource(tone), tk, snk)
         name = fg.wrapped(tk).instance_name
@@ -402,7 +513,7 @@ def _random_trial(rng: random.Random, idx: int):
             plan.arm(site, rate=1.0, max_faults=rng.choice((1, 2)), seed=seed)
 
         def check(error):
-            if site == "dispatch":
+            if site == "dispatch" and policy == "fail_fast":
                 assert error is not None
                 assert name in error.blocks, (label, error.blocks)
             else:
@@ -411,9 +522,10 @@ def _random_trial(rng: random.Random, idx: int):
                                            rtol=1e-5)
         return fg, check
 
+    expect = "error" if (site == "dispatch" and policy == "fail_fast") \
+        else "ok"
     try:
-        _run_trial(build, label,
-                   expect="error" if site == "dispatch" else "ok")
+        _run_trial(build, label, expect=expect)
     finally:
         faults.reset()
 
@@ -435,6 +547,8 @@ SCENARIOS = (
     ("restart_recovers", scenario_restart_recovers),
     ("isolate_branches", scenario_isolate_branches),
     ("transfer_retry_deterministic", scenario_transfer_retry_deterministic),
+    ("stateful-restart-replay", scenario_stateful_restart_replay),
+    ("isolate-group", scenario_isolate_group),
     ("deadline_bounds_wedge", scenario_deadline_bounds_wedge),
 )
 

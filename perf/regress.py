@@ -19,6 +19,10 @@ Field policy:
   graded against a TPU round.
 * Only fields present in BOTH stamps compare (``--skip-extra-chains`` quick
   runs simply skip the chain fields).
+* ``checkpoint_overhead_frac`` (stamped by ``bench.py --doctor``: fault-free
+  streamed rate at the default carry-checkpoint cadence vs checkpointing
+  off) is LOWER-is-better — it flags when the fraction RISES past an
+  absolute slack instead of when it falls.
 
 Exit status: 0 unless ``--strict`` AND a regression was found — ``check.sh``
 wires this as a NON-fatal warning on CPU backends, where short-window noise
@@ -45,6 +49,11 @@ FIELDS_ANY_BACKEND = ("cpu_baseline_msps",)
 FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
                        "streamed_fanout_msps",
                        "fm_msps", "wlan_msps", "lora_msps")
+# lower-is-better fields (fractions, not rates): regression = the value ROSE
+# past the reference by more than the absolute slack below — e.g. the
+# carry-checkpoint cost of the device-plane recovery contract creeping up
+FIELDS_INVERSE_SAME_BACKEND = ("checkpoint_overhead_frac",)
+INVERSE_SLACK = 0.10       # absolute fraction a lower-is-better field may rise
 
 
 def load_trajectory(root=_ROOT):
@@ -89,13 +98,22 @@ def compare(current, trajectory, tolerance):
     same, any_ = pick_references(trajectory, backend)
     rows = []
 
-    def one(field, ref_pair):
+    def one(field, ref_pair, inverse=False):
         if ref_pair is None:
             return
         rnd, ref = ref_pair
         cur_v, ref_v = current.get(field), ref.get(field)
         if not isinstance(cur_v, (int, float)) or \
-                not isinstance(ref_v, (int, float)) or ref_v <= 0:
+                not isinstance(ref_v, (int, float)):
+            return
+        if inverse:
+            # lower-is-better fraction (ref may legitimately be 0): flag a
+            # rise past the absolute slack, ratio is informational only
+            ratio = cur_v / ref_v if ref_v > 0 else float("inf")
+            rows.append((field, cur_v, ref_v, rnd, ratio,
+                         cur_v > ref_v + INVERSE_SLACK))
+            return
+        if ref_v <= 0:
             return
         ratio = cur_v / ref_v
         rows.append((field, cur_v, ref_v, rnd, ratio,
@@ -105,6 +123,8 @@ def compare(current, trajectory, tolerance):
         one(f, any_)
     for f in FIELDS_SAME_BACKEND:
         one(f, same)
+    for f in FIELDS_INVERSE_SAME_BACKEND:
+        one(f, same, inverse=True)
     return rows, (same[0] if same else None)
 
 

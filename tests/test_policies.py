@@ -390,10 +390,36 @@ def test_supervisor_flight_record_carries_error_count():
 
 
 # ---------------------------------------------------------------------------
-# fusion degrades for policy-bearing members
+# fusion × policy: isolate refuses, restart fuses (device-plane recovery)
 # ---------------------------------------------------------------------------
 
-def test_devchain_refuses_policy_members():
+def test_devchain_refuses_isolate_members():
+    from futuresdr_tpu.ops import mag2_stage
+    from futuresdr_tpu.tpu import TpuD2H, TpuH2D, TpuStage
+    frame = 4096
+    n = 4 * frame
+    tone = np.exp(2j * np.pi * 0.05 * np.arange(n)).astype(np.complex64)
+    fg = Flowgraph()
+    src = VectorSource(tone)
+    h2d = TpuH2D(np.complex64, frame_size=frame)
+    st = TpuStage([mag2_stage()], np.complex64)
+    st.policy = BlockPolicy(on_error="isolate")
+    d2h = TpuD2H(np.float32)
+    snk = VectorSink(np.float32)
+    fg.connect(src, h2d, st, d2h, snk)
+    done = Runtime().run(fg)
+    m = done.wrapped(st).metrics()
+    assert not m.get("fused_devchain"), \
+        "an isolate-policy member must refuse device-graph fusion"
+    np.testing.assert_allclose(
+        np.asarray(snk.items()),
+        (tone.real ** 2 + tone.imag ** 2).astype(np.float32), rtol=1e-5)
+
+
+def test_devchain_fuses_restart_members():
+    """Device-plane recovery acceptance: a restart-policy member NO LONGER
+    declines fusion — the fused kernel carries the recovery contract
+    (checkpoint/replay) itself."""
     from futuresdr_tpu.ops import mag2_stage
     from futuresdr_tpu.tpu import TpuD2H, TpuH2D, TpuStage
     frame = 4096
@@ -409,8 +435,8 @@ def test_devchain_refuses_policy_members():
     fg.connect(src, h2d, st, d2h, snk)
     done = Runtime().run(fg)
     m = done.wrapped(st).metrics()
-    assert not m.get("fused_devchain"), \
-        "a restart-policy member must refuse device-graph fusion"
+    assert m.get("fused_devchain"), \
+        "a restart-policy member should fuse (recovery AND fusion)"
     np.testing.assert_allclose(
         np.asarray(snk.items()),
         (tone.real ** 2 + tone.imag ** 2).astype(np.float32), rtol=1e-5)
@@ -419,7 +445,11 @@ def test_devchain_refuses_policy_members():
 def test_devchain_degrades_under_global_policy(monkeypatch):
     from futuresdr_tpu.runtime.devchain import devchain_enabled
     assert devchain_enabled()
+    # a global restart default no longer degrades (fused kernels restart in
+    # place from their composed-carry checkpoint); isolate still does
     monkeypatch.setattr(config(), "block_policy", "restart")
+    assert devchain_enabled()
+    monkeypatch.setattr(config(), "block_policy", "isolate")
     assert not devchain_enabled()
 
 
@@ -427,6 +457,25 @@ def test_devchain_degrades_under_work_faults():
     from futuresdr_tpu.runtime import faults
     from futuresdr_tpu.runtime.devchain import devchain_enabled
     faults.reset().arm("work:some_block", rate=0.5)
+    try:
+        assert not devchain_enabled()
+    finally:
+        faults.reset()
+    assert devchain_enabled()
+
+
+def test_devchain_dispatch_fault_gating():
+    """A bare `dispatch` site keeps fusion on (the fused kernel polls it);
+    a block-ADDRESSED dispatch:<name> site degrades — fused mode would
+    silently un-arm it."""
+    from futuresdr_tpu.runtime import faults
+    from futuresdr_tpu.runtime.devchain import devchain_enabled
+    faults.reset().arm("dispatch", rate=0.5)
+    try:
+        assert devchain_enabled()
+    finally:
+        faults.reset()
+    faults.reset().arm("dispatch:TpuKernel_1", rate=0.5)
     try:
         assert not devchain_enabled()
     finally:
@@ -501,3 +550,354 @@ def test_describe_policy_decisions_empty_on_clean_run():
     desc = fg.describe().to_json()
     assert desc["policy_decisions"] == []
     assert all(b["restarts"] == 0 for b in desc["blocks"])
+
+
+# ---------------------------------------------------------------------------
+# device-plane recovery: carry checkpoint/replay (ISSUE 8 tentpole)
+# ---------------------------------------------------------------------------
+
+_FRAME = 1 << 11
+_N = _FRAME * 21 + 517        # partial tail frame + partial K-batch at EOS
+
+
+def _stateful_data():
+    rng = np.random.default_rng(7)
+    return (rng.standard_normal(_N) + 1j * rng.standard_normal(_N)) \
+        .astype(np.complex64)
+
+
+def _stateful_stages():
+    """FIR history + rotator phase: both carries must survive a restart for
+    bit-equality to hold — exactly the state a fresh re-init forfeits."""
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import fir_stage, rotator_stage
+    taps = firdes.lowpass(0.2, 31).astype(np.float32)
+    return [fir_stage(taps, fft_len=256), rotator_stage(0.05)]
+
+
+def _run_stateful(data, fault=None, restart=False, k=1, ck=None,
+                  max_faults=1):
+    """One VectorSource → TpuKernel(FIR→rotator) → VectorSink run; ``fault``
+    = (site, rate, seed) armed NON-transient (h2d/d2h included — the fatal
+    class is what exercises restart, the transient class only the retry
+    plane). Returns (output, restarts)."""
+    from futuresdr_tpu.runtime import faults
+    from futuresdr_tpu.tpu import TpuKernel
+    fg = Flowgraph()
+    tk = TpuKernel(_stateful_stages(), np.complex64, frame_size=_FRAME,
+                   frames_in_flight=2, frames_per_dispatch=k,
+                   checkpoint_every=ck)
+    if restart:
+        tk.policy = BlockPolicy(on_error="restart", max_restarts=4,
+                                backoff=0.002)
+    snk = VectorSink(np.complex64)
+    fg.connect(VectorSource(data), tk, snk)
+    name = fg.wrapped(tk).instance_name
+    plan = faults.reset()
+    if fault:
+        site, rate, seed = fault
+        plan.arm(f"{site}:{name}" if site == "dispatch" else site,
+                 rate=rate, max_faults=max_faults, seed=seed,
+                 transient=False)
+    try:
+        Runtime().run(fg, timeout=60.0)
+    finally:
+        faults.reset()
+    return np.asarray(snk.items()), fg.wrapped(tk).restarts
+
+
+def _replayed() -> float:
+    from futuresdr_tpu.tpu.kernel_block import _REPLAYED
+    return sum(v for _, v in _REPLAYED.samples())
+
+
+def _forfeited() -> float:
+    from futuresdr_tpu.tpu.kernel_block import _FORFEITED
+    return sum(v for _, v in _FORFEITED.samples())
+
+
+def test_stateful_restart_replay_dispatch_fault():
+    """Acceptance: a carry-bearing device chain with `restart` policy and a
+    seeded dispatch fault injected MID-STREAM produces output bit-identical
+    to the fault-free run — the checkpoint restore + replay path, billed on
+    fsdr_frames_replayed_total."""
+    data = _stateful_data()
+    exp, r0 = _run_stateful(data)
+    assert r0 == 0
+    before = _replayed()
+    got, r = _run_stateful(data, fault=("dispatch", 0.12, 9), restart=True)
+    assert r == 1
+    assert _replayed() - before > 0
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_stateful_restart_replay_transfer_faults():
+    """Fatal (non-transient) h2d/d2h failures mid-stream recover bit-correct
+    too — including a second fault landing DURING recovery (it consumes
+    another restart attempt and the retried recovery completes)."""
+    data = _stateful_data()
+    exp, _ = _run_stateful(data)
+    for site, rate, seed, mf in (("h2d", 0.08, 4, 1), ("h2d", 0.05, 11, 2),
+                                 ("d2h", 0.03, 2, 2)):
+        got, r = _run_stateful(data, fault=(site, rate, seed), restart=True,
+                               max_faults=mf)
+        assert r >= 1, (site, seed)
+        np.testing.assert_array_equal(got, exp, err_msg=f"{site}@{seed}")
+
+
+def test_stateful_restart_replay_megabatch():
+    """Megabatch K=4 replay respects partial-batch semantics: the log
+    retains the exact zero-padded scan payload, so the partial EOS group
+    replays bit-identical (compared against the fault-free K=4 run — the
+    scan program's own rounding differs from K=1's by contract)."""
+    data = _stateful_data()
+    exp, _ = _run_stateful(data, k=4)
+    got, r = _run_stateful(data, fault=("dispatch", 0.3, 5), restart=True,
+                           k=4)
+    assert r == 1
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_sparse_checkpoint_cadence_replays_bit_correct():
+    """checkpoint_every=3: longer replay window, same bit-equality."""
+    data = _stateful_data()
+    exp, _ = _run_stateful(data)
+    got, r = _run_stateful(data, fault=("dispatch", 0.12, 9), restart=True,
+                           ck=3)
+    assert r == 1
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_checkpoint_off_forfeits_and_bills():
+    """checkpoint_every=0: recover() declines, the fresh re-init forfeits the
+    in-flight window (billed on fsdr_frames_forfeited_total) and the run
+    completes with the gap — the pre-recovery behavior, now accounted."""
+    data = _stateful_data()
+    exp, _ = _run_stateful(data)
+    before = _forfeited()
+    got, r = _run_stateful(data, fault=("dispatch", 0.12, 9), restart=True,
+                           ck=0)
+    assert r == 1
+    assert _forfeited() - before > 0
+    assert len(got) < len(exp)            # frames really were dropped
+
+
+def test_carry_fault_falls_back_to_previous_checkpoint():
+    """Satellite: the `carry` site corrupts checkpoint candidates; the
+    restore path's integrity check (tree/shape/dtype) must reject them and
+    fall back — output stays bit-identical."""
+    from futuresdr_tpu.runtime import faults
+    from futuresdr_tpu.tpu import TpuKernel
+    data = _stateful_data()
+    exp, _ = _run_stateful(data)
+    fg = Flowgraph()
+    tk = TpuKernel(_stateful_stages(), np.complex64, frame_size=_FRAME,
+                   frames_in_flight=2)
+    tk.policy = BlockPolicy(on_error="restart", max_restarts=4,
+                            backoff=0.002)
+    snk = VectorSink(np.complex64)
+    fg.connect(VectorSource(data), tk, snk)
+    name = fg.wrapped(tk).instance_name
+    plan = faults.reset()
+    carry_inj = plan.arm("carry", rate=0.3, max_faults=2, seed=3)
+    plan.arm(f"dispatch:{name}", rate=0.10, max_faults=1, seed=9,
+             transient=False)
+    try:
+        Runtime().run(fg, timeout=60.0)
+    finally:
+        faults.reset()
+    assert carry_inj.fired >= 1, "the carry corruption never fired"
+    assert fg.wrapped(tk).restarts == 1
+    np.testing.assert_array_equal(np.asarray(snk.items()), exp)
+
+
+def test_fused_devchain_restart_replay():
+    """Acceptance: the FUSED devchain path recovers bit-identically too —
+    a restart-policy member fuses, the drive loop restarts the fused kernel
+    from its composed-carry checkpoint, and the supervisor records the
+    restart decision under the member's name."""
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import fir_stage, rotator_stage
+    from futuresdr_tpu.runtime import faults
+    from futuresdr_tpu.tpu import TpuKernel
+    data = _stateful_data()
+    taps = firdes.lowpass(0.2, 31).astype(np.float32)
+
+    def run(fault):
+        fg = Flowgraph()
+        k1 = TpuKernel([fir_stage(taps, fft_len=256)], np.complex64,
+                       frame_size=_FRAME, frames_in_flight=2)
+        k2 = TpuKernel([rotator_stage(0.05)], np.complex64,
+                       frame_size=_FRAME, frames_in_flight=2)
+        k2.policy = BlockPolicy(on_error="restart", max_restarts=4,
+                                backoff=0.002)
+        snk = VectorSink(np.complex64)
+        fg.connect(VectorSource(data), k1, k2, snk)
+        plan = faults.reset()
+        if fault:
+            plan.arm("dispatch", rate=0.12, max_faults=1, seed=5,
+                     transient=False)
+        try:
+            Runtime().run(fg, timeout=60.0)
+        finally:
+            faults.reset()
+        wk2 = fg.wrapped(k2)
+        return (np.asarray(snk.items()), wk2.restarts,
+                bool(wk2.metrics().get("fused_devchain")),
+                fg.describe().to_json())
+
+    exp, _, fused0, _ = run(fault=False)
+    assert fused0, "restart-policy member should fuse"
+    got, restarts, fused1, desc = run(fault=True)
+    assert fused1 and restarts == 1
+    np.testing.assert_array_equal(got, exp)
+    acts = [d for d in desc["policy_decisions"] if d["action"] == "restart"]
+    assert len(acts) == 1 and acts[0]["phase"] == "work"
+
+
+def test_fanout_fused_restart_replay():
+    """Acceptance: a fused fan-out region (TpuFanoutKernel, FLAT composed
+    carry) recovers bit-identically on EVERY branch."""
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import fir_stage, mag2_stage, rotator_stage
+    from futuresdr_tpu.runtime import faults
+    from futuresdr_tpu.tpu import TpuKernel
+    taps = firdes.lowpass(0.2, 31).astype(np.float32)
+    n = _FRAME * 13 + 300
+    rng = np.random.default_rng(3)
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)) \
+        .astype(np.complex64)
+
+    def run(fault):
+        fg = Flowgraph()
+        prod = TpuKernel([fir_stage(taps, fft_len=256)], np.complex64,
+                         frame_size=_FRAME, frames_in_flight=2)
+        prod.policy = BlockPolicy(on_error="restart", max_restarts=4,
+                                  backoff=0.002)
+        b1 = TpuKernel([rotator_stage(0.05)], np.complex64,
+                       frame_size=_FRAME, frames_in_flight=2)
+        b2 = TpuKernel([mag2_stage()], np.complex64, frame_size=_FRAME,
+                       frames_in_flight=2)
+        s1, s2 = VectorSink(np.complex64), VectorSink(np.float32)
+        src = VectorSource(data)
+        fg.connect(src, prod)
+        fg.connect(prod, b1, s1)
+        fg.connect(prod, b2, s2)
+        plan = faults.reset()
+        if fault:
+            plan.arm("dispatch", rate=0.15, max_faults=1, seed=6,
+                     transient=False)
+        try:
+            Runtime().run(fg, timeout=60.0)
+        finally:
+            faults.reset()
+        wp = fg.wrapped(prod)
+        return (np.asarray(s1.items()), np.asarray(s2.items()),
+                wp.restarts, bool(wp.metrics().get("fused_devchain")))
+
+    e1, e2, _, fused0 = run(fault=False)
+    assert fused0
+    g1, g2, restarts, fused1 = run(fault=True)
+    assert fused1 and restarts == 1
+    np.testing.assert_array_equal(g1, e1)
+    np.testing.assert_array_equal(g2, e2)
+
+
+# ---------------------------------------------------------------------------
+# isolate groups: retire a subgraph, not just one block (ISSUE 8 tentpole)
+# ---------------------------------------------------------------------------
+
+def test_isolate_group_retires_whole_subgraph():
+    """Acceptance: one member of a named 3-block group dies → the whole
+    group retires (topo-order EOS), the sibling branch finishes bit-correct,
+    and policy_decisions carries ONE isolate_group verdict naming the group
+    and every member."""
+    from futuresdr_tpu.runtime import faults
+    data = np.arange(100_000, dtype=np.float32)
+    fg = Flowgraph()
+    snk_a = VectorSink(np.float32)
+    fg.connect(VectorSource(data), Copy(np.float32), snk_a)
+    g1, g2, g3 = (Copy(np.float32) for _ in range(3))
+    for g in (g1, g2, g3):
+        g.policy = BlockPolicy(isolate_group="rx-branch")
+    snk_b = VectorSink(np.float32)
+    fg.connect(VectorSource(np.zeros(200_000, np.float32)), g1, g2, g3,
+               snk_b)
+    name = fg.wrapped(g2).instance_name
+    members = [fg.wrapped(g).instance_name for g in (g1, g2, g3)]
+    faults.reset().arm(f"work:{name}", rate=1.0, max_faults=1, seed=5)
+    try:
+        with pytest.raises(FlowgraphError) as ei:
+            Runtime().run(fg, timeout=30.0)
+    finally:
+        faults.reset()
+    e = ei.value
+    np.testing.assert_array_equal(np.asarray(snk_a.items()), data)
+    dec = [d for d in e.policy_decisions if d["action"] == "isolate_group"]
+    assert len(dec) == 1, e.policy_decisions
+    assert dec[0]["group"] == "rx-branch"
+    assert dec[0]["block"] == name
+    assert dec[0]["members"] == members   # topological order
+    assert e.blocks == [name]
+    # the description surface carries the group per block
+    desc = fg.describe().to_json()
+    grouped = [b["instance_name"] for b in desc["blocks"]
+               if b.get("isolate_group") == "rx-branch"]
+    assert sorted(grouped) == sorted(members)
+
+
+def test_isolate_group_from_config(monkeypatch):
+    """config `block_isolate_groups = "name=group;…"` assigns groups to
+    blocks with no own policy — same retirement semantics."""
+    from futuresdr_tpu.runtime import faults
+    data = np.arange(60_000, dtype=np.float32)
+    fg = Flowgraph()
+    snk_a = VectorSink(np.float32)
+    fg.connect(VectorSource(data), Copy(np.float32), snk_a)
+    b1, b2 = Copy(np.float32), Copy(np.float32)
+    snk_b = VectorSink(np.float32)
+    fg.connect(VectorSource(np.zeros(80_000, np.float32)), b1, b2, snk_b)
+    n1 = fg.wrapped(b1).instance_name
+    n2 = fg.wrapped(b2).instance_name
+    monkeypatch.setattr(config(), "block_isolate_groups",
+                        f"{n1}=grp;{n2}=grp")
+    faults.reset().arm(f"work:{n1}", rate=1.0, max_faults=1, seed=5)
+    try:
+        with pytest.raises(FlowgraphError) as ei:
+            Runtime().run(fg, timeout=30.0)
+    finally:
+        faults.reset()
+    np.testing.assert_array_equal(np.asarray(snk_a.items()), data)
+    dec = [d for d in ei.value.policy_decisions
+           if d["action"] == "isolate_group"]
+    assert dec and dec[0]["group"] == "grp"
+    assert set(dec[0]["members"]) == {n1, n2}
+
+
+def test_isolate_group_covers_init_failures():
+    """A group member failing INIT retires the whole group during the
+    barrier; the sibling branch still finishes."""
+    data = np.arange(50_000, dtype=np.float32)
+    fg = Flowgraph()
+    snk_a = VectorSink(np.float32)
+    fg.connect(VectorSource(data), Copy(np.float32), snk_a)
+    bad = FlakyInit(np.float32, fail_times=99)
+    tail = Copy(np.float32)
+    for b in (bad, tail):
+        b.policy = BlockPolicy(isolate_group="dead-branch")
+    snk_b = VectorSink(np.float32)
+    fg.connect(VectorSource(np.zeros(1000, np.float32)), bad, tail, snk_b)
+    with pytest.raises(FlowgraphError) as ei:
+        Runtime().run(fg, timeout=30.0)
+    np.testing.assert_array_equal(np.asarray(snk_a.items()), data)
+    dec = [d for d in ei.value.policy_decisions
+           if d["action"] == "isolate_group"]
+    assert len(dec) == 1 and dec[0]["group"] == "dead-branch"
+
+
+def test_isolate_group_policy_validation():
+    assert BlockPolicy(isolate_group="x").on_error == "isolate"
+    assert BlockPolicy(on_error="isolate", isolate_group="x") \
+        .isolate_group == "x"
+    with pytest.raises(ValueError):
+        BlockPolicy(on_error="restart", isolate_group="x")

@@ -430,7 +430,9 @@ def _null_rand_chain(samples=1_000_000, stages=3, max_copy=2048):
 def test_telemetry_disabled_overhead_null_rand(monkeypatch):
     """The ≤ ~3% gate, measured on the REAL null_rand actor chain — with the
     doctor watchdog armed at its default interval (the flowgraph-doctor PR
-    extends the gate: always-on diagnosis must ride inside the same budget).
+    extends the gate: always-on diagnosis must ride inside the same budget)
+    and the device-plane recovery PR's disabled checkpoint hook billed as a
+    third per-call cost (checkpoint_every=0 must be free).
 
     The per-work-call cost of the disabled telemetry path (the `if
     rec.enabled:` guard, the ns-clock reads the loop already paid
@@ -475,7 +477,25 @@ def test_telemetry_disabled_overhead_null_rand(monkeypatch):
         for _ in range(n):
             if rec.enabled:                       # pragma: no cover
                 rec.complete("park", "x", 0)
-    work_ns, park_ns = best_of(work_hook), best_of(park_hook)
+
+    # checkpoint hook (device-plane recovery, tpu/kernel_block.py): with
+    # checkpoint_every=0 the per-dispatch _checkpoint_tick must be one falsy
+    # check — billed here as a THIRD per-call hook even though the host chain
+    # never dispatches (a conservative over-count: the real rate is one tick
+    # per device dispatch group, far below the work-call rate)
+    from futuresdr_tpu.ops import mag2_stage
+    from futuresdr_tpu.tpu import TpuKernel
+    tk = TpuKernel([mag2_stage()], np.complex64, frame_size=1 << 12,
+                   checkpoint_every=0)
+    assert tk._ckpt_every == 0
+    tick = tk._checkpoint_tick
+
+    def ckpt_hook():
+        for _ in range(n):
+            tick(0)
+
+    work_ns, park_ns, ckpt_ns = \
+        best_of(work_hook), best_of(park_hook), best_of(ckpt_hook)
     # the chain's real call rate, measured with the watchdog running at its
     # DEFAULT interval (its 1 Hz sampling lands in `elapsed`, not per call)
     doc.enable()
@@ -484,11 +504,11 @@ def test_telemetry_disabled_overhead_null_rand(monkeypatch):
         elapsed, calls = _null_rand_chain()
     finally:
         doc.disable()
-    overhead = calls * (work_ns + park_ns) * 1e-9 / elapsed
+    overhead = calls * (work_ns + park_ns + ckpt_ns) * 1e-9 / elapsed
     assert overhead <= 0.03, (
         f"telemetry-disabled hooks cost {overhead * 100:.2f}% of the "
-        f"null_rand chain ({calls} work calls, {work_ns:.0f}+{park_ns:.0f} "
-        f"ns/hook, {elapsed:.3f}s elapsed)")
+        f"null_rand chain ({calls} work calls, {work_ns:.0f}+{park_ns:.0f}"
+        f"+{ckpt_ns:.0f} ns/hook, {elapsed:.3f}s elapsed)")
 
 
 def test_telemetry_enabled_stays_cheap(tracing, monkeypatch):
