@@ -296,6 +296,59 @@ def run_streamed_fanout(n_samples: int, frame_size: int,
     return n_samples / dt / 1e6, dpf
 
 
+def run_streamed_dag(n_samples: int, frame_size: int,
+                     depth: int = 8) -> tuple:
+    """Nested-fan-out DAG through the actor runtime (round-13 general-DAG
+    fusion): the bench FIR feeds ``{a → {c, d}, b}`` — a broadcast INSIDE a
+    branch — over stream edges; the fusion pass collapses the whole
+    5-kernel region into ONE multi-output ``TpuDagKernel`` dispatch per
+    frame with every interior edge device-resident. Returns
+    ``(msps, dispatches_per_frame)`` — the trajectory stamp for the
+    whole-receiver single-dispatch win."""
+    from futuresdr_tpu.config import config
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import fir_stage, mag2_stage
+
+    config().buffer_size = max(config().buffer_size, 4 * frame_size * 8)
+    t1 = firdes.lowpass(0.2, N_TAPS).astype(np.float32)
+    t2 = firdes.lowpass(0.15, N_TAPS).astype(np.float32)
+    fg = Flowgraph()
+    src = NullSource(np.complex64)
+    head = Head(np.complex64, n_samples)
+    prod = TpuKernel([fir_stage(t1, name="p")], np.complex64,
+                     frame_size=frame_size, frames_in_flight=depth)
+    a = TpuKernel([fir_stage(t2, name="a")], np.complex64,
+                  frame_size=frame_size, frames_in_flight=depth)
+    b = TpuKernel([mag2_stage()], np.complex64, frame_size=frame_size,
+                  frames_in_flight=depth)
+    c = TpuKernel([fir_stage(t2, decim=4, name="c")], np.complex64,
+                  frame_size=frame_size, frames_in_flight=depth)
+    d = TpuKernel([mag2_stage()], np.complex64, frame_size=frame_size,
+                  frames_in_flight=depth)
+    s_c, s_d, s_b = (NullSink(np.complex64), NullSink(np.float32),
+                     NullSink(np.float32))
+    fg.connect_stream(src, "out", head, "in")
+    fg.connect_stream(head, "out", prod, "in")
+    fg.connect_stream(prod, "out", a, "in")      # broadcast port group
+    fg.connect_stream(prod, "out", b, "in")
+    fg.connect_stream(a, "out", c, "in")         # nested broadcast
+    fg.connect_stream(a, "out", d, "in")
+    fg.connect_stream(c, "out", s_c, "in")
+    fg.connect_stream(d, "out", s_d, "in")
+    fg.connect_stream(b, "out", s_b, "in")
+    t0 = time.perf_counter()
+    Runtime().run(fg)
+    dt = time.perf_counter() - t0
+    n_frames = n_samples // frame_size
+    assert s_b.n_received >= n_frames * frame_size, s_b.n_received
+    m = prod.extra_metrics()
+    if m.get("fused_devchain"):
+        dpf = m["devchain_dispatches"] / max(1, m["devchain_frames"])
+    else:   # declined (FSDR_NO_DEVCHAIN, policy degrade): per-hop dispatches
+        dpf = sum(k._dispatches for k in (prod, a, b, c, d)) / max(1, n_frames)
+    return n_samples / dt / 1e6, dpf
+
+
 _CHAINS = ("fm", "wlan", "lora")        # keys: <name>_msps (input Msamples/s)
 
 
@@ -326,6 +379,13 @@ def _run_fanout_child(frame: int, n: int, depth: int) -> None:
     rate, dpf = run_streamed_fanout(n, frame, depth)
     print(f"FANOUT_DPF {dpf}")
     print(f"FANOUT_RATE {rate}")
+
+
+def _run_dag_child(frame: int, n: int, depth: int) -> None:
+    """Child mode (``--run-dag``): one streamed nested-DAG measurement."""
+    rate, dpf = run_streamed_dag(n, frame, depth)
+    print(f"DAG_DPF {dpf}")
+    print(f"DAG_RATE {rate}")
 
 
 def _sub_rate(argv, pattern, timeout, extra_env=None):
@@ -442,6 +502,10 @@ def main():
                    metavar=("FRAME", "N", "DEPTH"),
                    help="internal child mode: one streamed 1→2 fan-out "
                         "measurement")
+    p.add_argument("--run-dag", nargs=3, type=int, default=None,
+                   metavar=("FRAME", "N", "DEPTH"),
+                   help="internal child mode: one streamed nested-DAG "
+                        "measurement")
     p.add_argument("--wire", default="f32",
                    help="wire format for --run-streamed (ops/wire.py)")
     p.add_argument("--trace", default=None, metavar="OUT_JSON",
@@ -474,6 +538,9 @@ def main():
         return
     if args.run_fanout:
         _run_fanout_child(*args.run_fanout)
+        return
+    if args.run_dag:
+        _run_dag_child(*args.run_dag)
         return
 
     inst_ = instance()
@@ -856,6 +923,54 @@ def main():
         print(f"# streamed fan-out A/B unavailable: {e!r}", file=sys.stderr)
         fanout_extra["streamed_fanout_error"] = repr(e)
 
+    # streamed nested-DAG (general-DAG fusion, runtime/devchain.py round 13):
+    # the same frame/depth regime, a producer FIR feeding {a → {c, d}, b} —
+    # a broadcast INSIDE a branch — fused into ONE multi-output dispatch per
+    # frame with every interior edge device-resident. Stamped so the
+    # trajectory captures the whole-receiver single-dispatch win (and
+    # perf/regress.py grades streamed_dag_msps round over round).
+    dag_extra = {}
+    try:
+        import re as _re
+        n_dag = int(min(max(probe_best * 1e6 * per_run,
+                            stream_frame * 4 * args.depth), 200_000_000))
+        n_dag = (n_dag // stream_frame) * stream_frame
+        dag_runs, dag_dpf = [], None
+        for _ in range(3):
+            if guarded:
+                r, err, out = _sub_rate(
+                    ["--run-dag", str(stream_frame), str(n_dag),
+                     str(args.depth)], "DAG_RATE", 600)
+                if r is None:
+                    dag_extra["streamed_dag_error"] = err
+                    print(f"# streamed DAG run failed: {err}",
+                          file=sys.stderr)
+                    continue
+                md = _re.search(r"DAG_DPF ([0-9.eE+-]+)", out)
+                if md:
+                    dag_dpf = float(md.group(1))
+            else:
+                r, dag_dpf = run_streamed_dag(n_dag, stream_frame,
+                                              args.depth)
+            dag_runs.append(r)
+        dag_runs.sort()
+        if dag_runs:
+            dag_extra.update({
+                "streamed_dag_msps": round(
+                    dag_runs[(len(dag_runs) - 1) // 2], 1),
+                "streamed_dag_runs": [round(r, 1) for r in dag_runs],
+                "dag_dispatches_per_frame": round(dag_dpf, 3)
+                if dag_dpf is not None else None,
+            })
+            print(f"# streamed nested DAG: median "
+                  f"{dag_extra['streamed_dag_msps']:.1f} Msps, "
+                  f"{dag_extra['dag_dispatches_per_frame']} "
+                  f"dispatches/frame, runs {['%.1f' % r for r in dag_runs]}",
+                  file=sys.stderr)
+    except Exception as e:                              # noqa: BLE001
+        print(f"# streamed DAG A/B unavailable: {e!r}", file=sys.stderr)
+        dag_extra["streamed_dag_error"] = repr(e)
+
     result = {
         "metric": f"fir64+fft{FFT_SIZE}+mag2 fused chain, device-resident ({inst_.platform})",
         "value": round(dev_rate, 1),
@@ -881,6 +996,7 @@ def main():
         **link,
         **wire_extra,
         **fanout_extra,
+        **dag_extra,
         **roof,
         **doctor_extra,
         **extras,

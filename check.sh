@@ -14,9 +14,12 @@ JAX_PLATFORMS=cpu python -m pytest -q \
 
 echo "== device-graph fusion gate (docs/tpu_notes.md 'Device-graph fusion') =="
 # fused A/B smoke: the linear pass engages (dispatches drop 3x -> 1x per
-# frame) AND the fan-out pass engages (1->2 broadcast region: H2D bytes bill
+# frame), the fan-out pass engages (1->2 broadcast region: H2D bytes bill
 # exactly ONE upload per marginal frame via fsdr_xfer_bytes_total, one
-# multi-output dispatch per frame, replayed-link throughput win)
+# multi-output dispatch per frame, replayed-link throughput win), AND the
+# general-DAG pass engages (diamond broadcast->merge + nested fan-out:
+# dispatches/frame == 1 with interior-edge D2H bytes == 0 — the fused side's
+# marginal D2H equals exactly the sink payloads)
 JAX_PLATFORMS=cpu python perf/devchain_ab.py --smoke
 # fusion equality tests, then the DECLINED mode (FSDR_NO_DEVCHAIN=1) over the
 # device-plane suite: the per-hop fallback must stand alone

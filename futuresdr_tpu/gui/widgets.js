@@ -22,6 +22,16 @@ FSDR.Handle.prototype.describe = async function (fg) {
 FSDR.Handle.prototype.metrics = async function (fg) {
   return (await fetch(this.base + '/api/fg/' + fg + '/metrics/')).json();
 };
+FSDR.Handle.prototype.doctor = async function (fg, md) {
+  /* flight-recorder dump (runtime/ctrl_port.py GET /api/fg/{fg}/doctor/):
+   * md=true fetches the rendered markdown, else the JSON record */
+  const url = this.base + '/api/fg/' + fg + '/doctor/' + (md ? '?md=1' : '');
+  const r = await fetch(url);
+  /* fetch resolves on ANY completed HTTP exchange — a 404 (stale fg id) or
+   * 500 must not render its error body as a flight record */
+  if (r.ok === false) throw new Error('doctor endpoint HTTP ' + r.status);
+  return md ? r.text() : r.json();
+};
 FSDR.Handle.prototype.call = async function (fg, blk, handler, pmt) {
   const r = await fetch(
     this.base + '/api/fg/' + fg + '/block/' + blk + '/call/' + handler + '/',
@@ -231,6 +241,61 @@ FSDR.MetricsTable.prototype.update = function (metrics) {
       c.appendChild(label);
     } else {
       c.textContent = m.fused_native ? '' : '—';
+    }
+  }
+};
+
+/* ---------------- DoctorPanel: flight-record markdown tab ------------------ */
+/* Fetches GET /api/fg/{fg}/doctor/?md=1 (telemetry/doctor.py render_markdown:
+ * watchdog verdict, per-block metrics + live port state, bottleneck lanes,
+ * e2e latency percentiles, thread stacks) on demand and renders the markdown
+ * with a minimal line renderer — headings and fenced code blocks styled, the
+ * rest preformatted (stack frames and metric tables stay aligned). */
+FSDR.DoctorPanel = function (root, handle, fgId) {
+  this.root = root; this.handle = handle; this.fgId = fgId;
+  const btn = document.createElement('button');
+  btn.textContent = 'refresh';
+  btn.onclick = () => this.refresh();
+  this.status = document.createElement('span');
+  this.status.className = 'doctor-status';
+  this.body = document.createElement('div');
+  this.body.className = 'doctor-body';
+  root.appendChild(btn);
+  root.appendChild(this.status);
+  root.appendChild(this.body);
+};
+FSDR.DoctorPanel.prototype.refresh = async function () {
+  try {
+    const md = await this.handle.doctor(this.fgId, true);
+    this.render(md);
+    this.status.textContent = '';
+  } catch (e) {
+    this.status.textContent = ' doctor endpoint unavailable';
+  }
+};
+FSDR.DoctorPanel.prototype.render = function (md) {
+  const body = this.body;
+  body.innerHTML = '';
+  let pre = null, fence = false;
+  const flush = () => { pre = null; };
+  for (const line of ('' + md).split('\n')) {
+    if (line.slice(0, 3) === '```') { fence = !fence; flush(); continue; }
+    if (!fence && line.slice(0, 2) === '# ') {
+      flush();
+      const h = document.createElement('h3');
+      h.textContent = line.slice(2);
+      body.appendChild(h);
+    } else if (!fence && line.slice(0, 3) === '## ') {
+      flush();
+      const h = document.createElement('h4');
+      h.textContent = line.slice(3);
+      body.appendChild(h);
+    } else {
+      if (!pre) {
+        pre = document.createElement('pre');
+        body.appendChild(pre);
+      }
+      pre.textContent += line + '\n';
     }
   }
 };

@@ -4,7 +4,10 @@ These are the jax/XLA counterparts of the numpy cores in :mod:`futuresdr_tpu.dsp
 streaming contracts, explicit carry, static shapes. Used by :class:`futuresdr_tpu.tpu.TpuKernel`.
 """
 
-from .stages import (Stage, Pipeline, FanoutPipeline, fir_stage, fft_stage, mag2_stage, log10_stage,
+from .stages import (Stage, Pipeline, FanoutPipeline, MergeStage, DagPipeline,
+                     apply_merge_stage, add_merge_stage, interleave_merge_stage,
+                     concat_merge_stage,
+                     fir_stage, fft_stage, mag2_stage, log10_stage,
                      xlating_fir_stage,
                      rotator_stage, quad_demod_stage, apply_stage, fftshift_stage,
                      decimate_stage, moving_avg_stage, resample_stage, agc_stage,
@@ -12,7 +15,10 @@ from .stages import (Stage, Pipeline, FanoutPipeline, fir_stage, fft_stage, mag2
 from .wire import (Wire, WIRE_FORMATS, get_wire, resolve_wire, wire_names,
                    measure_snr_db, streamed_ceiling_msps)
 
-__all__ = ["Stage", "Pipeline", "FanoutPipeline", "fir_stage", "fft_stage", "mag2_stage", "log10_stage",
+__all__ = ["Stage", "Pipeline", "FanoutPipeline", "MergeStage", "DagPipeline",
+           "apply_merge_stage", "add_merge_stage", "interleave_merge_stage",
+           "concat_merge_stage",
+           "fir_stage", "fft_stage", "mag2_stage", "log10_stage",
            "xlating_fir_stage",
            "rotator_stage", "quad_demod_stage", "apply_stage", "fftshift_stage",
            "decimate_stage", "moving_avg_stage", "resample_stage", "agc_stage",

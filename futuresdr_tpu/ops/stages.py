@@ -24,7 +24,9 @@ import numpy as np
 
 from . import mxu_fft
 
-__all__ = ["Stage", "Pipeline", "FanoutPipeline", "fir_stage", "fft_stage",
+__all__ = ["Stage", "Pipeline", "FanoutPipeline", "MergeStage", "DagPipeline",
+           "apply_merge_stage", "add_merge_stage", "interleave_merge_stage",
+           "concat_merge_stage", "fir_stage", "fft_stage",
            "mag2_stage", "log10_stage",
            "rotator_stage", "quad_demod_stage", "apply_stage", "fftshift_stage",
            "decimate_stage", "moving_avg_stage"]
@@ -70,6 +72,98 @@ class Stage:
 
     def __repr__(self):
         return f"Stage({self.name}, ratio={self.ratio})"
+
+
+@dataclass
+class MergeStage:
+    """A fan-IN stage: K ordered inputs joined into one output stream.
+
+    ``fn(carry, xs) -> (carry, y)`` with ``xs`` a K-tuple of arrays, jax-
+    traceable with static shapes — the merge node of a device-plane DAG
+    (:class:`DagPipeline`): the WLAN ``{demod, chan-est} → decode`` join and
+    the FM ``{audio, RDS} → mux`` both land here. The rate contract is per
+    MODE:
+
+    * ``mode="equal"`` — every input arrives at the SAME path rate (the
+      :class:`DagPipeline` constructor enforces it; a violating region is a
+      rate-contract error the devchain finder declines on). For n items per
+      input the output is ``n * ratio`` items (``apply_merge_stage``: ratio 1;
+      ``interleave_merge_stage(k)``: ratio k).
+    * ``mode="concat"`` — inputs may arrive at DIFFERENT rates; the output is
+      ``sum(n_i) * ratio`` items (``concat_merge_stage``: the mux join).
+
+    Stream tags crossing a merge ride the PRIMARY input (index 0): on the
+    actor path (``tpu/frames.TpuMergeStage``) only input 0's tags propagate
+    (rebased by ``ratio`` — concat places input 0 at offset 0, so the same
+    index math holds), and the fused path rebases region-input tags through
+    each sink's primary-chain ``tag_ratio`` — the two stay bit-identical.
+    """
+
+    fn: Callable[[Any, Tuple[jnp.ndarray, ...]], Tuple[Any, jnp.ndarray]]
+    init_carry: Callable[[np.dtype], Any]
+    k: int
+    mode: str = "equal"                           # "equal" | "concat"
+    ratio: Fraction = Fraction(1, 1)
+    out_dtype: Optional[np.dtype] = None          # None = same as input
+    frame_multiple: int = 1                       # per-INPUT requirement
+    name: str = "merge"
+    update: Optional[Callable[..., Any]] = None
+
+    def __post_init__(self):
+        assert self.mode in ("equal", "concat"), self.mode
+        assert self.k >= 2, "a merge needs >= 2 inputs"
+
+    def __repr__(self):
+        return f"MergeStage({self.name}, k={self.k}, mode={self.mode})"
+
+
+def apply_merge_stage(f: Callable[..., jnp.ndarray], k: int,
+                      out_dtype=None, name: str = "merge") -> MergeStage:
+    """Elementwise K-way join: ``y = f(x_0, …, x_{K-1})`` over equal-length
+    inputs (``mode="equal"``, ratio 1) — the device-plane ``Combine``
+    (``blocks/functional.py``) generalized to K inputs."""
+
+    def fn(carry, xs):
+        return carry, f(*xs)
+
+    return MergeStage(fn, lambda d: jnp.zeros(()), k, "equal",
+                      Fraction(1, 1), out_dtype, 1, name)
+
+
+def add_merge_stage(k: int, name: str = "add_merge") -> MergeStage:
+    """Elementwise sum of K equal-rate inputs (diversity/branch combining)."""
+
+    def fn(carry, xs):
+        y = xs[0]
+        for x in xs[1:]:
+            y = y + x
+        return carry, y
+
+    return MergeStage(fn, lambda d: jnp.zeros(()), k, "equal",
+                      Fraction(1, 1), None, 1, name)
+
+
+def interleave_merge_stage(k: int, name: str = "interleave") -> MergeStage:
+    """Item-interleave K equal-rate inputs: ``y[i·K + j] = x_j[i]`` (K· the
+    per-input rate) — the symbol-mux join."""
+
+    def fn(carry, xs):
+        return carry, jnp.stack(xs, axis=1).reshape(-1)
+
+    return MergeStage(fn, lambda d: jnp.zeros(()), k, "equal",
+                      Fraction(k, 1), None, 1, name)
+
+
+def concat_merge_stage(k: int, name: str = "concat_merge") -> MergeStage:
+    """Frame-concatenate K inputs (rates may differ): ``y = x_0 ++ … ++
+    x_{K-1}`` per frame — the FM ``{audio, RDS} → mux`` style join where each
+    branch contributes its own item count."""
+
+    def fn(carry, xs):
+        return carry, jnp.concatenate(xs)
+
+    return MergeStage(fn, lambda d: jnp.zeros(()), k, "concat",
+                      Fraction(1, 1), None, 1, name)
 
 
 class Pipeline:
@@ -473,6 +567,228 @@ class FanoutPipeline:
     # composed fan-out carry is exactly the linear pipeline's contract — one
     # checkpoint covers every branch's state at once (per-branch replay
     # cursors live in the kernel's drain bookkeeping, not the carry)
+    snapshot_carry = Pipeline.snapshot_carry
+    carry_matches = Pipeline.carry_matches
+    restore_carry = Pipeline.restore_carry
+
+
+class DagPipeline:
+    """A general device-plane stage DAG compiled as ONE multi-output program.
+
+    The explicit node/edge generalization of :class:`FanoutPipeline`: nested
+    fan-out (a node's value consumed by several nodes, at ANY depth), fan-IN
+    (a node whose first stage is a :class:`MergeStage` over K ordered input
+    nodes), and their closure — the diamond ``producer → broadcast →
+    branches → merge`` — all collapse into one XLA program whose outputs are
+    the DAG's SINK set. This is the compute plane of the whole-receiver
+    fusion pass (``runtime/devchain.py``): a ``sync → {demod, chan-est} →
+    decode`` region becomes one dispatch per frame with zero interior
+    host↔device traffic (the whole-program handoff of arXiv:1810.09868).
+
+    ``nodes`` is a sequence of ``(stage_list, input_ids)`` in TOPOLOGICAL
+    order: node 0 is the root (``input_ids == []``, reads the program input);
+    every other node lists the node indices feeding it (all ``< i``). A node
+    with several inputs must START with a ``MergeStage(k == len(inputs))``;
+    plain stages compose linearly after it. Sinks (nodes no other node
+    consumes, in index order) are the program outputs.
+
+    Donation contract — exactly :class:`FanoutPipeline`'s, generalized: the
+    flat carries and the input wire parts are donation-safe
+    (:meth:`donation_mask`); any MULTIPLY-consumed interior value is a node
+    output read by several nodes, which is never a program argument, so no
+    donation mask can alias it. The devchain builder additionally pins every
+    such value (and every member boundary) to standalone numerics with a
+    carry-stash ``devchain_boundary`` fence — a program output root.
+
+    Rate contracts: each sink ``j`` carries ``path_ratios[j]`` (output items
+    per region-input item — through a merge this SUMS the joined branches in
+    ``concat`` mode) and ``tag_ratios[j]`` (the tag-index remap along the
+    PRIMARY chain: merges contribute only their own ``ratio``, because tags
+    ride input 0 — see :class:`MergeStage`). ``mode="equal"`` merges whose
+    input paths arrive at different rates raise ``ValueError`` at
+    construction (the devchain finder declines such regions honestly).
+
+    Duck-types the fan-out surface the TPU kernel blocks consume
+    (``n_branches``/``path_ratios``/``out_dtypes``/``branch_out_items``/
+    ``part_counts``/``in_part_count``/``wired_fn``/``donation_mask`` plus the
+    linear compile/checkpoint surface), with ``stages`` the FLAT node-order
+    concatenation — also the carry layout, so ``update_stage`` addressing and
+    carry checkpointing work exactly as on a linear pipeline.
+    """
+
+    def __init__(self, nodes, in_dtype, optimize: bool = False):
+        if not nodes:
+            raise ValueError("DagPipeline needs at least one node")
+        self.in_dtype = np.dtype(in_dtype)
+        self.raw_nodes = [(list(sl), tuple(int(j) for j in inputs))
+                          for sl, inputs in nodes]
+        consumed: dict = {}
+        for i, (_sl, inputs) in enumerate(self.raw_nodes):
+            if i == 0:
+                if inputs:
+                    raise ValueError("node 0 is the root and takes the "
+                                     "program input (input_ids must be [])")
+            elif not inputs:
+                raise ValueError(f"node {i} has no inputs (one root only)")
+            for j in inputs:
+                if not 0 <= j < i:
+                    raise ValueError(
+                        f"node {i} input {j} violates topological order")
+                consumed[j] = consumed.get(j, 0) + 1
+        self.sinks = [i for i in range(len(self.raw_nodes))
+                      if i not in consumed]
+        # -- per-node stage lists (optionally LTI-merged per linear segment) --
+        self._nodes: list = []           # (stages, inputs, carry_offset)
+        self.stages: list = []
+        # -- rate/dtype walk: r = items per region-input item in front of the
+        # value; fm accumulates the region-input frame multiple exactly like
+        # Pipeline's scan, but per DAG path --
+        fm = 1
+        node_r: list = []                # per node: output rate
+        node_dt: list = []               # per node: output dtype
+        node_tag_r: list = []            # per node: primary-chain tag remap
+        for i, (sl, inputs) in enumerate(self.raw_nodes):
+            stages = list(sl)
+            if len(inputs) > 1:
+                if not stages or not isinstance(stages[0], MergeStage):
+                    raise ValueError(
+                        f"node {i} joins {len(inputs)} inputs but does not "
+                        f"start with a MergeStage")
+                m = stages[0]
+                if m.k != len(inputs):
+                    raise ValueError(
+                        f"node {i}: MergeStage k={m.k} != {len(inputs)} "
+                        f"inputs")
+                in_rs = [node_r[j] for j in inputs]
+                in_dts = {np.dtype(node_dt[j]) for j in inputs}
+                if len(in_dts) != 1:
+                    raise ValueError(
+                        f"node {i}: merge inputs disagree on dtype "
+                        f"({sorted(str(d) for d in in_dts)})")
+                for r_i in in_rs:
+                    need = Fraction(m.frame_multiple, 1) / r_i
+                    fm = int(np.lcm(fm, need.numerator))
+                if m.mode == "equal":
+                    if len(set(in_rs)) != 1:
+                        raise ValueError(
+                            f"node {i}: equal-mode merge rate contract "
+                            f"violated (input path rates {in_rs})")
+                    r = in_rs[0] * m.ratio
+                else:                    # concat: output counts every input
+                    r = sum(in_rs, Fraction(0, 1)) * m.ratio
+                fm = int(np.lcm(fm, r.denominator))
+                dt = np.dtype(m.out_dtype) if m.out_dtype is not None \
+                    else in_dts.pop()
+                tag_r = node_tag_r[inputs[0]] * m.ratio
+                rest = stages[1:]
+            else:
+                r = node_r[inputs[0]] if inputs else Fraction(1, 1)
+                dt = np.dtype(node_dt[inputs[0]]) if inputs \
+                    else self.in_dtype
+                tag_r = node_tag_r[inputs[0]] if inputs else Fraction(1, 1)
+                m = None
+                rest = stages
+            if any(isinstance(s, MergeStage) for s in rest):
+                raise ValueError(
+                    f"node {i}: a MergeStage may only be a multi-input "
+                    f"node's FIRST stage")
+            if optimize and rest:
+                rest = _merge_lti(rest, dt)
+            for s in rest:
+                need = Fraction(s.frame_multiple, 1) / r
+                fm = int(np.lcm(fm, need.numerator))
+                r *= s.ratio
+                tag_r *= s.ratio
+                fm = int(np.lcm(fm, r.denominator))
+                if s.out_dtype is not None:
+                    dt = np.dtype(s.out_dtype)
+            node_r.append(r)
+            node_dt.append(dt)
+            node_tag_r.append(tag_r)
+            final = ([m] if m is not None else []) + list(rest)
+            self._nodes.append((final, tuple(inputs), len(self.stages)))
+            self.stages.extend(final)
+        self.frame_multiple = fm
+        self.node_ratios = list(node_r)
+        self.node_dtypes = list(node_dt)
+        # -- fan-out-compatible sink surface ---------------------------------
+        self.n_branches = len(self.sinks)
+        self.path_ratios = [node_r[s] for s in self.sinks]
+        self.tag_ratios = [node_tag_r[s] for s in self.sinks]
+        self.out_dtypes = [node_dt[s] for s in self.sinks]
+        # per sink: does its path cross a concat-mode merge? A concat output
+        # interleaves its inputs' FULL frames back to back, so a partial
+        # (EOS-tail) input frame cannot be represented by a valid-prefix
+        # count — such sinks emit only full frames (the kernels' drain clamps
+        # a partial group's valid to 0; same rule as TpuMergeStage's actor
+        # path), which stays inside the devchain EOS-tail divergence contract
+        crossed = []
+        for i, (_sl, inputs) in enumerate(self.raw_nodes):
+            c = any(crossed[j] for j in inputs)
+            first = self._nodes[i][0][0] if self._nodes[i][0] else None
+            if isinstance(first, MergeStage) and first.mode == "concat":
+                c = True
+            crossed.append(c)
+        self.concat_sinks = [crossed[s] for s in self.sinks]
+        self.ratio = sum(self.path_ratios, Fraction(0, 1))
+        self.out_dtype = self.out_dtypes[0]
+        self._fn = None
+        self._wired_fns = {}
+
+    def init_carry(self):
+        """Flat carries in node order, matching ``self.stages`` (the
+        ``update_stage`` / checkpoint addressing contract)."""
+        carries = []
+        for i, (stages, inputs, _off) in enumerate(self._nodes):
+            dt = self.in_dtype if not inputs \
+                else np.dtype(self.node_dtypes[inputs[0]])
+            for s in stages:
+                carries.append(s.init_carry(dt))
+                if s.out_dtype is not None:
+                    dt = np.dtype(s.out_dtype)
+        return tuple(carries)
+
+    def fn(self):
+        """``run(carries, x) -> (carries, (y_sink0, …))``: every interior
+        edge stays in-program — a multiply-consumed node output feeds each
+        consumer without rematerialization, a merge node reads its K input
+        values as one tuple."""
+        if self._fn is None:
+            nodes = self._nodes
+            sinks = self.sinks
+
+            def run(carries, x):
+                new_c = list(carries)
+                vals: list = [None] * len(nodes)
+                for i, (stages, inputs, off) in enumerate(nodes):
+                    if not inputs:
+                        v = x
+                    elif len(inputs) == 1:
+                        v = vals[inputs[0]]
+                    else:
+                        v = tuple(vals[j] for j in inputs)
+                    for si, s in enumerate(stages):
+                        c, v = s.fn(carries[off + si], v)
+                        new_c[off + si] = c
+                    vals[i] = v
+                return tuple(new_c), tuple(vals[s] for s in sinks)
+
+            self._fn = run
+        return self._fn
+
+    # the per-sink item math, flat multi-output wired form, donation mask and
+    # the linear compile/checkpoint surface are exactly the fan-out
+    # pipeline's — the sink tuple quacks like the branch tuple (part_counts
+    # gives the split)
+    branch_out_items = FanoutPipeline.branch_out_items
+    out_items = FanoutPipeline.out_items
+    part_counts = FanoutPipeline.part_counts
+    in_part_count = FanoutPipeline.in_part_count
+    wired_fn = FanoutPipeline.wired_fn
+    donation_mask = FanoutPipeline.donation_mask
+    compile = Pipeline.compile
+    compile_wired = Pipeline.compile_wired
+    update_stage = Pipeline.update_stage
     snapshot_carry = Pipeline.snapshot_carry
     carry_matches = Pipeline.carry_matches
     restore_carry = Pipeline.restore_carry

@@ -21,7 +21,16 @@ At launch the supervisor calls :func:`find_device_chains`; each detected run —
   every branch INSIDE one multi-output program
   (:class:`~futuresdr_tpu.ops.stages.FanoutPipeline` /
   :class:`~futuresdr_tpu.tpu.TpuFanoutKernel`), so the scarce H2D link is
-  paid once instead of N times and 2N+1 per-frame dispatches become 1
+  paid once instead of N times and 2N+1 per-frame dispatches become 1, or
+* a GENERAL DAG region (round 13): NESTED fan-out (a broadcast inside a
+  branch, any depth) and FAN-IN — K branch tails joining a frame-plane
+  :class:`~futuresdr_tpu.tpu.frames.TpuMergeStage` — including the diamond
+  ``producer → broadcast → branches → merge`` closure (WLAN
+  ``sync → {demod, chan-est} → decode``, FM ``demod → {audio, RDS} → mux``):
+  the whole receiver graph becomes ONE multi-output dispatch per frame
+  (:class:`~futuresdr_tpu.ops.stages.DagPipeline` /
+  :class:`~futuresdr_tpu.tpu.TpuDagKernel`) whose interior edges never touch
+  the host — the merge point's D2H→host→H2D bounce disappears
 
 — is collapsed into one fused :class:`~futuresdr_tpu.tpu.TpuKernel` whose
 ``Pipeline`` is the concatenation of the member stage lists (composed with
@@ -57,8 +66,13 @@ Refusals (the run stays on the actor path):
   the WHOLE region to per-hop mode: all-or-nothing);
 * mismatched wire formats at the fused edges;
 * a broadcast whose edges do not ALL open fusable consumer runs (a tap to a
-  host sink, a policy-bearing branch member, …), a nested fan-out inside a
-  branch (v1 fuses one broadcast level), or any port MERGE;
+  host sink, a policy-bearing branch member, …) — nested fan-out and
+  frame-plane merges FUSE since round 13; what still refuses is a merge
+  taking an input from OUTSIDE the region (multi-root, v2), an equal-mode
+  merge whose input paths arrive at different rates (rate-contract
+  violation), and a region whose sink feeds host blocks that loop back into
+  it (a cycle through host edges — the fused block cannot honor the per-hop
+  loop's interior queue slack);
 * a first-member frame size that is not a multiple of the COMPOSED pipeline's
   frame multiple;
 * a per-kernel ``devchain = False`` opt-out, or ``FSDR_NO_DEVCHAIN=1``
@@ -92,7 +106,7 @@ from __future__ import annotations
 import asyncio
 import os
 from fractions import Fraction
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 from ..log import logger
 from ..telemetry.spans import recorder as _trace_recorder
@@ -138,21 +152,35 @@ def devchain_enabled() -> bool:
 class DevChain(list):
     """Fusable device-plane region in topological order. ``kind`` is
     ``"frames"`` (TpuH2D → TpuStage* → TpuD2H) or ``"kernels"`` (adjacent
-    TpuKernels). A LINEAR run is the flat member list; a FAN-OUT region also
-    carries its topology: ``producer`` (the shared head run) and ``branches``
-    (one member list per consumer run), with the flat list being
+    TpuKernels). A LINEAR run is the flat member list; a single-level FAN-OUT
+    region also carries its topology: ``producer`` (the shared head run) and
+    ``branches`` (one member list per consumer run), with the flat list being
     ``producer + branches[0] + … + branches[N-1]`` — the composed-stage /
-    metrics / ctrl addressing order everywhere downstream."""
+    metrics / ctrl addressing order everywhere downstream. A general DAG
+    region (nested fan-out, fan-IN merges, the diamond closure) instead
+    carries ``nodes`` (per member, in flat/topological order: the member
+    indices feeding it — a ``TpuMergeStage`` member lists its K ordered
+    inputs), ``sinks`` (member indices whose outputs leave the region) and
+    ``node_ratios`` (per-member output rate relative to the region input,
+    from the validated :class:`~futuresdr_tpu.ops.stages.DagPipeline`)."""
 
-    def __init__(self, members, kind: str, producer=None, branches=None):
+    def __init__(self, members, kind: str, producer=None, branches=None,
+                 nodes=None, sinks=None, node_ratios=None):
         super().__init__(members)
         self.kind = kind
         self.producer = producer
         self.branches = branches
+        self.nodes = nodes
+        self.sinks = sinks
+        self.node_ratios = node_ratios
 
     @property
     def fanout(self) -> bool:
         return self.branches is not None
+
+    @property
+    def dag(self) -> bool:
+        return self.nodes is not None
 
 
 class _FwdCtrl:
@@ -178,7 +206,7 @@ def find_device_chains(fg) -> List[DevChain]:
     if not devchain_enabled():
         return []
     from ..ops.stages import Pipeline
-    from ..tpu.frames import TpuD2H, TpuH2D, TpuStage
+    from ..tpu.frames import TpuD2H, TpuH2D, TpuMergeStage, TpuStage
     from ..tpu.kernel_block import TpuKernel
 
     msg_touched = {id(e.src) for e in fg.message_edges} | \
@@ -306,173 +334,353 @@ def find_device_chains(fg) -> List[DevChain]:
                                producer=list(producer),
                                branches=[list(br) for br in branches]))
 
+    def _host_cycle(members) -> bool:
+        """True when a DATA path LEAVES the region (a sink's stream consumer)
+        and re-enters it through host blocks — a cycle the fused kernel
+        cannot honor (the per-hop pipeline's interior queue slack is what
+        kept the loop fed; collapsing the region to one block changes that
+        depth). Only backpressure-coupled edges (stream + inplace) count:
+        a MESSAGE edge closing the loop (a measurement block retuning a
+        ``devchain_static`` member's ``ctrl`` — AGC/AFC feedback) is fine,
+        because message inboxes are unbounded and the drive loop applies
+        ctrl between dispatches, so no deadlock coupling exists there."""
+        member_ids = {id(m) for m in members}
+        adj: dict = {}
+        for e in (fg.stream_edges + fg.inplace_edges):
+            adj.setdefault(id(e.src), []).append(e.dst)
+        stack = [d for m in members for d in adj.get(id(m), [])
+                 if id(d) not in member_ids]
+        seen: set = set()
+        while stack:
+            b = stack.pop()
+            if id(b) in seen:
+                continue
+            seen.add(id(b))
+            for d in adj.get(id(b), []):
+                if id(d) in member_ids:
+                    return True
+                stack.append(d)
+        return False
+
+    def _topo(members, node_inputs):
+        """Kahn topological order over the region's node graph; None on a
+        cycle (decline — inplace graphs should be acyclic, but a hand-wired
+        cycle must not wedge the finder)."""
+        n = len(members)
+        indeg = [0] * n
+        cons: List[list] = [[] for _ in range(n)]
+        for i, ins in enumerate(node_inputs):
+            for j in ins:
+                indeg[i] += 1
+                cons[j].append(i)
+        order = [i for i in range(n) if indeg[i] == 0]
+        qi = 0
+        while qi < len(order):
+            for c in cons[order[qi]]:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    order.append(c)
+            qi += 1
+        return order if len(order) == n else None
+
+    def _close_dag(members, node_inputs, kind) -> None:
+        """Validate and claim one GENERAL DAG region (nested fan-out, fan-in
+        merges, the diamond closure) — all-or-nothing, exactly like the
+        linear/fan-out closers: any cross-member contract violation declines
+        the whole region to the per-hop actor path."""
+        from ..ops.stages import DagPipeline
+        first = members[0]
+        if len({id(m.inst) for m in members}) != 1:
+            log.debug("devchain refuses DAG %s: mismatched TpuInstances",
+                      members)
+            return
+        in_dtype = first.dtype if kind == "frames" else first.pipeline.in_dtype
+        try:
+            # _member_fused_stages is THE member→stage-list mapping (shared
+            # with the builder, so the finder can never validate a different
+            # stage list than _build_fused_dag compiles)
+            dag = DagPipeline(
+                [(_member_fused_stages(m), node_inputs[i])
+                 for i, m in enumerate(members)], in_dtype, optimize=False)
+        except ValueError as e:
+            # merge rate-contract violations, malformed merges, … — the
+            # region declines honestly rather than fusing something whose
+            # composed contract the actor path does not have
+            log.debug("devchain refuses DAG %s: %s", members, e)
+            return
+        # ONE definition of "sink" everywhere: the validated pipeline's
+        # (consumer-free nodes) — the wire check, the dtype check and the
+        # claimed chain all read dag.sinks
+        if kind == "frames":
+            wired = [first] + [members[i] for i in dag.sinks]
+        else:
+            wired = members
+        if len({m.wire.name for m in wired}) != 1:
+            log.debug("devchain refuses DAG %s: wire mismatch", members)
+            return
+        if first.frame_size % dag.frame_multiple != 0:
+            log.debug("devchain refuses DAG %s: frame %d not a multiple of "
+                      "the composed contract %d", members, first.frame_size,
+                      dag.frame_multiple)
+            return
+        if kind == "frames":
+            import numpy as np
+            for j, i in enumerate(dag.sinks):
+                if np.dtype(dag.out_dtypes[j]) != np.dtype(members[i].dtype):
+                    # the unfused TpuD2H casts to ITS dtype at decode (same
+                    # rule as the linear/fan-out closers)
+                    log.debug("devchain refuses DAG %s: D2H dtype %s != "
+                              "composed %s", members, members[i].dtype,
+                              dag.out_dtypes[j])
+                    return
+        claimed.update(id(m) for m in members)
+        chains.append(DevChain(members, kind, nodes=list(node_inputs),
+                               sinks=list(dag.sinks),
+                               node_ratios=list(dag.node_ratios)))
+
+    def _classify(node_inputs) -> str:
+        """``linear`` / ``fanout`` (single broadcast level, no merge — the
+        PR 6 shape) / ``dag`` (everything else the new path fuses)."""
+        if any(len(ins) > 1 for ins in node_inputs):
+            return "dag"
+        cons = [0] * len(node_inputs)
+        for ins in node_inputs:
+            for j in ins:
+                cons[j] += 1
+        multi = [i for i, c in enumerate(cons) if c > 1]
+        if not multi:
+            return "linear"
+        return "fanout" if len(multi) == 1 else "dag"
+
+    def _split_fanout(members, node_inputs):
+        """Decompose a single-broadcast tree into (producer, branches) — the
+        PR 6 representation (flat order producer + branches concatenated)."""
+        n = len(members)
+        cons: List[list] = [[] for _ in range(n)]
+        for i, ins in enumerate(node_inputs):
+            for j in ins:
+                cons[j].append(i)
+        b = next(i for i in range(n) if len(cons[i]) > 1)
+        producer, cur = [], 0
+        while True:
+            producer.append(members[cur])
+            if cur == b:
+                break
+            cur = cons[cur][0]
+        branches = []
+        for head in cons[b]:
+            br, cur = [], head
+            while True:
+                br.append(members[cur])
+                if not cons[cur]:
+                    break
+                cur = cons[cur][0]
+            branches.append(br)
+        return producer, branches
+
+    def _chain_order(members, node_inputs):
+        """Flat member order of a linear region (root → sink)."""
+        n = len(members)
+        nxt = {}
+        for i, ins in enumerate(node_inputs):
+            for j in ins:
+                nxt[j] = i
+        out, cur = [members[0]], 0
+        while cur in nxt:
+            cur = nxt[cur]
+            out.append(members[cur])
+        return out
+
+    def _close_region(members, node_inputs, kind) -> None:
+        shape = _classify(node_inputs)
+        if _host_cycle(members):
+            log.debug("devchain refuses %s region %s: cycle through host "
+                      "edges", shape, members)
+            return
+        if shape == "linear":
+            if len(members) >= 2:
+                _close(_chain_order(members, node_inputs), kind)
+        elif shape == "fanout":
+            producer, branches = _split_fanout(members, node_inputs)
+            _close_fanout(producer, branches, kind)
+        else:
+            _close_dag(members, node_inputs, kind)
+
     kernels = [b.kernel for b in fg._blocks if b is not None]
 
-    # ---- frame-plane regions: TpuH2D → TpuStage* → (fan-out →) TpuD2H ------
+    # ---- frame-plane regions: the general DAG rooted at a TpuH2D ------------
+    # (linear runs, single- and NESTED fan-out, fan-IN through TpuMergeStage,
+    # and the diamond broadcast→merge closure — one grower, all-or-nothing)
+    def _grow_frame_dag(root):
+        """Forward closure of ``root`` over inplace edges; returns
+        ``(members, node_inputs)`` in topological order, or None when any
+        reachable consumer refuses (the whole region declines)."""
+        members, idx = [root], {id(root): 0}
+        qi = 0
+        while qi < len(members):
+            cur = members[qi]
+            qi += 1
+            if type(cur) is TpuD2H:
+                continue                 # sinks end the plane
+            outs = i_out.get(id(cur), [])
+            if not outs:
+                log.debug("devchain refuses region at %s: dangling device "
+                          "node %s", root, cur)
+                return None
+            for e in outs:
+                nxt = e.dst
+                if id(nxt) in idx:
+                    continue             # another edge into a known member
+                if type(nxt) not in (TpuStage, TpuMergeStage, TpuD2H) \
+                        or id(nxt) in claimed or not member_ok(nxt):
+                    log.debug("devchain refuses region at %s: consumer %s",
+                              root, nxt)
+                    return None
+                if type(nxt) in (TpuStage, TpuMergeStage) \
+                        and nxt._carry is not None:
+                    # mid-stream state from a previous run: the actor path
+                    # resumes it, a fused fresh carry would not
+                    log.debug("devchain refuses region at %s: %s carries "
+                              "mid-stream state", root, nxt)
+                    return None
+                if type(nxt) is TpuD2H and (
+                        i_out.get(id(nxt)) or not s_out.get(id(nxt))):
+                    log.debug("devchain refuses region at %s: D2H %s must "
+                              "exit to the stream plane", root, nxt)
+                    return None
+                idx[id(nxt)] = len(members)
+                members.append(nxt)
+        node_inputs: List[list] = []
+        for m in members:
+            if m is root:
+                node_inputs.append([])
+                continue
+            ins = i_in.get(id(m), [])
+            if type(m) is TpuMergeStage:
+                by_port = {}
+                for e in ins:
+                    if e.dst_port in by_port:
+                        log.debug("devchain refuses region at %s: merge "
+                                  "port %s double-wired", root, e.dst_port)
+                        return None
+                    by_port[e.dst_port] = e.src
+                srcs = []
+                for i in range(m.merge.k):
+                    s = by_port.get(f"in{i}")
+                    if s is None:
+                        log.debug("devchain refuses region at %s: merge "
+                                  "input in%d unwired", root, i)
+                        return None
+                    srcs.append(s)
+            else:
+                if len(ins) != 1:
+                    log.debug("devchain refuses region at %s: %s has %d "
+                              "inputs", root, m, len(ins))
+                    return None
+                srcs = [ins[0].src]
+            if any(id(s) not in idx for s in srcs):
+                # an input from OUTSIDE the closure: a second root feeding
+                # the merge (multi-root regions decline, v1)
+                log.debug("devchain refuses region at %s: %s takes an "
+                          "input from outside the region", root, m)
+                return None
+            node_inputs.append([idx[id(s)] for s in srcs])
+        order = _topo(members, node_inputs)
+        if order is None:
+            log.debug("devchain refuses region at %s: cyclic inplace graph",
+                      root)
+            return None
+        remap = {old: new for new, old in enumerate(order)}
+        members = [members[i] for i in order]
+        node_inputs = [[remap[j] for j in node_inputs[i]] for i in order]
+        return members, node_inputs
+
     for k in kernels:
         if type(k) is not TpuH2D or id(k) in claimed or not member_ok(k):
             continue
         if len(s_in.get(id(k), [])) != 1 or not i_out.get(id(k)):
             continue                     # unwired H2D
-        members, cur, ok = [k], k, True
-        local_seen = {id(k)}             # diamond/merge guard within a region
-        branches = None
+        region = _grow_frame_dag(k)
+        if region is not None and len(region[0]) >= 2:
+            _close_region(region[0], region[1], "frames")
 
-        def _frames_branch(edge):
-            """One fan-out branch from the broadcast edge: TpuStage* → TpuD2H
-            (each hop single-in/single-out). Returns members or None."""
-            out, b_cur = [], edge.dst
-            while True:
-                if id(b_cur) in claimed or id(b_cur) in local_seen \
-                        or not member_ok(b_cur) \
-                        or len(i_in.get(id(b_cur), [])) != 1:
-                    return None
-                if type(b_cur) is TpuStage:
-                    if b_cur._carry is not None:
-                        return None      # mid-stream state: actor path
-                    b_outs = i_out.get(id(b_cur), [])
-                    if len(b_outs) != 1:
-                        return None      # nested fan-out: refuse (v1)
-                    out.append(b_cur)
-                    local_seen.add(id(b_cur))
-                    b_cur = b_outs[0].dst
-                    continue
-                if type(b_cur) is TpuD2H:
-                    if i_out.get(id(b_cur)) or not s_out.get(id(b_cur)):
-                        return None      # D2H must exit to the stream plane
-                    out.append(b_cur)
-                    local_seen.add(id(b_cur))
-                    return out
-                return None              # a foreign consumer on the plane
-
-        while True:
-            outs = i_out.get(id(cur), [])
-            if len(outs) > 1:
-                # fan-out point: EVERY edge must open a fusable branch, or
-                # the whole region declines to per-hop mode (all-or-nothing)
-                brs = []
-                for e in outs:
-                    br = _frames_branch(e)
-                    if br is None:
-                        brs = None
-                        break
-                    brs.append(br)
-                if brs is None:
-                    ok = False
-                else:
-                    branches = brs
-                break
-            if len(outs) != 1:
-                ok = False
-                break
-            nxt = outs[0].dst
-            if id(nxt) in claimed or id(nxt) in local_seen \
-                    or not member_ok(nxt) \
-                    or len(i_in.get(id(nxt), [])) != 1:
-                ok = False
-                break
-            if type(nxt) is TpuStage:
-                if nxt._carry is not None:
-                    ok = False   # mid-stream state from a previous run: the
-                    break        # actor path resumes it, a fused fresh carry
-                                 # would not (fastchain's _hist rule)
-                members.append(nxt)
-                local_seen.add(id(nxt))
-                cur = nxt
-                continue
-            if type(nxt) is TpuD2H:
-                if i_out.get(id(nxt)) or not s_out.get(id(nxt)):
-                    ok = False           # D2H must exit to the stream plane
-                    break
-                members.append(nxt)
-                break
-            ok = False                   # a foreign consumer on the plane
-            break
-        if ok and branches is not None:
-            _close_fanout(members, branches, "frames")
-        elif ok and len(members) >= 2:
-            _close(members, "frames")
-
-    # ---- adjacent TpuKernel runs over stream edges --------------------------
+    # ---- TpuKernel regions over stream edges (out-trees: linear runs and
+    # fan-outs at ANY depth; stream ports are single-writer, so fan-IN is
+    # inexpressible on this plane — it rides the frame plane's merge block) --
     def _kernel_ok(k) -> bool:
-        # exact-type check: a TpuFanoutKernel (or any subclass) manages its
-        # own branches and never joins a chain
+        # exact-type check: a TpuFanoutKernel/TpuDagKernel (or any subclass)
+        # manages its own sinks and never joins a chain
         return (type(k) is TpuKernel and id(k) not in claimed and member_ok(k)
                 and not i_out.get(id(k)) and not i_in.get(id(k)))
 
-    def _link(a) -> Optional[object]:
-        """The next TpuKernel if ``a``'s single output edge feeds one."""
-        outs = s_out.get(id(a), [])
-        if len(outs) != 1:
-            return None                  # broadcast: the fan-out pass owns it
-        nxt = outs[0].dst
-        if not _kernel_ok(nxt) or len(s_in.get(id(nxt), [])) != 1:
-            return None
-        if id(nxt.inst) != id(a.inst) or nxt.wire.name != a.wire.name:
-            return None
-        return nxt
+    def _follows(a, b) -> bool:
+        """``b`` can extend a region whose member ``a`` feeds it."""
+        return (_kernel_ok(b) and len(s_in.get(id(b), [])) == 1
+                and id(b.inst) == id(a.inst) and b.wire.name == a.wire.name)
+
+    def _will_extend(src, k) -> bool:
+        """``src``'s region will actually absorb its consumer ``k``: a single
+        edge extends when the consumer follows; a BROADCAST extends only when
+        EVERY consumer follows (mixed broadcasts truncate — see the grower)."""
+        outs = s_out.get(id(src), [])
+        if len(outs) == 1:
+            return _follows(src, k)
+        return all(_follows(src, e.dst) for e in outs)
 
     def _is_head(k) -> bool:
-        """A run head: the upstream is not itself a fusable link into k."""
+        """A region head: no fusable upstream will absorb k. Mirrors the
+        grower exactly: under a MIXED broadcast (one consumer not fusable)
+        the producer's region truncates at the broadcast owner, so each
+        fusable branch head IS a head and fuses its own run — the round-11
+        behavior (the prefix and every clean branch still fuse linearly)."""
         ups = s_in.get(id(k), [])
         return not (len(ups) == 1 and _kernel_ok(ups[0].src)
-                    and _link(ups[0].src) is k)
+                    and _will_extend(ups[0].src, k))
 
-    # fan-out pass FIRST: a branch head looks like a run head to the linear
-    # pass (its upstream broadcasts, so _link is None there) — detecting
-    # fan-outs before linear runs keeps a later-listed producer from losing
-    # its branches to premature linear claims
-    for k in kernels:
-        if not _kernel_ok(k) or not _is_head(k):
-            continue
-        members, cur = [k], k
-        while True:
-            nxt = _link(cur)
-            if nxt is None:
-                break
-            members.append(nxt)
-            cur = nxt
-        outs = s_out.get(id(cur), [])
-        if len(outs) <= 1:
-            continue                     # linear run: the next pass owns it
-        local_seen = {id(m) for m in members}
-        branches = []
-        for e in outs:
-            head = e.dst
-            if not _kernel_ok(head) or id(head) in local_seen \
-                    or len(s_in.get(id(head), [])) != 1 \
-                    or id(head.inst) != id(cur.inst) \
-                    or head.wire.name != cur.wire.name:
-                branches = None
-                break
-            br, b_cur = [head], head
-            local_seen.add(id(head))
-            while True:
-                nxt = _link(b_cur)
-                if nxt is None or id(nxt) in local_seen:
-                    break
-                br.append(nxt)
-                local_seen.add(id(nxt))
-                b_cur = nxt
-            if s_out.get(id(b_cur), []) and len(s_out.get(id(b_cur), [])) > 1:
-                branches = None          # nested fan-out: refuse (v1)
-                break
-            branches.append(br)
-        if branches is not None:
-            _close_fanout(members, branches, "kernels")
+    def _grow_kernel_tree(root):
+        """Forward closure of ``root`` over stream edges: a branch ENDS at a
+        non-fusable single consumer (the member becomes a sink feeding it),
+        and a BROADCAST with any non-fusable consumer TRUNCATES the region at
+        the broadcast owner — its output port is driven by the fused kernel
+        and the port group still broadcasts to every (unfused) consumer,
+        exactly as a round-8 linear chain ending on a broadcasting port did;
+        the fusable branches fuse as their own regions (``_is_head``). BFS
+        order is topological for an out-tree."""
+        members, idx = [root], {id(root): 0}
+        node_inputs: List[list] = [[]]
+        qi = 0
+        while qi < len(members):
+            cur = members[qi]
+            qi += 1
+            outs = s_out.get(id(cur), [])
+            if len(outs) == 1:
+                nxt = outs[0].dst
+                if not _follows(cur, nxt) or id(nxt) in idx:
+                    continue             # branch ends: cur is a region sink
+                idx[id(nxt)] = len(members)
+                members.append(nxt)
+                node_inputs.append([idx[id(cur)]])
+            elif len(outs) > 1:
+                if any(not _follows(cur, e.dst) or id(e.dst) in idx
+                       for e in outs):
+                    log.debug("devchain region at %s truncates at %s: mixed "
+                              "broadcast (a consumer is not fusable)",
+                              root, cur)
+                    continue             # cur is a region sink; port-group
+                    #                      broadcast serves the consumers
+                for e in outs:
+                    nxt = e.dst
+                    idx[id(nxt)] = len(members)
+                    members.append(nxt)
+                    node_inputs.append([idx[id(cur)]])
+        return members, node_inputs
 
     for k in kernels:
         if not _kernel_ok(k) or not _is_head(k):
             continue
-        members, cur = [k], k
-        while True:
-            nxt = _link(cur)
-            if nxt is None:
-                break
-            members.append(nxt)
-            cur = nxt
-        if len(members) >= 2:
-            _close(members, "kernels")
+        region = _grow_kernel_tree(k)
+        if region is not None and len(region[0]) >= 2:
+            _close_region(region[0], region[1], "kernels")
     return chains
 
 
@@ -541,6 +749,8 @@ def _build_fused(chain: DevChain):
     from ..ops.stages import Pipeline
     from ..tpu.kernel_block import TpuKernel
 
+    if chain.dag:
+        return _build_fused_dag(chain)
     if chain.fanout:
         return _build_fused_fanout(chain)
 
@@ -711,6 +921,93 @@ def _build_fused_fanout(chain: DevChain):
     return fused
 
 
+def _member_fused_stages(m) -> list:
+    """THE member → fused-stage-list mapping, shared by the finder's DAG
+    validation and the builder: ``[merge] + post`` for a TpuMergeStage, the
+    pipeline stages for TpuStage/TpuKernel, [] for the stage-less H2D/D2H
+    endpoints."""
+    from ..tpu.frames import TpuMergeStage
+    if type(m) is TpuMergeStage:
+        return [m.merge] + list(m.post)
+    p = getattr(m, "pipeline", None)
+    return list(p.stages) if p is not None else []
+
+
+def _build_fused_dag(chain: DevChain):
+    """One :class:`~futuresdr_tpu.tpu.TpuDagKernel` over the region's general
+    DAG, driving the root's ORIGINAL input port and each SINK's ORIGINAL
+    output port.
+
+    Fences (:func:`_boundary_stage`): every INTERIOR member gets a trailing
+    carry-stash fence — which uniformly covers all three fence roles of the
+    linear/fan-out builders: the frame-plane edge fences (the stage-less
+    H2D/D2H endpoints contribute fence-only nodes), the member-boundary
+    fences that pin each member segment to its standalone numerics, and the
+    multiply-consumed-value fences (a broadcast point is always a member
+    boundary, so its value is a program OUTPUT root that donation can never
+    alias — the PR 6 contract, generalized). MERGE inputs are member
+    boundaries too, so each joined value is pinned before the merge reads
+    it — the fused diamond reads bit-identical branch values to the per-hop
+    broadcast run. KERNELS-plane SINKS carry no trailing fence, mirroring
+    the linear/fan-out builders' no-edge-fence rule there: the unfused
+    TpuKernel lets XLA fuse its final stage into the wire encode, and the
+    fused sink must compile to the same numerics."""
+    from ..ops.stages import DagPipeline
+    from ..tpu.kernel_block import TpuDagKernel
+
+    members = list(chain)
+    first = members[0]
+    frame = first.frame_size
+    in_dtype = first.dtype if chain.kind == "frames" \
+        else first.pipeline.in_dtype
+    # a no-fence validation pass resolves every node's output rate/dtype —
+    # the fence sizes (the finder already built this once; rebuilding keeps
+    # the builder usable standalone)
+    plain = DagPipeline([(_member_fused_stages(m), chain.nodes[i])
+                         for i, m in enumerate(members)], in_dtype,
+                        optimize=False)
+    slices: list = []
+    nodes: list = []
+    off = 0
+    import numpy as np
+    sink_set = set(plain.sinks)
+    for i, m in enumerate(members):
+        sl = _member_fused_stages(m)
+        stages = list(sl)
+        if not (chain.kind == "kernels" and i in sink_set):
+            # trailing boundary fence (docstring); kernels-plane sinks skip
+            # it so the final stage fuses into the wire encode exactly as
+            # the member's own standalone program would
+            q = Fraction(frame) * plain.node_ratios[i]
+            assert q.denominator == 1, (frame, plain.node_ratios[i])
+            stages.append(_boundary_stage(int(q),
+                                          np.dtype(plain.node_dtypes[i])))
+        slices.append((off, off + len(sl)))      # member-local ctrl range
+        off += len(stages)
+        nodes.append((stages, chain.nodes[i]))
+    # optimize=False: the bit-equality contract, exactly as the linear builder
+    dag = DagPipeline(nodes, in_dtype, optimize=False)
+    depth = first.max_inflight if chain.kind == "frames" else first.depth
+    k_batch = _resolve_k_batch(first, chain.kind, dag, in_dtype)
+    fused = TpuDagKernel(dag, frame_size=frame, inst=first.inst,
+                         frames_in_flight=depth, wire=first.wire,
+                         frames_per_dispatch=k_batch)
+    assert fused.frame_size == frame, (fused.frame_size, frame)
+    # steal the boundary ports: the region's own input and each sink's own
+    # output — buffers, tags and backpressure stay the live flowgraph's
+    tails = [members[i] for i in chain.sinks]
+    fused._stream_inputs = [first.input]
+    fused.input = first.input
+    fused._stream_outputs = [t.output for t in tails]
+    fused.outputs = [t.output for t in tails]
+    fused.output = fused.outputs[0]
+    fused.meta.instance_name = (
+        f"devchain[{type(first).__name__}…x{len(members)}"
+        f"⋈{len(tails)}]")
+    fused._dc_slices = slices
+    return fused
+
+
 def _port_name(kernel, port):
     """Resolve a Call/Callback port id to a handler NAME the way
     ``Kernel.call_handler`` does (PortId / int index / str)."""
@@ -757,6 +1054,12 @@ def _apply_ctrl(fused, member_kernels, idx: int, port, p):
     try:
         stage, params = parse_ctrl(p)
         _apply_stage_update(fused, idx, stage, params)
+        # retune-in-replay observability (docs/robustness.md): a retune
+        # landing while the fused kernel is replaying checkpointed groups
+        # logs a structured warning naming the chain and the replayed-frame
+        # count (the recovered stream re-dispatches those frames with the
+        # NEW parameters)
+        fused.warn_retune_in_replay()
     except Exception as e:                             # noqa: BLE001
         log.warning("devchain ctrl rejected: %r", e)
         return Pmt.invalid_value()
@@ -786,7 +1089,36 @@ def _chain_rates(chain: DevChain) -> list:
     cumulative out-rate, branch)`` relative to the fused region's input.
     ``branch`` is None for linear chains and producer members, else the
     member's branch index — fan-out branch members restart the cumulative
-    walk from the producer's boundary rate."""
+    walk from the producer's boundary rate. DAG regions read the validated
+    node rates (``chain.node_ratios``); a merge member's in-rate is the
+    TUPLE of its input-port rates, and ``branch`` becomes the member's SINK
+    index when exactly one sink consumes it (shared producers report
+    None)."""
+    if chain.dag:
+        n = len(chain)
+        cons: list = [[] for _ in range(n)]
+        for i, ins in enumerate(chain.nodes):
+            for j in ins:
+                cons[j].append(i)
+        # per member: the set of sinks its value reaches (for attribution)
+        reach = [set() for _ in range(n)]
+        for pos, s in enumerate(chain.sinks):
+            reach[s].add(pos)
+        for i in range(n - 1, -1, -1):
+            for c in cons[i]:
+                reach[i] |= reach[c]
+        out = []
+        for i, m in enumerate(chain):
+            ins = chain.nodes[i]
+            if not ins:
+                r_in = Fraction(1, 1)
+            elif len(ins) == 1:
+                r_in = chain.node_ratios[ins[0]]
+            else:
+                r_in = tuple(chain.node_ratios[j] for j in ins)
+            branch = next(iter(reach[i])) if len(reach[i]) == 1 else None
+            out.append((m, r_in, chain.node_ratios[i], branch))
+        return out
     out = []
     producer = chain.producer if chain.fanout else list(chain)
     r_in = Fraction(1, 1)
@@ -805,11 +1137,17 @@ def _chain_rates(chain: DevChain) -> list:
     return out
 
 
-def _set_member_counters(m, boundary, items: int, r_in: Fraction,
+def _set_member_counters(m, boundary, items: int, r_in,
                          r_out: Fraction) -> None:
-    for p in m.stream_inputs:
-        if id(p) not in boundary:          # boundary counters are live
-            p.items_consumed = int(items * r_in)
+    if isinstance(r_in, tuple):
+        # a merge member: one in-rate per ordered input port
+        for p, r in zip(m.stream_inputs, r_in):
+            if id(p) not in boundary:
+                p.items_consumed = int(items * r)
+    else:
+        for p in m.stream_inputs:
+            if id(p) not in boundary:      # boundary counters are live
+                p.items_consumed = int(items * r_in)
     for p in m.stream_outputs:
         if id(p) not in boundary:
             p.items_produced = int(items * r_out)
@@ -980,7 +1318,9 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
     # produce/consume notifications land on THOSE, because the boundary
     # buffers were bound to them at materialize time. Linear chains have one
     # tail (the last member); fan-out regions one per branch.
-    if chain.fanout:
+    if chain.dag:
+        tail_idx = list(chain.sinks)
+    elif chain.fanout:
         tail_idx = []
         off = len(chain.producer)
         for br in chain.branches:
@@ -989,6 +1329,7 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
     else:
         tail_idx = [len(members) - 1]
     tail_set = set(tail_idx)
+    multi_out = chain.fanout or chain.dag
 
     # Intermediate members' inboxes: nothing routes data there, but ctrl
     # Calls/Callbacks must reach the drive thread (carry surgery happens
@@ -1073,12 +1414,12 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
                         kernel.input.set_finished()
                         io.call_again = True
                     elif isinstance(msg, StreamOutputDone):
-                        if chain.fanout:
-                            # one branch's reader detached: retire THAT
-                            # branch, the survivors keep streaming (the
+                        if multi_out:
+                            # one sink's reader detached: retire THAT
+                            # branch/sink, the survivors keep streaming (the
                             # port-group rule — a finished reader is dropped,
                             # not fatal); work() finishes the block when
-                            # every branch retired
+                            # every output retired
                             kernel.retire_branch(branch_of_ib[id(ib)])
                             io.call_again = True
                         else:
@@ -1161,6 +1502,16 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
              "items_out": fused._frames_dispatched * fused.out_frames[j],
              "retired": bool(fused._branch_done[j])}
             for j, i in enumerate(tail_idx)]
+    elif chain.dag:
+        # general DAG regions: per-SINK attribution + the merge count, so a
+        # doctor report names which sink of a fused receiver carried output
+        span_args["sinks"] = [
+            {"sink": j,
+             "tail": members[i].instance_name,
+             "items_out": fused._frames_dispatched * fused.out_frames[j],
+             "retired": bool(fused._branch_done[j])}
+            for j, i in enumerate(tail_idx)]
+        span_args["merges"] = sum(1 for ins in chain.nodes if len(ins) > 1)
     _trace.complete(
         "devchain",
         f"devchain[{members[0].instance_name}…x{len(members)}]", t_chain,

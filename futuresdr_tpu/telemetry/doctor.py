@@ -615,6 +615,10 @@ class Doctor:
                      "frames_per_dispatch": a.get("frames_per_dispatch")}
             if a.get("branches"):
                 entry["branches"] = a["branches"]
+            if a.get("sinks"):
+                # general DAG regions: per-SINK attribution (+ merge count)
+                entry["sinks"] = a["sinks"]
+                entry["merges"] = a.get("merges")
             devchains.append(entry)
         return {
             "wall_s": wall / 1e9,

@@ -7,11 +7,12 @@ whole block chains run as single jitted XLA programs (see :mod:`futuresdr_tpu.op
 """
 
 from .instance import TpuInstance, instance
-from .kernel_block import TpuFanoutKernel, TpuKernel
-from .frames import TpuH2D, TpuStage, TpuD2H
+from .kernel_block import TpuDagKernel, TpuFanoutKernel, TpuKernel
+from .frames import TpuH2D, TpuStage, TpuMergeStage, TpuD2H
 from .autotune import autotune, autotune_streamed
 from .sp_block import SpKernel
 from .pp_block import PpKernel
 
-__all__ = ["TpuInstance", "instance", "TpuKernel", "TpuFanoutKernel", "TpuH2D", "TpuStage", "TpuD2H",
+__all__ = ["TpuInstance", "instance", "TpuKernel", "TpuFanoutKernel",
+           "TpuDagKernel", "TpuH2D", "TpuStage", "TpuMergeStage", "TpuD2H",
            "autotune", "autotune_streamed", "SpKernel", "PpKernel"]

@@ -265,6 +265,46 @@ def _fanout_names(producer_stages, branch_stage_lists) -> tuple:
     return names
 
 
+def _dag_names(dag) -> tuple:
+    """DAG SHAPE signature, CANONICALIZED: linear runs of single-input /
+    single-consumer nodes contract into one group before the per-group
+    ``dag[i<-inputs]`` markers are emitted — so a devchain-composed region
+    (one node per flowgraph MEMBER, plus fence-only endpoint nodes) and a
+    hand-built :class:`~futuresdr_tpu.ops.stages.DagPipeline` of the same
+    stages map to the SAME streamed pick. Boundary fences are filtered
+    exactly as in linear signatures."""
+    nodes = [([s for s in sl
+               if getattr(s, "name", "") != "devchain_boundary"],
+              list(inputs)) for sl, inputs in dag.raw_nodes]
+    n = len(nodes)
+    n_cons = [0] * n
+    for _sl, ins in nodes:
+        for j in ins:
+            n_cons[j] += 1
+    # group assignment in topo (index) order: a node with exactly one input
+    # whose producer has exactly one consumer joins the producer's group
+    group = [0] * n
+    g_stages: Dict[int, list] = {}
+    g_inputs: Dict[int, list] = {}
+    next_g = 0
+    for i, (sl, ins) in enumerate(nodes):
+        if len(ins) == 1 and n_cons[ins[0]] == 1:
+            g = group[ins[0]]
+            group[i] = g
+            g_stages[g].extend(sl)
+        else:
+            g = next_g
+            next_g += 1
+            group[i] = g
+            g_stages[g] = list(sl)
+            g_inputs[g] = [group[j] for j in ins]
+    names: tuple = ()
+    for g in range(next_g):
+        names += (f"dag[{g}<-{','.join(map(str, g_inputs[g]))}]",)
+        names += _sig_names(g_stages[g])
+    return names
+
+
 def _make_sig(platform: str, in_dtype, names: tuple) -> tuple:
     """THE cache-key layout — every signature (linear, fan-out, raw-list)
     must be assembled here so recorder and lookup can never diverge."""
@@ -275,9 +315,13 @@ def _streamed_sig(stages, in_dtype, platform: str) -> tuple:
     """Cache key for one tuned chain: devchain boundary fences are ignored so
     a FUSED composition of the same member stages maps to the same entry.
     A :class:`~futuresdr_tpu.ops.stages.FanoutPipeline` keys on its fan-out
-    shape (:func:`_fanout_names`)."""
-    from ..ops.stages import FanoutPipeline
-    if isinstance(stages, FanoutPipeline):
+    shape (:func:`_fanout_names`); a
+    :class:`~futuresdr_tpu.ops.stages.DagPipeline` on its canonicalized DAG
+    shape (:func:`_dag_names`)."""
+    from ..ops.stages import DagPipeline, FanoutPipeline
+    if isinstance(stages, DagPipeline):
+        names = _dag_names(stages)
+    elif isinstance(stages, FanoutPipeline):
         names = _fanout_names(stages.producer.stages,
                               [b.stages for b in stages.branches])
     else:
@@ -417,18 +461,20 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
     assumed.
 
     ``stages`` may be a ready-made
-    :class:`~futuresdr_tpu.ops.stages.FanoutPipeline` (a fan-out region):
-    the sweep then measures the multi-output drain loop and records the pick
-    under the region's fan-out SHAPE, which the device-graph fusion pass
-    looks up when it launches the fused ``TpuFanoutKernel``."""
+    :class:`~futuresdr_tpu.ops.stages.FanoutPipeline` (a fan-out region) or
+    :class:`~futuresdr_tpu.ops.stages.DagPipeline` (a general DAG region —
+    nested fan-out / merges / the diamond closure): the sweep then measures
+    the multi-output drain loop and records the pick under the region's
+    SHAPE signature, which the device-graph fusion pass looks up when it
+    launches the fused ``TpuFanoutKernel``/``TpuDagKernel``."""
     from ..config import config
-    from ..ops.stages import FanoutPipeline
+    from ..ops.stages import DagPipeline, FanoutPipeline
     inst = inst or instance()
     # ONE Pipeline for everything: wired_fn caches per (wire name, k) on the
     # instance, so the jit function identity stays stable and each (wire,
     # frame, k) shape compiles once — not once per depth (compile_wired hands
     # out a fresh carry per call, so reuse across measurements is safe)
-    pipe = stages if isinstance(stages, FanoutPipeline) \
+    pipe = stages if isinstance(stages, (FanoutPipeline, DagPipeline)) \
         else Pipeline(list(stages), in_dtype)
     if wires is None:
         pinned = config().tpu_wire_format
@@ -436,7 +482,7 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
             wires = (pinned,)
         else:
             up, down = measure_link(inst)
-            if isinstance(pipe, FanoutPipeline):
+            if getattr(pipe, "n_branches", 0):
                 # D2H budget across MIXED branch dtypes: weight each branch's
                 # path rate by its dtype width relative to branch 0 (the
                 # complex:real byte ratio is 2:1 under every float wire
@@ -479,7 +525,12 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
                         best_rate = rate
                         best = (wname, f, d, k)
     results.frames_per_dispatch = best[3]
-    if isinstance(pipe, FanoutPipeline):
+    if isinstance(pipe, DagPipeline):
+        # the canonicalized DAG signature already maps a devchain-composed
+        # region (per-member nodes) and a hand-built pipeline of the same
+        # stages to one key — one record suffices
+        record_streamed_pick(pipe, pipe.in_dtype, inst.platform, best[3])
+    elif isinstance(pipe, FanoutPipeline):
         # record BOTH fan-out-shaped signatures: the pipeline's (possibly
         # LTI-merged) stage names AND the caller's raw lists — the devchain
         # lookup composes from per-member stage lists, which match the raw

@@ -39,8 +39,8 @@ from ..telemetry.spans import recorder as _trace_recorder
 from ..types import Pmt
 from .instance import TpuInstance, instance
 
-__all__ = ["TpuH2D", "TpuStage", "TpuD2H", "rebase_frame_tags", "emit_with_tags",
-           "parse_ctrl"]
+__all__ = ["TpuH2D", "TpuStage", "TpuMergeStage", "TpuD2H", "rebase_frame_tags",
+           "emit_with_tags", "parse_ctrl"]
 
 log = logger("tpu.frames")
 _trace = _trace_recorder()
@@ -267,6 +267,176 @@ class TpuStage(Kernel):
             self.output.put_full(y, out_valid,
                                  rebase_frame_tags(tags, self.pipeline, out_valid))
         if self.input.finished() and len(self.input) == 0:
+            io.finished = True
+
+
+class _TagRatio:
+    """Rate shim for :func:`rebase_frame_tags` (reads only ``.ratio``)."""
+
+    __slots__ = ("ratio",)
+
+    def __init__(self, ratio):
+        self.ratio = ratio
+
+
+class TpuMergeStage(Kernel):
+    """Device frame fan-IN: K inplace inputs joined on-device into one output.
+
+    The frame-plane merge node (``ops/stages.MergeStage``): K device frames —
+    one full frame from EACH input queue — enter one jitted program (merge +
+    optional post stages) and the joined frame continues on the plane without
+    leaving HBM. This is the block form of the WLAN ``{demod, chan-est} →
+    decode`` join and the FM ``{audio, RDS} → mux``; the device-graph fusion
+    pass (``runtime/devchain.py``) collapses a whole ``producer → broadcast →
+    branches → merge`` diamond containing it into ONE dispatch per frame.
+
+    Actor-path semantics (the reference the fused path must bit-match):
+
+    * the block waits until EVERY input holds a frame, then merges exactly one
+      frame per input per dispatch;
+    * stream tags ride the PRIMARY input (``in0``) — rebased through the
+      merge + post rate contract; secondary inputs' tag copies are dropped
+      (a broadcast upstream would otherwise duplicate every tag K times);
+    * EOS follows ``blocks.Combine``: when ANY input is finished and drained,
+      the block finishes (remaining partner frames can never join).
+
+    Carries a ``ctrl`` port with the TpuStage retune contract addressing the
+    ``[merge] + post_stages`` list.
+    """
+
+    BLOCKING = True
+
+    def __init__(self, merge, post_stages: Sequence[Stage] = (),
+                 inst: Optional[TpuInstance] = None):
+        from ..ops.stages import MergeStage
+        super().__init__()
+        assert isinstance(merge, MergeStage), merge
+        self.inst = inst or instance()
+        self.merge = merge
+        self.post = list(post_stages)
+        #: ctrl addressing surface (Pipeline.update_stage reads .stages)
+        self.stages = [merge] + self.post
+        self._compiled = None
+        self._carry = None
+        self._post_pipe: Optional[Pipeline] = None
+        self._tag_ratio = None
+        self._dispatches = 0
+        self._pending_ctrl: List[tuple] = []
+        self.inputs = [self.add_inplace_input(f"in{i}")
+                       for i in range(merge.k)]
+        self.input = self.inputs[0]
+        self.output = self.add_inplace_output("out")
+
+    def extra_metrics(self) -> dict:
+        return {"dispatches": self._dispatches}
+
+    # Pipeline.update_stage only touches the duck-typed ``.stages`` surface,
+    # so the linear implementation serves the merge block's ctrl addressing
+    update_stage = Pipeline.update_stage
+
+    @message_handler(name="ctrl")
+    async def ctrl_handler(self, io, mio, meta, p):
+        try:
+            stage, params = parse_ctrl(p)
+            if self._carry is None:
+                # lazy-carry contract, exactly TpuStage's: queue until the
+                # first frame compiles the carry, validating what can be
+                self.update_stage(None, stage, _validate_only=True, **params)
+                self._pending_ctrl.append((stage, params))
+            else:
+                self._carry = self.update_stage(self._carry, stage, **params)
+        except Exception as e:                         # noqa: BLE001
+            log.warning("ctrl update rejected: %r", e)
+            return Pmt.invalid_value()
+        return Pmt.ok()
+
+    def _compile(self, frames) -> None:
+        import jax
+        dts = {np.dtype(f.dtype) for f in frames}
+        assert len(dts) == 1, f"merge inputs disagree on dtype: {dts}"
+        in_dt = dts.pop()
+        merge, post = self.merge, self.post
+        for f in frames:
+            assert f.shape[0] % merge.frame_multiple == 0, \
+                (f.shape[0], merge.frame_multiple)
+        mid_dt = np.dtype(merge.out_dtype) if merge.out_dtype is not None \
+            else in_dt
+        self._post_pipe = Pipeline(list(post), mid_dt, optimize=False)
+        self._tag_ratio = _TagRatio(merge.ratio * self._post_pipe.ratio)
+
+        def fn(carries, xs):
+            c, v = merge.fn(carries[0], xs)
+            new = [c]
+            for i, s in enumerate(post):
+                c, v = s.fn(carries[1 + i], v)
+                new.append(c)
+            return tuple(new), v
+
+        self._compiled = jax.jit(fn, donate_argnums=(0,))
+        carries = [merge.init_carry(in_dt)]
+        dt = mid_dt
+        for s in post:
+            carries.append(s.init_carry(dt))
+            if s.out_dtype is not None:
+                dt = np.dtype(s.out_dtype)
+        self._carry = jax.device_put(tuple(carries), self.inst.device) \
+            if self.inst.device is not None else tuple(carries)
+        for stage, params in self._pending_ctrl:
+            try:
+                self._carry = self.update_stage(self._carry, stage, **params)
+            except Exception as e:                     # noqa: BLE001
+                log.warning("queued ctrl update rejected: %r", e)
+        self._pending_ctrl.clear()
+
+    def _out_valid(self, valids, frames) -> int:
+        # clamp to the merge's own contract BEFORE applying the ratio
+        # (TpuStage's `valid - valid % frame_multiple` rule): a ragged EOS
+        # tail under a fractional-ratio or frame_multiple>1 merge drops the
+        # sub-multiple items instead of tripping the integrality assert
+        step = int(np.lcm(self.merge.frame_multiple,
+                          self.merge.ratio.denominator))
+        if self.merge.mode == "equal":
+            # elementwise/interleave joins consume index-aligned prefixes, so
+            # the shortest input bounds the valid output
+            n = min(valids) // step * step
+        else:
+            # concat lays the inputs' FULL frames back to back: a partial
+            # (EOS-tail) input frame cannot be expressed as a valid-prefix
+            # count of that layout — input 0's zero padding would be emitted
+            # as data and input 1's tail dropped. Concat joins therefore emit
+            # only full frames; the tail rides the devchain EOS divergence
+            # contract (the fused path applies the same rule,
+            # DagPipeline.concat_sinks)
+            if any(v < f.shape[0] for v, f in zip(valids, frames)):
+                return 0
+            n = sum(valids) // step * step
+        q = n * self.merge.ratio
+        assert q.denominator == 1, (n, self.merge.ratio)
+        n = int(q)
+        pp = self._post_pipe
+        return pp.out_items(n - n % pp.frame_multiple)
+
+    async def work(self, io, mio, meta):
+        while True:
+            if any(len(p) == 0 for p in self.inputs):
+                break
+            items = [p.get_full() for p in self.inputs]
+            frames = tuple(it[0] for it in items)
+            valids = [it[1] for it in items]
+            if self._compiled is None:
+                self._compile(frames)
+            t0 = _trace.now() if _trace.enabled else 0
+            self._carry, y = self._compiled(self._carry, frames)
+            self._dispatches += 1
+            if t0:
+                _trace.complete("tpu", "compute", t0,
+                                args={"frame": int(frames[0].shape[0]),
+                                      "merge_k": self.merge.k})
+            out_valid = self._out_valid(valids, frames)
+            # tags ride the primary input only (class docstring)
+            tags = rebase_frame_tags(items[0][2], self._tag_ratio, out_valid)
+            self.output.put_full(y, out_valid, tags)
+        if any(p.finished() and len(p) == 0 for p in self.inputs):
             io.finished = True
 
 

@@ -59,7 +59,7 @@ from ..types import Pmt
 from .frames import emit_with_tags, rebase_frame_tags
 from .instance import TpuInstance, instance
 
-__all__ = ["TpuKernel", "TpuFanoutKernel"]
+__all__ = ["TpuKernel", "TpuFanoutKernel", "TpuDagKernel"]
 
 log = logger("tpu.kernel")
 _trace = _trace_recorder()
@@ -80,6 +80,12 @@ _REPLAYED = _prom.counter(
 
 class TpuKernel(Kernel):
     BLOCKING = True
+
+    #: carry-donation setting for every compile of this kernel's program
+    #: (init, warmup recompile, recover — ONE setting, so the jit cache never
+    #: holds two executables of different aliasing for the same kernel).
+    #: TpuDagKernel narrows it (see its override).
+    _donate = True
 
     def __init__(self, stages: Sequence[Stage], in_dtype,
                  frame_size: Optional[int] = None,
@@ -199,7 +205,7 @@ class TpuKernel(Kernel):
             source=self.meta.instance_name or "TpuKernel")
         self._compiled, self._carry = self.pipeline.compile_wired(
             self.frame_size, self.wire, device=self.inst.device,
-            k=self.k_batch)
+            k=self.k_batch, donate=self._donate)
         # warm the compile cache off the hot path (raw device_put: the fake
         # link must not bill warmup bytes), then reset the carry state
         parts = self.wire.encode_host(
@@ -214,7 +220,7 @@ class TpuKernel(Kernel):
         del warm_carry  # donated buffers; fresh carry below
         _, self._carry = self.pipeline.compile_wired(
             self.frame_size, self.wire, device=self.inst.device,
-            k=self.k_batch)
+            k=self.k_batch, donate=self._donate)
         if self._ckpt_every:
             # fresh-init sentinel: "restore = recompile the init carry" — a
             # fault before the first committed checkpoint replays from the
@@ -239,10 +245,40 @@ class TpuKernel(Kernel):
                 # direct handler calls before init
                 raise RuntimeError("ctrl before init")
             self._carry = self.pipeline.update_stage(self._carry, stage, **params)
+            self.warn_retune_in_replay()
         except Exception as e:
             log.warning("ctrl update rejected: %r", e)
             return Pmt.invalid_value()
         return Pmt.ok()
+
+    def warn_retune_in_replay(self) -> int:
+        """Structured observability for the retune-in-replay caveat
+        (docs/robustness.md): a ``ctrl`` retune landing while checkpoint
+        recovery is still replaying logged groups applies its carry surgery
+        to the REPLAYED frames too — recovered output can differ from the
+        unfailed run by up to the pending replayed-frame count (the unfailed
+        run processed those frames with the PRE-retune parameters). The known
+        few-frames-late behavior is now logged instead of silent; returns
+        the pending count (0 = no active replay window). Called by the ctrl
+        handler and the devchain drive loop's member-addressed retune path."""
+        if self._replay_high < 0:
+            return 0
+        pending = sum(len(m) for _, _, m, _ in self._replay_queue)
+        pending += sum(len(m) for _, m, s, _ in self._staged
+                       if s <= self._replay_high)
+        pending += sum(len(m) for _, m, s, _ in self._inflight
+                       if s <= self._replay_high)
+        if pending == 0:
+            self._replay_high = -1       # window fully drained: disarm
+            return 0
+        log.warning(
+            "%s: ctrl retune landed inside an active replay window — %d "
+            "replayed frame(s) still in flight will re-dispatch with the NEW "
+            "parameters, so recovered output may differ from the unfailed "
+            "run by up to that many frames (docs/robustness.md "
+            "retune-in-replay caveat)",
+            self.meta.instance_name or type(self).__name__, pending)
+        return pending
 
     # -- helpers ---------------------------------------------------------------
     def _stage(self, frame: np.ndarray, valid_in: int,
@@ -469,6 +505,11 @@ class TpuKernel(Kernel):
         # once would burst device memory past what the budget bounds
         self._replay_queue: Deque[tuple] = deque()
         self._rlog_dropped = 0           # leak-guard drops (see _stage_group)
+        # newest replayed group's seq while a recovery's replay window is
+        # active (-1 = none): ctrl retunes landing inside the window log a
+        # structured warning (warn_retune_in_replay) instead of silently
+        # shifting where the swap lands in the recovered stream
+        self._replay_high = -1
         self._forfeit_ctr = None
         self._replay_ctr = None
 
@@ -581,6 +622,7 @@ class TpuKernel(Kernel):
         self._ckpts.clear()
         self._pending_ckpts.clear()
         self._replay_queue.clear()
+        self._replay_high = -1
 
     def _restore_candidates(self):
         """Committed checkpoints newest-first, each validated lazily by
@@ -605,7 +647,7 @@ class TpuKernel(Kernel):
         # the failed incarnation never finished init
         self._compiled, fresh = self.pipeline.compile_wired(
             self.frame_size, self.wire, device=self.inst.device,
-            k=self.k_batch)
+            k=self.k_batch, donate=self._donate)
         chosen = None
         invalid: set = set()
         for seq, leaves, treedef in self._restore_candidates():
@@ -659,6 +701,7 @@ class TpuKernel(Kernel):
                 continue
             self._replay_queue.append((s, parts, metas,
                                        s <= self._drained_seq))
+            self._replay_high = max(self._replay_high, s)
             replayed += len(metas)
         if replayed:
             if self._replay_ctr is None:
@@ -891,16 +934,28 @@ class TpuFanoutKernel(TpuKernel):
         branch's tag indices rebased through ITS path rate."""
         fo = self.pipeline
         finish = xfer.start_host_transfer_parts(flat_parts)
+        # tag remap per branch: the item-COUNT ratio, unless the pipeline
+        # carries separate tag ratios (a DagPipeline through a merge — tags
+        # ride the primary chain, so a concat join must not scale indices by
+        # the summed output rate)
+        tag_ratios = getattr(fo, "tag_ratios", None) or fo.path_ratios
+        # sinks downstream of a CONCAT merge cannot represent a partial
+        # input frame as a valid-prefix count (the concat layout interleaves
+        # full frames) — they emit only for full frames, exactly like the
+        # actor-path TpuMergeStage (DagPipeline.concat_sinks)
+        concat = getattr(fo, "concat_sinks", None)
         out_metas = []
         for valid_in, tags, t_in in metas:
             per_branch = []
             for j in range(fo.n_branches):
                 valid_out = min(fo.branch_out_items(j, valid_in),
                                 self.out_frames[j])
+                if concat and concat[j] and valid_in < self.frame_size:
+                    valid_out = 0
                 per_branch.append(
                     (valid_out,
                      tuple(rebase_frame_tags(
-                         tags, _PathRatio(fo.path_ratios[j]), valid_out))))
+                         tags, _PathRatio(tag_ratios[j]), valid_out))))
             out_metas.append((tuple(per_branch), t_in))
         return (finish, tuple(out_metas))
 
@@ -1016,3 +1071,43 @@ class TpuFanoutKernel(TpuKernel):
         elif eos and (self._inflight or self._staged or self._accum
                       or self._replay_queue):
             io.call_again = True
+
+
+class TpuDagKernel(TpuFanoutKernel):
+    """ONE fused dispatch driving a general device-plane DAG's SINK set.
+
+    The block form of :class:`~futuresdr_tpu.ops.stages.DagPipeline`: a
+    region shaped as an arbitrary device DAG — nested fan-out, fan-IN
+    (:class:`~futuresdr_tpu.ops.stages.MergeStage` joins), and the diamond
+    ``producer → broadcast → branches → merge`` closure — runs as a single
+    multi-output XLA program per frame (per megabatch window). The input
+    crosses the link ONCE, every interior edge stays device-resident (the
+    merge point's D2H→host→H2D bounce disappears), and each SINK's result
+    streams out its own port: ``outputs[j]`` carries sink j in the DAG's
+    node order.
+
+    Everything — staging, megabatch, H2D, dispatch, checkpoint/replay, and
+    the per-output drain/emit/tag-rebase — is the shared
+    ``_stage_available_input``/``_launch_staged``/fan-out drain path: the
+    ``DagPipeline`` presents its sink set through the same per-branch
+    surface (``n_branches``/``path_ratios``/``out_dtypes``/``part_counts``)
+    a ``FanoutPipeline`` presents its branches, generalized with per-sink
+    ``tag_ratios`` so tags crossing a merge rebase along the PRIMARY chain
+    (``_start_result_d2h``). A single-sink DAG (the diamond) is simply
+    ``n_branches == 1``. Constructed by the device-graph fusion pass
+    (``runtime/devchain.py``); the direct-use caveat of
+    :class:`TpuFanoutKernel` (per-sink retirement needs the devchain drive
+    loop's per-tail inbox routing) applies unchanged.
+    """
+
+    @property
+    def _donate(self):
+        """Megabatch DAG programs compile WITHOUT carry donation: under the
+        ``lax.scan`` form, donated carries let XLA pick aliased layouts for a
+        multiply-consumed interior value's boundary stash that round a sink
+        differently from the k=1 program (observed on the nested-fan-out
+        shape, CPU backend) — and fused-vs-actor bit-equality is the
+        contract. k=1 keeps donation: the single-frame program matches the
+        per-hop numerics with it (pinned by the fused-vs-actor tests), and
+        the carry reuse is free."""
+        return self.k_batch <= 1

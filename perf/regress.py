@@ -13,7 +13,7 @@ Field policy:
   regardless of which backend the bench targeted, so it is comparable across
   the whole trajectory (reference: the latest stamp that carries it).
 * The backend-bound fields (``value``, ``streamed_msps``,
-  ``streamed_wire_msps``, ``streamed_fanout_msps``,
+  ``streamed_wire_msps``, ``streamed_fanout_msps``, ``streamed_dag_msps``,
   ``fm_msps``/``wlan_msps``/``lora_msps``) compare
   only against a same-backend reference — a CPU-fallback run must not be
   graded against a TPU round.
@@ -47,7 +47,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 FIELDS_ANY_BACKEND = ("cpu_baseline_msps",)
 FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
-                       "streamed_fanout_msps",
+                       "streamed_fanout_msps", "streamed_dag_msps",
                        "fm_msps", "wlan_msps", "lora_msps")
 # lower-is-better fields (fractions, not rates): regression = the value ROSE
 # past the reference by more than the absolute slack below — e.g. the

@@ -805,3 +805,684 @@ def test_random_devchain_shapes_fuzz():
                     err_msg=f"fanout case {case} branch {j}: frame={frame} "
                             f"prod_depth={prod_depth} "
                             f"branches={n_branches}")
+
+    # DAG shapes (round 13): random diamonds (broadcast → K equal-rate
+    # branches → merge, add/interleave/concat joins), and
+    # broadcast-inside-a-branch (nested fan-out) — every fused region must
+    # bit-equal its per-hop run
+    from futuresdr_tpu.ops import (add_merge_stage, concat_merge_stage,
+                                   interleave_merge_stage)
+    from futuresdr_tpu.tpu.frames import TpuMergeStage
+    for case in range(3):
+        rng = np.random.default_rng(master.integers(1 << 62))
+        frame = int(rng.choice([2048, 4096]))
+        n_frames = int(rng.integers(2, 5))
+        taps = firdes.lowpass(0.3, int(rng.choice([16, 33]))).astype(
+            np.float32)
+        shape = ("diamond", "nested")[case % 2]
+        prod_depth = int(rng.integers(0, 2))   # 0 = H2D broadcasts directly
+        k_in = int(rng.integers(2, 4))
+        decim = int(rng.choice([1, 2]))
+        pick = int(rng.integers(0, 3))
+        n = n_frames * frame
+        data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+                ).astype(np.complex64)
+
+        def build_dag(shape=shape, taps=taps, frame=frame, data=data,
+                      prod_depth=prod_depth, k_in=k_in, decim=decim,
+                      pick=pick):
+            fg = Flowgraph()
+            src = VectorSource(data)
+            h2d = TpuH2D(np.complex64, frame_size=frame)
+            fg.connect_stream(src, "out", h2d, "in")
+            prev = h2d
+            for d in range(prod_depth):
+                st = TpuStage([fir_stage(taps, fft_len=512, name=f"dp{d}")],
+                              np.complex64)
+                fg.connect_inplace(prev, "out", st, "in")
+                prev = st
+            snks = []
+            if shape == "diamond":
+                mg = TpuMergeStage(
+                    [add_merge_stage(k_in), interleave_merge_stage(k_in),
+                     concat_merge_stage(k_in)][pick])
+                for i in range(k_in):
+                    st = TpuStage([fir_stage(taps, decim=decim, fft_len=512,
+                                             name=f"db{i}")], np.complex64)
+                    fg.connect_inplace(prev, "out", st, "in")
+                    fg.connect_inplace(st, "out", mg, f"in{i}")
+                d2h = TpuD2H(np.complex64)
+                snk = VectorSink(np.complex64)
+                fg.connect_inplace(mg, "out", d2h, "in")
+                fg.connect_stream(d2h, "out", snk, "in")
+                snks.append(snk)
+            else:
+                mid = TpuStage([fir_stage(taps, fft_len=512, name="mid")],
+                               np.complex64)
+                fg.connect_inplace(prev, "out", mid, "in")
+                ends = []
+                for i in range(2):     # broadcast inside the mid branch
+                    st = TpuStage([fir_stage(taps, fft_len=512,
+                                             name=f"leaf{i}")], np.complex64)
+                    fg.connect_inplace(mid, "out", st, "in")
+                    ends.append(st)
+                st2 = TpuStage([mag2_stage()], np.complex64)
+                fg.connect_inplace(prev, "out", st2, "in")
+                for st, dt in [(ends[0], np.complex64),
+                               (ends[1], np.complex64), (st2, np.float32)]:
+                    d2h = TpuD2H(dt)
+                    snk = VectorSink(dt)
+                    fg.connect_inplace(st, "out", d2h, "in")
+                    fg.connect_stream(d2h, "out", snk, "in")
+                    snks.append(snk)
+            return fg, snks
+
+        with _no_devchain():
+            fg, snks = build_dag()
+            Runtime().run(fg)
+            refs = [s.items() for s in snks]
+        with _no_devchain(False):
+            fg, snks = build_dag()
+            chains = find_device_chains(fg)
+            assert len(chains) == 1 and chains[0].dag, (case, shape, chains)
+            Runtime().run(fg)
+            for j, (s, r) in enumerate(zip(snks, refs)):
+                np.testing.assert_array_equal(
+                    s.items(), r,
+                    err_msg=f"dag case {case} ({shape}) sink {j}: "
+                            f"frame={frame} prod_depth={prod_depth}")
+
+
+# ---------------------------------------------------------------------------
+# general DAG fusion (round 13): fan-IN (merge), the diamond closure, and
+# NESTED fan-out — whole-receiver single-dispatch
+# ---------------------------------------------------------------------------
+
+from futuresdr_tpu.ops import (add_merge_stage, concat_merge_stage,  # noqa: E402
+                               interleave_merge_stage)
+from futuresdr_tpu.tpu.frames import TpuMergeStage  # noqa: E402
+
+
+def _diamond_fg(split: str, data, frame: int, merge="add"):
+    """``TpuH2D → producer? → broadcast → two decim-4 FIR branches →
+    TpuMergeStage(+|x|²) → TpuD2H`` under different member splits. The
+    DECIMATING merge branches are the acceptance shape; ``merge="concat"``
+    swaps the equal-rate join for a concat of UNEQUAL rates (branch 2 runs
+    1:1)."""
+    t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+    t2 = firdes.lowpass(0.2, 32).astype(np.float32)
+    p = fir_stage(t1, name="p")
+    b1 = fir_stage(t2, decim=4, fft_len=512, name="b1")
+    b2 = fir_stage(t2, decim=4, fft_len=512, name="b2") if merge == "add" \
+        else rotator_stage(0.1, name="b2")
+    prod_lists, br1_lists, br2_lists = {
+        "0|1|1": ([], [[b1]], [[b2]]),
+        "1|1|1": ([[p]], [[b1]], [[b2]]),
+        "1|2|1": ([[p]], [[rotator_stage(0.2)], [b1]], [[b2]]),
+    }[split]
+    if merge == "add":
+        mg = TpuMergeStage(add_merge_stage(2), [mag2_stage()])
+        out_dt = np.float32
+    else:
+        mg = TpuMergeStage(concat_merge_stage(2))
+        out_dt = np.complex64
+    fg = Flowgraph()
+    src = VectorSource(data)
+    h2d = TpuH2D(np.complex64, frame_size=frame)
+    fg.connect_stream(src, "out", h2d, "in")
+    prev = h2d
+    for sl in prod_lists:
+        st = TpuStage(sl, np.complex64)
+        fg.connect_inplace(prev, "out", st, "in")
+        prev = st
+    for port, lists in (("in0", br1_lists), ("in1", br2_lists)):
+        b_prev = prev
+        for sl in lists:
+            st = TpuStage(sl, np.complex64)
+            fg.connect_inplace(b_prev, "out", st, "in")
+            b_prev = st
+        fg.connect_inplace(b_prev, "out", mg, port)
+    d2h = TpuD2H(out_dt)
+    snk = VectorSink(out_dt)
+    fg.connect_inplace(mg, "out", d2h, "in")
+    fg.connect_stream(d2h, "out", snk, "in")
+    return fg, snk, mg
+
+
+@pytest.mark.parametrize("split", ["0|1|1", "1|1|1", "1|2|1"])
+@pytest.mark.parametrize("frames_n", [1, 3])      # one-shot vs chunked stream
+def test_diamond_fused_bit_equals_actor(split, frames_n):
+    """The diamond ``broadcast → branches → merge`` closure fuses into ONE
+    dispatch per frame, BIT-identical to the per-hop actor run (decimating
+    merge branches, member splits, chunked/one-shot)."""
+    frame = 4096
+    rng = np.random.default_rng(31)
+    n = frames_n * frame
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+    with _no_devchain():
+        fg, snk, _ = _diamond_fg(split, data, frame)
+        assert find_device_chains(fg) == []
+        Runtime().run(fg)
+        ref = snk.items()
+    with _no_devchain(False):
+        fg, snk, _ = _diamond_fg(split, data, frame)
+        chains = find_device_chains(fg)
+        assert len(chains) == 1 and chains[0].dag and not chains[0].fanout
+        assert len(chains[0].sinks) == 1          # single-sink DAG
+        Runtime().run(fg)
+        got = snk.items()
+    assert len(ref) == n // 4
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_diamond_megabatch_bit_equals_actor(k):
+    """frames_per_dispatch K through the fused diamond keeps bit-equality,
+    including the EOS partial batch."""
+    from futuresdr_tpu.config import config
+    frame = 4096
+    rng = np.random.default_rng(37)
+    n = 5 * frame                     # 5 frames: one K=4 batch stays partial
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+    with _no_devchain():
+        fg, snk, _ = _diamond_fg("1|1|1", data, frame)
+        Runtime().run(fg)
+        ref = snk.items()
+    old = config().tpu_frames_per_dispatch
+    config().tpu_frames_per_dispatch = k
+    try:
+        with _no_devchain(False):
+            fg, snk, _ = _diamond_fg("1|1|1", data, frame)
+            Runtime().run(fg)
+            got = snk.items()
+    finally:
+        config().tpu_frames_per_dispatch = old
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_concat_merge_unequal_rates_bit_equals_actor():
+    """A concat merge joining a decim-4 branch with a 1:1 branch fuses —
+    per-path rate contracts compose (out = 5/4 of the input)."""
+    frame = 4096
+    rng = np.random.default_rng(41)
+    n = 3 * frame
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+    with _no_devchain():
+        fg, snk, _ = _diamond_fg("1|1|1", data, frame, merge="concat")
+        Runtime().run(fg)
+        ref = snk.items()
+    with _no_devchain(False):
+        fg, snk, _ = _diamond_fg("1|1|1", data, frame, merge="concat")
+        chains = find_device_chains(fg)
+        assert len(chains) == 1 and chains[0].dag
+        Runtime().run(fg)
+        got = snk.items()
+    assert len(ref) == n + n // 4     # concat: both branches' items
+    np.testing.assert_array_equal(got, ref)
+
+
+def _nested_kernel_fg(data, frame):
+    """Stream-plane NESTED fan-out: ``prod → {a → {c, d}, b}`` (a broadcast
+    inside a branch) — 3 sinks, 5 kernels, 5 dispatches/frame per-hop.
+    The interior stays LTI (fir/mag2): the K>1 megabatch scan form is a
+    different XLA compilation whose transcendental-phase rounding (rotator
+    exp) may legitimately differ from the k=1 program — a pre-existing
+    property of the scan megabatch, pinned LTI-only exactly like the linear
+    megabatch tests."""
+    t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+    t2 = firdes.lowpass(0.2, 32).astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(data)
+    prod = TpuKernel([fir_stage(t1, name="p")], np.complex64,
+                     frame_size=frame)
+    a = TpuKernel([fir_stage(t2, fft_len=512, name="a")], np.complex64,
+                  frame_size=frame)
+    b = TpuKernel([mag2_stage()], np.complex64, frame_size=frame)
+    c = TpuKernel([fir_stage(t2, decim=4, fft_len=512, name="c")],
+                  np.complex64, frame_size=frame)
+    d = TpuKernel([mag2_stage()], np.complex64, frame_size=frame)
+    snks = [VectorSink(np.complex64), VectorSink(np.float32),
+            VectorSink(np.float32)]
+    fg.connect(src, prod)
+    fg.connect_stream(prod, "out", a, "in")
+    fg.connect_stream(prod, "out", b, "in")
+    fg.connect_stream(a, "out", c, "in")
+    fg.connect_stream(a, "out", d, "in")
+    fg.connect(c, snks[0])
+    fg.connect(d, snks[1])
+    fg.connect(b, snks[2])
+    return fg, snks, prod
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_nested_fanout_kernels_bit_equals_actor(k):
+    """A broadcast INSIDE a branch (nested fan-out) fuses into one
+    multi-output dispatch per frame walking the region's SINK set."""
+    from futuresdr_tpu.config import config
+    frame = 4096
+    rng = np.random.default_rng(43)
+    n = 4 * frame
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+    with _no_devchain():
+        fg, snks, _ = _nested_kernel_fg(data, frame)
+        assert find_device_chains(fg) == []
+        Runtime().run(fg)
+        refs = [s.items() for s in snks]
+    old = config().tpu_frames_per_dispatch
+    config().tpu_frames_per_dispatch = k
+    try:
+        with _no_devchain(False):
+            fg, snks, prod = _nested_kernel_fg(data, frame)
+            chains = find_device_chains(fg)
+            assert len(chains) == 1 and chains[0].dag \
+                and chains[0].kind == "kernels"
+            assert len(chains[0].sinks) == 3
+            Runtime().run(fg)
+            got = [s.items() for s in snks]
+            m = prod.extra_metrics()
+            assert m.get("fused_devchain")
+            # ONE dispatch per frame for the whole nested 5-kernel region
+            assert m["devchain_dispatches"] * k == m["devchain_frames"] == 4
+    finally:
+        config().tpu_frames_per_dispatch = old
+    for g, r in zip(got, refs):
+        np.testing.assert_array_equal(g, r)
+
+
+def test_nested_fanout_frames_bit_equals_actor():
+    """Frame-plane nested fan-out: ``h2d → p → {b1 → {s_a → d2h, s_b →
+    d2h}, b2 → d2h}`` fuses whole (3 sinks) bit-identically."""
+    t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+    t2 = firdes.lowpass(0.2, 32).astype(np.float32)
+    frame = 4096
+    rng = np.random.default_rng(47)
+    n = 3 * frame
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+
+    def build():
+        fg = Flowgraph()
+        src = VectorSource(data)
+        h2d = TpuH2D(np.complex64, frame_size=frame)
+        p = TpuStage([fir_stage(t1, name="p")], np.complex64)
+        b1 = TpuStage([rotator_stage(0.1)], np.complex64)
+        b2 = TpuStage([mag2_stage()], np.complex64)
+        sa = TpuStage([fir_stage(t2, decim=4, fft_len=512, name="sa")],
+                      np.complex64)
+        sb = TpuStage([mag2_stage()], np.complex64)
+        fg.connect_stream(src, "out", h2d, "in")
+        fg.connect_inplace(h2d, "out", p, "in")
+        fg.connect_inplace(p, "out", b1, "in")
+        fg.connect_inplace(p, "out", b2, "in")
+        fg.connect_inplace(b1, "out", sa, "in")
+        fg.connect_inplace(b1, "out", sb, "in")
+        snks = []
+        for st, dt in ((sa, np.complex64), (sb, np.float32),
+                       (b2, np.float32)):
+            d2h = TpuD2H(dt)
+            snk = VectorSink(dt)
+            fg.connect_inplace(st, "out", d2h, "in")
+            fg.connect_stream(d2h, "out", snk, "in")
+            snks.append(snk)
+        return fg, snks
+
+    with _no_devchain():
+        fg, snks = build()
+        Runtime().run(fg)
+        refs = [s.items() for s in snks]
+    with _no_devchain(False):
+        fg, snks = build()
+        chains = find_device_chains(fg)
+        assert len(chains) == 1 and chains[0].dag \
+            and chains[0].kind == "frames"
+        Runtime().run(fg)
+        for s, r in zip(snks, refs):
+            np.testing.assert_array_equal(s.items(), r)
+
+
+def test_diamond_tags_cross_fused_merge():
+    """A tag crossing the fused diamond lands exactly where the per-hop
+    actor path (merge: tags ride the PRIMARY input) puts it."""
+    from tests.test_tpu_tags import TagRecordingSink, TaggedRampSource
+
+    frame = 4096
+    n = 3 * frame
+
+    def build():
+        t2 = firdes.lowpass(0.2, 32).astype(np.float32)
+        fg = Flowgraph()
+        src = TaggedRampSource(n)
+        h2d = TpuH2D(np.complex64, frame_size=frame)
+        b1 = TpuStage([fir_stage(t2, decim=4, fft_len=512, name="b1")],
+                      np.complex64)
+        b2 = TpuStage([fir_stage(t2, decim=4, fft_len=512, name="b2")],
+                      np.complex64)
+        mg = TpuMergeStage(add_merge_stage(2), [mag2_stage()])
+        d2h = TpuD2H(np.float32)
+        snk = TagRecordingSink(np.float32)
+        fg.connect_stream(src, "out", h2d, "in")
+        fg.connect_inplace(h2d, "out", b1, "in")
+        fg.connect_inplace(h2d, "out", b2, "in")
+        fg.connect_inplace(b1, "out", mg, "in0")
+        fg.connect_inplace(b2, "out", mg, "in1")
+        fg.connect_inplace(mg, "out", d2h, "in")
+        fg.connect_stream(d2h, "out", snk, "in")
+        return fg, snk
+
+    with _no_devchain():
+        fg, snk = build()
+        Runtime().run(fg)
+        ref = [(idx, t.value) for idx, t in snk.seen]
+    with _no_devchain(False):
+        fg, snk = build()
+        assert len(find_device_chains(fg)) == 1
+        Runtime().run(fg)
+        got = [(idx, t.value) for idx, t in snk.seen]
+    assert snk.n_received == n // 4
+    assert got == ref and ref            # same tags at the same indices
+
+
+def test_dag_member_metrics_bridge():
+    """DAG members bridge per-block metrics: the merge member reports one
+    in-count PER PORT (each at its path rate) and the composed out-count;
+    single-sink regions attribute every member to sink 0."""
+    frame = 4096
+    data = np.zeros(3 * frame, np.complex64)
+    with _no_devchain(False):
+        fg, _snk, mg = _diamond_fg("1|1|1", data, frame)
+        rt = Runtime()
+        rt.start(fg).wait_sync()
+    mets = {b.instance_name: b.metrics() for b in fg._blocks if b is not None}
+    fused = {nm: m for nm, m in mets.items() if m.get("fused_devchain")}
+    assert len(fused) == 6            # h2d + producer + 2 branches + merge + d2h
+    mm = fg.wrapped(mg).metrics()
+    assert mm["items_in"] == {"in0": 3 * frame // 4, "in1": 3 * frame // 4}
+    assert mm["items_out"] == {"out": 3 * frame // 4}
+    assert all(m.get("devchain_branch") == 0 for m in fused.values())
+
+
+def test_dag_span_and_report_carry_sink_attribution():
+    """The fused DAG run's span carries per-SINK args + the merge count, and
+    doctor.report() surfaces them."""
+    from futuresdr_tpu.telemetry import doctor as doc
+    from futuresdr_tpu.telemetry import spans
+
+    frame = 4096
+    rng = np.random.default_rng(53)
+    data = (rng.standard_normal(3 * frame)
+            + 1j * rng.standard_normal(3 * frame)).astype(np.complex64)
+    spans.enable(True)
+    try:
+        spans.recorder().drain()
+        with _no_devchain(False):
+            fg, _snk, _mg = _diamond_fg("1|1|1", data, frame)
+            Runtime().run(fg)
+        events = spans.recorder().drain()
+    finally:
+        spans.enable(False)
+    dev = [e for e in events if e.cat == "devchain"]
+    assert len(dev) == 1
+    sinks = dev[0].args["sinks"]
+    assert len(sinks) == 1 and not sinks[0]["retired"]
+    assert sinks[0]["items_out"] == 3 * frame // 4
+    assert dev[0].args["merges"] == 1
+    rep = doc.doctor().report(events=events)
+    assert rep["devchain"] and rep["devchain"][0]["sinks"] == sinks
+    assert rep["devchain"][0]["merges"] == 1
+
+
+def test_dag_refuses_equal_merge_rate_violation():
+    """An equal-mode merge fed by branches at DIFFERENT path rates is a
+    rate-contract violation: the whole region declines honestly."""
+    t2 = firdes.lowpass(0.2, 32).astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(8192, np.complex64))
+    h2d = TpuH2D(np.complex64, frame_size=4096)
+    b1 = TpuStage([fir_stage(t2, decim=4, fft_len=512, name="b1")],
+                  np.complex64)
+    b2 = TpuStage([rotator_stage(0.1)], np.complex64)    # 1:1 branch
+    mg = TpuMergeStage(add_merge_stage(2))
+    d2h = TpuD2H(np.complex64)
+    snk = VectorSink(np.complex64)
+    fg.connect_stream(src, "out", h2d, "in")
+    fg.connect_inplace(h2d, "out", b1, "in")
+    fg.connect_inplace(h2d, "out", b2, "in")
+    fg.connect_inplace(b1, "out", mg, "in0")
+    fg.connect_inplace(b2, "out", mg, "in1")
+    fg.connect_inplace(mg, "out", d2h, "in")
+    fg.connect_stream(d2h, "out", snk, "in")
+    with _no_devchain(False):
+        assert find_device_chains(fg) == []
+
+
+def test_dag_refuses_cycle_through_host_edges():
+    """A region whose sink feeds host blocks that loop back into the root
+    declines — the fused block cannot honor the per-hop loop's interior
+    queue slack."""
+    from futuresdr_tpu.blocks import Combine
+
+    t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+    frame = 4096
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(2 * frame, np.complex64))
+    h2d = TpuH2D(np.complex64, frame_size=frame)
+    st = TpuStage([fir_stage(t1, name="p")], np.complex64)
+    d2h = TpuD2H(np.complex64)
+    comb = Combine(lambda a, b: a + b, np.complex64)
+    fg.connect_stream(src, "out", comb, "in0")
+    fg.connect_stream(d2h, "out", comb, "in1")           # the loop edge
+    fg.connect_stream(comb, "out", h2d, "in")
+    fg.connect_inplace(h2d, "out", st, "in")
+    fg.connect_inplace(st, "out", d2h, "in")
+    with _no_devchain(False):
+        assert find_device_chains(fg) == []
+
+
+def test_dag_refuses_merge_with_external_input():
+    """A merge joining one branch of the region with a SECOND H2D chain
+    (multi-root) declines the whole region."""
+    fg = Flowgraph()
+    src1 = VectorSource(np.zeros(8192, np.complex64))
+    src2 = VectorSource(np.zeros(8192, np.complex64))
+    h2d1 = TpuH2D(np.complex64, frame_size=4096)
+    h2d2 = TpuH2D(np.complex64, frame_size=4096)
+    st1 = TpuStage([rotator_stage(0.1)], np.complex64)
+    st2 = TpuStage([rotator_stage(0.2)], np.complex64)
+    mg = TpuMergeStage(add_merge_stage(2))
+    d2h = TpuD2H(np.complex64)
+    snk = VectorSink(np.complex64)
+    fg.connect_stream(src1, "out", h2d1, "in")
+    fg.connect_stream(src2, "out", h2d2, "in")
+    fg.connect_inplace(h2d1, "out", st1, "in")
+    fg.connect_inplace(h2d2, "out", st2, "in")
+    fg.connect_inplace(st1, "out", mg, "in0")
+    fg.connect_inplace(st2, "out", mg, "in1")
+    fg.connect_inplace(mg, "out", d2h, "in")
+    fg.connect_stream(d2h, "out", snk, "in")
+    with _no_devchain(False):
+        assert find_device_chains(fg) == []
+
+
+def test_dag_launches_with_cached_autotune_k():
+    """A DAG region whose CANONICALIZED shape was tuned by autotune_streamed
+    launches fused with the cached megabatch K — the member-split composed
+    region and the hand-built DagPipeline share one signature."""
+    from futuresdr_tpu.ops import DagPipeline
+    from futuresdr_tpu.tpu import instance
+    from futuresdr_tpu.tpu.autotune import (_dag_names, _make_sig,
+                                            _record_sig, _streamed_cache)
+
+    frame, k = 4096, 2
+    rng = np.random.default_rng(59)
+    n = 4 * frame
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+    with _no_devchain(False):
+        fg, snk, mg = _diamond_fg("1|1|1", data, frame)
+        # the hand-built pipeline a user would tune: same stages, coarser
+        # node granularity than the per-member composition
+        t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+        t2 = firdes.lowpass(0.2, 32).astype(np.float32)
+        user = DagPipeline([
+            ([fir_stage(t1, name="p")], []),
+            ([fir_stage(t2, decim=4, fft_len=512, name="b1")], [0]),
+            ([fir_stage(t2, decim=4, fft_len=512, name="b2")], [0]),
+            ([add_merge_stage(2), mag2_stage()], [1, 2]),
+        ], np.complex64)
+        _record_sig(_make_sig(instance().platform, np.complex64,
+                              _dag_names(user)), k)
+        try:
+            Runtime().run(fg)
+            m = fg.wrapped(mg).metrics()
+            assert m.get("fused_devchain") is True, m
+            assert m.get("frames_per_dispatch") == k, m
+            assert m["devchain_frames"] == 4 and m["devchain_dispatches"] == 2
+        finally:
+            _streamed_cache.clear()
+    np.testing.assert_array_equal(
+        snk.items().shape, (n // 4,))
+
+
+def test_ctrl_retune_in_replay_window_warns(caplog):
+    """The ROADMAP caveat made observable: a ctrl retune landing inside an
+    active replay window logs a structured warning naming the block and the
+    pending replayed-frame count."""
+    import asyncio
+    import logging
+
+    from futuresdr_tpu.types import Pmt
+
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    k = TpuKernel([fir_stage(taps, name="f")], np.complex64, frame_size=4096)
+    k.meta.instance_name = "replay_kernel"
+    asyncio.run(k.init(k.mio, k.meta))
+    # seed an active replay window: two queued groups of one frame each
+    k._replay_queue.append((3, (), ((4096, (), 0),), False))
+    k._replay_queue.append((4, (), ((4096, (), 0),), False))
+    k._replay_high = 4
+    pmt = Pmt.map({"stage": "f", "taps": taps.tolist()})
+    with caplog.at_level(logging.WARNING, logger="futuresdr_tpu.tpu.kernel"):
+        res = asyncio.run(k.ctrl_handler(None, k.mio, k.meta, pmt))
+    assert res == Pmt.ok()
+    recs = [r for r in caplog.records
+            if "replay window" in r.getMessage()]
+    assert recs, caplog.text
+    msg = recs[0].getMessage()
+    assert "replay_kernel" in msg and "2 replayed frame(s)" in msg
+    # window drained → no further warning
+    caplog.clear()
+    k._replay_queue.clear()
+    with caplog.at_level(logging.WARNING, logger="futuresdr_tpu.tpu.kernel"):
+        asyncio.run(k.ctrl_handler(None, k.mio, k.meta, pmt))
+    assert not [r for r in caplog.records
+                if "replay window" in r.getMessage()]
+    assert k._replay_high == -1          # disarmed once drained
+
+
+def test_concat_merge_partial_tail_bit_equals_actor():
+    """EOS partial tail through a CONCAT merge: the concat layout cannot
+    represent a ragged tail as a valid-prefix count, so BOTH paths emit only
+    the full frames (actor TpuMergeStage and fused DagPipeline.concat_sinks
+    apply the same rule) — fused stays bit-identical to actor, and no
+    zero-padding leaks into the output as data."""
+    frame = 4096
+    rng = np.random.default_rng(61)
+    n = 3 * frame + 1000                  # ragged EOS tail
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+    with _no_devchain():
+        fg, snk, _ = _diamond_fg("1|1|1", data, frame, merge="concat")
+        Runtime().run(fg)
+        ref = snk.items()
+    with _no_devchain(False):
+        fg, snk, _ = _diamond_fg("1|1|1", data, frame, merge="concat")
+        assert len(find_device_chains(fg)) == 1
+        Runtime().run(fg)
+        got = snk.items()
+    # only the 3 full frames joined (5/4 items per input item); the ragged
+    # tail dropped on both sides — and nothing in the output is pad garbage
+    assert len(ref) == 3 * frame + 3 * frame // 4
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_mixed_broadcast_truncates_not_declines():
+    """A kernel-plane broadcast with one NON-fusable consumer no longer
+    strands the graph: the producer prefix fuses up to (and including) the
+    broadcast owner — whose port group still serves the host tap — and the
+    clean branch chain fuses as its own region (the round-8/11 behavior,
+    regression-pinned)."""
+    t1 = firdes.lowpass(0.25, 48).astype(np.float32)
+    t2 = firdes.lowpass(0.2, 32).astype(np.float32)
+    frame = 4096
+    rng = np.random.default_rng(67)
+    n = 3 * frame
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+            ).astype(np.complex64)
+
+    def build():
+        fg = Flowgraph()
+        src = VectorSource(data)
+        k1 = TpuKernel([fir_stage(t1, name="k1")], np.complex64,
+                       frame_size=frame)
+        k2 = TpuKernel([rotator_stage(0.1)], np.complex64, frame_size=frame)
+        b1 = TpuKernel([fir_stage(t2, decim=4, fft_len=512, name="b1")],
+                       np.complex64, frame_size=frame)
+        b2 = TpuKernel([mag2_stage()], np.complex64, frame_size=frame)
+        tap = VectorSink(np.complex64)        # the non-fusable consumer
+        s1 = VectorSink(np.complex64)
+        s2 = VectorSink(np.float32)
+        fg.connect(src, k1, k2)
+        fg.connect_stream(k2, "out", b1, "in")     # mixed broadcast: b1, b2
+        fg.connect_stream(k2, "out", b2, "in")     # are fusable, tap is not
+        fg.connect_stream(k2, "out", tap, "in")
+        fg.connect(b1, s1)
+        fg.connect(b2, s2)
+        return fg, (tap, s1, s2), (k1, b1)
+
+    with _no_devchain():
+        fg, snks, _ = build()
+        Runtime().run(fg)
+        refs = [s.items() for s in snks]
+    with _no_devchain(False):
+        fg, snks, (k1, b1) = build()
+        chains = find_device_chains(fg)
+        # the k1→k2 prefix fuses (truncated at the mixed broadcast); b1 and
+        # b2 are single-member runs (len < 2) and stay actor blocks
+        assert len(chains) == 1 and not chains[0].dag and not chains[0].fanout
+        assert [type(m).__name__ for m in chains[0]] == \
+            ["TpuKernel", "TpuKernel"]
+        Runtime().run(fg)
+        for s, r in zip(snks, refs):
+            np.testing.assert_array_equal(s.items(), r)
+        assert k1.extra_metrics().get("fused_devchain")
+
+
+def test_message_ctrl_feedback_loop_still_fuses():
+    """A MESSAGE edge closing a loop (sink → host measurement → ctrl of a
+    devchain_static member: AGC/AFC-style retune feedback) is NOT a host
+    cycle — message inboxes are unbounded and ctrl applies between
+    dispatches, so only backpressure-coupled (stream/inplace) loops decline."""
+    from futuresdr_tpu.blocks import Apply
+
+    taps = firdes.lowpass(0.2, 32).astype(np.float32)
+    fg = Flowgraph()
+    src = VectorSource(np.zeros(8192, np.complex64))
+    h2d = TpuH2D(np.complex64, frame_size=4096)
+    st = TpuStage([fir_stage(taps, name="f")], np.complex64)
+    st.devchain_static = True            # live retunes expected and opted in
+    d2h = TpuD2H(np.complex64)
+    meas = Apply(lambda x: x, np.complex64)    # stand-in measurement block
+    meas.add_message_output("ctrl_out")
+    snk = VectorSink(np.complex64)
+    fg.connect_stream(src, "out", h2d, "in")
+    fg.connect_inplace(h2d, "out", st, "in")
+    fg.connect_inplace(st, "out", d2h, "in")
+    fg.connect_stream(d2h, "out", meas, "in")
+    fg.connect_stream(meas, "out", snk, "in")
+    fg.connect_message(meas, "ctrl_out", st, "ctrl")   # the feedback edge
+    with _no_devchain(False):
+        assert len(find_device_chains(fg)) == 1        # fuses, not a cycle
