@@ -28,6 +28,13 @@ FSDR_NO_DEVCHAIN=1 JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_devchain.py tests/test_tpu_stages.py tests/test_tpu_tags.py \
     tests/test_tpu_frames.py tests/test_retune.py
 
+echo "== host data path gate (docs/tpu_notes.md 'The host data path') =="
+# deterministic fake-link replay: the staging arena's steady-state allocation
+# count is O(1) per frame class (misses flat over a sustained window) and the
+# streamed utilization with arena + codec pool + credit controller armed is
+# no worse than the pre-arena baseline
+JAX_PLATFORMS=cpu python perf/hostpath_ab.py --smoke
+
 echo "== chaos smoke (docs/robustness.md invariants) =="
 # seeded fault injection at every site × every failure policy on the CPU
 # backend: restart recovers bit-correct, isolate finishes independent

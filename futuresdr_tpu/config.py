@@ -79,6 +79,27 @@ class Config:
     #   deadline path gives up and raises with the flowgraph still wedged
     autotune_cache_dir: str = "~/.cache/futuresdr_tpu"   # persisted
     #   autotune_streamed picks (JSON, tpu/autotune.py); "off"/"" disables
+    # Host data path (docs/tpu_notes.md "The host data path"): the staging
+    # arena (ops/arena.py — recycled host buffers for wire-encode outputs,
+    # H2D staging parts and megabatch pads) and the codec worker pool
+    # (ops/codec_pool.py — host encode/decode off the drain thread).
+    host_arena: bool = True                # FUTURESDR_TPU_HOST_ARENA=0 falls
+    #   back to per-frame allocation (the A/B baseline mode)
+    host_arena_mb: int = 256               # arena pool byte cap: past it a
+    #   released buffer is dropped to the allocator instead of pooled
+    host_codec_workers: int = 2            # codec threads per lane (encode /
+    #   decode); 0 = inline synchronous codec (the pre-pool path)
+    tpu_inflight: int = 0                  # in-flight credit budget of the
+    #   streamed drain loop: 0 = auto — an adaptive, hysteretic credit
+    #   controller (tpu/kernel_block.py CreditController) seeds from the
+    #   autotune_streamed pick (or tpu_frames_in_flight) and adjusts at
+    #   runtime from link idle/backpressure signals; N>0 pins the budget
+    #   (as does an explicit per-kernel frames_in_flight argument)
+    checkpoint_dir: str = ""               # persist the committed carry-
+    #   checkpoint ring across PROCESSES (docs/robustness.md): each commit
+    #   also lands as an atomic, integrity-checked snapshot file under this
+    #   directory, and recover() falls back to it when no in-kernel
+    #   checkpoint survives (a process restart). "" = off (default)
     # TPU-specific knobs (no reference analog; this is the compute-plane config).
     tpu_frame_size: int = 1 << 18          # samples per device frame
     tpu_frames_in_flight: int = 4          # dispatch pipeline depth

@@ -120,6 +120,17 @@ class Wire:
     def encode_host(self, a: np.ndarray) -> Tuple[np.ndarray, ...]:
         raise NotImplementedError
 
+    def encode_into(self, a: np.ndarray, alloc) -> Tuple[np.ndarray, ...]:
+        """:meth:`encode_host` with output buffers drawn from ``alloc``
+        (an ``ops/arena.GroupAlloc``): quantizing formats land their int
+        payload in recycled arena pages instead of fresh allocations —
+        bit-identical parts, no per-frame allocator tax. The base
+        implementation falls back to :meth:`encode_host` (exact formats'
+        parts are views of the caller's staging buffer, which the caller
+        already pins; formats without an arena path stay allocation-fresh,
+        which is always recycle-safe)."""
+        return self.encode_host(a)
+
     def decode_jax(self, parts: Sequence, dtype):
         raise NotImplementedError
 
@@ -296,6 +307,29 @@ class _QuantWire(Wire):
         if peak <= 0.0:
             peak = 1.0
         q = np.round(flat * (self.qmax / peak)).astype(self.itype)
+        return (q, np.float32(peak))
+
+    def encode_into(self, a, alloc):
+        """Arena path: the int payload lands in a recycled buffer; the
+        float scratch is a pool temp released before returning. The math is
+        exactly :meth:`encode_host`'s (multiply → round → cast), so the
+        parts are bit-identical to the allocating path."""
+        a = np.asarray(a)
+        if not _is_float(a.dtype):
+            return (np.ascontiguousarray(a),)
+        flat = self._flat_host(a)
+        peak = float(np.max(np.abs(flat))) if flat.size else 0.0
+        if not np.isfinite(peak):
+            flat = np.where(np.isfinite(flat), flat, np.float32(0.0))
+            peak = float(np.max(np.abs(flat))) if flat.size else 0.0
+        if peak <= 0.0:
+            peak = 1.0
+        scratch = alloc.temp(flat.shape, np.float32)
+        np.multiply(flat, np.float32(self.qmax / peak), out=scratch)
+        np.round(scratch, out=scratch)
+        q = alloc(flat.shape, self.itype)
+        np.copyto(q, scratch, casting="unsafe")
+        alloc.drop_temps()
         return (q, np.float32(peak))
 
     def decode_jax(self, parts, dtype):

@@ -436,6 +436,11 @@ def start_device_transfer_parts(parts, device=None):
             _trace.complete("tpu", "H2D", s, end_ns=e, args={"bytes": nbytes})
         return devs
 
+    # modeled wire window (service start, landing deadline) — zeros without a
+    # fake link. The streamed credit controller (tpu/kernel_block.py) reads
+    # consecutive windows to detect up-link idle gaps; symmetric with the
+    # D2H finishes' _wire attribute below.
+    finish._wire = (service, deadline)
     return finish
 
 

@@ -739,6 +739,15 @@ def _resolve_k_batch(first, chain_kind: str, sig_pipe_or_stages, in_dtype):
     return k_batch
 
 
+def _members_pinned_depth(members) -> bool:
+    """Did ANY member pin its in-flight depth explicitly (per-kernel
+    ``frames_in_flight`` / ``max_inflight`` argument)? The fused kernel's
+    credit controller then pins too — fusion must not un-pin a budget the
+    user fixed (``TpuKernel._adopt_credit_mode`` additionally honors a
+    config ``tpu_inflight`` pin)."""
+    return any(getattr(m, "_depth_explicit", False) for m in members)
+
+
 def _build_fused(chain: DevChain):
     """One TpuKernel over the members' concatenated stage lists, driving the
     chain's ORIGINAL boundary ports (the live, already-materialized buffers).
@@ -818,6 +827,9 @@ def _build_fused(chain: DevChain):
                       _pipeline=composed)
     assert fused.frame_size == first.frame_size, \
         (fused.frame_size, first.frame_size)    # finder checked the multiple
+    # credit adaptivity follows the MEMBERS' explicitness (the builder's own
+    # frames_in_flight argument would otherwise pin the fused budget)
+    fused._adopt_credit_mode(not _members_pinned_depth(members))
     # steal the boundary ports: the fused kernel works the chain's own buffers
     fused._stream_inputs = [first.input]
     fused._stream_outputs = [last.output]
@@ -906,6 +918,7 @@ def _build_fused_fanout(chain: DevChain):
                             frames_in_flight=depth, wire=first.wire,
                             frames_per_dispatch=k_batch)
     assert fused.frame_size == frame, (fused.frame_size, frame)
+    fused._adopt_credit_mode(not _members_pinned_depth(list(chain)))
     # steal the boundary ports: the region's own input and each branch tail's
     # own output — buffers, tags and backpressure stay the live flowgraph's
     tails = [br[-1] for br in branches]
@@ -993,6 +1006,7 @@ def _build_fused_dag(chain: DevChain):
                          frames_in_flight=depth, wire=first.wire,
                          frames_per_dispatch=k_batch)
     assert fused.frame_size == frame, (fused.frame_size, frame)
+    fused._adopt_credit_mode(not _members_pinned_depth(members))
     # steal the boundary ports: the region's own input and each sink's own
     # output — buffers, tags and backpressure stay the live flowgraph's
     tails = [members[i] for i in chain.sinks]

@@ -620,12 +620,25 @@ class Doctor:
                 entry["sinks"] = a["sinks"]
                 entry["merges"] = a.get("merges")
             devchains.append(entry)
+        # host codec lanes (encode ∪ decode) against the wall: with the codec
+        # worker pool armed (ops/codec_pool.py) these spans land in worker
+        # threads, so this fraction is how much of the run the host codec
+        # genuinely overlapped under the wire/compute lanes — bench.py stamps
+        # it as `host_codec_overlap_frac`
+        codec_iv = lane_iv.get("encode", []) + lane_iv.get("decode", [])
+        codec_frac = (spans.union_ns(codec_iv) / wall) if wall else 0.0
+        # staging-arena occupancy snapshot (ops/arena.py): hit/miss totals and
+        # currently pinned/pooled bytes — steady state shows misses flat and
+        # hits climbing once the in-flight window's buffers warmed up
+        from ..ops.arena import arena_stats
         return {
             "wall_s": wall / 1e9,
             "lanes": lanes,
             "blocks": work,
             "bottleneck_lane": bottleneck,
             "bottleneck_busy_frac": round(frac, 4),
+            "host_codec_overlap_frac": round(codec_frac, 4),
+            "arena": arena_stats(),
             "e2e_latency": e2e if e2e.get("p50_s") is not None else None,
             "devchain": devchains or None,
         }

@@ -32,7 +32,7 @@ from .instance import TpuInstance, instance
 
 __all__ = ["autotune", "autotune_streamed", "default_frames", "measure_link",
            "pick_wire", "StreamedResults", "record_streamed_pick",
-           "cached_frames_per_dispatch"]
+           "cached_frames_per_dispatch", "cached_streamed_pick"]
 
 log = logger("tpu.autotune")
 
@@ -240,15 +240,18 @@ def _measure_wired(pipe: Pipeline, wire, frame: int, depth: int,
 # streamed-pick cache: autotune_streamed results survive for later launches
 # ---------------------------------------------------------------------------
 
-#: ``(platform, in_dtype, stage names) -> frames_per_dispatch`` — recorded by
-#: :func:`autotune_streamed`, consumed by the device-graph fusion pass
-#: (``runtime/devchain.py``) when config leaves ``tpu_frames_per_dispatch``
-#: unset, so a deploy that autotuned once keeps its megabatch K on every
-#: later fused launch of the same chain without re-measuring. The in-memory
-#: layer is authoritative within a process; picks also persist as JSON under
-#: the ``autotune_cache_dir`` config knob (ROADMAP follow-up), so a deploy
-#: that autotuned once keeps its K across PROCESSES too.
-_streamed_cache: Dict[tuple, int] = {}
+#: ``(platform, in_dtype, stage names) -> {"k": …, "inflight": …}`` —
+#: recorded by :func:`autotune_streamed`, consumed by the device-graph
+#: fusion pass (``runtime/devchain.py``) when config leaves
+#: ``tpu_frames_per_dispatch`` unset, and by ``TpuKernel`` construction as
+#: the SEED of the adaptive in-flight credit controller when config leaves
+#: ``tpu_inflight`` at auto — so a deploy that autotuned once keeps its
+#: megabatch K and its in-flight budget on every later launch of the same
+#: chain without re-measuring. The in-memory layer is authoritative within
+#: a process; picks also persist as JSON under the ``autotune_cache_dir``
+#: config knob, so they survive across PROCESSES too (legacy on-disk
+#: entries are bare ints — K only — and load with no inflight seed).
+_streamed_cache: Dict[tuple, dict] = {}
 
 
 def _sig_names(stages) -> tuple:
@@ -344,38 +347,52 @@ def _sig_str(sig: tuple) -> str:
     return "|".join((platform, dtype, ",".join(names)))
 
 
+def _norm_entry(v) -> Optional[dict]:
+    """Normalize one cache value to ``{"k": int, "inflight": int|None}``.
+    Legacy entries (pre-round-14) are bare ints carrying only K; a malformed
+    value returns None (skip the entry — a bad cache line must never fail a
+    launch)."""
+    try:
+        if isinstance(v, dict):
+            fl = v.get("inflight")
+            return {"k": int(v["k"]),
+                    "inflight": int(fl) if fl is not None else None}
+        return {"k": int(v), "inflight": None}
+    except (TypeError, ValueError, KeyError):
+        return None
+
+
 #: one disk read per process (keyed by path so a test that repoints
 #: ``autotune_cache_dir`` re-reads); the memory layer is authoritative
 #: in-process, so stale memo entries only cost a re-measure, never correctness
-_disk_memo: Dict[str, Dict[str, int]] = {}
+_disk_memo: Dict[str, Dict[str, dict]] = {}
 
 
-def _disk_load(refresh: bool = False) -> Dict[str, int]:
+def _disk_load(refresh: bool = False) -> Dict[str, dict]:
     path = _cache_file()
     if not path:
         return {}
     if not refresh and path in _disk_memo:
         return _disk_memo[path]
-    out: Dict[str, int] = {}
+    out: Dict[str, dict] = {}
     try:
         with open(path) as f:
             d = json.load(f)
         if isinstance(d, dict):
             for key, v in d.items():
-                try:
-                    out[str(key)] = int(v)
-                except (TypeError, ValueError):
-                    # hand-edited / foreign value: skip the entry, keep the
-                    # rest — a bad cache line must never fail a launch
+                entry = _norm_entry(v)
+                if entry is None:
                     log.warning("streamed-pick cache: ignoring bad value "
                                 "%r for %r", v, key)
+                else:
+                    out[str(key)] = entry
     except (OSError, ValueError):
         pass
     _disk_memo[path] = out
     return out
 
 
-def _disk_store(sig: tuple, k: int) -> None:
+def _disk_store(sig: tuple, entry: dict) -> None:
     """Best-effort read-modify-write with an atomic rename: concurrent
     processes see the old or the new file, never a torn one (a lost
     concurrent update costs one re-measure, not correctness)."""
@@ -385,50 +402,68 @@ def _disk_store(sig: tuple, k: int) -> None:
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         d = dict(_disk_load(refresh=True))    # fresh read for the RMW
-        d[_sig_str(sig)] = int(k)
+        d[_sig_str(sig)] = entry
         tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
             json.dump(d, f, sort_keys=True, indent=0)
         os.replace(tmp, path)
-        _disk_memo[path] = d
+        # the memo holds NORMALIZED entries (the freshly-stored value is
+        # still in its wire form here)
+        _disk_memo[path] = {k2: e for k2, e in
+                            ((k2, _norm_entry(v2)) for k2, v2 in d.items())
+                            if e is not None}
     except OSError as e:
         log.debug("streamed-pick cache write failed: %r", e)
 
 
-def _record_sig(sig: tuple, frames_per_dispatch: int) -> None:
-    _streamed_cache[sig] = int(frames_per_dispatch)
-    _disk_store(sig, int(frames_per_dispatch))
+def _record_sig(sig: tuple, frames_per_dispatch: int,
+                inflight: Optional[int] = None) -> None:
+    entry = {"k": int(frames_per_dispatch),
+             "inflight": int(inflight) if inflight else None}
+    _streamed_cache[sig] = entry
+    # K-only records persist in the legacy bare-int form (readable by older
+    # processes); the dict form is written only when it carries more
+    _disk_store(sig, int(frames_per_dispatch) if not inflight else entry)
 
 
 def record_streamed_pick(stages, in_dtype, platform: str,
-                         frames_per_dispatch: int) -> None:
+                         frames_per_dispatch: int,
+                         inflight: Optional[int] = None) -> None:
     _record_sig(_streamed_sig(stages, in_dtype, platform),
-                frames_per_dispatch)
+                frames_per_dispatch, inflight)
+
+
+def cached_streamed_pick(stages, in_dtype, platform: str) -> Optional[dict]:
+    """The cached pick of a previously autotuned chain as
+    ``{"k": …, "inflight": …}`` — the in-process memory layer first
+    (authoritative), then the persisted store; None when never tuned."""
+    sig = _streamed_sig(stages, in_dtype, platform)
+    entry = _streamed_cache.get(sig)
+    if entry is not None:
+        return entry
+    entry = _disk_load().get(_sig_str(sig))
+    if entry is not None:
+        _streamed_cache[sig] = entry  # promote: later lookups stay in memory
+    return entry
 
 
 def cached_frames_per_dispatch(stages, in_dtype,
                                platform: str) -> Optional[int]:
-    """The cached megabatch K of a previously autotuned chain — the
-    in-process memory layer first (authoritative), then the persisted store;
-    None when the chain was never tuned."""
-    sig = _streamed_sig(stages, in_dtype, platform)
-    k = _streamed_cache.get(sig)
-    if k is not None:
-        return k
-    k = _disk_load().get(_sig_str(sig))
-    if k is not None:
-        k = int(k)
-        _streamed_cache[sig] = k      # promote: later lookups stay in memory
-    return k
+    """The cached megabatch K of a previously autotuned chain (see
+    :func:`cached_streamed_pick`); None when the chain was never tuned."""
+    entry = cached_streamed_pick(stages, in_dtype, platform)
+    return entry["k"] if entry is not None else None
 
 
 class StreamedResults(dict):
     """The ``autotune_streamed`` sweep matrix: a plain dict keyed by
     ``(wire, frame, depth, k)`` (so it iterates/sorts uniformly), with the
     winning megabatch size stamped as the ``frames_per_dispatch`` ATTRIBUTE —
-    feed it to ``TpuKernel(frames_per_dispatch=…)``."""
+    feed it to ``TpuKernel(frames_per_dispatch=…)`` — and the winning
+    in-flight depth as ``frames_in_flight`` (the credit-controller seed)."""
 
     frames_per_dispatch: int = 1
+    frames_in_flight: int = 0
 
 
 def autotune_streamed(stages: Sequence[Stage], in_dtype,
@@ -525,11 +560,13 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
                         best_rate = rate
                         best = (wname, f, d, k)
     results.frames_per_dispatch = best[3]
+    results.frames_in_flight = best[2]
     if isinstance(pipe, DagPipeline):
         # the canonicalized DAG signature already maps a devchain-composed
         # region (per-member nodes) and a hand-built pipeline of the same
         # stages to one key — one record suffices
-        record_streamed_pick(pipe, pipe.in_dtype, inst.platform, best[3])
+        record_streamed_pick(pipe, pipe.in_dtype, inst.platform, best[3],
+                             inflight=best[2])
     elif isinstance(pipe, FanoutPipeline):
         # record BOTH fan-out-shaped signatures: the pipeline's (possibly
         # LTI-merged) stage names AND the caller's raw lists — the devchain
@@ -537,17 +574,19 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
         # names whenever the caller's optimize=True merged across what are
         # separate members in the flowgraph (the same both-signatures rule
         # as the linear branch below)
-        record_streamed_pick(pipe, pipe.in_dtype, inst.platform, best[3])
+        record_streamed_pick(pipe, pipe.in_dtype, inst.platform, best[3],
+                             inflight=best[2])
         raw_p, raw_b = pipe.raw_stage_lists
         _record_sig(_make_sig(inst.platform, pipe.in_dtype,
-                              _fanout_names(raw_p, raw_b)), best[3])
+                              _fanout_names(raw_p, raw_b)), best[3],
+                    inflight=best[2])
     else:
         # record under BOTH the caller's raw stage list and the optimized
         # pipeline stages: TpuStage/TpuKernel instances carry post-optimize
         # stage lists, so the devchain lookup sees those names
         for sig_stages in (list(stages), pipe.stages):
             record_streamed_pick(sig_stages, pipe.in_dtype, inst.platform,
-                                 best[3])
+                                 best[3], inflight=best[2])
     log.info("autotune_streamed best: wire=%s frame=%d depth=%d k=%d "
              "(%.1f Msps)", *best, best_rate)
     return best[0], best[1], best[2], results

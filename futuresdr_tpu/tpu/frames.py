@@ -115,33 +115,44 @@ class TpuH2D(Kernel):
     BLOCKING = True
 
     def __init__(self, dtype, frame_size: Optional[int] = None,
-                 inst: Optional[TpuInstance] = None, max_inflight: int = 8,
-                 wire=None):
+                 inst: Optional[TpuInstance] = None,
+                 max_inflight: Optional[int] = None, wire=None):
         super().__init__()
         from collections import deque
+        from ..ops import arena as _arena_mod
         from ..ops.wire import resolve_wire
         self.inst = inst or instance()
         self.frame_size = frame_size or self.inst.frame_size
-        self.max_inflight = max_inflight
+        self.max_inflight = 8 if max_inflight is None else max_inflight
+        # an EXPLICIT queue bound must survive device-graph fusion: the
+        # fused kernel's credit controller pins when any member pinned
+        # (runtime/devchain.py _adopt_credit_mode)
+        self._depth_explicit = max_inflight is not None
         # staging read-ahead BEYOND the queue bound (TpuKernel contract,
         # kernel_block.py): without it a frame is staged and launched in the
         # same work cycle at steady state, serializing its wire time behind
         # the previous frame's decode instead of riding under it
-        self.stage_ahead = 1 if max_inflight > 1 else 0
+        self.stage_ahead = 1 if self.max_inflight > 1 else 0
         self.dtype = np.dtype(dtype)
         self.wire = resolve_wire(wire, self.inst.platform)
-        self._staged = deque()                    # (h2d_finish, valid, tags)
+        # ring-exit staging copies ride the arena (ops/arena.py); a frame's
+        # buffer is released once its decode dispatched — the jitted prolog's
+        # output is a fresh XLA buffer, so nothing references the staging
+        # pages after that (docs/tpu_notes.md "The host data path")
+        self._arena = _arena_mod.arena()
+        self._staged = deque()             # (h2d_finish, valid, tags, handle)
         self.input = self.add_stream_input("in", dtype, min_items=self.frame_size)
         self.output = self.add_inplace_output("out")
 
-    def _stage(self, frame: np.ndarray, valid: int, tags) -> None:
+    def _stage(self, frame: np.ndarray, valid: int, tags,
+               handle=None) -> None:
         t0 = _trace.now() if _trace.enabled else 0
         parts = self.wire.encode_host(frame)
         if t0:
             _trace.complete("tpu", "encode", t0,
                             args={"wire": self.wire.name, "items": len(frame)})
         self._staged.append((xfer.start_device_transfer_parts(
-            parts, self.inst.device), valid, tags))
+            parts, self.inst.device), valid, tags, handle))
 
     def _decode_frame(self, parts):
         t0 = _trace.now() if _trace.enabled else 0
@@ -162,11 +173,15 @@ class TpuH2D(Kernel):
         while len(inp) >= self.frame_size and slots() > 0:
             tags = self.input.tags(self.frame_size)   # frame-relative indices
             frame = inp[:self.frame_size]
+            handle = None
             if self.wire.encode_may_alias(frame.dtype):
                 # async H2D must leave the ring before consume(); quantizing
                 # wires materialize fresh arrays in encode_host already
-                frame = frame.copy()
-            self._stage(frame, self.frame_size, tags)
+                if self._arena is not None:
+                    frame, handle = self._arena.copy_in(frame)
+                else:
+                    frame = frame.copy()
+            self._stage(frame, self.frame_size, tags, handle)
             self.input.consume(self.frame_size)
             inp = self.input.slice()
         eos = self.input.finished()
@@ -180,8 +195,23 @@ class TpuH2D(Kernel):
         # launch: decode landed transfers onto the frame plane, oldest first —
         # waiting only on the oldest frame's remaining wire time
         while self._staged and self.output.queue_depth() < self.max_inflight:
-            h2d, valid, tags = self._staged.popleft()
-            self.output.put_full(self._decode_frame(h2d()), valid, tags)
+            h2d, valid, tags, handle = self._staged.popleft()
+            dev_parts = h2d()
+            decoded = self._decode_frame(dev_parts)
+            if handle is not None:
+                # the staging pages are dead once nothing device-side still
+                # READS them: on accelerators that is the H2D itself (the
+                # async device_put may still be DMA-ing from the host
+                # buffer after finish() — wait for the PUT to materialize;
+                # the decode stays async); on the CPU client, device_put
+                # zero-copy BORROWS the aligned buffer, so the decode that
+                # consumes it must materialize first (free: CPU jit is
+                # synchronous)
+                import jax
+                jax.block_until_ready(
+                    decoded if self.inst.platform == "cpu" else dev_parts)
+                handle.release()
+            self.output.put_full(decoded, valid, tags)
             sent += 1
         if eos and len(inp) == 0 and not self._staged:
             io.finished = True

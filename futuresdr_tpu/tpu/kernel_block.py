@@ -13,6 +13,17 @@ The block is ``BLOCKING`` (dedicated thread), so the host sync in result retriev
 stalls the scheduler loop — the reference marks its hardware blocks ``#[blocking]`` the same
 way (`seify/source.rs`).
 
+The HOST side of the path is its own executor (docs/tpu_notes.md "The host
+data path"): ring-exit staging copies, quantizing wire-encode payloads and
+megabatch stacks live in a recycled buffer arena (``ops/arena.py`` — pinned
+per dispatch group until its outputs drain, and by the replay log until a
+checkpoint covers it, so recycling never aliases a retry/replay re-ship);
+host encode/decode can ride a small worker pool (``ops/codec_pool.py`` —
+encode offload for aliasing wires whose staging copy exists anyway, the
+D2H-landing + decode lane for every wire), and the in-flight window is a
+live credit budget (:class:`CreditController`) seeded by the
+``autotune_streamed`` pick instead of a static depth.
+
 Stream tags ride the device segment (SURVEY §7): each dispatched frame snapshots the
 tags of its input window, their indices are rebased by the pipeline's rate contract
 (the ``blocks/dsp.py`` remap; reference ``buffer/circular.rs:37-64``), and they are
@@ -40,13 +51,18 @@ per-branch drain cursors ride the drop-aware group metadata.
 
 from __future__ import annotations
 
+import os
+import threading
 import time
+import zlib
 from collections import deque
-from typing import Deque, List, Optional, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..log import logger
+from ..ops import arena as _arena_mod
+from ..ops import codec_pool as _codec_mod
 from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
 from ..telemetry import prom as _prom
@@ -78,6 +94,169 @@ _REPLAYED = _prom.counter(
     ("block",))
 
 
+#: single-thread executor for checkpoint persistence (snapshot writes +
+#: clean-EOS purges): ONE worker is the ordering guarantee — writes land
+#: newest-last and a purge queued after pending writes wins. (The codec
+#: pool's encode executor has several workers, so routing persistence
+#: through it let two writes share a tmp file and tear each other.)
+_persist_pool = None
+_persist_pool_lock = threading.Lock()
+
+
+def _persist_executor():
+    global _persist_pool
+    if _persist_pool is None:
+        with _persist_pool_lock:
+            if _persist_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _persist_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="fsdr-codec-persist")
+    return _persist_pool
+
+
+def _settle_future(fut) -> None:
+    """Wait out a codec-pool task, swallowing its outcome: quiescing before
+    recovery/re-init only needs the task's side effects (replay-log insert,
+    arena registration) to have landed — its error already surfaced (or will
+    be superseded by the restart)."""
+    try:
+        fut.result()
+    except BaseException:                  # noqa: BLE001 — quiesce only
+        pass
+
+
+class CreditController:
+    """Adaptive in-flight credit budget for the streamed drain loop.
+
+    Replaces the static ``frames_in_flight`` window with runtime credits:
+    seeded by the ``autotune_streamed`` pick (or config), BOUNDED
+    (``[lo, hi]``) and HYSTERETIC (at most ±1 per observation window, and a
+    shrink needs two consecutive slack windows). Signals, all O(1) per
+    dispatch, collected by ``TpuKernel._launch_staged``:
+
+    * **grow** — the up-link idled between consecutive dispatch groups'
+      modeled wire windows (the ``_wire`` attribute of the H2D finishes —
+      populated under a fake/measured link) while the credit budget was the
+      binding constraint (staged work waited on a full in-flight window):
+      one more credit lets one more frame's wire time ride under compute.
+    * **shrink** — the window never came within 2 credits of the budget for
+      two consecutive windows and was never credit-limited: the budget is
+      oversized; shrink toward what steady state actually uses (each unused
+      credit is a frame of latency and device memory for nothing).
+    * **rollback** — every grow is a PROBE: the next window's dispatch rate
+      must improve by >5% or the grow reverts, and growing backs off
+      EXPONENTIALLY on consecutive rollbacks (4, 8, 16 … windows). Wire
+      idle that extra credits cannot cure (synchronous CPU compute pacing
+      the loop, a genuinely host-bound cycle) — or that is just measurement
+      noise on a loaded host — therefore cannot ratchet the budget up and
+      hold latency hostage.
+
+    Without a wire-window signal (a real backend with no fake link) the
+    controller holds the seed — autotune's measured pick — rather than
+    guessing from noise. An EXPLICIT depth (per-kernel ``frames_in_flight``
+    argument or config ``tpu_inflight`` > 0) pins the budget entirely:
+    ``adaptive=False`` makes every note a no-op, so depth=1 A/B baselines
+    keep their strictly-serial contract."""
+
+    __slots__ = ("credits", "lo", "hi", "adaptive", "window",
+                 "_prev_deadline", "_idle_s", "_limited", "_max_seen",
+                 "_count", "_slack_windows", "_grow_windows", "_t0",
+                 "_probe", "_hold", "_rollbacks")
+
+    def __init__(self, seed: int, adaptive: bool, lo: int = 2,
+                 hi: Optional[int] = None, window: int = 16):
+        seed = max(1, int(seed))
+        self.credits = seed
+        self.adaptive = bool(adaptive) and seed > 1
+        self.lo = min(lo, seed)
+        # headroom is deliberately TIGHT (+2): the seed is autotune's
+        # measured pick, adaptation is fine-tuning around it — and on a
+        # loaded host, rate noise wins enough probes that a generous cap
+        # would ratchet latency up for nothing
+        self.hi = seed if not self.adaptive else \
+            (hi if hi is not None else min(16, seed + 2))
+        self.window = int(window)
+        self._prev_deadline = 0.0
+        self._idle_s = 0.0
+        self._limited = False
+        self._max_seen = 0
+        self._count = 0
+        self._slack_windows = 0
+        self._grow_windows = 0       # consecutive idle+limited windows seen
+        self._probe = None           # (credits before grow, rate before grow)
+        self._hold = 0               # windows to skip growing after a rollback
+        self._rollbacks = 0          # consecutive rollbacks (backoff exponent)
+        self._t0 = time.perf_counter()
+
+    def note_dispatch(self, wire: Optional[tuple], inflight: int) -> None:
+        """One dispatch group launched: fold in its H2D wire window and the
+        in-flight occupancy after the launch."""
+        if not self.adaptive:
+            return
+        if wire:
+            service, deadline = wire
+            if deadline:
+                if self._prev_deadline and service > self._prev_deadline:
+                    self._idle_s += service - self._prev_deadline
+                if deadline > self._prev_deadline:
+                    self._prev_deadline = deadline
+        if inflight > self._max_seen:
+            self._max_seen = inflight
+        self._count += 1
+        if self._count >= self.window:
+            self._tick()
+
+    def note_limited(self) -> None:
+        """Staged work is waiting because the in-flight window is full."""
+        if self.adaptive:
+            self._limited = True
+
+    def _tick(self) -> None:
+        span = max(time.perf_counter() - self._t0, 1e-9)
+        rate = self._count / span          # dispatch groups per second
+        if self._probe is not None:
+            # last window grew the budget as a probe: keep it only if the
+            # dispatch rate CLEARLY improved (>5% — under that, host-load
+            # noise wins more probes than real wins do) — idle the extra
+            # credit cannot cure must not ratchet the budget (and its
+            # latency) up; consecutive rollbacks back off exponentially
+            prev_credits, prev_rate = self._probe
+            self._probe = None
+            if rate < prev_rate * 1.05:
+                self.credits = prev_credits
+                self._hold = min(32, 4 << self._rollbacks)
+                self._rollbacks += 1
+            else:
+                self._rollbacks = 0
+        if self._hold > 0:
+            self._hold -= 1
+            self._grow_windows = 0
+        elif self._limited and self._idle_s > 0.02 * span \
+                and self.credits < self.hi:
+            # hysteresis on the grow side too: one noisy window must not
+            # trigger a probe (each probe costs a window at the new budget)
+            self._grow_windows += 1
+            if self._grow_windows >= 2:
+                self._probe = (self.credits, rate)
+                self.credits += 1
+                self._grow_windows = 0
+            self._slack_windows = 0
+        elif not self._limited and self._max_seen <= self.credits - 2:
+            self._grow_windows = 0
+            self._slack_windows += 1
+            if self._slack_windows >= 2 and self.credits > self.lo:
+                self.credits -= 1
+                self._slack_windows = 0
+        else:
+            self._slack_windows = 0
+            self._grow_windows = 0
+        self._count = 0
+        self._idle_s = 0.0
+        self._limited = False
+        self._max_seen = 0
+        self._t0 = time.perf_counter()
+
+
 class TpuKernel(Kernel):
     BLOCKING = True
 
@@ -104,6 +283,7 @@ class TpuKernel(Kernel):
         self.frame_size = max(m, (fs // m) * m)
         self.out_frame = self.pipeline.out_items(self.frame_size)
         self.depth = frames_in_flight or self.inst.frames_in_flight
+        self._depth_explicit = frames_in_flight is not None
         # megabatch K: lax.scan K frames through the compiled program per
         # dispatch (ops/stages.py wired_fn(k)) — per-call host overhead is paid
         # once per K frames instead of once per frame. A partial batch is only
@@ -116,17 +296,12 @@ class TpuKernel(Kernel):
         # explicit per-kernel K (even K=1) must not be second-guessed by the
         # devchain's cached-autotune pick
         self._k_explicit = frames_per_dispatch is not None
-        # H2D staging read-ahead BEYOND the in-flight budget: at steady state
-        # the in-flight deque is full, so without extra headroom a frame would
-        # be staged and launched in the same work cycle — its wire time would
-        # serialize after the previous frame's compute instead of riding under
-        # it (depth=1 keeps 0: strictly serial semantics for A/B baselines)
-        self.stage_ahead = 1 if self.depth > 1 else 0
         from ..ops.wire import resolve_wire
         # wire codec for both link crossings (None → config/auto, ops/wire.py):
         # decode/encode ride INSIDE the jitted program (compile_wired)
         self.wire = resolve_wire(wire, self.inst.platform)
         self._needs_staging = xfer.h2d_needs_staging(self.inst.platform)
+        self._init_hostpath()
         self._compiled = None
         self._carry = None
         # frames consumed from the ring, awaiting a full K-batch (k_batch > 1
@@ -155,7 +330,75 @@ class TpuKernel(Kernel):
             min_buffer_size=(self.depth * self.k_batch + 1) * self.out_frame *
             np.dtype(self.pipeline.out_dtype).itemsize)
 
+    def _init_hostpath(self) -> None:
+        """Host-data-path state shared by TpuKernel and TpuFanoutKernel
+        construction (docs/tpu_notes.md "The host data path"): the staging
+        arena, the codec worker pool, and the in-flight credit controller.
+        Requires ``self.depth`` / ``self._depth_explicit`` / ``self.wire`` /
+        ``self.pipeline`` to be set. Resolves the credit SEED: an explicit
+        per-kernel depth pins it; else config ``tpu_inflight`` > 0 pins that
+        value; else the seed is the cached ``autotune_streamed`` pick's
+        winning depth (falling back to the instance default) and the
+        controller adapts at runtime."""
+        from ..config import config
+        self._arena = _arena_mod.arena()
+        self._codec_pool = _codec_mod.pool()
+        adaptive = not self._depth_explicit
+        if not self._depth_explicit:
+            pinned = int(config().get("tpu_inflight", 0))
+            if pinned > 0:
+                self.depth = pinned
+                adaptive = False
+            else:
+                try:
+                    from .autotune import cached_streamed_pick
+                    sig = self.pipeline \
+                        if getattr(self.pipeline, "n_branches", 0) \
+                        else self.pipeline.stages
+                    pick = cached_streamed_pick(sig, self.pipeline.in_dtype,
+                                                self.inst.platform)
+                except Exception:              # noqa: BLE001 — seed only
+                    pick = None
+                if pick and pick.get("inflight"):
+                    self.depth = int(pick["inflight"])
+                    log.info("%s: in-flight credit seed %d from cached "
+                             "autotune_streamed pick",
+                             type(self).__name__, self.depth)
+        self._credits = CreditController(self.depth, adaptive=adaptive)
+        # the pool offloads the ENCODE only when the wire's host encode
+        # ALIASES its input (f32 pairs view): those frames pay the ring-exit
+        # staging copy regardless, so shipping the copy to a worker is free.
+        # Quantizing wires encode inline BEFORE consume() — zero extra copy,
+        # the contract the synchronous path always had — and still get the
+        # pooled D2H-landing/decode lane. (Offloading their encode would
+        # force a ring-exit copy the sync path never paid; measured a net
+        # loss at small frames, perf/HOSTPATH_AB_r14.md.)
+        self._encode_offload = self._codec_pool is not None and \
+            self.wire.encode_may_alias(self.pipeline.in_dtype)
+        # H2D staging read-ahead BEYOND the in-flight budget: at steady state
+        # the in-flight deque is full, so without extra headroom a frame would
+        # be staged and launched in the same work cycle — its wire time would
+        # serialize after the previous frame's compute instead of riding under
+        # it (depth=1 keeps 0: strictly serial semantics for A/B baselines)
+        self.stage_ahead = 1 if self.depth > 1 else 0
+
+    def _adopt_credit_mode(self, adaptive: bool) -> None:
+        """Re-arm the credit controller post-construction. The device-graph
+        fusion builders pass the members' depth as an explicit argument
+        (which pins credits), but whether the FUSED kernel may adapt follows
+        the members' own explicitness — a chain of default-depth kernels
+        keeps its adaptive budget across fusion. A config ``tpu_inflight``
+        pin always wins: "N>0 pins the budget" must survive fusion too."""
+        from ..config import config
+        if int(config().get("tpu_inflight", 0)) > 0:
+            adaptive = False
+        self._credits = CreditController(self.depth, adaptive=adaptive)
+
     def extra_metrics(self) -> dict:
+        # the scrape thread reads the replay log while codec workers insert
+        # into it out of band — same lock as every other rlog access
+        with self._rlog_lock:
+            replay_frames = sum(len(m) for _, _, m, _ in self._rlog)
         return {
             "frame_size": self.frame_size,
             "wire": self.wire.name,
@@ -165,9 +408,10 @@ class TpuKernel(Kernel):
             "frames_in_flight": sum(len(m) for _, m, _, _ in self._inflight),
             "frames_dispatched": self._frames_dispatched,
             "dispatches": self._dispatches,
+            "inflight_credits": self._credits.credits,
             "checkpoint_every": self._ckpt_every,
             "checkpoint_seq": self._ckpts[-1][0] if self._ckpts else -1,
-            "replay_log_frames": sum(len(m) for _, _, m in self._rlog),
+            "replay_log_frames": replay_frames,
         }
 
     async def init(self, mio, meta):
@@ -181,6 +425,10 @@ class TpuKernel(Kernel):
         # restores the last committed checkpoint and replays the logged
         # groups bit-correct; init is only the fallback when no usable
         # checkpoint exists (checkpoint_every=0, or every candidate invalid).
+        # quiesce codec-pool tasks first: a straggling encode worker must not
+        # insert into the replay log after the reset below clears it, and
+        # arena buffers must be registered before they are released
+        self._settle_staged()
         # drop-flagged replayed groups are excluded everywhere: their outputs
         # were already emitted, so losing them forfeits nothing
         forfeit = len(self._accum) \
@@ -194,6 +442,10 @@ class TpuKernel(Kernel):
             self._forfeit_ctr.inc(forfeit)
             log.warning("%s: fresh re-init forfeits %d in-flight frame(s)",
                         self.meta.instance_name, forfeit)
+        for entry in self._accum:          # arena staging copies of queued
+            h = entry[4]                   # megabatch frames die with them
+            if h is not None:
+                h.release()
         self._accum.clear()
         self._staged.clear()
         self._inflight.clear()
@@ -282,56 +534,204 @@ class TpuKernel(Kernel):
 
     # -- helpers ---------------------------------------------------------------
     def _stage(self, frame: np.ndarray, valid_in: int,
-               tags: Sequence[ItemTag] = ()) -> None:
+               tags: Sequence[ItemTag] = (), handle=None) -> None:
         """Queue one frame toward a dispatch group. ``k_batch == 1``: encode
         into wire parts and START its H2D immediately (compute dispatch waits
-        for :meth:`_launch_staged`). ``k_batch > 1``: accumulate until the
-        group fills, then :meth:`_flush_accum` ships the whole batch as one
+        for :meth:`_launch_staged`) — with the codec pool armed, the encode
+        and the H2D start run on a worker so they ride under this thread's
+        dispatch of older frames. ``k_batch > 1``: accumulate until the group
+        fills, then :meth:`_flush_accum` ships the whole batch as one
         transfer. ``valid_in`` (a frame_multiple multiple) bounds how much of
-        the output is real data vs zero-pad tail; ``tags`` are frame-relative."""
+        the output is real data vs zero-pad tail; ``tags`` are
+        frame-relative; ``handle`` is the arena buffer backing ``frame``
+        (None when the frame is allocation-fresh)."""
         t_in = time.perf_counter_ns()
         if self.k_batch == 1:
-            t0 = _trace.now() if _trace.enabled else 0
-            parts = self.wire.encode_host(frame)
+            self._submit_group([frame], ((valid_in, tuple(tags), t_in),),
+                               [handle] if handle is not None else [])
+            return
+        self._accum.append((frame, valid_in, tuple(tags), t_in, handle))
+        if len(self._accum) >= self.k_batch:
+            self._flush_accum()
+
+    def _encode_group(self, frames: list, frame_handles: list) -> tuple:
+        """Encode one dispatch group's frames into wire parts (``k>1``:
+        stacked along a leading frame axis, into recycled arena buffers) and
+        partition the arena buffers by lifetime: aliasing encodes' parts are
+        views of the staging frame (the f32 pairs view), so that frame's
+        handle must stay PINNED with the group; every other staging frame
+        dies with the encode and its handle is merely RELEASABLE — the
+        caller releases on success, or leaves ownership with the restored
+        input retention on a fatal H2D start (``_flush_accum``). Runs on the
+        staging thread or a codec worker — either way the encode span lands
+        in the running thread's ring, so the doctor's lane unions attribute
+        the host codec time to where it was actually paid.
+
+        Returns ``(parts, pinned_handles, releasable_handles)``."""
+        t0 = _trace.now() if _trace.enabled else 0
+        alloc = _arena_mod.GroupAlloc(self._arena) \
+            if self._arena is not None else None
+        if self.k_batch == 1:
+            frame = frames[0]
+            parts = self.wire.encode_into(frame, alloc) \
+                if alloc is not None else self.wire.encode_host(frame)
+            aliases = self.wire.encode_may_alias(frame.dtype)
+            pinned = list(frame_handles) if aliases else []
+            rel = [] if aliases else list(frame_handles)
+            if alloc is not None:
+                pinned += alloc.handles
             if t0:
                 _trace.complete("tpu", "encode", t0,
                                 args={"wire": self.wire.name,
                                       "items": len(frame)})
-            self._stage_group(parts, ((valid_in, tuple(tags), t_in),))
-            return
-        self._accum.append((frame, valid_in, tuple(tags), t_in))
-        if len(self._accum) >= self.k_batch:
-            self._flush_accum()
+            return parts, pinned, rel
+        # megabatch: per-frame encodes are SCRATCH (the stacked copies are
+        # the group's payload), so they ride the temp side of the alloc and
+        # are dropped before return; the staging frames never alias the
+        # stacked parts, so every frame handle is releasable
+        sub = alloc.temps_only() if alloc is not None else None
+        parts_list = [self.wire.encode_into(f, sub) if sub is not None
+                      else self.wire.encode_host(f) for f in frames]
+        stacked = []
+        for j in range(len(parts_list[0])):
+            rows = [np.asarray(p[j]) for p in parts_list]
+            if alloc is not None:
+                out = alloc((len(rows),) + rows[0].shape, rows[0].dtype)
+                for i, r in enumerate(rows):
+                    out[i] = r
+            else:
+                out = np.stack(rows)
+            stacked.append(out)
+        if alloc is not None:
+            alloc.drop_temps()
+        if t0:
+            _trace.complete("tpu", "encode", t0,
+                            args={"wire": self.wire.name,
+                                  "items": len(frames) * self.frame_size,
+                                  "frames": len(frames)})
+        return (tuple(stacked),
+                alloc.handles if alloc is not None else [],
+                list(frame_handles))
 
-    def _stage_group(self, parts: tuple, metas: tuple) -> None:
-        """Start one dispatch group's H2D, then assign its sequence number
-        and log it for replay. The log entry is created only AFTER the start
-        succeeds: a fatally-failed start leaves the group's input in its
-        previous retention (the ring for ``k==1`` — consume() runs after
-        ``_stage`` returns — or ``_accum``, restored by ``_flush_accum``), so
-        logging it too would make a later replay process it twice."""
-        fin = xfer.start_device_transfer_parts(parts, self.inst.device)
+    def _rlog_insert(self, seq: int, parts: tuple, metas: tuple,
+                     handles) -> None:
+        """Insert one group into the replay log in SEQUENCE order (codec
+        workers may complete out of order), retaining its arena buffers for
+        the log's lifetime. The leak guard of the old append path applies:
+        commits normally prune the log, but PERSISTENT snapshot failures
+        would grow it without bound — past several windows' worth the head
+        is dropped, and recovery then declines non-contiguous checkpoints
+        and falls back to the billed forfeiting re-init instead of the
+        process leaking until OOM."""
+        for h in handles:
+            h.retain()
+        dropped = False
+        with self._rlog_lock:
+            entry = (seq, parts, metas, tuple(handles))
+            if not self._rlog or self._rlog[-1][0] < seq:
+                self._rlog.append(entry)
+            else:
+                i = 0
+                for i, e in enumerate(self._rlog):      # noqa: B007
+                    if e[0] > seq:
+                        break
+                self._rlog.insert(i, entry)
+            cap = 64 + 4 * (self.depth + self.stage_ahead + self._ckpt_every)
+            while len(self._rlog) > cap:
+                _, _, _, hs = self._rlog.popleft()
+                for h in hs:
+                    h.release()
+                self._rlog_dropped += 1
+                dropped = self._rlog_dropped == 1
+        if dropped:
+            log.warning(
+                "%s: replay log exceeded its cap (checkpoints not "
+                "committing?) — dropping oldest; a restart may now "
+                "forfeit instead of replaying", self.meta.instance_name)
+
+    def _submit_group(self, frames: list, metas: tuple,
+                      frame_handles: list) -> None:
+        """Route one dispatch group toward the wire.
+
+        Codec pool OFF (``host_codec_workers=0``): the synchronous pre-pool
+        path — encode, then :meth:`_stage_group` starts the H2D and logs the
+        group only AFTER the start succeeds (a fatally-failed start leaves
+        the input in its previous retention: the ring for ``k==1``, or
+        ``_accum`` restored by ``_flush_accum``).
+
+        Encode offload ON (pool armed AND the wire's encode aliases — see
+        ``_init_hostpath``): encode AND the H2D start run on a worker — the
+        encode(t+1) ∥ H2D(t) lanes. The frames already left the ring at
+        submit (consume() runs right after ``_stage`` returns), so the
+        replay log is the group's ONLY retention: pool mode logs BEFORE the
+        start attempt, and a fatally-failed start surfaces at the join in
+        :meth:`_launch_staged` with the group still replayable (and still
+        counted by the forfeit accounting when checkpointing is off)."""
+        pool = self._codec_pool
+        if pool is None or not self._encode_offload:
+            parts, pinned, rel = self._encode_group(frames, frame_handles)
+            # a fatal start releases `pinned` inside _stage_group and leaves
+            # `rel` with the restored input retention (_flush_accum puts the
+            # frames — still backed by those buffers — back into _accum)
+            self._stage_group(parts, metas, pinned)
+            for h in rel:
+                h.release()
+            return
         seq = self._seq
         self._seq = seq + 1
+        ck = self._ckpt_every
+
+        def task():
+            parts, pinned, rel = self._encode_group(frames, frame_handles)
+            for h in rel:      # pool-mode frames never return to a ring
+                h.release()
+            if ck:
+                self._rlog_insert(seq, parts, metas, pinned)
+            if pinned:
+                self._group_handles[seq] = pinned
+            return xfer.start_device_transfer_parts(parts, self.inst.device)
+
+        fut = pool.submit_encode(task)
+
+        def join():
+            fin = fut.result()
+            join._wire = getattr(fin, "_wire", None)
+            return fin()
+
+        join._settle = lambda: _settle_future(fut)
+        self._staged.append((join, metas, seq, False))
+
+    def _stage_group(self, parts: tuple, metas: tuple,
+                     handles: Sequence = ()) -> None:
+        """Synchronous-path H2D start + sequence assignment + replay
+        logging (see :meth:`_submit_group` for the retention contract).
+        ``handles`` are the arena buffers backing ``parts`` — released here
+        on a fatal start (the input retention reverts to the ring/_accum),
+        pinned with the group otherwise."""
+        try:
+            fin = xfer.start_device_transfer_parts(parts, self.inst.device)
+        except BaseException:
+            for h in handles:
+                h.release()
+            raise
+        seq = self._seq
+        self._seq = seq + 1
+        if handles:
+            self._group_handles[seq] = list(handles)
         if self._ckpt_every:
-            self._rlog.append((seq, parts, metas))
-            # leak guard: commits normally prune the log, but PERSISTENT
-            # snapshot failures would grow it without bound (commits never
-            # advance past the init sentinel). Past several windows' worth,
-            # drop the head — recovery then declines non-contiguous
-            # checkpoints and falls back to the billed forfeiting re-init
-            # instead of the process leaking until OOM.
-            cap = 64 + 4 * (self.depth + self.stage_ahead + self._ckpt_every)
-            if len(self._rlog) > cap:
-                self._rlog.popleft()
-                self._rlog_dropped += 1
-                if self._rlog_dropped == 1:
-                    log.warning(
-                        "%s: replay log exceeded %d groups (checkpoints not "
-                        "committing?) — dropping oldest; a restart may now "
-                        "forfeit instead of replaying",
-                        self.meta.instance_name, cap)
+            self._rlog_insert(seq, parts, metas, handles)
         self._staged.append((fin, metas, seq, False))
+
+    def _settle_staged(self) -> None:
+        """Quiesce pool-mode tasks still running for this kernel (exceptions
+        swallowed — they already surfaced, or the restart supersedes them):
+        recovery and re-init must observe a settled replay log and a
+        complete arena-handle registry before clearing either."""
+        for dq in (self._staged, self._inflight):
+            for entry in dq:
+                s = getattr(entry[0], "_settle", None)
+                if s is not None:
+                    s()
 
     def _flush_accum(self) -> None:
         """Encode the accumulated frames, stack each wire part along a leading
@@ -342,27 +742,21 @@ class TpuKernel(Kernel):
         if not self._accum:
             return
         group, self._accum = self._accum, []
-        frames = [f for f, _, _, _ in group]
+        frames = [f for f, _, _, _, _ in group]
         while len(frames) < self.k_batch:
             frames.append(np.zeros(self.frame_size,
                                    dtype=self.pipeline.in_dtype))
-        t0 = _trace.now() if _trace.enabled else 0
-        parts_list = [self.wire.encode_host(f) for f in frames]
-        stacked = tuple(np.stack([np.asarray(p[j]) for p in parts_list])
-                        for j in range(len(parts_list[0])))
-        if t0:
-            _trace.complete("tpu", "encode", t0,
-                            args={"wire": self.wire.name,
-                                  "items": len(group) * self.frame_size,
-                                  "frames": len(group)})
-        metas = tuple((v, t, tin) for _, v, t, tin in group)
+        metas = tuple((v, t, tin) for _, v, t, tin, _ in group)
+        handles = [h for _, _, _, _, h in group if h is not None]
         # the stacked (zero-padded) parts are what the replay log retains, so
         # a replayed partial EOS batch re-ships the exact same scan payload.
-        # A fatally-failed start restores the group to _accum: its frames
-        # already left the ring, and only _accum (or the replay log, which
-        # only admits started groups) may retain them.
+        # On the synchronous path a fatally-failed start restores the group
+        # to _accum: its frames already left the ring, and only _accum (or
+        # the replay log) may retain them — the restored entries keep their
+        # arena handles (releasable ones are only released on success), so
+        # the arena cannot recycle a buffer a restored frame still views.
         try:
-            self._stage_group(stacked, metas)
+            self._submit_group(frames, metas, handles)
         except Exception:
             self._accum = group + self._accum
             raise
@@ -392,17 +786,23 @@ class TpuKernel(Kernel):
         keep transferring, dispatched frames keep computing, finished frames'
         D2H keeps draining: the H2D(t+1) ∥ compute(t) ∥ D2H(t−1) overlap of
         the reference's circulating h2d/d2h staging pairs, on XLA's async
-        dispatch queue. Shared verbatim by the fan-out kernel — only the
-        result-side hook differs."""
+        dispatch queue (with the codec pool armed, encode and decode become
+        their own lanes around it). The in-flight bound is the credit
+        controller's LIVE budget, not the construction-time depth. Shared
+        verbatim by the fan-out kernel — only the result-side hook differs."""
         fplan = _faults.plan()
-        while self._staged and len(self._inflight) < self.depth:
+        while self._staged and len(self._inflight) < self._credits.credits:
             if fplan.armed():
                 # `dispatch` site (runtime/faults.py): fault BEFORE the group
                 # leaves the staging deque, so recovery replays (or
                 # fail_fast/isolate forfeit) a deterministic amount of work
                 fplan.maybe("dispatch", self.meta.instance_name)
-            h2d, metas, seq, drop = self._staged.popleft()
+            # peek-then-pop: a pool-mode group whose H2D start failed fatally
+            # raises at the join below with the group STILL staged — the
+            # forfeit accounting and the replay log both keep sight of it
+            h2d, metas, seq, drop = self._staged[0]
             x_parts = h2d()
+            self._staged.popleft()
             # donation fence: the snapshot D2H of the previous carry must be
             # host-side before this dispatch donates and reuses its buffers
             self._materialize_pending_ckpts()
@@ -415,21 +815,48 @@ class TpuKernel(Kernel):
                 _trace.complete("tpu", "compute", t0,
                                 args={"frame": self.frame_size,
                                       "frames": len(metas)})
+            fin, out_metas = self._start_result_d2h(y_parts, metas)
             self._inflight.append(
-                self._start_result_d2h(y_parts, metas) + (seq, drop))
+                (self._wrap_landing(fin, out_metas, drop), out_metas, seq,
+                 drop))
             self._checkpoint_tick(seq)
             self._frames_dispatched += len(metas)
             self._dispatches += 1
+            self._credits.note_dispatch(getattr(h2d, "_wire", None),
+                                        len(self._inflight))
+        if self._staged and len(self._inflight) >= self._credits.credits:
+            self._credits.note_limited()
 
-    def _drain_one(self) -> Optional[Tuple[np.ndarray, list]]:
-        finish, out_metas, seq, drop = self._inflight.popleft()
-        # sync point: blocks only this block's thread
-        raw = finish()
-        if drop:
-            # replayed group whose outputs were emitted before the fault: the
-            # replay only re-advanced the carry — suppress the duplicate
-            self._note_drained(seq)
-            return None
+    def _wrap_landing(self, finish, out_metas, drop: bool):
+        """Turn one dispatch group's D2H finish into a zero-arg ``land()``
+        yielding the DECODED payload (None for a drop-marked replayed group —
+        its transfer still lands, the duplicate emission is suppressed).
+        With the codec pool armed the whole landing — D2H wire wait + host
+        decode — runs on a decode worker starting NOW, so decode(t−1) rides
+        under this thread's staging/dispatch of younger frames; emission
+        order is preserved because the caller joins the in-flight deque
+        oldest-first."""
+        def land():
+            raw = finish()
+            if drop:
+                return None
+            return self._decode_group(raw, out_metas)
+
+        pool = self._codec_pool
+        if pool is None:
+            return land
+        fut = pool.submit_decode(land)
+
+        def join():
+            return fut.result()
+
+        join._settle = lambda: _settle_future(fut)
+        return join
+
+    def _decode_group(self, raw, out_metas):
+        """Host-decode one landed dispatch group (runs on the drain thread,
+        or on a codec worker under the pool). Returns
+        ``(result, tags, t_ins)``."""
         t0 = _trace.now() if _trace.enabled else 0
         if self.k_batch == 1:
             ((valid, tags, t_in),) = out_metas
@@ -447,6 +874,23 @@ class TpuKernel(Kernel):
             result = (np.concatenate(chunks) if chunks
                       else np.empty(0, dtype=self.pipeline.out_dtype))
             t_ins = tuple(tin for _, _, tin in out_metas)
+        if t0:
+            _trace.complete("tpu", "decode", t0,
+                            args={"wire": self.wire.name,
+                                  "items": len(result)})
+        return result, all_tags, t_ins
+
+    def _drain_one(self) -> Optional[Tuple[np.ndarray, list]]:
+        land, _out_metas, seq, _drop = self._inflight.popleft()
+        # sync point: blocks only this block's thread (pool mode: joins the
+        # decode worker's already-running landing task)
+        payload = land()
+        if payload is None:
+            # replayed group whose outputs were emitted before the fault: the
+            # replay only re-advanced the carry — suppress the duplicate
+            self._note_drained(seq)
+            return None
+        result, all_tags, t_ins = payload
         end = time.perf_counter_ns()
         if self._e2e_hist is not None:
             # per-frame end-to-end latency: ring exit → decoded host result
@@ -456,9 +900,6 @@ class TpuKernel(Kernel):
             # OWN ingestion stamp, so K>1 trickle latency stays visible.
             for tin in t_ins:
                 self._e2e_hist.observe((end - tin) * 1e-9)
-        if t0:
-            _trace.complete("tpu", "decode", t0, end_ns=end,
-                            args={"wire": self.wire.name, "items": len(result)})
         # mark drained only AFTER the decode succeeded: a fault inside the
         # decode/rebase window must replay this group WITH its outputs, not
         # drop them as already-emitted
@@ -487,10 +928,30 @@ class TpuKernel(Kernel):
         self._ckpt_every = self._ckpt_cadence if self._ckpt_explicit else 0
         self._seq = 0                    # next dispatch-group sequence number
         self._drained_seq = -1           # newest group whose outputs drained
-        # replay log: (seq, host wire parts, metas) per un-covered dispatch
-        # group — the parts are the idempotent host STAGING copies the
-        # transfer-retry plane already relies on (no extra copy)
+        # replay log: (seq, host wire parts, metas, arena handles) per
+        # un-covered dispatch group — the parts are the idempotent host
+        # STAGING copies the transfer-retry plane already relies on (no
+        # extra copy); the handles PIN the arena buffers backing them so
+        # recycling can never alias a frame fault recovery may re-ship
         self._rlog: Deque[tuple] = deque()
+        # codec workers insert into the log out of band — one lock guards
+        # every rlog mutation (insert, prune, cap-drop, clear)
+        self._rlog_lock = threading.Lock()
+        # seq -> arena handles of the group's live staging buffers, released
+        # when the group's outputs drain (or at forfeiture)
+        self._group_handles: Dict[int, list] = {}
+        # cross-process checkpoint persistence (docs/robustness.md): each
+        # commit also lands on disk when `checkpoint_dir` is set, and
+        # recover() falls back to it when no in-kernel state survives.
+        # Writes COALESCE through a one-slot latest box: at most one write
+        # task is queued per kernel, and it drains the NEWEST snapshot — a
+        # disk slower than the commit rate skips intermediate snapshots
+        # instead of backlogging MB-scale carries without bound.
+        d = str(config().get("checkpoint_dir", "") or "")
+        self._ckpt_dir = os.path.expanduser(d) if d else ""
+        self._persist_lock = threading.Lock()
+        self._persist_box = None         # newest un-written (seq, leaves)
+        self._persist_queued = False
         # committed checkpoints (seq, host leaves | None, treedef | None),
         # newest last; ring of 2 so a corrupted candidate can fall back to
         # the previous one. (seq=-1, None, None) is the fresh-init sentinel.
@@ -579,10 +1040,13 @@ class TpuKernel(Kernel):
         self._pending_ckpts = keep
 
     def _note_drained(self, seq: int) -> None:
-        """Group ``seq``'s outputs are host-side: advance the drain cursor,
-        commit every snapshot it covers, and prune the replay log back to the
-        PREVIOUS committed checkpoint (kept so a corrupted newest candidate
-        can still fall back and replay from the older restore point)."""
+        """Group ``seq``'s outputs are host-side: release its pinned arena
+        staging buffers, advance the drain cursor, commit every snapshot it
+        covers, and prune the replay log back to the PREVIOUS committed
+        checkpoint (kept so a corrupted newest candidate can still fall back
+        and replay from the older restore point)."""
+        for h in self._group_handles.pop(seq, ()):
+            h.release()
         if seq > self._drained_seq:
             self._drained_seq = seq
         if not self._ckpt_every:
@@ -607,22 +1071,162 @@ class TpuKernel(Kernel):
             if self._ckpts and self._ckpts[-1][0] >= s:
                 continue                 # replay re-commit of a covered seq
             self._ckpts.append((s, leaves, treedef))
+            self._persist_ckpt(s, leaves)
             if len(self._ckpts) >= 2:
                 floor = self._ckpts[0][0]
-                while self._rlog and self._rlog[0][0] <= floor:
-                    self._rlog.popleft()
+                with self._rlog_lock:
+                    while self._rlog and self._rlog[0][0] <= floor:
+                        _, _, _, hs = self._rlog.popleft()
+                        for h in hs:
+                            h.release()
 
-    def _recovery_reset(self) -> None:
+    def _recovery_reset(self, purge_disk: bool = False) -> None:
         """Drop every checkpoint/replay artifact (fresh incarnation, or a
         cleanly finished stream — a later re-run must not replay stale
-        groups into a new flowgraph's buffers)."""
+        groups into a new flowgraph's buffers), releasing the arena buffers
+        the log and the live groups pinned. ``purge_disk`` additionally
+        removes the persisted snapshot (clean EOS only: the stream's state
+        is complete, a later process must start fresh — a RE-INIT must NOT
+        purge, the disk snapshot is exactly what a process restart resumes
+        from)."""
         self._seq = 0
         self._drained_seq = -1
-        self._rlog.clear()
+        with self._rlog_lock:
+            for _, _, _, hs in self._rlog:
+                for h in hs:
+                    h.release()
+            self._rlog.clear()
+        for hs in self._group_handles.values():
+            for h in hs:
+                h.release()
+        self._group_handles.clear()
         self._ckpts.clear()
         self._pending_ckpts.clear()
         self._replay_queue.clear()
         self._replay_high = -1
+        if purge_disk and self._ckpt_dir:
+            path = self._ckpt_file()
+            if path:
+                def purge():
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                # same FIFO executor as the writes: a purge queued after a
+                # pending persist deletes what that persist wrote, so a
+                # cleanly-finished stream can never leave a snapshot behind
+                self._persist_submit(purge)
+
+    # -- cross-process checkpoint persistence (config `checkpoint_dir`) -------
+    def _ckpt_file(self) -> Optional[str]:
+        """The snapshot path of THIS kernel: instance name (sanitized) plus a
+        hash of the pipeline signature (stage names + in dtype), so a
+        restarted process with the same flowgraph maps to the same file and
+        a DIFFERENT pipeline under a reused name can never restore a
+        mismatched carry (the integrity check would reject it anyway — the
+        name just keeps unrelated snapshots from colliding)."""
+        if not self._ckpt_dir:
+            return None
+        import hashlib
+        name = self.meta.instance_name or type(self).__name__
+        stages = getattr(self.pipeline, "stages", ())
+        sig = "|".join(str(getattr(s, "name", "?")) for s in stages) \
+            or type(self.pipeline).__name__
+        h = hashlib.sha1(
+            f"{name}|{sig}|{np.dtype(self.pipeline.in_dtype)}".encode()
+        ).hexdigest()[:10]
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
+        return os.path.join(self._ckpt_dir, f"{safe}-{h}.ckpt.npz")
+
+    @staticmethod
+    def _ckpt_crc(leaves) -> int:
+        crc = 0
+        for l in leaves:
+            a = np.ascontiguousarray(np.asarray(l))
+            crc = zlib.crc32(a.tobytes(), crc)
+        return crc & 0xFFFFFFFF
+
+    def _persist_submit(self, fn) -> None:
+        """Run a persistence task (snapshot write, clean-EOS purge) off the
+        drain thread on the ONE-worker persistence executor
+        (:func:`_persist_executor`) — strictly serialized, so writes land
+        newest-last and a purge queued after pending writes wins. Inline
+        with the codec pool off (a deliberate minimal-thread config;
+        persistence is opt-in there, and the kernel thread is trivially
+        serial)."""
+        if self._codec_pool is None:
+            fn()
+        else:
+            _persist_executor().submit(fn)
+
+    def _persist_ckpt(self, seq: int, leaves) -> None:
+        """Serialize one COMMITTED checkpoint under ``checkpoint_dir``:
+        atomic rename (a reader sees the old or the new snapshot, never a
+        torn one), CRC-integrity-checked on load. Best-effort — a write
+        failure only narrows the cross-process restore window, it must
+        never fail the drain path — queued off-thread
+        (:meth:`_persist_submit`, the CRC + npz write of an MB-scale carry
+        must not stall the dispatch/drain loop every cadence interval) and
+        COALESCED (the one-slot latest box of ``_init_recovery_state``):
+        only the newest snapshot matters, so a slow disk skips intermediate
+        commits instead of queueing them without bound. ``leaves`` are
+        already-materialized host arrays the checkpoint ring owns
+        immutably, so the task reads stable bytes."""
+        path = self._ckpt_file()
+        if not path:
+            return
+        name = self.meta.instance_name
+        with self._persist_lock:
+            self._persist_box = (seq, leaves)
+            if self._persist_queued:
+                return                   # the queued task drains the box
+            self._persist_queued = True
+
+        def write():
+            with self._persist_lock:
+                item = self._persist_box
+                self._persist_box = None
+                self._persist_queued = False
+            if item is None:
+                return
+            s, lv = item
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = f"{path}.{os.getpid()}.tmp"
+                arrs = {f"leaf{i}": np.asarray(l) for i, l in enumerate(lv)}
+                with open(tmp, "wb") as f:
+                    np.savez(f, _seq=np.int64(s), _n=np.int64(len(lv)),
+                             _crc=np.uint32(self._ckpt_crc(lv)), **arrs)
+                os.replace(tmp, path)
+            except Exception as e:                     # noqa: BLE001
+                log.warning("%s: checkpoint persist @%d failed (%r)",
+                            name, s, e)
+
+        self._persist_submit(write)
+
+    def _load_disk_ckpt(self) -> Optional[tuple]:
+        """``(seq, leaves)`` of the persisted snapshot, or None when absent,
+        unreadable, or failing the CRC — a corrupted file is logged and
+        ignored (recovery falls through to the fresh-init path)."""
+        path = self._ckpt_file()
+        if not path or not os.path.exists(path):
+            return None
+        try:
+            with np.load(path) as z:
+                n = int(z["_n"])
+                seq = int(z["_seq"])
+                crc = int(z["_crc"])
+                leaves = [z[f"leaf{i}"] for i in range(n)]
+            if crc != self._ckpt_crc(leaves):
+                log.warning("%s: persisted checkpoint %s failed its "
+                            "integrity check — ignored",
+                            self.meta.instance_name, path)
+                return None
+            return seq, leaves
+        except Exception as e:                         # noqa: BLE001
+            log.warning("%s: persisted checkpoint %s unreadable (%r) — "
+                        "ignored", self.meta.instance_name, path, e)
+            return None
 
     def _restore_candidates(self):
         """Committed checkpoints newest-first, each validated lazily by
@@ -642,12 +1246,54 @@ class TpuKernel(Kernel):
         untouched — it was never lost."""
         if not self._ckpt_every or not self._ckpts:
             return False
+        # quiesce codec-pool tasks: the replay log must be settled (workers
+        # insert out of band) before it is read as the recovery source
+        self._settle_staged()
         # integrity template: the pipeline's OWN fresh carry for this compile
         # (cached jit — no recompilation); also re-resolves self._compiled if
         # the failed incarnation never finished init
         self._compiled, fresh = self.pipeline.compile_wired(
             self.frame_size, self.wire, device=self.inst.device,
             k=self.k_batch, donate=self._donate)
+        if self._seq == 0 and not self._rlog and self._ckpt_dir:
+            # VIRGIN incarnation (nothing dispatched, nothing to replay):
+            # the only meaningful state is a previous PROCESS's persisted
+            # snapshot — prefer it over the fresh-init sentinel. In-kernel
+            # candidates always win once this process has dispatched
+            # anything (docs/robustness.md "persisting checkpoints").
+            disk = self._load_disk_ckpt()
+            if disk is not None:
+                seq_d, leaves_d = disk
+                import jax
+                treedef_d = jax.tree_util.tree_flatten(fresh)[1]
+                if self.pipeline.carry_matches(leaves_d, treedef_d, fresh):
+                    self._carry = self.pipeline.restore_carry(
+                        leaves_d, treedef_d, self.inst.device)
+                    self._staged.clear()
+                    self._inflight.clear()
+                    self._pending_ckpts.clear()
+                    self._replay_queue.clear()
+                    # seed the ring with the DISK carry as a real candidate
+                    # at the pre-stream position: a later in-process fault
+                    # (before the first new commit) must replay this
+                    # incarnation's groups on top of the restored carry,
+                    # not on a fresh one
+                    self._ckpts.clear()
+                    self._ckpts.append(
+                        (-1, [np.asarray(l) for l in leaves_d], treedef_d))
+                    log.info("%s: restored carry from persisted checkpoint "
+                             "@%d (%s) after a process restart — the replay "
+                             "window of the previous process is lost, "
+                             "resuming from the snapshot after %r",
+                             self.meta.instance_name, seq_d,
+                             self._ckpt_file(), err)
+                    _trace.instant("tpu", "checkpoint_restore_disk",
+                                   args={"block": self.meta.instance_name,
+                                         "checkpoint_seq": seq_d})
+                    return True
+                log.warning("%s: persisted checkpoint failed the carry "
+                            "contract check (pipeline changed?) — ignored",
+                            self.meta.instance_name)
         chosen = None
         invalid: set = set()
         for seq, leaves, treedef in self._restore_candidates():
@@ -696,7 +1342,9 @@ class TpuKernel(Kernel):
         self._pending_ckpts.clear()
         self._replay_queue.clear()
         replayed = 0
-        for s, parts, metas in self._rlog:
+        with self._rlog_lock:
+            log_entries = list(self._rlog)
+        for s, parts, metas, _hs in log_entries:
             if s <= seq:
                 continue
             self._replay_queue.append((s, parts, metas,
@@ -715,6 +1363,31 @@ class TpuKernel(Kernel):
                              "checkpoint_seq": seq, "replayed": replayed})
         return True
 
+    def _stage_copy(self, frame: np.ndarray) -> tuple:
+        """The ring-exit staging copy, arena-backed: ``(frame', handle)``.
+        The copy is needed when the encode may ALIAS the ring view (async
+        H2D would read the ring after the writer reclaims it — the f32 pairs
+        view; ``ops/xfer.h2d_needs_staging`` is always True); in pool mode
+        the worker-side encode then reads the copy, never the ring. With the
+        arena on, the copy lands in recycled pages instead of a fresh
+        allocation."""
+        if not self._needs_staging:
+            return frame, None
+        if not self.wire.encode_may_alias(frame.dtype) and self.k_batch == 1:
+            # quantizing wires materialize fresh arrays in the encode
+            # before consume() — inline in pool mode too (encode offload is
+            # reserved for aliasing wires, see _init_hostpath) — no copy.
+            # k==1 ONLY: a megabatch frame sits in _accum across work
+            # cycles AFTER consume() freed its ring space, so it must leave
+            # the ring regardless of the wire (the writer would otherwise
+            # overwrite it before _flush_accum encodes — a latent hazard of
+            # the pre-arena k>1 quantizing path, now closed by the cheap
+            # recycled copy)
+            return frame, None
+        if self._arena is not None:
+            return self._arena.copy_in(frame)
+        return frame.copy(), None
+
     def _stage_available_input(self):
         """Step 2 of the work loop, shared with the fan-out kernel: stage as
         many full frames as the pipeline depth allows — each one's H2D starts
@@ -724,7 +1397,7 @@ class TpuKernel(Kernel):
         handing it a live ring-buffer view would race with the writer
         overwriting consumed space — the frame must leave the ring before
         consume(). Returns ``(remaining input slice, eos)``."""
-        budget = self.depth + self.stage_ahead
+        budget = self._credits.credits + self.stage_ahead
         # replayed groups re-enter the dispatch window FIRST (sequence
         # order), under the same budget as live staging
         while self._replay_queue and \
@@ -741,14 +1414,8 @@ class TpuKernel(Kernel):
                 len(inp) >= self.frame_size:
             tags = self.input.tags(self.frame_size)
             frame = inp[:self.frame_size]
-            if self._needs_staging and self.wire.encode_may_alias(frame.dtype):
-                # the frame must leave the ring before consume(): async H2D on
-                # accelerators, and the CPU client zero-copy BORROWS aligned
-                # views (ops/xfer.h2d_needs_staging — always True). Quantizing
-                # wires already materialize fresh arrays in encode_host, so
-                # only aliasing encodes (f32 pairs view) pay the copy.
-                frame = frame.copy()
-            self._stage(frame, self.frame_size, tags)
+            frame, handle = self._stage_copy(frame)
+            self._stage(frame, self.frame_size, tags, handle)
             self.input.consume(self.frame_size)
             inp = self.input.slice()
 
@@ -756,13 +1423,21 @@ class TpuKernel(Kernel):
         if eos and len(inp) > 0 and len(inp) < self.frame_size and \
                 len(self._staged) + len(self._inflight) < budget:
             # final partial frame: zero-pad, emit only the valid prefix
-            frame = np.zeros(self.frame_size, dtype=self.pipeline.in_dtype)
+            if self._arena is not None:
+                frame, handle = self._arena.take_array(
+                    (self.frame_size,), self.pipeline.in_dtype)
+                frame.fill(0)
+            else:
+                frame = np.zeros(self.frame_size,
+                                 dtype=self.pipeline.in_dtype)
+                handle = None
             frame[:len(inp)] = inp
             n = len(inp)
             tags = self.input.tags(n)
             # items beyond the last frame_multiple boundary cannot produce integral
             # output and are dropped at EOS (streaming frame contract)
-            self._stage(frame, n - (n % self.pipeline.frame_multiple), tags)
+            self._stage(frame, n - (n % self.pipeline.frame_multiple), tags,
+                        handle)
             self.input.consume(n)
             inp = self.input.slice()
         if eos and self._accum:
@@ -787,10 +1462,11 @@ class TpuKernel(Kernel):
         self._launch_staged()
 
         # 4. retrieve: when the pipe is full, when the input is starved (no full frame
-        #    waiting — flush for latency; when saturated the depth gate keeps overlap),
+        #    waiting — flush for latency; when saturated the credit gate keeps overlap),
         #    or on EOS drain
         should_drain = bool(self._inflight) and (
-            len(self._inflight) >= self.depth or len(inp) < self.frame_size or eos)
+            len(self._inflight) >= self._credits.credits
+            or len(inp) < self.frame_size or eos)
         if should_drain:
             drained = self._drain_one()
             if drained is not None:      # None = replayed already-emitted group
@@ -805,8 +1481,9 @@ class TpuKernel(Kernel):
                 self._pending_out is None and len(inp) == 0:
             io.finished = True
             # stream cleanly finished: a later re-run of this kernel must
-            # start from a fresh carry, never replay this stream's tail
-            self._recovery_reset()
+            # start from a fresh carry, never replay this stream's tail —
+            # and the persisted snapshot (if any) is complete state, purged
+            self._recovery_reset(purge_disk=True)
         elif eos and (self._inflight or self._staged or self._accum
                       or self._replay_queue):
             io.call_again = True
@@ -863,13 +1540,14 @@ class TpuFanoutKernel(TpuKernel):
                            for j in range(fanout.n_branches)]
         self.out_frame = sum(self.out_frames)      # linear-surface compat
         self.depth = frames_in_flight or self.inst.frames_in_flight
+        self._depth_explicit = frames_in_flight is not None
         self.k_batch = max(1, int(frames_per_dispatch
                                   or config().tpu_frames_per_dispatch))
         self._k_explicit = frames_per_dispatch is not None
-        self.stage_ahead = 1 if self.depth > 1 else 0
         from ..ops.wire import resolve_wire
         self.wire = resolve_wire(wire, self.inst.platform)
         self._needs_staging = xfer.h2d_needs_staging(self.inst.platform)
+        self._init_hostpath()
         self._compiled = None
         self._carry = None
         self._accum = []
@@ -959,17 +1637,13 @@ class TpuFanoutKernel(TpuKernel):
             out_metas.append((tuple(per_branch), t_in))
         return (finish, tuple(out_metas))
 
-    def _drain_one(self) -> Optional[List[Tuple[np.ndarray, list]]]:
-        """Land the oldest dispatch group; returns one ``(result, tags)`` per
-        BRANCH (megabatch groups concatenate their frames per branch, tag
-        indices rebased by the branch's running offset), or None for a
-        replayed group every branch already emitted."""
+    def _decode_group(self, raw, out_metas):
+        """Per-branch host decode of one landed group (the fan-out form of
+        the base hook — runs on the drain thread, or on a codec worker under
+        the pool). Returns ``(results, t_ins)`` with one ``(result, tags)``
+        per branch (megabatch groups concatenate their frames per branch,
+        tag indices rebased by the branch's running offset)."""
         fo = self.pipeline
-        finish, out_metas, seq, drop = self._inflight.popleft()
-        raw = finish()                       # flat: branch parts in order
-        if drop:
-            self._note_drained(seq)
-            return None
         t0 = _trace.now() if _trace.enabled else 0
         nb = fo.n_branches
         results: List[Tuple[np.ndarray, list]] = []
@@ -1010,15 +1684,26 @@ class TpuFanoutKernel(TpuKernel):
                  all_tags[j])
                 for j, c in enumerate(chunks)]
             t_ins = tuple(tin for _, tin in out_metas)
+        if t0:
+            _trace.complete("tpu", "decode", t0,
+                            args={"wire": self.wire.name,
+                                  "items": sum(len(r) for r, _ in results),
+                                  "branches": nb})
+        return results, t_ins
+
+    def _drain_one(self) -> Optional[List[Tuple[np.ndarray, list]]]:
+        """Land the oldest dispatch group; returns one ``(result, tags)`` per
+        BRANCH, or None for a replayed group every branch already emitted."""
+        land, _out_metas, seq, _drop = self._inflight.popleft()
+        payload = land()                     # joins the pool-mode landing
+        if payload is None:
+            self._note_drained(seq)
+            return None
+        results, t_ins = payload
         end = time.perf_counter_ns()
         if self._e2e_hist is not None:
             for tin in t_ins:                # one observation per input frame
                 self._e2e_hist.observe((end - tin) * 1e-9)
-        if t0:
-            _trace.complete("tpu", "decode", t0, end_ns=end,
-                            args={"wire": self.wire.name,
-                                  "items": sum(len(r) for r, _ in results),
-                                  "branches": nb})
         # drained only after every branch decoded (the base-class contract)
         self._note_drained(seq)
         return results
@@ -1050,8 +1735,8 @@ class TpuFanoutKernel(TpuKernel):
 
         # 4. per-branch retrieve/emit
         should_drain = bool(self._inflight) and (
-            len(self._inflight) >= self.depth or len(inp) < self.frame_size
-            or eos)
+            len(self._inflight) >= self._credits.credits
+            or len(inp) < self.frame_size or eos)
         if should_drain:
             drained = self._drain_one()
             for j, (result, tags) in enumerate(drained or ()):
@@ -1067,7 +1752,7 @@ class TpuFanoutKernel(TpuKernel):
                 and all(p is None for p in self._pendings) \
                 and len(inp) == 0:
             io.finished = True
-            self._recovery_reset()           # same clean-EOS contract as base
+            self._recovery_reset(purge_disk=True)  # clean-EOS contract (base)
         elif eos and (self._inflight or self._staged or self._accum
                       or self._replay_queue):
             io.call_again = True

@@ -77,6 +77,7 @@ class PpKernel(Kernel):
         self.n_micro = int(n_micro)
         self.frame_size = self.n_micro * int(np.prod(self.micro_shape))
         platform = next(iter(np.asarray(mesh.devices).flat)).platform
+        self._platform = platform
         self.wire = resolve_wire(wire, platform)
         self._in_dt = np.dtype(in_dtype)
         self._out_dt = np.dtype(out_dtype)
@@ -100,7 +101,11 @@ class PpKernel(Kernel):
         # under the current frame's compute at steady state
         self.stage_ahead = 1 if self.depth > 1 else 0
         self._needs_staging = xfer.h2d_needs_staging(platform)
-        self._staged: Deque = deque()                   # (h2d_finish, valid)
+        # ring-exit staging copies ride the arena (ops/arena.py): a frame's
+        # buffer is released after its pipeline dispatch consumed the parts
+        from ..ops import arena as _arena_mod
+        self._arena = _arena_mod.arena()
+        self._staged: Deque = deque()           # (h2d_finish, valid, handle)
         self._inflight: Deque = deque()                 # (d2h_finish, valid)
         self._pending: Optional[np.ndarray] = None
         self.input = self.add_stream_input("in", in_dtype,
@@ -135,7 +140,8 @@ class PpKernel(Kernel):
         self.wire.decode_host(tuple(np.asarray(p) for p in y_parts),
                               self._out_dt)
 
-    def _stage(self, frame: np.ndarray, valid: Optional[int] = None) -> None:
+    def _stage(self, frame: np.ndarray, valid: Optional[int] = None,
+               handle=None) -> None:
         # wire-encoded parts are plain reals/ints — the complex-pair shim's
         # broken-tunnel rule (ops/xfer.py) is satisfied by construction; the
         # complex frame is formed in-trace by the wired prolog
@@ -145,19 +151,30 @@ class PpKernel(Kernel):
             _trace.complete("tpu", "encode", t0,
                             args={"wire": self.wire.name, "items": len(frame)})
         h2d = xfer.start_device_transfer_parts(parts, self._x_shard)
-        self._staged.append((h2d, self.frame_size if valid is None else valid))
+        self._staged.append((h2d, self.frame_size if valid is None else valid,
+                             handle))
 
     def _launch_staged(self) -> None:
         """Dispatch the pipeline on staged frames (oldest first) and start
         each result's D2H — H2D(t+1) ∥ pipeline(t) ∥ D2H(t−1), like TpuKernel."""
         while self._staged and len(self._inflight) < self.depth:
-            h2d, valid = self._staged.popleft()
+            h2d, valid, handle = self._staged.popleft()
             x_parts = h2d()
             t0 = _trace.now() if _trace.enabled else 0
             y_parts = self._fn(self._W, *x_parts)
             if t0:
                 _trace.complete("tpu", "compute", t0,
                                 args={"frame": self.frame_size})
+            if handle is not None:
+                # the staging copy is dead once nothing device-side still
+                # reads it: accelerators — wait for the async PUT itself to
+                # materialize (x_parts; the pipeline dispatch stays async);
+                # CPU client — the borrow means the consuming computation
+                # must materialize first (free: CPU jit is synchronous)
+                import jax
+                jax.block_until_ready(
+                    y_parts if self._platform == "cpu" else x_parts)
+                handle.release()
             self._inflight.append((xfer.start_host_transfer_parts(y_parts),
                                    valid))
 
@@ -176,10 +193,15 @@ class PpKernel(Kernel):
         while len(self._staged) + len(self._inflight) < budget and \
                 len(inp) >= self.frame_size:
             frame = np.asarray(inp[:self.frame_size])
+            handle = None
             if self._needs_staging and self.wire.encode_may_alias(frame.dtype):
-                frame = frame.copy()   # async H2D must leave the ring first
-                # (quantizing wires materialize fresh arrays in encode_host)
-            self._stage(frame)
+                # async H2D must leave the ring first (quantizing wires
+                # materialize fresh arrays in encode_host)
+                if self._arena is not None:
+                    frame, handle = self._arena.copy_in(frame)
+                else:
+                    frame = frame.copy()
+            self._stage(frame, handle=handle)
             self.input.consume(self.frame_size)
             inp = self.input.slice()
         eos = self.input.finished()

@@ -74,7 +74,7 @@ def _assert_no_leaked_threads(before, label):
         gc.collect()
         leaked = [t for t in threading.enumerate()
                   if t not in before and t.is_alive() and not t.daemon
-                  and not t.name.startswith("fsdr-d2h")]
+                  and not t.name.startswith(("fsdr-d2h", "fsdr-codec"))]
         if not leaked:
             return
         if time.monotonic() > deadline:
@@ -348,6 +348,76 @@ def scenario_stateful_restart_replay():
     np.testing.assert_array_equal(faulted["got"], clean["got"])
 
 
+def scenario_arena_recycle_replay():
+    """Acceptance (host staging arena × device-plane recovery): with the
+    arena recycling under MEMORY PRESSURE (a tiny pool cap forces every
+    released buffer back into circulation immediately) and the codec worker
+    pool armed, seeded mid-stream faults at the dispatch AND h2d sites
+    recover BIT-IDENTICAL to the fault-free run — recycling must never alias
+    a staging buffer the replay log still pins (the retry-safe pinning
+    contract of ops/arena.py)."""
+    from futuresdr_tpu import BlockPolicy, Flowgraph
+    from futuresdr_tpu.blocks import VectorSink, VectorSource
+    from futuresdr_tpu.config import config
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import arena as arena_mod
+    from futuresdr_tpu.ops import codec_pool as codec_mod
+    from futuresdr_tpu.ops import fir_stage, rotator_stage
+    from futuresdr_tpu.runtime import faults
+    from futuresdr_tpu.tpu import TpuKernel
+    frame = 1 << 11
+    n = frame * 23 + 311                 # partial tail frame too
+    rng = np.random.default_rng(11)
+    data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)) \
+        .astype(np.complex64)
+    taps = firdes.lowpass(0.2, 31).astype(np.float32)
+    c = config()
+    saved = (c.host_arena, c.host_arena_mb, c.host_codec_workers)
+    c.host_arena, c.host_arena_mb, c.host_codec_workers = True, 1, 2
+    arena_mod.reset_arena()
+    codec_mod.reset_pool()
+
+    def one_run(fault):
+        out = {}
+
+        def build():
+            fg = Flowgraph()
+            tk = TpuKernel([fir_stage(taps, fft_len=256),
+                            rotator_stage(0.05)], np.complex64,
+                           frame_size=frame, frames_in_flight=2)
+            tk.policy = BlockPolicy(on_error="restart", max_restarts=4,
+                                    backoff=0.002)
+            snk = VectorSink(np.complex64)
+            fg.connect(VectorSource(data), tk, snk)
+            plan = faults.reset()
+            if fault:
+                site, rate, seed = fault
+                plan.arm(site, rate=rate, max_faults=2, seed=seed,
+                         transient=False)
+
+            def check(error):
+                assert error is None, repr(error)
+                out["got"] = np.asarray(snk.items())
+            return fg, check
+
+        try:
+            _run_trial(build, f"arena_recycle_replay(fault={fault})",
+                       expect="ok")
+        finally:
+            faults.reset()
+        return out["got"]
+
+    try:
+        clean = one_run(None)
+        for fault in (("dispatch", 0.10, 9), ("h2d", 0.06, 4)):
+            got = one_run(fault)
+            np.testing.assert_array_equal(got, clean)
+    finally:
+        (c.host_arena, c.host_arena_mb, c.host_codec_workers) = saved
+        arena_mod.reset_arena()
+        codec_mod.reset_pool()
+
+
 def scenario_isolate_group():
     """Acceptance (isolate groups): one member of a named 3-block subgraph
     dies → the WHOLE group retires (topo-order port EOS, clean drain), the
@@ -548,6 +618,7 @@ SCENARIOS = (
     ("isolate_branches", scenario_isolate_branches),
     ("transfer_retry_deterministic", scenario_transfer_retry_deterministic),
     ("stateful-restart-replay", scenario_stateful_restart_replay),
+    ("arena-recycle-replay", scenario_arena_recycle_replay),
     ("isolate-group", scenario_isolate_group),
     ("deadline_bounds_wedge", scenario_deadline_bounds_wedge),
 )

@@ -48,6 +48,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIELDS_ANY_BACKEND = ("cpu_baseline_msps",)
 FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
                        "streamed_fanout_msps", "streamed_dag_msps",
+                       "streamed_link_utilization", "host_codec_overlap_frac",
                        "fm_msps", "wlan_msps", "lora_msps")
 # lower-is-better fields (fractions, not rates): regression = the value ROSE
 # past the reference by more than the absolute slack below — e.g. the

@@ -60,8 +60,9 @@ _THREAD_CHECKED_MODULES = {
     "test_faults", "test_policies",
 }
 #: process-global by design, exempt from the leak gate: the D2H fetch pool
-#: (ops/xfer.py) lives for the process lifetime
-_THREAD_ALLOW_PREFIXES = ("fsdr-d2h",)
+#: (ops/xfer.py) and the codec worker pool (ops/codec_pool.py) live for the
+#: process lifetime
+_THREAD_ALLOW_PREFIXES = ("fsdr-d2h", "fsdr-codec")
 
 
 @pytest.fixture(autouse=True)
