@@ -991,6 +991,28 @@ def main():
         print(f"# streamed DAG A/B unavailable: {e!r}", file=sys.stderr)
         dag_extra["streamed_dag_error"] = repr(e)
 
+    # multi-tenant serving (futuresdr_tpu/serve, round 15): N sessions of
+    # one receiver chain batched into a single vmapped dispatch per frame
+    # vs N independent dispatch loops — stamps sessions/chip at matched
+    # per-session throughput and the per-tenant p99 under churn, both
+    # graded by perf/regress.py. Skipped with --skip-extra-chains (the
+    # quick regress gate) like the other extra chains.
+    serve_extra = {}
+    if not args.skip_extra_chains:
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "perf"))
+            from serve_ab import measure as _serve_measure
+            serve_extra = _serve_measure(n_sessions=32, steps=40)
+            print(f"# serving A/B: {serve_extra['serve_sessions_per_chip']} "
+                  f"sessions/chip ({serve_extra['serve_speedup']}x vs "
+                  f"independent at N={serve_extra['serve_sessions']}), "
+                  f"churn p99 {serve_extra['serve_p99_under_churn_ms']} ms",
+                  file=sys.stderr)
+        except Exception as e:                          # noqa: BLE001
+            print(f"# serving A/B unavailable: {e!r}", file=sys.stderr)
+            serve_extra["serve_error"] = repr(e)
+
     result = {
         "metric": f"fir64+fft{FFT_SIZE}+mag2 fused chain, device-resident ({inst_.platform})",
         "value": round(dev_rate, 1),
@@ -1017,6 +1039,7 @@ def main():
         **wire_extra,
         **fanout_extra,
         **dag_extra,
+        **serve_extra,
         **roof,
         **doctor_extra,
         **extras,

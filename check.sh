@@ -35,6 +35,14 @@ echo "== host data path gate (docs/tpu_notes.md 'The host data path') =="
 # no worse than the pre-arena baseline
 JAX_PLATFORMS=cpu python perf/hostpath_ab.py --smoke
 
+echo "== multi-tenant serving gate (docs/serving.md) =="
+# N sessions of one receiver chain through a single vmapped dispatch per
+# frame: dispatches/frame == 1 regardless of the active session count,
+# session join/leave under load causes ZERO recompiles of resident slot
+# buckets, and the sessions/chip ratio vs independent per-session dispatch
+# loops clears the smoke floor
+JAX_PLATFORMS=cpu python perf/serve_ab.py --smoke
+
 echo "== chaos smoke (docs/robustness.md invariants) =="
 # seeded fault injection at every site × every failure policy on the CPU
 # backend: restart recovers bit-correct, isolate finishes independent

@@ -23,11 +23,11 @@ __all__ = [
     "message_handler", "AsyncScheduler", "ThreadedScheduler", "TpbScheduler", "FlowgraphError",
     "FlowgraphCancelled", "BlockPolicy", "ConnectError",
     "blocks", "dsp", "ops", "tpu", "parallel", "models", "utils", "hw", "ctrl", "apps",
-    "telemetry",
+    "telemetry", "serve",
 ]
 
 _LAZY_SUBMODULES = {"blocks", "dsp", "ops", "tpu", "parallel", "models", "utils",
-                    "hw", "ctrl", "apps", "telemetry"}
+                    "hw", "ctrl", "apps", "telemetry", "serve"}
 
 
 def __getattr__(name):

@@ -112,6 +112,18 @@ class Config:
     #   that autotune_streamed already tuned in this process, which launches
     #   with its measured K (runtime/devchain.py). An explicit 1 pins
     #   dispatch-per-frame everywhere (latency-critical deployments).
+    # Multi-tenant serving (futuresdr_tpu/serve, docs/serving.md): slot
+    # buckets and per-tenant admission budget of the vmapped serving engine.
+    serve_buckets: str = ""                # slot-bucket ladder, e.g. "1,4,16,64";
+    #   "" = auto (the cached autotune_serve pick for the pipeline, else the
+    #   default power-of-two ladder to 64)
+    serve_queue_frames: int = 2            # shared admission budget = this many
+    #   queued-but-undispatched frames per slot, divided fairly between
+    #   tenants (serve/credits.py TenantCreditController)
+    serve_retired_keep: int = 64           # retired-session views kept for the
+    #   REST plane (a faulted client rarely comes back to DELETE); the oldest
+    #   beyond this are forgotten so fault churn cannot grow the registry
+    #   without bound
     tpu_checkpoint_every: int = 1          # carry-checkpoint cadence of the
     #   device-plane recovery contract (docs/robustness.md "Device-plane
     #   recovery"): snapshot the kernel carry every Nth dispatch group (host

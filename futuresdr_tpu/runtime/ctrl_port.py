@@ -19,6 +19,12 @@ plus the telemetry plane (docs/observability.md):
                                  (telemetry/doctor.py; ``?md=1`` renders
                                  markdown instead of JSON)
 
+plus the multi-tenant serving session plane (docs/serving.md, merged from
+``futuresdr_tpu/serve/api.py``):
+
+  GET/POST/DELETE /api/serve/...  → serving apps, session admit/evict/
+                                    readmit/leave, per-session metrics views
+
 Pmt values are serialized with the same externally-tagged JSON as the reference's serde.
 CORS is permissive (including on error responses raised as ``web.HTTPException``);
 graceful shutdown on ``stop()``.
@@ -114,6 +120,15 @@ class ControlPort:
         app.router.add_get("/api/fg/{fg}/block/{blk}/", self._describe_block)
         app.router.add_get("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
         app.router.add_post("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
+        # multi-tenant serving session plane (futuresdr_tpu/serve/api.py,
+        # docs/serving.md): the registry is process-global like /metrics and
+        # the doctor, so every control port serves it
+        try:
+            from ..serve import api as serve_api
+            for method, path, handler in serve_api.routes():
+                app.router.add_route(method, path, handler)
+        except Exception as e:             # noqa: BLE001 — optional plane
+            log.warning("serve session plane unavailable: %r", e)
         for method, path, handler in self.extra_routes:
             app.router.add_route(method, path, handler)
         import os

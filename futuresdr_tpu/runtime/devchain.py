@@ -1035,9 +1035,11 @@ def _port_name(kernel, port):
 
 def _apply_stage_update(fused, idx: int, stage, params: dict) -> None:
     """Translate a MEMBER-local stage address (name or index) into the fused
-    pipeline's composed index and apply the carry surgery. Raises on a bad
-    address — callers answer ``Pmt.invalid_value()`` exactly like the member's
-    own handler would."""
+    pipeline's composed index and apply the carry surgery through the
+    kernel's replay-exact retune path (``TpuKernel.apply_retune`` — logged
+    for checkpoint-replay re-application, deferred past an active replay
+    window). Raises on a bad address — callers answer
+    ``Pmt.invalid_value()`` exactly like the member's own handler would."""
     start, stop = fused._dc_slices[idx]
     if isinstance(stage, str):
         hits = [j for j in range(start, stop)
@@ -1051,7 +1053,7 @@ def _apply_stage_update(fused, idx: int, stage, params: dict) -> None:
         j = start + int(stage)
         if not start <= j < stop:
             raise KeyError(f"stage index {stage} out of member range")
-    fused._carry = fused.pipeline.update_stage(fused._carry, j, **params)
+    fused.apply_retune(j, params)
 
 
 def _apply_ctrl(fused, member_kernels, idx: int, port, p):
@@ -1067,13 +1069,12 @@ def _apply_ctrl(fused, member_kernels, idx: int, port, p):
         return Pmt.invalid_value()
     try:
         stage, params = parse_ctrl(p)
+        # apply_retune handles retune-in-replay itself (docs/robustness.md
+        # replay-aware retunes): surgery landing inside an active replay
+        # window is deferred to the post-window boundary with a structured
+        # warning, and every applied retune is logged so a later checkpoint
+        # replay re-applies it at exactly its original frame
         _apply_stage_update(fused, idx, stage, params)
-        # retune-in-replay observability (docs/robustness.md): a retune
-        # landing while the fused kernel is replaying checkpointed groups
-        # logs a structured warning naming the chain and the replayed-frame
-        # count (the recovered stream re-dispatches those frames with the
-        # NEW parameters)
-        fused.warn_retune_in_replay()
     except Exception as e:                             # noqa: BLE001
         log.warning("devchain ctrl rejected: %r", e)
         return Pmt.invalid_value()

@@ -1,0 +1,30 @@
+"""futuresdr_tpu.serve — multi-tenant flowgraph serving (docs/serving.md).
+
+Batch thousands of concurrent sessions of the SAME fused receiver program
+into one dispatch per frame: a slot-table session manager with ragged
+admission (:mod:`.slots`), the vmapped serving engine (:mod:`.engine`),
+per-tenant fair credits (:mod:`.credits`) and the REST session plane
+(:mod:`.api` — merged into every control port).
+"""
+
+from .credits import TenantCreditController
+from .slots import ServeFull, Session, SlotTable
+from .api import apps, get_app, register_app, routes, unregister_app
+
+__all__ = ["ServeEngine", "ServeFull", "Session", "SlotTable",
+           "TenantCreditController", "build_slot_program", "default_buckets",
+           "register_app", "unregister_app", "get_app", "apps", "routes"]
+
+#: engine symbols resolve lazily: the control port merges the REST session
+#: plane into every server, and the HOST-only runtime must not pay the jax
+#: import the engine's compute plane needs just for that
+_LAZY_ENGINE = {"ServeEngine", "build_slot_program", "default_buckets"}
+
+
+def __getattr__(name):
+    if name in _LAZY_ENGINE:
+        from . import engine
+        val = getattr(engine, name)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

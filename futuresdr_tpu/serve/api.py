@@ -1,0 +1,179 @@
+"""REST session plane for the serving front-end.
+
+Extends the control port (``runtime/ctrl_port.py``) with the multi-tenant
+session API of docs/serving.md — the routes are merged into every control
+port automatically (plus available as ``routes()`` for a bespoke server):
+
+  GET    /api/serve/                         → registered serving apps
+  GET    /api/serve/{app}/                   → engine view (slots, buckets,
+                                               per-tenant credit/latency)
+  POST   /api/serve/{app}/session/           → admit  {"tenant": "...",
+                                               "sid": optional}
+  GET    /api/serve/{app}/session/{sid}/     → per-session metrics/doctor view
+  POST   /api/serve/{app}/session/{sid}/evict/   → evict carry to host
+  POST   /api/serve/{app}/session/{sid}/readmit/ → restore it bit-identically
+  DELETE /api/serve/{app}/session/{sid}/     → leave
+
+Engines register under an app name via :func:`register_app` (usually at
+construction by the app's serving loop); the registry is process-global,
+matching the control port's own process-global planes (/metrics, doctor).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..log import logger
+from .slots import ServeFull
+
+__all__ = ["register_app", "unregister_app", "get_app", "apps", "routes"]
+
+log = logger("serve.api")
+
+# app name -> ServeEngine; the module deliberately depends only on the
+# jax-free bookkeeping side (slots) so a host-only control port can merge
+# these routes without importing the compute plane
+_apps: Dict[str, "object"] = {}
+_lock = threading.Lock()
+
+
+def register_app(engine, name: Optional[str] = None) -> str:
+    """Register a :class:`~futuresdr_tpu.serve.engine.ServeEngine` under an
+    app name (default: its own ``app``)."""
+    name = str(name or engine.app)
+    with _lock:
+        _apps[name] = engine
+    return name
+
+
+def unregister_app(name: str) -> None:
+    with _lock:
+        _apps.pop(str(name), None)
+
+
+def get_app(name: str):
+    with _lock:
+        return _apps.get(str(name))
+
+
+def apps() -> Dict[str, "object"]:
+    with _lock:
+        return dict(_apps)
+
+
+# -- aiohttp handlers ---------------------------------------------------------
+
+async def _call(fn, *args, **kw):
+    """Run a blocking engine call off the event loop: engine methods contend
+    on the engine lock, which ``step()`` holds across an entire dispatch —
+    including a newly-resident bucket's jit compile (seconds on a real
+    backend). Calling them inline would freeze every other control-port
+    route (/metrics scrapes, doctor, flowgraph APIs) for that long."""
+    import asyncio
+    import functools
+    return await asyncio.get_running_loop().run_in_executor(
+        None, functools.partial(fn, *args, **kw))
+
+
+def _engine_or_404(request):
+    from aiohttp import web
+    eng = get_app(request.match_info["app"])
+    if eng is None:
+        raise web.HTTPNotFound(
+            text='{"error": "serving app not found"}',
+            content_type="application/json")
+    return eng
+
+
+async def _list_apps(request):
+    from aiohttp import web
+    return web.json_response(
+        {name: {"sessions": len(eng.table.sessions),
+                "active": eng.table.active,
+                "capacity": eng.capacity}
+         for name, eng in sorted(apps().items())})
+
+
+async def _describe_app(request):
+    from aiohttp import web
+    return web.json_response(await _call(_engine_or_404(request).describe))
+
+
+async def _create_session(request):
+    from aiohttp import web
+    eng = _engine_or_404(request)
+    body = {}
+    if request.can_read_body:
+        try:
+            body = await request.json()
+        except Exception:                  # noqa: BLE001 — bad JSON → 400
+            return web.json_response({"error": "bad json body"}, status=400)
+    tenant = str(body.get("tenant", "default"))
+    try:
+        s = await _call(eng.admit, tenant=tenant, sid=body.get("sid"))
+    except ServeFull as e:
+        return web.json_response({"error": str(e)}, status=503)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=409)
+    return web.json_response(s.view(), status=201)
+
+
+async def _session_view(request):
+    from aiohttp import web
+    eng = _engine_or_404(request)
+    try:
+        return web.json_response(
+            await _call(eng.session_view, request.match_info["sid"]))
+    except KeyError:
+        return web.json_response({"error": "session not found"}, status=404)
+
+
+async def _session_evict(request):
+    from aiohttp import web
+    eng = _engine_or_404(request)
+    try:
+        s = await _call(eng.evict, request.match_info["sid"])
+    except KeyError:
+        return web.json_response({"error": "session not found"}, status=404)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=409)
+    return web.json_response(s.view())
+
+
+async def _session_readmit(request):
+    from aiohttp import web
+    eng = _engine_or_404(request)
+    try:
+        s = await _call(eng.readmit, request.match_info["sid"])
+    except KeyError:
+        return web.json_response({"error": "session not found"}, status=404)
+    except ServeFull as e:
+        return web.json_response({"error": str(e)}, status=503)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=409)
+    return web.json_response(s.view())
+
+
+async def _session_delete(request):
+    from aiohttp import web
+    eng = _engine_or_404(request)
+    try:
+        await _call(eng.close, request.match_info["sid"])
+    except KeyError:
+        return web.json_response({"error": "session not found"}, status=404)
+    return web.json_response({"ok": True})
+
+
+def routes() -> List[Tuple[str, str, object]]:
+    """The session-plane route table, in control-port ``extra_routes``
+    form (method, path, handler)."""
+    return [
+        ("GET", "/api/serve/", _list_apps),
+        ("GET", "/api/serve/{app}/", _describe_app),
+        ("POST", "/api/serve/{app}/session/", _create_session),
+        ("GET", "/api/serve/{app}/session/{sid}/", _session_view),
+        ("POST", "/api/serve/{app}/session/{sid}/evict/", _session_evict),
+        ("POST", "/api/serve/{app}/session/{sid}/readmit/", _session_readmit),
+        ("DELETE", "/api/serve/{app}/session/{sid}/", _session_delete),
+    ]

@@ -1,0 +1,591 @@
+"""ServeEngine: batch N concurrent sessions of ONE receiver DAG into one
+dispatch per frame.
+
+The production serving plane of docs/serving.md. Every fused
+``Pipeline``/``FanoutPipeline``/``DagPipeline`` program computes exactly one
+session per dispatch on the actor path — at SDR frame rates that leaves the
+chip almost entirely idle (MFU 5.6% on the resident chain, ROADMAP). This
+engine multiplexes N concurrent sessions running the SAME program through a
+single per-frame dispatch by compiling the pipeline ONCE per slot bucket
+with a leading session axis:
+
+* ``jax.vmap`` over the inputs AND the flat composed carry — the carry
+  layout per lane stays exactly the linear contract, so ``update_stage``
+  addressing and the checkpoint ``snapshot_carry``/``restore_carry``
+  surface keep working per slot;
+* RAGGED admission in the style of Ragged Paged Attention
+  (arXiv:2604.15464): a fixed-capacity slot axis with padded inactive
+  lanes masked by an ``active`` lanes vector threaded as a program input —
+  sessions join, leave and stall mid-flight by flipping mask lanes and
+  functionally updating carry slices, with ZERO recompiles of resident
+  buckets (``self.compiles`` is the pin);
+* autotuned bucket sizes (``tpu/autotune.autotune_serve``): occupancy
+  crossing the current bucket restacks the carries into the next bucket's
+  capacity and compiles THAT bucket once;
+* per-session carry slots riding the checkpoint machinery: ``evict`` lands
+  a session's carry lane on the host via ``snapshot_carry``'s leaf
+  contract, ``readmit`` restores it bit-identically (validated by
+  ``carry_matches`` against the fresh-carry template, exactly like the
+  kernel recovery path);
+* per-tenant fairness over the shared admission budget
+  (:class:`~futuresdr_tpu.serve.credits.TenantCreditController` — the
+  multi-tenant generalization of the streamed path's CreditController);
+* per-session fault isolation (the ``isolate_group``-per-session
+  semantics): a work/dispatch fault addressed at one session retires ONLY
+  that slot — siblings keep their lanes and their bit-exact outputs.
+
+Masking semantics: inactive lanes still ride through the vmapped program
+(their input rows are zeros), but their computed carries are DISCARDED by a
+``where(active, new, old)`` merge inside the jitted program — a stalled
+lane's filter history and oscillator phase are bit-frozen until its next
+real frame, and an active lane's carry is exactly what the standalone
+program would have produced (the N=1 ≡ bare-pipeline bit-equality
+contract, test-pinned).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..log import logger
+from ..ops import xfer
+from ..runtime import faults as _faults
+from ..telemetry import prom as _prom
+from .credits import TenantCreditController
+from .slots import ServeFull, Session, SlotTable
+
+__all__ = ["ServeEngine", "ServeFull", "default_buckets"]
+
+log = logger("serve.engine")
+
+# per-tenant Prometheus families (docs/serving.md "Observability"): every
+# family carries {app, tenant} so one scrape separates tenants; label
+# ordering in the exposition is stable (telemetry/prom.py sorts samples)
+_SESSIONS = _prom.gauge(
+    "fsdr_serve_sessions", "live serving sessions per state",
+    ("app", "tenant", "state"))
+_FRAMES = _prom.counter(
+    "fsdr_serve_frames_total", "frames dispatched through the serving plane",
+    ("app", "tenant"))
+_DISPATCHES = _prom.counter(
+    "fsdr_serve_dispatches_total",
+    "batched serving dispatches (one per step with >= 1 active lane)",
+    ("app",))
+_RETIRED = _prom.counter(
+    "fsdr_serve_retired_total",
+    "sessions retired by a per-session fault (slot-isolated)",
+    ("app", "tenant"))
+_EVICTIONS = _prom.counter(
+    "fsdr_serve_evictions_total",
+    "session carries evicted to the host", ("app", "tenant"))
+_REJECTS = _prom.counter(
+    "fsdr_serve_rejects_total",
+    "frame submissions refused by the per-tenant credit guard",
+    ("app", "tenant"))
+_LATENCY = _prom.histogram(
+    "fsdr_serve_latency_seconds",
+    "submit -> decoded-result latency per frame", ("app", "tenant"))
+
+
+def default_buckets() -> tuple:
+    """The slot-bucket ladder when neither the caller nor the autotune cache
+    provides one: config ``serve_buckets`` ("1,2,4,…"), else powers of two
+    to 64."""
+    from ..config import config
+    spec = str(config().get("serve_buckets", "") or "").strip()
+    if spec:
+        try:
+            out = sorted({int(x) for x in spec.replace(";", ",").split(",")
+                          if x.strip()})
+            if out and all(b > 0 for b in out):
+                return tuple(out)
+        except ValueError:
+            log.warning("bad serve_buckets spec %r — using the default "
+                        "ladder", spec)
+    return (1, 2, 4, 8, 16, 32, 64)
+
+
+def build_slot_program(pipeline, capacity: int, k: int = 1):
+    """Compile the pipeline's slot-batched serving step for one bucket:
+
+        step(carries, x, active) -> (carries', outs)
+
+    with every carry leaf carrying a leading ``[capacity]`` axis. ``k == 1``
+    (the default): ``x`` is ``[capacity, frame]``, ``active`` a
+    ``[capacity]`` bool vector, outs ``[capacity, out]`` per sink.
+
+    ``k > 1`` is the MEGABATCH serving form: ``x`` is ``[capacity, k,
+    frame]``, ``active`` a ``[capacity, k]`` PER-FRAME mask, and a
+    ``lax.scan`` chains the k frames through every lane in one program call
+    (amortizing per-dispatch host cost exactly like ``TpuKernel``'s
+    ``frames_per_dispatch``) — the mask is RAGGED per lane, so sessions
+    with fewer than k queued frames ride the same dispatch with their tail
+    masked and their carries frozen from their last real frame on (frames
+    pack at the front of the k axis; a masked row can never corrupt a
+    later real frame's carry).
+
+    Inactive lanes keep their OLD carry (bit-frozen stall semantics);
+    output rows of inactive lane-frames are never delivered, so their
+    value is irrelevant. No donation: admission/eviction do functional
+    lane reads/updates on the live stacked carries between dispatches —
+    donation would invalidate exactly the buffers those touch. Shared
+    with ``tpu/autotune.autotune_serve`` so the measured program is
+    exactly the served one."""
+    import jax
+    import jax.numpy as jnp
+
+    inner = pipeline.fn()
+    multi = bool(getattr(pipeline, "n_branches", 0))
+
+    def masked_lane_step(carries, x, active):
+        new_c, y = jax.vmap(inner)(carries, x)
+
+        def sel(n, o):
+            m = active.reshape((active.shape[0],) + (1,) * (n.ndim - 1))
+            return jnp.where(m, n, o)
+
+        return jax.tree_util.tree_map(sel, new_c, carries), y
+
+    if int(k) <= 1:
+        def step(carries, x, active):
+            new_c, y = masked_lane_step(carries, x, active)
+            return new_c, (y if multi else (y,))
+    else:
+        def step(carries, x, active):
+            def body(c, xa):
+                xk, ak = xa
+                return masked_lane_step(c, xk, ak)
+
+            carries, ys = jax.lax.scan(
+                body, carries,
+                (jnp.moveaxis(x, 1, 0), jnp.moveaxis(active, 1, 0)))
+            # ys: [k, capacity, out] per sink -> [capacity, k, out]
+            if multi:
+                outs = tuple(jnp.moveaxis(yj, 0, 1) for yj in ys)
+            else:
+                outs = (jnp.moveaxis(ys, 0, 1),)
+            return carries, outs
+
+    return jax.jit(step, donate_argnums=())
+
+
+class ServeEngine:
+    """Multi-tenant serving front-end over one compiled receiver program.
+
+    Host-driven: a serving loop (``perf/serve_ab.py``, an app's pump thread)
+    calls :meth:`step` once per frame time; the REST session plane
+    (``serve/api.py``) and any thread may ``admit``/``submit``/``evict``/
+    ``close`` concurrently — one engine lock serializes table mutations
+    against the dispatch walk.
+    """
+
+    def __init__(self, pipeline, frame_size: Optional[int] = None,
+                 app: str = "serve", inst=None,
+                 buckets: Optional[Sequence[int]] = None,
+                 queue_frames: Optional[int] = None,
+                 frames_per_dispatch: int = 1):
+        from ..config import config
+        from ..tpu.instance import instance
+        self.pipeline = pipeline
+        self.app = str(app)
+        self.inst = inst or instance()
+        self.k_batch = max(1, int(frames_per_dispatch))
+        m = pipeline.frame_multiple
+        fs = frame_size or config().tpu_frame_size
+        self.frame_size = max(m, (fs // m) * m)
+        self.n_sinks = int(getattr(pipeline, "n_branches", 0)) or 1
+        self._multi = bool(getattr(pipeline, "n_branches", 0))
+        if buckets is None:
+            buckets = self._cached_buckets()
+        self.buckets = tuple(sorted({int(b) for b in buckets})) \
+            if buckets else default_buckets()
+        #: compiled serving programs per resident bucket capacity — the
+        #: session-churn contract is that this map only ever GAINS entries
+        #: (join/leave/stall/evict inside resident buckets never recompiles)
+        self._programs: Dict[int, object] = {}
+        self.compiles = 0                 # program builds (the recompile pin)
+        self.table = SlotTable(self.buckets[0])
+        self._fresh = None                # fresh single-lane carry template
+        self._carries = self._stacked_fresh(self.table.capacity)
+        per_slot = int(queue_frames
+                       if queue_frames is not None
+                       else config().get("serve_queue_frames", 2))
+        self._queue_frames = max(1, per_slot)
+        self.credits = TenantCreditController(
+            self._queue_frames * self.table.capacity)
+        self._lock = threading.RLock()
+        # bounded retired-session retention: a faulted client rarely comes
+        # back to DELETE its session, so retired views (and their
+        # undelivered output) would otherwise accumulate forever in a
+        # long-running process — keep the newest N, forget the oldest
+        self._retired_keep = max(0, int(config().get("serve_retired_keep",
+                                                     64)))
+        self._retired: List[str] = []
+        self.steps = 0                    # step() calls (incl. idle)
+        self.dispatches = 0               # steps that launched the program
+        self.frames = 0                   # session-frames dispatched
+        self._gauge_cache: Dict[tuple, object] = {}
+
+    # -- carry plumbing --------------------------------------------------------
+    def _fresh_carry(self):
+        if self._fresh is None:
+            self._fresh = self.pipeline.init_carry()
+        return self._fresh
+
+    def _stacked_fresh(self, capacity: int):
+        import jax
+        import jax.numpy as jnp
+        fresh = self._fresh_carry()
+        return jax.tree_util.tree_map(
+            lambda l: jnp.stack([jnp.asarray(l)] * capacity), fresh)
+
+    def _set_lane(self, slot: int, value_tree) -> None:
+        import jax
+        self._carries = jax.tree_util.tree_map(
+            lambda L, v: L.at[slot].set(v), self._carries, value_tree)
+
+    def _lane_leaves(self, slot: int) -> tuple:
+        """One lane's carry as host leaves ``(leaves, treedef)`` — the same
+        leaf contract as ``Pipeline.snapshot_carry`` materialized, so
+        ``carry_matches``/``restore_carry`` validate and rebuild it."""
+        import jax
+        leaves, _ = jax.tree_util.tree_flatten(self._carries)
+        treedef = jax.tree_util.tree_flatten(self._fresh_carry())[1]
+        return [xfer.to_host(l[slot]) for l in leaves], treedef
+
+    def _program(self, capacity: int):
+        prog = self._programs.get(capacity)
+        if prog is None:
+            prog = build_slot_program(self.pipeline, capacity, self.k_batch)
+            self._programs[capacity] = prog
+            self.compiles += 1
+            log.info("%s: compiled serving program for slot bucket %d "
+                     "(k=%d, resident buckets: %s)", self.app, capacity,
+                     self.k_batch, sorted(self._programs))
+        return prog
+
+    def _cached_buckets(self) -> Optional[tuple]:
+        try:
+            from ..tpu.autotune import cached_serve_buckets
+            got = cached_serve_buckets(self.pipeline, self.pipeline.in_dtype,
+                                       self.inst.platform)
+            return tuple(got) if got else None
+        except Exception:                  # noqa: BLE001 — ladder seed only
+            return None
+
+    # -- occupancy / bucket growth ---------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.table.capacity
+
+    def _grow_to_fit(self) -> None:
+        """Called with the lock held and no free slot: move to the next
+        bucket — restack the carries with fresh tail lanes, grow the table,
+        re-size the shared credit budget. Resident buckets keep their
+        compiled programs untouched."""
+        import jax
+        import jax.numpy as jnp
+        cur = self.table.capacity
+        bigger = [b for b in self.buckets if b > cur]
+        if not bigger:
+            raise ServeFull(
+                f"{self.app}: at the largest slot bucket ({cur}); "
+                f"admission refused")
+        cap = bigger[0]
+        fresh = self._fresh_carry()
+        extra = cap - cur
+        self._carries = jax.tree_util.tree_map(
+            lambda L, f: jnp.concatenate(
+                [L, jnp.stack([jnp.asarray(f)] * extra)]),
+            self._carries, fresh)
+        self.table.grow(cap)
+        self.credits.set_total(self._queue_frames * cap)
+        log.info("%s: slot bucket grew %d -> %d (active %d)", self.app, cur,
+                 cap, self.table.active)
+
+    # -- session lifecycle -----------------------------------------------------
+    def admit(self, tenant: str = "default",
+              sid: Optional[str] = None) -> Session:
+        """Join: claim a lane (growing to the next bucket when full), with a
+        FRESH per-session carry. Raises :class:`ServeFull` past the largest
+        bucket."""
+        with self._lock:
+            if self.table.get(sid) is not None:
+                raise ValueError(f"session id {sid!r} already exists")
+            s = Session(tenant, sid)
+            if not self.table.free_slots():
+                self._grow_to_fit()
+            slot = self.table.admit(s)
+            self._set_lane(slot, self._fresh_carry())
+            self.credits.register(s.tenant)
+            self._refresh_gauges()
+            return s
+
+    def readmit(self, sid: str) -> Session:
+        """Re-admit an evicted session: restore its host carry snapshot into
+        a lane BIT-IDENTICALLY (validated against the fresh-carry template —
+        a snapshot that no longer matches the pipeline contract is
+        refused)."""
+        with self._lock:
+            s = self._session(sid)
+            if s.state != "evicted" or s.carry_leaves is None:
+                raise ValueError(f"session {sid!r} is not evicted "
+                                 f"(state={s.state})")
+            if not self.pipeline.carry_matches(
+                    s.carry_leaves, s.carry_treedef, self._fresh_carry()):
+                raise ValueError(f"session {sid!r}: evicted carry fails the "
+                                 f"pipeline contract check")
+            if not self.table.free_slots():
+                self._grow_to_fit()
+            slot = self.table.admit(s)
+            self._set_lane(slot, self.pipeline.restore_carry(
+                s.carry_leaves, s.carry_treedef, self.inst.device))
+            s.carry_leaves = None
+            s.carry_treedef = None
+            s.stall_steps = 0
+            self._refresh_gauges()
+            return s
+
+    def evict(self, sid: str) -> Session:
+        """Stall handling: snapshot the session's carry lane to the host and
+        free the lane for a busier session; queued input stays queued. The
+        snapshot rides the same leaf contract as the kernel checkpoint
+        machinery, so :meth:`readmit` restores it bit-identically."""
+        with self._lock:
+            s = self._session(sid)
+            if s.state != "active":
+                raise ValueError(f"session {sid!r} not active "
+                                 f"(state={s.state})")
+            leaves, treedef = self._lane_leaves(s.slot)
+            s.carry_leaves = leaves
+            s.carry_treedef = treedef
+            self.table.release_slot(s)
+            s.state = "evicted"
+            _EVICTIONS.inc(app=self.app, tenant=s.tenant)
+            self._refresh_gauges()
+            return s
+
+    def close(self, sid: str) -> None:
+        """Leave: release the lane and forget the session. The lane's stale
+        carry is inert (masked) until the next admit overwrites it."""
+        with self._lock:
+            s = self._session(sid)
+            self.credits.release(s.tenant, len(s.pending))
+            s.pending.clear()
+            self.table.forget(s)
+            s.state = "closed"
+            if not self._tenant_live(s.tenant):
+                self.credits.unregister(s.tenant)
+            self._refresh_gauges()
+
+    def _tenant_live(self, tenant: str) -> bool:
+        """Does the tenant still have a session that can submit (active or
+        re-admissible)? Retired/closed sessions stay in the registry for
+        their views, but they must not keep the tenant's fair share
+        reserved in the credit controller."""
+        return any(o.tenant == tenant and o.state in ("active", "evicted")
+                   for o in self.table.sessions.values())
+
+    def _retire(self, s: Session, err: BaseException) -> None:
+        """Per-session fault isolation (the isolate_group-of-one semantics):
+        the faulted session's slot is masked off and released — the batch,
+        and every sibling's carry and output, is untouched."""
+        self.credits.release(s.tenant, len(s.pending))
+        s.pending.clear()
+        self.table.release_slot(s)
+        s.state = "retired"
+        s.error = repr(err)
+        if not self._tenant_live(s.tenant):
+            self.credits.unregister(s.tenant)
+        self._retired.append(s.sid)
+        while len(self._retired) > self._retired_keep:
+            old = self.table.get(self._retired.pop(0))
+            if old is not None and old.state == "retired":
+                self.table.forget(old)
+        _RETIRED.inc(app=self.app, tenant=s.tenant)
+        log.warning("%s: session %s (tenant %s) retired by %r — siblings "
+                    "unaffected", self.app, s.sid, s.tenant, err)
+        self._refresh_gauges()
+
+    def _session(self, sid: str) -> Session:
+        s = self.table.get(sid)
+        if s is None:
+            raise KeyError(f"no session {sid!r}")
+        return s
+
+    # -- the data plane --------------------------------------------------------
+    def submit(self, sid: str, frame: np.ndarray) -> bool:
+        """Queue one input frame for ``sid``. Returns False (backpressure)
+        when the tenant's fair credit share is exhausted — a stalled tenant
+        cannot starve siblings of queue budget (docs/serving.md)."""
+        with self._lock:
+            s = self._session(sid)
+            if s.state in ("retired", "closed"):
+                raise ValueError(f"session {sid!r} is {s.state}")
+            frame = np.asarray(frame)
+            if frame.shape != (self.frame_size,):
+                raise ValueError(
+                    f"frame shape {frame.shape} != ({self.frame_size},)")
+            if not self.credits.try_acquire(s.tenant):
+                _REJECTS.inc(app=self.app, tenant=s.tenant)
+                return False
+            s.pending.append((np.ascontiguousarray(
+                frame, dtype=self.pipeline.in_dtype), time.perf_counter_ns()))
+            s.frames_in += 1
+            return True
+
+    def results(self, sid: str) -> list:
+        """Drain the session's decoded results (oldest first)."""
+        with self._lock:
+            s = self._session(sid)
+            out, s.out = list(s.out), type(s.out)()
+            return out
+
+    def step(self) -> int:
+        """One frame-time dispatch: every active lane with pending frames
+        rides ONE vmapped program call — one H2D of the stacked batch, one
+        dispatch, one D2H per sink, regardless of the active session count.
+        ``frames_per_dispatch > 1`` additionally megabatches up to k queued
+        frames PER LANE through the in-program scan, ragged per lane (a
+        session with fewer queued frames masks its tail — joins/leaves land
+        cleanly at megabatch boundaries because the mask, not the program
+        shape, carries the raggedness). Returns the number of
+        session-frames dispatched (0 = idle step)."""
+        with self._lock:
+            C = self.table.capacity
+            K = self.k_batch
+            fplan = _faults.plan()
+            lanes: List[tuple] = []       # (session, popped pending entries)
+            # idle frame-time ticks (no lane has pending input — the common
+            # case for a pump loop ticking at frame rate) must cost nothing:
+            # the batch/mask arrays allocate lazily on the first busy lane
+            batch = None
+            active = None
+            for s in self.table.occupants():
+                if not s.pending:
+                    s.stall_steps += 1
+                    continue
+                if batch is None:
+                    shape = (C, self.frame_size) if K == 1 \
+                        else (C, K, self.frame_size)
+                    batch = np.zeros(shape, dtype=self.pipeline.in_dtype)
+                    active = np.zeros((C,) if K == 1 else (C, K), dtype=bool)
+                if fplan.armed():
+                    # per-session fault sites (runtime/faults.py): address a
+                    # work/dispatch injector at ONE session id and only that
+                    # slot retires — the tenant-isolation chaos scenario
+                    try:
+                        fplan.maybe("work", s.sid)
+                        fplan.maybe("dispatch", s.sid)
+                    except _faults.InjectedFault as e:
+                        self._retire(s, e)
+                        continue
+                popped = []
+                for j in range(min(K, len(s.pending))):
+                    entry = s.pending.popleft()
+                    frame, _ = entry
+                    self.credits.release(s.tenant)
+                    if K == 1:
+                        batch[s.slot] = frame
+                        active[s.slot] = True
+                    else:
+                        batch[s.slot, j] = frame
+                        active[s.slot, j] = True
+                    popped.append(entry)
+                s.stall_steps = 0
+                lanes.append((s, popped))
+            self.steps += 1
+            if not lanes:
+                return 0
+            try:
+                prog = self._program(C)
+                x = xfer.to_device(batch, self.inst.device)
+                act = xfer.to_device(active, self.inst.device)
+                new_carries, outs = prog(self._carries, x, act)
+                host = [xfer.to_host(o) for o in outs]  # one D2H per sink
+            except Exception:
+                # dispatch-failure rollback: a real transfer/compile/dispatch
+                # error must not silently drop the popped frames for every
+                # session in the batch — re-queue them at the front of their
+                # queues (original order), re-take their credits and leave
+                # the carries untouched so the caller's retry re-dispatches
+                # the exact same frames
+                for s, popped in lanes:
+                    s.pending.extendleft(reversed(popped))
+                    self.credits.reacquire(s.tenant, len(popped))
+                raise
+            self._carries = new_carries
+            self.dispatches += 1
+            end = time.perf_counter_ns()
+            dispatched = 0
+            for s, popped in lanes:
+                for j, (_, t_sub) in enumerate(popped):
+                    if K == 1:
+                        rows = [h[s.slot] for h in host]
+                    else:
+                        rows = [h[s.slot, j] for h in host]
+                    res = tuple(np.asarray(r) for r in rows) \
+                        if self._multi else np.asarray(rows[0])
+                    s.out.append(res)
+                    s.frames_out += 1
+                    lat = (end - t_sub) * 1e-9
+                    s.last_latency_s = lat
+                    _LATENCY.observe(lat, app=self.app, tenant=s.tenant)
+                    _FRAMES.inc(app=self.app, tenant=s.tenant)
+                    dispatched += 1
+            self.frames += dispatched
+            _DISPATCHES.inc(app=self.app)
+            return dispatched
+
+    # -- observability ---------------------------------------------------------
+    def _refresh_gauges(self) -> None:
+        counts: Dict[tuple, int] = {}
+        for s in self.table.sessions.values():
+            counts[(s.tenant, s.state)] = counts.get((s.tenant, s.state), 0) + 1
+        for key in set(self._gauge_cache) | set(counts):
+            tenant, state = key
+            _SESSIONS.set(float(counts.get(key, 0)), app=self.app,
+                          tenant=tenant, state=state)
+            self._gauge_cache[key] = True
+
+    def tenant_latency_ms(self, tenant: str, q: float = 0.99) -> Optional[float]:
+        v = _LATENCY.labels(app=self.app, tenant=tenant).quantile(q)
+        return None if v is None else v * 1e3
+
+    def describe(self) -> dict:
+        """The app-level view served by ``GET /api/serve/{app}/``."""
+        with self._lock:
+            tenants = self.table.tenants()
+            return {
+                "app": self.app,
+                "frame_size": self.frame_size,
+                "frames_per_dispatch": self.k_batch,
+                "buckets": list(self.buckets),
+                "capacity": self.table.capacity,
+                "resident_buckets": sorted(self._programs),
+                "compiles": self.compiles,
+                "active": self.table.active,
+                "sessions": len(self.table.sessions),
+                "steps": self.steps,
+                "dispatches": self.dispatches,
+                "frames": self.frames,
+                "credit_total": self.credits.total,
+                "credit_fair_share": self.credits.fair_share(),
+                "tenants": {
+                    t: {"sessions": n,
+                        "credits_used": self.credits.used(t),
+                        "p99_ms": self.tenant_latency_ms(t)}
+                    for t, n in sorted(tenants.items())},
+            }
+
+    def session_view(self, sid: str) -> dict:
+        with self._lock:
+            v = self._session(sid).view()
+        t = v["tenant"]
+        v["tenant_p50_ms"] = self.tenant_latency_ms(t, 0.5)
+        v["tenant_p99_ms"] = self.tenant_latency_ms(t, 0.99)
+        return v

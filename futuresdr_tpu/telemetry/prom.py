@@ -98,6 +98,11 @@ class _Metric:
         samples = self.samples()
         if not samples and not self.labelnames:
             samples = [({}, 0.0)]      # unlabelled metrics expose their zero
+        # STABLE sample order: sort by label values (dict insertion order
+        # would expose label-set CREATION order, which differs between
+        # processes — per-tenant serving label sets made this visible, and
+        # scrape diffing / the regress harness need deterministic text)
+        samples.sort(key=lambda s: tuple(str(v) for v in s[0].values()))
         for labels, v in samples:
             lines.append(_sample_line(self.name, labels, v))
         return lines
@@ -224,6 +229,9 @@ class Histogram(_Metric):
         lines.append(f"# TYPE {self.name} {self.kind}")
         with self._lock:
             items = list(self._hists.items())
+        # same stable order as _Metric.render: child creation order is not
+        # deterministic across processes, label-value order is
+        items.sort(key=lambda kv: tuple(str(v) for v in kv[0]))
         for k, h in items:
             base = dict(zip(self.labelnames, k))
             counts, total_sum, total = h.snapshot()
@@ -374,7 +382,10 @@ def render_block_metrics(fg_metrics: Dict[int, Dict[str, dict]],
         kind, help, samples = fams[fam]
         lines.append(f"# HELP {fam} {help}")
         lines.append(f"# TYPE {fam} {kind}")
-        lines.extend(samples)
+        # sample lines sort within the family (same stable-exposition
+        # contract as the registry metrics — block/port discovery order is
+        # not deterministic, the rendered text must be)
+        lines.extend(sorted(samples))
     return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -30,9 +30,11 @@ from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
 from .instance import TpuInstance, instance
 
-__all__ = ["autotune", "autotune_streamed", "default_frames", "measure_link",
+__all__ = ["autotune", "autotune_streamed", "autotune_serve",
+           "default_frames", "measure_link",
            "pick_wire", "StreamedResults", "record_streamed_pick",
-           "cached_frames_per_dispatch", "cached_streamed_pick"]
+           "cached_frames_per_dispatch", "cached_streamed_pick",
+           "record_serve_buckets", "cached_serve_buckets"]
 
 log = logger("tpu.autotune")
 
@@ -348,15 +350,28 @@ def _sig_str(sig: tuple) -> str:
 
 
 def _norm_entry(v) -> Optional[dict]:
-    """Normalize one cache value to ``{"k": int, "inflight": int|None}``.
-    Legacy entries (pre-round-14) are bare ints carrying only K; a malformed
-    value returns None (skip the entry — a bad cache line must never fail a
-    launch)."""
+    """Normalize one cache value to ``{"k": int, "inflight": int|None}``
+    plus the optional serving-plane ``"serve_buckets"`` slot-bucket ladder
+    (round-15 axis — absent from older entries). Legacy entries
+    (pre-round-14) are bare ints carrying only K; a malformed value returns
+    None (skip the entry — a bad cache line must never fail a launch)."""
     try:
         if isinstance(v, dict):
             fl = v.get("inflight")
-            return {"k": int(v["k"]),
-                    "inflight": int(fl) if fl is not None else None}
+            out = {"k": int(v["k"]),
+                   "inflight": int(fl) if fl is not None else None}
+            sb = v.get("serve_buckets")
+            if sb:
+                # parsed in its own guard: a malformed ladder (e.g. the
+                # config-style string "1,4,16") must lose only the serving
+                # axis, never the entry's valid k/inflight picks
+                try:
+                    buckets = sorted({int(b) for b in sb if int(b) > 0})
+                    if buckets:
+                        out["serve_buckets"] = buckets
+                except (TypeError, ValueError):
+                    pass
+            return out
         return {"k": int(v), "inflight": None}
     except (TypeError, ValueError, KeyError):
         return None
@@ -420,10 +435,16 @@ def _record_sig(sig: tuple, frames_per_dispatch: int,
                 inflight: Optional[int] = None) -> None:
     entry = {"k": int(frames_per_dispatch),
              "inflight": int(inflight) if inflight else None}
+    # preserve an orthogonal axis a previous record stamped on this chain
+    # (the serving-plane bucket ladder) — streamed re-tunes must not wipe it
+    prev = _streamed_cache.get(sig) or _disk_load().get(_sig_str(sig))
+    if prev and prev.get("serve_buckets"):
+        entry["serve_buckets"] = list(prev["serve_buckets"])
     _streamed_cache[sig] = entry
     # K-only records persist in the legacy bare-int form (readable by older
     # processes); the dict form is written only when it carries more
-    _disk_store(sig, int(frames_per_dispatch) if not inflight else entry)
+    _disk_store(sig, int(frames_per_dispatch)
+                if not inflight and "serve_buckets" not in entry else entry)
 
 
 def record_streamed_pick(stages, in_dtype, platform: str,
@@ -453,6 +474,103 @@ def cached_frames_per_dispatch(stages, in_dtype,
     :func:`cached_streamed_pick`); None when the chain was never tuned."""
     entry = cached_streamed_pick(stages, in_dtype, platform)
     return entry["k"] if entry is not None else None
+
+
+# ---------------------------------------------------------------------------
+# serving-plane slot buckets (futuresdr_tpu/serve, docs/serving.md)
+# ---------------------------------------------------------------------------
+
+def _serve_sig_stages(pipeline):
+    """Normalize a pipeline-or-stage-list to what :func:`_streamed_sig`
+    keys on (a plain :class:`Pipeline` keys on its stage list; fan-out/DAG
+    pipelines key on their shape signatures)."""
+    if isinstance(pipeline, Pipeline):
+        return pipeline.stages
+    return pipeline
+
+
+def record_serve_buckets(pipeline, in_dtype, platform: str,
+                         buckets: Sequence[int]) -> None:
+    """Stamp a measured slot-bucket ladder into the streamed-pick cache
+    entry of this chain (the serving axis rides NEXT TO the (k, inflight)
+    streamed axes — one signature, orthogonal planes)."""
+    sig = _streamed_sig(_serve_sig_stages(pipeline), in_dtype, platform)
+    cur = _streamed_cache.get(sig) or _disk_load().get(_sig_str(sig)) \
+        or {"k": 1, "inflight": None}
+    entry = {**cur, "serve_buckets": sorted({int(b) for b in buckets
+                                             if int(b) > 0})}
+    _streamed_cache[sig] = entry
+    _disk_store(sig, entry)
+
+
+def cached_serve_buckets(pipeline, in_dtype, platform: str) -> Optional[list]:
+    """The cached slot-bucket ladder of a previously :func:`autotune_serve`d
+    chain; None when never tuned (the engine then uses the configured or
+    default ladder)."""
+    entry = cached_streamed_pick(_serve_sig_stages(pipeline), in_dtype,
+                                 platform)
+    if entry is None:
+        return None
+    return entry.get("serve_buckets")
+
+
+def autotune_serve(pipeline, frame_size: Optional[int] = None,
+                   inst: Optional[TpuInstance] = None,
+                   capacities: Sequence[int] = (1, 2, 4, 8, 16, 32, 64),
+                   reps: int = 4, min_gain: float = 1.2,
+                   record: bool = True) -> Tuple[list, Dict[int, float]]:
+    """Measure the vmapped serving program per slot-bucket capacity and pick
+    the bucket ladder (the serving-plane axis next to (wire, frame, K,
+    depth) — docs/serving.md "Autotuned slot buckets").
+
+    For each candidate capacity the REAL serving step
+    (``serve.engine.build_slot_program`` — vmapped program + active-lane
+    mask, exactly what the engine dispatches) runs fully occupied and the
+    aggregate session-frame rate is measured. The ladder keeps doubling
+    while aggregate throughput still grows by ``min_gain``× per doubling —
+    past that point a bigger bucket only adds latency and pad-lane compute
+    for the same chip output, so admission stops growing there. Returns
+    ``(ladder, {capacity: session_frames_per_sec})`` and records the ladder
+    under the chain's streamed-pick signature (``record=False`` for
+    measurement-only sweeps)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..serve.engine import build_slot_program
+    inst = inst or instance()
+    m = pipeline.frame_multiple
+    fs = frame_size or inst.frame_size
+    fs = max(m, (fs // m) * m)
+    results: Dict[int, float] = {}
+    ladder: list = []
+    prev_rate = None
+    fresh = pipeline.init_carry()
+    for cap in sorted({int(c) for c in capacities if int(c) > 0}):
+        prog = build_slot_program(pipeline, cap)
+        carries = jax.tree_util.tree_map(
+            lambda l: jnp.stack([jnp.asarray(l)] * cap), fresh)
+        x = xfer.to_device(np.zeros((cap, fs), dtype=pipeline.in_dtype),
+                           inst.device)
+        act = xfer.to_device(np.ones((cap,), dtype=bool), inst.device)
+        carries, outs = prog(carries, x, act)      # warmup/compile
+        jax.block_until_ready(outs)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            carries, outs = prog(carries, x, act)
+        jax.block_until_ready(outs)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        rate = cap * reps / dt
+        results[cap] = rate
+        log.info("autotune_serve: capacity %d -> %.1f session-frames/s "
+                 "(%.1f dispatches/s)", cap, rate, reps / dt)
+        if prev_rate is not None and rate < prev_rate * min_gain:
+            break
+        ladder.append(cap)
+        prev_rate = rate
+    if record and ladder:
+        record_serve_buckets(pipeline, pipeline.in_dtype, inst.platform,
+                             ladder)
+    return ladder, results
 
 
 class StreamedResults(dict):

@@ -496,23 +496,81 @@ class TpuKernel(Kernel):
                 # (init() compiles the carry eagerly), so this only triggers on
                 # direct handler calls before init
                 raise RuntimeError("ctrl before init")
-            self._carry = self.pipeline.update_stage(self._carry, stage, **params)
-            self.warn_retune_in_replay()
+            self.apply_retune(stage, params)
         except Exception as e:
             log.warning("ctrl update rejected: %r", e)
             return Pmt.invalid_value()
         return Pmt.ok()
 
-    def warn_retune_in_replay(self) -> int:
-        """Structured observability for the retune-in-replay caveat
-        (docs/robustness.md): a ``ctrl`` retune landing while checkpoint
-        recovery is still replaying logged groups applies its carry surgery
-        to the REPLAYED frames too — recovered output can differ from the
-        unfailed run by up to the pending replayed-frame count (the unfailed
-        run processed those frames with the PRE-retune parameters). The known
-        few-frames-late behavior is now logged instead of silent; returns
-        the pending count (0 = no active replay window). Called by the ctrl
-        handler and the devchain drive loop's member-addressed retune path."""
+    def apply_retune(self, stage, params: dict) -> None:
+        """Replay-exact carry surgery — THE retune entry point (the ctrl
+        handler and the devchain member-addressed path both land here).
+
+        Normal operation: the surgery applies immediately (frames in flight
+        keep the old parameters, later dispatches see the new ones) and is
+        LOGGED against the next dispatch-group sequence number, pruned by
+        the same committed-checkpoint floor as the replay log. A later
+        checkpoint recovery whose restore point precedes a logged retune
+        RE-APPLIES it at exactly its original group boundary
+        (:meth:`_launch_staged`), so the recovered stream reproduces the
+        original retune frame instead of losing the surgery to the restored
+        (pre-retune) carry.
+
+        Inside an active replay window the surgery is instead DEFERRED to
+        the post-replay boundary (``_replay_high + 1``): the replayed frames
+        re-dispatch with their ORIGINAL parameters — bit-identical to the
+        unfailed run — and the new retune lands right after the window,
+        which is exactly "now" in the recovered timeline. The PR 8
+        structured warning survives, upgraded from "recovered output may
+        differ" to reporting the exactness-preserving deferral."""
+        if self._replay_pending():
+            # validate the FULL surgery FIRST — stage address AND params —
+            # by applying it to the current carry and discarding the result
+            # (functional update, side-effect free): a bad retune must
+            # reject at the call site, because the deferred application
+            # cannot answer the caller (address-only validation would
+            # return ok and then silently drop an unknown-param retune).
+            # Validation precedes the deferral warning so a rejected retune
+            # never logs a deferral that will not happen.
+            self.pipeline.update_stage(self._carry, stage, **params)
+            self.warn_retune_in_replay()
+            entry = (self._replay_high + 1, stage, dict(params))
+            self._replay_retunes.append(entry)
+            if self._ckpt_every:
+                self._retune_log.append(entry)
+            return
+        self._carry = self.pipeline.update_stage(self._carry, stage, **params)
+        if self._ckpt_every:
+            # the new parameters are visible from the oldest
+            # staged-but-unlaunched group onward (frames the credit budget is
+            # holding back dispatch with the mutated carry), not from the next
+            # group to be STAGED — log the boundary replay must reproduce
+            seq = self._staged[0][2] if self._staged else self._seq
+            self._retune_log.append((seq, stage, dict(params)))
+
+    def _apply_replay_retunes(self, seq: int) -> None:
+        """Re-apply logged carry surgery at its ORIGINAL dispatch boundary:
+        called by :meth:`_launch_staged` before dispatching group ``seq``,
+        this lands every queued retune recorded at or before that group —
+        during replay the recovered carry walks through exactly the
+        parameter timeline of the unfailed run (and a mid-replay retune's
+        deferred boundary lands right after the window)."""
+        while self._replay_retunes and self._replay_retunes[0][0] <= seq:
+            _, stage, params = self._replay_retunes.popleft()
+            try:
+                self._carry = self.pipeline.update_stage(
+                    self._carry, stage, **params)
+            except Exception as e:                     # noqa: BLE001
+                # the surgery validated cleanly when accepted — a failure
+                # here can only follow a pipeline contract change; narrowing
+                # the replay to parameter-divergent is the honest fallback
+                log.warning("%s: replayed retune @%d failed (%r) — recovered "
+                            "output may diverge at that boundary",
+                            self.meta.instance_name, seq, e)
+
+    def _replay_pending(self) -> int:
+        """Frames of the active replay window still in flight (0 = no
+        active window; a fully-drained window disarms)."""
         if self._replay_high < 0:
             return 0
         pending = sum(len(m) for _, _, m, _ in self._replay_queue)
@@ -522,14 +580,27 @@ class TpuKernel(Kernel):
                        if s <= self._replay_high)
         if pending == 0:
             self._replay_high = -1       # window fully drained: disarm
+        return pending
+
+    def warn_retune_in_replay(self) -> int:
+        """Structured observability for retunes landing inside an active
+        checkpoint-replay window (docs/robustness.md): since the
+        replay-aware retune upgrade the surgery is deferred to the
+        post-replay boundary (see :meth:`apply_retune`) so recovered output
+        stays bit-identical — the warning now reports that deferral instead
+        of a divergence. Returns the pending replayed-frame count (0 = no
+        active replay window)."""
+        pending = self._replay_pending()
+        if pending == 0:
             return 0
         log.warning(
-            "%s: ctrl retune landed inside an active replay window — %d "
-            "replayed frame(s) still in flight will re-dispatch with the NEW "
-            "parameters, so recovered output may differ from the unfailed "
-            "run by up to that many frames (docs/robustness.md "
-            "retune-in-replay caveat)",
-            self.meta.instance_name or type(self).__name__, pending)
+            "%s: ctrl retune landed inside an active replay window — "
+            "deferred to the post-replay boundary (seq %d) so the %d "
+            "replayed frame(s) still in flight re-dispatch with their "
+            "ORIGINAL parameters and recovered output stays bit-identical "
+            "to the unfailed run (docs/robustness.md replay-aware retunes)",
+            self.meta.instance_name or type(self).__name__,
+            self._replay_high + 1, pending)
         return pending
 
     # -- helpers ---------------------------------------------------------------
@@ -803,6 +874,11 @@ class TpuKernel(Kernel):
             h2d, metas, seq, drop = self._staged[0]
             x_parts = h2d()
             self._staged.popleft()
+            # replay-aware retunes: logged carry surgery recorded at or
+            # before this group re-applies NOW, at its original boundary
+            # (empty deque outside recovery — one truthiness check)
+            if self._replay_retunes:
+                self._apply_replay_retunes(seq)
             # donation fence: the snapshot D2H of the previous carry must be
             # host-side before this dispatch donates and reuses its buffers
             self._materialize_pending_ckpts()
@@ -967,10 +1043,22 @@ class TpuKernel(Kernel):
         self._replay_queue: Deque[tuple] = deque()
         self._rlog_dropped = 0           # leak-guard drops (see _stage_group)
         # newest replayed group's seq while a recovery's replay window is
-        # active (-1 = none): ctrl retunes landing inside the window log a
-        # structured warning (warn_retune_in_replay) instead of silently
-        # shifting where the swap lands in the recovered stream
+        # active (-1 = none): ctrl retunes landing inside the window defer
+        # to the post-window boundary (apply_retune) with a structured
+        # warning (warn_retune_in_replay) instead of silently shifting
+        # where the swap lands in the recovered stream
         self._replay_high = -1
+        # retune log: (seq, stage, params) per applied carry surgery, seq =
+        # the first dispatch group that saw the new parameters — pruned by
+        # the same committed-checkpoint floor as the replay log, replayed by
+        # recover() so a restore point BEFORE a retune re-applies it at
+        # exactly its original boundary (replay-aware retunes,
+        # docs/robustness.md)
+        self._retune_log: Deque[tuple] = deque()
+        # surgery queued for application at a dispatch boundary (recovery
+        # re-application + mid-replay deferrals), consumed in seq order by
+        # _launch_staged
+        self._replay_retunes: Deque[tuple] = deque()
         self._forfeit_ctr = None
         self._replay_ctr = None
 
@@ -1079,6 +1167,10 @@ class TpuKernel(Kernel):
                         _, _, _, hs = self._rlog.popleft()
                         for h in hs:
                             h.release()
+                # retunes at or before the floor are baked into every
+                # restorable checkpoint — same retention rule as the log
+                while self._retune_log and self._retune_log[0][0] <= floor:
+                    self._retune_log.popleft()
 
     def _recovery_reset(self, purge_disk: bool = False) -> None:
         """Drop every checkpoint/replay artifact (fresh incarnation, or a
@@ -1104,6 +1196,8 @@ class TpuKernel(Kernel):
         self._pending_ckpts.clear()
         self._replay_queue.clear()
         self._replay_high = -1
+        self._retune_log.clear()
+        self._replay_retunes.clear()
         if purge_disk and self._ckpt_dir:
             path = self._ckpt_file()
             if path:
@@ -1273,6 +1367,7 @@ class TpuKernel(Kernel):
                     self._inflight.clear()
                     self._pending_ckpts.clear()
                     self._replay_queue.clear()
+                    self._replay_retunes.clear()
                     # seed the ring with the DISK carry as a real candidate
                     # at the pre-stream position: a later in-process fault
                     # (before the first new commit) must replay this
@@ -1341,6 +1436,12 @@ class TpuKernel(Kernel):
         self._inflight.clear()
         self._pending_ckpts.clear()
         self._replay_queue.clear()
+        # replay-aware retunes: surgery recorded AFTER the restore point is
+        # not in the restored carry — queue it for re-application at its
+        # original group boundary (_launch_staged applies in seq order), so
+        # the replayed stream walks the unfailed run's parameter timeline
+        self._replay_retunes = deque(
+            e for e in self._retune_log if e[0] > seq)
         replayed = 0
         with self._rlog_lock:
             log_entries = list(self._rlog)

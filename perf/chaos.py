@@ -459,6 +459,66 @@ def scenario_isolate_group():
         faults.reset()
 
 
+def scenario_tenant_isolation():
+    """Acceptance (multi-tenant serving, docs/serving.md): one session's
+    injected work/dispatch fault retires ONLY that session's slot — sibling
+    sessions keep dispatching and their outputs stay BIT-IDENTICAL to a
+    fault-free run, the batch itself never fails, and the retired session
+    carries the structured error in its doctor view."""
+    from futuresdr_tpu.ops.stages import Pipeline, fir_stage, rotator_stage
+    from futuresdr_tpu.runtime import faults
+    from futuresdr_tpu.serve import ServeEngine
+
+    taps = np.hanning(21).astype(np.float32)
+    pipe = Pipeline([fir_stage(taps, fft_len=128), rotator_stage(0.02)],
+                    np.complex64)
+    rng = np.random.default_rng(11)
+    frames = {sid: [(rng.standard_normal(512) + 1j
+                     * rng.standard_normal(512)).astype(np.complex64)
+                    for _ in range(5)]
+              for sid in ("csa", "csb", "csc")}
+
+    def one_run():
+        eng = ServeEngine(pipe, frame_size=512, app="chaos_serve",
+                          buckets=(4,), queue_frames=8)
+        for sid, tenant in (("csa", "t0"), ("csb", "t1"), ("csc", "t1")):
+            eng.admit(tenant=tenant, sid=sid)
+        outs = {sid: [] for sid in frames}
+        for step in range(5):
+            for sid in frames:
+                s = eng.table.get(sid)
+                if s is not None and s.state == "active":
+                    eng.submit(sid, frames[sid][step])
+            eng.step()
+            for sid in frames:
+                if eng.table.get(sid) is not None:
+                    outs[sid].extend(eng.results(sid))
+        return eng, outs
+
+    before = _threads_now()
+    clean_eng, clean = one_run()
+    assert all(len(v) == 5 for v in clean.values()), \
+        {k: len(v) for k, v in clean.items()}
+    # fault addressed at ONE session id: only its slot may retire
+    faults.reset().arm("work:csb", rate=1.0, max_faults=1, seed=3)
+    try:
+        eng, got = one_run()
+    finally:
+        faults.reset()
+    vb = eng.session_view("csb")
+    assert vb["state"] == "retired" and vb["error"], vb
+    assert len(got["csb"]) == 0, "retired session still produced output"
+    # siblings: full output, bit-identical to the fault-free run
+    for sid in ("csa", "csc"):
+        assert len(got[sid]) == 5, (sid, len(got[sid]))
+        for a, b in zip(got[sid], clean[sid]):
+            np.testing.assert_array_equal(a, b, err_msg=sid)
+    # the batch kept dispatching every step (one dispatch per frame time)
+    assert eng.dispatches == clean_eng.dispatches == 5, \
+        (eng.dispatches, clean_eng.dispatches)
+    _assert_no_leaked_threads(before, "tenant_isolation")
+
+
 def scenario_deadline_bounds_wedge():
     """Acceptance: a wedged sink + run deadline → structured FlowgraphError
     within deadline+grace instead of an indefinite hang."""
@@ -620,6 +680,7 @@ SCENARIOS = (
     ("stateful-restart-replay", scenario_stateful_restart_replay),
     ("arena-recycle-replay", scenario_arena_recycle_replay),
     ("isolate-group", scenario_isolate_group),
+    ("tenant-isolation", scenario_tenant_isolation),
     ("deadline_bounds_wedge", scenario_deadline_bounds_wedge),
 )
 

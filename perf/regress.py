@@ -49,12 +49,19 @@ FIELDS_ANY_BACKEND = ("cpu_baseline_msps",)
 FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
                        "streamed_fanout_msps", "streamed_dag_msps",
                        "streamed_link_utilization", "host_codec_overlap_frac",
-                       "fm_msps", "wlan_msps", "lora_msps")
+                       "fm_msps", "wlan_msps", "lora_msps",
+                       "serve_sessions_per_chip")
 # lower-is-better fields (fractions, not rates): regression = the value ROSE
 # past the reference by more than the absolute slack below — e.g. the
 # carry-checkpoint cost of the device-plane recovery contract creeping up
 FIELDS_INVERSE_SAME_BACKEND = ("checkpoint_overhead_frac",)
 INVERSE_SLACK = 0.10       # absolute fraction a lower-is-better field may rise
+# lower-is-better RATE/LATENCY fields (serving p99 under churn): regression =
+# the value rose past the reference by the multiplicative slack — generous,
+# because tail latency on a shared CI host carries straggler noise the
+# median-based rate fields do not
+FIELDS_INVERSE_RATIO_SAME_BACKEND = ("serve_p99_under_churn_ms",)
+INVERSE_RATIO_SLACK = 2.0  # may rise up to (1 + slack)x the reference
 
 
 def load_trajectory(root=_ROOT):
@@ -99,7 +106,7 @@ def compare(current, trajectory, tolerance):
     same, any_ = pick_references(trajectory, backend)
     rows = []
 
-    def one(field, ref_pair, inverse=False):
+    def one(field, ref_pair, inverse=None):
         if ref_pair is None:
             return
         rnd, ref = ref_pair
@@ -107,12 +114,20 @@ def compare(current, trajectory, tolerance):
         if not isinstance(cur_v, (int, float)) or \
                 not isinstance(ref_v, (int, float)):
             return
-        if inverse:
+        if inverse == "abs":
             # lower-is-better fraction (ref may legitimately be 0): flag a
             # rise past the absolute slack, ratio is informational only
             ratio = cur_v / ref_v if ref_v > 0 else float("inf")
             rows.append((field, cur_v, ref_v, rnd, ratio,
                          cur_v > ref_v + INVERSE_SLACK))
+            return
+        if inverse == "ratio":
+            # lower-is-better latency: flag a multiplicative rise
+            if ref_v <= 0:
+                return
+            ratio = cur_v / ref_v
+            rows.append((field, cur_v, ref_v, rnd, ratio,
+                         ratio > 1.0 + INVERSE_RATIO_SLACK))
             return
         if ref_v <= 0:
             return
@@ -125,7 +140,9 @@ def compare(current, trajectory, tolerance):
     for f in FIELDS_SAME_BACKEND:
         one(f, same)
     for f in FIELDS_INVERSE_SAME_BACKEND:
-        one(f, same, inverse=True)
+        one(f, same, inverse="abs")
+    for f in FIELDS_INVERSE_RATIO_SAME_BACKEND:
+        one(f, same, inverse="ratio")
     return rows, (same[0] if same else None)
 
 

@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""perf/serve_ab — multi-tenant serving A/B (docs/serving.md).
+
+A/B of the SAME receiver chain serving N concurrent sessions two ways:
+
+* **independent** — N dedicated dispatch loops, one per session: each frame
+  time every session pays its own H2D, program dispatch and D2H (what N
+  separate flowgraphs with one ``TpuKernel`` each do, minus their thread
+  overhead — a deliberately generous baseline: the real actor path also
+  pays per-block supervision);
+* **serve** — the ``futuresdr_tpu/serve`` engine: all sessions ride ONE
+  vmapped dispatch per frame time (one stacked H2D, one program call, one
+  D2H per sink), with ragged admission masking the idle lanes.
+
+At a matched per-session throughput target T, sessions/chip = aggregate
+session-frames-per-second / T — so the serve:independent ratio of aggregate
+rates IS the sessions-per-chip ratio at any matched T. The CHURN phase
+closes and admits sessions under load (two tenants) and reports per-tenant
+p99 submit→result latency plus the zero-recompile pin (resident slot
+buckets never recompile on join/leave).
+
+``--smoke`` (the check.sh gate) asserts: dispatches/frame-time == 1
+regardless of the active session count, session churn causes ZERO
+recompiles of resident buckets, and the sessions/chip ratio clears a
+conservative floor (the committed artifact documents the full curve).
+
+Stamps a JSON line: ``serve_sessions_per_chip`` (N × ratio: sessions one
+chip serves at the per-session rate the independent baseline sustained for
+N), ``serve_speedup``, ``serve_p99_under_churn_ms``,
+``serve_dispatches_per_frame`` — graded by ``perf/regress.py``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+FRAME = 512          # small frames: the regime where per-dispatch host cost
+#                      dominates per-session compute — the serving win
+N_TENANTS = 4
+
+
+def build_pipeline():
+    """A light stateful receiver chain (rotator + short FIR): carries real
+    per-session state (oscillator phase, filter history) while keeping
+    per-session compute small enough that dispatch amortization — the thing
+    under test — is visible on the CPU backend too."""
+    from futuresdr_tpu.ops.stages import Pipeline, fir_stage, rotator_stage
+    taps = np.hanning(17).astype(np.float32)
+    return Pipeline([rotator_stage(0.013), fir_stage(taps, fft_len=128)],
+                    np.complex64)
+
+
+def session_data(n_sessions: int, frames_each: int, frame: int):
+    rng = np.random.default_rng(42)
+    return [
+        [(rng.standard_normal(frame) + 1j * rng.standard_normal(frame))
+         .astype(np.complex64) for _ in range(frames_each)]
+        for _ in range(n_sessions)
+    ]
+
+
+def run_independent(pipe, data, steps: int) -> float:
+    """N dedicated per-session dispatch loops; returns aggregate
+    session-frames/s. The compiled program is shared across sessions (same
+    shape → same executable, as N real flowgraphs would get from the jit
+    cache); every session still pays its own H2D/dispatch/D2H per frame."""
+    import jax
+
+    from futuresdr_tpu.ops import xfer
+    from futuresdr_tpu.tpu.instance import instance
+    dev = instance().device
+    n = len(data)
+    fn = jax.jit(pipe.fn())
+    carries = [jax.device_put(pipe.init_carry(), dev) for _ in range(n)]
+    # warmup/compile
+    c, y = fn(carries[0], xfer.to_device(data[0][0], dev))
+    jax.block_until_ready(y)
+    carries[0] = jax.device_put(pipe.init_carry(), dev)
+    # median per-step duration: robust to shared-host straggler steps (the
+    # suite's median-of-runs methodology applied per frame time)
+    durs = []
+    for step in range(steps):
+        t0 = time.perf_counter()
+        for i in range(n):
+            x = xfer.to_device(data[i][step % len(data[i])], dev)
+            carries[i], y = fn(carries[i], x)
+            xfer.to_host(y)
+        durs.append(time.perf_counter() - t0)
+    return n / float(np.median(durs))
+
+
+def run_serve(pipe, data, steps: int, churn_every: int = 0,
+              queue_frames: int = 4):
+    """The serving engine: one dispatch per frame time for every active
+    session. ``churn_every`` > 0 closes the oldest session and admits a
+    fresh one every that-many steps (join/leave under load). Returns
+    ``(aggregate_fps, engine, p99_ms)``."""
+    from futuresdr_tpu.serve import ServeEngine
+    n = len(data)
+    eng = ServeEngine(pipe, frame_size=FRAME, app="serve_ab",
+                      queue_frames=queue_frames)
+    sessions = [eng.admit(tenant=f"t{i % N_TENANTS}") for i in range(n)]
+    # warmup/compile the resident bucket (excluded from the timing AND the
+    # latency sample — a compile under the first dispatch is not churn p99)
+    for i, s in enumerate(sessions):
+        eng.submit(s.sid, data[i][0])
+    eng.step()
+    for s in sessions:
+        eng.results(s.sid)
+    compiles_at_start = eng.compiles
+    dispatched = 0
+    churned = 0
+    lat_s = []                   # steady-state per-frame submit→result
+    durs = []
+    for step in range(1, steps + 1):
+        if churn_every and step % churn_every == 0:
+            old = sessions.pop(0)
+            eng.close(old.sid)
+            fresh = eng.admit(tenant=f"t{churned % N_TENANTS}")
+            sessions.append(fresh)
+            data.append(data.pop(0))          # the new session reuses a lane
+            churned += 1
+        t0 = time.perf_counter()
+        for i, s in enumerate(sessions):
+            eng.submit(s.sid, data[i][step % len(data[i])])
+        before = {s.sid: s.frames_out for s in sessions}
+        dispatched += eng.step()
+        for s in sessions:
+            if s.frames_out > before.get(s.sid, 0) \
+                    and s.last_latency_s is not None:
+                lat_s.append(s.last_latency_s)
+            eng.results(s.sid)
+        durs.append(time.perf_counter() - t0)
+    p99 = float(np.percentile(lat_s, 99)) * 1e3 if lat_s else 0.0
+    eng.stats = {
+        "dispatches_per_step": eng.dispatches and
+        (eng.dispatches - 1) / steps,       # -1: the warmup dispatch
+        "compiles_during_run": eng.compiles - compiles_at_start,
+        "churned": churned,
+    }
+    return len(sessions) / float(np.median(durs)), eng, p99
+
+
+def _stamp(n, indep, serve, p99, eng, churn_eng) -> dict:
+    """The ONE stamp schema — shared by :func:`measure` (the ``bench.py``
+    serve section) and the standalone harness, so the two output paths
+    cannot drift from what ``perf/regress.py`` grades."""
+    ratio = serve / indep if indep > 0 else 0.0
+    return {
+        "serve_sessions": n,
+        "serve_indep_fps": round(indep, 1),
+        "serve_fps": round(serve, 1),
+        "serve_speedup": round(ratio, 2),
+        "serve_sessions_per_chip": round(n * ratio, 1),
+        "serve_p99_under_churn_ms": round(p99, 3),
+        "serve_dispatches_per_frame": round(
+            eng.stats["dispatches_per_step"], 3),
+        "serve_churn_compiles": churn_eng.stats["compiles_during_run"],
+        "serve_churned_sessions": churn_eng.stats["churned"],
+    }
+
+
+def measure(n_sessions: int = 32, steps: int = 60, churn_every: int = 10):
+    """One full A/B at ``n_sessions``; returns the stamp dict (the
+    ``bench.py`` serve section calls this)."""
+    pipe = build_pipeline()
+    data = session_data(n_sessions, 8, FRAME)
+    indep_fps = run_independent(pipe, data, steps)
+    serve_fps, eng, _ = run_serve(pipe, list(data), steps)
+    _, churn_eng, p99 = run_serve(pipe, list(data), steps,
+                                  churn_every=churn_every)
+    return _stamp(n_sessions, indep_fps, serve_fps, p99, eng, churn_eng)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sessions", default="8,32,64",
+                   help="comma list of concurrent session counts to sweep")
+    p.add_argument("--steps", type=int, default=60,
+                   help="dispatch steps per measurement")
+    p.add_argument("--churn-every", type=int, default=10,
+                   help="churn phase: close+admit one session every N steps")
+    p.add_argument("--smoke", action="store_true",
+                   help="check.sh gate: single point + hard assertions")
+    args = p.parse_args()
+
+    counts = ([64] if args.smoke
+              else [int(x) for x in args.sessions.split(",") if x.strip()])
+    steps = 24 if args.smoke else args.steps
+
+    pipe = build_pipeline()
+    print(f"# serve_ab: frame={FRAME}, chain="
+          f"{[s.name for s in pipe.stages]}, steps={steps}, "
+          f"tenants={N_TENANTS}")
+    print(f"{'N':>4} {'indep fps':>12} {'serve fps':>12} {'ratio':>7} "
+          f"{'disp/frame':>11} {'churn p99 ms':>13} {'churn compiles':>15}")
+    stamp = None
+    for n in counts:
+        data = session_data(n, 8, FRAME)
+        indep = run_independent(pipe, data, steps)
+        serve, eng, _ = run_serve(pipe, list(data), steps)
+        _, churn_eng, p99 = run_serve(pipe, list(data), steps,
+                                      churn_every=args.churn_every)
+        stamp = _stamp(n, indep, serve, p99, eng, churn_eng)
+        ratio = serve / indep if indep else 0.0
+        dpf = eng.stats["dispatches_per_step"]
+        cc = churn_eng.stats["compiles_during_run"]
+        print(f"{n:4d} {indep:12.1f} {serve:12.1f} {ratio:7.2f} "
+              f"{dpf:11.3f} {p99:13.3f} {cc:15d}")
+        if args.smoke:
+            # one batched dispatch per frame time, no matter how many
+            # sessions are active (the tentpole invariant)
+            assert abs(dpf - 1.0) < 1e-9, \
+                f"dispatches/frame {dpf} != 1 at N={n}"
+            # join/leave under load never recompiles a resident bucket
+            assert cc == 0, f"churn recompiled {cc} resident bucket(s)"
+            assert churn_eng.stats["churned"] > 0
+            # conservative smoke floor — the artifact documents the full
+            # curve (>= 8x at the committed settings); CI boxes are noisy
+            assert ratio >= 3.0, \
+                f"sessions/chip ratio {ratio:.2f} under the 3.0 smoke floor"
+    print(json.dumps(stamp))
+    if args.smoke:
+        print("serve_ab smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    # standalone-harness environment only — bench.py imports measure()
+    # in-process and must NOT inherit these (a live-TPU bench would be
+    # silently forced onto the CPU backend with cache persistence off)
+    sys.path.insert(0, ".")
+    sys.path.insert(0, "..")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("FUTURESDR_TPU_AUTOTUNE_CACHE_DIR", "off")
+    sys.exit(main())

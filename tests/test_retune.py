@@ -373,3 +373,171 @@ def test_xlating_taps_update_preserves_exact_theta():
     got_W = np.asarray(jax.device_get(c[0][0]))
     want_W = np.asarray(jax.device_get(fresh[0][0]))
     np.testing.assert_array_equal(got_W, want_W)
+
+
+# ---------------------------------------------------------------------------
+# replay-aware retunes (ISSUE 11 satellite, docs/robustness.md)
+# ---------------------------------------------------------------------------
+
+def _mocked_kernel(ck=10):
+    """A stateful TpuKernel driven by the Mocker: sparse checkpoint cadence
+    so a recovery's restore point predates recent dispatch groups — the
+    regime where a logged retune must be RE-APPLIED during replay."""
+    from futuresdr_tpu.tpu import TpuKernel
+    taps = firdes.lowpass(0.2, 31).astype(np.float32)
+    return TpuKernel([fir_stage(taps, fft_len=256, name="f"),
+                      rotator_stage(0.05, name="rot")],
+                     np.complex64, frame_size=2048, frames_in_flight=2,
+                     checkpoint_every=ck)
+
+
+def _retune_data(n_frames=9):
+    rng = np.random.default_rng(21)
+    n = 2048 * n_frames
+    return (rng.standard_normal(n) + 1j * rng.standard_normal(n)) \
+        .astype(np.complex64)
+
+
+def _drive(m, data, lo, hi):
+    """Feed frames [lo, hi) through the mocked kernel and drain."""
+    m.input("in", data[lo * 2048:hi * 2048])
+    m.run()
+
+
+def test_replayed_retune_lands_on_exactly_the_original_frame():
+    """Acceptance (replay-aware ctrl retunes): with a sparse checkpoint
+    cadence, a recovery whose restore point PRECEDES a logged retune
+    re-applies the carry surgery at exactly its original dispatch boundary
+    during replay — the full output is BIT-IDENTICAL to the unfailed run
+    with the same retune timing. (Before this PR the restored carry simply
+    lost the surgery: the replayed and subsequent frames recomputed with
+    the OLD parameters.)"""
+    import asyncio
+
+    from futuresdr_tpu import Mocker
+    from futuresdr_tpu.types import Pmt
+    data = _retune_data()
+    pmt = Pmt.map({"stage": "rot", "phase_inc": -0.11})
+
+    # unfailed reference: 3 frames, retune, 6 more frames
+    mk_ref = _mocked_kernel()
+    ref = Mocker(mk_ref)
+    ref.init_output("out", len(data) * 2)
+    ref.init()
+    _drive(ref, data, 0, 3)
+    assert ref.post("ctrl", pmt) == Pmt.ok()
+    _drive(ref, data, 3, 9)
+    expected = ref.output("out").copy()
+
+    # faulted run: same timing, then a recovery AFTER the retune whose
+    # restore point (the fresh-init sentinel — no commit yet at cadence 10)
+    # precedes it: every group replays, the retune must re-land at group 3
+    mk = _mocked_kernel()
+    m = Mocker(mk)
+    m.init_output("out", len(data) * 2)
+    m.init()
+    _drive(m, data, 0, 3)
+    assert m.post("ctrl", pmt) == Pmt.ok()
+    _drive(m, data, 3, 6)
+    assert mk._retune_log and mk._retune_log[0][0] == 3
+    assert asyncio.run(mk.recover(RuntimeError("injected test fault")))
+    assert mk._replay_retunes and mk._replay_retunes[0][0] == 3
+    _drive(m, data, 6, 9)
+    got = m.output("out")
+    np.testing.assert_array_equal(got, expected)
+    assert not mk._replay_retunes        # consumed at its boundary
+
+
+def test_retune_during_replay_rejects_bad_params_at_call_site():
+    """A retune landing mid-replay with a valid stage but invalid params
+    must reject at the call site (InvalidValue), exactly like the same
+    retune outside a replay window — NOT return ok and then silently drop
+    at the deferred boundary (the deferral branch validates the FULL
+    surgery against the current carry, discarding the result)."""
+    import asyncio
+
+    from futuresdr_tpu import Mocker
+    from futuresdr_tpu.types import Pmt
+
+    data = _retune_data(6)
+    mk = _mocked_kernel()
+    m = Mocker(mk)
+    m.init_output("out", len(data) * 2)
+    m.init()
+    _drive(m, data, 0, 3)
+    assert asyncio.run(mk.recover(RuntimeError("injected test fault")))
+    assert mk._replay_queue              # replay window armed, not drained
+    assert m.post("ctrl", Pmt.map({"stage": "rot", "bogus_param": 1.0})) \
+        == Pmt.invalid_value()
+    assert not mk._replay_retunes        # nothing queued for the boundary
+
+
+def test_retune_with_staged_backlog_logs_the_oldest_unlaunched_group():
+    """A retune arriving while dispatch groups are STAGED but not yet
+    launched (the credit budget holding them back) mutates the carry those
+    groups will dispatch with — so the replay log must record the OLDEST
+    unlaunched group's boundary, not the next group to be staged. Logging
+    ``self._seq`` there would make a later replay re-dispatch the staged
+    groups with the pre-retune parameters."""
+    import asyncio
+
+    mk = _mocked_kernel()
+    asyncio.run(mk.init(None, None))
+
+    # drained kernel: the boundary IS the next staged seq
+    mk._seq = 4
+    mk.apply_retune("rot", {"phase_inc": -0.07})
+    assert mk._retune_log[-1][0] == 4
+
+    # staged backlog: groups 5 and 6 are staged awaiting credits — the new
+    # parameters are visible from group 5 onward
+    mk._seq = 7
+    mk._staged.append((None, [], 5, False))
+    mk._staged.append((None, [], 6, False))
+    try:
+        mk.apply_retune("rot", {"phase_inc": 0.19})
+    finally:
+        mk._staged.clear()
+    assert mk._retune_log[-1][0] == 5
+
+
+def test_retune_during_replay_defers_to_post_window_boundary(caplog):
+    """A NEW retune arriving while the replay window is still in flight is
+    deferred to the post-replay boundary (structured warning upgraded from
+    the PR 8 divergence note): replayed frames keep their original
+    parameters and the final output is bit-identical to an unfailed run
+    where the retune lands at that same frame."""
+    import asyncio
+    import logging
+
+    from futuresdr_tpu import Mocker
+    from futuresdr_tpu.types import Pmt
+    data = _retune_data()
+    pmt = Pmt.map({"stage": "rot", "phase_inc": 0.21})
+
+    # unfailed reference: retune lands after frame 6
+    mk_ref = _mocked_kernel()
+    ref = Mocker(mk_ref)
+    ref.init_output("out", len(data) * 2)
+    ref.init()
+    _drive(ref, data, 0, 6)
+    assert ref.post("ctrl", pmt) == Pmt.ok()
+    _drive(ref, data, 6, 9)
+    expected = ref.output("out").copy()
+
+    mk = _mocked_kernel()
+    m = Mocker(mk)
+    m.init_output("out", len(data) * 2)
+    m.init()
+    _drive(m, data, 0, 6)
+    assert asyncio.run(mk.recover(RuntimeError("injected test fault")))
+    assert mk._replay_queue              # replay window armed, not drained
+    with caplog.at_level(logging.WARNING, logger="futuresdr_tpu.tpu.kernel"):
+        assert m.post("ctrl", pmt) == Pmt.ok()
+    msgs = [r.getMessage() for r in caplog.records
+            if "replay window" in r.getMessage()]
+    assert msgs and "deferred to the post-replay boundary" in msgs[0]
+    assert mk._replay_retunes and mk._replay_retunes[0][0] == 6
+    _drive(m, data, 6, 9)
+    got = m.output("out")
+    np.testing.assert_array_equal(got, expected)
