@@ -12,6 +12,13 @@ echo "== telemetry overhead gate (docs/observability.md budget) =="
 JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_telemetry.py::test_telemetry_disabled_overhead_null_rand
 
+echo "== profile plane smoke (docs/observability.md 'The profile plane') =="
+# a warmed streamed run bills exactly ONE warmup compile and ZERO
+# steady-state fsdr_compiles_total increments; the live mfu stamp is
+# present (config peak overrides exercise the unknown-chip path); serving
+# bucket compiles bill once per resident bucket, never per step
+JAX_PLATFORMS=cpu python perf/profile_smoke.py --smoke
+
 echo "== device-graph fusion gate (docs/tpu_notes.md 'Device-graph fusion') =="
 # fused A/B smoke: the linear pass engages (dispatches drop 3x -> 1x per
 # frame), the fan-out pass engages (1->2 broadcast region: H2D bytes bill

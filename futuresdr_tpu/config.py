@@ -49,6 +49,14 @@ class Config:
     #   before a trip (trip latency ≈ interval × window)
     doctor_dir: str = ""                   # write flight-recorder dumps here
     #   ("" = keep in memory only; served via GET /api/fg/{fg}/doctor/)
+    # Profile plane (telemetry/profile.py, docs/observability.md "The
+    # profile plane"): MFU/HBM-utilization denominators. 0 = autodetect the
+    # chip from jax.devices()[0].device_kind (utils/roofline.detect_peaks);
+    # set BOTH to pin peaks on an unknown chip (or to force an MFU stamp on
+    # the CPU backend for CI smokes — perf/profile_smoke.py does exactly
+    # that). Env: FUTURESDR_TPU_PEAK_FLOPS / FUTURESDR_TPU_PEAK_HBM_GBPS.
+    peak_flops: float = 0.0                # chip peak, FLOP/s (bf16 matmul)
+    peak_hbm_gbps: float = 0.0             # chip HBM bandwidth, GB/s
     doctor_action: str = "record"          # watchdog-trip escalation
     #   (telemetry/doctor.py): "record" keeps today's flight-record-only
     #   behavior; "cancel" additionally cancels the wedged flowgraph after

@@ -18,6 +18,9 @@ plus the telemetry plane (docs/observability.md):
   GET  /api/fg/{fg}/doctor/    → flight-recorder dump + bottleneck attribution
                                  (telemetry/doctor.py; ``?md=1`` renders
                                  markdown instead of JSON)
+  GET  /api/fg/{fg}/profile/   → live profile plane: compile counters/storms
+                                 + per-program roofline (telemetry/profile.py;
+                                 ``?costs=1`` materializes lazy cost analyses)
 
 plus the multi-tenant serving session plane (docs/serving.md, merged from
 ``futuresdr_tpu/serve/api.py``):
@@ -117,6 +120,7 @@ class ControlPort:
         app.router.add_get("/api/fg/{fg}/metrics/", self._metrics)
         app.router.add_get("/api/fg/{fg}/trace/", self._trace)
         app.router.add_get("/api/fg/{fg}/doctor/", self._doctor)
+        app.router.add_get("/api/fg/{fg}/profile/", self._profile)
         app.router.add_get("/api/fg/{fg}/block/{blk}/", self._describe_block)
         app.router.add_get("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
         app.router.add_post("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
@@ -179,7 +183,15 @@ class ControlPort:
         source; ``telemetry/prom.py`` only renders the dicts)."""
         from aiohttp import web
 
-        from ..telemetry import prom
+        from ..telemetry import profile, prom
+        try:
+            # refresh fsdr_mfu/fsdr_hbm_util from the dispatch window since
+            # the previous scrape (telemetry/profile.py; min_interval keeps
+            # a scrape storm from shrinking the window into noise) — only
+            # materialized program costs publish, so a scrape never compiles
+            profile.plane().update_live_gauges()
+        except Exception as e:                   # noqa: BLE001 — scrape must
+            log.warning("profile gauge refresh failed: %r", e)   # not fail
         fg_metrics = {}
         for fg_id in self.handle.flowgraph_ids():
             fg = self.handle.get_flowgraph(fg_id)
@@ -236,6 +248,39 @@ class ControlPort:
         # default=str: span args / extra_metrics may carry numpy scalars
         return web.json_response(
             body, dumps=lambda o: _json.dumps(o, default=str))
+
+    async def _profile(self, request):
+        """The live profile plane (telemetry/profile.py): per-program
+        compile counters/reasons, active compiles, recompile-storm
+        classification, and the live roofline table (registered
+        flops/bytes per unit, windowed + run-average MFU/HBM-util,
+        hbm/compute-bound classification). ``?costs=1`` materializes
+        lazily-registered cost analyses first — that may compile once per
+        program signature, so it runs off the event loop; the default view
+        never compiles. 404s for unknown flowgraphs to match the
+        ``/api/fg/`` family (the plane is process-global, like the trace
+        ring and the doctor)."""
+        import asyncio
+        import json as _json
+
+        from aiohttp import web
+
+        from ..telemetry import profile
+        fg = self._fg(request)
+        if fg is None:
+            return web.json_response({"error": "flowgraph not found"},
+                                     status=404)
+        ensure = bool(request.query.get("costs"))
+        if ensure:
+            snap = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: profile.plane().snapshot(ensure_costs=True))
+        else:
+            # default min_interval: a polling client must not shrink the
+            # gauge window into per-dispatch noise (same guard as /metrics)
+            profile.plane().update_live_gauges()
+            snap = profile.plane().snapshot()
+        return web.json_response(
+            snap, dumps=lambda o: _json.dumps(o, default=str))
 
     async def _describe_block(self, request):
         from aiohttp import web

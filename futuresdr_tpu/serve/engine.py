@@ -54,13 +54,16 @@ import numpy as np
 from ..log import logger
 from ..ops import xfer
 from ..runtime import faults as _faults
+from ..telemetry import profile as _profile
 from ..telemetry import prom as _prom
+from ..telemetry.spans import recorder as _trace_recorder
 from .credits import TenantCreditController
 from .slots import ServeFull, Session, SlotTable
 
 __all__ = ["ServeEngine", "ServeFull", "default_buckets"]
 
 log = logger("serve.engine")
+_trace = _trace_recorder()
 
 # per-tenant Prometheus families (docs/serving.md "Observability"): every
 # family carries {app, tenant} so one scrape separates tenants; label
@@ -229,6 +232,21 @@ class ServeEngine:
         self.dispatches = 0               # steps that launched the program
         self.frames = 0                   # session-frames dispatched
         self._gauge_cache: Dict[tuple, object] = {}
+        # profile plane (telemetry/profile.py): capacities whose first
+        # dispatch (the real jit compile — build_slot_program only wraps)
+        # has been billed as reason="serve_bucket", and the live-roofline
+        # entry whose unit is ONE SESSION-FRAME (lane) — the registered
+        # cost is the single-lane program's cost_analysis(), so vmapped
+        # bucket MFU attributes per lane regardless of the resident bucket
+        pipe, fs = self.pipeline, self.frame_size
+
+        def _lane_cost():
+            from ..utils.roofline import program_cost
+            return program_cost(pipe, fs)
+
+        self._warmed: set = set()
+        self._prof = _profile.register(f"serve:{self.app}",
+                                       cost_thunk=_lane_cost)
 
     # -- carry plumbing --------------------------------------------------------
     def _fresh_carry(self):
@@ -460,6 +478,14 @@ class ServeEngine:
             K = self.k_batch
             fplan = _faults.plan()
             lanes: List[tuple] = []       # (session, popped pending entries)
+            # serving-plane spans (docs/serving.md "Observability"): the
+            # batch assembly is the serving path's encode lane, the program
+            # call its compute lane, the host fetch + per-session fan-back
+            # its D2H/decode lanes — so the doctor's interval-union lanes,
+            # host_codec_overlap_frac and the trace export cover the
+            # serving plane exactly like the streamed path
+            t_step = _trace.now() if _trace.enabled else 0
+            t_enc = t_step
             # idle frame-time ticks (no lane has pending input — the common
             # case for a pump loop ticking at frame rate) must cost nothing:
             # the batch/mask arrays allocate lazily on the first busy lane
@@ -501,12 +527,42 @@ class ServeEngine:
             self.steps += 1
             if not lanes:
                 return 0
+            if t_enc:
+                _trace.complete("tpu", "encode", t_enc,
+                                args={"sessions": len(lanes),
+                                      "capacity": C})
             try:
                 prog = self._program(C)
+                t0 = _trace.now() if _trace.enabled else 0
                 x = xfer.to_device(batch, self.inst.device)
                 act = xfer.to_device(active, self.inst.device)
-                new_carries, outs = prog(self._carries, x, act)
+                if t0:
+                    _trace.complete("tpu", "H2D", t0,
+                                    args={"bytes": batch.nbytes})
+                t0 = _trace.now() if _trace.enabled else 0
+                if C in self._warmed:
+                    new_carries, outs = prog(self._carries, x, act)
+                else:
+                    # a bucket's FIRST dispatch pays its jit compile: bill
+                    # it (fsdr_compiles_total{reason="serve_bucket"}) and
+                    # mark the window active so a slow bucket compile reads
+                    # as "compiling" to the doctor, never as a stalled
+                    # serving loop
+                    with _profile.compiling(f"serve:{self.app}",
+                                            "serve_bucket",
+                                            f"cap={C},k={K},"
+                                            f"frame={self.frame_size}"):
+                        new_carries, outs = prog(self._carries, x, act)
+                    self._warmed.add(C)
+                if t0:
+                    _trace.complete("tpu", "compute", t0,
+                                    args={"capacity": C,
+                                          "active_lanes": len(lanes)})
+                t0 = _trace.now() if _trace.enabled else 0
                 host = [xfer.to_host(o) for o in outs]  # one D2H per sink
+                if t0:
+                    _trace.complete("tpu", "D2H", t0,
+                                    args={"sinks": len(host)})
             except Exception:
                 # dispatch-failure rollback: a real transfer/compile/dispatch
                 # error must not silently drop the popped frames for every
@@ -521,6 +577,7 @@ class ServeEngine:
             self._carries = new_carries
             self.dispatches += 1
             end = time.perf_counter_ns()
+            t_dec = _trace.now() if _trace.enabled else 0
             dispatched = 0
             for s, popped in lanes:
                 for j, (_, t_sub) in enumerate(popped):
@@ -539,6 +596,19 @@ class ServeEngine:
                     dispatched += 1
             self.frames += dispatched
             _DISPATCHES.inc(app=self.app)
+            # live-roofline unit for serving: one SESSION-FRAME (the
+            # registered cost is the single-lane program's); the step
+            # stamps its own group time
+            self._prof.dispatch(dispatched, t=time.monotonic())
+            if t_dec:
+                _trace.complete("tpu", "decode", t_dec,
+                                args={"frames": dispatched})
+            if t_step:
+                _trace.complete("serve", "serve_step", t_step,
+                                args={"sessions": len(lanes),
+                                      "active_lanes": len(lanes),
+                                      "frames": dispatched,
+                                      "capacity": C})
             return dispatched
 
     # -- observability ---------------------------------------------------------

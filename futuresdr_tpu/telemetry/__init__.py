@@ -26,10 +26,11 @@ from .prom import (Counter, Gauge, Histogram, Registry, counter, gauge,
                    histogram, registry)
 from .spans import (SpanEvent, SpanRecorder, chrome_trace, drain, enable,
                     enabled, export, overlap_report, recorder, union_ns)
-from . import doctor  # noqa: E402 — after prom/spans: doctor builds on both
+from . import profile  # noqa: E402 — after prom/spans: the profile plane
+from . import doctor  # noqa: E402 — after profile: doctor reads all three
 
 __all__ = [
-    "spans", "prom", "hist", "doctor",
+    "spans", "prom", "hist", "doctor", "profile",
     "SpanRecorder", "SpanEvent", "recorder", "enable", "enabled", "drain",
     "chrome_trace", "export", "overlap_report", "union_ns",
     "Registry", "Counter", "Gauge", "Histogram", "registry", "counter",

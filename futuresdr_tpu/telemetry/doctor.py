@@ -55,6 +55,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..log import logger
 from . import prom, spans
+from . import profile as _profile
 
 __all__ = [
     "Doctor", "doctor", "enable", "disable", "enabled", "flight_record",
@@ -68,9 +69,11 @@ log = logger("telemetry.doctor")
 LANES = ("encode", "H2D", "compute", "D2H", "decode")
 
 #: every state a watchdog diagnosis can carry (``idle``: a message-plane-only
-#: flowgraph with drained inboxes — waiting for events, not wedged)
+#: flowgraph with drained inboxes — waiting for events, not wedged;
+#: ``compiling``: an XLA compile was in progress or finished inside the
+#: no-progress window — the stall is the compiler's, not a deadlock)
 WATCHDOG_STATES = ("progressing", "backpressured", "starved", "deadlocked",
-                   "idle")
+                   "idle", "compiling")
 
 # always-on histogram families (the metrics plane contract: frame-rate
 # updates, never per-sample) — observation sites bind children once
@@ -305,6 +308,13 @@ class Doctor:
         if self._signal_dump:
             self._signal_dump = False
             self.dump(self.flight_record("SIGUSR1"))
+        try:
+            # live-roofline refresh rides the watchdog cadence: the
+            # fsdr_mfu/fsdr_hbm_util gauges stay fresh whenever the doctor
+            # is armed (scrapes refresh too — ctrl_port /metrics)
+            _profile.plane().update_live_gauges()
+        except Exception as e:                         # noqa: BLE001 — the
+            log.error("profile gauge refresh failed: %r", e)   # dog survives
         with self._lock:
             atts = list(self._fgs.values())
         for att in atts:
@@ -327,23 +337,26 @@ class Doctor:
                 diag = self.diagnose(att)
                 prev_state = (att.diagnosis or {}).get("state")
                 att.diagnosis = diag
-                if diag["state"] != "idle" or prev_state != "idle":
-                    # idle re-fires every window (the re-arm below) but is
-                    # not a stall: count only the TRANSITION, so alerting on
-                    # rate(fsdr_doctor_trips_total) stays meaningful
+                benign = ("idle", "compiling")
+                if diag["state"] not in benign or prev_state != diag["state"]:
+                    # idle/compiling re-fire every window (the re-arm below)
+                    # but are not stalls: count only the TRANSITION, so
+                    # alerting on rate(fsdr_doctor_trips_total) stays
+                    # meaningful
                     _TRIPS.inc(state=diag["state"])
-                if diag["state"] == "idle":
-                    # a quiet message-plane flowgraph is not a wedge: no
-                    # flight record, no escalation — and the window RE-ARMS
+                if diag["state"] in benign:
+                    # a quiet message-plane flowgraph (idle) or an in-window
+                    # XLA compile (compiling) is not a wedge: no flight
+                    # record, no escalation — and the window RE-ARMS
                     # (tripped stays clear), so a later genuine deadlock
-                    # (queued messages a wedged handler never drains, which
-                    # never advances progress) still gets diagnosed, dumped
-                    # and escalated
+                    # (queued messages a wedged handler never drains, or a
+                    # stall that outlives the compile) still gets diagnosed,
+                    # dumped and escalated
                     att.tripped = False
                     att.strikes = 0
-                    if prev_state != "idle":      # first idle verdict only —
-                        log.info("watchdog: fg %d is idle (message-plane, "
-                                 "inboxes drained)", att.key)   # no log spam
+                    if prev_state != diag["state"]:   # first verdict only —
+                        log.info("watchdog: fg %d is %s (%s)", att.key,
+                                 diag["state"], diag.get("detail"))
                     self.last_trip = diag
                     continue
                 log.error("watchdog trip (fg %d): %s — suspect %s via %s",
@@ -391,8 +404,24 @@ class Doctor:
           messages instead classify ``deadlocked`` naming the stuck block
           (progress already samples ``messages_handled``, so a handler that IS
           draining never gets here).
+        * ``compiling``: an XLA compile was in progress (overrides any
+          verdict) or finished inside the no-progress window (downgrades a
+          would-be wedge verdict only — ``idle`` stays ``idle``): the stall
+          is the compiler's, not a deadlock. No flight record; the window
+          re-arms so a stall outliving the compile still escalates.
         """
         window_s = round(att.strikes * self.interval, 3)
+        # compile-aware verdicts (profile plane): an XLA compile IN PROGRESS
+        # explains any silence (a long first compile of a big fused program
+        # used to false-trip as `deadlocked` here); a compile that FINISHED
+        # inside the no-progress window only downgrades a would-be wedge
+        # verdict below — an idle message-plane flowgraph stays `idle` (the
+        # plane is process-global, so a finished compile says nothing about
+        # THIS graph). The window re-arms either way, so a stall that
+        # outlives the compile still gets a real diagnosis.
+        comp = _profile.plane().compiling_or_recent(max(window_s, 1e-9))
+        if comp is not None and comp.get("in_progress"):
+            return self._compiling_diag(att, comp, window_s)
         if not att.edges and not any(
                 getattr(b.kernel, "stream_inputs", ()) or
                 getattr(b.kernel, "stream_outputs", ())
@@ -406,6 +435,9 @@ class Doctor:
                 if n:
                     queued[b.instance_name] = n
             if queued:
+                if comp is not None:
+                    # the handler's thread may BE the one compiling
+                    return self._compiling_diag(att, comp, window_s)
                 worst = max(queued, key=queued.get)
                 return self._diag(
                     "deadlocked", att, None, suspect=worst,
@@ -416,6 +448,10 @@ class Doctor:
                 "idle", att, None, suspect=None, window_s=window_s,
                 detail="message-plane flowgraph with drained inboxes — "
                        "waiting for events, not wedged")
+        if comp is not None:
+            # a compile that finished inside the no-progress window explains
+            # (part of) the silence — downgrade the would-be wedge verdict
+            return self._compiling_diag(att, comp, window_s)
         full = [e for e in att.edges if _edge_full(e[0], e[1])]
         if full:
             full_src = {id(e[0]) for e in full}
@@ -440,6 +476,17 @@ class Doctor:
                           window_s=window_s,
                           detail="no progress, no full or starving ring — "
                                  "see thread stacks in the flight record")
+
+    def _compiling_diag(self, att: _Attached, comp: dict, window_s: float):
+        state = ("in progress" if comp.get("in_progress")
+                 else f"finished {comp.get('seconds', 0)}s compile")
+        return self._diag(
+            "compiling", att, None, suspect=comp.get("program"),
+            window_s=window_s,
+            detail=f"XLA compile of {comp.get('program')} "
+                   f"({comp.get('reason')}, "
+                   f"sig {comp.get('signature') or '?'}) {state} inside "
+                   f"the no-progress window — not a deadlock")
 
     @staticmethod
     def _diag(state: str, att: _Attached, edge, suspect, window_s, detail):
@@ -502,6 +549,7 @@ class Doctor:
                 "cat": e.cat, "name": e.name, "args": e.args})
         e2e = {f"p{int(q * 100)}_s": E2E_LATENCY.quantile(q)
                for q in (0.5, 0.95, 0.99)}
+        prof = _profile.plane()
         report = {
             "reason": reason,
             "unix_time": time.time(),
@@ -510,6 +558,13 @@ class Doctor:
             "spans": {k: v[-max_spans:] for k, v in ring.items()},
             "span_drops": rec.dropped,
             "e2e_latency": e2e if e2e.get("p50_s") is not None else None,
+            # compile observability (telemetry/profile.py): active compiles
+            # + storm classification ride every flight record — "why is it
+            # silent" and "what churned" answer from one dump (cost thunks
+            # are NOT materialized here; a flight record must never compile)
+            "profile": {"active_compiles": prof.active_compiles(),
+                        "compiles_total": prof.compiles_total,
+                        "storms": prof.storm_report() or None},
             "metrics": prom.registry().render(),
         }
         if extra is not None:
@@ -631,16 +686,47 @@ class Doctor:
         # currently pinned/pooled bytes — steady state shows misses flat and
         # hits climbing once the in-flight window's buffers warmed up
         from ..ops.arena import arena_stats
+        # live roofline attribution (telemetry/profile.py): refresh the
+        # windowed gauges, then merge each program's hbm/compute-bound
+        # classification into the lane verdict — the bottleneck names the
+        # binding RESOURCE, not just the busiest lane
+        prof = _profile.plane()
+        try:
+            # default min_interval: a client polling the doctor endpoint
+            # must not shrink the gauge window into per-dispatch noise
+            prof.update_live_gauges()
+        except Exception:                              # noqa: BLE001
+            pass
+        roofline = prof.roofline_report()
+        resource = None
+        if bottleneck is not None:
+            if bottleneck in ("H2D", "D2H"):
+                resource = "link"
+            elif bottleneck in ("encode", "decode") or \
+                    bottleneck.startswith("work:"):
+                resource = "host"
+            elif bottleneck == "compute":
+                # the compute lane is bound by whatever resource its
+                # dominant program sits on: the roofline classification of
+                # the program with the most dispatched units (fallback:
+                # "device" when no program registered a cost)
+                progs = [(v.get("units", 0), v.get("bound"))
+                         for v in roofline["programs"].values()
+                         if v.get("bound")]
+                resource = max(progs)[1] if progs else "device"
         return {
             "wall_s": wall / 1e9,
             "lanes": lanes,
             "blocks": work,
             "bottleneck_lane": bottleneck,
             "bottleneck_busy_frac": round(frac, 4),
+            "bottleneck_resource": resource,
             "host_codec_overlap_frac": round(codec_frac, 4),
             "arena": arena_stats(),
             "e2e_latency": e2e if e2e.get("p50_s") is not None else None,
             "devchain": devchains or None,
+            "roofline": roofline,
+            "compile_storms": prof.storm_report() or None,
         }
 
 

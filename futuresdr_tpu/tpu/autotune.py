@@ -28,6 +28,7 @@ import numpy as np
 from ..log import logger
 from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
+from ..telemetry import profile as _profile
 from .instance import TpuInstance, instance
 
 __all__ = ["autotune", "autotune_streamed", "autotune_serve",
@@ -55,9 +56,12 @@ def _measure(pipe: Pipeline, frame: int, depth: int, inst: TpuInstance,
     """Msamples/s through the pipeline incl. H2D staging and D2H sync."""
     fn, carry = pipe.compile(frame, device=inst.device)
     host = np.zeros(frame, dtype=pipe.in_dtype)
-    # warmup (compile)
-    carry, y = fn(carry, inst.put(host))
-    inst.get(y)
+    # warmup (compile) — billed reason="autotune" so a tuning sweep's
+    # compiles never read as a recompile storm (telemetry/profile.py)
+    with _profile.compiling("autotune", "autotune",
+                            f"frame={frame},depth={depth}"):
+        carry, y = fn(carry, inst.put(host))
+        inst.get(y)
     inflight = []
     n_frames = 0
     t0 = time.perf_counter()
@@ -208,8 +212,11 @@ def _measure_wired(pipe: Pipeline, wire, frame: int, depth: int,
     import jax
     dev = tuple(jax.device_put(np.asarray(p), inst.device)
                 for p in encode_group())
-    carry, y = fn(carry, *dev)              # warmup compile off the clock
-    jax.block_until_ready(y)
+    # warmup compile off the clock, billed reason="autotune" (never a storm)
+    with _profile.compiling("autotune", "autotune",
+                            f"wire={wire.name},frame={frame},k={k}"):
+        carry, y = fn(carry, *dev)
+        jax.block_until_ready(y)
     staged: deque = deque()
     inflight: deque = deque()
     n_frames = 0
@@ -552,8 +559,10 @@ def autotune_serve(pipeline, frame_size: Optional[int] = None,
         x = xfer.to_device(np.zeros((cap, fs), dtype=pipeline.in_dtype),
                            inst.device)
         act = xfer.to_device(np.ones((cap,), dtype=bool), inst.device)
-        carries, outs = prog(carries, x, act)      # warmup/compile
-        jax.block_until_ready(outs)
+        with _profile.compiling("autotune", "autotune",
+                                f"serve_cap={cap},frame={fs}"):
+            carries, outs = prog(carries, x, act)  # warmup/compile
+            jax.block_until_ready(outs)
         t0 = time.perf_counter()
         for _ in range(reps):
             carries, outs = prog(carries, x, act)

@@ -65,6 +65,7 @@ from ..ops import arena as _arena_mod
 from ..ops import codec_pool as _codec_mod
 from ..ops import xfer
 from ..ops.stages import Pipeline, Stage
+from ..telemetry import profile as _profile
 from ..telemetry import prom as _prom
 from ..telemetry.doctor import E2E_LATENCY as _E2E_LATENCY
 from ..telemetry.spans import recorder as _trace_recorder
@@ -320,6 +321,7 @@ class TpuKernel(Kernel):
         self._inflight: Deque[tuple] = deque()
         self._init_recovery_state(checkpoint_every)
         self._e2e_hist = None         # bound at init (instance name is final)
+        self._prof = None             # profile-plane entry, bound at init
         self._pending_out: Optional[np.ndarray] = None
         self._pending_tags: List[ItemTag] = []
         self._frames_dispatched = 0
@@ -453,26 +455,49 @@ class TpuKernel(Kernel):
         self._pending_tags = []
         self._recovery_reset()
         self._ckpt_every = self._resolve_ckpt_every()
+        prog_name = self.meta.instance_name or type(self).__name__
         self._e2e_hist = _E2E_LATENCY.labels(
             source=self.meta.instance_name or "TpuKernel")
-        self._compiled, self._carry = self.pipeline.compile_wired(
-            self.frame_size, self.wire, device=self.inst.device,
-            k=self.k_batch, donate=self._donate)
-        # warm the compile cache off the hot path (raw device_put: the fake
-        # link must not bill warmup bytes), then reset the carry state
-        parts = self.wire.encode_host(
-            np.zeros(self.frame_size, dtype=self.pipeline.in_dtype))
-        if self.k_batch > 1:
-            parts = tuple(np.stack([np.asarray(p)] * self.k_batch)
-                          for p in parts)
-        dev = tuple(jax.device_put(np.asarray(p), self.inst.device)
-                    for p in parts)
-        warm_carry, y = self._compiled(self._carry, *dev)
-        jax.block_until_ready(y)
+        # compile observability (telemetry/profile.py): the whole
+        # compile+warm window is billed (fsdr_compiles_total{program,reason}
+        # + fsdr_compile_seconds) and visible to the doctor's "compiling"
+        # verdict — a long first compile of a big fused devchain must never
+        # false-trip the watchdog as a deadlock. First init is `warmup`;
+        # a restart's fresh re-init is `reinit` (storm-detection signal).
+        reason = "warmup" if self._compiled is None else "reinit"
+        prog_sig = (f"frame={self.frame_size},wire={self.wire.name},"
+                    f"k={self.k_batch}")
+        with _profile.compiling(prog_name, reason, prog_sig):
+            self._compiled, self._carry = self.pipeline.compile_wired(
+                self.frame_size, self.wire, device=self.inst.device,
+                k=self.k_batch, donate=self._donate)
+            # warm the compile cache off the hot path (raw device_put: the
+            # fake link must not bill warmup bytes), then reset carry state
+            parts = self.wire.encode_host(
+                np.zeros(self.frame_size, dtype=self.pipeline.in_dtype))
+            if self.k_batch > 1:
+                parts = tuple(np.stack([np.asarray(p)] * self.k_batch)
+                              for p in parts)
+            dev = tuple(jax.device_put(np.asarray(p), self.inst.device)
+                        for p in parts)
+            warm_carry, y = self._compiled(self._carry, *dev)
+            jax.block_until_ready(y)
         del warm_carry  # donated buffers; fresh carry below
         _, self._carry = self.pipeline.compile_wired(
             self.frame_size, self.wire, device=self.inst.device,
             k=self.k_batch, donate=self._donate)
+        # roofline attribution: register the DISPATCHED program form's
+        # cost_analysis() flops/bytes (wired + megabatch scan) — lazily, so
+        # init pays nothing; the cost-analysis AOT compile happens once per
+        # signature when the profile plane is actually read (ensure_costs)
+        pipe, fs, wn, kb = self.pipeline, self.frame_size, self.wire.name, \
+            self.k_batch
+
+        def _program_cost():
+            from ..utils.roofline import program_cost
+            return program_cost(pipe, fs, wire=wn, k=kb)
+
+        self._prof = _profile.register(prog_name, cost_thunk=_program_cost)
         if self._ckpt_every:
             # fresh-init sentinel: "restore = recompile the init carry" — a
             # fault before the first committed checkpoint replays from the
@@ -898,6 +923,12 @@ class TpuKernel(Kernel):
             self._checkpoint_tick(seq)
             self._frames_dispatched += len(metas)
             self._dispatches += 1
+            if self._prof is not None:
+                # live-roofline unit: ONE dispatch group (the registered
+                # cost covers the whole wired megabatch program); the
+                # group stamp is this drive loop's clock to pay, keeping
+                # the per-call hook itself a bare add
+                self._prof.dispatch(t=time.monotonic())
             self._credits.note_dispatch(getattr(h2d, "_wire", None),
                                         len(self._inflight))
         if self._staged and len(self._inflight) >= self._credits.credits:
@@ -1344,11 +1375,17 @@ class TpuKernel(Kernel):
         # insert out of band) before it is read as the recovery source
         self._settle_staged()
         # integrity template: the pipeline's OWN fresh carry for this compile
-        # (cached jit — no recompilation); also re-resolves self._compiled if
-        # the failed incarnation never finished init
-        self._compiled, fresh = self.pipeline.compile_wired(
-            self.frame_size, self.wire, device=self.inst.device,
-            k=self.k_batch, donate=self._donate)
+        # (cached jit — usually no recompilation; a failed incarnation that
+        # never finished init recompiles here). Billed as reason="recover"
+        # either way — the profile plane's storm detector and the doctor's
+        # "compiling" verdict both want recovery re-resolves attributed.
+        with _profile.compiling(
+                self.meta.instance_name or type(self).__name__, "recover",
+                f"frame={self.frame_size},wire={self.wire.name},"
+                f"k={self.k_batch}"):
+            self._compiled, fresh = self.pipeline.compile_wired(
+                self.frame_size, self.wire, device=self.inst.device,
+                k=self.k_batch, donate=self._donate)
         if self._seq == 0 and not self._rlog and self._ckpt_dir:
             # VIRGIN incarnation (nothing dispatched, nothing to replay):
             # the only meaningful state is a previous PROCESS's persisted

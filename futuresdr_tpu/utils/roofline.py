@@ -8,40 +8,243 @@ HLO that runs), so they track fusion decisions instead of a paper formula.
 Caveat: the analysis is per-backend — a CPU-compiled pipeline fuses differently
 than the TPU one, so artifacts must carry the backend they were derived on.
 
-Peak table: the only figures used are the PUBLIC v5e chip specs (197e12 bf16
-FLOP/s, 819e9 B/s HBM) — MFU is reported against the bf16 matmul peak, the
-standard MFU convention. There is no official f32 peak; f32 matmuls lower to
-multiple bf16 passes, so the same denominator is used and f32 chains simply
-show proportionally lower MFU.
+Peaks: :func:`detect_peaks` resolves the denominator for MFU/HBM-utilization
+claims in three layers — explicit config overrides (``peak_flops`` in FLOP/s,
+``peak_hbm_gbps`` in GB/s), then the LIVE chip kind from
+``jax.devices()[0].device_kind`` against the public per-chip spec table
+(:data:`CHIP_PEAKS`, bf16 matmul peaks — the standard MFU convention; there is
+no official f32 peak, f32 matmuls lower to multiple bf16 passes so f32 chains
+simply show proportionally lower MFU), and finally the historical
+backend-label mapping (:data:`PEAKS` — "tpu"/"axon" are the tunnel's v5 lite
+chip) for callers naming a backend without a live device to interrogate. An
+UNKNOWN live accelerator returns None: flops/bytes-only output, never an MFU
+against the wrong denominator.
+
+Cost records are cached **by program signature** (:data:`_cost_cache`):
+``cost_of`` pays its AOT ``jax.jit(fn).lower().compile()`` once per signature
+per process, so bench roofline accounting and the profile plane's program
+registration (``telemetry/profile.py``) stop double-compiling programs the
+pipeline's own jit cache already holds.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["cost_of", "pipeline_roofline", "PEAKS"]
+__all__ = ["cost_of", "pipeline_roofline", "graph_roofline", "program_cost",
+           "detect_peaks", "PEAKS", "CHIP_PEAKS"]
 
-# public chip specs (per chip). "tpu" maps the tunneled TPU v5 lite to v5e;
-# "axon" is the tunnel plugin's own platform name for the same chip.
-PEAKS = {
-    "tpu": {"flops": 197e12, "hbm_bytes": 819e9},     # v5e, bf16 matmul peak
+# public per-chip specs (per chip, bf16 matmul peak FLOP/s + HBM B/s)
+CHIP_PEAKS = {
+    "v2": {"flops": 45e12, "hbm_bytes": 700e9},
+    "v3": {"flops": 123e12, "hbm_bytes": 900e9},
+    "v4": {"flops": 275e12, "hbm_bytes": 1228e9},
+    "v5e": {"flops": 197e12, "hbm_bytes": 819e9},
+    "v5p": {"flops": 459e12, "hbm_bytes": 2765e9},
+    "v6e": {"flops": 918e12, "hbm_bytes": 1640e9},
 }
+
+# historical backend-label mapping: "tpu" maps the tunneled TPU v5 lite to
+# v5e; "axon" is the tunnel plugin's own platform name for the same chip.
+PEAKS = {"tpu": dict(CHIP_PEAKS["v5e"])}
 PEAKS["axon"] = PEAKS["tpu"]
 
 
-def cost_of(fn, *args) -> dict:
-    """flops + bytes accessed of ``jit(fn)(*args)`` from XLA's cost analysis."""
-    import jax
+def _kind_to_chip(kind: str) -> Optional[str]:
+    """Map a ``device_kind`` string to a :data:`CHIP_PEAKS` key (None =
+    unknown). Kind strings vary by runtime version ("TPU v5 lite",
+    "TPU v5e", "tpu_v5_lite", …) — match on the version token."""
+    k = str(kind).lower().replace("_", " ")
+    if "v5p" in k:
+        return "v5p"
+    if "v5" in k and ("lite" in k or "v5e" in k):
+        return "v5e"
+    if "v6" in k:
+        return "v6e"
+    if "v4" in k:
+        return "v4"
+    if "v3" in k:
+        return "v3"
+    if "v2" in k:
+        return "v2"
+    return None
 
-    comp = jax.jit(fn).lower(*args).compile()
-    ca = comp.cost_analysis()
+
+def detect_peaks(backend: Optional[str] = None) -> Optional[dict]:
+    """Resolve ``{"flops", "hbm_bytes", "chip"}`` for MFU accounting.
+
+    Layering (module docstring): both config overrides set → pure-config
+    peaks; a live TPU device → its ``device_kind`` against the public table
+    (single-axis overrides still apply; an unknown kind returns None —
+    degrade, don't guess); else the ``backend`` LABEL against the historical
+    :data:`PEAKS` mapping. None disables MFU/HBM-util output entirely."""
+    from ..config import config
+    c = config()
+    try:
+        pf = float(c.get("peak_flops", 0) or 0)
+    except (TypeError, ValueError):
+        pf = 0.0
+    try:
+        pb = float(c.get("peak_hbm_gbps", 0) or 0)
+    except (TypeError, ValueError):
+        pb = 0.0
+    if pf > 0 and pb > 0:
+        return {"flops": pf, "hbm_bytes": pb * 1e9, "chip": "config"}
+
+    def _overridden(p: dict, chip: str) -> dict:
+        out = dict(p)
+        out["chip"] = chip
+        if pf > 0:
+            out["flops"] = pf
+        if pb > 0:
+            out["hbm_bytes"] = pb * 1e9
+        return out
+
+    try:
+        import jax
+        dev = jax.devices()[0]
+        if dev.platform != "cpu":
+            chip = _kind_to_chip(getattr(dev, "device_kind", "") or "")
+            if chip is None:
+                # unknown LIVE accelerator: flops/bytes only, even when the
+                # backend LABEL would map — the live device IS the chip
+                # being measured, and the label mapping is an offline-
+                # analysis convention for CPU hosts. Pin the denominator on
+                # an unknown chip with peak_flops/peak_hbm_gbps instead.
+                return None
+            return _overridden(CHIP_PEAKS[chip], chip)
+    except Exception:                                   # noqa: BLE001 — peak
+        pass                                            # lookup is best-effort
+    p = PEAKS.get(str(backend or ""))
+    if p is not None:
+        return _overridden(p, "v5e")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# cost analysis (signature-cached)
+# ---------------------------------------------------------------------------
+
+#: ``signature -> {"flops", "bytes"}`` — one AOT cost-analysis compile per
+#: signature per process (bench prefix sweeps, kernel registrations and the
+#: profile plane's ensure_costs all share it)
+_cost_cache: Dict[tuple, dict] = {}
+
+
+def cost_of(fn, *args, signature: Optional[tuple] = None,
+            compiled=None) -> dict:
+    """flops + bytes accessed of ``jit(fn)(*args)`` from XLA's cost analysis.
+
+    ``signature`` (hashable) memoizes the record — the second ask for the
+    same program is free. ``compiled`` reuses an ALREADY-compiled executable
+    (anything with ``cost_analysis()``) instead of paying the AOT
+    ``jax.jit(fn).lower().compile()`` second copy. An actual AOT compile is
+    billed to the profile plane as ``reason="cost"`` (visible to the
+    doctor's "compiling" verdict; excluded from storm detection — each
+    signature compiles at most once per process by construction)."""
+    if signature is not None:
+        hit = _cost_cache.get(signature)
+        if hit is not None:
+            return dict(hit)
+    if compiled is None:
+        import jax
+
+        from ..telemetry import profile as _profile
+        with _profile.compiling("cost_analysis", "cost",
+                                str(signature or "?")):
+            compiled = jax.jit(fn).lower(*args).compile()
+    ca = compiled.cost_analysis()
     if isinstance(ca, (list, tuple)):
         ca = ca[0]
-    return {"flops": float(ca.get("flops", 0.0)),
-            "bytes": float(ca.get("bytes accessed", 0.0))}
+    out = {"flops": float(ca.get("flops", 0.0)),
+           "bytes": float(ca.get("bytes accessed", 0.0))}
+    if signature is not None:
+        _cost_cache[signature] = dict(out)
+    return dict(out)
 
+
+def _stage_marker(s) -> tuple:
+    """A structural fingerprint of one stage for cost-cache keys. Names
+    alone are NOT enough — two ``fir_stage``s with different tap counts or
+    decimation share ``name="fir"`` but compile to different-cost programs.
+    Ratio, out dtype, frame multiple and the LTI config (tap count, decim,
+    fft length, impl) disambiguate every structural cost determinant;
+    carry-resident parameters (retunable without recompile) by construction
+    cannot change the program's cost."""
+    lti = getattr(s, "lti", None)
+    lti_m = None
+    if lti is not None:
+        taps, decim, fft_len, impl = lti
+        lti_m = (int(np.asarray(taps).size), int(decim), int(fft_len),
+                 str(impl))
+    return (str(getattr(s, "name", "?")), str(getattr(s, "ratio", "")),
+            str(getattr(s, "out_dtype", None)),
+            int(getattr(s, "frame_multiple", 1) or 1), lti_m,
+            # MergeStage extras (None for plain stages): input count + mode
+            getattr(s, "k", None), getattr(s, "mode", None))
+
+
+def _host_frame(in_dtype, frame: int) -> np.ndarray:
+    rng = np.random.default_rng(0)
+    if np.issubdtype(np.dtype(in_dtype), np.complexfloating):
+        return (rng.standard_normal(frame)
+                + 1j * rng.standard_normal(frame)).astype(in_dtype)
+    return rng.standard_normal(frame).astype(in_dtype)
+
+
+def program_cost(pipeline, frame: int, wire=None, k: int = 1) -> dict:
+    """Per-DISPATCH flops/bytes of a pipeline's compiled program FORM.
+
+    ``wire=None`` analyzes the bare ``(carry, frame) -> (carry, out)``
+    program; a wire name analyzes the WIRED form (decode prolog + encode
+    epilog fused in) and ``k > 1`` the megabatch ``lax.scan`` form — exactly
+    the program ``TpuKernel`` dispatches, so the profile plane's live MFU is
+    charged for the HLO that actually runs. Cached by signature (pipeline
+    shape + topology + dtype + frame + wire + k + backend)."""
+    import jax
+
+    from ..ops.stages import DagPipeline, FanoutPipeline
+    markers = tuple(_stage_marker(s) for s in pipeline.stages)
+    # flat markers alone cannot distinguish two graphs with the same stage
+    # multiset (a diamond vs a chain of the same nodes, or a fan-out split
+    # at a different producer boundary) — the edge structure changes the
+    # compiled program's cost, so it must be part of the cache key. The
+    # node lengths partition the MERGED flat ``stages`` list the markers
+    # were taken from, so (markers, topo) fully determines the program.
+    topo: Optional[tuple] = None
+    if isinstance(pipeline, DagPipeline):
+        topo = ("dag", tuple((len(sl), tuple(inputs))
+                             for sl, inputs, _off in pipeline._nodes))
+    elif isinstance(pipeline, FanoutPipeline):
+        topo = ("fanout", len(pipeline.producer.stages),
+                tuple(len(b.stages) for b in pipeline.branches))
+    in_dt = np.dtype(pipeline.in_dtype)
+    wire_name = None
+    if wire is not None:
+        from ..ops.wire import get_wire
+        wire = get_wire(wire)
+        wire_name = wire.name
+    sig = ("program", jax.default_backend(), type(pipeline).__name__,
+           str(in_dt), int(frame), wire_name, int(k), markers, topo)
+    hit = _cost_cache.get(sig)
+    if hit is not None:
+        return dict(hit)
+    carry = pipeline.init_carry()
+    host = np.zeros(frame, dtype=in_dt)
+    if wire is None:
+        return cost_of(pipeline.fn(), carry, host, signature=sig)
+    parts = wire.encode_host(host)
+    if k > 1:
+        parts = tuple(np.stack([np.asarray(p)] * int(k)) for p in parts)
+    return cost_of(pipeline.wired_fn(wire, int(k)), carry,
+                   *[np.asarray(p) for p in parts], signature=sig)
+
+
+# ---------------------------------------------------------------------------
+# per-stage / per-node attribution
+# ---------------------------------------------------------------------------
 
 def pipeline_roofline(stages: Sequence, in_dtype, frame: int,
                       rate_sps: Optional[float] = None,
@@ -52,25 +255,23 @@ def pipeline_roofline(stages: Sequence, in_dtype, frame: int,
     cost(stages[:k+1]) − cost(stages[:k])), so each stage is charged exactly
     what adding it to the fused program costs — fusion across the boundary
     lands on the stage that triggered it. With ``rate_sps`` the achieved
-    FLOP/s, bandwidth, and (for TPU) MFU vs the public bf16 peak are filled in.
-    """
-    import jax
-
+    FLOP/s, bandwidth, and (when :func:`detect_peaks` knows the chip) MFU
+    are filled in. Prefix costs are signature-cached, so a repeated bench
+    run (or a profile-plane registration of the full chain) compiles each
+    prefix once per process."""
     from ..ops.stages import Pipeline
 
     out = {"frame": frame, "backend": backend, "stages": []}
     prev = {"flops": 0.0, "bytes": 0.0}
-    rng = np.random.default_rng(0)
-    if np.issubdtype(np.dtype(in_dtype), np.complexfloating):
-        host = (rng.standard_normal(frame)
-                + 1j * rng.standard_normal(frame)).astype(in_dtype)
-    else:
-        host = rng.standard_normal(frame).astype(in_dtype)
+    host = _host_frame(in_dtype, frame)
+    dt = str(np.dtype(in_dtype))
+    markers = tuple(_stage_marker(s) for s in stages)
 
     for k in range(1, len(stages) + 1):
         pipe = Pipeline(list(stages[:k]), in_dtype)
         carry = pipe.init_carry()
-        cost = cost_of(pipe.fn(), carry, host)
+        sig = ("prefix", backend, dt, int(frame), markers[:k])
+        cost = cost_of(pipe.fn(), carry, host, signature=sig)
         out["stages"].append({
             "name": stages[k - 1].name,
             "flops_per_sample": (cost["flops"] - prev["flops"]) / frame,
@@ -79,11 +280,84 @@ def pipeline_roofline(stages: Sequence, in_dtype, frame: int,
         prev = cost
     out["flops_per_sample"] = prev["flops"] / frame
     out["bytes_per_sample"] = prev["bytes"] / frame
-    ridge = None
-    peak = PEAKS.get(backend)
+    _finish_roofline(out, out["stages"], rate_sps, backend)
+    return out
+
+
+def graph_roofline(pipeline, frame: Optional[int] = None,
+                   rate_sps: Optional[float] = None,
+                   backend: str = "cpu") -> dict:
+    """Per-NODE roofline attribution for fan-out / general-DAG pipelines.
+
+    The prefix-difference math of :func:`pipeline_roofline` generalized to
+    DAGs: node i's cost = cost(DAG truncated to nodes[:i+1]) − cost(nodes[:i])
+    (node lists are topological, so every prefix is a valid sub-DAG; a
+    truncated prefix's extra sink materializations mirror the linear prefix
+    caveat). Accepts a :class:`~futuresdr_tpu.ops.stages.DagPipeline`, a
+    :class:`~futuresdr_tpu.ops.stages.FanoutPipeline` (viewed as producer
+    node + one node per branch), or a plain
+    :class:`~futuresdr_tpu.ops.stages.Pipeline` (delegates to the per-stage
+    form, re-keyed under ``nodes``). Per-sample numbers are per REGION-INPUT
+    sample."""
+    from ..ops.stages import DagPipeline, FanoutPipeline, Pipeline
+
+    if isinstance(pipeline, Pipeline):
+        out = pipeline_roofline(pipeline.stages, pipeline.in_dtype,
+                                frame or pipeline.frame_multiple,
+                                rate_sps, backend)
+        out["nodes"] = [dict(s, inputs=([] if i == 0 else [i - 1]))
+                        for i, s in enumerate(out["stages"])]
+        return out
+    if isinstance(pipeline, FanoutPipeline):
+        nodes = [(list(pipeline.producer.stages), [])]
+        nodes += [(list(b.stages), [0]) for b in pipeline.branches]
+        in_dtype = pipeline.in_dtype
+    elif isinstance(pipeline, DagPipeline):
+        nodes = [(list(sl), list(inputs))
+                 for sl, inputs in pipeline.raw_nodes]
+        in_dtype = pipeline.in_dtype
+    else:
+        raise TypeError(f"graph_roofline: unsupported pipeline type "
+                        f"{type(pipeline).__name__}")
+    fm = pipeline.frame_multiple
+    frame = frame or fm
+    frame = max(fm, (int(frame) // fm) * fm)
+    host = _host_frame(in_dtype, frame)
+    dt = str(np.dtype(in_dtype))
+    node_names = tuple(
+        ("+".join(str(getattr(s, "name", "?")) for s in sl) or "passthrough",
+         tuple(inputs)) for sl, inputs in nodes)
+    node_markers = tuple(
+        (tuple(_stage_marker(s) for s in sl), tuple(inputs))
+        for sl, inputs in nodes)
+
+    out = {"frame": frame, "backend": backend, "nodes": []}
+    prev = {"flops": 0.0, "bytes": 0.0}
+    for i in range(1, len(nodes) + 1):
+        sub = DagPipeline(nodes[:i], in_dtype)
+        sig = ("dag-prefix", backend, dt, frame, node_markers[:i])
+        cost = cost_of(sub.fn(), sub.init_carry(), host, signature=sig)
+        name, inputs = node_names[i - 1]
+        out["nodes"].append({
+            "name": name,
+            "inputs": list(inputs),
+            "flops_per_sample": (cost["flops"] - prev["flops"]) / frame,
+            "bytes_per_sample": (cost["bytes"] - prev["bytes"]) / frame,
+        })
+        prev = cost
+    out["flops_per_sample"] = prev["flops"] / frame
+    out["bytes_per_sample"] = prev["bytes"] / frame
+    _finish_roofline(out, out["nodes"], rate_sps, backend)
+    return out
+
+
+def _finish_roofline(out: dict, entries, rate_sps, backend: str) -> None:
+    """Shared tail of the per-stage/per-node walks: bound classification
+    against the detected chip ridge + achieved-rate fields."""
+    peak = detect_peaks(backend)
     if peak:
-        ridge = peak["flops"] / peak["hbm_bytes"]      # flop/byte ridge point
-        for s in out["stages"]:
+        ridge = peak["flops"] / peak["hbm_bytes"]     # flop/byte ridge point
+        for s in entries:
             ai = s["flops_per_sample"] / max(s["bytes_per_sample"], 1e-12)
             s["arith_intensity"] = ai
             s["bound"] = "hbm" if ai < ridge else "compute"
@@ -93,4 +367,3 @@ def pipeline_roofline(stages: Sequence, in_dtype, frame: int,
         if peak:
             out["mfu"] = out["achieved_flops"] / peak["flops"]
             out["hbm_util"] = out["achieved_bw_bytes"] / peak["hbm_bytes"]
-    return out

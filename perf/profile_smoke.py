@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Profile-plane smoke (check.sh gate, docs/observability.md "The profile
+plane"): a warmed streamed run makes ZERO steady-state recompiles and the
+live MFU stamp is present.
+
+Two assertions, both on the REAL planes:
+
+* **Compile accounting.** A streamed ``TpuKernel`` run of N frames bills
+  exactly ONE ``fsdr_compiles_total{reason="warmup"}`` for the kernel's
+  program and nothing else — N dispatches after warmup add zero compile
+  records (a mid-run shape churn would bill more and trip the storm
+  detector). The serving engine likewise bills one ``serve_bucket`` compile
+  per RESIDENT slot bucket, never per step.
+* **Live roofline.** With the ``peak_flops``/``peak_hbm_gbps`` config
+  overrides pinned (the CPU backend has no public peak — this exercises the
+  override path of ``utils/roofline.detect_peaks``), the profile snapshot
+  carries a positive run-average ``mfu`` for the streamed program.
+
+Run: ``JAX_PLATFORMS=cpu python perf/profile_smoke.py --smoke``
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true",
+                   help="run the check.sh smoke (small sizes, hard asserts)")
+    p.add_argument("--frames", type=int, default=24)
+    args = p.parse_args()
+
+    from futuresdr_tpu import Flowgraph, Runtime
+    from futuresdr_tpu.blocks import Head, NullSink, NullSource
+    from futuresdr_tpu.config import config
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import fir_stage, mag2_stage
+    from futuresdr_tpu.telemetry import profile
+    from futuresdr_tpu.tpu import TpuKernel
+
+    # pin the MFU denominator: the CPU backend has no public peak, and the
+    # smoke must exercise the config-override path either way
+    c = config()
+    c.peak_flops = 1e12
+    c.peak_hbm_gbps = 100.0
+
+    frame = 1 << 14
+    n = args.frames * frame
+    c.buffer_size = max(c.buffer_size, 4 * frame * 8)
+    fg = Flowgraph()
+    src = NullSource(np.complex64)
+    head = Head(np.complex64, n)
+    taps = firdes.lowpass(0.2, 64).astype(np.float32)
+    tk = TpuKernel([fir_stage(taps), mag2_stage()], np.complex64,
+                   frame_size=frame, frames_in_flight=4)
+    snk = NullSink(np.float32)
+    fg.connect(src, head, tk, snk)
+    Runtime().run(fg)
+
+    prog = tk.meta.instance_name or "TpuKernel"
+    warm = profile.COMPILES.get(program=prog, reason="warmup")
+    reinit = profile.COMPILES.get(program=prog, reason="reinit")
+    recover = profile.COMPILES.get(program=prog, reason="recover")
+    dispatches = tk._dispatches
+    print(f"# streamed {prog}: {dispatches} dispatches, compiles "
+          f"warmup={warm:.0f} reinit={reinit:.0f} recover={recover:.0f}")
+    assert dispatches >= args.frames // 2, \
+        f"streamed run too short to judge steady state ({dispatches})"
+    assert warm == 1, f"expected exactly one warmup compile, got {warm}"
+    assert reinit == 0 and recover == 0, \
+        "steady-state streamed run must not recompile " \
+        f"(reinit={reinit}, recover={recover})"
+    assert not profile.plane().storm_report(), \
+        f"storm detector fired: {profile.plane().storm_report()}"
+
+    # live MFU stamp: materialize the registered cost (one cached
+    # cost-analysis compile) and read the run average
+    snap = profile.plane().snapshot(ensure_costs=True)
+    entry = snap["roofline"]["programs"].get(prog) or {}
+    mfu = entry.get("mfu_avg")
+    print(f"# live roofline {prog}: units={entry.get('units')} "
+          f"mfu_avg={mfu} hbm_util_avg={entry.get('hbm_util_avg')} "
+          f"bound={entry.get('bound')}")
+    assert mfu is not None and mfu > 0, \
+        f"live mfu stamp missing from the profile snapshot: {entry}"
+    assert entry.get("bound") in ("hbm", "compute"), entry
+
+    # serving plane: bucket compiles bill once per RESIDENT bucket, never
+    # per step (the zero-churn-recompile serving contract, now auditable
+    # from fsdr_compiles_total)
+    from futuresdr_tpu.ops.stages import Pipeline
+    from futuresdr_tpu.serve.engine import ServeEngine
+    eng = ServeEngine(Pipeline([fir_stage(taps), mag2_stage()], np.complex64),
+                      frame_size=1 << 12, app="profile-smoke",
+                      buckets=(2, 4))
+    sids = [eng.admit(tenant="t").sid for _ in range(2)]
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal(1 << 12)
+         + 1j * rng.standard_normal(1 << 12)).astype(np.complex64)
+    steps = 10
+    for _ in range(steps):
+        for sid in sids:
+            eng.submit(sid, x)
+        eng.step()
+    sb = profile.COMPILES.get(program="serve:profile-smoke",
+                              reason="serve_bucket")
+    print(f"# serve: {eng.dispatches} dispatches over {steps} steps, "
+          f"{sb:.0f} bucket compiles (resident: {sorted(eng._programs)})")
+    assert eng.dispatches == steps
+    assert sb == len(eng._programs) == 1, \
+        f"serve bucket compiles must bill once per resident bucket " \
+        f"({sb} vs {len(eng._programs)})"
+
+    print("PROFILE_SMOKE OK: zero steady-state recompiles, live mfu stamped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

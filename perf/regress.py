@@ -50,7 +50,11 @@ FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
                        "streamed_fanout_msps", "streamed_dag_msps",
                        "streamed_link_utilization", "host_codec_overlap_frac",
                        "fm_msps", "wlan_msps", "lora_msps",
-                       "serve_sessions_per_chip")
+                       "serve_sessions_per_chip",
+                       # live profile plane (telemetry/profile.py): the
+                       # streamed kernel's run-average utilization — the
+                       # MFU ROADMAP item's regress-graded substrate
+                       "live_mfu", "live_hbm_util", "mfu", "hbm_util")
 # lower-is-better fields (fractions, not rates): regression = the value ROSE
 # past the reference by more than the absolute slack below — e.g. the
 # carry-checkpoint cost of the device-plane recovery contract creeping up
@@ -60,7 +64,13 @@ INVERSE_SLACK = 0.10       # absolute fraction a lower-is-better field may rise
 # the value rose past the reference by the multiplicative slack — generous,
 # because tail latency on a shared CI host carries straggler noise the
 # median-based rate fields do not
-FIELDS_INVERSE_RATIO_SAME_BACKEND = ("serve_p99_under_churn_ms",)
+FIELDS_INVERSE_RATIO_SAME_BACKEND = ("serve_p99_under_churn_ms",
+                                     # compile counts/seconds are lower-is-
+                                     # better: a storm of steady-state
+                                     # recompiles shows up as this figure
+                                     # blowing past the reference round
+                                     "compiles_total",
+                                     "compile_seconds_total")
 INVERSE_RATIO_SLACK = 2.0  # may rise up to (1 + slack)x the reference
 
 

@@ -442,7 +442,12 @@ def test_flight_record_shape_and_markdown(tmp_path, monkeypatch):
         rep = d.flight_record("shape-test")
         # golden shape: every black-box section present
         assert set(rep) == {"reason", "unix_time", "threads", "flowgraphs",
-                            "spans", "span_drops", "e2e_latency", "metrics"}
+                            "spans", "span_drops", "e2e_latency", "profile",
+                            "metrics"}
+        # profile-plane section: compile counters + storm classification
+        # ride every flight record (telemetry/profile.py)
+        assert set(rep["profile"]) == {"active_compiles", "compiles_total",
+                                       "storms"}
         assert rep["reason"] == "shape-test"
         # the calling thread's stack is recorded down to this test
         main = next(t for t in rep["threads"] if t["name"] == "MainThread")
