@@ -1013,6 +1013,31 @@ def main():
             print(f"# serving A/B unavailable: {e!r}", file=sys.stderr)
             serve_extra["serve_error"] = repr(e)
 
+    # interior precision + Pallas hot kernels (ops/precision.py /
+    # perf/precision_ab.py): the auto-lowered resident rate next to the f32
+    # headline, the plan's pinned SNR floor, and the Pallas kernel matrix —
+    # `resident_lowered_msps` and `interior_snr_db_min` are regress-graded
+    # (the ≥2x ROADMAP target reads off resident_lowered_speedup on TPU
+    # rounds; CPU rounds carry the same stamps as the trajectory baseline).
+    precision_extra = {}
+    if not args.skip_extra_chains:
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "perf"))
+            from precision_ab import measure as _precision_measure
+            precision_extra = _precision_measure(frame=min(best_frame,
+                                                           1 << 18))
+            print(f"# precision A/B: lowered "
+                  f"{precision_extra.get('resident_lowered_msps')} vs f32 "
+                  f"{precision_extra.get('resident_f32_msps')} Msps "
+                  f"({precision_extra.get('resident_lowered_speedup')}x), "
+                  f"min SNR {precision_extra.get('interior_snr_db_min')} dB, "
+                  f"{precision_extra.get('pallas_kernels_active')} pallas "
+                  f"stage(s)", file=sys.stderr)
+        except Exception as e:                          # noqa: BLE001
+            print(f"# precision A/B unavailable: {e!r}", file=sys.stderr)
+            precision_extra["precision_error"] = repr(e)
+
     # live profile plane (telemetry/profile.py): the ALWAYS-ON counterpart
     # of the offline roofline block above — compile counts/seconds billed at
     # every program-compile boundary this bench crossed, and the run-average
@@ -1081,6 +1106,7 @@ def main():
         **fanout_extra,
         **dag_extra,
         **serve_extra,
+        **precision_extra,
         **roof,
         **profile_extra,
         **doctor_extra,

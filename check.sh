@@ -42,6 +42,14 @@ echo "== host data path gate (docs/tpu_notes.md 'The host data path') =="
 # no worse than the pre-arena baseline
 JAX_PLATFORMS=cpu python perf/hostpath_ab.py --smoke
 
+echo "== interior precision gate (docs/tpu_notes.md 'Interior precision') =="
+# SNR-budgeted lowering correctness: interior_precision=off is BIT-identical
+# (same program object, same bits), the auto plan lowers the resident
+# fir64+fft2048 chain with every MEASURED per-edge SNR over the budget and
+# the end-to-end output inside budget − incoherent-sum allowance, and the
+# fused Pallas PFB / FIR→decimate kernels match the matmul paths they replace
+JAX_PLATFORMS=cpu python perf/precision_ab.py --smoke
+
 echo "== multi-tenant serving gate (docs/serving.md) =="
 # N sessions of one receiver chain through a single vmapped dispatch per
 # frame: dispatches/frame == 1 regardless of the active session count,

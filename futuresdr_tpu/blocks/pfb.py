@@ -8,6 +8,13 @@ XLA program on the TPU path.
 
 Channel ``c`` carries the band centered at ``c/N`` of the input sample rate (FFT bin order);
 each output runs at ``fs/N`` (critically sampled).
+
+This module is the HOST actor form. The fused device-plane form is
+``ops/stages.channelizer_stage`` — ``impl="matmul"`` (branch-MAC einsum +
+batched IFFT) or ``impl="pallas"`` (the fused ``pallas_pfb`` kernel: both
+passes in one kernel, the inter-pass branch bank never touching HBM; the
+``"auto"`` default picks it on the TPU backend) — see docs/tpu_notes.md
+"Interior precision".
 """
 
 from __future__ import annotations

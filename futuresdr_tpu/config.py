@@ -132,6 +132,21 @@ class Config:
     #   REST plane (a faulted client rarely comes back to DELETE); the oldest
     #   beyond this are forgotten so fault churn cannot grow the registry
     #   without bound
+    # Interior precision (ops/precision.py, docs/tpu_notes.md "Interior
+    # precision"): SNR-budgeted lowering of interior DAG edges and stage
+    # accumulation inside the fused device programs. "off" (default) is
+    # BIT-IDENTICAL to an unlowered build; "auto" lowers only where the
+    # measured per-edge SNR vs the f32 reference clears the budget; "bf16"
+    # force-lowers every supporting stage/edge (budget ignored, SNR still
+    # measured). Env: FUTURESDR_TPU_INTERIOR_PRECISION etc.
+    interior_precision: str = "off"        # "off" | "auto" | "bf16"
+    interior_snr_budget_db: float = 40.0   # per-edge SNR floor for "auto"
+    #   (bf16 edges measure ~55 dB on unit-power Gaussian frames, so the
+    #   default accepts bf16 and refuses anything sc8-grade)
+    interior_precision_overrides: str = "" # per-stage pins,
+    #   "fir=off;fft2048=bf16": "off" keeps a stage f32 whatever the budget
+    #   says, a precision forces it — the config-side form of the per-stage
+    #   ctrl retune (TpuKernel ctrl {"stage": ..., "interior_precision": ...})
     tpu_checkpoint_every: int = 1          # carry-checkpoint cadence of the
     #   device-plane recovery contract (docs/robustness.md "Device-plane
     #   recovery"): snapshot the kernel carry every Nth dispatch group (host

@@ -14,6 +14,8 @@ from .stages import (Stage, Pipeline, FanoutPipeline, MergeStage, DagPipeline,
                      channelizer_stage, lora_demod_stage)
 from .wire import (Wire, WIRE_FORMATS, get_wire, resolve_wire, wire_names,
                    measure_snr_db, streamed_ceiling_msps)
+from .precision import (PrecisionPlan, plan_interior_precision,
+                        lower_pipeline)
 
 __all__ = ["Stage", "Pipeline", "FanoutPipeline", "MergeStage", "DagPipeline",
            "apply_merge_stage", "add_merge_stage", "interleave_merge_stage",
@@ -24,4 +26,5 @@ __all__ = ["Stage", "Pipeline", "FanoutPipeline", "MergeStage", "DagPipeline",
            "decimate_stage", "moving_avg_stage", "resample_stage", "agc_stage",
            "channelizer_stage", "lora_demod_stage",
            "Wire", "WIRE_FORMATS", "get_wire", "resolve_wire", "wire_names",
-           "measure_snr_db", "streamed_ceiling_msps"]
+           "measure_snr_db", "streamed_ceiling_msps",
+           "PrecisionPlan", "plan_interior_precision", "lower_pipeline"]

@@ -37,7 +37,11 @@ import numpy as np
 # caches keep whichever path was bound at first trace. Flipping set_impl /
 # set_precision after a stage or Pipeline has compiled has no effect on the
 # cached executable — rebuild the stage, or pass impl=/precision= explicitly
-# (fft(..., impl=...), fir_stage(..., impl=...)) to bind per call site.
+# to bind per call site: fft/ifft(..., impl=..., precision=...) here,
+# fft_stage(impl=..., precision=...) and fir_stage(fft_impl=...,
+# precision=...) at the stage layer (regression-pinned in
+# tests/test_precision.py) — two chains in one process can hold different
+# routes without fighting over the module policy.
 _impl = os.environ.get("FUTURESDR_TPU_FFT_IMPL", "auto")
 _precision = os.environ.get("FUTURESDR_TPU_FFT_PRECISION", "f32")
 

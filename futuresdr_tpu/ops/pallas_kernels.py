@@ -1,9 +1,25 @@
 """Pallas TPU kernels for the streaming hot ops.
 
-Hand-written kernels for cases XLA's fusion doesn't cover well: the short-tap streaming
-FIR (direct form beats FFT overlap-save below ~32 taps) as an unrolled shifted
-multiply-accumulate on the VPU, with the inter-block overlap handled by passing each grid
-step both its own input block and its left neighbour (no overlapping BlockSpecs needed).
+Hand-written kernels for cases XLA's fusion doesn't cover well (the dataflow-shaped
+kernel argument of Flex-TPU, arXiv:2407.08700):
+
+* the short-tap streaming FIR (direct form beats FFT overlap-save below ~32 taps) as an
+  unrolled shifted multiply-accumulate on the VPU, with the inter-block overlap handled
+  by passing each grid step both its own input block and its left neighbour (no
+  overlapping BlockSpecs needed);
+* the fused PFB channelizer (:func:`pallas_pfb`): polyphase partition MAC + the
+  twiddle-feed IDFT across branches as one kernel — the intermediate ``v[t, c]`` bank
+  never round-trips HBM between the branch filters and the branch transform, which is
+  exactly the HBM-bound half of the ``blocks/pfb.py`` / ``ops/stages.channelizer_stage``
+  matmul path;
+* the fused FIR→decimate kernel (:func:`pallas_poly_fir`): the shifted-row polyphase
+  factorization of ``ops/stages._poly_decim_fir_stage`` computed at the DECIMATED rate
+  inside one kernel (ntaps/D MACs per input sample, no full-rate intermediate).
+
+Every kernel takes ``precision="bf16"`` for the interior-precision policy
+(``ops/precision.py``): operands are cast to bfloat16 and accumulated in float32 —
+on the MXU this is the native-speed pass; on CPU/interpret it applies exactly the same
+quantization, so SNR calibration measures the real thing.
 
 Falls back to interpret mode off-TPU — numerics are identical, so CI validates the kernel
 on CPU and the same code runs compiled on the chip.
@@ -19,30 +35,46 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-__all__ = ["pallas_fir", "pallas_fir_continue", "pallas_fir_stage"]
+__all__ = ["pallas_fir", "pallas_fir_continue", "pallas_fir_stage",
+           "pallas_pfb", "pallas_poly_fir"]
 
 
-def _fir_kernel(prev_ref, cur_ref, taps_ref, o_ref, *, n_taps: int, block: int):
+def _maybe_bf16(*arrays, bf16: bool):
+    if not bf16:
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = tuple(a.astype(jnp.bfloat16) for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+def _fir_kernel(prev_ref, cur_ref, taps_ref, o_ref, *, n_taps: int, block: int,
+                bf16: bool = False):
     """One grid step: y[i] = Σ_k taps[k] · x[i − k] over this block, using the previous
     block's tail for the first n_taps−1 outputs."""
     full = jnp.concatenate([prev_ref[...], cur_ref[...]])       # [2·block]
+    taps = taps_ref[...]
+    full, taps = _maybe_bf16(full, taps, bf16=bf16)
     acc = jnp.zeros((block,), jnp.float32)
     base = block - (n_taps - 1)
     for k in range(n_taps):                                     # static unroll
         # static slice offsets (k is a Python int) — dynamic_slice has no Mosaic
         # TC lowering; static lax.slice does
-        acc = acc + taps_ref[n_taps - 1 - k] * full[base + k:base + k + block]
+        acc = acc + (taps[n_taps - 1 - k]
+                     * full[base + k:base + k + block]).astype(jnp.float32)
     o_ref[...] = acc
 
 
 def pallas_fir(x: jnp.ndarray, taps, block: int = 4096,
-               interpret: Optional[bool] = None) -> jnp.ndarray:
+               interpret: Optional[bool] = None,
+               precision: Optional[str] = None) -> jnp.ndarray:
     """Causal FIR of a float32 frame (zero initial state): len(x) must divide ``block``.
 
     Complex frames are filtered as two real passes at the wrapper level
-    (:func:`pallas_fir_stage`).
+    (:func:`pallas_fir_stage`). ``precision="bf16"`` runs the MAC with bfloat16
+    operands and float32 accumulation (module docstring).
     """
-    taps = jnp.asarray(taps, jnp.float32)
+    taps = jnp.asarray(taps)
+    if not jnp.issubdtype(taps.dtype, jnp.bfloat16):
+        taps = taps.astype(jnp.float32)
     n_taps = taps.shape[0]
     assert block >= n_taps, "block must exceed the tap count"
     n = x.shape[0]
@@ -54,7 +86,8 @@ def pallas_fir(x: jnp.ndarray, taps, block: int = 4096,
     # block i sees: prev = x[(i-1)·block : i·block] (block 0 → block of zeros via the
     # leading pad), cur = x[i·block : (i+1)·block]
     xp = jnp.concatenate([jnp.zeros(block, x.dtype), x])
-    kernel = partial(_fir_kernel, n_taps=n_taps, block=block)
+    kernel = partial(_fir_kernel, n_taps=n_taps, block=block,
+                     bf16=(precision == "bf16"))
     return pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -70,25 +103,28 @@ def pallas_fir(x: jnp.ndarray, taps, block: int = 4096,
 
 
 def pallas_fir_continue(hist: jnp.ndarray, x: jnp.ndarray, taps: np.ndarray,
-                        block: int = 4096) -> jnp.ndarray:
+                        block: int = 4096,
+                        precision: Optional[str] = None) -> jnp.ndarray:
     """Streaming continuation: filter frame ``x`` given the previous ``n_taps-1``
     input samples in ``hist``. Pads to the kernel's block granularity, runs complex
     frames as two real passes, and returns exactly ``len(x)`` aligned outputs.
     Shared by :func:`pallas_fir_stage` and ``stages.fir_stage(impl="pallas")``.
     ``taps`` may be a traced device array (carry-resident, for runtime tap swap) —
     only its static shape is read here."""
-    taps = jnp.asarray(taps, dtype=jnp.float32)
+    taps = jnp.asarray(taps)
+    if not jnp.issubdtype(taps.dtype, jnp.bfloat16):
+        taps = taps.astype(jnp.float32)
     nt = taps.shape[0]
     ext = jnp.concatenate([hist, x])               # [(nt-1) + n]
     pad = (-ext.shape[0]) % block
     if pad:
         ext = jnp.concatenate([ext, jnp.zeros(pad, ext.dtype)])
     if jnp.iscomplexobj(x):
-        yr = pallas_fir(ext.real, taps, block)
-        yi = pallas_fir(ext.imag, taps, block)
+        yr = pallas_fir(ext.real, taps, block, precision=precision)
+        yi = pallas_fir(ext.imag, taps, block, precision=precision)
         y = (yr + 1j * yi).astype(x.dtype)
     else:
-        y = pallas_fir(ext, taps, block).astype(x.dtype)
+        y = pallas_fir(ext, taps, block, precision=precision).astype(x.dtype)
     return y[nt - 1:nt - 1 + x.shape[0]]
 
 
@@ -111,3 +147,170 @@ def pallas_fir_stage(taps, block: int = 4096):
         return jnp.zeros(nt - 1, dtype=dtype)
 
     return Stage(fn, init_carry, Fraction(1, 1), None, 1, "pallas_fir")
+
+
+# ---------------------------------------------------------------------------
+# fused PFB channelizer: polyphase MAC + twiddle-feed IDFT in one kernel
+# ---------------------------------------------------------------------------
+
+def _pfb_kernel(prev_r, prev_i, cur_r, cur_i, taps_ref, er_ref, ei_ref,
+                out_r, out_i, *, n_taps: int, block: int, bf16: bool):
+    """One grid step over ``block`` commutated time rows: the branch-filter MAC
+    ``v[s, c] = Σ_k taps[k, c] · rows[s + K−1 − k, c]`` (history rows ride in
+    from the previous block, exactly the FIR kernel's neighbour trick), then
+    the IDFT across branches as two real matmuls per output plane — the
+    intermediate ``v`` bank lives only in VMEM."""
+    fr = jnp.concatenate([prev_r[...], cur_r[...]])          # [2·block, N]
+    fi = jnp.concatenate([prev_i[...], cur_i[...]])
+    taps = taps_ref[...]                                     # [K, N]
+    fr, fi, taps = _maybe_bf16(fr, fi, taps, bf16=bf16)
+    acc_r = jnp.zeros(cur_r.shape, jnp.float32)
+    acc_i = jnp.zeros(cur_i.shape, jnp.float32)
+    for k in range(n_taps):                                  # static unroll
+        t = taps[k]
+        acc_r = acc_r + (t * fr[block - k:2 * block - k]).astype(jnp.float32)
+        acc_i = acc_i + (t * fi[block - k:2 * block - k]).astype(jnp.float32)
+    er, ei = er_ref[...], ei_ref[...]
+    prec = (jax.lax.Precision.DEFAULT if bf16
+            else jax.lax.Precision.HIGHEST)
+    if bf16:
+        acc_r, acc_i, er, ei = _maybe_bf16(acc_r, acc_i, er, ei, bf16=True)
+    dot = partial(jnp.dot, preferred_element_type=jnp.float32,
+                  precision=prec)
+    # y = v @ E with E = exp(+2πi·cc'/N): 4 real matmuls (er=cos, ei=sin)
+    out_r[...] = dot(acc_r, er) - dot(acc_i, ei)
+    out_i[...] = dot(acc_r, ei) + dot(acc_i, er)
+
+
+def pallas_pfb(rows: jnp.ndarray, taps_kn, block: int = 256,
+               interpret: Optional[bool] = None,
+               precision: Optional[str] = None) -> jnp.ndarray:
+    """Fused critically-sampled PFB analysis bank over commutated rows.
+
+    ``rows``: ``[t + K−1, N]`` complex64 — the channelizer's commutated block
+    matrix WITH its K−1 history rows in front (``ops/stages.channelizer_stage``
+    builds exactly this from its carry). ``taps_kn``: ``[K, N]`` branch taps at
+    depth k (``branchᵀ`` — may be a carry-resident traced array, f32 or bf16).
+    Returns ``[t, N]`` complex64 — bit-comparable to the matmul path's
+    ``ifft(v) * N`` (same math, fused op order; tolerance-pinned in
+    tests/test_pallas.py). ``precision="bf16"`` casts MAC/matmul operands to
+    bfloat16 with float32 accumulation.
+    """
+    K, N = taps_kn.shape
+    R = rows.shape[0]
+    t = R - (K - 1)
+    bt = max(int(block), K)             # alignment needs bt ≥ K−1; K is safe
+    assert t >= 1, "need at least one output row"
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bf16 = precision == "bf16"
+    rr = jnp.real(rows).astype(jnp.float32)
+    ri = jnp.imag(rows).astype(jnp.float32)
+    # pad t up to a block multiple with zero rows (their outputs are trimmed)
+    t_pad = -(-t // bt) * bt
+    tail = t_pad - t
+    if tail:
+        z = jnp.zeros((tail, N), jnp.float32)
+        rr = jnp.concatenate([rr, z])
+        ri = jnp.concatenate([ri, z])
+    # causal alignment: front-pad so output row s reads full[bt + s − k]
+    z0 = jnp.zeros((bt - (K - 1), N), jnp.float32)
+    xr = jnp.concatenate([z0, rr])
+    xi = jnp.concatenate([z0, ri])
+    # twiddle-feed IDFT matrix built IN TRACE (device constant — the axon
+    # tunnel cannot ship host complex constants, ops/xfer.py). The phase
+    # index reduces mod N BEFORE the float multiply: cc' grows to ~N² and
+    # f32 rounding of 2π·cc'/N at large N costs ~10 dB per octave of N
+    # (88 dB @ N=512 without the reduction vs near-exact with it)
+    c = jnp.arange(N)
+    ang = 2 * jnp.pi * (jnp.outer(c, c) % N) / N
+    er = jnp.cos(ang).astype(jnp.float32)
+    ei = jnp.sin(ang).astype(jnp.float32)
+    grid = t_pad // bt
+    kern = partial(_pfb_kernel, n_taps=K, block=bt, bf16=bf16)
+    out_r, out_i = pl.pallas_call(
+        kern,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((bt, N), lambda i: (i, 0)),       # prev rows (re)
+            pl.BlockSpec((bt, N), lambda i: (i, 0)),       # prev rows (im)
+            pl.BlockSpec((bt, N), lambda i: (i + 1, 0)),   # cur rows (re)
+            pl.BlockSpec((bt, N), lambda i: (i + 1, 0)),   # cur rows (im)
+            pl.BlockSpec((K, N), lambda i: (0, 0)),
+            pl.BlockSpec((N, N), lambda i: (0, 0)),
+            pl.BlockSpec((N, N), lambda i: (0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((bt, N), lambda i: (i, 0)),
+                   pl.BlockSpec((bt, N), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((t_pad, N), jnp.float32),
+                   jax.ShapeDtypeStruct((t_pad, N), jnp.float32)],
+        interpret=interpret,
+    )(xr, xi, xr, xi, taps_kn, er, ei)
+    return jax.lax.complex(out_r[:t], out_i[:t])
+
+
+# ---------------------------------------------------------------------------
+# fused FIR→decimate: shifted-row polyphase MACs at the decimated rate
+# ---------------------------------------------------------------------------
+
+def _poly_fir_kernel(prev, cur, w_ref, o_ref, *, m: int, block: int,
+                     bf16: bool):
+    """One grid step of ``block`` decimated outputs: ``y[q] = Σ_a
+    rows[q + m − a] · W[a]`` over the stride-D row matrix — m+1 [block, D]·[D]
+    matvecs, the in-kernel form of ``ops/stages._shifted_matvec``."""
+    full = jnp.concatenate([prev[...], cur[...]])            # [2·block, D]
+    W = w_ref[...]                                           # [m+1, D]
+    full, W = _maybe_bf16(full, W, bf16=bf16)
+    prec = (jax.lax.Precision.DEFAULT if bf16
+            else jax.lax.Precision.HIGHEST)
+    dot = partial(jnp.dot, preferred_element_type=jnp.float32,
+                  precision=prec)
+    acc = dot(full[block:2 * block], W[0])
+    for a in range(1, m + 1):                                # static unroll
+        acc = acc + dot(full[block - a:2 * block - a], W[a])
+    o_ref[...] = acc
+
+
+def pallas_poly_fir(rows: jnp.ndarray, W, block: int = 1024,
+                    interpret: Optional[bool] = None,
+                    precision: Optional[str] = None) -> jnp.ndarray:
+    """Fused decimating FIR over the stride-D row matrix.
+
+    ``rows``: ``[m + nq, D]`` float32 — the reshape of the history-extended
+    input (``ext.reshape(-1, D)``, no copy); ``W``: ``[m+1, D]`` the shifted-row
+    weight matrix (``ops/stages._poly_decim_weights`` — may be carry-resident,
+    f32 or bf16, REAL taps only). Returns ``[nq]`` float32 decimated outputs —
+    ntaps/D MACs per input sample with no full-rate intermediate (the fused
+    FIR→decimate kernel). Complex frames run as two real passes at the stage
+    level. ``precision="bf16"`` casts operands to bfloat16, accumulates f32.
+    """
+    m1, D = W.shape
+    m = m1 - 1
+    nq = rows.shape[0] - m
+    assert nq >= 1, "need at least one output row"
+    bq = max(int(block), m)             # slice starts need bq ≥ m
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    rows = rows.astype(jnp.float32)
+    nq_pad = -(-nq // bq) * bq
+    tail = nq_pad - nq
+    if tail:
+        rows = jnp.concatenate([rows, jnp.zeros((tail, D), jnp.float32)])
+    # causal alignment: front-pad so output q reads full[bq + q − a]
+    xp = jnp.concatenate([jnp.zeros((bq - m, D), jnp.float32), rows])
+    grid = nq_pad // bq
+    kern = partial(_poly_fir_kernel, m=m, block=bq,
+                   bf16=(precision == "bf16"))
+    y = pl.pallas_call(
+        kern,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((bq, D), lambda i: (i, 0)),       # prev rows
+            pl.BlockSpec((bq, D), lambda i: (i + 1, 0)),   # cur rows
+            pl.BlockSpec((m + 1, D), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bq,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((nq_pad,), jnp.float32),
+        interpret=interpret,
+    )(xp, xp, W)
+    return y[:nq]

@@ -69,6 +69,22 @@ class Stage:
     update: Optional[Callable[..., Any]] = None   # host-side ``(carry, **params) -> carry``
     #   runtime control hook: parameters (taps, phase_inc, …) live in the carry, so a
     #   retune is carry surgery between dispatches — NO recompile, frames stay in flight
+    lower: Optional[Callable[[str], Optional["Stage"]]] = None
+    #   interior-precision hook (ops/precision.py): return this stage rebuilt with its
+    #   accumulation/taps lowered to the given precision ("bf16"; "int8" where the
+    #   stage declares support), or None when unsupported — the SNR-budgeted lowering
+    #   pass only considers stages that offer the hook; everything else gets at most
+    #   an interior-EDGE cast
+    compute_dtype: str = "f32"                    # dominant accumulation dtype of the
+    #   traced program ("f32" | "bf16") — keys the MFU denominator on the right
+    #   per-dtype chip peak (utils/roofline.detect_peaks)
+    route: Optional[Tuple[Optional[str], Optional[str], Optional[str]]] = None
+    #   (impl, fft_impl, precision) — the builder's per-call-site selection for
+    #   kernel-backed stages (fir/fft/channelizer). LTI merging preserves pins
+    #   only when both sides agree (a pin must never be silently dropped), the
+    #   cost-cache marker includes it (two same-shape stages on different
+    #   routes compile different-cost programs), and
+    #   ops/precision.pallas_stage_count resolves pallas routing from it
 
     def __repr__(self):
         return f"Stage({self.name}, ratio={self.ratio})"
@@ -814,10 +830,18 @@ def _merge_lti(stages: Sequence[Stage], in_dtype) -> list:
         if s.lti is not None and out and out[-1].lti is not None:
             t1, d1, fl1, im1 = out[-1].lti
             t2, d2, fl2, im2 = s.lti
+            # per-call-site route pins (fft_impl, precision): merge only when
+            # both sides agree — a merged stage can honor ONE pin set, and
+            # silently dropping a pin would revert the stage to the module
+            # policy / f32, defeating exactly what the pin bought
+            p1 = (out[-1].route or (None, None, None))[1:]
+            p2 = (s.route or (None, None, None))[1:]
             complex_stream = bool(np.issubdtype(out_dtypes[-1], np.complexfloating))
-            if not complex_stream and not (np.isrealobj(t1) and np.isrealobj(t2)):
-                # a real stream takes .real at EACH stage boundary; merging complex-tap
-                # cascades would change that — only safe on complex streams
+            if p1 != p2 or (not complex_stream
+                            and not (np.isrealobj(t1) and np.isrealobj(t2))):
+                # (real streams take .real at EACH stage boundary; merging
+                # complex-tap cascades would change that — only safe on
+                # complex streams)
                 out.append(s)
                 out_dtypes.append(dtype)
                 if s.out_dtype is not None:
@@ -836,7 +860,8 @@ def _merge_lti(stages: Sequence[Stage], in_dtype) -> list:
                 ("pallas" if im1 == im2 == "pallas" else
                  ("poly" if im1 == im2 == "poly" else "auto"))
             out[-1] = fir_stage(taps, decim=d1 * d2, fft_len=max(fl1, fl2),
-                                name=f"{out[-1].name}*{s.name}", impl=impl)
+                                name=f"{out[-1].name}*{s.name}", impl=impl,
+                                fft_impl=p1[0], precision=p1[1])
             # stream dtype entering the merged stage is unchanged; FIR stages keep the
             # stream dtype so `dtype` needs no update here
         else:
@@ -868,7 +893,8 @@ def _pallas_fir_wins(nt: int, is_complex: bool) -> bool:
 
 
 def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
-              impl: str = "auto") -> Stage:
+              impl: str = "auto", fft_impl: Optional[str] = None,
+              precision: Optional[str] = None) -> Stage:
     """FFT overlap-save FIR (+ optional decimation) as a jitted stage.
 
     History carry = last ``ntaps-1`` inputs (the `min_items` overlap of `fir.rs:49`
@@ -893,6 +919,18 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
     input sample, and the stage's frame multiple drops from lcm(hop, D) to D.
     Matches ``decimate == true`` FIR cores (``futuredsp/fir.rs:31``) re-designed for
     the MXU rather than translated.
+
+    ``fft_impl`` pins the overlap-save core's FFT implementation PER CALL SITE
+    (``mxu_fft.fft(impl=…)``): the module ``set_impl`` policy binds at trace time
+    and jit caches keep whichever path was bound first, so a per-stage pin is
+    the only way two chains in one process can hold different FFT routes
+    (the plumbing promised in the ``ops/mxu_fft.py`` header).
+
+    ``precision="bf16"`` builds the interior-precision-lowered variant
+    (``ops/precision.py``): bf16 MXU passes in the overlap-save FFTs, bf16
+    tap/accumulation in the pallas and polyphase kernels (carried weights land
+    in bf16). The f32-built stage exposes the same lowering through its
+    ``Stage.lower`` hook — the SNR-budgeted pass uses that.
     """
     assert impl in ("auto", "os", "pallas", "poly"), impl
     taps = np.asarray(taps)
@@ -901,9 +939,14 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
     #                                     hook refuses swaps that would change it
     # auto cap nt/D ≤ 32: the poly window matrix materializes ~nt/D × the frame in
     # HBM, so the route stays where both the MACs/input and the intermediate are
-    # modest; longer filters keep the OS path's fixed fft_len working set
-    if impl == "poly" or (impl == "auto" and decim > 1 and nt <= 32 * decim):
-        return _poly_decim_fir_stage(taps, decim, fft_len, name, impl)
+    # modest; longer filters keep the OS path's fixed fft_len working set.
+    # An explicit pallas force on a DECIMATING filter routes through the poly
+    # factorization too — its fused FIR→decimate kernel (pallas_poly_fir)
+    # computes at the decimated rate instead of full-rate-then-slice.
+    if impl == "poly" or (impl == "pallas" and decim > 1) \
+            or (impl == "auto" and decim > 1 and nt <= 32 * decim):
+        return _poly_decim_fir_stage(taps, decim, fft_len, name, impl,
+                                     precision=precision)
     if impl == "pallas":
         # an explicit force must not silently no-op: the kernel is real-taps-only
         assert np.isrealobj(taps) and nt >= 2, \
@@ -926,6 +969,8 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
 
     H, Hr = _spectra(taps)
 
+    fft_prec = "bf16" if precision == "bf16" else None
+
     def fn(carry, x):
         Hc, tt, tail = carry
         ext = jnp.concatenate([tail, x])             # [(S+1)·L], S = n // L
@@ -935,7 +980,8 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
             from .pallas_kernels import pallas_fir_continue
             # time-domain taps come from the CARRY (not the closure) so a runtime
             # tap swap reaches the pallas path too — same shape, no recompile
-            y = pallas_fir_continue(ext[L - (nt - 1):L], x, tt)
+            y = pallas_fir_continue(ext[L - (nt - 1):L], x, tt,
+                                    precision=precision)
             if decim > 1:
                 y = y[::decim]
             return (Hc, tt, ext[ext.shape[0] - L:]), y
@@ -944,15 +990,18 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
         rows = ext.reshape(-1, L)
         blocks = jnp.concatenate([rows[:-1], rows[1:]], axis=1)   # [S, 2L]
         if jnp.iscomplexobj(x):
-            spec = mxu_fft.fft(blocks) * Hc[None, :]
-            seg = mxu_fft.ifft(spec)[:, L:]          # linear-conv region (L ≥ ntaps-1)
+            spec = mxu_fft.fft(blocks, precision=fft_prec,
+                               impl=fft_impl) * Hc[None, :]
+            seg = mxu_fft.ifft(spec, precision=fft_prec,
+                               impl=fft_impl)[:, L:]   # linear-conv region
         elif Hc.shape[0] == fft_len:
             # real input with a full-spectrum carry (chosen at init_carry time when the
             # MXU policy was active — the four-step has no half-spectrum variant; it
             # still beats the XLA rfft). Branching on the carry shape keeps fn and
             # carry coherent even if the policy flips between init and trace.
-            spec = mxu_fft.fft(blocks.astype(jnp.complex64)) * Hc[None, :]
-            seg = mxu_fft.ifft(spec)[:, L:].real
+            spec = mxu_fft.fft(blocks.astype(jnp.complex64), precision=fft_prec,
+                               impl=fft_impl) * Hc[None, :]
+            seg = mxu_fft.ifft(spec, precision=fft_prec, impl=fft_impl)[:, L:].real
         else:
             spec = jnp.fft.rfft(blocks, axis=1) * Hc[None, :]
             seg = jnp.fft.irfft(spec, n=fft_len, axis=1)[:, L:]
@@ -964,7 +1013,7 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
     def init_carry(dtype):
         dt = np.dtype(dtype)
         use_full = (np.issubdtype(dt, np.complexfloating)
-                    or mxu_fft._use_mxu(fft_len))
+                    or mxu_fft._use_mxu(fft_len, fft_impl))
         Hsel = H if use_full else Hr
         # complex H2D (incl. eager jnp.zeros, which is a host device_put!) must ride
         # the pair shim — broken complex transfers on axon, see ops/xfer.py
@@ -1002,21 +1051,47 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
 
     # frame must be a multiple of the hop (and of decim at the output side)
     multiple = int(np.lcm(L, decim))
+
+    def _lower(p: str) -> Optional[Stage]:
+        if p != "bf16":
+            return None
+        return fir_stage(taps, decim=decim, fft_len=fft_len, name=name,
+                         impl=impl, fft_impl=fft_impl, precision="bf16")
+
     return Stage(fn, init_carry, Fraction(1, decim), None, multiple, name,
-                 lti=(taps, decim, fft_len, impl), update=update)
+                 lti=(taps, decim, fft_len, impl), update=update,
+                 lower=_lower,
+                 compute_dtype="bf16" if precision == "bf16" else "f32",
+                 route=(impl, fft_impl, precision))
 
 
-def _shifted_matvec(ext: jnp.ndarray, W, m: int, nq: int):
+def _shifted_matvec(ext: jnp.ndarray, W, m: int, nq: int,
+                    precision: Optional[str] = None):
     """``y = Σ_{r=0..m} rows[m−r : m−r+nq] @ W[r]`` with ``rows = ext.reshape(-1, D)``
     (a view — nothing materialized). The shared accumulation of the shifted-row
     polyphase factorization (_poly_decim_fir_stage / resample_stage /
-    xlating_fir_stage); HIGHEST precision so no TPU bf16 passes sneak in."""
+    xlating_fir_stage); HIGHEST precision by default so no TPU bf16 passes sneak
+    in. ``precision="bf16"`` (the interior-precision policy, ops/precision.py)
+    casts REAL operands to bfloat16 with float32 accumulation — the native MXU
+    pass on TPU, the identical quantization on CPU; complex operands (no bf16
+    complex exists) fall back to DEFAULT matmul precision, which is the bf16-pass
+    path on TPU and a no-op on CPU."""
+    from functools import partial as _partial
     D = W.shape[-2] if W.ndim == 3 else W.shape[-1]
     rows = ext.reshape(-1, D)
-    hi = jax.lax.Precision.HIGHEST
-    y = jnp.matmul(rows[m:m + nq], W[0], precision=hi)
+    if precision == "bf16" and not (jnp.iscomplexobj(rows)
+                                    or jnp.iscomplexobj(W)):
+        rows = rows.astype(jnp.bfloat16)
+        W = W.astype(jnp.bfloat16)
+        mm = _partial(jnp.matmul, precision=jax.lax.Precision.DEFAULT,
+                      preferred_element_type=jnp.float32)
+    elif precision == "bf16":
+        mm = _partial(jnp.matmul, precision=jax.lax.Precision.DEFAULT)
+    else:
+        mm = _partial(jnp.matmul, precision=jax.lax.Precision.HIGHEST)
+    y = mm(rows[m:m + nq], W[0])
     for r in range(1, m + 1):
-        y = y + jnp.matmul(rows[m - r:m - r + nq], W[r], precision=hi)
+        y = y + mm(rows[m - r:m - r + nq], W[r])
     return y
 
 
@@ -1035,7 +1110,8 @@ def _poly_decim_weights(taps: np.ndarray, D: int, m: int) -> np.ndarray:
 
 
 def _poly_decim_fir_stage(taps: np.ndarray, decim: int, fft_len: int,
-                          name: str, impl: str) -> Stage:
+                          name: str, impl: str,
+                          precision: Optional[str] = None) -> Stage:
     """Decimating FIR as m+1 shifted matvecs over the stride-D row matrix.
 
     ``y[q] = Σ_t taps[t] · x[q·D − t]``. Decompose ``t = r·D − s``: with
@@ -1048,6 +1124,13 @@ def _poly_decim_fir_stage(taps: np.ndarray, decim: int, fft_len: int,
     (128 taps, D=16) and strictly less HBM traffic on TPU (VERDICT r3 weak 2).
     The weight matrix rides the carry, so it is donation-safe and hot-swappable
     exactly like the OS path's frequency-domain ``Hc``.
+
+    ``impl="pallas"`` routes REAL weight matrices through the fused
+    FIR→decimate kernel (``pallas_kernels.pallas_poly_fir``): the same
+    shifted-row MACs computed inside one kernel at the decimated rate (complex
+    frames run two real passes; complex taps keep the matvec path — the kernel
+    is real-only). ``precision="bf16"`` carries the weight matrix in bfloat16
+    and runs the MACs with bf16 operands / f32 accumulation on either path.
     """
     D = int(decim)
     nt = len(taps)
@@ -1058,7 +1141,20 @@ def _poly_decim_fir_stage(taps: np.ndarray, decim: int, fft_len: int,
     def fn(carry, x):
         W, hist = carry
         ext = jnp.concatenate([hist, x])                 # [H + n]
-        y = _shifted_matvec(ext, W, m, x.shape[0] // D)
+        if impl == "pallas" and not jnp.iscomplexobj(W):
+            from .pallas_kernels import pallas_poly_fir
+            if jnp.iscomplexobj(x):
+                yr = pallas_poly_fir(ext.real.reshape(-1, D), W,
+                                     precision=precision)
+                yi = pallas_poly_fir(ext.imag.reshape(-1, D), W,
+                                     precision=precision)
+                y = jax.lax.complex(yr, yi)
+            else:
+                y = pallas_poly_fir(ext.reshape(-1, D), W,
+                                    precision=precision)
+        else:
+            y = _shifted_matvec(ext, W, m, x.shape[0] // D,
+                                precision=precision)
         return (W, ext[ext.shape[0] - H:]), y.astype(x.dtype)
 
     def _weights(t, complex_stream: bool):
@@ -1066,7 +1162,11 @@ def _poly_decim_fir_stage(taps: np.ndarray, decim: int, fft_len: int,
         # path's half-spectrum Hr) — bake that into the carried weights
         teff = t if complex_stream else np.real(t)
         teff = teff.astype(np.complex64 if np.iscomplexobj(teff) else np.float32)
-        return _poly_decim_weights(teff, D, m)
+        W = _poly_decim_weights(teff, D, m)
+        if precision == "bf16" and not np.iscomplexobj(W):
+            import ml_dtypes
+            W = W.astype(ml_dtypes.bfloat16)   # carried weights: half the HBM
+        return W
 
     def init_carry(dtype):
         dt = np.dtype(dtype)
@@ -1095,8 +1195,17 @@ def _poly_decim_fir_stage(taps: np.ndarray, decim: int, fft_len: int,
         complex_stream = np.issubdtype(hist.dtype, np.complexfloating)
         return (to_device(_weights(new, complex_stream), dev), hist)
 
+    def _lower(p: str) -> Optional[Stage]:
+        if p != "bf16" or not built_real:
+            return None
+        return _poly_decim_fir_stage(taps, D, fft_len, name, impl,
+                                     precision="bf16")
+
     return Stage(fn, init_carry, Fraction(1, D), None, D, name,
-                 lti=(taps, D, fft_len, impl), update=update)
+                 lti=(taps, D, fft_len, impl), update=update,
+                 lower=_lower,
+                 compute_dtype="bf16" if precision == "bf16" else "f32",
+                 route=(impl, None, precision))
 
 
 def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,
@@ -1190,29 +1299,49 @@ def decimate_stage(decim: int) -> Stage:
 
 
 def fft_stage(n: int, direction: str = "forward", shift: bool = False,
-              normalize: bool = False, window=None) -> Stage:
+              normalize: bool = False, window=None,
+              impl: Optional[str] = None,
+              precision: Optional[str] = None) -> Stage:
     """Batched frame FFT: input frame reshaped [-1, n], transformed on axis 1.
-    ``window``: optional name/array applied per frame before a forward FFT."""
+    ``window``: optional name/array applied per frame before a forward FFT.
+
+    ``impl``/``precision`` pin the FFT route and MXU matmul precision PER CALL
+    SITE (``mxu_fft.fft(impl=…, precision=…)``): the module ``set_impl`` /
+    ``set_precision`` policy binds at trace time and jit caches keep the
+    first-bound path, so per-stage pins are how two chains in one process hold
+    different routes (the ``ops/mxu_fft.py`` header's promised plumbing).
+    ``precision="bf16"`` is also what the interior-precision policy
+    (``ops/precision.py``) selects through this stage's ``lower`` hook."""
     if window is not None:
         from ..dsp.windows import get_window
         window = np.asarray(window, dtype=np.float32) if not isinstance(window, str) \
             else get_window(window, n).astype(np.float32)
+    fft_prec = "bf16" if precision == "bf16" else None
 
     def fn(carry, x):
         f = x.reshape(-1, n)
         if direction == "forward":
             if window is not None:
                 f = f * jnp.asarray(window)[None, :]
-            y = mxu_fft.fft(f)
+            y = mxu_fft.fft(f, precision=fft_prec, impl=impl)
         else:
-            y = mxu_fft.ifft(f) * n
+            y = mxu_fft.ifft(f, precision=fft_prec, impl=impl) * n
         if normalize:
             y = y / jnp.sqrt(n)
         if shift:
             y = jnp.fft.fftshift(y, axes=1)
         return carry, y.reshape(-1).astype(jnp.complex64)
 
-    return Stage(fn, lambda d: jnp.zeros(()), Fraction(1, 1), np.complex64, n, f"fft{n}")
+    def _lower(p: str) -> Optional[Stage]:
+        if p != "bf16":
+            return None
+        return fft_stage(n, direction, shift, normalize, window,
+                         impl=impl, precision="bf16")
+
+    return Stage(fn, lambda d: jnp.zeros(()), Fraction(1, 1), np.complex64, n,
+                 f"fft{n}", lower=_lower,
+                 compute_dtype="bf16" if precision == "bf16" else "f32",
+                 route=(impl, None, precision))
 
 
 def fftshift_stage(n: int) -> Stage:
@@ -1392,15 +1521,26 @@ def apply_stage(f: Callable[[jnp.ndarray], jnp.ndarray], out_dtype=None,
     return Stage(fn, lambda d: jnp.zeros(()), Fraction(1, 1), out_dtype, 1, name)
 
 
-def channelizer_stage(n_channels: int, taps=None, name: str = "channelizer") -> Stage:
+def channelizer_stage(n_channels: int, taps=None, name: str = "channelizer",
+                      impl: str = "auto",
+                      precision: Optional[str] = None) -> Stage:
     """Critically-sampled PFB analysis bank as a stage: frames of k·N complex samples →
     k·N outputs, CHANNEL-INTERLEAVED ([t, N] flattened — feed a StreamDeinterleaver(N)
     to split, or consume interleaved). Carry = the branch-filter history block.
 
-    The polyphase branch FIRs are expressed as one [N, K] × windows dot per output
-    step batched over the frame (MXU work), followed by a batched IFFT across branches —
-    the fused-TPU form of `blocks/pfb.PfbChannelizer`.
+    ``impl="matmul"``: the polyphase branch FIRs as one [N, K] × windows dot per
+    output step batched over the frame (MXU work), followed by a batched IFFT
+    across branches — the fused-TPU form of `blocks/pfb.PfbChannelizer`.
+    ``impl="pallas"``: the fused PFB kernel (``pallas_kernels.pallas_pfb``) —
+    polyphase MAC + twiddle-feed IDFT in ONE kernel, so the [t, N] branch bank
+    never round-trips HBM between the two passes (the windows stack is ~K× the
+    frame in HBM writes on the matmul path). ``"auto"`` picks pallas on the TPU
+    backend (trace-time, same convention as ``_pallas_fir_wins``) and the matmul
+    path elsewhere. ``precision="bf16"`` carries the branch taps in bfloat16 and
+    runs MAC/IDFT with bf16 operands, f32 accumulation (the interior-precision
+    policy selects it via this stage's ``lower`` hook).
     """
+    assert impl in ("auto", "matmul", "pallas"), impl
     N = n_channels
     if taps is None:
         from ..blocks.pfb import pfb_default_taps
@@ -1409,20 +1549,33 @@ def channelizer_stage(n_channels: int, taps=None, name: str = "channelizer") -> 
     K = -(-len(taps) // N)
     padded = np.zeros(K * N, dtype=np.float32)
     padded[:len(taps)] = taps
-    branch = jnp.asarray(padded.reshape(K, N).T)          # [N, K]
+    branch_np = padded.reshape(K, N).T                    # [N, K]
+    if precision == "bf16":
+        import ml_dtypes
+        branch_np = branch_np.astype(ml_dtypes.bfloat16)  # carried taps: half HBM
+    branch = jnp.asarray(branch_np)
+    fft_prec = "bf16" if precision == "bf16" else None
 
     def fn(carry, x):
         Hc, hist = carry                                   # hist: [(K-1)·N]
         ext = jnp.concatenate([hist, x])                   # [(t + K-1)·N]
         blocks = ext.reshape(-1, N)[:, ::-1]               # [t+K-1, N] commutated
         t = x.shape[0] // N
-        # windows[s, k, c] = blocks[s + (K-1) - k, c]  (branch c history depth k)
-        # K static slices + stack instead of a gather (slow on TPU)
-        windows = jnp.stack(
-            [blocks[(K - 1) - k:(K - 1) - k + t] for k in range(K)], axis=1)  # [t, K, N]
-        v = jnp.einsum("tkc,ck->tc", windows, Hc,
-                       precision=jax.lax.Precision.HIGHEST)  # [t, N]
-        y = mxu_fft.ifft(v) * N                  # ifft across branches (small-n MXU)
+        use_pallas = impl == "pallas" or (
+            impl == "auto" and jax.default_backend() == "tpu")
+        if use_pallas:
+            from .pallas_kernels import pallas_pfb
+            y = pallas_pfb(blocks, Hc.T, precision=precision)      # [t, N]
+        else:
+            # windows[s, k, c] = blocks[s + (K-1) - k, c] (branch c, depth k):
+            # K static slices + stack instead of a gather (slow on TPU)
+            windows = jnp.stack(
+                [blocks[(K - 1) - k:(K - 1) - k + t] for k in range(K)],
+                axis=1)                                            # [t, K, N]
+            prec = (jax.lax.Precision.DEFAULT if precision == "bf16"
+                    else jax.lax.Precision.HIGHEST)
+            v = jnp.einsum("tkc,ck->tc", windows, Hc, precision=prec)  # [t, N]
+            y = mxu_fft.ifft(v, precision=fft_prec) * N    # ifft across branches
         new_hist = ext[ext.shape[0] - (K - 1) * N:]
         return (Hc, new_hist), y.reshape(-1).astype(jnp.complex64)
 
@@ -1430,7 +1583,15 @@ def channelizer_stage(n_channels: int, taps=None, name: str = "channelizer") -> 
         from .xfer import to_device
         return (branch, to_device(np.zeros((K - 1) * N, dtype=np.dtype(dtype))))
 
-    return Stage(fn, init_carry, Fraction(1, 1), np.complex64, N, name)
+    def _lower(p: str) -> Optional[Stage]:
+        if p != "bf16":
+            return None
+        return channelizer_stage(N, taps, name, impl=impl, precision="bf16")
+
+    return Stage(fn, init_carry, Fraction(1, 1), np.complex64, N, name,
+                 lower=_lower,
+                 compute_dtype="bf16" if precision == "bf16" else "f32",
+                 route=(impl, None, precision))
 
 
 def lora_demod_stage(sf: int, name: str = "lora_demod") -> Stage:

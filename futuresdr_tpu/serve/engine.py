@@ -245,8 +245,10 @@ class ServeEngine:
             return program_cost(pipe, fs)
 
         self._warmed: set = set()
+        from ..utils.roofline import dominant_dtype
         self._prof = _profile.register(f"serve:{self.app}",
-                                       cost_thunk=_lane_cost)
+                                       cost_thunk=_lane_cost,
+                                       dtype=dominant_dtype(pipe.stages))
 
     # -- carry plumbing --------------------------------------------------------
     def _fresh_carry(self):

@@ -727,7 +727,21 @@ class Doctor:
             "devchain": devchains or None,
             "roofline": roofline,
             "compile_storms": prof.storm_report() or None,
+            # interior-precision plans (ops/precision.py): per program, the
+            # applied mode, each edge's accum/edge verdict with its MEASURED
+            # SNR, and every decline reason — None until a kernel publishes
+            "precision": _precision_plans() or None,
         }
+
+
+def _precision_plans() -> dict:
+    """Published interior-precision plans, keyed by program name (guarded:
+    the doctor must report even when the ops plane is half-imported)."""
+    try:
+        from ..ops.precision import plans_report
+        return plans_report()
+    except Exception:                                  # noqa: BLE001
+        return {}
 
 
 # ---------------------------------------------------------------------------

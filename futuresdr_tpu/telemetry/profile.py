@@ -100,11 +100,14 @@ class _Program:
     __slots__ = ("name", "_lock", "units", "t_first", "t_last", "cost",
                  "_cost_thunk", "_window_t", "_window_units", "_units_first",
                  "achieved_flops", "achieved_bytes", "mfu",
-                 "hbm_util", "dispatch")
+                 "hbm_util", "dispatch", "compute_dtype")
 
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
+        self.compute_dtype = "f32"      # dominant compute dtype — keys the
+        #   MFU denominator on the right per-dtype chip peak (the tabled
+        #   peaks are bf16 figures; utils/roofline.dtype_peak_flops)
         self.units = 0                  # cost units dispatched (monotonic)
         self._units_first = 0           # units billed by the FIRST dispatch
         self.t_first: Optional[float] = None
@@ -293,17 +296,24 @@ class ProfilePlane:
 
     # -- roofline attribution --------------------------------------------------
     def register(self, program: str, cost: Optional[dict] = None,
-                 cost_thunk=None) -> _Program:
+                 cost_thunk=None, dtype: Optional[str] = None) -> _Program:
         """Get-or-create the program's live entry; an explicit ``cost``
         ({"flops", "bytes"} per unit) binds immediately, ``cost_thunk``
         defers the cost-analysis compile until the plane is read
         (:meth:`ensure_costs`). Re-registration updates the cost source and
-        keeps the dispatch counters (a restart re-inits the same program)."""
+        keeps the dispatch counters (a restart re-inits the same program).
+        ``dtype`` declares the program's dominant compute dtype ("f32"
+        default / "bf16" for interior-precision-lowered programs) — the MFU
+        denominator keys on it (utils/roofline.dtype_peak_flops), so an
+        f32 chain grades against the f32 peak, not the bf16 one it cannot
+        reach."""
         name = str(program)
         with self._lock:
             p = self._programs.get(name)
             if p is None:
                 p = self._programs[name] = _Program(name)
+        if dtype is not None:
+            p.compute_dtype = str(dtype)
         if cost is not None:
             p.cost = {"flops": float(cost["flops"]),
                       "bytes": float(cost["bytes"])}
@@ -386,7 +396,9 @@ class ProfilePlane:
             p.achieved_bytes = rate * p.cost["bytes"]
             if not peaks:
                 continue
-            p.mfu = p.achieved_flops / peaks["flops"]
+            from ..utils.roofline import dtype_peak_flops
+            p.mfu = p.achieved_flops / dtype_peak_flops(peaks,
+                                                        p.compute_dtype)
             p.hbm_util = p.achieved_bytes / peaks["hbm_bytes"]
             MFU.set(p.mfu, program=p.name)
             HBM_UTIL.set(p.hbm_util, program=p.name)
@@ -411,9 +423,16 @@ class ProfilePlane:
                 entry.update({
                     "flops_per_unit": fl, "bytes_per_unit": by,
                     "arith_intensity": round(ai, 4),
+                    "compute_dtype": p.compute_dtype,
                 })
-                if ridge is not None:
-                    entry["bound"] = "hbm" if ai < ridge else "compute"
+                # the peak (and so the ridge) is keyed per program on its
+                # dominant compute dtype: an f32 chain classifies and grades
+                # against the f32 peak (= bf16/2 on the tabled chips)
+                if peaks:
+                    from ..utils.roofline import dtype_peak_flops
+                    pfl = dtype_peak_flops(peaks, p.compute_dtype)
+                    entry["bound"] = ("hbm" if ai < pfl / peaks["hbm_bytes"]
+                                      else "compute")
                 if p.mfu is not None:
                     entry["mfu"] = round(p.mfu, 6)
                     entry["hbm_util"] = round(p.hbm_util, 6)
@@ -427,7 +446,7 @@ class ProfilePlane:
                 if peaks and t0 is not None and t1 is not None and t1 > t0 \
                         and units >= 1:
                     rate = units / (t1 - t0)
-                    entry["mfu_avg"] = round(rate * fl / peaks["flops"], 6)
+                    entry["mfu_avg"] = round(rate * fl / pfl, 6)
                     entry["hbm_util_avg"] = round(
                         rate * by / peaks["hbm_bytes"], 6)
             out[p.name] = entry
@@ -450,6 +469,13 @@ class ProfilePlane:
         with self._lock:
             totals = (self.compiles_total,
                       round(self.compile_seconds_total, 6))
+        try:
+            # guarded like doctor._precision_plans: the profile view must
+            # serve even when the ops plane is half-imported
+            from ..ops.precision import plans_report
+            precision = plans_report()
+        except Exception:                       # noqa: BLE001
+            precision = {}
         return {
             "compiles": compiles,
             "compiles_total": totals[0],
@@ -457,6 +483,9 @@ class ProfilePlane:
             "active_compiles": self.active_compiles(),
             "storms": self.storm_report(),
             "roofline": self.roofline_report(),
+            # interior-precision plans per program (ops/precision.py):
+            # applied mode, per-edge verdicts + measured SNRs, declines
+            "precision": precision,
         }
 
 
@@ -479,8 +508,9 @@ def plane() -> ProfilePlane:
 
 
 def register(program: str, cost: Optional[dict] = None,
-             cost_thunk=None) -> _Program:
-    return plane().register(program, cost=cost, cost_thunk=cost_thunk)
+             cost_thunk=None, dtype: Optional[str] = None) -> _Program:
+    return plane().register(program, cost=cost, cost_thunk=cost_thunk,
+                            dtype=dtype)
 
 
 def compiling(program: str, reason: str, signature: str = "") -> _Compiling:

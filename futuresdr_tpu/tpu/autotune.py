@@ -35,7 +35,8 @@ __all__ = ["autotune", "autotune_streamed", "autotune_serve",
            "default_frames", "measure_link",
            "pick_wire", "StreamedResults", "record_streamed_pick",
            "cached_frames_per_dispatch", "cached_streamed_pick",
-           "record_serve_buckets", "cached_serve_buckets"]
+           "record_serve_buckets", "cached_serve_buckets",
+           "record_interior_precision", "cached_interior_precision"]
 
 log = logger("tpu.autotune")
 
@@ -359,9 +360,10 @@ def _sig_str(sig: tuple) -> str:
 def _norm_entry(v) -> Optional[dict]:
     """Normalize one cache value to ``{"k": int, "inflight": int|None}``
     plus the optional serving-plane ``"serve_buckets"`` slot-bucket ladder
-    (round-15 axis — absent from older entries). Legacy entries
-    (pre-round-14) are bare ints carrying only K; a malformed value returns
-    None (skip the entry — a bad cache line must never fail a launch)."""
+    (round-15 axis) and the applied ``"interior_precision"`` mode (round-17
+    axis — both absent from older entries). Legacy entries (pre-round-14)
+    are bare ints carrying only K; a malformed value returns None (skip the
+    entry — a bad cache line must never fail a launch)."""
     try:
         if isinstance(v, dict):
             fl = v.get("inflight")
@@ -376,6 +378,17 @@ def _norm_entry(v) -> Optional[dict]:
                     buckets = sorted({int(b) for b in sb if int(b) > 0})
                     if buckets:
                         out["serve_buckets"] = buckets
+                except (TypeError, ValueError):
+                    pass
+            ip = v.get("interior_precision")
+            if ip is not None:
+                # same per-axis guard: a malformed precision field (a list,
+                # a typo'd mode) loses only this axis, never the entry's
+                # valid (k, inflight, serve_buckets)
+                try:
+                    mode = str(ip).strip().lower()
+                    if mode in ("off", "auto", "bf16", "int8"):
+                        out["interior_precision"] = mode
                 except (TypeError, ValueError):
                     pass
             return out
@@ -442,16 +455,19 @@ def _record_sig(sig: tuple, frames_per_dispatch: int,
                 inflight: Optional[int] = None) -> None:
     entry = {"k": int(frames_per_dispatch),
              "inflight": int(inflight) if inflight else None}
-    # preserve an orthogonal axis a previous record stamped on this chain
-    # (the serving-plane bucket ladder) — streamed re-tunes must not wipe it
+    # preserve the orthogonal axes a previous record stamped on this chain
+    # (the serving-plane bucket ladder, the applied interior-precision
+    # mode) — streamed re-tunes must not wipe them
     prev = _streamed_cache.get(sig) or _disk_load().get(_sig_str(sig))
     if prev and prev.get("serve_buckets"):
         entry["serve_buckets"] = list(prev["serve_buckets"])
+    if prev and prev.get("interior_precision"):
+        entry["interior_precision"] = prev["interior_precision"]
     _streamed_cache[sig] = entry
     # K-only records persist in the legacy bare-int form (readable by older
     # processes); the dict form is written only when it carries more
     _disk_store(sig, int(frames_per_dispatch)
-                if not inflight and "serve_buckets" not in entry else entry)
+                if not inflight and len(entry) == 2 else entry)
 
 
 def record_streamed_pick(stages, in_dtype, platform: str,
@@ -519,6 +535,42 @@ def cached_serve_buckets(pipeline, in_dtype, platform: str) -> Optional[list]:
     if entry is None:
         return None
     return entry.get("serve_buckets")
+
+
+# ---------------------------------------------------------------------------
+# interior-precision axis (ops/precision.py, docs/tpu_notes.md "Interior
+# precision")
+# ---------------------------------------------------------------------------
+
+def record_interior_precision(stages, in_dtype, platform: str,
+                              mode: str) -> None:
+    """Stamp the APPLIED interior-precision mode into this chain's
+    streamed-pick cache entry — the precision axis rides next to
+    (k, inflight, serve_buckets) under one signature, so a later launch of
+    the same chain knows which lowering the previous tune ran under (a
+    cached K measured on a bf16-lowered program is not comparable to an f32
+    rebuild). Unknown modes are dropped, not stored — the cache must never
+    carry a value :func:`_norm_entry` would strip on the next read."""
+    mode = str(mode).strip().lower()
+    if mode not in ("off", "auto", "bf16", "int8"):
+        return
+    sig = _streamed_sig(_serve_sig_stages(stages), in_dtype, platform)
+    cur = _streamed_cache.get(sig) or _disk_load().get(_sig_str(sig)) \
+        or {"k": 1, "inflight": None}
+    entry = {**cur, "interior_precision": mode}
+    _streamed_cache[sig] = entry
+    _disk_store(sig, entry)
+
+
+def cached_interior_precision(stages, in_dtype,
+                              platform: str) -> Optional[str]:
+    """The interior-precision mode the chain's last recorded tune was
+    measured under; None when never stamped (pre-round-17 entries)."""
+    entry = cached_streamed_pick(_serve_sig_stages(stages), in_dtype,
+                                 platform)
+    if entry is None:
+        return None
+    return entry.get("interior_precision")
 
 
 def autotune_serve(pipeline, frame_size: Optional[int] = None,

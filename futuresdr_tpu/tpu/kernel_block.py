@@ -273,12 +273,14 @@ class TpuKernel(Kernel):
                  frames_in_flight: Optional[int] = None,
                  wire=None, frames_per_dispatch: Optional[int] = None,
                  checkpoint_every: Optional[int] = None,
+                 interior_precision: Optional[str] = None,
                  _pipeline: Optional[Pipeline] = None):
         super().__init__()
         from ..config import config
         self.inst = inst or instance()
         self.pipeline = _pipeline if _pipeline is not None \
             else Pipeline(stages, in_dtype)
+        self._apply_interior_precision(interior_precision)
         fs = frame_size or self.inst.frame_size
         m = self.pipeline.frame_multiple
         self.frame_size = max(m, (fs // m) * m)
@@ -331,6 +333,36 @@ class TpuKernel(Kernel):
             "out", self.pipeline.out_dtype, min_items=self.out_frame,
             min_buffer_size=(self.depth * self.k_batch + 1) * self.out_frame *
             np.dtype(self.pipeline.out_dtype).itemsize)
+
+    def _apply_interior_precision(self, interior_precision=None) -> None:
+        """Interior-precision lowering (ops/precision.py): the SNR-budgeted
+        pass rewrites ``self.pipeline`` BEFORE anything derives from it
+        (frame multiples, out frames, the cost registration). "off" (the
+        default) never touches the object — the bit-identical contract. A
+        failing calibration degrades to f32, never takes the kernel down.
+        Shared by TpuKernel and TpuFanoutKernel construction."""
+        from ..config import config
+        self._base_pipeline = self.pipeline
+        self._precision_mode = str(
+            interior_precision if interior_precision is not None
+            else config().get("interior_precision", "off") or "off")
+        self._precision_overrides: dict = {}
+        self._precision_plan = None
+        if self._precision_mode in ("", "off"):
+            return
+        from ..ops import precision as _precision_mod
+        try:
+            self._precision_overrides = _precision_mod.parse_overrides(
+                config().get("interior_precision_overrides", ""))
+            self.pipeline, self._precision_plan = \
+                _precision_mod.plan_interior_precision(
+                    self.pipeline, mode=self._precision_mode,
+                    overrides=self._precision_overrides)
+        except Exception as e:                 # noqa: BLE001 — degrade to f32
+            log.warning("%s: interior-precision lowering failed (%r); "
+                        "staying f32", type(self).__name__, e)
+            self.pipeline = self._base_pipeline
+            self._precision_plan = None
 
     def _init_hostpath(self) -> None:
         """Host-data-path state shared by TpuKernel and TpuFanoutKernel
@@ -414,6 +446,9 @@ class TpuKernel(Kernel):
             "checkpoint_every": self._ckpt_every,
             "checkpoint_seq": self._ckpts[-1][0] if self._ckpts else -1,
             "replay_log_frames": replay_frames,
+            "interior_precision": self._precision_mode,
+            "interior_lowered": (self._precision_plan.lowered
+                                 if self._precision_plan is not None else 0),
         }
 
     async def init(self, mio, meta):
@@ -497,7 +532,36 @@ class TpuKernel(Kernel):
             from ..utils.roofline import program_cost
             return program_cost(pipe, fs, wire=wn, k=kb)
 
-        self._prof = _profile.register(prog_name, cost_thunk=_program_cost)
+        from ..utils.roofline import dominant_dtype
+        self._prof = _profile.register(prog_name, cost_thunk=_program_cost,
+                                       dtype=dominant_dtype(pipe.stages))
+        # interior-precision observability: the applied plan lands under the
+        # SAME program name the profile plane bills (doctor.report() and the
+        # REST profile view read the registry), and the APPLIED mode rides
+        # the streamed-pick cache next to (k, inflight, serve_buckets) —
+        # recorded unconditionally ("off" included), else a kernel reverted
+        # to off would leave a previous round's "bf16" stamp describing the
+        # wrong program for every later cached-K launch
+        if self._precision_plan is not None:
+            from ..ops import precision as _precision_mod
+            _precision_mod.note_plan(prog_name, self._precision_plan)
+        try:
+            from .autotune import (cached_interior_precision,
+                                   record_interior_precision)
+            sig = self._base_pipeline \
+                if getattr(self._base_pipeline, "n_branches", 0) \
+                else self._base_pipeline.stages
+            mode = self._precision_mode or "off"
+            if mode != "off" or cached_interior_precision(
+                    sig, self.pipeline.in_dtype,
+                    self.inst.platform) is not None:
+                # off-mode kernels only CORRECT an existing entry (a stale
+                # "bf16" from a previous round must not describe an f32
+                # rebuild) — they never create entries for untuned chains
+                record_interior_precision(sig, self.pipeline.in_dtype,
+                                          self.inst.platform, mode)
+        except Exception:                      # noqa: BLE001 — cache only
+            pass
         if self._ckpt_every:
             # fresh-init sentinel: "restore = recompile the init carry" — a
             # fault before the first committed checkpoint replays from the
@@ -516,6 +580,12 @@ class TpuKernel(Kernel):
         from .frames import parse_ctrl
         try:
             stage, params = parse_ctrl(p)
+            if set(params) == {"interior_precision"}:
+                # per-stage precision retune: re-plan + recompile, carry
+                # converted in place (apply_precision_retune docstring)
+                self.apply_precision_retune(stage,
+                                            params["interior_precision"])
+                return Pmt.ok()
             if self._carry is None:
                 # the runtime's init barrier answers pre-init messages itself
                 # (init() compiles the carry eagerly), so this only triggers on
@@ -572,6 +642,156 @@ class TpuKernel(Kernel):
             # group to be STAGED — log the boundary replay must reproduce
             seq = self._staged[0][2] if self._staged else self._seq
             self._retune_log.append((seq, stage, dict(params)))
+
+    def apply_precision_retune(self, stage, precision) -> None:
+        """Per-stage interior-precision retune (the ctrl verb
+        ``{"stage": <name-or-index>, "interior_precision": "off"|"auto"|
+        "bf16"|"int8"}``). Unlike a parameter retune this is a PROGRAM
+        change, so it re-plans the lowering from the pristine pipeline with
+        the stage pinned, recompiles (billed ``reason="reinit"`` on the
+        profile plane — visible, never a silent storm), and CONVERTS the
+        live carry leaf-by-leaf into the new program's dtypes — streaming
+        state (filter history, oscillator phase) survives the precision
+        flip. Frames already in flight finish under the old program; the
+        next dispatch uses the new one. Checkpoints of the old incarnation
+        fail the restore-path dtype integrity check and fall back — honest,
+        never corrupting."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import precision as _precision_mod
+        prec = str(precision)
+        if prec not in ("off", "auto", "bf16", "int8"):
+            raise ValueError(f"interior_precision retune {prec!r}: expected "
+                             f"off|auto|bf16|int8")
+        # resolve the stage against the BASE pipeline (lowering keeps names).
+        # Overrides are NAME-keyed (the config-string contract), so a retune
+        # cannot address one of two same-named stages — reject ambiguity
+        # instead of silently lowering both (update_stage's name rule; an
+        # index resolving to a duplicated name is just the name form in
+        # disguise and gets the same rejection)
+        base = self._base_pipeline
+        names = [s.name for s in base.stages]
+        if isinstance(stage, str):
+            if stage not in names:
+                raise KeyError(f"no stage named {stage!r} in {names}")
+            name = stage
+        else:
+            idx = int(stage)
+            if not 0 <= idx < len(base.stages):
+                raise KeyError(f"stage index {idx} out of range "
+                               f"({len(base.stages)} stages)")
+            name = names[idx]
+        if names.count(name) > 1:
+            raise KeyError(
+                f"stage name {name!r} is ambiguous (appears "
+                f"{names.count(name)}x) — interior-precision overrides are "
+                f"name-keyed; give the stages distinct name= arguments")
+        if self._precision_mode in ("", "off"):
+            # an "off" kernel entering the planner via a single-stage retune
+            # must stay a SINGLE-stage change: pin every other stage "off" so
+            # switching the plan mode to "auto" cannot silently lower the
+            # rest of the chain (later retunes overwrite their own pin)
+            for s in base.stages:
+                self._precision_overrides.setdefault(s.name, "off")
+        self._precision_overrides[name] = prec
+        mode = self._precision_mode if self._precision_mode not in ("", "off") \
+            else "auto"
+        new_pipe, plan = _precision_mod.plan_interior_precision(
+            base, mode=mode, overrides=self._precision_overrides)
+        assert new_pipe.frame_multiple == self.pipeline.frame_multiple, \
+            "lowering must preserve the rate contract"
+        if new_pipe is self.pipeline:
+            # no-op retune (e.g. pinning "off" on an already-off kernel):
+            # the program is unchanged, so no recompile, no mode flip — the
+            # override is kept so a LATER retune of another stage honors it
+            log.info("%s: interior precision retune %s=%s is a no-op "
+                     "(program unchanged)",
+                     getattr(self.meta, "instance_name", None)
+                     or type(self).__name__, name, prec)
+            return
+        if self._carry is None:
+            # pre-init: init() compiles whatever self.pipeline holds
+            self.pipeline = new_pipe
+            self._precision_plan = plan
+            self._precision_mode = mode
+            return
+        old_carry = self._carry
+        prog_name = self.meta.instance_name or type(self).__name__
+        with _profile.compiling(prog_name, "reinit",
+                                f"precision:{name}={prec}"):
+            self._compiled, fresh = new_pipe.compile_wired(
+                self.frame_size, self.wire, device=self.inst.device,
+                k=self.k_batch, donate=self._donate)
+            parts = self.wire.encode_host(
+                np.zeros(self.frame_size, dtype=new_pipe.in_dtype))
+            if self.k_batch > 1:
+                parts = tuple(np.stack([np.asarray(p)] * self.k_batch)
+                              for p in parts)
+            dev = tuple(jax.device_put(np.asarray(p), self.inst.device)
+                        for p in parts)
+            warm_carry, y = self._compiled(fresh, *dev)
+            jax.block_until_ready(y)
+        del warm_carry
+        # convert the LIVE carry into the new program's leaf dtypes: same
+        # stage structure by construction, so the trees match — only leaf
+        # dtypes (bf16 weight matrices) change. Direction matters:
+        # NARROWING (f32→bf16) casts the old leaf, preserving any runtime
+        # parameter retune at exactly the loss the lowering was budgeted
+        # for; WIDENING (bf16→f32) takes the PRISTINE template leaf —
+        # upcasting the old values would freeze the narrow incarnation's
+        # quantization into a program that claims full precision (lowering
+        # only changes PARAMETER leaf dtypes, so the template leaf IS the
+        # full-precision parameter; a tap retune applied under the old
+        # incarnation must be re-sent — logged).
+        template = new_pipe.init_carry()
+        o_leaves, o_def = jax.tree_util.tree_flatten(old_carry)
+        t_leaves, t_def = jax.tree_util.tree_flatten(template)
+        if o_def == t_def and all(
+                np.shape(a) == np.shape(b)
+                for a, b in zip(o_leaves, t_leaves)):
+            from ..ops.xfer import to_device
+            conv, rederived = [], 0
+            for a, b in zip(o_leaves, t_leaves):
+                da = np.dtype(getattr(a, "dtype", np.float32))
+                db = np.dtype(getattr(b, "dtype", np.float32))
+                if da == db:
+                    conv.append(a)
+                elif db.itemsize > da.itemsize:
+                    conv.append(to_device(np.asarray(b), self.inst.device))
+                    rederived += 1
+                else:
+                    conv.append(jnp.asarray(a).astype(db))
+            self._carry = jax.tree_util.tree_unflatten(t_def, conv)
+            if rederived:
+                log.info("%s: precision retune re-derived %d widened "
+                         "parameter leaf(s) from build-time values — "
+                         "re-send any runtime tap/parameter retunes",
+                         prog_name, rederived)
+        else:                                  # pragma: no cover — structure
+            log.warning("%s: precision retune could not convert the live "
+                        "carry (structure changed); streaming state reset",
+                        prog_name)
+            self._carry = jax.device_put(template, self.inst.device)
+        self.pipeline = new_pipe
+        self._precision_plan = plan
+        self._precision_mode = mode
+        _precision_mod.note_plan(prog_name, plan)
+        # the registered cost thunk must describe the NEW program (the old
+        # closure would mis-cost every later MFU gauge); re-registration also
+        # restarts the run-average window at this incarnation
+        fs2, wn2, kb2 = self.frame_size, self.wire.name, self.k_batch
+
+        def _cost():
+            from ..utils.roofline import program_cost
+            return program_cost(new_pipe, fs2, wire=wn2, k=kb2)
+
+        from ..utils.roofline import dominant_dtype
+        self._prof = _profile.register(prog_name, cost_thunk=_cost,
+                                       dtype=dominant_dtype(new_pipe.stages))
+        log.info("%s: interior precision retune %s=%s (lowered %d stage(s), "
+                 "min SNR %s dB)", prog_name, name, prec, plan.lowered,
+                 plan.min_snr_db)
 
     def _apply_replay_retunes(self, seq: int) -> None:
         """Re-apply logged carry surgery at its ORIGINAL dispatch boundary:
@@ -1665,12 +1885,15 @@ class TpuFanoutKernel(TpuKernel):
                  inst: Optional[TpuInstance] = None,
                  frames_in_flight: Optional[int] = None,
                  wire=None, frames_per_dispatch: Optional[int] = None,
-                 checkpoint_every: Optional[int] = None):
+                 checkpoint_every: Optional[int] = None,
+                 interior_precision: Optional[str] = None):
         from ..runtime.kernel import Kernel
         Kernel.__init__(self)
         from ..config import config
         self.inst = inst or instance()
         self.pipeline = fanout
+        self._apply_interior_precision(interior_precision)
+        fanout = self.pipeline            # the (possibly lowered) rebuild
         fs = frame_size or self.inst.frame_size
         m = fanout.frame_multiple
         self.frame_size = max(m, (fs // m) * m)

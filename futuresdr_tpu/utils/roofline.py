@@ -34,7 +34,8 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 __all__ = ["cost_of", "pipeline_roofline", "graph_roofline", "program_cost",
-           "detect_peaks", "PEAKS", "CHIP_PEAKS"]
+           "detect_peaks", "dtype_peak_flops", "dominant_dtype",
+           "PEAKS", "CHIP_PEAKS"]
 
 # public per-chip specs (per chip, bf16 matmul peak FLOP/s + HBM B/s)
 CHIP_PEAKS = {
@@ -72,14 +73,49 @@ def _kind_to_chip(kind: str) -> Optional[str]:
     return None
 
 
-def detect_peaks(backend: Optional[str] = None) -> Optional[dict]:
+def dtype_peak_flops(peaks: dict, dtype: Optional[str] = None) -> float:
+    """The MFU flops denominator for a program whose dominant compute dtype
+    is ``dtype``. The tabled peaks (and the config ``peak_flops`` override —
+    config.py documents it as the bf16 matmul peak) are BF16 figures; f32
+    matmuls lower to multiple bf16 passes on every tabled chip, so the f32
+    peak is half. Keying the denominator on the program's dtype stops
+    f32-dominant chains from grading themselves against a peak they cannot
+    reach (5.6% of bf16-peak is 11.2% of the f32 peak the chain actually
+    runs against — the headroom claim changes materially)."""
+    f = float(peaks["flops"])
+    return f if str(dtype or "bf16") == "bf16" else f / 2.0
+
+
+def dominant_dtype(stages) -> str:
+    """``"bf16"`` when any stage of the (possibly lowered) chain accumulates
+    in bf16, or the process-wide MXU FFT precision policy is bf16; else
+    ``"f32"`` — the per-program key for :func:`dtype_peak_flops`."""
+    try:
+        from ..ops import mxu_fft
+        if mxu_fft._precision == "bf16":
+            return "bf16"
+    except Exception:                                   # noqa: BLE001
+        pass
+    for s in stages:
+        if getattr(s, "compute_dtype", "f32") == "bf16":
+            return "bf16"
+    return "f32"
+
+
+def detect_peaks(backend: Optional[str] = None,
+                 dtype: Optional[str] = None) -> Optional[dict]:
     """Resolve ``{"flops", "hbm_bytes", "chip"}`` for MFU accounting.
 
     Layering (module docstring): both config overrides set → pure-config
     peaks; a live TPU device → its ``device_kind`` against the public table
     (single-axis overrides still apply; an unknown kind returns None —
     degrade, don't guess); else the ``backend`` LABEL against the historical
-    :data:`PEAKS` mapping. None disables MFU/HBM-util output entirely."""
+    :data:`PEAKS` mapping. None disables MFU/HBM-util output entirely.
+
+    ``dtype`` keys the flops figure on the program's dominant compute dtype
+    (:func:`dtype_peak_flops`): ``"f32"`` halves the tabled bf16 peak and
+    stamps ``"dtype"`` on the result; ``None``/``"bf16"`` keeps the tabled
+    figure (back-compatible)."""
     from ..config import config
     c = config()
     try:
@@ -90,8 +126,17 @@ def detect_peaks(backend: Optional[str] = None) -> Optional[dict]:
         pb = float(c.get("peak_hbm_gbps", 0) or 0)
     except (TypeError, ValueError):
         pb = 0.0
+    def _keyed(out: dict) -> dict:
+        # per-dtype denominator: applied LAST so it scales whatever source
+        # won (table, label, or the config override — all bf16 figures)
+        if dtype is not None:
+            out = dict(out)
+            out["flops"] = dtype_peak_flops(out, dtype)
+            out["dtype"] = str(dtype)
+        return out
+
     if pf > 0 and pb > 0:
-        return {"flops": pf, "hbm_bytes": pb * 1e9, "chip": "config"}
+        return _keyed({"flops": pf, "hbm_bytes": pb * 1e9, "chip": "config"})
 
     def _overridden(p: dict, chip: str) -> dict:
         out = dict(p)
@@ -114,12 +159,12 @@ def detect_peaks(backend: Optional[str] = None) -> Optional[dict]:
                 # analysis convention for CPU hosts. Pin the denominator on
                 # an unknown chip with peak_flops/peak_hbm_gbps instead.
                 return None
-            return _overridden(CHIP_PEAKS[chip], chip)
+            return _keyed(_overridden(CHIP_PEAKS[chip], chip))
     except Exception:                                   # noqa: BLE001 — peak
         pass                                            # lookup is best-effort
     p = PEAKS.get(str(backend or ""))
     if p is not None:
-        return _overridden(p, "v5e")
+        return _keyed(_overridden(p, "v5e"))
     return None
 
 
@@ -182,6 +227,10 @@ def _stage_marker(s) -> tuple:
     return (str(getattr(s, "name", "?")), str(getattr(s, "ratio", "")),
             str(getattr(s, "out_dtype", None)),
             int(getattr(s, "frame_multiple", 1) or 1), lti_m,
+            # per-call-site route pins (impl, fft_impl, precision): two
+            # same-shape stages on different routes compile different-cost
+            # programs and must not share a cost-cache line
+            getattr(s, "route", None),
             # MergeStage extras (None for plain stages): input count + mode
             getattr(s, "k", None), getattr(s, "mode", None))
 
@@ -280,7 +329,8 @@ def pipeline_roofline(stages: Sequence, in_dtype, frame: int,
         prev = cost
     out["flops_per_sample"] = prev["flops"] / frame
     out["bytes_per_sample"] = prev["bytes"] / frame
-    _finish_roofline(out, out["stages"], rate_sps, backend)
+    _finish_roofline(out, out["stages"], rate_sps, backend,
+                     dominant_dtype(stages))
     return out
 
 
@@ -347,14 +397,19 @@ def graph_roofline(pipeline, frame: Optional[int] = None,
         prev = cost
     out["flops_per_sample"] = prev["flops"] / frame
     out["bytes_per_sample"] = prev["bytes"] / frame
-    _finish_roofline(out, out["nodes"], rate_sps, backend)
+    _finish_roofline(out, out["nodes"], rate_sps, backend,
+                     dominant_dtype(pipeline.stages))
     return out
 
 
-def _finish_roofline(out: dict, entries, rate_sps, backend: str) -> None:
+def _finish_roofline(out: dict, entries, rate_sps, backend: str,
+                     dtype: Optional[str] = None) -> None:
     """Shared tail of the per-stage/per-node walks: bound classification
-    against the detected chip ridge + achieved-rate fields."""
-    peak = detect_peaks(backend)
+    against the detected chip ridge + achieved-rate fields, with the MFU
+    denominator keyed on the chain's dominant compute dtype."""
+    peak = detect_peaks(backend, dtype=dtype)
+    if dtype is not None:
+        out["compute_dtype"] = str(dtype)
     if peak:
         ridge = peak["flops"] / peak["hbm_bytes"]     # flop/byte ridge point
         for s in entries:

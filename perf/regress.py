@@ -54,7 +54,12 @@ FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
                        # live profile plane (telemetry/profile.py): the
                        # streamed kernel's run-average utilization — the
                        # MFU ROADMAP item's regress-graded substrate
-                       "live_mfu", "live_hbm_util", "mfu", "hbm_util")
+                       "live_mfu", "live_hbm_util", "mfu", "hbm_util",
+                       # interior-precision plane (perf/precision_ab.py):
+                       # the auto-lowered resident rate and its pinned SNR
+                       # floor — a rate win that costs SNR below reference
+                       # flags here, not just in the smoke's absolute gate
+                       "resident_lowered_msps", "interior_snr_db_min")
 # lower-is-better fields (fractions, not rates): regression = the value ROSE
 # past the reference by more than the absolute slack below — e.g. the
 # carry-checkpoint cost of the device-plane recovery contract creeping up

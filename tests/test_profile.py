@@ -165,23 +165,37 @@ def test_live_gauge_math(monkeypatch):
     p.dispatch(4, t=time.monotonic())     # step); the hook stays bare
     pl.update_live_gauges(min_interval=0.0)
     assert p.mfu is not None and p.mfu > 0
-    # flops/peak_flops = 2e9/1e12 = 2e-3 per unit-rate; bytes/peak_bw =
-    # 1e8/1e11 = 1e-3 — mfu must be exactly 2x hbm_util (same window)
-    assert p.mfu == pytest.approx(2 * p.hbm_util, rel=1e-6)
+    # the config peak_flops is the BF16 matmul peak; an unlowered program
+    # defaults to compute_dtype="f32" whose peak is half (per-dtype chip
+    # peaks, utils/roofline.dtype_peak_flops): flops/(peak/2) = 2e9/5e11 =
+    # 4e-3 per unit-rate; bytes/peak_bw = 1e8/1e11 = 1e-3 — mfu must be
+    # exactly 4x hbm_util (same window)
+    assert p.compute_dtype == "f32"
+    assert p.mfu == pytest.approx(4 * p.hbm_util, rel=1e-6)
     assert profile.MFU.get(program="t-gauge-math") == pytest.approx(p.mfu)
     # run-average lands in the roofline report with bound classification
     rep = pl.roofline_report()
     entry = rep["programs"]["t-gauge-math"]
     assert entry["units"] == 8
+    assert entry["compute_dtype"] == "f32"
     assert entry["mfu_avg"] > 0
     # the run average spans first..last dispatch and the FIRST call's units
     # mark the left edge: rate = (8 - 4) / (t_last - t_first), not 8/dt —
     # units/(units-1) inflation on short runs is the bug this pins
     dt = p.t_last - p.t_first
-    want = (4 / dt) * 2e9 / 1e12
+    want = (4 / dt) * 2e9 / (1e12 / 2)
     assert entry["mfu_avg"] == pytest.approx(want, rel=1e-3)
-    # arith intensity 2e9/1e8 = 20 flop/B vs ridge 1e12/1e11 = 10 → compute
+    # arith intensity 2e9/1e8 = 20 flop/B vs the f32 ridge 5e11/1e11 = 5
+    # → compute
     assert entry["bound"] == "compute"
+    # a bf16-lowered program re-registered with dtype="bf16" grades against
+    # the FULL tabled peak: same dispatch record, half the mfu
+    pl.register("t-gauge-math", cost={"flops": 2e9, "bytes": 1e8},
+                dtype="bf16")
+    rep2 = pl.roofline_report()
+    e2 = rep2["programs"]["t-gauge-math"]
+    assert e2["compute_dtype"] == "bf16"
+    assert e2["mfu_avg"] == pytest.approx(want / 2, rel=1e-3)
 
 
 def test_dispatch_hook_bound_before_first_call_advances_window(monkeypatch):
