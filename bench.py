@@ -1007,7 +1007,10 @@ def main():
             print(f"# serving A/B: {serve_extra['serve_sessions_per_chip']} "
                   f"sessions/chip ({serve_extra['serve_speedup']}x vs "
                   f"independent at N={serve_extra['serve_sessions']}), "
-                  f"churn p99 {serve_extra['serve_p99_under_churn_ms']} ms",
+                  f"churn p99 {serve_extra['serve_p99_under_churn_ms']} ms, "
+                  f"restart resume frac "
+                  f"{serve_extra.get('serve_restart_resume_frac')}, "
+                  f"storm p99 {serve_extra.get('serve_shed_p99_ms')} ms",
                   file=sys.stderr)
         except Exception as e:                          # noqa: BLE001
             print(f"# serving A/B unavailable: {e!r}", file=sys.stderr)

@@ -54,15 +54,21 @@ echo "== multi-tenant serving gate (docs/serving.md) =="
 # N sessions of one receiver chain through a single vmapped dispatch per
 # frame: dispatches/frame == 1 regardless of the active session count,
 # session join/leave under load causes ZERO recompiles of resident slot
-# buckets, and the sessions/chip ratio vs independent per-session dispatch
-# loops clears the smoke floor
+# buckets, the sessions/chip ratio vs independent per-session dispatch
+# loops clears the smoke floor, a simulated crash-restart with durable
+# persistence resumes 100% of sessions bit-identically
+# (serve_restart_resume_frac == 1.0), and an admission storm sheds
+# newcomers while residents keep delivering (serve_shed_p99_ms stamped)
 JAX_PLATFORMS=cpu python perf/serve_ab.py --smoke
 
 echo "== chaos smoke (docs/robustness.md invariants) =="
 # seeded fault injection at every site × every failure policy on the CPU
 # backend: restart recovers bit-correct, isolate finishes independent
 # branches, fail_fast keeps today's behavior, transfer retries are
-# deterministic, and no run hangs past its deadline or leaks threads
+# deterministic, no run hangs past its deadline or leaks threads — plus
+# the serving plane: SIGKILL mid-serve + restart resumes every persisted
+# session bit-identically (serve-crash-restart) and an overload storm
+# sheds only via the documented ladder (serve-overload-shed)
 JAX_PLATFORMS=cpu python perf/chaos.py --smoke
 
 echo "== perf-regression gate (non-fatal; perf/regress.py vs BENCH_r*.json) =="

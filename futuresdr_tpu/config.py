@@ -132,6 +132,39 @@ class Config:
     #   REST plane (a faulted client rarely comes back to DELETE); the oldest
     #   beyond this are forgotten so fault churn cannot grow the registry
     #   without bound
+    # Crash-safe serving (docs/robustness.md "Serving-plane recovery"):
+    # durable per-session carry snapshots, drain lifecycle and the SLO-aware
+    # overload-shedding ladder of the serving engine.
+    serve_persist_dir: str = ""            # durable session state: per-slot
+    #   carry snapshots land here (atomic rename + CRC, keyed by session id
+    #   + pipeline-signature hash — utils/snapshot.py) and a VIRGIN
+    #   ServeEngine incarnation re-admits every persisted session
+    #   bit-identically. "" = off (default)
+    serve_persist_every: int = 0           # persistence cadence in serving
+    #   steps: every Nth step() queues a background snapshot of every lane
+    #   (one falsy check when 0 = off — step() stays inside the ≤3%
+    #   telemetry overhead budget); evictions and drains persist regardless
+    serve_slo_ms: float = 0.0              # per-frame submit→result latency
+    #   SLO driving the shedding ladder (serve/overload.py); 0 = ladder
+    #   driven by queue pressure only
+    serve_shed_hi: float = 0.85            # queue-pressure high watermark:
+    #   consecutive steps at/above it escalate the shedding ladder one rung
+    serve_shed_lo: float = 0.50            # low watermark: the ladder only
+    #   unwinds (one rung at a time — hysteretic recovery) after sustained
+    #   pressure at/below it
+    serve_shed_trip: int = 3               # consecutive over-watermark/SLO
+    #   steps per one-rung escalation
+    serve_shed_clear: int = 8              # consecutive healthy steps per
+    #   one-rung unwind
+    serve_brownout: str = "off"            # optional third shedding rung
+    #   under sustained overload: "off" (default — rungs 1-2 only, both
+    #   bit-exact for residents) | "k" (drop megabatch K to 1 on resident
+    #   buckets — latency over throughput; K>1 vs K=1 round differently by
+    #   repo contract) | "precision" (retune interior precision to bf16 via
+    #   ops/precision.py — SNR-bounded quality loss for the duration)
+    serve_drain_on_sigterm: bool = False   # register_app installs a SIGTERM
+    #   hook that drains every registered serving app (refuse admissions,
+    #   finish in-flight, persist all lanes) — the rolling-restart contract
     # Interior precision (ops/precision.py, docs/tpu_notes.md "Interior
     # precision"): SNR-budgeted lowering of interior DAG edges and stage
     # accumulation inside the fused device programs. "off" (default) is

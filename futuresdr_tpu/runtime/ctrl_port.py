@@ -26,7 +26,16 @@ plus the multi-tenant serving session plane (docs/serving.md, merged from
 ``futuresdr_tpu/serve/api.py``):
 
   GET/POST/DELETE /api/serve/...  → serving apps, session admit/evict/
-                                    readmit/leave, per-session metrics views
+                                    readmit/leave, per-session metrics views,
+                                    graceful drain (POST .../drain/)
+
+plus the orchestrator lifecycle endpoints on EVERY control port (rolling
+restarts, docs/serving.md "Lifecycle"):
+
+  GET /healthz   → liveness (the event loop answers)
+  GET /readyz    → readiness: serving apps compiled + not draining, no
+                   serving-program compile storm on the profile plane (503 + Retry-After
+                   otherwise)
 
 Pmt values are serialized with the same externally-tagged JSON as the reference's serde.
 CORS is permissive (including on error responses raised as ``web.HTTPException``);
@@ -133,6 +142,18 @@ class ControlPort:
                 app.router.add_route(method, path, handler)
         except Exception as e:             # noqa: BLE001 — optional plane
             log.warning("serve session plane unavailable: %r", e)
+
+            # the lifecycle endpoints must exist on EVERY control port even
+            # with the serve plane unimportable — an orchestrator's probes
+            # are not optional; with no serving apps the process is ready
+            async def _healthz_fallback(request):
+                return web.json_response({"ok": True})
+
+            async def _readyz_fallback(request):
+                return web.json_response({"ready": True, "apps": {}})
+
+            app.router.add_get("/healthz", _healthz_fallback)
+            app.router.add_get("/readyz", _readyz_fallback)
         for method, path, handler in self.extra_routes:
             app.router.add_route(method, path, handler)
         import os

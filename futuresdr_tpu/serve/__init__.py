@@ -8,23 +8,31 @@ per-tenant fair credits (:mod:`.credits`) and the REST session plane
 """
 
 from .credits import TenantCreditController
-from .slots import ServeFull, Session, SlotTable
+from .overload import ShedLadder
+from .slots import (ServeDraining, ServeFull, ServeOverload, Session,
+                    SlotTable)
 from .api import apps, get_app, register_app, routes, unregister_app
 
-__all__ = ["ServeEngine", "ServeFull", "Session", "SlotTable",
+__all__ = ["ServeEngine", "ServeFull", "ServeDraining", "ServeOverload",
+           "Session", "SlotTable", "SessionStore", "ShedLadder",
            "TenantCreditController", "build_slot_program", "default_buckets",
+           "install_sigterm_drain", "drain_all_apps",
            "register_app", "unregister_app", "get_app", "apps", "routes"]
 
 #: engine symbols resolve lazily: the control port merges the REST session
 #: plane into every server, and the HOST-only runtime must not pay the jax
 #: import the engine's compute plane needs just for that
-_LAZY_ENGINE = {"ServeEngine", "build_slot_program", "default_buckets"}
+_LAZY_ENGINE = {"ServeEngine", "build_slot_program", "default_buckets",
+                "install_sigterm_drain", "drain_all_apps", "SessionStore"}
 
 
 def __getattr__(name):
     if name in _LAZY_ENGINE:
-        from . import engine
-        val = getattr(engine, name)
+        if name == "SessionStore":
+            from .persist import SessionStore as val
+        else:
+            from . import engine
+            val = getattr(engine, name)
         globals()[name] = val
         return val
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

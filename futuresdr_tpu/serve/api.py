@@ -13,6 +13,21 @@ port automatically (plus available as ``routes()`` for a bespoke server):
   POST   /api/serve/{app}/session/{sid}/evict/   → evict carry to host
   POST   /api/serve/{app}/session/{sid}/readmit/ → restore it bit-identically
   DELETE /api/serve/{app}/session/{sid}/     → leave
+  POST   /api/serve/{app}/drain/             → graceful drain (refuse
+                                               admissions, finish in-flight,
+                                               persist all lanes)
+
+plus the orchestrator lifecycle endpoints the control port mounts at the
+server root (docs/serving.md "Lifecycle"):
+
+  GET /healthz  → liveness (the process answers)
+  GET /readyz   → readiness: every registered serving app compiled, not
+                  draining, and the profile plane reports no serving-program storm
+                  (503 + Retry-After otherwise)
+
+Error responses are structured JSON (``{"error": ..., "app": ...}``), and
+every 503 (ServeFull / draining / overload shed) carries a ``Retry-After``
+header derived from the engine's measured step rate.
 
 Engines register under an app name via :func:`register_app` (usually at
 construction by the app's serving loop); the registry is process-global,
@@ -27,7 +42,8 @@ from typing import Dict, List, Optional, Tuple
 from ..log import logger
 from .slots import ServeFull
 
-__all__ = ["register_app", "unregister_app", "get_app", "apps", "routes"]
+__all__ = ["register_app", "unregister_app", "get_app", "apps", "routes",
+           "readiness", "healthz", "readyz"]
 
 log = logger("serve.api")
 
@@ -40,10 +56,19 @@ _lock = threading.Lock()
 
 def register_app(engine, name: Optional[str] = None) -> str:
     """Register a :class:`~futuresdr_tpu.serve.engine.ServeEngine` under an
-    app name (default: its own ``app``)."""
+    app name (default: its own ``app``). With config
+    ``serve_drain_on_sigterm`` set, the first registration also installs
+    the SIGTERM graceful-drain hook (rolling-restart lifecycle)."""
     name = str(name or engine.app)
     with _lock:
         _apps[name] = engine
+    try:
+        from ..config import config
+        if config().get("serve_drain_on_sigterm", False):
+            from .engine import install_sigterm_drain
+            install_sigterm_drain()
+    except Exception as e:                 # noqa: BLE001 — lifecycle sugar
+        log.warning("sigterm drain hook unavailable: %r", e)
     return name
 
 
@@ -76,12 +101,35 @@ async def _call(fn, *args, **kw):
         None, functools.partial(fn, *args, **kw))
 
 
+def _json_error(app: Optional[str], message: str, status: int,
+                retry_after: Optional[int] = None):
+    """Structured JSON error body (``{"error": ..., "app": ...}``) with the
+    ``Retry-After`` header on backpressure statuses — a client or load
+    balancer reads WHEN to come back instead of hammering a 503."""
+    from aiohttp import web
+    headers = {"Retry-After": str(int(retry_after))} \
+        if retry_after is not None else None
+    return web.json_response({"error": message, "app": app},
+                             status=status, headers=headers)
+
+
+def _serve_full(eng, name: str, e: BaseException):
+    """503 for ServeFull/ServeDraining/ServeOverload, Retry-After derived
+    from the engine's measured step rate."""
+    try:
+        after = int(eng.retry_after_s())
+    except Exception:                      # noqa: BLE001 — header is advisory
+        after = 1
+    return _json_error(name, str(e), 503, retry_after=after)
+
+
 def _engine_or_404(request):
     from aiohttp import web
-    eng = get_app(request.match_info["app"])
+    name = request.match_info["app"]
+    eng = get_app(name)
     if eng is None:
         raise web.HTTPNotFound(
-            text='{"error": "serving app not found"}',
+            text='{"error": "serving app not found", "app": "%s"}' % name,
             content_type="application/json")
     return eng
 
@@ -91,7 +139,8 @@ async def _list_apps(request):
     return web.json_response(
         {name: {"sessions": len(eng.table.sessions),
                 "active": eng.table.active,
-                "capacity": eng.capacity}
+                "capacity": eng.capacity,
+                "draining": bool(getattr(eng, "draining", False))}
          for name, eng in sorted(apps().items())})
 
 
@@ -103,19 +152,20 @@ async def _describe_app(request):
 async def _create_session(request):
     from aiohttp import web
     eng = _engine_or_404(request)
+    name = request.match_info["app"]
     body = {}
     if request.can_read_body:
         try:
             body = await request.json()
         except Exception:                  # noqa: BLE001 — bad JSON → 400
-            return web.json_response({"error": "bad json body"}, status=400)
+            return _json_error(name, "bad json body", 400)
     tenant = str(body.get("tenant", "default"))
     try:
         s = await _call(eng.admit, tenant=tenant, sid=body.get("sid"))
     except ServeFull as e:
-        return web.json_response({"error": str(e)}, status=503)
+        return _serve_full(eng, name, e)
     except ValueError as e:
-        return web.json_response({"error": str(e)}, status=409)
+        return _json_error(name, str(e), 409)
     return web.json_response(s.view(), status=201)
 
 
@@ -126,32 +176,35 @@ async def _session_view(request):
         return web.json_response(
             await _call(eng.session_view, request.match_info["sid"]))
     except KeyError:
-        return web.json_response({"error": "session not found"}, status=404)
+        return _json_error(request.match_info["app"], "session not found",
+                           404)
 
 
 async def _session_evict(request):
     from aiohttp import web
     eng = _engine_or_404(request)
+    name = request.match_info["app"]
     try:
         s = await _call(eng.evict, request.match_info["sid"])
     except KeyError:
-        return web.json_response({"error": "session not found"}, status=404)
+        return _json_error(name, "session not found", 404)
     except ValueError as e:
-        return web.json_response({"error": str(e)}, status=409)
+        return _json_error(name, str(e), 409)
     return web.json_response(s.view())
 
 
 async def _session_readmit(request):
     from aiohttp import web
     eng = _engine_or_404(request)
+    name = request.match_info["app"]
     try:
         s = await _call(eng.readmit, request.match_info["sid"])
     except KeyError:
-        return web.json_response({"error": "session not found"}, status=404)
+        return _json_error(name, "session not found", 404)
     except ServeFull as e:
-        return web.json_response({"error": str(e)}, status=503)
+        return _serve_full(eng, name, e)
     except ValueError as e:
-        return web.json_response({"error": str(e)}, status=409)
+        return _json_error(name, str(e), 409)
     return web.json_response(s.view())
 
 
@@ -161,8 +214,84 @@ async def _session_delete(request):
     try:
         await _call(eng.close, request.match_info["sid"])
     except KeyError:
-        return web.json_response({"error": "session not found"}, status=404)
+        return _json_error(request.match_info["app"], "session not found",
+                           404)
     return web.json_response({"ok": True})
+
+
+async def _drain_app(request):
+    """``POST /api/serve/{app}/drain/``: graceful drain — refuse new
+    admissions (503 + Retry-After), finish in-flight megabatch groups,
+    persist every live lane, report drained. Runs off the event loop (the
+    pump steps the engine); body ``{"pump": false}`` only MARKS draining
+    for apps with their own pump thread, ``{"timeout": s}`` bounds the
+    pump."""
+    from aiohttp import web
+    eng = _engine_or_404(request)
+    name = request.match_info["app"]
+    body = {}
+    if request.can_read_body:
+        try:
+            body = await request.json()
+        except Exception:                  # noqa: BLE001
+            body = {}
+    try:
+        report = await _call(eng.drain,
+                             pump=bool(body.get("pump", True)),
+                             timeout=float(body.get("timeout", 30.0)))
+    except Exception as e:                 # noqa: BLE001 — drain must report
+        return _json_error(name, f"drain failed: {e!r}", 500)
+    return web.json_response(report)
+
+
+# -- orchestrator lifecycle (healthz/readyz) ----------------------------------
+
+def readiness() -> Tuple[bool, dict]:
+    """Process readiness for ``GET /readyz``: every registered serving app
+    ready (current bucket compiled, not draining) AND no live SERVING-
+    program compile storm on the profile plane. Detail names the unready app/reason so an
+    operator reads WHY a pod is out of rotation."""
+    detail: Dict[str, dict] = {}
+    ready = True
+    for name, eng in sorted(apps().items()):
+        try:
+            h = eng.health()
+        except Exception as e:             # noqa: BLE001 — an engine that
+            h = {"ready": False, "error": repr(e)}     # cannot answer is
+        detail[name] = h                               # not ready
+        ready = ready and bool(h.get("ready"))
+    storms = None
+    try:
+        from ..telemetry import profile
+        # SERVING-program storms only ("serve:<app>" labels): the plane is
+        # process-global and flowgraph instance names collide across runs
+        # by design, so an unrelated kernel's recompile churn must not pull
+        # this pod out of rotation — a churning slot-bucket ladder must
+        storms = [s for s in profile.plane().storm_report()
+                  if str(s.get("program", "")).startswith("serve:")] or None
+    except Exception:                      # noqa: BLE001 — profile plane
+        pass                               # absence must not fail readiness
+    if storms:
+        ready = False
+    return ready, {"apps": detail, "compile_storms": storms}
+
+
+async def healthz(request):
+    """Liveness: the process (and its control-port event loop) answers."""
+    from aiohttp import web
+    return web.json_response({"ok": True})
+
+
+async def readyz(request):
+    """Readiness for rolling restarts: 200 only when every serving app is
+    compiled + not draining with no serving-program compile storm;
+    503 (+ Retry-After) otherwise so an orchestrator holds traffic."""
+    from aiohttp import web
+    ready, detail = readiness()
+    if ready:
+        return web.json_response({"ready": True, **detail})
+    return web.json_response({"ready": False, **detail}, status=503,
+                             headers={"Retry-After": "1"})
 
 
 def routes() -> List[Tuple[str, str, object]]:
@@ -176,4 +305,7 @@ def routes() -> List[Tuple[str, str, object]]:
         ("POST", "/api/serve/{app}/session/{sid}/evict/", _session_evict),
         ("POST", "/api/serve/{app}/session/{sid}/readmit/", _session_readmit),
         ("DELETE", "/api/serve/{app}/session/{sid}/", _session_delete),
+        ("POST", "/api/serve/{app}/drain/", _drain_app),
+        ("GET", "/healthz", healthz),
+        ("GET", "/readyz", readyz),
     ]

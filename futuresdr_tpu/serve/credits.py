@@ -82,6 +82,22 @@ class TenantCreditController:
         with self._lock:
             return dict(self._used)
 
+    def pressure(self) -> float:
+        """Aggregate queue pressure in [0, 1]: outstanding credits over the
+        shared budget — the shedding ladder's primary signal
+        (serve/overload.py)."""
+        with self._lock:
+            return min(1.0, sum(self._used.values()) / float(self._total))
+
+    def tenant_pressure(self) -> Dict[str, float]:
+        """Per-tenant queue-depth watermark view: each tenant's outstanding
+        credits over its fair share (>1 = borrowing past the guarantee).
+        Served on the engine's describe() so an operator sees WHICH tenant
+        is driving the ladder."""
+        with self._lock:
+            fair = float(self._fair())
+            return {t: round(u / fair, 4) for t, u in self._used.items()}
+
     # -- the credit operations ------------------------------------------------
     def try_acquire(self, tenant: str) -> bool:
         """Grant one credit to ``tenant`` or refuse.

@@ -45,9 +45,12 @@ contract, test-pinned).
 
 from __future__ import annotations
 
+import math
+import os
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -58,9 +61,13 @@ from ..telemetry import profile as _profile
 from ..telemetry import prom as _prom
 from ..telemetry.spans import recorder as _trace_recorder
 from .credits import TenantCreditController
-from .slots import ServeFull, Session, SlotTable
+from .overload import ShedLadder
+from .persist import SessionStore
+from .slots import (ServeDraining, ServeFull, ServeOverload, Session,
+                    SlotTable)
 
-__all__ = ["ServeEngine", "ServeFull", "default_buckets"]
+__all__ = ["ServeEngine", "ServeFull", "ServeDraining", "ServeOverload",
+           "default_buckets", "install_sigterm_drain"]
 
 log = logger("serve.engine")
 _trace = _trace_recorder()
@@ -92,6 +99,19 @@ _REJECTS = _prom.counter(
 _LATENCY = _prom.histogram(
     "fsdr_serve_latency_seconds",
     "submit -> decoded-result latency per frame", ("app", "tenant"))
+_SHED = _prom.counter(
+    "fsdr_serve_shed_total",
+    "overload/drain shedding actions by the serving engine "
+    "(reason: admission | evict | brownout | drain)",
+    ("app", "tenant", "reason"))
+_SHED_LEVEL = _prom.gauge(
+    "fsdr_serve_shed_level",
+    "current shedding-ladder rung (0 ok, 1 admission, 2 evict, 3 brownout)",
+    ("app",))
+_RESUMED = _prom.counter(
+    "fsdr_serve_resumed_total",
+    "sessions re-admitted from durable snapshots by a fresh incarnation",
+    ("app", "tenant"))
 
 
 def default_buckets() -> tuple:
@@ -190,10 +210,14 @@ class ServeEngine:
                  app: str = "serve", inst=None,
                  buckets: Optional[Sequence[int]] = None,
                  queue_frames: Optional[int] = None,
-                 frames_per_dispatch: int = 1):
+                 frames_per_dispatch: int = 1,
+                 persist_dir: Optional[str] = None,
+                 persist_every: Optional[int] = None,
+                 slo_ms: Optional[float] = None):
         from ..config import config
         from ..tpu.instance import instance
         self.pipeline = pipeline
+        self._base_pipeline = pipeline     # pre-brownout program identity
         self.app = str(app)
         self.inst = inst or instance()
         self.k_batch = max(1, int(frames_per_dispatch))
@@ -206,10 +230,12 @@ class ServeEngine:
             buckets = self._cached_buckets()
         self.buckets = tuple(sorted({int(b) for b in buckets})) \
             if buckets else default_buckets()
-        #: compiled serving programs per resident bucket capacity — the
+        #: compiled serving programs keyed (capacity, k, pipeline tag) — the
         #: session-churn contract is that this map only ever GAINS entries
-        #: (join/leave/stall/evict inside resident buckets never recompiles)
-        self._programs: Dict[int, object] = {}
+        #: (join/leave/stall/evict inside resident buckets never recompiles;
+        #: the k/tag axes exist for the brownout lever, which is a DOCUMENTED
+        #: program change, never churn)
+        self._programs: Dict[tuple, object] = {}
         self.compiles = 0                 # program builds (the recompile pin)
         self.table = SlotTable(self.buckets[0])
         self._fresh = None                # fresh single-lane carry template
@@ -249,6 +275,49 @@ class ServeEngine:
         self._prof = _profile.register(f"serve:{self.app}",
                                        cost_thunk=_lane_cost,
                                        dtype=dominant_dtype(pipe.stages))
+        # -- crash safety + lifecycle + overload control (this PR) ---------
+        # durable session state (docs/robustness.md "Serving-plane
+        # recovery"): per-slot carry snapshots under serve_persist_dir,
+        # background cadence serve_persist_every (0 = off and free — one
+        # falsy check per step)
+        d = persist_dir if persist_dir is not None \
+            else config().get("serve_persist_dir", "")
+        d = str(d or "")
+        self._store = SessionStore(d, self.app, pipeline) if d else None
+        self._persist_every = max(0, int(
+            persist_every if persist_every is not None
+            else config().get("serve_persist_every", 0)))
+        self._steps_since_persist = 0
+        # graceful lifecycle: draining refuses admissions, finishes
+        # in-flight groups, persists all lanes; drained is terminal-ish
+        # (a new incarnation, not this one, serves the next wave)
+        self._draining = False
+        self._drained = False
+        # SLO-aware overload shedding (serve/overload.py): queue-pressure
+        # watermarks + latency deadline budget drive the hysteretic ladder
+        self._slo_ms = float(slo_ms if slo_ms is not None
+                             else config().get("serve_slo_ms", 0.0))
+        self._ladder = ShedLadder.from_config()
+        self._brownout = str(config().get("serve_brownout", "off") or "off")
+        self._brownout_active = False
+        self._low_pipe = None              # lazily-planned bf16 brownout form
+        self._pipe_tag = "base"            # program-cache axis for brownout
+        self._base_dt = None               # base-pipeline leaf dtypes (lazy)
+        self._lat_recent: Deque[float] = deque(maxlen=128)   # seconds
+        self._step_stamps: Deque[float] = deque(maxlen=32)   # busy-step times
+        self.restored_sessions = 0         # persisted sessions re-admitted
+        self.shed_evictions = 0            # ladder rung-2 evictions
+        # doctor coverage: the engine registers with the process-global
+        # watchdog (weakref — test churn must not leak attachments) so a
+        # wedged step()/drain trips a flight record naming the stuck app
+        self._doctor_token = None
+        try:
+            from ..telemetry import doctor as _doctor
+            self._doctor_token = _doctor.doctor().attach_serve(self)
+        except Exception as e:             # noqa: BLE001 — observability only
+            log.warning("%s: doctor attach failed: %r", self.app, e)
+        if self._store is not None:
+            self._restore_persisted()
 
     # -- carry plumbing --------------------------------------------------------
     def _fresh_carry(self):
@@ -277,16 +346,29 @@ class ServeEngine:
         treedef = jax.tree_util.tree_flatten(self._fresh_carry())[1]
         return [xfer.to_host(l[slot]) for l in leaves], treedef
 
-    def _program(self, capacity: int):
-        prog = self._programs.get(capacity)
+    @property
+    def _k_eff(self) -> int:
+        """The megabatch K this step runs at: 1 under an active "k"-lever
+        brownout (latency over throughput), else the configured K."""
+        if self._brownout_active and self._brownout == "k":
+            return 1
+        return self.k_batch
+
+    def _program(self, capacity: int, k: Optional[int] = None):
+        k = self.k_batch if k is None else int(k)
+        key = (capacity, k, self._pipe_tag)
+        prog = self._programs.get(key)
         if prog is None:
-            prog = build_slot_program(self.pipeline, capacity, self.k_batch)
-            self._programs[capacity] = prog
+            prog = build_slot_program(self.pipeline, capacity, k)
+            self._programs[key] = prog
             self.compiles += 1
             log.info("%s: compiled serving program for slot bucket %d "
-                     "(k=%d, resident buckets: %s)", self.app, capacity,
-                     self.k_batch, sorted(self._programs))
+                     "(k=%d, %s; resident buckets: %s)", self.app, capacity,
+                     k, self._pipe_tag, self.resident_buckets())
         return prog
+
+    def resident_buckets(self) -> List[int]:
+        return sorted({cap for cap, _k, _t in self._programs})
 
     def _cached_buckets(self) -> Optional[tuple]:
         try:
@@ -328,12 +410,29 @@ class ServeEngine:
                  cap, self.table.active)
 
     # -- session lifecycle -----------------------------------------------------
+    def _refuse_admission(self, tenant: str) -> None:
+        """Lifecycle/overload admission gate (called with the lock held):
+        draining and the shedding ladder's first rung both refuse NEW
+        admissions — 503 + ``Retry-After`` on the REST plane, billed on
+        ``fsdr_serve_shed_total{reason}``."""
+        if self._draining:
+            _SHED.inc(app=self.app, tenant=tenant, reason="drain")
+            raise ServeDraining(
+                f"{self.app}: draining — admission refused")
+        if self._ladder.level >= 1:
+            _SHED.inc(app=self.app, tenant=tenant, reason="admission")
+            raise ServeOverload(
+                f"{self.app}: overloaded (shed rung "
+                f"{self._ladder.rung}) — admission refused")
+
     def admit(self, tenant: str = "default",
               sid: Optional[str] = None) -> Session:
         """Join: claim a lane (growing to the next bucket when full), with a
         FRESH per-session carry. Raises :class:`ServeFull` past the largest
-        bucket."""
+        bucket, :class:`ServeDraining` while draining, and
+        :class:`ServeOverload` while the shedding ladder is engaged."""
         with self._lock:
+            self._refuse_admission(tenant)
             if self.table.get(sid) is not None:
                 raise ValueError(f"session id {sid!r} already exists")
             s = Session(tenant, sid)
@@ -351,6 +450,7 @@ class ServeEngine:
         a snapshot that no longer matches the pipeline contract is
         refused)."""
         with self._lock:
+            self._refuse_admission(self._session(sid).tenant)
             s = self._session(sid)
             if s.state != "evicted" or s.carry_leaves is None:
                 raise ValueError(f"session {sid!r} is not evicted "
@@ -385,6 +485,11 @@ class ServeEngine:
             s.carry_treedef = treedef
             self.table.release_slot(s)
             s.state = "evicted"
+            if self._store is not None:
+                # evict-to-disk: the host snapshot is already materialized,
+                # so the durable copy is a pure background write — a crash
+                # between evict and readmit loses nothing
+                self._persist_session(s)
             _EVICTIONS.inc(app=self.app, tenant=s.tenant)
             self._refresh_gauges()
             return s
@@ -398,6 +503,10 @@ class ServeEngine:
             s.pending.clear()
             self.table.forget(s)
             s.state = "closed"
+            if self._store is not None:
+                # clean close: the session's state is complete — purge its
+                # durable snapshot so a later incarnation starts it fresh
+                self._store.purge(s.sid)
             if not self._tenant_live(s.tenant):
                 self.credits.unregister(s.tenant)
             self._refresh_gauges()
@@ -419,6 +528,9 @@ class ServeEngine:
         self.table.release_slot(s)
         s.state = "retired"
         s.error = repr(err)
+        if self._store is not None:
+            # a faulted session must not resurrect into a fresh incarnation
+            self._store.purge(s.sid)
         if not self._tenant_live(s.tenant):
             self.credits.unregister(s.tenant)
         self._retired.append(s.sid)
@@ -477,7 +589,7 @@ class ServeEngine:
         session-frames dispatched (0 = idle step)."""
         with self._lock:
             C = self.table.capacity
-            K = self.k_batch
+            K = self._k_eff
             fplan = _faults.plan()
             lanes: List[tuple] = []       # (session, popped pending entries)
             # serving-plane spans (docs/serving.md "Observability"): the
@@ -528,13 +640,22 @@ class ServeEngine:
                 lanes.append((s, popped))
             self.steps += 1
             if not lanes:
+                if self._ladder.level:
+                    # traffic stopped while the ladder was engaged: idle
+                    # steps count as healthy observations so admissions
+                    # reopen (one int check when the ladder is at rung 0 —
+                    # the idle tick stays allocation-free). idle=True: the
+                    # latency window is FROZEN with the pre-idle samples, so
+                    # the SLO term must not read a stale p99 as a live miss
+                    # and ratchet the ladder up on an empty engine
+                    self._overload_tick(idle=True)
                 return 0
             if t_enc:
                 _trace.complete("tpu", "encode", t_enc,
                                 args={"sessions": len(lanes),
                                       "capacity": C})
             try:
-                prog = self._program(C)
+                prog = self._program(C, K)
                 t0 = _trace.now() if _trace.enabled else 0
                 x = xfer.to_device(batch, self.inst.device)
                 act = xfer.to_device(active, self.inst.device)
@@ -542,7 +663,7 @@ class ServeEngine:
                     _trace.complete("tpu", "H2D", t0,
                                     args={"bytes": batch.nbytes})
                 t0 = _trace.now() if _trace.enabled else 0
-                if C in self._warmed:
+                if (C, K, self._pipe_tag) in self._warmed:
                     new_carries, outs = prog(self._carries, x, act)
                 else:
                     # a bucket's FIRST dispatch pays its jit compile: bill
@@ -553,9 +674,10 @@ class ServeEngine:
                     with _profile.compiling(f"serve:{self.app}",
                                             "serve_bucket",
                                             f"cap={C},k={K},"
-                                            f"frame={self.frame_size}"):
+                                            f"frame={self.frame_size},"
+                                            f"pipe={self._pipe_tag}"):
                         new_carries, outs = prog(self._carries, x, act)
-                    self._warmed.add(C)
+                    self._warmed.add((C, K, self._pipe_tag))
                 if t0:
                     _trace.complete("tpu", "compute", t0,
                                     args={"capacity": C,
@@ -593,11 +715,19 @@ class ServeEngine:
                     s.frames_out += 1
                     lat = (end - t_sub) * 1e-9
                     s.last_latency_s = lat
+                    self._lat_recent.append(lat)
                     _LATENCY.observe(lat, app=self.app, tenant=s.tenant)
                     _FRAMES.inc(app=self.app, tenant=s.tenant)
                     dispatched += 1
             self.frames += dispatched
             _DISPATCHES.inc(app=self.app)
+            self._step_stamps.append(time.monotonic())
+            if self._persist_every and self._store is not None:
+                self._steps_since_persist += 1
+                if self._steps_since_persist >= self._persist_every:
+                    self._steps_since_persist = 0
+                    self._persist_all()
+            self._overload_tick()
             # live-roofline unit for serving: one SESSION-FRAME (the
             # registered cost is the single-lane program's); the step
             # stamps its own group time
@@ -612,6 +742,442 @@ class ServeEngine:
                                       "frames": dispatched,
                                       "capacity": C})
             return dispatched
+
+    # -- durable session state (docs/robustness.md "Serving-plane recovery") --
+    def _base_leaf_dtypes(self) -> list:
+        """The BASE pipeline's flat carry leaf dtypes — the dtype contract
+        every durable snapshot is written in, whatever the live program
+        runs at (a brownout-lowered bf16 carry persisted as-is would fail
+        ``carry_matches`` in the next incarnation and lose the session)."""
+        if self._base_dt is None:
+            import jax
+            leaves = jax.tree_util.tree_flatten(
+                self._base_pipeline.init_carry())[0]
+            self._base_dt = [np.dtype(getattr(l, "dtype", "float32"))
+                             for l in leaves]
+        return self._base_dt
+
+    def _persist_session(self, s: Session, sync: bool = False) -> None:
+        """Queue one session's durable snapshot (lock held). Active lanes
+        capture a reference to the CURRENT stacked carries — the serving
+        program never donates, so the writer thread reads stable device
+        arrays even while later steps replace ``self._carries`` — and fetch
+        their host leaves off the step thread; evicted sessions already
+        hold host leaves. Leaves are written in the BASE pipeline's dtypes
+        (upcast when the precision brownout is live), so a kill -9 at any
+        rung restores into a fresh base-pipeline incarnation."""
+        import jax
+        meta = {"sid": s.sid, "tenant": s.tenant,
+                "frames_in": s.frames_in, "frames_out": s.frames_out}
+        dts = self._base_leaf_dtypes()
+        if s.state == "active" and s.slot is not None:
+            leaves = jax.tree_util.tree_flatten(self._carries)[0]
+            slot = s.slot
+
+            def fetch(_leaves=leaves, _slot=slot, _dts=dts):
+                raw = [np.asarray(xfer.to_host(l[_slot])) for l in _leaves]
+                if len(raw) == len(_dts):
+                    raw = [a if a.dtype == dt else a.astype(dt)
+                           for a, dt in zip(raw, _dts)]
+                return raw
+        elif s.state == "evicted" and s.carry_leaves is not None:
+            snap = list(s.carry_leaves)
+
+            def fetch(_snap=snap, _dts=dts):
+                raw = [np.asarray(a) for a in _snap]
+                if len(raw) == len(_dts):
+                    raw = [a if a.dtype == dt else a.astype(dt)
+                           for a, dt in zip(raw, _dts)]
+                return raw
+        else:
+            return
+        self._store.save(s.sid, fetch, meta, sync=sync)
+
+    def _persist_all(self, sync: bool = False) -> int:
+        """Snapshot every live (active/evicted) session (lock held).
+        ``sync`` enqueues everything first and rides ONE flush barrier —
+        every write still lands on the single-writer executor (two writer
+        threads would tear the shared pid-keyed tmp file)."""
+        n = 0
+        for s in self.table.sessions.values():
+            if s.state in ("active", "evicted"):
+                self._persist_session(s)
+                n += 1
+        if sync and n and self._store is not None:
+            self._store.flush()
+        return n
+
+    def flush_persist(self) -> None:
+        """Barrier on the persistence executor: every snapshot queued before
+        this call is durable after it (tests + pre-restart hooks)."""
+        if self._store is not None:
+            self._store.flush()
+
+    def _restore_persisted(self) -> None:
+        """Virgin-incarnation restore: re-admit every persisted session of
+        this app+pipeline-signature bit-identically (the ``carry_matches``-
+        validated readmit path). Corrupted files were already skipped by the
+        store's reader; a snapshot failing the carry contract (pipeline
+        changed under the same app name — the signature hash makes this
+        near-impossible, but the check is cheap) is skipped per-session.
+        Sessions beyond the largest bucket are left on disk (logged) — a
+        smaller replacement deployment refuses gracefully instead of
+        refusing to boot."""
+        import jax
+        records = self._store.load_all()
+        if not records:
+            return
+        with self._lock:
+            fresh = self._fresh_carry()
+            treedef = jax.tree_util.tree_flatten(fresh)[1]
+            skipped = 0
+            for r in records:
+                if self.table.get(r["sid"]) is not None:
+                    continue
+                if not self.pipeline.carry_matches(r["leaves"], treedef,
+                                                   fresh):
+                    log.warning("%s: persisted session %s fails the carry "
+                                "contract — skipped", self.app, r["sid"])
+                    skipped += 1
+                    continue
+                if not self.table.free_slots():
+                    try:
+                        self._grow_to_fit()
+                    except ServeFull:
+                        log.warning("%s: %d persisted session(s) exceed the "
+                                    "largest slot bucket — left on disk",
+                                    self.app,
+                                    len(records) - self.restored_sessions
+                                    - skipped)
+                        break
+                s = Session(r["tenant"], r["sid"])
+                slot = self.table.admit(s)
+                self._set_lane(slot, self.pipeline.restore_carry(
+                    r["leaves"], treedef, self.inst.device))
+                s.frames_in = r["frames_in"]
+                s.frames_out = r["frames_out"]
+                self.credits.register(s.tenant)
+                self.restored_sessions += 1
+                _RESUMED.inc(app=self.app, tenant=s.tenant)
+            self._refresh_gauges()
+        if self.restored_sessions:
+            log.info("%s: re-admitted %d persisted session(s) after a "
+                     "process restart (%d skipped)", self.app,
+                     self.restored_sessions, skipped)
+            # warm the current bucket NOW: a restored pod must turn ready
+            # (readyz 200) without waiting for traffic — restored sessions
+            # have no pending frames, so no busy step would ever compile
+            # the program and the pod would sit NotReady forever
+            try:
+                with self._lock:
+                    self._warm_current_bucket()
+            except Exception as e:         # noqa: BLE001 — a failed warmup
+                log.warning("%s: restore warmup failed: %r", self.app, e)
+
+    def _warm_current_bucket(self) -> None:
+        """Compile + warm the current bucket's program with an ALL-MASKED
+        no-op dispatch (lock held): every lane inactive, so the in-program
+        ``where(active, new, old)`` merge keeps the restored carries
+        bit-identical — the dispatch exists only to pay the jit compile
+        before the orchestrator routes traffic. Billed ``serve_bucket``
+        like any first dispatch."""
+        import jax
+        C, K = self.table.capacity, self._k_eff
+        key = (C, K, self._pipe_tag)
+        if key in self._warmed:
+            return
+        prog = self._program(C, K)
+        shape = (C, self.frame_size) if K == 1 else (C, K, self.frame_size)
+        batch = np.zeros(shape, dtype=self.pipeline.in_dtype)
+        active = np.zeros((C,) if K == 1 else (C, K), dtype=bool)
+        with _profile.compiling(f"serve:{self.app}", "serve_bucket",
+                                f"cap={C},k={K},frame={self.frame_size},"
+                                f"pipe={self._pipe_tag},warm=restore"):
+            _new_c, outs = prog(self._carries,
+                                xfer.to_device(batch, self.inst.device),
+                                xfer.to_device(active, self.inst.device))
+            jax.block_until_ready(outs)
+        self._warmed.add(key)
+
+    # -- graceful lifecycle ----------------------------------------------------
+    def drain(self, pump: bool = True, timeout: float = 30.0,
+              persist: bool = True) -> dict:
+        """Graceful shutdown for rolling restarts: refuse new admissions
+        (:class:`ServeDraining` → 503 + ``Retry-After``), finish the
+        in-flight megabatch groups and every queued frame (``pump=True``
+        steps the engine here; an app with its own pump thread passes
+        ``pump=False`` and keeps stepping), persist all live lanes, and
+        report drained. Idempotent — a second call re-reports."""
+        with self._lock:
+            self._draining = True
+        pumped = 0
+        deadline = (time.monotonic() + float(timeout)) if timeout else None
+        if pump:
+            while True:
+                if deadline is not None and time.monotonic() > deadline:
+                    log.warning("%s: drain timed out with frames still "
+                                "queued", self.app)
+                    break
+                got = self.step()
+                pumped += got
+                if not got:
+                    # no lane dispatched anything: every ACTIVE queue is
+                    # empty. Frames may remain on evicted sessions' queues
+                    # — those cannot dispatch without a readmit, which
+                    # draining refuses, so there is nothing left to finish
+                    # (the report's pending_frames counts them honestly)
+                    break
+        persisted = 0
+        if persist and self._store is not None:
+            with self._lock:
+                if self._brownout_active:
+                    # release the brownout before the final persist: the
+                    # snapshots must land in the base dtype contract (the
+                    # per-write upcast covers a kill -9; a graceful drain
+                    # hands the NEXT incarnation full-precision carries)
+                    self._set_brownout(False)
+                persisted = self._persist_all(sync=True)
+        with self._lock:
+            leftover = sum(len(s.pending) for s in self.table.sessions.values())
+            self._drained = True
+            report = {
+                "app": self.app,
+                "draining": True,
+                "drained": True,
+                "frames_drained": pumped,
+                "pending_frames": leftover,
+                "sessions_persisted": persisted,
+                "sessions": len(self.table.sessions),
+            }
+        log.info("%s: drained — %d frame(s) finished, %d session(s) "
+                 "persisted, %d frame(s) left queued", self.app, pumped,
+                 persisted, report["pending_frames"])
+        return report
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        return self._drained
+
+    def retry_after_s(self) -> int:
+        """``Retry-After`` seconds for a 503 (ServeFull/draining/overload),
+        derived from the measured step rate: roughly how long until one
+        queue-depth's worth of frames drains. Clamped to [1, 30].
+
+        LOCK-FREE by design: the REST error path calls this on the aiohttp
+        event loop, and step() holds the engine lock across an entire
+        dispatch — including a new bucket's multi-second jit compile.
+        Taking the lock here would freeze every control-port route (incl.
+        /healthz) for that long. ``list(deque)`` under the GIL is safe
+        against a concurrent append; ``_queue_frames`` is immutable."""
+        stamps = list(self._step_stamps)
+        qf = self._queue_frames
+        if len(stamps) >= 2 and stamps[-1] > stamps[0]:
+            rate = (len(stamps) - 1) / (stamps[-1] - stamps[0])
+            est = qf / max(rate, 1e-3)
+        else:
+            est = 1.0
+        return int(min(30, max(1, math.ceil(est))))
+
+    def health(self) -> dict:
+        """Liveness/readiness view for ``/healthz``/``/readyz``
+        (docs/serving.md "Lifecycle"): ready = the CURRENT bucket's program
+        has dispatched (compiled) — or nothing is admitted yet — and the
+        engine is not draining. The readiness endpoint additionally refuses
+        while the profile plane reports a serving-program compile storm.
+
+        LOCK-FREE like :meth:`retry_after_s`: readyz runs on the aiohttp
+        event loop and step() holds the engine lock across whole dispatches
+        (incl. a new bucket's multi-second jit compile — exactly when an
+        orchestrator probes hardest). Plain attribute/set reads under the
+        GIL give an at-most-one-step-stale answer, which is all a probe
+        needs; blocking here would freeze /healthz too and get a healthy
+        pod killed mid-compile."""
+        key = (self.table.capacity, self._k_eff, self._pipe_tag)
+        active = self.table.active
+        compiled = active == 0 or key in self._warmed
+        return {"ready": bool(compiled and not self._draining),
+                "compiled": bool(compiled),
+                "draining": self._draining,
+                "drained": self._drained,
+                "shed_level": self._ladder.level,
+                "shed_rung": self._ladder.rung,
+                "active": active,
+                "capacity": self.table.capacity}
+
+    def watch_sample(self) -> Optional[dict]:
+        """Cheap progress probe for the doctor's serve watchdog. Returns
+        None when the engine lock is busy — a step() in flight IS progress
+        (or a compile, which the doctor's ``compiling`` verdict explains),
+        so the watchdog must not strike on it."""
+        if not self._lock.acquire(timeout=0.05):
+            return None
+        try:
+            stuck = sorted((s for s in self.table.occupants() if s.pending),
+                           key=lambda s: -len(s.pending))
+            return {"app": self.app,
+                    "frames": self.frames,
+                    "pending": sum(len(s.pending) for s in
+                                   self.table.occupants()),
+                    "draining": self._draining,
+                    "capacity": self.table.capacity,
+                    "active": self.table.active,
+                    "shed_level": self._ladder.level,
+                    "stuck_sessions": [s.sid for s in stuck[:4]]}
+        finally:
+            self._lock.release()
+
+    def shutdown(self) -> None:
+        """Detach from the doctor and stop persisting. Does NOT drain —
+        call :meth:`drain` first for a graceful handoff."""
+        if self._doctor_token is not None:
+            try:
+                from ..telemetry import doctor as _doctor
+                _doctor.doctor().detach_serve(self._doctor_token)
+            except Exception:                          # noqa: BLE001
+                pass
+            self._doctor_token = None
+
+    # -- SLO-aware overload control (serve/overload.py) ------------------------
+    def _overload_tick(self, idle: bool = False) -> None:
+        """One shedding-ladder observation (lock held, busy steps + engaged
+        idle steps): queue pressure vs the watermarks, rolling p99 vs the
+        ``serve_slo_ms`` deadline budget. Escalations act on the transition
+        — rung 2 evicts the most-stalled sessions, rung 3 engages the
+        optional brownout lever; recovery unwinds one rung at a time.
+        ``idle`` ticks skip the SLO term: the latency window holds only
+        pre-idle samples, and a frozen p99 must read as "no current miss",
+        not as a live violation that keeps escalating an empty engine."""
+        p99_ms = None
+        if self._slo_ms and self._lat_recent and not idle:
+            p99_ms = float(np.quantile(
+                np.asarray(self._lat_recent), 0.99)) * 1e3
+        prev = self._ladder.level
+        lvl = self._ladder.observe(self.credits.pressure(), p99_ms,
+                                   self._slo_ms)
+        if lvl == prev:
+            return
+        _SHED_LEVEL.set(float(lvl), app=self.app)
+        if lvl > prev:
+            log.warning("%s: overload ladder escalated to rung %d (%s) — "
+                        "pressure %.2f, p99 %s ms (SLO %s)", self.app, lvl,
+                        self._ladder.rung, self.credits.pressure(),
+                        f"{p99_ms:.1f}" if p99_ms is not None else "-",
+                        self._slo_ms or "-")
+            if lvl >= 2:
+                self._shed_stalled()
+            if lvl >= 3 and self._brownout != "off":
+                self._set_brownout(True)
+        else:
+            log.info("%s: overload ladder recovered to rung %d (%s)",
+                     self.app, lvl, self._ladder.rung)
+            if lvl < 3 and self._brownout_active:
+                self._set_brownout(False)
+
+    def _shed_stalled(self) -> None:
+        """Rung 2: evict the most-stalled sessions (no queued input, most
+        consecutive inputless steps first) to host/disk — frees their lanes
+        without touching a single resident bit (the evict/readmit leaf
+        contract is bit-identical). At most a quarter of the active lanes
+        per escalation, so one rung transition cannot empty the table."""
+        cands = sorted((s for s in self.table.occupants()
+                        if s.stall_steps >= 1 and not s.pending),
+                       key=lambda s: -s.stall_steps)
+        for s in cands[:max(1, self.table.active // 4)]:
+            try:
+                self.evict(s.sid)
+            except (KeyError, ValueError) as e:
+                log.warning("%s: shed-evict of %s failed: %r", self.app,
+                            s.sid, e)
+                continue
+            self.shed_evictions += 1
+            _SHED.inc(app=self.app, tenant=s.tenant, reason="evict")
+            log.warning("%s: shed-evicted stalled session %s (tenant %s, "
+                        "%d stalled steps)", self.app, s.sid, s.tenant,
+                        s.stall_steps)
+
+    def _set_brownout(self, on: bool) -> None:
+        """Rung 3 (config ``serve_brownout``, default off): trade quality
+        for headroom on resident buckets — ``"k"`` drops megabatch K to 1
+        (per-dispatch latency over throughput; K>1 vs K=1 round differently
+        by repo contract), ``"precision"`` retunes the interior to bf16 via
+        ``ops/precision.py`` (SNR-bounded loss for the duration). Both
+        compile their program form once (billed ``serve_bucket``) and keep
+        the base programs cached — recovery never recompiles."""
+        if on == self._brownout_active:
+            return
+        if self._brownout == "precision":
+            if not self._apply_precision_brownout(on):
+                return
+        self._brownout_active = on
+        if on:
+            _SHED.inc(app=self.app, tenant="-", reason="brownout")
+        log.warning("%s: brownout lever (%s) %s", self.app, self._brownout,
+                    "ENGAGED" if on else "released")
+
+    def _apply_precision_brownout(self, on: bool) -> bool:
+        """Swap the served pipeline between the base and the bf16-lowered
+        form, converting the stacked carries leaf-by-leaf (narrowing casts;
+        widening upcasts the live values — the brownout's documented,
+        bounded quality loss for its duration). Returns False (logged, no
+        state change) when nothing lowers or the carry trees refuse."""
+        import jax
+        prev_pipe = self.pipeline
+        if on:
+            if self._low_pipe is None:
+                try:
+                    from ..ops import precision as _precision_mod
+                    low, plan = _precision_mod.plan_interior_precision(
+                        self._base_pipeline, mode="bf16")
+                except Exception as e:                 # noqa: BLE001
+                    log.warning("%s: precision brownout plan failed (%r) — "
+                                "lever disabled", self.app, e)
+                    return False
+                if low is self._base_pipeline:
+                    log.warning("%s: precision brownout lowers nothing — "
+                                "lever disabled", self.app)
+                    return False
+                self._low_pipe = low
+            target, tag = self._low_pipe, "bf16"
+        else:
+            target, tag = self._base_pipeline, "base"
+        if target is self.pipeline:
+            self._pipe_tag = tag
+            return True
+        old_leaves, old_def = jax.tree_util.tree_flatten(self._carries)
+        self.pipeline = target
+        self._fresh = None
+        stacked = self._stacked_fresh(self.table.capacity)
+        t_leaves, t_def = jax.tree_util.tree_flatten(stacked)
+        if old_def != t_def or any(
+                np.shape(a) != np.shape(b)
+                for a, b in zip(old_leaves, t_leaves)):
+            log.warning("%s: precision brownout carry trees mismatch — "
+                        "lever disabled", self.app)
+            self.pipeline = prev_pipe
+            self._fresh = None
+            return False
+        conv = [a if getattr(a, "dtype", None) == getattr(b, "dtype", None)
+                else a.astype(b.dtype)
+                for a, b in zip(old_leaves, t_leaves)]
+        self._carries = jax.tree_util.tree_unflatten(t_def, conv)
+        # evicted sessions hold HOST snapshots in the old dtypes: convert
+        # them too, or their readmit would fail the carry_matches dtype
+        # check against the new template until a process restart
+        lane = jax.tree_util.tree_flatten(self.pipeline.init_carry())[0]
+        lane_dts = [np.dtype(getattr(l, "dtype", "float32")) for l in lane]
+        for s in self.table.sessions.values():
+            if s.state == "evicted" and s.carry_leaves is not None and \
+                    len(s.carry_leaves) == len(lane_dts):
+                s.carry_leaves = [
+                    np.asarray(a) if np.asarray(a).dtype == dt
+                    else np.asarray(a).astype(dt)
+                    for a, dt in zip(s.carry_leaves, lane_dts)]
+        self._pipe_tag = tag
+        return True
 
     # -- observability ---------------------------------------------------------
     def _refresh_gauges(self) -> None:
@@ -638,7 +1204,7 @@ class ServeEngine:
                 "frames_per_dispatch": self.k_batch,
                 "buckets": list(self.buckets),
                 "capacity": self.table.capacity,
-                "resident_buckets": sorted(self._programs),
+                "resident_buckets": self.resident_buckets(),
                 "compiles": self.compiles,
                 "active": self.table.active,
                 "sessions": len(self.table.sessions),
@@ -647,6 +1213,19 @@ class ServeEngine:
                 "frames": self.frames,
                 "credit_total": self.credits.total,
                 "credit_fair_share": self.credits.fair_share(),
+                "draining": self._draining,
+                "drained": self._drained,
+                "shed": {**self._ladder.view(),
+                         "slo_ms": self._slo_ms or None,
+                         "brownout": self._brownout,
+                         "brownout_active": self._brownout_active,
+                         "evictions": self.shed_evictions,
+                         "pressure": round(self.credits.pressure(), 4),
+                         "tenant_pressure": self.credits.tenant_pressure()},
+                "persist": ({"dir": self._store._dir,
+                             "every": self._persist_every,
+                             "restored_sessions": self.restored_sessions}
+                            if self._store is not None else None),
                 "tenants": {
                     t: {"sessions": n,
                         "credits_used": self.credits.used(t),
@@ -661,3 +1240,72 @@ class ServeEngine:
         v["tenant_p50_ms"] = self.tenant_latency_ms(t, 0.5)
         v["tenant_p99_ms"] = self.tenant_latency_ms(t, 0.99)
         return v
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM drain hook (rolling restarts)
+# ---------------------------------------------------------------------------
+
+_sigterm_installed = False
+_sigterm_lock = threading.Lock()
+
+
+def drain_all_apps(timeout: float = 30.0) -> Dict[str, dict]:
+    """Drain every registered serving app (refuse admissions, finish
+    in-flight groups, persist all lanes). The SIGTERM hook's body; callable
+    directly from an app's own shutdown path."""
+    from . import api as _api
+    out: Dict[str, dict] = {}
+    for name, eng in _api.apps().items():
+        try:
+            out[name] = eng.drain(timeout=timeout)
+        except Exception as e:                         # noqa: BLE001 — one
+            out[name] = {"app": name, "error": repr(e)}    # bad app must not
+            log.error("drain of %s failed: %r", name, e)   # block the rest
+    return out
+
+
+def install_sigterm_drain(timeout: float = 30.0) -> bool:
+    """Install a SIGTERM handler that gracefully drains every registered
+    serving app (docs/robustness.md "Serving-plane recovery"): the
+    orchestrator's rolling-restart contract is SIGTERM → readyz goes
+    unready (draining) → in-flight groups finish → all lanes persist →
+    process exit. The drain runs on a background thread (a signal handler
+    must not take engine locks); the previous handler is chained after the
+    drain completes. Idempotent; returns False when not on the main thread
+    (signals uninstallable) — auto-installed by ``register_app`` when
+    config ``serve_drain_on_sigterm`` is set."""
+    global _sigterm_installed
+    import signal
+    with _sigterm_lock:
+        if _sigterm_installed:
+            return True
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def on_term(signum, frame):
+                def run():
+                    drain_all_apps(timeout=timeout)
+                    if callable(prev):
+                        try:
+                            prev(signum, frame)
+                        except Exception:              # noqa: BLE001
+                            pass
+                    elif prev == signal.SIG_DFL:
+                        # restore + re-raise so the process still dies the
+                        # default way once the drain landed
+                        try:
+                            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                            os.kill(os.getpid(), signal.SIGTERM)
+                        except Exception:              # noqa: BLE001
+                            pass
+
+                threading.Thread(target=run, name="fsdr-serve-drain",
+                                 daemon=True).start()
+
+            signal.signal(signal.SIGTERM, on_term)
+        except ValueError:
+            # not the main thread: the caller owns its signal story
+            return False
+        _sigterm_installed = True
+        return True

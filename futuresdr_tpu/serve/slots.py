@@ -21,7 +21,8 @@ import time
 from collections import deque
 from typing import Deque, Dict, List, Optional
 
-__all__ = ["Session", "SlotTable", "ServeFull"]
+__all__ = ["Session", "SlotTable", "ServeFull", "ServeDraining",
+           "ServeOverload"]
 
 #: session lifecycle states (docs/serving.md "Session lifecycle"):
 #:   active   — owns a slot, dispatches whenever it has a pending frame
@@ -35,6 +36,21 @@ _sid_counter = itertools.count(1)
 
 class ServeFull(RuntimeError):
     """Admission refused: every slot bucket is at capacity."""
+
+
+class ServeDraining(ServeFull):
+    """Admission refused: the engine is draining (graceful shutdown —
+    rolling-restart lifecycle, docs/robustness.md "Serving-plane recovery").
+    The REST plane maps it to 503 + ``Retry-After`` like :class:`ServeFull`;
+    an orchestrator should route new sessions to another replica."""
+
+
+class ServeOverload(ServeFull):
+    """Admission refused by the overload-shedding ladder (rung 1): the
+    engine is over its queue-pressure watermark or missing its latency SLO,
+    so NEW admissions shed first while resident sessions keep their lanes
+    bit-exact (serve/overload.py, billed on
+    ``fsdr_serve_shed_total{reason="admission"}``)."""
 
 
 class Session:

@@ -71,9 +71,12 @@ LANES = ("encode", "H2D", "compute", "D2H", "decode")
 #: every state a watchdog diagnosis can carry (``idle``: a message-plane-only
 #: flowgraph with drained inboxes — waiting for events, not wedged;
 #: ``compiling``: an XLA compile was in progress or finished inside the
-#: no-progress window — the stall is the compiler's, not a deadlock)
+#: no-progress window — the stall is the compiler's, not a deadlock;
+#: ``serve_wedged``: an attached serving engine with queued frames made no
+#: dispatch progress for the window — a wedged step() loop or a lane stuck
+#: in drain, naming the app/bucket/stuck sessions)
 WATCHDOG_STATES = ("progressing", "backpressured", "starved", "deadlocked",
-                   "idle", "compiling")
+                   "idle", "compiling", "serve_wedged")
 
 # always-on histogram families (the metrics plane contract: frame-rate
 # updates, never per-sample) — observation sites bind children once
@@ -101,6 +104,25 @@ class _Attached:
         #   supervisor's CancelMsg hook for doctor_action=cancel escalation
         self.t_attach = time.monotonic()
         self.progress: Optional[int] = None   # None = no baseline sample yet
+        self.strikes = 0
+        self.tripped = False
+        self.diagnosis: Optional[dict] = None
+
+
+class _AttachedServe:
+    """One serving engine under watch (docs/serving.md) — held by WEAKREF:
+    test/app churn constructs engines freely and must not leak attachments;
+    a collected engine detaches itself on the next tick."""
+
+    __slots__ = ("key", "engine", "t_attach", "frames", "strikes", "tripped",
+                 "diagnosis")
+
+    def __init__(self, key: int, engine):
+        import weakref
+        self.key = key
+        self.engine = weakref.ref(engine)
+        self.t_attach = time.monotonic()
+        self.frames: Optional[int] = None     # None = no baseline sample yet
         self.strikes = 0
         self.tripped = False
         self.diagnosis: Optional[dict] = None
@@ -199,6 +221,7 @@ class Doctor:
     def __init__(self):
         self._lock = threading.Lock()
         self._fgs: Dict[int, _Attached] = {}
+        self._serve: Dict[int, _AttachedServe] = {}
         self._next_key = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -230,6 +253,41 @@ class Doctor:
     def attached(self) -> List[int]:
         with self._lock:
             return list(self._fgs)
+
+    # -- serving-plane attachment (ServeEngine registers at construction) ------
+    def attach_serve(self, engine) -> int:
+        """Register a serving engine for watchdog coverage (weakref — a
+        collected engine detaches itself). The engine's ``watch_sample``
+        contract: a dict with monotonic ``frames``/``pending`` counters, or
+        None while the engine lock is busy (a dispatch in flight IS
+        progress)."""
+        with self._lock:
+            key = self._next_key
+            self._next_key += 1
+            self._serve[key] = _AttachedServe(key, engine)
+            return key
+
+    def detach_serve(self, token: int) -> None:
+        with self._lock:
+            self._serve.pop(token, None)
+
+    def serve_engines(self) -> List[object]:
+        """Live attached serving engines (pruning collected ones)."""
+        with self._lock:
+            atts = list(self._serve.items())
+        out = []
+        dead = []
+        for key, att in atts:
+            eng = att.engine()
+            if eng is None:
+                dead.append(key)
+            else:
+                out.append(eng)
+        if dead:
+            with self._lock:
+                for key in dead:
+                    self._serve.pop(key, None)
+        return out
 
     # -- watchdog --------------------------------------------------------------
     @property
@@ -367,6 +425,76 @@ class Doctor:
                 self._maybe_cancel(att, diag, paths)
                 # published LAST: a waiter seeing last_trip can rely on the
                 # flight record (last_report) being complete
+                self.last_trip = diag
+        self._tick_serve()
+
+    def _tick_serve(self) -> None:
+        """Watchdog pass over attached serving engines: queued frames with
+        no dispatch progress for the window trip ``serve_wedged`` — a
+        wedged step() loop, or a drain stuck on a lane that never finishes.
+        An idle engine (nothing queued) and a busy engine lock (a dispatch
+        or bucket compile in flight) both count as healthy."""
+        with self._lock:
+            atts = list(self._serve.items())
+        for key, att in atts:
+            eng = att.engine()
+            if eng is None:
+                with self._lock:
+                    self._serve.pop(key, None)
+                continue
+            try:
+                sample = eng.watch_sample()
+            except Exception as e:                     # noqa: BLE001 — a
+                log.error("serve watch sample failed: %r", e)   # dying engine
+                continue                               # must not kill the dog
+            if sample is None:
+                # engine lock busy — a step()/compile in flight is progress
+                att.strikes = 0
+                continue
+            frames = int(sample.get("frames", 0))
+            if att.frames is None or frames != att.frames \
+                    or not sample.get("pending"):
+                if att.tripped and frames != att.frames:
+                    log.info("serving app %s progressing again (watchdog "
+                             "re-armed)", sample.get("app"))
+                att.frames = frames
+                att.strikes = 0
+                att.tripped = False
+                att.diagnosis = None
+                continue
+            att.strikes += 1
+            if att.strikes >= self.window and not att.tripped:
+                att.tripped = True
+                window_s = round(att.strikes * self.interval, 3)
+                comp = _profile.plane().compiling_or_recent(
+                    max(window_s, 1e-9))
+                if comp is not None and comp.get("in_progress"):
+                    # a bucket compile explains the silence — benign,
+                    # window re-arms like the flowgraph compiling verdict
+                    att.tripped = False
+                    att.strikes = 0
+                    continue
+                diag = {
+                    "state": "serve_wedged",
+                    "app": sample.get("app"),
+                    "capacity": sample.get("capacity"),
+                    "active": sample.get("active"),
+                    "pending_frames": sample.get("pending"),
+                    "draining": sample.get("draining"),
+                    "stuck_sessions": sample.get("stuck_sessions"),
+                    "no_progress_for_s": window_s,
+                    "detail": (f"serving app {sample.get('app')}: "
+                               f"{sample.get('pending')} queued frame(s) on "
+                               f"{sample.get('active')} lane(s) made no "
+                               f"dispatch progress"
+                               + (" while draining"
+                                  if sample.get("draining") else "")),
+                }
+                att.diagnosis = diag
+                _TRIPS.inc(state="serve_wedged")
+                log.error("watchdog trip (serve %s): %s",
+                          sample.get("app"), diag["detail"])
+                self.dump(self.flight_record("watchdog:serve_wedged"))
                 self.last_trip = diag
 
     def _maybe_cancel(self, att: _Attached, diag: dict, paths) -> None:
@@ -541,6 +669,21 @@ class Doctor:
                 "edges": [[e[0].instance_name, e[1],
                            e[2].instance_name, e[3]] for e in att.edges],
             }
+        serve: Dict[str, dict] = {}
+        with self._lock:
+            satts = list(self._serve.values())
+        for att in satts:
+            eng = att.engine()
+            if eng is None:
+                continue
+            try:
+                sample = eng.watch_sample()   # non-blocking: a wedged step()
+            except Exception as e:            # noqa: BLE001 — holding the
+                sample = {"error": repr(e)}   # engine lock must not hang the
+            entry = dict(sample or {"lock": "busy"})        # flight record
+            if att.diagnosis:
+                entry["diagnosis"] = att.diagnosis
+            serve[str(getattr(eng, "app", att.key))] = entry
         rec = spans.recorder()
         ring: Dict[str, List[dict]] = {}
         for e in rec.snapshot():              # non-destructive: other trace
@@ -565,6 +708,11 @@ class Doctor:
             "profile": {"active_compiles": prof.active_compiles(),
                         "compiles_total": prof.compiles_total,
                         "storms": prof.storm_report() or None},
+            # serving-plane coverage (docs/serving.md): every attached
+            # engine's live occupancy/pending sample plus its watchdog
+            # diagnosis — "which app/bucket/session is stuck" answers from
+            # the same dump as the flowgraph story
+            "serve": serve or None,
             "metrics": prom.registry().render(),
         }
         if extra is not None:
@@ -714,6 +862,13 @@ class Doctor:
                          for v in roofline["programs"].values()
                          if v.get("bound")]
                 resource = max(progs)[1] if progs else "device"
+        # serving-plane section: each attached engine's full describe()
+        # (slots, buckets, shed ladder, persistence) when its lock is free
+        # within a short grace, else the non-blocking watch sample — an
+        # operator report must not hang on a wedged step()
+        serve: Dict[str, dict] = {}
+        for eng in self.serve_engines():
+            serve[str(getattr(eng, "app", "?"))] = _serve_describe(eng)
         return {
             "wall_s": wall / 1e9,
             "lanes": lanes,
@@ -725,6 +880,7 @@ class Doctor:
             "arena": arena_stats(),
             "e2e_latency": e2e if e2e.get("p50_s") is not None else None,
             "devchain": devchains or None,
+            "serve": serve or None,
             "roofline": roofline,
             "compile_storms": prof.storm_report() or None,
             # interior-precision plans (ops/precision.py): per program, the
@@ -732,6 +888,25 @@ class Doctor:
             # SNR, and every decline reason — None until a kernel publishes
             "precision": _precision_plans() or None,
         }
+
+
+def _serve_describe(eng) -> Optional[dict]:
+    """An engine's describe() without risking a hang: take the engine lock
+    only under a short timeout (a wedged step() holds it indefinitely) and
+    fall back to the non-blocking watch sample."""
+    lock = getattr(eng, "_lock", None)
+    try:
+        if lock is not None and lock.acquire(timeout=0.2):
+            try:
+                return eng.describe()
+            finally:
+                lock.release()
+    except Exception:                                  # noqa: BLE001
+        pass
+    try:
+        return eng.watch_sample() or {"lock": "busy"}
+    except Exception as e:                             # noqa: BLE001
+        return {"error": repr(e)}
 
 
 def _precision_plans() -> dict:

@@ -54,7 +54,6 @@ from __future__ import annotations
 import os
 import threading
 import time
-import zlib
 from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
@@ -73,6 +72,7 @@ from ..runtime import faults as _faults
 from ..runtime.kernel import Kernel, message_handler
 from ..runtime.tag import ItemTag
 from ..types import Pmt
+from ..utils import snapshot as _snapshot
 from .frames import emit_with_tags, rebase_frame_tags
 from .instance import TpuInstance, instance
 
@@ -95,24 +95,13 @@ _REPLAYED = _prom.counter(
     ("block",))
 
 
-#: single-thread executor for checkpoint persistence (snapshot writes +
-#: clean-EOS purges): ONE worker is the ordering guarantee — writes land
-#: newest-last and a purge queued after pending writes wins. (The codec
-#: pool's encode executor has several workers, so routing persistence
-#: through it let two writes share a tmp file and tear each other.)
-_persist_pool = None
-_persist_pool_lock = threading.Lock()
-
-
-def _persist_executor():
-    global _persist_pool
-    if _persist_pool is None:
-        with _persist_pool_lock:
-            if _persist_pool is None:
-                from concurrent.futures import ThreadPoolExecutor
-                _persist_pool = ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="fsdr-codec-persist")
-    return _persist_pool
+# single-thread executor for checkpoint persistence (snapshot writes +
+# clean-EOS purges): ONE worker is the ordering guarantee — writes land
+# newest-last and a purge queued after pending writes wins. (The codec
+# pool's encode executor has several workers, so routing persistence
+# through it let two writes share a tmp file and tear each other.) Shared
+# with the serving plane's session store (utils/snapshot.py owns it now).
+_persist_executor = _snapshot.persist_executor
 
 
 def _settle_future(fut) -> None:
@@ -1472,24 +1461,10 @@ class TpuKernel(Kernel):
         name just keeps unrelated snapshots from colliding)."""
         if not self._ckpt_dir:
             return None
-        import hashlib
         name = self.meta.instance_name or type(self).__name__
-        stages = getattr(self.pipeline, "stages", ())
-        sig = "|".join(str(getattr(s, "name", "?")) for s in stages) \
-            or type(self.pipeline).__name__
-        h = hashlib.sha1(
-            f"{name}|{sig}|{np.dtype(self.pipeline.in_dtype)}".encode()
-        ).hexdigest()[:10]
-        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
+        h = _snapshot.snapshot_signature(self.pipeline, name)
+        safe = _snapshot.sanitize_name(name)
         return os.path.join(self._ckpt_dir, f"{safe}-{h}.ckpt.npz")
-
-    @staticmethod
-    def _ckpt_crc(leaves) -> int:
-        crc = 0
-        for l in leaves:
-            a = np.ascontiguousarray(np.asarray(l))
-            crc = zlib.crc32(a.tobytes(), crc)
-        return crc & 0xFFFFFFFF
 
     def _persist_submit(self, fn) -> None:
         """Run a persistence task (snapshot write, clean-EOS purge) off the
@@ -1535,17 +1510,8 @@ class TpuKernel(Kernel):
             if item is None:
                 return
             s, lv = item
-            try:
-                os.makedirs(os.path.dirname(path), exist_ok=True)
-                tmp = f"{path}.{os.getpid()}.tmp"
-                arrs = {f"leaf{i}": np.asarray(l) for i, l in enumerate(lv)}
-                with open(tmp, "wb") as f:
-                    np.savez(f, _seq=np.int64(s), _n=np.int64(len(lv)),
-                             _crc=np.uint32(self._ckpt_crc(lv)), **arrs)
-                os.replace(tmp, path)
-            except Exception as e:                     # noqa: BLE001
-                log.warning("%s: checkpoint persist @%d failed (%r)",
-                            name, s, e)
+            if not _snapshot.write_snapshot(path, s, lv):
+                log.warning("%s: checkpoint persist @%d failed", name, s)
 
         self._persist_submit(write)
 
@@ -1553,25 +1519,11 @@ class TpuKernel(Kernel):
         """``(seq, leaves)`` of the persisted snapshot, or None when absent,
         unreadable, or failing the CRC — a corrupted file is logged and
         ignored (recovery falls through to the fresh-init path)."""
-        path = self._ckpt_file()
-        if not path or not os.path.exists(path):
+        got = _snapshot.read_snapshot(self._ckpt_file() or "")
+        if got is None:
             return None
-        try:
-            with np.load(path) as z:
-                n = int(z["_n"])
-                seq = int(z["_seq"])
-                crc = int(z["_crc"])
-                leaves = [z[f"leaf{i}"] for i in range(n)]
-            if crc != self._ckpt_crc(leaves):
-                log.warning("%s: persisted checkpoint %s failed its "
-                            "integrity check — ignored",
-                            self.meta.instance_name, path)
-                return None
-            return seq, leaves
-        except Exception as e:                         # noqa: BLE001
-            log.warning("%s: persisted checkpoint %s unreadable (%r) — "
-                        "ignored", self.meta.instance_name, path, e)
-            return None
+        seq, leaves, _meta = got
+        return seq, leaves
 
     def _restore_candidates(self):
         """Committed checkpoints newest-first, each validated lazily by

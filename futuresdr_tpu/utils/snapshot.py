@@ -1,0 +1,151 @@
+"""Shared disk-snapshot helpers (docs/robustness.md "Persisting checkpoints").
+
+One implementation of the durable-state disk contract used by BOTH the
+kernel checkpoint persistence (``tpu/kernel_block.py``, config
+``checkpoint_dir``) and the serving plane's per-session carry store
+(``serve/persist.py``, config ``serve_persist_dir``):
+
+* **atomic rename** — a reader sees the old or the new snapshot, never a
+  torn one (``os.replace`` of a pid-suffixed temp file);
+* **CRC integrity** — a crc32 over every leaf's bytes is stored alongside
+  and re-checked on load; a corrupted file reads as "absent", it never
+  restores garbage;
+* **signature-keyed filenames** — :func:`snapshot_signature` hashes the
+  owning name together with the pipeline signature (stage names + input
+  dtype), so a REUSED name over a DIFFERENT pipeline maps to a different
+  file and can never restore a mismatched carry (the key-collision rule
+  pinned by ``tests/test_arena.py::test_checkpoint_dir_key_collisions``);
+* **optional metadata** — a small JSON dict (session id, tenant, frame
+  cursors) rides next to the leaves for stores that need more than a
+  sequence number;
+* **one serialized writer** — :func:`persist_executor` is the process-wide
+  single-worker pool every snapshot write/purge rides, so writes land
+  newest-last and a purge queued after pending writes wins.
+
+Writes are best-effort by contract: a failed write only narrows the
+restore window, it must never fail the caller's hot path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..log import logger
+
+__all__ = [
+    "snapshot_signature", "sanitize_name", "snapshot_crc",
+    "write_snapshot", "read_snapshot", "persist_executor",
+]
+
+log = logger("utils.snapshot")
+
+_persist_pool = None
+_persist_pool_lock = threading.Lock()
+
+
+def persist_executor():
+    """The ONE-worker persistence executor (strictly serialized FIFO): every
+    disk snapshot write and purge in the process rides it, off the caller's
+    dispatch/drain/step thread."""
+    global _persist_pool
+    if _persist_pool is None:
+        with _persist_pool_lock:
+            if _persist_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _persist_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="fsdr-codec-persist")
+    return _persist_pool
+
+
+def sanitize_name(name: str) -> str:
+    """A filesystem-safe rendering of an instance/session name."""
+    return "".join(c if c.isalnum() or c in "-_." else "_" for c in str(name))
+
+
+def snapshot_signature(pipeline, name: str) -> str:
+    """Ten hex chars keying ``name`` + the pipeline signature (stage names +
+    input dtype): a restarted process with the same flowgraph maps to the
+    same file, and a DIFFERENT pipeline under a reused name can never read
+    the other's snapshot — the integrity check would reject it anyway, the
+    signature keeps unrelated snapshots from colliding at all."""
+    stages = getattr(pipeline, "stages", ())
+    sig = "|".join(str(getattr(s, "name", "?")) for s in stages) \
+        or type(pipeline).__name__
+    return hashlib.sha1(
+        f"{name}|{sig}|{np.dtype(pipeline.in_dtype)}".encode()
+    ).hexdigest()[:10]
+
+
+def snapshot_crc(leaves) -> int:
+    crc = 0
+    for l in leaves:
+        a = np.ascontiguousarray(np.asarray(l))
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def write_snapshot(path: str, seq: int, leaves,
+                   meta: Optional[Dict[str, Any]] = None) -> bool:
+    """Serialize one snapshot at ``path``: atomic rename, CRC-stamped,
+    optional JSON ``meta``. Returns False (logged) on any failure — a lost
+    write narrows the restore window, it never raises into the caller."""
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        lv = [np.asarray(l) for l in leaves]
+        arrs = {f"leaf{i}": a for i, a in enumerate(lv)}
+        crc_over = list(lv)
+        if meta:
+            arrs["_meta"] = np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8).copy()
+            # the metadata (session id, frame cursors) is restore-critical
+            # state too: it rides the SAME integrity check as the leaves —
+            # a digit flip in a persisted frame cursor must read as
+            # "corrupted file, skipped", never as a silently shifted resume
+            crc_over.append(arrs["_meta"])
+        with open(tmp, "wb") as f:
+            np.savez(f, _seq=np.int64(seq), _n=np.int64(len(lv)),
+                     _crc=np.uint32(snapshot_crc(crc_over)), **arrs)
+        os.replace(tmp, path)
+        return True
+    except Exception as e:                             # noqa: BLE001
+        log.warning("snapshot persist %s @%d failed (%r)", path, seq, e)
+        return False
+
+
+def read_snapshot(path: str
+                  ) -> Optional[Tuple[int, List[np.ndarray],
+                                      Optional[Dict[str, Any]]]]:
+    """``(seq, leaves, meta)`` of a persisted snapshot, or None when absent,
+    unreadable, or failing the CRC — a corrupted file is logged and ignored
+    (the caller falls through to its fresh-init path)."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            n = int(z["_n"])
+            seq = int(z["_seq"])
+            crc = int(z["_crc"])
+            leaves = [z[f"leaf{i}"] for i in range(n)]
+            meta = None
+            crc_over = list(leaves)
+            if "_meta" in z.files:
+                meta_arr = z["_meta"]
+                crc_over.append(meta_arr)      # meta rides the CRC (write side)
+                meta = json.loads(bytes(meta_arr.tobytes()).decode())
+        if crc != snapshot_crc(crc_over):
+            log.warning("persisted snapshot %s failed its integrity "
+                        "check — ignored", path)
+            return None
+        return seq, leaves, meta
+    except Exception as e:                             # noqa: BLE001
+        log.warning("persisted snapshot %s unreadable (%r) — ignored",
+                    path, e)
+        return None

@@ -519,6 +519,232 @@ def scenario_tenant_isolation():
     _assert_no_leaked_threads(before, "tenant_isolation")
 
 
+def _serve_chaos_pipe():
+    """The crash/overload scenarios' stateful chain (oscillator phase + FIR
+    history) — shared by the child process and the restarted parent so the
+    pipeline signature (and therefore the snapshot files) match."""
+    from futuresdr_tpu.ops.stages import Pipeline, fir_stage, rotator_stage
+    taps = np.hanning(21).astype(np.float32)
+    return Pipeline([fir_stage(taps, fft_len=128), rotator_stage(0.02)],
+                    np.complex64)
+
+
+def _serve_chaos_frames(sid: str, n: int = 64):
+    import zlib
+    # crc32, NOT hash(): the child process and the restarted parent must
+    # derive the SAME stream (str hash is salted per process)
+    rng = np.random.default_rng(zlib.crc32(sid.encode()))
+    return [(rng.standard_normal(512) + 1j * rng.standard_normal(512))
+            .astype(np.complex64) for _ in range(n)]
+
+
+def _serve_child_main(workdir: str) -> int:
+    """The ``--_serve-child`` entry: a serving loop with per-step durable
+    persistence, printing a STEP marker after every flushed snapshot — the
+    parent SIGKILLs it mid-serve at an arbitrary marker."""
+    from futuresdr_tpu.serve import ServeEngine
+    eng = ServeEngine(_serve_chaos_pipe(), frame_size=512, app="crash_serve",
+                      buckets=(2,), queue_frames=8,
+                      persist_dir=workdir, persist_every=1)
+    frames = {sid: _serve_chaos_frames(sid) for sid in ("cr0", "cr1")}
+    for sid, tenant in (("cr0", "t0"), ("cr1", "t1")):
+        eng.admit(tenant=tenant, sid=sid)
+    for i in range(64):
+        for sid in frames:
+            eng.submit(sid, frames[sid][i])
+        eng.step()
+        # flushed BEFORE the marker: once the parent has seen "STEP i",
+        # a kill at any later instant leaves at least step i's snapshot
+        # complete on disk (atomic rename covers the torn-write case)
+        eng.flush_persist()
+        print(f"STEP {i}", flush=True)
+        time.sleep(0.005)
+    return 0
+
+
+def scenario_serve_crash_restart():
+    """Acceptance (ISSUE 14): SIGKILL a serving process mid-serve with
+    ``serve_persist_dir`` set → a virgin engine incarnation in a new
+    process re-admits 100% of the persisted sessions and every resumed
+    stream is BIT-IDENTICAL to an unfailed run from its persisted cursor —
+    kill -9 loses in-flight work, never session state."""
+    import shutil
+    import subprocess
+    import tempfile
+    from futuresdr_tpu.serve import ServeEngine
+    workdir = tempfile.mkdtemp(prefix="fsdr_serve_crash_")
+    env = os.environ.copy()
+    env.update(JAX_PLATFORMS="cpu", FUTURESDR_TPU_AUTOTUNE_CACHE_DIR="off")
+    before = _threads_now()
+    try:
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--_serve-child", workdir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        try:
+            # reader THREAD + queue: a blocking `for line in p.stdout` would
+            # hang the harness forever on a silently-wedged child — the
+            # deadline must bound the WAIT, not just the line count (chaos
+            # invariant I1: no run hangs past its deadline)
+            import queue
+            lines: "queue.Queue" = queue.Queue()
+
+            def _pump_stdout():
+                for line in p.stdout:
+                    lines.put(line)
+
+            threading.Thread(target=_pump_stdout, daemon=True,
+                             name="chaos-serve-child-stdout").start()
+            steps_seen = 0
+            deadline = time.monotonic() + 120.0
+            while steps_seen < 6:
+                wait = deadline - time.monotonic()
+                assert wait > 0, \
+                    f"serve child never reached 6 steps ({steps_seen})"
+                try:
+                    line = lines.get(timeout=min(wait, 5.0))
+                except queue.Empty:
+                    assert p.poll() is None, \
+                        f"child exited early ({steps_seen} steps)"
+                    continue
+                if line.startswith("STEP"):
+                    steps_seen += 1
+            p.kill()                       # SIGKILL — no atexit, no flush
+        finally:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait(timeout=30)
+        # restart: a VIRGIN incarnation over the same persist dir
+        eng = ServeEngine(_serve_chaos_pipe(), frame_size=512,
+                          app="crash_serve", buckets=(2,), queue_frames=8,
+                          persist_dir=workdir, persist_every=1)
+        try:
+            assert eng.restored_sessions == 2, eng.restored_sessions
+            resumed_ok = 0
+            for sid in ("cr0", "cr1"):
+                s = eng.table.get(sid)
+                assert s is not None and s.state == "active", sid
+                start = s.frames_out
+                assert start >= 1, (sid, start)
+                frames = _serve_chaos_frames(sid)
+                # unfailed reference: the bare pipeline over the FULL stream
+                import jax
+                fn = jax.jit(_serve_chaos_pipe().fn())
+                carry = _serve_chaos_pipe().init_carry()
+                ref = []
+                for f in frames[:start + 8]:
+                    carry, y = fn(carry, f)
+                    ref.append(np.asarray(y))
+                for f in frames[start:start + 8]:
+                    assert eng.submit(sid, f)
+                while eng.step():
+                    pass
+                got = eng.results(sid)
+                assert len(got) == 8, (sid, len(got))
+                for a, b in zip(got, ref[start:]):
+                    np.testing.assert_array_equal(a, b, err_msg=sid)
+                resumed_ok += 1
+            assert resumed_ok == 2, "serve_restart_resume_frac < 1.0"
+        finally:
+            eng.shutdown()
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    _assert_no_leaked_threads(before, "serve_crash_restart")
+
+
+def scenario_serve_overload_shed():
+    """Acceptance (ISSUE 14): an admission storm at 2x capacity sheds ONLY
+    via the documented ladder — newcomers refused (rung 1, billed on
+    fsdr_serve_shed_total), resident sessions bit-identical to a storm-free
+    run and under the latency ceiling, and the ladder unwinds in order once
+    the storm passes."""
+    import jax
+    from futuresdr_tpu.serve import ServeEngine, ServeFull, ShedLadder
+    from futuresdr_tpu.serve.engine import _SHED
+    before = _threads_now()
+    pipe_ref = _serve_chaos_pipe()
+    frames = {sid: _serve_chaos_frames(sid, 12) for sid in ("ov0", "ov1")}
+    fn = jax.jit(pipe_ref.fn())
+    ref = {}
+    for sid in frames:
+        carry = pipe_ref.init_carry()
+        ref[sid] = []
+        for f in frames[sid]:
+            carry, y = fn(carry, f)
+            ref[sid].append(np.asarray(y))
+    eng = ServeEngine(_serve_chaos_pipe(), frame_size=512,
+                      app="overload_serve", buckets=(2,), queue_frames=2)
+    eng._ladder = ShedLadder(hi=0.5, lo=0.25, trip=2, clear=2)
+    try:
+        for sid in frames:
+            eng.admit(tenant=sid, sid=sid)
+        backlog = {sid: list(frames[sid]) for sid in frames}
+        out = {sid: [] for sid in frames}
+        shed = 0
+        for step in range(60):
+            if not any(backlog.values()):
+                break
+            # storm: offer 2 frames per session per frame time (2x the
+            # dispatch rate) and keep trying to admit newcomers
+            for sid in frames:
+                for _ in range(2):
+                    if backlog[sid] and eng.submit(sid, backlog[sid][0]):
+                        backlog[sid].pop(0)
+            try:
+                eng.admit(tenant="newcomer", sid=f"nc{step}")
+                eng.close(f"nc{step}")     # got in while healthy: back out
+            except ServeFull:
+                shed += 1                  # ladder rung 1 (or bucket-full)
+            eng.step()
+            for sid in frames:
+                out[sid].extend(eng.results(sid))
+        assert not any(backlog.values()), "resident frames never accepted"
+        # drain the tail: a resident the ladder evicted at rung 2 readmits
+        # BIT-IDENTICALLY once the pressure clears (the evict/readmit leaf
+        # contract under the shedding ladder — the documented recovery)
+        for _ in range(80):
+            if all(len(out[sid]) == 12 for sid in frames):
+                break
+            for sid in frames:
+                s = eng.table.get(sid)
+                if s.state == "evicted":
+                    try:
+                        eng.readmit(sid)
+                    except ServeFull:
+                        pass               # ladder still engaged: next pass
+            eng.step()
+            for sid in frames:
+                out[sid].extend(eng.results(sid))
+        assert eng._ladder.escalations >= 1, "storm never tripped the ladder"
+        assert shed >= 1, "no admission was shed"
+        assert _SHED.get(app="overload_serve", tenant="newcomer",
+                         reason="admission") >= 1
+        # zero resident-session corruption: every resident output
+        # bit-identical to the storm-free reference
+        for sid in frames:
+            assert len(out[sid]) == 12, (sid, len(out[sid]))
+            for a, b in zip(out[sid], ref[sid]):
+                np.testing.assert_array_equal(a, b, err_msg=sid)
+        # latency ceiling: resident p99 stays sane under the storm (the
+        # regress gate grades the measured figure; this is the smoke bound)
+        for sid in frames:
+            p99 = eng.tenant_latency_ms(sid)
+            assert p99 is not None and p99 < 5000.0, (sid, p99)
+        # hysteretic recovery: idle frame times unwind the ladder in order
+        for _ in range(12):
+            eng.step()
+        assert eng._ladder.level == 0, eng._ladder.level
+        eng.close("ov0")                   # free a lane (bucket is full)
+        s = eng.admit(tenant="late")       # admissions reopen
+        assert s.state == "active"
+    finally:
+        eng.shutdown()
+    _assert_no_leaked_threads(before, "serve_overload_shed")
+
+
 def scenario_deadline_bounds_wedge():
     """Acceptance: a wedged sink + run deadline → structured FlowgraphError
     within deadline+grace instead of an indefinite hang."""
@@ -563,9 +789,16 @@ def _random_trial(rng: random.Random, idx: int):
     from futuresdr_tpu.ops import xfer
     from futuresdr_tpu.runtime import faults
     label = f"trial_{idx}"
-    topology = rng.choice(("host", "tpu"))
+    topology = rng.choice(("host", "tpu", "serve"))
     n = rng.choice((50_000, 120_000))
     seed = rng.randrange(1 << 16)
+
+    if topology == "serve":
+        # serving plane: serve steps paired with work:<sid> faults and
+        # durable persistence on — the faulted session retires alone, the
+        # siblings stay bit-identical AND survive a process-restart resume
+        _random_serve_trial(rng, label, seed)
+        return
 
     if topology == "host":
         data = np.arange(n, dtype=np.float32)
@@ -660,6 +893,73 @@ def _random_trial(rng: random.Random, idx: int):
         faults.reset()
 
 
+def _random_serve_trial(rng: random.Random, label: str, seed: int) -> None:
+    """One randomized serving trial: 3 sessions, a seeded ``work:<sid>``
+    fault at one of them, persistence on. Invariants: only the victim
+    retires (siblings bit-identical to their solo runs), its snapshot is
+    purged, and a virgin incarnation resumes exactly the two survivors."""
+    import jax
+    import shutil
+    import tempfile
+    from futuresdr_tpu.runtime import faults
+    from futuresdr_tpu.serve import ServeEngine
+    before = _threads_now()
+    workdir = tempfile.mkdtemp(prefix="fsdr_chaos_serve_")
+    sids = ("rs0", "rs1", "rs2")
+    victim = rng.choice(sids)
+    nframes = rng.choice((4, 6))
+    frames = {sid: _serve_chaos_frames(sid, nframes) for sid in sids}
+    pipe_ref = _serve_chaos_pipe()
+    fn = jax.jit(pipe_ref.fn())
+    ref = {}
+    for sid in sids:
+        carry = pipe_ref.init_carry()
+        ref[sid] = []
+        for f in frames[sid]:
+            carry, y = fn(carry, f)
+            ref[sid].append(np.asarray(y))
+    try:
+        eng = ServeEngine(_serve_chaos_pipe(), frame_size=512,
+                          app=f"chaos_{label}", buckets=(4,), queue_frames=8,
+                          persist_dir=workdir, persist_every=1)
+        for sid in sids:
+            eng.admit(tenant=sid, sid=sid)
+        faults.reset().arm(f"work:{victim}", rate=1.0, max_faults=1,
+                           seed=seed)
+        out = {sid: [] for sid in sids}
+        for i in range(nframes):
+            for sid in sids:
+                s = eng.table.get(sid)
+                if s is not None and s.state == "active":
+                    eng.submit(sid, frames[sid][i])
+            eng.step()
+            for sid in sids:
+                out[sid].extend(eng.results(sid))
+        vv = eng.session_view(victim)
+        assert vv["state"] == "retired" and vv["error"], (label, vv)
+        for sid in sids:
+            if sid == victim:
+                continue
+            assert len(out[sid]) == nframes, (label, sid, len(out[sid]))
+            for a, b in zip(out[sid], ref[sid]):
+                np.testing.assert_array_equal(a, b, err_msg=f"{label}:{sid}")
+        eng.flush_persist()
+        eng.shutdown()
+        # virgin incarnation: exactly the two survivors resume (the
+        # victim's snapshot was purged at retirement)
+        eng2 = ServeEngine(_serve_chaos_pipe(), frame_size=512,
+                           app=f"chaos_{label}", buckets=(4,),
+                           queue_frames=8, persist_dir=workdir,
+                           persist_every=1)
+        assert eng2.restored_sessions == 2, (label, eng2.restored_sessions)
+        assert eng2.table.get(victim) is None, label
+        eng2.shutdown()
+    finally:
+        faults.reset()
+        shutil.rmtree(workdir, ignore_errors=True)
+    _assert_no_leaked_threads(before, label)
+
+
 def campaign(trials: int, seed: int) -> None:
     rng = random.Random(seed)
     for i in range(trials):
@@ -681,6 +981,8 @@ SCENARIOS = (
     ("arena-recycle-replay", scenario_arena_recycle_replay),
     ("isolate-group", scenario_isolate_group),
     ("tenant-isolation", scenario_tenant_isolation),
+    ("serve-crash-restart", scenario_serve_crash_restart),
+    ("serve-overload-shed", scenario_serve_overload_shed),
     ("deadline_bounds_wedge", scenario_deadline_bounds_wedge),
 )
 
@@ -693,7 +995,14 @@ def main(argv=None) -> int:
     ap.add_argument("--trials", type=int, default=12,
                     help="randomized campaign length (ignored with --smoke)")
     ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--_serve-child", dest="serve_child", default=None,
+                    metavar="DIR", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.serve_child:
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ.get("JAX_PLATFORMS", "cpu"))
+        return _serve_child_main(args.serve_child)
     import jax
     jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
     t_all = time.perf_counter()

@@ -110,7 +110,7 @@ def main() -> int:
     sb = profile.COMPILES.get(program="serve:profile-smoke",
                               reason="serve_bucket")
     print(f"# serve: {eng.dispatches} dispatches over {steps} steps, "
-          f"{sb:.0f} bucket compiles (resident: {sorted(eng._programs)})")
+          f"{sb:.0f} bucket compiles (resident: {eng.resident_buckets()})")
     assert eng.dispatches == steps
     assert sb == len(eng._programs) == 1, \
         f"serve bucket compiles must bill once per resident bucket " \

@@ -51,6 +51,11 @@ FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
                        "streamed_link_utilization", "host_codec_overlap_frac",
                        "fm_msps", "wlan_msps", "lora_msps",
                        "serve_sessions_per_chip",
+                       # crash-safe serving (docs/robustness.md
+                       # "Serving-plane recovery"): fraction of persisted
+                       # sessions a virgin incarnation resumes
+                       # bit-identically — target 1.0, any drop flags
+                       "serve_restart_resume_frac",
                        # live profile plane (telemetry/profile.py): the
                        # streamed kernel's run-average utilization — the
                        # MFU ROADMAP item's regress-graded substrate
@@ -70,6 +75,11 @@ INVERSE_SLACK = 0.10       # absolute fraction a lower-is-better field may rise
 # because tail latency on a shared CI host carries straggler noise the
 # median-based rate fields do not
 FIELDS_INVERSE_RATIO_SAME_BACKEND = ("serve_p99_under_churn_ms",
+                                     # resident p99 during an overload
+                                     # storm at 2x capacity: the shedding
+                                     # ladder must keep residents under
+                                     # the latency ceiling
+                                     "serve_shed_p99_ms",
                                      # compile counts/seconds are lower-is-
                                      # better: a storm of steady-state
                                      # recompiles shows up as this figure

@@ -145,12 +145,13 @@ def run_serve(pipe, data, steps: int, churn_every: int = 0,
     return len(sessions) / float(np.median(durs)), eng, p99
 
 
-def _stamp(n, indep, serve, p99, eng, churn_eng) -> dict:
+def _stamp(n, indep, serve, p99, eng, churn_eng, resume_frac=None,
+           shed_p99=None) -> dict:
     """The ONE stamp schema — shared by :func:`measure` (the ``bench.py``
     serve section) and the standalone harness, so the two output paths
     cannot drift from what ``perf/regress.py`` grades."""
     ratio = serve / indep if indep > 0 else 0.0
-    return {
+    out = {
         "serve_sessions": n,
         "serve_indep_fps": round(indep, 1),
         "serve_fps": round(serve, 1),
@@ -162,6 +163,125 @@ def _stamp(n, indep, serve, p99, eng, churn_eng) -> dict:
         "serve_churn_compiles": churn_eng.stats["compiles_during_run"],
         "serve_churned_sessions": churn_eng.stats["churned"],
     }
+    if resume_frac is not None:
+        out["serve_restart_resume_frac"] = round(resume_frac, 3)
+    if shed_p99 is not None:
+        out["serve_shed_p99_ms"] = round(shed_p99, 3)
+    return out
+
+
+def _solo_refs(pipe, data):
+    import jax
+    fn = jax.jit(pipe.fn())
+    refs = []
+    for frames in data:
+        carry = pipe.init_carry()
+        r = []
+        for f in frames:
+            carry, y = fn(carry, f)
+            r.append(np.asarray(y))
+        refs.append(r)
+    return refs
+
+
+def measure_restart_resume(n_sessions: int = 6, frames_each: int = 10
+                           ) -> float:
+    """``serve_restart_resume_frac``: fraction of persisted sessions a
+    VIRGIN engine incarnation resumes BIT-IDENTICALLY after a simulated
+    crash (abandoned engine, durable snapshots on disk — the chaos
+    ``serve-crash-restart`` scenario proves the same with a real SIGKILL;
+    this is the regress-graded figure, target 1.0)."""
+    import shutil
+    import tempfile
+
+    from futuresdr_tpu.serve import ServeEngine
+    pipe = build_pipeline()
+    data = session_data(n_sessions, frames_each, FRAME)
+    refs = _solo_refs(pipe, data)
+    half = frames_each // 2
+    workdir = tempfile.mkdtemp(prefix="fsdr_serve_resume_")
+    try:
+        a = ServeEngine(build_pipeline(), frame_size=FRAME,
+                        app="serve_resume", queue_frames=frames_each,
+                        persist_dir=workdir, persist_every=1)
+        sids = []
+        for i in range(n_sessions):
+            sids.append(a.admit(tenant=f"t{i % N_TENANTS}",
+                                sid=f"rr{i}").sid)
+        for i, sid in enumerate(sids):
+            for f in data[i][:half]:
+                a.submit(sid, f)
+        while a.step():
+            pass
+        a.flush_persist()
+        a.shutdown()                       # "crash": never closed or drained
+        b = ServeEngine(build_pipeline(), frame_size=FRAME,
+                        app="serve_resume", queue_frames=frames_each,
+                        persist_dir=workdir, persist_every=0)
+        for i, sid in enumerate(sids):
+            if b.table.get(sid) is not None:
+                for f in data[i][half:]:
+                    b.submit(sid, f)
+        while b.step():
+            pass
+        ok = 0
+        for i, sid in enumerate(sids):
+            s = b.table.get(sid)
+            if s is None or s.frames_out != frames_each:
+                continue
+            got = b.results(sid)
+            if len(got) == frames_each - half and all(
+                    np.array_equal(g, r)
+                    for g, r in zip(got, refs[i][half:])):
+                ok += 1
+        b.shutdown()
+        return ok / float(n_sessions)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def measure_overload_shed(n_resident: int = 8, steps: int = 40):
+    """``serve_shed_p99_ms``: resident per-frame p99 during an admission
+    storm at 2x capacity (offered load 2x the dispatch rate + a stream of
+    refused admissions). Returns ``(p99_ms, shed_admissions,
+    resident_frames_ok)`` — residents must lose nothing to the storm."""
+    from futuresdr_tpu.serve import ServeEngine, ServeFull, ShedLadder
+    pipe = build_pipeline()
+    data = session_data(n_resident, steps + 4, FRAME)
+    eng = ServeEngine(build_pipeline(), frame_size=FRAME, app="serve_shed",
+                      buckets=(n_resident,), queue_frames=2)
+    eng._ladder = ShedLadder(hi=0.5, lo=0.25, trip=2, clear=4)
+    sessions = [eng.admit(tenant=f"t{i % N_TENANTS}", sid=f"ovr{i}")
+                for i in range(n_resident)]
+    # warmup compile outside the latency sample
+    for i, s in enumerate(sessions):
+        eng.submit(s.sid, data[i][0])
+    eng.step()
+    for s in sessions:
+        eng.results(s.sid)
+    lat = []
+    shed = 0
+    delivered = 0
+    for step in range(1, steps + 1):
+        for i, s in enumerate(sessions):
+            # 2x offered load: two submits per frame time (the second one
+            # rides or bounces on the credit guard — backpressure, not loss)
+            eng.submit(s.sid, data[i][step % len(data[i])])
+            eng.submit(s.sid, data[i][(step + 1) % len(data[i])])
+        try:
+            eng.admit(tenant="storm", sid=f"st{step}")
+            eng.close(f"st{step}")
+        except ServeFull:
+            shed += 1
+        before = {s.sid: s.frames_out for s in sessions}
+        eng.step()
+        for s in sessions:
+            if s.frames_out > before[s.sid] and s.last_latency_s is not None:
+                lat.append(s.last_latency_s)
+            delivered += len(eng.results(s.sid))
+    eng.shutdown()
+    p99 = float(np.percentile(lat, 99)) * 1e3 if lat else 0.0
+    return p99, shed, delivered
 
 
 def measure(n_sessions: int = 32, steps: int = 60, churn_every: int = 10):
@@ -173,7 +293,10 @@ def measure(n_sessions: int = 32, steps: int = 60, churn_every: int = 10):
     serve_fps, eng, _ = run_serve(pipe, list(data), steps)
     _, churn_eng, p99 = run_serve(pipe, list(data), steps,
                                   churn_every=churn_every)
-    return _stamp(n_sessions, indep_fps, serve_fps, p99, eng, churn_eng)
+    resume_frac = measure_restart_resume()
+    shed_p99, _, _ = measure_overload_shed()
+    return _stamp(n_sessions, indep_fps, serve_fps, p99, eng, churn_eng,
+                  resume_frac=resume_frac, shed_p99=shed_p99)
 
 
 def main():
@@ -223,6 +346,24 @@ def main():
             # curve (>= 8x at the committed settings); CI boxes are noisy
             assert ratio >= 3.0, \
                 f"sessions/chip ratio {ratio:.2f} under the 3.0 smoke floor"
+    # crash-safety + overload figures (ISSUE 14): resumed fraction after a
+    # simulated crash (target 1.0 — every persisted session bit-identical)
+    # and resident p99 under an admission storm at 2x capacity. Routed
+    # through _stamp (the ONE schema) like measure() — the two output
+    # paths must not drift from what perf/regress.py grades
+    resume_frac = measure_restart_resume()
+    shed_p99, shed_n, delivered = measure_overload_shed()
+    if stamp is not None:
+        stamp = _stamp(n, indep, serve, p99, eng, churn_eng,
+                       resume_frac=resume_frac, shed_p99=shed_p99)
+    print(f"# restart resume frac: {resume_frac:.3f}   storm p99: "
+          f"{shed_p99:.3f} ms ({shed_n} admissions shed, {delivered} "
+          f"resident frames delivered)")
+    if args.smoke:
+        assert resume_frac == 1.0, \
+            f"serve_restart_resume_frac {resume_frac} != 1.0"
+        assert shed_n > 0, "the admission storm shed nothing"
+        assert shed_p99 > 0.0
     print(json.dumps(stamp))
     if args.smoke:
         print("serve_ab smoke OK")

@@ -402,6 +402,66 @@ def test_checkpoint_disk_corruption_rejected(tmp_path, monkeypatch):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_checkpoint_dir_key_collisions(tmp_path, monkeypatch):
+    """ISSUE 14 satellite: instance names are per-FLOWGRAPH, so two kernels
+    in different flowgraphs can carry the SAME name. The snapshot filename
+    is keyed by name + pipeline-signature hash (utils/snapshot.py
+    ``snapshot_signature``): different pipelines under one reused name map
+    to DIFFERENT files — neither can ever read the other's carry — and the
+    true worst case (same name AND same pipeline) shares one file but still
+    restores bit-consistently because the signature IS the carry contract."""
+    import os
+    from futuresdr_tpu.config import config
+    from futuresdr_tpu.ops import rotator_stage
+    from futuresdr_tpu.tpu import TpuKernel
+    from futuresdr_tpu.utils import snapshot as snap
+    monkeypatch.setattr(config(), "checkpoint_dir", str(tmp_path))
+
+    tk_fir = _make_kernel()                      # fir+rotator chain
+    tk_rot = TpuKernel([rotator_stage(0.05)], np.complex64,
+                       frame_size=_FRAME, frames_in_flight=2,
+                       checkpoint_every=1)
+    asyncio.run(tk_rot.init(None, None))
+    # same instance name, different pipelines
+    tk_rot.meta.instance_name = tk_fir.meta.instance_name
+    p_fir, p_rot = tk_fir._ckpt_file(), tk_rot._ckpt_file()
+    assert p_fir != p_rot, "signature hash failed to separate the files"
+    # the signature term is the pipeline (stage names + in dtype), pinned
+    # at the shared-helper level too
+    assert snap.snapshot_signature(tk_fir.pipeline,
+                                   tk_fir.meta.instance_name) != \
+        snap.snapshot_signature(tk_rot.pipeline, tk_rot.meta.instance_name)
+
+    # drive both; each persists under its own file
+    frames = _frames(4)
+    _drive(tk_fir, frames)
+    _drive(tk_rot, frames)
+    assert _wait_for(lambda: os.path.exists(p_fir) and os.path.exists(p_rot))
+    _drain_persist_queue()
+
+    # a fresh incarnation of EACH kernel loads only its own snapshot: the
+    # rotator kernel (same name!) never sees the FIR chain's carry
+    tk_fir2 = _make_kernel()
+    got = tk_fir2._load_disk_ckpt()
+    assert got is not None
+    _, leaves = got
+    import jax
+    _, fresh = tk_fir2.pipeline.compile_wired(
+        tk_fir2.frame_size, tk_fir2.wire, device=tk_fir2.inst.device,
+        k=tk_fir2.k_batch, donate=tk_fir2._donate)
+    treedef = jax.tree_util.tree_flatten(fresh)[1]
+    assert tk_fir2.pipeline.carry_matches(leaves, treedef, fresh)
+    tk_rot2 = TpuKernel([rotator_stage(0.05)], np.complex64,
+                        frame_size=_FRAME, frames_in_flight=2,
+                        checkpoint_every=1)
+    asyncio.run(tk_rot2.init(None, None))
+    tk_rot2.meta.instance_name = tk_fir.meta.instance_name
+    got2 = tk_rot2._load_disk_ckpt()
+    assert got2 is not None
+    assert len(got2[1]) != len(leaves), \
+        "rotator kernel read the FIR chain's snapshot"
+
+
 def test_checkpoint_clean_eos_purges_snapshot(tmp_path, monkeypatch):
     """A cleanly finished stream's state is complete — the persisted
     snapshot is removed so a later process starts fresh (the in-kernel

@@ -443,7 +443,7 @@ def test_flight_record_shape_and_markdown(tmp_path, monkeypatch):
         # golden shape: every black-box section present
         assert set(rep) == {"reason", "unix_time", "threads", "flowgraphs",
                             "spans", "span_drops", "e2e_latency", "profile",
-                            "metrics"}
+                            "serve", "metrics"}
         # profile-plane section: compile counters + storm classification
         # ride every flight record (telemetry/profile.py)
         assert set(rep["profile"]) == {"active_compiles", "compiles_total",

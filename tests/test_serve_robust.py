@@ -1,0 +1,562 @@
+"""Crash-safe serving (ISSUE 14, docs/robustness.md "Serving-plane
+recovery"): durable per-session carry snapshots + virgin-incarnation
+restore, graceful drain lifecycle (drain/healthz/readyz + Retry-After),
+the SLO-aware overload-shedding ladder, and doctor coverage of the serving
+plane."""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from futuresdr_tpu.ops.stages import Pipeline, fir_stage, rotator_stage
+from futuresdr_tpu.serve import (ServeDraining, ServeEngine, ServeFull,
+                                 ServeOverload, ShedLadder, register_app,
+                                 unregister_app)
+
+FRAME = 1024
+
+
+def _pipe():
+    taps = np.hanning(31).astype(np.float32)
+    return Pipeline([fir_stage(taps, fft_len=256), rotator_stage(0.03)],
+                    np.complex64)
+
+
+def _frames(n, seed=0, frame=FRAME):
+    rng = np.random.default_rng(seed)
+    return [(rng.standard_normal(frame) + 1j * rng.standard_normal(frame))
+            .astype(np.complex64) for _ in range(n)]
+
+
+def _solo(pipe, frames):
+    fn, carry = pipe.compile(FRAME, donate=False)
+    out = []
+    for f in frames:
+        carry, y = fn(carry, f)
+        out.append(np.asarray(y))
+    return out
+
+
+def _drain_results(eng, *sessions):
+    while eng.step():
+        pass
+    return [eng.results(s.sid) for s in sessions]
+
+
+# ---------------------------------------------------------------------------
+# durable session state: persist -> virgin incarnation restores bit-identically
+# ---------------------------------------------------------------------------
+
+def test_persisted_sessions_resume_bit_identically(tmp_path):
+    """Acceptance (tentpole 1): a virgin ServeEngine incarnation re-admits
+    every persisted session and continues its stream BIT-IDENTICAL to an
+    unfailed run — the serving analog of the kernel checkpoint_dir
+    contract, through the carry_matches-validated readmit path."""
+    pipe = _pipe()
+    da, db = _frames(9, 1), _frames(9, 2)
+    expa, expb = _solo(pipe, da), _solo(pipe, db)
+
+    a = ServeEngine(_pipe(), frame_size=FRAME, app="crashsafe",
+                    buckets=(2,), queue_frames=16,
+                    persist_dir=str(tmp_path), persist_every=1)
+    sa = a.admit(tenant="t0", sid="dura")
+    sb = a.admit(tenant="t1", sid="durb")
+    for fa, fb in zip(da[:5], db[:5]):
+        assert a.submit(sa.sid, fa) and a.submit(sb.sid, fb)
+    outa, outb = _drain_results(a, sa, sb)
+    assert len(outa) == 5 and len(outb) == 5
+    a.flush_persist()
+    a.shutdown()                     # "crash": never closed, never drained
+
+    b = ServeEngine(_pipe(), frame_size=FRAME, app="crashsafe",
+                    buckets=(2,), queue_frames=16,
+                    persist_dir=str(tmp_path), persist_every=1)
+    assert b.restored_sessions == 2
+    # restore WARMS the current bucket (all-masked no-op dispatch): the
+    # restarted pod reports ready without waiting for traffic — readyz
+    # would otherwise sit 503 forever on idle restored sessions
+    assert b.health()["ready"] and b.health()["compiled"]
+    ra, rb = b.table.get("dura"), b.table.get("durb")
+    assert ra.state == "active" and ra.tenant == "t0"
+    assert ra.frames_out == 5 and rb.frames_out == 5
+    for fa, fb in zip(da[5:], db[5:]):
+        assert b.submit("dura", fa) and b.submit("durb", fb)
+    tail_a, tail_b = _drain_results(b, ra, rb)
+    for got, want in ((outa + tail_a, expa), (outb + tail_b, expb)):
+        assert len(got) == 9
+        for x, y in zip(got, want):
+            np.testing.assert_array_equal(x, y)
+    b.shutdown()
+
+
+def test_corrupted_snapshot_skipped_per_session(tmp_path):
+    """One torn/corrupted file must not block the OTHER sessions' recovery
+    — per-session skip, exactly the kernel disk-checkpoint rule."""
+    a = ServeEngine(_pipe(), frame_size=FRAME, app="corrupt",
+                    buckets=(2,), queue_frames=8,
+                    persist_dir=str(tmp_path), persist_every=1)
+    a.admit(tenant="t", sid="good")
+    a.admit(tenant="t", sid="bad")
+    for f in _frames(2, 3):
+        a.submit("good", f)
+        a.submit("bad", f)
+    while a.step():
+        pass
+    a.flush_persist()
+    a.shutdown()
+    path = a._store.path("bad")
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+
+    b = ServeEngine(_pipe(), frame_size=FRAME, app="corrupt",
+                    buckets=(2,), queue_frames=8,
+                    persist_dir=str(tmp_path), persist_every=1)
+    assert b.restored_sessions == 1
+    assert b.table.get("good") is not None
+    assert b.table.get("bad") is None
+    b.shutdown()
+
+
+def test_clean_close_and_retire_purge_snapshots(tmp_path):
+    """A cleanly closed session's state is complete and a retired (faulted)
+    session must not resurrect — both purge their durable files; evicted
+    and active sessions keep theirs."""
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="purge",
+                      buckets=(4,), queue_frames=8,
+                      persist_dir=str(tmp_path), persist_every=1)
+    for sid in ("pa", "pb", "pc"):
+        eng.admit(tenant="t", sid=sid)
+        eng.submit(sid, _frames(1, 7)[0])
+    while eng.step():
+        pass
+    eng.flush_persist()
+    for sid in ("pa", "pb", "pc"):
+        assert os.path.exists(eng._store.path(sid)), sid
+    eng.close("pa")
+    eng._retire(eng.table.get("pb"), RuntimeError("injected"))
+    eng.flush_persist()
+    assert not os.path.exists(eng._store.path("pa"))
+    assert not os.path.exists(eng._store.path("pb"))
+    assert os.path.exists(eng._store.path("pc"))
+    eng.shutdown()
+
+
+def test_pipeline_signature_separates_app_snapshots(tmp_path):
+    """A DIFFERENT pipeline under a reused app name maps to different
+    snapshot files (signature hash) — restore finds nothing instead of
+    restoring a mismatched carry."""
+    a = ServeEngine(_pipe(), frame_size=FRAME, app="sig",
+                    buckets=(1,), queue_frames=4,
+                    persist_dir=str(tmp_path), persist_every=1)
+    a.admit(tenant="t", sid="s1")
+    a.submit("s1", _frames(1, 9)[0])
+    a.step()
+    a.flush_persist()
+    a.shutdown()
+    other = Pipeline([rotator_stage(0.2)], np.complex64)
+    b = ServeEngine(other, frame_size=FRAME, app="sig",
+                    buckets=(1,), queue_frames=4,
+                    persist_dir=str(tmp_path), persist_every=1)
+    assert b.restored_sessions == 0
+    assert a._store.signature != b._store.signature
+    b.shutdown()
+
+
+def test_persist_off_is_one_falsy_check(tmp_path):
+    """serve_persist_every=0 (the default) must keep step() free of any
+    persistence work — no store, no snapshot, no executor traffic."""
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="pfree", buckets=(1,),
+                      queue_frames=4)
+    assert eng._store is None and eng._persist_every == 0
+    s = eng.admit(tenant="t")
+    eng.submit(s.sid, _frames(1, 4)[0])
+    eng.step()
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# graceful lifecycle: drain + health/readiness
+# ---------------------------------------------------------------------------
+
+def test_drain_refuses_admissions_finishes_and_persists(tmp_path):
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="drainy",
+                      buckets=(2,), queue_frames=16,
+                      persist_dir=str(tmp_path), persist_every=0)
+    s = eng.admit(tenant="t", sid="dr1")
+    for f in _frames(4, 5):
+        assert eng.submit(s.sid, f)
+    report = eng.drain()
+    assert report["drained"] and report["frames_drained"] == 4
+    assert report["pending_frames"] == 0
+    assert report["sessions_persisted"] == 1
+    eng.flush_persist()
+    assert os.path.exists(eng._store.path("dr1"))
+    assert len(eng.results(s.sid)) == 4
+    with pytest.raises(ServeDraining):
+        eng.admit(tenant="t2")
+    # the shed counter bills the refused admission under reason=drain
+    from futuresdr_tpu.telemetry import prom
+    from futuresdr_tpu.serve.engine import _SHED
+    assert _SHED.get(app="drainy", tenant="t2", reason="drain") == 1
+    assert eng.health()["ready"] is False
+    eng.shutdown()
+
+
+def test_drain_is_idempotent_and_describe_reports_lifecycle():
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="drain2", buckets=(1,),
+                      queue_frames=4)
+    r1 = eng.drain()
+    r2 = eng.drain()
+    assert r1["drained"] and r2["drained"]
+    d = eng.describe()
+    assert d["draining"] and d["drained"]
+    assert d["shed"]["rung"] == "ok"
+    eng.shutdown()
+
+
+def test_retry_after_derived_from_step_rate():
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="retry", buckets=(1,),
+                      queue_frames=4)
+    assert eng.retry_after_s() == 1          # no rate measured yet
+    s = eng.admit(tenant="t")
+    for f in _frames(6, 6):
+        eng.submit(s.sid, f)
+        eng.step()
+    after = eng.retry_after_s()
+    assert 1 <= after <= 30
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# SLO-aware overload shedding
+# ---------------------------------------------------------------------------
+
+def test_shed_ladder_unit_escalates_and_unwinds_in_order():
+    lad = ShedLadder(hi=0.8, lo=0.3, trip=2, clear=2)
+    # healthy observations keep rung 0
+    assert lad.observe(0.1, None, 0.0) == 0
+    # two consecutive over-watermark steps escalate exactly one rung
+    assert lad.observe(0.9, None, 0.0) == 0
+    assert lad.observe(0.9, None, 0.0) == 1
+    # SLO misses escalate too (pressure fine, p99 over budget)
+    assert lad.observe(0.1, 50.0, 10.0) == 1
+    assert lad.observe(0.1, 50.0, 10.0) == 2
+    assert lad.observe(0.9, None, 0.0) == 2
+    assert lad.observe(0.9, None, 0.0) == 3
+    assert lad.observe(0.9, None, 0.0) == 3      # capped at brownout
+    # the band between watermarks HOLDS the rung (hysteresis)
+    for _ in range(6):
+        assert lad.observe(0.5, None, 0.0) == 3
+    # recovery unwinds ONE rung per clear window, in order
+    assert lad.observe(0.1, 1.0, 10.0) == 3
+    assert lad.observe(0.1, 1.0, 10.0) == 2
+    assert lad.observe(0.1, None, 0.0) == 2
+    assert lad.observe(0.1, None, 0.0) == 1
+    assert lad.observe(0.1, None, 0.0) == 1
+    assert lad.observe(0.1, None, 0.0) == 0
+    assert lad.escalations == 3
+
+
+def test_overload_sheds_admissions_then_recovers():
+    """Rung 1 integration: sustained queue pressure refuses NEW admissions
+    (ServeOverload, billed on fsdr_serve_shed_total{reason=admission});
+    resident sessions stay bit-exact; draining the backlog unwinds the
+    ladder and admissions reopen."""
+    pipe = _pipe()
+    data = _frames(8, 11)
+    exp = _solo(pipe, data)
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="storm",
+                      buckets=(2,), queue_frames=2)    # total = 4 credits
+    eng._ladder = ShedLadder(hi=0.5, lo=0.25, trip=2, clear=2)
+    s = eng.admit(tenant="hot", sid="res")
+    out = []
+    # storm: offer two frames per dispatched one — post-step pressure 0.5+;
+    # a credit-refused submit RETRIES later (backpressure, not loss), so
+    # the resident stream stays gap-free
+    backlog = list(data)
+    refused = 0
+    for _ in range(50):
+        if not backlog:
+            break
+        for _ in range(2):
+            if backlog and eng.submit(s.sid, backlog[0]):
+                backlog.pop(0)
+            elif backlog:
+                refused += 1
+                break
+        eng.step()
+        out.extend(eng.results(s.sid))
+    assert not backlog
+    assert eng._ladder.level >= 1
+    with pytest.raises(ServeOverload):
+        eng.admit(tenant="newcomer")
+    from futuresdr_tpu.serve.engine import _SHED
+    assert _SHED.get(app="storm", tenant="newcomer",
+                     reason="admission") >= 1
+    # the resident stream never shed a frame and stays bit-exact
+    while eng.step():
+        pass
+    out.extend(eng.results(s.sid))
+    assert len(out) == 8
+    for a, b in zip(out, exp):
+        np.testing.assert_array_equal(a, b)
+    # recovery: idle steps observe pressure 0 and unwind the ladder —
+    # INCLUDING with an SLO set whose rolling p99 window is frozen at the
+    # storm's values (idle ticks skip the stale SLO term; a frozen p99
+    # must never keep escalating an empty engine)
+    eng._slo_ms = 0.001                   # every recorded latency "misses"
+    for _ in range(8):
+        eng.step()
+    assert eng._ladder.level == 0
+    eng._slo_ms = 0.0
+    s2 = eng.admit(tenant="newcomer")
+    assert s2.state == "active"
+    eng.shutdown()
+
+
+def test_shed_rung2_evicts_most_stalled_session(tmp_path):
+    """Rung 2: the most-stalled lane (no queued input the longest) evicts
+    to host/disk, freeing its lane without touching resident bits."""
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="rung2",
+                      buckets=(2,), queue_frames=2,
+                      persist_dir=str(tmp_path), persist_every=0)
+    eng._ladder = ShedLadder(hi=0.5, lo=0.25, trip=1, clear=8)
+    # same tenant: its fair share is the whole budget, so one hog session
+    # can push aggregate pressure past the watermark while its sibling
+    # lane sits stalled
+    hog = eng.admit(tenant="t", sid="hogs")
+    idle = eng.admit(tenant="t", sid="idles")
+    data = _frames(10, 12)
+    for i in range(0, 10, 2):
+        eng.submit(hog.sid, data[i])
+        eng.submit(hog.sid, data[i + 1])
+        eng.step()
+        if eng._ladder.level >= 2:
+            break
+    assert eng._ladder.level >= 2
+    assert idle.state == "evicted" and idle.carry_leaves is not None
+    assert eng.shed_evictions >= 1
+    eng.flush_persist()
+    assert os.path.exists(eng._store.path("idles"))   # evict-to-disk
+    eng.shutdown()
+
+
+def test_brownout_k_lever_drops_megabatch_on_residents(monkeypatch):
+    """Rung 3 with serve_brownout="k": resident buckets re-dispatch at K=1
+    (per-dispatch latency over throughput), and recovery returns to the
+    configured K reusing the cached base program — no recompile."""
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="bk",
+                      buckets=(1,), queue_frames=16, frames_per_dispatch=4)
+    eng._brownout = "k"
+    s = eng.admit(tenant="t")
+    data = _frames(12, 13)
+    for f in data[:4]:
+        assert eng.submit(s.sid, f)
+    assert eng.step() == 4                   # K=4 megabatch
+    compiles_k4 = eng.compiles
+    eng._set_brownout(True)
+    assert eng._k_eff == 1
+    for f in data[4:8]:
+        assert eng.submit(s.sid, f)
+    assert eng.step() == 1                   # browned out: one frame per step
+    assert eng.compiles == compiles_k4 + 1   # the K=1 program, once
+    while eng.step():
+        pass
+    eng._set_brownout(False)
+    for f in data[8:12]:
+        assert eng.submit(s.sid, f)
+    assert eng.step() == 4                   # back to K=4 ...
+    assert eng.compiles == compiles_k4 + 1   # ... with zero new compiles
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# doctor coverage of the serving plane
+# ---------------------------------------------------------------------------
+
+def test_doctor_trips_serve_wedged_and_reports_serve_section():
+    from futuresdr_tpu.telemetry import doctor as doc
+    d = doc.doctor()
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="wedge", buckets=(1,),
+                      queue_frames=4)
+    try:
+        att = next(a for a in d._serve.values() if a.engine() is eng)
+        s = eng.admit(tenant="t", sid="wedged_sid")
+        assert eng.submit(s.sid, _frames(1, 14)[0])
+        saved = d.window
+        d.window = 2
+        try:
+            for _ in range(4):               # baseline + strikes past window
+                d._tick_serve()
+        finally:
+            d.window = saved
+        diag = att.diagnosis
+        assert diag and diag["state"] == "serve_wedged"
+        assert diag["app"] == "wedge"
+        assert "wedged_sid" in diag["stuck_sessions"]
+        assert diag["pending_frames"] == 1
+        # flight record carries the serve section with the diagnosis
+        rec = d.flight_record("test")
+        assert rec["serve"]["wedge"]["diagnosis"]["state"] == "serve_wedged"
+        # progress re-arms
+        eng.step()
+        d._tick_serve()
+        assert att.diagnosis is None and not att.tripped
+        # doctor.report() serves the full engine view
+        rep = d.report(events=[])
+        assert rep["serve"]["wedge"]["app"] == "wedge"
+        assert rep["serve"]["wedge"]["capacity"] == 1
+    finally:
+        eng.shutdown()
+
+
+def test_engine_shutdown_detaches_from_doctor():
+    from futuresdr_tpu.telemetry import doctor as doc
+    d = doc.doctor()
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="detach", buckets=(1,))
+    assert any(a.engine() is eng for a in d._serve.values())
+    eng.shutdown()
+    assert not any(a.engine() is eng for a in d._serve.values())
+
+
+# ---------------------------------------------------------------------------
+# REST lifecycle: drain route, healthz/readyz, Retry-After, structured errors
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    return json.load(urllib.request.urlopen(url))
+
+
+def _post(url, body=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    return json.load(urllib.request.urlopen(req))
+
+
+def test_rest_lifecycle_drain_healthz_readyz_retry_after():
+    from futuresdr_tpu import Runtime
+    from futuresdr_tpu.runtime.ctrl_port import ControlPort
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="lifecycle",
+                      buckets=(1,), queue_frames=8)
+    register_app(eng)
+    rt = Runtime()
+    cp = ControlPort(rt.handle, bind="127.0.0.1:29671")
+    cp.start()
+    base = "http://127.0.0.1:29671"
+    try:
+        assert _get(f"{base}/healthz") == {"ok": True}
+        # ready: nothing admitted yet
+        r = _get(f"{base}/readyz")
+        assert r["ready"] and r["apps"]["lifecycle"]["compiled"]
+        # admitted + pending but not yet compiled -> NOT ready (503)
+        s = _post(f"{base}/api/serve/lifecycle/session/", {"tenant": "g"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/readyz")
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After")
+        body = json.load(ei.value)
+        assert body["ready"] is False
+        assert body["apps"]["lifecycle"]["compiled"] is False
+        # first dispatch compiles the bucket -> ready again
+        assert eng.submit(s["sid"], _frames(1, 15)[0])
+        eng.step()
+        assert _get(f"{base}/readyz")["ready"]
+        # ServeFull past the largest bucket: 503 + Retry-After + structured
+        # JSON body naming the app
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{base}/api/serve/lifecycle/session/", {"tenant": "g"})
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        body = json.load(ei.value)
+        assert body["app"] == "lifecycle" and "error" in body
+        # drain over REST: report + refused admissions + unready
+        rep = _post(f"{base}/api/serve/lifecycle/drain/")
+        assert rep["drained"] and rep["app"] == "lifecycle"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{base}/api/serve/lifecycle/session/", {"tenant": "x"})
+        assert ei.value.code == 503
+        assert "draining" in json.load(ei.value)["error"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/readyz")
+        assert json.load(ei.value)["apps"]["lifecycle"]["draining"] is True
+        # structured 404 bodies carry the app too
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/api/serve/lifecycle/session/nosuch/")
+        assert json.load(ei.value) == {"error": "session not found",
+                                       "app": "lifecycle"}
+    finally:
+        cp.stop()
+        unregister_app("lifecycle")
+        eng.shutdown()
+
+
+def test_readiness_storm_gate_scopes_to_serving_programs():
+    """readyz must gate on SERVING-program compile storms only: flowgraph
+    instance names collide across runs by design, so an unrelated kernel's
+    recompile churn (e.g. a busy test/bench process) must never pull the
+    pod out of rotation — a churning slot-bucket ladder must."""
+    from futuresdr_tpu.serve import api
+    from futuresdr_tpu.telemetry import profile
+    for _ in range(4):                     # an unrelated kernel "storm"
+        profile.record_compile("tk_readyz_probe", "warmup", "sig", 0.01)
+    assert any(s["program"] == "tk_readyz_probe"
+               for s in profile.plane().storm_report())
+    ready, detail = api.readiness()
+    assert ready and detail["compile_storms"] is None
+    try:
+        for _ in range(4):                 # a genuine serving-plane storm
+            profile.record_compile("serve:readyz_probe", "serve_bucket",
+                                   "cap=2", 0.01)
+        ready, detail = api.readiness()
+        assert not ready
+        assert any(s["program"] == "serve:readyz_probe"
+                   for s in detail["compile_storms"])
+    finally:
+        # drop the synthetic records: the storm window is 60 s and a later
+        # test's readyz probe must not inherit this test's fake storm
+        plane = profile.plane()
+        with plane._lock:
+            keep = [e for e in plane._recent
+                    if e[1] not in ("tk_readyz_probe", "serve:readyz_probe")]
+            plane._recent.clear()
+            plane._recent.extend(keep)
+
+
+def test_sigterm_hook_drains_registered_apps():
+    """install_sigterm_drain: SIGTERM marks every registered app draining,
+    finishes queued frames, then chains the previous handler."""
+    from futuresdr_tpu.serve.engine import install_sigterm_drain
+    import futuresdr_tpu.serve.engine as engine_mod
+    eng = ServeEngine(_pipe(), frame_size=FRAME, app="sigterm",
+                      buckets=(1,), queue_frames=8)
+    register_app(eng)
+    chained = threading.Event()
+    prev = signal.signal(signal.SIGTERM, lambda s, f: chained.set())
+    engine_mod._sigterm_installed = False    # fresh install for this test
+    try:
+        assert install_sigterm_drain(timeout=10.0)
+        s = eng.admit(tenant="t")
+        for f in _frames(3, 16):
+            assert eng.submit(s.sid, f)
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 10.0
+        while not (eng.drained and chained.is_set()):
+            assert time.monotonic() < deadline, "sigterm drain did not land"
+            time.sleep(0.02)
+        assert len(eng.results(s.sid)) == 3
+        with pytest.raises(ServeDraining):
+            eng.admit(tenant="late")
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        engine_mod._sigterm_installed = False
+        unregister_app("sigterm")
+        eng.shutdown()
