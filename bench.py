@@ -1016,6 +1016,41 @@ def main():
             print(f"# serving A/B unavailable: {e!r}", file=sys.stderr)
             serve_extra["serve_error"] = repr(e)
 
+    # mesh-sharded device plane (futuresdr_tpu/shard / perf/multichip_ab.py):
+    # the D=8 one-dispatch data-sharded program vs 8 independent per-device
+    # loops — multichip_scaling_frac and sharded_streamed_msps are
+    # regress-graded. Runs as a SUBPROCESS: the virtual 8-device CPU mesh
+    # flag only acts before jax initializes, and this process's backend is
+    # long live (the dryrun_multichip discipline).
+    multichip_extra = {}
+    if not args.skip_extra_chains:
+        try:
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "perf", "multichip_ab.py"), "--stamp"],
+                capture_output=True, text=True, timeout=600)
+            stamp_line = next(
+                (ln.strip() for ln in reversed(r.stdout.splitlines())
+                 if ln.strip().startswith("{")), None)
+            if stamp_line is None:
+                raise RuntimeError(
+                    f"multichip_ab produced no stamp (rc={r.returncode}): "
+                    f"{r.stdout[-300:]}{r.stderr[-300:]}")
+            d = json.loads(stamp_line)
+            multichip_extra = {k: d[k] for k in
+                               ("multichip_scaling_frac",
+                                "sharded_streamed_msps",
+                                "multichip_devices") if k in d}
+            print(f"# multichip A/B: scaling frac "
+                  f"{multichip_extra.get('multichip_scaling_frac')} at D="
+                  f"{multichip_extra.get('multichip_devices')}, sharded "
+                  f"streamed {multichip_extra.get('sharded_streamed_msps')} "
+                  f"Msps", file=sys.stderr)
+        except Exception as e:                          # noqa: BLE001
+            print(f"# multichip A/B unavailable: {e!r}", file=sys.stderr)
+            multichip_extra["multichip_error"] = repr(e)
+
     # interior precision + Pallas hot kernels (ops/precision.py /
     # perf/precision_ab.py): the auto-lowered resident rate next to the f32
     # headline, the plan's pinned SNR floor, and the Pallas kernel matrix —
@@ -1109,6 +1144,7 @@ def main():
         **fanout_extra,
         **dag_extra,
         **serve_extra,
+        **multichip_extra,
         **precision_extra,
         **roof,
         **profile_extra,

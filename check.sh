@@ -61,6 +61,15 @@ echo "== multi-tenant serving gate (docs/serving.md) =="
 # newcomers while residents keep delivering (serve_shed_p99_ms stamped)
 JAX_PLATFORMS=cpu python perf/serve_ab.py --smoke
 
+echo "== mesh-sharded device plane gate (docs/parallel.md) =="
+# the data-sharded fused program on the virtual 8-device mesh: bit-identical
+# per shard to the D=1 program at matched K, ONE dispatch per group (the
+# per-shard dispatch count never multiplies with D), ZERO cross-shard
+# collectives in the compiled HLO (interior edges never leave their shard),
+# and the D=8 scaling fraction vs the independent-per-device-loop linear
+# reference clears the floor (multichip_scaling_frac stamped, regress-graded)
+JAX_PLATFORMS=cpu python perf/multichip_ab.py --smoke
+
 echo "== chaos smoke (docs/robustness.md invariants) =="
 # seeded fault injection at every site × every failure policy on the CPU
 # backend: restart recovers bit-correct, isolate finishes independent

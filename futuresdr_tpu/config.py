@@ -180,6 +180,19 @@ class Config:
     #   "fir=off;fft2048=bf16": "off" keeps a stage f32 whatever the budget
     #   says, a precision forces it — the config-side form of the per-stage
     #   ctrl retune (TpuKernel ctrl {"stage": ..., "interior_precision": ...})
+    # Mesh-sharded device plane (futuresdr_tpu/shard, docs/parallel.md
+    # "Mesh-sharded device plane"): lift fused device programs onto the
+    # chip mesh. "off" (default) is the single-device contract —
+    # shard_pipeline returns the SAME program object, bit-identical by
+    # construction. Env: FUTURESDR_TPU_SHARD etc.
+    shard: str = "off"                     # "off" | "auto" | "data" | "model"
+    shard_devices: int = 0                 # mesh width (0 = every visible
+    #   device); requesting more than exist REFUSES loudly at plan time
+    #   (parallel/mesh.make_mesh — never a silent truncation)
+    serve_shard_devices: int = 0           # slot-axis sharding of the
+    #   serving engine (sessions x devices, docs/serving.md): a bucket's
+    #   session lanes spread one contiguous block per device; 0 = off.
+    #   Buckets whose capacity does not divide evenly stay unsharded.
     tpu_checkpoint_every: int = 1          # carry-checkpoint cadence of the
     #   device-plane recovery contract (docs/robustness.md "Device-plane
     #   recovery"): snapshot the kernel carry every Nth dispatch group (host

@@ -17,7 +17,18 @@ __all__ = ["make_mesh", "factor_devices", "shard_params", "P", "NamedSharding"]
 
 
 def factor_devices(n: int, n_axes: int = 2) -> Tuple[int, ...]:
-    """Factor n devices into a near-balanced axis tuple (largest factors first)."""
+    """Factor n devices into a near-balanced axis tuple (largest axes first).
+
+    The product ALWAYS equals ``n`` and the tuple always has ``n_axes``
+    entries — prime counts on deep meshes land the whole prime on one axis
+    with 1s elsewhere (``factor_devices(7, 3) == (7, 1, 1)``), never a
+    truncated or padded factorization. Degenerate inputs are refused
+    loudly instead of returning a shape whose product is wrong."""
+    n, n_axes = int(n), int(n_axes)
+    if n < 1:
+        raise ValueError(f"cannot factor {n} devices (need >= 1)")
+    if n_axes < 1:
+        raise ValueError(f"need >= 1 mesh axis, got {n_axes}")
     dims = [1] * n_axes
     rem = n
     # peel off prime factors, assigning each to the currently-smallest axis
@@ -33,16 +44,36 @@ def factor_devices(n: int, n_axes: int = 2) -> Tuple[int, ...]:
     for f in sorted(factors, reverse=True):
         i = int(np.argmin(dims))
         dims[i] *= f
+    assert int(np.prod(dims)) == n, (n, n_axes, dims)
     return tuple(sorted(dims, reverse=True))
 
 
 def make_mesh(axis_names: Sequence[str], shape: Optional[Sequence[int]] = None,
               devices=None) -> Mesh:
-    """Mesh over all (or given) devices; shape auto-factored when omitted."""
+    """Mesh over all (or given) devices; shape auto-factored when omitted.
+
+    A ``shape`` needing MORE devices than exist is refused with a clear
+    error (previously a cryptic numpy reshape failure): a silently
+    truncated or short mesh would change the program's sharding semantics.
+    A shape covering FEWER devices than exist stays valid — an explicit
+    sub-mesh (e.g. a 1-device reference mesh next to the full one) is a
+    deliberate, documented pattern (``__graft_entry__.dryrun_multichip``).
+    """
     devices = list(devices if devices is not None else jax.devices())
     if shape is None:
         shape = factor_devices(len(devices), len(axis_names))
-    arr = np.array(devices[:int(np.prod(shape))]).reshape(tuple(shape))
+    shape = tuple(int(s) for s in shape)
+    if len(shape) != len(axis_names):
+        raise ValueError(
+            f"mesh shape {shape} has {len(shape)} axes but "
+            f"{len(axis_names)} axis names {tuple(axis_names)}")
+    need = int(np.prod(shape))
+    if need > len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {need} devices but only "
+            f"{len(devices)} exist — refusing to build a short mesh "
+            f"(shrink the shape or grow the slice)")
+    arr = np.array(devices[:need]).reshape(shape)
     return Mesh(arr, tuple(axis_names))
 
 

@@ -213,7 +213,8 @@ class ServeEngine:
                  frames_per_dispatch: int = 1,
                  persist_dir: Optional[str] = None,
                  persist_every: Optional[int] = None,
-                 slo_ms: Optional[float] = None):
+                 slo_ms: Optional[float] = None,
+                 shard_devices: Optional[int] = None):
         from ..config import config
         from ..tpu.instance import instance
         self.pipeline = pipeline
@@ -230,6 +231,27 @@ class ServeEngine:
             buckets = self._cached_buckets()
         self.buckets = tuple(sorted({int(b) for b in buckets})) \
             if buckets else default_buckets()
+        # -- slot-axis sharding (docs/parallel.md "Mesh-sharded device
+        # plane", docs/serving.md): a bucket's session lanes spread across
+        # the chip mesh — the stacked carries, batch and mask shard on the
+        # SLOT axis (one contiguous lane block per device), so a D-chip
+        # mesh serves D x the lanes per dispatch with the same program.
+        # Off (the default, serve_shard_devices=0 / D=1) is byte-for-byte
+        # the single-device engine. Refusals are loud (make_mesh contract:
+        # more devices than exist never truncates silently); a bucket whose
+        # capacity does not divide by D stays UNSHARDED — evict/readmit and
+        # lane surgery address (device, lane) through slot_device()
+        sd = int(shard_devices if shard_devices is not None
+                 else config().get("serve_shard_devices", 0) or 0)
+        self._shard_d = max(1, sd)
+        self._slot_sharding = None
+        if self._shard_d > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..shard.data import shard_mesh
+            from ..shard.plan import AXIS
+            self._shard_mesh = shard_mesh(self._shard_d)   # loud refusal
+            self._slot_sharding = NamedSharding(self._shard_mesh, P(AXIS))
+            self._replicated_sharding = NamedSharding(self._shard_mesh, P())
         #: compiled serving programs keyed (capacity, k, pipeline tag) — the
         #: session-churn contract is that this map only ever GAINS entries
         #: (join/leave/stall/evict inside resident buckets never recompiles;
@@ -325,15 +347,50 @@ class ServeEngine:
             self._fresh = self.pipeline.init_carry()
         return self._fresh
 
+    def _shard_ok(self, capacity: int) -> bool:
+        """Does this bucket shard over the mesh? Needs the slot-axis mesh
+        armed AND an even lane split (one contiguous block per device)."""
+        return (self._slot_sharding is not None
+                and capacity % self._shard_d == 0)
+
+    def slot_device(self, slot: int) -> tuple:
+        """The ``(device_index, lane)`` pair a slot addresses under the
+        slot-axis sharding (``(0, slot)`` unsharded): slots shard in
+        contiguous blocks, so device ``slot // (capacity // D)`` owns lane
+        ``slot % (capacity // D)`` of its shard. Evict/readmit and lane
+        surgery stay slot-addressed — this is the observability mapping
+        (session views, doctor)."""
+        if not self._shard_ok(self.table.capacity):
+            return (0, int(slot))
+        per = self.table.capacity // self._shard_d
+        return (int(slot) // per, int(slot) % per)
+
+    def _place_slots(self, x):
+        """Land a slot-axis array (leading ``[capacity]``) according to the
+        bucket's sharding — plain device placement when unsharded."""
+        if self._shard_ok(self.table.capacity):
+            import jax
+            return jax.device_put(x, self._slot_sharding)
+        return xfer.to_device(x, self.inst.device)
+
     def _stacked_fresh(self, capacity: int):
         import jax
         import jax.numpy as jnp
         fresh = self._fresh_carry()
-        return jax.tree_util.tree_map(
+        stacked = jax.tree_util.tree_map(
             lambda l: jnp.stack([jnp.asarray(l)] * capacity), fresh)
+        if self._shard_ok(capacity):
+            stacked = jax.device_put(stacked, self._slot_sharding)
+        return stacked
 
     def _set_lane(self, slot: int, value_tree) -> None:
         import jax
+        if self._shard_ok(self.table.capacity):
+            # lane values arrive committed to ONE device (restore_carry,
+            # fresh-carry leaves) — replicate them over the mesh so the
+            # scatter into the slot-sharded stack sees one device set
+            value_tree = jax.device_put(value_tree,
+                                        self._replicated_sharding)
         self._carries = jax.tree_util.tree_map(
             lambda L, v: L.at[slot].set(v), self._carries, value_tree)
 
@@ -404,6 +461,13 @@ class ServeEngine:
             lambda L, f: jnp.concatenate(
                 [L, jnp.stack([jnp.asarray(f)] * extra)]),
             self._carries, fresh)
+        if self._shard_ok(cap):
+            # re-shard the grown stack: the concatenate above computed on
+            # whatever sharding the old bucket had (a non-dividing small
+            # bucket may have been unsharded) — the new bucket's lanes
+            # split one contiguous block per device
+            self._carries = jax.device_put(self._carries,
+                                           self._slot_sharding)
         self.table.grow(cap)
         self.credits.set_total(self._queue_frames * cap)
         log.info("%s: slot bucket grew %d -> %d (active %d)", self.app, cur,
@@ -657,8 +721,8 @@ class ServeEngine:
             try:
                 prog = self._program(C, K)
                 t0 = _trace.now() if _trace.enabled else 0
-                x = xfer.to_device(batch, self.inst.device)
-                act = xfer.to_device(active, self.inst.device)
+                x = self._place_slots(batch)
+                act = self._place_slots(active)
                 if t0:
                     _trace.complete("tpu", "H2D", t0,
                                     args={"bytes": batch.nbytes})
@@ -893,9 +957,13 @@ class ServeEngine:
         with _profile.compiling(f"serve:{self.app}", "serve_bucket",
                                 f"cap={C},k={K},frame={self.frame_size},"
                                 f"pipe={self._pipe_tag},warm=restore"):
+            # _place_slots, not bare to_device: a slot-sharded bucket's
+            # carries are committed to the mesh, and a single-device batch
+            # would make the warm dispatch raise (and the first real step
+            # pay a second, unbilled compile)
             _new_c, outs = prog(self._carries,
-                                xfer.to_device(batch, self.inst.device),
-                                xfer.to_device(active, self.inst.device))
+                                self._place_slots(batch),
+                                self._place_slots(active))
             jax.block_until_ready(outs)
         self._warmed.add(key)
 
@@ -1215,6 +1283,15 @@ class ServeEngine:
                 "credit_fair_share": self.credits.fair_share(),
                 "draining": self._draining,
                 "drained": self._drained,
+                # slot-axis sharding (docs/parallel.md): the mesh width and
+                # whether the CURRENT bucket's lanes spread over it
+                "shard": ({"devices": self._shard_d,
+                           "sharded": self._shard_ok(self.table.capacity),
+                           "lanes_per_device":
+                               (self.table.capacity // self._shard_d
+                                if self._shard_ok(self.table.capacity)
+                                else self.table.capacity)}
+                          if self._shard_d > 1 else None),
                 "shed": {**self._ladder.view(),
                          "slo_ms": self._slo_ms or None,
                          "brownout": self._brownout,
@@ -1236,6 +1313,12 @@ class ServeEngine:
     def session_view(self, sid: str) -> dict:
         with self._lock:
             v = self._session(sid).view()
+            if self._shard_d > 1 and v.get("slot") is not None:
+                # the (device, lane) pair this session's slot addresses
+                # under the slot-axis sharding — evict/readmit stay
+                # slot-addressed, this is the mesh-side identity
+                dev, lane = self.slot_device(v["slot"])
+                v["device"], v["device_lane"] = dev, lane
         t = v["tenant"]
         v["tenant_p50_ms"] = self.tenant_latency_ms(t, 0.5)
         v["tenant_p99_ms"] = self.tenant_latency_ms(t, 0.99)

@@ -1,0 +1,32 @@
+"""Mesh-sharded device plane (docs/parallel.md "Mesh-sharded device plane").
+
+Lifts fused device programs (``ops/stages.py`` pipelines) onto a
+``jax.sharding.Mesh`` over the chip mesh:
+
+* :func:`plan_shard` / :class:`ShardPlan` — the per-stage shard-plan pass
+  (``shard/plan.py``), published to ``doctor.report()["shard"]``;
+* :class:`ShardedProgram` / :class:`ShardRunner` — data sharding: D
+  independent stream lanes, one carry shard per device, whole-mesh
+  checkpoint + per-shard replay logs (``shard/data.py``);
+* :class:`ModelShardedProgram` — the arXiv:2002.03260 interior
+  decomposition: one frame's item axis across the mesh (``shard/model.py``);
+* :func:`shard_pipeline` — plan-then-apply; ``shard=off`` / D=1 returns
+  the SAME pipeline object (bit-identical by construction).
+
+The serving plane's slot-axis sharding (sessions x devices) lives in
+``serve/engine.py`` (``ServeEngine(shard_devices=…)``) on the same mesh
+helpers.
+"""
+
+from .data import (ShardedProgram, ShardRunner, collective_ops,
+                   shard_mesh, shard_pipeline)
+from .model import ModelShardedProgram
+from .plan import (AXIS, MODES, ShardPlan, StageDecision, clear_plans,
+                   note_plan, plan_shard, plans_report, resolve_devices)
+
+__all__ = [
+    "ShardPlan", "StageDecision", "plan_shard", "resolve_devices",
+    "note_plan", "plans_report", "clear_plans", "MODES", "AXIS",
+    "ShardedProgram", "ShardRunner", "shard_pipeline", "shard_mesh",
+    "collective_ops", "ModelShardedProgram",
+]

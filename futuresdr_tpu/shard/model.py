@@ -1,0 +1,110 @@
+"""Model-sharded interior stages: one frame split across the mesh.
+
+The arXiv:2002.03260 decomposition applied to the fused device plane
+(``shard/plan.py`` mode ``model``): instead of giving each device its own
+stream lane (``shard/data.py``), ONE frame's item axis shards over the
+mesh — the overlap-save FIR/FFT interior is a batch of independent
+sub-transforms over frame blocks, and the PFB channelizer's phase bank
+splits the same way, so each device computes its contiguous block span
+locally and XLA/GSPMD inserts exactly the boundary communication the
+decomposition needs (a halo ``collective-permute`` for the FIR history
+carry, gathers where a stage genuinely mixes the whole frame). This is
+the sharding story ``parallel/stream_sp.py`` hand-writes with explicit
+``ppermute`` halos, obtained instead from the UNCHANGED fused program by
+placement alone — the same ``Pipeline.fn()`` the single-device kernel
+dispatches, with the input committed to a ``NamedSharding`` along the
+item axis.
+
+Output parity is numerical (allclose at f32 tolerance), not bit-pinned:
+GSPMD may re-associate reductions across shard boundaries. The
+bit-identity contract belongs to the data plane; the plan pass records
+that distinction (``docs/parallel.md``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..log import logger
+from .plan import ShardPlan, note_plan, plan_shard
+
+__all__ = ["ModelShardedProgram"]
+
+log = logger("shard.model")
+
+
+class ModelShardedProgram:
+    """A fused pipeline whose FRAME shards across the mesh (one stream,
+    D-way interior decomposition). Same compile surface as
+    :class:`~futuresdr_tpu.shard.data.ShardedProgram` minus the leading
+    device axis: frames stay ``[K, frame]`` (or ``[frame]``), placed
+    sharded along the ITEM axis; the carry replicates (it is the
+    whole-stream state every shard's halo reads)."""
+
+    def __init__(self, pipeline, plan: Optional[ShardPlan] = None,
+                 n_devices: Optional[int] = None, name: str = "shard_model"):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .data import shard_mesh
+        self.pipeline = pipeline
+        self.plan = plan if plan is not None else plan_shard(
+            pipeline, mode="model", n_devices=n_devices)
+        if not self.plan.active:
+            raise ValueError("ModelShardedProgram needs an active plan")
+        if self.plan.applied != "model":
+            raise ValueError(
+                f"plan applied {self.plan.applied!r}, not 'model' "
+                f"(declines: {self.plan.declined})")
+        self.name = str(name)
+        self.n_devices = self.plan.n_devices
+        self.axis = self.plan.axis
+        self.mesh = shard_mesh(self.n_devices, self.axis)
+        # frames shard on their LAST axis (the item axis — a megabatch
+        # [K, frame] batch keeps K replicated); carries replicate
+        self._frame_sharding = NamedSharding(self.mesh, P(self.axis))
+        self._batch_sharding = NamedSharding(self.mesh, P(None, self.axis))
+        self._replicated = NamedSharding(self.mesh, P())
+        self.in_dtype = pipeline.in_dtype
+        self.out_dtype = pipeline.out_dtype
+        self.ratio = pipeline.ratio
+        self.stages = pipeline.stages
+        # per-shard frame chunks must honor the per-lane frame contract
+        self.frame_multiple = int(np.lcm(pipeline.frame_multiple,
+                                         self.n_devices))
+        note_plan(self.name, self.plan)
+
+    def place(self, x):
+        import jax
+        x = np.asarray(x)
+        sh = self._frame_sharding if x.ndim == 1 else self._batch_sharding
+        return jax.device_put(x, sh)
+
+    def init_carry(self):
+        import jax
+        return jax.device_put(self.pipeline.init_carry(), self._replicated)
+
+    def fn(self, k: int = 1):
+        import jax
+        inner = self.pipeline.fn()
+        if int(k) == 1:
+            return inner
+        def scan(carry, xs):
+            return jax.lax.scan(lambda c, xk: inner(c, xk), carry, xs)
+        return scan
+
+    def compile(self, frame_size: int, k: int = 1):
+        import jax
+        assert frame_size % self.frame_multiple == 0, \
+            f"frame_size {frame_size} not a multiple of {self.frame_multiple}"
+        return jax.jit(self.fn(k), donate_argnums=()), self.init_carry()
+
+    def compiled_text(self, frame_size: int, k: int = 1) -> str:
+        fn, carry = self.compile(frame_size, k)
+        shape = (frame_size,) if k == 1 else (k, frame_size)
+        x = self.place(np.zeros(shape, dtype=self.in_dtype))
+        return fn.lower(carry, x).compile().as_text()
+
+    def out_items(self, in_items: int) -> int:
+        return self.pipeline.out_items(in_items)

@@ -1,0 +1,243 @@
+"""Shard-plan pass: decide how a fused device program spreads over the mesh.
+
+The analog of ``ops/precision.py``'s plan surface for the DEVICE axis: one
+pass inspects a fused ``Pipeline``/``FanoutPipeline``/``DagPipeline`` and
+decides, per stage, how it rides a :class:`jax.sharding.Mesh` — then the
+decisions (and every decline, with its reason) are published for
+``doctor.report()["shard"]`` and the REST profile view, exactly like
+precision plans. Three modes (config ``shard`` / the ``mode=`` argument):
+
+* ``off`` — the DEFAULT and the single-device contract: :func:`plan_shard`
+  marks the plan inert and ``shard.data.shard_pipeline`` returns the SAME
+  pipeline object, bit-identical by construction. ``n_devices == 1``
+  resolves to ``off`` too.
+* ``data`` — the always-sound lift: megabatch frames gain a leading device
+  axis (``[K]`` per dispatch becomes ``[D, K]``), each device owns ONE
+  carry shard and runs an independent stream lane
+  (``shard/data.ShardedProgram``). No stage ever communicates across
+  shards, so the compiled program carries ZERO collectives (the
+  ``perf/multichip_ab.py`` smoke asserts exactly that) and each device's
+  row is bit-identical to the D=1 program fed that row.
+* ``model`` — the arXiv:2002.03260 decomposition for the big interior
+  stages: ONE frame's item axis shards across the mesh, the overlap-save
+  FIR/FFT block batch and the PFB channelizer's phase bank distribute, and
+  XLA/GSPMD inserts the collectives (halo ``collective-permute`` for the
+  FIR history, gathers at the sinks) — ``shard/model.py``. Per-stage
+  decisions record which stages genuinely decompose (``"model"``) and
+  which merely replicate through sharding propagation (``"replicate"``).
+
+``mode="auto"`` resolves to ``data`` — the lift that is sound for every
+program shape; stages that would profit from model sharding are still
+ANNOTATED in the decisions so an operator can see what an explicit
+``mode="model"`` would shard.
+
+Refusals are loud: an unknown mode, or more devices requested than exist,
+raise ``ValueError`` at plan time (the ``make_mesh`` refusal contract —
+never a silent truncation). Declines that have a sound fallback (a model
+plan whose frame cannot split evenly) are RECORDED on the plan and the
+mode falls back to ``data``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["StageDecision", "ShardPlan", "plan_shard", "resolve_devices",
+           "note_plan", "plans_report", "clear_plans", "MODES", "AXIS"]
+
+MODES = ("off", "auto", "data", "model")
+
+#: the canonical mesh axis name of the shard plane (one 1-D axis: the
+#: ragged slot/serving axis is a HOST-side table, not a second mesh axis)
+AXIS = "dev"
+
+#: stage-name markers of the interior stages the arXiv:2002.03260
+#: decomposition targets: the FFT block batch and the polyphase bank both
+#: split along the frame's item axis with only boundary communication
+_MODEL_MARKERS = ("fft", "pfb", "channelizer")
+
+
+@dataclass
+class StageDecision:
+    """One stage's shard verdict: the mode applied (``data`` lanes /
+    ``model`` interior decomposition / ``replicate`` — the stage rides
+    sharding propagation without decomposing) and the reason when it is
+    not what the requested mode asked for."""
+    stage: str
+    index: int
+    mode: str
+    reason: Optional[str] = None
+
+    def as_dict(self) -> dict:
+        out = {"stage": self.stage, "index": self.index, "mode": self.mode}
+        if self.reason:
+            out["reason"] = self.reason
+        return out
+
+
+@dataclass
+class ShardPlan:
+    """The pass output: requested vs applied mode, the device count and
+    axis, per-stage decisions, and every decline reason. ``applied ==
+    "off"`` is the bit-identity contract — the caller must hand back the
+    UNCHANGED program object."""
+    mode: str                       # requested
+    applied: str                    # "off" | "data" | "model"
+    n_devices: int
+    axis: str = AXIS
+    decisions: List[StageDecision] = field(default_factory=list)
+    declined: List[str] = field(default_factory=list)
+
+    @property
+    def active(self) -> bool:
+        return self.applied != "off" and self.n_devices > 1
+
+    def describe(self) -> dict:
+        return {
+            "mode": self.mode,
+            "applied": self.applied,
+            "n_devices": self.n_devices,
+            "axis": self.axis,
+            "stages": [d.as_dict() for d in self.decisions],
+            "declined": list(self.declined),
+        }
+
+
+def resolve_devices(n_devices: Optional[int] = None) -> int:
+    """The device count a plan targets: an explicit request (refused loudly
+    when more than exist — the ``make_mesh`` contract), else every visible
+    device, else 1 when no backend is live."""
+    import jax
+    try:
+        avail = len(jax.devices())
+    except Exception:                          # noqa: BLE001 — no backend
+        avail = 1
+    if n_devices is None:
+        from ..config import config
+        n_devices = int(config().get("shard_devices", 0) or 0) or avail
+    n_devices = int(n_devices)
+    if n_devices < 1:
+        raise ValueError(f"shard plan needs >= 1 device, got {n_devices}")
+    if n_devices > avail:
+        raise ValueError(
+            f"shard plan requests {n_devices} devices but only {avail} "
+            f"exist — a truncated mesh would silently change the program; "
+            f"pass n_devices<={avail} or grow the slice")
+    return n_devices
+
+
+def _is_model_stage(stage) -> bool:
+    """Does this stage decompose along the frame's item axis the way the
+    large-scale-DFT split does? FFT-backed stages (overlap-save FIR, the
+    spectral stages) and the PFB channelizer qualify: their interior is a
+    batch of independent sub-transforms plus boundary exchange."""
+    name = str(getattr(stage, "name", "")).lower()
+    if any(m in name for m in _MODEL_MARKERS):
+        return True
+    return getattr(stage, "lti", None) is not None
+
+
+def plan_shard(pipeline, mode: Optional[str] = None,
+               n_devices: Optional[int] = None,
+               frame_size: Optional[int] = None,
+               axis: str = AXIS) -> ShardPlan:
+    """Run the pass. ``mode=None`` reads config ``shard`` (default "off").
+
+    Raises ``ValueError`` for an unknown mode or an over-sized device
+    request; records (never raises) declines that have a sound fallback.
+    """
+    from ..config import config
+    if mode is None:
+        mode = str(config().get("shard", "off") or "off")
+    mode = str(mode).strip().lower()
+    if mode not in MODES:
+        raise ValueError(f"unknown shard mode {mode!r} (one of {MODES})")
+    if mode == "off":
+        return ShardPlan(mode, "off", 1, axis)
+    n = resolve_devices(n_devices)
+    if n == 1:
+        # one device: every mode degenerates to the unsharded program —
+        # applied=off is the bit-identity contract, not a decline
+        return ShardPlan(mode, "off", 1, axis)
+
+    stages = list(getattr(pipeline, "stages", []))
+    declined: List[str] = []
+    applied = "data" if mode in ("auto", "data") else "model"
+
+    if applied == "model":
+        # the item-axis split needs an even frame division to place one
+        # contiguous chunk per device; a ragged split would reshard on
+        # every stage boundary
+        if frame_size is not None and int(frame_size) % n != 0:
+            declined.append(
+                f"model: frame_size {frame_size} not divisible by "
+                f"{n} devices — fell back to data sharding")
+            applied = "data"
+        elif not any(_is_model_stage(s) for s in stages):
+            declined.append(
+                "model: no FFT/PFB interior stage to decompose — fell "
+                "back to data sharding")
+            applied = "data"
+        elif getattr(pipeline, "n_branches", 0):
+            # multi-sink programs: per-sink rates differ, so one item-axis
+            # split does not map to every sink — the data lift covers them
+            declined.append(
+                "model: multi-sink (fan-out/DAG) program — per-sink rate "
+                "contracts do not share one item-axis split; fell back to "
+                "data sharding")
+            applied = "data"
+
+    decisions = []
+    for i, s in enumerate(stages):
+        if applied == "data":
+            d_mode, reason = "data", None
+            if mode == "model":
+                reason = "plan fell back to data (see declined)"
+            elif _is_model_stage(s):
+                reason = "model-capable (mode=model would decompose it)"
+            decisions.append(StageDecision(
+                str(getattr(s, "name", f"stage{i}")), i, d_mode, reason))
+        else:
+            if _is_model_stage(s):
+                decisions.append(StageDecision(
+                    str(getattr(s, "name", f"stage{i}")), i, "model", None))
+            else:
+                decisions.append(StageDecision(
+                    str(getattr(s, "name", f"stage{i}")), i, "replicate",
+                    "no shardable interior axis — rides sharding "
+                    "propagation"))
+    return ShardPlan(mode, applied, n, axis, decisions, declined)
+
+
+# ---------------------------------------------------------------------------
+# published plans (the doctor/REST surface — ops/precision.note_plan pattern)
+# ---------------------------------------------------------------------------
+
+_plans_lock = threading.Lock()
+_plans: dict = {}
+
+
+def note_plan(name: str, plan: ShardPlan, extra: Optional[dict] = None
+              ) -> None:
+    """Publish a program's shard plan under its name; ``extra`` merges
+    runner-side live stats (dispatches, per-shard frames, replay counts)
+    into the same entry so ``doctor.report()["shard"]`` is one lookup."""
+    entry = plan.describe()
+    if extra:
+        entry.update(extra)
+    with _plans_lock:
+        _plans[str(name)] = entry
+
+
+def plans_report() -> dict:
+    with _plans_lock:
+        return {k: dict(v) for k, v in _plans.items()}
+
+
+def clear_plans() -> None:
+    with _plans_lock:
+        _plans.clear()

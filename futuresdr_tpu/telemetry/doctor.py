@@ -789,6 +789,19 @@ class Doctor:
                     "busy_frac": (busy / wall) if wall else 0.0}
         lanes = {n: lane_entry(iv) for n, iv in lane_iv.items()}
         work = {f"work:{n}": lane_entry(iv) for n, iv in blocks.items()}
+        # mesh-sharded runs (futuresdr_tpu/shard): one lane PER DEVICE SHARD
+        # from the runner's cat="shard" spans ("shard:d0"…"shard:d7") — each
+        # shard's interval is its dispatch window (per-device on-chip timing
+        # is not host-visible; the window is when that shard's lane held the
+        # device), so a dead shard shows as an idle lane next to its busy
+        # siblings
+        shard_sp: Dict[str, list] = {}
+        for e in evs:
+            if e.cat == "shard" and e.dur_ns is not None:
+                shard_sp.setdefault(e.name, []).append(
+                    (e.t0_ns, e.t0_ns + e.dur_ns))
+        shard_lanes = {n: lane_entry(iv)
+                       for n, iv in sorted(shard_sp.items())}
         device_busy = {n: v["busy_frac"] for n, v in lanes.items()
                        if v["spans"]}
         if device_busy:
@@ -881,6 +894,9 @@ class Doctor:
             "e2e_latency": e2e if e2e.get("p50_s") is not None else None,
             "devchain": devchains or None,
             "serve": serve or None,
+            # mesh-sharded device plane (futuresdr_tpu/shard): published
+            # shard plans + live runner stats, and the per-shard lanes above
+            "shard": _shard_section(shard_lanes) or None,
             "roofline": roofline,
             "compile_storms": prof.storm_report() or None,
             # interior-precision plans (ops/precision.py): per program, the
@@ -917,6 +933,24 @@ def _precision_plans() -> dict:
         return plans_report()
     except Exception:                                  # noqa: BLE001
         return {}
+
+
+def _shard_section(shard_lanes: dict) -> dict:
+    """The mesh-sharded plane's report section: published shard plans with
+    their runners' live stats (futuresdr_tpu/shard/plan.py) plus the
+    per-shard dispatch-window lanes collected from cat="shard" spans.
+    Guarded exactly like the precision plans."""
+    try:
+        from ..shard.plan import plans_report
+        plans = plans_report()
+    except Exception:                                  # noqa: BLE001
+        plans = {}
+    out: dict = {}
+    if plans:
+        out["plans"] = plans
+    if shard_lanes:
+        out["lanes"] = shard_lanes
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -52,7 +52,8 @@ from . import prom, spans
 
 __all__ = [
     "ProfilePlane", "plane", "register", "compiling", "record_compile",
-    "COMPILES", "COMPILE_SECONDS", "MFU", "HBM_UTIL", "COMPILE_REASONS",
+    "COMPILES", "COMPILE_SECONDS", "MFU", "HBM_UTIL", "MFU_DEVICE",
+    "HBM_UTIL_DEVICE", "COMPILE_REASONS",
 ]
 
 #: the compile-site vocabulary (free-form strings are accepted; these are
@@ -75,6 +76,18 @@ HBM_UTIL = prom.gauge(
     "fsdr_hbm_util",
     "live HBM bandwidth utilization per program (windowed dispatch rate x "
     "registered bytes/unit vs the chip peak)", ("program",))
+# per-DEVICE attribution of the same two gauges (the mesh-sharded device
+# plane, futuresdr_tpu/shard): a sharded program registers one entry per
+# shard (register(..., device="3")) and its runner bills each device's
+# units, so fsdr_mfu attribution gains the device axis next to program
+MFU_DEVICE = prom.gauge(
+    "fsdr_mfu_device",
+    "live model-flops utilization per (program, device shard) — the "
+    "mesh-sharded plane's per-chip attribution", ("program", "device"))
+HBM_UTIL_DEVICE = prom.gauge(
+    "fsdr_hbm_util_device",
+    "live HBM bandwidth utilization per (program, device shard)",
+    ("program", "device"))
 
 
 class _Program:
@@ -100,10 +113,13 @@ class _Program:
     __slots__ = ("name", "_lock", "units", "t_first", "t_last", "cost",
                  "_cost_thunk", "_window_t", "_window_units", "_units_first",
                  "achieved_flops", "achieved_bytes", "mfu",
-                 "hbm_util", "dispatch", "compute_dtype")
+                 "hbm_util", "dispatch", "compute_dtype", "device")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, device: Optional[str] = None):
         self.name = name
+        self.device = device            # shard label ("0"…"7") of a mesh-
+        #   sharded program's per-device entry, None for whole-program
+        #   entries — selects the per-device gauge family
         self._lock = threading.Lock()
         self.compute_dtype = "f32"      # dominant compute dtype — keys the
         #   MFU denominator on the right per-dtype chip peak (the tabled
@@ -296,7 +312,8 @@ class ProfilePlane:
 
     # -- roofline attribution --------------------------------------------------
     def register(self, program: str, cost: Optional[dict] = None,
-                 cost_thunk=None, dtype: Optional[str] = None) -> _Program:
+                 cost_thunk=None, dtype: Optional[str] = None,
+                 device: Optional[str] = None) -> _Program:
         """Get-or-create the program's live entry; an explicit ``cost``
         ({"flops", "bytes"} per unit) binds immediately, ``cost_thunk``
         defers the cost-analysis compile until the plane is read
@@ -306,12 +323,17 @@ class ProfilePlane:
         default / "bf16" for interior-precision-lowered programs) — the MFU
         denominator keys on it (utils/roofline.dtype_peak_flops), so an
         f32 chain grades against the f32 peak, not the bf16 one it cannot
-        reach."""
+        reach. ``device`` registers a mesh-sharded program's PER-DEVICE
+        entry (one per shard, next to the whole-program one): its gauges
+        land in ``fsdr_mfu_device{program,device}`` and its registry key is
+        ``program@dev<device>`` so shards never collide with the
+        aggregate."""
         name = str(program)
+        key = name if device is None else f"{name}@dev{device}"
         with self._lock:
-            p = self._programs.get(name)
+            p = self._programs.get(key)
             if p is None:
-                p = self._programs[name] = _Program(name)
+                p = self._programs[key] = _Program(name, device=device)
         if dtype is not None:
             p.compute_dtype = str(dtype)
         if cost is not None:
@@ -400,12 +422,22 @@ class ProfilePlane:
             p.mfu = p.achieved_flops / dtype_peak_flops(peaks,
                                                         p.compute_dtype)
             p.hbm_util = p.achieved_bytes / peaks["hbm_bytes"]
-            MFU.set(p.mfu, program=p.name)
-            HBM_UTIL.set(p.hbm_util, program=p.name)
+            if p.device is None:
+                MFU.set(p.mfu, program=p.name)
+                HBM_UTIL.set(p.hbm_util, program=p.name)
+            else:
+                # a mesh-sharded program's per-shard entry: the device axis
+                # rides its own gauge family so the aggregate exposition
+                # keeps its one-label shape
+                MFU_DEVICE.set(p.mfu, program=p.name, device=p.device)
+                HBM_UTIL_DEVICE.set(p.hbm_util, program=p.name,
+                                    device=p.device)
             if rec.enabled:
                 # Perfetto counter tracks next to the lane spans
-                rec.counter(f"mfu:{p.name}", p.mfu)
-                rec.counter(f"hbm_util:{p.name}", p.hbm_util)
+                tag = p.name if p.device is None \
+                    else f"{p.name}@dev{p.device}"
+                rec.counter(f"mfu:{tag}", p.mfu)
+                rec.counter(f"hbm_util:{tag}", p.hbm_util)
 
     # -- snapshots -------------------------------------------------------------
     def roofline_report(self) -> dict:
@@ -417,6 +449,8 @@ class ProfilePlane:
         out: Dict[str, dict] = {}
         for p in self.programs():
             entry: dict = {"units": p.units}
+            if p.device is not None:
+                entry["device"] = p.device
             if p.cost is not None:
                 fl, by = p.cost["flops"], p.cost["bytes"]
                 ai = fl / max(by, 1e-12)
@@ -449,7 +483,8 @@ class ProfilePlane:
                     entry["mfu_avg"] = round(rate * fl / pfl, 6)
                     entry["hbm_util_avg"] = round(
                         rate * by / peaks["hbm_bytes"], 6)
-            out[p.name] = entry
+            out[p.name if p.device is None
+                else f"{p.name}@dev{p.device}"] = entry
         return {"peaks": peaks, "ridge_flop_per_byte":
                 (round(ridge, 2) if ridge is not None else None),
                 "programs": out}
@@ -508,9 +543,10 @@ def plane() -> ProfilePlane:
 
 
 def register(program: str, cost: Optional[dict] = None,
-             cost_thunk=None, dtype: Optional[str] = None) -> _Program:
+             cost_thunk=None, dtype: Optional[str] = None,
+             device: Optional[str] = None) -> _Program:
     return plane().register(program, cost=cost, cost_thunk=cost_thunk,
-                            dtype=dtype)
+                            dtype=dtype, device=device)
 
 
 def compiling(program: str, reason: str, signature: str = "") -> _Compiling:

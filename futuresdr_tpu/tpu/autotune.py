@@ -32,11 +32,12 @@ from ..telemetry import profile as _profile
 from .instance import TpuInstance, instance
 
 __all__ = ["autotune", "autotune_streamed", "autotune_serve",
-           "default_frames", "measure_link",
+           "autotune_shard", "default_frames", "measure_link",
            "pick_wire", "StreamedResults", "record_streamed_pick",
            "cached_frames_per_dispatch", "cached_streamed_pick",
            "record_serve_buckets", "cached_serve_buckets",
-           "record_interior_precision", "cached_interior_precision"]
+           "record_interior_precision", "cached_interior_precision",
+           "record_shard_devices", "cached_shard_devices"]
 
 log = logger("tpu.autotune")
 
@@ -380,6 +381,17 @@ def _norm_entry(v) -> Optional[dict]:
                         out["serve_buckets"] = buckets
                 except (TypeError, ValueError):
                     pass
+            nd = v.get("n_devices")
+            if nd is not None:
+                # round-19 axis (mesh-sharded device plane): the measured
+                # best shard width — same per-axis guard, a malformed field
+                # loses only this axis
+                try:
+                    nd = int(nd)
+                    if nd >= 1:
+                        out["n_devices"] = nd
+                except (TypeError, ValueError):
+                    pass
             ip = v.get("interior_precision")
             if ip is not None:
                 # same per-axis guard: a malformed precision field (a list,
@@ -463,6 +475,8 @@ def _record_sig(sig: tuple, frames_per_dispatch: int,
         entry["serve_buckets"] = list(prev["serve_buckets"])
     if prev and prev.get("interior_precision"):
         entry["interior_precision"] = prev["interior_precision"]
+    if prev and prev.get("n_devices"):
+        entry["n_devices"] = int(prev["n_devices"])
     _streamed_cache[sig] = entry
     # K-only records persist in the legacy bare-int form (readable by older
     # processes); the dict form is written only when it carries more
@@ -571,6 +585,129 @@ def cached_interior_precision(stages, in_dtype,
     if entry is None:
         return None
     return entry.get("interior_precision")
+
+
+# ---------------------------------------------------------------------------
+# device-count axis (futuresdr_tpu/shard, docs/parallel.md "Mesh-sharded
+# device plane")
+# ---------------------------------------------------------------------------
+
+def record_shard_devices(stages, in_dtype, platform: str, n: int) -> None:
+    """Stamp the measured best shard width into this chain's streamed-pick
+    cache entry — the device-count axis rides next to (k, inflight,
+    serve_buckets, interior_precision) under one signature, so a later
+    launch of the same chain spreads over the width the previous tune
+    measured instead of guessing. Non-positive widths are dropped, not
+    stored (the :func:`_norm_entry` contract)."""
+    try:
+        n = int(n)
+    except (TypeError, ValueError):
+        return
+    if n < 1:
+        return
+    sig = _streamed_sig(_serve_sig_stages(stages), in_dtype, platform)
+    cur = _streamed_cache.get(sig) or _disk_load().get(_sig_str(sig)) \
+        or {"k": 1, "inflight": None}
+    entry = {**cur, "n_devices": n}
+    _streamed_cache[sig] = entry
+    _disk_store(sig, entry)
+
+
+def cached_shard_devices(stages, in_dtype, platform: str) -> Optional[int]:
+    """The shard width the chain's last :func:`autotune_shard` measured;
+    None when never stamped."""
+    entry = cached_streamed_pick(_serve_sig_stages(stages), in_dtype,
+                                 platform)
+    if entry is None:
+        return None
+    return entry.get("n_devices")
+
+
+def autotune_shard(stages, in_dtype, frame: Optional[int] = None,
+                   k: int = 1, devices: Sequence[int] = (1, 2, 4, 8),
+                   min_seconds: float = 0.3,
+                   inst: Optional[TpuInstance] = None,
+                   record: bool = True) -> Tuple[int, Dict[int, float]]:
+    """Measure the DATA-sharded program per device count and pick the best
+    width (the device-count axis of the streamed-pick cache).
+
+    For each candidate D (capped at the visible device count) the real
+    sharded dispatch loop runs — one ``[D, k, frame]`` group per call,
+    host staging in, gathered sinks out, exactly what
+    ``shard.data.ShardRunner`` dispatches — and the aggregate sample rate
+    is measured. Returns ``(best_D, {D: Msps})`` and records the winner
+    under the chain's streamed-pick signature. A width is only ever
+    PICKED over a smaller one when it measured strictly faster, so
+    degenerate hosts (a 2-core CI box timing an 8-way virtual mesh) keep
+    their honest small width."""
+    import jax
+
+    from ..shard.data import ShardedProgram
+    from ..shard.plan import plan_shard
+    inst = inst or instance()
+    pipe = stages if isinstance(stages, Pipeline) \
+        else Pipeline(list(stages), in_dtype)
+    m = pipe.frame_multiple
+    f = frame or inst.frame_size
+    f = max(m, (f // m) * m)
+    avail = len(jax.devices())
+    results: Dict[int, float] = {}
+    best, best_rate = 1, -1.0
+    for D in sorted({int(d) for d in devices if 0 < int(d) <= avail}):
+        try:
+            host = np.zeros((D, k, f), dtype=pipe.in_dtype)
+            if D == 1:
+                # the honest baseline: the REAL unsharded program at the
+                # SAME megabatch form (one dispatch per k-frame group —
+                # what a shard=off launch with frames_per_dispatch=k
+                # dispatches). A k-looped per-frame baseline would pay k
+                # dispatch round-trips per group and bias the pick wide.
+                import jax
+                if k == 1:
+                    fn1 = jax.jit(pipe.fn(), donate_argnums=())
+                else:
+                    _inner = pipe.fn()
+                    fn1 = jax.jit(
+                        lambda c, xs: jax.lax.scan(
+                            lambda cc, xk: _inner(cc, xk), c, xs),
+                        donate_argnums=())
+                carry = pipe.init_carry()
+
+                def group(c, _fn=fn1):
+                    x = xfer.to_device(host[0, 0] if k == 1 else host[0],
+                                       inst.device)
+                    c, y = _fn(c, x)
+                    return c, np.asarray(y)
+            else:
+                prog = ShardedProgram(pipe, plan_shard(pipe, mode="data",
+                                                       n_devices=D))
+                fnD, carry = prog.compile(f, k)
+
+                def group(c, _fn=fnD, _p=prog):
+                    c, y = _fn(c, _p.place(host[:, 0] if k == 1 else host))
+                    return c, np.asarray(y)
+            with _profile.compiling("autotune", "autotune",
+                                    f"shard_d={D},frame={f},k={k}"):
+                carry, _ = group(carry)
+            n = 0
+            t0 = time.perf_counter()
+            while True:
+                carry, _ = group(carry)
+                n += D * k
+                if time.perf_counter() - t0 > min_seconds or n > 10000:
+                    break
+            rate = n * f / (time.perf_counter() - t0) / 1e6
+        except Exception as e:                 # OOM, short mesh, …
+            log.warning("autotune_shard D=%d failed: %r", D, e)
+            continue
+        results[D] = round(rate, 1)
+        if rate > best_rate:
+            best_rate, best = rate, D
+    log.info("autotune_shard best: D=%d (%.1f Msps) over %s", best,
+             best_rate, results)
+    if record and results:
+        record_shard_devices(pipe.stages, pipe.in_dtype, inst.platform, best)
+    return best, results
 
 
 def autotune_serve(pipeline, frame_size: Optional[int] = None,

@@ -655,6 +655,86 @@ def scenario_serve_crash_restart():
     _assert_no_leaked_threads(before, "serve_crash_restart")
 
 
+_SHARD_REPLAY_WORKER = r"""
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from futuresdr_tpu.ops.stages import Pipeline, fir_stage, rotator_stage, \
+    mag2_stage
+from futuresdr_tpu.runtime import faults as _faults
+from futuresdr_tpu.shard import ShardRunner, ShardedProgram, plan_shard
+
+# a STATEFUL chain (FIR history + oscillator phase carries) so recovery has
+# real state to restore — the whole point of the whole-mesh snapshot
+pipe = Pipeline([fir_stage(np.hanning(33).astype(np.float32)),
+                 rotator_stage(0.07), mag2_stage()], np.complex64)
+D, K, F, GROUPS = 8, 2, 8192, 5
+rng = np.random.default_rng(11)
+groups = [(rng.standard_normal((D, K, F))
+           + 1j * rng.standard_normal((D, K, F))).astype(np.complex64)
+          for _ in range(GROUPS)]
+
+def sharded(name, faulted):
+    prog = ShardedProgram(pipe, plan_shard(pipe, mode="data", n_devices=D),
+                          name=name)
+    runner = ShardRunner(prog, F, k=K, checkpoint_every=2, name=name)
+    if faulted:
+        # seeded mid-stream dispatch fault (site dispatch:<runner name>)
+        _faults.arm(f"dispatch:{name}", rate=0.5, seed=5, max_faults=1)
+    out, recoveries = [], 0
+    try:
+        for g in groups:
+            for attempt in (0, 1):
+                try:
+                    out.append(runner.run_group(g))
+                    break
+                except _faults.InjectedFault:
+                    assert attempt == 0, "fault re-raised after recovery"
+                    runner.recover()
+                    recoveries += 1
+    finally:
+        _faults.disarm()
+    return out, recoveries
+
+ref, _ = sharded("shard_ref", faulted=False)
+got, recoveries = sharded("shard_hit", faulted=True)
+assert recoveries >= 1, "the injected fault never fired"
+for seq, (a, b) in enumerate(zip(ref, got)):
+    np.testing.assert_array_equal(a, b, err_msg=f"group {seq}")
+print(f"SHARD-REPLAY OK recoveries={recoveries}", flush=True)
+"""
+
+
+def scenario_shard_replay():
+    """Acceptance (ISSUE 15): an injected dispatch fault on a DATA-SHARDED
+    stateful chain (``futuresdr_tpu/shard``) recovers BIT-IDENTICALLY from
+    the whole-mesh carry snapshot + per-shard replay logs. Runs in a fresh
+    subprocess: the 8-device virtual mesh flag only acts before jax init,
+    and the chaos parent's backend is already live."""
+    import subprocess
+    import tempfile
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pypath = repo + os.pathsep + os.environ.get("PYTHONPATH", "")
+    env = os.environ.copy()
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               FUTURESDR_TPU_AUTOTUNE_CACHE_DIR="off",
+               PYTHONPATH=pypath.rstrip(os.pathsep))
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as wf:
+        wf.write(_SHARD_REPLAY_WORKER)
+        path = wf.name
+    try:
+        r = subprocess.run([sys.executable, path], env=env,
+                           capture_output=True, text=True, timeout=240)
+        assert r.returncode == 0, \
+            f"shard-replay child rc={r.returncode}\n{r.stdout[-1500:]}" \
+            f"\n{r.stderr[-1500:]}"
+        assert "SHARD-REPLAY OK" in r.stdout, r.stdout[-1500:]
+    finally:
+        os.unlink(path)
+
+
 def scenario_serve_overload_shed():
     """Acceptance (ISSUE 14): an admission storm at 2x capacity sheds ONLY
     via the documented ladder — newcomers refused (rung 1, billed on
@@ -983,6 +1063,7 @@ SCENARIOS = (
     ("tenant-isolation", scenario_tenant_isolation),
     ("serve-crash-restart", scenario_serve_crash_restart),
     ("serve-overload-shed", scenario_serve_overload_shed),
+    ("shard-replay", scenario_shard_replay),
     ("deadline_bounds_wedge", scenario_deadline_bounds_wedge),
 )
 

@@ -64,7 +64,12 @@ FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
                        # the auto-lowered resident rate and its pinned SNR
                        # floor — a rate win that costs SNR below reference
                        # flags here, not just in the smoke's absolute gate
-                       "resident_lowered_msps", "interior_snr_db_min")
+                       "resident_lowered_msps", "interior_snr_db_min",
+                       # mesh-sharded device plane (perf/multichip_ab.py):
+                       # the D=8 scaling fraction vs the independent-loop
+                       # linear reference, and the sharded streamed rate
+                       # there — a shard-plane overhead creep flags here
+                       "multichip_scaling_frac", "sharded_streamed_msps")
 # lower-is-better fields (fractions, not rates): regression = the value ROSE
 # past the reference by more than the absolute slack below — e.g. the
 # carry-checkpoint cost of the device-plane recovery contract creeping up

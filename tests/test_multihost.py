@@ -19,6 +19,32 @@ import pytest
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+#: error markers of a HOST ENVIRONMENT that cannot run the multihost story
+#: at all (vs a genuine regression in our code): a jaxlib built without the
+#: cross-process CPU collectives backend (no Gloo) fails every multiprocess
+#: computation with the first marker; a sandbox that cannot bind/reach the
+#: coordinator port fails distributed init with the others. Such runs SKIP
+#: instead of failing — tier-1 output stays clean where the env, not the
+#: repo, is missing the capability.
+_ENV_MARKERS = (
+    "Multiprocess computations aren't implemented on the CPU backend",
+    "Failed to connect to distributed service",
+    "DEADLINE_EXCEEDED: Barrier timed out",
+    "UNAVAILABLE: failed to connect",
+)
+
+#: one verdict per process: the first detected env limitation short-circuits
+#: later scenarios (each would spawn + time out on the same missing backend)
+_env_unsupported: list = []
+
+
+def _skip_if_env_unsupported(outs) -> None:
+    for out in outs:
+        for marker in _ENV_MARKERS:
+            if marker in out:
+                _env_unsupported.append(marker)
+                pytest.skip(f"multihost env unsupported: {marker}")
+
 
 def _run_two_workers(worker_src: str, tmp_path):
     """Spawn two worker processes on a fresh coordinator port, retry once on a
@@ -58,11 +84,17 @@ def _run_two_workers(worker_src: str, tmp_path):
                 p.kill()
         return procs, outs
 
+    if _env_unsupported:
+        pytest.skip(f"multihost env unsupported: {_env_unsupported[0]}")
     procs, outs = attempt()
     if any(p.returncode != 0 for p in procs):
+        # an env that fundamentally lacks the capability must not burn a
+        # retry (the second attempt fails identically, ~30 s later)
+        _skip_if_env_unsupported(outs)
         # bind-then-close port probing races other processes on busy hosts;
         # one retry with a fresh port removes the flake
         procs, outs = attempt()
+    _skip_if_env_unsupported(outs)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} rc={p.returncode}\n{out[-2000:]}"
         assert f"proc {i} OK" in out, out[-2000:]

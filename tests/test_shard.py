@@ -1,0 +1,444 @@
+"""Mesh-sharded device plane (futuresdr_tpu/shard) — docs/parallel.md.
+
+The heavy scenarios run in a FRESH subprocess pinned to the virtual
+8-device CPU mesh (the ``__graft_entry__.dryrun_multichip`` pattern: the
+``--xla_force_host_platform_device_count`` flag only acts BEFORE jax
+initializes, so a worker process guarantees the mesh regardless of how
+this test process was launched — an ``FSDR_TEST_TPU`` run keeps working).
+Each worker covers one acceptance area end to end:
+
+* data-shard bit-equality vs the D=1 program at matched K (+ the wired
+  form, + zero cross-shard collectives in the compiled HLO);
+* whole-mesh checkpoint + per-shard replay-log recovery (bit-identical
+  after an injected dispatch fault; corrupt newest candidate evicted in
+  favor of the previous one);
+* serve slot-axis sharding (sharded engine bit-equal to unsharded,
+  evict/readmit round trip, (device, lane) addressing, bucket growth
+  across the shard-divisibility boundary).
+
+Plan refusals, the mesh fixes, the autotune device axis and the
+doctor/profile surfaces are cheap and run in-process.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def shard_worker(tmp_path):
+    """Run a worker script in a fresh process on the 8-device virtual CPU
+    mesh; asserts it prints OK and returns its output."""
+
+    def run(src: str, timeout: float = 240.0) -> str:
+        wf = tmp_path / "worker.py"
+        wf.write_text(src)
+        pypath = _REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+        env = dict(os.environ,
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                   JAX_PLATFORMS="cpu",
+                   FUTURESDR_TPU_AUTOTUNE_CACHE_DIR="off",
+                   PYTHONPATH=pypath.rstrip(os.pathsep))
+        r = subprocess.run([sys.executable, str(wf)], env=env,
+                           capture_output=True, text=True, timeout=timeout)
+        assert r.returncode == 0, \
+            f"worker rc={r.returncode}\n{r.stdout[-3000:]}\n" \
+            f"{r.stderr[-3000:]}"
+        assert "WORKER OK" in r.stdout, r.stdout[-3000:]
+        return r.stdout
+
+    return run
+
+
+_PRELUDE = r"""
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from futuresdr_tpu.ops.stages import Pipeline, fir_stage, rotator_stage, \
+    mag2_stage
+from futuresdr_tpu.shard import (ShardRunner, ShardedProgram,
+                                 collective_ops, plan_shard, shard_pipeline)
+assert len(jax.devices()) == 8, jax.devices()
+PIPE = Pipeline([fir_stage(np.hanning(33).astype(np.float32)),
+                 rotator_stage(0.05), mag2_stage()], np.complex64)
+D, K, F = 8, 2, 8192
+RNG = np.random.default_rng(0)
+
+def cplx(shape):
+    return (RNG.standard_normal(shape)
+            + 1j * RNG.standard_normal(shape)).astype(np.complex64)
+"""
+
+
+def test_data_shard_bit_equality_and_zero_collectives(shard_worker):
+    """The tentpole pin: every shard's output (and carry) of the D=8
+    data-sharded program is bit-identical to the D=1 program fed that row
+    at MATCHED K (the repo's megabatch scan-rounding convention), at K=1
+    and K=2, raw and wired — and the compiled HLO carries zero cross-shard
+    collectives."""
+    shard_worker(_PRELUDE + r"""
+prog = shard_pipeline(PIPE, mode="data", n_devices=D, name="eq")
+assert isinstance(prog, ShardedProgram)
+
+# zero cross-shard collectives, raw + wired, K=1 + K=2
+for k in (1, 2):
+    assert collective_ops(prog.compiled_text(F, k)) == [], k
+assert collective_ops(prog.compiled_text(F, 2, wire="sc16")) == []
+
+# K=2: rows + carries bit-equal vs the D=1 scan program
+fn, carries = prog.compile(F, K)
+x = cplx((D, K, F))
+nc, y = fn(carries, prog.place(x))
+got = np.asarray(y)
+inner = PIPE.fn()
+scan1 = jax.jit(lambda c, xs: jax.lax.scan(
+    lambda cc, xk: inner(cc, xk), c, xs))
+nc_leaves = jax.tree_util.tree_flatten(nc)[0]
+for d in range(D):
+    c1, y1 = scan1(PIPE.init_carry(), jnp.asarray(x[d]))
+    assert np.array_equal(np.asarray(y1), got[d]), d
+    for got_leaf, ref_leaf in zip(nc_leaves,
+                                  jax.tree_util.tree_flatten(c1)[0]):
+        assert np.array_equal(np.asarray(got_leaf[d]),
+                              np.asarray(ref_leaf)), d
+
+# K=1: vs the plain jitted per-frame program
+fn1, car1 = prog.compile(F, 1)
+x1 = cplx((D, F))
+_, y1v = fn1(car1, prog.place(x1))
+jin = jax.jit(inner)
+for d in range(D):
+    _, yr = jin(PIPE.init_carry(), jnp.asarray(x1[d]))
+    assert np.array_equal(np.asarray(yr), np.asarray(y1v)[d]), d
+
+# the wired form round-trips through the codec with per-device stacks
+from futuresdr_tpu.ops.wire import get_wire
+w = get_wire("sc16")
+fnw, cw = prog.compile(F, K, wire="sc16")
+enc = [[w.encode_host(x[d, k]) for k in range(K)] for d in range(D)]
+parts = tuple(np.stack([np.stack([np.asarray(enc[d][k][j])
+                                  for k in range(K)]) for d in range(D)])
+              for j in range(len(enc[0][0])))
+ncw, yw = fnw(cw, *[prog.place(p) for p in parts])
+outs = yw if isinstance(yw, tuple) else (yw,)
+assert np.asarray(outs[0]).shape[:2] == (D, K)
+
+# shard=off / D=1 return the SAME program object (bit-identity by
+# construction)
+assert shard_pipeline(PIPE, mode="off") is PIPE
+assert shard_pipeline(PIPE, mode="data", n_devices=1) is PIPE
+print("WORKER OK")
+""")
+
+
+def test_shard_runner_checkpoint_replay_recovery(shard_worker):
+    """Whole-mesh snapshot + per-shard replay logs: an injected dispatch
+    fault mid-stream recovers bit-identically; a corrupted NEWEST snapshot
+    candidate is evicted in favor of the previous one; the per-shard
+    dispatch count never multiplies with D."""
+    shard_worker(_PRELUDE + r"""
+from futuresdr_tpu.runtime import faults as _faults
+
+def make_runner(name, checkpoint_every=1):
+    prog = ShardedProgram(PIPE, plan_shard(PIPE, mode="data", n_devices=D),
+                          name=name)
+    return ShardRunner(prog, F, k=K, checkpoint_every=checkpoint_every,
+                       name=name)
+
+groups = [cplx((D, K, F)) for _ in range(5)]
+ref_runner = make_runner("ref")
+ref = [ref_runner.run_group(g) for g in groups]
+# ONE dispatch per group, never x D (the multichip smoke's pin, unit here)
+assert ref_runner.dispatches == len(groups)
+
+# injected dispatch fault -> recover -> bit-identical
+hit = make_runner("hit", checkpoint_every=2)
+_faults.arm("dispatch:hit", rate=0.5, seed=5, max_faults=1)
+out, recoveries = [], 0
+try:
+    for g in groups:
+        try:
+            out.append(hit.run_group(g))
+        except _faults.InjectedFault:
+            hit.recover()
+            recoveries += 1
+            out.append(hit.run_group(g))
+finally:
+    _faults.disarm()
+assert recoveries == 1, recoveries
+for a, b in zip(ref, out):
+    np.testing.assert_array_equal(a, b)
+
+# corrupt the NEWEST checkpoint candidate: recover() evicts it, restores
+# the previous one, replays the per-shard window, and the next group is
+# still bit-identical
+c2 = make_runner("c2")
+for g in groups[:4]:
+    c2.run_group(g)
+seq, leaves, treedef = c2._ckpts[-1]
+bad = [np.asarray(l)[..., :1] if np.ndim(l) else l for l in leaves]
+c2._ckpts[-1] = (seq, bad, treedef)
+replayed = c2.recover()
+assert replayed >= 1, replayed
+np.testing.assert_array_equal(c2.run_group(groups[4]), ref[4])
+
+# the replay log prunes to the previous committed snapshot: depth bounded
+depth = max(len(q) for q in c2._rlog.values())
+assert depth <= 2 + c2.checkpoint_every, depth
+
+# degenerate: the SOLE committed snapshot is corrupt -> fresh-init + FULL
+# replay (the log must still hold the whole window) stays bit-identical
+c3 = make_runner("c3")
+c3.run_group(groups[0])
+seq, leaves, treedef = c3._ckpts[-1]
+assert len(c3._ckpts) == 1
+c3._ckpts[-1] = (seq, [np.asarray(l)[..., :1] if np.ndim(l) else l
+                       for l in leaves], treedef)
+assert c3.recover() == 1
+np.testing.assert_array_equal(c3.run_group(groups[1]), ref[1])
+print("WORKER OK")
+""")
+
+
+def test_serve_slot_axis_sharding(shard_worker):
+    """Slot-axis sharding (sessions x devices): the sharded engine's
+    per-session streams are bit-identical to the unsharded engine's,
+    evict/readmit round-trips on the sharded carries, sessions address a
+    (device, lane) pair, and bucket growth crosses the shard-divisibility
+    boundary cleanly."""
+    shard_worker(r"""
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from futuresdr_tpu.ops.stages import Pipeline, rotator_stage, mag2_stage
+from futuresdr_tpu.serve.engine import ServeEngine
+assert len(jax.devices()) == 8
+PIPE = Pipeline([rotator_stage(0.05), mag2_stage()], np.complex64)
+
+def run(shard):
+    eng = ServeEngine(PIPE, frame_size=1024, app=f"sh{shard}",
+                      buckets=(8, 16), shard_devices=shard)
+    sids = [eng.admit(tenant="t", sid=f"s{i}").sid for i in range(6)]
+    frames = {}
+    for s in sids:
+        r = np.random.default_rng(abs(hash(s)) % 2**31)
+        frames[s] = [(r.standard_normal(1024)
+                      + 1j * r.standard_normal(1024)).astype(np.complex64)
+                     for _ in range(4)]
+    outs = {s: [] for s in sids}
+    for step in range(4):
+        for s in sids:
+            eng.submit(s, frames[s][step])
+        eng.step()
+        for s in sids:
+            outs[s].extend(eng.results(s))
+    # evict -> readmit round trip (the checkpoint leaf contract) on the
+    # SHARDED stacked carries, then one more frame to prove the lane lives
+    eng.evict(sids[0])
+    eng.readmit(sids[0])
+    view = eng.session_view(sids[0])
+    eng.submit(sids[0], frames[sids[0]][0])
+    eng.step()
+    outs[sids[0]].extend(eng.results(sids[0]))
+    eng.shutdown()
+    return outs, view
+
+o8, v8 = run(8)
+o0, v0 = run(0)
+for s in o0:
+    assert len(o0[s]) == len(o8[s]), s
+    for a, b in zip(o0[s], o8[s]):
+        assert np.array_equal(a, b), s
+# (device, lane) addressing on the sharded engine; absent unsharded
+assert v8.get("device") is not None and v8.get("device_lane") is not None
+assert v0.get("device") is None
+
+# growth across the shard-divisibility boundary: bucket 6 (unsharded,
+# 6 % 8 != 0) grows into bucket 16 (sharded, 2 lanes/device)
+eng = ServeEngine(PIPE, frame_size=1024, app="grow", buckets=(6, 16),
+                  shard_devices=8)
+for i in range(7):
+    eng.admit(tenant="t", sid=f"g{i}")
+assert eng.table.capacity == 16
+assert eng._shard_ok(16) and not eng._shard_ok(6)
+for i in range(7):
+    eng.submit(f"g{i}", np.zeros(1024, np.complex64))
+assert eng.step() == 7
+d = eng.describe()["shard"]
+assert d == {"devices": 8, "sharded": True, "lanes_per_device": 2}, d
+eng.shutdown()
+
+# loud refusal: more shard devices than exist (the make_mesh contract)
+try:
+    ServeEngine(PIPE, frame_size=1024, app="over", shard_devices=16)
+    raise SystemExit("no refusal")
+except ValueError as e:
+    assert "refusing" in str(e) or "devices" in str(e)
+print("WORKER OK")
+""")
+
+
+# ---------------------------------------------------------------------------
+# in-process units: plan pass, mesh fixes, autotune axis, observability
+# ---------------------------------------------------------------------------
+
+def _pipe():
+    from futuresdr_tpu.ops.stages import (Pipeline, fir_stage, mag2_stage,
+                                          rotator_stage)
+    return Pipeline([fir_stage(np.hanning(33).astype(np.float32)),
+                     rotator_stage(0.05), mag2_stage()], np.complex64)
+
+
+def test_factor_devices_balanced_and_prime_counts():
+    from futuresdr_tpu.parallel.mesh import factor_devices
+    # prime counts on deep meshes: the whole prime on one axis, 1s elsewhere
+    assert factor_devices(7, 3) == (7, 1, 1)
+    assert factor_devices(13, 4) == (13, 1, 1, 1)
+    # the product ALWAYS equals n at every (n, n_axes)
+    for n in range(1, 65):
+        for n_axes in (1, 2, 3, 4):
+            t = factor_devices(n, n_axes)
+            assert len(t) == n_axes and int(np.prod(t)) == n, (n, n_axes, t)
+    assert factor_devices(8, 3) == (2, 2, 2)
+    assert factor_devices(12, 2) == (4, 3)
+    with pytest.raises(ValueError):
+        factor_devices(0, 2)
+    with pytest.raises(ValueError):
+        factor_devices(8, 0)
+
+
+def test_make_mesh_refuses_short_mesh():
+    import jax
+
+    from futuresdr_tpu.parallel.mesh import make_mesh
+    avail = len(jax.devices())
+    with pytest.raises(ValueError, match="refusing"):
+        make_mesh(("a", "b"), shape=(avail, 2))
+    with pytest.raises(ValueError, match="axis names"):
+        make_mesh(("a",), shape=(1, 1))
+    # an explicit SUB-mesh stays valid (the 1-device reference pattern)
+    m = make_mesh(("sp",), shape=(1,))
+    assert m.shape["sp"] == 1
+
+
+def test_plan_refusals_declines_and_off_identity():
+    import jax
+
+    from futuresdr_tpu.shard import plan_shard, shard_pipeline
+    pipe = _pipe()
+    with pytest.raises(ValueError, match="unknown shard mode"):
+        plan_shard(pipe, mode="banana")
+    with pytest.raises(ValueError, match="exist"):
+        plan_shard(pipe, mode="data", n_devices=len(jax.devices()) + 1)
+    with pytest.raises(ValueError, match=">= 1 device"):
+        plan_shard(pipe, mode="data", n_devices=0)
+    # off / D=1: inert plan, SAME program object
+    for kw in ({"mode": "off"}, {"mode": "data", "n_devices": 1}):
+        p = plan_shard(pipe, **kw)
+        assert p.applied == "off" and not p.active
+        assert shard_pipeline(pipe, **kw) is pipe
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices for active plans")
+    # model declines fall back to data, with the reason recorded
+    from futuresdr_tpu.ops.stages import Pipeline, rotator_stage
+    flat = Pipeline([rotator_stage(0.1)], np.complex64)
+    p = plan_shard(flat, mode="model", n_devices=4)
+    assert p.applied == "data" and any("no FFT/PFB" in r for r in p.declined)
+    p = plan_shard(pipe, mode="model", n_devices=4, frame_size=4098)
+    assert p.applied == "data" and any("divisible" in r for r in p.declined)
+    # an eligible model plan applies, with per-stage decisions
+    p = plan_shard(pipe, mode="model", n_devices=4)
+    assert p.applied == "model"
+    modes = {d.stage: d.mode for d in p.decisions}
+    assert modes["fir"] == "model" and modes["rotator"] == "replicate"
+    d = p.describe()
+    assert d["applied"] == "model" and len(d["stages"]) == len(pipe.stages)
+
+
+def test_autotune_shard_device_axis(tmp_path, monkeypatch):
+    from futuresdr_tpu.tpu.autotune import (_norm_entry, _streamed_cache,
+                                            cached_shard_devices,
+                                            record_shard_devices,
+                                            record_streamed_pick)
+    pipe = _pipe()
+    # guarded parse: a malformed width loses only its axis
+    assert _norm_entry({"k": 2, "inflight": None,
+                        "n_devices": "8"})["n_devices"] == 8
+    assert "n_devices" not in _norm_entry({"k": 2, "inflight": None,
+                                           "n_devices": "x"})
+    assert _norm_entry({"k": 2, "inflight": None,
+                        "n_devices": -4}) is not None
+    assert "n_devices" not in _norm_entry({"k": 2, "inflight": None,
+                                           "n_devices": -4})
+    record_shard_devices(pipe.stages, pipe.in_dtype, "cpu", 4)
+    assert cached_shard_devices(pipe.stages, pipe.in_dtype, "cpu") == 4
+    # a streamed re-record PRESERVES the device axis (the orthogonal-axes
+    # contract of the streamed-pick cache)
+    record_streamed_pick(pipe.stages, pipe.in_dtype, "cpu", 2, inflight=4)
+    assert cached_shard_devices(pipe.stages, pipe.in_dtype, "cpu") == 4
+    # dropped, not stored: junk widths never enter the cache
+    record_shard_devices(pipe.stages, pipe.in_dtype, "cpu", "junk")
+    assert cached_shard_devices(pipe.stages, pipe.in_dtype, "cpu") == 4
+
+
+def test_doctor_shard_section_and_per_device_gauges(monkeypatch):
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    # pinned peaks: the CPU backend has no chip peak, and the per-device
+    # gauges only publish against a known denominator
+    from futuresdr_tpu.config import config
+    monkeypatch.setattr(config(), "peak_flops", 1e12)
+    monkeypatch.setattr(config(), "peak_hbm_gbps", 100.0)
+    from futuresdr_tpu.shard import (ShardRunner, ShardedProgram,
+                                     clear_plans, plan_shard)
+    from futuresdr_tpu.telemetry import doctor as _doc
+    from futuresdr_tpu.telemetry import profile as _profile
+    from futuresdr_tpu.telemetry import prom
+    from futuresdr_tpu.telemetry.spans import SpanEvent
+    clear_plans()
+    pipe = _pipe()
+    D = min(4, len(jax.devices()))
+    prog = ShardedProgram(pipe, plan_shard(pipe, mode="data", n_devices=D),
+                          name="doc_shard")
+    runner = ShardRunner(prog, 8192, k=1, name="doc_shard")
+    rng = np.random.default_rng(0)
+    rows = (rng.standard_normal((D, 8192))
+            + 1j * rng.standard_normal((D, 8192))).astype(np.complex64)
+    runner.run_group(rows)
+    # plans + live runner stats under doctor.report()["shard"]; per-shard
+    # lanes from cat="shard" spans (synthetic here — the runner only emits
+    # when the recorder is armed)
+    evs = [SpanEvent(1, "t", int(i * 1e6), int(5e5), "shard",
+                     f"shard:d{i}", {"runner": "doc_shard"})
+           for i in range(D)]
+    rep = _doc.doctor().report(events=evs)
+    plans = rep["shard"]["plans"]
+    assert plans["doc_shard"]["applied"] == "data"
+    assert plans["doc_shard"]["n_devices"] == D
+    assert plans["doc_shard"]["dispatches"] == 1
+    lanes = rep["shard"]["lanes"]
+    assert set(lanes) == {f"shard:d{i}" for i in range(D)}
+    assert all(v["spans"] == 1 for v in lanes.values())
+    # per-device roofline entries + the fsdr_mfu_device gauge family
+    pl = _profile.plane()
+    pl.ensure_costs()
+    pl.update_live_gauges(min_interval=0.0)   # seeds the gauge window
+    runner.run_group(rows)                    # units inside the window
+    pl.update_live_gauges(min_interval=0.0)
+    progs = pl.roofline_report()["programs"]
+    dev_entries = {k: v for k, v in progs.items()
+                   if k.startswith("doc_shard@dev")}
+    assert len(dev_entries) == D, sorted(progs)
+    assert all(v["units"] >= 1 for v in dev_entries.values())
+    assert {v["device"] for v in dev_entries.values()} \
+        == {str(i) for i in range(D)}
+    text = prom.registry().render()
+    assert "fsdr_mfu_device" in text
+    assert 'program="doc_shard"' in text
