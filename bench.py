@@ -148,6 +148,39 @@ def _stages():
     return [fir_stage(taps), fft_stage(FFT_SIZE), mag2_stage()]
 
 
+def _measure_host_peaks(n=1536, reps=3):
+    """Measured host peaks for the CPU-replay ``live_mfu`` denominator:
+    the FLOP/s XLA:CPU actually achieves on an f32 GEMM (the ceiling any
+    chain on this backend could reach) and a large-copy memory bandwidth.
+    Returns ``(gemm_flops_per_s, mem_gbps)``. Both numerator and
+    denominator of the resulting MFU depress together under shared-host
+    load, so the fraction is steadier than either rate alone."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
+    mm = jax.jit(lambda x, y: x @ y)
+    mm(a, b).block_until_ready()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        mm(a, b).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    gemm = 2.0 * n ** 3 / best
+    v = jnp.asarray(np.zeros(16 << 20, np.float32))       # 64 MB
+    inc = jax.jit(lambda x: x + 1.0)
+    inc(v).block_until_ready()
+    best_m = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        inc(v).block_until_ready()
+        best_m = min(best_m, time.perf_counter() - t0)
+    mem_gbps = 2.0 * v.nbytes / best_m / 1e9              # read + write
+    return gemm, mem_gbps
+
+
 def run_cpu(n_samples: int) -> float:
     """CPU path: NullSource → 64-tap FIR → FFT(2048) → mag² → NullSink."""
     taps = firdes.lowpass(0.2, N_TAPS).astype(np.float32)
@@ -1069,7 +1102,12 @@ def main():
                   f"{precision_extra.get('resident_lowered_msps')} vs f32 "
                   f"{precision_extra.get('resident_f32_msps')} Msps "
                   f"({precision_extra.get('resident_lowered_speedup')}x), "
+                  f"int8 {precision_extra.get('resident_int8_msps')} Msps "
+                  f"(ladder min SNR "
+                  f"{precision_extra.get('interior_int8_snr_db_min')} dB), "
                   f"min SNR {precision_extra.get('interior_snr_db_min')} dB, "
+                  f"fused FIR→FFT "
+                  f"{precision_extra.get('fir_fft_fused_msps')} Msps, "
                   f"{precision_extra.get('pallas_kernels_active')} pallas "
                   f"stage(s)", file=sys.stderr)
         except Exception as e:                          # noqa: BLE001
@@ -1089,19 +1127,107 @@ def main():
     profile_extra = {}
     try:
         from futuresdr_tpu.telemetry import profile as _profile_mod
+
+        # CPU replay has no tabled chip peak (utils/roofline.detect_peaks →
+        # None), which would leave live_mfu unstamped and the trajectory
+        # blind between TPU rounds: pin MEASURED host peaks through the
+        # config override so mfu_avg stamps against a real denominator —
+        # the f32 GEMM rate XLA:CPU itself achieves here (doubled into the
+        # table's bf16-unit convention, so f32 programs grade against the
+        # measured figure exactly) and a measured large-copy bandwidth.
+        # The stamp below carries the measured figures so no reader
+        # mistakes a replay number for chip MFU; existing overrides win.
+        pinned_peaks = None
+        from futuresdr_tpu.config import config as _bench_config
+        from futuresdr_tpu.utils.roofline import detect_peaks as _detect
+        if _detect(inst_.platform) is None:
+            _bc = _bench_config()
+            if not (float(getattr(_bc, "peak_flops", 0) or 0) > 0
+                    and float(getattr(_bc, "peak_hbm_gbps", 0) or 0) > 0):
+                gemm_fps, mem_gbps = _measure_host_peaks()
+                _bc.peak_flops = 2.0 * gemm_fps
+                _bc.peak_hbm_gbps = mem_gbps
+                pinned_peaks = (f"pinned-host-measured("
+                                f"{gemm_fps / 1e9:.0f} GFLOP/s f32 GEMM, "
+                                f"{mem_gbps:.1f} GB/s copy)")
+            else:
+                pinned_peaks = "config-override"
+
+        # the RESIDENT chain's live entry: the headline dev rate comes from
+        # a raw Pipeline.fn() marginal (never a TpuKernel), so nothing
+        # registered it on the plane. Register the offline roofline's
+        # per-frame cost and bill short scanned runs at the headline frame
+        # — the SAME in-program frame loop the headline methodology uses
+        # (docs/tpu_notes.md "Measuring through the tunnel": carry chained
+        # inside the scan, checksum feedback so XLA can't hoist the body),
+        # billed K units per dispatch. live_mfu below then reads the
+        # resident chain's achieved-FLOP fraction of the (measured-host or
+        # chip) peak, which is the figure the precision ladder and Pallas
+        # rounds are graded on.
+        if roof.get("ops_per_sample") and dev_rate:
+            try:
+                import jax
+                import jax.numpy as jnp
+
+                from futuresdr_tpu.ops.stages import Pipeline as _Pipe
+                from futuresdr_tpu.ops.xfer import to_device as _to_dev
+                _pipe = _Pipe(_stages(), np.complex64)
+                _carry = jax.device_put(_pipe.init_carry(), inst_.device)
+                _rng = np.random.default_rng(11)
+                _host = (_rng.standard_normal(best_frame)
+                         + 1j * _rng.standard_normal(best_frame)
+                         ).astype(np.complex64)
+                _x = _to_dev(_host, inst_.device)
+                _run, _K = _pipe.fn(), 8
+
+                @jax.jit
+                def _scan_k(carry, xin):
+                    def _body(c, _):
+                        sc, acc = c
+                        xi = xin * (1 + 1e-20 * acc.astype(xin.dtype))
+                        sc, y = _run(sc, xi)
+                        return (sc, acc
+                                + jnp.sum(y).real.astype(jnp.float32)), None
+                    (carry, acc), _ = jax.lax.scan(
+                        _body, (carry, jnp.float32(0)), None, length=_K)
+                    return carry, acc
+
+                _prog = _profile_mod.plane().register(
+                    "resident",
+                    cost={"flops": roof["ops_per_sample"] * best_frame,
+                          "bytes": roof["bytes_per_sample"] * best_frame},
+                    dtype="f32")
+                _carry, _acc = _scan_k(_carry, _x)    # compile, unbilled
+                jax.block_until_ready(_acc)
+                import time as _time
+                for _ in range(6):
+                    _carry, _acc = _scan_k(_carry, _x)
+                    jax.block_until_ready(_acc)
+                    _prog.dispatch(_K, _time.monotonic())
+            except Exception as e:                      # noqa: BLE001
+                print(f"# resident live-mfu probe failed: {e!r}",
+                      file=sys.stderr)
+
         psnap = _profile_mod.plane().snapshot(ensure_costs=True)
         profile_extra = {
             "compiles_total": psnap["compiles_total"],
             "compile_seconds_total": round(psnap["compile_seconds_total"], 3),
         }
-        # the streamed kernel's run-average utilization: the registered
-        # STREAMED program with the most dispatched units that carries an
-        # average (serve:* entries bill per session-frame, so their unit
-        # counts would otherwise hijack the pick from the streamed kernel)
+        if pinned_peaks:
+            profile_extra["live_mfu_peaks"] = pinned_peaks
+        # the RESIDENT chain's run-average utilization when its probe above
+        # billed (the headline live_mfu target rides the resident chain);
+        # otherwise the registered STREAMED program with the most dispatched
+        # units that carries an average (serve:* entries bill per
+        # session-frame, so their unit counts would otherwise hijack the
+        # pick from the streamed kernel)
         live = [(v.get("units", 0), v)
                 for name, v in psnap["roofline"]["programs"].items()
                 if v.get("mfu_avg") is not None
                 and not name.startswith("serve:")]
+        resident = psnap["roofline"]["programs"].get("resident")
+        if resident is not None and resident.get("mfu_avg") is not None:
+            live = [(float("inf"), resident)]
         if live:
             # key= keeps ties from falling through to dict comparison
             best_prog = max(live, key=lambda t: t[0])[1]

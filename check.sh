@@ -50,6 +50,73 @@ echo "== interior precision gate (docs/tpu_notes.md 'Interior precision') =="
 # fused Pallas PFB / FIR→decimate kernels match the matmul paths they replace
 JAX_PLATFORMS=cpu python perf/precision_ab.py --smoke
 
+echo "== pallas autotune cache gate (docs/tpu_notes.md 'Pallas autotune plane') =="
+# streamed-pick cache round-trip for the pallas_blocks axis: recorded block
+# winners survive a streamed k/inflight re-record, a malformed axis on disk
+# loses ONLY itself (per-axis guarded parse — the k pick survives), and a
+# second autotune_pallas_blocks call is a cache hit that skips the sweep
+JAX_PLATFORMS=cpu python - <<'EOF'
+import importlib, json, os, tempfile
+td = tempfile.mkdtemp()
+os.environ["FUTURESDR_TPU_AUTOTUNE_CACHE_DIR"] = td
+import numpy as np
+from futuresdr_tpu.ops.stages import fir_stage, mag2_stage, Pipeline
+from futuresdr_tpu.ops import pallas_kernels as pk
+at = importlib.import_module("futuresdr_tpu.tpu.autotune")
+pallas_tune = importlib.import_module("futuresdr_tpu.tpu.pallas_tune")
+
+taps = np.random.default_rng(0).standard_normal(33).astype(np.float32)
+P = Pipeline([fir_stage(taps), mag2_stage()], np.complex64)
+
+# record (junk keys dropped at the gate) + read back, per-device-kind keyed
+at.record_pallas_blocks(P.stages, P.in_dtype, "cpu", "v5e",
+                        {"fir": 2048, "bogus": 7, "pfb": -1})
+assert at.cached_pallas_blocks(P.stages, P.in_dtype, "cpu", "v5e") == \
+    {"fir": 2048}
+assert at.cached_pallas_blocks(P.stages, P.in_dtype, "cpu", "v5p") is None
+
+# axis survives a streamed k/inflight re-record on the same signature
+at.record_streamed_pick(P.stages, P.in_dtype, "cpu", 4, inflight=2)
+assert at.cached_pallas_blocks(P.stages, P.in_dtype, "cpu", "v5e") == \
+    {"fir": 2048}
+e = at.cached_streamed_pick(P.stages, P.in_dtype, "cpu")
+assert e["k"] == 4 and e["inflight"] == 2, e
+
+# disk round-trip through a cleared memo (a fresh process would see this)
+at._disk_memo.clear(); at._streamed_cache.clear()
+assert at.cached_pallas_blocks(P.stages, P.in_dtype, "cpu", "v5e") == \
+    {"fir": 2048}
+
+# a malformed axis on disk loses only itself — the entry (k pick) survives
+path = os.path.join(td, "streamed_picks.json")
+with open(path) as f:
+    d = json.load(f)
+d[next(iter(d))]["pallas_blocks"] = "garbage"
+with open(path, "w") as f:
+    json.dump(d, f)
+at._disk_memo.clear(); at._streamed_cache.clear()
+e = at.cached_streamed_pick(P.stages, P.in_dtype, "cpu")
+assert e is not None and e["k"] == 4 and "pallas_blocks" not in e, e
+
+# driver: first call sweeps + records, second is a cache hit (no sweep)
+at._disk_memo.clear(); at._streamed_cache.clear()
+calls = {"n": 0}
+orig = pallas_tune.sweep_blocks
+def counting(*a, **k):
+    calls["n"] += 1
+    return orig(*a, **k)
+pallas_tune.sweep_blocks = counting
+w1 = at.autotune_pallas_blocks(P.stages, P.in_dtype, kernels=("rotator",),
+                               frame=1 << 14, reps=1)
+assert calls["n"] == 1 and "rotator" in w1, (calls, w1)
+w2 = at.autotune_pallas_blocks(P.stages, P.in_dtype, kernels=("rotator",),
+                               frame=1 << 14, reps=1)
+assert calls["n"] == 1, "cache hit must skip the sweep"
+assert w2 == w1 and pk.tuned_blocks()["rotator"] == w1["rotator"]
+pk.set_tuned_blocks(None)
+print("pallas autotune cache round-trip: OK")
+EOF
+
 echo "== multi-tenant serving gate (docs/serving.md) =="
 # N sessions of one receiver chain through a single vmapped dispatch per
 # frame: dispatches/frame == 1 regardless of the active session count,

@@ -160,8 +160,13 @@ class Config:
     #   under sustained overload: "off" (default — rungs 1-2 only, both
     #   bit-exact for residents) | "k" (drop megabatch K to 1 on resident
     #   buckets — latency over throughput; K>1 vs K=1 round differently by
-    #   repo contract) | "precision" (retune interior precision to bf16 via
+    #   repo contract) | "precision" (retune interior precision via
     #   ops/precision.py — SNR-bounded quality loss for the duration)
+    serve_brownout_precision: str = "bf16"  # the mode the "precision"
+    #   brownout rung lowers to: "bf16" (default) or "int8" (the deeper
+    #   ladder rung — FIR-family stages drop to quantized int8 MXU matmuls,
+    #   ~36 dB SNR; int8 stages carry float weights and quantize in-trace,
+    #   so engage/release stays a leafwise dtype conversion)
     serve_drain_on_sigterm: bool = False   # register_app installs a SIGTERM
     #   hook that drains every registered serving app (refuse admissions,
     #   finish in-flight, persist all lanes) — the rolling-restart contract

@@ -14,12 +14,27 @@ kernel argument of Flex-TPU, arXiv:2407.08700):
   matmul path;
 * the fused FIR→decimate kernel (:func:`pallas_poly_fir`): the shifted-row polyphase
   factorization of ``ops/stages._poly_decim_fir_stage`` computed at the DECIMATED rate
-  inside one kernel (ntaps/D MACs per input sample, no full-rate intermediate).
+  inside one kernel (ntaps/D MACs per input sample, no full-rate intermediate) — a 3-D
+  weight tensor runs the same kernel per interpolation phase, which is the resampler's
+  polyphase inner loop;
+* the fused FIR→FFT kernel (:func:`pallas_fir_fft`): filter + windowed DFT in one
+  kernel — the filtered frame never round-trips HBM between the FIR and the transform,
+  which is the resident fir64+fft2048 chain's whole interior edge;
+* the rotator / quadrature-demod inner loops (:func:`pallas_rotator`,
+  :func:`pallas_quad_demod`): phase-ramp multiply and ``angle(x·conj(x₋₁))`` over 2-D
+  lane tiles, the remaining elementwise hot loops of the FM chain.
 
 Every kernel takes ``precision="bf16"`` for the interior-precision policy
 (``ops/precision.py``): operands are cast to bfloat16 and accumulated in float32 —
 on the MXU this is the native-speed pass; on CPU/interpret it applies exactly the same
-quantization, so SNR calibration measures the real thing.
+quantization, so SNR calibration measures the real thing. (The int8 rung does NOT run
+through these kernels — it lowers to quantized XLA matmuls in ``ops/stages``.)
+
+Block shapes: every kernel's ``block`` parameter defaults to ``None`` = "resolve
+through the autotuned table" (:func:`set_tuned_blocks`, installed at kernel init from
+the ``pallas_blocks`` autotune-cache axis swept by ``tpu/pallas_tune.py``), falling
+back to the hand-picked :data:`DEFAULT_BLOCKS`. Stage-level callers pass no block, so
+a measured sweep reaches every ``impl="pallas"`` stage without re-plumbing.
 
 Falls back to interpret mode off-TPU — numerics are identical, so CI validates the kernel
 on CPU and the same code runs compiled on the chip.
@@ -27,8 +42,9 @@ on CPU and the same code runs compiled on the chip.
 
 from __future__ import annotations
 
+import threading
 from functools import partial
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +52,57 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 __all__ = ["pallas_fir", "pallas_fir_continue", "pallas_fir_stage",
-           "pallas_pfb", "pallas_poly_fir"]
+           "pallas_pfb", "pallas_poly_fir", "pallas_fir_fft",
+           "pallas_rotator", "pallas_quad_demod",
+           "DEFAULT_BLOCKS", "set_tuned_blocks", "tuned_blocks"]
+
+# ---------------------------------------------------------------------------
+# tuned block shapes (the Pallas autotune plane, tpu/pallas_tune.py)
+# ---------------------------------------------------------------------------
+
+#: hand-picked fallback block shapes per kernel — the pre-autotune defaults
+#: (``fir``/``poly_fir`` in samples / decimated rows, ``pfb`` in commutated
+#: time rows, ``fir_fft`` in transform rows, ``rotator``/``quad_demod`` in
+#: 128-lane rows). Always part of the sweep's candidate set, so a recorded
+#: winner is never a regression against them.
+DEFAULT_BLOCKS: Dict[str, int] = {
+    "fir": 4096, "pfb": 256, "poly_fir": 1024, "fir_fft": 8,
+    "rotator": 256, "quad_demod": 256,
+}
+
+_tuned_lock = threading.Lock()
+_tuned: Dict[str, int] = {}
+
+
+def set_tuned_blocks(blocks: Optional[Dict[str, int]]) -> None:
+    """Install measured block shapes process-wide (``None``/``{}`` clears).
+    Unknown kernel keys and non-positive values are IGNORED, not raised —
+    a stale cache entry from an older repo revision must never wedge kernel
+    init (mirrors the autotune cache's per-axis guarded-parse contract)."""
+    with _tuned_lock:
+        _tuned.clear()
+        for k, v in (blocks or {}).items():
+            try:
+                v = int(v)
+            except (TypeError, ValueError):
+                continue
+            if k in DEFAULT_BLOCKS and v > 0:
+                _tuned[k] = v
+
+
+def tuned_blocks() -> Dict[str, int]:
+    """The active block table: measured winners over the defaults."""
+    with _tuned_lock:
+        return {**DEFAULT_BLOCKS, **_tuned}
+
+
+def _resolve_block(kernel: str, block: Optional[int]) -> int:
+    """``block=None`` (the stage-level calling convention) → the tuned table;
+    an explicit block always wins (tests pin odd shapes through it)."""
+    if block is not None:
+        return int(block)
+    with _tuned_lock:
+        return int(_tuned.get(kernel, DEFAULT_BLOCKS[kernel]))
 
 
 def _maybe_bf16(*arrays, bf16: bool):
@@ -63,15 +129,17 @@ def _fir_kernel(prev_ref, cur_ref, taps_ref, o_ref, *, n_taps: int, block: int,
     o_ref[...] = acc
 
 
-def pallas_fir(x: jnp.ndarray, taps, block: int = 4096,
+def pallas_fir(x: jnp.ndarray, taps, block: Optional[int] = None,
                interpret: Optional[bool] = None,
                precision: Optional[str] = None) -> jnp.ndarray:
-    """Causal FIR of a float32 frame (zero initial state): len(x) must divide ``block``.
+    """Causal FIR of a float32 frame (zero initial state): len(x) must divide ``block``
+    (default: the tuned table's ``"fir"`` shape).
 
     Complex frames are filtered as two real passes at the wrapper level
     (:func:`pallas_fir_stage`). ``precision="bf16"`` runs the MAC with bfloat16
     operands and float32 accumulation (module docstring).
     """
+    block = _resolve_block("fir", block)
     taps = jnp.asarray(taps)
     if not jnp.issubdtype(taps.dtype, jnp.bfloat16):
         taps = taps.astype(jnp.float32)
@@ -103,7 +171,7 @@ def pallas_fir(x: jnp.ndarray, taps, block: int = 4096,
 
 
 def pallas_fir_continue(hist: jnp.ndarray, x: jnp.ndarray, taps: np.ndarray,
-                        block: int = 4096,
+                        block: Optional[int] = None,
                         precision: Optional[str] = None) -> jnp.ndarray:
     """Streaming continuation: filter frame ``x`` given the previous ``n_taps-1``
     input samples in ``hist``. Pads to the kernel's block granularity, runs complex
@@ -111,6 +179,7 @@ def pallas_fir_continue(hist: jnp.ndarray, x: jnp.ndarray, taps: np.ndarray,
     Shared by :func:`pallas_fir_stage` and ``stages.fir_stage(impl="pallas")``.
     ``taps`` may be a traced device array (carry-resident, for runtime tap swap) —
     only its static shape is read here."""
+    block = _resolve_block("fir", block)
     taps = jnp.asarray(taps)
     if not jnp.issubdtype(taps.dtype, jnp.bfloat16):
         taps = taps.astype(jnp.float32)
@@ -128,7 +197,7 @@ def pallas_fir_continue(hist: jnp.ndarray, x: jnp.ndarray, taps: np.ndarray,
     return y[nt - 1:nt - 1 + x.shape[0]]
 
 
-def pallas_fir_stage(taps, block: int = 4096):
+def pallas_fir_stage(taps, block: Optional[int] = None):
     """Streaming Stage (carry = tail samples) running the pallas kernel per frame; the
     drop-in alternative to :func:`futuresdr_tpu.ops.stages.fir_stage` for short taps."""
     from fractions import Fraction
@@ -182,7 +251,7 @@ def _pfb_kernel(prev_r, prev_i, cur_r, cur_i, taps_ref, er_ref, ei_ref,
     out_i[...] = dot(acc_r, ei) + dot(acc_i, er)
 
 
-def pallas_pfb(rows: jnp.ndarray, taps_kn, block: int = 256,
+def pallas_pfb(rows: jnp.ndarray, taps_kn, block: Optional[int] = None,
                interpret: Optional[bool] = None,
                precision: Optional[str] = None) -> jnp.ndarray:
     """Fused critically-sampled PFB analysis bank over commutated rows.
@@ -196,6 +265,7 @@ def pallas_pfb(rows: jnp.ndarray, taps_kn, block: int = 256,
     tests/test_pallas.py). ``precision="bf16"`` casts MAC/matmul operands to
     bfloat16 with float32 accumulation.
     """
+    block = _resolve_block("pfb", block)
     K, N = taps_kn.shape
     R = rows.shape[0]
     t = R - (K - 1)
@@ -257,7 +327,9 @@ def _poly_fir_kernel(prev, cur, w_ref, o_ref, *, m: int, block: int,
                      bf16: bool):
     """One grid step of ``block`` decimated outputs: ``y[q] = Σ_a
     rows[q + m − a] · W[a]`` over the stride-D row matrix — m+1 [block, D]·[D]
-    matvecs, the in-kernel form of ``ops/stages._shifted_matvec``."""
+    matvecs, the in-kernel form of ``ops/stages._shifted_matvec``. A 3-D
+    weight tensor (``W[a]``: [D, I] — the resampler's phase-tap matrix) runs
+    the same accumulation as m+1 [block, D]·[D, I] matmuls."""
     full = jnp.concatenate([prev[...], cur[...]])            # [2·block, D]
     W = w_ref[...]                                           # [m+1, D]
     full, W = _maybe_bf16(full, W, bf16=bf16)
@@ -271,7 +343,7 @@ def _poly_fir_kernel(prev, cur, w_ref, o_ref, *, m: int, block: int,
     o_ref[...] = acc
 
 
-def pallas_poly_fir(rows: jnp.ndarray, W, block: int = 1024,
+def pallas_poly_fir(rows: jnp.ndarray, W, block: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     precision: Optional[str] = None) -> jnp.ndarray:
     """Fused decimating FIR over the stride-D row matrix.
@@ -281,10 +353,14 @@ def pallas_poly_fir(rows: jnp.ndarray, W, block: int = 1024,
     weight matrix (``ops/stages._poly_decim_weights`` — may be carry-resident,
     f32 or bf16, REAL taps only). Returns ``[nq]`` float32 decimated outputs —
     ntaps/D MACs per input sample with no full-rate intermediate (the fused
-    FIR→decimate kernel). Complex frames run as two real passes at the stage
-    level. ``precision="bf16"`` casts operands to bfloat16, accumulates f32.
+    FIR→decimate kernel). A 3-D ``W`` (``[m+1, D, I]`` — the resampler's
+    phase-tap tensor, :func:`ops.stages.resample_stage`) returns ``[nq, I]``
+    interpolated rows instead, same kernel. Complex frames run as two real
+    passes at the stage level. ``precision="bf16"`` casts operands to
+    bfloat16, accumulates f32.
     """
-    m1, D = W.shape
+    block = _resolve_block("poly_fir", block)
+    m1, D = W.shape[0], W.shape[1]
     m = m1 - 1
     nq = rows.shape[0] - m
     assert nq >= 1, "need at least one output row"
@@ -301,16 +377,281 @@ def pallas_poly_fir(rows: jnp.ndarray, W, block: int = 1024,
     grid = nq_pad // bq
     kern = partial(_poly_fir_kernel, m=m, block=bq,
                    bf16=(precision == "bf16"))
+    if W.ndim == 3:
+        I = W.shape[2]
+        w_spec = pl.BlockSpec((m + 1, D, I), lambda i: (0, 0, 0))
+        out_specs = pl.BlockSpec((bq, I), lambda i: (i, 0))
+        out_shape = jax.ShapeDtypeStruct((nq_pad, I), jnp.float32)
+    else:
+        w_spec = pl.BlockSpec((m + 1, D), lambda i: (0, 0))
+        out_specs = pl.BlockSpec((bq,), lambda i: (i,))
+        out_shape = jax.ShapeDtypeStruct((nq_pad,), jnp.float32)
     y = pl.pallas_call(
         kern,
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((bq, D), lambda i: (i, 0)),       # prev rows
             pl.BlockSpec((bq, D), lambda i: (i + 1, 0)),   # cur rows
-            pl.BlockSpec((m + 1, D), lambda i: (0, 0)),
+            w_spec,
         ],
-        out_specs=pl.BlockSpec((bq,), lambda i: (i,)),
-        out_shape=jax.ShapeDtypeStruct((nq_pad,), jnp.float32),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(xp, xp, W)
     return y[:nq]
+
+
+# ---------------------------------------------------------------------------
+# fused FIR→FFT: filter + windowed DFT with no HBM round-trip between them
+# ---------------------------------------------------------------------------
+
+def _fir_fft_kernel(prev_r, prev_i, cur_r, cur_i, taps_ref, er_ref, ei_ref,
+                    out_r, out_i, *, n_taps: int, block: int, n_fft: int,
+                    bf16: bool):
+    """One grid step over ``block`` transform rows of ``n_fft`` samples: the
+    FIR MAC over the row-major stream (sample shifts that cross a row
+    boundary read the tail of the row above — the 1-D neighbour trick lifted
+    to 2-D row tiles), then the forward DFT along rows as four real matmuls.
+    The filtered rows live only in VMEM between the two halves — that
+    intermediate is exactly the resident chain's fir→fft HBM edge."""
+    ar = jnp.concatenate([prev_r[...], cur_r[...]])          # [2·block, n_fft]
+    ai = jnp.concatenate([prev_i[...], cur_i[...]])
+    taps = taps_ref[...]
+    ar, ai, taps = _maybe_bf16(ar, ai, taps, bf16=bf16)
+
+    def _shift(a, k):
+        # S_k[r, c] = stream[r·n_fft + c − k] for the rows of the CUR tile:
+        # the first k columns come from the row above (static slices only)
+        if k == 0:
+            return a[block:2 * block]
+        left = a[block - 1:2 * block - 1, n_fft - k:]
+        right = a[block:2 * block, :n_fft - k]
+        return jnp.concatenate([left, right], axis=1)
+
+    acc_r = jnp.zeros(cur_r.shape, jnp.float32)
+    acc_i = jnp.zeros(cur_i.shape, jnp.float32)
+    for k in range(n_taps):                                  # static unroll
+        t = taps[k]
+        acc_r = acc_r + (t * _shift(ar, k)).astype(jnp.float32)
+        acc_i = acc_i + (t * _shift(ai, k)).astype(jnp.float32)
+    er, ei = er_ref[...], ei_ref[...]
+    prec = (jax.lax.Precision.DEFAULT if bf16
+            else jax.lax.Precision.HIGHEST)
+    if bf16:
+        acc_r, acc_i, er, ei = _maybe_bf16(acc_r, acc_i, er, ei, bf16=True)
+    dot = partial(jnp.dot, preferred_element_type=jnp.float32,
+                  precision=prec)
+    # Y = v @ E with E = exp(−2πi·cj/N) = er − i·ei (forward DFT sign)
+    out_r[...] = dot(acc_r, er) + dot(acc_i, ei)
+    out_i[...] = dot(acc_i, er) - dot(acc_r, ei)
+
+
+def pallas_fir_fft(hist: jnp.ndarray, x: jnp.ndarray, taps, n_fft: int,
+                   block: Optional[int] = None,
+                   interpret: Optional[bool] = None,
+                   precision: Optional[str] = None) -> jnp.ndarray:
+    """Fused FIR → windowed forward FFT: ``fft(filtered.reshape(-1, n_fft))``
+    flattened, without materializing the filtered stream in HBM.
+
+    ``hist``: the previous ``n_taps−1`` input samples (carry-resident);
+    ``x``: the frame, ``len(x) % n_fft == 0``; ``taps``: REAL taps (may be a
+    traced carry array), ``n_taps ≤ n_fft`` (a shift never reaches past the
+    row directly above). ``block`` counts transform ROWS per grid step
+    (default: the tuned table's ``"fir_fft"`` shape — ragged row counts are
+    zero-padded and trimmed). Complex frames filter both planes with the real
+    taps and transform once. ``precision="bf16"`` casts the MAC and DFT
+    matmul operands to bfloat16 with float32 accumulation.
+    """
+    block = _resolve_block("fir_fft", block)
+    taps = jnp.asarray(taps)
+    if not jnp.issubdtype(taps.dtype, jnp.bfloat16):
+        taps = taps.astype(jnp.float32)
+    nt = taps.shape[0]
+    n = x.shape[0]
+    assert n % n_fft == 0, f"frame ({n}) must be a multiple of n_fft ({n_fft})"
+    assert nt <= n_fft, "fused FIR→FFT requires n_taps <= n_fft"
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B = max(1, int(block))
+    R = n // n_fft
+    R_pad = -(-R // B) * B
+
+    def _plane(p):
+        # history row: the nt−1 carry samples land at the END of the row
+        # directly above the frame's first row, zeros elsewhere
+        pad_row = jnp.concatenate(
+            [jnp.zeros(n_fft - (nt - 1), jnp.float32), p[:nt - 1]])
+        rows = jnp.concatenate([pad_row[None, :],
+                                p[nt - 1:].reshape(R, n_fft)])
+        z0 = jnp.zeros((B - 1, n_fft), jnp.float32)
+        ztail = jnp.zeros((R_pad - R, n_fft), jnp.float32)
+        return jnp.concatenate([z0, rows, ztail])        # [B + R_pad, n_fft]
+
+    if jnp.iscomplexobj(x):
+        full = jnp.concatenate([hist, x])
+        pr = _plane(full.real.astype(jnp.float32))
+        pi = _plane(full.imag.astype(jnp.float32))
+    else:
+        full = jnp.concatenate([hist, x]).astype(jnp.float32)
+        pr = _plane(full)
+        pi = jnp.zeros_like(pr)
+    # forward-DFT twiddles built IN TRACE, phase index reduced mod N before
+    # the float multiply (same reasoning as pallas_pfb's IDFT matrix)
+    c = jnp.arange(n_fft)
+    ang = 2 * jnp.pi * (jnp.outer(c, c) % n_fft) / n_fft
+    er = jnp.cos(ang).astype(jnp.float32)
+    ei = jnp.sin(ang).astype(jnp.float32)
+    grid = R_pad // B
+    kern = partial(_fir_fft_kernel, n_taps=nt, block=B, n_fft=n_fft,
+                   bf16=(precision == "bf16"))
+    out_r, out_i = pl.pallas_call(
+        kern,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((B, n_fft), lambda i: (i, 0)),      # prev rows (re)
+            pl.BlockSpec((B, n_fft), lambda i: (i, 0)),      # prev rows (im)
+            pl.BlockSpec((B, n_fft), lambda i: (i + 1, 0)),  # cur rows (re)
+            pl.BlockSpec((B, n_fft), lambda i: (i + 1, 0)),  # cur rows (im)
+            pl.BlockSpec((nt,), lambda i: (0,)),
+            pl.BlockSpec((n_fft, n_fft), lambda i: (0, 0)),
+            pl.BlockSpec((n_fft, n_fft), lambda i: (0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((B, n_fft), lambda i: (i, 0)),
+                   pl.BlockSpec((B, n_fft), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((R_pad, n_fft), jnp.float32),
+                   jax.ShapeDtypeStruct((R_pad, n_fft), jnp.float32)],
+        interpret=interpret,
+    )(pr, pi, pr, pi, taps, er, ei)
+    return jax.lax.complex(out_r[:R], out_i[:R]).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# rotator / quadrature-demod inner loops over 2-D lane tiles
+# ---------------------------------------------------------------------------
+
+_LANES = 128      # TPU vector lane width — the tile minor dimension
+
+
+def _rotator_kernel(xr, xi, p_ref, or_, oi_, *, block: int):
+    """One grid step of ``block`` 128-lane rows: y = x · exp(i·(ph0 + inc·t))
+    with the absolute sample index rebuilt from the grid position (2-D iota —
+    1-D iota has no TPU lowering)."""
+    ph0 = p_ref[0, 0]
+    inc = p_ref[1, 0]
+    g = pl.program_id(0)
+    r = jax.lax.broadcasted_iota(jnp.float32, (block, _LANES), 0)
+    c = jax.lax.broadcasted_iota(jnp.float32, (block, _LANES), 1)
+    t = (g * block + r) * _LANES + c
+    ph = ph0 + inc * t
+    cr = jnp.cos(ph)
+    si = jnp.sin(ph)
+    or_[...] = xr[...] * cr - xi[...] * si
+    oi_[...] = xr[...] * si + xi[...] * cr
+
+
+def pallas_rotator(x: jnp.ndarray, ph0, inc,
+                   block: Optional[int] = None,
+                   interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Phase-ramp rotator ``y[t] = x[t] · exp(i·(ph0 + inc·t))`` over 2-D
+    lane tiles — the in-kernel form of ``ops/stages.rotator_stage``'s inner
+    loop. ``ph0``/``inc`` may be traced carry scalars; ragged frames are
+    zero-padded to the tile grid and trimmed."""
+    block = max(1, _resolve_block("rotator", block))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = x.shape[0]
+    tile = block * _LANES
+    n_pad = -(-n // tile) * tile
+    xr = jnp.real(x).astype(jnp.float32)
+    xi = jnp.imag(x).astype(jnp.float32)
+    if n_pad != n:
+        z = jnp.zeros(n_pad - n, jnp.float32)
+        xr = jnp.concatenate([xr, z])
+        xi = jnp.concatenate([xi, z])
+    rows = n_pad // _LANES
+    xr = xr.reshape(rows, _LANES)
+    xi = xi.reshape(rows, _LANES)
+    # carry scalars ride a broadcast VMEM row (no SMEM plumbing needed):
+    # row 0 = ph0, row 1 = inc
+    params = jnp.stack([jnp.broadcast_to(jnp.float32(ph0), (_LANES,)),
+                        jnp.broadcast_to(jnp.float32(inc), (_LANES,))])
+    kern = partial(_rotator_kernel, block=block)
+    out_r, out_i = pl.pallas_call(
+        kern,
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((2, _LANES), lambda i: (0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((block, _LANES), lambda i: (i, 0)),
+                   pl.BlockSpec((block, _LANES), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(xr, xi, params)
+    y = jax.lax.complex(out_r.reshape(-1), out_i.reshape(-1))
+    return y[:n].astype(jnp.complex64)
+
+
+def _quad_demod_kernel(prev_r, prev_i, cur_r, cur_i, g_ref, o_ref, *,
+                       block: int):
+    """One grid step: y[t] = gain · atan2(im, re) of x[t]·conj(x[t−1]) — the
+    one-sample shift reads the previous tile's last lane row (the FIR
+    neighbour trick at shift 1, lifted to 2-D tiles)."""
+    gain = g_ref[0, 0]
+    ar = jnp.concatenate([prev_r[...], cur_r[...]])      # [2·block, 128]
+    ai = jnp.concatenate([prev_i[...], cur_i[...]])
+
+    def _shift1(a):
+        left = a[block - 1:2 * block - 1, _LANES - 1:]
+        right = a[block:2 * block, :_LANES - 1]
+        return jnp.concatenate([left, right], axis=1)
+
+    xr, xi = ar[block:2 * block], ai[block:2 * block]
+    pr, pi = _shift1(ar), _shift1(ai)
+    zr = xr * pr + xi * pi
+    zi = xi * pr - xr * pi
+    o_ref[...] = gain * jnp.arctan2(zi, zr)
+
+
+def pallas_quad_demod(prev, x: jnp.ndarray, gain,
+                      block: Optional[int] = None,
+                      interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Quadrature (FM) demod ``y[t] = gain · angle(x[t] · conj(x[t−1]))``
+    over 2-D lane tiles — the in-kernel form of
+    ``ops/stages.quad_demod_stage``'s inner loop. ``prev`` is the carry's
+    last sample of the previous frame (a traced scalar); ragged frames are
+    zero-padded and trimmed."""
+    block = max(1, _resolve_block("quad_demod", block))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = x.shape[0]
+    tile = block * _LANES
+    n_pad = -(-n // tile) * tile
+    # the stream with its one-sample history in front; the pad keeps tile
+    # rows aligned so sample t sits at flat index t + tile
+    ext = jnp.concatenate([jnp.zeros(tile - 1, x.dtype),
+                           jnp.reshape(prev, (1,)).astype(x.dtype), x])
+    if n_pad != n:
+        ext = jnp.concatenate([ext, jnp.zeros(n_pad - n, x.dtype)])
+    xr = jnp.real(ext).astype(jnp.float32).reshape(-1, _LANES)
+    xi = jnp.imag(ext).astype(jnp.float32).reshape(-1, _LANES)
+    g = jnp.broadcast_to(jnp.float32(gain), (1, _LANES))
+    kern = partial(_quad_demod_kernel, block=block)
+    y = pl.pallas_call(
+        kern,
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((block, _LANES), lambda i: (i, 0)),      # prev tile
+            pl.BlockSpec((block, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block, _LANES), lambda i: (i + 1, 0)),  # cur tile
+            pl.BlockSpec((block, _LANES), lambda i: (i + 1, 0)),
+            pl.BlockSpec((1, _LANES), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, _LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad // _LANES, _LANES),
+                                       jnp.float32),
+        interpret=interpret,
+    )(xr, xi, xr, xi, g)
+    return y.reshape(-1)[:n]

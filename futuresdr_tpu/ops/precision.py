@@ -32,9 +32,12 @@ reference output is measured — a lowering that blows
 An end-to-end check guards the composition: the fully-lowered program's sink
 SNR must clear the budget minus the incoherent-sum allowance
 (``budget − 10·log10(n_lowered)``), else the whole plan declines.
-``mode="bf16"`` force-lowers every supporting stage/edge (budget ignored,
-SNR still measured and reported). ``mode="off"`` returns the pipeline object
-UNCHANGED — bit-identical by construction.
+``mode="bf16"`` force-lowers every supporting stage/edge to bf16 (budget
+ignored, SNR still measured and reported); ``mode="int8"`` force-lowers each
+supporting stage as deep as its hook goes — int8 where accepted (the FIR
+family's quantized matmul rungs), bf16 otherwise — the deepest serve
+brownout lever. ``mode="off"`` returns the pipeline object UNCHANGED —
+bit-identical by construction.
 
 Declined edges and achieved per-edge SNR are visible in ``doctor.report()``
 (key ``"precision"``) and the REST profile view
@@ -58,11 +61,17 @@ __all__ = ["EdgeDecision", "PrecisionPlan", "plan_interior_precision",
            "dominant_compute_dtype"]
 
 #: precisions tried per stage, most-compressed first (int8 only where the
-#: stage's ``lower`` hook accepts it — no built-in stage does yet; the
-#: mechanism is exercised by tests/test_precision.py's declaring stage)
+#: stage's ``lower`` hook accepts it — the FIR family does: ``fir_stage``'s
+#: banded int8 matmul and the polyphase decimator's int8 shifted matvec,
+#: both real-taps-only; FFT/channelizer stages decline the rung)
 LOWER_LADDER = ("int8", "bf16")
 
-MODES = ("off", "auto", "bf16")
+#: ``"bf16"`` force-lowers every supporting stage/edge to bf16 exactly;
+#: ``"int8"`` force-lowers each supporting stage as DEEP as it goes (int8
+#: where the hook accepts it, bf16 fallback, edges bf16) — the serve
+#: brownout's deepest precision lever. Forced modes ignore the budget but
+#: still measure and report every SNR.
+MODES = ("off", "auto", "bf16", "int8")
 
 
 def snr_db(ref, got) -> float:
@@ -376,7 +385,14 @@ def plan_interior_precision(pipeline, mode: Optional[str] = None,
             else:
                 # -- accumulation ladder (stage-declared support only) ------
                 if s.lower is not None:
-                    ladder = (ov,) if ov in ("bf16", "int8") else LOWER_LADDER
+                    if ov in ("bf16", "int8"):
+                        ladder = (ov,)
+                    elif mode == "bf16":
+                        # forced-bf16 must not force-accept a DEEPER rung
+                        ladder = ("bf16",)
+                    else:
+                        ladder = LOWER_LADDER
+                    forced = mode in ("bf16", "int8")
                     for prec in ladder:
                         cand = s.lower(prec)
                         if cand is None:
@@ -385,7 +401,7 @@ def plan_interior_precision(pipeline, mode: Optional[str] = None,
                             continue
                         got = _replay_stage(cand, ref_ins)
                         s_db = snr_db(ref_out, got)
-                        if mode == "bf16" or s_db >= budget_db or ov == prec:
+                        if forced or s_db >= budget_db or ov == prec:
                             d.accum = prec
                             d.accum_snr_db = s_db
                             cur = cand
@@ -402,7 +418,7 @@ def plan_interior_precision(pipeline, mode: Optional[str] = None,
                 if not is_boundary:
                     e_db = snr_db(ref_out, _edge_cast_host(ref_out))
                     d.edge_snr_db = e_db
-                    if mode == "bf16" or e_db >= budget_db:
+                    if mode in ("bf16", "int8") or e_db >= budget_db:
                         d.edge = "bf16"
                         cur = _wrap_edge(cur)
                         # a partially-lowered stage is LOWERED: the accum
@@ -519,7 +535,11 @@ def pallas_stage_count(pipeline) -> int:
         route = getattr(s, "route", None)
         lti = getattr(s, "lti", None)
         is_c = np.issubdtype(dt, np.complexfloating)
-        if name == "pallas_fir":
+        if route is not None and len(route) > 2 and route[2] == "int8":
+            # the int8 rung computes through quantized XLA matmuls, not the
+            # (f32/bf16-only) Pallas kernels — never counts
+            pass
+        elif name == "pallas_fir":
             n += 1
         elif lti is not None:
             taps, decim, _fl, lti_impl = lti

@@ -76,8 +76,8 @@ class Stage:
     #   pass only considers stages that offer the hook; everything else gets at most
     #   an interior-EDGE cast
     compute_dtype: str = "f32"                    # dominant accumulation dtype of the
-    #   traced program ("f32" | "bf16") — keys the MFU denominator on the right
-    #   per-dtype chip peak (utils/roofline.detect_peaks)
+    #   traced program ("f32" | "bf16" | "int8") — keys the MFU denominator on the
+    #   right per-dtype chip peak (utils/roofline.detect_peaks)
     route: Optional[Tuple[Optional[str], Optional[str], Optional[str]]] = None
     #   (impl, fft_impl, precision) — the builder's per-call-site selection for
     #   kernel-backed stages (fir/fft/channelizer). LTI merging preserves pins
@@ -929,8 +929,17 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
     ``precision="bf16"`` builds the interior-precision-lowered variant
     (``ops/precision.py``): bf16 MXU passes in the overlap-save FFTs, bf16
     tap/accumulation in the pallas and polyphase kernels (carried weights land
-    in bf16). The f32-built stage exposes the same lowering through its
-    ``Stage.lower`` hook — the SNR-budgeted pass uses that.
+    in bf16). ``precision="int8"`` (real taps only) abandons the FFT form
+    entirely — no useful int8 FFT exists — and runs the convolution as a
+    banded windowed matmul: the frame blocks into ``Bq``-sample tiles
+    (each with its left neighbour, the overlap-save trick in the time
+    domain), both operands absmax-quantized to int8 in-trace, one
+    ``[2Bq]·[2Bq, Bq]`` int8 matmul with int32 accumulation per tile. The
+    band matrix is built from the CARRIED taps so runtime swaps reach it, and
+    the carry tree (spectrum, taps, tail) is bit-compatible with the f32
+    stage — the serve brownout's leaf conversion and the checkpoint leaf
+    contract both depend on that. The f32-built stage exposes both lowerings
+    through its ``Stage.lower`` hook — the SNR-budgeted pass uses that.
     """
     assert impl in ("auto", "os", "pallas", "poly"), impl
     taps = np.asarray(taps)
@@ -957,6 +966,14 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
     while L < 2 * nt:                   # hop must comfortably exceed the tap overlap
         L *= 2
     fft_len = 2 * L
+    if precision == "int8":
+        assert built_real, "precision='int8' requires real taps"
+    # int8 banded-matmul tile: a power of two dividing the hop L (frames are
+    # L-multiples, so they block evenly) that covers the tap overlap in one
+    # left-neighbour tile (Bq >= nt-1; pow2ceil(nt-1) <= L since L >= 2*nt)
+    Bq = min(L, 128)
+    while Bq < nt - 1:
+        Bq *= 2
 
     def _spectra(t):
         # full spectrum, and the real-input half spectrum (real inputs discard the
@@ -973,6 +990,39 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
 
     def fn(carry, x):
         Hc, tt, tail = carry
+        if precision == "int8":
+            # int8 ladder rung: banded windowed matmul over Bq-sample tiles.
+            # T[j, i] = taps[Bq + i − j], so tile s's output
+            # y[s·Bq + i] = Σ_j ext8[s·Bq + j] · T[j, i] = Σ_k taps[k]·x[s·Bq+i−k]
+            # with ext8 carrying Bq history samples in front (Bq >= nt−1).
+            jj = jnp.arange(2 * Bq)[:, None]
+            ii = jnp.arange(Bq)[None, :]
+            kk = Bq + ii - jj
+            T = jnp.where((kk >= 0) & (kk < nt),
+                          tt[jnp.clip(kk, 0, nt - 1)], 0.0)
+            sw = jnp.maximum(jnp.max(jnp.abs(tt)), 1e-30) / 127.0
+            Tq = jnp.round(T / sw).astype(jnp.int8)
+
+            def _conv(plane):
+                sx = jnp.maximum(jnp.max(jnp.abs(plane)), 1e-30) / 127.0
+                q = jnp.round(plane / sx).astype(jnp.int8)
+                rq = q.reshape(-1, Bq)                      # [S+1, Bq]
+                blk = jnp.concatenate([rq[:-1], rq[1:]], axis=1)   # [S, 2Bq]
+                acc = jnp.matmul(blk, Tq,
+                                 preferred_element_type=jnp.int32)
+                return acc.reshape(-1).astype(jnp.float32) * (sx * sw)
+
+            ext8 = jnp.concatenate([tail[L - Bq:], x])
+            if jnp.iscomplexobj(x):
+                y = jax.lax.complex(_conv(ext8.real), _conv(ext8.imag))
+            else:
+                y = _conv(ext8)
+            y = y.astype(x.dtype)
+            if decim > 1:
+                y = y[::decim]
+            # frames are >= L samples (frame_multiple), so the new tail is
+            # the frame's own last L samples
+            return (Hc, tt, x[x.shape[0] - L:]), y
         ext = jnp.concatenate([tail, x])             # [(S+1)·L], S = n // L
         is_c = jnp.iscomplexobj(x)
         if impl != "os" and np.isrealobj(taps) and nt >= 2 and (
@@ -1053,16 +1103,38 @@ def fir_stage(taps, decim: int = 1, fft_len: int = 8192, name: str = "fir",
     multiple = int(np.lcm(L, decim))
 
     def _lower(p: str) -> Optional[Stage]:
-        if p != "bf16":
-            return None
-        return fir_stage(taps, decim=decim, fft_len=fft_len, name=name,
-                         impl=impl, fft_impl=fft_impl, precision="bf16")
+        if p == "bf16" or (p == "int8" and built_real):
+            return fir_stage(taps, decim=decim, fft_len=fft_len, name=name,
+                             impl=impl, fft_impl=fft_impl, precision=p)
+        return None
 
     return Stage(fn, init_carry, Fraction(1, decim), None, multiple, name,
                  lti=(taps, decim, fft_len, impl), update=update,
                  lower=_lower,
-                 compute_dtype="bf16" if precision == "bf16" else "f32",
+                 compute_dtype=(precision if precision in ("bf16", "int8")
+                                else "f32"),
                  route=(impl, fft_impl, precision))
+
+
+def _int8_shifted_matvec(rows, W, m: int, nq: int):
+    """The int8 ladder rung of :func:`_shifted_matvec` (real planes only):
+    dynamic absmax quantization of BOTH operands (scale = absmax/127 — the
+    standard symmetric int8 scheme), every shifted MAC on the int8 matmul
+    path with int32 accumulation, one dequantize at the sink. The scales are
+    data-derived in-trace, so the carried weight matrix stays float32 and the
+    carry tree is bit-compatible with the f32 stage (the serve brownout's
+    leaf-wise ``astype`` conversion and the checkpoint leaf contract both
+    rely on that — see ops/precision.py)."""
+    from functools import partial as _partial
+    sw = jnp.maximum(jnp.max(jnp.abs(W)), 1e-30) / 127.0
+    Wq = jnp.round(W / sw).astype(jnp.int8)
+    sx = jnp.maximum(jnp.max(jnp.abs(rows)), 1e-30) / 127.0
+    rq = jnp.round(rows / sx).astype(jnp.int8)
+    mm = _partial(jnp.matmul, preferred_element_type=jnp.int32)
+    acc = mm(rq[m:m + nq], Wq[0])
+    for r in range(1, m + 1):
+        acc = acc + mm(rq[m - r:m - r + nq], Wq[r])
+    return acc.astype(jnp.float32) * (sx * sw)
 
 
 def _shifted_matvec(ext: jnp.ndarray, W, m: int, nq: int,
@@ -1075,10 +1147,18 @@ def _shifted_matvec(ext: jnp.ndarray, W, m: int, nq: int,
     casts REAL operands to bfloat16 with float32 accumulation — the native MXU
     pass on TPU, the identical quantization on CPU; complex operands (no bf16
     complex exists) fall back to DEFAULT matmul precision, which is the bf16-pass
-    path on TPU and a no-op on CPU."""
+    path on TPU and a no-op on CPU. ``precision="int8"`` (real weights only —
+    the lower hooks guard that) quantizes through :func:`_int8_shifted_matvec`,
+    complex streams per re/im plane."""
     from functools import partial as _partial
     D = W.shape[-2] if W.ndim == 3 else W.shape[-1]
     rows = ext.reshape(-1, D)
+    if precision == "int8" and not jnp.iscomplexobj(W):
+        if jnp.iscomplexobj(rows):
+            return jax.lax.complex(
+                _int8_shifted_matvec(rows.real, W, m, nq),
+                _int8_shifted_matvec(rows.imag, W, m, nq))
+        return _int8_shifted_matvec(rows, W, m, nq)
     if precision == "bf16" and not (jnp.iscomplexobj(rows)
                                     or jnp.iscomplexobj(W)):
         rows = rows.astype(jnp.bfloat16)
@@ -1131,6 +1211,11 @@ def _poly_decim_fir_stage(taps: np.ndarray, decim: int, fft_len: int,
     frames run two real passes; complex taps keep the matvec path — the kernel
     is real-only). ``precision="bf16"`` carries the weight matrix in bfloat16
     and runs the MACs with bf16 operands / f32 accumulation on either path.
+    ``precision="int8"`` (real taps only) runs the shifted MACs as int8×int8
+    matmuls with int32 accumulation (:func:`_int8_shifted_matvec`); the Pallas
+    kernel is f32/bf16-only, so an int8 build routes the matvec path and the
+    carried weights STAY float32 (quantized in-trace) — the carry tree is
+    bit-compatible with the f32 stage for brownout/checkpoint conversion.
     """
     D = int(decim)
     nt = len(taps)
@@ -1141,7 +1226,8 @@ def _poly_decim_fir_stage(taps: np.ndarray, decim: int, fft_len: int,
     def fn(carry, x):
         W, hist = carry
         ext = jnp.concatenate([hist, x])                 # [H + n]
-        if impl == "pallas" and not jnp.iscomplexobj(W):
+        if impl == "pallas" and not jnp.iscomplexobj(W) \
+                and precision != "int8":
             from .pallas_kernels import pallas_poly_fir
             if jnp.iscomplexobj(x):
                 yr = pallas_poly_fir(ext.real.reshape(-1, D), W,
@@ -1196,15 +1282,16 @@ def _poly_decim_fir_stage(taps: np.ndarray, decim: int, fft_len: int,
         return (to_device(_weights(new, complex_stream), dev), hist)
 
     def _lower(p: str) -> Optional[Stage]:
-        if p != "bf16" or not built_real:
+        if p not in ("bf16", "int8") or not built_real:
             return None
         return _poly_decim_fir_stage(taps, D, fft_len, name, impl,
-                                     precision="bf16")
+                                     precision=p)
 
     return Stage(fn, init_carry, Fraction(1, D), None, D, name,
                  lti=(taps, D, fft_len, impl), update=update,
                  lower=_lower,
-                 compute_dtype="bf16" if precision == "bf16" else "f32",
+                 compute_dtype=(precision if precision in ("bf16", "int8")
+                                else "f32"),
                  route=(impl, None, precision))
 
 
@@ -1215,6 +1302,10 @@ def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,
 
     ``impl="poly"`` (default): true polyphase — phase-grouped stride-D windows built
     from static slices, contracted against the phase-tap matrix in one MXU einsum.
+    ``impl="pallas"``: the same factorization computed inside the fused
+    polyphase kernel (``pallas_kernels.pallas_poly_fir`` with the 3-D
+    phase-tap tensor) — the resampler's inner loop on the autotuned Pallas
+    plane; complex frames run two real passes.
     ``impl="stuff"``: the earlier zero-stuff ×I → overlap-save lowpass → ↓D form
     (kept for cross-validation and for complex taps)."""
     from math import gcd
@@ -1226,7 +1317,7 @@ def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,
         r = max(I, D)
         taps = firdes.kaiser_lowpass(0.5 / r * 0.8, 0.1 / r) * I
     taps = np.asarray(taps)
-    assert impl in ("poly", "stuff"), impl
+    assert impl in ("poly", "stuff", "pallas"), impl
     if np.iscomplexobj(taps):
         impl = "stuff"                  # poly path computes a plain taps·x dot; the
                                         # stuffed OS path owns complex-tap semantics
@@ -1281,14 +1372,26 @@ def resample_stage(interp: int, decim: int, taps=None, fft_len: int = 8192,
     def fn(carry, x):
         hist = carry
         ext = jnp.concatenate([hist, x])                 # [H + n]
-        y = _shifted_matvec(ext, jnp.asarray(W), m, x.shape[0] // D)  # [nq, I]
+        if impl == "pallas":
+            from .pallas_kernels import pallas_poly_fir
+            Wj = jnp.asarray(W)
+            if jnp.iscomplexobj(x):
+                yr = pallas_poly_fir(ext.real.reshape(-1, D), Wj)
+                yi = pallas_poly_fir(ext.imag.reshape(-1, D), Wj)
+                y = jax.lax.complex(yr, yi)              # [nq, I]
+            else:
+                y = pallas_poly_fir(ext.reshape(-1, D), Wj)
+        else:
+            y = _shifted_matvec(ext, jnp.asarray(W), m,
+                                x.shape[0] // D)         # [nq, I]
         return ext[ext.shape[0] - H:], y.reshape(-1).astype(x.dtype)
 
     def init_carry(dtype):
         from .xfer import to_device
         return to_device(np.zeros(H, dtype=np.dtype(dtype)))
 
-    return Stage(fn, init_carry, Fraction(I, D), None, D, name)
+    return Stage(fn, init_carry, Fraction(I, D), None, D, name,
+                 route=(("pallas", None, None) if impl == "pallas" else None))
 
 
 def decimate_stage(decim: int) -> Stage:
@@ -1342,6 +1445,73 @@ def fft_stage(n: int, direction: str = "forward", shift: bool = False,
                  f"fft{n}", lower=_lower,
                  compute_dtype="bf16" if precision == "bf16" else "f32",
                  route=(impl, None, precision))
+
+
+def fir_fft_stage(taps, n_fft: int, name: Optional[str] = None,
+                  precision: Optional[str] = None) -> Stage:
+    """Fused FIR → windowed-FFT stage (``pallas_kernels.pallas_fir_fft``):
+    the filtered stream never round-trips HBM between the filter MAC and the
+    transform — the resident ``fir_stage + fft_stage`` chain's whole interior
+    edge, collapsed into one kernel.
+
+    Semantically identical (allclose-pinned, tests/test_pallas.py) to
+    ``Pipeline([fir_stage(taps), fft_stage(n_fft)])``: frames of ``n_fft``
+    samples are filtered causally (history rides the carry) and transformed
+    per ``n_fft`` window. REAL taps only, ``2 <= n_taps <= n_fft`` (a tap
+    shift must not reach past the transform row directly above — the
+    kernel's neighbour-tile precondition). The taps ride the carry, so
+    runtime swaps (``update(taps=…)``) reach the kernel with no recompile.
+    NOT LTI-mergeable (``lti=None`` — the FFT half is not a filter); the
+    ``route`` pin marks the pallas dispatch for the cost-cache marker and
+    ``pallas_stage_count``. ``precision="bf16"`` runs MAC + DFT matmuls with
+    bf16 operands / f32 accumulation; the ``lower`` hook exposes that to the
+    SNR-budgeted interior-precision pass.
+    """
+    taps = np.asarray(taps)
+    nt = len(taps)
+    assert np.isrealobj(taps) and 2 <= nt <= int(n_fft), \
+        "fir_fft_stage requires real taps with 2 <= n_taps <= n_fft"
+    n_fft = int(n_fft)
+    name = name or f"fir_fft{n_fft}"
+
+    def fn(carry, x):
+        tt, tail = carry
+        from .pallas_kernels import pallas_fir_fft
+        y = pallas_fir_fft(tail, x, tt, n_fft, precision=precision)
+        # frames are >= n_fft >= nt samples, so the new history is the
+        # frame's own last nt-1 samples
+        return (tt, x[x.shape[0] - (nt - 1):]), y
+
+    def init_carry(dtype):
+        from .xfer import to_device
+        return (to_device(np.real(taps).astype(np.float32)),
+                to_device(np.zeros(nt - 1, dtype=np.dtype(dtype))))
+
+    def update(carry, taps=None):
+        """Runtime tap swap (same count; real — the kernel is real-taps-only)."""
+        if taps is None:
+            return carry
+        new = np.asarray(taps)
+        if len(new) != nt:
+            raise ValueError(
+                f"tap swap must keep the tap count ({nt}); got {len(new)} — "
+                f"rebuild the stage for a different filter length")
+        if np.iscomplexobj(new):
+            raise ValueError("fir_fft_stage taps must stay real")
+        _tt, tail = carry
+        from .xfer import to_device
+        dev = next(iter(tail.devices())) if isinstance(tail, jax.Array) else None
+        return (to_device(new.astype(np.float32), dev), tail)
+
+    def _lower(p: str) -> Optional[Stage]:
+        if p != "bf16":
+            return None
+        return fir_fft_stage(taps, n_fft, name=name, precision="bf16")
+
+    return Stage(fn, init_carry, Fraction(1, 1), np.complex64, n_fft, name,
+                 update=update, lower=_lower,
+                 compute_dtype="bf16" if precision == "bf16" else "f32",
+                 route=("pallas", None, precision))
 
 
 def fftshift_stage(n: int) -> Stage:
@@ -1461,20 +1631,31 @@ def xlating_fir_stage(taps, phase_inc: float, decim: int,
     return Stage(fn, init_carry, Fraction(1, D), None, D, name, update=update)
 
 
-def rotator_stage(phase_inc: float, name: str = "rotator") -> Stage:
+def rotator_stage(phase_inc: float, name: str = "rotator",
+                  impl: str = "xla") -> Stage:
     """Complex rotator with phase carry (futuredsp `Rotator` as a stage).
 
     The increment rides the CARRY (not the trace), so a runtime retune —
     ``pipeline.update_stage(carries, "rotator", phase_inc=…)`` or the TpuKernel
     ``ctrl`` port — takes effect on the next dispatched frame with phase
     continuity, no recompile: the device-path analog of the fm-receiver's
-    ``freq`` handler (``examples/fm-receiver/src/main.rs:83-155``)."""
+    ``freq`` handler (``examples/fm-receiver/src/main.rs:83-155``).
+
+    ``impl="pallas"`` routes the phase-ramp multiply through the 2-D lane-tile
+    kernel (``pallas_kernels.pallas_rotator`` — the autotuned Pallas plane);
+    ``"xla"`` (default) keeps the fused XLA form. Same carry, same retune
+    grammar on both routes."""
+    assert impl in ("xla", "pallas"), impl
 
     def fn(carry, x):
         ph0, inc = carry
         n = x.shape[0]
-        ph = ph0 + inc * jnp.arange(n, dtype=jnp.float32)
-        y = x * jnp.exp(1j * ph).astype(x.dtype)
+        if impl == "pallas":
+            from .pallas_kernels import pallas_rotator
+            y = pallas_rotator(x, ph0, inc).astype(x.dtype)
+        else:
+            ph = ph0 + inc * jnp.arange(n, dtype=jnp.float32)
+            y = x * jnp.exp(1j * ph).astype(x.dtype)
         new = jnp.mod(ph0 + inc * n, 2 * np.pi)
         return (new, inc), y
 
@@ -1491,13 +1672,22 @@ def rotator_stage(phase_inc: float, name: str = "rotator") -> Stage:
             new_inc = jax.device_put(new_inc, next(iter(ph0.devices())))
         return (ph0, new_inc)
 
-    return Stage(fn, init_carry, Fraction(1, 1), None, 1, name, update=update)
+    return Stage(fn, init_carry, Fraction(1, 1), None, 1, name, update=update,
+                 route=(("pallas", None, None) if impl == "pallas" else None))
 
 
-def quad_demod_stage(gain: float = 1.0) -> Stage:
-    """FM discriminator with one-sample carry."""
+def quad_demod_stage(gain: float = 1.0, impl: str = "xla") -> Stage:
+    """FM discriminator with one-sample carry. ``impl="pallas"`` routes the
+    ``angle(x·conj(x₋₁))`` inner loop through the 2-D lane-tile kernel
+    (``pallas_kernels.pallas_quad_demod``); the one-sample history carry is
+    identical on both routes."""
+    assert impl in ("xla", "pallas"), impl
 
     def fn(carry, x):
+        if impl == "pallas":
+            from .pallas_kernels import pallas_quad_demod
+            y = pallas_quad_demod(carry, x, gain)
+            return x[-1], y.astype(jnp.float32)
         prev = jnp.concatenate([carry[None], x[:-1]])
         y = gain * jnp.angle(x * jnp.conj(prev))
         return x[-1], y.astype(jnp.float32)
@@ -1508,7 +1698,8 @@ def quad_demod_stage(gain: float = 1.0) -> Stage:
         from .xfer import to_device
         return to_device(np.ones((), dtype=np.dtype(dtype)))
 
-    return Stage(fn, init_carry, Fraction(1, 1), np.float32, 1, "quad_demod")
+    return Stage(fn, init_carry, Fraction(1, 1), np.float32, 1, "quad_demod",
+                 route=(("pallas", None, None) if impl == "pallas" else None))
 
 
 def apply_stage(f: Callable[[jnp.ndarray], jnp.ndarray], out_dtype=None,

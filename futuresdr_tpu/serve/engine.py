@@ -321,8 +321,12 @@ class ServeEngine:
                              else config().get("serve_slo_ms", 0.0))
         self._ladder = ShedLadder.from_config()
         self._brownout = str(config().get("serve_brownout", "off") or "off")
+        bp = str(config().get("serve_brownout_precision", "bf16") or "bf16")
+        # unknown modes fall back to bf16 — a typo'd config must not turn
+        # the overload lever into a no-op at the worst possible moment
+        self._brownout_prec = bp if bp in ("bf16", "int8") else "bf16"
         self._brownout_active = False
-        self._low_pipe = None              # lazily-planned bf16 brownout form
+        self._low_pipe = None              # lazily-planned lowered brownout form
         self._pipe_tag = "base"            # program-cache axis for brownout
         self._base_dt = None               # base-pipeline leaf dtypes (lazy)
         self._lat_recent: Deque[float] = deque(maxlen=128)   # seconds
@@ -1171,8 +1175,10 @@ class ServeEngine:
         """Rung 3 (config ``serve_brownout``, default off): trade quality
         for headroom on resident buckets — ``"k"`` drops megabatch K to 1
         (per-dispatch latency over throughput; K>1 vs K=1 round differently
-        by repo contract), ``"precision"`` retunes the interior to bf16 via
-        ``ops/precision.py`` (SNR-bounded loss for the duration). Both
+        by repo contract), ``"precision"`` retunes the interior to the
+        configured ``serve_brownout_precision`` mode (bf16 default, or the
+        deeper int8 rung) via ``ops/precision.py`` (SNR-bounded loss for
+        the duration). Both
         compile their program form once (billed ``serve_bucket``) and keep
         the base programs cached — recovery never recompiles."""
         if on == self._brownout_active:
@@ -1187,11 +1193,14 @@ class ServeEngine:
                     "ENGAGED" if on else "released")
 
     def _apply_precision_brownout(self, on: bool) -> bool:
-        """Swap the served pipeline between the base and the bf16-lowered
-        form, converting the stacked carries leaf-by-leaf (narrowing casts;
+        """Swap the served pipeline between the base and the lowered form
+        (``serve_brownout_precision``: bf16, or the deeper int8 rung),
+        converting the stacked carries leaf-by-leaf (narrowing casts;
         widening upcasts the live values — the brownout's documented,
-        bounded quality loss for its duration). Returns False (logged, no
-        state change) when nothing lowers or the carry trees refuse."""
+        bounded quality loss for its duration; int8 stages carry FLOAT
+        weights and quantize in-trace, so their leaves convert as plain
+        dtype casts like any other). Returns False (logged, no state
+        change) when nothing lowers or the carry trees refuse."""
         import jax
         prev_pipe = self.pipeline
         if on:
@@ -1199,7 +1208,7 @@ class ServeEngine:
                 try:
                     from ..ops import precision as _precision_mod
                     low, plan = _precision_mod.plan_interior_precision(
-                        self._base_pipeline, mode="bf16")
+                        self._base_pipeline, mode=self._brownout_prec)
                 except Exception as e:                 # noqa: BLE001
                     log.warning("%s: precision brownout plan failed (%r) — "
                                 "lever disabled", self.app, e)
@@ -1209,7 +1218,7 @@ class ServeEngine:
                                 "lever disabled", self.app)
                     return False
                 self._low_pipe = low
-            target, tag = self._low_pipe, "bf16"
+            target, tag = self._low_pipe, self._brownout_prec
         else:
             target, tag = self._base_pipeline, "base"
         if target is self.pipeline:

@@ -37,7 +37,9 @@ __all__ = ["autotune", "autotune_streamed", "autotune_serve",
            "cached_frames_per_dispatch", "cached_streamed_pick",
            "record_serve_buckets", "cached_serve_buckets",
            "record_interior_precision", "cached_interior_precision",
-           "record_shard_devices", "cached_shard_devices"]
+           "record_shard_devices", "cached_shard_devices",
+           "record_pallas_blocks", "cached_pallas_blocks",
+           "autotune_pallas_blocks"]
 
 log = logger("tpu.autotune")
 
@@ -403,6 +405,28 @@ def _norm_entry(v) -> Optional[dict]:
                         out["interior_precision"] = mode
                 except (TypeError, ValueError):
                     pass
+            pb = v.get("pallas_blocks")
+            if pb is not None:
+                # round-20 axis (Pallas autotune plane): measured per-chip
+                # block shapes as {device_kind: {kernel: block}} — same
+                # per-axis guard, a malformed table (wrong nesting, a
+                # negative shape, an unknown kernel from a newer revision)
+                # loses only this axis, never the entry's valid picks
+                try:
+                    from ..ops.pallas_kernels import DEFAULT_BLOCKS
+                    tbl = {}
+                    for dev, blocks in dict(pb).items():
+                        good = {}
+                        for kn, bv in dict(blocks).items():
+                            bv = int(bv)
+                            if str(kn) in DEFAULT_BLOCKS and bv > 0:
+                                good[str(kn)] = bv
+                        if good:
+                            tbl[str(dev)] = good
+                    if tbl:
+                        out["pallas_blocks"] = tbl
+                except (TypeError, ValueError, AttributeError):
+                    pass
             return out
         return {"k": int(v), "inflight": None}
     except (TypeError, ValueError, KeyError):
@@ -477,6 +501,9 @@ def _record_sig(sig: tuple, frames_per_dispatch: int,
         entry["interior_precision"] = prev["interior_precision"]
     if prev and prev.get("n_devices"):
         entry["n_devices"] = int(prev["n_devices"])
+    if prev and prev.get("pallas_blocks"):
+        entry["pallas_blocks"] = {d: dict(b) for d, b
+                                  in prev["pallas_blocks"].items()}
     _streamed_cache[sig] = entry
     # K-only records persist in the legacy bare-int form (readable by older
     # processes); the dict form is written only when it carries more
@@ -708,6 +735,94 @@ def autotune_shard(stages, in_dtype, frame: Optional[int] = None,
     if record and results:
         record_shard_devices(pipe.stages, pipe.in_dtype, inst.platform, best)
     return best, results
+
+
+# ---------------------------------------------------------------------------
+# Pallas block-shape axis (tpu/pallas_tune.py, docs/tpu_notes.md "Pallas
+# autotune plane")
+# ---------------------------------------------------------------------------
+
+def record_pallas_blocks(stages, in_dtype, platform: str, device: str,
+                         blocks: Dict[str, int]) -> None:
+    """Stamp measured Pallas block shapes for one chip generation into this
+    chain's streamed-pick cache entry — the ``pallas_blocks`` axis rides
+    next to (k, inflight, serve_buckets, interior_precision, n_devices)
+    under one signature, keyed per device kind INSIDE the axis so one
+    entry serves mixed chip generations (a v5e sweep must not clobber the
+    v5p picks). Unknown kernel keys and non-positive shapes are dropped,
+    not stored (the :func:`_norm_entry` contract: the cache must never
+    carry a value the next read would strip)."""
+    from ..ops.pallas_kernels import DEFAULT_BLOCKS
+    good: Dict[str, int] = {}
+    for kn, bv in (blocks or {}).items():
+        try:
+            bv = int(bv)
+        except (TypeError, ValueError):
+            continue
+        if kn in DEFAULT_BLOCKS and bv > 0:
+            good[str(kn)] = bv
+    if not good or not device:
+        return
+    sig = _streamed_sig(_serve_sig_stages(stages), in_dtype, platform)
+    cur = _streamed_cache.get(sig) or _disk_load().get(_sig_str(sig)) \
+        or {"k": 1, "inflight": None}
+    tbl = {d: dict(b) for d, b in (cur.get("pallas_blocks") or {}).items()}
+    tbl[str(device)] = good
+    entry = {**cur, "pallas_blocks": tbl}
+    _streamed_cache[sig] = entry
+    _disk_store(sig, entry)
+
+
+def cached_pallas_blocks(stages, in_dtype, platform: str,
+                         device: str) -> Optional[Dict[str, int]]:
+    """The measured block table of a previous sweep for this chain on this
+    chip generation; None when never swept (kernel init then compiles with
+    the hand-picked :data:`~futuresdr_tpu.ops.pallas_kernels.DEFAULT_BLOCKS`)."""
+    entry = cached_streamed_pick(_serve_sig_stages(stages), in_dtype,
+                                 platform)
+    if entry is None:
+        return None
+    blocks = (entry.get("pallas_blocks") or {}).get(str(device))
+    return dict(blocks) if blocks else None
+
+
+def autotune_pallas_blocks(stages, in_dtype,
+                           inst: Optional[TpuInstance] = None,
+                           kernels: Optional[Sequence[str]] = None,
+                           frame: int = 1 << 16, reps: int = 3,
+                           force: bool = False,
+                           record: bool = True) -> Dict[str, int]:
+    """Sweep the Pallas kernel block shapes for this chip generation and
+    install the winners process-wide (sweep → record →
+    :func:`~futuresdr_tpu.ops.pallas_kernels.set_tuned_blocks` — the
+    driver of ``tpu/pallas_tune.py``).
+
+    A cache hit (this chain was swept on this device kind before) SKIPS
+    the sweep entirely and just installs the recorded winners;
+    ``force=True`` re-measures. A recorded winner can never regress the
+    hand-picked defaults: the defaults are always in the candidate set
+    and win ties (see :func:`~futuresdr_tpu.tpu.pallas_tune.sweep_blocks`)."""
+    from ..ops.pallas_kernels import set_tuned_blocks
+    from . import pallas_tune
+    inst = inst or instance()
+    dev = pallas_tune.device_key()
+    chain = _serve_sig_stages(stages)
+    if not force:
+        hit = cached_pallas_blocks(chain, in_dtype, inst.platform, dev)
+        if hit is not None:
+            log.info("pallas-blocks cache hit (%s): %s — sweep skipped",
+                     dev, hit)
+            set_tuned_blocks(hit)
+            return hit
+    winners, matrix = pallas_tune.sweep_blocks(kernels=kernels, frame=frame,
+                                               reps=reps)
+    log.info("pallas-blocks sweep (%s): winners=%s over %s", dev, winners,
+             {k: {b: round(t * 1e3, 3) for b, t in m.items()}
+              for k, m in matrix.items()})
+    if record and winners:
+        record_pallas_blocks(chain, in_dtype, inst.platform, dev, winners)
+    set_tuned_blocks(winners)
+    return winners
 
 
 def autotune_serve(pipeline, frame_size: Optional[int] = None,

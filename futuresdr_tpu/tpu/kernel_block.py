@@ -270,6 +270,7 @@ class TpuKernel(Kernel):
         self.pipeline = _pipeline if _pipeline is not None \
             else Pipeline(stages, in_dtype)
         self._apply_interior_precision(interior_precision)
+        self._apply_pallas_blocks()
         fs = frame_size or self.inst.frame_size
         m = self.pipeline.frame_multiple
         self.frame_size = max(m, (fs // m) * m)
@@ -352,6 +353,31 @@ class TpuKernel(Kernel):
                         "staying f32", type(self).__name__, e)
             self.pipeline = self._base_pipeline
             self._precision_plan = None
+
+    def _apply_pallas_blocks(self) -> None:
+        """Install this chain's cached Pallas block sweep (the
+        ``pallas_blocks`` autotune axis, tpu/pallas_tune.py) BEFORE the
+        program compiles — ``impl="pallas"`` stages resolve ``block=None``
+        against the process-wide tuned table at trace time, so a cached
+        winner reaches every kernel without a per-stage parameter. No
+        cache entry for this chip generation (or any lookup failure)
+        leaves the hand-picked defaults in place. Shared by TpuKernel and
+        TpuFanoutKernel construction."""
+        try:
+            from ..ops.pallas_kernels import set_tuned_blocks
+            from .autotune import cached_pallas_blocks
+            from .pallas_tune import device_key
+            sig = self.pipeline \
+                if getattr(self.pipeline, "n_branches", 0) \
+                else self.pipeline.stages
+            blocks = cached_pallas_blocks(sig, self.pipeline.in_dtype,
+                                          self.inst.platform, device_key())
+        except Exception:              # noqa: BLE001 — defaults only
+            return
+        if blocks:
+            set_tuned_blocks(blocks)
+            log.info("%s: pallas block shapes from cached sweep: %s",
+                     type(self).__name__, blocks)
 
     def _init_hostpath(self) -> None:
         """Host-data-path state shared by TpuKernel and TpuFanoutKernel
@@ -1845,6 +1871,7 @@ class TpuFanoutKernel(TpuKernel):
         self.inst = inst or instance()
         self.pipeline = fanout
         self._apply_interior_precision(interior_precision)
+        self._apply_pallas_blocks()
         fanout = self.pipeline            # the (possibly lowered) rebuild
         fs = frame_size or self.inst.frame_size
         m = fanout.frame_multiple

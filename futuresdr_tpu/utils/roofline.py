@@ -37,14 +37,17 @@ __all__ = ["cost_of", "pipeline_roofline", "graph_roofline", "program_cost",
            "detect_peaks", "dtype_peak_flops", "dominant_dtype",
            "PEAKS", "CHIP_PEAKS"]
 
-# public per-chip specs (per chip, bf16 matmul peak FLOP/s + HBM B/s)
+# public per-chip specs (per chip, bf16 matmul peak FLOP/s + HBM B/s;
+# ``int8_flops`` where the generation publishes a distinct int8 OPS figure —
+# v5e/v5p/v6e run int8 matmuls at 2x the bf16 rate, v2–v4 have no int8
+# acceleration so the key is absent and int8 grades against the bf16 peak)
 CHIP_PEAKS = {
     "v2": {"flops": 45e12, "hbm_bytes": 700e9},
     "v3": {"flops": 123e12, "hbm_bytes": 900e9},
     "v4": {"flops": 275e12, "hbm_bytes": 1228e9},
-    "v5e": {"flops": 197e12, "hbm_bytes": 819e9},
-    "v5p": {"flops": 459e12, "hbm_bytes": 2765e9},
-    "v6e": {"flops": 918e12, "hbm_bytes": 1640e9},
+    "v5e": {"flops": 197e12, "hbm_bytes": 819e9, "int8_flops": 394e12},
+    "v5p": {"flops": 459e12, "hbm_bytes": 2765e9, "int8_flops": 918e12},
+    "v6e": {"flops": 918e12, "hbm_bytes": 1640e9, "int8_flops": 1836e12},
 }
 
 # historical backend-label mapping: "tpu" maps the tunneled TPU v5 lite to
@@ -81,25 +84,42 @@ def dtype_peak_flops(peaks: dict, dtype: Optional[str] = None) -> float:
     peak is half. Keying the denominator on the program's dtype stops
     f32-dominant chains from grading themselves against a peak they cannot
     reach (5.6% of bf16-peak is 11.2% of the f32 peak the chain actually
-    runs against — the headroom claim changes materially)."""
+    runs against — the headroom claim changes materially). ``"int8"`` uses
+    the chip's published int8 OPS figure (``int8_flops`` in
+    :data:`CHIP_PEAKS`) where one exists — the HONEST denominator for an
+    int8-accumulating program, typically 2x the bf16 peak — falling back to
+    the bf16 figure on generations without int8 acceleration (and on pure
+    config-override peaks, which carry no int8 axis)."""
     f = float(peaks["flops"])
-    return f if str(dtype or "bf16") == "bf16" else f / 2.0
+    d = str(dtype or "bf16")
+    if d == "bf16":
+        return f
+    if d == "int8":
+        return float(peaks.get("int8_flops", f))
+    return f / 2.0
 
 
 def dominant_dtype(stages) -> str:
-    """``"bf16"`` when any stage of the (possibly lowered) chain accumulates
-    in bf16, or the process-wide MXU FFT precision policy is bf16; else
-    ``"f32"`` — the per-program key for :func:`dtype_peak_flops`."""
+    """The per-program key for :func:`dtype_peak_flops`: ``"int8"`` when any
+    stage of the (possibly lowered) chain accumulates through an int8 MXU
+    pass (the deepest ladder rung dominates — its peak is the one the
+    program's hot matmuls run against), else ``"bf16"`` when any stage
+    accumulates in bf16 or the process-wide MXU FFT precision policy is
+    bf16, else ``"f32"``."""
+    bf16 = False
     try:
         from ..ops import mxu_fft
         if mxu_fft._precision == "bf16":
-            return "bf16"
+            bf16 = True
     except Exception:                                   # noqa: BLE001
         pass
     for s in stages:
-        if getattr(s, "compute_dtype", "f32") == "bf16":
-            return "bf16"
-    return "f32"
+        cd = getattr(s, "compute_dtype", "f32")
+        if cd == "int8":
+            return "int8"
+        if cd == "bf16":
+            bf16 = True
+    return "bf16" if bf16 else "f32"
 
 
 def detect_peaks(backend: Optional[str] = None,

@@ -49,13 +49,20 @@ N_TAPS = 64
 def _chains():
     from futuresdr_tpu.dsp import firdes
     from futuresdr_tpu.ops.stages import (Pipeline, channelizer_stage,
-                                          fft_stage, fir_stage, mag2_stage)
+                                          fft_stage, fir_fft_stage,
+                                          fir_stage, mag2_stage)
     taps = firdes.lowpass(0.2, N_TAPS).astype(np.float32)
     dtaps = firdes.lowpass(0.04, 128).astype(np.float32)
     return {
         "resident": lambda: Pipeline(
             [fir_stage(taps), fft_stage(FFT_SIZE), mag2_stage()],
             np.complex64),
+        # the SAME chain with the filter and transform fused in one Pallas
+        # kernel (no HBM round-trip between them) — the fused-vs-composed
+        # A/B row; optimize=False keeps the factory's stage split intact
+        "fir_fft_fused": lambda: Pipeline(
+            [fir_fft_stage(taps, FFT_SIZE), mag2_stage()],
+            np.complex64, optimize=False),
         "pfb_matmul": lambda: Pipeline(
             [channelizer_stage(64, impl="matmul")], np.complex64),
         "pfb_pallas": lambda: Pipeline(
@@ -136,10 +143,30 @@ def measure(frame: int = 1 << 18, rates: bool = True) -> dict:
     # where the trace-time policy actually picks them)
     out["pallas_kernels_active"] = sum(
         P.pallas_stage_count(p) for p in (lowered, chains["pfb_pallas"],
-                                          chains["decim_pallas"]))
+                                          chains["decim_pallas"],
+                                          chains["fir_fft_fused"]))
+
+    # the forced-int8 rung on the resident chain (mode="int8": FIR-family
+    # stages drop to quantized int8 MXU matmuls, edges/FFT stay bf16 — the
+    # ladder's deepest rung, ~36 dB dynamic-absmax SNR)
+    int8_pipe = None
+    try:
+        int8_pipe, plan8 = P.plan_interior_precision(res, mode="int8")
+        out["interior_int8_stages"] = plan8.lowered
+        mn8 = plan8.min_snr_db
+        out["interior_int8_snr_db_min"] = (round(mn8, 1)
+                                           if mn8 is not None else None)
+        if int8_pipe is res or plan8.lowered == 0:
+            int8_pipe = None                    # nothing took the rung
+    except Exception as e:                      # noqa: BLE001
+        out["interior_int8_error"] = repr(e)
+        print(f"# int8 plan failed: {e!r}", file=sys.stderr)
 
     if rates:
-        for key, pipe in (("resident_f32", res), ("resident_lowered", lowered)):
+        rows = [("resident_f32", res), ("resident_lowered", lowered)]
+        if int8_pipe is not None:
+            rows.append(("resident_int8", int8_pipe))
+        for key, pipe in rows:
             try:
                 r = _rate(pipe, frame)
                 out[f"{key}_msps"] = round(r, 1)
@@ -151,7 +178,11 @@ def measure(frame: int = 1 << 18, rates: bool = True) -> dict:
         low = out.get("resident_lowered_msps")
         if f32 and low:
             out["resident_lowered_speedup"] = round(low / f32, 2)
-        for key in ("pfb_matmul", "pfb_pallas", "decim_poly", "decim_pallas"):
+        i8 = out.get("resident_int8_msps")
+        if f32 and i8:
+            out["resident_int8_speedup"] = round(i8 / f32, 2)
+        for key in ("fir_fft_fused", "pfb_matmul", "pfb_pallas",
+                    "decim_poly", "decim_pallas"):
             try:
                 r = _rate(chains[key], min(frame, 1 << 17))
                 out[f"{key}_msps"] = round(r, 1)
@@ -200,6 +231,22 @@ def smoke(frame: int = 1 << 15) -> None:
     print(f"# smoke: resident auto-lowered {plan.lowered} stage(s), "
           f"min edge SNR {plan.min_snr_db}, e2e {snr:.1f} dB",
           file=sys.stderr)
+
+    # forced int8 takes the rung on the FIR and stays inside its honest
+    # quantization floor (dynamic absmax ≈ 36 dB; edges/FFT stay bf16, so
+    # the chain floor is the FIR's)
+    int8_pipe, plan8 = P.plan_interior_precision(res, mode="int8")
+    assert plan8.lowered >= 1, "mode=int8 declined the resident FIR"
+    snr8 = _snr_db(y_ref, _one_frame(int8_pipe, frame))
+    assert snr8 >= 25.0, f"int8 resident chain SNR {snr8:.1f} dB"
+    print(f"# smoke: resident int8 rung on {plan8.lowered} stage(s), "
+          f"e2e {snr8:.1f} dB", file=sys.stderr)
+
+    # the fused FIR→FFT stage matches the composed fir+fft program
+    y_fu = _one_frame(chains["fir_fft_fused"], frame)
+    snr_fu = _snr_db(y_ref, y_fu)
+    assert snr_fu >= 80.0, \
+        f"fused FIR→FFT off the composed chain ({snr_fu:.1f} dB)"
 
     # Pallas kernels match the matmul paths they replace
     y_mm = _one_frame(chains["pfb_matmul"], frame)

@@ -65,6 +65,13 @@ FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
                        # floor — a rate win that costs SNR below reference
                        # flags here, not just in the smoke's absolute gate
                        "resident_lowered_msps", "interior_snr_db_min",
+                       # int8 ladder rung + fused FIR→FFT stage (round-20
+                       # Pallas autotune plane): the forced-int8 resident
+                       # rate with its ladder SNR floor, and the fused
+                       # kernel's rate — a fusion or quantization-path
+                       # regression flags here
+                       "resident_int8_msps", "interior_int8_snr_db_min",
+                       "fir_fft_fused_msps",
                        # mesh-sharded device plane (perf/multichip_ab.py):
                        # the D=8 scaling fraction vs the independent-loop
                        # linear reference, and the sharded streamed rate

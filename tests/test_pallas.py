@@ -41,3 +41,252 @@ def test_pallas_fir_stage_streaming():
     got = np.concatenate(outs)
     ref = sps.lfilter(taps, 1.0, x)
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# round-20: tuned block table, fused FIR→FFT, rotator/demod kernels, ragged
+# tails at swept shapes, and the pallas_blocks autotune axis
+# ---------------------------------------------------------------------------
+
+import jax.numpy as jnp
+
+from futuresdr_tpu.ops.pallas_kernels import (DEFAULT_BLOCKS, pallas_fir_fft,
+                                              pallas_pfb, pallas_poly_fir,
+                                              pallas_quad_demod,
+                                              pallas_rotator,
+                                              set_tuned_blocks, tuned_blocks)
+
+
+@pytest.fixture
+def clean_tuned_blocks():
+    set_tuned_blocks(None)
+    yield
+    set_tuned_blocks(None)
+
+
+def test_tuned_block_table_guarded_parse(clean_tuned_blocks):
+    """set_tuned_blocks mirrors the autotune cache's guarded-parse contract:
+    unknown kernels and non-positive shapes are ignored, coercible strings
+    coerce, and None clears back to the hand-picked defaults."""
+    set_tuned_blocks({"fir": 2048, "bogus": 4, "pfb": -1, "poly_fir": "512"})
+    tb = tuned_blocks()
+    assert tb["fir"] == 2048
+    assert tb["poly_fir"] == 512
+    assert tb["pfb"] == DEFAULT_BLOCKS["pfb"]       # junk ignored
+    assert "bogus" not in tb
+    set_tuned_blocks(None)
+    assert tuned_blocks() == DEFAULT_BLOCKS
+
+
+def test_tuned_blocks_reach_block_none_callers(clean_tuned_blocks):
+    """A kernel called WITHOUT a block (the stage calling convention)
+    resolves against the tuned table — the consumption path kernel init
+    relies on. pallas_fir asserts frame % block == 0, so a 2048 frame only
+    traces when the tuned 2048 (not the default 4096) reached it."""
+    rng = np.random.default_rng(4)
+    taps = rng.standard_normal(16).astype(np.float32)
+    x = rng.standard_normal(2048).astype(np.float32)
+    set_tuned_blocks({"fir": 2048})
+    y = np.asarray(pallas_fir(x, taps))
+    np.testing.assert_allclose(y, sps.lfilter(taps, 1.0, x),
+                               rtol=1e-4, atol=1e-4)
+    set_tuned_blocks(None)
+    with pytest.raises(AssertionError):
+        pallas_fir(x, taps)                         # default 4096 ∤ 2048
+
+
+def test_candidate_grids_cover_defaults():
+    """Every sweep grid contains its kernel's default — the never-regress
+    contract (a sweep can always record the hand-picked shape)."""
+    from futuresdr_tpu.tpu.pallas_tune import CANDIDATE_BLOCKS
+    assert set(CANDIDATE_BLOCKS) == set(DEFAULT_BLOCKS)
+    for k, d in DEFAULT_BLOCKS.items():
+        assert d in CANDIDATE_BLOCKS[k], k
+
+
+@pytest.mark.parametrize("block", [3, 5])
+def test_pallas_fir_fft_matches_composed_ragged(block):
+    """Fused FIR→FFT vs lfilter+FFT at row counts not divisible by the
+    block (the swept shapes are odd; tails must not corrupt)."""
+    rng = np.random.default_rng(block)
+    n_fft, nt, rows = 128, 17, 7                    # 7 % 3, 7 % 5 ≠ 0
+    taps = rng.standard_normal(nt).astype(np.float32)
+    hist = (rng.standard_normal(nt - 1)
+            + 1j * rng.standard_normal(nt - 1)).astype(np.complex64)
+    x = (rng.standard_normal(n_fft * rows)
+         + 1j * rng.standard_normal(n_fft * rows)).astype(np.complex64)
+    got = np.asarray(pallas_fir_fft(jnp.asarray(hist), jnp.asarray(x),
+                                    jnp.asarray(taps), n_fft, block=block))
+    filt = sps.lfilter(taps, 1.0, np.concatenate([hist, x]))[nt - 1:]
+    ref = np.fft.fft(filt.reshape(-1, n_fft), axis=1).reshape(-1)
+    err = float(np.mean(np.abs(got - ref) ** 2))
+    sig = float(np.mean(np.abs(ref) ** 2))
+    assert 10 * np.log10(sig / max(err, 1e-30)) >= 80.0
+
+
+def test_fir_fft_stage_streaming_matches_composed():
+    """The fused stage streamed over carry-chained frames is the composed
+    fir+fft program's output (and routes as one Pallas stage)."""
+    from futuresdr_tpu.ops import precision as P
+    from futuresdr_tpu.ops.stages import fft_stage, fir_fft_stage, fir_stage
+    rng = np.random.default_rng(9)
+    taps = rng.standard_normal(33).astype(np.float32)
+    fused = Pipeline([fir_fft_stage(taps, 256)], np.complex64)
+    composed = Pipeline([fir_stage(taps), fft_stage(256)], np.complex64)
+    assert P.pallas_stage_count(fused) == 1
+    fa, ca = fused.fn(), fused.init_carry()
+    fb, cb = composed.fn(), composed.init_carry()
+    for i in range(3):
+        x = (rng.standard_normal(8192)
+             + 1j * rng.standard_normal(8192)).astype(np.complex64)
+        ca, ya = fa(ca, jnp.asarray(x))
+        cb, yb = fb(cb, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(ya), np.asarray(yb),
+                                   rtol=1e-3, atol=1e-2)
+
+
+@pytest.mark.parametrize("n,block", [(1000, 1), (257, 2)])
+def test_pallas_rotator_matches_reference_ragged(n, block):
+    rng = np.random.default_rng(n)
+    x = (rng.standard_normal(n)
+         + 1j * rng.standard_normal(n)).astype(np.complex64)
+    ph0, inc = 0.3, 0.011
+    got = np.asarray(pallas_rotator(jnp.asarray(x), ph0, inc, block=block))
+    ref = x * np.exp(1j * (ph0 + inc * np.arange(n))).astype(np.complex64)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("n,block", [(1000, 1), (129, 2)])
+def test_pallas_quad_demod_matches_reference_ragged(n, block):
+    rng = np.random.default_rng(n)
+    x = (rng.standard_normal(n)
+         + 1j * rng.standard_normal(n)).astype(np.complex64)
+    prev = np.complex64(0.7 - 0.2j)
+    gain = 0.8
+    got = np.asarray(pallas_quad_demod(jnp.asarray(prev), jnp.asarray(x),
+                                       gain, block=block))
+    ext = np.concatenate([[prev], x])
+    ref = gain * np.angle(ext[1:] * np.conj(ext[:-1]))
+    np.testing.assert_allclose(got, ref.astype(np.float32),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pfb_poly_ragged_at_swept_shapes():
+    """Swept candidates larger than the workload (block > t / block ∤ nq)
+    still produce exact tails — the autotuner may record any grid shape."""
+    rng = np.random.default_rng(11)
+    K, N = 4, 16
+    taps = rng.standard_normal((K, N)).astype(np.float32)
+    rows = (rng.standard_normal((300 + K - 1, N))
+            + 1j * rng.standard_normal((300 + K - 1, N))).astype(np.complex64)
+    t = 300
+    windows = np.stack([rows[(K - 1) - k:(K - 1) - k + t] for k in range(K)],
+                       axis=1)
+    ref = np.fft.ifft(np.einsum("tkc,kc->tc", windows, taps), axis=1) * N
+    got = np.asarray(pallas_pfb(jnp.asarray(rows), jnp.asarray(taps),
+                                block=512))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+    D, m, nq = 8, 7, 777                            # 777 % 512 ≠ 0
+    W = rng.standard_normal((m + 1, D)).astype(np.float32)
+    prows = rng.standard_normal((nq + m, D)).astype(np.float32)
+    ref2 = np.zeros(nq, np.float32)
+    for a in range(m + 1):
+        ref2 += prows[m - a:m - a + nq] @ W[a]
+    got2 = np.asarray(pallas_poly_fir(jnp.asarray(prows), jnp.asarray(W),
+                                      block=512))
+    np.testing.assert_allclose(got2, ref2, rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_blocks_cache_axis():
+    """The guarded pallas_blocks parse + record/cached round-trip + the
+    orthogonal-axes contract (a streamed re-record preserves the axis)."""
+    import importlib
+    at = importlib.import_module("futuresdr_tpu.tpu.autotune")
+    from futuresdr_tpu.ops.stages import fir_stage, mag2_stage
+    # per-axis guarded parse: junk kernels/shapes are stripped; a fully
+    # malformed axis loses ONLY itself, never the entry's valid picks
+    e = at._norm_entry({"k": 2, "inflight": None,
+                        "pallas_blocks": {"v5e": {"fir": 2048, "bogus": 1,
+                                                  "pfb": -2}}})
+    assert e["pallas_blocks"] == {"v5e": {"fir": 2048}}
+    e = at._norm_entry({"k": 2, "inflight": None,
+                        "pallas_blocks": "garbage"})
+    assert e is not None and e["k"] == 2 and "pallas_blocks" not in e
+    taps = np.hanning(17).astype(np.float32)
+    # unique stage name: these records must never collide with a real
+    # ("fir", ...) chain's signature in this process (kernel init consumes
+    # the axis globally)
+    pipe = Pipeline([fir_stage(taps, name="fir_r20ax"), mag2_stage()],
+                    np.complex64)
+    at.record_pallas_blocks(pipe.stages, pipe.in_dtype, "cpu", "v5e",
+                            {"fir": 2048, "bogus": 7, "pfb": -1})
+    got = at.cached_pallas_blocks(pipe.stages, pipe.in_dtype, "cpu", "v5e")
+    assert got == {"fir": 2048}
+    assert at.cached_pallas_blocks(pipe.stages, pipe.in_dtype, "cpu",
+                                   "v5p") is None
+    at.record_streamed_pick(pipe.stages, pipe.in_dtype, "cpu", 4, inflight=2)
+    assert at.cached_pallas_blocks(pipe.stages, pipe.in_dtype, "cpu",
+                                   "v5e") == {"fir": 2048}
+    # a second device kind rides the SAME axis without clobbering the first
+    at.record_pallas_blocks(pipe.stages, pipe.in_dtype, "cpu", "v5p",
+                            {"pfb": 128})
+    assert at.cached_pallas_blocks(pipe.stages, pipe.in_dtype, "cpu",
+                                   "v5e") == {"fir": 2048}
+    assert at.cached_pallas_blocks(pipe.stages, pipe.in_dtype, "cpu",
+                                   "v5p") == {"pfb": 128}
+    # all-junk records are dropped, not stored
+    at.record_pallas_blocks(pipe.stages, pipe.in_dtype, "cpu", "v5e",
+                            {"bogus": 7})
+    assert at.cached_pallas_blocks(pipe.stages, pipe.in_dtype, "cpu",
+                                   "v5e") == {"fir": 2048}
+
+
+def test_autotune_pallas_blocks_cache_hit_skips_sweep(monkeypatch,
+                                                      clean_tuned_blocks):
+    import importlib
+    at = importlib.import_module("futuresdr_tpu.tpu.autotune")
+    from futuresdr_tpu.ops.stages import fir_stage
+    from futuresdr_tpu.tpu import pallas_tune
+    taps = np.hanning(19).astype(np.float32)
+    pipe = Pipeline([fir_stage(taps, name="fir_r20hit")], np.complex64)
+    calls = {"n": 0}
+    real = pallas_tune.sweep_blocks
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(pallas_tune, "sweep_blocks", counting)
+    w1 = at.autotune_pallas_blocks(pipe.stages, pipe.in_dtype,
+                                   kernels=("rotator",), frame=1 << 14,
+                                   reps=1)
+    assert calls["n"] == 1 and "rotator" in w1
+    w2 = at.autotune_pallas_blocks(pipe.stages, pipe.in_dtype,
+                                   kernels=("rotator",), frame=1 << 14,
+                                   reps=1)
+    assert calls["n"] == 1, "cache hit must skip the sweep"
+    assert w2 == w1
+    assert tuned_blocks()["rotator"] == w1["rotator"]
+
+
+def test_kernel_init_installs_cached_blocks(clean_tuned_blocks):
+    """TpuKernel construction consumes the cached sweep: impl="pallas"
+    stages then trace with the measured shapes (block=None resolves
+    against the installed table)."""
+    import importlib
+    at = importlib.import_module("futuresdr_tpu.tpu.autotune")
+    from futuresdr_tpu.ops.stages import fir_stage, mag2_stage
+    from futuresdr_tpu.tpu.kernel_block import TpuKernel
+    from futuresdr_tpu.tpu.pallas_tune import device_key
+    taps = np.hanning(21).astype(np.float32)
+    stages = [fir_stage(taps, name="fir_r20init"), mag2_stage()]
+    pipe = Pipeline(stages, np.complex64)
+    kern = TpuKernel(stages, np.complex64, frame_size=8192)
+    platform = kern.inst.platform
+    at.record_pallas_blocks(pipe.stages, pipe.in_dtype, platform,
+                            device_key(), {"fir": 2048, "poly_fir": 512})
+    kern2 = TpuKernel(stages, np.complex64, frame_size=8192)
+    tb = tuned_blocks()
+    assert tb["fir"] == 2048 and tb["poly_fir"] == 512
+    assert tb["pfb"] == DEFAULT_BLOCKS["pfb"]

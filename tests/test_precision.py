@@ -166,8 +166,9 @@ def test_non_float_edges_decline():
 
 def test_int8_ladder_reaches_declaring_stage():
     """The int8 rung is tried first wherever a stage's ``lower`` hook accepts
-    it — no built-in stage does yet, so the mechanism is pinned here with a
-    declaring stage (scale-by-2 rebuilt at int8 as an exact int op)."""
+    it — the mechanism pinned with a synthetic declaring stage (scale-by-2
+    rebuilt at int8 as an exact int op), independent of the FIR family's
+    real int8 forms (tested below)."""
     def lower(prec):
         if prec not in ("int8", "bf16"):
             return None
@@ -196,6 +197,60 @@ def test_int8_ladder_reaches_declaring_stage():
         P._calib_frames = orig
     d = {e.stage: e for e in plan.edges}
     assert d["dbl"].accum == "int8"
+
+
+def test_int8_mode_forces_fir_rung_and_carry_compat():
+    """mode="int8" walks the FIR family down to the quantized int8 matmul
+    form (edges stay bf16 — forced modes never widen the wire), mode="bf16"
+    must NOT force-accept the deeper rung, and the int8-lowered carries
+    stay treedef/shape-compatible with the f32 chain's (the serve brownout
+    leafwise-conversion contract: int8 stages carry FLOAT weights and
+    quantize in-trace)."""
+    import jax
+    p = Pipeline(_chain() + [mag2_stage()], np.complex64)
+    low, plan = P.plan_interior_precision(p, mode="int8")
+    d = {e.stage: e for e in plan.edges}
+    assert d["fir"].accum == "int8"
+    cd = {s.name: s.compute_dtype for s in low.stages}
+    assert cd["fir"] == "int8"
+    for e in plan.edges:
+        assert e.edge in ("bf16", "f32")        # int8 never hits the wire
+
+    # forced bf16 stays bf16 — the deeper rung needs mode="int8"
+    _lb, plan_b = P.plan_interior_precision(p, mode="bf16")
+    db = {e.stage: e for e in plan_b.edges}
+    assert db["fir"].accum == "bf16"
+
+    # carry compatibility: same treedefs, same leaf shapes (dtype may
+    # narrow — the brownout converts leafwise)
+    a_l, a_def = jax.tree_util.tree_flatten(p.init_carry())
+    b_l, b_def = jax.tree_util.tree_flatten(low.init_carry())
+    assert a_def == b_def
+    assert [np.shape(a) for a in a_l] == [np.shape(b) for b in b_l]
+
+    # numerics: the quantization band, not garbage — and decim paths too
+    x = _frames(4 * 4096, seed=31)
+    ref, _ = _stream(p, x, 4096)
+    got, _ = _stream(low, x, 4096)
+    err = float(np.mean(np.abs(got - ref) ** 2))
+    sig = float(np.mean(np.abs(ref) ** 2))
+    assert 10 * np.log10(sig / max(err, 1e-30)) >= 25.0
+
+    taps = np.hanning(96).astype(np.float32)
+    taps /= taps.sum()
+    pd = Pipeline([fir_stage(taps, decim=8, impl="poly", name="dfir")],
+                  np.complex64)
+    lowd, pland = P.plan_interior_precision(pd, mode="int8")
+    assert {e.stage: e.accum for e in pland.edges}["dfir"] == "int8"
+    refd, _ = _stream(pd, x, 4096)
+    gotd, _ = _stream(lowd, x, 4096)
+    errd = float(np.mean(np.abs(gotd - refd) ** 2))
+    sigd = float(np.mean(np.abs(refd) ** 2))
+    assert 10 * np.log10(sigd / max(errd, 1e-30)) >= 25.0
+
+    # int8 routes never count as Pallas stages (they lower to quantized
+    # XLA matmuls, not hand-written kernels)
+    assert P.pallas_stage_count(lowd) == 0
 
 
 def _noise_stage(name, snr_target_db, phase=0.0):

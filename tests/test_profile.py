@@ -198,6 +198,52 @@ def test_live_gauge_math(monkeypatch):
     assert e2["mfu_avg"] == pytest.approx(want / 2, rel=1e-3)
 
 
+def test_int8_program_grades_against_int8_peak(monkeypatch):
+    """An int8-lowered program's MFU denominator is the int8 peak where the
+    chip tables one (2x the bf16 MXU figure), the bf16 peak where it does
+    not — NEVER the f32 half (the pre-round-20 fallback this pins out)."""
+    from futuresdr_tpu.config import config
+    from futuresdr_tpu.utils.roofline import (CHIP_PEAKS, dominant_dtype,
+                                              dtype_peak_flops)
+    v5e = CHIP_PEAKS["v5e"]
+    assert dtype_peak_flops(v5e, "int8") == v5e["int8_flops"]
+    assert dtype_peak_flops(v5e, "int8") == 2 * dtype_peak_flops(v5e, "bf16")
+    # v2-v4 MXUs have no int8 mode: fall back to the bf16 figure
+    v4 = CHIP_PEAKS["v4"]
+    assert "int8_flops" not in v4
+    assert dtype_peak_flops(v4, "int8") == dtype_peak_flops(v4, "bf16")
+    assert dtype_peak_flops(v4, "int8") == 2 * dtype_peak_flops(v4, "f32")
+
+    # the registration path TpuKernel drives: a mode="int8"-lowered chain's
+    # dominant dtype is "int8", so fsdr_mfu{program} keys the peak above
+    from futuresdr_tpu.ops import precision as P
+    from futuresdr_tpu.ops.stages import (Pipeline, fft_stage, fir_stage,
+                                          mag2_stage)
+    taps = np.hanning(33).astype(np.float32)
+    pipe = Pipeline([fir_stage(taps), fft_stage(256), mag2_stage()],
+                    np.complex64)
+    low, plan = P.plan_interior_precision(pipe, mode="int8")
+    assert plan.lowered >= 1
+    assert dominant_dtype(low.stages) == "int8"
+
+    # gauge math end-to-end: config peaks carry no int8 figure, so an
+    # int8-registered program grades against the FULL bf16 peak
+    monkeypatch.setattr(config(), "peak_flops", 1e12)
+    monkeypatch.setattr(config(), "peak_hbm_gbps", 100.0)
+    pl = profile.ProfilePlane()
+    p = pl.register("t-int8-peak", cost={"flops": 2e9, "bytes": 1e8},
+                    dtype="int8")
+    p.dispatch(4, t=time.monotonic())
+    time.sleep(0.05)
+    p.dispatch(4, t=time.monotonic())
+    rep = pl.roofline_report()
+    e = rep["programs"]["t-int8-peak"]
+    assert e["compute_dtype"] == "int8"
+    dt = p.t_last - p.t_first
+    want = (4 / dt) * 2e9 / 1e12
+    assert e["mfu_avg"] == pytest.approx(want, rel=1e-3)
+
+
 def test_dispatch_hook_bound_before_first_call_advances_window(monkeypatch):
     """A dispatch hook reference captured at init (before any dispatch —
     the hot-path pattern _Program's docstring encourages) must keep
