@@ -733,6 +733,34 @@ def main():
           f"median {stream_rate:.1f} Msps, runs {['%.1f' % r for r in runs]}",
           file=sys.stderr)
 
+    # default-run latency + tail stamps (frame-lineage plane): the always-on
+    # fsdr_e2e_latency_seconds histogram covered the sustained triplet above
+    # — no --doctor flag needed — and the lineage tracer's sampled records
+    # name the slowest pipeline lane. perf/regress.py grades e2e_latency_p99
+    # lower-is-better across the bench trajectory.
+    latency_extra = {}
+    try:
+        from futuresdr_tpu.telemetry import lineage as _lineage_mod
+        from futuresdr_tpu.telemetry.doctor import E2E_LATENCY as _E2E
+        p50, p99 = _E2E.quantile(0.50), _E2E.quantile(0.99)
+        if p50 is not None:
+            latency_extra["e2e_latency_p50"] = round(p50, 6)
+        if p99 is not None:
+            latency_extra["e2e_latency_p99"] = round(p99, 6)
+        tail = _lineage_mod.tail_report()
+        if tail and tail.get("slowest_lane"):
+            latency_extra["tail_slowest_lane"] = tail["slowest_lane"]
+            latency_extra["tail_slowest_lane_frac"] = \
+                tail["slowest_lane_frac"]
+        if latency_extra:
+            print(f"# e2e latency p50/p99 = "
+                  f"{latency_extra.get('e2e_latency_p50')}/"
+                  f"{latency_extra.get('e2e_latency_p99')} s, tail lane "
+                  f"{latency_extra.get('tail_slowest_lane')}",
+                  file=sys.stderr)
+    except Exception as e:                              # noqa: BLE001
+        print(f"# latency stamps unavailable: {e!r}", file=sys.stderr)
+
     # flowgraph-doctor stamp (--doctor): bottleneck attribution over the
     # streamed chain's trace window + e2e latency percentiles from the
     # always-on histogram (telemetry/doctor.py). On guarded backends the
@@ -1274,6 +1302,7 @@ def main():
         **precision_extra,
         **roof,
         **profile_extra,
+        **latency_extra,
         **doctor_extra,
         **extras,
     }

@@ -147,6 +147,88 @@ echo "== chaos smoke (docs/robustness.md invariants) =="
 # sheds only via the documented ladder (serve-overload-shed)
 JAX_PLATFORMS=cpu python perf/chaos.py --smoke
 
+echo "== lineage & journal smoke (docs/observability.md 'Frame lineage') =="
+# 1-in-1 sampled streamed run: the Perfetto export renders a sampled frame
+# as ONE connected s/t/f flow chain spanning >=4 lanes, tail attribution
+# names a slowest pipeline lane consistent with its own per-lane split, and
+# the lifecycle journal drains through the REST cursor contract (pages of 3,
+# no gaps, same seq order as the unlimited read)
+FUTURESDR_TPU_LINEAGE_STRIDE=1 JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import numpy as np
+from futuresdr_tpu import Flowgraph, Runtime
+from futuresdr_tpu.blocks import Head, NullSink, NullSource
+from futuresdr_tpu.config import config
+from futuresdr_tpu.dsp import firdes
+from futuresdr_tpu.ops import fir_stage, mag2_stage
+from futuresdr_tpu.telemetry import journal, lineage, spans
+from futuresdr_tpu.tpu import TpuKernel
+
+assert lineage.tracer().stride == 1, lineage.tracer().stride
+frame = 1 << 14
+n = 24 * frame
+c = config()
+c.buffer_size = max(c.buffer_size, 4 * frame * 8)
+fg = Flowgraph()
+taps = firdes.lowpass(0.2, 64).astype(np.float32)
+tk = TpuKernel([fir_stage(taps), mag2_stage()], np.complex64,
+               frame_size=frame, frames_in_flight=4)
+fg.connect(NullSource(np.complex64), Head(np.complex64, n), tk,
+           NullSink(np.float32))
+Runtime().run(fg)
+
+recs = lineage.tracer().records()
+assert recs, "1-in-1 sampling produced no completed lineage records"
+
+# Perfetto flow chains: at least one record renders as a connected
+# s -> t... -> f chain sharing one id across >=4 lanes
+trace = spans.chrome_trace()
+flows = {}
+for ev in trace["traceEvents"]:
+    if ev.get("cat") == "lineage":
+        flows.setdefault(ev["id"], []).append(ev)
+assert trace["otherData"]["lineage_flows"] == len(flows) > 0, \
+    trace["otherData"]
+chained = 0
+for tid, evs in flows.items():
+    phs = [e["ph"] for e in evs]
+    if len(evs) >= 4 and phs[0] == "s" and phs[-1] == "f" and \
+            all(p == "t" for p in phs[1:-1]) and evs[-1].get("bp") == "e":
+        lanes = [e["args"]["lane"] for e in evs]
+        assert lanes[0] == "ingest" and lanes[-1] == "emit", lanes
+        chained += 1
+assert chained, "no connected s/t/f flow chain spanning >=4 lanes"
+json.dumps(trace)  # the export must stay JSON-serializable
+
+# tail attribution: slowest lane named, consistent with its own split
+tail = lineage.tail_report()
+assert tail and tail["e2e_samples"] > 0, tail
+sl = tail["slowest_lane"]
+assert sl in lineage.PIPELINE_LANES, tail
+pipe = {ln: d["total_s"] for ln, d in tail["lanes"].items()
+        if ln in lineage.PIPELINE_LANES}
+assert sl == max(pipe, key=pipe.get), (sl, pipe)
+
+# journal: the run journaled its kernel init; the cursor contract drains
+# everything in order without gaps
+j = journal.journal()
+full = j.events()["events"]
+assert any(e["cat"] == "kernel" and e["event"] == "init" for e in full)
+drained, cur = [], 0
+while True:
+    page = j.events(since=cur, limit=3)
+    assert not page["gap"], page
+    drained.extend(page["events"])
+    if not page["events"] or page["next"] == cur:
+        break
+    cur = page["next"]
+seqs = [e["seq"] for e in drained]
+assert seqs == [e["seq"] for e in full] == sorted(seqs), \
+    "cursor drain disagrees with the unlimited read"
+print(f"lineage smoke: {len(recs)} records, {chained} flow chain(s), "
+      f"slowest lane {sl}, journal drained {len(seqs)} events: OK")
+EOF
+
 echo "== perf-regression gate (non-fatal; perf/regress.py vs BENCH_r*.json) =="
 # quick reduced bench on the CPU backend, graded against the committed
 # trajectory with a generous tolerance — warnings only, never fails the check

@@ -49,6 +49,19 @@ class Config:
     #   before a trip (trip latency ≈ interval × window)
     doctor_dir: str = ""                   # write flight-recorder dumps here
     #   ("" = keep in memory only; served via GET /api/fg/{fg}/doctor/)
+    # Frame-lineage tracing plane (telemetry/lineage.py) and the lifecycle
+    # event journal (telemetry/journal.py) — docs/observability.md "Frame
+    # lineage & flow traces" / "The event journal".
+    lineage_stride: int = 64               # sample 1-in-N frames for lineage
+    #   records (trace id + per-lane stamps): 0 disables (one falsy check
+    #   per frame), 1 samples every frame (tests/smokes). Sampled records
+    #   feed Perfetto flow links, doctor tail attribution, and OpenMetrics
+    #   exemplars on fsdr_e2e_latency_seconds
+    lineage_ring: int = 512                # completed lineage records kept
+    journal_ring: int = 1024               # lifecycle events kept in the
+    #   process-global journal ring (REST cursor: GET /api/events/)
+    journal_dir: str = ""                  # spool every journal event as one
+    #   JSONL line under this directory (atomic append; "" = ring only)
     # Profile plane (telemetry/profile.py, docs/observability.md "The
     # profile plane"): MFU/HBM-utilization denominators. 0 = autodetect the
     # chip from jax.devices()[0].device_kind (utils/roofline.detect_peaks);

@@ -130,6 +130,8 @@ class ControlPort:
         app.router.add_get("/api/fg/{fg}/trace/", self._trace)
         app.router.add_get("/api/fg/{fg}/doctor/", self._doctor)
         app.router.add_get("/api/fg/{fg}/profile/", self._profile)
+        app.router.add_get("/api/fg/{fg}/lineage/", self._lineage)
+        app.router.add_get("/api/events/", self._events)
         app.router.add_get("/api/fg/{fg}/block/{blk}/", self._describe_block)
         app.router.add_get("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
         app.router.add_post("/api/fg/{fg}/block/{blk}/call/{handler}/", self._call)
@@ -222,6 +224,18 @@ class ControlPort:
                 fg_metrics[fg_id] = await fg.metrics()
             except Exception as e:               # noqa: BLE001 — scrape must
                 log.warning("metrics scrape of fg %d failed: %r", fg_id, e)
+        if request.query.get("openmetrics"):
+            # OpenMetrics exposition: exemplars on histogram buckets (the
+            # lineage trace ids behind fsdr_e2e_latency_seconds) + # EOF;
+            # per-block families keep the shared v0.0.4-compatible text
+            from ..telemetry import prom as _p
+            body = _p.registry().render_openmetrics()
+            if fg_metrics:
+                body = body[:-len("# EOF\n")] \
+                    + prom.render_block_metrics(fg_metrics) + "# EOF\n"
+            return web.Response(body=body.encode(),
+                                headers={"Content-Type":
+                                         prom.CONTENT_TYPE_OPENMETRICS})
         return web.Response(body=prom.render_all(fg_metrics).encode(),
                             headers={"Content-Type": prom.CONTENT_TYPE})
 
@@ -302,6 +316,54 @@ class ControlPort:
             snap = profile.plane().snapshot()
         return web.json_response(
             snap, dumps=lambda o: _json.dumps(o, default=str))
+
+    async def _lineage(self, request):
+        """Sampled frame-lineage view (telemetry/lineage.py): the tail
+        attribution report plus the most recent completed records
+        (``?n=<count>``, default 32, stamps with lane/thread detail). The
+        read is non-destructive — the tracer's done ring keeps feeding the
+        doctor and the Perfetto flow export. 404s for unknown flowgraphs to
+        match the ``/api/fg/`` family (the tracer is process-global, like
+        the trace ring)."""
+        from aiohttp import web
+
+        from ..telemetry import lineage
+        fg = self._fg(request)
+        if fg is None:
+            return web.json_response({"error": "flowgraph not found"},
+                                     status=404)
+        try:
+            n = max(0, int(request.query.get("n", 32)))
+        except ValueError:
+            return web.json_response({"error": "bad n"}, status=400)
+        tr = lineage.tracer()
+        return web.json_response({
+            "stride": tr.stride,
+            "dropped": tr.dropped,
+            "tail": lineage.tail_report(),
+            "records": tr.records_dicts(n or None),
+        })
+
+    async def _events(self, request):
+        """Journal cursor read (telemetry/journal.py): ``?since=<seq>`` (0 =
+        from the oldest retained), ``?cat=<category>`` filter, ``?limit=``
+        page size. The response carries ``next`` (pass back as the next
+        ``since``), ``seq`` (the newest seq emitted so far) and ``gap``
+        (true when the ring already evicted events past the cursor — the
+        JSONL spool, ``journal_dir``, has the full history). Process-global
+        like /metrics, so it is NOT fg-scoped."""
+        from aiohttp import web
+
+        from ..telemetry import journal
+        q = request.query
+        try:
+            since = int(q.get("since", 0))
+            limit = int(q["limit"]) if "limit" in q else None
+        except ValueError:
+            return web.json_response({"error": "bad since/limit"}, status=400)
+        cat = q.get("cat") or None
+        return web.json_response(
+            journal.journal().events(since=since, cat=cat, limit=limit))
 
     async def _describe_block(self, request):
         from aiohttp import web

@@ -109,6 +109,7 @@ from fractions import Fraction
 from typing import List, Sequence
 
 from ..log import logger
+from ..telemetry import journal as _tel_journal
 from ..telemetry.spans import recorder as _trace_recorder
 from .inbox import (Call, Callback, Initialize, StreamInputDone,
                     StreamOutputDone, Terminate)
@@ -1393,6 +1394,10 @@ async def run_devchain_task(members: Sequence, chain: DevChain, fg_inbox,
             while pol_member is not None and \
                     pol_member.restarts < pol_member.policy.max_restarts:
                 await pol_member._note_restart(err, fg_inbox, phase="work")
+                _tel_journal.emit(
+                    "devchain", "restart",
+                    region=kernel.meta.instance_name,
+                    attempt=pol_member.restarts, error=repr(err))
                 try:
                     if await kernel.recover(err):
                         log.info("devchain %s recovered in place from its "

@@ -57,8 +57,11 @@ import numpy as np
 from ..log import logger
 from ..ops import xfer
 from ..runtime import faults as _faults
+from ..telemetry import journal as _journal
+from ..telemetry import lineage as _lineage
 from ..telemetry import profile as _profile
 from ..telemetry import prom as _prom
+from ..telemetry.doctor import E2E_LATENCY as _E2E_LATENCY
 from ..telemetry.spans import recorder as _trace_recorder
 from .credits import TenantCreditController
 from .overload import ShedLadder
@@ -220,6 +223,11 @@ class ServeEngine:
         self.pipeline = pipeline
         self._base_pipeline = pipeline     # pre-brownout program identity
         self.app = str(app)
+        # per-lane e2e latency for the serving plane: the SAME
+        # fsdr_e2e_latency_seconds family the streamed sinks observe, one
+        # source child per app — so the doctor's e2e quantiles and the
+        # lineage exemplars cover serving and streaming uniformly
+        self._e2e_hist = _E2E_LATENCY.labels(source=f"serve:{self.app}")
         self.inst = inst or instance()
         self.k_batch = max(1, int(frames_per_dispatch))
         m = pipeline.frame_multiple
@@ -485,10 +493,14 @@ class ServeEngine:
         ``fsdr_serve_shed_total{reason}``."""
         if self._draining:
             _SHED.inc(app=self.app, tenant=tenant, reason="drain")
+            _journal.emit("serve", "refuse", app=self.app, tenant=tenant,
+                          reason="drain")
             raise ServeDraining(
                 f"{self.app}: draining — admission refused")
         if self._ladder.level >= 1:
             _SHED.inc(app=self.app, tenant=tenant, reason="admission")
+            _journal.emit("serve", "refuse", app=self.app, tenant=tenant,
+                          reason="overload", rung=self._ladder.rung)
             raise ServeOverload(
                 f"{self.app}: overloaded (shed rung "
                 f"{self._ladder.rung}) — admission refused")
@@ -509,6 +521,8 @@ class ServeEngine:
             slot = self.table.admit(s)
             self._set_lane(slot, self._fresh_carry())
             self.credits.register(s.tenant)
+            _journal.emit("serve", "admit", app=self.app, session=s.sid,
+                          tenant=s.tenant, slot=slot)
             self._refresh_gauges()
             return s
 
@@ -535,6 +549,8 @@ class ServeEngine:
             s.carry_leaves = None
             s.carry_treedef = None
             s.stall_steps = 0
+            _journal.emit("serve", "readmit", app=self.app, session=s.sid,
+                          tenant=s.tenant, slot=slot)
             self._refresh_gauges()
             return s
 
@@ -559,6 +575,8 @@ class ServeEngine:
                 # between evict and readmit loses nothing
                 self._persist_session(s)
             _EVICTIONS.inc(app=self.app, tenant=s.tenant)
+            _journal.emit("serve", "evict", app=self.app, session=s.sid,
+                          tenant=s.tenant, stall_steps=s.stall_steps)
             self._refresh_gauges()
             return s
 
@@ -577,6 +595,8 @@ class ServeEngine:
                 self._store.purge(s.sid)
             if not self._tenant_live(s.tenant):
                 self.credits.unregister(s.tenant)
+            _journal.emit("serve", "close", app=self.app, session=s.sid,
+                          tenant=s.tenant)
             self._refresh_gauges()
 
     def _tenant_live(self, tenant: str) -> bool:
@@ -607,6 +627,8 @@ class ServeEngine:
             if old is not None and old.state == "retired":
                 self.table.forget(old)
         _RETIRED.inc(app=self.app, tenant=s.tenant)
+        _journal.emit("serve", "retire", app=self.app, session=s.sid,
+                      tenant=s.tenant, error=repr(err))
         log.warning("%s: session %s (tenant %s) retired by %r — siblings "
                     "unaffected", self.app, s.sid, s.tenant, err)
         self._refresh_gauges()
@@ -673,6 +695,7 @@ class ServeEngine:
             # the batch/mask arrays allocate lazily on the first busy lane
             batch = None
             active = None
+            step_tids: List[int] = []     # lineage-sampled frames this step
             for s in self.table.occupants():
                 if not s.pending:
                     s.stall_steps += 1
@@ -693,9 +716,10 @@ class ServeEngine:
                         self._retire(s, e)
                         continue
                 popped = []
+                tids = []
                 for j in range(min(K, len(s.pending))):
                     entry = s.pending.popleft()
-                    frame, _ = entry
+                    frame, t_sub = entry
                     self.credits.release(s.tenant)
                     if K == 1:
                         batch[s.slot] = frame
@@ -704,8 +728,16 @@ class ServeEngine:
                         batch[s.slot, j] = frame
                         active[s.slot, j] = True
                     popped.append(entry)
+                    # frame lineage (telemetry/lineage.py): 1-in-stride
+                    # sampled frames get a trace id here; unsampled frames
+                    # carry tid 0 and every stamp site below skips them
+                    tid = _lineage.tracer().sample()
+                    if tid:
+                        _lineage.tracer().stamp(tid, "ingest", t_sub)
+                        step_tids.append(tid)
+                    tids.append(tid)
                 s.stall_steps = 0
-                lanes.append((s, popped))
+                lanes.append((s, popped, tids))
             self.steps += 1
             if not lanes:
                 if self._ladder.level:
@@ -722,6 +754,10 @@ class ServeEngine:
                 _trace.complete("tpu", "encode", t_enc,
                                 args={"sessions": len(lanes),
                                       "capacity": C})
+            if step_tids:
+                lin = _lineage.tracer()
+                for tid in step_tids:
+                    lin.stamp(tid, "encode")
             try:
                 prog = self._program(C, K)
                 t0 = _trace.now() if _trace.enabled else 0
@@ -730,6 +766,9 @@ class ServeEngine:
                 if t0:
                     _trace.complete("tpu", "H2D", t0,
                                     args={"bytes": batch.nbytes})
+                if step_tids:
+                    for tid in step_tids:
+                        lin.stamp(tid, "H2D")
                 t0 = _trace.now() if _trace.enabled else 0
                 if (C, K, self._pipe_tag) in self._warmed:
                     new_carries, outs = prog(self._carries, x, act)
@@ -750,11 +789,17 @@ class ServeEngine:
                     _trace.complete("tpu", "compute", t0,
                                     args={"capacity": C,
                                           "active_lanes": len(lanes)})
+                if step_tids:
+                    for tid in step_tids:
+                        lin.stamp(tid, "dispatch")
                 t0 = _trace.now() if _trace.enabled else 0
                 host = [xfer.to_host(o) for o in outs]  # one D2H per sink
                 if t0:
                     _trace.complete("tpu", "D2H", t0,
                                     args={"sinks": len(host)})
+                if step_tids:
+                    for tid in step_tids:
+                        lin.stamp(tid, "D2H")
             except Exception:
                 # dispatch-failure rollback: a real transfer/compile/dispatch
                 # error must not silently drop the popped frames for every
@@ -762,7 +807,7 @@ class ServeEngine:
                 # queues (original order), re-take their credits and leave
                 # the carries untouched so the caller's retry re-dispatches
                 # the exact same frames
-                for s, popped in lanes:
+                for s, popped, _tids in lanes:
                     s.pending.extendleft(reversed(popped))
                     self.credits.reacquire(s.tenant, len(popped))
                 raise
@@ -771,7 +816,7 @@ class ServeEngine:
             end = time.perf_counter_ns()
             t_dec = _trace.now() if _trace.enabled else 0
             dispatched = 0
-            for s, popped in lanes:
+            for s, popped, tids in lanes:
                 for j, (_, t_sub) in enumerate(popped):
                     if K == 1:
                         rows = [h[s.slot] for h in host]
@@ -785,6 +830,17 @@ class ServeEngine:
                     s.last_latency_s = lat
                     self._lat_recent.append(lat)
                     _LATENCY.observe(lat, app=self.app, tenant=s.tenant)
+                    # satellite of PR-4's stamp audit: each serving lane
+                    # observes its OWN frame's submit->fan-back latency on
+                    # the shared e2e family (the streamed sinks' histogram)
+                    self._e2e_hist.observe(lat)
+                    tid = tids[j]
+                    if tid:
+                        lin = _lineage.tracer()
+                        lin.stamp(tid, "emit", end)
+                        lin.finish(tid, source=f"serve:{self.app}",
+                                   session=s.sid, tenant=s.tenant)
+                        self._e2e_hist.exemplar(lat, tid)
                     _FRAMES.inc(app=self.app, tenant=s.tenant)
                     dispatched += 1
             self.frames += dispatched
@@ -929,6 +985,8 @@ class ServeEngine:
                 _RESUMED.inc(app=self.app, tenant=s.tenant)
             self._refresh_gauges()
         if self.restored_sessions:
+            _journal.emit("serve", "restore", app=self.app,
+                          sessions=self.restored_sessions, skipped=skipped)
             log.info("%s: re-admitted %d persisted session(s) after a "
                      "process restart (%d skipped)", self.app,
                      self.restored_sessions, skipped)
@@ -982,6 +1040,8 @@ class ServeEngine:
         report drained. Idempotent — a second call re-reports."""
         with self._lock:
             self._draining = True
+        _journal.emit("serve", "drain", app=self.app,
+                      timeout_s=float(timeout), persist=bool(persist))
         pumped = 0
         deadline = (time.monotonic() + float(timeout)) if timeout else None
         if pump:
@@ -1021,6 +1081,9 @@ class ServeEngine:
                 "sessions_persisted": persisted,
                 "sessions": len(self.table.sessions),
             }
+        _journal.emit("serve", "drained", app=self.app,
+                      frames_drained=pumped, sessions_persisted=persisted,
+                      pending_frames=report["pending_frames"])
         log.info("%s: drained — %d frame(s) finished, %d session(s) "
                  "persisted, %d frame(s) left queued", self.app, pumped,
                  persisted, report["pending_frames"])
@@ -1133,6 +1196,13 @@ class ServeEngine:
         if lvl == prev:
             return
         _SHED_LEVEL.set(float(lvl), app=self.app)
+        # the shed-rung TRANSITION is the journal event (the gauge holds the
+        # current level; the journal tells the story in seq order)
+        _journal.emit("serve", "shed-rung", app=self.app,
+                      level=lvl, prev=prev, rung=self._ladder.rung,
+                      pressure=round(self.credits.pressure(), 4),
+                      p99_ms=round(p99_ms, 3) if p99_ms is not None
+                      else None)
         if lvl > prev:
             log.warning("%s: overload ladder escalated to rung %d (%s) — "
                         "pressure %.2f, p99 %s ms (SLO %s)", self.app, lvl,
@@ -1187,6 +1257,8 @@ class ServeEngine:
             if not self._apply_precision_brownout(on):
                 return
         self._brownout_active = on
+        _journal.emit("serve", "brownout", app=self.app,
+                      engaged=bool(on), lever=self._brownout)
         if on:
             _SHED.inc(app=self.app, tenant="-", reason="brownout")
         log.warning("%s: brownout lever (%s) %s", self.app, self._brownout,

@@ -35,6 +35,8 @@ import numpy as np
 
 from ..log import logger
 from ..runtime import faults as _faults
+from ..telemetry import journal as _journal
+from ..telemetry import lineage as _lineage
 from ..telemetry import profile as _profile
 from ..telemetry.spans import recorder as _trace_recorder
 from .plan import AXIS, ShardPlan, note_plan, plan_shard
@@ -357,14 +359,22 @@ class ShardRunner:
             (rows.shape, (D, K, self.frame_size))
         return np.ascontiguousarray(rows)
 
-    def _dispatch(self, rows: np.ndarray, seq: int, replay: bool):
+    def _dispatch(self, rows: np.ndarray, seq: int, replay: bool,
+                  tid: int = 0):
         t0 = _trace.now() if _trace.enabled else 0
+        lin = _lineage.tracer() if tid else None
         if self.k == 1:
             x = self.prog.place(rows[:, 0, :])
         else:
             x = self.prog.place(rows)
+        if lin is not None:
+            lin.stamp(tid, "H2D")
         self._carries, y = self._fn(self._carries, x)
+        if lin is not None:
+            lin.stamp(tid, "dispatch")
         out = np.asarray(y)                 # the SINK D2H (gathers shards)
+        if lin is not None:
+            lin.stamp(tid, "D2H")
         now = time.monotonic()
         self.dispatches += 1
         self._prof.dispatch(self.prog.n_devices * self.k, t=now)
@@ -392,6 +402,8 @@ class ShardRunner:
         fins, treedef = self.prog.snapshot_carry(self._carries)
         leaves = [np.asarray(f()) for f in fins]
         self._ckpts.append((self.seq, leaves, treedef))
+        _journal.emit("shard", "checkpoint-commit", runner=self.name,
+                      seq=int(self.seq))
         # prune to the PREVIOUS snapshot, not the one just committed: while
         # only ONE candidate exists, a corrupt candidate must still leave a
         # fresh-init + full-replay path, so the whole window stays logged
@@ -409,16 +421,25 @@ class ShardRunner:
         with self._lock:
             rows = self._norm_rows(rows)
             _faults.maybe("dispatch", self.name)
+            # frame lineage: one sampled trace per GROUP (the runner's unit
+            # of dispatch) — replayed groups re-dispatch with tid 0
+            tid = _lineage.tracer().sample()
+            if tid:
+                _lineage.tracer().stamp(tid, "ingest")
             seq = self.seq + 1
             if self.checkpoint_every:
                 # cadence 0 = recovery off AND FREE: no snapshots means
                 # nothing ever prunes the logs, so nothing may enter them
                 for d in range(self.prog.n_devices):
                     self._rlog[d].append((seq, rows[d].copy()))
-            out = self._dispatch(rows, seq, replay=False)
+            out = self._dispatch(rows, seq, replay=False, tid=tid)
             self.seq = seq
             if self.checkpoint_every and seq % self.checkpoint_every == 0:
                 self._checkpoint()
+            if tid:
+                lin = _lineage.tracer()
+                lin.stamp(tid, "emit")
+                lin.finish(tid, source=f"shard:{self.name}")
             self._note()
             return out
 
@@ -457,6 +478,14 @@ class ShardRunner:
                 replayed += 1
             self.replayed += replayed
             self.seq = max(self.seq, restore_seq + replayed)
+            _journal.emit("shard", "recover", runner=self.name,
+                          checkpoint_seq=int(restore_seq),
+                          replayed=int(replayed),
+                          fresh_init=restored is None)
+            if replayed:
+                _journal.emit("shard", "replay", runner=self.name,
+                              groups=int(replayed),
+                              high_seq=int(self.seq))
             log.info("%s: recovered at seq=%d, replayed %d group(s)",
                      self.name, restore_seq, replayed)
             self._note()

@@ -16,6 +16,12 @@ here they are first-class), plus the doctor that diagnoses from both:
 * :mod:`.doctor` — latency histograms (e2e / work() / link), the stall
   watchdog with structured stall diagnosis, black-box flight-recorder dumps,
   and bottleneck attribution over drained spans.
+* :mod:`.lineage` — sampled per-frame flow records (trace id + per-lane
+  monotonic stamps); Perfetto flow links, per-session tail attribution and
+  OpenMetrics exemplars all read from it.
+* :mod:`.journal` — a bounded process-global ring of structured lifecycle
+  events (admit/evict/shed/restart/recover/checkpoint/retune/compile/…)
+  with a monotonic REST cursor (``GET /api/events/``).
 
 See ``docs/observability.md`` for the span categories, metric names, endpoints
 and the overhead budget.
@@ -26,11 +32,13 @@ from .prom import (Counter, Gauge, Histogram, Registry, counter, gauge,
                    histogram, registry)
 from .spans import (SpanEvent, SpanRecorder, chrome_trace, drain, enable,
                     enabled, export, overlap_report, recorder, union_ns)
+from . import lineage  # noqa: E402 — after spans: flow links share its clock
+from . import journal  # noqa: E402 — config-only dependency
 from . import profile  # noqa: E402 — after prom/spans: the profile plane
-from . import doctor  # noqa: E402 — after profile: doctor reads all three
+from . import doctor  # noqa: E402 — after profile: doctor reads all four
 
 __all__ = [
-    "spans", "prom", "hist", "doctor", "profile",
+    "spans", "prom", "hist", "doctor", "profile", "lineage", "journal",
     "SpanRecorder", "SpanEvent", "recorder", "enable", "enabled", "drain",
     "chrome_trace", "export", "overlap_report", "union_ns",
     "Registry", "Counter", "Gauge", "Histogram", "registry", "counter",

@@ -55,6 +55,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..log import logger
 from . import prom, spans
+from . import journal as _journal
+from . import lineage as _lineage
 from . import profile as _profile
 
 __all__ = [
@@ -713,6 +715,13 @@ class Doctor:
             # diagnosis — "which app/bucket/session is stuck" answers from
             # the same dump as the flowgraph story
             "serve": serve or None,
+            # lifecycle decision history (telemetry/journal.py): the last-N
+            # structured events ride every flight record, so the black box
+            # carries WHAT the runtime decided next to what it was doing
+            "journal": _journal.journal().last(32) or None,
+            # sampled per-frame tail attribution (telemetry/lineage.py):
+            # which lane/session the slow frames spent their time in
+            "tail": _lineage.tail_report(),
             "metrics": prom.registry().render(),
         }
         if extra is not None:
@@ -897,6 +906,11 @@ class Doctor:
             # mesh-sharded device plane (futuresdr_tpu/shard): published
             # shard plans + live runner stats, and the per-shard lanes above
             "shard": _shard_section(shard_lanes) or None,
+            # sampled-frame tail attribution (telemetry/lineage.py): per-lane
+            # contribution to sampled e2e, slowest lane (commensurable with
+            # the interval-union bottleneck_lane above — same stamp
+            # boundaries as the cat="tpu" spans), slowest session/tenant
+            "tail": _lineage.tail_report(),
             "roofline": roofline,
             "compile_storms": prof.storm_report() or None,
             # interior-precision plans (ops/precision.py): per program, the

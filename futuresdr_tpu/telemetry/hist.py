@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 __all__ = ["Log2Hist", "log2_bounds", "DEFAULT_LO_EXP", "DEFAULT_HI_EXP"]
@@ -37,6 +38,7 @@ DEFAULT_LO_EXP = -20
 DEFAULT_HI_EXP = 7
 
 _frexp = math.frexp
+_time = time.time
 
 
 def log2_bounds(lo_exp: int = DEFAULT_LO_EXP,
@@ -51,7 +53,8 @@ class Log2Hist:
     """One fixed-bucket log2 histogram (one label child of a prom Histogram)."""
 
     __slots__ = ("lo_exp", "hi_exp", "bounds", "_lo", "_n", "_counts", "_sum",
-                 "_count", "_lock", "_stride_tick", "_stride_mask")
+                 "_count", "_lock", "_stride_tick", "_stride_mask",
+                 "_exemplars")
 
     #: stride of :meth:`observe_sampled` (must stay a power of two)
     SAMPLE_STRIDE = 8
@@ -72,6 +75,10 @@ class Log2Hist:
         # observe_sampled hot path: one attribute load instead of a class
         # attribute lookup + subtraction per call
         self._stride_mask = self.SAMPLE_STRIDE - 1
+        # bucket index -> (value, trace_id, wall_ts): latest lineage-sampled
+        # observation per bucket, for OpenMetrics exemplar exposition; lazy —
+        # only histograms fed by the lineage tracer ever allocate it
+        self._exemplars: Optional[dict] = None
 
     def _index(self, v: float) -> int:
         # v in (2^(e-1), 2^e] belongs to the bucket bounded above by 2^e;
@@ -118,6 +125,30 @@ class Log2Hist:
         if t & self._stride_mask:
             return
         self.observe(v)
+
+    def exemplar(self, v: float, trace_id: str) -> None:
+        """Attach a lineage exemplar to ``v``'s bucket (keeps the latest).
+
+        Called only on lineage-sampled frames (default 1-in-64), so it can
+        afford the lock and a ``time.time()`` call — the hot ``observe`` path
+        stays untouched. Does NOT bump counts: the caller observes the value
+        through the normal path; this just remembers which trace id landed in
+        the bucket most recently (the OpenMetrics exemplar contract).
+        """
+        if not (v >= 0.0) or not trace_id:
+            return
+        i = self._index(v)
+        wall = _time()
+        with self._lock:
+            if self._exemplars is None:
+                self._exemplars = {}
+            self._exemplars[i] = (v, trace_id, wall)
+
+    def exemplars(self) -> dict:
+        """``{bucket_index: (value, trace_id, wall_ts)}`` snapshot (may be
+        empty); bucket_index matches :meth:`snapshot` count positions."""
+        with self._lock:
+            return dict(self._exemplars) if self._exemplars else {}
 
     # -- reads -----------------------------------------------------------------
     def snapshot(self) -> Tuple[List[int], float, int]:

@@ -258,6 +258,13 @@ class ProfilePlane:
                                  str(signature), float(seconds)))
             self.compiles_total += 1
             self.compile_seconds_total += float(seconds)
+        # every compile the runtime bills funnels through here — the one
+        # journal emit covers kernel warmups, recoveries, serve buckets and
+        # autotune sweeps alike (telemetry/journal.py)
+        from . import journal as _journal
+        _journal.emit("compile", "compile", program=program, reason=reason,
+                      signature=str(signature), seconds=round(float(seconds),
+                                                              6))
 
     def active_compiles(self) -> List[dict]:
         with self._lock:

@@ -26,9 +26,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "registry", "counter",
            "gauge", "histogram", "render_block_metrics", "render_all",
-           "CONTENT_TYPE"]
+           "CONTENT_TYPE", "CONTENT_TYPE_OPENMETRICS"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+CONTENT_TYPE_OPENMETRICS = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
@@ -54,6 +56,12 @@ def _fmt_value(v: float) -> str:
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
     return repr(v)
+
+
+def _exemplar_suffix(ex: Tuple[float, str, float]) -> str:
+    """OpenMetrics exemplar tail: ``# {trace_id="…"} value wall_ts``."""
+    v, tid, ts = ex
+    return f' # {{trace_id="{_escape_label(tid)}"}} {_fmt_value(v)} {ts:.3f}'
 
 
 def _sample_line(name: str, labels: Dict[str, object], value: float) -> str:
@@ -106,6 +114,10 @@ class _Metric:
         for labels, v in samples:
             lines.append(_sample_line(self.name, labels, v))
         return lines
+
+    def render_openmetrics(self) -> List[str]:
+        # counters/gauges carry no exemplars; same text either way
+        return self.render()
 
 
 class _BoundCounter:
@@ -247,6 +259,46 @@ class Histogram(_Metric):
             lines.append(_sample_line(f"{self.name}_count", base, total))
         return lines
 
+    def render_openmetrics(self) -> List[str]:
+        """Like :meth:`render`, plus OpenMetrics exemplars on bucket lines.
+
+        An exemplar recorded by :meth:`~.hist.Log2Hist.exemplar` (the lineage
+        tracer feeds ``fsdr_e2e_latency_seconds`` this way) is appended to the
+        cumulative ``_bucket`` line of the bucket its value fell in:
+        ``… 5 # {trace_id="f-1a2b"} 0.0043 1754550000.123``. The default
+        v0.0.4 :meth:`render` stays byte-identical — Prometheus only parses
+        exemplars under the OpenMetrics content type.
+        """
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        with self._lock:
+            items = list(self._hists.items())
+        items.sort(key=lambda kv: tuple(str(v) for v in kv[0]))
+        for k, h in items:
+            base = dict(zip(self.labelnames, k))
+            counts, total_sum, total = h.snapshot()
+            exs = h.exemplars()
+            cum = 0
+            for i, (bound, c) in enumerate(zip(h.bounds, counts)):
+                cum += c
+                line = _sample_line(f"{self.name}_bucket",
+                                    {**base, "le": _fmt_value(bound)}, cum)
+                ex = exs.get(i)
+                if ex is not None:
+                    line += _exemplar_suffix(ex)
+                lines.append(line)
+            inf_line = _sample_line(f"{self.name}_bucket",
+                                    {**base, "le": "+Inf"}, total)
+            ex = exs.get(len(h.bounds))      # overflow-bucket exemplar
+            if ex is not None:
+                inf_line += _exemplar_suffix(ex)
+            lines.append(inf_line)
+            lines.append(_sample_line(f"{self.name}_sum", base, total_sum))
+            lines.append(_sample_line(f"{self.name}_count", base, total))
+        return lines
+
 
 class Registry:
     def __init__(self):
@@ -285,6 +337,18 @@ class Registry:
         for m in metrics:
             lines.extend(m.render())
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics 1.0 exposition (histogram exemplars included, ``# EOF``
+        terminator) — served when a scraper asks for
+        :data:`CONTENT_TYPE_OPENMETRICS` via ``GET /metrics?openmetrics=1``."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render_openmetrics())
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
 
 
 _registry = Registry()

@@ -242,7 +242,18 @@ class SpanRecorder:
         return out
 
     def chrome_trace(self, events: Optional[Sequence[SpanEvent]] = None) -> dict:
-        """Drain (unless given pre-drained events) into a Chrome trace dict."""
+        """Drain (unless given pre-drained events) into a Chrome trace dict.
+
+        Besides the recorded spans, completed **lineage records**
+        (telemetry/lineage.py) are synthesized into Perfetto flow events:
+        per sampled frame one ``s`` (flow start, at the first stamp), ``t``
+        steps at each interior stamp and a binding-point ``f`` at the last,
+        all sharing ``id=trace_id`` — each at the thread that took the
+        stamp, so Perfetto draws one connected arrow chain from the encode
+        thread through H2D/compute/D2H to the decode/drain thread. Stamps
+        use the recorder's own ``perf_counter_ns`` clock, so they land
+        inside the very lane slices they describe.
+        """
         evs = self.drain() if events is None else list(events)
         pid = os.getpid()
         epoch = self.epoch_ns
@@ -267,11 +278,32 @@ class SpanRecorder:
             else:
                 d["s"] = "t"                      # thread-scoped instant
             trace.append(d)
+        # lineage flow chains (local import: lineage loads after spans in the
+        # telemetry package, and only this export path needs it)
+        from . import lineage as _lineage
+        flows = 0
+        for r in _lineage.tracer().records():
+            stamps = r.stamps
+            if len(stamps) < 2:
+                continue
+            last = len(stamps) - 1
+            for i, (lane, t_ns, ident, tname) in enumerate(stamps):
+                seen_tids.setdefault(ident, tname)
+                d = {"ph": "s" if i == 0 else ("f" if i == last else "t"),
+                     "pid": pid, "tid": ident,
+                     "ts": (t_ns - epoch) / 1e3,
+                     "cat": "lineage", "name": "frame", "id": r.tid,
+                     "args": {"lane": lane, "source": r.source}}
+                if i == last:
+                    d["bp"] = "e"     # bind to the enclosing slice's end
+                trace.append(d)
+            flows += 1
         for tid, name in seen_tids.items():
             trace.append({"ph": "M", "pid": pid, "tid": tid,
                           "name": "thread_name", "args": {"name": name}})
         return {"traceEvents": trace, "displayTimeUnit": "ms",
-                "otherData": {"dropped_events": self.dropped}}
+                "otherData": {"dropped_events": self.dropped,
+                              "lineage_flows": flows}}
 
     def export(self, path: str,
                events: Optional[Sequence[SpanEvent]] = None) -> str:

@@ -98,6 +98,36 @@ def _assert_state_drained(fg, label, errored):
                     f"still holds data (fill={fill})"
 
 
+def _journal_since() -> int:
+    """Cursor into the lifecycle journal (telemetry/journal.py) taken at
+    scenario start — `_journal_story` reads forward from it."""
+    from futuresdr_tpu.telemetry import journal as _tj
+    return _tj.journal().seq
+
+
+def _journal_story(since, *expected, label=""):
+    """I5 (frame-lineage plane): the journal must TELL THE STORY — every
+    ``(cat, event)`` pair in ``expected`` appears after cursor ``since``,
+    in that seq order (other events may interleave), and the seqs are
+    strictly increasing (the REST cursor contract)."""
+    from futuresdr_tpu.telemetry import journal as _tj
+    evs = _tj.journal().events(since=since)["events"]
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(set(seqs)), \
+        f"[{label}] I5 violated — journal seqs not strictly increasing: " \
+        f"{seqs}"
+    keys = [(e["cat"], e["event"]) for e in evs]
+    i = 0
+    for want in expected:
+        while i < len(keys) and keys[i] != want:
+            i += 1
+        assert i < len(keys), \
+            f"[{label}] I5 violated — journal missing {want} (in order) " \
+            f"after seq {since}; recorded: {keys}"
+        i += 1
+    return evs
+
+
 def _run_trial(build, label, expect=None):
     """Build → run under deadline → assert I1..I4.
 
@@ -343,9 +373,15 @@ def scenario_stateful_restart_replay():
         return out
 
     clean = one_run(fault=False)
+    since = _journal_since()
     faulted = one_run(fault=True)
     assert faulted["restarts"] >= 1, "the dispatch fault did not fire"
     np.testing.assert_array_equal(faulted["got"], clean["got"])
+    # the journal tells the story: a checkpoint was committed BEFORE the
+    # fault, and the kernel recovered from it (telemetry/journal.py)
+    _journal_story(since, ("kernel", "checkpoint-commit"),
+                   ("kernel", "recover"),
+                   label="stateful_restart_replay")
 
 
 def scenario_arena_recycle_replay():
@@ -501,10 +537,14 @@ def scenario_tenant_isolation():
         {k: len(v) for k, v in clean.items()}
     # fault addressed at ONE session id: only its slot may retire
     faults.reset().arm("work:csb", rate=1.0, max_faults=1, seed=3)
+    since = _journal_since()
     try:
         eng, got = one_run()
     finally:
         faults.reset()
+    # journal story: the session was admitted, then retired by the fault
+    _journal_story(since, ("serve", "admit"), ("serve", "retire"),
+                   label="tenant_isolation")
     vb = eng.session_view("csb")
     assert vb["state"] == "retired" and vb["error"], vb
     assert len(got["csb"]) == 0, "retired session still produced output"
@@ -701,6 +741,17 @@ got, recoveries = sharded("shard_hit", faulted=True)
 assert recoveries >= 1, "the injected fault never fired"
 for seq, (a, b) in enumerate(zip(ref, got)):
     np.testing.assert_array_equal(a, b, err_msg=f"group {seq}")
+# the journal tells the story in seq order: a whole-mesh checkpoint was
+# committed, the runner recovered from it, and the logged window replayed
+from futuresdr_tpu.telemetry import journal as _tj
+evs = _tj.journal().events()["events"]
+keys = [(e["cat"], e["event"]) for e in evs]
+i_c = keys.index(("shard", "checkpoint-commit"))
+i_r = keys.index(("shard", "recover"))
+assert i_c < i_r, keys
+rec = evs[i_r]
+if rec["replayed"]:
+    assert ("shard", "replay") in keys[i_r:], keys
 print(f"SHARD-REPLAY OK recoveries={recoveries}", flush=True)
 """
 
@@ -758,6 +809,7 @@ def scenario_serve_overload_shed():
     eng = ServeEngine(_serve_chaos_pipe(), frame_size=512,
                       app="overload_serve", buckets=(2,), queue_frames=2)
     eng._ladder = ShedLadder(hi=0.5, lo=0.25, trip=2, clear=2)
+    since = _journal_since()
     try:
         for sid in frames:
             eng.admit(tenant=sid, sid=sid)
@@ -820,6 +872,24 @@ def scenario_serve_overload_shed():
         eng.close("ov0")                   # free a lane (bucket is full)
         s = eng.admit(tenant="late")       # admissions reopen
         assert s.state == "active"
+        # the journal tells the WHOLE story in seq order: residents
+        # admitted -> the storm tripped the ladder (a shed-rung transition
+        # UP, with a rung-1 refusal) -> traffic passed -> the ladder
+        # unwound (the LAST shed-rung transition lands back at level 0)
+        evs = _journal_story(since, ("serve", "admit"),
+                             ("serve", "shed-rung"), ("serve", "refuse"),
+                             label="serve_overload_shed")
+        rungs = [e for e in evs if (e["cat"], e["event"]) ==
+                 ("serve", "shed-rung")]
+        assert rungs[0]["level"] > rungs[0]["prev"], rungs[0]
+        assert rungs[-1]["level"] == 0, rungs[-1]
+        # IF rung 2 fired, the evict precedes its readmit in seq order
+        evicts = [e["seq"] for e in evs if (e["cat"], e["event"]) ==
+                  ("serve", "evict")]
+        readmits = [e["seq"] for e in evs if (e["cat"], e["event"]) ==
+                    ("serve", "readmit")]
+        if evicts and readmits:
+            assert min(evicts) < max(readmits), (evicts, readmits)
     finally:
         eng.shutdown()
     _assert_no_leaked_threads(before, "serve_overload_shed")

@@ -97,7 +97,12 @@ FIELDS_INVERSE_RATIO_SAME_BACKEND = ("serve_p99_under_churn_ms",
                                      # recompiles shows up as this figure
                                      # blowing past the reference round
                                      "compiles_total",
-                                     "compile_seconds_total")
+                                     "compile_seconds_total",
+                                     # streamed-run e2e p99 (seconds) from
+                                     # the always-on latency histogram —
+                                     # a latency-tail creep on the default
+                                     # bench run flags here
+                                     "e2e_latency_p99")
 INVERSE_RATIO_SLACK = 2.0  # may rise up to (1 + slack)x the reference
 
 

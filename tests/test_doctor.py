@@ -443,7 +443,13 @@ def test_flight_record_shape_and_markdown(tmp_path, monkeypatch):
         # golden shape: every black-box section present
         assert set(rep) == {"reason", "unix_time", "threads", "flowgraphs",
                             "spans", "span_drops", "e2e_latency", "profile",
-                            "serve", "metrics"}
+                            "serve", "metrics", "journal", "tail"}
+        # lifecycle journal section: the last-N structured events (or None
+        # when this process journaled nothing yet); each carries the
+        # monotonic seq + category the /api/events/ cursor pages by
+        if rep["journal"] is not None:
+            assert all({"seq", "cat", "event", "t_wall"} <= set(e)
+                       for e in rep["journal"])
         # profile-plane section: compile counters + storm classification
         # ride every flight record (telemetry/profile.py)
         assert set(rep["profile"]) == {"active_compiles", "compiles_total",

@@ -434,7 +434,9 @@ def test_telemetry_disabled_overhead_null_rand(monkeypatch):
     the device-plane recovery PR's disabled checkpoint hook billed as a
     third per-call cost (checkpoint_every=0 must be free), and the profile
     plane's dispatch-unit counter billed as a fourth (live MFU attribution
-    must ride inside the same budget too).
+    must ride inside the same budget too), and the lineage plane's per-frame
+    sample draw billed as a fifth (frame-lineage tracing at the default
+    stride must ride inside the same budget as well).
 
     The per-work-call cost of the disabled telemetry path (the `if
     rec.enabled:` guard, the ns-clock reads the loop already paid
@@ -513,16 +515,39 @@ def test_telemetry_disabled_overhead_null_rand(monkeypatch):
         for _ in range(n):
             dispatch()
 
+    # lineage sample hook (telemetry/lineage.py): the per-frame trace-id
+    # draw at the DEFAULT 1-in-64 stride — a FIFTH per-call hook class,
+    # again a conservative over-count (the real rate is one sample per
+    # FRAME, far below the work-call rate). Like the checkpoint and
+    # profile classes, the bill is the steady-state per-call guard — the
+    # unlocked countdown the contract promises — with the heavy-but-rare
+    # companion (the 1-in-64 record build + stamps, a few µs at 1/64 the
+    # frame rate) landing at group rate like checkpoint commits and
+    # profile window swaps. The loop still drains each sampled id through
+    # finish() so the open-table bound rides inside the measurement.
+    # Journal emits live at lifecycle decision sites, not on the
+    # per-frame path, so they bill into `elapsed`, not per call.
+    from futuresdr_tpu.telemetry import lineage as lin_mod
+    ltr = lin_mod.reset_tracer()
+    assert ltr.stride >= 2, "gate must measure the default sampled stride"
+    sample = ltr.sample
+
+    def lineage_hook():
+        for _ in range(n):
+            tid = sample()
+            if tid:
+                ltr.finish(tid)
+
     # paired trials: hook micro-costs and the chain rate are measured back to
     # back INSIDE each trial, and the gate takes the best trial — a transient
     # load spike that inflates only one side of one trial (the structural
     # flake mode: hooks and chain are necessarily sampled at different
     # instants) cannot flip the verdict as long as one trial runs clean
     trials = []
-    for _ in range(3):
-        work_ns, park_ns, ckpt_ns, prof_ns = \
+    for _ in range(5):
+        work_ns, park_ns, ckpt_ns, prof_ns, lin_ns = \
             best_of(work_hook), best_of(park_hook), best_of(ckpt_hook), \
-            best_of(prof_hook)
+            best_of(prof_hook), best_of(lineage_hook)
         # the chain's real call rate, measured with the watchdog running at
         # its DEFAULT interval (1 Hz sampling lands in `elapsed`, not per
         # call)
@@ -532,19 +557,20 @@ def test_telemetry_disabled_overhead_null_rand(monkeypatch):
             elapsed, calls = _null_rand_chain()
         finally:
             doc.disable()
-        overhead = calls * (work_ns + park_ns + ckpt_ns + prof_ns) * 1e-9 \
-            / elapsed
+        overhead = calls * (work_ns + park_ns + ckpt_ns + prof_ns
+                            + lin_ns) * 1e-9 / elapsed
         trials.append((overhead, work_ns, park_ns, ckpt_ns, prof_ns,
-                       calls, elapsed))
+                       lin_ns, calls, elapsed))
         if overhead <= 0.03:
             break
-    overhead, work_ns, park_ns, ckpt_ns, prof_ns, calls, elapsed = \
+    overhead, work_ns, park_ns, ckpt_ns, prof_ns, lin_ns, calls, elapsed = \
         min(trials)
+    ltr.clear()
     assert overhead <= 0.03, (
         f"telemetry-disabled hooks cost {overhead * 100:.2f}% of the "
         f"null_rand chain ({calls} work calls, {work_ns:.0f}+{park_ns:.0f}"
-        f"+{ckpt_ns:.0f}+{prof_ns:.0f} ns/hook, {elapsed:.3f}s elapsed; "
-        f"best of {len(trials)} paired trials)")
+        f"+{ckpt_ns:.0f}+{prof_ns:.0f}+{lin_ns:.0f} ns/hook, "
+        f"{elapsed:.3f}s elapsed; best of {len(trials)} paired trials)")
 
 
 def test_telemetry_enabled_stays_cheap(tracing, monkeypatch):
