@@ -957,6 +957,111 @@ def main():
         print(f"# streamed wire A/B unavailable: {e!r}", file=sys.stderr)
         wire_extra["streamed_wire_error"] = repr(e)
 
+    # single-shot uplink stamps (docs/tpu_notes.md "The single-shot uplink"):
+    # physical H2D starts per dispatch group (coalesced multi-part wires
+    # collapse to ONE), the zero-copy ingest hit fraction on a registered
+    # read-only capture over the aliasing-wire path, and the adaptive-wire
+    # policy state. On the CPU backend the packed-class (sc16) probe rides
+    # the deterministic 96/62 fake link — the hostpath replay regime — so
+    # the artifact carries a replayable streamed_link_utilization that
+    # perf/regress.py grades against the absolute >=0.9 replay bar. The
+    # probe drives the mock harness with compile + warm-up OUTSIDE the
+    # measured wall (the perf/uplink_ab.py methodology): the actor-path
+    # figure pays 1-2 s of per-run XLA compilation inside short windows,
+    # which swamps the steady-state number this stamp grades. Guarded
+    # backends skip the in-process probe (their wire A/B child already
+    # exercised the codec path; the replay figure belongs to CPU rounds).
+    uplink_extra = {}
+    if not guarded:
+        try:
+            from futuresdr_tpu import Mocker as _Mocker
+            from futuresdr_tpu.ops import ingest as _ingest
+            from futuresdr_tpu.ops import mag2_stage as _up_mag2
+            from futuresdr_tpu.ops import rotator_stage as _up_rot
+            from futuresdr_tpu.ops import xfer as _up_xfer
+            from futuresdr_tpu.ops.wire import streamed_ceiling_msps
+            from futuresdr_tpu.config import config as _up_config
+            up_frame = 1 << 18
+            _up_config().buffer_size = max(_up_config().buffer_size,
+                                           4 * up_frame * 8)
+            _up_xfer.set_fake_link(96e6, 62e6)
+            try:
+                up_ceil = streamed_ceiling_msps("sc16", 96e6, 62e6,
+                                                np.complex64, np.float32, 1.0)
+                n_up = int(up_ceil * 1e6 * 1.2) // up_frame * up_frame
+                _up_rng = np.random.default_rng(11)
+                up_data = (_up_rng.standard_normal(n_up)
+                           + 1j * _up_rng.standard_normal(n_up)) \
+                    .astype(np.complex64)
+
+                def _up_run(n):
+                    tk = TpuKernel([_up_rot(0.05), _up_mag2()], np.complex64,
+                                   frame_size=up_frame, wire="sc16")
+                    mm = _Mocker(tk)
+                    mm.input("in", up_data[:n])
+                    mm.init_output("out", n + up_frame)
+                    mm.init()        # compile + cost probes outside the wall
+                    t0 = time.perf_counter()
+                    mm.run()
+                    return n / (time.perf_counter() - t0) / 1e6, tk
+
+                _up_run(up_frame * 4)                # compile + arena warm-up
+                up_runs, up_m = [], {}
+                for _ in range(3):
+                    r, tk = _up_run(n_up)
+                    up_runs.append(r)
+                    up_m = tk.extra_metrics()
+                up_runs.sort()
+                up_rate = up_runs[(len(up_runs) - 1) // 2]
+                uplink_extra.update({
+                    "uplink_coalesced": up_m["uplink_coalesced"],
+                    "h2d_starts_per_frame": up_m["h2d_starts_per_frame"],
+                    "streamed_adaptive_wire": up_m["adaptive_wire"],
+                    "wire_switches": up_m["wire_switches"],
+                })
+                if inst_.platform == "cpu":
+                    uplink_extra["streamed_link_utilization"] = round(
+                        up_rate / up_ceil, 4)
+            finally:
+                _up_xfer.set_fake_link()             # remove the fake link
+
+            # zero-copy ingest frac: the runtime ring hands out WRITABLE
+            # frames (never eligible), so the honest measure of the ingest
+            # plane is a registered read-only capture driven through the
+            # mock harness over the aliasing (f32) wire — frac 1.0 means
+            # every staged frame skipped its ring-exit copy
+            _ingest.reset()
+            ing_frame = 1 << 14
+            rng = np.random.default_rng(0)
+            ing_n = ing_frame * 8
+            ing_data = (rng.standard_normal(ing_n)
+                        + 1j * rng.standard_normal(ing_n)) \
+                .astype(np.complex64)
+            _ingest.register(ing_data, name="bench-capture")
+            try:
+                ing_tk = TpuKernel([_up_rot(0.05), _up_mag2()], np.complex64,
+                                   frame_size=ing_frame, wire="f32")
+                mm = _Mocker(ing_tk)
+                mm.input("in", ing_data)
+                mm.init_output("out", ing_n * 2)
+                mm.init()
+                mm.run()
+                uplink_extra["ingest_zero_copy_frac"] = round(
+                    ing_tk.extra_metrics()["ingest_zero_copy_frac"], 4)
+            finally:
+                _ingest.reset()
+            print(f"# uplink: packed sc16 {up_rate:.1f} Msps on the replay "
+                  f"link (utilization "
+                  f"{uplink_extra.get('streamed_link_utilization')}), "
+                  f"h2d starts/frame "
+                  f"{uplink_extra.get('h2d_starts_per_frame')}, ingest "
+                  f"zero-copy frac "
+                  f"{uplink_extra.get('ingest_zero_copy_frac')}",
+                  file=sys.stderr)
+        except Exception as e:                          # noqa: BLE001
+            print(f"# uplink stamps unavailable: {e!r}", file=sys.stderr)
+            uplink_extra["uplink_error"] = repr(e)
+
     # streamed 1→2 fan-out (broadcast fusion, runtime/devchain.py): the same
     # frame/depth regime, a producer FIR feeding two device branches over a
     # broadcast stream edge — fused into ONE multi-output dispatch per frame
@@ -1295,6 +1400,7 @@ def main():
         "dev_frame_sweep": dev_sweep,
         **link,
         **wire_extra,
+        **uplink_extra,
         **fanout_extra,
         **dag_extra,
         **serve_extra,

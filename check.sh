@@ -42,6 +42,64 @@ echo "== host data path gate (docs/tpu_notes.md 'The host data path') =="
 # no worse than the pre-arena baseline
 JAX_PLATFORMS=cpu python perf/hostpath_ab.py --smoke
 
+echo "== single-shot uplink gate (docs/tpu_notes.md 'The single-shot uplink') =="
+# coalesced H2D: a quantizing-wire streamed chain bills exactly ONE physical
+# h2d start per dispatch group (payload + scale ride one packed buffer) and
+# stays bit-identical to the per-part path; zero-copy ingest: a registered
+# read-only capture over the aliasing (f32) wire skips every ring-exit copy
+# (frac == 1.0). The dedicated suite behind it carries the rest (packed
+# replay/fault bit-equality, deferred consume, adaptive wire switching,
+# autotune wire axis).
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+from futuresdr_tpu import Mocker
+from futuresdr_tpu.config import config
+from futuresdr_tpu.ops import fir_stage, rotator_stage
+from futuresdr_tpu.ops import ingest, xfer
+from futuresdr_tpu.tpu import TpuKernel
+
+FS = 2048
+rng = np.random.default_rng(7)
+n = FS * 8
+data = (rng.standard_normal(n) + 1j * rng.standard_normal(n)) \
+    .astype(np.complex64)
+taps = rng.standard_normal(33).astype(np.float32)
+
+def run(wire, coalesce=True, register=False):
+    config().tpu_coalesce = coalesce
+    if register:
+        ingest.register(data, name="gate-capture")
+    tk = TpuKernel([fir_stage(taps, fft_len=256), rotator_stage(0.05)],
+                   np.complex64, frame_size=FS, frames_in_flight=2,
+                   wire=wire)
+    m = Mocker(tk)
+    m.input("in", data)
+    m.init_output("out", n * 2)
+    m.init()                   # compile + cost-model probes bill separately
+    s0 = xfer._XFER_STARTS.get(direction="h2d")
+    m.run()
+    starts = xfer._XFER_STARTS.get(direction="h2d") - s0
+    out = m.output("out").copy()
+    em = tk.extra_metrics()
+    ingest.reset()
+    config().tpu_coalesce = True
+    return out, starts, em
+
+groups = 8
+a, sa, ema = run("sc16", coalesce=True)
+b, sb, emb = run("sc16", coalesce=False)
+np.testing.assert_array_equal(a, b)
+assert ema["uplink_coalesced"] == 1 and ema["h2d_starts_per_frame"] == 1, ema
+assert sa == groups, f"packed chain billed {sa} h2d starts / {groups} groups"
+assert sb == 2 * groups, sb
+_, _, emc = run("f32", register=True)
+assert emc["ingest_zero_copy_frac"] == 1.0, emc
+print(f"uplink gate: {sa} h2d starts / {groups} groups packed (vs {sb} "
+      f"per-part, bit-identical), ingest zero-copy frac "
+      f"{emc['ingest_zero_copy_frac']}: OK")
+EOF
+JAX_PLATFORMS=cpu python -m pytest -q tests/test_uplink.py
+
 echo "== interior precision gate (docs/tpu_notes.md 'Interior precision') =="
 # SNR-budgeted lowering correctness: interior_precision=off is BIT-identical
 # (same program object, same bits), the auto plan lowers the resident

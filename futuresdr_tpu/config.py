@@ -116,6 +116,36 @@ class Config:
     #   autotune_streamed pick (or tpu_frames_in_flight) and adjusts at
     #   runtime from link idle/backpressure signals; N>0 pins the budget
     #   (as does an explicit per-kernel frames_in_flight argument)
+    # Uplink optimization plane (docs/tpu_notes.md "The host data path"):
+    # coalesced H2D transfers, zero-copy ingest and deferred-consume staging.
+    tpu_coalesce: bool = True              # pack a dispatch group's wire
+    #   parts (quantizing wires ship payload + scale; megabatch K-stacks)
+    #   into ONE contiguous arena-backed buffer shipped as a single
+    #   device_put, unpacked by a slicing prolog fused into the wired
+    #   program (ops/xfer.PackedLayout) — h2d starts per dispatch group
+    #   drop from len(parts) to 1. 0 = per-part transfers (A/B baseline)
+    tpu_zero_copy_ingest: bool = True      # let frames backed by a
+    #   REGISTERED externally-owned read-only buffer (ops/ingest.py) skip
+    #   the ring-exit staging copy on aliasing wires: the buffer is pinned
+    #   by refcount until drain + checkpoint coverage instead of copied
+    tpu_deferred_consume: bool = True      # quantizing wires (sc16/sc8,
+    #   K=1) with the codec pool armed: defer the ring consume() until the
+    #   worker-side encode has read the ring slot IN PLACE — quantized
+    #   formats gain the encode-offload overlap without the ring-exit copy
+    #   offloading would otherwise force (only the int payload lands in
+    #   the arena). 0 = inline encode before consume (the pre-uplink path)
+    tpu_adaptive_wire: bool = False        # mid-stream adaptive wire
+    #   switching (tpu/kernel_block.py WireController): a hysteretic
+    #   controller reads the measured stream SNR of the active quantized
+    #   format and the h2d link occupancy windows, and retunes the wire
+    #   format between dispatch groups (bit-exact replay of the switch
+    #   boundary included). Off by default: the wire format is part of the
+    #   numerics contract, so opting in is explicit
+    tpu_wire_snr_budget_db: float = 40.0   # stream-SNR floor of the
+    #   adaptive-wire policy: the active quantized format WIDENS (toward
+    #   f32) when its measured SNR dips below this; a NARROWER format is
+    #   only adopted when its predicted SNR clears this plus the
+    #   controller's hysteresis margin
     checkpoint_dir: str = ""               # persist the committed carry-
     #   checkpoint ring across PROCESSES (docs/robustness.md): each commit
     #   also lands as an atomic, integrity-checked snapshot file under this

@@ -281,3 +281,41 @@ class _TempsOnly:
 
     def drop_temps(self) -> None:
         pass                                # the parent owns the temp set
+
+
+class PackedAlloc(GroupAlloc):
+    """A :class:`GroupAlloc` whose payload allocations are VIEWS into ONE
+    contiguous packed transfer buffer (the H2D coalescing plane,
+    ``ops/xfer.PackedLayout``): ``__call__`` hands out the next unfilled
+    layout slot matching the requested shape/dtype, so a quantizing encode's
+    int payload is written directly at its packed offset — the coalesce
+    costs zero extra payload copies. A request no slot matches falls back to
+    a plain arena take (``PackedLayout.pack`` copies those, plus bare parts
+    like the quantizer's scale scalar, into their slots afterwards).
+    ``handles[0]`` pins the packed buffer itself; the whole-group pinning /
+    replay-retention contract is the parent's, unchanged."""
+
+    __slots__ = ("layout", "packed", "_filled")
+
+    def __init__(self, arena: StagingArena, layout):
+        super().__init__(arena)
+        self.layout = layout
+        self.packed, buf = arena.take_array((layout.nbytes,), np.uint8)
+        self.handles.append(buf)
+        self._filled = [False] * len(layout.slots)
+
+    def __call__(self, shape, dtype) -> np.ndarray:
+        sh = ((int(shape),) if isinstance(shape, (int, np.integer))
+              else tuple(shape))
+        dt = np.dtype(dtype)
+        for i, (ssh, sdt, off, nb) in enumerate(self.layout.slots):
+            if not self._filled[i] and ssh == sh and sdt == dt:
+                self._filled[i] = True
+                return self.packed[off:off + nb].view(dt).reshape(sh)
+        return super().__call__(shape, dtype)
+
+    def finish(self, parts) -> np.ndarray:
+        """Settle the packed buffer for shipping: copy in every part the
+        encode did not write through a slot view, zero alignment gaps, and
+        return the packed uint8 array (backed by ``handles[0]``)."""
+        return self.layout.pack(parts, self.packed)

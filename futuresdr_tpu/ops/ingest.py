@@ -1,0 +1,195 @@
+"""Zero-copy ingest: externally-owned buffers ride the uplink without the
+ring-exit copy.
+
+The streamed drain loop pays one host copy per frame at the ring exit
+(``TpuKernel._stage_copy``): ``device_put`` is async, so a live ring view
+handed to it would race with the upstream writer reclaiming consumed space.
+That copy is a safety tax, not a law of physics — when the frame's backing
+buffer is EXTERNALLY OWNED (a dlpack import, a shared-memory mapping, a
+recorded capture an offline source replays), nobody overwrites it behind the
+transfer, and the copy buys nothing.
+
+This module is the ownership registry that makes skipping the copy sound. A
+source that controls its buffer's lifetime registers it (:func:`register`);
+the kernel's staging path looks frames up (:func:`lookup`) by walking the
+numpy base chain to the registered root. On a hit the frame is staged AS the
+ring-exit "copy" and the registered buffer's refcounted pin handle rides the
+arena pinning rules (``ops/arena.ArenaBuffer`` protocol: ``retain`` /
+``release``) through the dispatch group's handle set AND the checkpoint
+replay log — the buffer stays pinned until the frame's outputs drain and a
+committed checkpoint covers the group, exactly the retention the arena
+staging copy would have had. The owner learns the buffer is reclaimable from
+:attr:`IngestBuffer.pinned` (or an ``on_idle`` callback).
+
+The fast path only engages when it is actually free AND safe:
+
+* the buffer must be registered and READ-ONLY (``register`` clears the
+  writeable flag as a tripwire; a writable frame never matches — the
+  "falls back whenever the buffer is writable" contract);
+* the wire's host encode must ALIAS its input (the f32 pairs view). A
+  quantizing wire materializes fresh int payloads anyway — the copy it
+  would skip does not exist (the deferred-consume staging plane covers
+  that case instead).
+
+Everything else falls back to the copying path, bit-identically.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..log import logger
+from ..telemetry import prom as _prom
+
+__all__ = ["IngestBuffer", "register", "unregister", "lookup", "reset",
+           "stats", "from_dlpack"]
+
+log = logger("ops.ingest")
+
+_INGEST_FRAMES = _prom.counter(
+    "fsdr_ingest_zero_copy_frames_total",
+    "frames staged zero-copy from a registered externally-owned buffer")
+
+_lock = threading.Lock()
+_registry: Dict[int, "IngestBuffer"] = {}
+
+
+class IngestBuffer:
+    """Refcounted pin handle of one registered externally-owned buffer.
+
+    Speaks the ``ops/arena.ArenaBuffer`` retention protocol (``retain`` /
+    ``release``), so the kernel's group-handle set and replay log can pin it
+    exactly like an arena staging buffer. The registry's own reference is
+    one count; every staged frame adds one (released when the frame's
+    dispatch group drains / its replay-log entry is pruned). ``release``
+    past zero is a no-op, like the arena's. When the count returns to the
+    registry-only baseline the owner may reclaim the memory (``pinned``
+    goes False; ``on_idle`` fires if given)."""
+
+    __slots__ = ("root", "name", "on_idle", "_rc", "_lock")
+
+    def __init__(self, root: np.ndarray, name: str = "",
+                 on_idle: Optional[Callable[["IngestBuffer"], None]] = None):
+        self.root = root
+        self.name = name
+        self.on_idle = on_idle
+        self._rc = 1                      # the registry's reference
+        self._lock = threading.Lock()
+
+    def retain(self) -> "IngestBuffer":
+        with self._lock:
+            self._rc += 1
+        return self
+
+    def release(self) -> None:
+        cb = None
+        with self._lock:
+            if self._rc > 0:
+                self._rc -= 1
+                if self._rc == 1 and self.on_idle is not None:
+                    cb = self.on_idle      # back to registry-only: idle
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception as e:         # noqa: BLE001 — observer only
+                log.warning("ingest on_idle callback failed: %r", e)
+
+    @property
+    def pinned(self) -> bool:
+        """True while any staged frame / replay-log entry still pins the
+        buffer (the owner must not reclaim or rewrite it)."""
+        with self._lock:
+            return self._rc > 1
+
+    @property
+    def refcount(self) -> int:
+        with self._lock:
+            return self._rc
+
+
+def _root_of(a: np.ndarray) -> np.ndarray:
+    """Walk the numpy base chain to the owning array (views of views of a
+    registered buffer still resolve to the same root)."""
+    while isinstance(getattr(a, "base", None), np.ndarray):
+        a = a.base
+    return a
+
+
+def register(arr: np.ndarray, name: str = "",
+             on_idle: Optional[Callable[[IngestBuffer], None]] = None
+             ) -> IngestBuffer:
+    """Register an externally-owned buffer for zero-copy ingest.
+
+    ``arr`` (or any view of it) handed to a TPU kernel as a frame will skip
+    the ring-exit staging copy on aliasing wires; the returned handle's
+    :attr:`IngestBuffer.pinned` tells the owner when the buffer may be
+    reclaimed. Registration clears the writeable flag on the ROOT buffer
+    (the ownership contract says nobody writes it while registered; the
+    flag makes an accidental write raise instead of corrupting in-flight
+    frames). Registering the same root twice returns the existing handle."""
+    root = _root_of(np.asarray(arr))
+    with _lock:
+        got = _registry.get(id(root))
+        if got is not None:
+            return got
+        try:
+            root.setflags(write=False)
+        except ValueError:
+            # a foreign-owned view (dlpack import) may refuse; its producer
+            # already owns writability — the lookup-side check still holds
+            pass
+        h = IngestBuffer(root, name=name, on_idle=on_idle)
+        _registry[id(root)] = h
+        return h
+
+
+def unregister(handle: IngestBuffer) -> None:
+    """Drop the registry's reference. Frames already staged keep their own
+    pins; the buffer must stay valid until :attr:`IngestBuffer.pinned` goes
+    False."""
+    with _lock:
+        _registry.pop(id(handle.root), None)
+    handle.release()
+
+
+def lookup(frame: np.ndarray) -> Optional[IngestBuffer]:
+    """The staging-path probe: the registered handle backing ``frame``, or
+    None when the frame is unregistered OR writable (a writable view means
+    the zero-copy ownership contract cannot hold — fall back to copying)."""
+    if not _registry or frame.flags.writeable:
+        return None
+    root = _root_of(frame)
+    with _lock:
+        return _registry.get(id(root))
+
+
+def note_zero_copy(n: int = 1) -> None:
+    """Bill ``n`` frames staged through the zero-copy fast path."""
+    _INGEST_FRAMES.inc(n)
+
+
+def from_dlpack(capsule_owner) -> np.ndarray:
+    """Import an external producer's buffer via the dlpack protocol and
+    register the result: the shared-memory ingest entry point for sources
+    whose payload already lives in another framework's host buffer. Returns
+    the registered (read-only) numpy view."""
+    arr = np.from_dlpack(capsule_owner)
+    register(arr)
+    return arr
+
+
+def reset() -> None:
+    """Drop every registration (tests)."""
+    with _lock:
+        _registry.clear()
+
+
+def stats() -> dict:
+    with _lock:
+        return {
+            "registered": len(_registry),
+            "pinned": sum(1 for h in _registry.values() if h.pinned),
+        }

@@ -288,17 +288,50 @@ class Pipeline:
                 self._wired_fns[key] = run_scan
         return self._wired_fns[key]
 
+    def packed_wired_fn(self, wire, k: int = 1, packed=None):
+        """:meth:`wired_fn` with the COALESCED-uplink slicing prolog fused in
+        front: ``(carries, packed_u8) -> (carries, out_parts)``. ``packed`` is
+        an ``ops/xfer.PackedLayout`` — the offset table both the host packer
+        and this unpacker derive from the wire codec, so they cannot
+        disagree. The unpack is pure slice→bitcast→reshape, which XLA fuses
+        into the decode prolog; the host pays ONE ``device_put`` per dispatch
+        group instead of ``len(parts)``. Cached per
+        ``(wire, k, layout)`` so the jit identity stays stable across
+        compiles, exactly like :meth:`wired_fn`."""
+        from .wire import get_wire
+        wire = get_wire(wire)
+        key = (wire.name, int(k), "packed", packed.key)
+        if key not in self._wired_fns:
+            inner = self.wired_fn(wire, k)
+            lay = packed
+
+            def run_packed(carries, buf):
+                return inner(carries, *lay.unpack_jax(buf))
+
+            self._wired_fns[key] = run_packed
+        return self._wired_fns[key]
+
     def compile_wired(self, frame_size: int, wire, device=None,
-                      donate=True, k: int = 1):
+                      donate=True, k: int = 1, packed=None):
         """:meth:`compile` for the wired form: the compiled fn consumes/produces
         wire parts (see :meth:`wired_fn`); returns (compiled_fn, initial carry).
         ``k > 1`` compiles the megabatch scan form (parts carry a leading
         ``[k]`` frame axis). ``donate`` accepts the same bool-or-argnums
-        per-argnum mask as :meth:`compile`."""
+        per-argnum mask as :meth:`compile`. ``packed`` (an
+        ``ops/xfer.PackedLayout``) compiles the single-buffer coalesced form
+        instead — the fn consumes ONE packed uint8 array
+        (:meth:`packed_wired_fn`); only the carries (argnum 0) can donate
+        there, so an explicit parts-argnum mask is clamped."""
         assert frame_size % self.frame_multiple == 0, \
             f"frame_size {frame_size} not a multiple of {self.frame_multiple}"
-        fn = jax.jit(self.wired_fn(wire, k),
-                     donate_argnums=_donate_argnums(donate))
+        if packed is not None:
+            donate = bool(donate) if not isinstance(donate, (tuple, list)) \
+                else (0 in tuple(donate))
+            fn = jax.jit(self.packed_wired_fn(wire, k, packed),
+                         donate_argnums=_donate_argnums(donate))
+        else:
+            fn = jax.jit(self.wired_fn(wire, k),
+                         donate_argnums=_donate_argnums(donate))
         carry = self.init_carry()
         if device is not None:
             carry = jax.device_put(carry, device)
@@ -577,6 +610,7 @@ class FanoutPipeline:
     # appear in any mask because it is not an argument).
     compile = Pipeline.compile
     compile_wired = Pipeline.compile_wired
+    packed_wired_fn = Pipeline.packed_wired_fn
     update_stage = Pipeline.update_stage
     # carry checkpointing borrows too: the FLAT carries tuple (producer then
     # branches) is an ordinary pytree, so snapshot/validate/restore of the
@@ -804,6 +838,7 @@ class DagPipeline:
     donation_mask = FanoutPipeline.donation_mask
     compile = Pipeline.compile
     compile_wired = Pipeline.compile_wired
+    packed_wired_fn = Pipeline.packed_wired_fn
     update_stage = Pipeline.update_stage
     snapshot_carry = Pipeline.snapshot_carry
     carry_matches = Pipeline.carry_matches

@@ -35,7 +35,8 @@ from ..telemetry.spans import recorder as _trace_recorder
 __all__ = ["to_device", "to_host", "start_host_transfer", "start_device_transfer",
            "start_device_transfer_parts", "start_host_transfer_parts",
            "split_complex_platform", "set_fake_link", "fake_link",
-           "TransferError", "FakeLinkFault", "classify_transfer_error"]
+           "TransferError", "FakeLinkFault", "classify_transfer_error",
+           "PackedLayout"]
 
 log = logger("ops.xfer")
 _trace = _trace_recorder()
@@ -45,6 +46,14 @@ _XFER_BYTES = _prom.counter(
     ("direction",))
 _XFER_TRANSFERS = _prom.counter(
     "fsdr_xfer_transfers_total", "transfers started on the host-device link",
+    ("direction",))
+# physical per-buffer starts: how many device_put/fetch calls actually hit
+# the link. A coalesced (packed) frame counts ONE h2d start; the per-part
+# path counts len(parts). The transfers counter above stays frame-granular —
+# starts/transfers is the coalescing ratio the uplink gate reads.
+_XFER_STARTS = _prom.counter(
+    "fsdr_xfer_starts_total",
+    "physical per-buffer put/fetch starts on the host-device link",
     ("direction",))
 # per-transfer duration histogram (telemetry/hist.py log2 buckets) — always
 # on like the counters. Under the fake link the observed duration clamps to
@@ -415,6 +424,7 @@ def start_device_transfer_parts(parts, device=None):
     nbytes = sum(p.nbytes for p in host)
     _XFER_BYTES.inc(nbytes, direction="h2d")
     _XFER_TRANSFERS.inc(direction="h2d")
+    _XFER_STARTS.inc(len(host), direction="h2d")
 
     def attempt():
         # idempotent: re-puts the immutable host STAGING copies — a retried
@@ -442,6 +452,111 @@ def start_device_transfer_parts(parts, device=None):
     # D2H finishes' _wire attribute below.
     finish._wire = (service, deadline)
     return finish
+
+
+class PackedLayout:
+    """Offset table of ONE dispatch group's coalesced H2D transfer buffer.
+
+    The uplink coalescing plane: a quantizing wire ships several parts per
+    frame (int payload + scale; a megabatch K-stack per part), and each part
+    is a separate ``device_put`` — a separate link start. ``PackedLayout``
+    fixes the byte layout that packs every part of a dispatch group into one
+    contiguous uint8 buffer: slot ``i`` holds part ``i``'s bytes at a
+    64-byte-aligned offset (TPU/infeed-friendly, and it keeps every int16
+    payload view naturally aligned). The host side writes payloads in place
+    via ``ops/arena.PackedAlloc``; the device side recovers the parts with
+    :meth:`unpack_jax` — a slice→bitcast prolog fused into the wired program
+    by ``Pipeline.compile_wired(packed=...)``, so the unpack costs one fused
+    reshape pass, not a dispatch.
+
+    The layout is a pure function of the wire codec + frame shape (probed
+    from an encode of zeros), so host packer and device unpacker can never
+    disagree, and a replayed frame re-ships the EXACT packed bytes the first
+    attempt shipped (the replay log retains the packed buffer, not the
+    parts).
+    """
+
+    ALIGN = 64
+    __slots__ = ("slots", "nbytes")
+
+    def __init__(self, slots, nbytes):
+        self.slots = tuple(slots)     # (shape, dtype, offset, nbytes) each
+        self.nbytes = int(nbytes)
+
+    @classmethod
+    def from_parts(cls, parts) -> "PackedLayout":
+        """Layout for a concrete part tuple (shapes/dtypes as shipped)."""
+        slots, off = [], 0
+        for p in parts:
+            p = np.asarray(p)
+            slots.append((tuple(p.shape), np.dtype(p.dtype), off,
+                          int(p.nbytes)))
+            off += -(-max(p.nbytes, 1) // cls.ALIGN) * cls.ALIGN
+        return cls(slots, off)
+
+    @classmethod
+    def probe(cls, wire, frame_size: int, in_dtype, k: int = 1):
+        """Layout for ``wire``'s encode of a ``frame_size`` frame (``k > 1``:
+        the megabatch stack — every part gains a leading ``[k]`` axis), or
+        ``None`` when the wire ships a single part (nothing to coalesce —
+        packing a lone payload would only add a copy)."""
+        parts = wire.encode_host(np.zeros(frame_size, dtype=in_dtype))
+        parts = [np.asarray(p) for p in parts]
+        if len(parts) < 2:
+            return None
+        if k > 1:
+            parts = [np.broadcast_to(p, (int(k),) + p.shape) for p in parts]
+        return cls.from_parts(parts)
+
+    @property
+    def key(self):
+        """Hashable identity (the wired-program cache key extension)."""
+        return self.slots
+
+    def matches(self, parts) -> bool:
+        """Do ``parts`` fit this layout slot-for-slot (shape and dtype)?"""
+        if len(parts) != len(self.slots):
+            return False
+        return all(tuple(np.shape(p)) == sh and np.dtype(
+            getattr(p, "dtype", type(p))) == dt
+            for p, (sh, dt, _o, _n) in zip(parts, self.slots))
+
+    def pack(self, parts, out: np.ndarray) -> np.ndarray:
+        """Copy any part not already resident in its slot into ``out`` (a
+        ``(nbytes,)`` uint8 buffer) and zero the alignment gaps, so the
+        shipped bytes are a deterministic function of the parts. Parts the
+        encoder already wrote through a slot view (``PackedAlloc``) are left
+        untouched."""
+        assert out.nbytes >= self.nbytes, (out.nbytes, self.nbytes)
+        end = 0
+        for p, (sh, dt, off, nb) in zip(parts, self.slots):
+            p = np.asarray(p)
+            if end < off:                       # alignment gap before slot
+                out[end:off] = 0
+            view = out[off:off + nb].view(dt).reshape(sh)
+            if not np.shares_memory(view, p):
+                view[...] = p
+            end = off + nb
+        if end < self.nbytes:
+            out[end:self.nbytes] = 0
+        return out
+
+    def unpack_jax(self, buf):
+        """The device-side slicing prolog: recover the part tuple from the
+        packed uint8 buffer with slice→bitcast→reshape (pure XLA ops — they
+        fuse into the wired program's decode prolog, no extra dispatch)."""
+        import jax
+
+        parts = []
+        for sh, dt, off, nb in self.slots:
+            seg = jax.lax.slice(buf, (off,), (off + nb,))
+            if dt.itemsize > 1:
+                seg = jax.lax.bitcast_convert_type(
+                    seg.reshape(-1, dt.itemsize), dt)
+            elif dt != np.uint8:
+                seg = jax.lax.bitcast_convert_type(seg, dt)
+            parts.append(seg.reshape(sh))
+        return tuple(parts)
 
 
 def start_device_transfer(arr, device=None):
@@ -517,6 +632,9 @@ def start_host_transfer(arr, _instrument: bool = True):
             _, split = _jits()
             r, i = split(arr)                    # async device-side split
             nbytes = r.nbytes + i.nbytes
+            # physical starts bill regardless of _instrument (parts-path
+            # callers suppress the per-frame counters, not the start count)
+            _XFER_STARTS.inc(2, direction="d2h")
             if _instrument:
                 _XFER_BYTES.inc(nbytes, direction="d2h")
                 _XFER_TRANSFERS.inc(direction="d2h")
@@ -550,6 +668,7 @@ def start_host_transfer(arr, _instrument: bool = True):
             finish._wire = (service, deadline)
             return finish
     nbytes = int(getattr(arr, "nbytes", 0))
+    _XFER_STARTS.inc(direction="d2h")
     if _instrument:
         _XFER_BYTES.inc(nbytes, direction="d2h")
         _XFER_TRANSFERS.inc(direction="d2h")

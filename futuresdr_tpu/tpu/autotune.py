@@ -427,6 +427,19 @@ def _norm_entry(v) -> Optional[dict]:
                         out["pallas_blocks"] = tbl
                 except (TypeError, ValueError, AttributeError):
                     pass
+            w = v.get("wire")
+            if w is not None:
+                # round-22 axis (single-shot uplink plane): the adaptive
+                # wire policy's measured start format — same per-axis
+                # guard, an unknown format name (a newer revision's codec)
+                # loses only this axis, never the entry's valid picks
+                try:
+                    from ..ops.wire import WIRE_FORMATS
+                    w = str(w).strip().lower()
+                    if w in WIRE_FORMATS:
+                        out["wire"] = w
+                except (TypeError, ValueError):
+                    pass
             return out
         return {"k": int(v), "inflight": None}
     except (TypeError, ValueError, KeyError):
@@ -504,6 +517,8 @@ def _record_sig(sig: tuple, frames_per_dispatch: int,
     if prev and prev.get("pallas_blocks"):
         entry["pallas_blocks"] = {d: dict(b) for d, b
                                   in prev["pallas_blocks"].items()}
+    if prev and prev.get("wire"):
+        entry["wire"] = prev["wire"]
     _streamed_cache[sig] = entry
     # K-only records persist in the legacy bare-int form (readable by older
     # processes); the dict form is written only when it carries more
@@ -648,6 +663,45 @@ def cached_shard_devices(stages, in_dtype, platform: str) -> Optional[int]:
     if entry is None:
         return None
     return entry.get("n_devices")
+
+
+# ---------------------------------------------------------------------------
+# adaptive-wire start-point axis (tpu/kernel_block.WireController,
+# docs/tpu_notes.md "The host data path")
+# ---------------------------------------------------------------------------
+
+def record_wire_start(stages, in_dtype, platform: str, fmt: str) -> None:
+    """Stamp the measured best wire format into this chain's streamed-pick
+    cache entry — the adaptive wire controller's START POINT. The mid-stream
+    policy (``tpu_adaptive_wire``) then begins at the format the last tune
+    measured fastest instead of the build-time default, and only moves off
+    it when the live SNR / link-occupancy windows say so. Unknown formats
+    are dropped, not stored (the :func:`_norm_entry` contract)."""
+    from ..ops.wire import WIRE_FORMATS
+    fmt = str(fmt).strip().lower()
+    if fmt not in WIRE_FORMATS:
+        return
+    sig = _streamed_sig(_serve_sig_stages(stages), in_dtype, platform)
+    _record_wire_sig(sig, fmt)
+
+
+def _record_wire_sig(sig: tuple, fmt: str) -> None:
+    cur = _streamed_cache.get(sig) or _disk_load().get(_sig_str(sig)) \
+        or {"k": 1, "inflight": None}
+    entry = {**cur, "wire": fmt}
+    _streamed_cache[sig] = entry
+    _disk_store(sig, entry)
+
+
+def cached_wire_start(stages, in_dtype, platform: str) -> Optional[str]:
+    """The wire format the chain's last :func:`autotune_streamed` measured
+    fastest (the adaptive policy's start point); None when never stamped
+    (pre-round-22 entries)."""
+    entry = cached_streamed_pick(_serve_sig_stages(stages), in_dtype,
+                                 platform)
+    if entry is None:
+        return None
+    return entry.get("wire")
 
 
 def autotune_shard(stages, in_dtype, frame: Optional[int] = None,
@@ -998,6 +1052,7 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
         # stages to one key — one record suffices
         record_streamed_pick(pipe, pipe.in_dtype, inst.platform, best[3],
                              inflight=best[2])
+        record_wire_start(pipe, pipe.in_dtype, inst.platform, best[0])
     elif isinstance(pipe, FanoutPipeline):
         # record BOTH fan-out-shaped signatures: the pipeline's (possibly
         # LTI-merged) stage names AND the caller's raw lists — the devchain
@@ -1007,10 +1062,12 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
         # as the linear branch below)
         record_streamed_pick(pipe, pipe.in_dtype, inst.platform, best[3],
                              inflight=best[2])
+        record_wire_start(pipe, pipe.in_dtype, inst.platform, best[0])
         raw_p, raw_b = pipe.raw_stage_lists
-        _record_sig(_make_sig(inst.platform, pipe.in_dtype,
-                              _fanout_names(raw_p, raw_b)), best[3],
-                    inflight=best[2])
+        raw_sig = _make_sig(inst.platform, pipe.in_dtype,
+                            _fanout_names(raw_p, raw_b))
+        _record_sig(raw_sig, best[3], inflight=best[2])
+        _record_wire_sig(raw_sig, best[0])
     else:
         # record under BOTH the caller's raw stage list and the optimized
         # pipeline stages: TpuStage/TpuKernel instances carry post-optimize
@@ -1018,6 +1075,8 @@ def autotune_streamed(stages: Sequence[Stage], in_dtype,
         for sig_stages in (list(stages), pipe.stages):
             record_streamed_pick(sig_stages, pipe.in_dtype, inst.platform,
                                  best[3], inflight=best[2])
+            record_wire_start(sig_stages, pipe.in_dtype, inst.platform,
+                              best[0])
     log.info("autotune_streamed best: wire=%s frame=%d depth=%d k=%d "
              "(%.1f Msps)", *best, best_rate)
     return best[0], best[1], best[2], results

@@ -262,6 +262,153 @@ class CreditController:
         self._t0 = time.perf_counter()
 
 
+class WireController:
+    """Mid-stream adaptive wire-format policy (opt-in: ``tpu_adaptive_wire``).
+
+    Sits next to :class:`CreditController` in the drain loop and watches two
+    live signals, both O(1) amortized per dispatch group:
+
+    * **signal quality** — a strided sample of each staged frame's float
+      components (peak + mean power). From it the controller PREDICTS the
+      quantization SNR each ladder format would give the current signal:
+      a uniform quantizer with step ``Δ = peak/qmax`` contributes
+      ``Δ²/12`` noise power, so ``snr = p_mean / (Δ²/12)`` — the same
+      model ``ops/wire.measure_snr_db`` verifies empirically.
+    * **link occupancy** — the modeled wire windows the transfer plane
+      attaches to each H2D finish (``_wire = (start, deadline)``, populated
+      under a fake/measured link): the busy fraction of the inter-dispatch
+      span. No wire signal (a real backend with no link model) reads as
+      idle, so the controller can only ever WIDEN there — it will not
+      chase throughput it cannot observe.
+
+    Decisions are HYSTERETIC, mirroring the credit controller: windowed
+    (``window`` dispatch groups per evaluation), two consecutive windows
+    must agree before a switch is proposed, and a holdoff follows every
+    switch so the ladder cannot oscillate. The policy:
+
+    * WIDEN (toward f32) when the ACTIVE format's predicted SNR falls
+      below the budget — the signal's dynamic range outgrew the wire.
+    * NARROW (toward sc8) only when the link is BUSY (occupancy above
+      ``occupancy_bar``) and the narrower format's predicted SNR clears
+      the budget plus a safety margin — bytes are the bottleneck and the
+      signal has headroom to spare.
+
+    The controller only PROPOSES; the kernel applies the switch at a
+    quiescent dispatch-group boundary (``_maybe_switch_wire``) so no
+    in-flight frame ever spans two programs."""
+
+    LADDER = ("f32", "sc16", "sc8")      # widest → narrowest
+    QMAX = {"sc16": 32767.0, "sc8": 127.0}
+
+    __slots__ = ("budget_db", "margin_db", "window", "holdoff",
+                 "occupancy_bar", "_peak", "_power", "_nstat", "_busy_s",
+                 "_count", "_vote", "_votes", "_hold", "_t0",
+                 "last_snr_db")
+
+    def __init__(self, budget_db: float, window: int = 16,
+                 holdoff: int = 4, margin_db: float = 6.0,
+                 occupancy_bar: float = 0.92):
+        self.budget_db = float(budget_db)
+        self.margin_db = float(margin_db)
+        self.window = int(window)
+        self.holdoff = int(holdoff)           # windows muted after a switch
+        self.occupancy_bar = float(occupancy_bar)
+        self.reset()
+
+    def reset(self) -> None:
+        self._peak = 0.0
+        self._power = 0.0
+        self._nstat = 0
+        self._busy_s = 0.0
+        self._count = 0
+        self._vote = None            # format the current streak argues for
+        self._votes = 0              # consecutive windows agreeing on it
+        self._hold = 0
+        self._t0 = time.perf_counter()
+        self.last_snr_db = float("inf")   # the deciding window's active SNR
+
+    # -- signal feeds --------------------------------------------------------
+    def observe_frame(self, frame: np.ndarray) -> None:
+        """Fold a strided sample of one staged frame's float components
+        (≤512 points — the stats cost must vanish next to the encode)."""
+        x = np.asarray(frame)
+        if x.dtype.kind == "c":
+            x = x.view(np.float64 if x.dtype == np.complex128
+                       else np.float32)
+        elif x.dtype.kind != "f":
+            return                   # int passthrough: no quantization story
+        x = x.reshape(-1)
+        if not x.size:
+            return
+        s = np.abs(x[::max(1, x.size // 512)].astype(np.float32))
+        peak = float(s.max())
+        if peak > self._peak:
+            self._peak = peak
+        self._power += float(np.mean(np.square(s)))
+        self._nstat += 1
+
+    def note_dispatch(self, wire: Optional[tuple]) -> None:
+        """Fold one dispatch group's H2D wire window (same tuple the credit
+        controller reads)."""
+        if wire:
+            start, deadline = wire
+            if deadline and deadline > start:
+                self._busy_s += deadline - start
+        self._count += 1
+
+    # -- prediction ----------------------------------------------------------
+    def predicted_snr_db(self, fmt: str) -> float:
+        """The windowed signal's predicted SNR under ``fmt`` (inf for exact
+        formats or when no stats accumulated)."""
+        qmax = self.QMAX.get(fmt)
+        if qmax is None or self._nstat == 0 or self._peak <= 0.0:
+            return float("inf")
+        p_mean = self._power / self._nstat
+        if p_mean <= 0.0:
+            return float("inf")
+        delta = self._peak / qmax
+        return 10.0 * float(np.log10(p_mean / (delta * delta / 12.0)))
+
+    # -- decision ------------------------------------------------------------
+    def propose(self, current: str) -> Optional[str]:
+        """Evaluate at window boundaries; the target format after two
+        agreeing windows, else None. Callers apply the switch themselves
+        (at a quiescent boundary) — a returned proposal arms the holdoff."""
+        if self._count < self.window or current not in self.LADDER:
+            return None
+        span = max(time.perf_counter() - self._t0, 1e-9)
+        occupancy = min(1.0, self._busy_s / span)
+        want = None
+        pos = self.LADDER.index(current)
+        self.last_snr_db = self.predicted_snr_db(current)
+        if self.last_snr_db < self.budget_db and pos > 0:
+            want = self.LADDER[pos - 1]                  # widen
+        elif occupancy >= self.occupancy_bar and pos + 1 < len(self.LADDER) \
+                and self.predicted_snr_db(self.LADDER[pos + 1]) \
+                >= self.budget_db + self.margin_db:
+            want = self.LADDER[pos + 1]                  # narrow
+        # window bookkeeping (stats are per-window, votes persist across)
+        self._peak = 0.0
+        self._power = 0.0
+        self._nstat = 0
+        self._busy_s = 0.0
+        self._count = 0
+        self._t0 = time.perf_counter()
+        if self._hold > 0:
+            self._hold -= 1
+            self._vote, self._votes = None, 0
+            return None
+        if want is None or want != self._vote:
+            self._vote, self._votes = want, (1 if want else 0)
+            return None
+        self._votes += 1
+        if self._votes < 2:
+            return None
+        self._vote, self._votes = None, 0
+        self._hold = self.holdoff
+        return want
+
+
 class TpuKernel(Kernel):
     BLOCKING = True
 
@@ -448,6 +595,114 @@ class TpuKernel(Kernel):
         # serialize after the previous frame's compute instead of riding under
         # it (depth=1 keeps 0: strictly serial semantics for A/B baselines)
         self.stage_ahead = 1 if self.depth > 1 else 0
+        # ---- the single-shot uplink plane (docs/tpu_notes.md) --------------
+        # transfer coalescing: multi-part wires (quantizers shipping
+        # payload+scale) pack a dispatch group into ONE contiguous buffer,
+        # unpacked by a device-side slicing prolog fused into the program
+        # (ops/xfer.PackedLayout / ops/stages.packed_wired_fn). Single-part
+        # wires stay on the per-part path: they already cost one H2D start,
+        # and packing would add a copy of the f32 pairs view for nothing.
+        self._resolve_packed()
+        # zero-copy ingest: registered externally-owned read-only buffers
+        # (ops/ingest.py) skip the ring-exit staging copy on aliasing wires
+        self._ingest_enabled = bool(config().get("tpu_zero_copy_ingest",
+                                                 True)) and \
+            self.wire.encode_may_alias(self.pipeline.in_dtype)
+        self._ingest_frames = 0
+        self._staged_frames = 0
+        # deferred-consume staging: quantizing K=1 pool encodes read the ring
+        # slot IN PLACE (consume() deferred until the worker's encode has
+        # read it), so only the int payload lands in the arena — the staging
+        # copy the quant path would otherwise need to offload its encode
+        self._deferred_consume = self._codec_pool is not None and \
+            not self.wire.encode_may_alias(self.pipeline.in_dtype) and \
+            self.k_batch == 1 and \
+            bool(config().get("tpu_deferred_consume", True))
+        self._consume_event = None     # armed per staged frame (see _stage_*)
+        self._pending_consume = None   # (event, n_items) awaiting consume()
+        # mid-stream adaptive wire switching (off by default: the wire is
+        # part of the numerics contract) — controller lives in _init_wirectl
+        self._init_wirectl()
+
+    def _resolve_packed(self) -> None:
+        """(Re-)derive the uplink coalescing layout for the CURRENT
+        wire/frame/K signature (``ops/xfer.PackedLayout.probe`` — None for
+        single-part wires, where coalescing is moot, and when
+        ``tpu_coalesce`` is off). Called at construction and again by every
+        wire switch; any probe failure falls back to the per-part path."""
+        from ..config import config
+        self._packed = None
+        if not bool(config().get("tpu_coalesce", True)):
+            return
+        try:
+            self._packed = xfer.PackedLayout.probe(
+                self.wire, self.frame_size, self.pipeline.in_dtype,
+                k=self.k_batch)
+        except Exception as e:         # noqa: BLE001 — per-part fallback
+            log.warning("%s: uplink coalescing probe failed (%r) — "
+                        "shipping per-part", type(self).__name__, e)
+
+    def _init_wirectl(self) -> None:
+        """Arm the adaptive wire controller (``tpu_adaptive_wire``, off by
+        default: the wire format is part of the numerics contract, so
+        retuning it mid-stream must be an explicit opt-in). Disarms itself
+        when the starting wire is off the controller's ladder (bf16,
+        passthrough) or the input is not float/complex — there is no
+        quantization-SNR story to steer by."""
+        from ..config import config
+        self._wire0 = self.wire.name        # the built format (restore base)
+        self._wire_floor_fmt = self.wire.name
+        # (seq, fmt) per applied switch, seq = first dispatch group shipped
+        # under fmt — pruned by the committed-checkpoint floor exactly like
+        # the retune log, replayed by recover() so a restore point before a
+        # switch re-applies it at its original group boundary
+        self._wire_log: Deque[tuple] = deque()
+        self._replay_wire_switches: Deque[tuple] = deque()
+        self._wire_switch_target = None     # proposed, awaiting quiescence
+        self._wire_switches = 0
+        self._wirectl = None
+        if not bool(config().get("tpu_adaptive_wire", False)):
+            return
+        if self.wire.name not in WireController.LADDER or \
+                np.dtype(self.pipeline.in_dtype).kind not in "fc":
+            log.info("%s: adaptive wire disarmed (wire %s / in dtype %s "
+                     "off the f32/sc16/sc8 ladder)", type(self).__name__,
+                     self.wire.name, np.dtype(self.pipeline.in_dtype))
+            return
+        self._wirectl = WireController(
+            float(config().get("tpu_wire_snr_budget_db", 40.0)))
+        # arming the controller hands it the wire format — start from the
+        # point the last autotune_streamed measured fastest for this chain
+        # (the round-22 "wire" axis of the streamed-pick cache) instead of
+        # the build-time default; the live SNR/occupancy windows take over
+        # from there. Construction-time swap: nothing is compiled yet, so
+        # this is a re-derivation, not a switch (the replay log stays empty
+        # and _wire0/_wire_floor_fmt rebase onto the adopted format).
+        try:
+            from .autotune import cached_wire_start
+            sig = self.pipeline if getattr(self.pipeline, "n_branches", 0) \
+                else self.pipeline.stages
+            fmt = cached_wire_start(sig, self.pipeline.in_dtype,
+                                    self.inst.platform)
+        except Exception:                  # noqa: BLE001 — seed only
+            fmt = None
+        if fmt and fmt != self.wire.name and fmt in WireController.LADDER:
+            from ..ops.wire import get_wire
+            log.info("%s: adaptive wire starts at %s (cached "
+                     "autotune_streamed pick; built %s)",
+                     type(self).__name__, fmt, self.wire.name)
+            self.wire = get_wire(fmt)
+            self._wire0 = self._wire_floor_fmt = fmt
+            self._resolve_packed()
+            self._encode_offload = self._codec_pool is not None and \
+                self.wire.encode_may_alias(self.pipeline.in_dtype)
+            self._ingest_enabled = bool(
+                config().get("tpu_zero_copy_ingest", True)) and \
+                self.wire.encode_may_alias(self.pipeline.in_dtype)
+            self._deferred_consume = self._codec_pool is not None and \
+                not self.wire.encode_may_alias(self.pipeline.in_dtype) and \
+                self.k_batch == 1 and \
+                bool(config().get("tpu_deferred_consume", True))
 
     def _adopt_credit_mode(self, adaptive: bool) -> None:
         """Re-arm the credit controller post-construction. The device-graph
@@ -482,7 +737,39 @@ class TpuKernel(Kernel):
             "interior_precision": self._precision_mode,
             "interior_lowered": (self._precision_plan.lowered
                                  if self._precision_plan is not None else 0),
+            # the single-shot uplink plane: physical h2d starts per dispatch
+            # group (coalesced multi-part wires collapse to 1; single-part
+            # wires were already 1), the zero-copy ingest hit fraction, and
+            # the adaptive-wire policy state
+            "uplink_coalesced": int(self._packed is not None),
+            "h2d_starts_per_frame": (
+                1 if self._packed is not None
+                else self.wire.part_count(self.pipeline.in_dtype)),
+            "ingest_zero_copy_frac": (
+                self._ingest_frames / self._staged_frames
+                if self._staged_frames else 0.0),
+            "deferred_consume": int(self._deferred_consume),
+            "adaptive_wire": int(self._wirectl is not None),
+            "wire_switches": self._wire_switches,
         }
+
+    def _warm_parts(self, jax, in_dtype) -> tuple:
+        """Device input parts for a compile-cache warmup call: an encode of
+        zeros, K-stacked for megabatch programs, packed into one buffer when
+        the uplink coalesces (the warm call must trace the SAME program
+        signature the hot path dispatches). Raw ``device_put`` — the fake
+        link must not bill warmup bytes."""
+        parts = self.wire.encode_host(
+            np.zeros(self.frame_size, dtype=in_dtype))
+        if self.k_batch > 1:
+            parts = tuple(np.stack([np.asarray(p)] * self.k_batch)
+                          for p in parts)
+        if self._packed is not None:
+            buf = self._packed.pack([np.asarray(p) for p in parts],
+                                    np.empty(self._packed.nbytes, np.uint8))
+            return (jax.device_put(buf, self.inst.device),)
+        return tuple(jax.device_put(np.asarray(p), self.inst.device)
+                     for p in parts)
 
     async def init(self, mio, meta):
         import jax
@@ -543,22 +830,16 @@ class TpuKernel(Kernel):
         with _profile.compiling(prog_name, reason, prog_sig):
             self._compiled, self._carry = self.pipeline.compile_wired(
                 self.frame_size, self.wire, device=self.inst.device,
-                k=self.k_batch, donate=self._donate)
+                k=self.k_batch, donate=self._donate, packed=self._packed)
             # warm the compile cache off the hot path (raw device_put: the
             # fake link must not bill warmup bytes), then reset carry state
-            parts = self.wire.encode_host(
-                np.zeros(self.frame_size, dtype=self.pipeline.in_dtype))
-            if self.k_batch > 1:
-                parts = tuple(np.stack([np.asarray(p)] * self.k_batch)
-                              for p in parts)
-            dev = tuple(jax.device_put(np.asarray(p), self.inst.device)
-                        for p in parts)
+            dev = self._warm_parts(jax, self.pipeline.in_dtype)
             warm_carry, y = self._compiled(self._carry, *dev)
             jax.block_until_ready(y)
         del warm_carry  # donated buffers; fresh carry below
         _, self._carry = self.pipeline.compile_wired(
             self.frame_size, self.wire, device=self.inst.device,
-            k=self.k_batch, donate=self._donate)
+            k=self.k_batch, donate=self._donate, packed=self._packed)
         # roofline attribution: register the DISPATCHED program form's
         # cost_analysis() flops/bytes (wired + megabatch scan) — lazily, so
         # init pays nothing; the cost-analysis AOT compile happens once per
@@ -766,14 +1047,8 @@ class TpuKernel(Kernel):
                                 f"precision:{name}={prec}"):
             self._compiled, fresh = new_pipe.compile_wired(
                 self.frame_size, self.wire, device=self.inst.device,
-                k=self.k_batch, donate=self._donate)
-            parts = self.wire.encode_host(
-                np.zeros(self.frame_size, dtype=new_pipe.in_dtype))
-            if self.k_batch > 1:
-                parts = tuple(np.stack([np.asarray(p)] * self.k_batch)
-                              for p in parts)
-            dev = tuple(jax.device_put(np.asarray(p), self.inst.device)
-                        for p in parts)
+                k=self.k_batch, donate=self._donate, packed=self._packed)
+            dev = self._warm_parts(jax, new_pipe.in_dtype)
             warm_carry, y = self._compiled(fresh, *dev)
             jax.block_until_ready(y)
         del warm_carry
@@ -836,6 +1111,113 @@ class TpuKernel(Kernel):
         log.info("%s: interior precision retune %s=%s (lowered %d stage(s), "
                  "min SNR %s dB)", prog_name, name, prec, plan.lowered,
                  plan.min_snr_db)
+
+    def apply_wire_retune(self, fmt: str) -> None:
+        """Request a mid-stream wire-format switch (the ctrl-style manual
+        entry point; the adaptive controller lands on the same path). The
+        switch is DEFERRED to the next quiescent dispatch-group boundary —
+        no in-flight frame may span two wire programs — and applied by
+        :meth:`_maybe_switch_wire` from the staging loop."""
+        from ..ops.wire import WIRE_FORMATS
+        fmt = str(fmt)
+        if fmt not in WIRE_FORMATS:
+            raise ValueError(f"unknown wire format {fmt!r} "
+                             f"(expected one of {sorted(WIRE_FORMATS)})")
+        if fmt == self.wire.name:
+            return
+        self._wire_switch_target = fmt
+
+    def _apply_wire_program(self, fmt: str, reason: str = "adaptive") -> None:
+        """Swap the wire codec and rebuild everything derived from it — the
+        PROGRAM-change surgery of the adaptive wire plane. Must run at a
+        dispatch-group boundary: the live path enters via
+        :meth:`_maybe_switch_wire` only when nothing is staged or in flight;
+        the replay path applies it between groups in ``_launch_staged``
+        (younger in-flight groups decode with their dispatch-time codec —
+        ``_wrap_landing`` captures it). The carry is wire-INDEPENDENT (the
+        codec lives at the program boundary, not in the state), so unlike a
+        precision retune no leaf conversion is needed; the recompile is
+        billed ``reason="reinit"`` on the profile plane and the switch lands
+        in the event journal."""
+        import jax
+        from ..ops.wire import get_wire
+        if fmt == self.wire.name:
+            return
+        old = self.wire.name
+        from ..config import config
+        self.wire = get_wire(fmt)
+        self._resolve_packed()
+        self._encode_offload = self._codec_pool is not None and \
+            self.wire.encode_may_alias(self.pipeline.in_dtype)
+        self._ingest_enabled = bool(config().get("tpu_zero_copy_ingest",
+                                                 True)) and \
+            self.wire.encode_may_alias(self.pipeline.in_dtype)
+        self._deferred_consume = self._codec_pool is not None and \
+            not self.wire.encode_may_alias(self.pipeline.in_dtype) and \
+            self.k_batch == 1 and \
+            bool(config().get("tpu_deferred_consume", True))
+        if getattr(self, "_part_counts", None) is not None:
+            self._part_counts = self.pipeline.part_counts(self.wire)
+        self._wire_switches += 1
+        prog_name = self.meta.instance_name or type(self).__name__
+        if self._carry is not None:
+            # recompile + warm with a scratch carry: the LIVE carry must
+            # survive (donation would eat it), and switching BACK to a
+            # previously-used format hits the cached wired fn / jit entry
+            with _profile.compiling(prog_name, "reinit", f"wire:{fmt}"):
+                self._compiled, fresh = self.pipeline.compile_wired(
+                    self.frame_size, self.wire, device=self.inst.device,
+                    k=self.k_batch, donate=self._donate,
+                    packed=self._packed)
+                dev = self._warm_parts(jax, self.pipeline.in_dtype)
+                warm_carry, y = self._compiled(fresh, *dev)
+                jax.block_until_ready(y)
+            del warm_carry
+            # the registered cost thunk must describe the NEW wire program
+            pipe, fs2, wn2, kb2 = self.pipeline, self.frame_size, \
+                self.wire.name, self.k_batch
+
+            def _cost():
+                from ..utils.roofline import program_cost
+                return program_cost(pipe, fs2, wire=wn2, k=kb2)
+
+            from ..utils.roofline import dominant_dtype
+            self._prof = _profile.register(
+                prog_name, cost_thunk=_cost,
+                dtype=dominant_dtype(pipe.stages))
+        _journal.emit("kernel", "wire-switch", block=prog_name,
+                      old=old, new=fmt, reason=reason, seq=int(self._seq))
+        log.info("%s: wire switched %s -> %s (%s) at group %d", prog_name,
+                 old, fmt, reason, self._seq)
+
+    def _maybe_switch_wire(self) -> None:
+        """The staging-loop gate of the adaptive wire plane: collect the
+        controller's proposal, then apply the pending switch once the
+        dispatch window is QUIESCENT (nothing staged, in flight, accumulated
+        or consume-deferred reads the old program). While a target is
+        pending the staging loop pauses and ``work()`` drains toward the
+        boundary."""
+        if self._wire_switch_target is None:
+            if self._wirectl is None or self._replay_pending():
+                return               # controller paused inside a replay
+            tgt = self._wirectl.propose(self.wire.name)
+            if tgt is None:
+                return
+            self._wire_switch_target = tgt
+            log.info("%s: adaptive wire proposes %s -> %s (snr %.1f dB, "
+                     "budget %.1f dB) — draining to the switch boundary",
+                     self.meta.instance_name or type(self).__name__,
+                     self.wire.name, tgt, self._wirectl.last_snr_db,
+                     self._wirectl.budget_db)
+        if self._staged or self._inflight or self._accum or \
+                self._replay_queue or self._pending_consume is not None:
+            return
+        tgt, self._wire_switch_target = self._wire_switch_target, None
+        if self._ckpt_every:
+            # replay contract: seq = the first group shipped under the new
+            # format (nothing is staged, so the next staged group is _seq)
+            self._wire_log.append((self._seq, tgt))
+        self._apply_wire_program(tgt)
 
     def _apply_replay_retunes(self, seq: int) -> None:
         """Re-apply logged carry surgery at its ORIGINAL dispatch boundary:
@@ -906,6 +1288,9 @@ class TpuKernel(Kernel):
         frame-relative; ``handle`` is the arena buffer backing ``frame``
         (None when the frame is allocation-fresh)."""
         t_in = time.perf_counter_ns()
+        self._staged_frames += 1
+        if self._wirectl is not None:
+            self._wirectl.observe_frame(frame)
         # frame-lineage sampling (telemetry/lineage.py): 1-in-N frames get a
         # trace id that rides the metas through every pipeline boundary;
         # stride 0 makes sample() one falsy check, tid 0 makes every
@@ -936,6 +1321,8 @@ class TpuKernel(Kernel):
         the host codec time to where it was actually paid.
 
         Returns ``(parts, pinned_handles, releasable_handles)``."""
+        if self._packed is not None:
+            return self._encode_group_packed(frames, frame_handles)
         t0 = _trace.now() if _trace.enabled else 0
         alloc = _arena_mod.GroupAlloc(self._arena) \
             if self._arena is not None else None
@@ -980,6 +1367,60 @@ class TpuKernel(Kernel):
         return (tuple(stacked),
                 alloc.handles if alloc is not None else [],
                 list(frame_handles))
+
+    def _encode_group_packed(self, frames: list, frame_handles: list) -> tuple:
+        """Coalesced-uplink form of :meth:`_encode_group`: every wire part of
+        the dispatch group lands in ONE contiguous packed buffer
+        (``ops/arena.PackedAlloc`` — the encode writes payloads through slot
+        views, so coalescing costs zero extra payload copies; bare parts
+        like the quantizer's scale scalar are settled in by
+        ``PackedLayout.pack``). The group ships as a single-element part
+        tuple, so the transfer plane bills ONE h2d start with the summed
+        bytes, the replay log retains the EXACT shipped buffer, and a
+        retry/replay re-ships identical packed bytes. Packed wires are
+        quantizers — their parts never alias the staging frame — so every
+        frame handle is releasable."""
+        lay = self._packed
+        t0 = _trace.now() if _trace.enabled else 0
+        if self._arena is not None:
+            alloc = _arena_mod.PackedAlloc(self._arena, lay)
+            if self.k_batch == 1:
+                parts = self.wire.encode_into(frames[0], alloc)
+            else:
+                # megabatch: per-frame encodes are scratch; the K-stacked
+                # copies allocate (k,)+shape — exactly the layout's slots —
+                # so the stack writes land at their packed offsets directly
+                sub = alloc.temps_only()
+                parts_list = [self.wire.encode_into(f, sub) for f in frames]
+                stacked = []
+                for j in range(len(parts_list[0])):
+                    rows = [np.asarray(p[j]) for p in parts_list]
+                    out = alloc((len(rows),) + rows[0].shape, rows[0].dtype)
+                    for i, r in enumerate(rows):
+                        out[i] = r
+                    stacked.append(out)
+                alloc.drop_temps()
+                parts = tuple(stacked)
+            packed = alloc.finish(parts)
+            pinned = alloc.handles
+        else:
+            if self.k_batch == 1:
+                parts = self.wire.encode_host(frames[0])
+            else:
+                parts_list = [self.wire.encode_host(f) for f in frames]
+                parts = tuple(
+                    np.stack([np.asarray(p[j]) for p in parts_list])
+                    for j in range(len(parts_list[0])))
+            packed = lay.pack([np.asarray(p) for p in parts],
+                              np.empty(lay.nbytes, np.uint8))
+            pinned = []
+        if t0:
+            _trace.complete("tpu", "encode", t0,
+                            args={"wire": self.wire.name,
+                                  "items": len(frames) * self.frame_size,
+                                  "frames": len(frames),
+                                  "packed_bytes": lay.nbytes})
+        return (packed,), pinned, list(frame_handles)
 
     def _rlog_insert(self, seq: int, parts: tuple, metas: tuple,
                      handles) -> None:
@@ -1036,7 +1477,13 @@ class TpuKernel(Kernel):
         :meth:`_launch_staged` with the group still replayable (and still
         counted by the forfeit accounting when checkpointing is off)."""
         pool = self._codec_pool
-        if pool is None or not self._encode_offload:
+        # the pool path runs for aliasing-wire encode offload AND for a
+        # deferred-consume staged frame (quantizing K=1: the worker's encode
+        # reads the ring slot in place; ev signals the slot has been read so
+        # the staging loop may consume() — ops/ingest + docs/tpu_notes.md)
+        ev = self._consume_event
+        self._consume_event = None
+        if pool is None or not (self._encode_offload or ev is not None):
             parts, pinned, rel = self._encode_group(frames, frame_handles)
             _stamp_metas(metas, "encode")
             # a fatal start releases `pinned` inside _stage_group and leaves
@@ -1051,7 +1498,14 @@ class TpuKernel(Kernel):
         ck = self._ckpt_every
 
         def task():
-            parts, pinned, rel = self._encode_group(frames, frame_handles)
+            try:
+                parts, pinned, rel = self._encode_group(frames,
+                                                        frame_handles)
+            finally:
+                if ev is not None:
+                    # the encode has read (or abandoned) the ring slot —
+                    # the deferred consume() may advance the reader
+                    ev.set()
             # stamped on the codec WORKER thread — the flow link then renders
             # the encode hop where the work actually ran
             _stamp_metas(metas, "encode")
@@ -1063,7 +1517,12 @@ class TpuKernel(Kernel):
                 self._group_handles[seq] = pinned
             return xfer.start_device_transfer_parts(parts, self.inst.device)
 
-        fut = pool.submit_encode(task)
+        try:
+            fut = pool.submit_encode(task)
+        except BaseException:
+            if ev is not None:
+                ev.set()       # never leave the staging loop waiting
+            raise
 
         def join():
             fin = fut.result()
@@ -1099,6 +1558,9 @@ class TpuKernel(Kernel):
         swallowed — they already surfaced, or the restart supersedes them):
         recovery and re-init must observe a settled replay log and a
         complete arena-handle registry before clearing either."""
+        # a deferred ring consume must land first: the frame was staged and
+        # logged, so leaving it unconsumed would re-deliver it after recovery
+        self._settle_deferred_consume()
         for dq in (self._staged, self._inflight):
             for entry in dq:
                 s = getattr(entry[0], "_settle", None)
@@ -1181,6 +1643,15 @@ class TpuKernel(Kernel):
             # (empty deque outside recovery — one truthiness check)
             if self._replay_retunes:
                 self._apply_replay_retunes(seq)
+            # replay-aware wire switches: a logged format switch recorded at
+            # or before this group re-applies NOW, so every replayed group
+            # dispatches under the exact program (and packed layout) that
+            # first shipped it — bit-exact through the switch boundary
+            while self._replay_wire_switches and \
+                    self._replay_wire_switches[0][0] <= seq:
+                self._apply_wire_program(
+                    self._replay_wire_switches.popleft()[1],
+                    reason="replay")
             # donation fence: the snapshot D2H of the previous carry must be
             # host-side before this dispatch donates and reuses its buffers
             self._materialize_pending_ckpts()
@@ -1209,6 +1680,8 @@ class TpuKernel(Kernel):
                 self._prof.dispatch(t=time.monotonic())
             self._credits.note_dispatch(getattr(h2d, "_wire", None),
                                         len(self._inflight))
+            if self._wirectl is not None:
+                self._wirectl.note_dispatch(getattr(h2d, "_wire", None))
         if self._staged and len(self._inflight) >= self._credits.credits:
             self._credits.note_limited()
 
@@ -1221,12 +1694,17 @@ class TpuKernel(Kernel):
         under this thread's staging/dispatch of younger frames; emission
         order is preserved because the caller joins the in-flight deque
         oldest-first."""
+        # decode with the codec active at DISPATCH time: during an adaptive
+        # wire switch's replay window, in-flight groups may precede a
+        # re-applied switch — each must land under its own wire
+        wire = self.wire
+
         def land():
             raw = finish()
             _stamp_metas(out_metas, "D2H")
             if drop:
                 return None
-            payload = self._decode_group(raw, out_metas)
+            payload = self._decode_group(raw, out_metas, wire)
             _stamp_metas(out_metas, "decode")
             return payload
 
@@ -1241,14 +1719,16 @@ class TpuKernel(Kernel):
         join._settle = lambda: _settle_future(fut)
         return join
 
-    def _decode_group(self, raw, out_metas):
+    def _decode_group(self, raw, out_metas, wire=None):
         """Host-decode one landed dispatch group (runs on the drain thread,
-        or on a codec worker under the pool). Returns
+        or on a codec worker under the pool; ``wire`` is the codec captured
+        at dispatch — see :meth:`_wrap_landing`). Returns
         ``(result, tags, t_ins)``."""
+        wire = wire if wire is not None else self.wire
         t0 = _trace.now() if _trace.enabled else 0
         if self.k_batch == 1:
             ((valid, tags, t_in, _tid),) = out_metas
-            arr = self.wire.decode_host(raw, self.pipeline.out_dtype)
+            arr = wire.decode_host(raw, self.pipeline.out_dtype)
             result, all_tags = arr[:valid], list(tags)
             t_ins = (t_in,)
         else:
@@ -1256,7 +1736,7 @@ class TpuKernel(Kernel):
             for i, (valid, tags, _tin, _tid) in enumerate(out_metas):
                 row = tuple(p[i] for p in raw)
                 chunks.append(
-                    self.wire.decode_host(row, self.pipeline.out_dtype)[:valid])
+                    wire.decode_host(row, self.pipeline.out_dtype)[:valid])
                 all_tags.extend(ItemTag(t.index + off, t.tag) for t in tags)
                 off += valid
             result = (np.concatenate(chunks) if chunks
@@ -1264,7 +1744,7 @@ class TpuKernel(Kernel):
             t_ins = tuple(tin for _, _, tin, _ in out_metas)
         if t0:
             _trace.complete("tpu", "decode", t0,
-                            args={"wire": self.wire.name,
+                            args={"wire": wire.name,
                                   "items": len(result)})
         return result, all_tags, t_ins
 
@@ -1505,6 +1985,11 @@ class TpuKernel(Kernel):
                 # restorable checkpoint — same retention rule as the log
                 while self._retune_log and self._retune_log[0][0] <= floor:
                     self._retune_log.popleft()
+                # wire switches prune the same way, but the format is NOT in
+                # the carry — remember the format in effect AT the floor so
+                # a restore below every surviving entry knows its wire
+                while self._wire_log and self._wire_log[0][0] <= floor:
+                    self._wire_floor_fmt = self._wire_log.popleft()[1]
 
     def _recovery_reset(self, purge_disk: bool = False) -> None:
         """Drop every checkpoint/replay artifact (fresh incarnation, or a
@@ -1532,6 +2017,12 @@ class TpuKernel(Kernel):
         self._replay_high = -1
         self._retune_log.clear()
         self._replay_retunes.clear()
+        self._wire_log.clear()
+        self._replay_wire_switches.clear()
+        self._wire_floor_fmt = self.wire.name
+        self._wire_switch_target = None
+        if self._wirectl is not None:
+            self._wirectl.reset()
         if purge_disk and self._ckpt_dir:
             path = self._ckpt_file()
             if path:
@@ -1651,7 +2142,7 @@ class TpuKernel(Kernel):
                 f"k={self.k_batch}"):
             self._compiled, fresh = self.pipeline.compile_wired(
                 self.frame_size, self.wire, device=self.inst.device,
-                k=self.k_batch, donate=self._donate)
+                k=self.k_batch, donate=self._donate, packed=self._packed)
         if self._seq == 0 and not self._rlog and self._ckpt_dir:
             # VIRGIN incarnation (nothing dispatched, nothing to replay):
             # the only meaningful state is a previous PROCESS's persisted
@@ -1671,6 +2162,8 @@ class TpuKernel(Kernel):
                     self._pending_ckpts.clear()
                     self._replay_queue.clear()
                     self._replay_retunes.clear()
+                    self._replay_wire_switches.clear()
+                    self._wire_switch_target = None
                     # seed the ring with the DISK carry as a real candidate
                     # at the pre-stream position: a later in-process fault
                     # (before the first new commit) must replay this
@@ -1733,6 +2226,22 @@ class TpuKernel(Kernel):
         seq, leaves, treedef = chosen
         self._carry = fresh if leaves is None else \
             self.pipeline.restore_carry(leaves, treedef, self.inst.device)
+        # adaptive-wire replay contract: the first replayed group (seq+1)
+        # must dispatch under the wire it was FIRST shipped with — rewind
+        # to the format in effect there, and queue every later logged
+        # switch for re-application at its original boundary
+        # (_launch_staged). A stale pending proposal dies with the fault.
+        self._wire_switch_target = None
+        fmt = self._wire_floor_fmt
+        for s, f in self._wire_log:
+            if s <= seq + 1:
+                fmt = f
+        self._replay_wire_switches = deque(
+            (s, f) for s, f in self._wire_log if s > seq + 1)
+        if fmt != self.wire.name:
+            self._apply_wire_program(fmt, reason="recover")
+        if self._wirectl is not None:
+            self._wirectl.reset()
         # rebuild the dispatch window purely from the log: every group after
         # the checkpoint re-ships its exact staging parts; groups that had
         # already drained only re-advance the carry (drop=True). QUEUED, not
@@ -1785,9 +2294,26 @@ class TpuKernel(Kernel):
         view; ``ops/xfer.h2d_needs_staging`` is always True); in pool mode
         the worker-side encode then reads the copy, never the ring. With the
         arena on, the copy lands in recycled pages instead of a fresh
-        allocation."""
+        allocation.
+
+        Zero-copy ingest fast path (ops/ingest.py): a frame backed by a
+        REGISTERED externally-owned read-only buffer skips the copy — nobody
+        reclaims that memory behind the async H2D, so the ring-exit-race
+        rationale above does not apply. The ingest handle rides the group's
+        pin/replay retention exactly like the arena handle the copy would
+        have had (retained here, released when the group drains / the
+        replay log prunes), so the owner's ``pinned`` flag covers fault
+        replay too. Writable frames never match (``ingest.lookup``) — the
+        copying fallback is bit-identical."""
         if not self._needs_staging:
             return frame, None
+        if self._ingest_enabled:
+            from ..ops import ingest as _ingest_mod
+            h = _ingest_mod.lookup(frame)
+            if h is not None:
+                self._ingest_frames += 1
+                _ingest_mod.note_zero_copy()
+                return frame, h.retain()
         if not self.wire.encode_may_alias(frame.dtype) and self.k_batch == 1:
             # quantizing wires materialize fresh arrays in the encode
             # before consume() — inline in pool mode too (encode offload is
@@ -1803,6 +2329,38 @@ class TpuKernel(Kernel):
             return self._arena.copy_in(frame)
         return frame.copy(), None
 
+    def _stage_deferred(self, frame: np.ndarray, tags) -> None:
+        """Stage one quantizing K=1 frame WITHOUT the ring-exit copy: the
+        codec worker's ``encode_into`` reads the live ring slot in place
+        (safe — the slot cannot be reclaimed before ``consume()``), so only
+        the int payload lands in the arena. ``consume()`` is deferred until
+        the worker signals the read (``_settle_deferred_consume``); the sync
+        fallback (``_submit_group`` took the synchronous path after all)
+        sets the event here — the encode already ran on this thread."""
+        ev = threading.Event()
+        self._consume_event = ev
+        self._pending_consume = (ev, self.frame_size)
+        try:
+            self._stage(frame, self.frame_size, tags, None)
+        finally:
+            if self._consume_event is ev:
+                # no pool task picked the event up: the encode (or the
+                # failure) already happened synchronously on this thread
+                self._consume_event = None
+                ev.set()
+
+    def _settle_deferred_consume(self) -> None:
+        """Land a deferred ring consume: wait until the worker's in-place
+        encode has read the slot, then advance the reader. At most one
+        consume is ever deferred, and the wait is bounded by the encode of
+        one frame (which started when the frame was staged)."""
+        if self._pending_consume is None:
+            return
+        ev, n = self._pending_consume
+        ev.wait()
+        self._pending_consume = None
+        self.input.consume(n)
+
     def _stage_available_input(self):
         """Step 2 of the work loop, shared with the fan-out kernel: stage as
         many full frames as the pipeline depth allows — each one's H2D starts
@@ -1812,6 +2370,13 @@ class TpuKernel(Kernel):
         handing it a live ring-buffer view would race with the writer
         overwriting consumed space — the frame must leave the ring before
         consume(). Returns ``(remaining input slice, eos)``."""
+        # a deferred consume from the previous cycle must land before the
+        # ring is sliced again (the unconsumed frame is still in the slice)
+        self._settle_deferred_consume()
+        # adaptive wire: collect the controller's proposal / apply a pending
+        # switch at a quiescent boundary (pauses staging while pending)
+        if self._wirectl is not None or self._wire_switch_target is not None:
+            self._maybe_switch_wire()
         budget = self._credits.credits + self.stage_ahead
         # replayed groups re-enter the dispatch window FIRST (sequence
         # order), under the same budget as live staging
@@ -1825,17 +2390,36 @@ class TpuKernel(Kernel):
             # before they re-enter (their sequence numbers precede it)
             return self.input.slice(), self.input.finished()
         inp = self.input.slice()
+        # a pending wire switch pauses staging so the window drains to the
+        # switch boundary — except a part-filled megabatch group, which must
+        # keep filling to its flush (mid-stream zero-padding would corrupt
+        # the carries; the switch waits one group longer instead)
         while len(self._staged) + len(self._inflight) < budget and \
-                len(inp) >= self.frame_size:
+                (self._wire_switch_target is None or self._accum):
+            # a pending deferred consume settles HERE, at the top: staging
+            # the next frame needs the read cursor advanced, but the LAST
+            # frame of a cycle stays pending into the next work() call so
+            # the worker's in-place encode overlaps dispatch/drain below
+            self._settle_deferred_consume()
+            inp = self.input.slice()
+            if len(inp) < self.frame_size:
+                break
             tags = self.input.tags(self.frame_size)
             frame = inp[:self.frame_size]
-            frame, handle = self._stage_copy(frame)
-            self._stage(frame, self.frame_size, tags, handle)
-            self.input.consume(self.frame_size)
+            if self._deferred_consume:
+                # quantizing K=1 + pool: the worker's encode reads the ring
+                # slot IN PLACE and only the int payload lands in the arena
+                # — consume() is deferred until the read (at most one)
+                self._stage_deferred(frame, tags)
+            else:
+                frame, handle = self._stage_copy(frame)
+                self._stage(frame, self.frame_size, tags, handle)
+                self.input.consume(self.frame_size)
             inp = self.input.slice()
 
         eos = self.input.finished()
         if eos and len(inp) > 0 and len(inp) < self.frame_size and \
+                self._pending_consume is None and \
                 len(self._staged) + len(self._inflight) < budget:
             # final partial frame: zero-pad, emit only the valid prefix
             if self._arena is not None:
@@ -1859,6 +2443,11 @@ class TpuKernel(Kernel):
             # EOS: a partial dispatch group cannot wait for more frames —
             # zero-pad it to the scan length and ship (pad outputs dropped)
             self._flush_accum()
+        if self._pending_consume is not None:
+            # the deferred frame is still in the ring slice but is already
+            # staged — report only the input BEYOND it, so the caller's
+            # starved/finished checks see the logical remainder
+            inp = inp[self._pending_consume[1]:]
         return inp, eos
 
     async def work(self, io, mio, meta):
@@ -1878,10 +2467,11 @@ class TpuKernel(Kernel):
 
         # 4. retrieve: when the pipe is full, when the input is starved (no full frame
         #    waiting — flush for latency; when saturated the credit gate keeps overlap),
-        #    or on EOS drain
+        #    on EOS drain, or while draining toward a pending wire switch
         should_drain = bool(self._inflight) and (
             len(self._inflight) >= self._credits.credits
-            or len(inp) < self.frame_size or eos)
+            or len(inp) < self.frame_size or eos
+            or self._wire_switch_target is not None)
         if should_drain:
             drained = self._drain_one()
             if drained is not None:      # None = replayed already-emitted group
@@ -2056,20 +2646,25 @@ class TpuFanoutKernel(TpuKernel):
             out_metas.append((tuple(per_branch), t_in, tid))
         return (finish, tuple(out_metas))
 
-    def _decode_group(self, raw, out_metas):
+    def _decode_group(self, raw, out_metas, wire=None):
         """Per-branch host decode of one landed group (the fan-out form of
         the base hook — runs on the drain thread, or on a codec worker under
-        the pool). Returns ``(results, t_ins)`` with one ``(result, tags)``
+        the pool; ``wire`` is the codec captured at dispatch). Returns
+        ``(results, t_ins)`` with one ``(result, tags)``
         per branch (megabatch groups concatenate their frames per branch,
         tag indices rebased by the branch's running offset)."""
         fo = self.pipeline
+        # the flat-output slicing key follows the dispatch-time wire too
+        pc = self._part_counts if wire is None or wire is self.wire \
+            else fo.part_counts(wire)
+        wire = wire if wire is not None else self.wire
         t0 = _trace.now() if _trace.enabled else 0
         nb = fo.n_branches
         results: List[Tuple[np.ndarray, list]] = []
         if self.k_batch == 1:
             ((per_branch, t_in, _tid),) = out_metas
             off = 0
-            for j, cnt in enumerate(self._part_counts):
+            for j, cnt in enumerate(pc):
                 parts_j = raw[off:off + cnt]
                 off += cnt
                 if self._branch_done[j]:
@@ -2078,7 +2673,7 @@ class TpuFanoutKernel(TpuKernel):
                     results.append((np.empty(0, fo.out_dtypes[j]), []))
                     continue
                 valid, tags = per_branch[j]
-                arr = self.wire.decode_host(parts_j, fo.out_dtypes[j])
+                arr = wire.decode_host(parts_j, fo.out_dtypes[j])
                 results.append((arr[:valid], list(tags)))
             t_ins = (t_in,)
         else:
@@ -2087,13 +2682,13 @@ class TpuFanoutKernel(TpuKernel):
             offsets = [0] * nb
             for i, (per_branch, _tin, _tid) in enumerate(out_metas):
                 off = 0
-                for j, cnt in enumerate(self._part_counts):
+                for j, cnt in enumerate(pc):
                     parts_j = tuple(p[i] for p in raw[off:off + cnt])
                     off += cnt
                     if self._branch_done[j]:
                         continue         # retired: skip the decode + concat
                     valid, tags = per_branch[j]
-                    chunks[j].append(self.wire.decode_host(
+                    chunks[j].append(wire.decode_host(
                         parts_j, fo.out_dtypes[j])[:valid])
                     all_tags[j].extend(ItemTag(t.index + offsets[j], t.tag)
                                        for t in tags)
@@ -2105,7 +2700,7 @@ class TpuFanoutKernel(TpuKernel):
             t_ins = tuple(tin for _, tin, _ in out_metas)
         if t0:
             _trace.complete("tpu", "decode", t0,
-                            args={"wire": self.wire.name,
+                            args={"wire": wire.name,
                                   "items": sum(len(r) for r, _ in results),
                                   "branches": nb})
         return results, t_ins
@@ -2153,10 +2748,11 @@ class TpuFanoutKernel(TpuKernel):
         inp, eos = self._stage_available_input()
         self._launch_staged()
 
-        # 4. per-branch retrieve/emit
+        # 4. per-branch retrieve/emit (wire-switch drain: base-class rule)
         should_drain = bool(self._inflight) and (
             len(self._inflight) >= self._credits.credits
-            or len(inp) < self.frame_size or eos)
+            or len(inp) < self.frame_size or eos
+            or self._wire_switch_target is not None)
         if should_drain:
             drained = self._drain_one()
             for j, (result, tags) in enumerate(drained or ()):

@@ -454,6 +454,76 @@ def scenario_arena_recycle_replay():
         codec_mod.reset_pool()
 
 
+def scenario_adaptive_wire_switch():
+    """Acceptance (mid-stream adaptive wire switching, ISSUE 18): the
+    signal's crest factor collapses mid-stream → the armed controller's
+    predicted quantization SNR falls under budget → the wire WIDENS
+    (sc8 → sc16) at a quiescent dispatch boundary — and a fault-injected
+    recovery straddling the switch replays bit-identically to the clean
+    adaptive run (the wire-switch log restores the format timeline exactly
+    like the retune log)."""
+    import asyncio
+
+    from futuresdr_tpu import Mocker
+    from futuresdr_tpu.config import config
+    from futuresdr_tpu.dsp import firdes
+    from futuresdr_tpu.ops import fir_stage, rotator_stage
+    from futuresdr_tpu.tpu import TpuKernel
+
+    frame = 1 << 11
+    taps = firdes.lowpass(0.2, 31).astype(np.float32)
+    rng = np.random.default_rng(17)
+    # phase 1: well-conditioned (sc8 SNR clears the 40 dB budget) — then
+    # the crest factor collapses: one full-scale spike over a quiet floor
+    # per frame drags the predicted sc8 SNR far under budget
+    good = (0.5 * (rng.standard_normal(frame * 8)
+                   + 1j * rng.standard_normal(frame * 8))
+            ).astype(np.complex64)
+    bad = np.full(frame * 40, 1e-4 + 0j, np.complex64)
+    bad[frame // 2::frame] = 1.0 + 0j
+    tail = (0.5 * (rng.standard_normal(frame * 6)
+                   + 1j * rng.standard_normal(frame * 6))
+            ).astype(np.complex64)
+
+    c = config()
+    saved = c.tpu_adaptive_wire
+    c.tpu_adaptive_wire = True
+
+    def one_run(fault_after_switch):
+        mk = TpuKernel([fir_stage(taps, fft_len=256),
+                        rotator_stage(0.05)], np.complex64,
+                       frame_size=frame, frames_in_flight=2, wire="sc8",
+                       checkpoint_every=2)
+        assert mk._wirectl is not None, "controller failed to arm"
+        m = Mocker(mk)
+        m.init_output("out", (len(good) + len(bad) + len(tail)) * 2)
+        m.init()
+        m.input("in", good)
+        m.run()
+        assert mk.wire.name == "sc8", "no switch on healthy signal"
+        m.input("in", bad)
+        m.run()
+        assert mk.wire.name == "sc16", \
+            f"SNR drop did not widen the wire (still {mk.wire.name})"
+        assert mk.extra_metrics()["wire_switches"] >= 1
+        if fault_after_switch:
+            assert asyncio.run(
+                mk.recover(RuntimeError("injected chaos fault")))
+            assert mk.wire.name == "sc16", "recovery lost the switch"
+        m.input("in", tail)
+        m.run()
+        return m.output("out").copy()
+
+    try:
+        clean = one_run(fault_after_switch=False)
+        faulted = one_run(fault_after_switch=True)
+        np.testing.assert_array_equal(faulted, clean)
+    finally:
+        c.tpu_adaptive_wire = saved
+    print("  adaptive_wire_switch: widened sc8->sc16 under SNR drop, "
+          "bit-exact through recovery")
+
+
 def scenario_isolate_group():
     """Acceptance (isolate groups): one member of a named 3-block subgraph
     dies → the WHOLE group retires (topo-order port EOS, clean drain), the
@@ -1129,6 +1199,7 @@ SCENARIOS = (
     ("transfer_retry_deterministic", scenario_transfer_retry_deterministic),
     ("stateful-restart-replay", scenario_stateful_restart_replay),
     ("arena-recycle-replay", scenario_arena_recycle_replay),
+    ("adaptive-wire-switch", scenario_adaptive_wire_switch),
     ("isolate-group", scenario_isolate_group),
     ("tenant-isolation", scenario_tenant_isolation),
     ("serve-crash-restart", scenario_serve_crash_restart),

@@ -144,6 +144,36 @@ def smoke() -> None:
     # the binding-direction utilization floor: the drain loop must keep the
     # replayed link busy, not just beat the old path
     assert u_on >= 0.70, f"streamed link utilization {u_on:.2f} < 0.70"
+
+    # (3) the packed (coalesced) transfer class — single-shot uplink round:
+    # a quantizing wire now stages its payload+scale parts as ONE contiguous
+    # packed buffer per dispatch group (ops/xfer.PackedLayout backed by
+    # ops/arena.PackedAlloc), a NEW arena size class the pre-uplink baseline
+    # never allocated. Re-baseline the flatness gate over it: once warmed,
+    # the packed class must recycle like every other frame class (misses
+    # flat over a sustained window), the kernel must report the coalesced
+    # single-start layout, and utilization on the same replay link must sit
+    # in the committed bar's neighborhood (the bench median grades against
+    # the absolute 0.90 replay bar in perf/regress.py; the smoke window is
+    # shorter, so its floor carries CI slack).
+    wire = "sc16"
+    ceil = ceiling_msps(wire)
+    n = _sized_n(wire, frame, seconds)
+    run_one(wire, frame, frame * 8)                      # warm the packed class
+    m0 = ar.stats()["misses"]
+    r_pk, tk = run_one(wire, frame, n)
+    u_pk = r_pk / ceil
+    st = ar.stats()
+    miss_delta = st["misses"] - m0
+    frames = n // frame
+    em = tk.extra_metrics()
+    print(f"# hostpath smoke (packed sc16): {r_pk:.1f} Msps (util "
+          f"{u_pk:.2f}), h2d starts/frame {em['h2d_starts_per_frame']}, "
+          f"arena misses +{miss_delta} over {frames} frames")
+    assert em["uplink_coalesced"] == 1 and em["h2d_starts_per_frame"] == 1, em
+    assert miss_delta <= 8, \
+        f"packed class allocating per frame: +{miss_delta} / {frames} frames"
+    assert u_pk >= 0.80, f"packed streamed utilization {u_pk:.2f} < 0.80"
     print("# hostpath smoke: OK")
 
 

@@ -77,6 +77,13 @@ FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
                        # linear reference, and the sharded streamed rate
                        # there — a shard-plane overhead creep flags here
                        "multichip_scaling_frac", "sharded_streamed_msps")
+# absolute replay bars (single-shot uplink round): on the CPU backend the
+# bench figure comes from the deterministic 96/62 fake-link replay, so it
+# carries an ABSOLUTE floor in addition to the trajectory comparison — a
+# stamp below the bar flags even if the reference round also sat below it
+# (the trajectory-relative check alone would grandfather a regression in).
+# Non-CPU stamps measure a real link and are graded relatively only.
+ABS_FLOOR_CPU = {"streamed_link_utilization": 0.90}
 # lower-is-better fields (fractions, not rates): regression = the value ROSE
 # past the reference by more than the absolute slack below — e.g. the
 # carry-checkpoint cost of the device-plane recovery contract creeping up
@@ -251,6 +258,15 @@ def main():
         return 0
 
     regressed = [r for r in rows if r[5]]
+    # absolute replay bars: deterministic fake-link figures on the CPU
+    # backend grade against a fixed floor, not just the trajectory
+    if backend == "cpu":
+        for field, floor in ABS_FLOOR_CPU.items():
+            cur_v = current.get(field)
+            if isinstance(cur_v, (int, float)) and cur_v < floor:
+                regressed.append((field, cur_v, floor, 0, cur_v / floor, True))
+                print(f"WARNING: perf regression: {field} {cur_v:.3f} below "
+                      f"the absolute replay bar {floor:.2f}", file=sys.stderr)
     print(f"# perf regression gate: backend={backend}, "
           f"tolerance={tol:.0%}, reference rounds per field below")
     print(f"{'field':24} {'current':>10} {'ref':>10} {'ref_rnd':>7} "
