@@ -1217,6 +1217,40 @@ def main():
             print(f"# multichip A/B unavailable: {e!r}", file=sys.stderr)
             multichip_extra["multichip_error"] = repr(e)
 
+    # fleet observability plane (telemetry/fleet.py / serve/router.py,
+    # perf/fleet_smoke.py): the live 3-host topology's ready count and the
+    # routed-admission p99 — fleet_hosts_ready and fleet_route_p99_ms are
+    # regress-graded. Runs as a SUBPROCESS like multichip: the children are
+    # control-port processes of their own and the parent must not inherit
+    # this process's fleet/journal state.
+    fleet_extra = {}
+    if not args.skip_extra_chains:
+        try:
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "perf", "fleet_smoke.py"), "--stamp"],
+                capture_output=True, text=True, timeout=300)
+            stamp_line = next(
+                (ln.strip() for ln in reversed(r.stdout.splitlines())
+                 if ln.strip().startswith("{")), None)
+            if stamp_line is None:
+                raise RuntimeError(
+                    f"fleet_smoke produced no stamp (rc={r.returncode}): "
+                    f"{r.stdout[-300:]}{r.stderr[-300:]}")
+            d = json.loads(stamp_line)
+            fleet_extra = {k: d[k] for k in
+                           ("fleet_hosts_ready", "fleet_route_p99_ms",
+                            "fleet_route_p50_ms") if k in d}
+            print(f"# fleet: {fleet_extra.get('fleet_hosts_ready')} hosts "
+                  f"ready, routed admit p50/p99 "
+                  f"{fleet_extra.get('fleet_route_p50_ms')}/"
+                  f"{fleet_extra.get('fleet_route_p99_ms')} ms",
+                  file=sys.stderr)
+        except Exception as e:                          # noqa: BLE001
+            print(f"# fleet stamp unavailable: {e!r}", file=sys.stderr)
+            fleet_extra["fleet_error"] = repr(e)
+
     # interior precision + Pallas hot kernels (ops/precision.py /
     # perf/precision_ab.py): the auto-lowered resident rate next to the f32
     # headline, the plan's pinned SNR floor, and the Pallas kernel matrix —
@@ -1405,6 +1439,7 @@ def main():
         **dag_extra,
         **serve_extra,
         **multichip_extra,
+        **fleet_extra,
         **precision_extra,
         **roof,
         **profile_extra,

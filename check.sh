@@ -195,6 +195,14 @@ echo "== mesh-sharded device plane gate (docs/parallel.md) =="
 # reference clears the floor (multichip_scaling_frac stamped, regress-graded)
 JAX_PLATFORMS=cpu python perf/multichip_ab.py --smoke
 
+echo "== fleet observability gate (docs/observability.md 'The fleet plane') =="
+# three live control-port hosts over real sockets: the FleetView reaches 3
+# ready, the merged /api/fleet/metrics exposition is host-labelled and
+# scrape-stable, the first admit lands on the least-pressure host, and after
+# SIGKILL of that host the view flips it stale -> down (journal-ordered) with
+# 100% of subsequent admits routed to the survivors
+JAX_PLATFORMS=cpu python perf/fleet_smoke.py --smoke
+
 echo "== chaos smoke (docs/robustness.md invariants) =="
 # seeded fault injection at every site × every failure policy on the CPU
 # backend: restart recovers bit-correct, isolate finishes independent

@@ -62,6 +62,35 @@ class Config:
     #   process-global journal ring (REST cursor: GET /api/events/)
     journal_dir: str = ""                  # spool every journal event as one
     #   JSONL line under this directory (atomic append; "" = ring only)
+    journal_spool_mb: int = 64             # spool rotation cap, MiB per file:
+    #   past it the active events_<pid>.jsonl atomically renames to .1 (.1
+    #   shifts to .2, …) and a fresh file opens — long runs stay bounded at
+    #   ~(keep+1) x cap. 0 = never rotate (the pre-rotation behavior)
+    journal_spool_keep: int = 4            # rotated spool files kept per pid;
+    #   the oldest beyond this is deleted at rotation time
+    # Fleet observability plane (telemetry/fleet.py, docs/observability.md
+    # "The fleet plane"): per-host pressure exports on every control port
+    # (GET /api/host/), a cross-host aggregator (GET /api/fleet/), and the
+    # pressure-routed admission front door (serve/router.py). OFF by default:
+    # with no peers configured every hot-path hook (fleet.tick) is one falsy
+    # check — the ≤3% telemetry-overhead contract.
+    fleet_peers: str = ""                  # comma-separated control-port
+    #   addresses ("10.0.0.1:1337,10.0.0.2:1337"); "" = fleet plane disabled.
+    #   Env: FUTURESDR_TPU_FLEET_PEERS
+    fleet_poll_interval: float = 1.0       # peer poll cadence, seconds
+    fleet_stale_s: float = 0.0             # a host whose last good summary is
+    #   older than this reads `stale`; 0 = auto (3 x fleet_poll_interval)
+    fleet_down_errors: int = 2             # consecutive poll failures that
+    #   flip a host stale -> down (a SIGKILLed peer reads down within 2
+    #   poll intervals); the first failure alone marks it stale
+    fleet_skew: float = 0.5                # pressure-skew verdict threshold:
+    #   max-min per-host credit pressure past it surfaces the hottest host's
+    #   eviction candidates as the migration hint
+    fleet_hysteresis: float = 0.1          # admission-router switch band: a
+    #   candidate host must beat the current pick's pressure/p99 by this
+    #   margin (same shed rung) before routing moves — no flapping
+    fleet_host_id: str = ""                # this host's id in fleet views and
+    #   merged-metrics host= labels ("" = <hostname>:<pid>)
     # Profile plane (telemetry/profile.py, docs/observability.md "The
     # profile plane"): MFU/HBM-utilization denominators. 0 = autodetect the
     # chip from jax.devices()[0].device_kind (utils/roofline.detect_peaks);

@@ -37,6 +37,19 @@ restarts, docs/serving.md "Lifecycle"):
                    serving-program compile storm on the profile plane (503 + Retry-After
                    otherwise)
 
+plus the fleet observability plane (telemetry/fleet.py + serve/router.py,
+docs/observability.md "The fleet plane"):
+
+  GET  /api/host/                        → this host's lock-free pressure
+                                           summary (every control port)
+  GET  /api/fleet/                       → aggregated readyz + per-host
+                                           table + cross-host verdicts
+  GET  /api/fleet/metrics                → merged Prometheus exposition
+                                           (host= label, stable ordering)
+  POST /api/fleet/serve/{app}/session/   → pressure-routed admission
+                                           (least-pressure ready host,
+                                           failover honoring Retry-After)
+
 Pmt values are serialized with the same externally-tagged JSON as the reference's serde.
 CORS is permissive (including on error responses raised as ``web.HTTPException``);
 graceful shutdown on ``stop()``.
@@ -67,6 +80,7 @@ class ControlPort:
         self.host = host or "127.0.0.1"
         self.port = int(port or 1337)
         self.extra_routes = list(extra_routes or [])
+        self._fleet_router = None          # lazy AdmissionRouter (fleet on)
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
@@ -147,15 +161,40 @@ class ControlPort:
 
             # the lifecycle endpoints must exist on EVERY control port even
             # with the serve plane unimportable — an orchestrator's probes
-            # are not optional; with no serving apps the process is ready
+            # are not optional. The fallback retries the real readyz lazily
+            # (the import failure may be transient); while the plane stays
+            # unavailable readiness is UNKNOWN, so it answers 503 with a
+            # clamped Retry-After default — a fleet poller or load balancer
+            # must back off, not hammer (nor route to) a half-imported pod
             async def _healthz_fallback(request):
                 return web.json_response({"ok": True})
 
             async def _readyz_fallback(request):
-                return web.json_response({"ready": True, "apps": {}})
+                try:
+                    from ..serve import api as _serve_api
+                    return await _serve_api.readyz(request)
+                except Exception as err:   # noqa: BLE001 — still broken
+                    return web.json_response(
+                        {"ready": False, "apps": {},
+                         "error": f"serve plane unavailable: {err!r}"},
+                        status=503, headers={"Retry-After": "1"})
 
             app.router.add_get("/healthz", _healthz_fallback)
             app.router.add_get("/readyz", _readyz_fallback)
+        # fleet observability plane (telemetry/fleet.py, docs/
+        # observability.md "The fleet plane"): the per-host export is on
+        # every control port; the aggregated views answer from the process
+        # FleetView, which only polls when `fleet_peers` is configured
+        app.router.add_get("/api/host/", self._host_summary)
+        app.router.add_get("/api/fleet/", self._fleet)
+        app.router.add_get("/api/fleet/metrics", self._fleet_metrics)
+        app.router.add_post("/api/fleet/serve/{app}/session/",
+                            self._fleet_admit)
+        try:
+            from ..telemetry import fleet as _fleet
+            _fleet.ensure_started()
+        except Exception as e:             # noqa: BLE001 — optional plane
+            log.warning("fleet plane unavailable: %r", e)
         for method, path, handler in self.extra_routes:
             app.router.add_route(method, path, handler)
         import os
@@ -364,6 +403,96 @@ class ControlPort:
         cat = q.get("cat") or None
         return web.json_response(
             journal.journal().events(since=since, cat=cat, limit=limit))
+
+    async def _host_summary(self, request):
+        """The per-host fleet export (telemetry/fleet.py): one cheap,
+        lock-free summary — host id, uptime, readyz verdict, per-app shed
+        rung + credit pressure + session counts, windowed MFU/HBM-util,
+        compile-storm flag, doctor verdict, e2e p50/p99, journal cursor
+        head. Built on the health()/retry_after_s() discipline, so a
+        wedged step() holding an engine lock never stalls a fleet poll."""
+        import json as _json
+
+        from aiohttp import web
+
+        from ..telemetry import fleet
+        return web.json_response(
+            fleet.host_summary(),
+            dumps=lambda o: _json.dumps(o, default=str))
+
+    def _fleet_view(self):
+        from ..telemetry import fleet
+        return fleet.ensure_started()
+
+    async def _fleet(self, request):
+        """Aggregated fleet view: readyz rollup + per-host table + cross-
+        host verdicts. 404 while the fleet plane is disabled (no
+        ``fleet_peers`` configured) — same shape as an unknown-fg error."""
+        import json as _json
+
+        from aiohttp import web
+        view = self._fleet_view()
+        if view is None:
+            return web.json_response(
+                {"error": "fleet plane disabled (set fleet_peers)"},
+                status=404)
+        return web.json_response(
+            view.snapshot(), dumps=lambda o: _json.dumps(o, default=str))
+
+    async def _fleet_metrics(self, request):
+        """Merged Prometheus exposition across the fleet (``host=`` label,
+        stable ordering). The per-peer scrapes are blocking HTTP, so the
+        merge runs off the event loop."""
+        import asyncio
+
+        from aiohttp import web
+
+        from ..telemetry import prom
+        view = self._fleet_view()
+        if view is None:
+            return web.json_response(
+                {"error": "fleet plane disabled (set fleet_peers)"},
+                status=404)
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, view.merged_metrics)
+        return web.Response(body=body.encode(),
+                            headers={"Content-Type": prom.CONTENT_TYPE})
+
+    async def _fleet_admit(self, request):
+        """Pressure-routed admission (serve/router.py): pick the least-
+        pressure ready host, POST the admit there, fail over on 503
+        honoring Retry-After; every decision journals with the scores
+        considered. The remote admit is blocking HTTP — executor."""
+        import asyncio
+
+        from aiohttp import web
+
+        from ..serve.router import AdmissionRouter, NoReadyHost
+        view = self._fleet_view()
+        if view is None:
+            return web.json_response(
+                {"error": "fleet plane disabled (set fleet_peers)"},
+                status=404)
+        if self._fleet_router is None:
+            self._fleet_router = AdmissionRouter(view)
+        name = request.match_info["app"]
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:              # noqa: BLE001 — bad JSON → 400
+                return web.json_response(
+                    {"error": "bad json body", "app": name}, status=400)
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._fleet_router.admit(
+                    name, tenant=str(body.get("tenant", "default")),
+                    sid=body.get("sid"), body=body))
+        except NoReadyHost as e:
+            return web.json_response(
+                {"error": str(e), "app": name}, status=503,
+                headers={"Retry-After": str(e.retry_after)})
+        return web.json_response(out, status=201)
 
     async def _describe_block(self, request):
         from aiohttp import web

@@ -12,12 +12,14 @@ from .overload import ShedLadder
 from .slots import (ServeDraining, ServeFull, ServeOverload, Session,
                     SlotTable)
 from .api import apps, get_app, register_app, routes, unregister_app
+from .router import AdmissionRouter, NoReadyHost
 
 __all__ = ["ServeEngine", "ServeFull", "ServeDraining", "ServeOverload",
            "Session", "SlotTable", "SessionStore", "ShedLadder",
            "TenantCreditController", "build_slot_program", "default_buckets",
            "install_sigterm_drain", "drain_all_apps",
-           "register_app", "unregister_app", "get_app", "apps", "routes"]
+           "register_app", "unregister_app", "get_app", "apps", "routes",
+           "AdmissionRouter", "NoReadyHost"]
 
 #: engine symbols resolve lazily: the control port merges the REST session
 #: plane into every server, and the HOST-only runtime must not pay the jax

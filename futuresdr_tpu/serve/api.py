@@ -43,7 +43,7 @@ from ..log import logger
 from .slots import ServeFull
 
 __all__ = ["register_app", "unregister_app", "get_app", "apps", "routes",
-           "readiness", "healthz", "readyz"]
+           "readiness", "healthz", "readyz", "readyz_retry_after"]
 
 log = logger("serve.api")
 
@@ -282,16 +282,32 @@ async def healthz(request):
     return web.json_response({"ok": True})
 
 
+def readyz_retry_after() -> int:
+    """The Retry-After default of an unready 503: the largest registered
+    engine's measured ``retry_after_s()`` (lock-free), clamped to [1, 30]
+    like the engines' own estimate — a fleet poller or load balancer backs
+    off by how long this pod actually needs, not a hardcoded second."""
+    after = 1
+    for _name, eng in apps().items():
+        try:
+            after = max(after, int(eng.retry_after_s()))
+        except Exception:                  # noqa: BLE001 — advisory header
+            pass
+    return int(min(30, max(1, after)))
+
+
 async def readyz(request):
     """Readiness for rolling restarts: 200 only when every serving app is
     compiled + not draining with no serving-program compile storm;
-    503 (+ Retry-After) otherwise so an orchestrator holds traffic."""
+    503 (+ clamped Retry-After) otherwise so an orchestrator holds
+    traffic."""
     from aiohttp import web
     ready, detail = readiness()
     if ready:
         return web.json_response({"ready": True, **detail})
-    return web.json_response({"ready": False, **detail}, status=503,
-                             headers={"Retry-After": "1"})
+    return web.json_response(
+        {"ready": False, **detail}, status=503,
+        headers={"Retry-After": str(readyz_retry_after())})
 
 
 def routes() -> List[Tuple[str, str, object]]:

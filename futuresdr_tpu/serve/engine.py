@@ -57,6 +57,7 @@ import numpy as np
 from ..log import logger
 from ..ops import xfer
 from ..runtime import faults as _faults
+from ..telemetry import fleet as _fleet
 from ..telemetry import journal as _journal
 from ..telemetry import lineage as _lineage
 from ..telemetry import profile as _profile
@@ -677,6 +678,15 @@ class ServeEngine:
         cleanly at megabatch boundaries because the mask, not the program
         shape, carries the raggedness). Returns the number of
         session-frames dispatched (0 = idle step)."""
+        # fleet hot-path hook (telemetry/fleet.py): refresh this host's own
+        # fleet gauges at poll cadence. ONE falsy check when the fleet
+        # plane is disabled — the guard is INLINE (a module-global read, no
+        # call frame) so the disabled cost matches the park guard's; it is
+        # the sixth per-call hook class the telemetry overhead gate bills
+        # (tests/test_telemetry.py). Outside the engine lock by design: the
+        # refresh reads only lock-free surfaces
+        if _fleet._tick_state is not None:
+            _fleet.tick()
         with self._lock:
             C = self.table.capacity
             K = self._k_eff

@@ -22,6 +22,10 @@ here they are first-class), plus the doctor that diagnoses from both:
 * :mod:`.journal` — a bounded process-global ring of structured lifecycle
   events (admit/evict/shed/restart/recover/checkpoint/retune/compile/…)
   with a monotonic REST cursor (``GET /api/events/``).
+* :mod:`.fleet` — the cross-host plane: per-host pressure exports
+  (``GET /api/host/``), the FleetView aggregator (``GET /api/fleet/``,
+  merged ``/api/fleet/metrics``) and the fleet verdicts the admission
+  router (serve/router.py) consumes.
 
 See ``docs/observability.md`` for the span categories, metric names, endpoints
 and the overhead budget.
@@ -36,9 +40,11 @@ from . import lineage  # noqa: E402 — after spans: flow links share its clock
 from . import journal  # noqa: E402 — config-only dependency
 from . import profile  # noqa: E402 — after prom/spans: the profile plane
 from . import doctor  # noqa: E402 — after profile: doctor reads all four
+from . import fleet  # noqa: E402 — after journal/prom: the cross-host plane
 
 __all__ = [
     "spans", "prom", "hist", "doctor", "profile", "lineage", "journal",
+    "fleet",
     "SpanRecorder", "SpanEvent", "recorder", "enable", "enabled", "drain",
     "chrome_trace", "export", "overlap_report", "union_ns",
     "Registry", "Counter", "Gauge", "Histogram", "registry", "counter",

@@ -291,6 +291,30 @@ class Doctor:
                     self._serve.pop(key, None)
         return out
 
+    def verdicts(self) -> dict:
+        """Lock-cheap doctor verdict summary for the per-host fleet export
+        (telemetry/fleet.py): the watchdog state, the most recent trip's
+        diagnosis (trimmed — ``last_trip`` persists after recovery, so the
+        fleet verdict reads the LIVE attached diagnoses, not history), and
+        any currently-diagnosed flowgraph/serve attachment. Never takes an
+        engine lock."""
+        with self._lock:
+            fg_diag = {str(a.key): a.diagnosis for a in self._fgs.values()
+                       if a.diagnosis}
+            sv_diag = {str(a.key): a.diagnosis for a in self._serve.values()
+                       if a.diagnosis}
+        wedged = {**fg_diag, **sv_diag}
+        verdict = "ok"
+        if wedged:
+            verdict = next(iter(sorted(
+                d.get("state", "wedged") for d in wedged.values())))
+        return {"enabled": self.enabled,
+                "verdict": verdict,
+                "wedged": wedged or None,
+                "last_trip": ({k: self.last_trip.get(k) for k in
+                               ("state", "fg", "suspect_block", "detail")}
+                              if self.last_trip else None)}
+
     # -- watchdog --------------------------------------------------------------
     @property
     def enabled(self) -> bool:
@@ -722,6 +746,11 @@ class Doctor:
             # sampled per-frame tail attribution (telemetry/lineage.py):
             # which lane/session the slow frames spent their time in
             "tail": _lineage.tail_report(),
+            # cross-host fleet view (telemetry/fleet.py): per-host states +
+            # verdicts when this process runs a FleetView aggregator — a
+            # flight record from the routing front door carries WHERE the
+            # fleet stood when it tripped
+            "fleet": _fleet_section(),
             "metrics": prom.registry().render(),
         }
         if extra is not None:
@@ -911,6 +940,11 @@ class Doctor:
             # the interval-union bottleneck_lane above — same stamp
             # boundaries as the cat="tpu" spans), slowest session/tenant
             "tail": _lineage.tail_report(),
+            # cross-host fleet section (telemetry/fleet.py): aggregated
+            # readyz + per-host table + verdicts (host-down, host-wedged,
+            # pressure-skew, fleet-compile-storm) — None unless this
+            # process runs a FleetView aggregator
+            "fleet": _fleet_section(),
             "roofline": roofline,
             "compile_storms": prof.storm_report() or None,
             # interior-precision plans (ops/precision.py): per program, the
@@ -937,6 +971,18 @@ def _serve_describe(eng) -> Optional[dict]:
         return eng.watch_sample() or {"lock": "busy"}
     except Exception as e:                             # noqa: BLE001
         return {"error": repr(e)}
+
+
+def _fleet_section() -> Optional[dict]:
+    """The fleet plane's report section (telemetry/fleet.py): the live
+    FleetView's aggregated snapshot, None while the plane is disabled.
+    Guarded exactly like the precision plans — a report must come out
+    even with the fleet plane half-imported."""
+    try:
+        from . import fleet
+        return fleet.fleet_section()
+    except Exception:                                  # noqa: BLE001
+        return None
 
 
 def _precision_plans() -> dict:

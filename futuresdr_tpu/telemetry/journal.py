@@ -39,7 +39,17 @@ Categories in use: ``serve`` (engine lifecycle), ``kernel`` (device-plane
 init/restart/recover/replay/checkpoint/retune), ``compile`` (every
 ProfilePlane-billed compile), ``shard`` (mesh runner checkpoint/recover),
 ``devchain`` (fused-region restart), ``chaos`` (injected faults, so a
-post-mortem distinguishes the injection from the reaction).
+post-mortem distinguishes the injection from the reaction), ``fleet``
+(cross-host state transitions + admission-routing decisions —
+telemetry/fleet.py / serve/router.py), ``journal`` (the journal's own
+lifecycle: spool rotation).
+
+The spool is size-capped: past ``journal_spool_mb`` the active
+``events_<pid>.jsonl`` atomically renames to ``.1`` (``.1`` shifts to
+``.2``, …, the oldest beyond ``journal_spool_keep`` is deleted) and a
+fresh file opens — the rotation itself is journaled as the first event of
+the new file, so a reader stitching rotated files back together can
+detect the seam.
 """
 
 from __future__ import annotations
@@ -60,7 +70,8 @@ log = logger("telemetry.journal")
 
 #: the categories the runtime emits today (free-form strings are accepted;
 #: this tuple is the documented vocabulary — docs/observability.md)
-CATEGORIES = ("serve", "kernel", "compile", "shard", "devchain", "chaos")
+CATEGORIES = ("serve", "kernel", "compile", "shard", "devchain", "chaos",
+              "fleet", "journal")
 
 
 class Journal:
@@ -70,15 +81,23 @@ class Journal:
     counting, which is how :meth:`events` detects a cursor gap).
     ``spool_dir`` optionally appends every event as one JSONL line to
     ``events_<pid>.jsonl`` under it — the durable form of the ring.
+    ``spool_cap_mb``/``spool_keep`` bound the spool on long runs: past the
+    cap the active file rotates (atomic ``os.replace`` shifts, oldest
+    deleted), so disk use stays ≈ ``(keep + 1) × cap``.
     """
 
-    def __init__(self, maxlen: int = 1024, spool_dir: str = ""):
+    def __init__(self, maxlen: int = 1024, spool_dir: str = "",
+                 spool_cap_mb: int = 64, spool_keep: int = 4):
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max(1, int(maxlen)))
         self._seq = 0
         self._spool_dir = str(spool_dir or "")
         self._spool_f = None
         self._spool_failed = False
+        self._spool_path = ""
+        self._spool_bytes = 0
+        self._spool_cap = max(0, int(spool_cap_mb)) * (1 << 20)
+        self._spool_keep = max(1, int(spool_keep))
 
     # -- emission --------------------------------------------------------------
     def emit(self, cat: str, event: str, **fields: Any) -> int:
@@ -106,13 +125,58 @@ class Journal:
         try:
             if self._spool_f is None:
                 os.makedirs(self._spool_dir, exist_ok=True)
-                path = os.path.join(self._spool_dir,
-                                    f"events_{os.getpid()}.jsonl")
-                self._spool_f = open(path, "a", buffering=1)
-            self._spool_f.write(json.dumps(rec, default=str) + "\n")
+                self._spool_path = os.path.join(
+                    self._spool_dir, f"events_{os.getpid()}.jsonl")
+                self._spool_f = open(self._spool_path, "a", buffering=1)
+                try:  # resume the byte count of a pre-existing file
+                    self._spool_bytes = os.path.getsize(self._spool_path)
+                except OSError:
+                    self._spool_bytes = 0
+            line = json.dumps(rec, default=str) + "\n"
+            self._spool_f.write(line)
+            self._spool_bytes += len(line)
+            if self._spool_cap and self._spool_bytes >= self._spool_cap:
+                self._rotate_locked()
         except (OSError, TypeError, ValueError) as e:
             self._spool_failed = True
             log.error("journal spool disabled: %r", e)
+
+    def _rotate_locked(self) -> None:
+        """Size-cap rotation under the held emit lock: shift
+        ``events_<pid>.jsonl`` → ``.1`` → ``.2`` … via atomic ``os.replace``
+        (oldest beyond ``spool_keep`` deleted), reopen a fresh active file,
+        and record the rotation as the new file's first event. The record is
+        built inline — ``emit()`` would deadlock on the non-reentrant lock —
+        so the rotation seam is visible in both the ring and the spool."""
+        rotated_bytes = self._spool_bytes
+        try:
+            self._spool_f.close()
+        except OSError:
+            pass
+        self._spool_f = None
+        keep, path = self._spool_keep, self._spool_path
+        try:
+            os.remove(f"{path}.{keep}")
+        except OSError:
+            pass
+        for i in range(keep - 1, 0, -1):
+            try:
+                os.replace(f"{path}.{i}", f"{path}.{i + 1}")
+            except OSError:
+                pass  # gap in the chain: that generation never existed
+        os.replace(path, f"{path}.1")
+        self._spool_f = open(path, "a", buffering=1)
+        self._spool_bytes = 0
+        self._seq += 1
+        rec = {"seq": self._seq, "t_wall": time.time(),
+               "t_mono_ns": time.monotonic_ns(),
+               "cat": "journal", "event": "spool-rotate",
+               "file": os.path.basename(path), "rotated_to": f"{path}.1",
+               "rotated_bytes": rotated_bytes, "keep": keep}
+        self._ring.append(rec)
+        line = json.dumps(rec, default=str) + "\n"
+        self._spool_f.write(line)
+        self._spool_bytes += len(line)
 
     # -- reads -----------------------------------------------------------------
     @property
@@ -185,7 +249,9 @@ def journal() -> Journal:
                 c = config()
                 _journal = Journal(
                     maxlen=int(c.get("journal_ring", 1024)),
-                    spool_dir=str(c.get("journal_dir", "") or ""))
+                    spool_dir=str(c.get("journal_dir", "") or ""),
+                    spool_cap_mb=int(c.get("journal_spool_mb", 64)),
+                    spool_keep=int(c.get("journal_spool_keep", 4)))
     return _journal
 
 

@@ -672,6 +672,46 @@ def _serve_child_main(workdir: str) -> int:
     return 0
 
 
+def _fleet_child_main(workdir: str, port: int) -> int:
+    """The ``--_fleet-child`` entry: a REAL serving host — one ServeEngine
+    with per-step durable persistence, registered on a control port so the
+    fleet plane sees it (``/api/host/``) and the admission router can POST
+    sessions to it — printing a STEP marker after every flushed snapshot.
+    The parent SIGKILLs it mid-serve at an arbitrary marker."""
+    from futuresdr_tpu.runtime.ctrl_port import ControlPort
+    from futuresdr_tpu.serve import ServeEngine
+    from futuresdr_tpu.serve import api as serve_api
+
+    # fleet identity = the control-port address (what the aggregator polls)
+    os.environ.setdefault("FUTURESDR_TPU_FLEET_HOST_ID", f"127.0.0.1:{port}")
+
+    class _Handle:                         # host-only port: no flowgraphs
+        def flowgraph_ids(self):
+            return []
+
+        def get_flowgraph(self, fg):
+            return None
+
+    eng = ServeEngine(_serve_chaos_pipe(), frame_size=512, app="app",
+                      buckets=(2,), queue_frames=8,
+                      persist_dir=workdir, persist_every=1)
+    serve_api.register_app(eng, "app")
+    cp = ControlPort(_Handle(), bind=f"127.0.0.1:{port}")
+    cp.start()
+    eng.admit(tenant="t0", sid="fc0")
+    frames = _serve_chaos_frames("fc0", n=4096)
+    for i in range(4096):                  # parks until the parent kills it
+        eng.submit("fc0", frames[i])
+        eng.step()
+        # flushed BEFORE the marker: once the parent has seen "STEP i",
+        # a kill at any later instant leaves at least step i's snapshot
+        # complete on disk
+        eng.flush_persist()
+        print(f"STEP {i}", flush=True)
+        time.sleep(0.005)
+    return 0
+
+
 def scenario_serve_crash_restart():
     """Acceptance (ISSUE 14): SIGKILL a serving process mid-serve with
     ``serve_persist_dir`` set → a virgin engine incarnation in a new
@@ -965,6 +1005,165 @@ def scenario_serve_overload_shed():
     _assert_no_leaked_threads(before, "serve_overload_shed")
 
 
+def scenario_fleet_host_crash():
+    """Acceptance (ISSUE 19): SIGKILL one host of a live two-host fleet
+    mid-serve → the aggregator journals the staleness story IN ORDER
+    (host-stale → host-down at exactly ``fleet_down_errors`` consecutive
+    misses, BEFORE any post-crash route event), every admission routed after
+    the down flip lands on the survivor, and a virgin engine incarnation
+    over the dead host's persist dir resumes its session BIT-IDENTICALLY
+    from the persisted cursor — a host crash loses in-flight work, never
+    session state and never the fleet's routing sanity."""
+    import queue
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    from futuresdr_tpu.serve import ServeEngine
+    from futuresdr_tpu.serve.router import AdmissionRouter
+    from futuresdr_tpu.telemetry import journal as journal_mod
+    from futuresdr_tpu.telemetry.fleet import FleetView
+
+    def _free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    workdir = tempfile.mkdtemp(prefix="fsdr_fleet_crash_")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = os.environ.copy()
+    env.update(JAX_PLATFORMS="cpu", FUTURESDR_TPU_AUTOTUNE_CACHE_DIR="off",
+               PYTHONPATH=(root + os.pathsep
+                           + env.get("PYTHONPATH", "")).rstrip(os.pathsep))
+    before = _threads_now()
+    port_a, port_b = _free_port(), _free_port()
+    host_a, host_b = f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}"
+    interval = 0.15
+    view = None
+    pa = pb = None
+    try:
+        # host A: the REAL serving child (engine + persistence + control
+        # port); host B: the jax-free control-port survivor serving the
+        # same app name (tests/_fleet_child — the routed failover target)
+        pa = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--_fleet-child", workdir, str(port_a)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        pb = subprocess.Popen(
+            [sys.executable, os.path.join(root, "tests", "_fleet_child.py"),
+             str(port_b), "0.3"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            line = pb.stdout.readline()
+            if "READY" in line or not line:
+                break
+        assert line and "READY" in line, f"survivor child failed: {line!r}"
+
+        lines: "queue.Queue" = queue.Queue()
+
+        def _pump_stdout():
+            for ln in pa.stdout:
+                lines.put(ln)
+
+        threading.Thread(target=_pump_stdout, daemon=True,
+                         name="chaos-fleet-child-stdout").start()
+        steps_seen = 0
+        while steps_seen < 6:              # >= 6 flushed snapshots on disk
+            wait = deadline - time.monotonic()
+            assert wait > 0, \
+                f"fleet child never reached 6 steps ({steps_seen})"
+            try:
+                ln = lines.get(timeout=min(wait, 5.0))
+            except queue.Empty:
+                assert pa.poll() is None, \
+                    f"fleet child exited early ({steps_seen} steps)"
+                continue
+            if ln.startswith("STEP"):
+                steps_seen += 1
+
+        view = FleetView([host_a, host_b], poll_interval=interval).start()
+        router = AdmissionRouter(view, hysteresis=0.05)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and len(view.ready_hosts()) < 2:
+            time.sleep(interval / 3)
+        assert len(view.ready_hosts()) == 2, view.hosts()
+        # a pre-crash routed admission exercises the live path (either host
+        # is a legal pick; the post-crash contract is what the gate pins)
+        router.admit("app", tenant="rt")
+
+        j0 = journal_mod.journal().seq
+        pa.kill()                          # SIGKILL — no atexit, no flush
+        pa.wait(timeout=30)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if view.hosts()[host_a]["state"] == "down":
+                break
+            time.sleep(interval / 3)
+        assert view.hosts()[host_a]["state"] == "down", view.hosts()
+        evs = journal_mod.events(since=j0, cat="fleet")["events"]
+        a_evs = [e for e in evs if e.get("host") == host_a]
+        assert [e["event"] for e in a_evs][:2] == \
+            ["host-stale", "host-down"], [e["event"] for e in a_evs]
+        down = next(e for e in a_evs if e["event"] == "host-down")
+        assert down["errors"] == view.down_errors, down
+
+        # routing shift: every post-flip admit lands on the survivor, and
+        # every one is journaled AFTER the down flip (seq order)
+        targets = [router.admit("app", tenant=f"rt{i}")["host"]
+                   for i in range(6)]
+        assert set(targets) == {host_b}, targets
+        routes = [e for e in
+                  journal_mod.events(since=j0, cat="fleet")["events"]
+                  if e["event"] == "route" and e["seq"] > down["seq"]]
+        assert len(routes) >= 6 and \
+            all(e["host"] == host_b for e in routes), routes
+
+        # bit-identical resume "on the survivor": a virgin incarnation over
+        # the dead host's persist dir readmits fc0 and continues its stream
+        # from the persisted cursor, matched against an unfailed reference
+        eng = ServeEngine(_serve_chaos_pipe(), frame_size=512, app="app",
+                          buckets=(2,), queue_frames=8,
+                          persist_dir=workdir, persist_every=1)
+        try:
+            s = eng.table.get("fc0")
+            assert s is not None and s.state == "active", s
+            start = s.frames_out
+            assert start >= 1, start
+            frames = _serve_chaos_frames("fc0", n=start + 8)
+            import jax
+            fn = jax.jit(_serve_chaos_pipe().fn())
+            carry = _serve_chaos_pipe().init_carry()
+            ref = []
+            for f in frames:
+                carry, y = fn(carry, f)
+                ref.append(np.asarray(y))
+            for f in frames[start:]:
+                assert eng.submit("fc0", f)
+            while eng.step():
+                pass
+            got = eng.results("fc0")
+            assert len(got) == 8, len(got)
+            for a, b in zip(got, ref[start:]):
+                np.testing.assert_array_equal(a, b, err_msg="fc0")
+        finally:
+            eng.shutdown()
+    finally:
+        if view is not None:
+            view.stop()
+        for p in (pa, pb):
+            if p is not None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+                p.wait(timeout=30)
+        shutil.rmtree(workdir, ignore_errors=True)
+    _assert_no_leaked_threads(before, "fleet_host_crash")
+
+
 def scenario_deadline_bounds_wedge():
     """Acceptance: a wedged sink + run deadline → structured FlowgraphError
     within deadline+grace instead of an indefinite hang."""
@@ -1204,6 +1403,7 @@ SCENARIOS = (
     ("tenant-isolation", scenario_tenant_isolation),
     ("serve-crash-restart", scenario_serve_crash_restart),
     ("serve-overload-shed", scenario_serve_overload_shed),
+    ("fleet-host-crash", scenario_fleet_host_crash),
     ("shard-replay", scenario_shard_replay),
     ("deadline_bounds_wedge", scenario_deadline_bounds_wedge),
 )
@@ -1219,7 +1419,16 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--_serve-child", dest="serve_child", default=None,
                     metavar="DIR", help=argparse.SUPPRESS)
+    ap.add_argument("--_fleet-child", dest="fleet_child", default=None,
+                    nargs=2, metavar=("DIR", "PORT"),
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.fleet_child:
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ.get("JAX_PLATFORMS", "cpu"))
+        return _fleet_child_main(args.fleet_child[0],
+                                 int(args.fleet_child[1]))
     if args.serve_child:
         import jax
         jax.config.update("jax_platforms",

@@ -76,7 +76,11 @@ FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
                        # the D=8 scaling fraction vs the independent-loop
                        # linear reference, and the sharded streamed rate
                        # there — a shard-plane overhead creep flags here
-                       "multichip_scaling_frac", "sharded_streamed_msps")
+                       "multichip_scaling_frac", "sharded_streamed_msps",
+                       # fleet plane (perf/fleet_smoke.py): every host of the
+                       # 3-host live topology must come up ready — a poller or
+                       # readiness regression reads as this dropping below 3
+                       "fleet_hosts_ready")
 # absolute replay bars (single-shot uplink round): on the CPU backend the
 # bench figure comes from the deterministic 96/62 fake-link replay, so it
 # carries an ABSOLUTE floor in addition to the trajectory comparison — a
@@ -109,7 +113,14 @@ FIELDS_INVERSE_RATIO_SAME_BACKEND = ("serve_p99_under_churn_ms",
                                      # the always-on latency histogram —
                                      # a latency-tail creep on the default
                                      # bench run flags here
-                                     "e2e_latency_p99")
+                                     "e2e_latency_p99",
+                                     # routed-admission p99 over the live
+                                     # 3-host fleet (perf/fleet_smoke.py
+                                     # --stamp): score/pick/failover overhead
+                                     # creeping into the admit path flags
+                                     # here (tail-noise slack shared with
+                                     # the other latency fields)
+                                     "fleet_route_p99_ms")
 INVERSE_RATIO_SLACK = 2.0  # may rise up to (1 + slack)x the reference
 
 

@@ -443,7 +443,7 @@ def test_flight_record_shape_and_markdown(tmp_path, monkeypatch):
         # golden shape: every black-box section present
         assert set(rep) == {"reason", "unix_time", "threads", "flowgraphs",
                             "spans", "span_drops", "e2e_latency", "profile",
-                            "serve", "metrics", "journal", "tail"}
+                            "serve", "metrics", "journal", "tail", "fleet"}
         # lifecycle journal section: the last-N structured events (or None
         # when this process journaled nothing yet); each carries the
         # monotonic seq + category the /api/events/ cursor pages by

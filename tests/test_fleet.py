@@ -1,0 +1,445 @@
+"""Fleet observability plane (telemetry/fleet.py + serve/router.py).
+
+Unit coverage: the routing score's lexicographic ordering (shed rung →
+credit pressure → p99 headroom), the hysteresis band, ready-host
+filtering, FleetView staleness transitions (fresh → stale → down →
+recovered) against an injected fetch, the merged-exposition stable
+ordering (histogram ``le=`` bucket order preserved), journal spool
+rotation, and router failover honoring Retry-After.
+
+Live coverage: three jax-free control-port subprocesses
+(tests/_fleet_child.py) — ``GET /api/fleet/`` shows 3 ready, SIGKILL one,
+the fleet flips it to down within 2 poll intervals (``fleet_down_errors``)
+and the router sends 100% of subsequent admits to the survivors, with
+every decision journaled.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from futuresdr_tpu.serve.router import AdmissionRouter, NoReadyHost, score, \
+    _better
+from futuresdr_tpu.telemetry import fleet
+from futuresdr_tpu.telemetry import journal as journal_mod
+from futuresdr_tpu.telemetry.fleet import FleetView, merge_metrics
+from futuresdr_tpu.telemetry.journal import Journal
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CHILD = os.path.join(_ROOT, "tests", "_fleet_child.py")
+
+
+def _summary(ready=True, shed=0, pressure=0.0, p99=0.01, app="app",
+             app_ready=None, occupants=(), host="h"):
+    return {
+        "host": host, "ready": ready, "pressure": pressure,
+        "shed_level": shed, "compile_storm": False,
+        "sessions": len(occupants),
+        "doctor": {"verdict": "ok"},
+        "e2e": {"p50_s": p99 / 2, "p99_s": p99},
+        "apps": {app: {"ready": ready if app_ready is None else app_ready,
+                       "shed_level": shed, "pressure": pressure,
+                       "sessions": len(occupants),
+                       "occupants": list(occupants)}},
+    }
+
+
+# ---------------------------------------------------------------------------
+# routing score: lexicographic rung -> pressure -> p99, ready filtering
+# ---------------------------------------------------------------------------
+
+def test_score_orders_rung_then_pressure_then_p99():
+    calm = score(_summary(shed=0, pressure=0.9, p99=0.5), "app")
+    shedding = score(_summary(shed=1, pressure=0.1, p99=0.001), "app")
+    # a host one rung up loses to ANY host a rung down, whatever its
+    # pressure or latency
+    assert calm < shedding
+    lo_p = score(_summary(pressure=0.2, p99=0.5), "app")
+    hi_p = score(_summary(pressure=0.8, p99=0.001), "app")
+    assert lo_p < hi_p                     # same rung: pressure decides
+    fast = score(_summary(pressure=0.5, p99=0.01), "app")
+    slow = score(_summary(pressure=0.5, p99=0.10), "app")
+    assert fast < slow                     # same rung+pressure: p99 decides
+
+
+def test_score_filters_unready():
+    assert score(_summary(ready=False), "app") is None
+    assert score({}, "app") is None
+    # host ready but the NAMED app draining/unready -> filtered too
+    assert score(_summary(ready=True, app_ready=False), "app") is None
+    # unknown app falls back to the host-level signals, stays a candidate
+    assert score(_summary(), "other_app") is not None
+
+
+def test_hysteresis_band():
+    h = 0.1
+    cur = (0.0, 0.50, 0.020)
+    # inside the band on the deciding component: stay
+    assert not _better((0.0, 0.45, 0.020), cur, h)
+    assert not _better((0.0, 0.50, 0.021), cur, h)
+    # outside the band: switch
+    assert _better((0.0, 0.30, 0.020), cur, h)
+    assert _better((0.0, 0.50, 0.005), cur, h)
+    # a WORSE candidate never switches, band or not
+    assert not _better((0.0, 0.70, 0.020), cur, h)
+    # rung differences always switch (the ladder is hysteretic upstream)
+    assert _better((0.0, 0.9, 0.9), (1.0, 0.0, 0.0), h)
+    assert not _better((1.0, 0.0, 0.0), (0.0, 0.9, 0.9), h)
+
+
+class FakeView:
+    def __init__(self, summaries):
+        self._s = dict(summaries)
+
+    def set(self, host, summary):
+        self._s[host] = summary
+
+    def ready_hosts(self):
+        return {p: {"state": "up", "summary": s}
+                for p, s in self._s.items() if s and s.get("ready")}
+
+
+def test_router_picks_least_pressure_and_sticks_inside_band():
+    view = FakeView({"a:1": _summary(pressure=0.6),
+                     "b:1": _summary(pressure=0.2),
+                     "c:1": _summary(ready=False)})
+    r = AdmissionRouter(view, hysteresis=0.1, post=lambda *a: (201, {}, b"{}"))
+    host, scores = r.pick("app")
+    assert host == "b:1"
+    assert set(scores) == {"a:1", "b:1"}   # the unready host never scored
+    # a near-tie inside the band keeps the traffic where it is
+    view.set("a:1", _summary(pressure=0.15))
+    assert r.pick("app")[0] == "b:1"
+    # outside the band: routing moves
+    view.set("a:1", _summary(pressure=0.01))
+    assert r.pick("app")[0] == "a:1"
+
+
+def test_router_failover_honors_retry_after():
+    view = FakeView({"a:1": _summary(pressure=0.1),
+                     "b:1": _summary(pressure=0.5)})
+    calls = []
+
+    def post(url, body, timeout):
+        calls.append(url)
+        if "//a:1/" in url:                # best host sheds: 503 + backoff
+            return 503, {"Retry-After": "7"}, b'{"error": "overloaded"}'
+        return 201, {}, json.dumps({"sid": "s1", "tenant":
+                                    body["tenant"]}).encode()
+
+    r = AdmissionRouter(view, hysteresis=0.1, post=post)
+    out = r.admit("app", tenant="t")
+    assert out["host"] == "b:1" and out["failovers"] == 1
+    assert out["session"]["sid"] == "s1"
+    assert ["//a:1/" in c for c in calls] == [True, False]
+    # every host refusing surfaces the largest Retry-After it saw
+    view.set("b:1", None)
+    with pytest.raises(NoReadyHost) as ei:
+        r.admit("app")
+    assert ei.value.retry_after >= 7
+    evs = journal_mod.events(cat="fleet")["events"]
+    names = [e["event"] for e in evs]
+    assert "route-failover" in names and "route" in names \
+        and "route-failed" in names
+    routed = [e for e in evs if e["event"] == "route"][-1]
+    assert routed["host"] == "b:1" and "b:1" in routed["scores"]
+    assert routed["failovers"] == 1
+    # the refused host's decision is its own journaled event
+    fo = [e for e in evs if e["event"] == "route-failover"][-1]
+    assert fo["host"] == "a:1" and fo["status"] == 503 \
+        and fo["retry_after"] == 7
+
+
+# ---------------------------------------------------------------------------
+# FleetView staleness state machine (injected fetch, no sockets)
+# ---------------------------------------------------------------------------
+
+def test_fleetview_fresh_stale_down_recovered():
+    up = {"p1:1": True, "p2:1": True}
+
+    def fetch(url, timeout):
+        peer = url.split("//")[1].split("/")[0]
+        if not up[peer]:
+            raise OSError("connection refused")
+        return json.dumps(_summary(host=peer)).encode()
+
+    v = FleetView(["p1:1", "p2:1"], poll_interval=0.05, down_errors=2,
+                  fetch=fetch)
+    j0 = journal_mod.journal().seq
+    v.poll_once()
+    assert {p: h["state"] for p, h in v.hosts().items()} == \
+        {"p1:1": "up", "p2:1": "up"}
+    assert v.snapshot()["ready"] and v.snapshot()["hosts_ready"] == 2
+    # first failed poll: stale (not yet down), verdict surfaces it
+    up["p2:1"] = False
+    v.poll_once()
+    assert v.hosts()["p2:1"]["state"] == "stale"
+    assert any(x["verdict"] == "host-stale" and x["host"] == "p2:1"
+               for x in v.verdicts())
+    # second consecutive failure (= fleet_down_errors): down
+    v.poll_once()
+    assert v.hosts()["p2:1"]["state"] == "down"
+    assert not v.snapshot()["ready"]       # a down host degrades the fleet
+    assert "p2:1" not in v.ready_hosts() and "p1:1" in v.ready_hosts()
+    # recovery on the next good poll
+    up["p2:1"] = True
+    v.poll_once()
+    assert v.hosts()["p2:1"]["state"] == "up"
+    # the journal tells the story in order: stale -> down -> recovered
+    evs = [e for e in journal_mod.events(since=j0, cat="fleet")["events"]
+           if e.get("host") == "p2:1"]
+    assert [e["event"] for e in evs] == \
+        ["host-up", "host-stale", "host-down", "host-recovered"]
+    down = [e for e in evs if e["event"] == "host-down"][0]
+    assert down["errors"] == 2             # within 2 poll intervals
+
+
+def test_fleetview_age_staleness_between_polls():
+    v = FleetView(["p:1"], poll_interval=0.05, stale_s=0.08,
+                  fetch=lambda u, t: json.dumps(_summary()).encode())
+    v.poll_once()
+    assert v.hosts()["p:1"]["state"] == "up"
+    time.sleep(0.1)                        # age past stale_s with no poll
+    v._age_sweep()
+    assert v.hosts()["p:1"]["state"] == "stale"
+
+
+def test_fleet_verdicts_pressure_skew_and_storm():
+    def fetch(url, timeout):
+        peer = url.split("//")[1].split("/")[0]
+        if peer == "hot:1":
+            s = _summary(host=peer, pressure=0.9, occupants=("s1", "s2"))
+            s["compile_storm"] = True
+            return json.dumps(s).encode()
+        s = _summary(host=peer, pressure=0.1)
+        s["compile_storm"] = peer == "warm:1"
+        return json.dumps(s).encode()
+
+    v = FleetView(["hot:1", "cold:1", "warm:1"], poll_interval=0.05,
+                  skew=0.5, fetch=fetch)
+    v.poll_once()
+    verdicts = {x["verdict"]: x for x in v.verdicts()}
+    skew = verdicts["pressure-skew"]
+    assert skew["hot"] == "hot:1" and skew["cold"] in ("cold:1", "warm:1")
+    # the hottest host's resident sessions surface as eviction candidates
+    assert {c["sid"] for c in skew["evict_candidates"]} == {"s1", "s2"}
+    # 2 of 3 hosts storming -> fleet-wide compile storm
+    storm = verdicts["fleet-compile-storm"]
+    assert storm["hosts"] == ["hot:1", "warm:1"]
+
+
+# ---------------------------------------------------------------------------
+# merged exposition: host label + stable ordering
+# ---------------------------------------------------------------------------
+
+def test_merge_metrics_stable_order_and_bucket_order():
+    hist = ("# TYPE fsdr_lat histogram\n"
+            'fsdr_lat_bucket{le="0.5"} 1\n'
+            'fsdr_lat_bucket{le="2"} 3\n'    # "2" sorts before "0.5"
+            'fsdr_lat_bucket{le="+Inf"} 3\n'  # lexically — order must hold
+            "fsdr_lat_sum 1.5\nfsdr_lat_count 3\n")
+    texts = {"b:1": "# TYPE z_c counter\nz_c 1\n# TYPE a_g gauge\na_g 2\n",
+             "a:1": hist}
+    merged = merge_metrics(texts)
+    # families sort by name; each host's sample lines keep original order
+    fam_order = [ln.split()[2] for ln in merged.splitlines()
+                 if ln.startswith("# TYPE")]
+    assert fam_order == ["a_g", "fsdr_lat", "z_c"]
+    lat = [ln for ln in merged.splitlines()
+           if ln.startswith("fsdr_lat_bucket")]
+    assert [ln.split('le="')[1].split('"')[0] for ln in lat] == \
+        ["0.5", "2", "+Inf"]               # NOT resorted lexically
+    assert all('host="a:1"' in ln for ln in lat)
+    # merging twice is byte-identical (the stable-ordering contract the
+    # fleet smoke diffs)
+    assert merged == merge_metrics(dict(reversed(list(texts.items()))))
+    # unlabelled samples gain {host=...}; labelled keep theirs after it
+    assert 'z_c{host="b:1"} 1' in merged
+    assert 'a_g{host="b:1"} 2' in merged
+
+
+# ---------------------------------------------------------------------------
+# journal spool rotation (satellite: size-capped, keep-N, atomic, journaled)
+# ---------------------------------------------------------------------------
+
+def test_journal_spool_rotation(tmp_path):
+    j = Journal(maxlen=64, spool_dir=str(tmp_path), spool_cap_mb=1,
+                spool_keep=2)
+    blob = "x" * 4096
+    # ~3 MiB of events through a 1 MiB cap: at least two rotations
+    for i in range(3 * 256):
+        j.emit("chaos", "fill", i=i, blob=blob)
+    seq_after = j.seq
+    base = tmp_path / f"events_{os.getpid()}.jsonl"
+    assert base.exists()
+    assert (tmp_path / f"{base.name}.1").exists()
+    assert (tmp_path / f"{base.name}.2").exists()
+    assert not (tmp_path / f"{base.name}.3").exists()   # keep-N enforced
+    assert base.stat().st_size < 1 << 20   # active file restarted fresh
+    # every rotated generation stays within ~cap
+    for gen in (f"{base.name}.1", f"{base.name}.2"):
+        assert (tmp_path / gen).stat().st_size < (1 << 20) + 8192
+    # the rotation event is journaled — in the ring AND as the first line
+    # of each post-rotation spool file — with seq continuity intact
+    rot = [e for e in j.last(64) if e["event"] == "spool-rotate"]
+    assert rot and rot[-1]["cat"] == "journal"
+    assert rot[-1]["keep"] == 2 and rot[-1]["rotated_bytes"] >= 1 << 20
+    first = json.loads(base.read_text().splitlines()[0])
+    assert first["event"] == "spool-rotate"
+    with open(tmp_path / f"{base.name}.1") as f:
+        gen1 = [json.loads(ln) for ln in f]
+    assert gen1[0]["event"] == "spool-rotate"
+    seqs = [e["seq"] for e in gen1]
+    assert seqs == sorted(seqs)            # monotonic within a generation
+    # emission never raises and the counter never resets across rotation
+    assert j.emit("chaos", "after") == seq_after + 1
+    j.close()
+
+
+def test_journal_spool_no_rotation_when_disabled(tmp_path):
+    j = Journal(maxlen=8, spool_dir=str(tmp_path), spool_cap_mb=0)
+    for i in range(64):
+        j.emit("chaos", "fill", blob="y" * 1024)
+    assert not list(tmp_path.glob("*.jsonl.1"))         # 0 = never rotate
+    j.close()
+
+
+# ---------------------------------------------------------------------------
+# live: 3 control-port subprocesses, kill one, routing shifts
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(url, timeout=5):
+    return json.load(urllib.request.urlopen(url, timeout=timeout))
+
+
+def _spawn_children(specs):
+    """specs: [(port, pressure), ...] -> procs (READY line awaited)."""
+    pypath = _ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, PYTHONPATH=pypath.rstrip(os.pathsep))
+    procs = [subprocess.Popen(
+        [sys.executable, _CHILD, str(port), str(pressure)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for port, pressure in specs]
+    deadline = time.monotonic() + 30
+    for p, (port, _pr) in zip(procs, specs):
+        seen = []
+        while time.monotonic() < deadline:
+            line = p.stdout.readline()     # log lines precede the marker
+            seen.append(line)
+            if "READY" in line or not line:
+                break
+        assert seen and "READY" in seen[-1], \
+            f"child {port} failed: {seen!r}"
+    return procs
+
+
+def test_live_fleet_three_hosts_kill_one_routes_to_survivors():
+    specs = [(_free_port(), 0.1), (_free_port(), 0.3), (_free_port(), 0.5)]
+    peers = [f"127.0.0.1:{port}" for port, _ in specs]
+    interval = 0.15
+    procs = _spawn_children(specs)
+    view = None
+    parent_port = _free_port()
+    cp = None
+    try:
+        # the parent is a host-only aggregator: fleet config via env ->
+        # reload, its control port starts the FleetView + serves /api/fleet/
+        os.environ["FUTURESDR_TPU_FLEET_PEERS"] = ",".join(peers)
+        os.environ["FUTURESDR_TPU_FLEET_POLL_INTERVAL"] = str(interval)
+        from futuresdr_tpu.config import reload_config
+        from futuresdr_tpu.runtime.ctrl_port import ControlPort
+        reload_config()
+
+        class _Handle:                     # host-only port: no flowgraphs
+            def flowgraph_ids(self):
+                return []
+
+            def get_flowgraph(self, fg):
+                return None
+
+        cp = ControlPort(_Handle(), bind=f"127.0.0.1:{parent_port}")
+        cp.start()
+        view = fleet.active_view()
+        assert view is not None            # started by the control port
+        base = f"http://127.0.0.1:{parent_port}"
+        deadline = time.monotonic() + 15
+        snap = {}
+        while time.monotonic() < deadline:
+            snap = _get(f"{base}/api/fleet/")
+            if snap.get("hosts_ready") == 3:
+                break
+            time.sleep(interval)
+        assert snap.get("hosts_ready") == 3 and snap["ready"], snap
+        # per-host summaries rode the poll: pressure + app table visible
+        hosts = snap["hosts"]
+        assert hosts[peers[0]]["summary"]["pressure"] == 0.1
+        assert "app" in hosts[peers[2]]["summary"]["apps"]
+        # merged exposition: stably ordered, every sample host-labelled
+        m1 = urllib.request.urlopen(
+            f"{base}/api/fleet/metrics", timeout=5).read().decode()
+        m2 = urllib.request.urlopen(
+            f"{base}/api/fleet/metrics", timeout=5).read().decode()
+        assert f'host="{peers[0]}"' in m1
+        stable = [ln.partition(" ")[0] for ln in m1.splitlines()]
+        assert stable == [ln.partition(" ")[0] for ln in m2.splitlines()]
+        # routed admission lands on the least-pressure child
+        router = AdmissionRouter(view, hysteresis=0.05)
+        out = router.admit("app", tenant="t0")
+        assert out["host"] == peers[0]
+        # SIGKILL the current pick mid-serve
+        j0 = journal_mod.journal().seq
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        t_kill = time.monotonic()
+        deadline = t_kill + 15
+        while time.monotonic() < deadline:
+            if view.hosts()[peers[0]]["state"] == "down":
+                break
+            time.sleep(interval / 3)
+        assert view.hosts()[peers[0]]["state"] == "down"
+        # the flip took exactly fleet_down_errors consecutive misses — the
+        # "down within 2 poll intervals" contract (journal carries it)
+        evs = [e for e in journal_mod.events(since=j0, cat="fleet")["events"]
+               if e.get("host") == peers[0]]
+        assert [e["event"] for e in evs][:2] == ["host-stale", "host-down"]
+        assert evs[1]["errors"] == 2
+        # 100% of subsequent admits route to the survivors, journaled
+        targets = [router.admit("app", tenant=f"t{i}")["host"]
+                   for i in range(10)]
+        assert set(targets) <= {peers[1], peers[2]}
+        routed = [e for e in journal_mod.events(since=j0,
+                                                cat="fleet")["events"]
+                  if e["event"] == "route"]
+        assert len(routed) >= 10
+        assert all(e["host"] != peers[0] for e in routed)
+        # the doctor report carries the fleet section with the down verdict
+        from futuresdr_tpu.telemetry import doctor as doc
+        rep = doc.doctor().report(events=[])
+        assert rep["fleet"]["states"]["down"] == [peers[0]]
+        assert any(x["verdict"] == "host-down"
+                   for x in rep["fleet"]["verdicts"])
+    finally:
+        if cp is not None:
+            cp.stop()
+        fleet.shutdown()
+        os.environ.pop("FUTURESDR_TPU_FLEET_PEERS", None)
+        os.environ.pop("FUTURESDR_TPU_FLEET_POLL_INTERVAL", None)
+        from futuresdr_tpu.config import reload_config
+        reload_config()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
