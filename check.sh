@@ -186,6 +186,13 @@ echo "== multi-tenant serving gate (docs/serving.md) =="
 # newcomers while residents keep delivering (serve_shed_p99_ms stamped)
 JAX_PLATFORMS=cpu python perf/serve_ab.py --smoke
 
+echo "== serve churn gate (docs/serving.md 'Paged session carries') =="
+# the paged-engine acceptance regime: join/leave EVERY step for 100 events
+# at N=64, K in {1,4} — ZERO recompiles of the resident capacity (the page
+# table absorbs all churn as host map edits) and churn p99 within 1.5x the
+# no-churn p99 at the same capacity
+JAX_PLATFORMS=cpu python perf/serve_ab.py --churn --smoke
+
 echo "== mesh-sharded device plane gate (docs/parallel.md) =="
 # the data-sharded fused program on the virtual 8-device mesh: bit-identical
 # per shard to the D=1 program at matched K, ONE dispatch per group (the

@@ -242,6 +242,12 @@ class Config:
     serve_drain_on_sigterm: bool = False   # register_app installs a SIGTERM
     #   hook that drains every registered serving app (refuse admissions,
     #   finish in-flight, persist all lanes) — the rolling-restart contract
+    serve_inflight: int = 1                # overlapped-step depth: how many
+    #   dispatch groups the engine keeps in flight before draining the
+    #   oldest (CreditController-governed, docs/serving.md "The overlapped
+    #   step"). 1 (default) = launch-then-drain each step, byte-for-byte
+    #   the synchronous engine; >1 overlaps H2D(t+1) ∥ compute(t) ∥
+    #   D2H(t-1) and adapts within [2, depth] off wire/compute balance
     # Interior precision (ops/precision.py, docs/tpu_notes.md "Interior
     # precision"): SNR-budgeted lowering of interior DAG edges and stage
     # accumulation inside the fused device programs. "off" (default) is

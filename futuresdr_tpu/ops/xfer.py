@@ -582,6 +582,7 @@ def start_device_transfer(arr, device=None):
             (p,) = put()
             return join(p)
 
+        finish._wire = getattr(put, "_wire", None)
         return finish
     put = start_device_transfer_parts((a,), device)
 
@@ -589,6 +590,7 @@ def start_device_transfer(arr, device=None):
         (x,) = put()
         return x
 
+    finish._wire = getattr(put, "_wire", None)
     return finish
 
 

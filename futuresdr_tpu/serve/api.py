@@ -12,6 +12,8 @@ port automatically (plus available as ``routes()`` for a bespoke server):
   GET    /api/serve/{app}/session/{sid}/     → per-session metrics/doctor view
   POST   /api/serve/{app}/session/{sid}/evict/   → evict carry to host
   POST   /api/serve/{app}/session/{sid}/readmit/ → restore it bit-identically
+  POST   /api/serve/{app}/session/{sid}/ctrl/    → lane-addressed retune
+                                               {"stage": ..., "params": {...}}
   DELETE /api/serve/{app}/session/{sid}/     → leave
   POST   /api/serve/{app}/drain/             → graceful drain (refuse
                                                admissions, finish in-flight,
@@ -90,11 +92,14 @@ def apps() -> Dict[str, "object"]:
 # -- aiohttp handlers ---------------------------------------------------------
 
 async def _call(fn, *args, **kw):
-    """Run a blocking engine call off the event loop: engine methods contend
-    on the engine lock, which ``step()`` holds across an entire dispatch —
-    including a newly-resident bucket's jit compile (seconds on a real
-    backend). Calling them inline would freeze every other control-port
-    route (/metrics scrapes, doctor, flowgraph APIs) for that long."""
+    """Run a blocking engine call off the event loop: surgery methods
+    (evict/readmit/retune) contend on the engine's STEP lock, which a
+    stepper holds across an entire dispatch — including a newly-resident
+    capacity's jit compile (seconds on a real backend). Calling them inline
+    would freeze every other control-port route (/metrics scrapes, doctor,
+    flowgraph APIs) for that long. (Read-only views only take the narrow
+    state lock, but they ride the executor too — uniformity is cheaper
+    than auditing each handler's lock discipline.)"""
     import asyncio
     import functools
     return await asyncio.get_running_loop().run_in_executor(
@@ -204,6 +209,35 @@ async def _session_readmit(request):
     except ServeFull as e:
         return _serve_full(eng, name, e)
     except ValueError as e:
+        return _json_error(name, str(e), 409)
+    return web.json_response(s.view())
+
+
+async def _session_ctrl(request):
+    """``POST /api/serve/{app}/session/{sid}/ctrl/``: lane-addressed
+    retune — apply an ``update_stage`` hook to ONE session's carry page at
+    the lane's next quiescent boundary, siblings untouched. Body
+    ``{"stage": <name|index>, "params": {...}}``; a bad stage address or a
+    stage without an update hook is a 409 on this app's contract (the
+    session exists — the REQUEST is wrong)."""
+    from aiohttp import web
+    eng = _engine_or_404(request)
+    name = request.match_info["app"]
+    try:
+        body = await request.json()
+        stage = body["stage"]
+        params = body.get("params") or {}
+        if not isinstance(params, dict):
+            raise TypeError("params must be an object")
+    except (ValueError, KeyError, TypeError):
+        return _json_error(name, "bad json body: expected "
+                           '{"stage": ..., "params": {...}}', 400)
+    try:
+        s = await _call(eng.retune, request.match_info["sid"], stage,
+                        **params)
+    except KeyError:
+        return _json_error(name, "session not found", 404)
+    except (ValueError, TypeError) as e:
         return _json_error(name, str(e), 409)
     return web.json_response(s.view())
 
@@ -320,6 +354,7 @@ def routes() -> List[Tuple[str, str, object]]:
         ("GET", "/api/serve/{app}/session/{sid}/", _session_view),
         ("POST", "/api/serve/{app}/session/{sid}/evict/", _session_evict),
         ("POST", "/api/serve/{app}/session/{sid}/readmit/", _session_readmit),
+        ("POST", "/api/serve/{app}/session/{sid}/ctrl/", _session_ctrl),
         ("DELETE", "/api/serve/{app}/session/{sid}/", _session_delete),
         ("POST", "/api/serve/{app}/drain/", _drain_app),
         ("GET", "/healthz", healthz),

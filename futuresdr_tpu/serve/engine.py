@@ -16,12 +16,26 @@ with a leading session axis:
 * RAGGED admission in the style of Ragged Paged Attention
   (arXiv:2604.15464): a fixed-capacity slot axis with padded inactive
   lanes masked by an ``active`` lanes vector threaded as a program input —
-  sessions join, leave and stall mid-flight by flipping mask lanes and
-  functionally updating carry slices, with ZERO recompiles of resident
-  buckets (``self.compiles`` is the pin);
+  sessions join, leave and stall mid-flight by flipping mask lanes, with
+  ZERO recompiles of resident buckets (``self.compiles`` is the pin);
+* PAGED carry storage (docs/serving.md "Paged session carries"): per-lane
+  carries live in a fixed-size page pool indexed by the session→page
+  permutation the :class:`~futuresdr_tpu.serve.slots.SlotTable` maintains;
+  the compiled program gathers each lane's page, substitutes the fresh
+  template on ``fresh``-flagged lanes, steps, and scatters back — so a
+  join lands at its own frame cursor MID-megabatch as a page-map edit, a
+  leave parks the page, and eviction reads one page, never a restack;
+* an OVERLAPPED step: the dispatch group launched at step t rides async
+  ``start_device_transfer`` H2D and ``start_host_transfer`` D2H finishes,
+  governed by the streamed path's
+  :class:`~futuresdr_tpu.tpu.kernel_block.CreditController`, so
+  H2D(t+1) ∥ compute(t) ∥ D2H(t−1) holds for serving exactly as for the
+  streamed kernel — committed carries advance ONLY after a group's D2H
+  lands (a failed drain re-queues every uncommitted group's frames:
+  PR 10's rollback contract, now over a window);
 * autotuned bucket sizes (``tpu/autotune.autotune_serve``): occupancy
-  crossing the current bucket restacks the carries into the next bucket's
-  capacity and compiles THAT bucket once;
+  crossing the current bucket grows the PAGE POOL to the next bucket's
+  capacity and compiles THAT capacity once;
 * per-session carry slots riding the checkpoint machinery: ``evict`` lands
   a session's carry lane on the host via ``snapshot_carry``'s leaf
   contract, ``readmit`` restores it bit-identically (validated by
@@ -65,6 +79,7 @@ from ..telemetry import prom as _prom
 from ..telemetry.doctor import E2E_LATENCY as _E2E_LATENCY
 from ..telemetry.spans import recorder as _trace_recorder
 from .credits import TenantCreditController
+from .overload import LATENCY_RUNG as _LATENCY_RUNG
 from .overload import ShedLadder
 from .persist import SessionStore
 from .slots import (ServeDraining, ServeFull, ServeOverload, Session,
@@ -137,12 +152,26 @@ def default_buckets() -> tuple:
 
 
 def build_slot_program(pipeline, capacity: int, k: int = 1):
-    """Compile the pipeline's slot-batched serving step for one bucket:
+    """Compile the pipeline's PAGED slot-batched serving step for one
+    page-pool capacity:
 
-        step(carries, x, active) -> (carries', outs)
+        step(pages, page_map, fresh, x, active) -> (pages', outs)
 
-    with every carry leaf carrying a leading ``[capacity]`` axis. ``k == 1``
-    (the default): ``x`` is ``[capacity, frame]``, ``active`` a
+    with every page-pool leaf carrying a leading ``[capacity]`` page axis.
+    ``page_map`` is the lane→page PERMUTATION of ``[0, capacity)`` the
+    :class:`~futuresdr_tpu.serve.slots.SlotTable` maintains, threaded as a
+    program INPUT: the step gathers each lane's carry page
+    (``leaf[page_map]``), steps the lanes, and scatters the merged carries
+    back (``leaf.at[page_map].set(...)``) — churn edits the map on the
+    host, never the program. The permutation invariant is load-bearing:
+    a duplicate scatter index would make the result order-undefined.
+    ``fresh`` is a ``[capacity]`` bool vector flagging lanes admitted since
+    the last dispatch: their gathered page (stale bits of whoever parked
+    there last) is replaced by the pipeline's init-carry template INSIDE
+    the program, so admission writes nothing to the device — a joining
+    session starts at its own frame cursor mid-megabatch.
+
+    ``k == 1`` (the default): ``x`` is ``[capacity, frame]``, ``active`` a
     ``[capacity]`` bool vector, outs ``[capacity, out]`` per sink.
 
     ``k > 1`` is the MEGABATCH serving form: ``x`` is ``[capacity, k,
@@ -153,20 +182,37 @@ def build_slot_program(pipeline, capacity: int, k: int = 1):
     with fewer than k queued frames ride the same dispatch with their tail
     masked and their carries frozen from their last real frame on (frames
     pack at the front of the k axis; a masked row can never corrupt a
-    later real frame's carry).
+    later real frame's carry). The page gather/scatter happens ONCE around
+    the whole scan, not per frame.
 
-    Inactive lanes keep their OLD carry (bit-frozen stall semantics);
-    output rows of inactive lane-frames are never delivered, so their
-    value is irrelevant. No donation: admission/eviction do functional
-    lane reads/updates on the live stacked carries between dispatches —
-    donation would invalidate exactly the buffers those touch. Shared
-    with ``tpu/autotune.autotune_serve`` so the measured program is
-    exactly the served one."""
+    Inactive lanes keep their OLD carry (bit-frozen stall semantics) —
+    except fresh lanes, which scatter the TEMPLATE back so their page is
+    initialized by their first ride whether or not they had a frame.
+    Output rows of inactive lane-frames are never delivered, so their
+    value is irrelevant. No donation: eviction and lane surgery do
+    functional page reads/updates on the live pool between dispatches, and
+    the overlapped step keeps the committed pool alive while speculative
+    groups are in flight — donation would invalidate exactly those
+    buffers. Shared with ``tpu/autotune.autotune_serve`` so the measured
+    program is exactly the served one."""
     import jax
     import jax.numpy as jnp
 
     inner = pipeline.fn()
     multi = bool(getattr(pipeline, "n_branches", 0))
+    template = pipeline.init_carry()
+
+    def gather(pages, page_map, fresh):
+        def pick(P, t):
+            c = P[page_map]
+            m = fresh.reshape((fresh.shape[0],) + (1,) * (c.ndim - 1))
+            return jnp.where(m, jnp.asarray(t)[None], c)
+
+        return jax.tree_util.tree_map(pick, pages, template)
+
+    def scatter(pages, page_map, carries):
+        return jax.tree_util.tree_map(
+            lambda P, c: P.at[page_map].set(c), pages, carries)
 
     def masked_lane_step(carries, x, active):
         new_c, y = jax.vmap(inner)(carries, x)
@@ -178,11 +224,14 @@ def build_slot_program(pipeline, capacity: int, k: int = 1):
         return jax.tree_util.tree_map(sel, new_c, carries), y
 
     if int(k) <= 1:
-        def step(carries, x, active):
+        def step(pages, page_map, fresh, x, active):
+            carries = gather(pages, page_map, fresh)
             new_c, y = masked_lane_step(carries, x, active)
-            return new_c, (y if multi else (y,))
+            return scatter(pages, page_map, new_c), (y if multi else (y,))
     else:
-        def step(carries, x, active):
+        def step(pages, page_map, fresh, x, active):
+            carries = gather(pages, page_map, fresh)
+
             def body(c, xa):
                 xk, ak = xa
                 return masked_lane_step(c, xk, ak)
@@ -195,9 +244,39 @@ def build_slot_program(pipeline, capacity: int, k: int = 1):
                 outs = tuple(jnp.moveaxis(yj, 0, 1) for yj in ys)
             else:
                 outs = (jnp.moveaxis(ys, 0, 1),)
-            return carries, outs
+            return scatter(pages, page_map, carries), outs
 
     return jax.jit(step, donate_argnums=())
+
+
+class _DispatchGroup:
+    """One launched-but-uncommitted serving dispatch (the overlapped step's
+    unit of flight): the host-side batch bookkeeping assembled at step t,
+    the speculative output pages the program produced, and the pending D2H
+    finishes. Committed oldest-first; a failed drain rolls the whole chain
+    back (every younger group derived its pages from this one's output)."""
+
+    __slots__ = ("capacity", "k", "lanes", "n_frames", "batch", "active",
+                 "fresh", "page_map", "fresh_lanes", "step_tids", "t_step",
+                 "new_pages", "fins", "wire")
+
+    def __init__(self, capacity: int, k: int, lanes: list, batch, active,
+                 fresh, page_map, fresh_lanes: frozenset, step_tids: list,
+                 t_step: int):
+        self.capacity = capacity
+        self.k = k
+        self.lanes = lanes            # (session, lane, popped, tids) tuples
+        self.n_frames = sum(len(p) for _s, _l, p, _t in lanes)
+        self.batch = batch
+        self.active = active
+        self.fresh = fresh
+        self.page_map = page_map
+        self.fresh_lanes = fresh_lanes
+        self.step_tids = step_tids
+        self.t_step = t_step
+        self.new_pages = None         # set by launch
+        self.fins = None              # pending D2H finishes, one per sink
+        self.wire = None              # H2D (service, deadline) wire window
 
 
 class ServeEngine:
@@ -218,7 +297,8 @@ class ServeEngine:
                  persist_dir: Optional[str] = None,
                  persist_every: Optional[int] = None,
                  slo_ms: Optional[float] = None,
-                 shard_devices: Optional[int] = None):
+                 shard_devices: Optional[int] = None,
+                 inflight: Optional[int] = None):
         from ..config import config
         from ..tpu.instance import instance
         self.pipeline = pipeline
@@ -268,16 +348,55 @@ class ServeEngine:
         #: program change, never churn)
         self._programs: Dict[tuple, object] = {}
         self.compiles = 0                 # program builds (the recompile pin)
-        self.table = SlotTable(self.buckets[0])
+        start_cap = self.buckets[0]
+        if buckets is None:
+            # the autotune cache's paged-bucket axis (serve_pages): a
+            # measured page-pool capacity pre-provisions the pool so the
+            # first churn wave never climbs the ladder compile-by-compile
+            start_cap = self._cached_pages() or start_cap
+        self.table = SlotTable(start_cap)
         self._fresh = None                # fresh single-lane carry template
-        self._carries = self._stacked_fresh(self.table.capacity)
+        #: committed page pool: one lane-sized carry page per slot of the
+        #: current capacity, indexed by the SlotTable's lane→page
+        #: permutation. Advances ONLY when a dispatch group's D2H lands.
+        self._pages = self._stacked_fresh(self.table.capacity)
+        #: speculative head of the page-pool chain: the newest launched
+        #: group's output pages — the next group's input. Equal to
+        #: ``_pages`` whenever nothing is in flight.
+        self._head_pages = self._pages
+        #: lanes admitted since their first dispatch: the program replaces
+        #: their gathered page with the fresh template (see
+        #: build_slot_program) — the bits clear when a group launches and
+        #: are restored by rollback
+        self._fresh_lanes: set = set()
         per_slot = int(queue_frames
                        if queue_frames is not None
                        else config().get("serve_queue_frames", 2))
         self._queue_frames = max(1, per_slot)
         self.credits = TenantCreditController(
             self._queue_frames * self.table.capacity)
+        # overlapped step (docs/serving.md "The overlapped step"): up to
+        # ``serve_inflight`` dispatch groups ride concurrently — launched
+        # (H2D + program call + D2H started) but uncommitted. Depth 1 is
+        # byte-for-byte the synchronous engine. The budget is governed by
+        # the streamed path's CreditController: with a modeled wire it
+        # probes one extra group when the up-link idles between launches
+        # and rolls back probes that don't pay (kernel_block.py).
+        from ..tpu.kernel_block import CreditController
+        depth = max(1, int(inflight if inflight is not None
+                           else config().get("serve_inflight", 1)))
+        self._flight = CreditController(depth, adaptive=depth > 1)
+        self._inflight: Deque = deque()   # launched, uncommitted groups
+        #: step/quiesce lock — ALWAYS acquired before ``_lock``. Held by
+        #: steppers across launch+drain (so the in-flight chain has one
+        #: owner) and by page-touching surgery (evict/readmit/retune/
+        #: growth/brownout), which must drain the chain first. The state
+        #: lock ``_lock`` below is held only for table/queue mutation —
+        #: never across a compile, transfer wait, or program call — so
+        #: /metrics, health() and describe() answer mid-step.
+        self._step_lock = threading.RLock()
         self._lock = threading.RLock()
+        self._ticking = False             # _overload_tick re-entry guard
         # bounded retired-session retention: a faulted client rarely comes
         # back to DELETE its session, so retired views (and their
         # undelivered output) would otherwise accumulate forever in a
@@ -378,14 +497,6 @@ class ServeEngine:
         per = self.table.capacity // self._shard_d
         return (int(slot) // per, int(slot) % per)
 
-    def _place_slots(self, x):
-        """Land a slot-axis array (leading ``[capacity]``) according to the
-        bucket's sharding — plain device placement when unsharded."""
-        if self._shard_ok(self.table.capacity):
-            import jax
-            return jax.device_put(x, self._slot_sharding)
-        return xfer.to_device(x, self.inst.device)
-
     def _stacked_fresh(self, capacity: int):
         import jax
         import jax.numpy as jnp
@@ -394,27 +505,53 @@ class ServeEngine:
             lambda l: jnp.stack([jnp.asarray(l)] * capacity), fresh)
         if self._shard_ok(capacity):
             stacked = jax.device_put(stacked, self._slot_sharding)
+        else:
+            # COMMIT the pool to the instance device: the program's output
+            # pages (the pool after the first commit) are committed arrays,
+            # and jit keys on sharding — an uncommitted seed pool would buy
+            # a second silent compile of the same capacity on step 2
+            stacked = jax.device_put(stacked, self.inst.device)
         return stacked
 
-    def _set_lane(self, slot: int, value_tree) -> None:
+    def _set_page(self, page: int, value_tree) -> None:
+        """Write one carry page of the COMMITTED pool (readmit, restore,
+        retune). Only legal at a quiescent boundary — the caller holds the
+        step lock with nothing in flight, so the speculative head is
+        re-synced here and the next launch derives from the write."""
         import jax
+        assert not self._inflight, "page write with groups in flight"
         if self._shard_ok(self.table.capacity):
-            # lane values arrive committed to ONE device (restore_carry,
+            # page values arrive committed to ONE device (restore_carry,
             # fresh-carry leaves) — replicate them over the mesh so the
-            # scatter into the slot-sharded stack sees one device set
+            # scatter into the slot-sharded pool sees one device set
             value_tree = jax.device_put(value_tree,
                                         self._replicated_sharding)
-        self._carries = jax.tree_util.tree_map(
-            lambda L, v: L.at[slot].set(v), self._carries, value_tree)
+        self._pages = jax.tree_util.tree_map(
+            lambda L, v: L.at[page].set(v), self._pages, value_tree)
+        self._head_pages = self._pages
 
-    def _lane_leaves(self, slot: int) -> tuple:
-        """One lane's carry as host leaves ``(leaves, treedef)`` — the same
+    def _page_leaves(self, page: int) -> tuple:
+        """One carry page as host leaves ``(leaves, treedef)`` — the same
         leaf contract as ``Pipeline.snapshot_carry`` materialized, so
         ``carry_matches``/``restore_carry`` validate and rebuild it."""
         import jax
-        leaves, _ = jax.tree_util.tree_flatten(self._carries)
+        leaves, _ = jax.tree_util.tree_flatten(self._pages)
         treedef = jax.tree_util.tree_flatten(self._fresh_carry())[1]
-        return [xfer.to_host(l[slot]) for l in leaves], treedef
+        return [xfer.to_host(l[page]) for l in leaves], treedef
+
+    def _fresh_host_leaves(self) -> tuple:
+        """The fresh-template carry as host leaves: what a still-fresh
+        lane's page WILL hold after its first ride — its page bits are
+        stale until then, so evict/persist of a fresh lane snapshot the
+        template, not the page."""
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(self._fresh_carry())
+        return [np.asarray(l) for l in leaves], treedef
+
+    def _session_leaves(self, s: Session) -> tuple:
+        if s.slot is not None and s.slot in self._fresh_lanes:
+            return self._fresh_host_leaves()
+        return self._page_leaves(s.page)
 
     @property
     def _k_eff(self) -> int:
@@ -449,16 +586,31 @@ class ServeEngine:
         except Exception:                  # noqa: BLE001 — ladder seed only
             return None
 
+    def _cached_pages(self) -> Optional[int]:
+        """The autotune cache's measured page-pool capacity (the
+        paged-bucket axis ``serve_pages``), honored only when it names a
+        rung of this engine's ladder — a stale cache from a different
+        ladder must not invent an uncompilable capacity."""
+        try:
+            from ..tpu.autotune import cached_serve_pages
+            got = cached_serve_pages(self.pipeline, self.pipeline.in_dtype,
+                                     self.inst.platform)
+            return int(got) if got and int(got) in self.buckets else None
+        except Exception:                  # noqa: BLE001 — pool seed only
+            return None
+
     # -- occupancy / bucket growth ---------------------------------------------
     @property
     def capacity(self) -> int:
         return self.table.capacity
 
     def _grow_to_fit(self) -> None:
-        """Called with the lock held and no free slot: move to the next
-        bucket — restack the carries with fresh tail lanes, grow the table,
-        re-size the shared credit budget. Resident buckets keep their
-        compiled programs untouched."""
+        """Called at a QUIESCENT boundary (step lock held, nothing in
+        flight, state lock held) with no free slot: grow the page pool to
+        the next bucket — append fresh tail pages, extend the table's
+        page permutation, re-size the shared credit budget. Resident
+        capacities keep their compiled programs untouched; only the new
+        capacity compiles, once, on its first dispatch."""
         import jax
         import jax.numpy as jnp
         cur = self.table.capacity
@@ -470,20 +622,21 @@ class ServeEngine:
         cap = bigger[0]
         fresh = self._fresh_carry()
         extra = cap - cur
-        self._carries = jax.tree_util.tree_map(
+        self._pages = jax.tree_util.tree_map(
             lambda L, f: jnp.concatenate(
                 [L, jnp.stack([jnp.asarray(f)] * extra)]),
-            self._carries, fresh)
+            self._pages, fresh)
         if self._shard_ok(cap):
-            # re-shard the grown stack: the concatenate above computed on
+            # re-shard the grown pool: the concatenate above computed on
             # whatever sharding the old bucket had (a non-dividing small
             # bucket may have been unsharded) — the new bucket's lanes
             # split one contiguous block per device
-            self._carries = jax.device_put(self._carries,
-                                           self._slot_sharding)
+            self._pages = jax.device_put(self._pages,
+                                         self._slot_sharding)
+        self._head_pages = self._pages
         self.table.grow(cap)
         self.credits.set_total(self._queue_frames * cap)
-        log.info("%s: slot bucket grew %d -> %d (active %d)", self.app, cur,
+        log.info("%s: page pool grew %d -> %d (active %d)", self.app, cur,
                  cap, self.table.active)
 
     # -- session lifecycle -----------------------------------------------------
@@ -508,66 +661,92 @@ class ServeEngine:
 
     def admit(self, tenant: str = "default",
               sid: Optional[str] = None) -> Session:
-        """Join: claim a lane (growing to the next bucket when full), with a
-        FRESH per-session carry. Raises :class:`ServeFull` past the largest
-        bucket, :class:`ServeDraining` while draining, and
-        :class:`ServeOverload` while the shedding ladder is engaged."""
-        with self._lock:
-            self._refuse_admission(tenant)
-            if self.table.get(sid) is not None:
-                raise ValueError(f"session id {sid!r} already exists")
-            s = Session(tenant, sid)
-            if not self.table.free_slots():
-                self._grow_to_fit()
-            slot = self.table.admit(s)
-            self._set_lane(slot, self._fresh_carry())
-            self.credits.register(s.tenant)
-            _journal.emit("serve", "admit", app=self.app, session=s.sid,
-                          tenant=s.tenant, slot=slot)
-            self._refresh_gauges()
-            return s
+        """Join: claim a lane and bind it a carry page, with a FRESH
+        per-session carry. The fast path is a pure host-side page-map edit
+        — the fresh template is substituted INSIDE the next dispatch, so a
+        join never touches device memory, never waits for in-flight
+        groups, and lands at its own frame cursor mid-megabatch. Only pool
+        GROWTH (no free page) quiesces the in-flight window. Raises
+        :class:`ServeFull` past the largest bucket, :class:`ServeDraining`
+        while draining, and :class:`ServeOverload` while the shedding
+        ladder is engaged."""
+        while True:
+            with self._lock:
+                self._refuse_admission(tenant)
+                if self.table.get(sid) is not None:
+                    raise ValueError(f"session id {sid!r} already exists")
+                if self.table.free_slots():
+                    s = Session(tenant, sid)
+                    slot = self.table.admit(s)
+                    self._fresh_lanes.add(slot)
+                    self.credits.register(s.tenant)
+                    _journal.emit("serve", "page-admit", app=self.app,
+                                  session=s.sid, tenant=s.tenant, slot=slot,
+                                  page=s.page)
+                    self._refresh_gauges()
+                    return s
+            # no free page: growth is page-touching surgery — drain the
+            # in-flight window under the step lock, grow the pool once,
+            # and retry the map-edit fast path (another admitter may have
+            # won the race, which is fine: the re-check sees its free page)
+            with self._step_lock:
+                self._drain_inflight(0)
+                with self._lock:
+                    if not self.table.free_slots():
+                        self._grow_to_fit()
 
     def readmit(self, sid: str) -> Session:
         """Re-admit an evicted session: restore its host carry snapshot into
-        a lane BIT-IDENTICALLY (validated against the fresh-carry template —
+        a page BIT-IDENTICALLY (validated against the fresh-carry template —
         a snapshot that no longer matches the pipeline contract is
-        refused)."""
-        with self._lock:
-            self._refuse_admission(self._session(sid).tenant)
-            s = self._session(sid)
-            if s.state != "evicted" or s.carry_leaves is None:
-                raise ValueError(f"session {sid!r} is not evicted "
-                                 f"(state={s.state})")
-            if not self.pipeline.carry_matches(
-                    s.carry_leaves, s.carry_treedef, self._fresh_carry()):
-                raise ValueError(f"session {sid!r}: evicted carry fails the "
-                                 f"pipeline contract check")
-            if not self.table.free_slots():
-                self._grow_to_fit()
-            slot = self.table.admit(s)
-            self._set_lane(slot, self.pipeline.restore_carry(
-                s.carry_leaves, s.carry_treedef, self.inst.device))
-            s.carry_leaves = None
-            s.carry_treedef = None
-            s.stall_steps = 0
-            _journal.emit("serve", "readmit", app=self.app, session=s.sid,
-                          tenant=s.tenant, slot=slot)
-            self._refresh_gauges()
-            return s
+        refused). A page write, so the in-flight window drains first."""
+        with self._step_lock:
+            self._drain_inflight(0)
+            with self._lock:
+                self._refuse_admission(self._session(sid).tenant)
+                s = self._session(sid)
+                if s.state != "evicted" or s.carry_leaves is None:
+                    raise ValueError(f"session {sid!r} is not evicted "
+                                     f"(state={s.state})")
+                if not self.pipeline.carry_matches(
+                        s.carry_leaves, s.carry_treedef, self._fresh_carry()):
+                    raise ValueError(f"session {sid!r}: evicted carry fails "
+                                     f"the pipeline contract check")
+                if not self.table.free_slots():
+                    self._grow_to_fit()
+                slot = self.table.admit(s)
+                self._set_page(s.page, self.pipeline.restore_carry(
+                    s.carry_leaves, s.carry_treedef, self.inst.device))
+                s.carry_leaves = None
+                s.carry_treedef = None
+                s.stall_steps = 0
+                _journal.emit("serve", "readmit", app=self.app, session=s.sid,
+                              tenant=s.tenant, slot=slot, page=s.page)
+                self._refresh_gauges()
+                return s
 
     def evict(self, sid: str) -> Session:
-        """Stall handling: snapshot the session's carry lane to the host and
+        """Stall handling: snapshot the session's carry page to the host and
         free the lane for a busier session; queued input stays queued. The
         snapshot rides the same leaf contract as the kernel checkpoint
-        machinery, so :meth:`readmit` restores it bit-identically."""
+        machinery, so :meth:`readmit` restores it bit-identically. A page
+        read, so the in-flight window drains first (a still-fresh lane —
+        admitted but never dispatched — snapshots the template instead of
+        its stale page bits)."""
+        with self._step_lock:
+            self._drain_inflight(0)
+            return self._evict_quiesced(sid)
+
+    def _evict_quiesced(self, sid: str) -> Session:
         with self._lock:
             s = self._session(sid)
             if s.state != "active":
                 raise ValueError(f"session {sid!r} not active "
                                  f"(state={s.state})")
-            leaves, treedef = self._lane_leaves(s.slot)
+            leaves, treedef = self._session_leaves(s)
             s.carry_leaves = leaves
             s.carry_treedef = treedef
+            self._fresh_lanes.discard(s.slot)
             self.table.release_slot(s)
             s.state = "evicted"
             if self._store is not None:
@@ -588,6 +767,8 @@ class ServeEngine:
             s = self._session(sid)
             self.credits.release(s.tenant, len(s.pending))
             s.pending.clear()
+            if s.slot is not None:
+                self._fresh_lanes.discard(s.slot)
             self.table.forget(s)
             s.state = "closed"
             if self._store is not None:
@@ -614,6 +795,8 @@ class ServeEngine:
         and every sibling's carry and output, is untouched."""
         self.credits.release(s.tenant, len(s.pending))
         s.pending.clear()
+        if s.slot is not None:
+            self._fresh_lanes.discard(s.slot)
         self.table.release_slot(s)
         s.state = "retired"
         s.error = repr(err)
@@ -674,30 +857,88 @@ class ServeEngine:
         dispatch, one D2H per sink, regardless of the active session count.
         ``frames_per_dispatch > 1`` additionally megabatches up to k queued
         frames PER LANE through the in-program scan, ragged per lane (a
-        session with fewer queued frames masks its tail — joins/leaves land
-        cleanly at megabatch boundaries because the mask, not the program
-        shape, carries the raggedness). Returns the number of
-        session-frames dispatched (0 = idle step)."""
+        session with fewer queued frames masks its tail; a JOINING session
+        rides with whatever frames it has — the fresh-page substitution
+        lands it at its own cursor mid-megabatch).
+
+        OVERLAPPED (docs/serving.md "The overlapped step"): the group
+        launched here is committed only once its D2H lands; with
+        ``serve_inflight > 1`` up to that many groups ride concurrently,
+        so H2D(t+1) ∥ compute(t) ∥ D2H(t−1). The state lock is held only
+        for batch assembly and commit bookkeeping — never across the
+        compile, the transfers, or the program call — so /metrics,
+        ``health()`` and ``describe()`` answer mid-step.
+
+        Returns the number of session-frames LAUNCHED this step. An idle
+        step (no lane has pending input) first commits everything still in
+        flight, then returns 0 — so a pump loop's
+        ``while eng.step(): pass`` still means "fully drained"."""
         # fleet hot-path hook (telemetry/fleet.py): refresh this host's own
         # fleet gauges at poll cadence. ONE falsy check when the fleet
         # plane is disabled — the guard is INLINE (a module-global read, no
         # call frame) so the disabled cost matches the park guard's; it is
         # the sixth per-call hook class the telemetry overhead gate bills
-        # (tests/test_telemetry.py). Outside the engine lock by design: the
-        # refresh reads only lock-free surfaces
+        # (tests/test_telemetry.py). Outside the engine locks by design:
+        # the refresh reads only lock-free surfaces
         if _fleet._tick_state is not None:
             _fleet.tick()
+        with self._step_lock:
+            g = self._assemble()
+            if g is None:
+                self._drain_inflight(0)
+                with self._lock:
+                    if self._ladder.level:
+                        # traffic stopped while the ladder was engaged: idle
+                        # steps count as healthy observations so admissions
+                        # reopen. idle=True: the latency window is FROZEN
+                        # with the pre-idle samples, so the SLO term must
+                        # not read a stale p99 as a live miss and ratchet
+                        # the ladder up on an empty engine
+                        self._overload_tick(idle=True)
+                return 0
+            try:
+                self._launch(g)
+            except Exception:
+                # launch-failure rollback: a transfer/compile/dispatch error
+                # must not silently drop the popped frames — re-queue them
+                # at the front of their queues (original order), re-take
+                # their credits, restore the fresh bits. The head never
+                # advanced (launch's last effect), so older in-flight
+                # groups stay valid and the caller's retry re-dispatches
+                # the exact same frames
+                self._rollback([g], reset_head=False)
+                raise
+            self._inflight.append(g)
+            self._flight.note_dispatch(g.wire, len(self._inflight))
+            n = g.n_frames
+            self._drain_inflight(self._depth_limit() - 1)
+            return n
+
+    def _depth_limit(self) -> int:
+        """The in-flight group budget this step: the flight controller's
+        live credits, collapsed to 1 while the shed ladder is at or above
+        the latency rung — an overloaded engine prefers per-frame latency
+        over pipelining, the same trade as the ``"k"`` brownout lever."""
+        if self._ladder.level >= _LATENCY_RUNG:
+            return 1
+        return max(1, int(self._flight.credits))
+
+    def _assemble(self) -> Optional[_DispatchGroup]:
+        """Build this step's dispatch group under the state lock: pop up to
+        K pending frames per occupied lane into the stacked batch, snapshot
+        the lane→page permutation and the fresh-lane vector, and CLEAR the
+        fresh bits — the launch materializes those lanes' template pages
+        (rollback restores the bits). Returns None on an idle step."""
         with self._lock:
             C = self.table.capacity
             K = self._k_eff
             fplan = _faults.plan()
-            lanes: List[tuple] = []       # (session, popped pending entries)
+            lanes: List[tuple] = []   # (session, lane, popped, tids)
             # serving-plane spans (docs/serving.md "Observability"): the
-            # batch assembly is the serving path's encode lane, the program
-            # call its compute lane, the host fetch + per-session fan-back
-            # its D2H/decode lanes — so the doctor's interval-union lanes,
-            # host_codec_overlap_frac and the trace export cover the
-            # serving plane exactly like the streamed path
+            # batch assembly is the serving path's encode lane; the H2D/D2H
+            # lanes are emitted by the async transfer finishes themselves
+            # (ops/xfer.py), so the doctor's interval-union lanes show the
+            # REAL wire concurrency of the overlapped step
             t_step = _trace.now() if _trace.enabled else 0
             t_enc = t_step
             # idle frame-time ticks (no lane has pending input — the common
@@ -747,19 +988,10 @@ class ServeEngine:
                         step_tids.append(tid)
                     tids.append(tid)
                 s.stall_steps = 0
-                lanes.append((s, popped, tids))
+                lanes.append((s, s.slot, popped, tids))
             self.steps += 1
             if not lanes:
-                if self._ladder.level:
-                    # traffic stopped while the ladder was engaged: idle
-                    # steps count as healthy observations so admissions
-                    # reopen (one int check when the ladder is at rung 0 —
-                    # the idle tick stays allocation-free). idle=True: the
-                    # latency window is FROZEN with the pre-idle samples, so
-                    # the SLO term must not read a stale p99 as a live miss
-                    # and ratchet the ladder up on an empty engine
-                    self._overload_tick(idle=True)
-                return 0
+                return None
             if t_enc:
                 _trace.complete("tpu", "encode", t_enc,
                                 args={"sessions": len(lanes),
@@ -768,70 +1000,141 @@ class ServeEngine:
                 lin = _lineage.tracer()
                 for tid in step_tids:
                     lin.stamp(tid, "encode")
+            # the fresh vector covers EVERY fresh lane, busy or not: its
+            # first ride writes the template to its page either way, so
+            # the page is real from this group on
+            fresh = np.zeros((C,), dtype=bool)
+            for lane in self._fresh_lanes:
+                if lane < C:
+                    fresh[lane] = True
+            g = _DispatchGroup(
+                C, K, lanes, batch, active, fresh,
+                np.asarray(self.table.page_of_lane, dtype=np.int32),
+                frozenset(self._fresh_lanes), step_tids, t_step)
+            self._fresh_lanes.clear()
+            return g
+
+    def _launch(self, g: _DispatchGroup) -> None:
+        """Launch one assembled group OUTSIDE the state lock (step lock
+        held): program lookup/compile, async H2D starts, the paged program
+        call against the speculative head, async D2H starts. Advancing the
+        head is the LAST effect — a failure anywhere above leaves the
+        chain exactly as it was for the rollback path."""
+        C, K = g.capacity, g.k
+        prog = self._program(C, K)
+        fx = self._start_h2d(g.batch, shard=True)
+        fa = self._start_h2d(g.active, shard=True)
+        fm = self._start_h2d(g.page_map, shard=False)
+        ff = self._start_h2d(g.fresh, shard=False)
+        x, act = fx(), fa()
+        pmap, fresh = fm(), ff()
+        g.wire = getattr(fx, "_wire", None)
+        if g.step_tids:
+            lin = _lineage.tracer()
+            for tid in g.step_tids:
+                lin.stamp(tid, "H2D")
+        t0 = _trace.now() if _trace.enabled else 0
+        key = (C, K, self._pipe_tag)
+        if key in self._warmed:
+            new_pages, outs = prog(self._head_pages, pmap, fresh, x, act)
+        else:
+            # a capacity's FIRST dispatch pays its jit compile: bill it
+            # (fsdr_compiles_total{reason="serve_bucket"}) and mark the
+            # window active so a slow compile reads as "compiling" to the
+            # doctor, never as a stalled serving loop
+            with _profile.compiling(f"serve:{self.app}", "serve_bucket",
+                                    f"cap={C},k={K},"
+                                    f"frame={self.frame_size},"
+                                    f"pipe={self._pipe_tag}"):
+                new_pages, outs = prog(self._head_pages, pmap, fresh, x, act)
+            self._warmed.add(key)
+        if t0:
+            _trace.complete("tpu", "compute", t0,
+                            args={"capacity": C,
+                                  "active_lanes": len(g.lanes)})
+        if g.step_tids:
+            lin = _lineage.tracer()
+            for tid in g.step_tids:
+                lin.stamp(tid, "dispatch")
+        g.fins = [xfer.start_host_transfer(o) for o in outs]
+        g.new_pages = new_pages
+        self._head_pages = new_pages
+
+    def _start_h2d(self, arr: np.ndarray, shard: bool):
+        """Start one async H2D for a group launch; returns a finish thunk.
+        Unsharded buckets ride ``xfer.start_device_transfer``, whose finish
+        models/measures the wire window (the ``_wire`` attribute feeding
+        the flight controller) and emits the H2D trace span — the serving
+        overlap evidence. Slot-sharded buckets place synchronously
+        (``device_put`` owns the mesh layout)."""
+        if self._shard_ok(self.table.capacity):
+            import jax
+            v = jax.device_put(arr, self._slot_sharding if shard
+                               else self._replicated_sharding)
+            return lambda: v
+        return xfer.start_device_transfer(arr, self.inst.device)
+
+    def _drain_inflight(self, keep: int) -> None:
+        """Commit in-flight groups oldest-first until at most ``keep``
+        remain (step lock held; the state lock is NOT held across the D2H
+        wait). ``keep=0`` is the quiescent barrier page-touching surgery
+        uses. A failed wait rolls back EVERY uncommitted group — each
+        younger group derived its pages from the failed one's output, so
+        none of them can commit."""
+        keep = max(0, int(keep))
+        while len(self._inflight) > keep:
+            if keep:
+                self._flight.note_limited()
+            g = self._inflight[0]
             try:
-                prog = self._program(C, K)
-                t0 = _trace.now() if _trace.enabled else 0
-                x = self._place_slots(batch)
-                act = self._place_slots(active)
-                if t0:
-                    _trace.complete("tpu", "H2D", t0,
-                                    args={"bytes": batch.nbytes})
-                if step_tids:
-                    for tid in step_tids:
-                        lin.stamp(tid, "H2D")
-                t0 = _trace.now() if _trace.enabled else 0
-                if (C, K, self._pipe_tag) in self._warmed:
-                    new_carries, outs = prog(self._carries, x, act)
-                else:
-                    # a bucket's FIRST dispatch pays its jit compile: bill
-                    # it (fsdr_compiles_total{reason="serve_bucket"}) and
-                    # mark the window active so a slow bucket compile reads
-                    # as "compiling" to the doctor, never as a stalled
-                    # serving loop
-                    with _profile.compiling(f"serve:{self.app}",
-                                            "serve_bucket",
-                                            f"cap={C},k={K},"
-                                            f"frame={self.frame_size},"
-                                            f"pipe={self._pipe_tag}"):
-                        new_carries, outs = prog(self._carries, x, act)
-                    self._warmed.add((C, K, self._pipe_tag))
-                if t0:
-                    _trace.complete("tpu", "compute", t0,
-                                    args={"capacity": C,
-                                          "active_lanes": len(lanes)})
-                if step_tids:
-                    for tid in step_tids:
-                        lin.stamp(tid, "dispatch")
-                t0 = _trace.now() if _trace.enabled else 0
-                host = [xfer.to_host(o) for o in outs]  # one D2H per sink
-                if t0:
-                    _trace.complete("tpu", "D2H", t0,
-                                    args={"sinks": len(host)})
-                if step_tids:
-                    for tid in step_tids:
-                        lin.stamp(tid, "D2H")
+                host = [np.asarray(f()) for f in g.fins]
             except Exception:
-                # dispatch-failure rollback: a real transfer/compile/dispatch
-                # error must not silently drop the popped frames for every
-                # session in the batch — re-queue them at the front of their
-                # queues (original order), re-take their credits and leave
-                # the carries untouched so the caller's retry re-dispatches
-                # the exact same frames
-                for s, popped, _tids in lanes:
-                    s.pending.extendleft(reversed(popped))
-                    self.credits.reacquire(s.tenant, len(popped))
+                doomed = list(self._inflight)
+                self._inflight.clear()
+                self._rollback(doomed, reset_head=True)
                 raise
-            self._carries = new_carries
+            self._inflight.popleft()
+            self._commit(g, host)
+
+    def _rollback(self, groups: list, reset_head: bool) -> None:
+        """Re-queue every frame of the given UNCOMMITTED groups at the
+        front of their sessions' queues (youngest group first, preserving
+        order), re-take their credits and restore their fresh-lane bits —
+        the retry re-dispatches the exact same frames. ``reset_head``: a
+        drain failure abandons the whole speculative chain, so the head
+        re-syncs to the committed pool; a LAUNCH failure never advanced
+        the head, which must stay at the older in-flight groups' output."""
+        with self._lock:
+            for g in reversed(groups):
+                for s, _lane, popped, _tids in g.lanes:
+                    if s.state not in ("active", "evicted"):
+                        continue          # closed/retired meanwhile: its
+                    s.pending.extendleft(reversed(popped))   # credits were
+                    self.credits.reacquire(s.tenant, len(popped))  # released
+                self._fresh_lanes |= g.fresh_lanes
+            if reset_head:
+                self._head_pages = self._pages
+
+    def _commit(self, g: _DispatchGroup, host: list) -> None:
+        """Land one finished group (its D2H already waited out): the
+        committed pool advances to its output pages, results fan back per
+        session, latency/lineage/persist/overload bookkeeping runs — all
+        under the state lock. A session that left while its group was in
+        flight (closed/retired, or its lane re-bound) is skipped: there is
+        nobody to deliver to."""
+        end = time.perf_counter_ns()
+        t_dec = _trace.now() if _trace.enabled else 0
+        K = g.k
+        with self._lock:
+            self._pages = g.new_pages
             self.dispatches += 1
-            end = time.perf_counter_ns()
-            t_dec = _trace.now() if _trace.enabled else 0
             dispatched = 0
-            for s, popped, tids in lanes:
+            for s, lane, popped, tids in g.lanes:
+                deliver = s.state == "active" and s.slot == lane
+                if not deliver:
+                    continue
                 for j, (_, t_sub) in enumerate(popped):
-                    if K == 1:
-                        rows = [h[s.slot] for h in host]
-                    else:
-                        rows = [h[s.slot, j] for h in host]
+                    rows = [h[lane] if K == 1 else h[lane, j] for h in host]
                     res = tuple(np.asarray(r) for r in rows) \
                         if self._multi else np.asarray(rows[0])
                     s.out.append(res)
@@ -863,19 +1166,63 @@ class ServeEngine:
                     self._persist_all()
             self._overload_tick()
             # live-roofline unit for serving: one SESSION-FRAME (the
-            # registered cost is the single-lane program's); the step
+            # registered cost is the single-lane program's); the commit
             # stamps its own group time
             self._prof.dispatch(dispatched, t=time.monotonic())
-            if t_dec:
-                _trace.complete("tpu", "decode", t_dec,
-                                args={"frames": dispatched})
-            if t_step:
-                _trace.complete("serve", "serve_step", t_step,
-                                args={"sessions": len(lanes),
-                                      "active_lanes": len(lanes),
-                                      "frames": dispatched,
-                                      "capacity": C})
-            return dispatched
+        if t_dec:
+            _trace.complete("tpu", "decode", t_dec,
+                            args={"frames": dispatched})
+        if g.t_step:
+            _trace.complete("serve", "serve_step", g.t_step,
+                            args={"sessions": len(g.lanes),
+                                  "active_lanes": len(g.lanes),
+                                  "frames": dispatched,
+                                  "capacity": g.capacity})
+
+    # -- lane-addressed retunes ------------------------------------------------
+    def retune(self, sid: str, stage, **params) -> Session:
+        """Per-session mid-stream surgery: apply ``update_stage`` to ONE
+        session's carry page at its next quiescent boundary (the in-flight
+        window drains first), journaled as ``serve/lane-retune`` — one
+        tenant retunes its receiver without touching a sibling's bits.
+        ``stage`` addresses by name or index, ``params`` are the stage's
+        ``update`` hook kwargs (the flat-carry contract of
+        ``ops/stages.py``). Raises KeyError for an unknown session,
+        ValueError for a non-active session or a refused update."""
+        import jax
+        with self._step_lock:
+            self._drain_inflight(0)
+            with self._lock:
+                s = self._session(sid)
+                if s.state != "active":
+                    raise ValueError(f"session {sid!r} not active "
+                                     f"(state={s.state})")
+                page = s.page
+                if s.slot in self._fresh_lanes:
+                    # never dispatched: its page holds stale bits — retune
+                    # the template it WILL start from, and materialize it
+                    lane_carry = self._fresh_carry()
+                else:
+                    lane_carry = jax.tree_util.tree_map(
+                        lambda P: P[page], self._pages)
+                try:
+                    new_carry = self.pipeline.update_stage(lane_carry, stage,
+                                                           **params)
+                except KeyError as e:
+                    # a bad STAGE address is a client error on this app's
+                    # contract (409), not a missing resource (404 is the
+                    # session lookup's) — re-raise in the ValueError family
+                    raise ValueError(f"retune of {sid!r}: {e}") from e
+                self._set_page(page, new_carry)
+                self._fresh_lanes.discard(s.slot)
+                _journal.emit("serve", "lane-retune", app=self.app,
+                              session=s.sid, tenant=s.tenant, slot=s.slot,
+                              page=page, stage=str(stage),
+                              params=sorted(params))
+                log.info("%s: lane retune of %s (slot %d, page %d): "
+                         "stage=%r params=%s", self.app, s.sid, s.slot,
+                         page, stage, sorted(params))
+                return s
 
     # -- durable session state (docs/robustness.md "Serving-plane recovery") --
     def _base_leaf_dtypes(self) -> list:
@@ -892,24 +1239,45 @@ class ServeEngine:
         return self._base_dt
 
     def _persist_session(self, s: Session, sync: bool = False) -> None:
-        """Queue one session's durable snapshot (lock held). Active lanes
-        capture a reference to the CURRENT stacked carries — the serving
-        program never donates, so the writer thread reads stable device
-        arrays even while later steps replace ``self._carries`` — and fetch
-        their host leaves off the step thread; evicted sessions already
-        hold host leaves. Leaves are written in the BASE pipeline's dtypes
-        (upcast when the precision brownout is live), so a kill -9 at any
-        rung restores into a fresh base-pipeline incarnation."""
+        """Queue one session's durable snapshot (state lock held). Active
+        lanes capture their PAGE of the committed pool and fetch its host
+        leaves off the step thread; evicted sessions already hold host
+        leaves. Leaves are written in the BASE pipeline's dtypes (upcast
+        when the precision brownout is live), so a kill -9 at any rung
+        restores into a fresh base-pipeline incarnation."""
         import jax
         meta = {"sid": s.sid, "tenant": s.tenant,
                 "frames_in": s.frames_in, "frames_out": s.frames_out}
         dts = self._base_leaf_dtypes()
-        if s.state == "active" and s.slot is not None:
-            leaves = jax.tree_util.tree_flatten(self._carries)[0]
-            slot = s.slot
+        # a lane whose FIRST dispatch is still riding an in-flight group is
+        # fresh too: assembly moved it out of ``_fresh_lanes`` (the program
+        # does the template substitution in-flight) but the committed pool's
+        # page still holds whatever a dead predecessor parked there — the
+        # meta says frames_out=0, so the snapshot must say "start fresh"
+        fresh_lane = s.slot is not None and (
+            s.slot in self._fresh_lanes or
+            any(s.slot in g.fresh_lanes for g in self._inflight))
+        if s.state == "active" and fresh_lane:
+            # admitted but never dispatched: its page holds stale bits —
+            # the durable snapshot is the fresh template it will start from
+            snap = self._fresh_host_leaves()[0]
 
-            def fetch(_leaves=leaves, _slot=slot, _dts=dts):
-                raw = [np.asarray(xfer.to_host(l[_slot])) for l in _leaves]
+            def fetch(_snap=snap, _dts=dts):
+                raw = [np.asarray(a) for a in _snap]
+                if len(raw) == len(_dts):
+                    raw = [a if a.dtype == dt else a.astype(dt)
+                           for a, dt in zip(raw, _dts)]
+                return raw
+        elif s.state == "active" and s.slot is not None:
+            # page-granular capture: a reference to the COMMITTED pool's
+            # leaves + this session's page index — the serving program
+            # never donates, so the writer thread reads stable device
+            # arrays even while later commits replace ``self._pages``
+            leaves = jax.tree_util.tree_flatten(self._pages)[0]
+            page = s.page
+
+            def fetch(_leaves=leaves, _page=page, _dts=dts):
+                raw = [np.asarray(xfer.to_host(l[_page])) for l in _leaves]
                 if len(raw) == len(_dts):
                     raw = [a if a.dtype == dt else a.astype(dt)
                            for a, dt in zip(raw, _dts)]
@@ -985,8 +1353,8 @@ class ServeEngine:
                                     - skipped)
                         break
                 s = Session(r["tenant"], r["sid"])
-                slot = self.table.admit(s)
-                self._set_lane(slot, self.pipeline.restore_carry(
+                self.table.admit(s)
+                self._set_page(s.page, self.pipeline.restore_carry(
                     r["leaves"], treedef, self.inst.device))
                 s.frames_in = r["frames_in"]
                 s.frames_out = r["frames_out"]
@@ -1011,12 +1379,12 @@ class ServeEngine:
                 log.warning("%s: restore warmup failed: %r", self.app, e)
 
     def _warm_current_bucket(self) -> None:
-        """Compile + warm the current bucket's program with an ALL-MASKED
-        no-op dispatch (lock held): every lane inactive, so the in-program
-        ``where(active, new, old)`` merge keeps the restored carries
-        bit-identical — the dispatch exists only to pay the jit compile
-        before the orchestrator routes traffic. Billed ``serve_bucket``
-        like any first dispatch."""
+        """Compile + warm the current capacity's program with an ALL-MASKED
+        no-op dispatch (lock held): every lane inactive and nothing fresh,
+        so the in-program merge + permutation scatter keeps the restored
+        pages bit-identical (the returned pool is discarded anyway) — the
+        dispatch exists only to pay the jit compile before the orchestrator
+        routes traffic. Billed ``serve_bucket`` like any first dispatch."""
         import jax
         C, K = self.table.capacity, self._k_eff
         key = (C, K, self._pipe_tag)
@@ -1026,16 +1394,20 @@ class ServeEngine:
         shape = (C, self.frame_size) if K == 1 else (C, K, self.frame_size)
         batch = np.zeros(shape, dtype=self.pipeline.in_dtype)
         active = np.zeros((C,) if K == 1 else (C, K), dtype=bool)
+        pmap = np.asarray(self.table.page_of_lane, dtype=np.int32)
+        no_fresh = np.zeros((C,), dtype=bool)
         with _profile.compiling(f"serve:{self.app}", "serve_bucket",
                                 f"cap={C},k={K},frame={self.frame_size},"
                                 f"pipe={self._pipe_tag},warm=restore"):
-            # _place_slots, not bare to_device: a slot-sharded bucket's
-            # carries are committed to the mesh, and a single-device batch
+            # _start_h2d, not bare to_device: a slot-sharded bucket's
+            # pages are committed to the mesh, and a single-device batch
             # would make the warm dispatch raise (and the first real step
             # pay a second, unbilled compile)
-            _new_c, outs = prog(self._carries,
-                                self._place_slots(batch),
-                                self._place_slots(active))
+            _new_p, outs = prog(self._pages,
+                                self._start_h2d(pmap, shard=False)(),
+                                self._start_h2d(no_fresh, shard=False)(),
+                                self._start_h2d(batch, shard=True)(),
+                                self._start_h2d(active, shard=True)())
             jax.block_until_ready(outs)
         self._warmed.add(key)
 
@@ -1071,14 +1443,19 @@ class ServeEngine:
                     break
         persisted = 0
         if persist and self._store is not None:
-            with self._lock:
-                if self._brownout_active:
-                    # release the brownout before the final persist: the
-                    # snapshots must land in the base dtype contract (the
-                    # per-write upcast covers a kill -9; a graceful drain
-                    # hands the NEXT incarnation full-precision carries)
-                    self._set_brownout(False)
-                persisted = self._persist_all(sync=True)
+            # step lock first: the final persist must read the COMMITTED
+            # pool with nothing speculative in flight, and a brownout
+            # release is page-dtype surgery
+            with self._step_lock:
+                self._drain_inflight(0)
+                with self._lock:
+                    if self._brownout_active:
+                        # release the brownout before the final persist: the
+                        # snapshots must land in the base dtype contract (the
+                        # per-write upcast covers a kill -9; a graceful drain
+                        # hands the NEXT incarnation full-precision carries)
+                        self._set_brownout(False)
+                    persisted = self._persist_all(sync=True)
         with self._lock:
             leftover = sum(len(s.pending) for s in self.table.sessions.values())
             self._drained = True
@@ -1135,12 +1512,12 @@ class ServeEngine:
         while the profile plane reports a serving-program compile storm.
 
         LOCK-FREE like :meth:`retry_after_s`: readyz runs on the aiohttp
-        event loop and step() holds the engine lock across whole dispatches
-        (incl. a new bucket's multi-second jit compile — exactly when an
-        orchestrator probes hardest). Plain attribute/set reads under the
-        GIL give an at-most-one-step-stale answer, which is all a probe
-        needs; blocking here would freeze /healthz too and get a healthy
-        pod killed mid-compile."""
+        event loop, and while the overlapped step keeps the STATE lock
+        narrow, a stepper can still be inside a capacity's first jit
+        compile — exactly when an orchestrator probes hardest. Plain
+        attribute/set reads under the GIL give an at-most-one-step-stale
+        answer, which is all a probe needs; blocking here would freeze
+        /healthz too and get a healthy pod killed mid-compile."""
         key = (self.table.capacity, self._k_eff, self._pipe_tag)
         active = self.table.active
         compiled = active == 0 or key in self._warmed
@@ -1195,7 +1572,15 @@ class ServeEngine:
         optional brownout lever; recovery unwinds one rung at a time.
         ``idle`` ticks skip the SLO term: the latency window holds only
         pre-idle samples, and a frozen p99 must read as "no current miss",
-        not as a live violation that keeps escalating an empty engine."""
+        not as a live violation that keeps escalating an empty engine.
+
+        Re-entrant commits are guarded: a rung-2 shed evicts, eviction
+        drains the in-flight window, and each nested commit would tick the
+        ladder again mid-action — the ``_ticking`` flag makes the nested
+        calls no-ops (the ladder loses one observation, not its
+        hysteresis)."""
+        if self._ticking:
+            return
         p99_ms = None
         if self._slo_ms and self._lat_recent and not idle:
             p99_ms = float(np.quantile(
@@ -1213,21 +1598,25 @@ class ServeEngine:
                       pressure=round(self.credits.pressure(), 4),
                       p99_ms=round(p99_ms, 3) if p99_ms is not None
                       else None)
-        if lvl > prev:
-            log.warning("%s: overload ladder escalated to rung %d (%s) — "
-                        "pressure %.2f, p99 %s ms (SLO %s)", self.app, lvl,
-                        self._ladder.rung, self.credits.pressure(),
-                        f"{p99_ms:.1f}" if p99_ms is not None else "-",
-                        self._slo_ms or "-")
-            if lvl >= 2:
-                self._shed_stalled()
-            if lvl >= 3 and self._brownout != "off":
-                self._set_brownout(True)
-        else:
-            log.info("%s: overload ladder recovered to rung %d (%s)",
-                     self.app, lvl, self._ladder.rung)
-            if lvl < 3 and self._brownout_active:
-                self._set_brownout(False)
+        self._ticking = True
+        try:
+            if lvl > prev:
+                log.warning("%s: overload ladder escalated to rung %d (%s) "
+                            "— pressure %.2f, p99 %s ms (SLO %s)", self.app,
+                            lvl, self._ladder.rung, self.credits.pressure(),
+                            f"{p99_ms:.1f}" if p99_ms is not None else "-",
+                            self._slo_ms or "-")
+                if lvl >= 2:
+                    self._shed_stalled()
+                if lvl >= 3 and self._brownout != "off":
+                    self._set_brownout(True)
+            else:
+                log.info("%s: overload ladder recovered to rung %d (%s)",
+                         self.app, lvl, self._ladder.rung)
+                if lvl < 3 and self._brownout_active:
+                    self._set_brownout(False)
+        finally:
+            self._ticking = False
 
     def _shed_stalled(self) -> None:
         """Rung 2: evict the most-stalled sessions (no queued input, most
@@ -1264,6 +1653,11 @@ class ServeEngine:
         if on == self._brownout_active:
             return
         if self._brownout == "precision":
+            # page-dtype surgery: every in-flight group was launched with
+            # the OLD program form and must commit before the pool converts
+            # (callers hold the step lock — the overload tick runs on the
+            # step thread, drain takes it explicitly)
+            self._drain_inflight(0)
             if not self._apply_precision_brownout(on):
                 return
         self._brownout_active = on
@@ -1306,7 +1700,7 @@ class ServeEngine:
         if target is self.pipeline:
             self._pipe_tag = tag
             return True
-        old_leaves, old_def = jax.tree_util.tree_flatten(self._carries)
+        old_leaves, old_def = jax.tree_util.tree_flatten(self._pages)
         self.pipeline = target
         self._fresh = None
         stacked = self._stacked_fresh(self.table.capacity)
@@ -1322,7 +1716,8 @@ class ServeEngine:
         conv = [a if getattr(a, "dtype", None) == getattr(b, "dtype", None)
                 else a.astype(b.dtype)
                 for a, b in zip(old_leaves, t_leaves)]
-        self._carries = jax.tree_util.tree_unflatten(t_def, conv)
+        self._pages = jax.tree_util.tree_unflatten(t_def, conv)
+        self._head_pages = self._pages    # quiesced: re-root the chain
         # evicted sessions hold HOST snapshots in the old dtypes: convert
         # them too, or their readmit would fail the carry_matches dtype
         # check against the new template until a process restart
@@ -1366,6 +1761,14 @@ class ServeEngine:
                 "resident_buckets": self.resident_buckets(),
                 "compiles": self.compiles,
                 "active": self.table.active,
+                # paged carries + the overlapped step (this PR): the page
+                # pool is the capacity; free/fresh counts and the in-flight
+                # window tell an operator how churned and how pipelined the
+                # engine currently is
+                "pages": {"free": self.table.free_slots(),
+                          "fresh_lanes": len(self._fresh_lanes)},
+                "overlap": {"depth": int(self._flight.credits),
+                            "in_flight": len(self._inflight)},
                 "sessions": len(self.table.sessions),
                 "steps": self.steps,
                 "dispatches": self.dispatches,

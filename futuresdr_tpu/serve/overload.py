@@ -31,10 +31,18 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["ShedLadder", "RUNGS"]
+__all__ = ["ShedLadder", "RUNGS", "LATENCY_RUNG"]
 
 #: rung names, index == level
 RUNGS = ("ok", "admission", "evict", "brownout")
+
+#: the rung from which the engine prefers per-frame LATENCY over
+#: throughput levers: at/above it the overlapped step collapses its
+#: in-flight window to depth 1 (each extra in-flight group is a whole
+#: group-time of queueing delay — the same trade as the "k" brownout
+#: lever, taken one rung earlier because pipelining depth, unlike K, is
+#: bit-exact to unwind)
+LATENCY_RUNG = 2
 
 
 class ShedLadder:

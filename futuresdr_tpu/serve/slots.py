@@ -9,9 +9,21 @@ threads into every dispatch, and a lane's per-session carry slice is
 swapped by functional index update, never by reshaping the batch.
 
 The table is pure host bookkeeping (which session owns which lane, who is
-admissible, which lanes are free); the device-side carry restacking lives
-in :class:`~futuresdr_tpu.serve.engine.ServeEngine`, which owns the stacked
-carries the slots index into.
+admissible, which lanes are free); the device-side carry pages live in
+:class:`~futuresdr_tpu.serve.engine.ServeEngine`, which owns the page pool
+the slots index into.
+
+Paged carries: alongside lane ownership the table maintains the
+session→page binding of docs/serving.md "Paged session carries". A PAGE is
+one lane-sized row of the engine's device-resident carry pool; the mapping
+``page_of_lane`` is threaded into every dispatch as a program input, so the
+compiled program gathers each lane's carry page, steps it, and scatters it
+back — joins/leaves/evicts are edits to this host-side map, never a
+restack of device memory. The map is kept a PERMUTATION of ``[0, capacity)``
+at all times (admission SWAPS page entries between the claimed lane and
+wherever its page was parked): the in-program scatter therefore never sees
+duplicate indices, whose resolution order XLA does not define — the
+permutation invariant is what makes the paged step deterministic.
 """
 
 from __future__ import annotations
@@ -63,7 +75,7 @@ class Session:
     active, or the ``carry_leaves`` host snapshot while evicted.
     """
 
-    __slots__ = ("sid", "tenant", "state", "slot", "pending", "out",
+    __slots__ = ("sid", "tenant", "state", "slot", "page", "pending", "out",
                  "frames_in", "frames_out", "stall_steps", "created_ns",
                  "carry_leaves", "carry_treedef", "error", "last_latency_s")
 
@@ -72,6 +84,7 @@ class Session:
         self.tenant = str(tenant)
         self.state = "active"
         self.slot: Optional[int] = None
+        self.page: Optional[int] = None   # carry-pool page while active
         self.pending: Deque[tuple] = deque()
         self.out: Deque = deque()
         self.frames_in = 0
@@ -90,6 +103,7 @@ class Session:
             "tenant": self.tenant,
             "state": self.state,
             "slot": self.slot,
+            "page": self.page,
             "frames_in": self.frames_in,
             "frames_out": self.frames_out,
             "queued": len(self.pending),
@@ -120,6 +134,13 @@ class SlotTable:
         self.slots: List[Optional[Session]] = [None] * self.capacity
         self._free: List[int] = list(range(self.capacity - 1, -1, -1))
         self.sessions: Dict[str, Session] = {}
+        # session→page binding (module docstring): page_of_lane is the
+        # permutation the engine threads into every dispatch; lane_of_page
+        # is its inverse, kept in lockstep so admission can find where a
+        # free page is parked in O(1)
+        self.page_of_lane: List[int] = list(range(self.capacity))
+        self.lane_of_page: List[int] = list(range(self.capacity))
+        self._free_pages: List[int] = list(range(self.capacity - 1, -1, -1))
 
     # -- occupancy ------------------------------------------------------------
     @property
@@ -137,23 +158,39 @@ class SlotTable:
         return [s for s in self.slots if s is not None]
 
     # -- admission / release ---------------------------------------------------
+    def _bind_page(self, slot: int) -> int:
+        """Claim the lowest free page for ``slot``, SWAPPING map entries so
+        ``page_of_lane`` stays a permutation: the claimed page is free, so
+        the lane it is currently parked at is itself free — that lane takes
+        over whatever page ``slot`` was parked with. (Release never swaps;
+        a freed page stays parked at its lane until re-claimed.)"""
+        page = self._free_pages.pop()
+        lane2 = self.lane_of_page[page]
+        if lane2 != slot:
+            page2 = self.page_of_lane[slot]
+            self.page_of_lane[slot], self.page_of_lane[lane2] = page, page2
+            self.lane_of_page[page], self.lane_of_page[page2] = slot, lane2
+        return page
+
     def admit(self, session: Session) -> int:
         """Claim a free lane for ``session`` (lowest index first — keeps the
-        active prefix dense, which is what the autotuned buckets assume).
-        Raises :class:`ServeFull` when no lane is free; the ENGINE decides
-        whether to grow to the next bucket first."""
+        active prefix dense, which is what the autotuned buckets assume)
+        and bind it the lowest free carry page. Raises :class:`ServeFull`
+        when no lane is free; the ENGINE decides whether to grow to the
+        next bucket first."""
         if not self._free:
             raise ServeFull(f"slot table at capacity ({self.capacity})")
         slot = self._free.pop()
         session.slot = slot
+        session.page = self._bind_page(slot)
         session.state = "active"
         self.slots[slot] = session
         self.sessions[session.sid] = session
         return slot
 
     def release_slot(self, session: Session) -> Optional[int]:
-        """Give the session's lane back (eviction/retire/close). The session
-        stays in the registry — ``forget`` drops it entirely."""
+        """Give the session's lane and page back (eviction/retire/close).
+        The session stays in the registry — ``forget`` drops it entirely."""
         slot = session.slot
         if slot is None:
             return None
@@ -161,6 +198,10 @@ class SlotTable:
         self._free.append(slot)
         self._free.sort(reverse=True)     # lowest-index-first reuse
         session.slot = None
+        if session.page is not None:
+            self._free_pages.append(session.page)
+            self._free_pages.sort(reverse=True)
+            session.page = None
         return slot
 
     def forget(self, session: Session) -> None:
@@ -173,6 +214,12 @@ class SlotTable:
         extra = range(self.capacity, new_capacity)
         self.slots.extend([None] * (new_capacity - self.capacity))
         self._free = sorted(self._free + list(extra), reverse=True)
+        # new pages park at the new lanes (identity tail keeps the
+        # permutation invariant); existing bindings are untouched
+        self.page_of_lane.extend(extra)
+        self.lane_of_page.extend(extra)
+        self._free_pages = sorted(self._free_pages + list(extra),
+                                  reverse=True)
         self.capacity = new_capacity
 
     def tenants(self) -> Dict[str, int]:

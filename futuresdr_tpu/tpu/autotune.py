@@ -36,6 +36,7 @@ __all__ = ["autotune", "autotune_streamed", "autotune_serve",
            "pick_wire", "StreamedResults", "record_streamed_pick",
            "cached_frames_per_dispatch", "cached_streamed_pick",
            "record_serve_buckets", "cached_serve_buckets",
+           "record_serve_pages", "cached_serve_pages",
            "record_interior_precision", "cached_interior_precision",
            "record_shard_devices", "cached_shard_devices",
            "record_pallas_blocks", "cached_pallas_blocks",
@@ -383,6 +384,17 @@ def _norm_entry(v) -> Optional[dict]:
                         out["serve_buckets"] = buckets
                 except (TypeError, ValueError):
                     pass
+            sp = v.get("serve_pages")
+            if sp is not None:
+                # round-21 axis (paged serving carries): the measured
+                # page-pool capacity pick — same per-axis guard, a
+                # malformed field loses only this axis
+                try:
+                    sp = int(sp)
+                    if sp >= 1:
+                        out["serve_pages"] = sp
+                except (TypeError, ValueError):
+                    pass
             nd = v.get("n_devices")
             if nd is not None:
                 # round-19 axis (mesh-sharded device plane): the measured
@@ -510,6 +522,8 @@ def _record_sig(sig: tuple, frames_per_dispatch: int,
     prev = _streamed_cache.get(sig) or _disk_load().get(_sig_str(sig))
     if prev and prev.get("serve_buckets"):
         entry["serve_buckets"] = list(prev["serve_buckets"])
+    if prev and prev.get("serve_pages"):
+        entry["serve_pages"] = int(prev["serve_pages"])
     if prev and prev.get("interior_precision"):
         entry["interior_precision"] = prev["interior_precision"]
     if prev and prev.get("n_devices"):
@@ -591,6 +605,35 @@ def cached_serve_buckets(pipeline, in_dtype, platform: str) -> Optional[list]:
     if entry is None:
         return None
     return entry.get("serve_buckets")
+
+
+def record_serve_pages(pipeline, in_dtype, platform: str,
+                       pages: int) -> None:
+    """Stamp the measured page-pool capacity pick (the largest bucket the
+    :func:`autotune_serve` ladder kept) next to the ladder itself — the
+    engine seeds its paged carry pool there so a restarted process reaches
+    its steady-state capacity with ONE compile instead of walking the
+    ladder through churn."""
+    pages = int(pages)
+    if pages < 1:
+        return
+    sig = _streamed_sig(_serve_sig_stages(pipeline), in_dtype, platform)
+    cur = _streamed_cache.get(sig) or _disk_load().get(_sig_str(sig)) \
+        or {"k": 1, "inflight": None}
+    entry = {**cur, "serve_pages": pages}
+    _streamed_cache[sig] = entry
+    _disk_store(sig, entry)
+
+
+def cached_serve_pages(pipeline, in_dtype, platform: str) -> Optional[int]:
+    """The cached page-pool capacity of a previously :func:`autotune_serve`d
+    chain; None when never tuned (the engine then starts at the smallest
+    bucket and grows the pool on demand)."""
+    entry = cached_streamed_pick(_serve_sig_stages(pipeline), in_dtype,
+                                 platform)
+    if entry is None:
+        return None
+    return entry.get("serve_pages")
 
 
 # ---------------------------------------------------------------------------
@@ -912,18 +955,20 @@ def autotune_serve(pipeline, frame_size: Optional[int] = None,
     fresh = pipeline.init_carry()
     for cap in sorted({int(c) for c in capacities if int(c) > 0}):
         prog = build_slot_program(pipeline, cap)
-        carries = jax.tree_util.tree_map(
+        pages = jax.tree_util.tree_map(
             lambda l: jnp.stack([jnp.asarray(l)] * cap), fresh)
+        pmap = xfer.to_device(np.arange(cap, dtype=np.int32), inst.device)
+        no_fresh = xfer.to_device(np.zeros((cap,), dtype=bool), inst.device)
         x = xfer.to_device(np.zeros((cap, fs), dtype=pipeline.in_dtype),
                            inst.device)
         act = xfer.to_device(np.ones((cap,), dtype=bool), inst.device)
         with _profile.compiling("autotune", "autotune",
                                 f"serve_cap={cap},frame={fs}"):
-            carries, outs = prog(carries, x, act)  # warmup/compile
+            pages, outs = prog(pages, pmap, no_fresh, x, act)  # warm/compile
             jax.block_until_ready(outs)
         t0 = time.perf_counter()
         for _ in range(reps):
-            carries, outs = prog(carries, x, act)
+            pages, outs = prog(pages, pmap, no_fresh, x, act)
         jax.block_until_ready(outs)
         dt = max(time.perf_counter() - t0, 1e-9)
         rate = cap * reps / dt
@@ -937,6 +982,11 @@ def autotune_serve(pipeline, frame_size: Optional[int] = None,
     if record and ladder:
         record_serve_buckets(pipeline, pipeline.in_dtype, inst.platform,
                              ladder)
+        # the largest kept bucket is the page-pool capacity pick: the
+        # engine seeds its paged pool there on the next launch (one
+        # compile) instead of growing through the ladder under churn
+        record_serve_pages(pipeline, pipeline.in_dtype, inst.platform,
+                           ladder[-1])
     return ladder, results
 
 

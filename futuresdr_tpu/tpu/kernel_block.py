@@ -78,7 +78,8 @@ from ..utils import snapshot as _snapshot
 from .frames import emit_with_tags, rebase_frame_tags
 from .instance import TpuInstance, instance
 
-__all__ = ["TpuKernel", "TpuFanoutKernel", "TpuDagKernel"]
+__all__ = ["TpuKernel", "TpuFanoutKernel", "TpuDagKernel",
+           "CreditController"]
 
 log = logger("tpu.kernel")
 _trace = _trace_recorder()
@@ -161,7 +162,11 @@ class CreditController:
     guessing from noise. An EXPLICIT depth (per-kernel ``frames_in_flight``
     argument or config ``tpu_inflight`` > 0) pins the budget entirely:
     ``adaptive=False`` makes every note a no-op, so depth=1 A/B baselines
-    keep their strictly-serial contract."""
+    keep their strictly-serial contract.
+
+    The serving plane reuses this controller verbatim for its overlapped
+    step (``ServeEngine``, config ``serve_inflight``): one dispatch GROUP
+    per credit instead of one frame, same signals, same hysteresis."""
 
     __slots__ = ("credits", "lo", "hi", "adaptive", "window",
                  "_prev_deadline", "_idle_s", "_limited", "_max_seen",

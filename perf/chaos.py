@@ -613,7 +613,7 @@ def scenario_tenant_isolation():
     finally:
         faults.reset()
     # journal story: the session was admitted, then retired by the fault
-    _journal_story(since, ("serve", "admit"), ("serve", "retire"),
+    _journal_story(since, ("serve", "page-admit"), ("serve", "retire"),
                    label="tenant_isolation")
     vb = eng.session_view("csb")
     assert vb["state"] == "retired" and vb["error"], vb
@@ -666,6 +666,51 @@ def _serve_child_main(workdir: str) -> int:
         # flushed BEFORE the marker: once the parent has seen "STEP i",
         # a kill at any later instant leaves at least step i's snapshot
         # complete on disk (atomic rename covers the torn-write case)
+        eng.flush_persist()
+        print(f"STEP {i}", flush=True)
+        time.sleep(0.005)
+    return 0
+
+
+def _serve_churn_child_main(workdir: str) -> int:
+    """The ``--_serve-churn-child`` entry: a serving loop under CONSTANT
+    page churn — every step the oldest session leaves and a never-seen
+    sid joins at its own frame 0 (pure page-map edits on the resident
+    capacity) with the overlapped step in flight (inflight=2) and
+    per-step durable persistence. The parent SIGKILLs it mid-churn at an
+    arbitrary marker; sids are NEVER reused, so whichever sessions the
+    restart finds, their crc32-derived streams are reconstructible."""
+    from futuresdr_tpu.serve import ServeEngine
+    eng = ServeEngine(_serve_chaos_pipe(), frame_size=512,
+                      app="churn_crash", buckets=(4,), queue_frames=8,
+                      inflight=2, persist_dir=workdir, persist_every=1)
+    live, cursors, streams = [], {}, {}
+    next_id = 0
+
+    def join():
+        nonlocal next_id
+        sid = f"ch{next_id}"
+        next_id += 1
+        eng.admit(tenant="t", sid=sid)
+        live.append(sid)
+        cursors[sid] = 0
+        streams[sid] = _serve_chaos_frames(sid)
+        return sid
+
+    for _ in range(3):
+        join()
+    for i in range(64):
+        gone = live.pop(0)                 # churn: leave + fresh join,
+        eng.close(gone)                    # every single step
+        streams.pop(gone), cursors.pop(gone)
+        join()
+        for sid in live:
+            if eng.submit(sid, streams[sid][cursors[sid] % 64]):
+                cursors[sid] += 1
+        eng.step()
+        # flushed BEFORE the marker (same contract as the plain serve
+        # child): once "STEP i" is printed, a kill at any later instant
+        # leaves at least step i's committed snapshots complete on disk
         eng.flush_persist()
         print(f"STEP {i}", flush=True)
         time.sleep(0.005)
@@ -803,6 +848,100 @@ def scenario_serve_crash_restart():
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
     _assert_no_leaked_threads(before, "serve_crash_restart")
+
+
+def scenario_serve_churn_crash():
+    """Acceptance (ISSUE 20): SIGKILL a serving process MID-CHURN — a
+    session leaving and a fresh sid joining every single step, with the
+    overlapped step keeping speculative groups in flight — and a virgin
+    incarnation over the same persist dir resumes EVERY surviving session
+    bit-identically from its persisted cursor. Page-map churn and the
+    launch/commit window never corrupt durable session state: carries are
+    committed (and therefore persisted) only after D2H completes."""
+    import shutil
+    import subprocess
+    import tempfile
+    from futuresdr_tpu.serve import ServeEngine
+    workdir = tempfile.mkdtemp(prefix="fsdr_serve_churn_")
+    env = os.environ.copy()
+    env.update(JAX_PLATFORMS="cpu", FUTURESDR_TPU_AUTOTUNE_CACHE_DIR="off")
+    before = _threads_now()
+    try:
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--_serve-churn-child", workdir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        try:
+            import queue
+            lines: "queue.Queue" = queue.Queue()
+
+            def _pump_stdout():
+                for line in p.stdout:
+                    lines.put(line)
+
+            threading.Thread(target=_pump_stdout, daemon=True,
+                             name="chaos-churn-child-stdout").start()
+            steps_seen = 0
+            deadline = time.monotonic() + 120.0
+            # at least 8 churn steps: the kill lands with the page map
+            # several join/leave generations away from the seed layout
+            while steps_seen < 8:
+                wait = deadline - time.monotonic()
+                assert wait > 0, \
+                    f"churn child never reached 8 steps ({steps_seen})"
+                try:
+                    line = lines.get(timeout=min(wait, 5.0))
+                except queue.Empty:
+                    assert p.poll() is None, \
+                        f"churn child exited early ({steps_seen} steps)"
+                    continue
+                if line.startswith("STEP"):
+                    steps_seen += 1
+            p.kill()                       # SIGKILL — no atexit, no flush
+        finally:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait(timeout=30)
+        # restart: a VIRGIN incarnation over the same persist dir. Which
+        # sids survived depends on where the kill landed — enumerate them.
+        eng = ServeEngine(_serve_chaos_pipe(), frame_size=512,
+                          app="churn_crash", buckets=(4,), queue_frames=8,
+                          inflight=2, persist_dir=workdir, persist_every=1)
+        try:
+            survivors = sorted(sid for sid, s in eng.table.sessions.items()
+                               if s.state == "active")
+            assert eng.restored_sessions == len(survivors) >= 1, \
+                (eng.restored_sessions, survivors)
+            import jax
+            fn = jax.jit(_serve_chaos_pipe().fn())
+            for sid in survivors:
+                s = eng.table.get(sid)
+                start = s.frames_out
+                frames = _serve_chaos_frames(sid)
+                # unfailed reference: the bare pipeline over the full
+                # stream this sid would have seen (crc32-seeded, so the
+                # virgin process derives the identical frames)
+                carry = _serve_chaos_pipe().init_carry()
+                ref = []
+                for f in frames[:start + 6]:
+                    carry, y = fn(carry, f)
+                    ref.append(np.asarray(y))
+                for f in frames[start:start + 6]:
+                    assert eng.submit(sid, f), sid
+                while eng.step():
+                    pass
+                got = eng.results(sid)
+                assert len(got) == 6, (sid, len(got))
+                for a, b in zip(got, ref[start:]):
+                    np.testing.assert_array_equal(a, b, err_msg=sid)
+        finally:
+            eng.shutdown()
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    _assert_no_leaked_threads(before, "serve_churn_crash")
 
 
 _SHARD_REPLAY_WORKER = r"""
@@ -986,7 +1125,7 @@ def scenario_serve_overload_shed():
         # admitted -> the storm tripped the ladder (a shed-rung transition
         # UP, with a rung-1 refusal) -> traffic passed -> the ladder
         # unwound (the LAST shed-rung transition lands back at level 0)
-        evs = _journal_story(since, ("serve", "admit"),
+        evs = _journal_story(since, ("serve", "page-admit"),
                              ("serve", "shed-rung"), ("serve", "refuse"),
                              label="serve_overload_shed")
         rungs = [e for e in evs if (e["cat"], e["event"]) ==
@@ -1402,6 +1541,7 @@ SCENARIOS = (
     ("isolate-group", scenario_isolate_group),
     ("tenant-isolation", scenario_tenant_isolation),
     ("serve-crash-restart", scenario_serve_crash_restart),
+    ("serve-churn-crash", scenario_serve_churn_crash),
     ("serve-overload-shed", scenario_serve_overload_shed),
     ("fleet-host-crash", scenario_fleet_host_crash),
     ("shard-replay", scenario_shard_replay),
@@ -1419,6 +1559,8 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--_serve-child", dest="serve_child", default=None,
                     metavar="DIR", help=argparse.SUPPRESS)
+    ap.add_argument("--_serve-churn-child", dest="serve_churn_child",
+                    default=None, metavar="DIR", help=argparse.SUPPRESS)
     ap.add_argument("--_fleet-child", dest="fleet_child", default=None,
                     nargs=2, metavar=("DIR", "PORT"),
                     help=argparse.SUPPRESS)
@@ -1434,6 +1576,11 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms",
                           os.environ.get("JAX_PLATFORMS", "cpu"))
         return _serve_child_main(args.serve_child)
+    if args.serve_churn_child:
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ.get("JAX_PLATFORMS", "cpu"))
+        return _serve_churn_child_main(args.serve_churn_child)
     import jax
     jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
     t_all = time.perf_counter()

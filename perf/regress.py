@@ -51,6 +51,13 @@ FIELDS_SAME_BACKEND = ("value", "streamed_msps", "streamed_wire_msps",
                        "streamed_link_utilization", "host_codec_overlap_frac",
                        "fm_msps", "wlan_msps", "lora_msps",
                        "serve_sessions_per_chip",
+                       # paged serving engine (docs/serving.md "Paged
+                       # session carries"): sessions/chip measured with
+                       # join/leave EVERY step — the capacity the chip
+                       # retains while the tenancy churns; a page-table or
+                       # admission-path regression (restacks, recompiles)
+                       # reads as this dropping against reference
+                       "serve_churn_sessions_per_chip",
                        # crash-safe serving (docs/robustness.md
                        # "Serving-plane recovery"): fraction of persisted
                        # sessions a virgin incarnation resumes
